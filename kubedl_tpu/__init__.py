@@ -1,0 +1,20 @@
+"""kubedl-tpu: a TPU-native ML-workload operator + runtime.
+
+A brand-new framework with the capabilities of KubeDL (reference:
+mental2008/kubedl): distributed training jobs, model packaging, inference
+serving, notebooks, cron scheduling, and dataset caching as Kubernetes CRDs
+reconciled by a single controller-manager — re-designed for Cloud TPU slices
+on GKE. Pod specs request ``google.com/tpu`` with topology nodeSelectors,
+rendezvous is wired to ``jax.distributed`` / the XLA PJRT coordinator, and
+gang scheduling co-schedules whole TPU slices atomically.
+
+The package has two halves:
+
+* the **operator** (``core``, ``api``, ``controllers``, ``tpu``,
+  ``scheduling``, ``metrics``, ``storage``) — the control plane; and
+* the **runtime** (``models``, ``ops``, ``parallel``, ``train``,
+  ``runtime``, ``serving``) — the TPU-native JAX compute stack that the
+  operator's pods actually run.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,1 @@
+"""CRD type system: common job vocabulary + per-workload kinds."""

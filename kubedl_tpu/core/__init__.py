@@ -1,0 +1,1 @@
+"""Kubernetes substrate: object model, in-memory API server, client, manager."""

@@ -1,0 +1,273 @@
+"""In-memory Kubernetes-compatible API server.
+
+Plays two roles, mirroring how the reference tests everything against
+controller-runtime's fake client (reference ``controllers/suite_tests/
+suite_test.go:40-66`` builds ``fake.NewFakeClientWithScheme``):
+
+1. the **fake client** for the whole test pyramid (no cluster needed), and
+2. a **standalone control plane**: kubedl-tpu can run self-hosted on a TPU VM
+   with no Kubernetes at all, reconciling CRs submitted through this store.
+
+Semantics implemented (the subset the operator relies on):
+
+* CRUD with optimistic concurrency (``resourceVersion`` conflict on update),
+* ``metadata.generation`` bump on spec change (k8s semantics: status updates
+  do not bump generation),
+* finalizers: delete sets ``deletionTimestamp`` while finalizers remain; the
+  object is removed once the last finalizer is stripped,
+* cascading deletion of controller-owned dependents (background GC),
+* watch fan-out: subscribers receive ``(event_type, obj)`` tuples for
+  ADDED / MODIFIED / DELETED, the signal controller-runtime feeds workqueues
+  from (reference ``controllers/pytorch/pytorchjob_controller.go:148-185``).
+"""
+
+from __future__ import annotations
+
+import copy
+import threading
+import time
+from typing import Callable, Iterable, Optional
+
+from . import meta as m
+
+Obj = dict
+
+
+class ApiError(Exception):
+    pass
+
+
+class NotFound(ApiError):
+    pass
+
+
+class AlreadyExists(ApiError):
+    pass
+
+
+class Conflict(ApiError):
+    pass
+
+
+class Invalid(ApiError):
+    pass
+
+
+_ts = m.rfc3339
+
+
+class APIServer:
+    """Thread-safe in-memory object store with watch fan-out."""
+
+    def __init__(self, clock: Callable[[], float] = time.time):
+        self._clock = clock
+        self._objs: dict[tuple[str, str, str], Obj] = {}
+        self._rv = 0
+        self._lock = threading.RLock()
+        self._watchers: list[Callable[[str, Obj], None]] = []
+
+    # -- helpers ----------------------------------------------------------
+
+    def now(self) -> float:
+        return self._clock()
+
+    def _key(self, kind: str, namespace: str, name: str):
+        return (kind, namespace, name)
+
+    def _next_rv(self) -> int:
+        self._rv += 1
+        return self._rv
+
+    def _emit(self, event_type: str, obj: Obj):
+        for w in list(self._watchers):
+            w(event_type, copy.deepcopy(obj))
+
+    def watch(self, fn: Callable[[str, Obj], None]) -> Callable[[], None]:
+        """Subscribe to all object events. Returns an unsubscribe fn."""
+        with self._lock:
+            self._watchers.append(fn)
+
+        def cancel():
+            with self._lock:
+                if fn in self._watchers:
+                    self._watchers.remove(fn)
+        return cancel
+
+    # -- CRUD -------------------------------------------------------------
+
+    def create(self, obj: Obj) -> Obj:
+        obj = copy.deepcopy(obj)
+        md = m.meta(obj)
+        if not md.get("name"):
+            if md.get("generateName"):
+                md["name"] = md["generateName"] + m.new_uid()[:8]
+            else:
+                raise Invalid("object has no metadata.name")
+        md.setdefault("namespace", "default")
+        k = self._key(m.kind(obj), md["namespace"], md["name"])
+        with self._lock:
+            if k in self._objs:
+                raise AlreadyExists(f"{m.kind(obj)} {md['namespace']}/{md['name']} already exists")
+            md["uid"] = m.new_uid()
+            md["resourceVersion"] = self._next_rv()
+            md["generation"] = 1
+            md["creationTimestamp"] = _ts(self.now())
+            self._objs[k] = copy.deepcopy(obj)
+        self._emit("ADDED", obj)
+        return copy.deepcopy(obj)
+
+    def get(self, kind: str, namespace: str, name: str) -> Obj:
+        with self._lock:
+            k = self._key(kind, namespace, name)
+            if k not in self._objs:
+                raise NotFound(f"{kind} {namespace}/{name} not found")
+            return copy.deepcopy(self._objs[k])
+
+    def try_get(self, kind: str, namespace: str, name: str) -> Optional[Obj]:
+        try:
+            return self.get(kind, namespace, name)
+        except NotFound:
+            return None
+
+    def list(self, kind: str, namespace: Optional[str] = None,
+             selector: Optional[dict] = None) -> list[Obj]:
+        with self._lock:
+            out = []
+            for (kd, ns, _), obj in self._objs.items():
+                if kd != kind:
+                    continue
+                if namespace is not None and ns != namespace:
+                    continue
+                if selector is not None and not m.match_labels(
+                        m.meta(obj).get("labels", {}) or {}, selector):
+                    continue
+                out.append(copy.deepcopy(obj))
+            out.sort(key=lambda o: (m.namespace(o), m.name(o)))
+            return out
+
+    def update(self, obj: Obj, subresource: Optional[str] = None) -> Obj:
+        """Full replace with optimistic concurrency.
+
+        ``subresource="status"`` replaces only ``.status`` (generation not
+        bumped); otherwise spec/meta are replaced and generation bumps when
+        the spec changed.
+        """
+        obj = copy.deepcopy(obj)
+        md = m.meta(obj)
+        k = self._key(m.kind(obj), md.get("namespace", "default"), md.get("name", ""))
+        with self._lock:
+            if k not in self._objs:
+                raise NotFound(f"{m.kind(obj)} {md.get('namespace')}/{md.get('name')} not found")
+            cur = self._objs[k]
+            cur_rv = m.resource_version(cur)
+            if md.get("resourceVersion") and int(md["resourceVersion"]) != cur_rv:
+                raise Conflict(
+                    f"resourceVersion mismatch for {k}: stored {cur_rv}, "
+                    f"caller supplied {md.get('resourceVersion')}")
+            if subresource == "status":
+                new = copy.deepcopy(cur)
+                if "status" in obj:
+                    new["status"] = obj["status"]
+                else:
+                    new.pop("status", None)
+            else:
+                new = obj
+                # immutable / server-managed fields
+                nm = m.meta(new)
+                nm["uid"] = m.uid(cur)
+                nm["creationTimestamp"] = m.meta(cur).get("creationTimestamp")
+                if m.is_deleting(cur):  # deletionTimestamp is immutable once set
+                    nm["deletionTimestamp"] = m.deletion_timestamp(cur)
+                if "status" not in new and "status" in cur:
+                    new["status"] = copy.deepcopy(cur["status"])
+                if new.get("spec") != cur.get("spec"):
+                    nm["generation"] = m.generation(cur) + 1
+                else:
+                    nm["generation"] = m.generation(cur)
+            m.meta(new)["resourceVersion"] = self._next_rv()
+            self._objs[k] = copy.deepcopy(new)
+            finalizing = (m.is_deleting(new) and not m.finalizers(new))
+        if finalizing:
+            # last finalizer removed while deleting -> actually remove
+            self._remove(new)
+        else:
+            self._emit("MODIFIED", new)
+        return copy.deepcopy(new)
+
+    def update_status(self, obj: Obj) -> Obj:
+        return self.update(obj, subresource="status")
+
+    def patch_merge(self, kind: str, namespace: str, name: str, patch: Obj) -> Obj:
+        """Strategic-ish merge patch: dicts merge recursively, lists replace.
+
+        Mirrors the reference's patch utilities (``pkg/util/patch``) used for
+        annotation updates in the elastic-checkpoint protocol.
+        """
+        with self._lock:
+            cur = self.get(kind, namespace, name)
+            merged = _merge(cur, copy.deepcopy(patch))
+            m.meta(merged)["resourceVersion"] = m.resource_version(cur)
+            return self.update(merged)
+
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        with self._lock:
+            k = self._key(kind, namespace, name)
+            if k not in self._objs:
+                raise NotFound(f"{kind} {namespace}/{name} not found")
+            obj = self._objs[k]
+            if m.meta(obj).get("finalizers"):
+                if not m.is_deleting(obj):
+                    m.meta(obj)["deletionTimestamp"] = _ts(self.now())
+                    m.meta(obj)["resourceVersion"] = self._next_rv()
+                    obj = copy.deepcopy(obj)
+                    self._emit("MODIFIED", obj)
+                return
+        self._remove(self.get(kind, namespace, name))
+
+    def _remove(self, obj: Obj) -> None:
+        k = self._key(m.kind(obj), m.namespace(obj), m.name(obj))
+        with self._lock:
+            removed = self._objs.pop(k, None)
+        if removed is None:
+            return
+        self._emit("DELETED", removed)
+        self._gc_dependents(removed)
+
+    def _gc_dependents(self, owner: Obj) -> None:
+        """Background-policy cascading GC of controller-owned dependents."""
+        owner_uid = m.uid(owner)
+        with self._lock:
+            dependents = [
+                (m.kind(o), m.namespace(o), m.name(o))
+                for o in self._objs.values()
+                if any(r.get("uid") == owner_uid for r in m.meta(o).get("ownerReferences", []) or [])
+            ]
+        for kd, ns, nm in dependents:
+            try:
+                self.delete(kd, ns, nm)
+            except NotFound:
+                pass
+
+    # -- test/introspection helpers --------------------------------------
+
+    def kinds(self) -> set:
+        with self._lock:
+            return {k[0] for k in self._objs}
+
+    def __len__(self):
+        with self._lock:
+            return len(self._objs)
+
+
+def _merge(base, patch):
+    if isinstance(base, dict) and isinstance(patch, dict):
+        out = dict(base)
+        for k, v in patch.items():
+            if v is None:
+                out.pop(k, None)
+            elif k in out:
+                out[k] = _merge(out[k], v)
+            else:
+                out[k] = copy.deepcopy(v)
+        return out
+    return patch

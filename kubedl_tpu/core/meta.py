@@ -1,0 +1,208 @@
+"""Helpers over dict-shaped (JSON-shaped) Kubernetes API objects.
+
+All API objects in kubedl-tpu — Pods, Services, and our CRDs alike — are
+plain nested dicts shaped exactly like their JSON wire form. This module is
+the vocabulary for reading/writing ``metadata``, owner references, and label
+selectors, mirroring the roles of apimachinery's ``ObjectMeta`` helpers used
+throughout the reference operator (e.g. controller refs set in
+``pkg/job_controller/pod_control.go``, selector matching in
+``pkg/job_controller/pod.go:532-554``).
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Any, Iterable, Optional
+
+Obj = dict  # alias for readability: a JSON-shaped API object
+
+
+def rfc3339(t: Optional[float] = None) -> str:
+    """The one RFC3339 UTC timestamp formatter used across the package."""
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                         time.gmtime(time.time() if t is None else t))
+
+
+def new_obj(api_version: str, kind: str, name: str, namespace: str = "default",
+            labels: Optional[dict] = None, annotations: Optional[dict] = None,
+            spec: Optional[dict] = None) -> Obj:
+    obj: Obj = {
+        "apiVersion": api_version,
+        "kind": kind,
+        "metadata": {
+            "name": name,
+            "namespace": namespace,
+        },
+    }
+    if labels:
+        obj["metadata"]["labels"] = dict(labels)
+    if annotations:
+        obj["metadata"]["annotations"] = dict(annotations)
+    if spec is not None:
+        obj["spec"] = spec
+    return obj
+
+
+def meta(obj: Obj) -> dict:
+    return obj.setdefault("metadata", {})
+
+
+def name(obj: Obj) -> str:
+    return meta(obj).get("name", "")
+
+
+def namespace(obj: Obj) -> str:
+    return meta(obj).get("namespace", "default")
+
+
+def uid(obj: Obj) -> str:
+    return meta(obj).get("uid", "")
+
+
+def kind(obj: Obj) -> str:
+    return obj.get("kind", "")
+
+
+def api_version(obj: Obj) -> str:
+    return obj.get("apiVersion", "")
+
+
+def key(obj: Obj) -> str:
+    """namespace/name key, the workqueue key format."""
+    return f"{namespace(obj)}/{name(obj)}"
+
+
+def labels(obj: Obj) -> dict:
+    return meta(obj).setdefault("labels", {})
+
+
+def annotations(obj: Obj) -> dict:
+    return meta(obj).setdefault("annotations", {})
+
+
+def generation(obj: Obj) -> int:
+    return int(meta(obj).get("generation", 0))
+
+
+def resource_version(obj: Obj) -> int:
+    return int(meta(obj).get("resourceVersion", 0))
+
+
+def finalizers(obj: Obj) -> list:
+    return meta(obj).setdefault("finalizers", [])
+
+
+def deletion_timestamp(obj: Obj):
+    return meta(obj).get("deletionTimestamp")
+
+
+def is_deleting(obj: Obj) -> bool:
+    return meta(obj).get("deletionTimestamp") is not None
+
+
+def new_uid() -> str:
+    return str(uuid.uuid4())
+
+
+# ---------------------------------------------------------------------------
+# Owner references
+# ---------------------------------------------------------------------------
+
+def owner_references(obj: Obj) -> list:
+    return meta(obj).setdefault("ownerReferences", [])
+
+
+def owner_ref(owner: Obj, controller: bool = True,
+              block_owner_deletion: bool = True) -> dict:
+    return {
+        "apiVersion": api_version(owner),
+        "kind": kind(owner),
+        "name": name(owner),
+        "uid": uid(owner),
+        "controller": controller,
+        "blockOwnerDeletion": block_owner_deletion,
+    }
+
+
+def set_controller_ref(obj: Obj, owner: Obj) -> None:
+    """Make `owner` the managing controller of `obj` (one per object)."""
+    refs = [r for r in owner_references(obj) if not r.get("controller")]
+    refs.append(owner_ref(owner, controller=True))
+    meta(obj)["ownerReferences"] = refs
+
+
+def get_controller_ref(obj: Obj) -> Optional[dict]:
+    for r in owner_references(obj):
+        if r.get("controller"):
+            return r
+    return None
+
+
+def is_controlled_by(obj: Obj, owner: Obj) -> bool:
+    ref = get_controller_ref(obj)
+    return bool(ref and ref.get("uid") == uid(owner))
+
+
+# ---------------------------------------------------------------------------
+# Label selectors
+# ---------------------------------------------------------------------------
+
+def match_labels(obj_labels: dict, selector: dict) -> bool:
+    """Selector = {matchLabels: {...}, matchExpressions: [...]} or a bare
+    matchLabels mapping."""
+    if selector is None:
+        return True
+    if "matchLabels" in selector or "matchExpressions" in selector:
+        ml = selector.get("matchLabels", {})
+    else:  # bare mapping is treated as matchLabels
+        ml = selector
+    for k, v in (ml or {}).items():
+        if obj_labels.get(k) != v:
+            return False
+    for expr in selector.get("matchExpressions", []) or []:
+        k = expr.get("key")
+        op = expr.get("operator")
+        vals = expr.get("values", []) or []
+        has = k in obj_labels
+        if op == "In" and (not has or obj_labels[k] not in vals):
+            return False
+        if op == "NotIn" and has and obj_labels[k] in vals:
+            return False
+        if op == "Exists" and not has:
+            return False
+        if op == "DoesNotExist" and has:
+            return False
+    return True
+
+
+def select(objs: Iterable[Obj], selector: Optional[dict]) -> list:
+    return [o for o in objs if match_labels(labels(o), selector or {})]
+
+
+# ---------------------------------------------------------------------------
+# Misc structural helpers
+# ---------------------------------------------------------------------------
+
+def get_in(obj: Any, *path, default=None):
+    cur = obj
+    for p in path:
+        if isinstance(cur, dict):
+            if p not in cur:
+                return default
+            cur = cur[p]
+        elif isinstance(cur, list):
+            if not isinstance(p, int) or p >= len(cur):
+                return default
+            cur = cur[p]
+        else:
+            return default
+    return cur
+
+
+def set_in(obj: dict, *path_and_value):
+    *path, value = path_and_value
+    cur = obj
+    for p in path[:-1]:
+        cur = cur.setdefault(p, {})
+    cur[path[-1]] = value

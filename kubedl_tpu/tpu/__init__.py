@@ -1,0 +1,1 @@
+"""TPU placement: topology tables, slice math, pod-spec rendering."""

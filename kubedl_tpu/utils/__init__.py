@@ -1,0 +1,1 @@
+"""Cross-cutting utilities: conditions, retry classification, resources."""

@@ -1,0 +1,44 @@
+"""Test harness: force an 8-device virtual CPU mesh before jax imports.
+
+Mirrors the reference's test strategy (SURVEY.md §4): no real accelerators
+in CI — multi-chip topology is data, asserted on rendered specs, plus a
+virtual 8-device CPU mesh for the sharded compute path.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+from kubedl_tpu.core.apiserver import APIServer  # noqa: E402
+from kubedl_tpu.core.manager import Manager  # noqa: E402
+
+
+class FakeClock:
+    def __init__(self, t0: float = 1_700_000_000.0):
+        self.t = t0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float):
+        self.t += dt
+
+
+@pytest.fixture
+def clock():
+    return FakeClock()
+
+
+@pytest.fixture
+def api(clock):
+    return APIServer(clock=clock)
+
+
+@pytest.fixture
+def manager(api, clock):
+    return Manager(api, clock=clock)

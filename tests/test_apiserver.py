@@ -1,0 +1,123 @@
+"""In-memory API server semantics: CRUD, RV conflicts, finalizers, GC."""
+
+import pytest
+
+from kubedl_tpu.core import meta as m
+from kubedl_tpu.core.apiserver import AlreadyExists, Conflict, NotFound
+
+
+def mk(kind="PyTorchJob", name="job1", ns="default", spec=None):
+    return m.new_obj("training.kubedl.io/v1alpha1", kind, name, ns,
+                     spec=spec if spec is not None else {"x": 1})
+
+
+def test_create_get_list_delete(api):
+    obj = api.create(mk())
+    assert m.uid(obj)
+    assert m.generation(obj) == 1
+    got = api.get("PyTorchJob", "default", "job1")
+    assert got["spec"] == {"x": 1}
+    assert api.list("PyTorchJob") and not api.list("TFJob")
+    api.delete("PyTorchJob", "default", "job1")
+    with pytest.raises(NotFound):
+        api.get("PyTorchJob", "default", "job1")
+
+
+def test_create_duplicate(api):
+    api.create(mk())
+    with pytest.raises(AlreadyExists):
+        api.create(mk())
+
+
+def test_update_conflict_and_generation(api):
+    obj = api.create(mk())
+    stale_rv = m.resource_version(obj)
+    obj["spec"] = {"x": 2}
+    obj = api.update(obj)
+    assert m.generation(obj) == 2  # spec change bumps generation
+
+    # stale writer loses
+    stale = mk(spec={"x": 3})
+    stale["metadata"]["resourceVersion"] = stale_rv
+    with pytest.raises(Conflict):
+        api.update(stale)
+
+    # status update does not bump generation
+    obj["status"] = {"phase": "Running"}
+    obj = api.update_status(obj)
+    assert m.generation(obj) == 2
+    assert api.get("PyTorchJob", "default", "job1")["status"] == {"phase": "Running"}
+
+
+def test_status_update_does_not_touch_spec(api):
+    obj = api.create(mk())
+    upd = {"apiVersion": obj["apiVersion"], "kind": "PyTorchJob",
+           "metadata": {"name": "job1", "namespace": "default"},
+           "spec": {"x": 999}, "status": {"ok": True}}
+    api.update(upd, subresource="status")
+    got = api.get("PyTorchJob", "default", "job1")
+    assert got["spec"] == {"x": 1}
+    assert got["status"] == {"ok": True}
+
+
+def test_finalizer_blocks_delete(api):
+    obj = mk()
+    obj["metadata"]["finalizers"] = ["kubedl.io/preempt-protector"]
+    api.create(obj)
+    api.delete("PyTorchJob", "default", "job1")
+    got = api.get("PyTorchJob", "default", "job1")
+    assert m.is_deleting(got)
+    got["metadata"]["finalizers"] = []
+    api.update(got)
+    with pytest.raises(NotFound):
+        api.get("PyTorchJob", "default", "job1")
+
+
+def test_cascading_gc(api):
+    owner = api.create(mk())
+    pod = m.new_obj("v1", "Pod", "job1-worker-0", "default", spec={})
+    m.set_controller_ref(pod, owner)
+    api.create(pod)
+    assert len(api.list("Pod")) == 1
+    api.delete("PyTorchJob", "default", "job1")
+    assert api.list("Pod") == []
+
+
+def test_label_selector_list(api):
+    for i in range(3):
+        p = m.new_obj("v1", "Pod", f"p{i}", "default",
+                      labels={"job-name": "j" if i < 2 else "k"})
+        api.create(p)
+    assert len(api.list("Pod", selector={"job-name": "j"})) == 2
+    assert len(api.list("Pod", selector={"matchLabels": {"job-name": "k"}})) == 1
+    sel = {"matchExpressions": [{"key": "job-name", "operator": "In", "values": ["j"]}]}
+    assert len(api.list("Pod", selector=sel)) == 2
+
+
+def test_patch_merge(api):
+    api.create(mk())
+    api.patch_merge("PyTorchJob", "default", "job1",
+                    {"metadata": {"annotations": {"a": "1"}}})
+    got = api.get("PyTorchJob", "default", "job1")
+    assert got["metadata"]["annotations"] == {"a": "1"}
+    # deep merge keeps siblings, None deletes
+    api.patch_merge("PyTorchJob", "default", "job1",
+                    {"metadata": {"annotations": {"b": "2"}}})
+    api.patch_merge("PyTorchJob", "default", "job1",
+                    {"metadata": {"annotations": {"a": None}}})
+    got = api.get("PyTorchJob", "default", "job1")
+    assert got["metadata"]["annotations"] == {"b": "2"}
+
+
+def test_watch_events(api):
+    events = []
+    cancel = api.watch(lambda t, o: events.append((t, m.name(o))))
+    api.create(mk())
+    obj = api.get("PyTorchJob", "default", "job1")
+    obj["spec"] = {"x": 5}
+    api.update(obj)
+    api.delete("PyTorchJob", "default", "job1")
+    assert events == [("ADDED", "job1"), ("MODIFIED", "job1"), ("DELETED", "job1")]
+    cancel()
+    api.create(mk(name="job2"))
+    assert len(events) == 3

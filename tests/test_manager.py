@@ -1,0 +1,87 @@
+"""Manager queue semantics: routing, dedup, supersede, backoff."""
+
+from kubedl_tpu.core import meta as m
+from kubedl_tpu.core.manager import Manager, Reconciler, Request, Result
+
+
+class Recording(Reconciler):
+    kind = "TestJob"
+    owns = ("Pod",)
+
+    def __init__(self, result=None, fail_times=0):
+        self.calls = []
+        self.result = result
+        self.fail_times = fail_times
+
+    def reconcile(self, req):
+        self.calls.append(req)
+        if self.fail_times > 0:
+            self.fail_times -= 1
+            raise RuntimeError("boom")
+        return self.result
+
+
+def test_primary_and_owned_routing(api, manager):
+    rec = manager.register(Recording())
+    job = api.create(m.new_obj("t/v1", "TestJob", "j1"))
+    manager.run_until_idle()
+    assert rec.calls == [Request("TestJob", "default", "j1")]
+
+    pod = m.new_obj("v1", "Pod", "j1-w-0")
+    m.set_controller_ref(pod, job)
+    api.create(pod)
+    manager.run_until_idle()
+    assert rec.calls[-1] == Request("TestJob", "default", "j1")
+    assert len(rec.calls) == 2
+
+
+def test_unowned_pod_not_routed(api, manager):
+    rec = manager.register(Recording())
+    api.create(m.new_obj("v1", "Pod", "stray"))
+    manager.run_until_idle()
+    assert rec.calls == []
+
+
+def test_immediate_event_supersedes_delayed_requeue(api, manager, clock):
+    """A watch event during a long requeue_after window must reconcile now,
+    not wait out the timer."""
+    rec = manager.register(Recording(result=Result(requeue_after=300)))
+    api.create(m.new_obj("t/v1", "TestJob", "j1"))
+    manager.run_until_idle()
+    assert len(rec.calls) == 1  # delayed self-requeue parked for +300s
+
+    # a pod failure event arrives 10s later
+    clock.advance(10)
+    job = api.get("TestJob", "default", "j1")
+    pod = m.new_obj("v1", "Pod", "j1-w-0")
+    m.set_controller_ref(pod, job)
+    api.create(pod)
+    manager.run_until_idle()
+    assert len(rec.calls) == 2  # reconciled immediately, not at +300
+
+    # and the delayed entry still fires once its time comes
+    clock.advance(301)
+    manager.run_until_idle()
+    assert len(rec.calls) == 3
+
+
+def test_failure_backoff_and_recovery(api, manager, clock):
+    rec = manager.register(Recording(fail_times=2))
+    api.create(m.new_obj("t/v1", "TestJob", "j1"))
+    manager.run_until_idle()
+    assert len(rec.calls) == 1  # first attempt failed, retry parked
+    clock.advance(1)
+    manager.run_until_idle()
+    clock.advance(1)
+    manager.run_until_idle()
+    assert len(rec.calls) == 3  # two retries ran; third attempt succeeded
+    assert manager.pending() == 0
+
+
+def test_dedup_same_key(api, manager):
+    rec = manager.register(Recording())
+    api.create(m.new_obj("t/v1", "TestJob", "j1"))
+    manager.enqueue(Request("TestJob", "default", "j1"))
+    manager.enqueue(Request("TestJob", "default", "j1"))
+    manager.run_until_idle()
+    assert len(rec.calls) == 1

@@ -1,0 +1,75 @@
+"""Golden-spec assertions on TPU worker pod rendering (the analog of the
+reference's rendered-env tests, e.g. controllers/xgboost/pod_test.go:98-122)."""
+
+from kubedl_tpu.tpu import placement as pl
+from kubedl_tpu.tpu.topology import parse_accelerator
+
+
+def worker_pod():
+    return {"spec": {"containers": [{"name": "pytorch", "image": "train:latest"}]}}
+
+
+def test_render_v5p32_worker():
+    s = parse_accelerator("v5p-32")
+    pod = pl.render_tpu_worker(
+        worker_pod(), slice_spec=s, job_name="llama", namespace="ns1",
+        replica_type="Worker", worker_id=2)
+    spec = pod["spec"]
+    assert spec["nodeSelector"] == {
+        "cloud.google.com/gke-tpu-accelerator": "tpu-v5p-slice",
+        "cloud.google.com/gke-tpu-topology": "2x2x4",
+    }
+    ct = spec["containers"][0]
+    assert ct["resources"]["limits"]["google.com/tpu"] == "4"
+    assert ct["resources"]["requests"]["google.com/tpu"] == "4"
+    env = {e["name"]: e.get("value") for e in ct["env"]}
+    assert env["TPU_WORKER_ID"] == "2"
+    assert env["TPU_WORKER_HOSTNAMES"] == (
+        "llama-worker-0.ns1.svc,llama-worker-1.ns1.svc,"
+        "llama-worker-2.ns1.svc,llama-worker-3.ns1.svc")
+    assert env["KUBEDL_COORDINATOR_ADDRESS"] == "llama-worker-0.ns1.svc:8476"
+    assert env["KUBEDL_NUM_PROCESSES"] == "4"
+    assert env["KUBEDL_PROCESS_ID"] == "2"
+    assert env["TPU_ACCELERATOR_TYPE"] == "v5p-32"
+    assert "MEGASCALE_NUM_SLICES" not in env
+    assert any(t["key"] == "google.com/tpu" for t in spec["tolerations"])
+    assert {"name": "coordinator", "containerPort": 8476} in ct["ports"]
+
+
+def test_render_multislice():
+    s = parse_accelerator("v5p-16")  # 2 hosts per slice
+    pod = pl.render_tpu_worker(
+        worker_pod(), slice_spec=s, job_name="ms", namespace="default",
+        replica_type="Worker", worker_id=1, slice_id=1, num_slices=2)
+    ct = pod["spec"]["containers"][0]
+    env = {e["name"]: e.get("value") for e in ct["env"]}
+    assert env["MEGASCALE_NUM_SLICES"] == "2"
+    assert env["MEGASCALE_SLICE_ID"] == "1"
+    assert env["KUBEDL_NUM_PROCESSES"] == "4"  # 2 hosts x 2 slices
+    assert env["KUBEDL_PROCESS_ID"] == "3"     # slice 1, host 1
+    # per-slice ICI rendezvous: own slice's hostnames, unique across slices
+    assert env["TPU_WORKER_HOSTNAMES"] == (
+        "ms-slice1-worker-0.default.svc,ms-slice1-worker-1.default.svc")
+    # global DCN coordinator: always slice 0's worker 0
+    assert env["KUBEDL_COORDINATOR_ADDRESS"] == "ms-slice0-worker-0.default.svc:8476"
+    assert env["MEGASCALE_COORDINATOR_ADDRESS"] == "ms-slice0-worker-0.default.svc:8476"
+
+
+def test_render_respects_existing_env_upsert():
+    pod = worker_pod()
+    pod["spec"]["containers"][0]["env"] = [{"name": "TPU_WORKER_ID", "value": "9"}]
+    s = parse_accelerator("v5e-4")
+    pl.render_tpu_worker(pod, slice_spec=s, job_name="j", namespace="d",
+                         replica_type="Worker", worker_id=0)
+    env = [e for e in pod["spec"]["containers"][0]["env"] if e["name"] == "TPU_WORKER_ID"]
+    assert env == [{"name": "TPU_WORKER_ID", "value": "0"}]  # upserted, not duplicated
+
+
+def test_single_host_v5e4():
+    s = parse_accelerator("v5e-4")
+    pod = pl.render_tpu_worker(worker_pod(), slice_spec=s, job_name="r50",
+                               namespace="d", replica_type="Worker", worker_id=0)
+    ct = pod["spec"]["containers"][0]
+    assert ct["resources"]["limits"]["google.com/tpu"] == "4"
+    env = {e["name"]: e.get("value") for e in ct["env"]}
+    assert env["TPU_WORKER_HOSTNAMES"] == "r50-worker-0.d.svc"

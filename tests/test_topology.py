@@ -1,0 +1,81 @@
+"""TPU topology tables — load-bearing (SURVEY.md §7 "hard parts": wrong
+host counts would pass CI and fail on real slices)."""
+
+import pytest
+
+from kubedl_tpu.tpu import topology as tp
+from kubedl_tpu.tpu.topology import parse_accelerator, parse_topology
+
+
+@pytest.mark.parametrize("accel,chips,hosts,topo", [
+    # v5p/v4 suffix counts TensorCores (2/chip), 4 chips per host
+    ("v5p-8", 4, 1, "2x2x1"),
+    ("v5p-16", 8, 2, "2x2x2"),
+    ("v5p-32", 16, 4, "2x2x4"),     # BASELINE config 3: 4 hosts
+    ("v5p-64", 32, 8, "2x4x4"),
+    ("v5p-128", 64, 16, "4x4x4"),
+    ("v4-8", 4, 1, "2x2x1"),
+    ("v4-32", 16, 4, "2x2x4"),
+    # v5e/v6e suffix counts chips; single host up to 8 chips
+    ("v5e-1", 1, 1, "1x1"),
+    ("v5e-4", 4, 1, "2x2"),         # BASELINE config 2: single host
+    ("v5e-8", 8, 1, "2x4"),
+    ("v5e-16", 16, 4, "4x4"),
+    ("v5e-64", 64, 16, "8x8"),
+    ("v5e-256", 256, 64, "16x16"),
+    ("v6e-8", 8, 1, "2x4"),
+    ("v6e-16", 16, 4, "4x4"),
+])
+def test_slice_shapes(accel, chips, hosts, topo):
+    s = parse_accelerator(accel)
+    assert s.chips == chips
+    assert s.num_hosts == hosts
+    assert s.topology_str == topo
+    assert s.accelerator_type == accel
+
+
+def test_gke_accelerator_names():
+    assert parse_accelerator("v5p-32").gke_accelerator == "tpu-v5p-slice"
+    assert parse_accelerator("v5e-4").gke_accelerator == "tpu-v5-lite-podslice"
+    assert parse_accelerator("v6e-8").gke_accelerator == "tpu-v6e-slice"
+    assert parse_accelerator("v4-8").gke_accelerator == "tpu-v4-podslice"
+
+
+def test_parse_topology_gke_style():
+    s = parse_topology("v5p", "2x2x4")
+    assert s.chips == 16 and s.num_hosts == 4
+    assert s.accelerator_type == "v5p-32"
+
+
+def test_invalid():
+    with pytest.raises(ValueError):
+        parse_accelerator("h100-8")
+    with pytest.raises(ValueError):
+        parse_accelerator("v5p-7")  # odd core count
+    with pytest.raises(ValueError):
+        parse_topology("v5p", "3x3x3")  # 27 chips not divisible by 4/host
+    with pytest.raises(ValueError):
+        tp.from_chips("v5e", 300)  # exceeds v5e max of 256 chips
+
+
+def test_host_chips_override_2d():
+    # the 2-host ct5lp-hightpu-4t variant of a 2x4 v5e slice
+    s = tp.from_chips("v5e", 8, host_chips=4)
+    assert s.num_hosts == 2 and s.chips_per_host == 4
+    with pytest.raises(ValueError):
+        tp.from_chips("v5e", 8, host_chips=6)
+    with pytest.raises(ValueError):
+        tp.from_chips("v5p", 16, host_chips=8)  # v5p hosts are 4-chip only
+
+
+def test_v2_v3_never_single_host_8():
+    # v2/v3 hosts have exactly 4 chips; no 8-chip single-host machine exists
+    s = parse_accelerator("v3-16")  # 8 chips
+    assert s.num_hosts == 2 and s.chips_per_host == 4
+
+
+def test_noncanonical_topology_solved():
+    s = tp.from_chips("v5p", 24)
+    assert s.num_hosts == 6
+    import math
+    assert math.prod(s.topology) == 24
