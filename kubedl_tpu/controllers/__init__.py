@@ -1,0 +1,1 @@
+"""Controllers: the generic job engine + per-workload and platform controllers."""

@@ -1,0 +1,894 @@
+"""The generic job reconciliation engine.
+
+Behavioral port of the reference's core engine (``pkg/job_controller/
+job.go:71-370``, ``pod.go:237-448``, ``service.go:197-322``,
+``status.go:19-41``) with the pod/service symmetry collapsed into one typed
+child-resource diff loop and the GPU-era placement replaced by TPU slice
+rendering (``kubedl_tpu.tpu.placement``).
+
+One ``JobEngine`` instance serves one workload kind (its
+``WorkloadController`` plugin provides the framework seams); the engine owns:
+
+* pod/service diff loops with stable ``{job}-{rt}-{index}`` naming,
+* restart semantics (ExitCode retryability, restart-policy mapping),
+* backoff limit / active deadline / TTL-after-finished / clean-pod policy,
+* gang lifecycle (one PodGroup per TPU slice, all-or-nothing),
+* DAG stage gating (``dag_sched.go:29-67``) and the AIMaster gate,
+* job condition state machine + replica status counting,
+* launch-delay metrics and lifecycle events,
+* ModelVersion creation on success (``job.go:500-541``) via hook.
+"""
+
+from __future__ import annotations
+
+import copy
+import logging
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..api import common as c
+from ..api.common import JobStatus, ReplicaSpec, RunPolicy
+from ..core import meta as m
+from ..core.apiserver import AlreadyExists, APIServer, Conflict, NotFound
+from ..core.events import Recorder, TYPE_NORMAL, TYPE_WARNING
+from ..core.manager import Reconciler, Request, Result
+from ..metrics import JobMetrics
+from ..scheduling.gang import GangScheduler
+from ..tpu import placement as pl
+from ..utils import status as st
+from ..utils import train
+from .expectations import Expectations
+from .interface import TPUPolicy, WorkloadController
+
+log = logging.getLogger("kubedl_tpu.engine")
+
+
+@dataclass
+class EngineConfig:
+    enable_gang_scheduling: bool = True
+    enable_dag_scheduling: bool = True
+    dns_domain: str = ""
+    default_ttl_seconds: Optional[int] = None
+
+
+@dataclass
+class _ReplicaPlan:
+    """Resolved TPU shape for one job (or None for CPU-only jobs).
+
+    ``offsets[rtype]`` maps a TPU replica type to its base in the *global*
+    TPU process index space (reconcile order over TPU types, cumulative
+    replicas) — e.g. Master(1) + Worker(3) on a 4-host slice gives Master
+    process 0 and Workers processes 1..3, preserving the reference's
+    Master/Worker shape while keeping one flat SPMD index space.
+    """
+    policy: Optional[TPUPolicy] = None
+    slice_spec: object = None
+    num_slices: int = 1
+    offsets: dict = field(default_factory=dict)
+    global_dns: list = field(default_factory=list)  # hostname per global id
+
+
+class JobEngine(Reconciler):
+    def __init__(self, api: APIServer, controller: WorkloadController,
+                 config: Optional[EngineConfig] = None,
+                 metrics: Optional[JobMetrics] = None,
+                 recorder: Optional[Recorder] = None,
+                 gang: Optional[GangScheduler] = None):
+        self.api = api
+        self.controller = controller
+        self.config = config or EngineConfig()
+        self.metrics = metrics or JobMetrics()
+        self.recorder = recorder or Recorder(api)
+        self.gang = gang
+        self.expectations = Expectations(clock=api.now)
+        self.kind = controller.kind
+        self.owns = ("Pod", "Service")
+        self._retries: dict[str, int] = {}  # job uid -> observed failure rounds
+        self._job_states: dict[str, str] = {}  # job uid -> running|pending
+        api.watch(self._observe)
+
+    # ------------------------------------------------------------------
+    # watch observation (expectations bookkeeping + deletion metrics)
+    # ------------------------------------------------------------------
+
+    def _observe(self, event_type: str, obj: dict) -> None:
+        kd = m.kind(obj)
+        if kd == self.kind:
+            # incremental running/pending gauges (avoids a cluster-wide list
+            # per reconcile) + per-job bookkeeping cleanup on deletion
+            uid = m.uid(obj)
+            if event_type == "DELETED":
+                self.metrics.deleted.inc(kind=self.kind)
+                self._retries.pop(uid, None)
+                self._job_states.pop(uid, None)
+                self.expectations.delete_prefix(m.key(obj))
+            else:
+                s = JobStatus.from_dict(obj.get("status"))
+                if st.is_finished(s):
+                    self._job_states.pop(uid, None)
+                else:
+                    self._job_states[uid] = "running" if st.is_running(s) else "pending"
+            states = list(self._job_states.values())
+            self.metrics.running.set(states.count("running"), kind=self.kind)
+            self.metrics.pending.set(states.count("pending"), kind=self.kind)
+            return
+        if kd not in ("Pod", "Service"):
+            return
+        ref = m.get_controller_ref(obj)
+        if not ref or ref.get("kind") != self.kind:
+            return
+        job_key = f"{m.namespace(obj)}/{ref['name']}"
+        rt = m.meta(obj).get("labels", {}).get(c.LABEL_REPLICA_TYPE, "")
+        key_fn = (Expectations.pods_key if kd == "Pod" else Expectations.services_key)
+        if event_type == "ADDED":
+            self.expectations.creation_observed(key_fn(job_key, rt))
+        elif event_type == "DELETED":
+            self.expectations.deletion_observed(key_fn(job_key, rt))
+
+    # ------------------------------------------------------------------
+    # top-level reconcile
+    # ------------------------------------------------------------------
+
+    def reconcile(self, req: Request) -> Optional[Result]:
+        job = self.api.try_get(self.kind, req.namespace, req.name)
+        if job is None or m.is_deleting(job):
+            return None
+        self.controller.set_defaults(job)
+        replicas = self.controller.get_replica_specs(job)
+        run_policy = self.controller.get_run_policy(job)
+        job_key = m.key(job)
+
+        # stale-cache gate (reference SatisfyExpectations, job.go:129 area)
+        for rt in replicas:
+            if not (self.expectations.satisfied(Expectations.pods_key(job_key, rt))
+                    and self.expectations.satisfied(Expectations.services_key(job_key, rt))):
+                return None
+
+        status = JobStatus.from_dict(job.get("status"))
+        old_status = copy.deepcopy(status)
+
+        # scheduled jobs convert themselves into a Cron wrapper
+        # (reference job.go:372-455)
+        if run_policy.cron_policy and run_policy.cron_policy.schedule:
+            self._reconcile_cron(job, run_policy)
+            return None
+
+        if not status.conditions:
+            st.update_job_conditions(
+                status, c.JOB_CREATED, st.REASON_JOB_CREATED,
+                f"{self.kind} {req.name} is created.", now=self.api.now())
+            self.metrics.created.inc(kind=self.kind)
+            self.recorder.event(job, TYPE_NORMAL, st.REASON_JOB_CREATED,
+                                f"{self.kind} {req.name} is created.")
+
+        pods = self.get_pods_for_job(job)
+        services = self.get_services_for_job(job)
+
+        # ---- backoff limit / active deadline ---------------------------
+        failed_now = sum(1 for p in pods if _pod_phase(p) == c.POD_FAILED)
+        prev_failed = sum(rs.failed for rs in status.replica_statuses.values())
+        exceeds, failure_msg = False, ""
+        if run_policy.backoff_limit is not None:
+            uid = m.uid(job)
+            if failed_now > prev_failed:
+                self._retries[uid] = self._retries.get(uid, 0) + 1
+            restarts = _total_restart_count(pods)
+            if (self._retries.get(uid, 0) > run_policy.backoff_limit
+                    or restarts > run_policy.backoff_limit):
+                exceeds = True
+                failure_msg = (f"{self.kind} {req.name} has failed because it "
+                               f"has reached the specified backoff limit")
+        deadline_requeue = 0.0
+        if not exceeds and run_policy.active_deadline_seconds is not None \
+                and status.start_time:
+            elapsed = self.api.now() - _parse_ts(status.start_time)
+            if elapsed >= run_policy.active_deadline_seconds:
+                exceeds = True
+                failure_msg = (f"{self.kind} {req.name} has failed because it "
+                               f"was active longer than specified deadline")
+            else:
+                deadline_requeue = run_policy.active_deadline_seconds - elapsed
+
+        # ---- terminal path ---------------------------------------------
+        if st.is_finished(status) or exceeds:
+            return self._finish(job, replicas, run_policy, status, old_status,
+                                pods, exceeds, failure_msg)
+
+        try:
+            plan = self._resolve_tpu(job, replicas)
+        except ValueError as e:
+            # invalid slice shape is a permanent config error: fail the job
+            # loudly instead of retrying forever
+            msg = f"invalid tpuPolicy: {e}"
+            self.recorder.event(job, TYPE_WARNING, "InvalidTPUPolicy", msg)
+            st.update_job_conditions(status, c.JOB_FAILED, st.REASON_JOB_FAILED,
+                                     msg, now=self.api.now())
+            status.completion_time = m.rfc3339(self.api.now())
+            self.metrics.failed.inc(kind=self.kind)
+            self._flush_status(job, status, old_status)
+            return None
+
+        # ---- gang: one PodGroup per slice ------------------------------
+        if self.config.enable_gang_scheduling and self.gang is not None:
+            self.gang.create_gang(job, self._gang_min_members(replicas, plan),
+                                  run_policy.scheduling_policy)
+
+        # ---- elastic scaling hook --------------------------------------
+        if st.is_running(old_status) and \
+                self.controller.enable_elastic_scaling(job, run_policy):
+            if self.controller.checkpoint_if_necessary(job, pods) \
+                    and m.generation(job) > 1:
+                total = sum(int(rs.replicas or 1) for rs in replicas.values())
+                latest = _replicas_at_generation(pods, m.generation(job))
+                if total > latest:
+                    self.controller.scale_out(job, replicas, pods, services)
+                elif total < latest:
+                    self.controller.scale_in(job, replicas, pods, services)
+
+        # ---- per-replica-type diff loops -------------------------------
+        restart = [False]
+        for rtype in self._orders(replicas):
+            spec = replicas.get(rtype)
+            if spec is None:
+                continue
+            # AIMaster gate (reference job.go:293-298): AIMaster is always
+            # first in _orders, so breaking here never starves it
+            if (c.REPLICA_AIMASTER in replicas and rtype != c.REPLICA_AIMASTER
+                    and not _aimaster_ready(pods)):
+                break
+            if (self.config.enable_dag_scheduling and spec.depend_on
+                    and not self._dag_ready(pods, spec.depend_on)):
+                continue
+            self._reconcile_pods(job, status, pods, rtype, spec, replicas,
+                                 run_policy, plan, restart)
+            if self.controller.needs_service(rtype):
+                self._reconcile_services(job, services, rtype, spec)
+
+        self._update_job_status(job, replicas, status, restart[0], pods)
+        self.controller.on_job_running(job)
+
+        # ---- launch-delay metrics (job.go:339-356) ---------------------
+        created_at = _parse_ts(m.meta(job).get("creationTimestamp"))
+        if st.is_created(old_status) and st.is_running(status) and created_at:
+            self.metrics.first_pod_launch_delay.observe(
+                self.api.now() - created_at, kind=self.kind)
+        total = sum(int(rs.replicas or 1) for rs in replicas.values())
+        if (sum(rs.active for rs in status.replica_statuses.values()) == total
+                and sum(rs.active for rs in old_status.replica_statuses.values()) < total
+                and not st.is_restarting(old_status) and created_at):
+            self.metrics.all_pods_launch_delay.observe(
+                self.api.now() - created_at, kind=self.kind)
+            # TPU analog: gang (PodGroup) creation -> whole slice running
+            if self.gang is not None:
+                gang_ts = [_parse_ts(m.meta(g).get("creationTimestamp"))
+                           for g in self.gang.get_gangs(job)]
+                gang_ts = [t for t in gang_ts if t]
+                if gang_ts:
+                    self.metrics.gang_to_all_running.observe(
+                        self.api.now() - min(gang_ts), kind=self.kind)
+
+        self._flush_status(job, status, old_status)
+        if deadline_requeue > 0:
+            return Result(requeue_after=deadline_requeue)
+        return None
+
+    # ------------------------------------------------------------------
+    # terminal path
+    # ------------------------------------------------------------------
+
+    def _finish(self, job, replicas, run_policy: RunPolicy, status: JobStatus,
+                old_status: JobStatus, pods, exceeds: bool,
+                failure_msg: str) -> Optional[Result]:
+        self._delete_pods_and_services(job, run_policy, pods)
+        if exceeds:
+            self.recorder.event(job, TYPE_NORMAL, st.REASON_JOB_FAILED, failure_msg)
+            if status.completion_time is None:
+                status.completion_time = m.rfc3339(self.api.now())
+            st.update_job_conditions(status, c.JOB_FAILED, st.REASON_JOB_FAILED,
+                                     failure_msg, now=self.api.now())
+            if not st.is_failed(old_status):
+                self.metrics.failed.inc(kind=self.kind)
+
+        if st.is_succeeded(status):
+            for rs in status.replica_statuses.values():
+                rs.succeeded += rs.active
+                rs.active = 0
+            self._create_model_version(job, pods, status)
+
+        if self.config.enable_gang_scheduling and self.gang is not None:
+            self.gang.delete_gang(job)
+
+        self.controller.on_job_finished(job, pods)
+        self._flush_status(job, status, old_status)
+
+        # TTL-after-finished cleanup (reference job.go:596-620)
+        ttl = run_policy.ttl_seconds_after_finished
+        if ttl is None:
+            ttl = self.config.default_ttl_seconds
+        if ttl is not None:
+            finished_at = _parse_ts(status.completion_time) or self.api.now()
+            remaining = finished_at + ttl - self.api.now()
+            if remaining <= 0:
+                try:
+                    self.api.delete(self.kind, m.namespace(job), m.name(job))
+                except NotFound:
+                    pass
+                return None
+            return Result(requeue_after=remaining)
+        return None
+
+    def _delete_pods_and_services(self, job, run_policy: RunPolicy, pods) -> None:
+        policy = run_policy.clean_pod_policy or c.CLEAN_POD_RUNNING
+        if policy == c.CLEAN_POD_NONE:
+            return
+        for pod in pods:
+            if policy == c.CLEAN_POD_RUNNING and _pod_phase(pod) != c.POD_RUNNING:
+                continue
+            try:
+                self.api.delete("Pod", m.namespace(pod), m.name(pod))
+            except NotFound:
+                pass
+            # services share the pod's name (reference job.go:60-64)
+            try:
+                self.api.delete("Service", m.namespace(pod), m.name(pod))
+            except NotFound:
+                pass
+
+    def _create_model_version(self, job, pods, status: JobStatus) -> None:
+        """On success, emit a ModelVersion CR (reference job.go:500-541)."""
+        mv_spec = m.get_in(job, "spec", "modelVersion")
+        if not mv_spec or status.model_version_name:
+            return
+        name = f"mv-{m.name(job)}-{m.uid(job)[:5]}"
+        mv = m.new_obj("model.kubedl.io/v1alpha1", "ModelVersion", name,
+                       m.namespace(job), spec=copy.deepcopy(mv_spec))
+        mv["spec"].setdefault("createdBy", m.name(job))
+        m.set_controller_ref(mv, job)
+        try:
+            self.api.create(mv)
+        except AlreadyExists:
+            pass
+        status.model_version_name = name
+
+    # ------------------------------------------------------------------
+    # children: pods
+    # ------------------------------------------------------------------
+
+    def get_pods_for_job(self, job) -> list:
+        return self._claim(job, "Pod")
+
+    def get_services_for_job(self, job) -> list:
+        return self._claim(job, "Service")
+
+    def _claim(self, job, kind: str) -> list:
+        """List + adopt orphans matching our selector (reference
+        ``pod.go:532-554`` / ``service_ref_manager.go``)."""
+        sel = self.gen_labels(m.name(job))
+        out = []
+        for obj in self.api.list(kind, m.namespace(job), selector=sel):
+            ref = m.get_controller_ref(obj)
+            if ref is None and not m.is_deleting(job):
+                lbl = m.labels(obj)
+                if not (lbl.get(c.LABEL_REPLICA_TYPE)
+                        and lbl.get(c.LABEL_REPLICA_INDEX, "").isdigit()):
+                    continue  # orphan we couldn't manage; leave it alone
+                m.set_controller_ref(obj, job)
+                try:
+                    obj = self.api.update(obj)
+                except (Conflict, NotFound):
+                    continue
+            elif ref is not None and ref.get("uid") != m.uid(job):
+                continue  # controlled by someone else
+            out.append(obj)
+        return out
+
+    def gen_labels(self, job_name: str) -> dict:
+        return {
+            c.LABEL_GROUP_NAME: self.controller.group_name,
+            c.LABEL_JOB_NAME: job_name.replace("/", "-"),
+        }
+
+    def _reconcile_pods(self, job, status: JobStatus, all_pods, rtype: str,
+                        spec: ReplicaSpec, replicas, run_policy: RunPolicy,
+                        plan: _ReplicaPlan, restart: list) -> None:
+        rt = rtype.lower()
+        pods = [p for p in all_pods
+                if m.labels(p).get(c.LABEL_REPLICA_TYPE) == rt]
+        num = int(spec.replicas or 1)
+        status.replica_statuses.setdefault(rtype, c.ReplicaStatus())
+        rs = status.replica_statuses[rtype]
+        rs.active = rs.succeeded = rs.failed = rs.evicted = 0
+
+        by_index: dict[int, list] = {}
+        job_key = m.key(job)
+        for p in pods:
+            idx_str = m.labels(p).get(c.LABEL_REPLICA_INDEX, "")
+            if not idx_str.isdigit():
+                # a pod of ours with a broken index is unmanageable: delete it
+                # or it skews failure counting forever while staying invisible
+                self.recorder.event(job, TYPE_WARNING, "DeletePod",
+                                    f"pod {m.key(p)} has invalid replica-index "
+                                    f"label {idx_str!r}; deleting")
+                self._delete_pod(job_key, rtype, p)
+                continue
+            by_index.setdefault(int(idx_str), []).append(p)
+        for index in range(max([num] + [i + 1 for i in by_index])):
+            slice_pods = by_index.get(index, [])
+            if len(slice_pods) > 1:
+                log.warning("too many pods for %s %s %d", job_key, rt, index)
+            elif not slice_pods:
+                if index >= num:
+                    continue
+                self.expectations.expect_creations(
+                    Expectations.pods_key(job_key, rtype), 1)
+                try:
+                    self._create_pod(job, rtype, index, spec, replicas,
+                                     run_policy, plan)
+                except AlreadyExists:
+                    # the AlreadyExists trap (reference pod.go:282-307):
+                    # balance the expectation we just set or reconcile stalls
+                    self.expectations.creation_observed(
+                        Expectations.pods_key(job_key, rtype))
+                continue
+            else:
+                pod = slice_pods[0]
+                if index >= num:  # scale-in: out-of-range index
+                    if not m.is_deleting(pod):
+                        self.recorder.event(
+                            job, TYPE_NORMAL, "DeletePod",
+                            f"pod {m.key(pod)} with index {index} is out of "
+                            f"expected replicas {num} and should be deleted")
+                        self._delete_pod(job_key, rtype, pod)
+                    continue
+                exit_code = _exit_code(pod, self.controller.default_container_name)
+                if spec.restart_policy == c.RESTART_EXIT_CODE \
+                        and _pod_phase(pod) == c.POD_FAILED:
+                    reason = m.get_in(pod, "status", "reason", default="")
+                    if (exit_code is not None and train.is_retryable_exit_code(exit_code)) \
+                            or train.is_retryable_pod_failed_reason(reason):
+                        self.recorder.event(job, TYPE_WARNING, "RestartPod",
+                                            f"need to restart the pod {m.key(pod)}")
+                        self._delete_pod(job_key, rtype, pod)
+                        restart[0] = True
+                # the failed pod still counts this round (reference pod.go:
+                # 356-360 falls through to updateJobReplicaStatuses), which is
+                # what lets UpdateJobStatus flip the job to Restarting
+                _count_pod(rs, pod)
+
+    def _delete_pod(self, job_key: str, rtype: str, pod) -> None:
+        self.expectations.expect_deletions(Expectations.pods_key(job_key, rtype), 1)
+        try:
+            self.api.delete("Pod", m.namespace(pod), m.name(pod))
+        except NotFound:
+            self.expectations.deletion_observed(Expectations.pods_key(job_key, rtype))
+
+    def _create_pod(self, job, rtype: str, index: int, spec: ReplicaSpec,
+                    replicas, run_policy: RunPolicy, plan: _ReplicaPlan) -> None:
+        rt = rtype.lower()
+        template = copy.deepcopy(spec.template) or {}
+        pod = {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": copy.deepcopy(template.get("metadata", {})),
+            "spec": copy.deepcopy(template.get("spec", {})),
+        }
+        labels = self.gen_labels(m.name(job))
+        labels[c.LABEL_REPLICA_TYPE] = rt
+        labels[c.LABEL_REPLICA_INDEX] = str(index)
+        master = self.controller.is_master_role(replicas, rtype, index)
+        if master:
+            labels[c.LABEL_JOB_ROLE] = "master"
+        if self.controller.enable_elastic_scaling(job, run_policy):
+            m.finalizers(pod).append(c.FINALIZER_PREEMPT_PROTECTOR)
+            labels[c.LABEL_GENERATION] = str(m.generation(job))
+        md = pod["metadata"]
+        md["name"] = pl.replica_name(m.name(job), rt, index)
+        md["namespace"] = m.namespace(job)
+        md["labels"] = {**(md.get("labels") or {}), **labels}
+
+        # replica restart policy overrides template (reference pod.go:410)
+        pod["spec"]["restartPolicy"] = (
+            c.RESTART_NEVER if spec.restart_policy in (c.RESTART_EXIT_CODE, "")
+            else spec.restart_policy)
+
+        # TPU slice placement + PJRT rendezvous env. Non-TPU roles of a
+        # multislice job still gang with slice 0 (their minMember home).
+        slice_id = 0
+        num_slices = plan.num_slices if plan.policy is not None else 1
+        if plan.policy is not None and rtype in plan.offsets:
+            global_id = plan.offsets[rtype] + index
+            slice_id = global_id // plan.slice_spec.num_hosts
+            pl.render_tpu_worker(
+                pod, slice_spec=plan.slice_spec, job_name=m.name(job),
+                namespace=m.namespace(job), replica_type=rt, worker_id=global_id,
+                num_slices=num_slices,
+                container_name=self.controller.default_container_name,
+                dns_domain=self.config.dns_domain,
+                worker_hostnames=plan.global_dns,
+                coordinator_address=f"{plan.global_dns[0]}:{pl.DEFAULT_COORDINATOR_PORT}")
+
+        # framework-specific rendezvous on top (THE plugin seam)
+        self.controller.set_cluster_spec(job, pod, rtype, index)
+
+        if self.config.enable_gang_scheduling and self.gang is not None:
+            self.gang.bind_pod_to_gang(job, pod, slice_id, num_slices)
+
+        # spot replica overlay (reference pod.go:437-461)
+        if spec.spot_replica_spec is not None:
+            num = int(spec.replicas or 1)
+            if index >= num - spec.spot_replica_spec.spot_replica_number:
+                if spec.spot_replica_spec.priority_class_name:
+                    pod["spec"]["priorityClassName"] = \
+                        spec.spot_replica_spec.priority_class_name
+                md["labels"].update(spec.spot_replica_spec.labels)
+
+        m.set_controller_ref(pod, job)
+        self.api.create(pod)
+        self.recorder.event(job, TYPE_NORMAL, "SuccessfulCreatePod",
+                            f"Created pod: {md['name']}")
+
+    # ------------------------------------------------------------------
+    # children: services
+    # ------------------------------------------------------------------
+
+    def _reconcile_services(self, job, all_services, rtype: str,
+                            spec: ReplicaSpec) -> None:
+        rt = rtype.lower()
+        services = [s for s in all_services
+                    if m.labels(s).get(c.LABEL_REPLICA_TYPE) == rt]
+        num = int(spec.replicas or 1)
+        by_index = {}
+        for s in services:
+            try:
+                by_index.setdefault(
+                    int(m.labels(s).get(c.LABEL_REPLICA_INDEX, "-1")), []).append(s)
+            except ValueError:
+                continue
+        job_key = m.key(job)
+        for index in range(max([num] + [i + 1 for i in by_index])):
+            group = by_index.get(index, [])
+            if not group:
+                if index >= num:
+                    continue
+                self.expectations.expect_creations(
+                    Expectations.services_key(job_key, rtype), 1)
+                try:
+                    self._create_service(job, rtype, index, spec)
+                except AlreadyExists:
+                    self.expectations.creation_observed(
+                        Expectations.services_key(job_key, rtype))
+            elif index >= num and not m.is_deleting(group[0]):
+                self.expectations.expect_deletions(
+                    Expectations.services_key(job_key, rtype), 1)
+                try:
+                    self.api.delete("Service", m.namespace(group[0]), m.name(group[0]))
+                except NotFound:
+                    self.expectations.deletion_observed(
+                        Expectations.services_key(job_key, rtype))
+
+    def _create_service(self, job, rtype: str, index: int, spec: ReplicaSpec) -> None:
+        rt = rtype.lower()
+        labels = self.gen_labels(m.name(job))
+        labels[c.LABEL_REPLICA_TYPE] = rt
+        labels[c.LABEL_REPLICA_INDEX] = str(index)
+        port = _port_from_template(spec.template,
+                                   self.controller.default_container_name,
+                                   self.controller.default_port_name) \
+            or self.controller.default_port
+        svc = m.new_obj("v1", "Service", pl.replica_name(m.name(job), rt, index),
+                        m.namespace(job), labels=labels)
+        svc["spec"] = {
+            "clusterIP": "None",  # headless: DNS fabric for rendezvous
+            "selector": dict(labels),
+            "ports": [{"name": self.controller.default_port_name,
+                       "port": port, "targetPort": port}],
+        }
+        m.set_controller_ref(svc, job)
+        self.api.create(svc)
+
+    # ------------------------------------------------------------------
+    # status
+    # ------------------------------------------------------------------
+
+    def _update_job_status(self, job, replicas, status: JobStatus,
+                           restart: bool, pods) -> None:
+        """Generalized form of the per-framework updateGeneralJobStatus
+        (reference ``controllers/tensorflow/status.go:69-228``)."""
+        name = m.name(job)
+        previous_restarting = st.is_restarting(status)
+        previous_failed = st.is_failed(status)
+        if status.start_time is None:
+            status.start_time = m.rfc3339(self.api.now())
+
+        worker0_completed = self._worker0_completed(pods)
+        has_master = self.controller.contains_master_spec(replicas)
+        master_types = {t.lower() for t in self.controller.master_replica_types(replicas)}
+        success_policy = self.controller.success_policy(job)
+
+        for rtype, spec in replicas.items():
+            rs = status.replica_statuses.get(rtype)
+            if rs is None:
+                continue
+            expected = int(spec.replicas or 1) - rs.succeeded
+            if has_master and rtype.lower() in master_types:
+                if rs.active > 0:
+                    st.update_job_conditions(
+                        status, c.JOB_RUNNING, st.REASON_JOB_RUNNING,
+                        f"{self.kind} {name} is running.", now=self.api.now())
+                if expected == 0:
+                    self._mark_succeeded(job, status)
+            elif not has_master and rtype == self.controller.worker_replica_type():
+                if expected == 0 or (worker0_completed
+                                     and success_policy != c.SUCCESS_POLICY_ALL_WORKERS):
+                    self._mark_succeeded(job, status)
+                elif rs.active > 0:
+                    st.update_job_conditions(
+                        status, c.JOB_RUNNING, st.REASON_JOB_RUNNING,
+                        f"{self.kind} {name} is running.", now=self.api.now())
+            if rs.failed > 0:
+                if restart:
+                    st.update_job_conditions(
+                        status, c.JOB_RESTARTING, st.REASON_JOB_RESTARTING,
+                        f"{self.kind} {name} is restarting because "
+                        f"{rs.failed} {rtype} replica(s) failed.",
+                        now=self.api.now())
+                    self.recorder.event(job, TYPE_WARNING, st.REASON_JOB_RESTARTING,
+                                        f"{rs.failed} {rtype} replica(s) failed")
+                    if not previous_restarting:
+                        self.metrics.failed.inc(kind=self.kind)
+                        self.metrics.restarted.inc(kind=self.kind)
+                else:
+                    if status.completion_time is None:
+                        status.completion_time = m.rfc3339(self.api.now())
+                    st.update_job_conditions(
+                        status, c.JOB_FAILED, st.REASON_JOB_FAILED,
+                        f"{self.kind} {name} is failed because "
+                        f"{rs.failed} {rtype} replica(s) failed.",
+                        now=self.api.now())
+                    self.recorder.event(job, TYPE_NORMAL, st.REASON_JOB_FAILED,
+                                        f"{rs.failed} {rtype} replica(s) failed")
+                    if not previous_failed:
+                        self.metrics.failed.inc(kind=self.kind)
+
+    def _mark_succeeded(self, job, status: JobStatus) -> None:
+        if st.is_succeeded(status):
+            return
+        if status.completion_time is None:
+            status.completion_time = m.rfc3339(self.api.now())
+        st.update_job_conditions(
+            status, c.JOB_SUCCEEDED, st.REASON_JOB_SUCCEEDED,
+            f"{self.kind} {m.name(job)} successfully completed.",
+            now=self.api.now())
+        self.recorder.event(job, TYPE_NORMAL, st.REASON_JOB_SUCCEEDED,
+                            f"{self.kind} {m.name(job)} successfully completed.")
+        self.metrics.successful.inc(kind=self.kind)
+
+    def _worker0_completed(self, pods) -> bool:
+        wt = self.controller.worker_replica_type().lower()
+        for p in pods:
+            lbl = m.labels(p)
+            if lbl.get(c.LABEL_REPLICA_TYPE) == wt \
+                    and lbl.get(c.LABEL_REPLICA_INDEX) == "0":
+                code = _exit_code(p, self.controller.default_container_name)
+                return _pod_phase(p) == c.POD_SUCCEEDED and (code in (0, None))
+        return False
+
+    def _flush_status(self, job, status: JobStatus, old_status: JobStatus) -> None:
+        status.last_reconcile_time = m.rfc3339(self.api.now())
+        old_status.last_reconcile_time = status.last_reconcile_time
+        if status.to_dict() == old_status.to_dict():
+            return
+        fresh = self.api.try_get(self.kind, m.namespace(job), m.name(job))
+        if fresh is None:
+            return
+        fresh["status"] = status.to_dict()
+        try:
+            self.api.update_status(fresh)
+        except Conflict:
+            pass  # events will re-trigger reconcile
+
+    # ------------------------------------------------------------------
+    # TPU plan / gang membership / DAG / cron
+    # ------------------------------------------------------------------
+
+    def _resolve_tpu(self, job, replicas) -> _ReplicaPlan:
+        policy = TPUPolicy.from_job(job)
+        if policy is None:
+            return _ReplicaPlan()
+        slice_spec = policy.resolve()
+        num_slices = max(1, policy.num_slices)
+        # one flat TPU process index space across TPU replica types, in
+        # reconcile order (Master first => Master is process 0)
+        orders = self._orders(replicas)
+        offsets, total = {}, 0
+        for rtype in orders:
+            spec = replicas.get(rtype)
+            if spec is not None and self.controller.is_tpu_replica(rtype):
+                offsets[rtype] = total
+                total += int(spec.replicas or 1)
+        want = slice_spec.num_hosts * num_slices
+        if total != want:
+            raise ValueError(
+                f"TPU replica count mismatch: {total} TPU replica(s) "
+                f"({', '.join(offsets) or 'none'}) but "
+                f"{policy.accelerator_type or slice_spec.accelerator_type} x "
+                f"{num_slices} slice(s) needs exactly {want} worker pod(s) "
+                f"(one per TPU host)")
+        global_dns = []
+        for rtype, off in sorted(offsets.items(), key=lambda kv: kv[1]):
+            n = int(replicas[rtype].replicas or 1)
+            global_dns += [
+                pl.service_dns(m.name(job), rtype.lower(), i, m.namespace(job),
+                               self.config.dns_domain)
+                for i in range(n)]
+        return _ReplicaPlan(policy=policy, slice_spec=slice_spec,
+                            num_slices=num_slices, offsets=offsets,
+                            global_dns=global_dns)
+
+    def _orders(self, replicas) -> list[str]:
+        """Reconcile order with AIMaster forced first (its gate freezes all
+        other types, so it must be created before any of them)."""
+        orders = [rt for rt in (self.controller.get_reconcile_orders() or list(replicas))
+                  if rt in replicas]
+        for rt in replicas:
+            if rt not in orders:
+                orders.append(rt)
+        if c.REPLICA_AIMASTER in orders:
+            orders.remove(c.REPLICA_AIMASTER)
+            orders.insert(0, c.REPLICA_AIMASTER)
+        return orders
+
+    def _gang_min_members(self, replicas, plan: _ReplicaPlan) -> list[int]:
+        """minMember per slice gang: hosts-per-slice for TPU workers, with
+        non-TPU roles folded into slice 0 (SURVEY.md §2-P gang row)."""
+        if plan.policy is None:
+            return [sum(int(rs.replicas or 1) for rs in replicas.values())]
+        members = [0] * plan.num_slices
+        hosts = plan.slice_spec.num_hosts
+        for rtype, rs in replicas.items():
+            n = int(rs.replicas or 1)
+            if rtype in plan.offsets:
+                for idx in range(n):
+                    members[(plan.offsets[rtype] + idx) // hosts] += 1
+            else:
+                members[0] += n
+        return members
+
+    def _dag_ready(self, pods, conditions) -> bool:
+        """DAG stage gating (reference ``dag_sched.go:29-67``): all upstream
+        replicas must have reached the condition's phase."""
+        order = [c.POD_PENDING, c.POD_RUNNING, c.POD_SUCCEEDED]
+        for cond in conditions:
+            upstream = [p for p in pods
+                        if m.labels(p).get(c.LABEL_REPLICA_TYPE) == cond.upstream.lower()]
+            if not upstream:
+                return False
+            for p in upstream:
+                phase = _pod_phase(p)
+                if phase == c.POD_FAILED:
+                    return False
+                want = cond.on_phase
+                if want in order and phase in order:
+                    if order.index(phase) < order.index(want):
+                        return False
+                elif phase != want:
+                    return False
+        return True
+
+    def _reconcile_cron(self, job, run_policy: RunPolicy) -> None:
+        """A job carrying CronPolicy converts itself into a Cron CR wrapping
+        a cleaned copy of the job (reference job.go:372-455)."""
+        existing = self.api.try_get("Cron", m.namespace(job), m.name(job))
+        if existing is not None:
+            return
+        workload = copy.deepcopy(job)
+        wmeta = workload.get("metadata", {})
+        for k in ("resourceVersion", "uid", "creationTimestamp", "generation",
+                  "ownerReferences", "managedFields"):
+            wmeta.pop(k, None)
+        workload.pop("status", None)
+        workload.get("spec", {}).pop("cronPolicy", None)
+        cp = run_policy.cron_policy
+        cron = m.new_obj("apps.kubedl.io/v1alpha1", "Cron", m.name(job),
+                         m.namespace(job))
+        cron["spec"] = {
+            "schedule": cp.schedule,
+            "concurrencyPolicy": cp.concurrency_policy,
+            "template": {"workload": workload},
+        }
+        if cp.suspend is not None:
+            cron["spec"]["suspend"] = cp.suspend
+        if cp.deadline is not None:
+            cron["spec"]["deadline"] = cp.deadline
+        if cp.history_limit is not None:
+            cron["spec"]["historyLimit"] = cp.history_limit
+        m.set_controller_ref(cron, job)
+        try:
+            self.api.create(cron)
+            self.recorder.event(job, TYPE_NORMAL, "CronCreated",
+                                f"created cron {m.name(job)} for scheduled job")
+        except AlreadyExists:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# module helpers
+# ---------------------------------------------------------------------------
+
+def _pod_phase(pod) -> str:
+    return m.get_in(pod, "status", "phase", default=c.POD_PENDING)
+
+
+def _count_pod(rs, pod) -> None:
+    """Reference ``status.go:19-41``: Pending counts as active only once
+    scheduled with init containers passed."""
+    phase = _pod_phase(pod)
+    if phase == c.POD_PENDING:
+        if m.get_in(pod, "spec", "nodeName") and _init_containers_passed(pod):
+            rs.active += 1
+    elif phase == c.POD_RUNNING:
+        rs.active += 1
+    elif phase == c.POD_SUCCEEDED:
+        rs.succeeded += 1
+    elif phase == c.POD_FAILED:
+        rs.failed += 1
+        if m.get_in(pod, "status", "reason", default="") == "Evicted":
+            rs.evicted += 1
+
+
+def _init_containers_passed(pod) -> bool:
+    for cs in m.get_in(pod, "status", "initContainerStatuses", default=[]) or []:
+        state = cs.get("state", {})
+        if "terminated" not in state and "running" not in state:
+            return False
+    return True
+
+
+def _exit_code(pod, container_name: str) -> Optional[int]:
+    for cs in m.get_in(pod, "status", "containerStatuses", default=[]) or []:
+        if cs.get("name") == container_name:
+            term = m.get_in(cs, "state", "terminated")
+            if term is not None:
+                return int(term.get("exitCode", 0))
+    return None
+
+
+def _total_restart_count(pods) -> int:
+    total = 0
+    for p in pods:
+        for cs in m.get_in(p, "status", "containerStatuses", default=[]) or []:
+            total += int(cs.get("restartCount", 0))
+    return total
+
+
+def _replicas_at_generation(pods, generation: int) -> int:
+    return sum(1 for p in pods
+               if m.labels(p).get(c.LABEL_GENERATION) == str(generation))
+
+
+def _aimaster_ready(pods) -> bool:
+    for p in pods:
+        if m.labels(p).get(c.LABEL_REPLICA_TYPE) == c.REPLICA_AIMASTER.lower():
+            return _pod_phase(p) == c.POD_RUNNING
+    return False
+
+
+def _parse_ts(ts) -> Optional[float]:
+    if not ts:
+        return None
+    import calendar
+    import time as _time
+    try:
+        return calendar.timegm(_time.strptime(ts, "%Y-%m-%dT%H:%M:%SZ"))
+    except (ValueError, TypeError):
+        return None
+
+
+def _port_from_template(template: dict, container_name: str,
+                        port_name: str) -> Optional[int]:
+    for ct in m.get_in(template, "spec", "containers", default=[]) or []:
+        if ct.get("name") == container_name:
+            for p in ct.get("ports", []) or []:
+                if p.get("name") == port_name:
+                    return int(p.get("containerPort"))
+    return None

@@ -1,0 +1,3 @@
+"""Prometheus-style metrics (counters/gauges/histograms + text exposition)."""
+
+from .registry import Counter, Gauge, Histogram, Registry, JobMetrics  # noqa: F401

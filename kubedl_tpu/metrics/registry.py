@@ -1,0 +1,163 @@
+"""Metrics registry with Prometheus text exposition.
+
+Stdlib-only equivalent of the reference's ``pkg/metrics`` (``job_metrics.go:
+34-62,120-195``, documented in ``docs/metrics.md``). Metric names are kept
+verbatim (``kubedl_jobs_created`` etc.) so existing dashboards keep working;
+the launch-delay histograms gain a TPU-flavored sibling measuring
+gang-schedule-to-all-running — the operator half of the BASELINE
+"gang-schedule-to-first-step" target.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Iterable, Optional
+
+_DEFAULT_BUCKETS = (0.5, 1, 2.5, 5, 10, 20, 40, 60, 90, 120, 180, 300, 600)
+
+
+class _Metric:
+    def __init__(self, name: str, help_text: str, label_names: tuple):
+        self.name = name
+        self.help = help_text
+        self.label_names = label_names
+        self._lock = threading.Lock()
+        self._values: dict[tuple, float] = {}
+
+    def _key(self, labels: dict) -> tuple:
+        return tuple(str(labels.get(ln, "")) for ln in self.label_names)
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def inc(self, amount: float = 1.0, **labels):
+        with self._lock:
+            k = self._key(labels)
+            self._values[k] = self._values.get(k, 0.0) + amount
+
+    def value(self, **labels) -> float:
+        return self._values.get(self._key(labels), 0.0)
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def set(self, value: float, **labels):
+        with self._lock:
+            self._values[self._key(labels)] = float(value)
+
+    def value(self, **labels) -> float:
+        return self._values.get(self._key(labels), 0.0)
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name, help_text, label_names, buckets: Iterable[float] = _DEFAULT_BUCKETS):
+        super().__init__(name, help_text, label_names)
+        self.buckets = tuple(sorted(buckets))
+        self._counts: dict[tuple, list] = {}
+        self._sums: dict[tuple, float] = {}
+
+    def observe(self, value: float, **labels):
+        with self._lock:
+            k = self._key(labels)
+            counts = self._counts.setdefault(k, [0] * (len(self.buckets) + 1))
+            self._sums[k] = self._sums.get(k, 0.0) + value
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    counts[i] += 1
+            counts[-1] += 1  # +Inf
+
+    def count(self, **labels) -> int:
+        k = self._key(labels)
+        return self._counts.get(k, [0])[-1]
+
+    def sum(self, **labels) -> float:
+        return self._sums.get(self._key(labels), 0.0)
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: list[_Metric] = []
+        self._lock = threading.Lock()
+
+    def counter(self, name, help_text="", labels=()):
+        mt = Counter(name, help_text, tuple(labels))
+        with self._lock:
+            self._metrics.append(mt)
+        return mt
+
+    def gauge(self, name, help_text="", labels=()):
+        mt = Gauge(name, help_text, tuple(labels))
+        with self._lock:
+            self._metrics.append(mt)
+        return mt
+
+    def histogram(self, name, help_text="", labels=(), buckets=_DEFAULT_BUCKETS):
+        mt = Histogram(name, help_text, tuple(labels), buckets)
+        with self._lock:
+            self._metrics.append(mt)
+        return mt
+
+    def expose(self) -> str:
+        """Prometheus text exposition format."""
+        out = []
+        for mt in self._metrics:
+            out.append(f"# HELP {mt.name} {mt.help}")
+            out.append(f"# TYPE {mt.name} {mt.kind}")
+            if isinstance(mt, Histogram):
+                for k, counts in mt._counts.items():
+                    lbl = _fmt_labels(mt.label_names, k)
+                    cum = 0
+                    for i, b in enumerate(mt.buckets):
+                        cum = counts[i]
+                        out.append(f'{mt.name}_bucket{_merge(lbl, f'le="{b}"')} {cum}')
+                    out.append(f'{mt.name}_bucket{_merge(lbl, 'le="+Inf"')} {counts[-1]}')
+                    out.append(f"{mt.name}_sum{_wrap(lbl)} {mt._sums.get(k, 0.0)}")
+                    out.append(f"{mt.name}_count{_wrap(lbl)} {counts[-1]}")
+            else:
+                for k, v in mt._values.items():
+                    out.append(f"{mt.name}{_wrap(_fmt_labels(mt.label_names, k))} {v}")
+        return "\n".join(out) + "\n"
+
+
+def _fmt_labels(names: tuple, values: tuple) -> str:
+    return ",".join(f'{n}="{v}"' for n, v in zip(names, values) if v != "")
+
+
+def _wrap(lbl: str) -> str:
+    return f"{{{lbl}}}" if lbl else ""
+
+
+def _merge(lbl: str, extra: str) -> str:
+    return f"{{{lbl},{extra}}}" if lbl else f"{{{extra}}}"
+
+
+class JobMetrics:
+    """The reference's per-kind job metric set (``pkg/metrics/job_metrics.go``)."""
+
+    def __init__(self, registry: Optional[Registry] = None):
+        self.registry = registry or Registry()
+        r = self.registry
+        self.created = r.counter("kubedl_jobs_created", "Counts number of jobs created", ("kind",))
+        self.deleted = r.counter("kubedl_jobs_deleted", "Counts number of jobs deleted", ("kind",))
+        self.successful = r.counter("kubedl_jobs_successful", "Counts number of jobs successfully finished", ("kind",))
+        self.failed = r.counter("kubedl_jobs_failed", "Counts number of jobs failed", ("kind",))
+        self.restarted = r.counter("kubedl_jobs_restarted", "Counts number of jobs restarted", ("kind",))
+        self.running = r.gauge("kubedl_jobs_running", "Counts number of jobs running currently", ("kind",))
+        self.pending = r.gauge("kubedl_jobs_pending", "Counts number of jobs pending currently", ("kind",))
+        self.first_pod_launch_delay = r.histogram(
+            "kubedl_jobs_first_pod_launch_delay_seconds",
+            "Histogram for recording launch delay duration (from job created to first pod running)",
+            ("kind",))
+        self.all_pods_launch_delay = r.histogram(
+            "kubedl_jobs_all_pods_launch_delay_seconds",
+            "Histogram for recording launch delay duration (from job created to all pods running)",
+            ("kind",))
+        # TPU-native: the operator half of gang-schedule-to-first-step
+        self.gang_to_all_running = r.histogram(
+            "kubedl_jobs_gang_schedule_to_all_running_seconds",
+            "Histogram from gang (PodGroup) creation to all slice workers running",
+            ("kind",))

@@ -1,0 +1,6 @@
+"""Scheduling & placement: gang schedulers (PodGroup per TPU slice)."""
+
+from .gang import (  # noqa: F401
+    GangScheduler, CoschedulerPlugin, VolcanoPlugin, KubeBatchPlugin,
+    gang_registry, new_gang_scheduler,
+)

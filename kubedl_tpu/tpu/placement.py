@@ -83,35 +83,47 @@ def find_container(pod_spec: dict, name: Optional[str] = None) -> Optional[dict]
     return containers[0] if containers else None
 
 
-def replica_name(job_name: str, replica_type: str, index: int,
-                 slice_id: int = 0, num_slices: int = 1) -> str:
-    """Pod/service name for one replica. Multislice jobs get a slice
-    component so names (and DNS) are unique across slices."""
-    if num_slices > 1:
-        return f"{job_name}-slice{slice_id}-{replica_type.lower()}-{index}"
+def replica_name(job_name: str, replica_type: str, index: int) -> str:
+    """Pod/service name for one replica: ``{job}-{rt}-{index}`` (the
+    reference's GenGeneralName convention). ``index`` is global across
+    slices for multislice jobs, so names are always unique."""
     return f"{job_name}-{replica_type.lower()}-{index}"
 
 
 def service_dns(job_name: str, replica_type: str, index: int, namespace: str,
-                domain: str = "", slice_id: int = 0, num_slices: int = 1) -> str:
+                domain: str = "") -> str:
     """The reference's endpoint convention (``controllers/tensorflow/
     tensorflow.go:124-145``): one headless service per replica, DNS name
     ``{job}-{rt}-{i}.{ns}.svc[.domain]``."""
-    base = (f"{replica_name(job_name, replica_type, index, slice_id, num_slices)}"
-            f".{namespace}.svc")
+    base = f"{replica_name(job_name, replica_type, index)}.{namespace}.svc"
     return f"{base}.{domain}" if domain else base
 
 
 def render_tpu_worker(pod: dict, *, slice_spec: SliceSpec, job_name: str,
                       namespace: str, replica_type: str, worker_id: int,
-                      num_workers: Optional[int] = None,
-                      slice_id: int = 0, num_slices: int = 1,
+                      num_slices: int = 1,
                       container_name: Optional[str] = None,
                       coordinator_port: int = DEFAULT_COORDINATOR_PORT,
-                      dns_domain: str = "") -> dict:
-    """Mutate a worker pod dict into a TPU slice member. Returns the pod."""
+                      dns_domain: str = "",
+                      worker_hostnames: Optional[list] = None,
+                      coordinator_address: Optional[str] = None) -> dict:
+    """Mutate a worker pod dict into a TPU slice member. Returns the pod.
+
+    ``worker_id`` is the replica's **global** index across all slices
+    (0 .. num_hosts*num_slices-1); the slice id and in-slice host id are
+    derived from it, so replica index order == physical topology order.
+
+    ``worker_hostnames`` overrides the default same-replica-type DNS list
+    (global order) — jobs that spread TPU processes over several replica
+    types (Master+Worker) pass the cross-type list; ``coordinator_address``
+    likewise overrides the global process-0 address.
+    """
     spec = pod.setdefault("spec", {})
-    n = num_workers if num_workers is not None else slice_spec.num_hosts
+    n = slice_spec.num_hosts
+    slice_id, host_id = divmod(worker_id, n)
+    if not 0 <= slice_id < num_slices:
+        raise ValueError(
+            f"worker_id {worker_id} out of range for {num_slices} slice(s) of {n} host(s)")
 
     # -- placement: land on the right slice hardware
     sel = spec.setdefault("nodeSelector", {})
@@ -133,20 +145,25 @@ def render_tpu_worker(pod: dict, *, slice_spec: SliceSpec, job_name: str,
         res[kk][RESOURCE_TPU] = str(slice_spec.chips_per_host)
 
     # -- rendezvous env (PJRT + jax.distributed). TPU_WORKER_HOSTNAMES is
-    # per-slice (ICI rendezvous); the jax.distributed / MEGASCALE coordinator
-    # is global — always slice 0's worker 0 (DCN rendezvous).
-    hostnames = ",".join(
-        service_dns(job_name, replica_type, i, namespace, dns_domain,
-                    slice_id=slice_id, num_slices=num_slices)
-        for i in range(n))
-    coordinator = (f"{service_dns(job_name, replica_type, 0, namespace, dns_domain, slice_id=0, num_slices=num_slices)}"
-                   f":{coordinator_port}")
-    upsert_env(ct, ENV_TPU_WORKER_ID, worker_id)
+    # per-slice (ICI rendezvous) and TPU_WORKER_ID is the in-slice host id;
+    # the jax.distributed / MEGASCALE coordinator is global — always global
+    # worker 0 (DCN rendezvous).
+    if worker_hostnames is not None:
+        slice_hosts = worker_hostnames[slice_id * n:(slice_id + 1) * n]
+    else:
+        slice_hosts = [
+            service_dns(job_name, replica_type, slice_id * n + i, namespace, dns_domain)
+            for i in range(n)]
+    hostnames = ",".join(slice_hosts)
+    coordinator = coordinator_address or (
+        f"{service_dns(job_name, replica_type, 0, namespace, dns_domain)}"
+        f":{coordinator_port}")
+    upsert_env(ct, ENV_TPU_WORKER_ID, host_id)
     upsert_env(ct, ENV_TPU_WORKER_HOSTNAMES, hostnames)
     upsert_env(ct, ENV_TPU_ACCELERATOR_TYPE, slice_spec.accelerator_type)
     upsert_env(ct, ENV_COORDINATOR_ADDRESS, coordinator)
     upsert_env(ct, ENV_NUM_PROCESSES, n * num_slices)
-    upsert_env(ct, ENV_PROCESS_ID, slice_id * n + worker_id)
+    upsert_env(ct, ENV_PROCESS_ID, worker_id)
 
     # -- multislice: DCN coordination rides the pod network
     if num_slices > 1:

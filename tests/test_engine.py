@@ -1,0 +1,393 @@
+"""Generic job engine: the reconcile behavior pyramid from SURVEY.md §4,
+driven through the synthetic TestJob workload against the fake API server."""
+
+import pytest
+
+from kubedl_tpu.api import common as c
+from kubedl_tpu.api.common import JobStatus
+from kubedl_tpu.controllers.engine import EngineConfig, JobEngine
+from kubedl_tpu.controllers.testing import (
+    TestJobController, new_test_job, run_all_pods, set_pod_phase)
+from kubedl_tpu.core import meta as m
+from kubedl_tpu.scheduling.gang import CoschedulerPlugin
+from kubedl_tpu.utils import status as st
+
+
+@pytest.fixture
+def engine(api, manager):
+    eng = JobEngine(api, TestJobController(),
+                    EngineConfig(enable_gang_scheduling=True),
+                    gang=CoschedulerPlugin(api))
+    manager.register(eng)
+    return eng
+
+
+def reconcile(manager, n=50):
+    manager.run_until_idle(max_iterations=n)
+
+
+def job_status(api, name="tj", ns="default"):
+    return JobStatus.from_dict(api.get("TestJob", ns, name).get("status"))
+
+
+def test_create_pods_and_services(api, manager, engine):
+    api.create(new_test_job("tj", workers=3))
+    reconcile(manager)
+    pods = api.list("Pod")
+    assert len(pods) == 3
+    names = sorted(m.name(p) for p in pods)
+    assert names == ["tj-worker-0", "tj-worker-1", "tj-worker-2"]
+    p0 = pods[0]
+    lbl = m.labels(p0)
+    assert lbl[c.LABEL_JOB_NAME] == "tj"
+    assert lbl[c.LABEL_REPLICA_TYPE] == "worker"
+    assert lbl[c.LABEL_REPLICA_INDEX] in ("0", "1", "2")
+    assert lbl[c.LABEL_GROUP_NAME] == "kubedl.io"
+    assert m.get_controller_ref(p0)["kind"] == "TestJob"
+    # headless service per replica with matching selector
+    svcs = api.list("Service")
+    assert len(svcs) == 3
+    s0 = next(s for s in svcs if m.name(s) == "tj-worker-0")
+    assert s0["spec"]["clusterIP"] == "None"
+    assert s0["spec"]["selector"][c.LABEL_REPLICA_INDEX] == "0"
+    assert s0["spec"]["ports"][0]["port"] == 2222
+    # created condition + metrics
+    status = job_status(api)
+    assert st.is_created(status)
+    assert engine.metrics.created.value(kind="TestJob") == 1
+
+
+def test_running_then_succeeded(api, manager, engine, clock):
+    api.create(new_test_job("tj", workers=2))
+    reconcile(manager)
+    run_all_pods(api)
+    reconcile(manager)
+    status = job_status(api)
+    assert st.is_running(status)
+    assert status.replica_statuses["Worker"].active == 2
+
+    for pod in api.list("Pod"):
+        set_pod_phase(api, pod, "Succeeded", exit_code=0)
+    reconcile(manager)
+    status = job_status(api)
+    assert st.is_succeeded(status)
+    assert status.completion_time
+    assert engine.metrics.successful.value(kind="TestJob") == 1
+    # CleanPodPolicy=Running (the default) deletes only still-running pods;
+    # finished pods and their services survive for log inspection
+    assert len(api.list("Pod")) == 2
+    assert len(api.list("Service")) == 2
+
+
+def test_worker0_success_policy(api, manager, engine):
+    """Default success policy: worker 0 exiting 0 completes the job."""
+    api.create(new_test_job("tj", workers=3))
+    reconcile(manager)
+    run_all_pods(api)
+    reconcile(manager)
+    set_pod_phase(api, api.get("Pod", "default", "tj-worker-0"), "Succeeded",
+                  exit_code=0)
+    reconcile(manager)
+    assert st.is_succeeded(job_status(api))
+
+
+def test_master_completion_decides(api, manager, engine):
+    api.create(new_test_job("tj", workers=2, masters=1))
+    reconcile(manager)
+    master = api.get("Pod", "default", "tj-master-0")
+    assert m.labels(master)[c.LABEL_JOB_ROLE] == "master"
+    run_all_pods(api)
+    reconcile(manager)
+    assert st.is_running(job_status(api))
+    set_pod_phase(api, master, "Succeeded", exit_code=0)
+    reconcile(manager)
+    assert st.is_succeeded(job_status(api))
+
+
+def test_exit_code_retryable_restarts(api, manager, engine):
+    api.create(new_test_job("tj", workers=2, restart_policy="ExitCode"))
+    reconcile(manager)
+    run_all_pods(api)
+    reconcile(manager)
+    # SIGKILL (137) is retryable -> pod deleted and recreated
+    set_pod_phase(api, api.get("Pod", "default", "tj-worker-1"), "Failed",
+                  exit_code=137)
+    manager.run_until_idle(max_iterations=1)  # one reconcile: observe Restarting
+    assert st.is_restarting(job_status(api))
+    reconcile(manager)  # drain: pod recreated, job transitions back
+    status = job_status(api)
+    assert st.is_running(status)  # Restarting and Running are exclusive
+    pods = api.list("Pod")
+    assert len(pods) == 2  # re-created
+    w1 = api.get("Pod", "default", "tj-worker-1")
+    assert m.get_in(w1, "status", "phase", default="Pending") == "Pending"
+    assert engine.metrics.restarted.value(kind="TestJob") == 1
+
+
+def test_exit_code_permanent_fails(api, manager, engine):
+    api.create(new_test_job("tj", workers=2, restart_policy="ExitCode"))
+    reconcile(manager)
+    run_all_pods(api)
+    reconcile(manager)
+    set_pod_phase(api, api.get("Pod", "default", "tj-worker-1"), "Failed",
+                  exit_code=1)  # permanent
+    reconcile(manager)
+    status = job_status(api)
+    assert st.is_failed(status)
+    assert engine.metrics.failed.value(kind="TestJob") == 1
+
+
+def test_backoff_limit(api, manager, engine):
+    api.create(new_test_job("tj", workers=1, restart_policy="ExitCode",
+                            run_policy={"backoffLimit": 1}))
+    reconcile(manager)
+    for _ in range(3):
+        pod = api.try_get("Pod", "default", "tj-worker-0")
+        if pod is None:
+            break
+        set_pod_phase(api, pod, "Failed", exit_code=137)
+        reconcile(manager)
+    assert st.is_failed(job_status(api))
+
+
+def test_active_deadline(api, manager, engine, clock):
+    api.create(new_test_job("tj", workers=1,
+                            run_policy={"activeDeadlineSeconds": 60}))
+    reconcile(manager)
+    run_all_pods(api)
+    reconcile(manager)
+    assert st.is_running(job_status(api))
+    clock.advance(61)
+    manager.run_until_idle(include_delayed=True, max_iterations=20)
+    status = job_status(api)
+    assert st.is_failed(status)
+    assert "deadline" in status.conditions[-1].message
+
+
+def test_ttl_after_finished(api, manager, engine, clock):
+    api.create(new_test_job("tj", workers=1,
+                            run_policy={"ttlSecondsAfterFinished": 30}))
+    reconcile(manager)
+    run_all_pods(api)
+    reconcile(manager)
+    set_pod_phase(api, api.get("Pod", "default", "tj-worker-0"), "Succeeded",
+                  exit_code=0)
+    reconcile(manager)
+    assert st.is_succeeded(job_status(api))
+    clock.advance(31)
+    manager.run_until_idle(include_delayed=True, max_iterations=20)
+    assert api.try_get("TestJob", "default", "tj") is None
+
+
+def test_scale_in_deletes_out_of_range(api, manager, engine):
+    job = api.create(new_test_job("tj", workers=3))
+    reconcile(manager)
+    assert len(api.list("Pod")) == 3
+    job = api.get("TestJob", "default", "tj")
+    job["spec"]["testReplicaSpecs"]["Worker"]["replicas"] = 1
+    api.update(job)
+    reconcile(manager)
+    assert sorted(m.name(p) for p in api.list("Pod")) == ["tj-worker-0"]
+    assert sorted(m.name(s) for s in api.list("Service")) == ["tj-worker-0"]
+
+
+def test_tpu_policy_renders_and_gangs_per_slice(api, manager, engine):
+    api.create(new_test_job("tj", workers=4,
+                            tpu_policy={"acceleratorType": "v5p-32"}))
+    reconcile(manager)
+    pods = api.list("Pod")
+    assert len(pods) == 4
+    p2 = api.get("Pod", "default", "tj-worker-2")
+    ct = p2["spec"]["containers"][0]
+    env = {e["name"]: e.get("value") for e in ct["env"]}
+    assert env["TPU_WORKER_ID"] == "2"
+    assert ct["resources"]["limits"]["google.com/tpu"] == "4"
+    assert p2["spec"]["nodeSelector"]["cloud.google.com/gke-tpu-topology"] == "2x2x4"
+    # one PodGroup, minMember = 4 hosts (slice-atomic)
+    pgs = api.list("PodGroup")
+    assert len(pgs) == 1
+    assert pgs[0]["spec"]["minMember"] == 4
+    assert m.labels(p2)["pod-group.scheduling.sigs.k8s.io/name"] == "tj"
+    assert p2["spec"]["schedulerName"] == "default-scheduler"
+
+
+def test_tpu_multislice_gangs(api, manager, engine):
+    api.create(new_test_job("tj", workers=4,
+                            tpu_policy={"acceleratorType": "v5p-16",
+                                        "numSlices": 2}))
+    reconcile(manager)
+    pgs = sorted(api.list("PodGroup"), key=m.name)
+    assert [m.name(g) for g in pgs] == ["tj-slice-0", "tj-slice-1"]
+    assert [g["spec"]["minMember"] for g in pgs] == [2, 2]
+    # worker 3 -> slice 1 gang, slice-local TPU_WORKER_ID 1
+    p3 = api.get("Pod", "default", "tj-worker-3")
+    assert m.labels(p3)["pod-group.scheduling.sigs.k8s.io/name"] == "tj-slice-1"
+    env = {e["name"]: e.get("value") for e in p3["spec"]["containers"][0]["env"]}
+    assert env["TPU_WORKER_ID"] == "1"
+    assert env["MEGASCALE_SLICE_ID"] == "1"
+    # gang deleted on completion
+    run_all_pods(api)
+    reconcile(manager)
+    for pod in api.list("Pod"):
+        set_pod_phase(api, pod, "Succeeded", exit_code=0)
+    reconcile(manager)
+    assert api.list("PodGroup") == []
+
+
+def test_cron_policy_converts_to_cron(api, manager, engine):
+    api.create(new_test_job("tj", workers=1,
+                            run_policy={"cronPolicy": {"schedule": "*/5 * * * *"}}))
+    reconcile(manager)
+    assert api.list("Pod") == []  # job defers to its cron wrapper
+    cron = api.get("Cron", "default", "tj")
+    workload = cron["spec"]["template"]["workload"]
+    assert workload["kind"] == "TestJob"
+    assert "cronPolicy" not in workload["spec"]
+    assert "uid" not in workload["metadata"]
+
+
+def test_model_version_created_on_success(api, manager, engine):
+    job = new_test_job("tj", workers=1)
+    job["spec"]["modelVersion"] = {"modelName": "bert",
+                                   "storage": {"localStorage": {"path": "/models"}}}
+    api.create(job)
+    reconcile(manager)
+    run_all_pods(api)
+    reconcile(manager)
+    set_pod_phase(api, api.get("Pod", "default", "tj-worker-0"), "Succeeded",
+                  exit_code=0)
+    reconcile(manager)
+    mvs = api.list("ModelVersion")
+    assert len(mvs) == 1
+    assert mvs[0]["spec"]["modelName"] == "bert"
+    assert job_status(api).model_version_name == m.name(mvs[0])
+
+
+def test_dag_gating(api, manager, engine):
+    """Worker depends on Master running (reference dag_sched.go:29-67)."""
+    job = new_test_job("tj", workers=2, masters=1)
+    job["spec"]["testReplicaSpecs"]["Worker"]["dependOn"] = [
+        {"upstream": "Master", "onPhase": "Running"}]
+    api.create(job)
+    reconcile(manager)
+    assert sorted(m.name(p) for p in api.list("Pod")) == ["tj-master-0"]
+    set_pod_phase(api, api.get("Pod", "default", "tj-master-0"), "Running")
+    reconcile(manager)
+    assert len(api.list("Pod")) == 3
+
+
+def test_spot_replica_overlay(api, manager, engine):
+    job = new_test_job("tj", workers=3)
+    job["spec"]["testReplicaSpecs"]["Worker"]["spotReplicaSpec"] = {
+        "spotReplicaNumber": 1, "priorityClassName": "spot",
+        "labels": {"tier": "spot"}}
+    api.create(job)
+    reconcile(manager)
+    w2 = api.get("Pod", "default", "tj-worker-2")  # last replica is spot
+    assert w2["spec"]["priorityClassName"] == "spot"
+    assert m.labels(w2)["tier"] == "spot"
+    w0 = api.get("Pod", "default", "tj-worker-0")
+    assert "priorityClassName" not in w0["spec"]
+
+
+def test_self_heal_missing_pod(api, manager, engine):
+    api.create(new_test_job("tj", workers=2))
+    reconcile(manager)
+    api.delete("Pod", "default", "tj-worker-1")
+    reconcile(manager)
+    assert len(api.list("Pod")) == 2
+
+
+def test_invalid_tpu_policy_fails_permanently(api, manager, engine):
+    """A bad slice shape must fail the job loudly, not retry forever."""
+    api.create(new_test_job("tj", workers=2,
+                            tpu_policy={"acceleratorType": "a100-wat"}))
+    reconcile(manager)
+    status = job_status(api)
+    assert st.is_failed(status)
+    assert "tpuPolicy" in status.conditions[-1].message
+    assert api.list("Pod") == []
+    assert manager.pending() == 0  # no retry loop
+    evs = [e for e in api.list("Event") if e["reason"] == "InvalidTPUPolicy"]
+    assert len(evs) == 1 and evs[0]["type"] == "Warning"
+
+
+def test_restart_policy_mapping(api, manager, engine):
+    api.create(new_test_job("tj", workers=1, restart_policy="ExitCode"))
+    reconcile(manager)
+    pod = api.get("Pod", "default", "tj-worker-0")
+    assert pod["spec"]["restartPolicy"] == "Never"  # ExitCode -> Never
+
+
+def test_tpu_master_worker_flat_index_space(api, manager, engine):
+    """Master(1)+Worker(3) on a 4-host slice: one flat SPMD process space,
+    master is process 0, cross-type hostnames list."""
+    api.create(new_test_job("tj", workers=3, masters=1,
+                            tpu_policy={"acceleratorType": "v5p-32"}))
+    reconcile(manager)
+    assert len(api.list("Pod")) == 4
+    master = api.get("Pod", "default", "tj-master-0")
+    w2 = api.get("Pod", "default", "tj-worker-2")
+    env_m = {e["name"]: e.get("value") for e in master["spec"]["containers"][0]["env"]}
+    env_w = {e["name"]: e.get("value") for e in w2["spec"]["containers"][0]["env"]}
+    assert env_m["KUBEDL_PROCESS_ID"] == "0"
+    assert env_w["KUBEDL_PROCESS_ID"] == "3"  # offset 1 + index 2
+    expected_hosts = ("tj-master-0.default.svc,tj-worker-0.default.svc,"
+                      "tj-worker-1.default.svc,tj-worker-2.default.svc")
+    assert env_m["TPU_WORKER_HOSTNAMES"] == expected_hosts
+    assert env_w["TPU_WORKER_HOSTNAMES"] == expected_hosts
+    assert env_w["KUBEDL_COORDINATOR_ADDRESS"] == "tj-master-0.default.svc:8476"
+
+
+def test_tpu_replica_count_mismatch_fails(api, manager, engine):
+    """2 workers on a 4-host slice is a permanent config error."""
+    api.create(new_test_job("tj", workers=2,
+                            tpu_policy={"acceleratorType": "v5p-32"}))
+    reconcile(manager)
+    status = job_status(api)
+    assert st.is_failed(status)
+    assert "needs exactly 4" in status.conditions[-1].message
+    assert api.list("Pod") == []
+    assert manager.pending() == 0
+
+
+def test_aimaster_created_first_even_if_listed_last(api, manager, engine):
+    job = new_test_job("tj", workers=2)
+    job["spec"]["testReplicaSpecs"]["AIMaster"] = {
+        "replicas": 1, "restartPolicy": "Never",
+        "template": {"spec": {"containers": [{"name": "test-container",
+                                              "image": "aimaster:v1"}]}}}
+    api.create(job)
+    reconcile(manager)
+    # only AIMaster exists until it runs (gate freezes other types)
+    assert sorted(m.name(p) for p in api.list("Pod")) == ["tj-aimaster-0"]
+    set_pod_phase(api, api.get("Pod", "default", "tj-aimaster-0"), "Running")
+    reconcile(manager)
+    assert len(api.list("Pod")) == 3
+
+
+def test_gang_to_all_running_metric(api, manager, engine, clock):
+    api.create(new_test_job("tj", workers=4,
+                            tpu_policy={"acceleratorType": "v5p-32"}))
+    reconcile(manager)
+    clock.advance(7)
+    run_all_pods(api)
+    reconcile(manager)
+    h = engine.metrics.gang_to_all_running
+    assert h.count(kind="TestJob") == 1
+    assert 6 <= h.sum(kind="TestJob") <= 8
+
+
+def test_event_dedup_and_gc(api, manager, engine):
+    api.create(new_test_job("tj", workers=1, restart_policy="ExitCode"))
+    reconcile(manager)
+    for _ in range(3):
+        set_pod_phase(api, api.get("Pod", "default", "tj-worker-0"),
+                      "Failed", exit_code=137)
+        reconcile(manager)
+    restarts = [e for e in api.list("Event") if e["reason"] == "RestartPod"]
+    assert len(restarts) == 1           # deduplicated...
+    assert restarts[0]["count"] == 3    # ...with count incremented
+    api.delete("TestJob", "default", "tj")
+    reconcile(manager)
+    assert api.list("Event") == []      # events GC'd with the job

@@ -1,6 +1,8 @@
 """Golden-spec assertions on TPU worker pod rendering (the analog of the
 reference's rendered-env tests, e.g. controllers/xgboost/pod_test.go:98-122)."""
 
+import pytest
+
 from kubedl_tpu.tpu import placement as pl
 from kubedl_tpu.tpu.topology import parse_accelerator
 
@@ -38,21 +40,28 @@ def test_render_v5p32_worker():
 
 def test_render_multislice():
     s = parse_accelerator("v5p-16")  # 2 hosts per slice
+    # global worker index 3 = slice 1, in-slice host 1
     pod = pl.render_tpu_worker(
         worker_pod(), slice_spec=s, job_name="ms", namespace="default",
-        replica_type="Worker", worker_id=1, slice_id=1, num_slices=2)
+        replica_type="Worker", worker_id=3, num_slices=2)
     ct = pod["spec"]["containers"][0]
     env = {e["name"]: e.get("value") for e in ct["env"]}
     assert env["MEGASCALE_NUM_SLICES"] == "2"
     assert env["MEGASCALE_SLICE_ID"] == "1"
     assert env["KUBEDL_NUM_PROCESSES"] == "4"  # 2 hosts x 2 slices
-    assert env["KUBEDL_PROCESS_ID"] == "3"     # slice 1, host 1
-    # per-slice ICI rendezvous: own slice's hostnames, unique across slices
+    assert env["KUBEDL_PROCESS_ID"] == "3"     # global
+    assert env["TPU_WORKER_ID"] == "1"         # in-slice host id
+    # per-slice ICI rendezvous: own slice's hostnames only
     assert env["TPU_WORKER_HOSTNAMES"] == (
-        "ms-slice1-worker-0.default.svc,ms-slice1-worker-1.default.svc")
-    # global DCN coordinator: always slice 0's worker 0
-    assert env["KUBEDL_COORDINATOR_ADDRESS"] == "ms-slice0-worker-0.default.svc:8476"
-    assert env["MEGASCALE_COORDINATOR_ADDRESS"] == "ms-slice0-worker-0.default.svc:8476"
+        "ms-worker-2.default.svc,ms-worker-3.default.svc")
+    # global DCN coordinator: always global worker 0
+    assert env["KUBEDL_COORDINATOR_ADDRESS"] == "ms-worker-0.default.svc:8476"
+    assert env["MEGASCALE_COORDINATOR_ADDRESS"] == "ms-worker-0.default.svc:8476"
+
+    with pytest.raises(ValueError):
+        pl.render_tpu_worker(worker_pod(), slice_spec=s, job_name="ms",
+                             namespace="d", replica_type="Worker",
+                             worker_id=4, num_slices=2)  # out of range
 
 
 def test_render_respects_existing_env_upsert():
