@@ -1,0 +1,117 @@
+"""Benchmark: training tokens/sec/chip on the flagship Llama model.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The reference (mental2008/kubedl) publishes no performance numbers
+(BASELINE.md: ``published == {}``), so ``vs_baseline`` is measured MFU
+against a 40%-MFU nominal target on the local chip — vs_baseline >= 1.0
+means the step runs at or above 40% model-FLOPs utilization, a strong
+LLM-training baseline for TPU.
+
+Model size auto-scales to the chip's HBM so the same script benches v5e
+(16 GB), v5p (95 GB), or falls back to a tiny CPU config in dev shells.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+# chip peak bf16 FLOP/s by generation (public specs)
+PEAK_FLOPS = {
+    "v4": 275e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v6e": 918e12,
+    "cpu": 5e11,
+}
+TARGET_MFU = 0.40
+
+
+def chip_kind() -> tuple[str, object]:
+    import os
+
+    import jax
+    dev = jax.devices()[0]
+    kind = (dev.device_kind or "").lower()
+    plat = dev.platform.lower()
+    # the axon relay platform proxies a real TPU chip
+    if plat not in ("tpu", "axon") and "tpu" not in kind:
+        return "cpu", dev
+    for gen in ("v6e", "v5p", "v5e", "v4"):
+        if gen in kind or gen in str(dev).lower():
+            return gen, dev
+    return os.environ.get("PALLAS_AXON_TPU_GEN", "v5e"), dev
+
+
+def pick_config(gen: str):
+    from kubedl_tpu.models import llama
+    if gen == "cpu":
+        return llama.tiny(vocab=512, seq=256), 4, 256, 3
+    if gen in ("v5p", "v6e"):
+        # ~6.9B-param Llama-7B-class model fits v5p's 95 GB for training
+        return llama.llama2_7b(), 4, 2048, 10
+    # v5e/v4 (16 GB): ~1.1B-param config
+    cfg = llama.LlamaConfig(vocab_size=32000, d_model=2048, n_layers=16,
+                            n_heads=16, n_kv_heads=8, d_ff=5632,
+                            max_seq_len=2048, rope_theta=10000.0)
+    return cfg, 4, 2048, 10
+
+
+def model_flops_per_token(cfg, seq: int) -> float:
+    """Fwd+bwd FLOPs per trained token: 6*N params term + causal-attention
+    term 12*L*d_head*n_heads*(seq/2)."""
+    return (6.0 * cfg.num_params
+            + 12.0 * cfg.n_layers * cfg.hd * cfg.n_heads * (seq / 2))
+
+
+def main() -> None:
+    import jax
+
+    from kubedl_tpu.models import llama
+    from kubedl_tpu.parallel.mesh import MeshConfig, build_mesh
+    from kubedl_tpu.train.data import shard_batch, synthetic_lm_batches
+    from kubedl_tpu.train.trainer import TrainConfig, Trainer
+
+    gen, dev = chip_kind()
+    cfg, batch, seq, steps = pick_config(gen)
+    mesh = build_mesh(MeshConfig(), [dev])
+
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+
+    def loss_fn(p, b):
+        return llama.loss_fn(cfg, p, b["tokens"], b["targets"])
+
+    trainer = Trainer(loss_fn, llama.param_specs(cfg), mesh,
+                      TrainConfig(warmup_steps=10, decay_steps=1000))
+    state = trainer.init_state(params)
+    batches = synthetic_lm_batches(batch, seq, cfg.vocab_size)
+    get = lambda: shard_batch(next(batches), mesh)  # noqa: E731
+
+    # warmup (compile)
+    state, loss = trainer.step(state, get())
+    jax.block_until_ready(loss)
+    state, loss = trainer.step(state, get())
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, loss = trainer.step(state, get())
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * steps / dt
+    flops_per_tok = model_flops_per_token(cfg, seq)
+    mfu = tokens_per_sec * flops_per_tok / PEAK_FLOPS[gen]
+    target = TARGET_MFU * PEAK_FLOPS[gen] / flops_per_tok
+
+    print(json.dumps({
+        "metric": f"train_tokens_per_sec_per_chip[{gen},{cfg.num_params/1e9:.2f}B,seq{seq}]",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(tokens_per_sec / target, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

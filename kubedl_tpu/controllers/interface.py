@@ -46,10 +46,16 @@ class TPUPolicy:
             )
         ann = m.meta(job).get("annotations", {}) or {}
         if c.ANNOTATION_TPU_ACCELERATOR in ann or c.ANNOTATION_TPU_TOPOLOGY in ann:
-            accel = ann.get(c.ANNOTATION_TPU_ACCELERATOR, "")
-            gen, topo = "", ann.get(c.ANNOTATION_TPU_TOPOLOGY, "")
-            if accel and topo and not _looks_like_topology(topo):
-                gen, topo = "", ""
+            accel_ann = ann.get(c.ANNOTATION_TPU_ACCELERATOR, "")
+            topo = ann.get(c.ANNOTATION_TPU_TOPOLOGY, "")
+            # the accelerator annotation may be a full type ("v5p-32") or a
+            # bare generation ("v5p") paired with the topology annotation
+            if accel_ann and "-" in accel_ann:
+                accel, gen = accel_ann, ""
+            else:
+                accel, gen = "", accel_ann
+            if topo and not _looks_like_topology(topo):
+                topo = ""
             return cls(accelerator_type=accel, generation=gen, topology=topo,
                        num_slices=int(ann.get(c.ANNOTATION_TPU_NUM_SLICES, 1) or 1))
         return None

@@ -102,23 +102,29 @@ class Registry:
         return mt
 
     def expose(self) -> str:
-        """Prometheus text exposition format."""
+        """Prometheus text exposition format. Snapshots each metric under
+        its lock so a scrape never races a concurrent observe/inc/set."""
         out = []
         for mt in self._metrics:
             out.append(f"# HELP {mt.name} {mt.help}")
             out.append(f"# TYPE {mt.name} {mt.kind}")
             if isinstance(mt, Histogram):
-                for k, counts in mt._counts.items():
+                with mt._lock:
+                    counts_snap = {k: list(v) for k, v in mt._counts.items()}
+                    sums_snap = dict(mt._sums)
+                for k, counts in counts_snap.items():
                     lbl = _fmt_labels(mt.label_names, k)
-                    cum = 0
                     for i, b in enumerate(mt.buckets):
-                        cum = counts[i]
-                        out.append(f'{mt.name}_bucket{_merge(lbl, f'le="{b}"')} {cum}')
-                    out.append(f'{mt.name}_bucket{_merge(lbl, 'le="+Inf"')} {counts[-1]}')
-                    out.append(f"{mt.name}_sum{_wrap(lbl)} {mt._sums.get(k, 0.0)}")
+                        le = f'le="{b}"'
+                        out.append(f"{mt.name}_bucket{_merge(lbl, le)} {counts[i]}")
+                    inf = 'le="+Inf"'
+                    out.append(f"{mt.name}_bucket{_merge(lbl, inf)} {counts[-1]}")
+                    out.append(f"{mt.name}_sum{_wrap(lbl)} {sums_snap.get(k, 0.0)}")
                     out.append(f"{mt.name}_count{_wrap(lbl)} {counts[-1]}")
             else:
-                for k, v in mt._values.items():
+                with mt._lock:
+                    values_snap = dict(mt._values)
+                for k, v in values_snap.items():
                     out.append(f"{mt.name}{_wrap(_fmt_labels(mt.label_names, k))} {v}")
         return "\n".join(out) + "\n"
 

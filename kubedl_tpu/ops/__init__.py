@@ -1,0 +1,1 @@
+"""TPU ops: pallas kernels with XLA-fused fallbacks."""

@@ -1,0 +1,265 @@
+"""Attention: pallas flash kernel (TPU) + differentiable chunked fallback.
+
+Three implementations behind one entry point, selected by hardware/shape:
+
+* ``pallas`` — FlashAttention-2-style online-softmax kernel: grid over
+  (batch*heads, q blocks), K/V streamed through VMEM in 128-wide blocks,
+  scores accumulated in float32 on the MXU. Forward-only kernel wrapped in
+  ``jax.custom_vjp``; the backward recomputes through the chunked path
+  (same recompute strategy as flash backward, one extra forward).
+* ``chunked`` — the same online-softmax algorithm as a ``lax.scan`` over
+  K/V blocks in plain JAX: differentiable, O(seq * block) memory, runs
+  anywhere (this is what the virtual CPU mesh tests exercise).
+* ``reference`` — naive full-matrix attention for numerics tests.
+
+GQA: query heads are grouped onto ``n_kv_heads`` shared K/V heads.
+``segment_ids`` gives block-diagonal (packed-sequence) masking.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def _repeat_kv(k, q_heads: int):
+    """[b, s, nkv, hd] -> [b, s, q_heads, hd] by repeating each kv head."""
+    b, s, nkv, hd = k.shape
+    if nkv == q_heads:
+        return k
+    reps = q_heads // nkv
+    return jnp.repeat(k, reps, axis=2)
+
+
+def reference_attention(q, k, v, causal=True, segment_ids=None):
+    """Naive [b, s, h, hd] attention; float32 softmax."""
+    b, sq, nh, hd = q.shape
+    k = _repeat_kv(k, nh)
+    v = _repeat_kv(v, nh)
+    scale = 1.0 / math.sqrt(hd)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    mask = _build_mask(sq, k.shape[1], causal, segment_ids)
+    if mask is not None:
+        scores = jnp.where(mask, scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def _build_mask(sq, sk, causal, segment_ids):
+    """[b or 1, 1, sq, sk] boolean keep-mask, or None."""
+    mask = None
+    if causal:
+        rows = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        mask = (cols <= rows)[None, None]
+    if segment_ids is not None:
+        seg = segment_ids[:, None, :, None] == segment_ids[:, None, None, :]
+        mask = seg if mask is None else jnp.logical_and(mask, seg)
+    return mask
+
+
+# ---------------------------------------------------------------------------
+# chunked (differentiable flash-in-jnp)
+# ---------------------------------------------------------------------------
+
+def chunked_attention(q, k, v, causal=True, segment_ids=None,
+                      block_k: int = 512):
+    """Online-softmax attention, scanning K/V blocks: O(sq*block_k) memory."""
+    b, sq, nh, hd = q.shape
+    sk = k.shape[1]
+    k = _repeat_kv(k, nh)
+    v = _repeat_kv(v, nh)
+    block_k = min(block_k, sk)
+    num_blocks = -(-sk // block_k)
+    pad = num_blocks * block_k - sk
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        if segment_ids is not None:
+            seg_k = jnp.pad(segment_ids, ((0, 0), (0, pad)), constant_values=-1)
+        else:
+            seg_k = None
+    else:
+        seg_k = segment_ids
+
+    scale = 1.0 / math.sqrt(hd)
+    qh = jnp.swapaxes(q, 1, 2).astype(jnp.float32) * scale   # [b, h, sq, hd]
+    kh = jnp.swapaxes(k, 1, 2).astype(jnp.float32)           # [b, h, skp, hd]
+    vh = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
+    kb = kh.reshape(b, nh, num_blocks, block_k, hd).transpose(2, 0, 1, 3, 4)
+    vb = vh.reshape(b, nh, num_blocks, block_k, hd).transpose(2, 0, 1, 3, 4)
+
+    rows = jax.lax.broadcasted_iota(jnp.int32, (sq, block_k), 0)
+    block_cols = jax.lax.broadcasted_iota(jnp.int32, (sq, block_k), 1)
+    blk_idx = jnp.arange(num_blocks)
+    if seg_k is not None:
+        seg_kb = seg_k.reshape(b, num_blocks, block_k).transpose(1, 0, 2)
+    else:
+        seg_kb = jnp.zeros((num_blocks, b, block_k), jnp.int32)
+
+    def step(carry, blk):
+        acc, row_max, row_sum = carry
+        kj, vj, j, sj = blk
+        scores = jnp.einsum("bhqd,bhkd->bhqk", qh, kj)       # [b, h, sq, bk]
+        keep = block_cols + j * block_k < sk
+        if causal:
+            keep = jnp.logical_and(keep, block_cols + j * block_k <= rows)
+        keep = keep[None, None]
+        if segment_ids is not None:
+            keep = jnp.logical_and(
+                keep, (segment_ids[:, :, None] == sj[:, None, :])[:, None])
+        scores = jnp.where(keep, scores, _NEG_INF)
+        new_max = jnp.maximum(row_max, scores.max(axis=-1))
+        alpha = jnp.exp(row_max - new_max)
+        p = jnp.exp(scores - new_max[..., None])
+        acc = acc * alpha[..., None] + jnp.einsum("bhqk,bhkd->bhqd", p, vj)
+        row_sum = row_sum * alpha + p.sum(axis=-1)
+        return (acc, new_max, row_sum), None
+
+    acc0 = jnp.zeros((b, nh, sq, hd), jnp.float32)
+    max0 = jnp.full((b, nh, sq), _NEG_INF, jnp.float32)
+    sum0 = jnp.zeros((b, nh, sq), jnp.float32)
+    (acc, _, row_sum), _ = jax.lax.scan(
+        step, (acc0, max0, sum0), (kb, vb, blk_idx, seg_kb))
+    out = acc / jnp.maximum(row_sum[..., None], 1e-37)
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# pallas flash kernel (forward)
+# ---------------------------------------------------------------------------
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q, block_k, sk, causal):
+    """One (batch*head, q-block) program; K/V blocks streamed via fori_loop.
+    Block shapes carry a leading singleton (batch*head) dim: q [1, block_q,
+    hd], k/v [1, sk, hd], o [1, block_q, hd]."""
+    import jax.experimental.pallas as pl  # local to keep CPU import cheap
+
+    q_block_idx = pl.program_id(1)
+    hd = q_ref.shape[-1]
+    scale = 1.0 / math.sqrt(hd)
+    q = q_ref[0].astype(jnp.float32) * scale
+
+    num_kb = sk // block_k
+    rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) \
+        + q_block_idx * block_q
+    cols0 = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+
+    def body(j, carry):
+        acc, row_max, row_sum = carry
+        kj = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        vj = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        scores = jax.lax.dot_general(
+            q, kj, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)              # [bq, bk]
+        if causal:
+            keep = cols0 + j * block_k <= rows
+            scores = jnp.where(keep, scores, _NEG_INF)
+        new_max = jnp.maximum(row_max, scores.max(axis=-1, keepdims=True))
+        alpha = jnp.exp(row_max - new_max)
+        p = jnp.exp(scores - new_max)
+        acc = acc * alpha + jax.lax.dot_general(
+            p, vj, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        row_sum = row_sum * alpha + p.sum(axis=-1, keepdims=True)
+        return acc, new_max, row_sum
+
+    # causal: block j only contributes while j*block_k <= q_block end
+    upper = num_kb if not causal else \
+        ((q_block_idx + 1) * block_q + block_k - 1) // block_k
+    acc0 = jnp.zeros((block_q, hd), jnp.float32)
+    max0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
+    sum0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc, _, row_sum = jax.lax.fori_loop(0, upper, body, (acc0, max0, sum0))
+    o_ref[0] = (acc / jnp.maximum(row_sum, 1e-37)).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, causal, block_q=128, block_k=128,
+                   interpret=False):
+    """q [b, sq, nh, hd]; k/v repeated to nh already. Returns [b, sq, nh, hd]."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, sq, nh, hd = q.shape
+    sk = k.shape[1]
+    qh = jnp.swapaxes(q, 1, 2).reshape(b * nh, sq, hd)
+    kh = jnp.swapaxes(k, 1, 2).reshape(b * nh, sk, hd)
+    vh = jnp.swapaxes(v, 1, 2).reshape(b * nh, sk, hd)
+
+    kernel = functools.partial(_flash_kernel, block_q=block_q,
+                               block_k=block_k, sk=sk, causal=causal)
+    out = pl.pallas_call(
+        kernel,
+        grid=(b * nh, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, hd), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, sk, hd), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, sk, hd), lambda i, j: (i, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, hd), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * nh, sq, hd), q.dtype),
+        interpret=interpret,
+    )(qh, kh, vh)
+    return jnp.swapaxes(out.reshape(b, nh, sq, hd), 1, 2)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_attention(q, k, v, causal, interpret):
+    nh = q.shape[2]
+    return _flash_forward(q, _repeat_kv(k, nh), _repeat_kv(v, nh), causal,
+                          interpret=interpret)
+
+
+def _flash_fwd(q, k, v, causal, interpret):
+    return _flash_attention(q, k, v, causal, interpret), (q, k, v)
+
+
+def _flash_bwd(causal, interpret, residuals, g):
+    q, k, v = residuals
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_: chunked_attention(q_, k_, v_, causal=causal),
+        q, k, v)
+    return vjp(g)
+
+
+_flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+# ---------------------------------------------------------------------------
+# entry point
+# ---------------------------------------------------------------------------
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except RuntimeError:
+        return False
+
+
+def multi_head_attention(q, k, v, causal: bool = True, segment_ids=None,
+                         impl: Optional[str] = None):
+    """q [b, s, nh, hd]; k/v [b, s, nkv, hd] (GQA) -> [b, s, nh, hd]."""
+    b, sq, nh, hd = q.shape
+    if impl is None:
+        aligned = (sq % 128 == 0 and k.shape[1] % 128 == 0
+                   and hd % 128 == 0 and segment_ids is None)
+        impl = "pallas" if (_on_tpu() and aligned) else "chunked"
+    if impl == "pallas":
+        return _flash_attention(q, k, v, causal, False)
+    if impl == "pallas_interpret":  # CI path for the kernel itself
+        return _flash_attention(q, k, v, causal, True)
+    if impl == "chunked":
+        return chunked_attention(q, k, v, causal=causal,
+                                 segment_ids=segment_ids)
+    if impl == "reference":
+        return reference_attention(q, k, v, causal=causal,
+                                   segment_ids=segment_ids)
+    raise ValueError(f"unknown attention impl {impl!r}")

@@ -1,0 +1,3 @@
+"""Parallelism: device meshes, sharding rules, ring/context parallelism."""
+
+from .mesh import MeshConfig, build_mesh  # noqa: F401

@@ -1,0 +1,79 @@
+"""Device-mesh construction for TPU slices.
+
+The in-container counterpart of the operator's slice provisioning: the
+operator guarantees slice topology + rendezvous env (SURVEY.md §2-P); this
+module turns the resulting ``jax.devices()`` into a named ``Mesh`` whose
+axes carry the parallelism taxonomy:
+
+* ``dp``   — pure data parallelism (gradient psum over DCN or ICI),
+* ``fsdp`` — data parallelism with parameter/optimizer sharding (ZeRO-3;
+  params all-gathered per layer, gradients reduce-scattered),
+* ``tp``   — tensor parallelism (megatron-style column/row sharding, rides
+  the fastest ICI axis),
+* ``cp``   — context/sequence parallelism (ring attention over the sequence
+  axis; see ``kubedl_tpu.parallel.ring``).
+
+Axis order is outermost-to-innermost = slowest-to-fastest interconnect, so
+``tp`` (highest traffic per step) lands on contiguous chips of a slice and
+``dp`` spans slice boundaries (DCN) in multislice jobs.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXES = ("dp", "fsdp", "cp", "tp")
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    dp: int = 1
+    fsdp: int = -1   # -1: absorb remaining devices
+    cp: int = 1
+    tp: int = 1
+
+    def resolve(self, n_devices: int) -> tuple[int, int, int, int]:
+        known = [d for d in (self.dp, self.fsdp, self.cp, self.tp) if d != -1]
+        rest = n_devices // math.prod(known) if known else n_devices
+        dims = tuple(rest if d == -1 else d for d in
+                     (self.dp, self.fsdp, self.cp, self.tp))
+        if math.prod(dims) != n_devices:
+            raise ValueError(
+                f"mesh {dict(zip(AXES, dims))} needs {math.prod(dims)} devices, "
+                f"have {n_devices}")
+        return dims
+
+
+def build_mesh(config: Optional[MeshConfig] = None,
+               devices: Optional[Sequence] = None) -> Mesh:
+    """Build the named mesh. Default: all devices on ``fsdp`` (the right
+    single-slice default for LLM training: ZeRO-3 with no extra comm on the
+    forward beyond per-layer all-gathers XLA schedules onto ICI)."""
+    devices = list(devices if devices is not None else jax.devices())
+    config = config or MeshConfig()
+    dims = config.resolve(len(devices))
+    arr = np.array(devices).reshape(dims)
+    return Mesh(arr, AXES)
+
+
+def data_axes() -> tuple:
+    """Mesh axes the global batch is sharded over."""
+    return ("dp", "fsdp")
+
+
+def batch_spec():
+    from jax.sharding import PartitionSpec as P
+    return P(("dp", "fsdp"), "cp")  # [batch, seq] tokens
+
+
+def host_local_batch_size(global_batch: int, mesh: Mesh) -> int:
+    n = mesh.shape["dp"] * mesh.shape["fsdp"]
+    if global_batch % n:
+        raise ValueError(f"global batch {global_batch} not divisible by dp*fsdp={n}")
+    return global_batch // n

@@ -1,0 +1,56 @@
+"""Sharding rules: logical param/activation axes → mesh PartitionSpecs.
+
+The GSPMD recipe (scaling-book style): annotate params and batch with named
+shardings, jit the step, and let XLA insert the collectives — all-gather of
+fsdp-sharded params per layer, reduce-scatter of gradients, psum over dp —
+onto ICI. No hand-written collective calls in the model.
+
+Conventions (megatron/maxtext-compatible):
+* column-parallel weights (d_model → hidden) shard output dim on ``tp``,
+  input dim on ``fsdp``;
+* row-parallel weights (hidden → d_model) shard input dim on ``tp``,
+  output dim on ``fsdp``;
+* norms/scalars replicate;
+* activations ``[batch, seq, d_model]`` shard batch on ``(dp, fsdp)`` and
+  seq on ``cp`` (ring attention handles cross-block attention).
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+# logical axis name -> mesh axes
+LOGICAL_RULES = {
+    "batch": ("dp", "fsdp"),
+    "seq": "cp",
+    "embed": "fsdp",      # d_model dim of params (fsdp-sharded storage)
+    "heads": "tp",
+    "kv_heads": "tp",
+    "mlp": "tp",
+    "vocab": "tp",
+    "layers": None,
+    "norm": None,
+    "head_dim": None,
+}
+
+
+def spec(*logical_axes) -> P:
+    """Translate logical axis names to a PartitionSpec."""
+    return P(*(LOGICAL_RULES.get(a) if a is not None else None
+               for a in logical_axes))
+
+
+def tree_shardings(mesh: Mesh, spec_tree):
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s),
+        spec_tree,
+        is_leaf=lambda x: isinstance(x, P))
+
+
+def shard_tree(tree, mesh: Mesh, spec_tree):
+    """Device-put a pytree with the given specs."""
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        tree, spec_tree)

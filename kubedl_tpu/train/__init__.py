@@ -1,0 +1,3 @@
+"""Training loop: sharded train step, optimizer, checkpointing, data."""
+
+from .trainer import TrainConfig, Trainer, TrainState  # noqa: F401

@@ -1,0 +1,41 @@
+"""Data pipelines: synthetic LM batches + sharded host loading.
+
+The operator-side dataset story (CacheBackend CRD → host-disk cache) mounts
+data into the container; this module is the in-container loader. For
+benchmarks and CI the synthetic stream generates deterministic token
+batches; ``shard_batch`` places a host-local batch onto the mesh with the
+canonical (dp×fsdp, cp) sharding.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+
+from ..parallel import mesh as mesh_lib
+
+
+def synthetic_lm_batches(batch_size: int, seq_len: int, vocab_size: int,
+                         seed: int = 0) -> Iterator[dict]:
+    """Deterministic stream of {tokens, targets} next-token batches."""
+    rng = np.random.default_rng(seed)
+    while True:
+        toks = rng.integers(0, vocab_size, (batch_size, seq_len + 1),
+                            dtype=np.int32)
+        yield {"tokens": toks[:, :-1], "targets": toks[:, 1:]}
+
+
+def shard_batch(batch: dict, mesh: Mesh) -> dict:
+    sharding = NamedSharding(mesh, mesh_lib.batch_spec())
+    return jax.tree.map(lambda x: jax.device_put(jnp.asarray(x), sharding),
+                        batch)
+
+
+def sharded_synthetic_stream(batch_size: int, seq_len: int, vocab_size: int,
+                             mesh: Mesh, seed: int = 0) -> Iterator[dict]:
+    for batch in synthetic_lm_batches(batch_size, seq_len, vocab_size, seed):
+        yield shard_batch(batch, mesh)

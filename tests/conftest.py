@@ -7,10 +7,16 @@ virtual 8-device CPU mesh for the sharded compute path.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The image may pre-import jax with JAX_PLATFORMS=axon (TPU tunnel) via
+# sitecustomize; env vars alone are then too late — override the live config.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -378,6 +378,24 @@ def test_gang_to_all_running_metric(api, manager, engine, clock):
     assert 6 <= h.sum(kind="TestJob") <= 8
 
 
+def test_tpu_policy_from_annotations():
+    from kubedl_tpu.controllers.interface import TPUPolicy
+    j = m.new_obj("t/v1", "TestJob", "a",
+                  annotations={"kubedl.io/tpu-accelerator": "v5p-32"})
+    assert TPUPolicy.from_job(j).resolve().accelerator_type == "v5p-32"
+    # bare generation + topology annotation pair
+    j = m.new_obj("t/v1", "TestJob", "b",
+                  annotations={"kubedl.io/tpu-accelerator": "v5p",
+                               "kubedl.io/tpu-topology": "2x2x4"})
+    s = TPUPolicy.from_job(j).resolve()
+    assert s.accelerator_type == "v5p-32" and s.num_hosts == 4
+    j = m.new_obj("t/v1", "TestJob", "c",
+                  annotations={"kubedl.io/tpu-accelerator": "v5e-16",
+                               "kubedl.io/tpu-num-slices": "2"})
+    assert TPUPolicy.from_job(j).num_slices == 2
+    assert TPUPolicy.from_job(m.new_obj("t/v1", "TestJob", "d")) is None
+
+
 def test_event_dedup_and_gc(api, manager, engine):
     api.create(new_test_job("tj", workers=1, restart_policy="ExitCode"))
     reconcile(manager)

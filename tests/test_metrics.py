@@ -1,0 +1,65 @@
+"""Metrics registry: values, histogram buckets, exposition format."""
+
+from kubedl_tpu.metrics import JobMetrics, Registry
+
+
+def test_counter_gauge():
+    r = Registry()
+    ct = r.counter("jobs_total", "jobs", ("kind",))
+    ct.inc(kind="TFJob")
+    ct.inc(2, kind="TFJob")
+    assert ct.value(kind="TFJob") == 3
+    g = r.gauge("running", "", ("kind",))
+    g.set(5, kind="TFJob")
+    assert g.value(kind="TFJob") == 5
+
+
+def test_histogram_buckets():
+    r = Registry()
+    h = r.histogram("delay", "", ("kind",), buckets=(1, 5, 10))
+    for v in (0.5, 3, 7, 20):
+        h.observe(v, kind="X")
+    assert h.count(kind="X") == 4
+    assert h.sum(kind="X") == 30.5
+
+
+def test_exposition_format():
+    jm = JobMetrics()
+    jm.created.inc(kind="TFJob")
+    jm.running.set(1, kind="TFJob")
+    jm.first_pod_launch_delay.observe(3.0, kind="TFJob")
+    text = jm.registry.expose()
+    assert '# TYPE kubedl_jobs_created counter' in text
+    assert 'kubedl_jobs_created{kind="TFJob"} 1.0' in text
+    assert 'kubedl_jobs_running{kind="TFJob"} 1.0' in text
+    assert 'kubedl_jobs_first_pod_launch_delay_seconds_bucket{kind="TFJob",le="5"} 1' in text
+    assert 'le="+Inf"' in text
+    assert 'kubedl_jobs_first_pod_launch_delay_seconds_count{kind="TFJob"} 1' in text
+
+
+def test_expose_while_writing_thread_safety():
+    import threading
+    r = Registry()
+    ct = r.counter("c", "", ("k",))
+    stop = threading.Event()
+    errors = []
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            ct.inc(k=f"kind{i % 50}")
+            i += 1
+
+    def scraper():
+        try:
+            for _ in range(200):
+                r.expose()
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    t = threading.Thread(target=writer)
+    t.start()
+    scraper()
+    stop.set()
+    t.join()
+    assert errors == []
