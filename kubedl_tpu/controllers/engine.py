@@ -239,9 +239,20 @@ class JobEngine(Reconciler):
             if (self.config.enable_dag_scheduling and spec.depend_on
                     and not self._dag_ready(pods, spec.depend_on)):
                 continue
-            self._reconcile_pods(job, status, pods, rtype, spec, replicas,
-                                 run_policy, plan, restart)
-            if self.controller.needs_service(rtype):
+            try:
+                self._reconcile_pods(job, status, pods, rtype, spec, replicas,
+                                     run_policy, plan, restart)
+            except ValueError as e:
+                msg = f"invalid {self.kind} spec: {e}"
+                self.recorder.event(job, TYPE_WARNING, "InvalidJobSpec", msg)
+                st.update_job_conditions(status, c.JOB_FAILED,
+                                         st.REASON_JOB_FAILED, msg,
+                                         now=self.api.now())
+                status.completion_time = m.rfc3339(self.api.now())
+                self.metrics.failed.inc(kind=self.kind)
+                self._flush_status(job, status, old_status)
+                return None
+            if self.controller.needs_service(rtype, job):
                 self._reconcile_services(job, services, rtype, spec)
 
         self._update_job_status(job, replicas, status, restart[0], pods)
@@ -429,6 +440,13 @@ class JobEngine(Reconciler):
                     # balance the expectation we just set or reconcile stalls
                     self.expectations.creation_observed(
                         Expectations.pods_key(job_key, rtype))
+                except ValueError:
+                    # permanent config error from set_cluster_spec (e.g. two
+                    # PyTorch masters): balance the expectation, then let
+                    # reconcile() fail the job loudly
+                    self.expectations.creation_observed(
+                        Expectations.pods_key(job_key, rtype))
+                    raise
                 continue
             else:
                 pod = slice_pods[0]
@@ -603,7 +621,6 @@ class JobEngine(Reconciler):
         worker0_completed = self._worker0_completed(pods)
         has_master = self.controller.contains_master_spec(replicas)
         master_types = {t.lower() for t in self.controller.master_replica_types(replicas)}
-        success_policy = self.controller.success_policy(job)
 
         for rtype, spec in replicas.items():
             rs = status.replica_statuses.get(rtype)
@@ -618,8 +635,9 @@ class JobEngine(Reconciler):
                 if expected == 0:
                     self._mark_succeeded(job, status)
             elif not has_master and rtype == self.controller.worker_replica_type():
-                if expected == 0 or (worker0_completed
-                                     and success_policy != c.SUCCESS_POLICY_ALL_WORKERS):
+                if self.controller.judge_worker_success(
+                        job, int(spec.replicas or 1), rs.succeeded,
+                        worker0_completed):
                     self._mark_succeeded(job, status)
                 elif rs.active > 0:
                     st.update_job_conditions(

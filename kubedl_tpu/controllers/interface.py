@@ -97,6 +97,16 @@ class WorkloadController:
     #: xgbReplicaSpecs, ...)
     replica_specs_field_name: str = "replicaSpecs"
 
+    def __init__(self, api=None):
+        #: API-server handle for controllers that manage extra resources
+        #: (MPI hostfile ConfigMaps, elastic checkpoint patches); None in
+        #: pure-rendering unit tests.
+        self.api = api
+        #: cluster DNS suffix, set by the operator registry from
+        #: OperatorConfig.dns_domain so controller-rendered endpoints match
+        #: the engine-rendered TPU env.
+        self.dns_domain = ""
+
     # -- identity / spec access ------------------------------------------
 
     def get_replica_specs(self, job: dict) -> dict[str, ReplicaSpec]:
@@ -129,9 +139,12 @@ class WorkloadController:
     def is_master_role(self, replicas: dict, rtype: str, index: int) -> bool:
         return rtype.lower() in ("master", "chief")
 
-    def needs_service(self, rtype: str) -> bool:
+    def needs_service(self, rtype: str, job: Optional[dict] = None) -> bool:
         """Whether this replica type gets a headless service (PyTorch: master
-        only, reference ``job.go:320-326``; MPI/ElasticDL: none)."""
+        only, reference ``job.go:320-326``; MPI/ElasticDL: none). TPU jobs
+        need per-replica DNS regardless — TPU_WORKER_HOSTNAMES resolves
+        through these services — so controllers should return True for TPU
+        replicas when the job carries a tpuPolicy."""
         return True
 
     def is_tpu_replica(self, rtype: str) -> bool:
@@ -156,6 +169,16 @@ class WorkloadController:
 
     def worker_replica_type(self) -> str:
         return "Worker"
+
+    def judge_worker_success(self, job: dict, total: int, succeeded: int,
+                             worker0_completed: bool) -> bool:
+        """Whether a master-less job counts as succeeded given its worker
+        tally (reference TF ``status.go:170-171``; XDL overrides with its
+        min-finish-work-rate)."""
+        if succeeded >= total:
+            return True
+        return (worker0_completed
+                and self.success_policy(job) != c.SUCCESS_POLICY_ALL_WORKERS)
 
     # -- optional hooks ---------------------------------------------------
 

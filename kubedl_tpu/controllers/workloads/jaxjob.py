@@ -1,0 +1,44 @@
+"""JAXJob: the first-class TPU-native workload kind.
+
+No direct reference analog — this is the TPU-idiomatic successor of the
+reference's MPIJob rendezvous role (SURVEY.md §2-P: "MPIJob → JAX
+multi-process data parallel"). A JAXJob is a pure SPMD slice workload:
+one Worker replica type, one pod per TPU host, ``jax.distributed``
+rendezvous entirely through the engine-injected env
+(``KUBEDL_COORDINATOR_ADDRESS``/``KUBEDL_NUM_PROCESSES``/
+``KUBEDL_PROCESS_ID`` + ``TPU_WORKER_*``), consumed in-container by
+``kubedl_tpu.runtime.bootstrap``. Multislice (ICI+DCN) comes from
+``tpuPolicy.numSlices`` (BASELINE config 4).
+"""
+
+from __future__ import annotations
+
+from ...api import common as c
+from ...core import meta as m
+from ...tpu import placement as pl
+from ..interface import WorkloadController
+
+
+class JAXJobController(WorkloadController):
+    kind = "JAXJob"
+    api_version = "training.kubedl.io/v1alpha1"
+    default_container_name = "jax"
+    default_port_name = "jaxjob-port"
+    default_port = pl.DEFAULT_COORDINATOR_PORT
+    replica_specs_field_name = "jaxReplicaSpecs"
+
+    def get_reconcile_orders(self):
+        return [c.REPLICA_AIMASTER, "Worker"]
+
+    def is_master_role(self, replicas, rtype, index):
+        return rtype.lower() == "worker" and index == 0  # process 0
+
+    def is_tpu_replica(self, rtype):
+        return rtype.lower() == "worker"
+
+    def set_cluster_spec(self, job, pod, rtype, index):
+        # everything rendezvous-related is already injected by the TPU
+        # placement layer; add the JAX runtime switches
+        for ct in m.get_in(pod, "spec", "containers", default=[]) or []:
+            pl.upsert_env(ct, "JAX_PLATFORMS", "tpu,cpu")
+            pl.upsert_env(ct, "ENABLE_PJRT_COMPATIBILITY", "true")

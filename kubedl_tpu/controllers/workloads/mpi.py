@@ -1,0 +1,108 @@
+"""MPIJob controller.
+
+Parity with reference ``controllers/mpi``: Launcher/Worker topology, a
+generated hostfile, ``OMPI_MCA_plm_rsh_agent``/``OMPI_MCA_orte_default_
+hostfile`` env on the launcher (``mpi_config.go:49-124``,
+``mpijob_controller.go:218-246,312-395``), no per-replica services
+(``job.go:315-317`` skips MPI services — except TPU jobs, which need DNS).
+
+TPU-native twist (SURVEY.md §2-P): workers are TPU slice hosts; the
+launcher doubles as coordinator (process 0 lives on worker-0, the launcher
+only orchestrates). The hostfile is delivered as a ConfigMap exactly like
+the reference, listing worker DNS names with ``slots=<chips per host>``.
+"""
+
+from __future__ import annotations
+
+from ...api import common as c
+from ...core import meta as m
+from ...core.apiserver import AlreadyExists
+from ...tpu import placement as pl
+from ..interface import TPUPolicy, WorkloadController
+
+
+class MPIJobController(WorkloadController):
+    kind = "MPIJob"
+    api_version = "training.kubedl.io/v1alpha1"
+    default_container_name = "mpi"
+    default_port_name = "mpijob-port"
+    default_port = 9999
+    replica_specs_field_name = "mpiReplicaSpecs"
+
+    def get_reconcile_orders(self):
+        return [c.REPLICA_AIMASTER, "Worker", "Launcher"]
+
+    def is_master_role(self, replicas, rtype, index):
+        return rtype.lower() == "launcher"
+
+    def is_tpu_replica(self, rtype):
+        return rtype.lower() == "worker"
+
+    def needs_service(self, rtype, job=None):
+        # reference skips MPI services; TPU workers still need DNS
+        return (rtype.lower() == "worker" and job is not None
+                and TPUPolicy.from_job(job) is not None)
+
+    def master_replica_types(self, replicas):
+        return [rt for rt in replicas if rt.lower() == "launcher"]
+
+    def contains_master_spec(self, replicas):
+        return any(rt.lower() == "launcher" for rt in replicas)
+
+    def set_cluster_spec(self, job, pod, rtype, index):
+        rt = rtype.lower()
+        replicas = self.get_replica_specs(job)
+        workers = int((replicas.get("Worker") and replicas["Worker"].replicas) or 0)
+        slots = self._slots_per_worker(job)
+        hostfile = "\n".join(
+            f"{pl.service_dns(m.name(job), 'worker', i, m.namespace(job), self.dns_domain)} "
+            f"slots={slots}" for i in range(workers))
+        if rt == "launcher":
+            self._ensure_hostfile_configmap(job, hostfile)
+            vols = pod["spec"].setdefault("volumes", [])
+            if not any(v.get("name") == "mpi-job-config" for v in vols):
+                vols.append({"name": "mpi-job-config",
+                             "configMap": {"name": f"{m.name(job)}-config"}})
+            for ct in m.get_in(pod, "spec", "containers", default=[]) or []:
+                mounts = ct.setdefault("volumeMounts", [])
+                if not any(mt.get("name") == "mpi-job-config" for mt in mounts):
+                    mounts.append({"name": "mpi-job-config",
+                                   "mountPath": "/etc/mpi"})
+                pl.upsert_env(ct, "OMPI_MCA_orte_default_hostfile",
+                              "/etc/mpi/hostfile")
+                pl.upsert_env(ct, "OMPI_MCA_plm_rsh_agent", "/etc/mpi/kubexec.sh")
+                pl.upsert_env(ct, "OMPI_MCA_orte_keep_fqdn_hostnames", "t")
+                pl.upsert_env(ct, "KUBEDL_WORKER_HOSTS", hostfile.replace("\n", ","))
+        else:
+            for ct in m.get_in(pod, "spec", "containers", default=[]) or []:
+                pl.upsert_env(ct, "KUBEDL_MPI_ROLE", rt)
+
+    def _slots_per_worker(self, job) -> int:
+        slots = m.get_in(job, "spec", "slotsPerWorker")
+        if slots:
+            return int(slots)
+        policy = TPUPolicy.from_job(job)
+        if policy is not None:
+            return policy.resolve().chips_per_host
+        return 1
+
+    def _ensure_hostfile_configmap(self, job, hostfile: str) -> None:
+        """ConfigMap with hostfile + kubexec.sh (reference
+        mpi_config.go:49-124)."""
+        if self.api is None:
+            return
+        name = f"{m.name(job)}-config"
+        kubexec = ("#!/bin/sh\nset -x\nPOD_NAME=$1\nshift\n"
+                   'exec kubectl exec ${POD_NAME} -- /bin/sh -c "$*"\n')
+        cm = m.new_obj("v1", "ConfigMap", name, m.namespace(job))
+        cm["data"] = {"hostfile": hostfile, "kubexec.sh": kubexec}
+        m.set_controller_ref(cm, job)
+        existing = self.api.try_get("ConfigMap", m.namespace(job), name)
+        if existing is None:
+            try:
+                self.api.create(cm)
+            except AlreadyExists:
+                pass
+        elif existing.get("data", {}).get("hostfile") != hostfile:
+            existing["data"] = cm["data"]
+            self.api.update(existing)

@@ -1,0 +1,3 @@
+"""In-container runtime: rendezvous bootstrap + elastic checkpoint agent."""
+
+from .bootstrap import RendezvousInfo, initialize_distributed, rendezvous_from_env  # noqa: F401

@@ -1,0 +1,95 @@
+"""In-container rendezvous bootstrap.
+
+The consumer of the operator's injected env (SURVEY.md §2-P): where the
+reference's user containers read ``MASTER_ADDR``/``RANK``/``WORLD_SIZE`` to
+start NCCL, a kubedl-tpu container calls ``initialize_distributed()`` to
+wire ``jax.distributed`` from ``KUBEDL_COORDINATOR_ADDRESS`` /
+``KUBEDL_NUM_PROCESSES`` / ``KUBEDL_PROCESS_ID`` (with GKE-native
+``TPU_WORKER_ID``/``TPU_WORKER_HOSTNAMES`` as fallback). Single-process
+jobs no-op, so the same training script runs on one chip or a multislice
+fleet unchanged.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+from ..tpu import placement as pl
+
+log = logging.getLogger("kubedl_tpu.bootstrap")
+
+
+@dataclass(frozen=True)
+class RendezvousInfo:
+    coordinator_address: str
+    num_processes: int
+    process_id: int
+    slice_id: int = 0
+    num_slices: int = 1
+
+    @property
+    def is_distributed(self) -> bool:
+        return self.num_processes > 1
+
+
+def rendezvous_from_env(env: Optional[dict] = None) -> Optional[RendezvousInfo]:
+    """Parse the operator contract from the environment; None when absent."""
+    env = env if env is not None else dict(os.environ)
+    coord = env.get(pl.ENV_COORDINATOR_ADDRESS, "")
+    nproc = env.get(pl.ENV_NUM_PROCESSES, "")
+    pid = env.get(pl.ENV_PROCESS_ID, "")
+    if coord and nproc and pid == "":
+        # a partial contract would rendezvous every worker as process 0 and
+        # hang far from the root cause — fail here instead
+        raise ValueError(
+            f"{pl.ENV_COORDINATOR_ADDRESS} and {pl.ENV_NUM_PROCESSES} are set "
+            f"but {pl.ENV_PROCESS_ID} is missing")
+    if not (coord and nproc):
+        # GKE-native fallback: derive from TPU_WORKER_* (single slice)
+        hostnames = env.get(pl.ENV_TPU_WORKER_HOSTNAMES, "")
+        worker_id = env.get(pl.ENV_TPU_WORKER_ID, "")
+        if not hostnames or worker_id == "":
+            return None
+        hosts = [h.strip() for h in hostnames.split(",") if h.strip()]
+        coord = f"{hosts[0]}:{pl.DEFAULT_COORDINATOR_PORT}"
+        nproc, pid = str(len(hosts)), worker_id
+    num_slices = int(env.get(pl.ENV_MEGASCALE_NUM_SLICES, 1) or 1)
+    slice_id = int(env.get(pl.ENV_MEGASCALE_SLICE_ID, 0) or 0)
+    return RendezvousInfo(
+        coordinator_address=coord,
+        num_processes=int(nproc),
+        process_id=int(pid or 0),
+        slice_id=slice_id,
+        num_slices=num_slices)
+
+
+def initialize_distributed(info: Optional[RendezvousInfo] = None) -> RendezvousInfo:
+    """Idempotent ``jax.distributed.initialize`` from the operator env.
+
+    Returns the rendezvous info actually used (a 1-process info when the
+    env carries no contract — local/dev mode).
+    """
+    if info is None:
+        info = rendezvous_from_env()
+    if info is None:
+        log.info("no rendezvous env found; running single-process")
+        return RendezvousInfo("localhost:0", 1, 0)
+    if not info.is_distributed:
+        return info
+    import jax
+    try:
+        jax.distributed.initialize(
+            coordinator_address=info.coordinator_address,
+            num_processes=info.num_processes,
+            process_id=info.process_id)
+        log.info("jax.distributed initialized: process %d/%d via %s",
+                 info.process_id, info.num_processes, info.coordinator_address)
+    except RuntimeError as e:
+        if "already" in str(e).lower():
+            log.info("jax.distributed already initialized")
+        else:
+            raise
+    return info

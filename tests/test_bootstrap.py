@@ -1,0 +1,60 @@
+"""Runtime bootstrap: env contract parsing (operator -> container seam)."""
+
+from kubedl_tpu.runtime.bootstrap import rendezvous_from_env
+
+
+def test_kubedl_contract():
+    info = rendezvous_from_env({
+        "KUBEDL_COORDINATOR_ADDRESS": "j1-worker-0.ns.svc:8476",
+        "KUBEDL_NUM_PROCESSES": "4",
+        "KUBEDL_PROCESS_ID": "2",
+    })
+    assert info.coordinator_address == "j1-worker-0.ns.svc:8476"
+    assert info.num_processes == 4 and info.process_id == 2
+    assert info.is_distributed
+
+
+def test_gke_fallback():
+    info = rendezvous_from_env({
+        "TPU_WORKER_HOSTNAMES": "h0.ns.svc,h1.ns.svc",
+        "TPU_WORKER_ID": "1",
+    })
+    assert info.coordinator_address == "h0.ns.svc:8476"
+    assert info.num_processes == 2 and info.process_id == 1
+
+
+def test_multislice_fields():
+    info = rendezvous_from_env({
+        "KUBEDL_COORDINATOR_ADDRESS": "c:8476",
+        "KUBEDL_NUM_PROCESSES": "8",
+        "KUBEDL_PROCESS_ID": "5",
+        "MEGASCALE_NUM_SLICES": "2",
+        "MEGASCALE_SLICE_ID": "1",
+    })
+    assert info.num_slices == 2 and info.slice_id == 1
+
+
+def test_no_env():
+    assert rendezvous_from_env({}) is None
+
+
+def test_end_to_end_with_engine_rendered_pod(api):
+    """The env the engine renders parses back into a valid rendezvous."""
+    from kubedl_tpu.controllers.registry import build_operator
+    from kubedl_tpu.core import meta as m
+    op = build_operator(api)
+    job = m.new_obj("training.kubedl.io/v1alpha1", "JAXJob", "e2e", spec={
+        "tpuPolicy": {"acceleratorType": "v5p-16", "numSlices": 2},
+        "jaxReplicaSpecs": {"Worker": {"replicas": 4, "template": {
+            "spec": {"containers": [{"name": "jax", "image": "i"}]}}}},
+    })
+    api.create(job)
+    op.run_until_idle()
+    pod = api.get("Pod", "default", "e2e-worker-3")
+    env = {e["name"]: e.get("value") for e in
+           pod["spec"]["containers"][0]["env"]}
+    info = rendezvous_from_env(env)
+    assert info.num_processes == 4
+    assert info.process_id == 3
+    assert info.slice_id == 1 and info.num_slices == 2
+    assert info.coordinator_address == "e2e-worker-0.default.svc:8476"
