@@ -33,6 +33,7 @@ from ..core.apiserver import AlreadyExists, APIServer, Conflict, NotFound
 from ..core.events import Recorder, TYPE_NORMAL, TYPE_WARNING
 from ..core.manager import Reconciler, Request, Result
 from ..metrics import JobMetrics
+from ..platform.models import add_model_path_env, build_model_version_spec
 from ..scheduling.gang import GangScheduler
 from ..tpu import placement as pl
 from ..utils import status as st
@@ -134,6 +135,12 @@ class JobEngine(Reconciler):
         if job is None or m.is_deleting(job):
             return None
         self.controller.set_defaults(job)
+        # model-output volume + KUBEDL_MODEL_PATH env (reference job.go:471-498)
+        mv_spec = m.get_in(job, "spec", "modelVersion")
+        if mv_spec:
+            add_model_path_env(
+                m.get_in(job, "spec", self.controller.replica_specs_field_name,
+                         default={}) or {}, mv_spec)
         replicas = self.controller.get_replica_specs(job)
         run_policy = self.controller.get_run_policy(job)
         job_key = m.key(job)
@@ -352,8 +359,8 @@ class JobEngine(Reconciler):
             return
         name = f"mv-{m.name(job)}-{m.uid(job)[:5]}"
         mv = m.new_obj("model.kubedl.io/v1alpha1", "ModelVersion", name,
-                       m.namespace(job), spec=copy.deepcopy(mv_spec))
-        mv["spec"].setdefault("createdBy", m.name(job))
+                       m.namespace(job),
+                       spec=build_model_version_spec(job, mv_spec, pods))
         m.set_controller_ref(mv, job)
         try:
             self.api.create(mv)

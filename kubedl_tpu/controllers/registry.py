@@ -15,6 +15,8 @@ from ..core.apiserver import APIServer
 from ..core.events import Recorder
 from ..core.manager import Manager
 from ..metrics import JobMetrics, Registry
+from ..platform.models import (DEFAULT_IMAGE_BUILDER, ModelReconciler,
+                               ModelVersionReconciler)
 from ..scheduling.gang import new_gang_scheduler
 from .engine import EngineConfig, JobEngine
 from .workloads import ALL_CONTROLLERS
@@ -29,6 +31,8 @@ class OperatorConfig:
     enable_dag_scheduling: bool = True
     dns_domain: str = ""
     max_reconciles: int = 1
+    #: builder image for ModelVersion image builds (--model-image-builder)
+    model_image_builder: str = ""
 
 
 @dataclass
@@ -37,9 +41,16 @@ class Operator:
     manager: Manager
     engines: dict = field(default_factory=dict)
     metrics_registry: Registry = None
+    config: "OperatorConfig" = None
 
     def run_until_idle(self, **kw):
         return self.manager.run_until_idle(**kw)
+
+    def run(self):
+        """Standalone mode: background reconcile workers, sized by
+        ``max_reconciles`` (reference ``--max-reconciles``)."""
+        workers = max(1, (self.config.max_reconciles if self.config else 1))
+        return self.manager.run(workers=workers)
 
 
 def build_operator(api: Optional[APIServer] = None,
@@ -70,5 +81,11 @@ def build_operator(api: Optional[APIServer] = None,
                            recorder=recorder, gang=gang)
         manager.register(engine)
         engines[ctrl_cls.kind] = engine
+
+    # platform-service controllers (SURVEY.md §1.6)
+    manager.register(ModelVersionReconciler(
+        api, recorder=recorder,
+        image_builder=config.model_image_builder or DEFAULT_IMAGE_BUILDER))
+    manager.register(ModelReconciler(api))
     return Operator(api=api, manager=manager, engines=engines,
-                    metrics_registry=registry)
+                    metrics_registry=registry, config=config)

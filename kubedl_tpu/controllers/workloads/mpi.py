@@ -54,9 +54,12 @@ class MPIJobController(WorkloadController):
         replicas = self.get_replica_specs(job)
         workers = int((replicas.get("Worker") and replicas["Worker"].replicas) or 0)
         slots = self._slots_per_worker(job)
+        # bare pod names, not service FQDNs: the kubexec.sh rsh agent runs
+        # `kubectl exec $1` which takes a pod name (reference mpi_config.go
+        # builds `${job}-worker-${i}` for the same reason); the names still
+        # resolve as DNS where per-replica headless services exist
         hostfile = "\n".join(
-            f"{pl.service_dns(m.name(job), 'worker', i, m.namespace(job), self.dns_domain)} "
-            f"slots={slots}" for i in range(workers))
+            f"{m.name(job)}-worker-{i} slots={slots}" for i in range(workers))
         if rt == "launcher":
             self._ensure_hostfile_configmap(job, hostfile)
             vols = pod["spec"].setdefault("volumes", [])

@@ -41,7 +41,15 @@ class PyTorchJobController(WorkloadController):
     def needs_service(self, rtype, job=None):
         if rtype.lower() == "master" or rtype == c.REPLICA_AIMASTER:
             return True
-        return job is not None and TPUPolicy.from_job(job) is not None
+        if job is not None and TPUPolicy.from_job(job) is not None:
+            return True
+        # master-less SPMD shape: worker-0 anchors the rendezvous, so the
+        # workers need DNS even off-TPU
+        if rtype.lower() == "worker" and job is not None:
+            raw = m.get_in(job, "spec", self.replica_specs_field_name,
+                           default={}) or {}
+            return not any(r.lower() == "master" for r in raw)
+        return False
 
     def is_tpu_replica(self, rtype):
         return rtype.lower() in ("master", "worker")
@@ -54,7 +62,11 @@ class PyTorchJobController(WorkloadController):
         if rt == c.REPLICA_AIMASTER.lower():
             return
         replicas = self.get_replica_specs(job)
-        master_addr = f"{m.name(job)}-master-0"
+        has_master = any(rt_.lower() == "master" for rt_ in replicas)
+        # master-less jobs anchor the rendezvous on worker-0 so RANK=0
+        # exists and MASTER_ADDR resolves to a real service
+        master_addr = (f"{m.name(job)}-master-0" if has_master
+                       else f"{m.name(job)}-worker-0")
         master_port = self.default_port
         master_spec = replicas.get("Master") or replicas.get("Worker")
         if master_spec is not None:
@@ -68,7 +80,7 @@ class PyTorchJobController(WorkloadController):
         if rt == "master":
             if rank != 0:
                 raise ValueError("there should be a single master with index=0")
-        else:
+        elif has_master:
             rank += 1  # workers follow the master (reference :238)
 
         world = sum(int(rs.replicas or 1) for rt_, rs in replicas.items()

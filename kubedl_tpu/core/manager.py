@@ -88,9 +88,14 @@ class Manager:
                 # primary event, or a watched kind mapped by same ns/name
                 self.enqueue(Request(rec.kind, m.namespace(obj), m.name(obj)))
             if kd in rec.owns:
-                ref = m.get_controller_ref(obj)
-                if ref and ref.get("kind") == rec.kind:
-                    self.enqueue(Request(rec.kind, m.namespace(obj), ref["name"]))
+                # route via ANY owner ref of the matching kind, not just the
+                # controller ref: a ModelVersion is controller-owned by the
+                # job that produced it but also owned by its Model, and both
+                # owners' reconcilers need the event
+                for ref in m.meta(obj).get("ownerReferences", []) or []:
+                    if ref.get("kind") == rec.kind:
+                        self.enqueue(Request(rec.kind, m.namespace(obj),
+                                             ref["name"]))
 
     def enqueue(self, req: Request, after: float = 0.0):
         """Add with dedup. An immediate event always supersedes a pending

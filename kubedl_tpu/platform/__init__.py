@@ -1,0 +1,14 @@
+"""Platform-service controllers (SURVEY.md §1.6).
+
+The reference groups these under ``controllers/{model,serving,notebook,
+cache,apps,persist}``: everything that is not a training-job controller —
+model registry + image build, inference serving, notebooks, dataset cache,
+cron, record persistence.
+"""
+
+from .models import (  # noqa: F401
+    ModelReconciler,
+    ModelVersionReconciler,
+    add_model_path_env,
+    provider_for,
+)

@@ -1,0 +1,202 @@
+"""Model registry: ModelVersion image build + storage providers + model-path
+injection (reference ``controllers/model`` + ``pkg/job_controller/job.go:471-541``)."""
+
+import pytest
+
+from kubedl_tpu.controllers.registry import OperatorConfig, build_operator
+from kubedl_tpu.controllers.testing import set_pod_phase
+from kubedl_tpu.core import meta as m
+from kubedl_tpu.platform import models as pm
+
+
+@pytest.fixture
+def op(api):
+    return build_operator(api, OperatorConfig(gang_scheduler_name=""))
+
+
+def new_mv(name="mv1", storage=None, repo="registry.example.com/bert",
+           tag="", model_name="bert"):
+    mv = m.new_obj("model.kubedl.io/v1alpha1", "ModelVersion", name)
+    mv["spec"] = {"modelName": model_name, "imageRepo": repo}
+    if tag:
+        mv["spec"]["imageTag"] = tag
+    mv["spec"]["storage"] = storage or {
+        "localStorage": {"path": "/models/bert", "nodeName": "node-1",
+                         "mountPath": "/mnt/models"}}
+    return mv
+
+
+def test_local_storage_build_pipeline(api, op):
+    api.create(new_mv())
+    op.run_until_idle()
+
+    # PV/PVC staging + dockerfile + builder pod exist
+    pv = api.get("PersistentVolume", "default", "mv-pv-mv1")
+    assert pv["spec"]["local"]["path"] == "/models/bert"
+    affinity = m.get_in(pv, "spec", "nodeAffinity", "required",
+                        "nodeSelectorTerms")[0]["matchExpressions"][0]
+    assert affinity["values"] == ["node-1"]
+    assert api.get("PersistentVolumeClaim", "default", "mv-pvc-mv1")
+    assert "busybox" in api.get("ConfigMap", "default", "dockerfile")["data"]["dockerfile"]
+    pod = api.get("Pod", "default", "image-build-mv1")
+    args = pod["spec"]["containers"][0]["args"]
+    assert "--context=dir:///workspace/" in args
+    mv = api.get("ModelVersion", "default", "mv1")
+    assert mv["status"]["imageBuildPhase"] == pm.IMAGE_BUILDING
+    # tag defaults to the first 5 uid chars (modelversion_types.go:54)
+    expected_image = f"registry.example.com/bert:{m.uid(mv)[:5]}"
+    assert f"--destination={expected_image}" in args
+
+    # parent Model auto-created and owns the version
+    model = api.get("Model", "default", "bert")
+    mv = api.get("ModelVersion", "default", "mv1")
+    assert m.get_controller_ref(mv)["name"] == "bert"
+
+    # builder success -> status flips, Model.latestVersion updated
+    set_pod_phase(api, pod, "Succeeded", exit_code=0)
+    op.run_until_idle()
+    mv = api.get("ModelVersion", "default", "mv1")
+    assert mv["status"]["imageBuildPhase"] == pm.IMAGE_BUILD_SUCCEEDED
+    assert mv["status"]["image"] == expected_image
+    assert mv["status"]["finishTime"]
+    model = api.get("Model", "default", "bert")
+    assert model["status"]["latestVersion"] == {
+        "modelVersion": "mv1", "imageName": expected_image}
+
+
+def test_gcs_storage_builds_straight_from_bucket(api, op):
+    api.create(new_mv(storage={"gcs": {"bucket": "ckpts", "path": "bert/v1"}},
+                      tag="v1"))
+    op.run_until_idle()
+    pod = api.get("Pod", "default", "image-build-mv1")
+    args = pod["spec"]["containers"][0]["args"]
+    # the bucket is fuse-mounted at /workspace/build so the shared
+    # "COPY build/" dockerfile works; context stays a local dir
+    assert "--context=dir:///workspace/" in args
+    assert "--destination=registry.example.com/bert:v1" in args
+    src = next(v for v in pod["spec"]["volumes"] if v["name"] == "build-source")
+    assert src["csi"]["driver"] == "gcsfuse.csi.storage.gke.io"
+    assert src["csi"]["volumeAttributes"]["bucketName"] == "ckpts"
+    assert "only-dir=bert/v1" in src["csi"]["volumeAttributes"]["mountOptions"]
+    assert pod["metadata"]["annotations"]["gke-gcsfuse/volumes"] == "true"
+    # no PVC staging hop for GCS
+    assert api.try_get("PersistentVolumeClaim", "default", "mv-pvc-mv1") is None
+    assert not any(v.get("persistentVolumeClaim")
+                   for v in pod["spec"]["volumes"])
+
+
+def test_build_failure_reported(api, op):
+    api.create(new_mv())
+    op.run_until_idle()
+    pod = api.get("Pod", "default", "image-build-mv1")
+    set_pod_phase(api, pod, "Failed", exit_code=1)
+    op.run_until_idle()
+    mv = api.get("ModelVersion", "default", "mv1")
+    assert mv["status"]["imageBuildPhase"] == pm.IMAGE_BUILD_FAILED
+
+
+def test_missing_storage_fails_fast(api, op):
+    mv = new_mv()
+    mv["spec"].pop("storage")
+    api.create(mv)
+    op.run_until_idle()
+    mv = api.get("ModelVersion", "default", "mv1")
+    assert mv["status"]["imageBuildPhase"] == pm.IMAGE_BUILD_FAILED
+    assert "storage" in mv["status"]["message"]
+    # validation happens before any side objects: no junk Model left behind
+    assert api.try_get("Model", "default", "bert") is None
+
+
+def test_modelname_written_back_for_job_created_versions(api, op):
+    """A job-created version omitting modelName must not leave the Model's
+    latestVersion erasable by the ModelReconciler's filter."""
+    mv = new_mv("mv-j1-abcde", model_name="")
+    mv["spec"].pop("modelName")
+    api.create(mv)
+    op.run_until_idle()
+    mv = api.get("ModelVersion", "default", "mv-j1-abcde")
+    assert mv["spec"]["modelName"] == "mv-j1-abcde"
+    set_pod_phase(api, api.get("Pod", "default", "image-build-mv-j1-abcde"),
+                  "Succeeded", exit_code=0)
+    op.run_until_idle()
+    model = api.get("Model", "default", "mv-j1-abcde")
+    assert model["status"]["latestVersion"]["modelVersion"] == "mv-j1-abcde"
+
+
+def test_local_storage_node_resolved_from_output_pod(api, op):
+    """localStorage without nodeName resolves to the master pod's node
+    (reference job.go:525-529 GetNodeForModelOutput)."""
+    from kubedl_tpu.platform.models import build_model_version_spec
+    job = m.new_obj("training.kubedl.io/v1alpha1", "XGBoostJob", "j2")
+    pods = [
+        {"metadata": {"labels": {"replica-type": "worker", "replica-index": "0"}},
+         "spec": {"nodeName": "host-b"}},
+        {"metadata": {"labels": {"replica-type": "master", "replica-index": "0"}},
+         "spec": {"nodeName": "host-a"}},
+    ]
+    spec = build_model_version_spec(
+        job, {"imageRepo": "r/x",
+              "storage": {"localStorage": {"path": "/m"}}}, pods)
+    assert spec["storage"]["localStorage"]["nodeName"] == "host-a"
+    assert spec["modelName"] == "j2"
+
+
+def test_model_tracks_newest_version(api, op, clock):
+    api.create(new_mv("mv1", tag="a"))
+    op.run_until_idle()
+    set_pod_phase(api, api.get("Pod", "default", "image-build-mv1"),
+                  "Succeeded", exit_code=0)
+    op.run_until_idle()
+    clock.advance(60)
+    api.create(new_mv("mv2", tag="b"))
+    op.run_until_idle()
+    set_pod_phase(api, api.get("Pod", "default", "image-build-mv2"),
+                  "Succeeded", exit_code=0)
+    op.run_until_idle()
+    model = api.get("Model", "default", "bert")
+    assert model["status"]["latestVersion"]["modelVersion"] == "mv2"
+    # deleting the newest version heals latestVersion back to mv1
+    api.delete("ModelVersion", "default", "mv2")
+    op.run_until_idle()
+    model = api.get("Model", "default", "bert")
+    assert model["status"]["latestVersion"]["modelVersion"] == "mv1"
+
+
+def test_model_path_env_injected_into_job(api, op):
+    """Jobs with spec.modelVersion get KUBEDL_MODEL_PATH + the artifact
+    volume in every replica (reference job.go:471-498)."""
+    job = m.new_obj("training.kubedl.io/v1alpha1", "XGBoostJob", "j1")
+    job["spec"] = {
+        "modelVersion": {
+            "modelName": "bert", "imageRepo": "r/bert",
+            "storage": {"localStorage": {"path": "/models",
+                                         "mountPath": "/mnt/out",
+                                         "nodeName": "n1"}}},
+        "xgbReplicaSpecs": {
+            "Master": {"replicas": 1, "template": {"spec": {"containers": [
+                {"name": "xgboost", "image": "xgb"}]}}},
+            "Worker": {"replicas": 1, "template": {"spec": {"containers": [
+                {"name": "xgboost", "image": "xgb"}]}}},
+        },
+    }
+    api.create(job)
+    op.run_until_idle()
+    for pod_name in ("j1-master-0", "j1-worker-0"):
+        pod = api.get("Pod", "default", pod_name)
+        container = pod["spec"]["containers"][0]
+        envs = {e["name"]: e.get("value") for e in container["env"]}
+        assert envs[pm.MODEL_PATH_ENV] == "/mnt/out"
+        assert any(vm["mountPath"] == "/mnt/out"
+                   for vm in container["volumeMounts"])
+        assert any(v.get("hostPath", {}).get("path") == "/models"
+                   for v in pod["spec"]["volumes"])
+
+
+def test_gcs_volume_uses_gcsfuse_csi(api):
+    template = {"spec": {"containers": [{"name": "main", "image": "i"}]}}
+    storage = {"gcs": {"bucket": "b", "mountPath": "/gcs"}}
+    pm.provider_for(storage).add_model_volume(template, storage)
+    vol = template["spec"]["volumes"][0]
+    assert vol["csi"]["driver"] == "gcsfuse.csi.storage.gke.io"
+    assert vol["csi"]["volumeAttributes"]["bucketName"] == "b"
+    assert template["metadata"]["annotations"]["gke-gcsfuse/volumes"] == "true"

@@ -109,8 +109,10 @@ def test_mpi_hostfile(api, op):
     }, spec_extra={"slotsPerWorker": 2}))
     op.run_until_idle()
     cm = api.get("ConfigMap", "default", "j1-config")
+    # bare pod names: kubexec.sh passes $1 to `kubectl exec`, which takes a
+    # pod name, not a service FQDN (reference mpi_config.go:70-102)
     assert cm["data"]["hostfile"] == (
-        "j1-worker-0.default.svc slots=2\nj1-worker-1.default.svc slots=2")
+        "j1-worker-0 slots=2\nj1-worker-1 slots=2")
     assert "kubectl exec" in cm["data"]["kubexec.sh"]
     env_l = env_of(api, "j1-launcher-0")
     assert env_l["OMPI_MCA_orte_default_hostfile"] == "/etc/mpi/hostfile"
