@@ -15,8 +15,10 @@ from ..core.apiserver import APIServer
 from ..core.events import Recorder
 from ..core.manager import Manager
 from ..metrics import JobMetrics, Registry
+from ..core.deployment import DeploymentReconciler
 from ..platform.models import (DEFAULT_IMAGE_BUILDER, ModelReconciler,
                                ModelVersionReconciler)
+from ..platform.serving import InferenceReconciler
 from ..scheduling.gang import new_gang_scheduler
 from .engine import EngineConfig, JobEngine
 from .workloads import ALL_CONTROLLERS
@@ -87,5 +89,9 @@ def build_operator(api: Optional[APIServer] = None,
         api, recorder=recorder,
         image_builder=config.model_image_builder or DEFAULT_IMAGE_BUILDER))
     manager.register(ModelReconciler(api))
+    manager.register(InferenceReconciler(api, recorder=recorder))
+    # substrate shim: materializes Deployments into pods on the in-memory
+    # control plane (no kube-controller-manager underneath in standalone)
+    manager.register(DeploymentReconciler(api))
     return Operator(api=api, manager=manager, engines=engines,
                     metrics_registry=registry, config=config)

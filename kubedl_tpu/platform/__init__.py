@@ -12,3 +12,4 @@ from .models import (  # noqa: F401
     add_model_path_env,
     provider_for,
 )
+from .serving import InferenceReconciler  # noqa: F401

@@ -147,35 +147,34 @@ class GCSProvider(StorageProvider):
     land), mounted through the GKE gcsfuse CSI driver for both training and
     the build pod — no PV/PVC staging copy."""
 
-    def add_model_volume(self, pod_template, storage):
-        gcs = storage["gcs"]
-        md = pod_template.setdefault("metadata", {})
-        ann = md.setdefault("annotations", {})
-        ann.setdefault("gke-gcsfuse/volumes", "true")
-        _mount_all_containers(
-            pod_template,
-            {"name": "modelvolume",
-             "csi": {"driver": "gcsfuse.csi.storage.gke.io",
-                     "volumeAttributes": {
-                         "bucketName": gcs.get("bucket", ""),
-                         "mountOptions": "implicit-dirs",
-                     }}},
-            self.mount_path(storage))
-
-    def mount_path(self, storage):
-        return storage["gcs"].get("mountPath") or DEFAULT_MODEL_PATH_IN_IMAGE
-
-    def build_volume(self, storage, mv):
-        gcs = storage["gcs"]
+    @staticmethod
+    def _fuse_volume(name: str, gcs: dict) -> dict:
+        """gcsfuse CSI volume scoped to gcs.path via only-dir, so training,
+        build, and serving all see the same directory."""
         attrs = {"bucketName": gcs.get("bucket", "")}
         path = (gcs.get("path") or "").strip("/")
         opts = "implicit-dirs"
         if path:
             opts += f",only-dir={path}"
         attrs["mountOptions"] = opts
-        return {"name": "build-source",
+        return {"name": name,
                 "csi": {"driver": "gcsfuse.csi.storage.gke.io",
                         "volumeAttributes": attrs}}
+
+    def add_model_volume(self, pod_template, storage):
+        gcs = storage["gcs"]
+        md = pod_template.setdefault("metadata", {})
+        ann = md.setdefault("annotations", {})
+        ann.setdefault("gke-gcsfuse/volumes", "true")
+        _mount_all_containers(pod_template,
+                              self._fuse_volume("modelvolume", gcs),
+                              self.mount_path(storage))
+
+    def mount_path(self, storage):
+        return storage["gcs"].get("mountPath") or DEFAULT_MODEL_PATH_IN_IMAGE
+
+    def build_volume(self, storage, mv):
+        return self._fuse_volume("build-source", storage["gcs"])
 
     def needs_pvc(self) -> bool:
         return False

@@ -1,0 +1,223 @@
+"""Inference serving: predictor deployments, model loading, canary traffic
+split (reference ``controllers/serving``)."""
+
+import pytest
+
+from kubedl_tpu.controllers.registry import OperatorConfig, build_operator
+from kubedl_tpu.core import meta as m
+from kubedl_tpu.platform import serving as sv
+
+
+@pytest.fixture
+def op(api):
+    return build_operator(api, OperatorConfig(gang_scheduler_name=""))
+
+
+def built_mv(api, name="mv1", image="reg/bert:v1", storage=None):
+    mv = m.new_obj("model.kubedl.io/v1alpha1", "ModelVersion", name)
+    mv["spec"] = {"modelName": "bert", "imageRepo": "reg/bert",
+                  "storage": storage or {"localStorage": {
+                      "path": "/m", "nodeName": "n1"}}}
+    mv = api.create(mv)
+    mv["status"] = {"imageBuildPhase": "ImageBuildSucceeded", "image": image}
+    return api.update_status(mv)
+
+
+def new_inference(name="inf1", framework="TFServing", predictors=None):
+    inf = m.new_obj("serving.kubedl.io/v1alpha1", "Inference", name)
+    inf["spec"] = {"framework": framework,
+                   "predictors": predictors or [
+                       {"name": "p0", "modelVersion": "mv1", "replicas": 2,
+                        "template": {"spec": {"containers": [
+                            {"name": "serving", "image": "tfserving:2.9"}]}}}]}
+    return inf
+
+
+def test_predictor_deployment_and_services(api, op):
+    built_mv(api)
+    api.create(new_inference())
+    op.run_until_idle()
+
+    deploy = api.get("Deployment", "default", "inf1-p0")
+    assert deploy["spec"]["replicas"] == 2
+    # model loader init container from the baked image
+    tmpl = deploy["spec"]["template"]
+    init = tmpl["spec"]["initContainers"][0]
+    assert init["image"] == "reg/bert:v1"
+    assert "cp -r" in init["command"][-1]
+    ct = tmpl["spec"]["containers"][0]
+    envs = {e["name"]: e.get("value") for e in ct["env"]}
+    assert envs["KUBEDL_MODEL_PATH"] == "/kubedl-model/bert"
+    assert envs["MODEL_NAME"] == "bert"  # TFServing setter
+    assert envs["MODEL_BASE_PATH"] == "/kubedl-model"
+    # entry service + per-predictor service
+    assert api.get("Service", "default", "inf1")
+    assert api.get("Service", "default", "inf1-p0")
+    # substrate shim materialized the predictor pods
+    assert api.try_get("Pod", "default", "inf1-p0-0") is not None
+    assert api.try_get("Pod", "default", "inf1-p0-1") is not None
+
+    # status rolls up from the deployment once pods run
+    for i in range(2):
+        pod = api.get("Pod", "default", f"inf1-p0-{i}")
+        pod["status"] = {"phase": "Running"}
+        api.update_status(pod)
+    op.run_until_idle()
+    inf = api.get("Inference", "default", "inf1")
+    ps = inf["status"]["predictorStatuses"][0]
+    assert ps["readyReplicas"] == 2
+    assert ps["endpoint"] == "inf1-p0.default.svc"
+    assert inf["status"]["inferenceEndpoint"] == "inf1.default.svc"
+
+
+def test_gates_on_model_build(api, op):
+    mv = m.new_obj("model.kubedl.io/v1alpha1", "ModelVersion", "mv1")
+    mv["spec"] = {"modelName": "bert", "imageRepo": "r/b",
+                  "storage": {"gcs": {"bucket": "b"}}}
+    api.create(mv)
+    api.create(new_inference())
+    op.run_until_idle()
+    # build not finished -> no deployment yet
+    assert api.try_get("Deployment", "default", "inf1-p0") is None
+    build = api.get("Pod", "default", "image-build-mv1")
+    build["status"] = {"phase": "Succeeded"}
+    api.update_status(build)
+    op.run_until_idle(include_delayed=True)
+    assert api.get("Deployment", "default", "inf1-p0")
+
+
+def test_canary_traffic_split(api, op):
+    built_mv(api, "mv1")
+    built_mv(api, "mv2", image="reg/bert:v2")
+    api.create(new_inference(predictors=[
+        {"name": "stable", "modelVersion": "mv1", "trafficWeight": 90,
+         "template": {"spec": {"containers": [{"name": "s", "image": "i"}]}}},
+        {"name": "canary", "modelVersion": "mv2", "trafficWeight": 10,
+         "template": {"spec": {"containers": [{"name": "s", "image": "i"}]}}},
+    ]))
+    op.run_until_idle()
+    vs = api.get("VirtualService", "default", "inf1")
+    routes = {r["name"]: r["route"][0]["weight"] for r in vs["spec"]["http"]}
+    assert routes == {"stable": 90, "canary": 10}
+    assert vs["spec"]["http"][0]["route"][0]["destination"]["host"] == \
+        "inf1-stable.default.svc"
+    inf = api.get("Inference", "default", "inf1")
+    pcts = {p["name"]: p["trafficPercent"]
+            for p in inf["status"]["predictorStatuses"]}
+    assert pcts == {"stable": 90, "canary": 10}
+
+    # shifting weights updates the routes in place
+    inf["spec"]["predictors"][0]["trafficWeight"] = 50
+    inf["spec"]["predictors"][1]["trafficWeight"] = 50
+    api.update(inf)
+    op.run_until_idle()
+    vs = api.get("VirtualService", "default", "inf1")
+    routes = {r["name"]: r["route"][0]["weight"] for r in vs["spec"]["http"]}
+    assert routes == {"stable": 50, "canary": 50}
+
+
+def test_unweighted_predictors_split_evenly():
+    ratios = sv.compute_traffic_ratios([{"name": "a"}, {"name": "b"},
+                                        {"name": "c"}])
+    assert sum(ratios.values()) == 100
+    assert sorted(ratios.values()) == [33, 33, 34]
+
+
+def test_gcs_model_served_from_bucket(api, op):
+    built_mv(api, storage={"gcs": {"bucket": "ckpts", "path": "bert"}})
+    api.create(new_inference(framework="JAXServing"))
+    op.run_until_idle()
+    deploy = api.get("Deployment", "default", "inf1-p0")
+    tmpl = deploy["spec"]["template"]
+    # no loader init container; the bucket is fuse-mounted at the model path
+    assert not tmpl["spec"].get("initContainers")
+    vol = next(v for v in tmpl["spec"]["volumes"] if v["name"] == "modelvolume")
+    assert vol["csi"]["driver"] == "gcsfuse.csi.storage.gke.io"
+    ct = tmpl["spec"]["containers"][0]
+    envs = {e["name"]: e.get("value") for e in ct["env"]}
+    assert envs["PJRT_DEVICE"] == "TPU"  # JAXServing setter
+    assert envs["KUBEDL_MODEL_PATH"] == "/kubedl-model/bert"
+    assert any(vm["mountPath"] == "/kubedl-model/bert"
+               for vm in ct["volumeMounts"])
+
+
+def test_tpu_placement_single_host_slice(api, op):
+    built_mv(api)
+    inf = new_inference(framework="JAXServing")
+    inf["spec"]["tpuPolicy"] = {"acceleratorType": "v5e-4"}
+    api.create(inf)
+    op.run_until_idle()
+    tmpl = api.get("Deployment", "default", "inf1-p0")["spec"]["template"]
+    sel = tmpl["spec"]["nodeSelector"]
+    assert sel["cloud.google.com/gke-tpu-accelerator"]
+    ct = tmpl["spec"]["containers"][0]
+    assert ct["resources"]["limits"]["google.com/tpu"] == "4"
+
+
+def test_removed_predictor_pruned(api, op):
+    built_mv(api, "mv1")
+    built_mv(api, "mv2")
+    api.create(new_inference(predictors=[
+        {"name": "a", "modelVersion": "mv1",
+         "template": {"spec": {"containers": [{"name": "s", "image": "i"}]}}},
+        {"name": "b", "modelVersion": "mv2",
+         "template": {"spec": {"containers": [{"name": "s", "image": "i"}]}}},
+    ]))
+    op.run_until_idle()
+    assert api.get("Deployment", "default", "inf1-b")
+    inf = api.get("Inference", "default", "inf1")
+    inf["spec"]["predictors"] = inf["spec"]["predictors"][:1]
+    api.update(inf)
+    op.run_until_idle()
+    assert api.try_get("Deployment", "default", "inf1-b") is None
+    assert api.try_get("Service", "default", "inf1-b") is None
+    assert api.get("Deployment", "default", "inf1-a")
+
+
+def test_virtualservice_pruned_when_canary_removed(api, op):
+    built_mv(api, "mv1")
+    built_mv(api, "mv2")
+    api.create(new_inference(predictors=[
+        {"name": "a", "modelVersion": "mv1", "trafficWeight": 90,
+         "template": {"spec": {"containers": [{"name": "s", "image": "i"}]}}},
+        {"name": "b", "modelVersion": "mv2", "trafficWeight": 10,
+         "template": {"spec": {"containers": [{"name": "s", "image": "i"}]}}},
+    ]))
+    op.run_until_idle()
+    assert api.get("VirtualService", "default", "inf1")
+    inf = api.get("Inference", "default", "inf1")
+    inf["spec"]["predictors"] = inf["spec"]["predictors"][:1]
+    api.update(inf)
+    op.run_until_idle()
+    # stale weighted routes must not blackhole traffic at a dead predictor
+    assert api.try_get("VirtualService", "default", "inf1") is None
+
+
+def test_multihost_tpu_policy_fails_permanently(api, op):
+    built_mv(api)
+    inf = new_inference(framework="JAXServing")
+    inf["spec"]["tpuPolicy"] = {"acceleratorType": "v5p-32"}  # 4 hosts
+    api.create(inf)
+    op.run_until_idle()  # must terminate, not retry-loop
+    inf = api.get("Inference", "default", "inf1")
+    assert "single-host" in inf["status"]["failureMessage"]
+    assert api.try_get("Deployment", "default", "inf1-p0") is None
+
+
+def test_scale_predictor_replicas(api, op):
+    built_mv(api)
+    api.create(new_inference())
+    op.run_until_idle()
+    inf = api.get("Inference", "default", "inf1")
+    inf["spec"]["predictors"][0]["replicas"] = 4
+    api.update(inf)
+    op.run_until_idle()
+    assert api.get("Deployment", "default", "inf1-p0")["spec"]["replicas"] == 4
+    assert api.try_get("Pod", "default", "inf1-p0-3") is not None
+    # scale back down removes the extra pods
+    inf = api.get("Inference", "default", "inf1")
+    inf["spec"]["predictors"][0]["replicas"] = 1
+    api.update(inf)
+    op.run_until_idle()
+    assert api.try_get("Pod", "default", "inf1-p0-3") is None
+    assert api.try_get("Pod", "default", "inf1-p0-0") is not None
