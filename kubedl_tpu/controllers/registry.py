@@ -16,6 +16,7 @@ from ..core.events import Recorder
 from ..core.manager import Manager
 from ..metrics import JobMetrics, Registry
 from ..core.deployment import DeploymentReconciler
+from ..platform.cron import CronReconciler
 from ..platform.models import (DEFAULT_IMAGE_BUILDER, ModelReconciler,
                                ModelVersionReconciler)
 from ..platform.serving import InferenceReconciler
@@ -90,6 +91,8 @@ def build_operator(api: Optional[APIServer] = None,
         image_builder=config.model_image_builder or DEFAULT_IMAGE_BUILDER))
     manager.register(ModelReconciler(api))
     manager.register(InferenceReconciler(api, recorder=recorder))
+    manager.register(CronReconciler(
+        api, recorder=recorder, workload_kinds=list(engines)))
     # substrate shim: materializes Deployments into pods on the in-memory
     # control plane (no kube-controller-manager underneath in standalone)
     manager.register(DeploymentReconciler(api))

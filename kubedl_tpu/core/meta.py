@@ -24,6 +24,18 @@ def rfc3339(t: Optional[float] = None) -> str:
                          time.gmtime(time.time() if t is None else t))
 
 
+def parse_rfc3339(ts) -> Optional[float]:
+    """Inverse of :func:`rfc3339` — UTC in, UTC out (``calendar.timegm``,
+    never ``time.mktime``, which would shift by the host timezone)."""
+    if not ts:
+        return None
+    import calendar
+    try:
+        return float(calendar.timegm(time.strptime(ts, "%Y-%m-%dT%H:%M:%SZ")))
+    except ValueError:
+        return None
+
+
 def new_obj(api_version: str, kind: str, name: str, namespace: str = "default",
             labels: Optional[dict] = None, annotations: Optional[dict] = None,
             spec: Optional[dict] = None) -> Obj:

@@ -142,8 +142,7 @@ class InferenceReconciler(Reconciler):
         self._sync_entry_service(inf, predictors)
 
         requeue = False
-        ratios = (compute_traffic_ratios(predictors)
-                  if len(predictors) > 1 else {})
+        ready = []  # predictors with a live Deployment behind them
         for predictor in predictors:
             try:
                 ps = self._sync_predictor(inf, predictor)
@@ -163,15 +162,18 @@ class InferenceReconciler(Reconciler):
             if ps is None:
                 requeue = True
                 continue
-            if ratios:
-                ps["trafficPercent"] = ratios.get(predictor.get("name", ""), 0)
+            ready.append((predictor, ps))
             status["predictorStatuses"].append(ps)
 
-        if len(predictors) > 1:
-            self._sync_traffic_split(inf, predictors, ratios)
+        # traffic only ever routes to deployed predictors — a canary still
+        # waiting on its image build must not receive (and blackhole) weight
+        if len(ready) > 1:
+            ratios = compute_traffic_ratios([p for p, _ in ready])
+            for predictor, ps in ready:
+                ps["trafficPercent"] = ratios.get(predictor.get("name", ""), 0)
+            self._sync_traffic_split(inf, [p for p, _ in ready], ratios)
         else:
-            # canary over: drop stale weighted routes so no traffic is
-            # blackholed at a deleted predictor's host
+            # single live predictor: weighted routes would only blackhole
             try:
                 self.api.delete("VirtualService", req.namespace, req.name)
             except NotFound:
@@ -220,17 +222,18 @@ class InferenceReconciler(Reconciler):
                 return None  # not built yet -> requeue
 
         name = predictor_name(inf, predictor)
+        desired = self._render_deploy_spec(inf, predictor, mv)
         deploy = self.api.try_get("Deployment", ns, name)
         if deploy is None:
-            deploy = self._create_predictor_deploy(inf, predictor, mv)
-        else:
-            replicas = int(predictor.get("replicas") or 1)
-            if m.get_in(deploy, "spec", "replicas") != replicas:
-                deploy["spec"]["replicas"] = replicas
-                try:
-                    deploy = self.api.update(deploy)
-                except (Conflict, NotFound):
-                    pass
+            deploy = self._create_predictor_deploy(inf, predictor, desired)
+        elif deploy["spec"] != desired:
+            # propagate every spec change (template, model version, replicas),
+            # not just the replica count
+            deploy["spec"] = desired
+            try:
+                deploy = self.api.update(deploy)
+            except (Conflict, NotFound):
+                pass
         self._ensure_predictor_service(inf, predictor)
         return {
             "name": predictor.get("name", ""),
@@ -240,8 +243,8 @@ class InferenceReconciler(Reconciler):
             "endpoint": predictor_host(inf, predictor),
         }
 
-    def _create_predictor_deploy(self, inf: dict, predictor: dict,
-                                 mv: Optional[dict]) -> dict:
+    def _render_deploy_spec(self, inf: dict, predictor: dict,
+                            mv: Optional[dict]) -> dict:
         import copy as _copy
         template = _copy.deepcopy(predictor.get("template", {}) or {})
         model_path = predictor.get("modelPath") or ""
@@ -276,16 +279,19 @@ class InferenceReconciler(Reconciler):
         lbls = predictor_labels(inf, predictor)
         tmeta = template.setdefault("metadata", {})
         tmeta["labels"] = {**(tmeta.get("labels") or {}), **lbls}
-
-        deploy = m.new_obj("apps/v1", "Deployment",
-                           predictor_name(inf, predictor), m.namespace(inf))
-        m.labels(deploy).update(lbls)
-        deploy["spec"] = {
+        return {
             "replicas": int(predictor.get("replicas") or 1),
             "selector": {"matchLabels": dict(lbls)},
             "template": template,
             "strategy": {"type": "RollingUpdate"},
         }
+
+    def _create_predictor_deploy(self, inf: dict, predictor: dict,
+                                 spec: dict) -> dict:
+        deploy = m.new_obj("apps/v1", "Deployment",
+                           predictor_name(inf, predictor), m.namespace(inf))
+        m.labels(deploy).update(predictor_labels(inf, predictor))
+        deploy["spec"] = spec
         m.set_controller_ref(deploy, inf)
         try:
             deploy = self.api.create(deploy)

@@ -1,0 +1,243 @@
+"""Cron engine: schedule parsing + workload spawning + concurrency policies
+(reference ``controllers/apps``)."""
+
+import time
+
+import pytest
+
+from kubedl_tpu.controllers.registry import OperatorConfig, build_operator
+from kubedl_tpu.core import meta as m
+from kubedl_tpu.utils import cronschedule
+from kubedl_tpu.utils import status as st
+
+
+@pytest.fixture
+def op(api):
+    return build_operator(api, OperatorConfig(gang_scheduler_name=""))
+
+
+# ---------------------------------------------------------------------------
+# schedule parser
+# ---------------------------------------------------------------------------
+
+def _next(expr, t):
+    return cronschedule.parse(expr).next_after(t)
+
+
+def test_cron_parse_every_5_minutes():
+    t0 = time.mktime((2026, 1, 1, 10, 2, 0, 0, 1, -1))
+    nxt = _next("*/5 * * * *", t0)
+    assert time.localtime(nxt)[3:5] == (10, 5)
+    # exactly on a boundary -> strictly after
+    assert time.localtime(_next("*/5 * * * *", nxt))[3:5] == (10, 10)
+
+
+def test_cron_parse_daily_and_descriptors():
+    t0 = time.mktime((2026, 1, 1, 10, 2, 0, 0, 1, -1))
+    nxt = _next("30 6 * * *", t0)
+    assert time.localtime(nxt)[:5] == (2026, 1, 2, 6, 30)
+    assert _next("@daily", t0) == _next("0 0 * * *", t0)
+    assert _next("@hourly", t0) == _next("0 * * * *", t0)
+
+
+def test_cron_parse_dow_and_names():
+    # 2026-01-01 is a Thursday; next Monday is 2026-01-05
+    t0 = time.mktime((2026, 1, 1, 0, 0, 0, 0, 1, -1))
+    nxt = _next("0 9 * * mon", t0)
+    assert time.localtime(nxt)[:5] == (2026, 1, 5, 9, 0)
+    assert _next("0 9 * * 1", t0) == nxt
+    # month names + ranges
+    nxt = _next("0 0 1 feb-mar *", t0)
+    assert time.localtime(nxt)[:3] == (2026, 2, 1)
+
+
+def test_cron_parse_invalid():
+    for bad in ("", "* * * *", "61 * * * *", "* * * * 8-9", "a b c d e"):
+        with pytest.raises(cronschedule.InvalidSchedule):
+            cronschedule.parse(bad)
+
+
+def test_cron_dow_range_with_sunday_as_7():
+    # "5-7" = Fri,Sat,Sun — 7 folds to 0
+    s = cronschedule.parse("0 0 * * 5-7")
+    assert s.dow == frozenset({5, 6, 0})
+
+
+def test_cron_unsatisfiable_schedule_warns_not_loops(api, op):
+    api.create(new_cron(schedule="0 0 30 2 *"))  # Feb 30 never exists
+    n = op.run_until_idle()
+    assert n < 10
+    assert [e for e in api.list("Event") if e["reason"] == "InvalidSchedule"]
+
+
+def test_cron_long_outage_skips_backlog(api, op, clock):
+    api.create(new_cron(schedule="* * * * *"))  # every minute
+    op.run_until_idle()
+    clock.advance(3 * 86400)  # 3 days down: >> MAX_MISSED
+    op.run_until_idle()
+    # backlog skipped, cron resynced and alive — not wedged
+    cron = api.get("Cron", "default", "c1")
+    assert cron["status"]["lastScheduleTime"]
+    assert [e for e in api.list("Event")
+            if e["reason"] == "TooManyMissedTimes"]
+    clock.advance(61)
+    op.run_until_idle()
+    assert len(api.list("XGBoostJob")) == 1  # next tick fires normally
+
+
+def test_cron_dom_dow_or_semantics():
+    # POSIX: both restricted -> OR. Jan 2026: the 15th is a Thursday.
+    t0 = time.mktime((2026, 1, 12, 0, 0, 0, 0, 1, -1))  # Monday the 12th
+    s = cronschedule.parse("0 0 15 * fri")
+    nxt = s.next_after(t0)
+    # Friday the 16th? No - the 15th (dom) comes first
+    assert time.localtime(nxt)[:3] == (2026, 1, 15)
+
+
+# ---------------------------------------------------------------------------
+# controller
+# ---------------------------------------------------------------------------
+
+def new_cron(name="c1", schedule="*/5 * * * *", policy=None, **spec_extra):
+    cron = m.new_obj("apps.kubedl.io/v1alpha1", "Cron", name)
+    workload = {
+        "apiVersion": "training.kubedl.io/v1alpha1", "kind": "XGBoostJob",
+        "spec": {"xgbReplicaSpecs": {"Master": {
+            "replicas": 1,
+            "template": {"spec": {"containers": [
+                {"name": "xgboost", "image": "xgb"}]}}}}},
+    }
+    cron["spec"] = {"schedule": schedule,
+                    "template": {"workload": workload}, **spec_extra}
+    if policy:
+        cron["spec"]["concurrencyPolicy"] = policy
+    return cron
+
+
+def fire_next(op, clock, seconds=301):
+    clock.advance(seconds)
+    op.run_until_idle()
+
+
+def test_cron_spawns_workload_on_schedule(api, op, clock):
+    api.create(new_cron())
+    op.run_until_idle()
+    assert api.list("XGBoostJob") == []  # not due yet
+    fire_next(op, clock)
+    jobs = api.list("XGBoostJob")
+    assert len(jobs) == 1
+    job = jobs[0]
+    assert m.name(job).startswith("c1-")
+    assert m.labels(job)["kubedl.io/cron-name"] == "c1"
+    assert m.get_controller_ref(job)["kind"] == "Cron"
+    cron = api.get("Cron", "default", "c1")
+    assert len(cron["status"]["active"]) == 1
+    assert cron["status"]["lastScheduleTime"]
+    # the spawned job starts reconciling like any other job
+    assert api.try_get("Pod", "default", f"{m.name(job)}-master-0") is not None
+
+
+def test_cron_forbid_skips_while_active(api, op, clock):
+    api.create(new_cron(policy="Forbid"))
+    op.run_until_idle()
+    fire_next(op, clock)
+    assert len(api.list("XGBoostJob")) == 1
+    fire_next(op, clock)  # previous run still active -> skipped
+    assert len(api.list("XGBoostJob")) == 1
+
+
+def test_cron_replace_deletes_active(api, op, clock):
+    api.create(new_cron(policy="Replace"))
+    op.run_until_idle()
+    fire_next(op, clock)
+    first = m.name(api.list("XGBoostJob")[0])
+    fire_next(op, clock)
+    jobs = api.list("XGBoostJob")
+    assert len(jobs) == 1
+    assert m.name(jobs[0]) != first  # replaced
+
+
+def test_cron_allow_runs_concurrently(api, op, clock):
+    api.create(new_cron())
+    op.run_until_idle()
+    fire_next(op, clock)
+    fire_next(op, clock)
+    assert len(api.list("XGBoostJob")) == 2
+
+
+def test_cron_suspend(api, op, clock):
+    api.create(new_cron(suspend=True))
+    op.run_until_idle()
+    fire_next(op, clock)
+    assert api.list("XGBoostJob") == []
+
+
+def test_cron_deadline_stops_scheduling(api, op, clock):
+    deadline = m.rfc3339(clock() + 100)
+    api.create(new_cron(deadline=deadline))
+    op.run_until_idle()
+    fire_next(op, clock, 600)  # past the deadline
+    assert api.list("XGBoostJob") == []
+
+
+def test_cron_invalid_schedule_event_no_retry_loop(api, op):
+    api.create(new_cron(schedule="not a schedule"))
+    n = op.run_until_idle()
+    assert n < 10  # terminates instead of retry-looping
+    events = [e for e in api.list("Event") if e["reason"] == "InvalidSchedule"]
+    assert events
+
+
+def test_cron_finished_jobs_move_to_history(api, op, clock):
+    from kubedl_tpu.api.common import JobStatus
+    api.create(new_cron(historyLimit=1))
+    op.run_until_idle()
+    fire_next(op, clock)
+    job = api.list("XGBoostJob")[0]
+    status = JobStatus.from_dict(job.get("status"))
+    st.update_job_conditions(status, "Succeeded", "JobSucceeded", "done",
+                             now=clock())
+    status.completion_time = m.rfc3339(clock())
+    job["status"] = status.to_dict()
+    api.update_status(job)
+    op.run_until_idle()
+    cron = api.get("Cron", "default", "c1")
+    assert cron["status"]["active"] == []
+    assert len(cron["status"]["history"]) == 1
+    assert cron["status"]["history"][0]["status"] == "Succeeded"
+
+    # a second finished run evicts the first from history AND the cluster
+    first_name = m.name(job)
+    fire_next(op, clock)
+    job2 = next(j for j in api.list("XGBoostJob") if m.name(j) != first_name)
+    status = JobStatus.from_dict(job2.get("status"))
+    st.update_job_conditions(status, "Succeeded", "JobSucceeded", "done",
+                             now=clock())
+    job2["status"] = status.to_dict()
+    api.update_status(job2)
+    op.run_until_idle()
+    cron = api.get("Cron", "default", "c1")
+    assert len(cron["status"]["history"]) == 1
+    assert cron["status"]["history"][0]["object"]["name"] == m.name(job2)
+    assert api.try_get("XGBoostJob", "default", first_name) is None
+
+
+def test_job_with_cron_policy_runs_via_cron(api, op, clock):
+    """End-to-end: a job carrying runPolicy.cronPolicy defers to its Cron
+    wrapper, which then spawns copies on schedule."""
+    job = m.new_obj("training.kubedl.io/v1alpha1", "XGBoostJob", "nightly")
+    job["spec"] = {
+        "cronPolicy": {"schedule": "*/5 * * * *"},
+        "xgbReplicaSpecs": {"Master": {
+            "replicas": 1,
+            "template": {"spec": {"containers": [
+                {"name": "xgboost", "image": "xgb"}]}}}},
+    }
+    api.create(job)
+    op.run_until_idle()
+    assert api.get("Cron", "default", "nightly")
+    assert api.try_get("Pod", "default", "nightly-master-0") is None
+    fire_next(op, clock)
+    spawned = [j for j in api.list("XGBoostJob") if m.name(j) != "nightly"]
+    assert len(spawned) == 1
+    assert "cronPolicy" not in spawned[0]["spec"]

@@ -174,6 +174,45 @@ def test_removed_predictor_pruned(api, op):
     assert api.get("Deployment", "default", "inf1-a")
 
 
+def test_gated_canary_gets_no_traffic(api, op):
+    """A canary whose model image is still building must not receive
+    weighted traffic (it has no Deployment to serve it)."""
+    built_mv(api, "mv1")
+    mv2 = m.new_obj("model.kubedl.io/v1alpha1", "ModelVersion", "mv2")
+    mv2["spec"] = {"modelName": "bert", "imageRepo": "r/b",
+                   "storage": {"gcs": {"bucket": "b"}}}
+    api.create(mv2)  # build in flight
+    api.create(new_inference(predictors=[
+        {"name": "stable", "modelVersion": "mv1", "trafficWeight": 90,
+         "template": {"spec": {"containers": [{"name": "s", "image": "i"}]}}},
+        {"name": "canary", "modelVersion": "mv2", "trafficWeight": 10,
+         "template": {"spec": {"containers": [{"name": "s", "image": "i"}]}}},
+    ]))
+    op.run_until_idle()
+    assert api.try_get("VirtualService", "default", "inf1") is None
+    build = api.get("Pod", "default", "image-build-mv2")
+    build["status"] = {"phase": "Succeeded"}
+    api.update_status(build)
+    op.run_until_idle(include_delayed=True)
+    vs = api.get("VirtualService", "default", "inf1")
+    routes = {r["name"]: r["route"][0]["weight"] for r in vs["spec"]["http"]}
+    assert routes == {"stable": 90, "canary": 10}
+
+
+def test_predictor_template_change_propagates(api, op):
+    built_mv(api)
+    api.create(new_inference())
+    op.run_until_idle()
+    inf = api.get("Inference", "default", "inf1")
+    inf["spec"]["predictors"][0]["template"]["spec"]["containers"][0][
+        "image"] = "tfserving:2.11"
+    api.update(inf)
+    op.run_until_idle()
+    deploy = api.get("Deployment", "default", "inf1-p0")
+    assert deploy["spec"]["template"]["spec"]["containers"][0]["image"] == \
+        "tfserving:2.11"
+
+
 def test_virtualservice_pruned_when_canary_removed(api, op):
     built_mv(api, "mv1")
     built_mv(api, "mv2")
