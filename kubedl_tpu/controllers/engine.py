@@ -38,6 +38,7 @@ from ..scheduling.gang import GangScheduler
 from ..tpu import placement as pl
 from ..utils import status as st
 from ..utils import train
+from . import hostnetwork as hn
 from .expectations import Expectations
 from .interface import TPUPolicy, WorkloadController
 
@@ -50,6 +51,12 @@ class EngineConfig:
     enable_dag_scheduling: bool = True
     dns_domain: str = ""
     default_ttl_seconds: Optional[int] = None
+    #: (base, size) for hostnetwork random ports (reference main.go:69
+    #: --hostnetwork-port-range, default [20000, 30000))
+    hostnetwork_port_range: tuple = hn.DEFAULT_PORT_RANGE
+    #: HostNetWithHeadlessSvc gate: keep headless services even in
+    #: hostnetwork mode (reference features.go:36-40)
+    hostnet_with_headless_svc: bool = False
 
 
 @dataclass
@@ -234,6 +241,10 @@ class JobEngine(Reconciler):
 
         # ---- per-replica-type diff loops -------------------------------
         restart = [False]
+        # hostnetwork: replica -> live port, re-learned every round so
+        # service targetPorts track fail-overed pods (reference pod.go:337-340)
+        hostnet_ports: Optional[dict] = \
+            {} if hn.enable_hostnetwork(job) else None
         for rtype in self._orders(replicas):
             spec = replicas.get(rtype)
             if spec is None:
@@ -248,7 +259,7 @@ class JobEngine(Reconciler):
                 continue
             try:
                 self._reconcile_pods(job, status, pods, rtype, spec, replicas,
-                                     run_policy, plan, restart)
+                                     run_policy, plan, restart, hostnet_ports)
             except ValueError as e:
                 msg = f"invalid {self.kind} spec: {e}"
                 self.recorder.event(job, TYPE_WARNING, "InvalidJobSpec", msg)
@@ -260,7 +271,8 @@ class JobEngine(Reconciler):
                 self._flush_status(job, status, old_status)
                 return None
             if self.controller.needs_service(rtype, job):
-                self._reconcile_services(job, services, rtype, spec)
+                self._reconcile_services(job, services, rtype, spec,
+                                         hostnet_ports)
 
         self._update_job_status(job, replicas, status, restart[0], pods)
         self.controller.on_job_running(job)
@@ -408,7 +420,8 @@ class JobEngine(Reconciler):
 
     def _reconcile_pods(self, job, status: JobStatus, all_pods, rtype: str,
                         spec: ReplicaSpec, replicas, run_policy: RunPolicy,
-                        plan: _ReplicaPlan, restart: list) -> None:
+                        plan: _ReplicaPlan, restart: list,
+                        hostnet_ports: Optional[dict] = None) -> None:
         rt = rtype.lower()
         pods = [p for p in all_pods
                 if m.labels(p).get(c.LABEL_REPLICA_TYPE) == rt]
@@ -441,7 +454,7 @@ class JobEngine(Reconciler):
                     Expectations.pods_key(job_key, rtype), 1)
                 try:
                     self._create_pod(job, rtype, index, spec, replicas,
-                                     run_policy, plan)
+                                     run_policy, plan, hostnet_ports)
                 except AlreadyExists:
                     # the AlreadyExists trap (reference pod.go:282-307):
                     # balance the expectation we just set or reconcile stalls
@@ -457,6 +470,12 @@ class JobEngine(Reconciler):
                 continue
             else:
                 pod = slice_pods[0]
+                if hostnet_ports is not None:
+                    port = hn.get_pod_hostnetwork_port(
+                        pod, self.controller.default_container_name,
+                        self.controller.default_port_name)
+                    if port is not None:
+                        hostnet_ports[(rt, index)] = port
                 if index >= num:  # scale-in: out-of-range index
                     if not m.is_deleting(pod):
                         self.recorder.event(
@@ -488,7 +507,8 @@ class JobEngine(Reconciler):
             self.expectations.deletion_observed(Expectations.pods_key(job_key, rtype))
 
     def _create_pod(self, job, rtype: str, index: int, spec: ReplicaSpec,
-                    replicas, run_policy: RunPolicy, plan: _ReplicaPlan) -> None:
+                    replicas, run_policy: RunPolicy, plan: _ReplicaPlan,
+                    hostnet_ports: Optional[dict] = None) -> None:
         rt = rtype.lower()
         template = copy.deepcopy(spec.template) or {}
         pod = {
@@ -515,6 +535,15 @@ class JobEngine(Reconciler):
         pod["spec"]["restartPolicy"] = (
             c.RESTART_NEVER if spec.restart_policy in (c.RESTART_EXIT_CODE, "")
             else spec.restart_policy)
+
+        # hostnetwork: random port per replica (reference pod.go:509-521)
+        hostnet_port: Optional[int] = None
+        if hostnet_ports is not None:
+            port = hn.random_port(self.config.hostnetwork_port_range)
+            if hn.setup_pod_hostnetwork(
+                    pod, self.controller.default_container_name,
+                    self.controller.default_port_name, port):
+                hostnet_port = port
 
         # TPU slice placement + PJRT rendezvous env. Non-TPU roles of a
         # multislice job still gang with slice 0 (their minMember home).
@@ -549,6 +578,10 @@ class JobEngine(Reconciler):
 
         m.set_controller_ref(pod, job)
         self.api.create(pod)
+        # record the host port only once the pod really exists; on
+        # AlreadyExists the next round re-learns the live pod's port instead
+        if hostnet_ports is not None and hostnet_port is not None:
+            hostnet_ports[(rt, index)] = hostnet_port
         self.recorder.event(job, TYPE_NORMAL, "SuccessfulCreatePod",
                             f"Created pod: {md['name']}")
 
@@ -557,7 +590,8 @@ class JobEngine(Reconciler):
     # ------------------------------------------------------------------
 
     def _reconcile_services(self, job, all_services, rtype: str,
-                            spec: ReplicaSpec) -> None:
+                            spec: ReplicaSpec,
+                            hostnet_ports: Optional[dict] = None) -> None:
         rt = rtype.lower()
         services = [s for s in all_services
                     if m.labels(s).get(c.LABEL_REPLICA_TYPE) == rt]
@@ -578,7 +612,7 @@ class JobEngine(Reconciler):
                 self.expectations.expect_creations(
                     Expectations.services_key(job_key, rtype), 1)
                 try:
-                    self._create_service(job, rtype, index, spec)
+                    self._create_service(job, rtype, index, spec, hostnet_ports)
                 except AlreadyExists:
                     self.expectations.creation_observed(
                         Expectations.services_key(job_key, rtype))
@@ -590,8 +624,23 @@ class JobEngine(Reconciler):
                 except NotFound:
                     self.expectations.deletion_observed(
                         Expectations.services_key(job_key, rtype))
+            elif hostnet_ports is not None:
+                # fail-over port re-sync (reference service.go:236-250): the
+                # replica's pod may have restarted on a new random host port;
+                # point the stable service at wherever it listens now
+                svc = group[0]
+                live = hostnet_ports.get((rt, index))
+                ports = m.get_in(svc, "spec", "ports", default=[]) or []
+                if live is not None and ports \
+                        and ports[0].get("targetPort") != live:
+                    ports[0]["targetPort"] = live
+                    try:
+                        self.api.update(svc)
+                    except (Conflict, NotFound):
+                        pass
 
-    def _create_service(self, job, rtype: str, index: int, spec: ReplicaSpec) -> None:
+    def _create_service(self, job, rtype: str, index: int, spec: ReplicaSpec,
+                        hostnet_ports: Optional[dict] = None) -> None:
         rt = rtype.lower()
         labels = self.gen_labels(m.name(job))
         labels[c.LABEL_REPLICA_TYPE] = rt
@@ -600,13 +649,21 @@ class JobEngine(Reconciler):
                                    self.controller.default_container_name,
                                    self.controller.default_port_name) \
             or self.controller.default_port
+        # headless services can't remap ports, so hostnetwork mode uses a
+        # normal service whose targetPort tracks the pod's random host port
+        # (reference service.go:276-305), unless HostNetWithHeadlessSvc
+        cluster_ip = "None"
+        target_port = port
+        if hostnet_ports is not None and not self.config.hostnet_with_headless_svc:
+            cluster_ip = ""
+            target_port = hostnet_ports.get((rt, index), port)
         svc = m.new_obj("v1", "Service", pl.replica_name(m.name(job), rt, index),
                         m.namespace(job), labels=labels)
         svc["spec"] = {
-            "clusterIP": "None",  # headless: DNS fabric for rendezvous
+            "clusterIP": cluster_ip,  # "None" = headless DNS fabric
             "selector": dict(labels),
             "ports": [{"name": self.controller.default_port_name,
-                       "port": port, "targetPort": port}],
+                       "port": port, "targetPort": target_port}],
         }
         m.set_controller_ref(svc, job)
         self.api.create(svc)

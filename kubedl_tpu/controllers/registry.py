@@ -11,9 +11,12 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Optional, Sequence
 
+from ..core import features as ft
 from ..core.apiserver import APIServer
+from . import hostnetwork as hn
 from ..core.events import Recorder
 from ..core.manager import Manager
+from ..utils import workloadgate
 from ..metrics import JobMetrics, Registry
 from ..core.deployment import DeploymentReconciler
 from ..platform.cron import CronReconciler
@@ -30,12 +33,20 @@ class OperatorConfig:
     """Flag-surface parity with reference ``cmd/options/options.go`` +
     ``main.go:60-72``."""
     workloads: Optional[Sequence[str]] = None   # None = all kinds enabled
+    #: --workloads spec string ("*", "Kind,-Kind", "auto"); evaluated through
+    #: the workload gate (env WORKLOADS_ENABLE overrides) when set and
+    #: ``workloads`` is None
+    workloads_spec: Optional[str] = None
     gang_scheduler_name: str = "coscheduler"    # "" disables gang scheduling
     enable_dag_scheduling: bool = True
     dns_domain: str = ""
     max_reconciles: int = 1
     #: builder image for ModelVersion image builds (--model-image-builder)
     model_image_builder: str = ""
+    #: --feature-gates; None = process default gates
+    feature_gates: Optional[ft.FeatureGates] = None
+    #: --hostnetwork-port-range (base, size)
+    hostnetwork_port_range: tuple = hn.DEFAULT_PORT_RANGE
 
 
 @dataclass
@@ -66,15 +77,23 @@ def build_operator(api: Optional[APIServer] = None,
     registry = Registry()
     metrics = JobMetrics(registry)
     recorder = Recorder(api)
+    gates = config.feature_gates or ft.default_gates
     gang = (new_gang_scheduler(config.gang_scheduler_name, api)
-            if config.gang_scheduler_name else None)
+            if config.gang_scheduler_name
+            and gates.enabled(ft.GANG_SCHEDULING) else None)
     engine_config = EngineConfig(
         enable_gang_scheduling=gang is not None,
-        enable_dag_scheduling=config.enable_dag_scheduling,
-        dns_domain=config.dns_domain)
+        enable_dag_scheduling=(config.enable_dag_scheduling
+                               and gates.enabled(ft.DAG_SCHEDULING)),
+        dns_domain=config.dns_domain,
+        hostnetwork_port_range=config.hostnetwork_port_range,
+        hostnet_with_headless_svc=gates.enabled(ft.HOSTNET_WITH_HEADLESS_SVC))
 
     engines = {}
     enabled = set(config.workloads) if config.workloads is not None else None
+    if enabled is None and config.workloads_spec is not None:
+        enabled = set(workloadgate.enabled_kinds(
+            [cc.kind for cc in ALL_CONTROLLERS], config.workloads_spec))
     for ctrl_cls in ALL_CONTROLLERS:
         if enabled is not None and ctrl_cls.kind not in enabled:
             continue

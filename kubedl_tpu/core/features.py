@@ -1,0 +1,79 @@
+"""Feature gates.
+
+Behavioral analog of the reference's k8s component-base gates
+(``pkg/features/features.go:24-55``): a named on/off switch registry with
+per-gate defaults and a ``--feature-gates=K=V,...`` / ``KUBEDL_FEATURE_GATES``
+parser. Gates keep the reference's names plus TPU-native additions.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+# reference gates (features.go:24-40)
+GANG_SCHEDULING = "GangScheduling"
+DAG_SCHEDULING = "DAGScheduling"
+PYTORCH_LOCAL_MASTER_ADDR = "PyTorchLocalMasterAddr"
+HOSTNET_WITH_HEADLESS_SVC = "HostNetWithHeadlessSvc"
+# TPU-native gates
+TPU_MULTISLICE = "TPUMultislice"          # allow numSlices > 1 (DCN megascale env)
+JAX_PROFILER_UPLOAD = "JAXProfilerUpload"  # render XProf profile-dir env
+
+_DEFAULTS = {
+    GANG_SCHEDULING: True,           # Beta
+    DAG_SCHEDULING: True,            # Beta
+    PYTORCH_LOCAL_MASTER_ADDR: True,  # Beta
+    HOSTNET_WITH_HEADLESS_SVC: False,  # Alpha
+    TPU_MULTISLICE: True,
+    JAX_PROFILER_UPLOAD: False,
+}
+
+ENV_FEATURE_GATES = "KUBEDL_FEATURE_GATES"
+
+
+class UnknownFeature(KeyError):
+    pass
+
+
+@dataclass
+class FeatureGates:
+    """An isolated gate set (tests build their own; the operator uses the
+    process-wide ``default_gates``)."""
+
+    overrides: dict = field(default_factory=dict)
+
+    def enabled(self, name: str) -> bool:
+        if name not in _DEFAULTS:
+            raise UnknownFeature(name)
+        return self.overrides.get(name, _DEFAULTS[name])
+
+    def set(self, name: str, value: bool) -> None:
+        if name not in _DEFAULTS:
+            raise UnknownFeature(name)
+        self.overrides[name] = bool(value)
+
+    def parse(self, spec: str) -> None:
+        """Parse ``Gate1=true,Gate2=false`` (the --feature-gates syntax)."""
+        for part in (spec or "").split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if "=" not in part:
+                raise ValueError(f"feature gate {part!r} is not in K=V form")
+            name, _, raw = part.partition("=")
+            raw = raw.strip().lower()
+            if raw not in ("true", "false"):
+                raise ValueError(f"feature gate {name} value {raw!r} is not a bool")
+            self.set(name.strip(), raw == "true")
+
+    def parse_env(self, env: dict | None = None) -> None:
+        env = env if env is not None else dict(os.environ)
+        if env.get(ENV_FEATURE_GATES):
+            self.parse(env[ENV_FEATURE_GATES])
+
+    def known(self) -> dict:
+        return {k: self.enabled(k) for k in _DEFAULTS}
+
+
+default_gates = FeatureGates()

@@ -25,15 +25,19 @@ def rfc3339(t: Optional[float] = None) -> str:
 
 
 def parse_rfc3339(ts) -> Optional[float]:
-    """Inverse of :func:`rfc3339` — UTC in, UTC out (``calendar.timegm``,
-    never ``time.mktime``, which would shift by the host timezone)."""
+    """Inverse of :func:`rfc3339`, accepting the full RFC3339 surface
+    (fractional seconds, ``Z`` or numeric offsets) — a timestamp written by
+    another client must not silently parse to None and disable a deadline."""
     if not ts:
         return None
-    import calendar
+    from datetime import datetime, timezone
     try:
-        return float(calendar.timegm(time.strptime(ts, "%Y-%m-%dT%H:%M:%SZ")))
+        dt = datetime.fromisoformat(str(ts).replace("Z", "+00:00"))
     except ValueError:
         return None
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=timezone.utc)
+    return dt.timestamp()
 
 
 def new_obj(api_version: str, kind: str, name: str, namespace: str = "default",

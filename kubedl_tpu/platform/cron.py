@@ -108,14 +108,16 @@ class CronReconciler(Reconciler):
         history.sort(key=lambda h: h.get("created") or "")
         if limit is not None and len(history) > limit:
             # drop the oldest beyond the limit, and their objects with them
-            for h in history[:-limit]:
+            # (limit may be 0 = keep nothing, so slice by count kept)
+            drop = len(history) - limit
+            for h in history[:drop]:
                 obj = h.get("object", {})
                 try:
                     self.api.delete(obj.get("kind", ""), m.namespace(cron),
                                     obj.get("name", ""))
                 except NotFound:
                     pass
-            history = history[-limit:]
+            history = history[drop:]
         status["history"] = history
         actives[:] = still_active
 

@@ -1,0 +1,168 @@
+"""Feature gates, workload gate, and hostnetwork mode (reference
+``pkg/features``, ``pkg/util/workloadgate``, ``pkg/job_controller/
+hostnetwork.go`` + the service port re-sync in ``service.go:236-250``)."""
+
+import pytest
+
+from kubedl_tpu.api import common as c
+from kubedl_tpu.controllers import hostnetwork as hn
+from kubedl_tpu.controllers.engine import EngineConfig, JobEngine
+from kubedl_tpu.controllers.registry import OperatorConfig, build_operator
+from kubedl_tpu.controllers.testing import (
+    TestJobController, new_test_job, run_all_pods)
+from kubedl_tpu.core import features as ft
+from kubedl_tpu.core import meta as m
+from kubedl_tpu.utils import workloadgate as wg
+
+
+# ---------------------------------------------------------------------------
+# feature gates
+# ---------------------------------------------------------------------------
+
+def test_gate_defaults():
+    g = ft.FeatureGates()
+    assert g.enabled(ft.GANG_SCHEDULING)
+    assert g.enabled(ft.DAG_SCHEDULING)
+    assert g.enabled(ft.PYTORCH_LOCAL_MASTER_ADDR)
+    assert not g.enabled(ft.HOSTNET_WITH_HEADLESS_SVC)
+
+
+def test_gate_parse_and_override():
+    g = ft.FeatureGates()
+    g.parse("GangScheduling=false, HostNetWithHeadlessSvc=TRUE")
+    assert not g.enabled(ft.GANG_SCHEDULING)
+    assert g.enabled(ft.HOSTNET_WITH_HEADLESS_SVC)
+    # other gates keep defaults, and instances are isolated
+    assert g.enabled(ft.DAG_SCHEDULING)
+    assert ft.FeatureGates().enabled(ft.GANG_SCHEDULING)
+
+
+def test_gate_parse_errors():
+    g = ft.FeatureGates()
+    with pytest.raises(ft.UnknownFeature):
+        g.parse("NoSuchGate=true")
+    with pytest.raises(ValueError):
+        g.parse("GangScheduling=maybe")
+    with pytest.raises(ValueError):
+        g.parse("GangScheduling")
+
+
+def test_gate_parse_env():
+    g = ft.FeatureGates()
+    g.parse_env({ft.ENV_FEATURE_GATES: "DAGScheduling=false"})
+    assert not g.enabled(ft.DAG_SCHEDULING)
+
+
+# ---------------------------------------------------------------------------
+# workload gate
+# ---------------------------------------------------------------------------
+
+def test_workload_spec_grammar():
+    enables, enable_all = wg.parse_workloads_enabled("*,-MarsJob, TFJob")
+    assert enable_all
+    assert enables == {"MarsJob": False, "TFJob": True}
+
+
+def test_workload_enabled_flag_and_env():
+    # flag: enable-list
+    assert wg.is_workload_enabled("TFJob", "TFJob,PyTorchJob", env={})
+    assert not wg.is_workload_enabled("MarsJob", "TFJob,PyTorchJob", env={})
+    # star with negation
+    assert wg.is_workload_enabled("XDLJob", "*,-MarsJob", env={})
+    assert not wg.is_workload_enabled("MarsJob", "*,-MarsJob", env={})
+    # env overrides flag (workload_gate.go:48-56)
+    assert not wg.is_workload_enabled(
+        "TFJob", "TFJob", env={wg.ENV_WORKLOADS_ENABLE: "PyTorchJob"})
+
+
+def test_workload_auto_detect():
+    installed = {"TFJob": True, "MarsJob": False}
+    assert wg.is_workload_enabled("TFJob", "auto", env={},
+                                  crd_installed=installed.get)
+    assert not wg.is_workload_enabled("MarsJob", "auto", env={},
+                                      crd_installed=installed.get)
+    # default (no detector): everything served
+    assert wg.is_workload_enabled("MarsJob", None, env={})
+
+
+def test_operator_workloads_spec():
+    op = build_operator(config=OperatorConfig(workloads_spec="*,-MarsJob"))
+    assert "TFJob" in op.engines and "PyTorchJob" in op.engines
+    assert "MarsJob" not in op.engines
+
+
+def test_operator_gates_disable_gang():
+    gates = ft.FeatureGates()
+    gates.parse("GangScheduling=false")
+    op = build_operator(config=OperatorConfig(feature_gates=gates))
+    assert next(iter(op.engines.values())).gang is None
+
+
+# ---------------------------------------------------------------------------
+# hostnetwork mode
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def hostnet_engine(api, manager):
+    eng = JobEngine(api, TestJobController(),
+                    EngineConfig(enable_gang_scheduling=False,
+                                 hostnetwork_port_range=(21000, 100)))
+    manager.register(eng)
+    return eng
+
+
+def hostnet_job(workers=2):
+    return new_test_job("hj", workers=workers, annotations={
+        c.ANNOTATION_NETWORK_MODE: c.NETWORK_MODE_HOST})
+
+
+def test_hostnetwork_pod_rendering(api, manager, hostnet_engine):
+    api.create(hostnet_job())
+    manager.run_until_idle()
+    pods = api.list("Pod")
+    assert len(pods) == 2
+    for p in pods:
+        assert p["spec"]["hostNetwork"] is True
+        assert p["spec"]["dnsPolicy"] == "ClusterFirstWithHostNet"
+        port = hn.get_pod_hostnetwork_port(p, "test-container", "test-port")
+        assert 21000 <= port < 21100
+        ctr = p["spec"]["containers"][0]
+        pd = next(x for x in ctr["ports"] if x["name"] == "test-port")
+        assert pd["hostPort"] == pd["containerPort"] == port
+
+
+def test_hostnetwork_service_is_not_headless(api, manager, hostnet_engine):
+    api.create(hostnet_job(workers=1))
+    manager.run_until_idle()
+    svc = api.get("Service", "default", "hj-worker-0")
+    pod = api.get("Pod", "default", "hj-worker-0")
+    live = hn.get_pod_hostnetwork_port(pod, "test-container", "test-port")
+    assert svc["spec"]["clusterIP"] == ""  # normal svc: remaps ports
+    assert svc["spec"]["ports"][0]["port"] == 2222  # stable dial port
+    assert svc["spec"]["ports"][0]["targetPort"] == live
+
+
+def test_hostnetwork_port_resync_after_failover(api, manager, hostnet_engine):
+    api.create(hostnet_job(workers=1))
+    manager.run_until_idle()
+    run_all_pods(api)
+    manager.run_until_idle()
+    # fail over: delete the pod; the engine recreates it on a new random port
+    api.delete("Pod", "default", "hj-worker-0")
+    manager.run_until_idle()
+    pod = api.get("Pod", "default", "hj-worker-0")
+    live = hn.get_pod_hostnetwork_port(pod, "test-container", "test-port")
+    svc = api.get("Service", "default", "hj-worker-0")
+    assert svc["spec"]["ports"][0]["targetPort"] == live
+    assert svc["spec"]["ports"][0]["port"] == 2222
+
+
+def test_hostnet_with_headless_svc_gate(api, manager):
+    eng = JobEngine(api, TestJobController(),
+                    EngineConfig(enable_gang_scheduling=False,
+                                 hostnet_with_headless_svc=True))
+    manager.register(eng)
+    api.create(hostnet_job(workers=1))
+    manager.run_until_idle()
+    svc = api.get("Service", "default", "hj-worker-0")
+    assert svc["spec"]["clusterIP"] == "None"  # gate keeps headless fabric
