@@ -38,6 +38,7 @@ ANNOTATION_ELASTIC_SCALE_STATE = KUBEDL_PREFIX + "/scale-state"
 ANNOTATION_TENSORBOARD_CONFIG = KUBEDL_PREFIX + "/tensorboard-config"
 
 # TPU-native additions (no reference analog: the reference assumes GPU pools)
+ANNOTATION_GCS_SYNC_CONFIG = KUBEDL_PREFIX + "/gcs-sync-config"
 ANNOTATION_TPU_TOPOLOGY = KUBEDL_PREFIX + "/tpu-topology"
 ANNOTATION_TPU_ACCELERATOR = KUBEDL_PREFIX + "/tpu-accelerator"
 ANNOTATION_TPU_NUM_SLICES = KUBEDL_PREFIX + "/tpu-num-slices"

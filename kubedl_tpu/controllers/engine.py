@@ -33,7 +33,9 @@ from ..core.apiserver import AlreadyExists, APIServer, Conflict, NotFound
 from ..core.events import Recorder, TYPE_NORMAL, TYPE_WARNING
 from ..core.manager import Reconciler, Request, Result
 from ..metrics import JobMetrics
+from ..platform.codesync import inject_code_sync_init_containers
 from ..platform.models import add_model_path_env, build_model_version_spec
+from ..platform.tensorboard import reconcile_tensorboard
 from ..scheduling.gang import GangScheduler
 from ..tpu import placement as pl
 from ..utils import status as st
@@ -142,12 +144,12 @@ class JobEngine(Reconciler):
         if job is None or m.is_deleting(job):
             return None
         self.controller.set_defaults(job)
+        raw_specs = m.get_in(job, "spec", self.controller.replica_specs_field_name,
+                             default={}) or {}
         # model-output volume + KUBEDL_MODEL_PATH env (reference job.go:471-498)
         mv_spec = m.get_in(job, "spec", "modelVersion")
         if mv_spec:
-            add_model_path_env(
-                m.get_in(job, "spec", self.controller.replica_specs_field_name,
-                         default={}) or {}, mv_spec)
+            add_model_path_env(raw_specs, mv_spec)
         replicas = self.controller.get_replica_specs(job)
         run_policy = self.controller.get_run_policy(job)
         job_key = m.key(job)
@@ -208,19 +210,24 @@ class JobEngine(Reconciler):
             return self._finish(job, replicas, run_policy, status, old_status,
                                 pods, exceeds, failure_msg)
 
+        # git/GCS code-sync init containers (reference job.go:110), after the
+        # terminal gate so a bad config fails the job but still lets the next
+        # pass reach _finish and clean up pods
+        try:
+            inject_code_sync_init_containers(job, raw_specs)
+        except ValueError as e:
+            return self._fail_permanently(
+                job, f"invalid code-sync config: {e}",
+                "InvalidCodeSyncConfig", status, old_status)
+        replicas = self.controller.get_replica_specs(job)
+
         try:
             plan = self._resolve_tpu(job, replicas)
         except ValueError as e:
             # invalid slice shape is a permanent config error: fail the job
             # loudly instead of retrying forever
-            msg = f"invalid tpuPolicy: {e}"
-            self.recorder.event(job, TYPE_WARNING, "InvalidTPUPolicy", msg)
-            st.update_job_conditions(status, c.JOB_FAILED, st.REASON_JOB_FAILED,
-                                     msg, now=self.api.now())
-            status.completion_time = m.rfc3339(self.api.now())
-            self.metrics.failed.inc(kind=self.kind)
-            self._flush_status(job, status, old_status)
-            return None
+            return self._fail_permanently(job, f"invalid tpuPolicy: {e}",
+                                          "InvalidTPUPolicy", status, old_status)
 
         # ---- gang: one PodGroup per slice ------------------------------
         if self.config.enable_gang_scheduling and self.gang is not None:
@@ -261,21 +268,17 @@ class JobEngine(Reconciler):
                 self._reconcile_pods(job, status, pods, rtype, spec, replicas,
                                      run_policy, plan, restart, hostnet_ports)
             except ValueError as e:
-                msg = f"invalid {self.kind} spec: {e}"
-                self.recorder.event(job, TYPE_WARNING, "InvalidJobSpec", msg)
-                st.update_job_conditions(status, c.JOB_FAILED,
-                                         st.REASON_JOB_FAILED, msg,
-                                         now=self.api.now())
-                status.completion_time = m.rfc3339(self.api.now())
-                self.metrics.failed.inc(kind=self.kind)
-                self._flush_status(job, status, old_status)
-                return None
+                return self._fail_permanently(
+                    job, f"invalid {self.kind} spec: {e}", "InvalidJobSpec",
+                    status, old_status)
             if self.controller.needs_service(rtype, job):
                 self._reconcile_services(job, services, rtype, spec,
                                          hostnet_ports)
 
         self._update_job_status(job, replicas, status, restart[0], pods)
         self.controller.on_job_running(job)
+        tb_requeue = reconcile_tensorboard(self.api, job, status,
+                                           self._tb_master_spec(replicas))
 
         # ---- launch-delay metrics (job.go:339-356) ---------------------
         created_at = _parse_ts(m.meta(job).get("creationTimestamp"))
@@ -298,8 +301,42 @@ class JobEngine(Reconciler):
                         self.api.now() - min(gang_ts), kind=self.kind)
 
         self._flush_status(job, status, old_status)
-        if deadline_requeue > 0:
-            return Result(requeue_after=deadline_requeue)
+        requeues = [r for r in (deadline_requeue, tb_requeue) if r and r > 0]
+        if requeues:
+            return Result(requeue_after=min(requeues))
+        return None
+
+    def _tb_master_spec(self, replicas) -> dict:
+        """The replica template a TensorBoard pod derives from: the master's
+        when present, else the first in reconcile order."""
+        masters = self.controller.master_replica_types(replicas)
+        order = masters + [rt for rt in self._orders(replicas)
+                           if rt not in masters]
+        for rt in order:
+            spec = replicas.get(rt)
+            if spec is not None and spec.template:
+                return {"template": spec.template}
+        return {"template": {}}
+
+    def _fail_permanently(self, job, msg: str, reason: str,
+                          status: Optional[JobStatus] = None,
+                          old_status: Optional[JobStatus] = None) -> None:
+        """Fail the job on a permanent config error (no retry would fix it).
+        Idempotent: a job already failed records nothing new. Pass the
+        round's live status/old_status to keep its mutations; otherwise they
+        are re-read from the object."""
+        if status is None:
+            status = JobStatus.from_dict(job.get("status"))
+            old_status = copy.deepcopy(status)
+        if st.is_failed(status):
+            return None
+        self.recorder.event(job, TYPE_WARNING, reason, msg)
+        st.update_job_conditions(status, c.JOB_FAILED, st.REASON_JOB_FAILED,
+                                 msg, now=self.api.now())
+        if status.completion_time is None:
+            status.completion_time = m.rfc3339(self.api.now())
+        self.metrics.failed.inc(kind=self.kind)
+        self._flush_status(job, status, old_status)
         return None
 
     # ------------------------------------------------------------------
@@ -329,8 +366,12 @@ class JobEngine(Reconciler):
             self.gang.delete_gang(job)
 
         self.controller.on_job_finished(job, pods)
+        # TensorBoard outlives the job for its own TTL (tensorboard.go:99-135)
+        tb_requeue = reconcile_tensorboard(self.api, job, status,
+                                           self._tb_master_spec(replicas))
         self._flush_status(job, status, old_status)
 
+        requeues = [tb_requeue] if tb_requeue else []
         # TTL-after-finished cleanup (reference job.go:596-620)
         ttl = run_policy.ttl_seconds_after_finished
         if ttl is None:
@@ -344,7 +385,9 @@ class JobEngine(Reconciler):
                 except NotFound:
                     pass
                 return None
-            return Result(requeue_after=remaining)
+            requeues.append(remaining)
+        if requeues:
+            return Result(requeue_after=min(requeues))
         return None
 
     def _delete_pods_and_services(self, job, run_policy: RunPolicy, pods) -> None:

@@ -8,6 +8,7 @@ controller setup map, metrics). The workload gate mirrors
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass, field
 from typing import Optional, Sequence
 
@@ -77,7 +78,10 @@ def build_operator(api: Optional[APIServer] = None,
     registry = Registry()
     metrics = JobMetrics(registry)
     recorder = Recorder(api)
-    gates = config.feature_gates or ft.default_gates
+    gates = config.feature_gates
+    if gates is None:
+        gates = ft.default_gates
+        gates.parse_env()  # KUBEDL_FEATURE_GATES honored in standalone mode
     gang = (new_gang_scheduler(config.gang_scheduler_name, api)
             if config.gang_scheduler_name
             and gates.enabled(ft.GANG_SCHEDULING) else None)
@@ -91,7 +95,9 @@ def build_operator(api: Optional[APIServer] = None,
 
     engines = {}
     enabled = set(config.workloads) if config.workloads is not None else None
-    if enabled is None and config.workloads_spec is not None:
+    if enabled is None and (config.workloads_spec is not None
+                            or os.environ.get(workloadgate.ENV_WORKLOADS_ENABLE)):
+        # env overrides flag inside the gate (workload_gate.go:48-56)
         enabled = set(workloadgate.enabled_kinds(
             [cc.kind for cc in ALL_CONTROLLERS], config.workloads_spec))
     for ctrl_cls in ALL_CONTROLLERS:

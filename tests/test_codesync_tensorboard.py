@@ -1,0 +1,202 @@
+"""Code-sync injection (reference ``pkg/code_sync``) and the TensorBoard
+sidecar-job subsystem (reference ``pkg/tensorboard``)."""
+
+import json
+
+import pytest
+
+from kubedl_tpu.api import common as c
+from kubedl_tpu.controllers.engine import EngineConfig, JobEngine
+from kubedl_tpu.controllers.testing import (
+    TestJobController, new_test_job, run_all_pods, set_pod_phase)
+from kubedl_tpu.core import meta as m
+from kubedl_tpu.platform import codesync
+from kubedl_tpu.utils import status as st
+
+
+@pytest.fixture
+def engine(api, manager):
+    eng = JobEngine(api, TestJobController(),
+                    EngineConfig(enable_gang_scheduling=False))
+    manager.register(eng)
+    return eng
+
+
+# ---------------------------------------------------------------------------
+# code sync
+# ---------------------------------------------------------------------------
+
+def git_job(cfg: dict, **kw):
+    return new_test_job("gj", annotations={
+        c.ANNOTATION_GIT_SYNC_CONFIG: json.dumps(cfg)}, **kw)
+
+
+def test_git_sync_injection(api, manager, engine):
+    api.create(git_job({"source": "https://github.com/org/trainer.git",
+                        "branch": "main"}, workers=2))
+    manager.run_until_idle()
+    pods = api.list("Pod")
+    assert len(pods) == 2
+    for pod in pods:
+        inits = pod["spec"]["initContainers"]
+        assert len(inits) == 1
+        init = inits[0]
+        assert init["name"] == "git-sync-code"
+        env = {e["name"]: e.get("value") for e in init["env"]}
+        assert env["GIT_SYNC_REPO"] == "https://github.com/org/trainer.git"
+        assert env["GIT_SYNC_ONE_TIME"] == "true"  # must exit or pod hangs
+        assert env["GIT_SYNC_DEST"] == "trainer"   # repo name, .git stripped
+        assert env["GIT_SYNC_BRANCH"] == "main"
+        assert init["volumeMounts"][0]["mountPath"] == "/code"
+        # shared volume + mount in the main container under workingDir/dest
+        assert any(v["name"] == "git-sync" for v in pod["spec"]["volumes"])
+        main = pod["spec"]["containers"][0]
+        mount = next(x for x in main["volumeMounts"] if x["name"] == "git-sync")
+        assert mount["mountPath"] == "/trainer"
+        assert mount["subPath"] == "trainer"
+
+
+def test_git_sync_respects_workingdir_and_dest(api, manager, engine):
+    job = git_job({"source": "git@github.com:org/deep", "destPath": "src",
+                   "rootPath": "/sync"})
+    tmpl = job["spec"]["testReplicaSpecs"]["Worker"]["template"]
+    tmpl["spec"]["containers"][0]["workingDir"] = "/app"
+    api.create(job)
+    manager.run_until_idle()
+    pod = api.list("Pod")[0]
+    init = pod["spec"]["initContainers"][0]
+    env = {e["name"]: e.get("value") for e in init["env"]}
+    assert env["GIT_SYNC_ROOT"] == "/sync"
+    assert env["GIT_SYNC_DEST"] == "src"
+    main = pod["spec"]["containers"][0]
+    mount = next(x for x in main["volumeMounts"] if x["name"] == "git-sync")
+    assert mount["mountPath"] == "/app/src"
+
+
+def test_gcs_sync_injection(api, manager, engine):
+    api.create(new_test_job("cj", workers=1, annotations={
+        c.ANNOTATION_GCS_SYNC_CONFIG: json.dumps(
+            {"source": "gs://bucket/train-code"})}))
+    manager.run_until_idle()
+    pod = api.list("Pod")[0]
+    init = pod["spec"]["initContainers"][0]
+    assert init["name"] == "gcs-sync-code"
+    assert "gsutil -m rsync -r gs://bucket/train-code" in init["command"][2]
+
+
+def test_bad_code_sync_config_fails_job(api, manager, engine):
+    api.create(new_test_job("bj", workers=1, annotations={
+        c.ANNOTATION_GIT_SYNC_CONFIG: json.dumps({"image": "x"})}))  # no source
+    manager.run_until_idle()
+    from kubedl_tpu.api.common import JobStatus
+    status = JobStatus.from_dict(api.get("TestJob", "default", "bj").get("status"))
+    assert st.is_failed(status)
+    assert api.list("Pod") == []
+    # idempotent: more reconciles don't re-fail / re-create
+    manager.run_until_idle()
+    assert st.is_failed(status)
+
+
+def test_bad_code_sync_on_running_job_still_cleans_up(api, manager, engine):
+    api.create(git_job({"source": "https://x/y/repo.git"}, workers=2))
+    manager.run_until_idle()
+    run_all_pods(api)
+    manager.run_until_idle()
+    # config goes bad mid-flight: job must fail AND its pods must be reaped
+    job = api.get("TestJob", "default", "gj")
+    m.annotations(job)[c.ANNOTATION_GIT_SYNC_CONFIG] = "{not-json"
+    api.update(job)
+    manager.run_until_idle()
+    from kubedl_tpu.api.common import JobStatus
+    status = JobStatus.from_dict(api.get("TestJob", "default", "gj")["status"])
+    assert st.is_failed(status)
+    assert all(p["status"].get("phase") != "Running" for p in api.list("Pod")) \
+        or api.list("Pod") == []
+    # terminal path ran: running pods were deleted (CleanPodPolicy Running)
+    assert api.list("Pod") == []
+
+
+def test_inject_idempotent():
+    job = git_job({"source": "https://x/y/repo.git"})
+    specs = job["spec"]["testReplicaSpecs"]
+    codesync.inject_code_sync_init_containers(job, specs)
+    codesync.inject_code_sync_init_containers(job, specs)
+    spec = specs["Worker"]["template"]["spec"]
+    assert len(spec["initContainers"]) == 1
+    assert len([v for v in spec["volumes"] if v["name"] == "git-sync"]) == 1
+    assert len([x for x in spec["containers"][0]["volumeMounts"]
+                if x["name"] == "git-sync"]) == 1
+
+
+# ---------------------------------------------------------------------------
+# tensorboard
+# ---------------------------------------------------------------------------
+
+def tb_job(opts: dict, **kw):
+    return new_test_job("tb", annotations={
+        c.ANNOTATION_TENSORBOARD_CONFIG: json.dumps(opts)}, **kw)
+
+
+def test_tensorboard_pod_service(api, manager, engine):
+    api.create(tb_job({"logDir": "/logs/tb",
+                       "ingressSpec": {"host": "tb.example.com"}}, workers=1))
+    manager.run_until_idle()
+    pod = api.get("Pod", "default", "tb-tensorboard-0")
+    cmd = pod["spec"]["containers"][0]["command"][2]
+    assert "--logdir /logs/tb" in cmd
+    assert "--path_prefix /default/tb" in cmd
+    assert pod["spec"]["restartPolicy"] == "Always"
+    assert m.get_controller_ref(pod)["kind"] == "TestJob"
+    # viewer must not inherit trainer TPU/accelerator resources
+    assert "resources" not in pod["spec"]["containers"][0]
+    svc = api.get("Service", "default", "tb-tensorboard-0")
+    assert svc["spec"]["ports"][0]["port"] == 6006
+    ing = api.get("Ingress", "default", "tb-tensorboard-0")
+    assert ing["spec"]["rules"][0]["host"] == "tb.example.com"
+    # TB replica is not part of the job's worker accounting
+    from kubedl_tpu.api.common import JobStatus
+    status = JobStatus.from_dict(api.get("TestJob", "default", "tb")["status"])
+    assert "tensorboard" not in {k.lower() for k in status.replica_statuses}
+
+
+def test_tensorboard_config_change_recreates_pod(api, manager, engine):
+    api.create(tb_job({"logDir": "/a"}, workers=1))
+    manager.run_until_idle()
+    job = api.get("TestJob", "default", "tb")
+    m.annotations(job)[c.ANNOTATION_TENSORBOARD_CONFIG] = json.dumps(
+        {"logDir": "/b"})
+    api.update(job)
+    manager.run_until_idle()
+    pod = api.get("Pod", "default", "tb-tensorboard-0")
+    assert "--logdir /b" in pod["spec"]["containers"][0]["command"][2]
+
+
+def test_tensorboard_ttl_after_finish(api, manager, engine, clock):
+    api.create(tb_job({"logDir": "/logs", "ttlSecondsAfterJobFinished": 60},
+                      workers=1))
+    manager.run_until_idle()
+    run_all_pods(api)
+    manager.run_until_idle()
+    for pod in api.list("Pod"):
+        if "tensorboard" not in m.name(pod):
+            set_pod_phase(api, pod, "Succeeded", exit_code=0)
+    manager.run_until_idle()
+    # job finished; TB trio still alive inside the TTL window
+    assert api.try_get("Pod", "default", "tb-tensorboard-0") is not None
+    clock.advance(120)
+    manager.run_until_idle(include_delayed=True)
+    assert api.try_get("Pod", "default", "tb-tensorboard-0") is None
+    assert api.try_get("Service", "default", "tb-tensorboard-0") is None
+    job = api.get("TestJob", "default", "tb")
+    assert c.ANNOTATION_TENSORBOARD_CONFIG not in m.annotations(job)
+
+
+def test_tensorboard_removed_when_annotation_dropped(api, manager, engine):
+    api.create(tb_job({"logDir": "/logs"}, workers=1))
+    manager.run_until_idle()
+    assert api.try_get("Pod", "default", "tb-tensorboard-0") is not None
+    job = api.get("TestJob", "default", "tb")
+    m.annotations(job).pop(c.ANNOTATION_TENSORBOARD_CONFIG)
+    api.update(job)
+    manager.run_until_idle()
+    assert api.try_get("Pod", "default", "tb-tensorboard-0") is None
