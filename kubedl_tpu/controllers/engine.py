@@ -33,6 +33,7 @@ from ..core.apiserver import AlreadyExists, APIServer, Conflict, NotFound
 from ..core.events import Recorder, TYPE_NORMAL, TYPE_WARNING
 from ..core.manager import Reconciler, Request, Result
 from ..metrics import JobMetrics
+from ..platform.cache import reconcile_job_cache
 from ..platform.codesync import inject_code_sync_init_containers
 from ..platform.models import add_model_path_env, build_model_version_spec
 from ..platform.tensorboard import reconcile_tensorboard
@@ -95,6 +96,7 @@ class JobEngine(Reconciler):
         self.owns = ("Pod", "Service")
         self._retries: dict[str, int] = {}  # job uid -> observed failure rounds
         self._job_states: dict[str, str] = {}  # job uid -> running|pending
+        self._tb_jobs: set = set()  # uids that have carried a TB annotation
         api.watch(self._observe)
 
     # ------------------------------------------------------------------
@@ -111,6 +113,7 @@ class JobEngine(Reconciler):
                 self.metrics.deleted.inc(kind=self.kind)
                 self._retries.pop(uid, None)
                 self._job_states.pop(uid, None)
+                self._tb_jobs.discard(uid)
                 self.expectations.delete_prefix(m.key(obj))
             else:
                 s = JobStatus.from_dict(obj.get("status"))
@@ -219,6 +222,16 @@ class JobEngine(Reconciler):
             return self._fail_permanently(
                 job, f"invalid code-sync config: {e}",
                 "InvalidCodeSyncConfig", status, old_status)
+
+        # dataset cache: create CacheBackend, wait for its PVC, mount it
+        # (reference job.go:117-132 → job_controller.go:202-315)
+        cache_spec = m.get_in(job, "spec", "cacheBackend")
+        if cache_spec:
+            cache_requeue = reconcile_job_cache(self.api, job, cache_spec,
+                                                raw_specs, status)
+            if cache_requeue:
+                self._flush_status(job, status, old_status)
+                return Result(requeue_after=cache_requeue)
         replicas = self.controller.get_replica_specs(job)
 
         try:
@@ -277,8 +290,7 @@ class JobEngine(Reconciler):
 
         self._update_job_status(job, replicas, status, restart[0], pods)
         self.controller.on_job_running(job)
-        tb_requeue = reconcile_tensorboard(self.api, job, status,
-                                           self._tb_master_spec(replicas))
+        tb_requeue = self._reconcile_tb(job, status, replicas)
 
         # ---- launch-delay metrics (job.go:339-356) ---------------------
         created_at = _parse_ts(m.meta(job).get("creationTimestamp"))
@@ -305,6 +317,21 @@ class JobEngine(Reconciler):
         if requeues:
             return Result(requeue_after=min(requeues))
         return None
+
+    def _reconcile_tb(self, job, status: JobStatus, replicas) -> Optional[float]:
+        """TensorBoard sync with a cheap common-case skip: jobs that never
+        carried the annotation don't pay the reap lookups."""
+        uid = m.uid(job)
+        has_cfg = c.ANNOTATION_TENSORBOARD_CONFIG in m.annotations(job)
+        had = has_cfg or uid in self._tb_jobs
+        if has_cfg:
+            self._tb_jobs.add(uid)
+        r = reconcile_tensorboard(self.api, job, status,
+                                  self._tb_master_spec(replicas),
+                                  recorder=self.recorder, had_config=had)
+        if not has_cfg:
+            self._tb_jobs.discard(uid)
+        return r
 
     def _tb_master_spec(self, replicas) -> dict:
         """The replica template a TensorBoard pod derives from: the master's
@@ -367,8 +394,7 @@ class JobEngine(Reconciler):
 
         self.controller.on_job_finished(job, pods)
         # TensorBoard outlives the job for its own TTL (tensorboard.go:99-135)
-        tb_requeue = reconcile_tensorboard(self.api, job, status,
-                                           self._tb_master_spec(replicas))
+        tb_requeue = self._reconcile_tb(job, status, replicas)
         self._flush_status(job, status, old_status)
 
         requeues = [tb_requeue] if tb_requeue else []

@@ -20,7 +20,9 @@ from ..core.manager import Manager
 from ..utils import workloadgate
 from ..metrics import JobMetrics, Registry
 from ..core.deployment import DeploymentReconciler
+from ..platform.cache import CacheBackendReconciler
 from ..platform.cron import CronReconciler
+from ..platform.notebook import NotebookReconciler
 from ..platform.models import (DEFAULT_IMAGE_BUILDER, ModelReconciler,
                                ModelVersionReconciler)
 from ..platform.serving import InferenceReconciler
@@ -118,6 +120,8 @@ def build_operator(api: Optional[APIServer] = None,
     manager.register(InferenceReconciler(api, recorder=recorder))
     manager.register(CronReconciler(
         api, recorder=recorder, workload_kinds=list(engines)))
+    manager.register(CacheBackendReconciler(api, recorder=recorder))
+    manager.register(NotebookReconciler(api, recorder=recorder))
     # substrate shim: materializes Deployments into pods on the in-memory
     # control plane (no kube-controller-manager underneath in standalone)
     manager.register(DeploymentReconciler(api))

@@ -33,10 +33,23 @@ class CodeSyncError(ValueError):
     pass
 
 
-def _dest_from_source(source: str) -> str:
-    parts = [p for p in source.strip("/").split("/") if p]
-    dest = parts[-1] if parts else "code"
+def dest_from_source(source: str, fallback: str = "code") -> str:
+    """Last path segment of a git/GCS/OSS source URL, ``.git`` stripped —
+    the default checkout/sync directory name (shared with the dataset-cache
+    warm-up, which syncs with the same one-shot rsync contract)."""
+    cleaned = source.split("://", 1)[-1]
+    parts = [p for p in cleaned.strip("/").split("/") if p]
+    dest = parts[-1] if parts else fallback
     return dest[:-4] if dest.endswith(".git") else dest
+
+
+_dest_from_source = dest_from_source
+
+
+def gcs_rsync_command(source: str, dest_dir: str) -> str:
+    """The one-shot GCS sync shell line used by both code-sync init
+    containers and dataset-cache warm-up pods."""
+    return f"mkdir -p {dest_dir} && gsutil -m rsync -r {source} {dest_dir}"
 
 
 def _git_init_container(opts: dict, volume_name: str) -> tuple[dict, str]:
@@ -94,9 +107,7 @@ def _gcs_init_container(opts: dict, volume_name: str) -> tuple[dict, str]:
         "name": "gcs-sync-code",
         "image": opts.get("image") or DEFAULT_GCS_SYNC_IMAGE,
         "imagePullPolicy": "IfNotPresent",
-        "command": ["/bin/sh", "-c",
-                    f"mkdir -p {root}/{dest} && "
-                    f"gsutil -m rsync -r {source} {root}/{dest}"],
+        "command": ["/bin/sh", "-c", gcs_rsync_command(source, f"{root}/{dest}")],
         "env": list(opts.get("envs") or []),
         "volumeMounts": [{"name": volume_name, "mountPath": root}],
     }

@@ -42,14 +42,18 @@ def get_config(job: dict) -> Optional[dict]:
 
 
 def reconcile_tensorboard(api, job: dict, job_status, master_spec: dict,
-                          recorder=None, dns_domain: str = "") -> Optional[float]:
+                          recorder=None, dns_domain: str = "",
+                          had_config: bool = True) -> Optional[float]:
     """Sync (or TTL-reap) the job's TensorBoard trio. Returns a
     requeue-after in seconds while waiting out the TTL, else None.
     ``master_spec`` is the replica template the TB pod is derived from
-    (node tolerations/volumes follow the master, reference syncPod)."""
+    (node tolerations/volumes follow the master, reference syncPod).
+    ``had_config=False`` skips the reap lookups for jobs that never carried
+    the annotation (the common case — saves 3 GETs/job/pass)."""
     cfg_raw = m.annotations(job).get(c.ANNOTATION_TENSORBOARD_CONFIG)
     if cfg_raw is None:
-        _delete_all(api, job)
+        if had_config:
+            _delete_all(api, job)
         return None
     opts = get_config(job)
     if opts is None:
@@ -75,11 +79,21 @@ def reconcile_tensorboard(api, job: dict, job_status, master_spec: dict,
                     pass
             _delete_all(api, job)
             return None
-        _sync(api, job, opts, cfg_raw, master_spec)
+        _try_sync(api, job, opts, cfg_raw, master_spec, recorder)
         return delete_at - now
 
-    _sync(api, job, opts, cfg_raw, master_spec)
+    _try_sync(api, job, opts, cfg_raw, master_spec, recorder)
     return None
+
+
+def _try_sync(api, job, opts, cfg_raw, master_spec, recorder) -> None:
+    """An ownership conflict on the TB pod must not wedge the whole job
+    reconcile — record it and move on."""
+    try:
+        _sync(api, job, opts, cfg_raw, master_spec)
+    except ValueError as e:
+        if recorder is not None:
+            recorder.event(job, "Warning", "TensorBoardConflict", str(e))
 
 
 def _name(job: dict) -> str:
@@ -128,6 +142,10 @@ def _sync_pod(api, job: dict, opts: dict, cfg_raw: str, master_spec: dict) -> No
     template = copy.deepcopy(m.get_in(master_spec, "template") or {})
     pod_spec = template.get("spec") or {"containers": [{"name": "tensorboard"}]}
     pod_spec["restartPolicy"] = "Always"
+    # the viewer must not inherit trainer-side machinery: injected init
+    # containers (code-sync) carry the trainer's TPU resource requests and
+    # would pin a TPU host (or pend forever) for a UI pod
+    pod_spec.pop("initContainers", None)
     path_prefix = _path_prefix(job, opts)
     containers = pod_spec.get("containers") or [{"name": "tensorboard"}]
     tb = containers[0]

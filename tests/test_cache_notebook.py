@@ -1,0 +1,190 @@
+"""Dataset cache (reference ``pkg/cache_backend`` + ``controllers/cache`` +
+job-engine mounts) and the Notebook controller (``controllers/notebook``)."""
+
+import pytest
+
+from kubedl_tpu.api import common as c
+from kubedl_tpu.api.common import JobStatus
+from kubedl_tpu.controllers.engine import EngineConfig, JobEngine
+from kubedl_tpu.controllers.testing import TestJobController, new_test_job
+from kubedl_tpu.core import meta as m
+from kubedl_tpu.platform import cache as pc
+from kubedl_tpu.platform.notebook import NotebookReconciler
+
+
+@pytest.fixture
+def stack(api, manager):
+    eng = JobEngine(api, TestJobController(),
+                    EngineConfig(enable_gang_scheduling=False))
+    manager.register(eng)
+    manager.register(pc.CacheBackendReconciler(api))
+    manager.register(NotebookReconciler(api))
+    return eng
+
+
+CACHE_SPEC = {
+    "mountPath": "/dataset",
+    "dataset": {"dataSources": [
+        {"location": "gs://bkt/imagenet", "subDirName": "imagenet"}]},
+    "cacheEngine": {"hostDisk": {"path": "/mnt/ssd", "capacity": "10Gi"}},
+}
+
+
+def cache_job(**kw):
+    job = new_test_job("cj", workers=2, **kw)
+    job["spec"]["cacheBackend"] = CACHE_SPEC
+    return job
+
+
+def test_cache_backend_lifecycle(api, manager, stack):
+    api.create(cache_job())
+    manager.run_until_idle(include_delayed=True, max_iterations=60)
+    # the job engine created the CacheBackend, owned by the job
+    cb = api.get("CacheBackend", "default", "cj-cache")
+    assert m.get_controller_ref(cb)["kind"] == "TestJob"
+    assert cb["status"]["jobName"] == "cj"
+    status = JobStatus.from_dict(api.get("TestJob", "default", "cj")["status"])
+    assert status.cache_backend_name == "cj-cache"
+    # hostDisk engine rendered PV + PVC + warm-up pod
+    pv = api.get("PersistentVolume", "default", "cj-cache")
+    assert pv["spec"]["hostPath"]["path"] == "/mnt/ssd/default/cj-cache"
+    assert api.get("PersistentVolumeClaim", "default", "cj-cache")
+    warm = api.get("Pod", "default", "cj-cache-warmup")
+    assert "gsutil -m rsync -r gs://bkt/imagenet" in \
+        warm["spec"]["containers"][0]["command"][2]
+    # PVC exists but the warm-up rsync is still running: NOT ready, and no
+    # training pod may start on a half-populated cache
+    cb = api.get("CacheBackend", "default", "cj-cache")
+    assert cb["status"]["cacheStatus"] == pc.PVC_CREATING
+    assert [p for p in api.list("Pod")
+            if m.labels(p).get(c.LABEL_REPLICA_TYPE) == "worker"] == []
+    # warm-up finishes -> PVCCreated -> job proceeds
+    warm.setdefault("status", {})["phase"] = "Succeeded"
+    api.update_status(warm)
+    manager.run_until_idle(include_delayed=True, max_iterations=80)
+    cb = api.get("CacheBackend", "default", "cj-cache")
+    assert cb["status"]["cacheStatus"] == pc.PVC_CREATED
+    # worker pods got the volume, mount, and env
+    workers = [p for p in api.list("Pod")
+               if m.labels(p).get(c.LABEL_REPLICA_TYPE) == "worker"]
+    assert len(workers) == 2
+    for p in workers:
+        vols = {v["name"]: v for v in p["spec"]["volumes"]}
+        assert vols[pc.CACHE_VOLUME_NAME]["persistentVolumeClaim"][
+            "claimName"] == "cj-cache"
+        ctr = p["spec"]["containers"][0]
+        mount = next(x for x in ctr["volumeMounts"]
+                     if x["name"] == pc.CACHE_VOLUME_NAME)
+        assert mount["mountPath"] == "/dataset"
+        env = {e["name"]: e.get("value") for e in ctr["env"]}
+        assert env[pc.ENV_CACHE_NAME] == "cj-cache"
+
+
+def test_job_waits_for_cache_pvc(api, manager, stack):
+    """Until the PVC exists no training pod may start (the mount would be
+    missing); once the cache controller binds it the job proceeds."""
+    job = cache_job()
+    # use an engine spec no plugin serves so the PVC never appears
+    job["spec"]["cacheBackend"] = {**CACHE_SPEC, "cacheEngine": {"custom": {}}}
+    api.create(job)
+    manager.run_until_idle()
+    workers = [p for p in api.list("Pod")
+               if m.labels(p).get(c.LABEL_REPLICA_TYPE) == "worker"]
+    assert workers == []
+    cb = api.get("CacheBackend", "default", "cj-cache")
+    assert cb["status"]["cacheStatus"] == pc.CACHE_FAILED
+
+
+def test_fluid_engine_renders_dataset_and_runtime(api, manager):
+    manager.register(pc.CacheBackendReconciler(api))
+    cb = m.new_obj(pc.API_VERSION, pc.KIND, "fc", spec={
+        "mountPath": "/data",
+        "dataset": {"dataSources": [{"location": "oss://b/d", "subDirName": "d"}]},
+        "cacheEngine": {"fluid": {"alluxioRuntime": {
+            "replicas": 2,
+            "tieredStorage": [{"mediumType": "MEM", "cachePath": "/dev/shm",
+                               "quota": "2Gi"}]}}},
+    })
+    api.create(cb)
+    manager.run_until_idle()
+    ds = api.get("Dataset", "default", "fc")
+    assert ds["spec"]["mounts"][0]["mountPoint"] == "oss://b/d"
+    rt = api.get("AlluxioRuntime", "default", "fc")
+    assert rt["spec"]["replicas"] == 2
+    assert rt["spec"]["tieredstore"]["levels"][0]["quota"] == "2Gi"
+    # fluid owns PVC creation; simulate it binding and check status lands
+    pvc = m.new_obj("v1", "PersistentVolumeClaim", "fc")
+    api.create(pvc)
+    manager.run_until_idle(include_delayed=True, max_iterations=40)
+    assert api.get(pc.KIND, "default", "fc")["status"]["cacheStatus"] == \
+        pc.PVC_CREATED
+
+
+# ---------------------------------------------------------------------------
+# notebook
+# ---------------------------------------------------------------------------
+
+def notebook(name="nb1", token=None):
+    tmpl = {"spec": {"containers": [{
+        "name": "notebook", "image": "jupyter/tensorflow-notebook:latest",
+        "env": ([{"name": "JUPYTER_TOKEN", "value": token}] if token else []),
+    }]}}
+    obj = m.new_obj("notebook.kubedl.io/v1alpha1", "Notebook", name)
+    obj["spec"] = {"template": tmpl}
+    return obj
+
+
+def test_notebook_trio_and_status(api, manager, stack):
+    api.create(notebook(token="s3cret"))
+    manager.run_until_idle()
+    pod = api.get("Pod", "default", "nb-nb1")
+    ctr = pod["spec"]["containers"][0]
+    assert any(p["name"] == "notebook" and p["containerPort"] == 8888
+               for p in ctr["ports"])
+    env = {e["name"]: e.get("value") for e in ctr["env"]}
+    assert env["NOTEBOOK_ARGS"] == "--NotebookApp.base_url=/notebooks/default/nb1"
+    svc = api.get("Service", "default", "nb-nb1")
+    assert svc["spec"]["ports"][0]["port"] == 8888
+    ing = api.get("Ingress", "default", "nb-nb1")
+    path = ing["spec"]["rules"][0]["http"]["paths"][0]["path"]
+    assert path == "/notebooks/default/nb1"
+    nb = api.get("Notebook", "default", "nb1")
+    assert nb["status"]["condition"] == "Created"
+    # pod runs -> Running + url with token passthrough
+    pod.setdefault("status", {})["phase"] = "Running"
+    api.update_status(pod)
+    manager.run_until_idle(include_delayed=True, max_iterations=40)
+    nb = api.get("Notebook", "default", "nb1")
+    assert nb["status"]["condition"] == "Running"
+    assert nb["status"]["url"].endswith("/notebooks/default/nb1?token=s3cret")
+    # pod dies -> Terminated
+    pod = api.get("Pod", "default", "nb-nb1")
+    pod["status"]["phase"] = "Failed"
+    api.update_status(pod)
+    manager.run_until_idle(include_delayed=True, max_iterations=40)
+    assert api.get("Notebook", "default", "nb1")["status"]["condition"] == \
+        "Terminated"
+
+
+def test_notebook_tpu_template_gets_pjrt_env(api, manager, stack):
+    obj = notebook("tnb")
+    ctr = obj["spec"]["template"]["spec"]["containers"][0]
+    ctr["resources"] = {"limits": {"google.com/tpu": 4}}
+    api.create(obj)
+    manager.run_until_idle()
+    pod = api.get("Pod", "default", "nb-tnb")
+    env = {e["name"]: e.get("value")
+           for e in pod["spec"]["containers"][0]["env"]}
+    assert env["TPU_WORKER_ID"] == "0"
+    assert env["TPU_WORKER_HOSTNAMES"] == "localhost"
+
+
+def test_notebook_gc_on_delete(api, manager, stack):
+    api.create(notebook())
+    manager.run_until_idle()
+    assert api.try_get("Pod", "default", "nb-nb1") is not None
+    api.delete("Notebook", "default", "nb1")
+    manager.run_until_idle()
+    assert api.try_get("Pod", "default", "nb-nb1") is None
+    assert api.try_get("Service", "default", "nb-nb1") is None
+    assert api.try_get("Ingress", "default", "nb-nb1") is None

@@ -159,6 +159,41 @@ def test_tensorboard_pod_service(api, manager, engine):
     assert "tensorboard" not in {k.lower() for k in status.replica_statuses}
 
 
+def test_tensorboard_pod_strips_trainer_machinery(api, manager, engine):
+    """A TB viewer derived from a code-sync + TPU master template must not
+    inherit init containers (they carry trainer resource requests)."""
+    job = tb_job({"logDir": "/l"}, workers=1)
+    m.annotations(job)[c.ANNOTATION_GIT_SYNC_CONFIG] = json.dumps(
+        {"source": "https://x/y/repo.git"})
+    job["spec"]["testReplicaSpecs"]["Worker"]["template"]["spec"][
+        "containers"][0]["resources"] = {"limits": {"google.com/tpu": 4}}
+    api.create(job)
+    manager.run_until_idle()
+    worker = api.get("Pod", "default", "tb-worker-0")
+    assert worker["spec"]["initContainers"]  # trainer does get git-sync
+    tb = api.get("Pod", "default", "tb-tensorboard-0")
+    assert "initContainers" not in tb["spec"]
+    assert "resources" not in tb["spec"]["containers"][0]
+
+
+def test_tensorboard_conflict_does_not_wedge_job(api, manager, engine):
+    """A pre-existing unowned pod squatting the TB name is recorded as a
+    conflict event, but the job itself keeps reconciling."""
+    squatter = m.new_obj("v1", "Pod", "tb-tensorboard-0")
+    squatter["spec"] = {"containers": [{"name": "x", "image": "y"}]}
+    api.create(squatter)
+    api.create(tb_job({"logDir": "/l"}, workers=1))
+    manager.run_until_idle()
+    # workers still created and status still flushed despite the conflict
+    assert api.try_get("Pod", "default", "tb-worker-0") is not None
+    from kubedl_tpu.api.common import JobStatus
+    status = JobStatus.from_dict(api.get("TestJob", "default", "tb")["status"])
+    assert status.conditions
+    events = [e for e in api.list("Event")
+              if e.get("reason") == "TensorBoardConflict"]
+    assert events
+
+
 def test_tensorboard_config_change_recreates_pod(api, manager, engine):
     api.create(tb_job({"logDir": "/a"}, workers=1))
     manager.run_until_idle()
