@@ -33,7 +33,7 @@ from ..core.apiserver import AlreadyExists, APIServer, Conflict, NotFound
 from ..core.events import Recorder, TYPE_NORMAL, TYPE_WARNING
 from ..core.manager import Reconciler, Request, Result
 from ..metrics import JobMetrics
-from ..platform.cache import reconcile_job_cache
+from ..platform.cache import CacheError, reconcile_job_cache
 from ..platform.codesync import inject_code_sync_init_containers
 from ..platform.models import add_model_path_env, build_model_version_spec
 from ..platform.tensorboard import reconcile_tensorboard
@@ -97,6 +97,7 @@ class JobEngine(Reconciler):
         self._retries: dict[str, int] = {}  # job uid -> observed failure rounds
         self._job_states: dict[str, str] = {}  # job uid -> running|pending
         self._tb_jobs: set = set()  # uids that have carried a TB annotation
+        self._tb_reap_checked: set = set()  # uids whose TB reap ran at least once
         api.watch(self._observe)
 
     # ------------------------------------------------------------------
@@ -114,6 +115,7 @@ class JobEngine(Reconciler):
                 self._retries.pop(uid, None)
                 self._job_states.pop(uid, None)
                 self._tb_jobs.discard(uid)
+                self._tb_reap_checked.discard(uid)
                 self.expectations.delete_prefix(m.key(obj))
             else:
                 s = JobStatus.from_dict(obj.get("status"))
@@ -227,8 +229,12 @@ class JobEngine(Reconciler):
         # (reference job.go:117-132 → job_controller.go:202-315)
         cache_spec = m.get_in(job, "spec", "cacheBackend")
         if cache_spec:
-            cache_requeue = reconcile_job_cache(self.api, job, cache_spec,
-                                                raw_specs, status)
+            try:
+                cache_requeue = reconcile_job_cache(self.api, job, cache_spec,
+                                                    raw_specs, status)
+            except CacheError as e:
+                return self._fail_permanently(job, str(e), "CacheFailed",
+                                              status, old_status)
             if cache_requeue:
                 self._flush_status(job, status, old_status)
                 return Result(requeue_after=cache_requeue)
@@ -320,10 +326,13 @@ class JobEngine(Reconciler):
 
     def _reconcile_tb(self, job, status: JobStatus, replicas) -> Optional[float]:
         """TensorBoard sync with a cheap common-case skip: jobs that never
-        carried the annotation don't pay the reap lookups."""
+        carried the annotation don't pay the reap lookups — but each uid
+        pays them at least once, so TB resources created before an operator
+        restart (when ``_tb_jobs`` starts empty) still get reaped after the
+        annotation is removed."""
         uid = m.uid(job)
         has_cfg = c.ANNOTATION_TENSORBOARD_CONFIG in m.annotations(job)
-        had = has_cfg or uid in self._tb_jobs
+        had = has_cfg or uid in self._tb_jobs or uid not in self._tb_reap_checked
         if has_cfg:
             self._tb_jobs.add(uid)
         r = reconcile_tensorboard(self.api, job, status,
@@ -331,6 +340,7 @@ class JobEngine(Reconciler):
                                   recorder=self.recorder, had_config=had)
         if not has_cfg:
             self._tb_jobs.discard(uid)
+            self._tb_reap_checked.add(uid)
         return r
 
     def _tb_master_spec(self, replicas) -> dict:

@@ -27,6 +27,9 @@ from ..platform.models import (DEFAULT_IMAGE_BUILDER, ModelReconciler,
                                ModelVersionReconciler)
 from ..platform.serving import InferenceReconciler
 from ..scheduling.gang import new_gang_scheduler
+from ..storage.backends import (MemoryBackend, SQLiteBackend,
+                                get_event_backend, get_object_backend)
+from ..storage.persist import DEFAULT_JOB_KINDS, setup_persist_controllers
 from .engine import EngineConfig, JobEngine
 from .workloads import ALL_CONTROLLERS
 
@@ -50,6 +53,14 @@ class OperatorConfig:
     feature_gates: Optional[ft.FeatureGates] = None
     #: --hostnetwork-port-range (base, size)
     hostnetwork_port_range: tuple = hn.DEFAULT_PORT_RANGE
+    #: --object-storage / --event-storage backend names ("" = persistence
+    #: disabled, as in the reference where persist controllers are optional,
+    #: main.go:112-118). "memory" and "sqlite" ship built-in; a path-like
+    #: value ("sqlite:///var/kubedl/kubedl.db") selects sqlite at that file.
+    object_storage: str = ""
+    event_storage: str = ""
+    #: physical region stamped into persisted records (DeployRegion)
+    deploy_region: str = ""
 
 
 @dataclass
@@ -59,6 +70,8 @@ class Operator:
     engines: dict = field(default_factory=dict)
     metrics_registry: Registry = None
     config: "OperatorConfig" = None
+    object_backend: object = None
+    event_backend: object = None
 
     def run_until_idle(self, **kw):
         return self.manager.run_until_idle(**kw)
@@ -125,5 +138,39 @@ def build_operator(api: Optional[APIServer] = None,
     # substrate shim: materializes Deployments into pods on the in-memory
     # control plane (no kube-controller-manager underneath in standalone)
     manager.register(DeploymentReconciler(api))
+
+    # optional persistence mirror (reference main.go:112-118: storage
+    # backends + persist controllers)
+    object_backend = _storage_backend(config.object_storage)
+    event_backend = (_storage_backend(config.event_storage, for_events=True)
+                     if config.event_storage != config.object_storage
+                     else object_backend)
+    if object_backend is not None or event_backend is not None:
+        setup_persist_controllers(
+            api, manager, object_backend=object_backend,
+            event_backend=event_backend,
+            job_kinds=tuple(engines) or DEFAULT_JOB_KINDS,
+            region=config.deploy_region)
     return Operator(api=api, manager=manager, engines=engines,
-                    metrics_registry=registry, config=config)
+                    metrics_registry=registry, config=config,
+                    object_backend=object_backend,
+                    event_backend=event_backend)
+
+
+def _storage_backend(spec: str, for_events: bool = False):
+    """Resolve a --object-storage/--event-storage flag value to a backend:
+    a registered name (in the registry matching the flag's role), "memory",
+    "sqlite" (in-memory db), or "sqlite://<path>" for a durable file."""
+    if not spec:
+        return None
+    registered = (get_event_backend(spec) if for_events
+                  else get_object_backend(spec))
+    if registered is not None:
+        return registered
+    if spec == "memory":
+        return MemoryBackend()
+    if spec == "sqlite":
+        return SQLiteBackend(":memory:")
+    if spec.startswith("sqlite://"):
+        return SQLiteBackend(spec[len("sqlite://"):])
+    raise ValueError(f"unknown storage backend {spec!r}")

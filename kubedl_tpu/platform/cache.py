@@ -27,12 +27,17 @@ Engine plugins (the ``CacheEngine`` seam, reference ``interface.go:9-13``):
 
 from __future__ import annotations
 
+import shlex
 from typing import Optional
 
 from ..core import meta as m
 from ..core.apiserver import AlreadyExists, Conflict, NotFound
 from ..core.manager import Reconciler, Request, Result
 from .codesync import dest_from_source, gcs_rsync_command
+
+
+class CacheError(Exception):
+    """Permanent cache-config failure; the job engine fails the job on it."""
 
 # status progression (reference cachebackend_types.go / cache_backend consts)
 CACHE_CREATING = "CacheCreating"
@@ -65,6 +70,13 @@ class CacheEngine:
 
     def create_cache_job(self, cache_backend: dict) -> None:
         raise NotImplementedError
+
+    def _create_owned(self, obj: dict, owner: dict) -> None:
+        m.set_controller_ref(obj, owner)
+        try:
+            self.api.create(obj)
+        except AlreadyExists:
+            pass
 
 
 class HostDiskEngine(CacheEngine):
@@ -107,11 +119,15 @@ class HostDiskEngine(CacheEngine):
                     src.get("location", ""), fallback="data")
                 dst = f"/cache/{sub}"
                 loc = src.get("location", "")
+                # locations/dir names are user-controlled spec fields that
+                # land in a /bin/sh -c string on a hostPath-mounted pod:
+                # quote them
                 if loc.startswith("gs://"):
                     cmds.append(gcs_rsync_command(loc, dst))
                 else:
                     # non-GCS source: web/nfs fetch left to a custom image
-                    cmds.append(f"mkdir -p {dst} && echo skip {loc}")
+                    cmds.append(f"mkdir -p {shlex.quote(dst)} "
+                                f"&& echo skip {shlex.quote(loc)}")
             pod = m.new_obj("v1", "Pod", f"{name}-warmup", ns)
             pod["spec"] = {
                 "restartPolicy": "OnFailure",
@@ -126,13 +142,6 @@ class HostDiskEngine(CacheEngine):
                                           "type": "DirectoryOrCreate"}}],
             }
             self._create_owned(pod, cache_backend)
-
-    def _create_owned(self, obj: dict, owner: dict) -> None:
-        m.set_controller_ref(obj, owner)
-        try:
-            self.api.create(obj)
-        except AlreadyExists:
-            pass
 
 
 class FluidEngine(CacheEngine):
@@ -151,11 +160,7 @@ class FluidEngine(CacheEngine):
                                "name": src.get("subDirName", "")})
             ds = m.new_obj("data.fluid.io/v1alpha1", "Dataset", name, ns)
             ds["spec"] = {"mounts": mounts}
-            m.set_controller_ref(ds, cache_backend)
-            try:
-                self.api.create(ds)
-            except AlreadyExists:
-                pass
+            self._create_owned(ds, cache_backend)
         fluid_opts = m.get_in(cache_backend, "spec", "cacheEngine", "fluid",
                               default={}) or {}
         runtime_opts = fluid_opts.get("alluxioRuntime")
@@ -167,11 +172,7 @@ class FluidEngine(CacheEngine):
             rt = m.new_obj("data.fluid.io/v1alpha1", "AlluxioRuntime", name, ns)
             rt["spec"] = {"replicas": runtime_opts.get("replicas", 1),
                           "tieredstore": {"levels": levels}}
-            m.set_controller_ref(rt, cache_backend)
-            try:
-                self.api.create(rt)
-            except AlreadyExists:
-                pass
+            self._create_owned(rt, cache_backend)
 
 
 ENGINES = {e.name: e for e in (HostDiskEngine, FluidEngine)}
@@ -266,7 +267,12 @@ def reconcile_job_cache(api, job: dict, cache_spec: dict, raw_specs: dict,
     # hostDisk binds its PVC before the warm-up rsync finished
     if m.get_in(cb, "status", "cacheStatus", default="") != PVC_CREATED:
         cb = api.get(KIND, ns, name)
-        if m.get_in(cb, "status", "cacheStatus", default="") != PVC_CREATED:
+        cache_status = m.get_in(cb, "status", "cacheStatus", default="")
+        if cache_status == CACHE_FAILED:
+            raise CacheError(
+                f"cache backend {name} failed: no usable cacheEngine in "
+                f"{sorted(m.get_in(cb, 'spec', 'cacheEngine', default={}) or {})}")
+        if cache_status != PVC_CREATED:
             return 2.0  # cache warming; hold off pod creation
     mount_path = cache_spec.get("mountPath") or "/dataset"
     for spec in raw_specs.values():

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import copy
 import json
+import shlex
 from typing import Optional
 
 from ..api import common as c
@@ -48,8 +49,10 @@ _dest_from_source = dest_from_source
 
 def gcs_rsync_command(source: str, dest_dir: str) -> str:
     """The one-shot GCS sync shell line used by both code-sync init
-    containers and dataset-cache warm-up pods."""
-    return f"mkdir -p {dest_dir} && gsutil -m rsync -r {source} {dest_dir}"
+    containers and dataset-cache warm-up pods. Source/dest come from
+    user-controlled spec fields, so they are shell-quoted."""
+    src, dst = shlex.quote(source), shlex.quote(dest_dir)
+    return f"mkdir -p {dst} && gsutil -m rsync -r {src} {dst}"
 
 
 def _git_init_container(opts: dict, volume_name: str) -> tuple[dict, str]:

@@ -150,12 +150,16 @@ class NotebookReconciler(Reconciler):
                                   f"notebook pod {m.name(pod)} terminated: {phase}",
                                   False)
         status = nb.setdefault("status", {})
-        if status.get("condition") != cond:
+        # recompute the url while Running on every pass, not only on the
+        # condition transition: the ingress LB host typically lands *after*
+        # the pod went Running, and the published link must pick it up
+        url = self._url(nb, pod) if cond == COND_RUNNING else status.get("url")
+        if status.get("condition") != cond or status.get("url") != url:
             status["condition"] = cond
             status["message"] = msg
             status["lastTransitionTime"] = m.rfc3339(self.api.now())
-            if cond == COND_RUNNING:
-                status["url"] = self._url(nb, pod)
+            if url:
+                status["url"] = url
             try:
                 self.api.update_status(nb)
             except (Conflict, NotFound):

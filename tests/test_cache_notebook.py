@@ -82,7 +82,8 @@ def test_cache_backend_lifecycle(api, manager, stack):
 
 def test_job_waits_for_cache_pvc(api, manager, stack):
     """Until the PVC exists no training pod may start (the mount would be
-    missing); once the cache controller binds it the job proceeds."""
+    missing); an unserviceable cacheEngine fails the job permanently
+    instead of requeueing forever."""
     job = cache_job()
     # use an engine spec no plugin serves so the PVC never appears
     job["spec"]["cacheBackend"] = {**CACHE_SPEC, "cacheEngine": {"custom": {}}}
@@ -93,6 +94,13 @@ def test_job_waits_for_cache_pvc(api, manager, stack):
     assert workers == []
     cb = api.get("CacheBackend", "default", "cj-cache")
     assert cb["status"]["cacheStatus"] == pc.CACHE_FAILED
+    # the failed cache is observed and turns into a terminal job failure
+    manager.run_until_idle(include_delayed=True, max_iterations=50)
+    from kubedl_tpu.api.common import JobStatus
+    from kubedl_tpu.utils import status as st
+    job_status = JobStatus.from_dict(
+        api.get(job["kind"], "default", m.name(job)).get("status"))
+    assert st.is_failed(job_status)
 
 
 def test_fluid_engine_renders_dataset_and_runtime(api, manager):

@@ -1,0 +1,284 @@
+"""Persistence layer: DMO converter round-trips (reference
+``pkg/storage/dmo/converters/*_test.go``), backend CRUD/query parity
+between memory and SQLite, and end-to-end persist controllers mirroring a
+job lifecycle through the manager."""
+
+import pytest
+
+from kubedl_tpu.api import common as c
+from kubedl_tpu.controllers.engine import EngineConfig, JobEngine
+from kubedl_tpu.controllers.testing import (
+    TestJobController, new_test_job, set_pod_phase)
+from kubedl_tpu.core import meta as m
+from kubedl_tpu.storage import dmo
+from kubedl_tpu.storage.backends import (
+    MemoryBackend, Query, SQLiteBackend, get_object_backend,
+    register_object_backend)
+from kubedl_tpu.storage.persist import setup_persist_controllers
+
+
+def make_job(api, name="pj", workers=2):
+    job = new_test_job(name, workers=workers)
+    job["kind"] = "TestJob"
+    tmpl = job["spec"]["testReplicaSpecs"]["Worker"]["template"]
+    tmpl["spec"]["containers"][0]["resources"] = {
+        "requests": {"cpu": "2", "memory": "4Gi"},
+        "limits": {"google.com/tpu": "4"},
+    }
+    m.annotations(job)[c.ANNOTATION_TENANCY_INFO] = (
+        '{"tenant": "team-a", "user": "alice"}')
+    return api.create(job)
+
+
+# ---------------------------------------------------------------------------
+# converters
+# ---------------------------------------------------------------------------
+
+def test_job_converter_roundtrip(api):
+    job = make_job(api)
+    rec = dmo.job_to_record(job, region="us-central2")
+    assert rec.name == "pj" and rec.kind == "TestJob"
+    assert rec.job_id == m.uid(job)
+    assert rec.tenant == "team-a" and rec.owner == "alice"
+    assert rec.deploy_region == "us-central2"
+    assert rec.status == c.JOB_CREATED
+    import json
+    res = json.loads(rec.resources)
+    assert res["Worker"]["replicas"] == 2
+    assert res["Worker"]["resources"]["cpu"] == 2.0
+    assert res["Worker"]["resources"]["memory"] == 4 * 2**30
+    assert res["Worker"]["resources"]["google.com/tpu"] == 4.0
+    # row round-trip
+    assert dmo.JobRecord.from_row(rec.to_row()) == rec
+
+
+def test_job_converter_status_from_conditions(api):
+    job = make_job(api, "pj2")
+    job["status"] = {"conditions": [
+        {"type": "Created", "status": "True"},
+        {"type": "Running", "status": "True"},
+    ], "startTime": "2026-01-01T00:00:00Z"}
+    rec = dmo.job_to_record(job)
+    assert rec.status == c.JOB_RUNNING
+    assert rec.gmt_job_running == "2026-01-01T00:00:00Z"
+
+
+def test_pod_converter(api):
+    job = make_job(api, "pj3")
+    pod = m.new_obj("v1", "Pod", "pj3-worker-0", labels={
+        c.LABEL_REPLICA_TYPE: "worker", c.LABEL_JOB_NAME: "pj3"})
+    pod["spec"] = {"containers": [{
+        "name": "main", "image": "img:v1",
+        "resources": {"requests": {"cpu": "500m"}}}]}
+    m.set_controller_ref(pod, job)
+    pod = api.create(pod)
+    pod["status"] = {"phase": "Running", "podIP": "10.0.0.3",
+                     "hostIP": "10.128.0.9", "containerStatuses": [
+                         {"state": {"running": {"startedAt": "2026-01-01T01:00:00Z"}}}]}
+    rec = dmo.pod_to_record(pod)
+    assert rec.job_id == m.uid(job)
+    assert rec.replica_type == "worker"
+    assert rec.image == "img:v1"
+    assert rec.pod_ip == "10.0.0.3" and rec.host_ip == "10.128.0.9"
+    assert rec.status == "Running"
+    assert rec.gmt_started == "2026-01-01T01:00:00Z"
+    assert dmo.PodRecord.from_row(rec.to_row()) == rec
+
+
+def test_event_converter():
+    ev = {"apiVersion": "v1", "kind": "Event",
+          "metadata": {"name": "pj.0001", "namespace": "default"},
+          "type": "Normal", "reason": "SuccessfulCreatePod",
+          "message": "created pod pj-worker-0", "count": 3,
+          "involvedObject": {"kind": "TestJob", "namespace": "default",
+                             "name": "pj", "uid": "u-1"},
+          "firstTimestamp": "2026-01-01T00:00:00Z",
+          "lastTimestamp": "2026-01-01T00:05:00Z"}
+    rec = dmo.event_to_record(ev)
+    assert rec.obj_uid == "u-1" and rec.kind == "TestJob"
+    assert rec.count == 3
+    assert dmo.EventRecord.from_row(rec.to_row()) == rec
+
+
+def test_parse_quantity():
+    assert dmo.parse_quantity("500m") == 0.5
+    assert dmo.parse_quantity("2") == 2.0
+    assert dmo.parse_quantity("1Gi") == 2**30
+    assert dmo.parse_quantity("10k") == 10_000
+    assert dmo.parse_quantity(4) == 4.0
+
+
+# ---------------------------------------------------------------------------
+# backends: one parametrized suite over memory + sqlite
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(params=["memory", "sqlite"])
+def backend(request):
+    b = MemoryBackend() if request.param == "memory" else SQLiteBackend(":memory:")
+    b.initialize()
+    yield b
+    b.close()
+
+
+def job_rec(name, uid, status="Running", kind="TestJob", ns="default",
+            created="2026-01-01T00:00:00Z"):
+    return dmo.JobRecord(name=name, namespace=ns, job_id=uid, kind=kind,
+                         status=status, gmt_created=created,
+                         gmt_modified=created)
+
+
+def test_backend_job_crud(backend):
+    backend.save_job(job_rec("a", "u1"))
+    backend.save_job(job_rec("b", "u2", status="Succeeded",
+                             created="2026-01-02T00:00:00Z"))
+    assert backend.get_job("default", "a").job_id == "u1"
+    assert backend.get_job("default", "x", "u2").namespace == "default"
+
+    q = Query()
+    jobs = backend.list_jobs(q)
+    assert [j.name for j in jobs] == ["b", "a"]  # newest first
+    assert q.count == 2
+
+    q = Query(status="Succeeded")
+    assert [j.name for j in backend.list_jobs(q)] == ["b"]
+
+    q = Query(name="a")
+    assert [j.name for j in backend.list_jobs(q)] == ["a"]
+
+    # update keeps original gmt_created, accumulates running timestamp
+    upd = job_rec("a", "u1", status="Succeeded", created="2026-03-01T00:00:00Z")
+    upd.gmt_job_running = "2026-01-01T00:01:00Z"
+    backend.save_job(upd)
+    got = backend.get_job("default", "a")
+    assert got.gmt_created == "2026-01-01T00:00:00Z"
+    assert got.gmt_job_running == "2026-01-01T00:01:00Z"
+
+    backend.stop_job("default", "a")
+    assert backend.get_job("default", "a").status == "Stopped"
+    backend.delete_job("default", "b")
+    got = backend.get_job("default", "b")
+    assert got.deleted == dmo.DELETED and got.is_in_etcd == 0
+
+
+def test_backend_job_pagination(backend):
+    for i in range(5):
+        backend.save_job(job_rec(f"j{i}", f"u{i}",
+                                 created=f"2026-01-0{i+1}T00:00:00Z"))
+    q = Query(page_num=2, page_size=2)
+    page = backend.list_jobs(q)
+    assert q.count == 5
+    assert [j.name for j in page] == ["j2", "j1"]
+
+
+def test_backend_pods(backend):
+    rec = dmo.PodRecord(name="p-0", namespace="default", pod_id="pu1",
+                        job_id="u1", replica_type="worker", status="Pending",
+                        gmt_created="2026-01-01T00:00:00Z")
+    backend.save_pod(rec)
+    upd = dmo.PodRecord(name="p-0", namespace="default", pod_id="pu1",
+                        job_id="u1", replica_type="worker", status="Running",
+                        gmt_started="2026-01-01T00:02:00Z",
+                        gmt_created="2026-02-01T00:00:00Z")
+    backend.save_pod(upd)
+    pods = backend.list_pods("default", "j", "u1")
+    assert len(pods) == 1
+    assert pods[0].status == "Running"
+    assert pods[0].gmt_created == "2026-01-01T00:00:00Z"  # kept from first save
+    backend.stop_pod("default", "p-0", "pu1")
+    assert backend.list_pods("default", "j", "u1")[0].deleted == dmo.DELETED
+
+
+def test_backend_events(backend):
+    for i, ts in enumerate(["2026-01-01T00:02:00Z", "2026-01-01T00:01:00Z"]):
+        backend.save_event(dmo.EventRecord(
+            name=f"e{i}", obj_namespace="default", obj_name="pj",
+            obj_uid="u1", reason="r", message="m", last_timestamp=ts))
+    evs = backend.list_events("default", "pj")
+    assert [e.name for e in evs] == ["e1", "e0"]  # time-ordered
+    evs = backend.list_events("default", "pj", from_time="2026-01-01T00:01:30Z")
+    assert [e.name for e in evs] == ["e0"]
+    # upsert by (obj_uid, name)
+    backend.save_event(dmo.EventRecord(
+        name="e0", obj_namespace="default", obj_name="pj", obj_uid="u1",
+        reason="r", message="m2", count=5,
+        last_timestamp="2026-01-01T00:03:00Z"))
+    evs = backend.list_events("default", "pj")
+    assert len(evs) == 2 and evs[-1].count == 5
+
+
+def test_backend_notebooks(backend):
+    backend.save_notebook(dmo.NotebookRecord(
+        name="nb", namespace="default", notebook_id="n1", status="Running",
+        url="http://nb.example", gmt_created="2026-01-01T00:00:00Z"))
+    q = Query()
+    nbs = backend.list_notebooks(q)
+    assert len(nbs) == 1 and nbs[0].url == "http://nb.example"
+    backend.delete_notebook("default", "nb")
+    assert backend.list_notebooks(Query())[0].deleted == dmo.DELETED
+
+
+def test_registry():
+    b = MemoryBackend()
+    register_object_backend(b)
+    assert get_object_backend("memory") is b
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: persist controllers mirror a job lifecycle
+# ---------------------------------------------------------------------------
+
+def test_persist_controllers_mirror_job(api, manager):
+    backend = SQLiteBackend(":memory:")
+    engine = JobEngine(api, TestJobController(), EngineConfig())
+    manager.register(engine)
+    setup_persist_controllers(api, manager, object_backend=backend,
+                              event_backend=backend,
+                              job_kinds=("TestJob",), region="us-central2")
+
+    job = make_job(api, "e2e", workers=2)
+    manager.run_until_idle(max_iterations=80)
+
+    rec = backend.get_job("default", "e2e")
+    assert rec is not None and rec.kind == "TestJob"
+    pods = backend.list_pods("default", "e2e", m.uid(job))
+    assert len(pods) == 2
+    assert {p.replica_type for p in pods} == {"worker"}
+
+    # drive to succeeded: records reflect status + events mirrored
+    for pod in api.list("Pod"):
+        set_pod_phase(api, pod, c.POD_RUNNING)
+    manager.run_until_idle(max_iterations=80)
+    for pod in api.list("Pod"):
+        set_pod_phase(api, pod, c.POD_SUCCEEDED)
+    manager.run_until_idle(max_iterations=80)
+
+    rec = backend.get_job("default", "e2e")
+    assert rec.status == c.JOB_SUCCEEDED
+    assert rec.gmt_job_finished
+    events = backend.list_events("default", "e2e")
+    assert any(e.reason for e in events)
+
+    # deletion flips is_in_etcd but keeps the row (the whole point)
+    api.delete("TestJob", "default", "e2e")
+    manager.run_until_idle(max_iterations=80)
+    rec = backend.get_job("default", "e2e")
+    assert rec is not None and rec.is_in_etcd == 0
+
+
+def test_operator_with_persistence(api):
+    from kubedl_tpu.controllers.registry import OperatorConfig, build_operator
+    op = build_operator(api, OperatorConfig(
+        workloads=["PyTorchJob"], object_storage="sqlite",
+        event_storage="sqlite", deploy_region="us-east5"))
+    assert op.object_backend is op.event_backend  # same spec → shared
+    job = m.new_obj("training.kubedl.io/v1alpha1", "PyTorchJob", "op-job")
+    job["spec"] = {"pytorchReplicaSpecs": {"Master": {
+        "replicas": 1, "restartPolicy": "Never",
+        "template": {"spec": {"containers": [
+            {"name": "pytorch", "image": "img", "ports": [
+                {"name": "pytorchjob-port", "containerPort": 23456}]}]}}}}}
+    api.create(job)
+    op.run_until_idle(max_iterations=80)
+    rec = op.object_backend.get_job("default", "op-job")
+    assert rec is not None and rec.kind == "PyTorchJob"
+    assert rec.deploy_region == "us-east5"
