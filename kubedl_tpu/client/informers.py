@@ -1,0 +1,180 @@
+"""Shared informers and listers.
+
+The analog of the reference's generated informer/lister tree
+(``client/informers/externalversions``, ``client/listers``): a shared
+factory hands out one informer per kind; each informer keeps a local cache
+(indexed by namespace/name) synced from the API server's watch stream,
+replays the initial list to late-added handlers, and exposes a ``Lister``
+over the cache so reads don't hit the store.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+from ..core import meta as m
+from ..core.apiserver import APIServer
+from .clientset import KIND_TABLE
+
+
+class Lister:
+    """Cache-backed reads (``client/listers/.../tfjob.go`` shape)."""
+
+    def __init__(self, informer: "Informer"):
+        self._informer = informer
+
+    def get(self, namespace: str, name: str) -> Optional[dict]:
+        return self._informer._cache_get(namespace, name)
+
+    def list(self, namespace: Optional[str] = None,
+             selector: Optional[dict] = None) -> list:
+        return self._informer._cache_list(namespace, selector)
+
+
+class Informer:
+    """One kind's shared informer: local cache + event handlers."""
+
+    def __init__(self, api: APIServer, kind: str):
+        self.api = api
+        self.kind = kind
+        self._cache: dict[tuple[str, str], dict] = {}
+        self._handlers: list[dict] = []
+        self._lock = threading.RLock()
+        self._synced = False
+        self._cancel: Optional[Callable[[], None]] = None
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> None:
+        """Subscribe to the watch stream and sync the initial list."""
+        with self._lock:
+            if self._cancel is not None:
+                return
+            self._cancel = self.api.watch(self._on_event)
+            for obj in self.api.list(self.kind):
+                self._cache[(m.namespace(obj), m.name(obj))] = obj
+                self._dispatch("add", None, obj)
+            self._synced = True
+
+    def stop(self) -> None:
+        with self._lock:
+            if self._cancel is not None:
+                self._cancel()
+                self._cancel = None
+            self._synced = False
+
+    def has_synced(self) -> bool:
+        return self._synced
+
+    # -- handlers ---------------------------------------------------------
+
+    def add_event_handler(self, on_add: Optional[Callable] = None,
+                          on_update: Optional[Callable] = None,
+                          on_delete: Optional[Callable] = None) -> None:
+        """Handlers get (obj) for add/delete and (old, new) for update.
+        A handler added after start() gets the current cache replayed as
+        adds (client-go semantics)."""
+        handler = {"add": on_add, "update": on_update, "delete": on_delete}
+        with self._lock:
+            self._handlers.append(handler)
+            if self._synced and on_add is not None:
+                for obj in list(self._cache.values()):
+                    on_add(obj)
+
+    def lister(self) -> Lister:
+        return Lister(self)
+
+    # -- internals --------------------------------------------------------
+
+    def _on_event(self, event_type: str, obj: dict) -> None:
+        if m.kind(obj) != self.kind:
+            return
+        key = (m.namespace(obj), m.name(obj))
+        with self._lock:
+            if event_type == "ADDED":
+                prev = self._cache.get(key)
+                if prev is not None and \
+                        m.resource_version(prev) >= m.resource_version(obj):
+                    # already replayed by start()'s list snapshot: an object
+                    # created while start() held the lock would otherwise be
+                    # dispatched as 'add' twice
+                    return
+                self._cache[key] = obj
+                self._dispatch("add", None, obj)
+            elif event_type == "MODIFIED":
+                old = self._cache.get(key)
+                self._cache[key] = obj
+                if old is None:
+                    self._dispatch("add", None, obj)
+                else:
+                    self._dispatch("update", old, obj)
+            elif event_type == "DELETED":
+                self._cache.pop(key, None)
+                self._dispatch("delete", None, obj)
+
+    def _dispatch(self, which: str, old: Optional[dict], obj: dict) -> None:
+        for handler in list(self._handlers):
+            fn = handler.get(which)
+            if fn is None:
+                continue
+            if which == "update":
+                fn(old, obj)
+            else:
+                fn(obj)
+
+    def _cache_get(self, namespace: str, name: str) -> Optional[dict]:
+        with self._lock:
+            return self._cache.get((namespace, name))
+
+    def _cache_list(self, namespace: Optional[str],
+                    selector: Optional[dict]) -> list:
+        with self._lock:
+            out = []
+            for (ns, _), obj in self._cache.items():
+                if namespace is not None and ns != namespace:
+                    continue
+                if selector is not None and not m.match_labels(
+                        m.labels(obj), selector):
+                    continue
+                out.append(obj)
+        out.sort(key=lambda o: (m.namespace(o), m.name(o)))
+        return out
+
+
+class SharedInformerFactory:
+    """``externalversions.SharedInformerFactory``: one informer per kind,
+    shared across consumers; ``start()`` starts them all."""
+
+    def __init__(self, api: APIServer):
+        self.api = api
+        self._informers: dict[str, Informer] = {}
+        self._lock = threading.Lock()
+
+    def informer(self, kind: str) -> Informer:
+        if kind not in KIND_TABLE:
+            raise KeyError(f"unknown kind {kind!r}")
+        with self._lock:
+            inf = self._informers.get(kind)
+            if inf is None:
+                inf = Informer(self.api, kind)
+                self._informers[kind] = inf
+            return inf
+
+    def lister(self, kind: str) -> Lister:
+        return self.informer(kind).lister()
+
+    def start(self) -> None:
+        with self._lock:
+            informers = list(self._informers.values())
+        for inf in informers:
+            inf.start()
+
+    def stop(self) -> None:
+        with self._lock:
+            informers = list(self._informers.values())
+        for inf in informers:
+            inf.stop()
+
+    def wait_for_cache_sync(self) -> bool:
+        return all(inf.has_synced() for inf in self._informers.values())

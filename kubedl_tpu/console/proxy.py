@@ -1,0 +1,177 @@
+"""Console data layer: api-server first, persistence fallback ("proxy").
+
+The reference's default object storage for the console is ``proxy`` —
+"first try read/write from api-server, and fall back to DB if not exists"
+(``console/backend/pkg/routers/router.go:34-38``). This module is that
+merge: live objects come from the in-memory API server through the typed
+clientset; jobs that were GC'd from the api-server are filled in from the
+persistence backend's records.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+from ..client.clientset import TRAINING_KINDS
+from ..core import meta as m
+from ..core.apiserver import APIServer
+from ..storage import dmo
+from ..storage.backends import EventBackend, ObjectBackend, Query, _match
+
+
+class DataProxy:
+    def __init__(self, api: APIServer,
+                 object_backend: Optional[ObjectBackend] = None,
+                 event_backend: Optional[EventBackend] = None,
+                 job_kinds=TRAINING_KINDS):
+        self.api = api
+        self.object_backend = object_backend
+        self.event_backend = event_backend
+        self.job_kinds = tuple(job_kinds)
+
+    # -- jobs -------------------------------------------------------------
+
+    def list_jobs(self, query: Query) -> list:
+        """Live jobs rendered as records, unioned with persisted records of
+        jobs no longer in the api-server (matched by uid)."""
+        kinds = [query.kind] if query.kind else self.job_kinds
+        live: dict[str, dmo.JobRecord] = {}
+        for kind in kinds:
+            if kind not in self.job_kinds:
+                continue
+            for obj in self.api.list(kind):
+                rec = dmo.job_to_record(obj)
+                live[rec.job_id] = rec
+        rows = [r for r in live.values() if _match(r, query)]
+        if self.object_backend is not None:
+            persisted = self.object_backend.list_jobs(
+                Query(**{**query.__dict__, "page_num": 0, "page_size": 0}))
+            rows.extend(r for r in persisted if r.job_id not in live)
+        rows.sort(key=lambda r: r.gmt_created, reverse=True)
+        query.count = len(rows)
+        if query.page_num > 0 and query.page_size > 0:
+            lo = (query.page_num - 1) * query.page_size
+            rows = rows[lo:lo + query.page_size]
+        return rows
+
+    def get_job(self, kind: str, namespace: str, name: str) -> Optional[dict]:
+        """The live CR when present, else a record-shaped stub."""
+        obj = self.api.try_get(kind, namespace, name)
+        if obj is not None:
+            return obj
+        if self.object_backend is not None:
+            rec = self.object_backend.get_job(namespace, name)
+            if rec is not None and (not kind or rec.kind == kind):
+                return {"apiVersion": "training.kubedl.io/v1alpha1",
+                        "kind": rec.kind,
+                        "metadata": {"name": rec.name, "namespace": rec.namespace,
+                                     "uid": rec.job_id,
+                                     "creationTimestamp": rec.gmt_created},
+                        "spec": {"resources": json.loads(rec.resources or "{}")},
+                        "status": {"conditions": [{"type": rec.status,
+                                                   "status": "True"}]},
+                        "_persisted": True}
+        return None
+
+    def list_job_pods(self, kind: str, namespace: str, name: str) -> list:
+        job = self.api.try_get(kind, namespace, name)
+        if job is not None:
+            uid = m.uid(job)
+            pods = [p for p in self.api.list("Pod", namespace)
+                    if m.is_controlled_by(p, job)]
+            if pods:
+                return [dmo.pod_to_record(p) for p in pods]
+        else:
+            uid = ""
+            if self.object_backend is not None:
+                rec = self.object_backend.get_job(namespace, name)
+                uid = rec.job_id if rec else ""
+        if self.object_backend is not None and uid:
+            return self.object_backend.list_pods(namespace, name, uid)
+        return []
+
+    def stop_job(self, kind: str, namespace: str, name: str) -> bool:
+        """Stop = delete from api-server but keep (and mark) the record
+        (reference StopJob semantics)."""
+        obj = self.api.try_get(kind, namespace, name)
+        if obj is None:
+            return False
+        self.api.delete(kind, namespace, name)
+        if self.object_backend is not None:
+            self.object_backend.stop_job(namespace, name, m.uid(obj))
+        return True
+
+    def job_statistics(self, query: Query) -> dict:
+        """Reference GetJobStatistics: totals + per-status histogram."""
+        rows = self.list_jobs(Query(**{**query.__dict__,
+                                       "page_num": 0, "page_size": 0}))
+        by_status: dict[str, int] = {}
+        for r in rows:
+            by_status[r.status] = by_status.get(r.status, 0) + 1
+        return {"total": len(rows), "byStatus": by_status,
+                "statistics": [{"status": k, "count": v}
+                               for k, v in sorted(by_status.items())]}
+
+    # -- events / notebooks ----------------------------------------------
+
+    def list_events(self, namespace: str, obj_name: str) -> list:
+        live = [dmo.event_to_record(e) for e in self.api.list("Event", namespace)
+                if e.get("involvedObject", {}).get("name") == obj_name]
+        if live:
+            return sorted(live, key=lambda r: r.last_timestamp)
+        if self.event_backend is not None:
+            return self.event_backend.list_events(namespace, obj_name)
+        return []
+
+    def list_notebooks(self, query: Query) -> list:
+        live: dict[str, dmo.NotebookRecord] = {}
+        for obj in self.api.list("Notebook"):
+            rec = dmo.notebook_to_record(obj)
+            live[rec.notebook_id] = rec
+        rows = list(live.values())
+        if self.object_backend is not None:
+            rows.extend(r for r in self.object_backend.list_notebooks(Query())
+                        if r.notebook_id not in live)
+        rows.sort(key=lambda r: r.gmt_created, reverse=True)
+        return rows
+
+    # -- cluster ----------------------------------------------------------
+
+    def cluster_total(self) -> dict:
+        """Reference getClusterTotal: summed allocatable of Nodes; on the
+        standalone control plane, Node objects are optional so the TPU
+        devices visible to the process stand in when none exist."""
+        nodes = self.api.list("Node")
+        total = {"cpu": 0.0, "memory": 0.0, "google.com/tpu": 0.0}
+        for node in nodes:
+            alloc = m.get_in(node, "status", "allocatable", default={}) or {}
+            for key, val in alloc.items():
+                total[key] = total.get(key, 0.0) + dmo.parse_quantity(val)
+        return {"nodes": len(nodes), "total": total}
+
+    def cluster_request(self, pod_phase: str) -> dict:
+        """Summed requests of pods in the given phase (reference
+        getClusterRequest)."""
+        total: dict[str, float] = {}
+        count = 0
+        for pod in self.api.list("Pod"):
+            phase = m.get_in(pod, "status", "phase", default="Pending")
+            if pod_phase and phase != pod_phase:
+                continue
+            count += 1
+            for key, val in dmo._sum_container_resources(
+                    pod.get("spec", {}) or {}).items():
+                total[key] = total.get(key, 0) + val
+        return {"pods": count, "request": total}
+
+    def node_infos(self) -> list:
+        out = []
+        for node in self.api.list("Node"):
+            out.append({
+                "name": m.name(node),
+                "allocatable": m.get_in(node, "status", "allocatable",
+                                        default={}) or {},
+                "labels": m.labels(node),
+            })
+        return out

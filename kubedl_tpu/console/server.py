@@ -1,0 +1,379 @@
+"""Console REST backend on the stdlib HTTP stack.
+
+Route-for-route analog of the reference Gin server
+(``console/backend/pkg/routers/api/*.go``):
+
+* auth: ``POST /api/v1/login``, ``POST /api/v1/logout``,
+  ``GET /api/v1/current-user`` (session-cookie auth, ``auth.go``)
+* jobs: ``/api/v1/job/{list,detail,statistics,running-jobs}``,
+  ``/api/v1/job/{yaml,json}/{ns}/{name}``, ``POST /api/v1/job/stop``,
+  ``POST /api/v1/job/submit``, ``DELETE /api/v1/job/{ns}/{name}``
+  (``job.go:32-46``)
+* cluster: ``/api/v1/data/{total,nodeInfos}``,
+  ``/api/v1/data/request/{podPhase}`` (``data.go:24-29``)
+* events/logs: ``/api/v1/event/events/{ns}/{name}``,
+  ``/api/v1/log/logs/{ns}/{podName}`` (``log.go:26-31``)
+* notebooks: ``/api/v1/notebook/{list,submit}``, ``DELETE``, yaml/json
+  (``notebook.go:24-31``)
+* static dashboard at ``/`` (the frontend build the Gin server embeds).
+
+Responses use the reference's envelope: ``{"code": 200, "data": ...}`` on
+success, ``{"code": ..., "msg": ...}`` on error.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import secrets
+import threading
+from dataclasses import dataclass, field
+from http import HTTPStatus
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+import yaml
+
+from ..client.clientset import KIND_TABLE, TRAINING_KINDS, Clientset
+from ..core import meta as m
+from ..core.apiserver import AlreadyExists, ApiError, NotFound
+from ..storage.backends import Query
+from .proxy import DataProxy
+
+FRONTEND_DIR = Path(__file__).parent / "frontend"
+SESSION_COOKIE = "kubedl-session"
+
+
+@dataclass
+class ConsoleConfig:
+    host: str = "127.0.0.1"
+    port: int = 9090
+    #: username -> password; empty dict disables auth entirely (dev mode)
+    users: dict = field(default_factory=lambda: {"admin": "kubedl"})
+    #: cap on request body size (submit endpoints)
+    max_body: int = 4 << 20
+
+
+class _Sessions:
+    def __init__(self):
+        self._tokens: dict[str, str] = {}
+        self._lock = threading.Lock()
+
+    def login(self, user: str) -> str:
+        token = secrets.token_urlsafe(24)
+        with self._lock:
+            self._tokens[token] = user
+        return token
+
+    def user(self, token: Optional[str]) -> Optional[str]:
+        with self._lock:
+            return self._tokens.get(token or "")
+
+    def logout(self, token: Optional[str]) -> None:
+        with self._lock:
+            self._tokens.pop(token or "", None)
+
+
+class ConsoleServer:
+    """Owns the HTTP server; all state lives here, the handler is stateless."""
+
+    def __init__(self, proxy: DataProxy, config: Optional[ConsoleConfig] = None):
+        self.proxy = proxy
+        self.config = config or ConsoleConfig()
+        self.sessions = _Sessions()
+        self.cs = Clientset(proxy.api)
+        console = self
+
+        class Handler(_ConsoleHandler):
+            server_ref = console
+
+        self._httpd = ThreadingHTTPServer(
+            (self.config.host, self.config.port), Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.config.host}:{self.port}"
+
+    def start(self) -> "ConsoleServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="kubedl-console", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    # -- request routing (called from the handler) ------------------------
+
+    def route(self, method: str, path: str, params: dict, body: bytes,
+              token: Optional[str]):
+        """Returns (status, payload|bytes, extra_headers)."""
+        if not path.startswith("/api/"):
+            return self._static(path)
+
+        # auth endpoints are always reachable
+        if path == "/api/v1/login" and method == "POST":
+            try:
+                return self._login(body)
+            except ValueError as e:
+                return 400, {"code": 400, "msg": f"bad login body: {e}"}, []
+        if path == "/api/v1/logout" and method == "POST":
+            self.sessions.logout(token)
+            return 200, {"code": 200, "data": "ok"}, []
+        user = self.sessions.user(token)
+        if self.config.users and user is None:
+            return 401, {"code": 401, "msg": "not logged in"}, []
+        if path == "/api/v1/current-user":
+            return 200, {"code": 200, "data": {
+                "loginId": user or "anonymous"}}, []
+
+        try:
+            return self._dispatch(method, path, params, body)
+        except NotFound as e:
+            return 404, {"code": 404, "msg": str(e)}, []
+        except (ApiError, ValueError, KeyError) as e:
+            return 400, {"code": 400, "msg": f"{type(e).__name__}: {e}"}, []
+
+    # -- endpoint implementations ----------------------------------------
+
+    def _dispatch(self, method: str, path: str, params: dict, body: bytes):
+        ok = lambda data: (200, {"code": 200, "data": data}, [])  # noqa: E731
+
+        if path == "/api/v1/job/list":
+            q = _query_from_params(params)
+            rows = self.proxy.list_jobs(q)
+            return ok({"total": q.count,
+                       "jobInfos": [r.to_row() for r in rows]})
+        if path == "/api/v1/job/detail":
+            kind = params.get("kind", "")
+            ns = params.get("namespace", "default")
+            name = params.get("name", "")
+            job = self._find_job(kind, ns, name)
+            if job is None:
+                raise NotFound(f"job {ns}/{name} not found")
+            pods = self.proxy.list_job_pods(m.kind(job), ns, name)
+            events = self.proxy.list_events(ns, name)
+            return ok({"job": job, "pods": [p.to_row() for p in pods],
+                       "events": [e.to_row() for e in events]})
+        if path == "/api/v1/job/statistics":
+            return ok(self.proxy.job_statistics(_query_from_params(params)))
+        if path == "/api/v1/job/running-jobs":
+            q = _query_from_params(params)
+            q.status = "Running"
+            return ok([r.to_row() for r in self.proxy.list_jobs(q)])
+        mt = re.fullmatch(r"/api/v1/job/(yaml|json)/([^/]+)/([^/]+)", path)
+        if mt:
+            fmt, ns, name = mt.groups()
+            job = self._find_job(params.get("kind", ""), ns, name)
+            if job is None:
+                raise NotFound(f"job {ns}/{name} not found")
+            if fmt == "json":
+                return ok(job)
+            return 200, yaml.safe_dump(job, sort_keys=False).encode(), [
+                ("Content-Type", "text/yaml")]
+        if path == "/api/v1/job/stop" and method == "POST":
+            req = json.loads(body or b"{}")
+            stopped = self.proxy.stop_job(req.get("kind", ""),
+                                          req.get("namespace", "default"),
+                                          req.get("name", ""))
+            if not stopped:
+                raise NotFound("job not found")
+            return ok("stopped")
+        if path == "/api/v1/job/submit" and method == "POST":
+            obj = _parse_manifest(body)
+            kind = m.kind(obj)
+            if kind not in TRAINING_KINDS:
+                raise ValueError(f"kind {kind!r} is not a training job kind")
+            created = self.cs.kind(kind).create(obj)
+            return ok({"name": m.name(created),
+                       "namespace": m.namespace(created)})
+        mt = re.fullmatch(r"/api/v1/job/([^/]+)/([^/]+)", path)
+        if mt and method == "DELETE":
+            ns, name = mt.groups()
+            job = self._find_job(params.get("kind", ""), ns, name)
+            if job is None:
+                raise NotFound(f"job {ns}/{name} not found")
+            self.proxy.api.delete(m.kind(job), ns, name)
+            return ok("deleted")
+
+        if path == "/api/v1/data/total":
+            return ok(self.proxy.cluster_total())
+        if path == "/api/v1/data/nodeInfos":
+            return ok(self.proxy.node_infos())
+        mt = re.fullmatch(r"/api/v1/data/request/([^/]+)", path)
+        if mt:
+            return ok(self.proxy.cluster_request(mt.group(1)))
+
+        mt = re.fullmatch(r"/api/v1/event/events/([^/]+)/([^/]+)", path)
+        if mt:
+            ns, name = mt.groups()
+            return ok([e.to_row() for e in self.proxy.list_events(ns, name)])
+        mt = re.fullmatch(r"/api/v1/log/logs/([^/]+)/([^/]+)", path)
+        if mt:
+            # standalone control plane has no kubelet log endpoint; the
+            # nearest faithful signal is the pod's event stream
+            ns, name = mt.groups()
+            lines = [f"{e.last_timestamp} [{e.type}] {e.reason}: {e.message}"
+                     for e in self.proxy.list_events(ns, name)]
+            return ok(lines)
+
+        if path == "/api/v1/notebook/list":
+            return ok([r.to_row() for r in self.proxy.list_notebooks(Query())])
+        if path == "/api/v1/notebook/submit" and method == "POST":
+            obj = _parse_manifest(body)
+            if m.kind(obj) != "Notebook":
+                raise ValueError("manifest kind must be Notebook")
+            created = self.cs.kind("Notebook").create(obj)
+            return ok({"name": m.name(created)})
+        mt = re.fullmatch(r"/api/v1/notebook/([^/]+)/([^/]+)", path)
+        if mt and method == "DELETE":
+            ns, name = mt.groups()
+            self.proxy.api.delete("Notebook", ns, name)
+            return ok("deleted")
+        mt = re.fullmatch(r"/api/v1/notebook/(yaml|json)/([^/]+)/([^/]+)", path)
+        if mt:
+            fmt, ns, name = mt.groups()
+            nb = self.proxy.api.get("Notebook", ns, name)
+            if fmt == "json":
+                return ok(nb)
+            return 200, yaml.safe_dump(nb, sort_keys=False).encode(), [
+                ("Content-Type", "text/yaml")]
+
+        if path == "/api/v1/tensorboard/status":
+            ns = params.get("namespace", "default")
+            name = params.get("name", "")
+            pod = self.proxy.api.try_get("Pod", ns, f"{name}-tensorboard")
+            svc = self.proxy.api.try_get("Service", ns, f"{name}-tensorboard")
+            return ok({
+                "phase": m.get_in(pod, "status", "phase", default="NotFound")
+                if pod else "NotFound",
+                "service": m.name(svc) if svc else ""})
+
+        if path == "/api/v1/kinds":
+            return ok(sorted(TRAINING_KINDS))
+
+        raise NotFound(f"no route {method} {path}")
+
+    def _find_job(self, kind: str, ns: str, name: str) -> Optional[dict]:
+        kinds = [kind] if kind else TRAINING_KINDS
+        for kd in kinds:
+            if kd not in KIND_TABLE:
+                continue
+            job = self.proxy.get_job(kd, ns, name)
+            if job is not None:
+                return job
+        return None
+
+    def _login(self, body: bytes):
+        req = json.loads(body or b"{}")
+        user, pw = req.get("username", ""), req.get("password", "")
+        if self.config.users and self.config.users.get(user) != pw:
+            return 401, {"code": 401, "msg": "bad credentials"}, []
+        token = self.sessions.login(user or "anonymous")
+        return 200, {"code": 200, "data": {"loginId": user}}, [
+            ("Set-Cookie", f"{SESSION_COOKIE}={token}; Path=/; HttpOnly")]
+
+    def _static(self, path: str):
+        rel = path.lstrip("/") or "index.html"
+        target = (FRONTEND_DIR / rel).resolve()
+        if not target.is_relative_to(FRONTEND_DIR.resolve()) \
+                or not target.is_file():
+            target = FRONTEND_DIR / "index.html"  # SPA fallback
+            if not target.is_file():
+                return 404, {"code": 404, "msg": "no frontend build"}, []
+        ctype = {"html": "text/html", "js": "text/javascript",
+                 "css": "text/css", "svg": "image/svg+xml",
+                 "png": "image/png"}.get(target.suffix.lstrip("."),
+                                         "application/octet-stream")
+        return 200, target.read_bytes(), [("Content-Type", ctype)]
+
+
+class _ConsoleHandler(BaseHTTPRequestHandler):
+    server_ref: ConsoleServer = None  # injected per-server subclass
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *a):  # quiet by default
+        pass
+
+    def _token(self) -> Optional[str]:
+        cookie = self.headers.get("Cookie", "")
+        for part in cookie.split(";"):
+            k, _, v = part.strip().partition("=")
+            if k == SESSION_COOKIE:
+                return v
+        return None
+
+    def _handle(self, method: str):
+        parsed = urlparse(self.path)
+        params = {k: v[0] for k, v in parse_qs(parsed.query).items()}
+        length = int(self.headers.get("Content-Length") or 0)
+        if length > self.server_ref.config.max_body:
+            # the unread body would desync keep-alive framing: drop the conn
+            self.close_connection = True
+            self._respond(413, {"code": 413, "msg": "body too large"}, [])
+            return
+        body = self.rfile.read(length) if length else b""
+        status, payload, headers = self.server_ref.route(
+            method, parsed.path, params, body, self._token())
+        self._respond(status, payload, headers)
+
+    def _respond(self, status: int, payload, headers):
+        data = (payload if isinstance(payload, bytes)
+                else json.dumps(payload).encode())
+        self.send_response(status)
+        ctype = dict(headers).get("Content-Type", "application/json")
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        for key, val in headers:
+            if key != "Content-Type":
+                self.send_header(key, val)
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):
+        self._handle("GET")
+
+    def do_POST(self):
+        self._handle("POST")
+
+    def do_DELETE(self):
+        self._handle("DELETE")
+
+
+def _parse_manifest(body: bytes) -> dict:
+    """Submit endpoints accept JSON or YAML (the reference console submits
+    JSON; kubectl users paste YAML)."""
+    text = body.decode()
+    try:
+        obj = json.loads(text)
+    except ValueError:
+        try:
+            obj = yaml.safe_load(text)
+        except yaml.YAMLError as e:
+            raise ValueError(f"manifest is neither JSON nor YAML: {e}")
+    if not isinstance(obj, dict) or not m.name(obj):
+        raise ValueError("manifest must be an object with metadata.name")
+    return obj
+
+
+def _query_from_params(params: dict) -> Query:
+    return Query(
+        kind=params.get("kind", ""),
+        name=params.get("name", ""),
+        namespace=params.get("namespace", ""),
+        status=params.get("status", ""),
+        start_time=params.get("start_time", ""),
+        end_time=params.get("end_time", ""),
+        page_num=int(params.get("current_page", 0) or 0),
+        page_size=int(params.get("page_size", 0) or 0),
+    )

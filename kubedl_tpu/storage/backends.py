@@ -269,6 +269,17 @@ CREATE INDEX IF NOT EXISTS idx_events_obj ON events (obj_namespace, obj_name);
 """
 
 
+def _locked(fn):
+    """Serialize a backend method's whole statement+fetch sequence on the
+    shared connection."""
+    def wrapper(self, *args, **kwargs):
+        with self._lock:
+            return fn(self, *args, **kwargs)
+    wrapper.__name__ = fn.__name__
+    wrapper.__doc__ = fn.__doc__
+    return wrapper
+
+
 def _upsert(table: str, key: str, row: dict) -> tuple:
     cols = ", ".join(row)
     marks = ", ".join("?" for _ in row)
@@ -286,35 +297,37 @@ class SQLiteBackend(ObjectBackend, EventBackend):
 
     def __init__(self, path: str = ":memory:"):
         self.path = path
-        self._local = threading.local()
-        self._conns: list = []
-        self._lock = threading.Lock()
+        # ONE shared connection for all threads (``:memory:`` is
+        # per-connection — thread-local connections would each see a
+        # separate empty database). sqlite serializes writes anyway; the
+        # RLock serializes our statement+fetch sequences.
+        self._connection: Optional[sqlite3.Connection] = None
+        self._lock = threading.RLock()
 
     def _conn(self) -> sqlite3.Connection:
-        conn = getattr(self._local, "conn", None)
-        if conn is None:
-            conn = sqlite3.connect(self.path)
-            conn.row_factory = sqlite3.Row
-            conn.executescript(_SCHEMA)
-            self._local.conn = conn
-            with self._lock:
-                self._conns.append(conn)
-        return conn
+        with self._lock:
+            if self._connection is None:
+                conn = sqlite3.connect(self.path, check_same_thread=False)
+                conn.row_factory = sqlite3.Row
+                conn.executescript(_SCHEMA)
+                self._connection = conn
+            return self._connection
 
     def initialize(self) -> None:
         self._conn()
 
     def close(self) -> None:
         with self._lock:
-            for conn in self._conns:
+            if self._connection is not None:
                 try:
-                    conn.close()
+                    self._connection.close()
                 except sqlite3.Error:
                     pass
-            self._conns.clear()
+                self._connection = None
 
     # -- jobs -------------------------------------------------------------
 
+    @_locked
     def save_job(self, rec: JobRecord) -> None:
         conn = self._conn()
         row = rec.to_row()
@@ -326,6 +339,7 @@ class SQLiteBackend(ObjectBackend, EventBackend):
         with conn:
             conn.execute(*_upsert("jobs", "job_id", row))
 
+    @_locked
     def get_job(self, namespace, name, job_id=""):
         conn = self._conn()
         if job_id:
@@ -339,6 +353,7 @@ class SQLiteBackend(ObjectBackend, EventBackend):
         row = cur.fetchone()
         return JobRecord.from_row(dict(row)) if row else None
 
+    @_locked
     def list_jobs(self, query: Query) -> list:
         where, args = ["1=1"], []
         if query.job_id:
@@ -368,6 +383,7 @@ class SQLiteBackend(ObjectBackend, EventBackend):
             sql += f" LIMIT {int(query.page_size)} OFFSET {(query.page_num - 1) * int(query.page_size)}"
         return [JobRecord.from_row(dict(r)) for r in conn.execute(sql, args)]
 
+    @_locked
     def stop_job(self, namespace, name, job_id=""):
         rec = self.get_job(namespace, name, job_id)
         if rec is not None:
@@ -375,6 +391,7 @@ class SQLiteBackend(ObjectBackend, EventBackend):
                 conn.execute("UPDATE jobs SET status='Stopped' WHERE job_id=?",
                              (rec.job_id,))
 
+    @_locked
     def delete_job(self, namespace, name, job_id=""):
         rec = self.get_job(namespace, name, job_id)
         if rec is not None:
@@ -385,6 +402,7 @@ class SQLiteBackend(ObjectBackend, EventBackend):
 
     # -- pods -------------------------------------------------------------
 
+    @_locked
     def save_pod(self, rec: PodRecord) -> None:
         conn = self._conn()
         row = rec.to_row()
@@ -398,6 +416,7 @@ class SQLiteBackend(ObjectBackend, EventBackend):
         with conn:
             conn.execute(*_upsert("pods", "pod_id", row))
 
+    @_locked
     def list_pods(self, namespace, job_name, job_id) -> list:
         conn = self._conn()
         cur = conn.execute(
@@ -405,6 +424,7 @@ class SQLiteBackend(ObjectBackend, EventBackend):
             "ORDER BY replica_type, name", (namespace, job_id))
         return [PodRecord.from_row(dict(r)) for r in cur]
 
+    @_locked
     def stop_pod(self, namespace, name, pod_id):
         with self._conn() as conn:
             conn.execute(
@@ -413,10 +433,12 @@ class SQLiteBackend(ObjectBackend, EventBackend):
 
     # -- notebooks --------------------------------------------------------
 
+    @_locked
     def save_notebook(self, rec: NotebookRecord) -> None:
         with self._conn() as conn:
             conn.execute(*_upsert("notebooks", "notebook_id", rec.to_row()))
 
+    @_locked
     def list_notebooks(self, query: Query) -> list:
         where, args = ["1=1"], []
         if query.name:
@@ -436,6 +458,7 @@ class SQLiteBackend(ObjectBackend, EventBackend):
             sql += f" LIMIT {int(query.page_size)} OFFSET {(query.page_num - 1) * int(query.page_size)}"
         return [NotebookRecord.from_row(dict(r)) for r in conn.execute(sql, args)]
 
+    @_locked
     def delete_notebook(self, namespace, name, notebook_id=""):
         with self._conn() as conn:
             if notebook_id:
@@ -448,10 +471,12 @@ class SQLiteBackend(ObjectBackend, EventBackend):
 
     # -- events -----------------------------------------------------------
 
+    @_locked
     def save_event(self, rec: EventRecord) -> None:
         with self._conn() as conn:
             conn.execute(*_upsert("events", "obj_uid, name", rec.to_row()))
 
+    @_locked
     def list_events(self, obj_namespace, obj_name, obj_uid="",
                     from_time="", to_time="") -> list:
         where = ["obj_namespace=?", "obj_name=?"]

@@ -1,0 +1,215 @@
+"""Console backend: auth, job REST surface, proxy fallback to persisted
+records, cluster endpoints — driven over real HTTP against the standalone
+control plane (reference console/backend handler tests)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from kubedl_tpu.api import common as c
+from kubedl_tpu.console import ConsoleConfig, ConsoleServer, DataProxy
+from kubedl_tpu.controllers.registry import OperatorConfig, build_operator
+from kubedl_tpu.core import meta as m
+from kubedl_tpu.storage.backends import Query
+
+
+class Client:
+    """Tiny cookie-holding HTTP client."""
+
+    def __init__(self, base):
+        self.base = base
+        self.cookie = None
+
+    def req(self, method, path, body=None, raw=False):
+        req = urllib.request.Request(self.base + path, method=method)
+        if self.cookie:
+            req.add_header("Cookie", self.cookie)
+        data = None
+        if body is not None:
+            data = body.encode() if isinstance(body, str) else json.dumps(body).encode()
+            req.add_header("Content-Type", "application/json")
+        try:
+            with urllib.request.urlopen(req, data=data) as res:
+                cookie = res.headers.get("Set-Cookie")
+                if cookie:
+                    self.cookie = cookie.split(";")[0]
+                text = res.read().decode()
+                status = res.status
+        except urllib.error.HTTPError as e:
+            text, status = e.read().decode(), e.code
+        if raw:
+            return status, text
+        return status, json.loads(text) if text else {}
+
+
+@pytest.fixture
+def stack(api):
+    op = build_operator(api, OperatorConfig(
+        workloads=["PyTorchJob", "TFJob", "JAXJob"],
+        object_storage="sqlite", event_storage="sqlite"))
+    proxy = DataProxy(api, op.object_backend, op.event_backend)
+    server = ConsoleServer(proxy, ConsoleConfig(port=0))
+    server.start()
+    client = Client(server.url)
+    yield op, client
+    server.stop()
+
+
+def login(client):
+    status, _ = client.req("POST", "/api/v1/login",
+                           {"username": "admin", "password": "kubedl"})
+    assert status == 200
+
+
+PJ = {
+    "apiVersion": "training.kubedl.io/v1alpha1", "kind": "PyTorchJob",
+    "metadata": {"name": "web-job", "namespace": "default"},
+    "spec": {"pytorchReplicaSpecs": {"Master": {
+        "replicas": 1, "restartPolicy": "Never",
+        "template": {"spec": {"containers": [
+            {"name": "pytorch", "image": "img", "ports": [
+                {"name": "pytorchjob-port", "containerPort": 23456}]}]}}}}},
+}
+
+
+def test_auth_flow(stack):
+    op, client = stack
+    status, body = client.req("GET", "/api/v1/job/list")
+    assert status == 401
+    status, _ = client.req("POST", "/api/v1/login",
+                           {"username": "admin", "password": "wrong"})
+    assert status == 401
+    login(client)
+    status, body = client.req("GET", "/api/v1/current-user")
+    assert status == 200 and body["data"]["loginId"] == "admin"
+    status, _ = client.req("POST", "/api/v1/logout")
+    assert status == 200
+    status, _ = client.req("GET", "/api/v1/job/list")
+    assert status == 401
+
+
+def test_job_lifecycle_over_http(stack):
+    op, client = stack
+    login(client)
+
+    # submit (JSON body)
+    status, body = client.req("POST", "/api/v1/job/submit", PJ)
+    assert status == 200, body
+    op.run_until_idle(max_iterations=80)
+
+    # list + detail
+    status, body = client.req("GET", "/api/v1/job/list?kind=PyTorchJob")
+    assert status == 200
+    assert body["data"]["total"] == 1
+    assert body["data"]["jobInfos"][0]["name"] == "web-job"
+
+    status, body = client.req("GET", "/api/v1/job/detail?kind=PyTorchJob"
+                                     "&namespace=default&name=web-job")
+    assert status == 200
+    detail = body["data"]
+    assert detail["job"]["metadata"]["name"] == "web-job"
+    assert len(detail["pods"]) == 1
+    assert any(e["reason"] for e in detail["events"])
+
+    # yaml + statistics
+    status, text = client.req("GET", "/api/v1/job/yaml/default/web-job", raw=True)
+    assert status == 200 and "PyTorchJob" in text
+    status, body = client.req("GET", "/api/v1/job/statistics")
+    assert body["data"]["total"] == 1
+
+    # stop: gone from api-server, still listed from the persistence mirror
+    status, _ = client.req("POST", "/api/v1/job/stop",
+                           {"kind": "PyTorchJob", "namespace": "default",
+                            "name": "web-job"})
+    assert status == 200
+    op.run_until_idle(max_iterations=80)
+    assert op.api.try_get("PyTorchJob", "default", "web-job") is None
+    status, body = client.req("GET", "/api/v1/job/list")
+    assert body["data"]["total"] == 1
+    rec = body["data"]["jobInfos"][0]
+    assert rec["status"] == "Stopped" and rec["is_in_etcd"] == 0
+
+
+def test_submit_rejects_bad_manifest(stack):
+    op, client = stack
+    login(client)
+    status, body = client.req("POST", "/api/v1/job/submit",
+                              {"kind": "Pod", "metadata": {"name": "x"}})
+    assert status == 400
+    status, body = client.req("POST", "/api/v1/job/submit", "not: [valid")
+    assert status == 400
+
+
+def test_yaml_submit_and_events_logs(stack):
+    op, client = stack
+    login(client)
+    yaml_manifest = """
+apiVersion: training.kubedl.io/v1alpha1
+kind: TFJob
+metadata:
+  name: tf-yaml
+spec:
+  tfReplicaSpecs:
+    Worker:
+      replicas: 1
+      restartPolicy: Never
+      template:
+        spec:
+          containers:
+            - name: tensorflow
+              image: tf:latest
+              ports:
+                - name: tfjob-port
+                  containerPort: 2222
+"""
+    status, body = client.req("POST", "/api/v1/job/submit", yaml_manifest)
+    assert status == 200, body
+    op.run_until_idle(max_iterations=80)
+    status, body = client.req("GET", "/api/v1/event/events/default/tf-yaml")
+    assert status == 200 and body["data"]
+    # pseudo-logs from the pod's event stream
+    pod = op.api.list("Pod")[0]
+    status, body = client.req("GET", f"/api/v1/log/logs/default/{m.name(pod)}")
+    assert status == 200
+
+
+def test_cluster_endpoints(stack):
+    op, client = stack
+    login(client)
+    node = m.new_obj("v1", "Node", "tpu-node-0", labels={
+        "cloud.google.com/gke-tpu-topology": "2x2x1"})
+    node["status"] = {"allocatable": {"cpu": "96", "memory": "384Gi",
+                                      "google.com/tpu": "4"}}
+    op.api.create(node)
+    status, body = client.req("GET", "/api/v1/data/total")
+    assert status == 200
+    assert body["data"]["nodes"] == 1
+    assert body["data"]["total"]["google.com/tpu"] == 4.0
+    status, body = client.req("GET", "/api/v1/data/nodeInfos")
+    assert body["data"][0]["name"] == "tpu-node-0"
+    status, body = client.req("GET", "/api/v1/data/request/Running")
+    assert status == 200
+
+
+def test_frontend_served(stack):
+    op, client = stack
+    status, text = client.req("GET", "/", raw=True)
+    assert status == 200 and "kubedl-tpu" in text
+    # SPA fallback for client-side routes
+    status, text = client.req("GET", "/jobs", raw=True)
+    assert status == 200 and "kubedl-tpu" in text
+
+
+def test_proxy_merges_live_and_persisted(api):
+    op = build_operator(api, OperatorConfig(
+        workloads=["PyTorchJob"], object_storage="memory"))
+    proxy = DataProxy(api, op.object_backend, op.event_backend)
+    api.create(dict(PJ))
+    op.run_until_idle(max_iterations=80)
+    q = Query()
+    assert len(proxy.list_jobs(q)) == 1
+    api.delete("PyTorchJob", "default", "web-job")
+    op.run_until_idle(max_iterations=80)
+    rows = proxy.list_jobs(Query())
+    assert len(rows) == 1 and rows[0].is_in_etcd == 0
