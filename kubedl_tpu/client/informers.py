@@ -47,14 +47,23 @@ class Informer:
     # -- lifecycle --------------------------------------------------------
 
     def start(self) -> None:
-        """Subscribe to the watch stream and sync the initial list."""
+        """Subscribe to the watch stream and sync the initial list.
+
+        The API-server calls (watch/list) run *outside* the informer lock:
+        holding it across them would deadlock against a concurrent writer
+        whose watch fan-out blocks on this lock (ABBA with the store lock).
+        """
         with self._lock:
             if self._cancel is not None:
                 return
             self._cancel = self.api.watch(self._on_event)
-            for obj in self.api.list(self.kind):
-                self._cache[(m.namespace(obj), m.name(obj))] = obj
-                self._dispatch("add", None, obj)
+        snapshot = self.api.list(self.kind)
+        with self._lock:
+            for obj in snapshot:
+                key = (m.namespace(obj), m.name(obj))
+                if key not in self._cache:  # the watch may have raced ahead
+                    self._cache[key] = obj
+                    self._dispatch("add", None, obj)
             self._synced = True
 
     def stop(self) -> None:

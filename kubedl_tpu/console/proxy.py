@@ -17,7 +17,8 @@ from ..client.clientset import TRAINING_KINDS
 from ..core import meta as m
 from ..core.apiserver import APIServer
 from ..storage import dmo
-from ..storage.backends import EventBackend, ObjectBackend, Query, _match
+from ..storage.backends import (EventBackend, ObjectBackend, Query, _match,
+                                _paginate)
 
 
 class DataProxy:
@@ -49,11 +50,7 @@ class DataProxy:
                 Query(**{**query.__dict__, "page_num": 0, "page_size": 0}))
             rows.extend(r for r in persisted if r.job_id not in live)
         rows.sort(key=lambda r: r.gmt_created, reverse=True)
-        query.count = len(rows)
-        if query.page_num > 0 and query.page_size > 0:
-            lo = (query.page_num - 1) * query.page_size
-            rows = rows[lo:lo + query.page_size]
-        return rows
+        return _paginate(rows, query)
 
     def get_job(self, kind: str, namespace: str, name: str) -> Optional[dict]:
         """The live CR when present, else a record-shaped stub."""
@@ -129,12 +126,15 @@ class DataProxy:
         for obj in self.api.list("Notebook"):
             rec = dmo.notebook_to_record(obj)
             live[rec.notebook_id] = rec
-        rows = list(live.values())
+        rows = [r for r in live.values()
+                if _match(r, query, kind_field=False)]
         if self.object_backend is not None:
-            rows.extend(r for r in self.object_backend.list_notebooks(Query())
-                        if r.notebook_id not in live)
+            rows.extend(
+                r for r in self.object_backend.list_notebooks(
+                    Query(**{**query.__dict__, "page_num": 0, "page_size": 0}))
+                if r.notebook_id not in live)
         rows.sort(key=lambda r: r.gmt_created, reverse=True)
-        return rows
+        return _paginate(rows, query)
 
     # -- cluster ----------------------------------------------------------
 

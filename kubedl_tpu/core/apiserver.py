@@ -201,13 +201,21 @@ class APIServer:
         """Strategic-ish merge patch: dicts merge recursively, lists replace.
 
         Mirrors the reference's patch utilities (``pkg/util/patch``) used for
-        annotation updates in the elastic-checkpoint protocol.
+        annotation updates in the elastic-checkpoint protocol. Retry-on-
+        conflict rather than holding the store lock across ``update`` —
+        emitting watch events under the lock would deadlock subscribers
+        that take their own lock before reading the store (real api-server
+        patches are optimistic for the same reason).
         """
-        with self._lock:
+        for _ in range(10):
             cur = self.get(kind, namespace, name)
             merged = _merge(cur, copy.deepcopy(patch))
             m.meta(merged)["resourceVersion"] = m.resource_version(cur)
-            return self.update(merged)
+            try:
+                return self.update(merged)
+            except Conflict:
+                continue
+        raise Conflict(f"patch of {kind} {namespace}/{name} kept conflicting")
 
     def delete(self, kind: str, namespace: str, name: str) -> None:
         with self._lock:

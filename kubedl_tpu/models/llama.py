@@ -30,6 +30,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..ops.attention import multi_head_attention
+from ..parallel.ring import ring_attention
 from ..parallel.sharding import spec
 
 
@@ -182,7 +183,8 @@ def apply_rope(x, cos, sin):
     return out.astype(x.dtype)
 
 
-def _layer_forward(config: LlamaConfig, x, lp, cos, sin, segment_ids):
+def _layer_forward(config: LlamaConfig, x, lp, cos, sin, segment_ids,
+                   mesh=None):
     c = config
     b, s, d = x.shape
     nh, nkv, hd = c.n_heads, c.n_kv_heads, c.hd
@@ -194,7 +196,13 @@ def _layer_forward(config: LlamaConfig, x, lp, cos, sin, segment_ids):
     v = (h @ lp["wv"]).reshape(b, s, nkv, hd)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
-    attn = multi_head_attention(q, k, v, causal=True, segment_ids=segment_ids)
+    if mesh is not None and mesh.shape.get("cp", 1) > 1 and segment_ids is None:
+        # sequence sharded on cp: ring attention keeps the full-sequence
+        # attention exact while K/V blocks rotate over ICI
+        attn = ring_attention(mesh, q, k, v, causal=True)
+    else:
+        attn = multi_head_attention(q, k, v, causal=True,
+                                    segment_ids=segment_ids)
     x = x + (attn.reshape(b, s, nh * hd) @ lp["wo"])
 
     # -- SwiGLU MLP
@@ -205,8 +213,12 @@ def _layer_forward(config: LlamaConfig, x, lp, cos, sin, segment_ids):
 
 
 def forward(config: LlamaConfig, params: dict, tokens,
-            positions=None, segment_ids=None):
-    """tokens [b, s] int32 -> logits [b, s, vocab] float32."""
+            positions=None, segment_ids=None, mesh=None):
+    """tokens [b, s] int32 -> logits [b, s, vocab] float32.
+
+    ``mesh`` (optional, static): enables ring attention when the mesh has a
+    non-trivial ``cp`` axis; without it the sequence must fit one device's
+    attention window."""
     c = config
     b, s = tokens.shape
     if positions is None:
@@ -215,7 +227,7 @@ def forward(config: LlamaConfig, params: dict, tokens,
 
     x = params["embed"][tokens].astype(c.dtype)
 
-    body = partial(_layer_forward, c)
+    body = partial(_layer_forward, c, mesh=mesh)
     if c.remat:
         body = jax.checkpoint(
             body, policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims)
@@ -233,9 +245,9 @@ def forward(config: LlamaConfig, params: dict, tokens,
 
 
 def loss_fn(config: LlamaConfig, params: dict, tokens, targets,
-            mask=None) -> jnp.ndarray:
+            mask=None, mesh=None) -> jnp.ndarray:
     """Next-token cross-entropy, mean over unmasked targets."""
-    logits = forward(config, params, tokens)
+    logits = forward(config, params, tokens, mesh=mesh)
     logz = jax.nn.logsumexp(logits, axis=-1)
     gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
     nll = logz - gold

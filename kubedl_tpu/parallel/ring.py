@@ -1,0 +1,111 @@
+"""Ring attention: context parallelism over the ``cp`` mesh axis.
+
+Long-context training is first-class in this framework (SURVEY.md §5 notes
+the reference delegates it to user containers; here the user container IS
+the framework). With the sequence axis sharded on ``cp``, full attention
+needs every (query, key) pair — the ring algorithm (Liu et al., 2023)
+computes it without ever materializing the full sequence on one device:
+
+* each device holds one sequence shard of Q, K, V;
+* K/V blocks rotate around the ring via ``lax.ppermute`` (neighbor
+  exchange on ICI — the cheapest collective there is) while Q stays put;
+* per-block partial attention is merged with the online-softmax update
+  (the same math as the flash kernel in ``kubedl_tpu.ops.attention``,
+  applied across devices instead of across VMEM tiles);
+* compute and the next block's transfer overlap inside one ``lax.scan``
+  step, so the ring latency hides behind the matmuls for realistic sizes.
+
+Causal jobs skip nothing structurally (SPMD needs uniform control flow)
+but fully-masked blocks contribute zeros, and the per-block mask is built
+from *global* positions so the sharded result matches the unsharded one
+bit-for-bit in float32.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+_NEG_INF = -1e30
+
+
+def _repeat_kv(x, q_heads: int):
+    """[b, s, nkv, hd] -> [b, s, q_heads, hd] (GQA head grouping)."""
+    nkv = x.shape[2]
+    if nkv == q_heads:
+        return x
+    return jnp.repeat(x, q_heads // nkv, axis=2)
+
+
+def ring_attention_p(q, k, v, axis_name: str = "cp", causal: bool = True):
+    """Per-shard ring attention; must run under ``shard_map`` with
+    ``axis_name`` bound. q: [b, sq, h, hd]; k/v: [b, sk, nkv, hd] — all
+    *local* sequence shards. Returns [b, sq, h, hd] in q.dtype."""
+    axis_size = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    b, sq, h, hd = q.shape
+    sk = k.shape[1]
+    k = _repeat_kv(k, h)
+    v = _repeat_kv(v, h)
+    qf = q.astype(jnp.float32) * (1.0 / math.sqrt(hd))
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+
+    # derive the running state from qf so it carries qf's varying-axes type
+    # (fresh constants would be replicated and fail shard_map's scan check)
+    o0 = qf * 0.0
+    l0 = jnp.sum(qf, axis=-1).transpose(0, 2, 1) * 0.0  # [b, h, sq]
+    m0 = l0 + _NEG_INF
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+    q_pos = my * sq + jnp.arange(sq)
+
+    def step(carry, i):
+        o, m_run, l_run, k_blk, v_blk = carry
+        # after i rotations we hold the block that started on rank my - i
+        src = (my - i) % axis_size
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, k_blk,
+                       preferred_element_type=jnp.float32)
+        if causal:
+            k_pos = src * sk + jnp.arange(sk)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            s = jnp.where(mask[None, None], s, _NEG_INF)
+        m_new = jnp.maximum(m_run, jnp.max(s, axis=-1))
+        # exp(s - m) is 1, not 0, for rows where everything is masked so
+        # far (m == NEG_INF): zero masked scores explicitly
+        p = jnp.exp(s - m_new[..., None])
+        if causal:
+            p = jnp.where(mask[None, None], p, 0.0)
+        alpha = jnp.exp(m_run - m_new)
+        l_new = l_run * alpha + jnp.sum(p, axis=-1)
+        o_new = (o * alpha.transpose(0, 2, 1)[..., None]
+                 + jnp.einsum("bhqk,bkhd->bqhd", p, v_blk,
+                              preferred_element_type=jnp.float32))
+        # rotate K/V to the next rank; the final rotation returns the
+        # blocks home, keeping the scan carry shape uniform
+        k_next = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_next = jax.lax.ppermute(v_blk, axis_name, perm)
+        return (o_new, m_new, l_new, k_next, v_next), None
+
+    (o, _, l, _, _), _ = jax.lax.scan(
+        step, (o0, m0, l0, kf, vf), jnp.arange(axis_size))
+    l = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return (o / l).astype(q.dtype)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 4, 5))
+def ring_attention(mesh: Mesh, q, k, v, causal: bool = True,
+                   axis_name: str = "cp"):
+    """Sharded entry point: wraps the per-shard kernel in ``shard_map``
+    with the framework's activation layout ([batch, seq, heads, head_dim]
+    → batch on (dp, fsdp), seq on cp, heads on tp)."""
+    spec = P(("dp", "fsdp"), axis_name, "tp", None)
+    fn = jax.shard_map(
+        functools.partial(ring_attention_p, axis_name=axis_name,
+                          causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)

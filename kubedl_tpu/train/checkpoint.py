@@ -1,0 +1,162 @@
+"""Checkpoint/resume: orbax-backed state persistence + the elastic
+2-phase protocol's training side.
+
+The reference operator implements checkpoint *coordination* only — the
+versioned annotations ``ckpt-requested-version`` / ``ckpt-completed-version``
+driven between controller and AIMaster (``controllers/pytorch/
+elastic_scale.go:35-39,118-182``) — and leaves byte-level checkpointing to
+the training container. This framework ships both halves:
+
+* :class:`CheckpointManager` — orbax ``CheckpointManager`` wrapper that
+  saves/restores the sharded :class:`~kubedl_tpu.train.trainer.TrainState`.
+  Restore takes the *target mesh's* shardings, so a checkpoint written on
+  one world size resumes on another (orbax reshards on load) — the
+  mechanism elastic scaling relies on.
+* :class:`ElasticCheckpointAgent` — the in-container AIMaster analog: it
+  watches the job's ``ckpt-requested-version`` annotation, saves, and
+  acknowledges via ``ckpt-completed-version``, closing the loop with the
+  operator's elastic controller (``kubedl_tpu.controllers.workloads.pytorch``).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+from ..api import common as c
+from ..core import meta as m
+
+log = logging.getLogger("kubedl_tpu.checkpoint")
+
+
+@dataclass
+class CheckpointConfig:
+    directory: str
+    save_interval_steps: int = 0     # 0: only explicit save() calls
+    max_to_keep: int = 3
+    async_save: bool = True
+
+
+class CheckpointManager:
+    """Thin orbax wrapper pinned to the framework's TrainState layout."""
+
+    def __init__(self, config: CheckpointConfig):
+        self.config = config
+        self._mngr = ocp.CheckpointManager(
+            config.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=config.max_to_keep,
+                save_interval_steps=max(config.save_interval_steps, 1),
+                enable_async_checkpointing=config.async_save,
+            ))
+
+    def save(self, state, force: bool = False) -> bool:
+        """Save at ``state.step``; respects save_interval unless forced.
+        A step that is already on disk is a no-op (the final forced save
+        after an interval save of the same step)."""
+        if not force and self.config.save_interval_steps <= 0:
+            return False  # interval saves disabled: explicit saves only
+        step = int(jax.device_get(state.step))
+        if step in (self._mngr.all_steps() or []):
+            return False
+        saved = self._mngr.save(step, args=ocp.args.StandardSave(state),
+                                force=force)
+        if saved:
+            log.info("checkpoint saved at step %d", step)
+        return bool(saved)
+
+    def latest_step(self) -> Optional[int]:
+        return self._mngr.latest_step()
+
+    def restore(self, abstract_state, step: Optional[int] = None):
+        """Restore ``step`` (default latest) into the given abstract state
+        — a pytree of ``jax.ShapeDtypeStruct`` with *target* shardings, so
+        world-size changes reshard transparently."""
+        step = self._mngr.latest_step() if step is None else step
+        if step is None:
+            return None
+        return self._mngr.restore(
+            step, args=ocp.args.StandardRestore(abstract_state))
+
+    def restore_or(self, abstract_state, init_fn: Callable):
+        """Resume from the latest checkpoint, else initialize fresh — the
+        one-liner every elastic-restartable training loop needs."""
+        restored = self.restore(abstract_state)
+        if restored is not None:
+            log.info("resumed from checkpoint step %d",
+                     int(jax.device_get(restored.step)))
+            return restored
+        return init_fn()
+
+    def wait_until_finished(self) -> None:
+        self._mngr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mngr.close()
+
+
+def abstract_state_like(state, mesh, param_specs, opt_specs, step_spec=None):
+    """Build the abstract restore target for ``state`` on ``mesh``:
+    ShapeDtypeStructs carrying the *target* NamedShardings."""
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    def abstr(x, sharding):
+        return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sharding)
+
+    from .trainer import TrainState
+    step_sh = NamedSharding(mesh, step_spec or P())
+    return TrainState(
+        step=abstr(state.step, step_sh),
+        params=jax.tree.map(
+            lambda x, s: abstr(x, NamedSharding(mesh, s)),
+            state.params, param_specs),
+        opt_state=jax.tree.map(
+            lambda x, s: abstr(x, NamedSharding(mesh, s)),
+            state.opt_state, opt_specs),
+    )
+
+
+class ElasticCheckpointAgent:
+    """Training-side half of the operator's 2-phase elastic protocol.
+
+    The controller requests a checkpoint by bumping
+    ``kubedl.io/ckpt-requested-version`` on the job (the generation it
+    wants to resize to); this agent saves and acknowledges by writing the
+    same version into ``kubedl.io/ckpt-completed-version``, after which the
+    controller deletes victims and restarts the world
+    (``elastic_scale.go:136-160`` behavior contract).
+    """
+
+    def __init__(self, api, kind: str, namespace: str, name: str,
+                 manager: CheckpointManager):
+        self.api = api
+        self.kind = kind
+        self.namespace = namespace
+        self.name = name
+        self.manager = manager
+        self._acked = 0
+
+    def poll(self, state) -> bool:
+        """Check for an outstanding checkpoint request; save + ack if one
+        is pending. Returns True when a checkpoint was taken."""
+        job = self.api.try_get(self.kind, self.namespace, self.name)
+        if job is None:
+            return False
+        ann = m.annotations(job)
+        requested = int(ann.get(c.ANNOTATION_CKPT_REQUESTED_VERSION, 0) or 0)
+        completed = int(ann.get(c.ANNOTATION_CKPT_COMPLETED_VERSION, 0) or 0)
+        if requested <= max(completed, self._acked):
+            return False
+        self.manager.save(state, force=True)
+        self.manager.wait_until_finished()  # ack only after bytes are down
+        self.api.patch_merge(self.kind, self.namespace, self.name, {
+            "metadata": {"annotations": {
+                c.ANNOTATION_CKPT_COMPLETED_VERSION: str(requested)}}})
+        self._acked = requested
+        log.info("elastic checkpoint v%d taken and acknowledged", requested)
+        return True

@@ -180,7 +180,12 @@ class Trainer:
     # -- loop -------------------------------------------------------------
 
     def fit(self, state: TrainState, batches, num_steps: int,
-            log_every: int = 10, on_step=None):
+            log_every: int = 10, on_step=None, checkpoint_manager=None,
+            elastic_agent=None):
+        """Training loop. ``checkpoint_manager`` saves on its configured
+        interval plus a final save; ``elastic_agent`` is polled each step so
+        operator-requested elastic checkpoints are taken between steps
+        (the AIMaster contract, ``kubedl_tpu.train.checkpoint``)."""
         t0 = time.time()
         tokens = 0
         for i in range(num_steps):
@@ -189,11 +194,25 @@ class Trainer:
             state, loss = self.step(state, batch)
             if on_step is not None:
                 on_step(int(state.step), float(loss))
+            if elastic_agent is not None:
+                elastic_agent.poll(state)
+            if checkpoint_manager is not None:
+                checkpoint_manager.save(state)
             if log_every and (i + 1) % log_every == 0:
                 dt = time.time() - t0
                 print(f"step {int(state.step)} loss {float(loss):.4f} "
                       f"{tokens / dt:.0f} tok/s")
+        if checkpoint_manager is not None:
+            checkpoint_manager.save(state, force=True)
+            checkpoint_manager.wait_until_finished()
         return state
+
+    def abstract_state(self, state: TrainState):
+        """Restore target for this trainer's shardings (see
+        ``checkpoint.abstract_state_like``)."""
+        from .checkpoint import abstract_state_like
+        return abstract_state_like(state, self.mesh, self.param_specs,
+                                   self._opt_specs())
 
 
 def _batch_tokens(batch) -> int:
