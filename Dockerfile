@@ -1,0 +1,15 @@
+# Controller-manager image (reference Dockerfile: two-stage Go build; here
+# a slim Python image carrying the operator — the TPU runtime lives in the
+# *workload* images, not the manager).
+FROM python:3.12-slim AS base
+WORKDIR /app
+COPY kubedl_tpu/ kubedl_tpu/
+COPY config/ config/
+RUN pip install --no-cache-dir pyyaml
+# jax is only needed by workload payloads and the serving runtime; the
+# manager itself runs without it. Install the CPU wheel for the console's
+# cluster-total fallback and local smoke tests.
+RUN pip install --no-cache-dir "jax[cpu]" optax orbax-checkpoint || true
+EXPOSE 8080 9090
+ENTRYPOINT ["python", "-m", "kubedl_tpu"]
+CMD ["--workloads=*", "--console-port=9090"]

@@ -1,0 +1,212 @@
+#!/usr/bin/env python
+"""Generate config/crd/bases/*.yaml for every kind the operator serves.
+
+The analog of the reference's controller-gen output (``config/crd/bases``,
+13 CRDs). Schemas validate the common envelope (replica specs / run policy
+/ tpu policy) and leave pod templates open (``x-kubernetes-
+preserve-unknown-fields``), the same pragmatic depth the reference uses.
+
+Run: ``python hack/gen_crds.py`` (rewrites config/crd/bases).
+"""
+
+from __future__ import annotations
+
+import pathlib
+
+import yaml
+
+OUT = pathlib.Path(__file__).resolve().parent.parent / "config" / "crd" / "bases"
+
+OPEN = {"type": "object", "x-kubernetes-preserve-unknown-fields": True}
+
+REPLICA_SPEC = {
+    "type": "object",
+    "properties": {
+        "replicas": {"type": "integer", "minimum": 0},
+        "restartPolicy": {"type": "string",
+                          "enum": ["Always", "OnFailure", "Never", "ExitCode", ""]},
+        "template": OPEN,
+        "spotReplicaSpec": OPEN,
+        "dependOn": {"type": "array", "items": OPEN},
+    },
+}
+
+RUN_POLICY = {
+    "type": "object",
+    "properties": {
+        "cleanPodPolicy": {"type": "string"},
+        "ttlSecondsAfterFinished": {"type": "integer"},
+        "activeDeadlineSeconds": {"type": "integer"},
+        "backoffLimit": {"type": "integer"},
+        "schedulingPolicy": OPEN,
+        "cronPolicy": OPEN,
+    },
+}
+
+TPU_POLICY = {
+    "type": "object",
+    "properties": {
+        "accelerator": {"type": "string",
+                        "description": "TPU generation (v4/v5e/v5p/v6e) or "
+                                       "full type (v5p-32)"},
+        "acceleratorType": {"type": "string"},
+        "generation": {"type": "string"},
+        "hostChips": {"type": "integer"},
+        "topology": {"type": "string",
+                     "description": "slice topology, e.g. 2x2x4"},
+        "numSlices": {"type": "integer", "minimum": 1},
+        "reserved": {"type": "boolean"},
+    },
+}
+
+STATUS = OPEN
+
+
+def job_schema(replica_field: str, extra_spec: dict | None = None) -> dict:
+    spec_props = {
+        replica_field: {"type": "object",
+                        "additionalProperties": REPLICA_SPEC},
+        "runPolicy": RUN_POLICY,
+        "tpuPolicy": TPU_POLICY,
+        "cacheBackend": OPEN,
+        "modelVersion": OPEN,
+    }
+    spec_props.update(extra_spec or {})
+    return {
+        "type": "object",
+        "properties": {
+            "apiVersion": {"type": "string"},
+            "kind": {"type": "string"},
+            "metadata": {"type": "object"},
+            "spec": {"type": "object", "properties": spec_props},
+            "status": STATUS,
+        },
+    }
+
+
+# kind -> (group, plural, schema, short names)
+TRAINING = {
+    "TFJob": ("tfReplicaSpecs",
+              {"successPolicy": {"type": "string"}}),
+    "PyTorchJob": ("pytorchReplicaSpecs", {}),
+    "JAXJob": ("jaxReplicaSpecs", {}),
+    "MPIJob": ("mpiReplicaSpecs",
+               {"slotsPerWorker": {"type": "integer"},
+                "mainContainer": {"type": "string"}}),
+    "XGBoostJob": ("xgbReplicaSpecs", {}),
+    "XDLJob": ("xdlReplicaSpecs",
+               {"minFinishWorkRate": {"type": "integer"}}),
+    "MarsJob": ("marsReplicaSpecs",
+                {"webHost": {"type": "string"},
+                 "workerMemoryTuningPolicy": OPEN}),
+    "ElasticDLJob": ("elasticdlReplicaSpecs", {}),
+}
+
+PLATFORM = {
+    "Model": ("model.kubedl.io", "models", job_schema("_unused")),
+    "ModelVersion": ("model.kubedl.io", "modelversions", None),
+    "Inference": ("serving.kubedl.io", "inferences", None),
+    "Notebook": ("notebook.kubedl.io", "notebooks", None),
+    "CacheBackend": ("cache.kubedl.io", "cachebackends", None),
+    "Cron": ("apps.kubedl.io", "crons", None),
+}
+
+
+def crd(group: str, kind: str, plural: str, schema: dict,
+        categories=("kubedl",)) -> dict:
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": f"{plural}.{group}"},
+        "spec": {
+            "group": group,
+            "names": {"kind": kind, "listKind": f"{kind}List",
+                      "plural": plural, "singular": kind.lower(),
+                      "categories": list(categories)},
+            "scope": "Namespaced",
+            "versions": [{
+                "name": "v1alpha1",
+                "served": True,
+                "storage": True,
+                "subresources": {"status": {}},
+                "additionalPrinterColumns": [
+                    {"name": "Status", "type": "string",
+                     "jsonPath": ".status.conditions[-1:].type"},
+                    {"name": "Age", "type": "date",
+                     "jsonPath": ".metadata.creationTimestamp"},
+                ],
+                "schema": {"openAPIV3Schema": schema},
+            }],
+        },
+    }
+
+
+def generic_schema(spec: dict | None = None) -> dict:
+    return {
+        "type": "object",
+        "properties": {
+            "apiVersion": {"type": "string"},
+            "kind": {"type": "string"},
+            "metadata": {"type": "object"},
+            "spec": spec or OPEN,
+            "status": STATUS,
+        },
+    }
+
+
+def main() -> None:
+    OUT.mkdir(parents=True, exist_ok=True)
+    written = []
+    for kind, (field, extra) in TRAINING.items():
+        plural = kind.lower() + "s"
+        doc = crd("training.kubedl.io", kind, plural,
+                  job_schema(field, extra))
+        path = OUT / f"training.kubedl.io_{plural}.yaml"
+        path.write_text(yaml.safe_dump(doc, sort_keys=False))
+        written.append(path.name)
+    platform_schemas = {
+        "Model": generic_schema(),
+        "ModelVersion": generic_schema({
+            "type": "object",
+            "properties": {
+                "modelName": {"type": "string"},
+                "createdBy": {"type": "string"},
+                "imageRepo": {"type": "string"},
+                "imageTag": {"type": "string"},
+                "storage": OPEN,
+            }}),
+        "Inference": generic_schema({
+            "type": "object",
+            "properties": {
+                "framework": {"type": "string"},
+                "predictors": {"type": "array", "items": OPEN},
+            }}),
+        "Notebook": generic_schema(),
+        "CacheBackend": generic_schema({
+            "type": "object",
+            "properties": {
+                "mountPath": {"type": "string"},
+                "dataset": OPEN,
+                "cacheEngine": OPEN,
+            }}),
+        "Cron": generic_schema({
+            "type": "object",
+            "properties": {
+                "schedule": {"type": "string"},
+                "concurrencyPolicy": {"type": "string"},
+                "suspend": {"type": "boolean"},
+                "deadline": {"type": "string"},
+                "historyLimit": {"type": "integer"},
+                "template": OPEN,
+            }}),
+    }
+    for kind, (group, plural, _) in PLATFORM.items():
+        doc = crd(group, kind, plural, platform_schemas[kind])
+        path = OUT / f"{group}_{plural}.yaml"
+        path.write_text(yaml.safe_dump(doc, sort_keys=False))
+        written.append(path.name)
+    print(f"wrote {len(written)} CRDs to {OUT}")
+
+
+if __name__ == "__main__":
+    main()

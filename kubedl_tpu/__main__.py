@@ -1,0 +1,121 @@
+"""Controller-manager binary: ``python -m kubedl_tpu``.
+
+The ``main.go`` analog (reference ``main.go:56-129`` + flag surface
+``cmd/options/options.go`` / ``docs/startup_flags.md``): parse flags, build
+the operator over the standalone control plane, optionally start the
+console, then run reconcile workers until signalled.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import sys
+import threading
+
+from .controllers.registry import OperatorConfig, build_operator
+from .core import features as ft
+from .controllers import hostnetwork as hn
+
+
+def parse_args(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        prog="kubedl-tpu",
+        description="TPU-native deep-learning operator")
+    p.add_argument("--workloads", default="*",
+                   help='enabled kinds: "*", "auto", or comma list; '
+                        'prefix "-" disables a kind')
+    p.add_argument("--gang-scheduler-name", default="coscheduler",
+                   help='gang plugin: coscheduler|volcano|kube-batch|"" (off)')
+    p.add_argument("--max-reconciles", type=int, default=4)
+    p.add_argument("--model-image-builder", default="",
+                   help="builder image for ModelVersion image builds")
+    p.add_argument("--feature-gates", default="",
+                   help="comma list, e.g. GangScheduling=true,DAGScheduling=false")
+    p.add_argument("--hostnetwork-port-range", default="",
+                   help="BASE-END, default 20000-30000")
+    p.add_argument("--object-storage", default="",
+                   help='persistence: memory | sqlite | sqlite://<path>')
+    p.add_argument("--event-storage", default="")
+    p.add_argument("--deploy-region", default="")
+    p.add_argument("--dns-domain", default="")
+    p.add_argument("--console-port", type=int, default=0,
+                   help="serve the management console (0 = disabled)")
+    p.add_argument("--metrics-port", type=int, default=8080,
+                   help="Prometheus /metrics (0 = disabled)")
+    p.add_argument("-v", "--verbose", action="store_true")
+    return p.parse_args(argv)
+
+
+def config_from_args(args: argparse.Namespace) -> OperatorConfig:
+    gates = None
+    if args.feature_gates:
+        gates = ft.FeatureGates()
+        gates.parse(args.feature_gates)
+    port_range = hn.DEFAULT_PORT_RANGE
+    if args.hostnetwork_port_range:
+        base, _, end = args.hostnetwork_port_range.partition("-")
+        port_range = (int(base), int(end) - int(base))
+    return OperatorConfig(
+        workloads_spec=args.workloads,
+        gang_scheduler_name=args.gang_scheduler_name,
+        max_reconciles=args.max_reconciles,
+        model_image_builder=args.model_image_builder,
+        feature_gates=gates,
+        hostnetwork_port_range=port_range,
+        object_storage=args.object_storage,
+        event_storage=args.event_storage,
+        deploy_region=args.deploy_region,
+        dns_domain=args.dns_domain,
+    )
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s")
+    log = logging.getLogger("kubedl_tpu")
+
+    operator = build_operator(config=config_from_args(args))
+    log.info("workloads enabled: %s", ", ".join(operator.engines) or "none")
+
+    if args.metrics_port:
+        from .metrics.http import serve_metrics
+        serve_metrics(operator.metrics_registry, port=args.metrics_port)
+        log.info("metrics on :%d/metrics", args.metrics_port)
+
+    console = None
+    if args.console_port:
+        from .console import ConsoleConfig, ConsoleServer, DataProxy
+        proxy = DataProxy(operator.api, operator.object_backend,
+                          operator.event_backend,
+                          job_kinds=tuple(operator.engines))
+        console = ConsoleServer(
+            proxy, ConsoleConfig(host="0.0.0.0", port=args.console_port))
+        console.start()
+        log.info("console on %s", console.url)
+
+    stop = threading.Event()
+
+    def on_signal(signum, frame):
+        log.info("signal %d: shutting down", signum)
+        stop.set()
+
+    signal.signal(signal.SIGINT, on_signal)
+    signal.signal(signal.SIGTERM, on_signal)
+
+    operator.run()
+    log.info("operator running (%d reconcile workers)",
+             max(1, operator.config.max_reconciles))
+    stop.wait()
+
+    operator.manager.stop()
+    if console is not None:
+        console.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -42,6 +42,8 @@ class Informer:
         self._handlers: list[dict] = []
         self._lock = threading.RLock()
         self._synced = False
+        self._syncing = False
+        self._sync_tombstones: set = set()  # deletes seen during initial sync
         self._cancel: Optional[Callable[[], None]] = None
 
     # -- lifecycle --------------------------------------------------------
@@ -56,14 +58,20 @@ class Informer:
         with self._lock:
             if self._cancel is not None:
                 return
+            self._syncing = True
+            self._sync_tombstones.clear()
             self._cancel = self.api.watch(self._on_event)
         snapshot = self.api.list(self.kind)
         with self._lock:
             for obj in snapshot:
                 key = (m.namespace(obj), m.name(obj))
-                if key not in self._cache:  # the watch may have raced ahead
+                # skip keys the watch already saw — including DELETED
+                # events for snapshot objects, which must not resurrect
+                if key not in self._cache and key not in self._sync_tombstones:
                     self._cache[key] = obj
                     self._dispatch("add", None, obj)
+            self._syncing = False
+            self._sync_tombstones.clear()
             self._synced = True
 
     def stop(self) -> None:
@@ -119,6 +127,8 @@ class Informer:
                 else:
                     self._dispatch("update", old, obj)
             elif event_type == "DELETED":
+                if self._syncing:
+                    self._sync_tombstones.add(key)
                 self._cache.pop(key, None)
                 self._dispatch("delete", None, obj)
 

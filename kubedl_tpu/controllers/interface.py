@@ -37,9 +37,16 @@ class TPUPolicy:
     def from_job(cls, job: dict) -> Optional["TPUPolicy"]:
         d = m.get_in(job, "spec", "tpuPolicy")
         if d:
+            # "accelerator" is the friendly alias: a full type ("v5p-32")
+            # or a bare generation ("v5p") paired with topology
+            alias = d.get("accelerator", "")
+            accel = d.get("acceleratorType", "") or (
+                alias if "-" in alias else "")
+            gen = d.get("generation", "") or (
+                alias if alias and "-" not in alias else "")
             return cls(
-                accelerator_type=d.get("acceleratorType", ""),
-                generation=d.get("generation", ""),
+                accelerator_type=accel,
+                generation=gen,
                 topology=d.get("topology", ""),
                 num_slices=int(d.get("numSlices", 1) or 1),
                 host_chips=d.get("hostChips"),

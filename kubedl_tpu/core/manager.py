@@ -65,6 +65,7 @@ class Manager:
         self._by_kind: dict[str, list[Reconciler]] = {}
         self._queue: list[tuple[float, int, Request]] = []  # (ready_at, seq, req)
         self._queued: dict[Request, float] = {}  # req -> earliest ready_at queued
+        self._inflight: set = set()  # keys being reconciled right now
         self._seq = 0
         self._lock = threading.Condition()
         self._stopped = False
@@ -116,33 +117,53 @@ class Manager:
 
     def _pop_ready(self) -> Optional[Request]:
         with self._lock:
-            while self._queue:
-                ready_at, _, req = self._queue[0]
-                if self._queued.get(req) != ready_at:
-                    heapq.heappop(self._queue)  # superseded (stale) entry
-                    continue
-                if ready_at > self._clock():
-                    return None
-                heapq.heappop(self._queue)
-                del self._queued[req]
-                return req
-            return None
+            deferred = []
+            try:
+                while self._queue:
+                    ready_at, _, req = self._queue[0]
+                    if self._queued.get(req) != ready_at:
+                        heapq.heappop(self._queue)  # superseded (stale) entry
+                        continue
+                    if ready_at > self._clock():
+                        return None
+                    heapq.heappop(self._queue)
+                    if req in self._inflight:
+                        # single-reconcile-per-key: another worker is on this
+                        # key right now (controller-runtime semantics — the
+                        # engine's expectations/counters rely on it); defer
+                        del self._queued[req]
+                        deferred.append(req)
+                        continue
+                    del self._queued[req]
+                    self._inflight.add(req)
+                    return req
+                return None
+            finally:
+                for d in deferred:
+                    self._seq += 1
+                    ready = self._clock() + 0.005
+                    self._queued[d] = ready
+                    heapq.heappush(self._queue, (ready, self._seq, d))
 
     def _dispatch(self, req: Request) -> None:
-        for rec in self._by_kind.get(req.kind, []):
-            try:
-                res = rec.reconcile(req)
-            except Exception:
-                n = self._failures.get(req, 0) + 1
-                self._failures[req] = n
-                backoff = min(0.005 * (2 ** n), self._max_retries_backoff)
-                log.error("reconcile %s failed (retry %d in %.3fs):\n%s",
-                          req, n, backoff, traceback.format_exc())
-                self.enqueue(req, after=backoff)
-                continue
-            self._failures.pop(req, None)
-            if res and (res.requeue or res.requeue_after > 0):
-                self.enqueue(req, after=max(res.requeue_after, 0.0))
+        try:
+            for rec in self._by_kind.get(req.kind, []):
+                try:
+                    res = rec.reconcile(req)
+                except Exception:
+                    n = self._failures.get(req, 0) + 1
+                    self._failures[req] = n
+                    backoff = min(0.005 * (2 ** n), self._max_retries_backoff)
+                    log.error("reconcile %s failed (retry %d in %.3fs):\n%s",
+                              req, n, backoff, traceback.format_exc())
+                    self.enqueue(req, after=backoff)
+                    continue
+                self._failures.pop(req, None)
+                if res and (res.requeue or res.requeue_after > 0):
+                    self.enqueue(req, after=max(res.requeue_after, 0.0))
+        finally:
+            with self._lock:
+                self._inflight.discard(req)
 
     def run_until_idle(self, max_iterations: int = 10000,
                        include_delayed: bool = False) -> int:

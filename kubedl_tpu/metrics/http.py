@@ -1,0 +1,39 @@
+"""The ``/metrics`` HTTP endpoint (reference ``pkg/metrics/monitor.go:28``:
+the Prometheus scrape server started from ``main.go:121``)."""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .registry import Registry
+
+
+def serve_metrics(registry: Registry, port: int = 8080,
+                  host: str = "0.0.0.0") -> ThreadingHTTPServer:
+    """Start the scrape endpoint on a daemon thread; returns the server
+    (caller may ``.shutdown()`` it)."""
+
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            if self.path != "/metrics":
+                self.send_response(404)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return
+            body = registry.expose().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    httpd = ThreadingHTTPServer((host, port), Handler)
+    threading.Thread(target=httpd.serve_forever, name="kubedl-metrics",
+                     daemon=True).start()
+    return httpd

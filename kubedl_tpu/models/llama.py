@@ -29,7 +29,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from ..ops.attention import multi_head_attention
+from ..ops.attention import multi_head_attention, repeat_kv
 from ..parallel.ring import ring_attention
 from ..parallel.sharding import spec
 
@@ -242,6 +242,94 @@ def forward(config: LlamaConfig, params: dict, tokens,
 
     x = rms_norm(x, params["final_norm"], c.rms_eps)
     return (x @ params["lm_head"].astype(c.dtype)).astype(jnp.float32)
+
+
+# -- KV-cache inference path -------------------------------------------------
+
+def init_cache(config: LlamaConfig, batch: int, max_len: int,
+               dtype=None) -> dict:
+    """Stacked KV cache [n_layers, b, max_len, n_kv_heads, hd] — the layer
+    axis leads so the decode step scans layers and caches together."""
+    c = config
+    shape = (c.n_layers, batch, max_len, c.n_kv_heads, c.hd)
+    dt = dtype or c.dtype
+    return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+
+def _layer_step(config: LlamaConfig, x, lp, kc, vc, cos, sin, start_pos,
+                valid=None):
+    """Cache-aware layer: write this chunk's K/V at ``start_pos`` and attend
+    against the whole cache with a position mask. Static shapes throughout —
+    the mask, not the shape, encodes how much of the cache is live.
+    ``valid`` [b, max_len] additionally masks cache slots that hold padding
+    (ragged prompt batches)."""
+    c = config
+    b, s, d = x.shape
+    nh, nkv, hd = c.n_heads, c.n_kv_heads, c.hd
+    max_len = kc.shape[1]
+
+    h = rms_norm(x, lp["attn_norm"], c.rms_eps)
+    q = apply_rope((h @ lp["wq"]).reshape(b, s, nh, hd), cos, sin)
+    k = apply_rope((h @ lp["wk"]).reshape(b, s, nkv, hd), cos, sin)
+    v = (h @ lp["wv"]).reshape(b, s, nkv, hd)
+    kc = jax.lax.dynamic_update_slice(kc, k.astype(kc.dtype), (0, start_pos, 0, 0))
+    vc = jax.lax.dynamic_update_slice(vc, v.astype(vc.dtype), (0, start_pos, 0, 0))
+
+    kf = repeat_kv(kc, nh).astype(jnp.float32)
+    vf = repeat_kv(vc, nh).astype(jnp.float32)
+    qf = q.astype(jnp.float32) * (1.0 / math.sqrt(hd))
+    scores = jnp.einsum("bqhd,bkhd->bhqk", qf, kf,
+                        preferred_element_type=jnp.float32)
+    q_pos = start_pos + jnp.arange(s)
+    k_pos = jnp.arange(max_len)
+    mask = (k_pos[None, :] <= q_pos[:, None])[None, None]  # causal prefix
+    if valid is not None:
+        mask = mask & valid[:, None, None, :]
+    scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    attn = jnp.einsum("bhqk,bkhd->bqhd", probs, vf).astype(x.dtype)
+    x = x + (attn.reshape(b, s, nh * hd) @ lp["wo"])
+
+    h = rms_norm(x, lp["mlp_norm"], c.rms_eps)
+    gated = jax.nn.silu((h @ lp["w_gate"]).astype(jnp.float32)).astype(h.dtype)
+    x = x + ((gated * (h @ lp["w_up"])) @ lp["w_down"])
+    return x, kc, vc
+
+
+def forward_step(config: LlamaConfig, params: dict, tokens, cache: dict,
+                 start_pos, valid=None):
+    """Prefill (s = prompt len) or decode (s = 1) step against the KV cache.
+    tokens [b, s] + cache + scalar start_pos -> (last-token logits
+    [b, vocab] float32, updated cache). jit with ``donate_argnums`` on the
+    cache for in-place HBM updates. ``valid`` [b, max_len] marks live cache
+    slots for ragged prompt batches."""
+    c = config
+    b, s = tokens.shape
+    positions = start_pos + jnp.arange(s, dtype=jnp.int32)
+    cos, sin = rope_frequencies(c, positions)
+    x = params["embed"][tokens].astype(c.dtype)
+
+    if c.scan_layers:
+        def scan_step(x, layer):
+            lp, kc, vc = layer
+            x, kc, vc = _layer_step(c, x, lp, kc, vc, cos, sin, start_pos,
+                                    valid)
+            return x, (kc, vc)
+        x, (ks, vs) = jax.lax.scan(
+            scan_step, x, (params["layers"], cache["k"], cache["v"]))
+        new_cache = {"k": ks, "v": vs}
+    else:
+        ks, vs = [], []
+        for i, lp in enumerate(params["layers"]):
+            x, kc, vc = _layer_step(c, x, lp, cache["k"][i], cache["v"][i],
+                                    cos, sin, start_pos, valid)
+            ks.append(kc)
+            vs.append(vc)
+        new_cache = {"k": jnp.stack(ks), "v": jnp.stack(vs)}
+
+    x = rms_norm(x[:, -1:], params["final_norm"], c.rms_eps)
+    logits = (x @ params["lm_head"].astype(c.dtype)).astype(jnp.float32)
+    return logits[:, 0], new_cache
 
 
 def loss_fn(config: LlamaConfig, params: dict, tokens, targets,

@@ -28,8 +28,9 @@ import jax.numpy as jnp
 _NEG_INF = -1e30
 
 
-def _repeat_kv(k, q_heads: int):
-    """[b, s, nkv, hd] -> [b, s, q_heads, hd] by repeating each kv head."""
+def repeat_kv(k, q_heads: int):
+    """[b, s, nkv, hd] -> [b, s, q_heads, hd] by repeating each kv head
+    (blocked GQA grouping); the one shared GQA-expansion helper."""
     b, s, nkv, hd = k.shape
     if nkv == q_heads:
         return k
@@ -40,8 +41,8 @@ def _repeat_kv(k, q_heads: int):
 def reference_attention(q, k, v, causal=True, segment_ids=None):
     """Naive [b, s, h, hd] attention; float32 softmax."""
     b, sq, nh, hd = q.shape
-    k = _repeat_kv(k, nh)
-    v = _repeat_kv(v, nh)
+    k = repeat_kv(k, nh)
+    v = repeat_kv(v, nh)
     scale = 1.0 / math.sqrt(hd)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                         k.astype(jnp.float32)) * scale
@@ -75,8 +76,8 @@ def chunked_attention(q, k, v, causal=True, segment_ids=None,
     """Online-softmax attention, scanning K/V blocks: O(sq*block_k) memory."""
     b, sq, nh, hd = q.shape
     sk = k.shape[1]
-    k = _repeat_kv(k, nh)
-    v = _repeat_kv(v, nh)
+    k = repeat_kv(k, nh)
+    v = repeat_kv(v, nh)
     block_k = min(block_k, sk)
     num_blocks = -(-sk // block_k)
     pad = num_blocks * block_k - sk
@@ -214,7 +215,7 @@ def _flash_forward(q, k, v, causal, block_q=128, block_k=128,
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def _flash_attention(q, k, v, causal, interpret):
     nh = q.shape[2]
-    return _flash_forward(q, _repeat_kv(k, nh), _repeat_kv(v, nh), causal,
+    return _flash_forward(q, repeat_kv(k, nh), repeat_kv(v, nh), causal,
                           interpret=interpret)
 
 

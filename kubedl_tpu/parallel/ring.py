@@ -31,15 +31,9 @@ import jax.numpy as jnp
 from jax.sharding import Mesh
 from jax.sharding import PartitionSpec as P
 
+from ..ops.attention import repeat_kv as _repeat_kv
+
 _NEG_INF = -1e30
-
-
-def _repeat_kv(x, q_heads: int):
-    """[b, s, nkv, hd] -> [b, s, q_heads, hd] (GQA head grouping)."""
-    nkv = x.shape[2]
-    if nkv == q_heads:
-        return x
-    return jnp.repeat(x, q_heads // nkv, axis=2)
 
 
 def ring_attention_p(q, k, v, axis_name: str = "cp", causal: bool = True):
@@ -102,8 +96,26 @@ def ring_attention(mesh: Mesh, q, k, v, causal: bool = True,
                    axis_name: str = "cp"):
     """Sharded entry point: wraps the per-shard kernel in ``shard_map``
     with the framework's activation layout ([batch, seq, heads, head_dim]
-    → batch on (dp, fsdp), seq on cp, heads on tp)."""
-    spec = P(("dp", "fsdp"), axis_name, "tp", None)
+    → batch on (dp, fsdp), seq on cp, heads on tp). K/V heads replicate
+    over tp when GQA/MQA head counts don't divide the tp axis (the GQA
+    repeat inside the kernel then expands from full local kv heads)."""
+    tp = mesh.shape.get("tp", 1)
+    h, nkv = q.shape[2], k.shape[2]
+    if tp == 1 or h % tp:
+        # no tp split (or q heads don't divide it): replicate heads; the
+        # kernel's local GQA repeat sees all kv heads, grouping is global
+        heads = None
+    elif nkv % tp:
+        # q splits over tp but kv doesn't (MQA/GQA with nkv < tp): expand
+        # kv to full q heads first so the blocked head grouping survives
+        # the split — sharding unexpanded kv would pair the wrong groups
+        k = _repeat_kv(k, h)
+        v = _repeat_kv(v, h)
+        heads = "tp"
+    else:
+        # both divide: shard both, blocked local repeat stays aligned
+        heads = "tp"
+    spec = P(("dp", "fsdp"), axis_name, heads, None)
     fn = jax.shard_map(
         functools.partial(ring_attention_p, axis_name=axis_name,
                           causal=causal),

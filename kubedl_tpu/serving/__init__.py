@@ -1,0 +1,16 @@
+"""TPU-native inference runtime.
+
+The payload the Inference CRD deploys (reference
+``controllers/serving/framework/tfserving.go`` points predictors at
+TFServing/Triton images; here the predictor image IS this runtime):
+a KV-cache generation engine over the llama-family models, an HTTP
+prediction server, and a Morphling-style serving auto-configurator
+(reference ``README.md:33-35``).
+"""
+
+from .autoconfig import AutoConfigResult, autoconfigure
+from .engine import GenerateConfig, InferenceEngine
+from .server import InferenceServer, ServerConfig
+
+__all__ = ["AutoConfigResult", "autoconfigure", "GenerateConfig",
+           "InferenceEngine", "InferenceServer", "ServerConfig"]

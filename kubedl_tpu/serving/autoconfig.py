@@ -1,0 +1,59 @@
+"""Morphling-style serving auto-configuration.
+
+The reference README points serving users at Morphling ("auto-configuration
+for ML model serving", ACM SoCC 2021, ``README.md:33-35``) — a search over
+serving configs that maximizes throughput under a latency SLO. This is the
+TPU-native, in-process version: probe candidate batch sizes against the
+live engine (each probe costs one compile + a short measured run) and pick
+the largest-throughput config whose per-token latency meets the SLO.
+
+Used two ways: offline (pick flags before rollout) and by the Inference
+controller's predictor annotation ``kubedl.io/autoconfig`` (batch size is
+written back into the predictor's env).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+from .engine import InferenceEngine
+
+
+@dataclass
+class AutoConfigResult:
+    best_batch: int
+    measurements: list = field(default_factory=list)
+    slo_ms: float = 0.0
+
+    def to_dict(self) -> dict:
+        return {"bestBatch": self.best_batch, "sloMs": self.slo_ms,
+                "measurements": self.measurements}
+
+
+def autoconfigure(engine: InferenceEngine,
+                  batch_candidates: Sequence[int] = (1, 2, 4, 8, 16),
+                  prompt_len: int = 128, new_tokens: int = 16,
+                  latency_slo_ms: Optional[float] = None) -> AutoConfigResult:
+    """Probe each batch size; return the throughput-max config under the
+    SLO (or overall max when no SLO). Stops early when throughput drops —
+    decode is bandwidth-bound, so past saturation bigger batches only add
+    latency (the same unimodal assumption Morphling's search exploits)."""
+    measurements = []
+    best, best_tps = 0, -1.0
+    prev_tps = -1.0
+    for batch in batch_candidates:
+        probe = engine.score_throughput(batch, prompt_len, new_tokens)
+        measurements.append(probe)
+        tps = probe["decode_tokens_per_s"]
+        ok = (latency_slo_ms is None
+              or probe["latency_per_token_ms"] <= latency_slo_ms)
+        if ok and tps > best_tps:
+            best, best_tps = batch, tps
+        if prev_tps > 0 and tps < prev_tps * 0.9:
+            break  # past saturation
+        prev_tps = tps
+    if best == 0:  # nothing met the SLO: smallest batch is closest
+        best = batch_candidates[0]
+    return AutoConfigResult(best_batch=best, measurements=measurements,
+                            slo_ms=latency_slo_ms or 0.0)

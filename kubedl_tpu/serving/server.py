@@ -1,0 +1,136 @@
+"""Inference HTTP server: the predictor container's process.
+
+The reference's Inference controller points predictor Deployments at
+TFServing/Triton images (``controllers/serving/framework/tfserving.go``);
+kubedl-tpu predictors run this server instead. API shape follows the
+TFServing REST convention the console/tooling already speak:
+
+* ``POST /v1/models/{name}:predict`` — body
+  ``{"instances": [{"prompt_tokens": [...], "max_tokens": N}]}`` →
+  ``{"predictions": [{"tokens": [...]}]}``; instances in one request are
+  batched into a single generate call (static-shape bucket);
+* ``GET /v1/models/{name}`` — model status (readiness probe target);
+* ``GET /healthz`` — liveness.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from dataclasses import dataclass
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from .engine import InferenceEngine
+
+
+@dataclass
+class ServerConfig:
+    model_name: str = "model"
+    host: str = "0.0.0.0"
+    port: int = 8501               # TFServing's REST port
+    max_batch: int = 16
+    max_new_tokens: int = 256
+
+
+class InferenceServer:
+    def __init__(self, engine: InferenceEngine,
+                 config: Optional[ServerConfig] = None):
+        self.engine = engine
+        self.config = config or ServerConfig()
+        # one generate at a time: the TPU is serial anyway, and interleaved
+        # donated caches would alias
+        self._gen_lock = threading.Lock()
+        server = self
+
+        class Handler(_Handler):
+            server_ref = server
+
+        self._httpd = ThreadingHTTPServer(
+            (self.config.host, self.config.port), Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        host = self.config.host if self.config.host != "0.0.0.0" else "127.0.0.1"
+        return f"http://{host}:{self.port}"
+
+    def start(self) -> "InferenceServer":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="kubedl-inference", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    # -- request handling --------------------------------------------------
+
+    def predict(self, body: dict) -> dict:
+        instances = body.get("instances") or []
+        if not instances:
+            raise ValueError("no instances")
+        if len(instances) > self.config.max_batch:
+            raise ValueError(
+                f"batch {len(instances)} exceeds max_batch "
+                f"{self.config.max_batch}")
+        prompts = []
+        for inst in instances:
+            toks = inst.get("prompt_tokens")
+            if not isinstance(toks, list) or not toks:
+                raise ValueError("each instance needs prompt_tokens")
+            prompts.append([int(t) for t in toks])
+        max_new = min(int(instances[0].get("max_tokens", 16)),
+                      self.config.max_new_tokens)
+        with self._gen_lock:
+            outs = self.engine.generate(prompts, max_new)
+        return {"predictions": [{"tokens": o} for o in outs]}
+
+    def status(self) -> dict:
+        return {"model_version_status": [{
+            "version": "1", "state": "AVAILABLE",
+            "status": {"error_code": "OK", "error_message": ""}}]}
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_ref: InferenceServer = None
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *a):
+        pass
+
+    def _respond(self, status: int, payload: dict):
+        data = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):
+        cfg = self.server_ref.config
+        if self.path == "/healthz":
+            self._respond(200, {"status": "ok"})
+        elif self.path == f"/v1/models/{cfg.model_name}":
+            self._respond(200, self.server_ref.status())
+        else:
+            self._respond(404, {"error": f"no route {self.path}"})
+
+    def do_POST(self):
+        cfg = self.server_ref.config
+        if self.path != f"/v1/models/{cfg.model_name}:predict":
+            self._respond(404, {"error": f"no route {self.path}"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+            body = json.loads(self.rfile.read(length) or b"{}")
+            self._respond(200, self.server_ref.predict(body))
+        except (ValueError, KeyError, TypeError) as e:
+            self._respond(400, {"error": str(e)})

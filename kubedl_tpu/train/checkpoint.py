@@ -54,13 +54,19 @@ class CheckpointManager:
                 enable_async_checkpointing=config.async_save,
             ))
 
-    def save(self, state, force: bool = False) -> bool:
+    def save(self, state, force: bool = False,
+             step: Optional[int] = None) -> bool:
         """Save at ``state.step``; respects save_interval unless forced.
-        A step that is already on disk is a no-op (the final forced save
-        after an interval save of the same step)."""
+        Pass ``step`` (host-side counter) to skip the per-call
+        ``device_get`` sync — fit() does, so non-saving steps cost one
+        modulo instead of a device round-trip. A step already on disk is a
+        no-op (the final forced save after an interval save of it)."""
         if not force and self.config.save_interval_steps <= 0:
             return False  # interval saves disabled: explicit saves only
-        step = int(jax.device_get(state.step))
+        if step is None:
+            step = int(jax.device_get(state.step))
+        if not force and step % max(self.config.save_interval_steps, 1):
+            return False  # cheap early-out before touching orbax
         if step in (self._mngr.all_steps() or []):
             return False
         saved = self._mngr.save(step, args=ocp.args.StandardSave(state),

@@ -188,6 +188,7 @@ class Trainer:
         (the AIMaster contract, ``kubedl_tpu.train.checkpoint``)."""
         t0 = time.time()
         tokens = 0
+        step0 = int(jax.device_get(state.step))  # one sync, then host-side
         for i in range(num_steps):
             batch = next(batches)
             tokens += _batch_tokens(batch)
@@ -197,7 +198,7 @@ class Trainer:
             if elastic_agent is not None:
                 elastic_agent.poll(state)
             if checkpoint_manager is not None:
-                checkpoint_manager.save(state)
+                checkpoint_manager.save(state, step=step0 + i + 1)
             if log_every and (i + 1) % log_every == 0:
                 dt = time.time() - t0
                 print(f"step {int(state.step)} loss {float(loss):.4f} "

@@ -1,0 +1,150 @@
+"""Inference runtime: KV-cache decode exactness vs the full forward,
+ragged left-padded batches, greedy generation determinism, the HTTP
+prediction server, and the Morphling-style auto-configurator."""
+
+import dataclasses
+import json
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubedl_tpu.models import llama
+from kubedl_tpu.serving import (GenerateConfig, InferenceEngine,
+                                InferenceServer, ServerConfig, autoconfigure)
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = dataclasses.replace(llama.tiny(vocab=199, seq=128),
+                              dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(7))
+    return cfg, params
+
+
+def test_cached_forward_matches_full(model):
+    """Prefill+decode through the cache reproduces the plain forward's
+    next-token logits at every position."""
+    cfg, params = model
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 12), 1, cfg.vocab_size)
+    full = llama.forward(cfg, params, tokens)  # [b, s, vocab]
+
+    cache = llama.init_cache(cfg, 2, 32)
+    # prefill the first 8, then decode 4 more one at a time
+    logits, cache = llama.forward_step(cfg, params, tokens[:, :8], cache,
+                                       jnp.int32(0))
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(full[:, 7]),
+                               rtol=1e-4, atol=1e-4)
+    for i in range(8, 12):
+        logits, cache = llama.forward_step(cfg, params, tokens[:, i:i + 1],
+                                           cache, jnp.int32(i))
+        np.testing.assert_allclose(np.asarray(logits), np.asarray(full[:, i]),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_greedy_generate_matches_argmax_rollout(model):
+    cfg, params = model
+    prompt = [3, 17, 42, 9]
+    engine = InferenceEngine(cfg, params, GenerateConfig(max_len=32))
+    out = engine.generate([prompt], max_new_tokens=5)[0]
+
+    # manual rollout with the full forward
+    toks = list(prompt)
+    expect = []
+    for _ in range(5):
+        logits = llama.forward(cfg, params, jnp.asarray([toks]))
+        nxt = int(jnp.argmax(logits[0, -1]))
+        expect.append(nxt)
+        toks.append(nxt)
+    assert out == expect
+
+
+def test_ragged_batch_left_padding_exact(model):
+    """Short rows in a ragged batch generate exactly what they'd generate
+    alone — left-padding + validity mask + relative RoPE."""
+    cfg, params = model
+    engine = InferenceEngine(cfg, params, GenerateConfig(max_len=32))
+    short, long = [5, 11], [2, 8, 33, 71, 100, 4]
+    together = engine.generate([short, long], max_new_tokens=4)
+    alone = engine.generate([short], max_new_tokens=4)
+    assert together[0] == alone[0]
+    alone_long = engine.generate([long], max_new_tokens=4)
+    assert together[1] == alone_long[0]
+
+
+def test_eos_stops_row(model):
+    cfg, params = model
+    engine = InferenceEngine(cfg, params, GenerateConfig(max_len=32))
+    probe = engine.generate([[3, 17]], max_new_tokens=1)[0]
+    eos = probe[0]
+    engine_eos = InferenceEngine(cfg, params,
+                                 GenerateConfig(max_len=32, eos_id=eos))
+    out = engine_eos.generate([[3, 17]], max_new_tokens=6)[0]
+    assert out == [eos]
+
+
+def test_sampling_temperature(model):
+    cfg, params = model
+    engine = InferenceEngine(cfg, params,
+                             GenerateConfig(max_len=32, temperature=1.0,
+                                            top_k=20))
+    a = engine.generate([[1, 2, 3]], max_new_tokens=8, seed=0)[0]
+    b = engine.generate([[1, 2, 3]], max_new_tokens=8, seed=0)[0]
+    c = engine.generate([[1, 2, 3]], max_new_tokens=8, seed=123)[0]
+    assert a == b            # same seed -> deterministic
+    assert len(a) == 8 and all(0 <= t < cfg.vocab_size for t in a)
+    assert a != c or True    # different seed usually differs (not asserted hard)
+
+
+def test_inference_server(model):
+    cfg, params = model
+    engine = InferenceEngine(cfg, params, GenerateConfig(max_len=64))
+    server = InferenceServer(engine, ServerConfig(
+        model_name="gemma", host="127.0.0.1", port=0)).start()
+    try:
+        with urllib.request.urlopen(server.url + "/healthz") as r:
+            assert json.load(r)["status"] == "ok"
+        with urllib.request.urlopen(server.url + "/v1/models/gemma") as r:
+            assert json.load(r)["model_version_status"][0]["state"] == "AVAILABLE"
+        req = urllib.request.Request(
+            server.url + "/v1/models/gemma:predict", method="POST",
+            data=json.dumps({"instances": [
+                {"prompt_tokens": [3, 17, 42], "max_tokens": 4},
+                {"prompt_tokens": [9, 1], "max_tokens": 4},
+            ]}).encode(), headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req) as r:
+            preds = json.load(r)["predictions"]
+        assert len(preds) == 2
+        assert all(len(p["tokens"]) == 4 for p in preds)
+        # bad request -> 400
+        req = urllib.request.Request(
+            server.url + "/v1/models/gemma:predict", method="POST",
+            data=b'{"instances": [{}]}')
+        try:
+            urllib.request.urlopen(req)
+            raised = False
+        except urllib.error.HTTPError as e:
+            raised = e.code == 400
+        assert raised
+    finally:
+        server.stop()
+
+
+def test_autoconfigure(model):
+    cfg, params = model
+    engine = InferenceEngine(cfg, params, GenerateConfig(max_len=64))
+    result = autoconfigure(engine, batch_candidates=(1, 2),
+                           prompt_len=8, new_tokens=4)
+    assert result.best_batch in (1, 2)
+    assert len(result.measurements) >= 1
+    assert all("decode_tokens_per_s" in p for p in result.measurements)
+    d = result.to_dict()
+    assert d["bestBatch"] == result.best_batch
+
+
+def test_gemma_2b_config_shape():
+    cfg = llama.gemma_2b()
+    assert cfg.n_kv_heads == 1 and cfg.head_dim == 256
+    assert cfg.num_params > 2e9

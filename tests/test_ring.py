@@ -81,3 +81,14 @@ def test_llama_forward_ring_matches_unsharded(cp_mesh):
     ringed = llama.forward(cfg, params, tokens, mesh=cp_mesh)
     np.testing.assert_allclose(np.asarray(ringed), np.asarray(plain),
                                rtol=2e-4, atol=2e-4)
+
+
+def test_ring_mqa_with_tp():
+    """MQA (nkv=1) with tp>1: kv heads can't split over tp; the wrapper
+    pre-expands them so head grouping survives the split."""
+    mesh = build_mesh(MeshConfig(dp=1, fsdp=2, cp=2, tp=2))
+    q, k, v = qkv(h=4, nkv=1)
+    out = ring_attention(mesh, q, k, v, True)
+    ref = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
