@@ -17,6 +17,7 @@ from ..client.clientset import TRAINING_KINDS
 from ..core import meta as m
 from ..core.apiserver import APIServer
 from ..storage import dmo
+from ..utils import quota
 from ..storage.backends import (EventBackend, ObjectBackend, Query, _match,
                                 _paginate)
 
@@ -160,7 +161,7 @@ class DataProxy:
             if pod_phase and phase != pod_phase:
                 continue
             count += 1
-            for key, val in dmo._sum_container_resources(
+            for key, val in quota.pod_request(
                     pod.get("spec", {}) or {}).items():
                 total[key] = total.get(key, 0) + val
         return {"pods": count, "request": total}

@@ -34,23 +34,28 @@ class TPUPolicy:
     host_chips: Optional[int] = None  # force v5e/v6e host machine shape
 
     @classmethod
+    def from_spec(cls, d: dict) -> "TPUPolicy":
+        """Parse a ``tpuPolicy`` spec dict. "accelerator" is the friendly
+        alias: a full type ("v5p-32") or a bare generation ("v5p") paired
+        with topology."""
+        alias = d.get("accelerator", "")
+        accel = d.get("acceleratorType", "") or (
+            alias if "-" in alias else "")
+        gen = d.get("generation", "") or (
+            alias if alias and "-" not in alias else "")
+        return cls(
+            accelerator_type=accel,
+            generation=gen,
+            topology=d.get("topology", ""),
+            num_slices=int(d.get("numSlices", 1) or 1),
+            host_chips=d.get("hostChips"),
+        )
+
+    @classmethod
     def from_job(cls, job: dict) -> Optional["TPUPolicy"]:
         d = m.get_in(job, "spec", "tpuPolicy")
         if d:
-            # "accelerator" is the friendly alias: a full type ("v5p-32")
-            # or a bare generation ("v5p") paired with topology
-            alias = d.get("accelerator", "")
-            accel = d.get("acceleratorType", "") or (
-                alias if "-" in alias else "")
-            gen = d.get("generation", "") or (
-                alias if alias and "-" not in alias else "")
-            return cls(
-                accelerator_type=accel,
-                generation=gen,
-                topology=d.get("topology", ""),
-                num_slices=int(d.get("numSlices", 1) or 1),
-                host_chips=d.get("hostChips"),
-            )
+            return cls.from_spec(d)
         ann = m.meta(job).get("annotations", {}) or {}
         if c.ANNOTATION_TPU_ACCELERATOR in ann or c.ANNOTATION_TPU_TOPOLOGY in ann:
             accel_ann = ann.get(c.ANNOTATION_TPU_ACCELERATOR, "")

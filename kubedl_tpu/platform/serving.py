@@ -339,12 +339,7 @@ class InferenceReconciler(Reconciler):
         if not policy:
             return
         from ..controllers.interface import TPUPolicy
-        spec = TPUPolicy(
-            accelerator_type=policy.get("acceleratorType", ""),
-            generation=policy.get("generation", ""),
-            topology=policy.get("topology", ""),
-            host_chips=policy.get("hostChips"),
-        ).resolve()
+        spec = TPUPolicy.from_spec(policy).resolve()
         if spec.num_hosts != 1:
             raise ValueError(
                 f"inference tpuPolicy must be a single-host slice, got "

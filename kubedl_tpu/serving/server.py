@@ -81,17 +81,19 @@ class InferenceServer:
             raise ValueError(
                 f"batch {len(instances)} exceeds max_batch "
                 f"{self.config.max_batch}")
-        prompts = []
+        prompts, caps = [], []
         for inst in instances:
             toks = inst.get("prompt_tokens")
             if not isinstance(toks, list) or not toks:
                 raise ValueError("each instance needs prompt_tokens")
             prompts.append([int(t) for t in toks])
-        max_new = min(int(instances[0].get("max_tokens", 16)),
-                      self.config.max_new_tokens)
+            caps.append(min(int(inst.get("max_tokens", 16)),
+                            self.config.max_new_tokens))
+        # decode to the longest request, trim per instance to its own cap
         with self._gen_lock:
-            outs = self.engine.generate(prompts, max_new)
-        return {"predictions": [{"tokens": o} for o in outs]}
+            outs = self.engine.generate(prompts, max(caps))
+        return {"predictions": [{"tokens": o[:cap]}
+                                for o, cap in zip(outs, caps)]}
 
     def status(self) -> dict:
         return {"model_version_status": [{

@@ -307,6 +307,10 @@ class SQLiteBackend(ObjectBackend, EventBackend):
     def _conn(self) -> sqlite3.Connection:
         with self._lock:
             if self._connection is None:
+                if self.path != ":memory:":
+                    import os
+                    parent = os.path.dirname(os.path.abspath(self.path))
+                    os.makedirs(parent, exist_ok=True)
                 conn = sqlite3.connect(self.path, check_same_thread=False)
                 conn.row_factory = sqlite3.Row
                 conn.executescript(_SCHEMA)

@@ -17,6 +17,8 @@ from typing import Optional
 
 from ..api import common as c
 from ..core import meta as m
+from ..utils.quota import parse_quantity, pod_request
+from ..utils.tenancy import get_tenancy
 
 #: record not deleted / deleted markers (reference dmo.Job.Deleted tinyint)
 NOT_DELETED = 0
@@ -31,49 +33,6 @@ def _latest_condition(status: dict) -> str:
         if cond.get("status", "True") == "True":
             return cond.get("type", c.JOB_CREATED)
     return c.JOB_CREATED
-
-
-def _sum_container_resources(pod_spec: dict) -> dict:
-    """Aggregate resource requests across containers (reference
-    ``pkg/util/resource_utils/resources.go``): per-resource max(requests,
-    limits) summed over containers, plus the max over init containers."""
-    total: dict[str, float] = {}
-
-    def add(res: dict, into: dict):
-        req = dict(res.get("requests", {}) or {})
-        for k, v in (res.get("limits", {}) or {}).items():
-            if k not in req:
-                req[k] = v
-        for k, v in req.items():
-            into[k] = into.get(k, 0) + parse_quantity(v)
-
-    for ct in pod_spec.get("containers", []) or []:
-        add(ct.get("resources", {}) or {}, total)
-    init_max: dict[str, float] = {}
-    for ct in pod_spec.get("initContainers", []) or []:
-        one: dict[str, float] = {}
-        add(ct.get("resources", {}) or {}, one)
-        for k, v in one.items():
-            init_max[k] = max(init_max.get(k, 0), v)
-    for k, v in init_max.items():
-        total[k] = max(total.get(k, 0), v)
-    return total
-
-
-def parse_quantity(v) -> float:
-    """Parse a k8s resource quantity ("2", "500m", "10Gi") to a float in
-    base units (cores / bytes / chips)."""
-    if isinstance(v, (int, float)):
-        return float(v)
-    s = str(v).strip()
-    suffixes = {
-        "m": 1e-3, "k": 1e3, "M": 1e6, "G": 1e9, "T": 1e12, "P": 1e15,
-        "Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40, "Pi": 2**50,
-    }
-    for suf in ("Ki", "Mi", "Gi", "Ti", "Pi", "m", "k", "M", "G", "T", "P"):
-        if s.endswith(suf):
-            return float(s[: -len(suf)]) * suffixes[suf]
-    return float(s)
 
 
 @dataclass
@@ -207,15 +166,12 @@ def job_to_record(job: dict, region: str = "") -> JobRecord:
         pod_spec = m.get_in(spec, "template", "spec", default={}) or {}
         resources[rtype] = {
             "replicas": spec.get("replicas", 1),
-            "resources": _sum_container_resources(pod_spec),
+            "resources": pod_request(pod_spec),
         }
-    tenancy = {}
-    raw_tenancy = m.annotations(job).get(c.ANNOTATION_TENANCY_INFO)
-    if raw_tenancy:
-        try:
-            tenancy = json.loads(raw_tenancy)
-        except (ValueError, TypeError):
-            tenancy = {}
+    try:
+        tenancy = get_tenancy(job)
+    except (ValueError, TypeError):
+        tenancy = None
     return JobRecord(
         name=m.name(job),
         namespace=m.namespace(job),
@@ -225,8 +181,8 @@ def job_to_record(job: dict, region: str = "") -> JobRecord:
         status=_latest_condition(status),
         resources=json.dumps(resources, sort_keys=True),
         deploy_region=region,
-        tenant=tenancy.get("tenant", ""),
-        owner=tenancy.get("user", ""),
+        tenant=tenancy.tenant if tenancy else "",
+        owner=tenancy.user if tenancy else "",
         deleted=DELETED if m.is_deleting(job) else NOT_DELETED,
         is_in_etcd=1,
         gmt_created=md.get("creationTimestamp", ""),
@@ -264,9 +220,8 @@ def pod_to_record(pod: dict, region: str = "",
         image=image,
         job_id=ref.get("uid", ""),
         replica_type=m.labels(pod).get(c.LABEL_REPLICA_TYPE, ""),
-        resources=json.dumps(
-            _sum_container_resources(pod.get("spec", {}) or {}),
-            sort_keys=True),
+        resources=json.dumps(pod_request(pod.get("spec", {}) or {}),
+                             sort_keys=True),
         host_ip=status.get("hostIP", "") or "",
         pod_ip=status.get("podIP", "") or "",
         deploy_region=region,

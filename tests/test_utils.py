@@ -1,0 +1,83 @@
+"""Utility parity (reference pkg/util): resource quota math, tenancy
+extraction, the ticket semaphore."""
+
+import threading
+import time
+
+import pytest
+
+from kubedl_tpu.api import common as c
+from kubedl_tpu.core import meta as m
+from kubedl_tpu.utils import quota
+from kubedl_tpu.utils.concurrent import Semaphore
+from kubedl_tpu.utils.tenancy import get_tenancy
+
+
+def test_pod_request_scheduler_rule():
+    pod_spec = {
+        "containers": [
+            {"resources": {"requests": {"cpu": "500m", "memory": "1Gi"}}},
+            {"resources": {"limits": {"cpu": "1", "google.com/tpu": "4"}}},
+        ],
+        "initContainers": [
+            {"resources": {"requests": {"cpu": "2"}}},  # sequential: max wins
+            {"resources": {"requests": {"memory": "512Mi"}}},
+        ],
+    }
+    req = quota.pod_request(pod_spec)
+    # containers: cpu 0.5 + 1 = 1.5, but init cpu 2 > 1.5 -> 2
+    assert req["cpu"] == 2.0
+    assert req["memory"] == 2**30  # 1Gi > 512Mi
+    assert req["google.com/tpu"] == 4.0
+
+
+def test_job_request_and_tpu_chips():
+    specs = {
+        "Worker": {"replicas": 4, "template": {"spec": {"containers": [
+            {"resources": {"limits": {"google.com/tpu": "4", "cpu": "8"}}}]}}},
+        "Master": {"replicas": 1, "template": {"spec": {"containers": [
+            {"resources": {"requests": {"cpu": "1"}}}]}}},
+    }
+    total = quota.job_request(specs)
+    assert total["google.com/tpu"] == 16.0
+    assert total["cpu"] == 33.0
+    assert quota.tpu_chips(specs) == 16
+
+
+def test_tenancy():
+    job = m.new_obj("v1", "TestJob", "t")
+    assert get_tenancy(job) is None
+    m.annotations(job)[c.ANNOTATION_TENANCY_INFO] = (
+        '{"tenant": "a", "user": "bob", "region": "us-east5"}')
+    t = get_tenancy(job)
+    assert t.tenant == "a" and t.user == "bob" and t.region == "us-east5"
+    m.annotations(job)[c.ANNOTATION_TENANCY_INFO] = "not json"
+    with pytest.raises(ValueError):
+        get_tenancy(job)
+
+
+def test_semaphore_bounds_concurrency():
+    sem = Semaphore(2)
+    active = []
+    peak = []
+    lock = threading.Lock()
+
+    def work(i):
+        with lock:
+            active.append(i)
+            peak.append(len(active))
+        time.sleep(0.02)
+        with lock:
+            active.remove(i)
+
+    threads = [sem.go(work, i) for i in range(6)]
+    sem.wait()
+    assert max(peak) <= 2
+    assert not active
+    for t in threads:
+        t.join(timeout=1)
+
+
+def test_semaphore_validates():
+    with pytest.raises(ValueError):
+        Semaphore(0)
