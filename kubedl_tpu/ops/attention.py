@@ -239,8 +239,12 @@ _flash_attention.defvjp(_flash_fwd, _flash_bwd)
 # ---------------------------------------------------------------------------
 
 def _on_tpu() -> bool:
+    """True when the default device is a TPU chip. The axon relay platform
+    proxies a real TPU and lowers pallas through Mosaic, so it counts."""
     try:
-        return jax.devices()[0].platform == "tpu"
+        dev = jax.devices()[0]
+        return (dev.platform in ("tpu", "axon")
+                or "tpu" in (dev.device_kind or "").lower())
     except RuntimeError:
         return False
 

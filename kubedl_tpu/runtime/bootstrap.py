@@ -35,6 +35,22 @@ class RendezvousInfo:
         return self.num_processes > 1
 
 
+def pin_platform(platforms: str) -> None:
+    """Re-pin the live jax platform config (e.g. ``"cpu"``).
+
+    The TPU image's sitecustomize pre-imports jax pinned to the axon relay
+    platform; by the time user code runs, setting ``JAX_PLATFORMS`` is too
+    late — and a wedged relay makes ``jax.devices()`` hang rather than
+    error. Every entry point that needs a specific platform (bench, driver
+    dryrun, CI conftest) calls this one helper before any device query.
+    No-ops once the backend is initialized (jax raises; we swallow)."""
+    import jax
+    try:
+        jax.config.update("jax_platforms", platforms)
+    except Exception:
+        pass
+
+
 def rendezvous_from_env(env: Optional[dict] = None) -> Optional[RendezvousInfo]:
     """Parse the operator contract from the environment; None when absent."""
     env = env if env is not None else dict(os.environ)

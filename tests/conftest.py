@@ -14,9 +14,9 @@ if "xla_force_host_platform_device_count" not in flags:
 
 # The image may pre-import jax with JAX_PLATFORMS=axon (TPU tunnel) via
 # sitecustomize; env vars alone are then too late — override the live config.
-import jax  # noqa: E402
+from kubedl_tpu.runtime.bootstrap import pin_platform  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+pin_platform("cpu")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,43 @@
+"""CI coverage for the driver entrypoints (``__graft_entry__``).
+
+Round 1 lesson (VERDICT.md "What's weak" #1): the exact configuration the
+driver checks — grad through shard_map ring attention (cp=2) inside the
+scanned stack inside the jitted Trainer step — was the one configuration
+the suite skipped, and it timed out in the driver. These tests run that
+exact path with a wall-clock bound.
+"""
+
+import signal
+import sys
+from pathlib import Path
+
+import jax
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import __graft_entry__  # noqa: E402
+
+# generous vs the driver's 300s budget; observed ~15s warm, ~40s cold
+DRYRUN_BOUND_S = 240
+
+
+def test_dryrun_multichip_8_wallclock():
+    # SIGALRM, not a post-hoc timer: a hang (the round-1 failure mode)
+    # must FAIL the test, not stall CI
+    def on_alarm(signum, frame):
+        raise TimeoutError(f"dryrun_multichip(8) exceeded {DRYRUN_BOUND_S}s")
+
+    old = signal.signal(signal.SIGALRM, on_alarm)
+    signal.alarm(DRYRUN_BOUND_S)
+    try:
+        __graft_entry__.dryrun_multichip(8)
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)
+
+
+def test_entry_compiles_single_chip():
+    fn, args = __graft_entry__.entry()
+    out = jax.jit(fn).lower(*args).compile()(*args)
+    assert out.shape == (2, 256, 4096)
+    assert bool(jax.numpy.isfinite(out).all())
