@@ -44,6 +44,19 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="serve the management console (0 = disabled)")
     p.add_argument("--metrics-port", type=int, default=8080,
                    help="Prometheus /metrics (0 = disabled)")
+    # real-cluster mode (reference main.go:81-126: the manager talks to an
+    # actual kube-apiserver; without these flags kubedl-tpu runs its own
+    # standalone in-memory control plane)
+    p.add_argument("--kubeconfig", default="",
+                   help="kubeconfig path: reconcile a real cluster")
+    p.add_argument("--in-cluster", action="store_true",
+                   help="use the pod service account (deployed in-cluster)")
+    p.add_argument("--watch-namespace", default="",
+                   help="restrict watches to one namespace (default: all)")
+    p.add_argument("--enable-leader-election", action="store_true",
+                   help="HA: only the Lease holder reconciles")
+    p.add_argument("--leader-election-namespace", default="kubedl-system")
+    p.add_argument("--leader-election-id", default="kubedl-election")
     p.add_argument("-v", "--verbose", action="store_true")
     return p.parse_args(argv)
 
@@ -78,7 +91,15 @@ def main(argv=None) -> int:
         format="%(asctime)s %(levelname)s %(name)s: %(message)s")
     log = logging.getLogger("kubedl_tpu")
 
-    operator = build_operator(config=config_from_args(args))
+    real_cluster = bool(args.kubeconfig or args.in_cluster)
+    api = None
+    if real_cluster:
+        from .core.kubeclient import ClusterConfig, KubeAPIServer
+        cluster = (ClusterConfig.in_cluster() if args.in_cluster
+                   else ClusterConfig.from_kubeconfig(args.kubeconfig))
+        api = KubeAPIServer(cluster)
+        log.info("real-cluster mode: %s", cluster.server)
+    operator = build_operator(api=api, config=config_from_args(args))
     log.info("workloads enabled: %s", ", ".join(operator.engines) or "none")
 
     if args.metrics_port:
@@ -98,6 +119,7 @@ def main(argv=None) -> int:
         log.info("console on %s", console.url)
 
     stop = threading.Event()
+    lost_leadership = threading.Event()
 
     def on_signal(signum, frame):
         log.info("signal %d: shutting down", signum)
@@ -106,15 +128,52 @@ def main(argv=None) -> int:
     signal.signal(signal.SIGINT, on_signal)
     signal.signal(signal.SIGTERM, on_signal)
 
-    operator.run()
-    log.info("operator running (%d reconcile workers)",
-             max(1, operator.config.max_reconciles))
+    def start_operator():
+        if real_cluster:
+            operator.api.start(sorted(operator.manager.watched_kinds()),
+                               namespace=args.watch_namespace or None)
+        operator.run()
+        log.info("operator running (%d reconcile workers)",
+                 max(1, operator.config.max_reconciles))
+
+    if args.enable_leader_election:
+        from .core.leaderelection import (LeaderElectionConfig,
+                                          LeaderElector)
+        elector = LeaderElector(operator.api, LeaderElectionConfig(
+            namespace=args.leader_election_namespace,
+            name=args.leader_election_id))
+        log.info("leader election enabled (%s/%s as %s)",
+                 args.leader_election_namespace, args.leader_election_id,
+                 elector.config.identity)
+
+        def on_lost():
+            # a demoted replica must not keep reconciling: exit non-zero
+            # so the pod restarts into a fresh candidate
+            lost_leadership.set()
+            stop.set()
+
+        elector_thread = threading.Thread(
+            target=elector.run, args=(stop,),
+            kwargs={"on_started_leading": start_operator,
+                    "on_stopped_leading": on_lost},
+            name="leader-elector", daemon=True)
+        elector_thread.start()
+    else:
+        elector_thread = None
+        start_operator()
     stop.wait()
 
+    if elector_thread is not None:
+        # wait for the graceful lease release (elector.run's final step) —
+        # exiting first would kill it mid-flight and force the successor
+        # to wait out the full lease duration on every rolling restart
+        elector_thread.join(timeout=5.0)
     operator.manager.stop()
+    if real_cluster:
+        operator.api.stop()
     if console is not None:
         console.stop()
-    return 0
+    return 1 if lost_leadership.is_set() else 0
 
 
 if __name__ == "__main__":

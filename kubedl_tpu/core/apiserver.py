@@ -258,6 +258,11 @@ class APIServer:
 
     # -- test/introspection helpers --------------------------------------
 
+    def latest_resource_version(self) -> int:
+        """Current store RV (list+watch consistency for HTTP frontends)."""
+        with self._lock:
+            return self._rv
+
     def kinds(self) -> set:
         with self._lock:
             return {k[0] for k in self._objs}

@@ -1,0 +1,197 @@
+"""Lease-based leader election for HA operator deployments.
+
+The analog of controller-runtime's leader election as enabled in the
+reference (``main.go:81-88``: ``LeaderElection: true, LeaderElectionID:
+"kubedl-election"``): N replicas of the manager run, exactly one reconciles.
+Implemented on ``coordination.k8s.io/v1 Lease`` objects through the
+``APIServer`` interface, so it works identically against a real cluster
+(``KubeAPIServer``) and the in-memory control plane (tests).
+
+Semantics (mirroring client-go's leaderelection package):
+
+* acquire: create the Lease, or take it over when the holder's
+  ``renewTime + leaseDurationSeconds`` has passed;
+* renew: the holder refreshes ``renewTime`` every ``retry_period``;
+  failing to renew within ``renew_deadline`` demotes it;
+* every transition bumps ``leaseTransitions``; optimistic concurrency
+  (Conflict on update) resolves races between candidates.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import socket
+import threading
+import time
+import uuid
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from . import meta as m
+from .apiserver import AlreadyExists, ApiError, Conflict, NotFound
+
+log = logging.getLogger("kubedl_tpu.leaderelection")
+
+DEFAULT_ELECTION_ID = "kubedl-election"   # reference main.go:84
+
+
+def default_identity() -> str:
+    return f"{socket.gethostname()}_{os.getpid()}_{uuid.uuid4().hex[:6]}"
+
+
+@dataclass
+class LeaderElectionConfig:
+    namespace: str = "kubedl-system"
+    name: str = DEFAULT_ELECTION_ID
+    identity: str = ""
+    lease_duration: float = 15.0
+    renew_deadline: float = 10.0
+    retry_period: float = 2.0
+
+    def __post_init__(self):
+        if not self.identity:
+            self.identity = default_identity()
+        if not (self.retry_period < self.renew_deadline < self.lease_duration):
+            raise ValueError(
+                "need retry_period < renew_deadline < lease_duration, got "
+                f"{self.retry_period}/{self.renew_deadline}/{self.lease_duration}")
+
+
+class LeaderElector:
+    def __init__(self, api, config: Optional[LeaderElectionConfig] = None,
+                 clock: Optional[Callable[[], float]] = None):
+        self.api = api
+        self.config = config or LeaderElectionConfig()
+        self._clock = clock or time.time
+        self.is_leader = False
+        self._observed_record: tuple = ()
+        self._observed_at = 0.0
+
+    # -- single protocol step ---------------------------------------------
+
+    def try_acquire_or_renew(self) -> bool:
+        """One election round; returns True while this candidate holds the
+        lease. Never raises on ApiError — an unreachable api-server means
+        'not leader' (and demotion once renew_deadline passes)."""
+        c = self.config
+        now = self._clock()
+        try:
+            lease = self.api.try_get("Lease", c.namespace, c.name)
+            if lease is None:
+                lease = self._new_lease(now)
+                try:
+                    self.api.create(lease)
+                except AlreadyExists:
+                    return False  # lost the creation race; next round reads it
+                log.info("%s acquired lease %s/%s (created)",
+                         c.identity, c.namespace, c.name)
+                self.is_leader = True
+                return True
+
+            spec = lease.setdefault("spec", {})
+            holder = spec.get("holderIdentity", "")
+            duration = float(spec.get("leaseDurationSeconds")
+                             or c.lease_duration)
+
+            if holder == c.identity:
+                spec["renewTime"] = m.rfc3339_micro(now)
+                self.api.update(lease)
+                self.is_leader = True
+                return True
+
+            # client-go semantics: measure expiry purely on OUR clock from
+            # the last time the lease record changed — never against the
+            # holder's renewTime (a skewed holder clock would read as
+            # permanently expired and split-brain the operators)
+            record = (holder, spec.get("renewTime"), spec.get("acquireTime"))
+            if record != self._observed_record:
+                self._observed_record = record
+                self._observed_at = now
+            expired = (now - self._observed_at) > duration
+            if holder and not expired:
+                self.is_leader = False
+                return False
+
+            # stale holder: take over
+            prev_transitions = int(spec.get("leaseTransitions") or 0)
+            spec.update(self._spec(now))
+            spec["leaseTransitions"] = prev_transitions + 1
+            self.api.update(lease)
+            log.info("%s took over lease %s/%s from %r",
+                     c.identity, c.namespace, c.name, holder)
+            self.is_leader = True
+            return True
+        except Conflict:
+            # another candidate won this round's write
+            self.is_leader = False
+            return False
+        except Exception as e:  # noqa: BLE001 — the elector loop must
+            # survive ANY failure (a raised exception would kill the
+            # elector thread silently: the operator keeps reconciling with
+            # no lease while a successor takes over — permanent dual-leader)
+            log.warning("election round failed: %s", e)
+            return False
+
+    def _new_lease(self, now: float) -> dict:
+        c = self.config
+        lease = m.new_obj("coordination.k8s.io/v1", "Lease", c.name,
+                          namespace=c.namespace)
+        lease["spec"] = self._spec(now)
+        return lease
+
+    def _spec(self, now: float) -> dict:
+        c = self.config
+        return {
+            "holderIdentity": c.identity,
+            "leaseDurationSeconds": int(c.lease_duration),
+            "acquireTime": m.rfc3339_micro(now),
+            "renewTime": m.rfc3339_micro(now),
+            "leaseTransitions": 0,
+        }
+
+    # -- blocking loop -----------------------------------------------------
+
+    def run(self, stop: threading.Event,
+            on_started_leading: Optional[Callable[[], None]] = None,
+            on_stopped_leading: Optional[Callable[[], None]] = None) -> None:
+        """Block until leadership is acquired, call ``on_started_leading``,
+        then renew until demoted (→ ``on_stopped_leading``) or ``stop``."""
+        c = self.config
+        while not stop.is_set():
+            if self.try_acquire_or_renew():
+                break
+            stop.wait(c.retry_period)
+        if stop.is_set():
+            return
+        if on_started_leading:
+            on_started_leading()
+        last_renew = self._clock()
+        while not stop.is_set():
+            stop.wait(c.retry_period)
+            if stop.is_set():
+                break
+            if self.try_acquire_or_renew():
+                last_renew = self._clock()
+            elif self._clock() - last_renew > c.renew_deadline:
+                self.is_leader = False
+                log.error("%s lost leadership of %s/%s",
+                          c.identity, c.namespace, c.name)
+                if on_stopped_leading:
+                    on_stopped_leading()
+                return
+        # graceful release so a successor doesn't wait out the lease
+        if self.is_leader:
+            self.release()
+
+    def release(self) -> None:
+        c = self.config
+        try:
+            lease = self.api.try_get("Lease", c.namespace, c.name)
+            if lease and m.get_in(lease, "spec", "holderIdentity") == c.identity:
+                lease["spec"]["holderIdentity"] = ""
+                lease["spec"]["renewTime"] = None
+                self.api.update(lease)
+        except ApiError:
+            pass
+        self.is_leader = False

@@ -80,6 +80,17 @@ class Manager:
         self._by_kind.setdefault(rec.kind, []).append(rec)
         return rec
 
+    def watched_kinds(self) -> set:
+        """Every kind any registered reconciler needs events for — what a
+        real-cluster api adapter must list+watch (``KubeAPIServer.start``)."""
+        kinds = set()
+        for rec in self._reconcilers:
+            kinds.add(rec.kind)
+            kinds.update(rec.owns)
+            kinds.update(rec.watches)
+        kinds.discard("")
+        return kinds
+
     # -- event routing ----------------------------------------------------
 
     def _on_event(self, event_type: str, obj: dict):

@@ -24,6 +24,14 @@ def rfc3339(t: Optional[float] = None) -> str:
                          time.gmtime(time.time() if t is None else t))
 
 
+def rfc3339_micro(t: Optional[float] = None) -> str:
+    """Microsecond-precision RFC3339 — k8s ``metav1.MicroTime`` wire format
+    (Lease acquire/renew times need sub-second resolution)."""
+    from datetime import datetime, timezone
+    dt = datetime.fromtimestamp(time.time() if t is None else t, timezone.utc)
+    return dt.strftime("%Y-%m-%dT%H:%M:%S.%fZ")
+
+
 def parse_rfc3339(ts) -> Optional[float]:
     """Inverse of :func:`rfc3339`, accepting the full RFC3339 surface
     (fractional seconds, ``Z`` or numeric offsets) — a timestamp written by

@@ -1,0 +1,208 @@
+"""A fake kube-apiserver: HTTP REST frontend over the in-memory APIServer.
+
+The envtest analog (reference tests run against controller-runtime's fake
+client; SURVEY.md §4): `KubeAPIServer` — the real-cluster adapter — is
+exercised against this server over actual HTTP, including streaming
+watches, optimistic concurrency, and subresources. It intentionally
+reuses the in-memory ``APIServer`` as its store so both substrates are
+proven equivalent.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlsplit
+
+from kubedl_tpu.core import meta as m
+from kubedl_tpu.core.apiserver import (AlreadyExists, APIServer, ApiError,
+                                       Conflict, Invalid, NotFound)
+from kubedl_tpu.core.kubeclient import DEFAULT_SCHEME
+
+# plural -> kind (plurals are unique across the scheme)
+PLURAL_TO_KIND = {pl: kd for kd, (_, pl) in DEFAULT_SCHEME.items()}
+
+
+class FakeKube:
+    """Wraps an APIServer store with an HTTP frontend on 127.0.0.1:<port>."""
+
+    def __init__(self, api: APIServer = None):
+        self.api = api if api is not None else APIServer()
+        self._events: list[tuple[int, str, dict]] = []  # (rv, type, obj)
+        self._event_cond = threading.Condition()
+        self.api.watch(self._record)
+        handler = _make_handler(self)
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+        self.port = self.httpd.server_address[1]
+        self.url = f"http://127.0.0.1:{self.port}"
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, name="fakekube", daemon=True)
+        self._thread.start()
+
+    def _record(self, etype: str, obj: dict):
+        rv = m.resource_version(obj)
+        with self._event_cond:
+            self._events.append((rv, etype, obj))
+            self._event_cond.notify_all()
+
+    def events_after(self, rv: int, timeout: float):
+        """Yield (rv, type, obj) with rv > given; blocks up to timeout for
+        new ones, then returns."""
+        idx = 0
+        with self._event_cond:
+            while True:
+                while idx < len(self._events):
+                    item = self._events[idx]
+                    idx += 1
+                    if item[0] > rv:
+                        yield item
+                if not self._event_cond.wait(timeout):
+                    return
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def _make_handler(fk: FakeKube):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):  # silence
+            pass
+
+        # -- helpers -------------------------------------------------------
+
+        def _route(self):
+            """Parse /api/v1/... or /apis/{g}/{v}/... into
+            (kind, namespace|None, name|None, subresource|None, params)."""
+            u = urlsplit(self.path)
+            parts = [p for p in u.path.split("/") if p]
+            params = {k: v[0] for k, v in parse_qs(u.query).items()}
+            if parts[:1] == ["api"]:
+                rest = parts[2:]          # strip api/v1
+            elif parts[:1] == ["apis"]:
+                rest = parts[3:]          # strip apis/{group}/{version}
+            else:
+                raise Invalid(f"bad path {u.path}")
+            ns = None
+            if rest[:1] == ["namespaces"] and len(rest) >= 3:
+                ns = rest[1]
+                rest = rest[2:]
+            plural = rest[0]
+            kind = PLURAL_TO_KIND.get(plural)
+            if kind is None:
+                raise NotFound(f"unknown resource {plural}")
+            name = rest[1] if len(rest) > 1 else None
+            sub = rest[2] if len(rest) > 2 else None
+            return kind, ns, name, sub, params
+
+        def _body(self):
+            n = int(self.headers.get("Content-Length") or 0)
+            raw = self.rfile.read(n) if n else b""
+            return json.loads(raw) if raw else None
+
+        def _send(self, code: int, obj):
+            data = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def _send_err(self, e: Exception):
+            code = 500
+            if isinstance(e, NotFound):
+                code = 404
+            elif isinstance(e, (AlreadyExists, Conflict)):
+                code = 409
+            elif isinstance(e, Invalid):
+                code = 422
+            self._send(code, {"kind": "Status", "code": code,
+                              "message": str(e)})
+
+        # -- verbs ---------------------------------------------------------
+
+        def do_GET(self):
+            try:
+                kind, ns, name, _, params = self._route()
+                if name:
+                    return self._send(200, fk.api.get(kind, ns or "default",
+                                                      name))
+                if params.get("watch") == "true":
+                    return self._watch(kind, ns, params)
+                sel = None
+                if params.get("labelSelector"):
+                    sel = dict(kv.split("=", 1)
+                               for kv in params["labelSelector"].split(","))
+                items = fk.api.list(kind, namespace=ns, selector=sel)
+                self._send(200, {
+                    "kind": f"{kind}List",
+                    "metadata": {"resourceVersion":
+                                 str(fk.api.latest_resource_version())},
+                    "items": items})
+            except Exception as e:  # noqa: BLE001
+                self._send_err(e)
+
+        def _watch(self, kind, ns, params):
+            rv = int(params.get("resourceVersion") or 0)
+            timeout = float(params.get("timeoutSeconds") or 30)
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            deadline = timeout
+            try:
+                for erv, etype, obj in fk.events_after(rv, deadline):
+                    if m.kind(obj) != kind:
+                        continue
+                    if ns and m.namespace(obj) != ns:
+                        continue
+                    line = json.dumps({"type": etype, "object": obj}) + "\n"
+                    data = line.encode()
+                    self.wfile.write(f"{len(data):x}\r\n".encode()
+                                     + data + b"\r\n")
+                    self.wfile.flush()
+                self.wfile.write(b"0\r\n\r\n")
+            except (BrokenPipeError, ConnectionResetError):
+                pass
+
+        def do_POST(self):
+            try:
+                kind, ns, _, _, _ = self._route()
+                obj = self._body()
+                if ns:
+                    m.meta(obj)["namespace"] = ns
+                self._send(201, fk.api.create(obj))
+            except Exception as e:  # noqa: BLE001
+                self._send_err(e)
+
+        def do_PUT(self):
+            try:
+                kind, ns, name, sub, _ = self._route()
+                obj = self._body()
+                self._send(200, fk.api.update(obj, subresource=sub))
+            except Exception as e:  # noqa: BLE001
+                self._send_err(e)
+
+        def do_PATCH(self):
+            try:
+                kind, ns, name, _, _ = self._route()
+                patch = self._body()
+                self._send(200, fk.api.patch_merge(kind, ns or "default",
+                                                   name, patch))
+            except Exception as e:  # noqa: BLE001
+                self._send_err(e)
+
+        def do_DELETE(self):
+            try:
+                kind, ns, name, _, _ = self._route()
+                self._body()  # drain DeleteOptions, keep-alive stays in sync
+                fk.api.delete(kind, ns or "default", name)
+                self._send(200, {"kind": "Status", "status": "Success"})
+            except Exception as e:  # noqa: BLE001
+                self._send_err(e)
+
+    return Handler
