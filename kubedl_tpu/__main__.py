@@ -53,6 +53,11 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="use the pod service account (deployed in-cluster)")
     p.add_argument("--watch-namespace", default="",
                    help="restrict watches to one namespace (default: all)")
+    p.add_argument("--webhook-port", type=int, default=0,
+                   help="serve admission webhooks (real-cluster mode; "
+                        "0 = disabled)")
+    p.add_argument("--webhook-cert-dir", default="/tmp/k8s-webhook-server/serving-certs",
+                   help="dir with tls.crt/tls.key (certmanager-mounted)")
     p.add_argument("--enable-leader-election", action="store_true",
                    help="HA: only the Lease holder reconciles")
     p.add_argument("--leader-election-namespace", default="kubedl-system")
@@ -107,6 +112,46 @@ def main(argv=None) -> int:
         serve_metrics(operator.metrics_registry, port=args.metrics_port)
         log.info("metrics on :%d/metrics", args.metrics_port)
 
+    stop = threading.Event()
+    lost_leadership = threading.Event()
+
+    webhook_holder = {}
+    if args.webhook_port:
+        import os
+        from .core.admission import WebhookServer
+        cert = os.path.join(args.webhook_cert_dir, "tls.crt")
+        key = os.path.join(args.webhook_cert_dir, "tls.key")
+
+        def start_webhook_when_certs_ready():
+            # the cert secret is mounted `optional: true`, so the pod can
+            # start before cert-manager issues it. The kube-apiserver only
+            # speaks HTTPS to webhooks; serving plaintext "for now" would
+            # fail every TLS handshake forever and (failurePolicy: Fail)
+            # block all job creates cluster-wide. Wait for the kubelet to
+            # project the issued cert, then serve TLS.
+            while not (os.path.exists(cert) and os.path.exists(key)):
+                if not real_cluster:
+                    # dev/standalone: no certmanager coming; serve plaintext
+                    srv = WebhookServer(operator.admission,
+                                        port=args.webhook_port)
+                    srv.start()
+                    webhook_holder["server"] = srv
+                    log.warning("admission webhooks on :%d PLAINTEXT "
+                                "(standalone dev mode)", srv.port)
+                    return
+                log.info("waiting for webhook serving certs in %s",
+                         args.webhook_cert_dir)
+                if stop.wait(2.0):
+                    return
+            srv = WebhookServer(operator.admission, port=args.webhook_port,
+                                cert_file=cert, key_file=key)
+            srv.start()
+            webhook_holder["server"] = srv
+            log.info("admission webhooks on :%d (tls)", srv.port)
+
+        threading.Thread(target=start_webhook_when_certs_ready,
+                         name="webhook-startup", daemon=True).start()
+
     console = None
     if args.console_port:
         from .console import ConsoleConfig, ConsoleServer, DataProxy
@@ -117,9 +162,6 @@ def main(argv=None) -> int:
             proxy, ConsoleConfig(host="0.0.0.0", port=args.console_port))
         console.start()
         log.info("console on %s", console.url)
-
-    stop = threading.Event()
-    lost_leadership = threading.Event()
 
     def on_signal(signum, frame):
         log.info("signal %d: shutting down", signum)
@@ -173,6 +215,8 @@ def main(argv=None) -> int:
         operator.api.stop()
     if console is not None:
         console.stop()
+    if webhook_holder.get("server") is not None:
+        webhook_holder["server"].stop()
     return 1 if lost_leadership.is_set() else 0
 
 

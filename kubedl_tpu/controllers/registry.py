@@ -72,6 +72,7 @@ class Operator:
     config: "OperatorConfig" = None
     object_backend: object = None
     event_backend: object = None
+    admission: object = None
 
     def run_until_idle(self, **kw):
         return self.manager.run_until_idle(**kw)
@@ -139,6 +140,15 @@ def build_operator(api: Optional[APIServer] = None,
     # control plane (no kube-controller-manager underneath in standalone)
     manager.register(DeploymentReconciler(api))
 
+    # admission chain: defaulting + validation at create/update (reference
+    # config/webhook/ registers the same as webhooks; in standalone mode
+    # the in-memory api-server runs it inline)
+    from ..core.admission import AdmissionChain
+    admission = AdmissionChain.for_operator(
+        {kind: engine.controller for kind, engine in engines.items()})
+    if hasattr(api, "admission"):
+        api.admission = admission
+
     # optional persistence mirror (reference main.go:112-118: storage
     # backends + persist controllers)
     object_backend = _storage_backend(config.object_storage)
@@ -154,7 +164,7 @@ def build_operator(api: Optional[APIServer] = None,
     return Operator(api=api, manager=manager, engines=engines,
                     metrics_registry=registry, config=config,
                     object_backend=object_backend,
-                    event_backend=event_backend)
+                    event_backend=event_backend, admission=admission)
 
 
 def _storage_backend(spec: str, for_events: bool = False):

@@ -59,12 +59,16 @@ _ts = m.rfc3339
 class APIServer:
     """Thread-safe in-memory object store with watch fan-out."""
 
-    def __init__(self, clock: Callable[[], float] = time.time):
+    def __init__(self, clock: Callable[[], float] = time.time,
+                 admission=None):
         self._clock = clock
         self._objs: dict[tuple[str, str, str], Obj] = {}
         self._rv = 0
         self._lock = threading.RLock()
         self._watchers: list[Callable[[str, Obj], None]] = []
+        #: optional AdmissionChain run at create/update (webhook analog:
+        #: defaulting + validation happen at admission, not mid-reconcile)
+        self.admission = admission
 
     # -- helpers ----------------------------------------------------------
 
@@ -104,6 +108,9 @@ class APIServer:
             else:
                 raise Invalid("object has no metadata.name")
         md.setdefault("namespace", "default")
+        if self.admission is not None and self.admission.handles(m.kind(obj)):
+            obj = self.admission.admit(obj)  # raises Invalid on rejection
+            md = m.meta(obj)
         k = self._key(m.kind(obj), md["namespace"], md["name"])
         with self._lock:
             if k in self._objs:
@@ -153,6 +160,9 @@ class APIServer:
         the spec changed.
         """
         obj = copy.deepcopy(obj)
+        if (subresource is None and self.admission is not None
+                and self.admission.handles(m.kind(obj))):
+            obj = self.admission.admit(obj)
         md = m.meta(obj)
         k = self._key(m.kind(obj), md.get("namespace", "default"), md.get("name", ""))
         with self._lock:

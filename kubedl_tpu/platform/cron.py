@@ -16,7 +16,8 @@ from typing import Optional
 
 from ..api import common as c
 from ..core import meta as m
-from ..core.apiserver import AlreadyExists, APIServer, Conflict, NotFound
+from ..core.apiserver import (AlreadyExists, APIServer, Conflict, Invalid,
+                              NotFound)
 from ..core.manager import Reconciler, Request, Result
 from ..utils import cronschedule
 from ..utils import status as st
@@ -212,6 +213,12 @@ class CronReconciler(Reconciler):
             created = self.api.create(wl)
         except AlreadyExists:
             return None  # this fire already spawned (idempotent re-run)
+        except Invalid as e:
+            # template rejected by admission: surface it and move on —
+            # retry-looping would hammer the api-server every backoff tick
+            # with the same doomed create until the user edits the Cron
+            self._event(cron, "Warning", "InvalidWorkloadTemplate", str(e))
+            return None
         self._event(cron, "Normal", "SuccessfulCreate",
                     f"created {m.kind(wl)} {wmeta['name']}")
         return created

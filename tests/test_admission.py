@@ -1,0 +1,226 @@
+"""Admission-time defaulting/validation (VERDICT #5): invalid specs are
+rejected at ``api.create``, not discovered mid-reconcile; the same chain
+serves AdmissionReview for real clusters."""
+
+import base64
+import json
+
+import pytest
+
+from kubedl_tpu.controllers.registry import OperatorConfig, build_operator
+from kubedl_tpu.core import meta as m
+from kubedl_tpu.core.admission import (AdmissionChain, WebhookServer,
+                                       review_response, validate_cron)
+from kubedl_tpu.core.apiserver import APIServer, Invalid
+
+
+def pt_job(name="pj", **spec_extra):
+    spec = {"pytorchReplicaSpecs": {
+        "Worker": {"replicas": 2, "restartPolicy": "Never",
+                   "template": {"spec": {"containers": [
+                       {"name": "pytorch", "image": "x"}]}}}}}
+    spec.update(spec_extra)
+    return {"apiVersion": "training.kubedl.io/v1alpha1", "kind": "PyTorchJob",
+            "metadata": {"name": name, "namespace": "default"}, "spec": spec}
+
+
+@pytest.fixture
+def op(api):
+    return build_operator(api=api, config=OperatorConfig(
+        workloads=["PyTorchJob", "TFJob"]))
+
+
+def test_defaults_applied_at_create(op, api):
+    job = pt_job()
+    del job["spec"]["pytorchReplicaSpecs"]["Worker"]["restartPolicy"]
+    job["spec"]["pytorchReplicaSpecs"]["Worker"].pop("replicas")
+    created = api.create(job)
+    worker = created["spec"]["pytorchReplicaSpecs"]["Worker"]
+    assert worker["replicas"] == 1
+    assert worker["restartPolicy"]
+    assert created["spec"]["cleanPodPolicy"] == "Running"
+
+
+def test_empty_replica_specs_rejected(op, api):
+    job = pt_job()
+    job["spec"]["pytorchReplicaSpecs"] = {}
+    with pytest.raises(Invalid, match="must not be empty"):
+        api.create(job)
+
+
+def test_negative_replicas_rejected(op, api):
+    job = pt_job()
+    job["spec"]["pytorchReplicaSpecs"]["Worker"]["replicas"] = -1
+    with pytest.raises(Invalid, match="non-negative"):
+        api.create(job)
+
+
+def test_no_containers_rejected(op, api):
+    job = pt_job()
+    job["spec"]["pytorchReplicaSpecs"]["Worker"]["template"] = {"spec": {}}
+    with pytest.raises(Invalid, match="containers"):
+        api.create(job)
+
+
+def test_bad_restart_policy_rejected(op, api):
+    job = pt_job()
+    job["spec"]["pytorchReplicaSpecs"]["Worker"]["restartPolicy"] = "Sometimes"
+    with pytest.raises(Invalid, match="restartPolicy"):
+        api.create(job)
+
+
+def test_bad_tpu_policy_rejected_at_create(op, api):
+    with pytest.raises(Invalid, match="tpuPolicy"):
+        api.create(pt_job(tpuPolicy={"accelerator": "v99-9999"}))
+    with pytest.raises(Invalid, match="tpuPolicy"):
+        # topology without generation doesn't resolve
+        api.create(pt_job(tpuPolicy={"topology": "2x2x4"}))
+
+
+def test_tpu_policy_defaults_replicas_to_host_count(op, api):
+    """v5p-32 = 16 chips / 4 hosts: an unset Worker count becomes 4."""
+    job = pt_job(tpuPolicy={"accelerator": "v5p-32"})
+    job["spec"]["pytorchReplicaSpecs"]["Worker"].pop("replicas")
+    created = api.create(job)
+    assert created["spec"]["pytorchReplicaSpecs"]["Worker"]["replicas"] == 4
+
+
+def test_tpu_policy_defaults_around_explicit_master(op, api):
+    job = pt_job(tpuPolicy={"accelerator": "v5p-32"})
+    specs = job["spec"]["pytorchReplicaSpecs"]
+    specs["Worker"].pop("replicas")
+    specs["Master"] = {"replicas": 1, "restartPolicy": "Never",
+                      "template": specs["Worker"]["template"]}
+    created = api.create(job)
+    assert created["spec"]["pytorchReplicaSpecs"]["Worker"]["replicas"] == 3
+
+
+def test_tpu_replica_mismatch_rejected(op, api):
+    job = pt_job(tpuPolicy={"accelerator": "v5p-32"})
+    job["spec"]["pytorchReplicaSpecs"]["Worker"]["replicas"] = 2
+    with pytest.raises(Invalid, match="mismatch"):
+        api.create(job)
+
+
+def test_good_tpu_policy_accepted(op, api):
+    job = pt_job(tpuPolicy={"accelerator": "v5p-32"})
+    job["spec"]["pytorchReplicaSpecs"]["Worker"]["replicas"] = 4
+    created = api.create(job)
+    assert m.uid(created)
+
+
+def test_bad_cron_schedule_rejected(op, api):
+    job = pt_job(cronPolicy={"schedule": "every tuesday"})
+    with pytest.raises(Invalid, match="schedule"):
+        api.create(job)
+
+
+def test_update_also_validated(op, api):
+    created = api.create(pt_job())
+    created["spec"]["pytorchReplicaSpecs"]["Worker"]["replicas"] = -3
+    with pytest.raises(Invalid):
+        api.update(created)
+
+
+def test_status_update_bypasses_admission(op, api):
+    created = api.create(pt_job())
+    # a status write must never be blocked by spec validation
+    created["status"] = {"conditions": []}
+    api.update_status(created)
+
+
+def test_unknown_kind_not_handled(op, api):
+    # the chain only guards kinds it knows; Pods sail through
+    api.create(m.new_obj("v1", "Pod", "p1"))
+
+
+def test_cron_with_doomed_template_rejected(op, api):
+    """A Cron whose every fire would be rejected is itself rejected."""
+    bad_job = pt_job()
+    bad_job["spec"]["pytorchReplicaSpecs"] = {}
+    cron = m.new_obj("apps.kubedl.io/v1alpha1", "Cron", "c-bad",
+                     spec={"schedule": "*/5 * * * *",
+                           "template": {"workload": bad_job}})
+    with pytest.raises(Invalid, match="would be rejected"):
+        api.create(cron)
+
+
+def test_cron_with_good_template_accepted(op, api):
+    cron = m.new_obj("apps.kubedl.io/v1alpha1", "Cron", "c-good",
+                     spec={"schedule": "*/5 * * * *",
+                           "template": {"workload": pt_job()}})
+    assert m.uid(api.create(cron))
+
+
+def test_zero_tpu_replicas_rejected(op, api):
+    job = pt_job(tpuPolicy={"accelerator": "v5e-8"})  # 8 chips / 1 host
+    job["spec"]["pytorchReplicaSpecs"]["Worker"]["replicas"] = 0
+    with pytest.raises(Invalid, match="mismatch"):
+        api.create(job)
+
+
+def test_validate_cron_direct():
+    cron = m.new_obj("apps.kubedl.io/v1alpha1", "Cron", "c1",
+                     spec={"schedule": "*/5 * * * *",
+                           "template": {"workload": {"kind": "TFJob"}}})
+    validate_cron(cron)
+    cron["spec"]["concurrencyPolicy"] = "Maybe"
+    with pytest.raises(Invalid, match="concurrencyPolicy"):
+        validate_cron(cron)
+
+
+# -- AdmissionReview (real-cluster webhook path) ------------------------------
+
+def make_review(obj, uid="u1"):
+    return {"apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+            "request": {"uid": uid, "object": obj}}
+
+
+@pytest.fixture
+def chain(op):
+    return op.admission
+
+
+def test_review_mutate_returns_jsonpatch(chain):
+    job = pt_job()
+    job["spec"].pop("cleanPodPolicy", None)
+    out = review_response(chain, make_review(job), mutate=True)
+    resp = out["response"]
+    assert resp["allowed"] and resp["uid"] == "u1"
+    patch = json.loads(base64.b64decode(resp["patch"]))
+    specs = [p for p in patch if p["path"] == "/spec"]
+    assert specs and specs[0]["value"]["cleanPodPolicy"] == "Running"
+
+
+def test_review_validate_rejects(chain):
+    job = pt_job()
+    job["spec"]["pytorchReplicaSpecs"] = {}
+    out = review_response(chain, make_review(job), mutate=False)
+    resp = out["response"]
+    assert resp["allowed"] is False
+    assert resp["status"]["code"] == 422
+    assert "must not be empty" in resp["status"]["message"]
+
+
+def test_webhook_server_http_roundtrip(chain):
+    import urllib.request
+    server = WebhookServer(chain, port=0)
+    server.start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/validate-kubedl-io",
+            data=json.dumps(make_review(pt_job())).encode(),
+            headers={"Content-Type": "application/json"})
+        out = json.loads(urllib.request.urlopen(req).read())
+        assert out["response"]["allowed"] is True
+
+        bad = pt_job()
+        bad["spec"]["pytorchReplicaSpecs"] = {}
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/mutate-kubedl-io",
+            data=json.dumps(make_review(bad)).encode(),
+            headers={"Content-Type": "application/json"})
+        out = json.loads(urllib.request.urlopen(req).read())
+        assert out["response"]["allowed"] is False
+    finally:
+        server.stop()

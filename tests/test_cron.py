@@ -180,8 +180,20 @@ def test_cron_deadline_stops_scheduling(api, op, clock):
     assert api.list("XGBoostJob") == []
 
 
+def test_cron_invalid_schedule_rejected_at_admission(api, op):
+    from kubedl_tpu.core.apiserver import Invalid
+    with pytest.raises(Invalid, match="schedule"):
+        api.create(new_cron(schedule="not a schedule"))
+
+
 def test_cron_invalid_schedule_event_no_retry_loop(api, op):
-    api.create(new_cron(schedule="not a schedule"))
+    # an object that slipped past admission (e.g. created before the chain
+    # existed) still terminates with an event instead of retry-looping
+    admission, api.admission = api.admission, None
+    try:
+        api.create(new_cron(schedule="not a schedule"))
+    finally:
+        api.admission = admission
     n = op.run_until_idle()
     assert n < 10  # terminates instead of retry-looping
     events = [e for e in api.list("Event") if e["reason"] == "InvalidSchedule"]

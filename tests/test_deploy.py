@@ -100,6 +100,23 @@ def test_helm_chart_and_kustomize_parse():
     values = yaml.safe_load((ROOT / "helm/kubedl-tpu/values.yaml").read_text())
     assert values["gangSchedulerName"] == "coscheduler"
     kust = yaml.safe_load((ROOT / "config/kustomization.yaml").read_text())
-    assert len(kust["resources"]) == 16
+    assert len(kust["resources"]) == 18
     for res in kust["resources"]:
         assert (ROOT / "config" / res).is_file(), res
+    assert "webhook/manifests.yaml" in kust["resources"]
+    assert "certmanager/certificate.yaml" in kust["resources"]
+
+
+def test_webhook_manifests_cover_all_training_kinds():
+    docs = list(yaml.safe_load_all(
+        (ROOT / "config/webhook/manifests.yaml").read_text()))
+    kinds = {d["kind"] for d in docs}
+    assert {"MutatingWebhookConfiguration",
+            "ValidatingWebhookConfiguration", "Service"} <= kinds
+    for d in docs:
+        if d["kind"].endswith("WebhookConfiguration"):
+            resources = d["webhooks"][0]["rules"][0]["resources"]
+            for plural in ("tfjobs", "pytorchjobs", "jaxjobs", "mpijobs",
+                           "xgboostjobs", "xdljobs", "marsjobs",
+                           "elasticdljobs", "crons"):
+                assert plural in resources, (d["kind"], plural)
