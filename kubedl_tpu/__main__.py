@@ -42,6 +42,11 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--dns-domain", default="")
     p.add_argument("--console-port", type=int, default=0,
                    help="serve the management console (0 = disabled)")
+    p.add_argument("--console-host", default="0.0.0.0",
+                   help="console bind address (default 0.0.0.0 so the "
+                        "in-cluster Service reaches it; credentials come "
+                        "from $KUBEDL_CONSOLE_USERS or the "
+                        "kubedl-console-config ConfigMap, never hard-coded)")
     p.add_argument("--metrics-port", type=int, default=8080,
                    help="Prometheus /metrics (0 = disabled)")
     # real-cluster mode (reference main.go:81-126: the manager talks to an
@@ -159,7 +164,8 @@ def main(argv=None) -> int:
                           operator.event_backend,
                           job_kinds=tuple(operator.engines))
         console = ConsoleServer(
-            proxy, ConsoleConfig(host="0.0.0.0", port=args.console_port))
+            proxy, ConsoleConfig(host=args.console_host,
+                                 port=args.console_port))
         console.start()
         log.info("console on %s", console.url)
 

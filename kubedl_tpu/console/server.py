@@ -23,7 +23,10 @@ success, ``{"code": ..., "msg": ...}`` on error.
 
 from __future__ import annotations
 
+import hmac
 import json
+import logging
+import os
 import re
 import secrets
 import threading
@@ -40,20 +43,81 @@ from ..client.clientset import KIND_TABLE, TRAINING_KINDS, Clientset
 from ..core import meta as m
 from ..core.apiserver import AlreadyExists, ApiError, NotFound
 from ..storage.backends import Query
+from ..storage.dmo import WorkspaceRecord
+from .presubmit import run_pre_submit_hooks
 from .proxy import DataProxy
+from .sources import (CodeSource, CodeSourceHandler, DataSource,
+                      DataSourceHandler, WorkspaceHandler)
 
 FRONTEND_DIR = Path(__file__).parent / "frontend"
 SESSION_COOKIE = "kubedl-session"
+#: reference constants.KubeDLConsoleConfig in kubedl-system: user list lives
+#: in a ConfigMap so credentials are cluster-config, not code
+CONSOLE_CONFIGMAP = "kubedl-console-config"
+CONSOLE_NAMESPACE = "kubedl-system"
+
+log = logging.getLogger("kubedl.console")
 
 
 @dataclass
 class ConsoleConfig:
     host: str = "127.0.0.1"
     port: int = 9090
-    #: username -> password; empty dict disables auth entirely (dev mode)
-    users: dict = field(default_factory=lambda: {"admin": "kubedl"})
+    #: username -> password. None (default) resolves at startup from
+    #: $KUBEDL_CONSOLE_USERS, then the kubedl-console-config ConfigMap,
+    #: else generates a random admin password (logged once). An explicit
+    #: empty dict disables auth entirely (dev mode, reference auth "none").
+    users: Optional[dict] = None
     #: cap on request body size (submit endpoints)
     max_body: int = 4 << 20
+    #: mark the session cookie Secure (set when serving behind TLS)
+    cookie_secure: bool = False
+
+
+def resolve_users(config: ConsoleConfig, api) -> dict:
+    """Credential sources, most-explicit first (reference
+    ``model.GetUserInfoFromConfigMap``; the hard-coded admin:kubedl default
+    of earlier rounds is gone — ADVICE r1/r2)."""
+    if config.users is not None:
+        return dict(config.users)
+    env = os.environ.get("KUBEDL_CONSOLE_USERS", "")
+    if env:
+        try:
+            parsed = json.loads(env)
+            if isinstance(parsed, list):      # [{"username":..,"password":..}]
+                return {u["username"]: u["password"] for u in parsed}
+            if isinstance(parsed, dict):
+                return dict(parsed)
+        except ValueError:
+            pass
+        except (KeyError, TypeError) as e:
+            raise ValueError(
+                f"KUBEDL_CONSOLE_USERS JSON must be a list of "
+                f"{{username, password}} objects or a user->password map: {e}")
+        # "user:pass,user2:pass2" shorthand
+        users = {}
+        for pair in env.split(","):
+            user, _, pw = pair.partition(":")
+            if user and pw:
+                users[user] = pw
+        if users:
+            return users
+        raise ValueError("KUBEDL_CONSOLE_USERS is set but unparseable")
+    cm = api.try_get("ConfigMap", CONSOLE_NAMESPACE, CONSOLE_CONFIGMAP)
+    if cm is not None:
+        try:
+            infos = json.loads((cm.get("data") or {}).get("users", "[]"))
+            users = {u["username"]: u["password"] for u in infos}
+            if users:
+                return users
+        except (ValueError, TypeError, KeyError) as e:
+            log.warning("bad %s ConfigMap: %s", CONSOLE_CONFIGMAP, e)
+    password = secrets.token_urlsafe(12)
+    log.warning("no console credentials configured; generated admin "
+                "password: %s (set KUBEDL_CONSOLE_USERS or the %s/%s "
+                "ConfigMap to override)", password, CONSOLE_NAMESPACE,
+                CONSOLE_CONFIGMAP)
+    return {"admin": password}
 
 
 class _Sessions:
@@ -82,8 +146,17 @@ class ConsoleServer:
     def __init__(self, proxy: DataProxy, config: Optional[ConsoleConfig] = None):
         self.proxy = proxy
         self.config = config or ConsoleConfig()
+        self.users = resolve_users(self.config, proxy.api)
         self.sessions = _Sessions()
         self.cs = Clientset(proxy.api)
+        self.datasources = DataSourceHandler(proxy.api)
+        self.codesources = CodeSourceHandler(proxy.api)
+        now_fn = lambda: m.rfc3339(proxy.api.now())  # noqa: E731
+        self.workspaces = None
+        if proxy.object_backend is not None:
+            self.workspaces = WorkspaceHandler(
+                proxy.api, proxy.object_backend, self.datasources, now_fn)
+        self._now = now_fn
         console = self
 
         class Handler(_ConsoleHandler):
@@ -131,7 +204,7 @@ class ConsoleServer:
             self.sessions.logout(token)
             return 200, {"code": 200, "data": "ok"}, []
         user = self.sessions.user(token)
-        if self.config.users and user is None:
+        if self.users and user is None:
             return 401, {"code": 401, "msg": "not logged in"}, []
         if path == "/api/v1/current-user":
             return 200, {"code": 200, "data": {
@@ -194,6 +267,7 @@ class ConsoleServer:
             kind = m.kind(obj)
             if kind not in TRAINING_KINDS:
                 raise ValueError(f"kind {kind!r} is not a training job kind")
+            run_pre_submit_hooks(obj)
             created = self.cs.kind(kind).create(obj)
             return ok({"name": m.name(created),
                        "namespace": m.namespace(created)})
@@ -262,7 +336,79 @@ class ConsoleServer:
         if path == "/api/v1/kinds":
             return ok(sorted(TRAINING_KINDS))
 
+        # -- workspaces (reference routers/api/workspace.go:30-36) --------
+        if path.startswith("/api/v1/workspace"):
+            if self.workspaces is None:
+                return 501, {"code": 501,
+                             "msg": "no object backend: workspaces disabled"}, []
+            if path == "/api/v1/workspace/create" and method == "POST":
+                req = _parse_body(body)
+                self.workspaces.create(WorkspaceRecord(
+                    name=req.get("name", ""),
+                    namespace=req.get("namespace", "default"),
+                    username=req.get("username", ""),
+                    type=req.get("type", ""),
+                    pvc_name=req.get("pvc_name", ""),
+                    local_path=req.get("local_path", ""),
+                    description=req.get("description", ""),
+                    cpu=int(req.get("cpu", 0) or 0),
+                    memory=int(req.get("memory", 0) or 0),
+                    tpu=int(req.get("tpu", 0) or 0),
+                    storage=int(req.get("storage", 0) or 0),
+                ))
+                return ok(None)
+            if path == "/api/v1/workspace/list":
+                q = _query_from_params(params)
+                rows = self.workspaces.list(q)
+                return ok({"workspaceInfos": [r.to_row() for r in rows],
+                           "total": q.count})
+            if path == "/api/v1/workspace/detail":
+                rec = self.workspaces.detail(params.get("name", ""))
+                if rec is None:
+                    raise NotFound("workspace not found")
+                return ok(rec.to_row())
+            mt = re.fullmatch(r"/api/v1/workspace/([^/]+)", path)
+            if mt and method == "DELETE":
+                self.workspaces.delete(mt.group(1))
+                return ok(None)
+
+        # -- data sources (reference routers/api/data_source.go:25-32) ----
+        hit = _source_route(path, "/api/v1/datasource")
+        if hit is not None:
+            return self._source_crud(self.datasources, DataSource,
+                                     method, hit, body, ok)
+        # -- code sources (reference routers/api/code_source.go:25-32) ----
+        hit = _source_route(path, "/api/v1/codesource")
+        if hit is not None:
+            return self._source_crud(self.codesources, CodeSource,
+                                     method, hit, body, ok)
+
         raise NotFound(f"no route {method} {path}")
+
+    def _source_crud(self, handler, cls, method: str, name: str,
+                     body: bytes, ok):
+        """Shared POST/PUT/GET/GET-one/DELETE surface of the datasource and
+        codesource groups (their reference controllers are copies of each
+        other modulo the model type)."""
+        if method == "POST" or method == "PUT":
+            req = _parse_body(body)
+            entry = cls(**{k: str(req.get(k, "")) for k in
+                           cls.__dataclass_fields__})
+            entry.create_time = entry.create_time or self._now()
+            entry.update_time = self._now()
+            if method == "POST":
+                handler.create(entry)
+            else:
+                handler.update(entry)
+            return ok(f"success to {'create' if method == 'POST' else 'put'}")
+        if method == "DELETE":
+            if not name:
+                raise ValueError("name is required")
+            handler.delete(name)
+            return ok("success to delete")
+        if name:
+            return ok(handler.get(name))
+        return ok(handler.list())
 
     def _find_job(self, kind: str, ns: str, name: str) -> Optional[dict]:
         kinds = [kind] if kind else TRAINING_KINDS
@@ -277,11 +423,20 @@ class ConsoleServer:
     def _login(self, body: bytes):
         req = json.loads(body or b"{}")
         user, pw = req.get("username", ""), req.get("password", "")
-        if self.config.users and self.config.users.get(user) != pw:
-            return 401, {"code": 401, "msg": "bad credentials"}, []
+        if self.users:
+            # constant-time compare against a real entry or a dummy so a
+            # probe can't distinguish bad-user from bad-password by timing
+            expected = self.users.get(user) or secrets.token_urlsafe(8)
+            if not hmac.compare_digest(str(expected), str(pw)) \
+                    or user not in self.users:
+                return 401, {"code": 401, "msg": "bad credentials"}, []
         token = self.sessions.login(user or "anonymous")
+        cookie = (f"{SESSION_COOKIE}={token}; Path=/; HttpOnly; "
+                  "SameSite=Strict")
+        if self.config.cookie_secure:
+            cookie += "; Secure"
         return 200, {"code": 200, "data": {"loginId": user}}, [
-            ("Set-Cookie", f"{SESSION_COOKIE}={token}; Path=/; HttpOnly")]
+            ("Set-Cookie", cookie)]
 
     def _static(self, path: str):
         rel = path.lstrip("/") or "index.html"
@@ -346,8 +501,35 @@ class _ConsoleHandler(BaseHTTPRequestHandler):
     def do_POST(self):
         self._handle("POST")
 
+    def do_PUT(self):
+        self._handle("PUT")
+
     def do_DELETE(self):
         self._handle("DELETE")
+
+
+def _source_route(path: str, prefix: str) -> Optional[str]:
+    """Match ``{prefix}`` (collection) or ``{prefix}/{name}`` (item);
+    returns the item name, "" for the collection, None for no match."""
+    if path == prefix:
+        return ""
+    mt = re.fullmatch(re.escape(prefix) + r"/([^/]+)", path)
+    return mt.group(1) if mt else None
+
+
+def _parse_body(body: bytes) -> dict:
+    """POST bodies arrive as JSON (our SPA) or form-encoded (the reference
+    frontend uses PostForm)."""
+    text = body.decode()
+    if not text.strip():
+        return {}
+    try:
+        obj = json.loads(text)
+        if isinstance(obj, dict):
+            return obj
+    except ValueError:
+        pass
+    return {k: v[0] for k, v in parse_qs(text).items()}
 
 
 def _parse_manifest(body: bytes) -> dict:

@@ -17,7 +17,8 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from . import dmo
-from .dmo import (DELETED, EventRecord, JobRecord, NotebookRecord, PodRecord)
+from .dmo import (DELETED, EventRecord, JobRecord, NotebookRecord, PodRecord,
+                  WorkspaceRecord)
 
 
 @dataclass
@@ -109,6 +110,20 @@ class ObjectBackend:
     def delete_notebook(self, namespace: str, name: str, notebook_id: str = "") -> None:
         raise NotImplementedError
 
+    # -- workspaces (reference interface.go:60-65) ------------------------
+
+    def create_workspace(self, rec: WorkspaceRecord) -> None:
+        raise NotImplementedError
+
+    def list_workspaces(self, query: Query) -> list:
+        raise NotImplementedError
+
+    def get_workspace(self, name: str) -> Optional[WorkspaceRecord]:
+        raise NotImplementedError
+
+    def delete_workspace(self, name: str) -> None:
+        raise NotImplementedError
+
 
 class EventBackend:
     """Reference ``EventStorageBackend`` (``interface.go:70-84``)."""
@@ -139,6 +154,7 @@ class MemoryBackend(ObjectBackend, EventBackend):
         self._pods: dict[str, PodRecord] = {}
         self._notebooks: dict[str, NotebookRecord] = {}
         self._events: dict[tuple, EventRecord] = {}  # (obj_uid, name)
+        self._workspaces: dict[str, WorkspaceRecord] = {}  # key: name
         self._lock = threading.RLock()
 
     def save_job(self, rec: JobRecord) -> None:
@@ -220,6 +236,35 @@ class MemoryBackend(ObjectBackend, EventBackend):
                     rec.deleted = DELETED
                     rec.is_in_etcd = 0
 
+    def create_workspace(self, rec: WorkspaceRecord) -> None:
+        with self._lock:
+            if rec.name in self._workspaces \
+                    and self._workspaces[rec.name].deleted != DELETED:
+                raise ValueError(f"workspace {rec.name!r} already exists")
+            self._workspaces[rec.name] = rec
+
+    def list_workspaces(self, query: Query) -> list:
+        with self._lock:
+            rows = [r for r in self._workspaces.values()
+                    if r.deleted != DELETED
+                    and (not query.name or query.name in r.name)
+                    and (not query.start_time
+                         or r.create_time >= query.start_time)]
+        rows.sort(key=lambda r: r.create_time, reverse=True)
+        return _paginate(rows, query)
+
+    def get_workspace(self, name: str) -> Optional[WorkspaceRecord]:
+        with self._lock:
+            rec = self._workspaces.get(name)
+            return rec if rec is not None and rec.deleted != DELETED else None
+
+    def delete_workspace(self, name: str) -> None:
+        with self._lock:
+            rec = self._workspaces.get(name)
+            if rec is None or rec.deleted == DELETED:
+                raise KeyError(f"workspace {name!r} not found")
+            rec.deleted = DELETED
+
     def save_event(self, rec: EventRecord) -> None:
         with self._lock:
             self._events[(rec.obj_uid, rec.name)] = rec
@@ -260,6 +305,11 @@ CREATE TABLE IF NOT EXISTS notebooks (
   notebook_id TEXT PRIMARY KEY, name TEXT, namespace TEXT, version TEXT,
   status TEXT, url TEXT, deleted INTEGER, is_in_etcd INTEGER,
   gmt_created TEXT, gmt_modified TEXT);
+CREATE TABLE IF NOT EXISTS workspaces (
+  name TEXT PRIMARY KEY, namespace TEXT, username TEXT, type TEXT,
+  pvc_name TEXT, local_path TEXT, description TEXT, cpu INTEGER,
+  memory INTEGER, tpu INTEGER, storage INTEGER, status TEXT,
+  deleted INTEGER, create_time TEXT, update_time TEXT);
 CREATE TABLE IF NOT EXISTS events (
   obj_uid TEXT, name TEXT, kind TEXT, type TEXT, obj_namespace TEXT,
   obj_name TEXT, reason TEXT, message TEXT, count INTEGER, region TEXT,
@@ -472,6 +522,56 @@ class SQLiteBackend(ObjectBackend, EventBackend):
                 conn.execute("UPDATE notebooks SET deleted=?, is_in_etcd=0 "
                              "WHERE namespace=? AND name=?",
                              (DELETED, namespace, name))
+
+    # -- workspaces -------------------------------------------------------
+
+    @_locked
+    def create_workspace(self, rec: WorkspaceRecord) -> None:
+        conn = self._conn()
+        cur = conn.execute(
+            "SELECT deleted FROM workspaces WHERE name=?", (rec.name,))
+        row = cur.fetchone()
+        if row is not None and row["deleted"] != DELETED:
+            raise ValueError(f"workspace {rec.name!r} already exists")
+        with conn:
+            conn.execute(*_upsert("workspaces", "name", rec.to_row()))
+
+    @_locked
+    def list_workspaces(self, query: Query) -> list:
+        where, args = ["deleted!=?"], [DELETED]
+        if query.name:
+            where.append("name LIKE ?"); args.append(f"%{query.name}%")
+        if query.start_time:
+            where.append("create_time>=?"); args.append(query.start_time)
+        cond = " AND ".join(where)
+        conn = self._conn()
+        query.count = conn.execute(
+            f"SELECT COUNT(*) FROM workspaces WHERE {cond}", args).fetchone()[0]
+        sql = f"SELECT * FROM workspaces WHERE {cond} ORDER BY create_time DESC"
+        if query.page_num > 0 and query.page_size > 0:
+            sql += f" LIMIT {int(query.page_size)} OFFSET {(query.page_num - 1) * int(query.page_size)}"
+        return [WorkspaceRecord.from_row(dict(r))
+                for r in conn.execute(sql, args)]
+
+    @_locked
+    def get_workspace(self, name: str) -> Optional[WorkspaceRecord]:
+        cur = self._conn().execute(
+            "SELECT * FROM workspaces WHERE name=? AND deleted!=?",
+            (name, DELETED))
+        row = cur.fetchone()
+        return WorkspaceRecord.from_row(dict(row)) if row else None
+
+    @_locked
+    def delete_workspace(self, name: str) -> None:
+        conn = self._conn()
+        cur = conn.execute(
+            "SELECT deleted FROM workspaces WHERE name=?", (name,))
+        row = cur.fetchone()
+        if row is None or row["deleted"] == DELETED:
+            raise KeyError(f"workspace {name!r} not found")
+        with conn:
+            conn.execute("UPDATE workspaces SET deleted=? WHERE name=?",
+                         (DELETED, name))
 
     # -- events -----------------------------------------------------------
 

@@ -142,6 +142,35 @@ class NotebookRecord:
         return cls(**{k: row[k] for k in cls.__dataclass_fields__ if k in row})
 
 
+@dataclass
+class WorkspaceRecord:
+    """Reference ``model.WorkspaceInfo``
+    (``console/backend/pkg/model/workspace.go:7-39``): a named bundle of
+    compute quota + a PVC-backed storage area that jobs/notebooks mount."""
+    name: str = ""
+    namespace: str = ""
+    username: str = ""
+    type: str = ""              # storage class of workspace ("pvc", "hostpath")
+    pvc_name: str = ""
+    local_path: str = ""
+    description: str = ""
+    cpu: int = 0
+    memory: int = 0
+    tpu: int = 0                # reference counts GPUs; TPU chips here
+    storage: int = 0            # GiB
+    status: str = "Created"     # Created | Ready (pvc bound)
+    deleted: int = NOT_DELETED
+    create_time: str = ""
+    update_time: str = ""
+
+    def to_row(self) -> dict:
+        return asdict(self)
+
+    @classmethod
+    def from_row(cls, row: dict) -> "WorkspaceRecord":
+        return cls(**{k: row[k] for k in cls.__dataclass_fields__ if k in row})
+
+
 # ---------------------------------------------------------------------------
 # Converters (reference pkg/storage/dmo/converters/{job,pod,event}.go)
 # ---------------------------------------------------------------------------

@@ -49,7 +49,8 @@ def stack(api):
         workloads=["PyTorchJob", "TFJob", "JAXJob"],
         object_storage="sqlite", event_storage="sqlite"))
     proxy = DataProxy(api, op.object_backend, op.event_backend)
-    server = ConsoleServer(proxy, ConsoleConfig(port=0))
+    server = ConsoleServer(proxy, ConsoleConfig(
+        port=0, users={"admin": "kubedl"}))
     server.start()
     client = Client(server.url)
     yield op, client
@@ -199,6 +200,155 @@ def test_frontend_served(stack):
     # SPA fallback for client-side routes
     status, text = client.req("GET", "/jobs", raw=True)
     assert status == 200 and "kubedl-tpu" in text
+
+
+def test_credential_resolution(api, monkeypatch):
+    """No more hard-coded admin:kubedl (ADVICE r1/r2): explicit config >
+    env > ConfigMap > generated random password."""
+    from kubedl_tpu.console.server import (CONSOLE_CONFIGMAP,
+                                           CONSOLE_NAMESPACE, resolve_users)
+
+    # explicit dict wins, empty dict disables auth
+    assert resolve_users(ConsoleConfig(users={"u": "p"}), api) == {"u": "p"}
+    assert resolve_users(ConsoleConfig(users={}), api) == {}
+
+    # env: JSON list, JSON dict, and shorthand forms
+    monkeypatch.setenv("KUBEDL_CONSOLE_USERS",
+                       '[{"username": "a", "password": "b"}]')
+    assert resolve_users(ConsoleConfig(), api) == {"a": "b"}
+    monkeypatch.setenv("KUBEDL_CONSOLE_USERS", "x:1,y:2")
+    assert resolve_users(ConsoleConfig(), api) == {"x": "1", "y": "2"}
+    monkeypatch.delenv("KUBEDL_CONSOLE_USERS")
+
+    # ConfigMap (the reference's GetUserInfoFromConfigMap path)
+    cm = m.new_obj("v1", "ConfigMap", CONSOLE_CONFIGMAP, CONSOLE_NAMESPACE)
+    cm["data"] = {"users": json.dumps(
+        [{"username": "ops", "password": "secret"}])}
+    api.create(cm)
+    assert resolve_users(ConsoleConfig(), api) == {"ops": "secret"}
+    api.delete("ConfigMap", CONSOLE_NAMESPACE, CONSOLE_CONFIGMAP)
+
+    # nothing configured: random password, never the old default
+    users = resolve_users(ConsoleConfig(), api)
+    assert set(users) == {"admin"} and users["admin"] != "kubedl"
+    assert len(users["admin"]) >= 12
+
+
+def test_session_cookie_hardened(stack):
+    op, client = stack
+    req = urllib.request.Request(
+        client.base + "/api/v1/login", method="POST",
+        data=json.dumps({"username": "admin", "password": "kubedl"}).encode())
+    with urllib.request.urlopen(req) as res:
+        cookie = res.headers.get("Set-Cookie", "")
+    assert "HttpOnly" in cookie and "SameSite=Strict" in cookie
+
+
+def test_workspace_crud_over_http(stack):
+    op, client = stack
+    login(client)
+    status, body = client.req("POST", "/api/v1/workspace/create", {
+        "name": "team-a", "namespace": "default", "username": "alice",
+        "type": "pvc", "storage": 50, "description": "team A scratch"})
+    assert status == 200, body
+
+    # list: the workspace row + companion data source + PVC all exist
+    status, body = client.req("GET", "/api/v1/workspace/list")
+    assert status == 200
+    rows = body["data"]["workspaceInfos"]
+    assert len(rows) == 1 and rows[0]["name"] == "team-a"
+    assert rows[0]["pvc_name"] == "workspace-team-a"
+    status, body = client.req("GET", "/api/v1/datasource/workspace-team-a")
+    assert status == 200
+    assert body["data"]["pvc_name"] == "workspace-team-a"
+    pvc = op.api.try_get("PersistentVolumeClaim", "default",
+                         "workspace-team-a")
+    assert pvc is not None
+    assert pvc["spec"]["resources"]["requests"]["storage"] == "50Gi"
+
+    # duplicate create rejected
+    status, body = client.req("POST", "/api/v1/workspace/create",
+                              {"name": "team-a"})
+    assert status == 400
+
+    # PVC bound → detail reports Ready (workspace.go Status semantics)
+    pvc["status"] = {"phase": "Bound"}
+    op.api.update(pvc)
+    status, body = client.req("GET", "/api/v1/workspace/detail?name=team-a")
+    assert status == 200 and body["data"]["status"] == "Ready"
+
+    # delete removes row, data source, and PVC
+    status, _ = client.req("DELETE", "/api/v1/workspace/team-a")
+    assert status == 200
+    status, body = client.req("GET", "/api/v1/workspace/list")
+    assert body["data"]["total"] == 0
+    status, _ = client.req("GET", "/api/v1/datasource/workspace-team-a")
+    assert status == 400
+    assert op.api.try_get("PersistentVolumeClaim", "default",
+                          "workspace-team-a") is None
+
+
+def test_datasource_codesource_crud(stack):
+    op, client = stack
+    login(client)
+    # create (JSON body; form-encoded also accepted, tested via raw string)
+    status, body = client.req("POST", "/api/v1/datasource", {
+        "name": "imagenet", "type": "pvc", "pvc_name": "imagenet-pvc",
+        "local_path": "/data", "username": "alice"})
+    assert status == 200, body
+    status, body = client.req("GET", "/api/v1/datasource")
+    assert status == 200 and "imagenet" in body["data"]
+
+    # update preserves create_time (reference data_source.go:100)
+    status, body = client.req("GET", "/api/v1/datasource/imagenet")
+    created = body["data"]["create_time"]
+    assert created
+    status, _ = client.req("PUT", "/api/v1/datasource", {
+        "name": "imagenet", "type": "pvc", "pvc_name": "imagenet-pvc-v2"})
+    status, body = client.req("GET", "/api/v1/datasource/imagenet")
+    assert body["data"]["pvc_name"] == "imagenet-pvc-v2"
+    assert body["data"]["create_time"] == created
+
+    # duplicate create rejected; delete; survives in ConfigMap storage
+    status, _ = client.req("POST", "/api/v1/datasource", {"name": "imagenet"})
+    assert status == 400
+    status, _ = client.req("DELETE", "/api/v1/datasource/imagenet")
+    assert status == 200
+    status, _ = client.req("GET", "/api/v1/datasource/imagenet")
+    assert status == 400
+
+    # code sources: git-shaped fields, stored in their own ConfigMap
+    status, body = client.req("POST", "/api/v1/codesource", {
+        "name": "trainer-repo", "type": "git",
+        "code_path": "https://github.com/org/trainer.git",
+        "default_branch": "main", "local_path": "/workspace/code"})
+    assert status == 200, body
+    cm = op.api.try_get("ConfigMap", "kubedl-system",
+                        "kubedl-codesource-config")
+    assert cm is not None and "trainer-repo" in cm["data"]["codesource"]
+    status, body = client.req("GET", "/api/v1/codesource/trainer-repo")
+    assert body["data"]["default_branch"] == "main"
+
+
+def test_presubmit_hooks_applied_on_submit(stack):
+    op, client = stack
+    login(client)
+    # worker-only PyTorchJob: hook must carve out a Master before create
+    job = {
+        "apiVersion": "training.kubedl.io/v1alpha1", "kind": "PyTorchJob",
+        "metadata": {"name": "workers-only", "namespace": "default"},
+        "spec": {"pytorchReplicaSpecs": {"Worker": {
+            "replicas": 3, "restartPolicy": "Never",
+            "template": {"spec": {"containers": [
+                {"name": "pytorch", "image": "img", "ports": [
+                    {"name": "pytorchjob-port", "containerPort": 23456}]}]}}}}},
+    }
+    status, body = client.req("POST", "/api/v1/job/submit", job)
+    assert status == 200, body
+    created = op.api.get("PyTorchJob", "default", "workers-only")
+    specs = created["spec"]["pytorchReplicaSpecs"]
+    assert specs["Master"]["replicas"] == 1
+    assert specs["Worker"]["replicas"] == 2
 
 
 def test_proxy_merges_live_and_persisted(api):
