@@ -1,0 +1,176 @@
+// kubedl-tpu console SPA core: API client, hash router, i18n, helpers.
+// Pages live in /pages/*.js as ES modules; the route table below maps
+// #/name to each page's render(app, params) export.
+
+import { viewLogin } from "./pages/login.js";
+import { viewJobs } from "./pages/jobs.js";
+import { viewJobDetail } from "./pages/jobdetail.js";
+import { viewSubmit } from "./pages/submit.js";
+import { viewNotebooks, viewNotebookCreate } from "./pages/notebooks.js";
+import { viewWorkspaces, viewWorkspaceCreate } from "./pages/workspaces.js";
+import { viewDataSources, viewCodeSources } from "./pages/sources.js";
+import { viewCluster } from "./pages/cluster.js";
+
+// ---------------------------------------------------------------- api client
+
+export async function api(path, opts = {}) {
+  const res = await fetch("/api/v1" + path, {
+    headers: { "Content-Type": "application/json" }, ...opts });
+  if (res.status === 401) {
+    if (!location.hash.startsWith("#/login")) location.hash = "#/login";
+    throw new Error("auth");
+  }
+  const ctype = res.headers.get("Content-Type") || "";
+  const body = ctype.includes("json") ? await res.json() : await res.text();
+  if (typeof body === "object" && body.code !== 200)
+    throw new Error(body.msg || "request failed");
+  return typeof body === "object" ? body.data : body;
+}
+
+// ------------------------------------------------------------------- helpers
+
+export const esc = s => String(s ?? "").replace(/[&<>"]/g,
+  ch => ({ "&": "&amp;", "<": "&lt;", ">": "&gt;", '"': "&quot;" }[ch]));
+
+export const statusCell = s =>
+  `<span class="status ${esc(s)}">${esc(s)}</span>`;
+
+export function params() {
+  return new URLSearchParams(location.hash.split("?")[1] || "");
+}
+
+export function navigate(hash) {
+  if (location.hash === hash) route();
+  else location.hash = hash;
+}
+
+// Render tab strip + panels. tabs = [{id, label, render(el)}]
+export function tabbed(el, tabs, active) {
+  const id = active || tabs[0].id;
+  el.innerHTML = `
+    <div class="tabs">${tabs.map(t =>
+      `<button data-tab="${t.id}" class="${t.id === id ? "active" : ""}">
+       ${esc(t.label)}</button>`).join("")}</div>
+    <div id="tab-body"></div>`;
+  const body = el.querySelector("#tab-body");
+  const show = tab => Promise.resolve(tab.render(body)).catch(e => {
+    body.innerHTML = `<p class="error">error: ${esc(e.message)}</p>`;
+  });
+  el.querySelectorAll("[data-tab]").forEach(btn => btn.onclick = () => {
+    el.querySelectorAll("[data-tab]").forEach(b =>
+      b.classList.toggle("active", b === btn));
+    show(tabs.find(t => t.id === btn.dataset.tab));
+  });
+  show(tabs.find(t => t.id === id));
+}
+
+// ---------------------------------------------------------------------- i18n
+
+const MESSAGES = {
+  en: {
+    "nav.jobs": "Jobs", "nav.submit": "Submit", "nav.notebooks": "Notebooks",
+    "nav.workspaces": "Workspaces", "nav.datasources": "Data",
+    "nav.codesources": "Code", "nav.cluster": "Cluster",
+    "nav.logout": "logout",
+    "jobs.title": "Training jobs", "jobs.stop": "stop", "jobs.delete": "delete",
+    "jobs.archived": "archived", "jobs.allKinds": "all kinds",
+    "jobs.allStatuses": "all statuses",
+    "detail.pods": "Pods", "detail.events": "Events", "detail.logs": "Logs",
+    "detail.manifest": "Manifest",
+    "submit.title": "Submit job", "submit.form": "Form", "submit.yaml": "YAML",
+    "submit.create": "Submit", "submit.preview": "Preview manifest",
+    "notebooks.title": "Notebooks", "notebooks.create": "New notebook",
+    "workspaces.title": "Workspaces", "workspaces.create": "New workspace",
+    "sources.data": "Data sources", "sources.code": "Code sources",
+    "sources.add": "Add", "sources.save": "Save", "sources.edit": "edit",
+    "cluster.title": "Cluster",
+    "login.title": "Sign in", "login.button": "Login",
+    "login.failed": "login failed",
+  },
+  zh: {
+    "nav.jobs": "任务", "nav.submit": "提交", "nav.notebooks": "笔记本",
+    "nav.workspaces": "工作空间", "nav.datasources": "数据",
+    "nav.codesources": "代码", "nav.cluster": "集群",
+    "nav.logout": "退出",
+    "jobs.title": "训练任务", "jobs.stop": "停止", "jobs.delete": "删除",
+    "jobs.archived": "已归档", "jobs.allKinds": "全部类型",
+    "jobs.allStatuses": "全部状态",
+    "detail.pods": "容器组", "detail.events": "事件", "detail.logs": "日志",
+    "detail.manifest": "清单",
+    "submit.title": "提交任务", "submit.form": "表单", "submit.yaml": "YAML",
+    "submit.create": "提交", "submit.preview": "预览清单",
+    "notebooks.title": "笔记本", "notebooks.create": "新建笔记本",
+    "workspaces.title": "工作空间", "workspaces.create": "新建工作空间",
+    "sources.data": "数据源", "sources.code": "代码源",
+    "sources.add": "新增", "sources.save": "保存", "sources.edit": "编辑",
+    "cluster.title": "集群",
+    "login.title": "登录", "login.button": "登录",
+    "login.failed": "登录失败",
+  },
+};
+
+let lang = localStorage.getItem("kubedl-lang") || "en";
+
+export function t(key) {
+  return (MESSAGES[lang] && MESSAGES[lang][key]) || MESSAGES.en[key] || key;
+}
+
+function applyLangToChrome() {
+  document.querySelectorAll("[data-i18n]").forEach(el => {
+    el.textContent = t(el.dataset.i18n);
+  });
+  document.getElementById("lang").textContent = lang === "en" ? "中文" : "EN";
+}
+
+// -------------------------------------------------------------------- router
+
+const app = document.getElementById("app");
+
+const routes = {
+  "login": viewLogin,
+  "jobs": viewJobs,
+  "job": viewJobDetail,
+  "submit": viewSubmit,
+  "notebooks": viewNotebooks,
+  "notebook-create": viewNotebookCreate,
+  "workspaces": viewWorkspaces,
+  "workspace-create": viewWorkspaceCreate,
+  "datasources": viewDataSources,
+  "codesources": viewCodeSources,
+  "cluster": viewCluster,
+};
+
+export async function route() {
+  const hash = location.hash.replace(/^#\//, "") || "jobs";
+  const name = hash.split("?")[0];
+  const view = routes[name] || viewJobs;
+  if (name !== "login") {
+    document.getElementById("nav").hidden = false;
+    document.getElementById("logout").hidden = false;
+    try {
+      const u = await api("/current-user");
+      document.getElementById("user").textContent = u.loginId;
+    } catch (e) { return; /* redirected to login */ }
+  }
+  document.querySelectorAll("nav a").forEach(a =>
+    a.classList.toggle("active", a.getAttribute("href") === "#/" + name));
+  try { await view(app); }
+  catch (e) {
+    if (e.message !== "auth")
+      app.innerHTML = `<div class="panel error">error: ${esc(e.message)}</div>`;
+  }
+}
+
+document.getElementById("lang").onclick = () => {
+  lang = lang === "en" ? "zh" : "en";
+  localStorage.setItem("kubedl-lang", lang);
+  applyLangToChrome();
+  route();
+};
+document.getElementById("logout").onclick = async () => {
+  await api("/logout", { method: "POST" });
+  location.hash = "#/login";
+};
+window.addEventListener("hashchange", route);
+applyLangToChrome();
+route();

@@ -1,0 +1,37 @@
+// Cluster overview (reference pages/ClusterInfo): totals, per-phase pod
+// requests, node table with TPU topology labels.
+import { api, esc, t } from "../app.js";
+
+const fmt = obj => Object.entries(obj || {})
+  .map(([k, v]) => `${k}: ${v}`).join(", ") || "—";
+
+export async function viewCluster(app) {
+  const [total, running, pending, nodes] = await Promise.all([
+    api("/data/total"),
+    api("/data/request/Running"),
+    api("/data/request/Pending"),
+    api("/data/nodeInfos"),
+  ]);
+  app.innerHTML = `
+    <div class="panel"><h2>${esc(t("cluster.title"))}</h2>
+      <div class="kv">
+        <span class="muted">Nodes</span><span>${total.nodes}</span>
+        <span class="muted">Allocatable</span><span>${esc(fmt(total.total))}</span>
+        <span class="muted">Running pods</span><span>${running.pods}
+          <span class="muted">(${esc(fmt(running.request))})</span></span>
+        <span class="muted">Pending pods</span><span>${pending.pods}
+          <span class="muted">(${esc(fmt(pending.request))})</span></span>
+      </div>
+      <h3>Nodes</h3>
+      <table><thead><tr><th>Name</th><th>Allocatable</th>
+        <th>TPU accelerator</th><th>TPU topology</th></tr></thead><tbody>
+        ${nodes.map(n => `<tr><td>${esc(n.name)}</td>
+          <td class="muted">${esc(fmt(n.allocatable))}</td>
+          <td class="muted">${esc(n.labels["cloud.google.com/gke-tpu-accelerator"] || "")}</td>
+          <td class="muted">${esc(n.labels["cloud.google.com/gke-tpu-topology"] || "")}</td>
+        </tr>`).join("")}
+      </tbody></table>
+      ${nodes.length ? "" : `<p class="muted">no Node objects
+        (standalone mode reports the local process only)</p>`}
+    </div>`;
+}

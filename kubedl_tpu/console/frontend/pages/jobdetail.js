@@ -1,0 +1,98 @@
+// Job detail page (reference pages/JobDetail): header + tabs for pods,
+// events, per-pod logs, TensorBoard status, and the raw manifest.
+import { api, esc, params, statusCell, t, tabbed } from "../app.js";
+
+export async function viewJobDetail(app) {
+  const q = params();
+  const kind = q.get("kind") || "", ns = q.get("ns") || "";
+  const name = q.get("name") || "";
+  const qs = `kind=${encodeURIComponent(kind)}` +
+             `&namespace=${encodeURIComponent(ns)}` +
+             `&name=${encodeURIComponent(name)}`;
+  const data = await api(`/job/detail?${qs}`);
+  const status = (((data.job.status || {}).conditions || [])
+    .filter(c => c.status === "True").map(c => c.type).pop()) || "Created";
+
+  app.innerHTML = `
+    <div class="panel">
+      <div class="row">
+        <h2 style="margin:0">${esc(name)}</h2>
+        <span class="pill">${esc(kind)}</span>
+        <span class="pill">${esc(ns)}</span>
+        ${statusCell(status)}
+        <span style="flex:1"></span>
+        <button id="refresh" class="ghost">&#8635; refresh</button>
+      </div>
+      <div id="detail-tabs"></div>
+    </div>`;
+  document.getElementById("refresh").onclick = () => viewJobDetail(app);
+
+  const renderPods = el => {
+    el.innerHTML = `
+      <table><thead><tr><th>Name</th><th>Replica</th><th>Status</th>
+        <th>Pod IP</th><th>Host IP</th><th>Started</th><th>Finished</th>
+      </tr></thead><tbody>
+      ${data.pods.map(p => `<tr><td>${esc(p.name)}</td>
+        <td>${esc(p.replica_type)}</td><td>${statusCell(p.status)}</td>
+        <td class="muted">${esc(p.pod_ip)}</td>
+        <td class="muted">${esc(p.host_ip)}</td>
+        <td class="muted">${esc(p.gmt_started)}</td>
+        <td class="muted">${esc(p.gmt_finished)}</td></tr>`).join("")}
+      </tbody></table>`;
+  };
+
+  const renderEvents = el => {
+    el.innerHTML = `
+      <table><thead><tr><th>Time</th><th>Type</th><th>Reason</th>
+        <th>Message</th><th>Count</th></tr></thead><tbody>
+      ${data.events.map(e => `<tr>
+        <td class="muted">${esc(e.last_timestamp)}</td><td>${esc(e.type)}</td>
+        <td>${esc(e.reason)}</td><td>${esc(e.message)}</td>
+        <td class="muted">${esc(e.count)}</td></tr>`).join("")}
+      </tbody></table>`;
+  };
+
+  const renderLogs = el => {
+    const pods = data.pods.map(p => p.name);
+    el.innerHTML = `
+      <div class="row"><select id="log-pod">
+        ${pods.map(p => `<option>${esc(p)}</option>`).join("")}
+      </select></div>
+      <pre id="log-body">select a pod</pre>`;
+    const load = async () => {
+      const pod = el.querySelector("#log-pod").value;
+      if (!pod) { el.querySelector("#log-body").textContent = "no pods"; return; }
+      const lines = await api(
+        `/log/logs/${encodeURIComponent(ns)}/${encodeURIComponent(pod)}`);
+      el.querySelector("#log-body").textContent =
+        (lines || []).join("\n") || "(no log lines)";
+    };
+    el.querySelector("#log-pod").onchange = load;
+    if (pods.length) load();
+  };
+
+  const renderTB = async el => {
+    const tb = await api(`/tensorboard/status?namespace=` +
+      `${encodeURIComponent(ns)}&name=${encodeURIComponent(name)}`);
+    el.innerHTML = `<div class="kv">
+      <span class="muted">TensorBoard pod</span>
+      <span>${statusCell(tb.phase)}</span>
+      <span class="muted">Service</span>
+      <span>${esc(tb.service || "—")}</span></div>`;
+  };
+
+  const renderManifest = async el => {
+    const yaml = await api(
+      `/job/yaml/${encodeURIComponent(ns)}/${encodeURIComponent(name)}` +
+      `?kind=${encodeURIComponent(kind)}`);
+    el.innerHTML = `<pre>${esc(yaml)}</pre>`;
+  };
+
+  tabbed(document.getElementById("detail-tabs"), [
+    { id: "pods", label: t("detail.pods"), render: renderPods },
+    { id: "events", label: t("detail.events"), render: renderEvents },
+    { id: "logs", label: t("detail.logs"), render: renderLogs },
+    { id: "tensorboard", label: "TensorBoard", render: renderTB },
+    { id: "manifest", label: t("detail.manifest"), render: renderManifest },
+  ]);
+}

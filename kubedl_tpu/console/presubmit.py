@@ -34,7 +34,10 @@ def _replica_specs(job: dict, field: str) -> dict:
 def _replicas(spec: dict) -> int:
     if spec is None:
         return 0
-    return int(spec.get("replicas", 1) or 1)
+    val = spec.get("replicas", 1)
+    # absent/None defaults to 1 (k8s nil-replicas semantics); an explicit
+    # 0 stays 0 — the reference counts *Replicas verbatim
+    return 1 if val is None else int(val)
 
 
 def tf_auto_convert_replicas(job: dict) -> None:

