@@ -58,6 +58,17 @@ ANNOTATION_CKPT_REQUESTED_VERSION = KUBEDL_PREFIX + "/ckpt-requested-version"
 ANNOTATION_CKPT_COMPLETED_VERSION = KUBEDL_PREFIX + "/ckpt-completed-version"
 ANNOTATION_READY_TO_START_WORKER = KUBEDL_PREFIX + "/ready-to-start-worker"
 ANNOTATION_IMMEDIATELY_START_WORKER = KUBEDL_PREFIX + "/immediately-start-worker"
+#: in-place restart request (portable CRR analog, elastic_scale.go:~330-400):
+#: the in-container restart agent exits the trainer when this moves past the
+#: generation its container started at
+ANNOTATION_RESTART_REQUESTED_GENERATION = \
+    KUBEDL_PREFIX + "/restart-requested-generation"
+#: restartCount recorded when the restart was requested — the controller
+#: confirms the in-place restart happened by watching this move (the CRR
+#: status analog), and falls back to delete+recreate if it never does
+ANNOTATION_RESTART_BASIS_RESTARTS = \
+    KUBEDL_PREFIX + "/restart-basis-restartcount"
+ANNOTATION_RESTART_REQUESTED_AT = KUBEDL_PREFIX + "/restart-requested-at"
 
 ELASTIC_SCALE_INFLIGHT = "inflight"
 ELASTIC_SCALE_DONE = "done"

@@ -249,11 +249,16 @@ class WorkspaceHandler:
         except KeyError:
             pass
         if rec is not None and rec.pvc_name:
-            try:
-                self.api.delete("PersistentVolumeClaim",
-                                rec.namespace or "default", rec.pvc_name)
-            except NotFound:
-                pass
+            # only reap PVCs this handler created (they carry the workspace
+            # label); an adopted pre-existing PVC is the user's data
+            pvc = self.api.try_get("PersistentVolumeClaim",
+                                   rec.namespace or "default", rec.pvc_name)
+            if pvc is not None and m.labels(pvc).get(WORKSPACE_LABEL) == name:
+                try:
+                    self.api.delete("PersistentVolumeClaim",
+                                    rec.namespace or "default", rec.pvc_name)
+                except NotFound:
+                    pass
 
     def list(self, query: Query) -> list:
         rows = self.backend.list_workspaces(query)

@@ -98,6 +98,10 @@ class JobEngine(Reconciler):
         self._job_states: dict[str, str] = {}  # job uid -> running|pending
         self._tb_jobs: set = set()  # uids that have carried a TB annotation
         self._tb_reap_checked: set = set()  # uids whose TB reap ran at least once
+        #: pod uids whose deletionTimestamp has been counted against the
+        #: deletion expectation (finalizer-held pods emit several MODIFIED
+        #: events while deleting; only the transition counts)
+        self._deletion_seen: set = set()
         api.watch(self._observe)
 
     # ------------------------------------------------------------------
@@ -138,6 +142,20 @@ class JobEngine(Reconciler):
         if event_type == "ADDED":
             self.expectations.creation_observed(key_fn(job_key, rt))
         elif event_type == "DELETED":
+            if m.uid(obj) not in self._deletion_seen:
+                self.expectations.deletion_observed(key_fn(job_key, rt))
+            self._deletion_seen.discard(m.uid(obj))
+        elif event_type == "MODIFIED" and m.is_deleting(obj) \
+                and m.uid(obj) not in self._deletion_seen:
+            # a finalizer-held pod (preempt protector) never emits DELETED
+            # until a reconcile releases the finalizer — but an unsatisfied
+            # deletion expectation would block exactly that reconcile. The
+            # deletionTimestamp appearing proves our delete call landed, so
+            # count it once per pod uid here (the reference escapes this
+            # deadlock by GC'ing finalizers outside ReconcileJobs,
+            # pytorchjob_controller.go:335-355); the DELETED branch skips
+            # uids already counted so a pod is never observed twice
+            self._deletion_seen.add(m.uid(obj))
             self.expectations.deletion_observed(key_fn(job_key, rt))
 
     # ------------------------------------------------------------------
@@ -254,6 +272,9 @@ class JobEngine(Reconciler):
                                   run_policy.scheduling_policy)
 
         # ---- elastic scaling hook --------------------------------------
+        # scale_out/scale_in may return a requeue delay while waiting to
+        # confirm in-place restarts (the CRR-status analog)
+        elastic_requeue = None
         if st.is_running(old_status) and \
                 self.controller.enable_elastic_scaling(job, run_policy):
             if self.controller.checkpoint_if_necessary(job, pods) \
@@ -261,9 +282,11 @@ class JobEngine(Reconciler):
                 total = sum(int(rs.replicas or 1) for rs in replicas.values())
                 latest = _replicas_at_generation(pods, m.generation(job))
                 if total > latest:
-                    self.controller.scale_out(job, replicas, pods, services)
+                    elastic_requeue = self.controller.scale_out(
+                        job, replicas, pods, services)
                 elif total < latest:
-                    self.controller.scale_in(job, replicas, pods, services)
+                    elastic_requeue = self.controller.scale_in(
+                        job, replicas, pods, services)
 
         # ---- per-replica-type diff loops -------------------------------
         restart = [False]
@@ -319,7 +342,8 @@ class JobEngine(Reconciler):
                         self.api.now() - min(gang_ts), kind=self.kind)
 
         self._flush_status(job, status, old_status)
-        requeues = [r for r in (deadline_requeue, tb_requeue) if r and r > 0]
+        requeues = [r for r in (deadline_requeue, tb_requeue, elastic_requeue)
+                    if r and r > 0]
         if requeues:
             return Result(requeue_after=min(requeues))
         return None

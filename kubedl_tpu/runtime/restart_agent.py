@@ -1,0 +1,170 @@
+"""In-container restart agent — the portable analog of OpenKruise's
+ContainerRecreateRequest (reference ``controllers/pytorch/elastic_scale.go``
+~330-400, where stale-generation containers are restarted at the CRI level
+so the pod keeps its node across an elastic resize).
+
+Kubernetes has no portable "restart this container in place" verb, but it
+*does* restart a container whose main process exits (restartPolicy
+OnFailure/Always) while keeping the pod — same UID, same node binding,
+and on GKE TPU the same slice. This agent makes that controllable:
+
+1. The operator patches the pod's ``kubedl.io/restart-requested-generation``
+   annotation (plus the new ``world-size``) instead of deleting the pod.
+2. The agent, wrapped around the training command inside the container,
+   tails the downward-API annotations file; when the requested generation
+   moves past the generation it started at, it gracefully terminates the
+   training process group.
+3. kubelet restarts the container in place; the downward-API ``WORLD_SIZE``
+   env re-resolves against the patched annotation, so the restarted
+   trainer sees the resized world without the slice ever being
+   surrendered.
+
+Usage as PID-1 wrapper::
+
+    python -m kubedl_tpu.runtime.restart_agent -- python train.py --flags
+
+The annotations file is the standard downward-API volume rendering of
+``metadata.annotations`` (``key="escaped value"`` per line), mounted by the
+engine at $KUBEDL_PODINFO_ANNOTATIONS for elastic replicas.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+#: must match controllers.workloads.pytorch restart request annotation
+RESTART_ANNOTATION = "kubedl.io/restart-requested-generation"
+DEFAULT_ANNOTATIONS_PATH = "/etc/kubedl-podinfo/annotations"
+
+
+def parse_annotations_file(text: str) -> dict:
+    """Parse the kubelet's downward-API rendering: one ``key="value"`` per
+    line with Go-escaped values."""
+    out = {}
+    for line in text.splitlines():
+        key, sep, val = line.partition("=")
+        if not sep:
+            continue
+        val = val.strip()
+        if val.startswith('"') and val.endswith('"') and len(val) >= 2:
+            val = val[1:-1]
+            # unescape the common Go quoting (\" \\ \n)
+            val = (val.replace(r"\\", "\x00").replace(r"\"", '"')
+                      .replace(r"\n", "\n").replace("\x00", "\\"))
+        out[key.strip()] = val
+    return out
+
+
+def read_requested_generation(path: str) -> int:
+    try:
+        with open(path) as f:
+            anns = parse_annotations_file(f.read())
+    except OSError:
+        return 0
+    try:
+        return int(anns.get(RESTART_ANNOTATION, 0) or 0)
+    except ValueError:
+        return 0
+
+
+@dataclass
+class RestartAgent:
+    """Supervises one training process; exits it when a restart is
+    requested so kubelet recreates the container in place."""
+
+    annotations_path: str = DEFAULT_ANNOTATIONS_PATH
+    poll_interval: float = 2.0
+    grace_period: float = 30.0
+    #: test seam: agent-observed restarts (generation transitions)
+    on_restart: Optional[Callable[[int], None]] = None
+
+    def run(self, argv: list) -> int:
+        """Exec ``argv`` as a child process group and supervise it.
+
+        Returns the child's exit code, or 64 + SIGTERM after a requested
+        restart (a nonzero code, so OnFailure restart policies fire).
+
+        The agent usually runs as PID 1, and the child lives in its own
+        session (trainers fork dataloaders; we signal the whole group) —
+        so pod termination signals land on the agent only. They are
+        forwarded to the child's group, preserving graceful
+        checkpoint-on-preempt (the point of the preempt-protector
+        protocol)."""
+        baseline = read_requested_generation(self.annotations_path)
+        child = subprocess.Popen(argv, start_new_session=True)
+        stop = {"sig": None}
+
+        def forward(signum, frame):
+            stop["sig"] = signum
+
+        prev_handlers = {}
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            try:
+                prev_handlers[sig] = signal.signal(sig, forward)
+            except ValueError:
+                pass  # non-main thread (tests): kubelet path unaffected
+        try:
+            while True:
+                code = child.poll()
+                if code is not None:
+                    return code
+                if stop["sig"] is not None:
+                    self._terminate(child)
+                    return 128 + stop["sig"]
+                current = read_requested_generation(self.annotations_path)
+                if current > baseline:
+                    if self.on_restart is not None:
+                        self.on_restart(current)
+                    self._terminate(child)
+                    return 64 + signal.SIGTERM
+                time.sleep(self.poll_interval)
+        finally:
+            if child.poll() is None:
+                self._terminate(child)
+            for sig, handler in prev_handlers.items():
+                signal.signal(sig, handler)
+
+    def _terminate(self, child: subprocess.Popen) -> None:
+        """SIGTERM the whole process group (trainers fork dataloaders),
+        escalate to SIGKILL after the grace period — the same downgrade
+        kubelet applies on container stop."""
+        try:
+            os.killpg(child.pid, signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            return
+        deadline = time.monotonic() + self.grace_period
+        while time.monotonic() < deadline:
+            if child.poll() is not None:
+                return
+            time.sleep(0.1)
+        try:
+            os.killpg(child.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        child.wait()
+
+
+def main(argv: Optional[list] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "--":
+        argv = argv[1:]
+    if not argv:
+        print("usage: python -m kubedl_tpu.runtime.restart_agent -- CMD...",
+              file=sys.stderr)
+        return 2
+    agent = RestartAgent(
+        annotations_path=os.environ.get("KUBEDL_PODINFO_ANNOTATIONS",
+                                        DEFAULT_ANNOTATIONS_PATH),
+        poll_interval=float(os.environ.get("KUBEDL_RESTART_POLL_S", 2.0)),
+        grace_period=float(os.environ.get("KUBEDL_RESTART_GRACE_S", 30.0)))
+    return agent.run(argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
