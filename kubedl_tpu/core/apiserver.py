@@ -137,7 +137,9 @@ class APIServer:
             return None
 
     def list(self, kind: str, namespace: Optional[str] = None,
-             selector: Optional[dict] = None) -> list[Obj]:
+             selector: Optional[dict] = None,
+             field_selector: Optional[object] = None) -> list[Obj]:
+        fields = _parse_field_selector(field_selector)
         with self._lock:
             out = []
             for (kd, ns, _), obj in self._objs.items():
@@ -147,6 +149,9 @@ class APIServer:
                     continue
                 if selector is not None and not m.match_labels(
                         m.meta(obj).get("labels", {}) or {}, selector):
+                    continue
+                if any(str(m.get_in(obj, *path.split("."), default=""))
+                       != want for path, want in fields):
                     continue
                 out.append(copy.deepcopy(obj))
             out.sort(key=lambda o: (m.namespace(o), m.name(o)))
@@ -280,6 +285,18 @@ class APIServer:
     def __len__(self):
         with self._lock:
             return len(self._objs)
+
+
+def _parse_field_selector(field_selector) -> list:
+    """``{"status.phase": "Running"}`` or ``"metadata.name=x,..."`` →
+    [(path, value)] (the subset of fieldSelector semantics kube-apiservers
+    support: exact equality on dotted paths)."""
+    if not field_selector:
+        return []
+    if isinstance(field_selector, str):
+        pairs = (cond.partition("=") for cond in field_selector.split(","))
+        return [(path, want) for path, _, want in pairs if path]
+    return [(path, str(want)) for path, want in sorted(field_selector.items())]
 
 
 def _merge(base, patch):

@@ -31,6 +31,7 @@ import http.client
 import json
 import logging
 import os
+import random
 import ssl
 import tempfile
 import threading
@@ -193,17 +194,38 @@ def _data_file(b64: Optional[str], tag: str) -> Optional[str]:
 
 # -- the client --------------------------------------------------------------
 
+class _Backoff:
+    """Exponential backoff with full jitter (a flat retry cadence across
+    watchers turns an apiserver outage into a synchronized hammer —
+    round-2 weak #3)."""
+
+    def __init__(self, base: float = 1.0, cap: float = 30.0):
+        self.base = base
+        self.cap = cap
+        self._n = 0
+
+    def next(self) -> float:
+        delay = min(self.cap, self.base * (2 ** self._n))
+        self._n = min(self._n + 1, 16)
+        return random.uniform(0, delay)
+
+    def reset(self) -> None:
+        self._n = 0
+
+
 class KubeAPIServer:
     """``APIServer``-interface adapter over a real kube-apiserver."""
 
     def __init__(self, config: ClusterConfig,
                  clock: Callable[[], float] = time.time,
                  request_timeout: float = 30.0,
-                 watch_timeout_seconds: int = 300):
+                 watch_timeout_seconds: int = 300,
+                 list_page_size: int = 500):
         self.config = config
         self._clock = clock
         self._timeout = request_timeout
         self._watch_timeout = watch_timeout_seconds
+        self.list_page_size = list_page_size
         self._scheme = dict(DEFAULT_SCHEME)
         self._plural_cache: dict[str, tuple[str, str]] = {}
         self._local = threading.local()
@@ -246,20 +268,32 @@ class KubeAPIServer:
         if params:
             path = path + "?" + urllib.parse.urlencode(params)
         payload = json.dumps(body).encode() if body is not None else None
-        for attempt in (0, 1):
+        # reads retry transient trouble (transport + 429/5xx) with jittered
+        # backoff; mutations NEVER auto-retry — the request may have been
+        # delivered before the connection died, and a replayed POST/PUT is
+        # not idempotent. Reconcile-level backoff absorbs the raised error.
+        attempts = 3 if method == "GET" else 0
+        backoff = _Backoff(base=0.5, cap=5.0)
+        for attempt in range(attempts + 1):
             conn = self._conn()
             try:
                 conn.request(method, path, body=payload,
                              headers=self._headers(content_type))
                 resp = conn.getresponse()
                 data = resp.read()
-                break
             except (http.client.HTTPException, OSError):
-                # stale kept-alive connection: rebuild once, then surface
+                # drop the (possibly stale kept-alive) connection either way
                 self._local.conn = None
                 conn.close()
-                if attempt:
+                if attempt >= attempts:
                     raise
+                time.sleep(backoff.next())
+                continue
+            if method == "GET" and attempt < attempts \
+                    and (resp.status == 429 or resp.status >= 500):
+                time.sleep(backoff.next())
+                continue
+            break
         if resp.status >= 400:
             raise self._error(resp.status, data, method, path)
         return json.loads(data) if data else {}
@@ -335,20 +369,50 @@ class KubeAPIServer:
             return None
 
     def list(self, kind: str, namespace: Optional[str] = None,
-             selector: Optional[dict] = None) -> list[Obj]:
+             selector: Optional[dict] = None,
+             field_selector: Optional[object] = None) -> list[Obj]:
+        items, _ = self._paged_list(kind, namespace, selector, field_selector)
+        return items
+
+    def _paged_list(self, kind: str, namespace: Optional[str],
+                    selector: Optional[dict] = None,
+                    field_selector: Optional[object] = None
+                    ) -> tuple[list[Obj], str]:
+        """Chunked LIST via ``limit``+``continue`` (one giant response per
+        relist was round-2 weak #3). Returns (items, collection RV) — the
+        RV of the final page is the correct point to start a watch from."""
         params = {}
         if selector:
             params["labelSelector"] = ",".join(
                 f"{k}={v}" for k, v in sorted(selector.items()))
-        out = self._request("GET", self._path(kind, namespace),
-                            params=params or None)
-        items = out.get("items", []) or []
-        for it in items:
-            # list items omit apiVersion/kind; put them back so downstream
-            # meta helpers (and re-submission) see complete objects
-            it.setdefault("kind", kind)
-            it.setdefault("apiVersion", self.mapping(kind)[0])
-        return items
+        if field_selector:
+            params["fieldSelector"] = (
+                field_selector if isinstance(field_selector, str)
+                else ",".join(f"{k}={v}"
+                              for k, v in sorted(field_selector.items())))
+        av = self.mapping(kind)[0]
+        items: list[Obj] = []
+        rv = "0"
+        cont = ""
+        while True:
+            page = dict(params)
+            page["limit"] = str(self.list_page_size)
+            if cont:
+                page["continue"] = cont
+            out = self._request("GET", self._path(kind, namespace),
+                                params=page)
+            chunk = out.get("items", []) or []
+            for it in chunk:
+                # list items omit apiVersion/kind; put them back so
+                # downstream meta helpers see complete objects
+                it.setdefault("kind", kind)
+                it.setdefault("apiVersion", av)
+            items.extend(chunk)
+            rv = str(m.get_in(out, "metadata", "resourceVersion",
+                              default=rv) or rv)
+            cont = str(m.get_in(out, "metadata", "continue", default="") or "")
+            if not cont:
+                return items, rv
 
     def update(self, obj: Obj, subresource: Optional[str] = None) -> Obj:
         self._learn(obj)
@@ -406,27 +470,28 @@ class KubeAPIServer:
 
     def _watch_loop(self, kind: str, namespace: Optional[str]) -> None:
         rv: Optional[str] = None
+        backoff = _Backoff(base=1.0, cap=30.0)
         while not self._stopping.is_set():
             try:
                 if rv is None:
-                    av, plural = self.mapping(kind)
-                    out = self._request("GET", self._path(kind, namespace))
-                    rv = str(m.get_in(out, "metadata", "resourceVersion",
-                                      default="0") or "0")
-                    for it in out.get("items", []) or []:
-                        it.setdefault("kind", kind)
-                        it.setdefault("apiVersion", av)
+                    items, rv = self._paged_list(kind, namespace)
+                    for it in items:
                         self._emit("ADDED", it)
                 rv = self._watch_once(kind, namespace, rv)
+                backoff.reset()  # a full watch window without error
             except ApiError as e:
                 if getattr(e, "code", None) == 410:
                     rv = None  # 410 Gone: relist
                 else:
-                    log.warning("watch %s: %s; retrying", kind, e)
-                    time.sleep(1.0)
+                    delay = backoff.next()
+                    log.warning("watch %s: %s; retrying in %.1fs", kind, e,
+                                delay)
+                    self._stopping.wait(delay)
             except Exception:
-                log.exception("watch %s failed; retrying", kind)
-                time.sleep(1.0)
+                delay = backoff.next()
+                log.exception("watch %s failed; retrying in %.1fs", kind,
+                              delay)
+                self._stopping.wait(delay)
 
     def _watch_once(self, kind: str, namespace: Optional[str],
                     rv: str) -> str:

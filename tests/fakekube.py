@@ -138,11 +138,26 @@ def _make_handler(fk: FakeKube):
                     sel = dict(kv.split("=", 1)
                                for kv in params["labelSelector"].split(","))
                 items = fk.api.list(kind, namespace=ns, selector=sel)
+                if params.get("fieldSelector"):
+                    for cond in params["fieldSelector"].split(","):
+                        fpath, _, want = cond.partition("=")
+                        items = [it for it in items
+                                 if str(m.get_in(it, *fpath.split("."),
+                                                 default="")) == want]
+                md = {"resourceVersion":
+                      str(fk.api.latest_resource_version())}
+                # limit/continue chunking (continue token = plain offset;
+                # real apiservers use an opaque token — the client treats
+                # it opaquely either way)
+                limit = int(params.get("limit") or 0)
+                offset = int(params.get("continue") or 0)
+                if limit:
+                    page = items[offset:offset + limit]
+                    if offset + limit < len(items):
+                        md["continue"] = str(offset + limit)
+                    items = page
                 self._send(200, {
-                    "kind": f"{kind}List",
-                    "metadata": {"resourceVersion":
-                                 str(fk.api.latest_resource_version())},
-                    "items": items})
+                    "kind": f"{kind}List", "metadata": md, "items": items})
             except Exception as e:  # noqa: BLE001
                 self._send_err(e)
 
