@@ -242,18 +242,22 @@ class KubeAPIServer:
     def now(self) -> float:
         return self._clock()
 
-    def _conn(self) -> http.client.HTTPConnection:
+    def _conn(self) -> tuple[http.client.HTTPConnection, bool]:
+        """Returns (connection, reused): ``reused`` drives the
+        stale-keep-alive retry policy — a reused connection that fails is
+        almost always the server having reaped it while idle."""
         conn = getattr(self._local, "conn", None)
-        if conn is None:
-            if self._https:
-                conn = http.client.HTTPSConnection(
-                    self._host, self._port, timeout=self._timeout,
-                    context=self.config.ssl_context())
-            else:
-                conn = http.client.HTTPConnection(
-                    self._host, self._port, timeout=self._timeout)
-            self._local.conn = conn
-        return conn
+        if conn is not None:
+            return conn, True
+        if self._https:
+            conn = http.client.HTTPSConnection(
+                self._host, self._port, timeout=self._timeout,
+                context=self.config.ssl_context())
+        else:
+            conn = http.client.HTTPConnection(
+                self._host, self._port, timeout=self._timeout)
+        self._local.conn = conn
+        return conn, False
 
     def _headers(self, content_type: str = "application/json") -> dict:
         h = {"Accept": "application/json", "Content-Type": content_type}
@@ -269,13 +273,17 @@ class KubeAPIServer:
             path = path + "?" + urllib.parse.urlencode(params)
         payload = json.dumps(body).encode() if body is not None else None
         # reads retry transient trouble (transport + 429/5xx) with jittered
-        # backoff; mutations NEVER auto-retry — the request may have been
-        # delivered before the connection died, and a replayed POST/PUT is
-        # not idempotent. Reconcile-level backoff absorbs the raised error.
+        # backoff. Mutations never retry a request a FRESH connection may
+        # have delivered (a replayed POST/PUT is not idempotent) — but a
+        # REUSED keep-alive connection that fails gets one retry on a
+        # fresh connection: the server reaping an idle connection is the
+        # overwhelmingly common cause, and it fails before delivery
+        # (the Go net/http retry policy).
         attempts = 3 if method == "GET" else 0
         backoff = _Backoff(base=0.5, cap=5.0)
-        for attempt in range(attempts + 1):
-            conn = self._conn()
+        attempt = 0
+        while True:
+            conn, reused = self._conn()
             try:
                 conn.request(method, path, body=payload,
                              headers=self._headers(content_type))
@@ -285,13 +293,17 @@ class KubeAPIServer:
                 # drop the (possibly stale kept-alive) connection either way
                 self._local.conn = None
                 conn.close()
+                if reused:
+                    continue  # retry once on a fresh connection, any verb
                 if attempt >= attempts:
                     raise
-                time.sleep(backoff.next())
+                attempt += 1
+                self._stopping.wait(backoff.next())
                 continue
             if method == "GET" and attempt < attempts \
                     and (resp.status == 429 or resp.status >= 500):
-                time.sleep(backoff.next())
+                attempt += 1
+                self._stopping.wait(backoff.next())
                 continue
             break
         if resp.status >= 400:
@@ -481,7 +493,12 @@ class KubeAPIServer:
                 backoff.reset()  # a full watch window without error
             except ApiError as e:
                 if getattr(e, "code", None) == 410:
-                    rv = None  # 410 Gone: relist
+                    # 410 Gone: relist — with backoff, because an expired
+                    # continue token mid-relist also lands here and a
+                    # zero-delay relist loop is the hammer _Backoff exists
+                    # to prevent
+                    rv = None
+                    self._stopping.wait(backoff.next())
                 else:
                     delay = backoff.next()
                     log.warning("watch %s: %s; retrying in %.1fs", kind, e,

@@ -283,7 +283,10 @@ class MemoryBackend(ObjectBackend, EventBackend):
 
 
 # ---------------------------------------------------------------------------
-# SQLite backend (the MySQL/gorm analog, reference backends/objects/mysql)
+# SQL backends (the MySQL/gorm analog, reference backends/objects/mysql).
+# The query surface is DB-API paramstyle-agnostic: SQLiteBackend is the
+# embedded default, MySQLBackend (storage/external.py) reuses every query
+# against a real MySQL server.
 # ---------------------------------------------------------------------------
 
 _SCHEMA = """

@@ -137,13 +137,9 @@ def _make_handler(fk: FakeKube):
                 if params.get("labelSelector"):
                     sel = dict(kv.split("=", 1)
                                for kv in params["labelSelector"].split(","))
-                items = fk.api.list(kind, namespace=ns, selector=sel)
-                if params.get("fieldSelector"):
-                    for cond in params["fieldSelector"].split(","):
-                        fpath, _, want = cond.partition("=")
-                        items = [it for it in items
-                                 if str(m.get_in(it, *fpath.split("."),
-                                                 default="")) == want]
+                items = fk.api.list(
+                    kind, namespace=ns, selector=sel,
+                    field_selector=params.get("fieldSelector") or None)
                 md = {"resourceVersion":
                       str(fk.api.latest_resource_version())}
                 # limit/continue chunking (continue token = plain offset;

@@ -112,14 +112,27 @@ def test_paginated_list_relists_three_pages(fake):
     try:
         for i in range(11):
             client.create(tfjob(f"tf-{i:02d}"))
-        # count the HTTP pages the fake served
-        items, rv = client._paged_list("TFJob", "default")
+        # count the HTTP pages actually served: regressing to an
+        # unchunked LIST must fail this test, not silently pass
+        calls = []
+        real_list = fake.api.list
+
+        def counting_list(*a, **kw):
+            calls.append(kw)
+            return real_list(*a, **kw)
+
+        fake.api.list = counting_list
+        try:
+            items, rv = client._paged_list("TFJob", "default")
+        finally:
+            fake.api.list = real_list
         assert len(items) == 11
         assert sorted(m.name(it) for it in items) == \
             [f"tf-{i:02d}" for i in range(11)]
         assert int(rv) > 0
-        # 11 items / page size 4 -> exactly 3 pages, which means the
-        # continue token round-tripped twice
+        # 11 items / page size 4 -> exactly 3 pages (continue token
+        # round-tripped twice)
+        assert len(calls) == 3
         assert all(m.kind(it) == "TFJob" for it in items)
     finally:
         client.stop()
