@@ -35,6 +35,9 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="comma list, e.g. GangScheduling=true,DAGScheduling=false")
     p.add_argument("--hostnetwork-port-range", default="",
                    help="BASE-END, default 20000-30000")
+    p.add_argument("--kubectl-delivery-image", default="",
+                   help="utility image that drops a kubectl binary into the "
+                        "MPI launcher (reference mpijob_controller.go:52)")
     p.add_argument("--object-storage", default="",
                    help='persistence: memory | sqlite | sqlite://<path>')
     p.add_argument("--event-storage", default="")
@@ -91,6 +94,7 @@ def config_from_args(args: argparse.Namespace) -> OperatorConfig:
         event_storage=args.event_storage,
         deploy_region=args.deploy_region,
         dns_domain=args.dns_domain,
+        kubectl_delivery_image=args.kubectl_delivery_image,
     )
 
 

@@ -49,6 +49,9 @@ class OperatorConfig:
     max_reconciles: int = 1
     #: builder image for ModelVersion image builds (--model-image-builder)
     model_image_builder: str = ""
+    #: --kubectl-delivery-image: utility image dropping kubectl into the
+    #: MPI launcher ("" = the controller's default)
+    kubectl_delivery_image: str = ""
     #: --feature-gates; None = process default gates
     feature_gates: Optional[ft.FeatureGates] = None
     #: --hostnetwork-port-range (base, size)
@@ -121,6 +124,9 @@ def build_operator(api: Optional[APIServer] = None,
             continue
         ctrl = ctrl_cls(api)
         ctrl.dns_domain = config.dns_domain
+        if config.kubectl_delivery_image \
+                and hasattr(ctrl, "kubectl_delivery_image"):
+            ctrl.kubectl_delivery_image = config.kubectl_delivery_image
         engine = JobEngine(api, ctrl, engine_config, metrics=metrics,
                            recorder=recorder, gang=gang)
         manager.register(engine)

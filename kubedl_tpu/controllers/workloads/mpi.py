@@ -14,11 +14,21 @@ the reference, listing worker DNS names with ``slots=<chips per host>``.
 
 from __future__ import annotations
 
+import logging
+
 from ...api import common as c
 from ...core import meta as m
-from ...core.apiserver import AlreadyExists
+from ...core.apiserver import AlreadyExists, ApiError
 from ...tpu import placement as pl
 from ..interface import TPUPolicy, WorkloadController
+
+log = logging.getLogger("kubedl_tpu.mpi")
+
+#: reference mpi_config.go:34-41
+KUBECTL_MOUNT_PATH = "/opt/kube"
+KUBECTL_VOLUME = "mpi-kubectl-delivery"
+CONFIG_VOLUME = "mpi-job-config"
+CONFIG_MOUNT_PATH = "/etc/mpi"
 
 
 class MPIJobController(WorkloadController):
@@ -28,6 +38,11 @@ class MPIJobController(WorkloadController):
     default_port_name = "mpijob-port"
     default_port = 9999
     replica_specs_field_name = "mpiReplicaSpecs"
+    #: --kubectl-delivery-image analog (reference mpijob_controller.go:52):
+    #: utility image whose entrypoint copies a kubectl binary into
+    #: $TARGET_DIR, so the launcher image needs no kubectl of its own.
+    #: Overridden per instance from OperatorConfig.kubectl_delivery_image.
+    kubectl_delivery_image = "kubedl/kubectl-delivery:latest"
 
     def get_reconcile_orders(self):
         return [c.REPLICA_AIMASTER, "Worker", "Launcher"]
@@ -62,18 +77,62 @@ class MPIJobController(WorkloadController):
             f"{m.name(job)}-worker-{i} slots={slots}" for i in range(workers))
         if rt == "launcher":
             self._ensure_hostfile_configmap(job, hostfile)
-            vols = pod["spec"].setdefault("volumes", [])
-            if not any(v.get("name") == "mpi-job-config" for v in vols):
-                vols.append({"name": "mpi-job-config",
-                             "configMap": {"name": f"{m.name(job)}-config"}})
+            rbac_ok = self._ensure_launcher_rbac(job)
+            spec = pod["spec"]
+            vols = spec.setdefault("volumes", [])
+            if not any(v.get("name") == CONFIG_VOLUME for v in vols):
+                # kubexec.sh executable, hostfile read-only (reference
+                # mpijob_controller.go:358-383 scriptsMode/hostfileMode)
+                vols.append({"name": CONFIG_VOLUME, "configMap": {
+                    "name": f"{m.name(job)}-config",
+                    "items": [
+                        {"key": "kubexec.sh", "path": "kubexec.sh",
+                         "mode": 0o555},
+                        {"key": "hostfile", "path": "hostfile",
+                         "mode": 0o444},
+                    ]}})
+            if not any(v.get("name") == KUBECTL_VOLUME for v in vols):
+                vols.append({"name": KUBECTL_VOLUME, "emptyDir": {}})
+            # kubectl-delivery init container (mpijob_controller.go:312-352):
+            # drops a kubectl binary into the shared volume so kubexec.sh
+            # can exec into workers from any launcher image
+            inits = spec.setdefault("initContainers", [])
+            if not any(ic.get("name") == "kubectl-delivery" for ic in inits):
+                inits.append({
+                    "name": "kubectl-delivery",
+                    "image": self.kubectl_delivery_image,
+                    "imagePullPolicy": "IfNotPresent",
+                    "env": [
+                        {"name": "TARGET_DIR", "value": KUBECTL_MOUNT_PATH},
+                        {"name": "NAMESPACE", "value": m.namespace(job)},
+                    ],
+                    "resources": {"requests": {"cpu": "100m",
+                                               "memory": "64Mi"}},
+                    "volumeMounts": [
+                        {"name": KUBECTL_VOLUME,
+                         "mountPath": KUBECTL_MOUNT_PATH},
+                        {"name": CONFIG_VOLUME,
+                         "mountPath": CONFIG_MOUNT_PATH},
+                    ]})
+            # per-job ServiceAccount so kubectl exec inside kubexec.sh is
+            # actually authorized (no ambient cluster-admin assumption);
+            # left unset if RBAC creation failed (cluster without the
+            # pods/exec grants) so the pod falls back to the namespace SA
+            if rbac_ok:
+                spec.setdefault("serviceAccountName",
+                                f"{m.name(job)}-launcher")
             for ct in m.get_in(pod, "spec", "containers", default=[]) or []:
                 mounts = ct.setdefault("volumeMounts", [])
-                if not any(mt.get("name") == "mpi-job-config" for mt in mounts):
-                    mounts.append({"name": "mpi-job-config",
-                                   "mountPath": "/etc/mpi"})
+                if not any(mt.get("name") == CONFIG_VOLUME for mt in mounts):
+                    mounts.append({"name": CONFIG_VOLUME,
+                                   "mountPath": CONFIG_MOUNT_PATH})
+                if not any(mt.get("name") == KUBECTL_VOLUME for mt in mounts):
+                    mounts.append({"name": KUBECTL_VOLUME,
+                                   "mountPath": KUBECTL_MOUNT_PATH})
                 pl.upsert_env(ct, "OMPI_MCA_orte_default_hostfile",
-                              "/etc/mpi/hostfile")
-                pl.upsert_env(ct, "OMPI_MCA_plm_rsh_agent", "/etc/mpi/kubexec.sh")
+                              f"{CONFIG_MOUNT_PATH}/hostfile")
+                pl.upsert_env(ct, "OMPI_MCA_plm_rsh_agent",
+                              f"{CONFIG_MOUNT_PATH}/kubexec.sh")
                 pl.upsert_env(ct, "OMPI_MCA_orte_keep_fqdn_hostnames", "t")
                 pl.upsert_env(ct, "KUBEDL_WORKER_HOSTS", hostfile.replace("\n", ","))
         else:
@@ -89,6 +148,48 @@ class MPIJobController(WorkloadController):
             return policy.resolve().chips_per_host
         return 1
 
+    def _ensure_launcher_rbac(self, job) -> bool:
+        """Per-job ServiceAccount + Role + RoleBinding granting exactly
+        what kubexec.sh needs: get/list pods and create pods/exec in the
+        job's namespace. Owner-referenced, so they GC with the job.
+
+        Returns False (without raising) when the cluster refuses — e.g.
+        the manager ClusterRole lacks the pods/exec grant RBAC escalation
+        prevention requires — so launcher creation degrades to the
+        namespace default ServiceAccount instead of wedging the job.
+        ``config/rbac/role.yaml`` carries the needed grants."""
+        if self.api is None:
+            return False
+        ns = m.namespace(job)
+        name = f"{m.name(job)}-launcher"
+        sa = m.new_obj("v1", "ServiceAccount", name, ns)
+        role = m.new_obj("rbac.authorization.k8s.io/v1", "Role", name, ns)
+        role["rules"] = [
+            {"apiGroups": [""], "resources": ["pods"],
+             "verbs": ["get", "list", "watch"]},
+            {"apiGroups": [""], "resources": ["pods/exec"],
+             "verbs": ["create"]},
+        ]
+        binding = m.new_obj("rbac.authorization.k8s.io/v1", "RoleBinding",
+                            name, ns)
+        binding["subjects"] = [{"kind": "ServiceAccount", "name": name,
+                                "namespace": ns}]
+        binding["roleRef"] = {"apiGroup": "rbac.authorization.k8s.io",
+                              "kind": "Role", "name": name}
+        for obj in (sa, role, binding):
+            m.set_controller_ref(obj, job)
+            if self.api.try_get(m.kind(obj), ns, name) is None:
+                try:
+                    self.api.create(obj)
+                except AlreadyExists:
+                    pass
+                except ApiError as e:
+                    log.warning(
+                        "launcher RBAC for %s/%s degraded (%s %s): %s",
+                        ns, m.name(job), m.kind(obj), name, e)
+                    return False
+        return True
+
     def _ensure_hostfile_configmap(self, job, hostfile: str) -> None:
         """ConfigMap with hostfile + kubexec.sh (reference
         mpi_config.go:49-124)."""
@@ -96,7 +197,8 @@ class MPIJobController(WorkloadController):
             return
         name = f"{m.name(job)}-config"
         kubexec = ("#!/bin/sh\nset -x\nPOD_NAME=$1\nshift\n"
-                   'exec kubectl exec ${POD_NAME} -- /bin/sh -c "$*"\n')
+                   f'exec {KUBECTL_MOUNT_PATH}/kubectl exec ${{POD_NAME}}'
+                   ' -- /bin/sh -c "$*"\n')
         cm = m.new_obj("v1", "ConfigMap", name, m.namespace(job))
         cm["data"] = {"hostfile": hostfile, "kubexec.sh": kubexec}
         m.set_controller_ref(cm, job)

@@ -284,18 +284,39 @@ class KubeAPIServer:
         attempt = 0
         while True:
             conn, reused = self._conn()
+            sent = False
             try:
                 conn.request(method, path, body=payload,
                              headers=self._headers(content_type))
+                sent = True
                 resp = conn.getresponse()
                 data = resp.read()
+            except http.client.RemoteDisconnected:
+                self._local.conn = None
+                conn.close()
+                if reused:
+                    # clean close with ZERO response bytes on a reused
+                    # keep-alive: the server reaped the idle connection
+                    # before processing anything — safe to replay any verb
+                    # (the Go net/http retry rule). On a fresh connection
+                    # this is a real server-side close: normal policy.
+                    continue
+                if method != "GET" or attempt >= attempts:
+                    raise
+                attempt += 1
+                self._stopping.wait(backoff.next())
+                continue
             except (http.client.HTTPException, OSError):
                 # drop the (possibly stale kept-alive) connection either way
                 self._local.conn = None
                 conn.close()
-                if reused:
-                    continue  # retry once on a fresh connection, any verb
-                if attempt >= attempts:
+                if reused and not sent:
+                    # send-time failure: the request never left, replaying
+                    # any verb is safe. Post-send failures (timeout
+                    # mid-response) never replay mutations — the server
+                    # may have acted.
+                    continue
+                if method != "GET" or attempt >= attempts:
                     raise
                 attempt += 1
                 self._stopping.wait(backoff.next())

@@ -124,6 +124,62 @@ def test_mpi_hostfile(api, op):
                for v in launcher["spec"]["volumes"])
 
 
+def test_mpi_launcher_kubectl_delivery_and_rbac(api, op):
+    """Golden spec for the launcher plumbing (reference
+    mpijob_controller.go:312-395 + per-job RBAC): kubectl-delivery init
+    container, shared kubectl/config volumes, kubexec using the delivered
+    binary, and an owner-referenced SA/Role/RoleBinding scoped to
+    pods + pods/exec."""
+    api.create(mk_job("MPIJob", "mpiReplicaSpecs", {
+        "Launcher": (1, "mpi", ("mpijob-port", 9999)),
+        "Worker": (2, "mpi", ("mpijob-port", 9999)),
+    }))
+    op.run_until_idle()
+    launcher = api.get("Pod", "default", "j1-launcher-0")
+    spec = launcher["spec"]
+
+    inits = spec.get("initContainers", [])
+    assert [ic["name"] for ic in inits] == ["kubectl-delivery"]
+    ic = inits[0]
+    env = {e["name"]: e["value"] for e in ic["env"]}
+    assert env["TARGET_DIR"] == "/opt/kube"
+    assert env["NAMESPACE"] == "default"
+    assert {vm["name"] for vm in ic["volumeMounts"]} == {
+        "mpi-kubectl-delivery", "mpi-job-config"}
+
+    vols = {v["name"]: v for v in spec["volumes"]}
+    assert "emptyDir" in vols["mpi-kubectl-delivery"]
+    items = {it["key"]: it["mode"]
+             for it in vols["mpi-job-config"]["configMap"]["items"]}
+    assert items == {"kubexec.sh": 0o555, "hostfile": 0o444}
+
+    # the launcher's main container sees both volumes and the delivered
+    # kubectl path inside kubexec.sh
+    main = spec["containers"][0]
+    assert {vm["name"] for vm in main["volumeMounts"]} >= {
+        "mpi-kubectl-delivery", "mpi-job-config"}
+    cm = api.get("ConfigMap", "default", "j1-config")
+    assert "/opt/kube/kubectl exec" in cm["data"]["kubexec.sh"]
+
+    # per-job RBAC, owned by the job (GCs with it)
+    assert spec["serviceAccountName"] == "j1-launcher"
+    sa = api.get("ServiceAccount", "default", "j1-launcher")
+    role = api.get("Role", "default", "j1-launcher")
+    binding = api.get("RoleBinding", "default", "j1-launcher")
+    for obj in (sa, role, binding):
+        assert m.get_controller_ref(obj)["kind"] == "MPIJob"
+    verbs = {rule["resources"][0]: rule["verbs"] for rule in role["rules"]}
+    assert "create" in verbs["pods/exec"]
+    assert "list" in verbs["pods"]
+    assert binding["subjects"][0]["name"] == "j1-launcher"
+    assert binding["roleRef"]["name"] == "j1-launcher"
+
+    # workers get neither the init container nor the SA override
+    worker = api.get("Pod", "default", "j1-worker-0")
+    assert not worker["spec"].get("initContainers")
+    assert worker["spec"].get("serviceAccountName") != "j1-launcher"
+
+
 def test_mpi_tpu_slots_from_topology(api, op):
     api.create(mk_job("MPIJob", "mpiReplicaSpecs", {
         "Launcher": (1, "mpi", ("mpijob-port", 9999)),
