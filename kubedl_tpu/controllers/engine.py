@@ -645,7 +645,8 @@ class JobEngine(Reconciler):
         # hostnetwork: random port per replica (reference pod.go:509-521)
         hostnet_port: Optional[int] = None
         if hostnet_ports is not None:
-            port = hn.random_port(self.config.hostnetwork_port_range)
+            port = hn.random_port(self.config.hostnetwork_port_range,
+                                  exclude=set(hostnet_ports.values()))
             if hn.setup_pod_hostnetwork(
                     pod, self.controller.default_container_name,
                     self.controller.default_port_name, port):

@@ -283,17 +283,25 @@ def review_response(chain: AdmissionChain, review: dict,
             "response": resp}
 
 
-def _json_patch(before: dict, after: dict) -> list:
+def _json_patch(before: dict, after: dict, path: str = "") -> list:
+    """Per-path JSONPatch: descend into changed sub-objects so a defaulter
+    touching one replica count patches only that leaf, not the whole
+    ``spec`` — a top-level replace races against concurrent mutating
+    webhooks patching sibling fields (round-2 weak #6). Lists are treated
+    atomically (index-wise patches are not meaningfully mergeable)."""
     ops = []
     for key, val in after.items():
+        p = f"{path}/{_esc(key)}"
         if key not in before:
-            ops.append({"op": "add", "path": f"/{_esc(key)}", "value": val})
+            ops.append({"op": "add", "path": p, "value": val})
         elif before[key] != val:
-            ops.append({"op": "replace", "path": f"/{_esc(key)}",
-                        "value": val})
+            if isinstance(before[key], dict) and isinstance(val, dict):
+                ops.extend(_json_patch(before[key], val, p))
+            else:
+                ops.append({"op": "replace", "path": p, "value": val})
     for key in before:
         if key not in after:
-            ops.append({"op": "remove", "path": f"/{_esc(key)}"})
+            ops.append({"op": "remove", "path": f"{path}/{_esc(key)}"})
     return ops
 
 

@@ -16,6 +16,7 @@ TFServing REST convention the console/tooling already speak:
 from __future__ import annotations
 
 import json
+import logging
 import threading
 from dataclasses import dataclass
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -136,3 +137,7 @@ class _Handler(BaseHTTPRequestHandler):
             self._respond(200, self.server_ref.predict(body))
         except (ValueError, KeyError, TypeError) as e:
             self._respond(400, {"error": str(e)})
+        except Exception as e:  # noqa: BLE001 — a crashed predict must
+            # surface as a JSON 500, not a dropped connection (ADVICE r1)
+            logging.getLogger("kubedl_tpu.serving").exception("predict failed")
+            self._respond(500, {"error": f"{type(e).__name__}: {e}"})

@@ -184,15 +184,19 @@ class MemoryBackend(ObjectBackend, EventBackend):
         return _paginate(rows, query)
 
     def stop_job(self, namespace, name, job_id=""):
-        rec = self.get_job(namespace, name, job_id)
-        if rec is not None:
-            rec.status = "Stopped"
+        # mutate under the lock: get_job releases it before returning, and
+        # an unlocked field write races concurrent save_job replacements
+        with self._lock:
+            rec = self.get_job(namespace, name, job_id)
+            if rec is not None:
+                rec.status = "Stopped"
 
     def delete_job(self, namespace, name, job_id=""):
-        rec = self.get_job(namespace, name, job_id)
-        if rec is not None:
-            rec.deleted = DELETED
-            rec.is_in_etcd = 0
+        with self._lock:
+            rec = self.get_job(namespace, name, job_id)
+            if rec is not None:
+                rec.deleted = DELETED
+                rec.is_in_etcd = 0
 
     def save_pod(self, rec: PodRecord) -> None:
         with self._lock:

@@ -54,23 +54,40 @@ class CheckpointManager:
                 enable_async_checkpointing=config.async_save,
             ))
 
-    def save(self, state, force: bool = False,
-             step: Optional[int] = None) -> bool:
-        """Save at ``state.step``; respects save_interval unless forced.
+    def save(self, state, force: bool = False, step: Optional[int] = None,
+             periodic: bool = False) -> bool:
+        """Save at ``state.step``.
+
+        Three call shapes, disambiguated explicitly (the old force-only
+        API made ``save_interval_steps=0`` silently swallow explicit
+        ``save()`` calls — ADVICE r1):
+
+        * ``periodic=True`` — the trainer's per-step call: saves only on
+          interval boundaries; ``save_interval_steps=0`` disables it.
+        * ``force=True`` — always saves (final/preempt checkpoints).
+        * plain ``save(state)`` — an explicit request: always saves,
+          regardless of the interval setting.
+
         Pass ``step`` (host-side counter) to skip the per-call
         ``device_get`` sync — fit() does, so non-saving steps cost one
         modulo instead of a device round-trip. A step already on disk is a
         no-op (the final forced save after an interval save of it)."""
-        if not force and self.config.save_interval_steps <= 0:
-            return False  # interval saves disabled: explicit saves only
+        if periodic and not force:
+            if self.config.save_interval_steps <= 0:
+                return False  # interval saves disabled
+            if step is None:
+                step = int(jax.device_get(state.step))
+            if step % self.config.save_interval_steps:
+                return False  # cheap early-out before touching orbax
         if step is None:
             step = int(jax.device_get(state.step))
-        if not force and step % max(self.config.save_interval_steps, 1):
-            return False  # cheap early-out before touching orbax
         if step in (self._mngr.all_steps() or []):
             return False
+        # orbax applies its own interval gate to non-forced saves; explicit
+        # (non-periodic) requests must bypass it or an off-interval step
+        # would be silently skipped
         saved = self._mngr.save(step, args=ocp.args.StandardSave(state),
-                                force=force)
+                                force=force or not periodic)
         if saved:
             log.info("checkpoint saved at step %d", step)
         return bool(saved)

@@ -198,7 +198,8 @@ class Trainer:
             if elastic_agent is not None:
                 elastic_agent.poll(state)
             if checkpoint_manager is not None:
-                checkpoint_manager.save(state, step=step0 + i + 1)
+                checkpoint_manager.save(state, step=step0 + i + 1,
+                                        periodic=True)
             if log_every and (i + 1) % log_every == 0:
                 dt = time.time() - t0
                 print(f"step {int(state.step)} loss {float(loss):.4f} "

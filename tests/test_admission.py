@@ -188,8 +188,13 @@ def test_review_mutate_returns_jsonpatch(chain):
     resp = out["response"]
     assert resp["allowed"] and resp["uid"] == "u1"
     patch = json.loads(base64.b64decode(resp["patch"]))
-    specs = [p for p in patch if p["path"] == "/spec"]
-    assert specs and specs[0]["value"]["cleanPodPolicy"] == "Running"
+    # per-path patches (round-2 weak #6): the defaulter's additions land as
+    # leaf ops, never a whole-/spec replace that would clobber sibling
+    # fields patched by concurrent mutating webhooks
+    assert not any(p["path"] == "/spec" and p["op"] == "replace"
+                   for p in patch)
+    cpp = [p for p in patch if p["path"] == "/spec/cleanPodPolicy"]
+    assert cpp and cpp[0]["op"] == "add" and cpp[0]["value"] == "Running"
 
 
 def test_review_validate_rejects(chain):
