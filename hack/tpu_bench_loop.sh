@@ -22,8 +22,8 @@ print(json.dumps({'platform': d.platform, 'kind': d.device_kind or ''}))
       if python - "$line" <<'EOF'
 import json, sys
 r = json.loads(sys.argv[1])
-ok = r.get("value", 0) > 0 and "cpu" not in r.get("metric", "") \
-     and not r.get("note") and not r.get("error")
+ok = r.get("ok") and r.get("value", 0) > 0 \
+     and not r.get("cached") and not r.get("error")
 sys.exit(0 if ok else 1)
 EOF
       then
