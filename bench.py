@@ -119,16 +119,22 @@ def _pin(jax, platforms: str) -> None:
 
 
 def pick_config(gen: str):
+    import dataclasses
+
     from kubedl_tpu.models import llama
     if gen == "cpu":
         return llama.tiny(vocab=512, seq=256), 4, 256, 3
+    # chunked LM-head loss: never materialize [b, s, vocab] logits
+    # (ops/loss.py) — frees ~0.75 GB at the 7B bench shape for batch/remat
     if gen in ("v5p", "v6e"):
         # ~6.9B-param Llama-7B-class model fits v5p's 95 GB for training
-        return llama.llama2_7b(), 4, 2048, 10
+        cfg = dataclasses.replace(llama.llama2_7b(), loss_chunk=512)
+        return cfg, 4, 2048, 10
     # v5e/v4 (16 GB): ~1.1B-param config
     cfg = llama.LlamaConfig(vocab_size=32000, d_model=2048, n_layers=16,
                             n_heads=16, n_kv_heads=8, d_ff=5632,
-                            max_seq_len=2048, rope_theta=10000.0)
+                            max_seq_len=2048, rope_theta=10000.0,
+                            loss_chunk=512)
     return cfg, 4, 2048, 10
 
 

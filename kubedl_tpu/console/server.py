@@ -292,14 +292,21 @@ class ConsoleServer:
         if mt:
             ns, name = mt.groups()
             return ok([e.to_row() for e in self.proxy.list_events(ns, name)])
-        mt = re.fullmatch(r"/api/v1/log/logs/([^/]+)/([^/]+)", path)
+        mt = re.fullmatch(r"/api/v1/log/(logs|download)/([^/]+)/([^/]+)",
+                          path)
         if mt:
             # standalone control plane has no kubelet log endpoint; the
-            # nearest faithful signal is the pod's event stream
-            ns, name = mt.groups()
+            # nearest faithful signal is the pod's event stream. download
+            # (reference log.go:28) serves the same lines as an attachment
+            verb, ns, name = mt.groups()
             lines = [f"{e.last_timestamp} [{e.type}] {e.reason}: {e.message}"
                      for e in self.proxy.list_events(ns, name)]
-            return ok(lines)
+            if verb == "logs":
+                return ok(lines)
+            return 200, ("\n".join(lines) + "\n").encode(), [
+                ("Content-Type", "text/plain"),
+                ("Content-Disposition",
+                 f'attachment; filename="{name}.log"')]
 
         if path == "/api/v1/notebook/list":
             return ok([r.to_row() for r in self.proxy.list_notebooks(Query())])
@@ -324,14 +331,69 @@ class ConsoleServer:
                 ("Content-Type", "text/yaml")]
 
         if path == "/api/v1/tensorboard/status":
+            from ..tpu import placement as pl
             ns = params.get("namespace", "default")
-            name = params.get("name", "")
-            pod = self.proxy.api.try_get("Pod", ns, f"{name}-tensorboard")
-            svc = self.proxy.api.try_get("Service", ns, f"{name}-tensorboard")
+            name = pl.replica_name(params.get("name", ""), "tensorboard", 0)
+            pod = self.proxy.api.try_get("Pod", ns, name)
+            svc = self.proxy.api.try_get("Service", ns, name)
             return ok({
-                "phase": m.get_in(pod, "status", "phase", default="NotFound")
+                # a pod that exists but has no phase yet is Pending (real
+                # kubelets always stamp one; the standalone plane may not)
+                "phase": m.get_in(pod, "status", "phase", default="Pending")
                 if pod else "NotFound",
                 "service": m.name(svc) if svc else ""})
+
+        if path == "/api/v1/tensorboard/reapply" and method == "POST":
+            # reference tensorboard.go:40 ReapplyTensorBoardInstance: bump
+            # the TB config's update stamp so the reconciler recreates it
+            req = _parse_body(body)
+            ns = req.get("namespace", "default")
+            name = req.get("name", "")
+            job = self._find_job(req.get("kind", ""), ns, name)
+            if job is None:
+                raise NotFound(f"job {ns}/{name} not found")
+            from ..api import common as cc
+            raw = m.annotations(job).get(cc.ANNOTATION_TENSORBOARD_CONFIG)
+            if not raw:
+                raise ValueError("job has no tensorboard config")
+            tb = json.loads(raw)
+            tb["updateTimestamp"] = self._now()
+            self.proxy.api.patch_merge(m.kind(job), ns, name, {
+                "metadata": {"annotations": {
+                    cc.ANNOTATION_TENSORBOARD_CONFIG:
+                        json.dumps(tb, sort_keys=True)}}})
+            # the reconciler treats updateTimestamp as cosmetic; delete the
+            # live TB pod so the next sync recreates it from the config
+            from ..platform.tensorboard import _name as tb_name
+            try:
+                self.proxy.api.delete("Pod", ns, tb_name(job))
+            except NotFound:
+                pass
+            return ok("reapplied")
+
+        if path == "/api/v1/kubedl/images":
+            # curated image list for the submit form (reference
+            # kubedl.go:33 getImages, sourced from the console ConfigMap)
+            cm = self.proxy.api.try_get("ConfigMap", CONSOLE_NAMESPACE,
+                                        CONSOLE_CONFIGMAP)
+            images = {}
+            if cm is not None:
+                try:
+                    images = json.loads(
+                        (cm.get("data") or {}).get("images", "{}"))
+                except ValueError:
+                    images = {}
+            return ok(images)
+        if path == "/api/v1/kubedl/namespaces":
+            names = {m.name(n) for n in self.proxy.api.list("Namespace")}
+            names.add("default")
+            return ok(sorted(names))
+        if path == "/api/v1/pvc/list":
+            # reference job.go:45 ListPVC: the submit form's volume picker
+            ns = params.get("namespace", "default")
+            return ok(sorted(
+                m.name(p) for p in self.proxy.api.list(
+                    "PersistentVolumeClaim", ns)))
 
         if path == "/api/v1/kinds":
             return ok(sorted(TRAINING_KINDS))

@@ -49,6 +49,10 @@ class LlamaConfig:
     dtype: object = jnp.bfloat16
     remat: bool = True          # checkpoint each layer (HBM <-> FLOPs trade)
     scan_layers: bool = True
+    #: >0: compute the training loss in sequence chunks of this length so
+    #: the [b, s, vocab] logits tensor is never materialized
+    #: (ops/loss.py) — an s/chunk-fold cut in peak logits HBM
+    loss_chunk: int = 0
 
     @property
     def hd(self) -> int:
@@ -212,13 +216,11 @@ def _layer_forward(config: LlamaConfig, x, lp, cos, sin, segment_ids,
     return x
 
 
-def forward(config: LlamaConfig, params: dict, tokens,
-            positions=None, segment_ids=None, mesh=None):
-    """tokens [b, s] int32 -> logits [b, s, vocab] float32.
-
-    ``mesh`` (optional, static): enables ring attention when the mesh has a
-    non-trivial ``cp`` axis; without it the sequence must fit one device's
-    attention window."""
+def forward_hidden(config: LlamaConfig, params: dict, tokens,
+                   positions=None, segment_ids=None, mesh=None):
+    """tokens [b, s] int32 -> final hidden states [b, s, d] (pre-LM-head),
+    so callers can choose how to project to the vocabulary (the chunked
+    loss never materializes full logits)."""
     c = config
     b, s = tokens.shape
     if positions is None:
@@ -240,8 +242,18 @@ def forward(config: LlamaConfig, params: dict, tokens,
         for lp in params["layers"]:
             x = body(x, lp, cos, sin, segment_ids)
 
-    x = rms_norm(x, params["final_norm"], c.rms_eps)
-    return (x @ params["lm_head"].astype(c.dtype)).astype(jnp.float32)
+    return rms_norm(x, params["final_norm"], c.rms_eps)
+
+
+def forward(config: LlamaConfig, params: dict, tokens,
+            positions=None, segment_ids=None, mesh=None):
+    """tokens [b, s] int32 -> logits [b, s, vocab] float32.
+
+    ``mesh`` (optional, static): enables ring attention when the mesh has a
+    non-trivial ``cp`` axis; without it the sequence must fit one device's
+    attention window."""
+    x = forward_hidden(config, params, tokens, positions, segment_ids, mesh)
+    return (x @ params["lm_head"].astype(config.dtype)).astype(jnp.float32)
 
 
 # -- KV-cache inference path -------------------------------------------------
@@ -334,7 +346,18 @@ def forward_step(config: LlamaConfig, params: dict, tokens, cache: dict,
 
 def loss_fn(config: LlamaConfig, params: dict, tokens, targets,
             mask=None, mesh=None) -> jnp.ndarray:
-    """Next-token cross-entropy, mean over unmasked targets."""
+    """Next-token cross-entropy, mean over unmasked targets.
+
+    With ``config.loss_chunk > 0`` the LM-head projection + softmax run in
+    sequence chunks (``ops.loss.chunked_softmax_xent``) so the [b, s,
+    vocab] logits tensor is never materialized — numerically identical
+    (same float32 softmax), chunk-fold smaller peak HBM."""
+    if config.loss_chunk > 0:
+        from ..ops.loss import chunked_softmax_xent
+        x = forward_hidden(config, params, tokens, mesh=mesh)
+        return chunked_softmax_xent(
+            x, params["lm_head"].astype(config.dtype), targets, mask=mask,
+            chunk=config.loss_chunk)
     logits = forward(config, params, tokens, mesh=mesh)
     logz = jax.nn.logsumexp(logits, axis=-1)
     gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]

@@ -1,0 +1,73 @@
+"""Memory-efficient cross-entropy over large vocabularies.
+
+The naive loss path materializes float32 logits of shape [b, s, vocab]
+(``llama.loss_fn``): at Llama-7B bench shape (b=4, s=2048, V=32000) that
+is ~1 GB live in the forward pass and again as a saved residual for the
+backward — pure HBM pressure that caps the batch size on 16 GB chips.
+
+``chunked_softmax_xent`` scans the sequence in chunks: each step projects
+one [b, chunk, d] slice through the LM head, reduces it to its NLL
+contribution, and drops the chunk logits. ``jax.checkpoint`` on the step
+makes the backward recompute each chunk's logits instead of saving them,
+so peak logits memory is O(b * chunk * V) instead of O(b * s * V) — a
+seq/chunk-fold reduction — while XLA still sees dense [b*chunk, d] x
+[d, V] matmuls that tile straight onto the MXU.
+
+No reference analog (the reference is an operator, not a tensor library);
+this is TPU-native compute for the in-tree training stack.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _chunk_nll(x_chunk, w, targets_chunk):
+    """[b, c, d] x [d, V] -> per-token NLL [b, c]; float32 softmax."""
+    logits = (x_chunk @ w).astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(
+        logits, targets_chunk[..., None], axis=-1)[..., 0]
+    return logz - gold
+
+
+def chunked_softmax_xent(x, w, targets, mask=None, chunk: int = 512):
+    """Cross-entropy of ``x @ w`` against ``targets`` without ever
+    holding the full [b, s, V] logits.
+
+    Args:
+      x: [b, s, d] final hidden states (any float dtype).
+      w: [d, V] LM head.
+      targets: [b, s] int32 target token ids.
+      mask: optional [b, s] {0,1} float/bool mask over targets.
+      chunk: sequence-chunk length; peak logits memory is b*chunk*V.
+
+    Returns the mean NLL over unmasked targets (scalar float32), exactly
+    matching the unchunked computation (same float32 softmax).
+    """
+    b, s, d = x.shape
+    chunk = max(1, min(chunk, s))
+    pad = (-s) % chunk
+    if mask is None:
+        mask = jnp.ones((b, s), jnp.float32)
+    mask = mask.astype(jnp.float32)
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+        targets = jnp.pad(targets, ((0, 0), (0, pad)))
+        mask = jnp.pad(mask, ((0, 0), (0, pad)))
+    n = (s + pad) // chunk
+    # [n, b, chunk, ...] so the scan walks sequence chunks
+    xs = x.reshape(b, n, chunk, d).swapaxes(0, 1)
+    ts = targets.reshape(b, n, chunk).swapaxes(0, 1)
+    ms = mask.reshape(b, n, chunk).swapaxes(0, 1)
+
+    step_fn = jax.checkpoint(  # backward recomputes chunk logits
+        lambda xc, tc, mc: jnp.sum(_chunk_nll(xc, w, tc) * mc))
+
+    def step(carry, inp):
+        xc, tc, mc = inp
+        return carry + step_fn(xc, tc, mc), None
+
+    total, _ = jax.lax.scan(step, jnp.float32(0.0), (xs, ts, ms))
+    return total / jnp.maximum(jnp.sum(mask), 1.0)

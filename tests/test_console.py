@@ -401,6 +401,63 @@ def test_presubmit_hooks_applied_on_submit(stack):
     assert specs["Worker"]["replicas"] == 2
 
 
+def test_remaining_route_groups(stack):
+    """Parity for the last reference route groups: log download,
+    tensorboard reapply, kubedl images/namespaces, pvc list
+    (router.go route table)."""
+    op, client = stack
+    login(client)
+    status, body = client.req("POST", "/api/v1/job/submit", {
+        **PJ, "metadata": {**PJ["metadata"],
+                           "annotations": {"kubedl.io/tensorboard-config":
+                                           '{"logDir": "/logs"}'}}})
+    assert status == 200, body
+    op.run_until_idle(max_iterations=80)
+    for pod in op.api.list("Pod"):
+        pod["status"] = {"phase": "Running"}
+        op.api.update_status(pod)
+    op.run_until_idle(max_iterations=80)
+
+    # log download: text attachment
+    pod = op.api.list("Pod")[0]
+    status, text = client.req(
+        "GET", f"/api/v1/log/download/default/{m.name(pod)}", raw=True)
+    assert status == 200
+
+    # tensorboard reapply: annotation bumped AND the TB pod recreated
+    old_tb_pod = op.api.try_get("Pod", "default", "web-job-tensorboard-0")
+    assert old_tb_pod is not None
+    status, body = client.req("POST", "/api/v1/tensorboard/reapply", {
+        "kind": "PyTorchJob", "namespace": "default", "name": "web-job"})
+    assert status == 200, body
+    job = op.api.get("PyTorchJob", "default", "web-job")
+    tb = json.loads(job["metadata"]["annotations"][
+        "kubedl.io/tensorboard-config"])
+    assert tb["updateTimestamp"]
+    op.run_until_idle(max_iterations=80)
+    new_tb_pod = op.api.try_get("Pod", "default", "web-job-tensorboard-0")
+    assert new_tb_pod is not None
+    assert m.uid(new_tb_pod) != m.uid(old_tb_pod)
+    # status route resolves the same naming convention
+    status, body = client.req(
+        "GET", "/api/v1/tensorboard/status?namespace=default&name=web-job")
+    assert status == 200 and body["data"]["phase"] != "NotFound"
+
+    # kubedl images (from the console ConfigMap) + namespaces + pvc list
+    cm = m.new_obj("v1", "ConfigMap", "kubedl-console-config",
+                   "kubedl-system")
+    cm["data"] = {"images": json.dumps({"pytorch": ["torch:2.4"]})}
+    op.api.create(cm)
+    status, body = client.req("GET", "/api/v1/kubedl/images")
+    assert status == 200 and body["data"]["pytorch"] == ["torch:2.4"]
+    status, body = client.req("GET", "/api/v1/kubedl/namespaces")
+    assert status == 200 and "default" in body["data"]
+    pvc = m.new_obj("v1", "PersistentVolumeClaim", "data-pvc", "default")
+    op.api.create(pvc)
+    status, body = client.req("GET", "/api/v1/pvc/list?namespace=default")
+    assert status == 200 and "data-pvc" in body["data"]
+
+
 def test_proxy_merges_live_and_persisted(api):
     op = build_operator(api, OperatorConfig(
         workloads=["PyTorchJob"], object_storage="memory"))

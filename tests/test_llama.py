@@ -106,3 +106,55 @@ def test_sharded_training_step_decreases_loss():
     wq = state.params["layers"]["wq"]
     assert wq.dtype == jnp.bfloat16
     assert len(wq.sharding.device_set) == 8
+
+
+def test_chunked_loss_matches_unchunked(cfg):
+    """ops.loss.chunked_softmax_xent: identical value AND gradients to the
+    materialize-everything path (same float32 softmax), so enabling
+    loss_chunk changes memory, never math."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from kubedl_tpu.models import llama
+
+    key = jax.random.PRNGKey(0)
+    params = llama.init_params(cfg, key)
+    b, s = 2, 64
+    tokens = jax.random.randint(key, (b, s), 0, cfg.vocab_size)
+    targets = jnp.roll(tokens, -1, axis=1)
+    mask = jnp.ones((b, s)).at[:, -8:].set(0.0)
+
+    chunked_cfg = dataclasses.replace(cfg, loss_chunk=24)  # non-divisor
+    ref = llama.loss_fn(cfg, params, tokens, targets, mask=mask)
+    out = llama.loss_fn(chunked_cfg, params, tokens, targets, mask=mask)
+    assert jnp.allclose(ref, out, rtol=2e-5), (ref, out)
+
+    g_ref = jax.grad(lambda p: llama.loss_fn(
+        cfg, p, tokens, targets, mask=mask))(params)
+    g_out = jax.grad(lambda p: llama.loss_fn(
+        chunked_cfg, p, tokens, targets, mask=mask))(params)
+    flat_ref, _ = jax.tree_util.tree_flatten(g_ref)
+    flat_out, _ = jax.tree_util.tree_flatten(g_out)
+    for a, c in zip(flat_ref, flat_out):
+        assert jnp.allclose(a.astype(jnp.float32), c.astype(jnp.float32),
+                            rtol=3e-2, atol=3e-3)
+
+
+def test_chunked_loss_no_mask(cfg):
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from kubedl_tpu.models import llama
+
+    key = jax.random.PRNGKey(1)
+    params = llama.init_params(cfg, key)
+    tokens = jax.random.randint(key, (1, 32), 0, cfg.vocab_size)
+    targets = jnp.roll(tokens, -1, axis=1)
+    ref = llama.loss_fn(cfg, params, tokens, targets)
+    out = llama.loss_fn(dataclasses.replace(cfg, loss_chunk=16),
+                        params, tokens, targets)
+    assert jnp.allclose(ref, out, rtol=2e-5)
