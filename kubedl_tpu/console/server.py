@@ -331,9 +331,9 @@ class ConsoleServer:
                 ("Content-Type", "text/yaml")]
 
         if path == "/api/v1/tensorboard/status":
-            from ..tpu import placement as pl
+            from ..platform.tensorboard import tb_resource_name
             ns = params.get("namespace", "default")
-            name = pl.replica_name(params.get("name", ""), "tensorboard", 0)
+            name = tb_resource_name(params.get("name", ""))
             pod = self.proxy.api.try_get("Pod", ns, name)
             svc = self.proxy.api.try_get("Service", ns, name)
             return ok({
@@ -364,9 +364,9 @@ class ConsoleServer:
                         json.dumps(tb, sort_keys=True)}}})
             # the reconciler treats updateTimestamp as cosmetic; delete the
             # live TB pod so the next sync recreates it from the config
-            from ..platform.tensorboard import _name as tb_name
+            from ..platform.tensorboard import tb_resource_name
             try:
-                self.proxy.api.delete("Pod", ns, tb_name(job))
+                self.proxy.api.delete("Pod", ns, tb_resource_name(name))
             except NotFound:
                 pass
             return ok("reapplied")

@@ -96,8 +96,15 @@ def _try_sync(api, job, opts, cfg_raw, master_spec, recorder) -> None:
             recorder.event(job, "Warning", "TensorBoardConflict", str(e))
 
 
+def tb_resource_name(job_name: str) -> str:
+    """Public naming seam: the pod/service/ingress name this subsystem
+    gives a job's TensorBoard (the console's status/reapply routes resolve
+    the same name)."""
+    return pl.replica_name(job_name, TB_REPLICA_TYPE, 0)
+
+
 def _name(job: dict) -> str:
-    return pl.replica_name(m.name(job), TB_REPLICA_TYPE, 0)
+    return tb_resource_name(m.name(job))
 
 
 def _labels(job: dict) -> dict:

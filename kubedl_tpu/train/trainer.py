@@ -41,6 +41,14 @@ class TrainConfig:
     grad_clip: float = 1.0
     accum_steps: int = 1
     seed: int = 0
+    #: XProf trace directory ("" = off). Traces land under
+    #: <profile_dir>/plugins/profile, which the TensorBoard subsystem
+    #: (platform/tensorboard.py) serves straight from the job's logdir —
+    #: the operator-level profiling convention from SURVEY §5.
+    profile_dir: str = ""
+    #: trace window: [profile_start_step, profile_start_step+profile_steps)
+    profile_start_step: int = 10   # skip compile + warmup steps
+    profile_steps: int = 3
 
 
 @jax.tree_util.register_dataclass
@@ -189,21 +197,39 @@ class Trainer:
         t0 = time.time()
         tokens = 0
         step0 = int(jax.device_get(state.step))  # one sync, then host-side
-        for i in range(num_steps):
-            batch = next(batches)
-            tokens += _batch_tokens(batch)
-            state, loss = self.step(state, batch)
-            if on_step is not None:
-                on_step(int(state.step), float(loss))
-            if elastic_agent is not None:
-                elastic_agent.poll(state)
-            if checkpoint_manager is not None:
-                checkpoint_manager.save(state, step=step0 + i + 1,
-                                        periodic=True)
-            if log_every and (i + 1) % log_every == 0:
-                dt = time.time() - t0
-                print(f"step {int(state.step)} loss {float(loss):.4f} "
-                      f"{tokens / dt:.0f} tok/s")
+        cfg = self.config
+        tracing = False
+        # clamp the window into the actual run so a short fit still
+        # produces a trace instead of silently skipping it
+        profile_at = -1
+        if cfg.profile_dir and cfg.profile_steps > 0:
+            profile_at = min(cfg.profile_start_step, max(num_steps - 1, 0))
+        try:
+            for i in range(num_steps):
+                if i == profile_at:
+                    jax.profiler.start_trace(cfg.profile_dir)
+                    tracing = True
+                batch = next(batches)
+                tokens += _batch_tokens(batch)
+                state, loss = self.step(state, batch)
+                if tracing and i + 1 >= profile_at + cfg.profile_steps:
+                    jax.block_until_ready(loss)  # close open device events
+                    jax.profiler.stop_trace()
+                    tracing = False
+                if on_step is not None:
+                    on_step(int(state.step), float(loss))
+                if elastic_agent is not None:
+                    elastic_agent.poll(state)
+                if checkpoint_manager is not None:
+                    checkpoint_manager.save(state, step=step0 + i + 1,
+                                            periodic=True)
+                if log_every and (i + 1) % log_every == 0:
+                    dt = time.time() - t0
+                    print(f"step {int(state.step)} loss {float(loss):.4f} "
+                          f"{tokens / dt:.0f} tok/s")
+        finally:
+            if tracing:
+                jax.profiler.stop_trace()
         if checkpoint_manager is not None:
             checkpoint_manager.save(state, force=True)
             checkpoint_manager.wait_until_finished()

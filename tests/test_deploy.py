@@ -76,6 +76,17 @@ def test_example_manifests_reconcile(api, manager):
     groups = api.list("PodGroup")
     ms = [g for g in groups if m.name(g).startswith("llama-multislice")]
     assert len(ms) == 2
+    # MPI example: launcher with kubectl-delivery init + 4 slice workers
+    mpi_pods = by_job.get("allreduce-bench", [])
+    assert len(mpi_pods) == 5
+    launcher = next(p for p in mpi_pods if "launcher" in m.name(p))
+    assert [ic["name"] for ic in launcher["spec"]["initContainers"]] == \
+        ["kubectl-delivery"]
+    # notebook example rendered its pod; cron example stored the Cron CR;
+    # inference CR admitted (predictors gate on ModelVersion builds)
+    assert any(m.name(p) == "nb-research-nb" for p in pods)
+    assert api.try_get("Cron", "default", "nightly-eval") is not None
+    assert api.try_get("Inference", "default", "gemma-infer") is not None
 
 
 def test_metrics_http_endpoint():

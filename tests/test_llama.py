@@ -158,3 +158,30 @@ def test_chunked_loss_no_mask(cfg):
     out = llama.loss_fn(dataclasses.replace(cfg, loss_chunk=16),
                         params, tokens, targets)
     assert jnp.allclose(ref, out, rtol=2e-5)
+
+
+def test_fit_writes_xprof_trace(tmp_path):
+    """TrainConfig.profile_dir: fit() captures an XProf trace window whose
+    files land under plugins/profile — the layout the TensorBoard
+    subsystem serves (SURVEY §5 profiling convention)."""
+    import os
+
+    cfg = llama.tiny()
+    mesh = build_mesh(MeshConfig(dp=2, fsdp=2, tp=2))
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+
+    def loss_fn(p, b):
+        return llama.loss_fn(cfg, p, b["tokens"], b["targets"])
+
+    tr = Trainer(loss_fn, llama.param_specs(cfg), mesh,
+                 TrainConfig(warmup_steps=1, decay_steps=10,
+                             profile_dir=str(tmp_path),
+                             profile_start_step=1, profile_steps=1))
+    batches = (shard_batch(b, mesh)
+               for b in synthetic_lm_batches(8, 256, cfg.vocab_size))
+    tr.fit(tr.init_state(params), batches, num_steps=3, log_every=0)
+    hits = []
+    for root, _, files in os.walk(tmp_path):
+        if "plugins" in root and "profile" in root:
+            hits.extend(files)
+    assert hits, "no XProf trace files written"
