@@ -3,5 +3,5 @@
 ``llama`` is the flagship (BASELINE configs 3-4: Llama-3-8B SPMD fine-tune);
 ``resnet`` covers the vision config (BASELINE config 2); ``mlp`` is the
 CPU smoke-test model (BASELINE config 1); Gemma serving (config 5) reuses
-the llama architecture via ``llama.gemma_config``.
+the llama transformer core with the family knobs in ``gemma``.
 """

@@ -53,6 +53,18 @@ class LlamaConfig:
     #: the [b, s, vocab] logits tensor is never materialized
     #: (ops/loss.py) — an s/chunk-fold cut in peak logits HBM
     loss_chunk: int = 0
+    # -- family knobs (Gemma reuses this transformer core) ----------------
+    #: MLP activation: "silu" (Llama SwiGLU) or "gelu" (Gemma GeGLU)
+    act: str = "silu"
+    #: RMSNorm scales by (offset + weight): Llama 0 (weights init 1),
+    #: Gemma 1 (weights init 0)
+    norm_weight_offset: float = 0.0
+    #: Gemma multiplies embeddings by sqrt(d_model)
+    embed_scale: bool = False
+    #: Gemma ties the LM head to the embedding table (no lm_head param)
+    tie_embeddings: bool = False
+    #: Gemma-2 final-logit softcap: cap * tanh(logits / cap); 0 = off
+    logit_softcap: float = 0.0
 
     @property
     def hd(self) -> int:
@@ -64,7 +76,8 @@ class LlamaConfig:
         attn = d * hd * (self.n_heads + 2 * self.n_kv_heads) + self.n_heads * hd * d
         mlp = 3 * d * self.d_ff
         per_layer = attn + mlp + 2 * d
-        return self.n_layers * per_layer + 2 * self.vocab_size * d + d
+        head = (1 if self.tie_embeddings else 2) * self.vocab_size * d
+        return self.n_layers * per_layer + head + d
 
 
 # -- canonical configs -------------------------------------------------------
@@ -77,13 +90,6 @@ def llama3_8b() -> LlamaConfig:
 def llama2_7b() -> LlamaConfig:
     return LlamaConfig(vocab_size=32000, d_model=4096, n_layers=32,
                        n_heads=32, n_kv_heads=32, d_ff=11008,
-                       rope_theta=10000.0)
-
-
-def gemma_2b() -> LlamaConfig:
-    """Gemma-2B shape for the serving config (BASELINE config 5)."""
-    return LlamaConfig(vocab_size=256128, d_model=2048, n_layers=18,
-                       n_heads=8, n_kv_heads=1, d_ff=16384, head_dim=256,
                        rope_theta=10000.0)
 
 
@@ -105,15 +111,19 @@ def init_params(config: LlamaConfig, key) -> dict:
         return (jax.random.normal(key, shape, jnp.float32)
                 * (1.0 / math.sqrt(fan_in))).astype(c.dtype)
 
+    # rms_norm scales by (offset + weight): weights init to 1 - offset so
+    # every family starts at an identity-scaled norm
+    norm_init = 1.0 - c.norm_weight_offset
+
     def layer(key):
         ks = jax.random.split(key, 7)
         return {
-            "attn_norm": jnp.ones((d,), jnp.float32),
+            "attn_norm": jnp.full((d,), norm_init, jnp.float32),
             "wq": dense(ks[0], (d, nh * hd), d),
             "wk": dense(ks[1], (d, nkv * hd), d),
             "wv": dense(ks[2], (d, nkv * hd), d),
             "wo": dense(ks[3], (nh * hd, d), nh * hd),
-            "mlp_norm": jnp.ones((d,), jnp.float32),
+            "mlp_norm": jnp.full((d,), norm_init, jnp.float32),
             "w_gate": dense(ks[4], (d, c.d_ff), d),
             "w_up": dense(ks[5], (d, c.d_ff), d),
             "w_down": dense(ks[6], (c.d_ff, d), c.d_ff),
@@ -124,12 +134,14 @@ def init_params(config: LlamaConfig, key) -> dict:
         layers = jax.vmap(layer)(layer_keys)  # stacked: leading layer axis
     else:
         layers = [layer(k) for k in layer_keys]
-    return {
+    params = {
         "embed": dense(k_embed, (c.vocab_size, d), d),
         "layers": layers,
-        "final_norm": jnp.ones((d,), jnp.float32),
-        "lm_head": dense(k_out, (d, c.vocab_size), d),
+        "final_norm": jnp.full((d,), norm_init, jnp.float32),
     }
+    if not c.tie_embeddings:
+        params["lm_head"] = dense(k_out, (d, c.vocab_size), d)
+    return params
 
 
 def param_specs(config: LlamaConfig) -> dict:
@@ -152,20 +164,46 @@ def param_specs(config: LlamaConfig) -> dict:
         "w_down": ls("mlp", "embed"),
     }
     layers = layer if config.scan_layers else [layer] * config.n_layers
-    return {
+    specs = {
         "embed": spec("vocab", "embed"),
         "layers": layers,
         "final_norm": spec("norm"),
-        "lm_head": spec("embed", "vocab"),
     }
+    if not config.tie_embeddings:
+        specs["lm_head"] = spec("embed", "vocab")
+    return specs
 
 
 # -- ops ---------------------------------------------------------------------
 
-def rms_norm(x, weight, eps: float):
+def rms_norm(x, weight, eps: float, offset: float = 0.0):
     xf = x.astype(jnp.float32)
     scale = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
-    return (xf * scale * weight).astype(x.dtype)
+    return (xf * scale * (offset + weight)).astype(x.dtype)
+
+
+_ACTS = {"silu": jax.nn.silu, "gelu": jax.nn.gelu}
+
+
+def _act(config: LlamaConfig):
+    try:
+        return _ACTS[config.act]
+    except KeyError:
+        raise ValueError(
+            f"unknown act {config.act!r}; one of {sorted(_ACTS)}") from None
+
+
+def _lm_head(config: LlamaConfig, params: dict):
+    """[d, vocab] projection; Gemma ties it to the embedding table."""
+    w = (params["embed"].T if config.tie_embeddings else params["lm_head"])
+    return w.astype(config.dtype)
+
+
+def _softcap(config: LlamaConfig, logits):
+    cap = config.logit_softcap
+    if cap and cap > 0:
+        return cap * jnp.tanh(logits / cap)
+    return logits
 
 
 def rope_frequencies(config: LlamaConfig, positions):
@@ -194,7 +232,7 @@ def _layer_forward(config: LlamaConfig, x, lp, cos, sin, segment_ids,
     nh, nkv, hd = c.n_heads, c.n_kv_heads, c.hd
 
     # -- attention block
-    h = rms_norm(x, lp["attn_norm"], c.rms_eps)
+    h = rms_norm(x, lp["attn_norm"], c.rms_eps, c.norm_weight_offset)
     q = (h @ lp["wq"]).reshape(b, s, nh, hd)
     k = (h @ lp["wk"]).reshape(b, s, nkv, hd)
     v = (h @ lp["wv"]).reshape(b, s, nkv, hd)
@@ -209,9 +247,9 @@ def _layer_forward(config: LlamaConfig, x, lp, cos, sin, segment_ids,
                                     segment_ids=segment_ids)
     x = x + (attn.reshape(b, s, nh * hd) @ lp["wo"])
 
-    # -- SwiGLU MLP
-    h = rms_norm(x, lp["mlp_norm"], c.rms_eps)
-    gated = jax.nn.silu((h @ lp["w_gate"]).astype(jnp.float32)).astype(h.dtype)
+    # -- gated MLP (SwiGLU for Llama, GeGLU for Gemma)
+    h = rms_norm(x, lp["mlp_norm"], c.rms_eps, c.norm_weight_offset)
+    gated = _act(c)((h @ lp["w_gate"]).astype(jnp.float32)).astype(h.dtype)
     x = x + ((gated * (h @ lp["w_up"])) @ lp["w_down"])
     return x
 
@@ -228,6 +266,8 @@ def forward_hidden(config: LlamaConfig, params: dict, tokens,
     cos, sin = rope_frequencies(c, positions)
 
     x = params["embed"][tokens].astype(c.dtype)
+    if c.embed_scale:
+        x = x * jnp.asarray(math.sqrt(c.d_model), c.dtype)
 
     body = partial(_layer_forward, c, mesh=mesh)
     if c.remat:
@@ -242,7 +282,7 @@ def forward_hidden(config: LlamaConfig, params: dict, tokens,
         for lp in params["layers"]:
             x = body(x, lp, cos, sin, segment_ids)
 
-    return rms_norm(x, params["final_norm"], c.rms_eps)
+    return rms_norm(x, params["final_norm"], c.rms_eps, c.norm_weight_offset)
 
 
 def forward(config: LlamaConfig, params: dict, tokens,
@@ -253,7 +293,8 @@ def forward(config: LlamaConfig, params: dict, tokens,
     non-trivial ``cp`` axis; without it the sequence must fit one device's
     attention window."""
     x = forward_hidden(config, params, tokens, positions, segment_ids, mesh)
-    return (x @ params["lm_head"].astype(config.dtype)).astype(jnp.float32)
+    logits = (x @ _lm_head(config, params)).astype(jnp.float32)
+    return _softcap(config, logits)
 
 
 # -- KV-cache inference path -------------------------------------------------
@@ -280,7 +321,7 @@ def _layer_step(config: LlamaConfig, x, lp, kc, vc, cos, sin, start_pos,
     nh, nkv, hd = c.n_heads, c.n_kv_heads, c.hd
     max_len = kc.shape[1]
 
-    h = rms_norm(x, lp["attn_norm"], c.rms_eps)
+    h = rms_norm(x, lp["attn_norm"], c.rms_eps, c.norm_weight_offset)
     q = apply_rope((h @ lp["wq"]).reshape(b, s, nh, hd), cos, sin)
     k = apply_rope((h @ lp["wk"]).reshape(b, s, nkv, hd), cos, sin)
     v = (h @ lp["wv"]).reshape(b, s, nkv, hd)
@@ -302,8 +343,8 @@ def _layer_step(config: LlamaConfig, x, lp, kc, vc, cos, sin, start_pos,
     attn = jnp.einsum("bhqk,bkhd->bqhd", probs, vf).astype(x.dtype)
     x = x + (attn.reshape(b, s, nh * hd) @ lp["wo"])
 
-    h = rms_norm(x, lp["mlp_norm"], c.rms_eps)
-    gated = jax.nn.silu((h @ lp["w_gate"]).astype(jnp.float32)).astype(h.dtype)
+    h = rms_norm(x, lp["mlp_norm"], c.rms_eps, c.norm_weight_offset)
+    gated = _act(c)((h @ lp["w_gate"]).astype(jnp.float32)).astype(h.dtype)
     x = x + ((gated * (h @ lp["w_up"])) @ lp["w_down"])
     return x, kc, vc
 
@@ -320,6 +361,8 @@ def forward_step(config: LlamaConfig, params: dict, tokens, cache: dict,
     positions = start_pos + jnp.arange(s, dtype=jnp.int32)
     cos, sin = rope_frequencies(c, positions)
     x = params["embed"][tokens].astype(c.dtype)
+    if c.embed_scale:
+        x = x * jnp.asarray(math.sqrt(c.d_model), c.dtype)
 
     if c.scan_layers:
         def scan_step(x, layer):
@@ -339,9 +382,10 @@ def forward_step(config: LlamaConfig, params: dict, tokens, cache: dict,
             vs.append(vc)
         new_cache = {"k": jnp.stack(ks), "v": jnp.stack(vs)}
 
-    x = rms_norm(x[:, -1:], params["final_norm"], c.rms_eps)
-    logits = (x @ params["lm_head"].astype(c.dtype)).astype(jnp.float32)
-    return logits[:, 0], new_cache
+    x = rms_norm(x[:, -1:], params["final_norm"], c.rms_eps,
+                 c.norm_weight_offset)
+    logits = (x @ _lm_head(c, params)).astype(jnp.float32)
+    return _softcap(c, logits)[:, 0], new_cache
 
 
 def loss_fn(config: LlamaConfig, params: dict, tokens, targets,
@@ -356,8 +400,8 @@ def loss_fn(config: LlamaConfig, params: dict, tokens, targets,
         from ..ops.loss import chunked_softmax_xent
         x = forward_hidden(config, params, tokens, mesh=mesh)
         return chunked_softmax_xent(
-            x, params["lm_head"].astype(config.dtype), targets, mask=mask,
-            chunk=config.loss_chunk)
+            x, _lm_head(config, params), targets, mask=mask,
+            chunk=config.loss_chunk, logit_softcap=config.logit_softcap)
     logits = forward(config, params, tokens, mesh=mesh)
     logz = jax.nn.logsumexp(logits, axis=-1)
     gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]

@@ -1,0 +1,180 @@
+"""ResNet-v1.5 family — the vision bench model (BASELINE config 2:
+PyTorchJob ResNet-50 on a single v5e-4 TPU host).
+
+TPU-first choices:
+
+* NHWC layout end-to-end (the TPU-native convolution layout; NCHW would
+  force transposes around every conv),
+* bf16 activations/weights with float32 normalization statistics,
+* batch-statistics normalization, computed per step — pure-functional
+  (no mutable running averages threaded through the trainer), which is
+  exactly what a throughput benchmark measures; a dp mesh turns the
+  per-device batch stats into sync-free local normalization,
+* the stride-2 downsample lives on the 3x3 conv (the "v1.5" variant —
+  matches the torchvision model the reference's users run).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from ..parallel.sharding import spec
+
+#: per-depth block counts; stage widths are width * (1, 2, 4, 8)
+_DEPTHS = {
+    18: (2, 2, 2, 2),
+    50: (3, 4, 6, 3),
+    101: (3, 4, 23, 3),
+}
+
+
+@dataclass
+class ResNetConfig:
+    depth: int = 50
+    n_classes: int = 1000
+    width: int = 64          # first-stage width; later stages double it
+    dtype: object = jnp.bfloat16
+
+    @property
+    def bottleneck(self) -> bool:
+        return self.depth >= 50
+
+    @property
+    def stages(self) -> tuple:
+        return tuple((self.width * (2 ** i), blocks)
+                     for i, blocks in enumerate(_DEPTHS[self.depth]))
+
+
+def resnet50() -> ResNetConfig:
+    return ResNetConfig(depth=50)
+
+
+def resnet18() -> ResNetConfig:
+    return ResNetConfig(depth=18)
+
+
+def tiny() -> ResNetConfig:
+    """CI config: 18-layer at 1/8 width."""
+    return ResNetConfig(depth=18, width=8, n_classes=10)
+
+
+# -- params ------------------------------------------------------------------
+
+
+def _conv_init(key, kh, kw, c_in, c_out, dtype):
+    fan_in = kh * kw * c_in
+    return (jax.random.normal(key, (kh, kw, c_in, c_out), jnp.float32)
+            * math.sqrt(2.0 / fan_in)).astype(dtype)
+
+
+def _bn_init(c):
+    return {"scale": jnp.ones((c,), jnp.float32),
+            "bias": jnp.zeros((c,), jnp.float32)}
+
+
+def init_params(config: ResNetConfig, key) -> dict:
+    c = config
+    keys = iter(jax.random.split(key, 256))
+    w = c.width
+    params = {"stem": {"conv": _conv_init(next(keys), 7, 7, 3, w, c.dtype),
+                       "bn": _bn_init(w)},
+              "stages": []}
+    c_in = w
+    for si, (width, blocks) in enumerate(c.stages):
+        stage = []
+        for b in range(blocks):
+            # single source of stride truth shared with forward(): stage 0
+            # keeps stride 1 (the stem maxpool already downsampled)
+            stride = _block_stride(si, b)
+            c_out = width * (4 if c.bottleneck else 1)
+            block = {}
+            if c.bottleneck:
+                block["conv1"] = _conv_init(next(keys), 1, 1, c_in, width, c.dtype)
+                block["conv2"] = _conv_init(next(keys), 3, 3, width, width, c.dtype)
+                block["conv3"] = _conv_init(next(keys), 1, 1, width, c_out, c.dtype)
+                block["bn1"], block["bn2"], block["bn3"] = (
+                    _bn_init(width), _bn_init(width), _bn_init(c_out))
+            else:
+                block["conv1"] = _conv_init(next(keys), 3, 3, c_in, width, c.dtype)
+                block["conv2"] = _conv_init(next(keys), 3, 3, width, c_out, c.dtype)
+                block["bn1"], block["bn2"] = _bn_init(width), _bn_init(c_out)
+            if stride != 1 or c_in != c_out:
+                block["proj"] = _conv_init(next(keys), 1, 1, c_in, c_out, c.dtype)
+                block["proj_bn"] = _bn_init(c_out)
+            stage.append(block)
+            c_in = c_out
+        params["stages"].append(stage)
+    params["head"] = {
+        "w": (jax.random.normal(next(keys), (c_in, c.n_classes), jnp.float32)
+              / math.sqrt(c_in)).astype(c.dtype),
+        "b": jnp.zeros((c.n_classes,), c.dtype),
+    }
+    return params
+
+
+def param_specs(config: ResNetConfig) -> dict:
+    """Replicated weights (data-parallel vision training): an eval_shape
+    structural walk keeps the spec tree congruent with init_params."""
+    params = jax.eval_shape(
+        lambda k: init_params(config, k), jax.random.PRNGKey(0))
+    return jax.tree.map(lambda _: spec(), params)
+
+
+def _block_stride(stage_index: int, block_index: int) -> int:
+    return 2 if (block_index == 0 and stage_index > 0) else 1
+
+
+def _conv(x, w, stride=1):
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=(stride, stride), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def _bn(x, p, eps=1e-5):
+    """Batch-statistics norm over (N, H, W), float32 math."""
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=(0, 1, 2), keepdims=True)
+    var = jnp.var(xf, axis=(0, 1, 2), keepdims=True)
+    out = (xf - mean) * jax.lax.rsqrt(var + eps) * p["scale"] + p["bias"]
+    return out.astype(x.dtype)
+
+
+def _block(x, block, stride, bottleneck):
+    shortcut = x
+    if "proj" in block:
+        shortcut = _bn(_conv(x, block["proj"], stride), block["proj_bn"])
+    if bottleneck:
+        h = jax.nn.relu(_bn(_conv(x, block["conv1"]), block["bn1"]))
+        h = jax.nn.relu(_bn(_conv(h, block["conv2"], stride), block["bn2"]))
+        h = _bn(_conv(h, block["conv3"]), block["bn3"])
+    else:
+        h = jax.nn.relu(_bn(_conv(x, block["conv1"], stride), block["bn1"]))
+        h = _bn(_conv(h, block["conv2"]), block["bn2"])
+    return jax.nn.relu(h + shortcut)
+
+
+def forward(config: ResNetConfig, params: dict, images):
+    """images [b, h, w, 3] -> logits [b, n_classes] float32."""
+    c = config
+    x = images.astype(c.dtype)
+    x = jax.nn.relu(_bn(_conv(x, params["stem"]["conv"], 2),
+                        params["stem"]["bn"]))
+    x = jax.lax.reduce_window(
+        x, -jnp.inf, jax.lax.max, (1, 3, 3, 1), (1, 2, 2, 1), "SAME")
+    for si, stage in enumerate(params["stages"]):
+        for bi, block in enumerate(stage):
+            x = _block(x, block, _block_stride(si, bi), c.bottleneck)
+    x = jnp.mean(x, axis=(1, 2))  # global average pool
+    logits = x @ params["head"]["w"] + params["head"]["b"]
+    return logits.astype(jnp.float32)
+
+
+def loss_fn(config: ResNetConfig, params: dict, images, labels):
+    logits = forward(config, params, images)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, labels[:, None], axis=-1)[:, 0]
+    return jnp.mean(logz - gold)

@@ -23,16 +23,19 @@ import jax
 import jax.numpy as jnp
 
 
-def _chunk_nll(x_chunk, w, targets_chunk):
+def _chunk_nll(x_chunk, w, targets_chunk, logit_softcap: float):
     """[b, c, d] x [d, V] -> per-token NLL [b, c]; float32 softmax."""
     logits = (x_chunk @ w).astype(jnp.float32)
+    if logit_softcap and logit_softcap > 0:
+        logits = logit_softcap * jnp.tanh(logits / logit_softcap)
     logz = jax.nn.logsumexp(logits, axis=-1)
     gold = jnp.take_along_axis(
         logits, targets_chunk[..., None], axis=-1)[..., 0]
     return logz - gold
 
 
-def chunked_softmax_xent(x, w, targets, mask=None, chunk: int = 512):
+def chunked_softmax_xent(x, w, targets, mask=None, chunk: int = 512,
+                         logit_softcap: float = 0.0):
     """Cross-entropy of ``x @ w`` against ``targets`` without ever
     holding the full [b, s, V] logits.
 
@@ -63,7 +66,8 @@ def chunked_softmax_xent(x, w, targets, mask=None, chunk: int = 512):
     ms = mask.reshape(b, n, chunk).swapaxes(0, 1)
 
     step_fn = jax.checkpoint(  # backward recomputes chunk logits
-        lambda xc, tc, mc: jnp.sum(_chunk_nll(xc, w, tc) * mc))
+        lambda xc, tc, mc: jnp.sum(
+            _chunk_nll(xc, w, tc, logit_softcap) * mc))
 
     def step(carry, inp):
         xc, tc, mc = inp

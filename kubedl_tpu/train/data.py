@@ -14,7 +14,7 @@ from typing import Iterator, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel import mesh as mesh_lib
 
@@ -30,9 +30,27 @@ def synthetic_lm_batches(batch_size: int, seq_len: int, vocab_size: int,
 
 
 def shard_batch(batch: dict, mesh: Mesh) -> dict:
-    sharding = NamedSharding(mesh, mesh_lib.batch_spec())
-    return jax.tree.map(lambda x: jax.device_put(jnp.asarray(x), sharding),
-                        batch)
+    """Rank-aware batch sharding: the leading axis shards over the data
+    axes, a rank-2 [b, s] leaf additionally shards its sequence axis over
+    cp (ring attention), and higher-rank leaves (images) shard the batch
+    axis only."""
+    full = mesh_lib.batch_spec()  # P((dp, fsdp), cp)
+
+    def put(x):
+        x = jnp.asarray(x)
+        if x.ndim == 0:
+            s = P()
+        elif x.ndim == 2 and jnp.issubdtype(x.dtype, jnp.integer):
+            # integer [b, s] = token ids/targets/segments: sequence axis
+            # shards over cp. Float rank-2 leaves (feature matrices) only
+            # shard the batch axis — cp is a sequence axis, and a feature
+            # dim need not divide it.
+            s = full
+        else:
+            s = P(full[0], *([None] * (x.ndim - 1)))
+        return jax.device_put(x, NamedSharding(mesh, s))
+
+    return jax.tree.map(put, batch)
 
 
 def sharded_synthetic_stream(batch_size: int, seq_len: int, vocab_size: int,

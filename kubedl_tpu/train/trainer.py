@@ -26,7 +26,6 @@ import optax
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
-from ..parallel import mesh as mesh_lib
 from ..parallel.sharding import tree_shardings
 
 
@@ -89,8 +88,7 @@ class Trainer:
         self.config = config or TrainConfig()
         self.optimizer = make_optimizer(self.config)
         self.param_specs = param_specs
-        self.batch_spec = batch_spec if batch_spec is not None \
-            else mesh_lib.batch_spec()
+        self._batch_spec = batch_spec
         self._step_fn = None
 
     # -- state ------------------------------------------------------------
@@ -134,7 +132,11 @@ class Trainer:
         cfg = self.config
         p_shard = tree_shardings(self.mesh, self.param_specs)
         opt_shard = tree_shardings(self.mesh, self._opt_specs())
-        b_shard = NamedSharding(self.mesh, self.batch_spec)
+        # explicit batch_spec pins every leaf; the default defers to the
+        # shardings shard_batch() placed (rank-aware: [b] labels, [b, s]
+        # tokens, [b, h, w, c] images all shard differently)
+        b_shard = (NamedSharding(self.mesh, self._batch_spec)
+                   if self._batch_spec is not None else None)
         state_shardings = TrainState(
             step=NamedSharding(self.mesh, P()), params=p_shard,
             opt_state=opt_shard)

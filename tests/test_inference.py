@@ -145,6 +145,8 @@ def test_autoconfigure(model):
 
 
 def test_gemma_2b_config_shape():
-    cfg = llama.gemma_2b()
+    from kubedl_tpu.models import gemma
+    cfg = gemma.gemma_2b()
     assert cfg.n_kv_heads == 1 and cfg.head_dim == 256
+    assert cfg.tie_embeddings and cfg.act == "gelu"
     assert cfg.num_params > 2e9

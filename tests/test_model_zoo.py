@@ -1,0 +1,167 @@
+"""Model zoo beyond the Llama flagship: Gemma family knobs on the shared
+transformer core, ResNet vision model, and the MLP smoke model — each
+trains (loss decreases) on the virtual mesh."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from kubedl_tpu.models import gemma, llama, mlp, resnet
+from kubedl_tpu.parallel.mesh import MeshConfig, build_mesh
+from kubedl_tpu.train.data import shard_batch, synthetic_lm_batches
+from kubedl_tpu.train.trainer import TrainConfig, Trainer
+
+
+# -- gemma -------------------------------------------------------------------
+
+
+def test_gemma_knobs_change_the_function():
+    """Every Gemma knob must actually alter the computation vs a plain
+    Llama forward of the same shape."""
+    cfg_l = llama.tiny()
+    cfg_g = gemma.from_llama(cfg_l)
+    assert cfg_g.act == "gelu" and cfg_g.tie_embeddings
+    key = jax.random.PRNGKey(0)
+    p_l = llama.init_params(cfg_l, key)
+    p_g = gemma.init_params(cfg_g, key)
+    assert "lm_head" not in p_g  # tied
+    assert float(p_g["layers"]["attn_norm"][0, 0]) == 0.0  # offset init
+    tokens = jax.random.randint(key, (1, 16), 0, cfg_l.vocab_size)
+    out_l = llama.forward(cfg_l, p_l, tokens)
+    out_g = gemma.forward(cfg_g, p_g, tokens)
+    assert out_l.shape == out_g.shape
+    assert not jnp.allclose(out_l, out_g)
+
+
+def test_gemma2_softcap_bounds_logits():
+    cfg = gemma.tiny()
+    assert cfg.logit_softcap == 30.0
+    params = gemma.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16),
+                                0, cfg.vocab_size)
+    logits = gemma.forward(cfg, params, tokens)
+    assert float(jnp.max(jnp.abs(logits))) <= 30.0
+
+
+def test_gemma_trains_and_chunked_loss_matches():
+    import dataclasses
+
+    cfg = gemma.tiny()
+    mesh = build_mesh(MeshConfig(dp=2, fsdp=2, tp=2))
+    params = gemma.init_params(cfg, jax.random.PRNGKey(0))
+
+    def loss_fn(p, b):
+        return gemma.loss_fn(cfg, p, b["tokens"], b["targets"])
+
+    tr = Trainer(loss_fn, gemma.param_specs(cfg), mesh,
+                 TrainConfig(learning_rate=1e-3, warmup_steps=2,
+                             decay_steps=100))
+    state = tr.init_state(params)
+    batch = shard_batch(next(synthetic_lm_batches(8, 128, cfg.vocab_size)),
+                        mesh)
+    losses = []
+    for _ in range(6):
+        state, loss = tr.step(state, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] - 0.1
+
+    # chunked loss equals unchunked WITH softcap + tied head engaged
+    # (fresh params: the trainer donated the original buffers)
+    key = jax.random.PRNGKey(2)
+    params2 = gemma.init_params(cfg, key)
+    tokens = jax.random.randint(key, (2, 64), 0, cfg.vocab_size)
+    targets = jnp.roll(tokens, -1, axis=1)
+    ref = gemma.loss_fn(cfg, params2, tokens, targets)
+    out = gemma.loss_fn(dataclasses.replace(cfg, loss_chunk=24),
+                        params2, tokens, targets)
+    assert jnp.allclose(ref, out, rtol=2e-5)
+
+
+def test_gemma_decode_matches_forward():
+    """KV-cache decode path honors the family knobs: last-token logits
+    from forward_step equal the full forward's."""
+    cfg = gemma.tiny(seq=32)
+    params = gemma.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 8),
+                                0, cfg.vocab_size)
+    full = gemma.forward(cfg, params, tokens)[:, -1]
+    cache = gemma.init_cache(cfg, batch=1, max_len=32)
+    step, _ = gemma.forward_step(cfg, params, tokens, cache, 0)
+    assert jnp.allclose(full, step, atol=2e-2), (full[0, :4], step[0, :4])
+
+
+def test_gemma_2b_shapes():
+    assert gemma.gemma_2b().num_params == pytest.approx(2.5e9, rel=0.2)
+    assert gemma.gemma2_2b().logit_softcap == 30.0
+    assert gemma.gemma_7b().num_params == pytest.approx(8.5e9, rel=0.2)
+
+
+# -- resnet ------------------------------------------------------------------
+
+
+def test_resnet_forward_shapes():
+    cfg = resnet.tiny()
+    params = resnet.init_params(cfg, jax.random.PRNGKey(0))
+    images = jax.random.normal(jax.random.PRNGKey(1), (2, 64, 64, 3))
+    logits = resnet.forward(cfg, params, images)
+    assert logits.shape == (2, cfg.n_classes)
+    assert logits.dtype == jnp.float32
+    assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+def test_resnet50_param_count():
+    # torchvision ResNet-50 has ~25.6M params
+    params = resnet.init_params(resnet.resnet50(), jax.random.PRNGKey(0))
+    n = sum(x.size for x in jax.tree.leaves(params))
+    assert 2.3e7 < n < 2.8e7, n
+
+
+def test_resnet_trains():
+    cfg = resnet.tiny()
+    mesh = build_mesh(MeshConfig(dp=8))
+
+    def loss_fn(p, b):
+        return resnet.loss_fn(cfg, p, b["images"], b["labels"])
+
+    tr = Trainer(loss_fn, resnet.param_specs(cfg), mesh,
+                 TrainConfig(learning_rate=1e-2, warmup_steps=2,
+                             decay_steps=100))
+    state = tr.init_state(resnet.init_params(cfg, jax.random.PRNGKey(0)))
+    key = jax.random.PRNGKey(1)
+    batch = shard_batch({
+        "images": jax.random.normal(key, (16, 32, 32, 3)),
+        "labels": jax.random.randint(key, (16,), 0, cfg.n_classes),
+    }, mesh)
+    losses = []
+    for _ in range(6):
+        state, loss = tr.step(state, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] - 0.1
+
+
+# -- mlp ---------------------------------------------------------------------
+
+
+def test_mlp_trains_to_memorize():
+    cfg = mlp.MLPConfig(in_dim=32, hidden=(64,), n_classes=4)
+    mesh = build_mesh(MeshConfig(dp=8))
+
+    def loss_fn(p, b):
+        return mlp.loss_fn(cfg, p, b["x"], b["labels"])
+
+    tr = Trainer(loss_fn, mlp.param_specs(cfg), mesh,
+                 TrainConfig(learning_rate=1e-2, warmup_steps=2,
+                             decay_steps=100))
+    state = tr.init_state(mlp.init_params(cfg, jax.random.PRNGKey(0)))
+    key = jax.random.PRNGKey(1)
+    batch = shard_batch({
+        "x": jax.random.normal(key, (32, 32)),
+        "labels": jax.random.randint(key, (32,), 0, 4),
+    }, mesh)
+    for _ in range(30):
+        state, loss = tr.step(state, batch)
+    acc = mlp.accuracy(cfg, jax.device_get(state.params),
+                       jax.device_get(batch["x"]),
+                       jax.device_get(batch["labels"]))
+    assert float(loss) < 1.0
+    assert float(acc) > 0.5
