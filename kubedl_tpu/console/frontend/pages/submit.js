@@ -80,10 +80,16 @@ function renderYaml(el) {
 }
 
 async function renderForm(el) {
-  let dataSources = {}, codeSources = {};
-  try { dataSources = await api("/datasource"); } catch (e) { /* optional */ }
-  try { codeSources = await api("/codesource"); } catch (e) { /* optional */ }
+  // independent lookups in one round-trip; each degrades to its default
+  const [ds, cs, ns, im] = await Promise.allSettled([
+    api("/datasource"), api("/codesource"),
+    api("/kubedl/namespaces"), api("/kubedl/images")]);
+  const dataSources = ds.status === "fulfilled" ? ds.value : {};
+  const codeSources = cs.status === "fulfilled" ? cs.value : {};
+  const namespaces = ns.status === "fulfilled" ? ns.value : ["default"];
+  const images = im.status === "fulfilled" ? im.value : {};
   const kinds = Object.keys(KIND_ROLES);
+  const imageList = Object.values(images).flat();
 
   el.innerHTML = `
     <div class="form-grid">
@@ -91,9 +97,15 @@ async function renderForm(el) {
       <select id="f-kind">${kinds.map(k => `<option>${k}</option>`).join("")}
       </select>
       <label>Name</label><input id="f-name" placeholder="my-job">
-      <label>Namespace</label><input id="f-ns" value="default">
+      <label>Namespace</label>
+      <input id="f-ns" list="f-namespaces" value="default">
+      <datalist id="f-namespaces">${namespaces.map(n =>
+        `<option value="${esc(n)}">`).join("")}</datalist>
       <label>Image</label>
-      <input id="f-image" placeholder="gcr.io/project/train:latest">
+      <input id="f-image" list="f-images"
+             placeholder="gcr.io/project/train:latest">
+      <datalist id="f-images">${imageList.map(i =>
+        `<option value="${esc(i)}">`).join("")}</datalist>
       <label>Command</label>
       <input id="f-cmd" placeholder="python train.py --epochs 10">
     </div>

@@ -3,6 +3,7 @@ rejected at ``api.create``, not discovered mid-reconcile; the same chain
 serves AdmissionReview for real clusters."""
 
 import base64
+import copy
 import json
 
 import pytest
@@ -11,7 +12,7 @@ from kubedl_tpu.controllers.registry import OperatorConfig, build_operator
 from kubedl_tpu.core import meta as m
 from kubedl_tpu.core.admission import (AdmissionChain, WebhookServer,
                                        review_response, validate_cron)
-from kubedl_tpu.core.apiserver import APIServer, Invalid
+from kubedl_tpu.core.apiserver import ApiError, APIServer, Invalid
 
 
 def pt_job(name="pj", **spec_extra):
@@ -227,5 +228,86 @@ def test_webhook_server_http_roundtrip(chain):
             headers={"Content-Type": "application/json"})
         out = json.loads(urllib.request.urlopen(req).read())
         assert out["response"]["allowed"] is False
+    finally:
+        server.stop()
+
+
+# -- substrate equivalence over the WEBHOOK path (round-2 weak #7) -----------
+
+
+def test_webhook_and_standalone_reject_same_corpus(op, api):
+    """The same corpus of good/bad objects must get the same verdicts
+    through BOTH admission substrates: the in-memory apiserver's inline
+    chain (standalone mode) and the real AdmissionReview webhook served
+    over HTTP (real-cluster mode)."""
+    import urllib.request
+
+    chain = op.admission
+    server = WebhookServer(chain, port=0, host="127.0.0.1")
+    server.start()
+    try:
+        def post(obj, path):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}{path}", method="POST",
+                data=json.dumps(make_review(obj)).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req) as res:
+                return json.loads(res.read())["response"]
+
+        def apply_patch(obj, patch_ops):
+            """Minimal RFC-6902 apply (add/replace/remove on object
+            paths) — what the apiserver does with the mutate response."""
+            for op_ in patch_ops:
+                parts = [p.replace("~1", "/").replace("~0", "~")
+                         for p in op_["path"].lstrip("/").split("/")]
+                node = obj
+                for key in parts[:-1]:
+                    node = node.setdefault(key, {})
+                if op_["op"] == "remove":
+                    node.pop(parts[-1], None)
+                else:
+                    node[parts[-1]] = op_["value"]
+            return obj
+
+        def webhook_verdict(obj):
+            # the real-cluster flow: mutate webhook, apply its patch,
+            # then validate webhook — both legs must agree with inline
+            resp = post(obj, "/mutate-kubedl-io")
+            if not resp["allowed"]:
+                return False
+            if resp.get("patch"):
+                obj = apply_patch(obj, json.loads(
+                    base64.b64decode(resp["patch"])))
+            return post(obj, "/validate-kubedl-io")["allowed"]
+
+        def standalone_verdict(obj):
+            try:
+                api.create(copy.deepcopy(obj))
+                api.delete(m.kind(obj), m.namespace(obj) or "default",
+                           m.name(obj))
+                return True
+            except ApiError:
+                return False
+
+        corpus = [
+            (pt_job(), True),
+            ({**pt_job(), "spec": {"pytorchReplicaSpecs": {}}}, False),
+            ({**pt_job(), "spec": {"pytorchReplicaSpecs": {"Worker": {
+                "replicas": -1, "template": {"spec": {"containers": [
+                    {"name": "pytorch", "image": "i"}]}}}}}}, False),
+            ({**pt_job(), "spec": {"pytorchReplicaSpecs": {"Worker": {
+                "replicas": 1,
+                "template": {"spec": {"containers": []}}}}}}, False),
+            ({**pt_job(), "spec": {**pt_job()["spec"],
+                                   "tpuPolicy": {"acceleratorType":
+                                                 "v9z-99"}}}, False),
+        ]
+        for i, (obj, want) in enumerate(corpus):
+            obj = copy.deepcopy(obj)
+            obj["metadata"]["name"] = f"corpus-{i}"
+            wh = webhook_verdict(obj)
+            sa = standalone_verdict(obj)
+            assert wh == sa == want, \
+                f"corpus[{i}]: webhook={wh} standalone={sa} want={want}"
     finally:
         server.stop()
