@@ -57,3 +57,78 @@ def sharded_synthetic_stream(batch_size: int, seq_len: int, vocab_size: int,
                              mesh: Mesh, seed: int = 0) -> Iterator[dict]:
     for batch in synthetic_lm_batches(batch_size, seq_len, vocab_size, seed):
         yield shard_batch(batch, mesh)
+
+
+def prefetch_to_device(batches: Iterator[dict], mesh: Optional[Mesh] = None,
+                       size: int = 2) -> Iterator[dict]:
+    """Keep ``size`` device batches in flight ahead of the consumer.
+
+    ``jax.device_put`` is asynchronous: issuing the transfer for batch
+    N+1 while the step for batch N is still executing hides the
+    host→device copy behind compute — the standard TPU input-pipeline
+    overlap (without it, every step starts with a synchronous HBM fill).
+    With ``mesh`` each host batch is sharded on the way in; without it
+    the stream is assumed pre-sharded and only the lookahead window is
+    added. Host memory holds at most ``size`` extra batches."""
+    import collections
+
+    put = (lambda b: shard_batch(b, mesh)) if mesh is not None \
+        else (lambda b: b)
+    queue = collections.deque()
+    try:
+        for _ in range(size):
+            queue.append(put(next(batches)))
+    except StopIteration:
+        pass
+    while queue:
+        out = queue.popleft()
+        try:
+            queue.append(put(next(batches)))
+        except StopIteration:
+            pass
+        yield out
+
+
+class TokenFileDataset:
+    """Pre-tokenized corpus on disk: a flat int32 (or int16/uint16) token
+    array, memory-mapped — the layout GCS-FUSE/persistent-disk dataset
+    caches serve (CacheBackend CRD mounts it; this reads it).
+
+    Each host reads only its own contiguous shard of the file
+    (``process_index``/``process_count``), so a multi-host job streams
+    disjoint data with zero coordination.
+    """
+
+    def __init__(self, path: str, seq_len: int, batch_size: int,
+                 dtype=np.int32, process_index: int = 0,
+                 process_count: int = 1, seed: int = 0):
+        self.tokens = np.memmap(path, dtype=dtype, mode="r")
+        self.seq_len = seq_len
+        self.batch_size = batch_size
+        n = len(self.tokens) // (seq_len + 1)
+        lo = n * process_index // process_count
+        hi = n * (process_index + 1) // process_count
+        if hi - lo < batch_size:
+            # an undersized shard would make batches() spin forever
+            # yielding nothing — fail loudly at construction instead
+            raise ValueError(
+                f"token file too small: {n} sequences across "
+                f"{process_count} hosts leaves host {process_index} with "
+                f"{hi - lo} (< batch_size {batch_size})")
+        self._indices = np.arange(lo, hi)
+        self._rng = np.random.default_rng(seed + process_index)
+
+    def __len__(self) -> int:
+        return len(self._indices)
+
+    def batches(self) -> Iterator[dict]:
+        """Infinite shuffled stream of {tokens, targets} (epoch reshuffle)."""
+        sl = self.seq_len
+        while True:
+            order = self._rng.permutation(self._indices)
+            for start in range(0, len(order) - self.batch_size + 1,
+                               self.batch_size):
+                rows = [self.tokens[i * (sl + 1):(i + 1) * (sl + 1)]
+                        for i in order[start:start + self.batch_size]]
+                block = np.asarray(rows, dtype=np.int32)  # single host copy
+                yield {"tokens": block[:, :-1], "targets": block[:, 1:]}
