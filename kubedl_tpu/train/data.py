@@ -74,6 +74,7 @@ def prefetch_to_device(batches: Iterator[dict], mesh: Optional[Mesh] = None,
 
     put = (lambda b: shard_batch(b, mesh)) if mesh is not None \
         else (lambda b: b)
+    size = max(size, 1)  # size<=0 would silently drop the whole stream
     queue = collections.deque()
     try:
         for _ in range(size):

@@ -131,3 +131,59 @@ def test_webhook_manifests_cover_all_training_kinds():
                            "xgboostjobs", "xdljobs", "marsjobs",
                            "elasticdljobs", "crons"):
                 assert plural in resources, (d["kind"], plural)
+
+
+def test_helm_deployment_renders_new_values():
+    """Structural render of the deployment template (no helm binary in
+    CI): webhook certs, console auth secret, and delivery image all wire
+    through when their values are set."""
+    import re
+
+    values = yaml.safe_load(
+        (ROOT / "helm/kubedl-tpu/values.yaml").read_text())
+    values["webhook"]["enabled"] = True
+    values["webhook"]["certSecret"] = "wh-cert"
+    values["console"]["authSecret"] = "console-users"
+    values["kubectlDeliveryImage"] = "reg/kd:v1"
+    src = (ROOT / "helm/kubedl-tpu/templates/deployment.yaml").read_text()
+
+    def lookup(path):
+        cur = {"Values": values,
+               "Release": {"Name": "t", "Namespace": "ns"}}
+        for part in path.lstrip(".").split("."):
+            cur = cur[part]
+        return cur
+
+    out, stack, keep = [], [], True
+    for line in src.splitlines():
+        mt = re.match(r"\s*\{\{-? (?:if|with) (not )?(\.[\w.]+) \}\}", line)
+        if mt:
+            stack.append(keep)
+            try:
+                val = bool(lookup(mt.group(2)))
+            except KeyError:
+                val = False
+            keep = keep and (not val if mt.group(1) else val)
+            continue
+        if re.match(r"\s*\{\{-? end \}\}", line):
+            keep = stack.pop()
+            continue
+        if not keep or "toYaml" in line:
+            continue
+        assert "{{- fail" not in line, f"helm fail guard tripped: {line}"
+        line = re.sub(r"\{\{ \.([\w.]+) \}\}",
+                      lambda mt: str(lookup(mt.group(1))), line)
+        line = re.sub(r'"\{\{[^}]+\}\}"', '"img"', line)
+        line = re.sub(r"\{\{[^}]+\}\}", "X", line)
+        out.append(line)
+    text = "\n".join(ln for ln in out
+                     if ln.strip() not in ("X", "resources:"))
+    doc = yaml.safe_load(text)
+    spec = doc["spec"]["template"]["spec"]
+    ct = spec["containers"][0]
+    assert "--webhook-port=9443" in ct["args"]
+    assert "--kubectl-delivery-image=reg/kd:v1" in ct["args"]
+    assert ct["env"][0]["name"] == "KUBEDL_CONSOLE_USERS"
+    assert ct["env"][0]["valueFrom"]["secretKeyRef"]["name"] == "console-users"
+    assert any(v["name"] == "webhook-certs" for v in spec["volumes"])
+    assert any(mt["name"] == "webhook-certs" for mt in ct["volumeMounts"])
