@@ -187,3 +187,18 @@ def test_helm_deployment_renders_new_values():
     assert ct["env"][0]["valueFrom"]["secretKeyRef"]["name"] == "console-users"
     assert any(v["name"] == "webhook-certs" for v in spec["volumes"])
     assert any(mt["name"] == "webhook-certs" for mt in ct["volumeMounts"])
+
+
+def test_helm_webhook_template_is_release_scoped():
+    """The chart's webhook Service + configurations must be fully
+    release-scoped (no hard-coded kubedl-system or static names that
+    collide with the kustomize stack)."""
+    src = (ROOT / "helm/kubedl-tpu/templates/webhook-service.yaml").read_text()
+    assert "kubedl-system" not in src
+    assert "name: kubedl-tpu-webhook-service" not in src
+    assert "{{ .Release.Name }}-webhook" in src
+    assert "MutatingWebhookConfiguration" in src
+    assert "ValidatingWebhookConfiguration" in src
+    # the same training kinds the static configs guard
+    for plural in ("tfjobs", "pytorchjobs", "jaxjobs", "mpijobs", "crons"):
+        assert plural in src

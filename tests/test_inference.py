@@ -150,3 +150,19 @@ def test_gemma_2b_config_shape():
     assert cfg.n_kv_heads == 1 and cfg.head_dim == 256
     assert cfg.tie_embeddings and cfg.act == "gelu"
     assert cfg.num_params > 2e9
+
+
+def test_gemma_family_serves_through_engine():
+    """BASELINE config 5 path: the inference engine serves a Gemma-family
+    model (tied LM head, GeGLU, softcap) through the same cache-aware
+    forward as Llama — greedy decode is deterministic and in-vocab."""
+    from kubedl_tpu.models import gemma
+    from kubedl_tpu.serving.engine import GenerateConfig, InferenceEngine
+
+    cfg = gemma.tiny(vocab=199, seq=64)
+    params = gemma.init_params(cfg, jax.random.PRNGKey(3))
+    engine = InferenceEngine(cfg, params, GenerateConfig(max_len=32))
+    out = engine.generate([[1, 2, 3]], max_new_tokens=6)[0]
+    again = engine.generate([[1, 2, 3]], max_new_tokens=6)[0]
+    assert out == again
+    assert len(out) == 6 and all(0 <= t < cfg.vocab_size for t in out)
