@@ -90,3 +90,47 @@ def test_bf16_inputs(qkv):
     out = chunked_attention(q, k, v, causal=True, block_k=32)
     assert out.dtype == jnp.bfloat16
     assert bool(jnp.all(jnp.isfinite(out.astype(jnp.float32))))
+
+
+def test_pallas_interpret_gqa_bench_ratio():
+    """The exact head ratio the TPU bench runs (GQA heads/kv = 2:1 for
+    v5e config, 4:1 for llama3): interpret-mode pin so the first hardware
+    run isn't the first time the kernel sees the shape class."""
+    key = jax.random.PRNGKey(2)
+    for nh, nkv in ((4, 2), (8, 2)):
+        kq, kk, kv = jax.random.split(jax.random.fold_in(key, nh), 3)
+        q = jax.random.normal(kq, (1, 256, nh, 128), jnp.float32)
+        k = jax.random.normal(kk, (1, 256, nkv, 128), jnp.float32)
+        v = jax.random.normal(kv, (1, 256, nkv, 128), jnp.float32)
+        ref = reference_attention(q, k, v, causal=True)
+        pal = multi_head_attention(q, k, v, causal=True,
+                                   impl="pallas_interpret")
+        assert jnp.max(jnp.abs(ref - pal)) < 1e-5, (nh, nkv)
+
+
+def test_pallas_interpret_longer_seq_and_bf16():
+    """Multi-block q AND k dimension (seq 512 = 4 q-blocks x 4 k-blocks at
+    the 128 default), in the bench's bf16 dtype."""
+    key = jax.random.PRNGKey(3)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (2, 512, 2, 128), jnp.bfloat16)
+    k = jax.random.normal(kk, (2, 512, 2, 128), jnp.bfloat16)
+    v = jax.random.normal(kv, (2, 512, 2, 128), jnp.bfloat16)
+    ref = reference_attention(q, k, v, causal=True)
+    pal = multi_head_attention(q, k, v, causal=True,
+                               impl="pallas_interpret")
+    # bf16 tolerance: matmul rounding differs between paths
+    assert jnp.max(jnp.abs(ref.astype(jnp.float32)
+                           - pal.astype(jnp.float32))) < 3e-2
+
+
+def test_pallas_interpret_non_causal():
+    key = jax.random.PRNGKey(4)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (1, 256, 2, 128), jnp.float32)
+    k = jax.random.normal(kk, (1, 256, 2, 128), jnp.float32)
+    v = jax.random.normal(kv, (1, 256, 2, 128), jnp.float32)
+    ref = reference_attention(q, k, v, causal=False)
+    pal = multi_head_attention(q, k, v, causal=False,
+                               impl="pallas_interpret")
+    assert jnp.max(jnp.abs(ref - pal)) < 1e-5

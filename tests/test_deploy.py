@@ -190,15 +190,28 @@ def test_helm_deployment_renders_new_values():
 
 
 def test_helm_webhook_template_is_release_scoped():
-    """The chart's webhook Service + configurations must be fully
+    """The chart's webhook Service + cert + configurations must be fully
     release-scoped (no hard-coded kubedl-system or static names that
-    collide with the kustomize stack)."""
+    collide with the kustomize stack), self-issuing via cert-manager, and
+    guard EXACTLY the same resource rules as the static manifests."""
+    import re
+
     src = (ROOT / "helm/kubedl-tpu/templates/webhook-service.yaml").read_text()
     assert "kubedl-system" not in src
     assert "name: kubedl-tpu-webhook-service" not in src
     assert "{{ .Release.Name }}-webhook" in src
     assert "MutatingWebhookConfiguration" in src
     assert "ValidatingWebhookConfiguration" in src
-    # the same training kinds the static configs guard
-    for plural in ("tfjobs", "pytorchjobs", "jaxjobs", "mpijobs", "crons"):
-        assert plural in src
+    # self-contained TLS: Issuer + Certificate whose SANs match the
+    # chart's own Service name, CA injected from the chart's Certificate
+    assert "kind: Issuer" in src and "kind: Certificate" in src
+    assert "{{ .Release.Name }}-webhook.{{ .Release.Namespace }}.svc" in src
+    assert "cert-manager.io/inject-ca-from: " \
+           "{{ .Release.Namespace }}/{{ .Release.Name }}-webhook-cert" in src
+
+    # no rule drift vs the static configs: identical guarded plurals
+    static = (ROOT / "config/webhook/manifests.yaml").read_text()
+    plural_re = re.compile(r"^\s+- ([a-z]+jobs|crons)$", re.M)
+    static_plurals = sorted(set(plural_re.findall(static)))
+    helm_plurals = sorted(set(plural_re.findall(src)))
+    assert helm_plurals == static_plurals and len(static_plurals) == 9
