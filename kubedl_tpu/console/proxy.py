@@ -11,6 +11,7 @@ persistence backend's records.
 from __future__ import annotations
 
 import json
+import logging
 from typing import Optional
 
 from ..client.clientset import TRAINING_KINDS
@@ -112,6 +113,24 @@ class DataProxy:
                                for k, v in sorted(by_status.items())]}
 
     # -- events / notebooks ----------------------------------------------
+
+    def pod_log_lines(self, namespace: str, pod_name: str) -> list:
+        """Real kubelet logs when the API substrate serves the log
+        subresource (real-cluster mode); the pod's event stream otherwise
+        (the standalone control plane has no kubelet)."""
+        if hasattr(self.api, "pod_logs"):
+            try:
+                text = self.api.pod_logs(namespace, pod_name, tail_lines=1000)
+                return text.splitlines()
+            except Exception as e:  # noqa: BLE001 — degrade, but loudly:
+                # a swallowed 403 (missing pods/log RBAC) must not read as
+                # "this pod has no logs"
+                logging.getLogger("kubedl_tpu.console").warning(
+                    "pod logs for %s/%s unavailable (%s: %s); serving "
+                    "event stream instead", namespace, pod_name,
+                    type(e).__name__, e)
+        return [f"{e.last_timestamp} [{e.type}] {e.reason}: {e.message}"
+                for e in self.list_events(namespace, pod_name)]
 
     def list_events(self, namespace: str, obj_name: str) -> list:
         live = [dmo.event_to_record(e) for e in self.api.list("Event", namespace)

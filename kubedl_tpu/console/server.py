@@ -295,12 +295,11 @@ class ConsoleServer:
         mt = re.fullmatch(r"/api/v1/log/(logs|download)/([^/]+)/([^/]+)",
                           path)
         if mt:
-            # standalone control plane has no kubelet log endpoint; the
-            # nearest faithful signal is the pod's event stream. download
-            # (reference log.go:28) serves the same lines as an attachment
+            # real kubelet logs in real-cluster mode; event-stream pseudo-
+            # logs on the standalone plane. download (reference log.go:28)
+            # serves the same lines as an attachment
             verb, ns, name = mt.groups()
-            lines = [f"{e.last_timestamp} [{e.type}] {e.reason}: {e.message}"
-                     for e in self.proxy.list_events(ns, name)]
+            lines = self.proxy.pod_log_lines(ns, name)
             if verb == "logs":
                 return ok(lines)
             return 200, ("\n".join(lines) + "\n").encode(), [

@@ -268,7 +268,8 @@ class KubeAPIServer:
 
     def _request(self, method: str, path: str, body: Optional[Obj] = None,
                  params: Optional[dict] = None,
-                 content_type: str = "application/json") -> Obj:
+                 content_type: str = "application/json",
+                 raw: bool = False):
         if params:
             path = path + "?" + urllib.parse.urlencode(params)
         payload = json.dumps(body).encode() if body is not None else None
@@ -329,6 +330,8 @@ class KubeAPIServer:
             break
         if resp.status >= 400:
             raise self._error(resp.status, data, method, path)
+        if raw:
+            return data.decode(errors="replace")
         return json.loads(data) if data else {}
 
     @staticmethod
@@ -469,6 +472,21 @@ class KubeAPIServer:
         # that don't drain it (and the param form is equally valid)
         self._request("DELETE", self._path(kind, namespace, name),
                       params={"propagationPolicy": "Background"})
+
+    def pod_logs(self, namespace: str, name: str,
+                 container: Optional[str] = None,
+                 tail_lines: Optional[int] = None) -> str:
+        """GET the pod log subresource (real kubelet logs — the console's
+        logs tab upgrades from event-stream pseudo-logs to these when the
+        operator runs against a real cluster). Rides _request's full
+        transport policy (keep-alive recovery, 429/5xx backoff)."""
+        params = {}
+        if container:
+            params["container"] = container
+        if tail_lines is not None:
+            params["tailLines"] = str(tail_lines)
+        return self._request("GET", self._path("Pod", namespace, name, "log"),
+                             params=params or None, raw=True)
 
     # -- watch (informer-style list+watch fan-out) -------------------------
 

@@ -127,7 +127,19 @@ def _make_handler(fk: FakeKube):
 
         def do_GET(self):
             try:
-                kind, ns, name, _, params = self._route()
+                kind, ns, name, sub, params = self._route()
+                if name and sub == "log":
+                    # kubelet log subresource: served from the pod's
+                    # fake/logs annotation (raw text, not JSON)
+                    pod = fk.api.get(kind, ns or "default", name)
+                    text = m.annotations(pod).get("fake/logs", "")
+                    data = text.encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/plain")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                    return
                 if name:
                     return self._send(200, fk.api.get(kind, ns or "default",
                                                       name))

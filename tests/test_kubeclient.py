@@ -437,3 +437,14 @@ def test_run_loop_demotes_on_lost_lease():
     assert stopped.wait(5.0)
     stop.set()
     th.join(2.0)
+
+
+def test_pod_logs_subresource(kube):
+    pod = m.new_obj("v1", "Pod", "logpod", "default",
+                    annotations={"fake/logs": "line1\nline2\n"})
+    pod["spec"] = {"containers": [{"name": "c", "image": "i"}]}
+    kube.create(pod)
+    text = kube.pod_logs("default", "logpod", tail_lines=100)
+    assert text.splitlines() == ["line1", "line2"]
+    with pytest.raises(NotFound):
+        kube.pod_logs("default", "no-such-pod")
