@@ -120,7 +120,18 @@ class DataProxy:
         (the standalone control plane has no kubelet)."""
         if hasattr(self.api, "pod_logs"):
             try:
-                text = self.api.pod_logs(namespace, pod_name, tail_lines=1000)
+                # multi-container pods require an explicit container; use
+                # the first (the engine puts the main container first)
+                container = None
+                pod = self.api.try_get("Pod", namespace, pod_name)
+                if pod is not None:
+                    containers = m.get_in(pod, "spec", "containers",
+                                          default=[]) or []
+                    if len(containers) > 1:
+                        container = containers[0].get("name")
+                text = self.api.pod_logs(namespace, pod_name,
+                                         container=container,
+                                         tail_lines=1000)
                 return text.splitlines()
             except Exception as e:  # noqa: BLE001 — degrade, but loudly:
                 # a swallowed 403 (missing pods/log RBAC) must not read as

@@ -279,3 +279,36 @@ def test_agent_passes_through_child_exit(tmp_path):
     agent = RestartAgent(annotations_path=str(path), poll_interval=0.05)
     assert agent.run([sys.executable, "-c", "raise SystemExit(3)"]) == 3
     assert agent.run([sys.executable, "-c", "pass"]) == 0
+
+
+def test_agent_forwards_sigterm_to_child(tmp_path):
+    """Pod termination: kubelet SIGTERMs the agent (PID 1); the agent must
+    forward it to the trainer's process group and exit 128+15, preserving
+    graceful checkpoint-on-preempt."""
+    import pathlib
+
+    repo_root = str(pathlib.Path(__file__).resolve().parents[1])
+    marker = tmp_path / "child-terminated"
+    child_code = (
+        "import signal, sys, time, pathlib\n"
+        f"mark = pathlib.Path({str(marker)!r})\n"
+        "signal.signal(signal.SIGTERM,"
+        " lambda *a: (mark.write_text('x'), sys.exit(0)))\n"
+        "print('ready', flush=True)\n"
+        "time.sleep(60)\n")
+    env = {**os.environ,
+           "KUBEDL_PODINFO_ANNOTATIONS": str(tmp_path / "annotations"),
+           "KUBEDL_RESTART_POLL_S": "0.1",
+           "PYTHONPATH": repo_root}
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "kubedl_tpu.runtime.restart_agent", "--",
+         sys.executable, "-u", "-c", child_code],
+        env=env, stdout=subprocess.PIPE)
+    assert proc.stdout.readline().strip() == b"ready"
+    proc.send_signal(signal.SIGTERM)
+    code = proc.wait(timeout=15)
+    assert code == 128 + signal.SIGTERM
+    deadline = time.time() + 5
+    while not marker.exists() and time.time() < deadline:
+        time.sleep(0.05)
+    assert marker.exists(), "child never saw the forwarded SIGTERM"
