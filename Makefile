@@ -1,0 +1,39 @@
+# kubedl-tpu developer entry points (reference Makefile:17-80 analog).
+
+PY ?= python
+
+.PHONY: test test-fast bench dryrun crds run-standalone lint
+
+# full suite on the 8-device virtual CPU mesh (conftest pins the platform)
+test:
+	$(PY) -m pytest tests/ -q
+
+# operator-only tests (skips the slow compute/jit suites)
+test-fast:
+	$(PY) -m pytest tests/ -q --ignore=tests/test_llama.py \
+	    --ignore=tests/test_ring.py --ignore=tests/test_attention.py \
+	    --ignore=tests/test_checkpoint.py --ignore=tests/test_model_zoo.py \
+	    --ignore=tests/test_inference.py --ignore=tests/test_dryrun.py
+
+# one-line JSON training benchmark (TPU when reachable, cpu smoke otherwise)
+bench:
+	$(PY) bench.py
+
+# multi-chip sharding compile+execute proof on a virtual mesh
+dryrun:
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	    $(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+# regenerate config/crd/bases from the API types
+crds:
+	$(PY) hack/gen_crds.py
+
+# standalone control plane with console + sqlite persistence
+run-standalone:
+	$(PY) -m kubedl_tpu --workloads PyTorchJob,TFJob,JAXJob \
+	    --object-storage sqlite:///tmp/kubedl.db \
+	    --event-storage sqlite:///tmp/kubedl.db \
+	    --console-port 9090
+
+lint:
+	$(PY) -m compileall -q kubedl_tpu tests bench.py __graft_entry__.py
