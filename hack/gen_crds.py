@@ -92,7 +92,13 @@ TRAINING = {
     "JAXJob": ("jaxReplicaSpecs", {}),
     "MPIJob": ("mpiReplicaSpecs",
                {"slotsPerWorker": {"type": "integer"},
-                "mainContainer": {"type": "string"}}),
+                "mainContainer": {"type": "string"},
+                "mpiDistribution": {
+                    "type": "string",
+                    "enum": ["OpenMPI", "IntelMPI", "MPICH"]},
+                # reference MPIJobLegacySpec compat surface
+                "legacySpec": {"type": "object",
+                               "x-kubernetes-preserve-unknown-fields": True}}),
     "XGBoostJob": ("xgbReplicaSpecs", {}),
     "XDLJob": ("xdlReplicaSpecs",
                {"minFinishWorkRate": {"type": "integer"}}),

@@ -129,6 +129,10 @@ class WorkloadController:
         # reference kinds inline RunPolicy fields at spec top level
         return RunPolicy.from_dict(job.get("spec", {}))
 
+    def validate(self, job: dict) -> None:
+        """Kind-specific validation hook, run by the admission chain after
+        the generic job validators. Raise ValueError to reject."""
+
     def set_defaults(self, job: dict) -> None:
         """Defaulting webhook analog (reference ``apis/training/v1alpha1/
         *_defaults.go``): replicas=1, restart policy, port."""

@@ -29,6 +29,7 @@ KUBECTL_MOUNT_PATH = "/opt/kube"
 KUBECTL_VOLUME = "mpi-kubectl-delivery"
 CONFIG_VOLUME = "mpi-job-config"
 CONFIG_MOUNT_PATH = "/etc/mpi"
+DISTRIBUTIONS = ("OpenMPI", "IntelMPI", "MPICH")
 
 
 class MPIJobController(WorkloadController):
@@ -69,12 +70,20 @@ class MPIJobController(WorkloadController):
         replicas = self.get_replica_specs(job)
         workers = int((replicas.get("Worker") and replicas["Worker"].replicas) or 0)
         slots = self._slots_per_worker(job)
+        dist = self._distribution(job)
         # bare pod names, not service FQDNs: the kubexec.sh rsh agent runs
         # `kubectl exec $1` which takes a pod name (reference mpi_config.go
         # builds `${job}-worker-${i}` for the same reason); the names still
-        # resolve as DNS where per-replica headless services exist
-        hostfile = "\n".join(
-            f"{m.name(job)}-worker-{i} slots={slots}" for i in range(workers))
+        # resolve as DNS where per-replica headless services exist.
+        # Hostfile dialect per distribution (mpi_config.go:88-98): Intel
+        # MPI/MPICH use `host:slots`, Open MPI uses `host slots=N`.
+        if dist in ("IntelMPI", "MPICH"):
+            hostfile = "\n".join(
+                f"{m.name(job)}-worker-{i}:{slots}" for i in range(workers))
+        else:
+            hostfile = "\n".join(
+                f"{m.name(job)}-worker-{i} slots={slots}"
+                for i in range(workers))
         if rt == "launcher":
             self._ensure_hostfile_configmap(job, hostfile)
             rbac_ok = self._ensure_launcher_rbac(job)
@@ -129,15 +138,48 @@ class MPIJobController(WorkloadController):
                 if not any(mt.get("name") == KUBECTL_VOLUME for mt in mounts):
                     mounts.append({"name": KUBECTL_VOLUME,
                                    "mountPath": KUBECTL_MOUNT_PATH})
-                pl.upsert_env(ct, "OMPI_MCA_orte_default_hostfile",
+                # rsh-agent/hostfile env names differ per MPI framework
+                # (mpijob_controller.go:392-404)
+                rsh_env, hostfile_env = {
+                    "IntelMPI": ("I_MPI_HYDRA_BOOTSTRAP_EXEC",
+                                 "I_MPI_HYDRA_HOST_FILE"),
+                    "MPICH": ("HYDRA_LAUNCHER_EXEC", "HYDRA_HOST_FILE"),
+                }.get(dist, ("OMPI_MCA_plm_rsh_agent",
+                             "OMPI_MCA_orte_default_hostfile"))
+                pl.upsert_env(ct, hostfile_env,
                               f"{CONFIG_MOUNT_PATH}/hostfile")
-                pl.upsert_env(ct, "OMPI_MCA_plm_rsh_agent",
-                              f"{CONFIG_MOUNT_PATH}/kubexec.sh")
-                pl.upsert_env(ct, "OMPI_MCA_orte_keep_fqdn_hostnames", "t")
-                pl.upsert_env(ct, "KUBEDL_WORKER_HOSTS", hostfile.replace("\n", ","))
+                pl.upsert_env(ct, rsh_env, f"{CONFIG_MOUNT_PATH}/kubexec.sh")
+                if dist == "OpenMPI":
+                    pl.upsert_env(ct, "OMPI_MCA_orte_keep_fqdn_hostnames", "t")
+                # convenience env, NOT an MPI input: keep it dialect-
+                # independent (bare names) so consumers never parse the
+                # hostfile syntax
+                pl.upsert_env(ct, "KUBEDL_WORKER_HOSTS", ",".join(
+                    f"{m.name(job)}-worker-{i}" for i in range(workers)))
         else:
             for ct in m.get_in(pod, "spec", "containers", default=[]) or []:
                 pl.upsert_env(ct, "KUBEDL_MPI_ROLE", rt)
+
+    def validate(self, job: dict) -> None:
+        """Reject unknown mpiDistribution values at admission — silent
+        OpenMPI coercion of a typo ('intelMPI') would surface as an
+        inexplicable launcher hang."""
+        for dist in (m.get_in(job, "spec", "mpiDistribution"),
+                     m.get_in(job, "spec", "legacySpec", "legacyV1Alpha2",
+                              "mpiDistribution")):
+            if dist is not None and dist not in DISTRIBUTIONS:
+                raise ValueError(
+                    f"{m.name(job)}: mpiDistribution {dist!r} not in "
+                    f"{sorted(DISTRIBUTIONS)}")
+
+    def _distribution(self, job) -> str:
+        """MPI framework flavor: ``spec.mpiDistribution`` (clean spelling)
+        or the reference's legacy path
+        ``spec.legacySpec.legacyV1Alpha2.mpiDistribution``
+        (mpijob_controller.go:389-404). Default OpenMPI."""
+        dist = m.get_in(job, "spec", "mpiDistribution") or m.get_in(
+            job, "spec", "legacySpec", "legacyV1Alpha2", "mpiDistribution")
+        return dist if dist in ("IntelMPI", "MPICH") else "OpenMPI"
 
     def _slots_per_worker(self, job) -> int:
         slots = m.get_in(job, "spec", "slotsPerWorker")
@@ -196,9 +238,13 @@ class MPIJobController(WorkloadController):
         if self.api is None:
             return
         name = f"{m.name(job)}-config"
+        # spec.mainContainer targets the exec at a specific container of
+        # multi-container workers (reference mpi_config.go:75-77)
+        main = m.get_in(job, "spec", "mainContainer") or ""
+        container_flag = f" --container {main}" if main else ""
         kubexec = ("#!/bin/sh\nset -x\nPOD_NAME=$1\nshift\n"
                    f'exec {KUBECTL_MOUNT_PATH}/kubectl exec ${{POD_NAME}}'
-                   ' -- /bin/sh -c "$*"\n')
+                   f'{container_flag} -- /bin/sh -c "$*"\n')
         cm = m.new_obj("v1", "ConfigMap", name, m.namespace(job))
         cm["data"] = {"hostfile": hostfile, "kubexec.sh": kubexec}
         m.set_controller_ref(cm, job)
@@ -208,6 +254,8 @@ class MPIJobController(WorkloadController):
                 self.api.create(cm)
             except AlreadyExists:
                 pass
-        elif existing.get("data", {}).get("hostfile") != hostfile:
+        elif existing.get("data") != cm["data"]:
+            # compare ALL data: kubexec.sh varies with mainContainer, the
+            # hostfile with replicas/slots/dialect
             existing["data"] = cm["data"]
             self.api.update(existing)

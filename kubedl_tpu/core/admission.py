@@ -77,12 +77,24 @@ class AdmissionChain:
             chain.add_defaulter(kind, ctrl.set_defaults)
             chain.add_validator(kind, _job_validator(ctrl))
             chain.add_validator(kind, _tpu_replica_validator(ctrl))
+            chain.add_validator(kind, _wrap_value_errors(ctrl.validate))
         chain.add_validator("Cron", validate_cron)
         chain.add_validator("Cron", _cron_template_validator(chain))
         return chain
 
 
 # -- job validation ----------------------------------------------------------
+
+def _wrap_value_errors(fn: Callable[[dict], None]) -> Callable[[dict], None]:
+    """Controller ``validate`` hooks raise plain ValueError; surface it as
+    the admission Invalid the chain contract promises."""
+    def validate(job: dict) -> None:
+        try:
+            fn(job)
+        except ValueError as e:
+            raise Invalid(str(e)) from None
+    return validate
+
 
 def _job_validator(ctrl) -> Callable[[dict], None]:
     def validate(job: dict) -> None:

@@ -361,3 +361,49 @@ def test_kinds_coexist(api, op):
     assert len(api.list("Pod")) == 2
     assert op.engines["TFJob"].metrics.created.value(kind="TFJob") == 1
     assert op.engines["TFJob"].metrics.created.value(kind="PyTorchJob") == 1
+
+def test_mpi_distribution_dialects(api, op):
+    """Intel MPI / MPICH hostfile + env dialects (reference
+    mpi_config.go:88-98, mpijob_controller.go:392-404); mainContainer
+    targets kubexec at a specific worker container."""
+    api.create(mk_job("MPIJob", "mpiReplicaSpecs", {
+        "Launcher": (1, "mpi", ("mpijob-port", 9999)),
+        "Worker": (2, "mpi", ("mpijob-port", 9999)),
+    }, spec_extra={"slotsPerWorker": 4, "mpiDistribution": "IntelMPI",
+                   "mainContainer": "mpi"}))
+    op.run_until_idle()
+    cm = api.get("ConfigMap", "default", "j1-config")
+    # Intel dialect: host:slots, no "slots=" syntax
+    assert cm["data"]["hostfile"] == "j1-worker-0:4\nj1-worker-1:4"
+    assert "--container mpi" in cm["data"]["kubexec.sh"]
+    env_l = env_of(api, "j1-launcher-0")
+    assert env_l["I_MPI_HYDRA_HOST_FILE"] == "/etc/mpi/hostfile"
+    assert env_l["I_MPI_HYDRA_BOOTSTRAP_EXEC"] == "/etc/mpi/kubexec.sh"
+    assert "OMPI_MCA_plm_rsh_agent" not in env_l
+    assert "OMPI_MCA_orte_keep_fqdn_hostnames" not in env_l
+
+
+def test_mpi_legacy_distribution_path(api, op):
+    """The reference's legacy v1alpha2 spelling still selects the
+    dialect."""
+    api.create(mk_job("MPIJob", "mpiReplicaSpecs", {
+        "Launcher": (1, "mpi", ("mpijob-port", 9999)),
+        "Worker": (1, "mpi", ("mpijob-port", 9999)),
+    }, spec_extra={"legacySpec": {"legacyV1Alpha2": {
+        "mpiDistribution": "MPICH"}}}))
+    op.run_until_idle()
+    env_l = env_of(api, "j1-launcher-0")
+    assert env_l["HYDRA_HOST_FILE"] == "/etc/mpi/hostfile"
+    assert env_l["HYDRA_LAUNCHER_EXEC"] == "/etc/mpi/kubexec.sh"
+    cm = api.get("ConfigMap", "default", "j1-config")
+    assert cm["data"]["hostfile"].endswith(":1")
+
+
+def test_mpi_bad_distribution_rejected_at_admission(api, op):
+    from kubedl_tpu.core.apiserver import Invalid
+    job = mk_job("MPIJob", "mpiReplicaSpecs", {
+        "Launcher": (1, "mpi", ("mpijob-port", 9999)),
+        "Worker": (1, "mpi", ("mpijob-port", 9999)),
+    }, spec_extra={"mpiDistribution": "intelMPI"})  # case typo
+    with pytest.raises(Invalid, match="mpiDistribution"):
+        api.create(job)
