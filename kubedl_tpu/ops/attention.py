@@ -2,11 +2,13 @@
 
 Three implementations behind one entry point, selected by hardware/shape:
 
-* ``pallas`` — FlashAttention-2-style online-softmax kernel: grid over
+* ``pallas`` — FlashAttention-2-style online-softmax kernels: grid over
   (batch*heads, q blocks), K/V streamed through VMEM in 128-wide blocks,
-  scores accumulated in float32 on the MXU. Forward-only kernel wrapped in
-  ``jax.custom_vjp``; the backward recomputes through the chunked path
-  (same recompute strategy as flash backward, one extra forward).
+  scores accumulated in float32 on the MXU. The forward also emits the
+  per-row logsumexp; the backward is two pallas kernels (dQ over q-blocks,
+  dK/dV over k-blocks) that recompute p = exp(s - lse) flash-2 style —
+  O(seq·block) memory end to end. ``KUBEDL_FLASH_BWD=chunked`` falls back
+  to differentiating the chunked path (safety valve).
 * ``chunked`` — the same online-softmax algorithm as a ``lax.scan`` over
   K/V blocks in plain JAX: differentiable, O(seq * block) memory, runs
   anywhere (this is what the virtual CPU mesh tests exercise).
@@ -20,6 +22,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 from typing import Optional
 
 import jax
@@ -138,10 +141,29 @@ def chunked_attention(q, k, v, causal=True, segment_ids=None,
 # pallas flash kernel (forward)
 # ---------------------------------------------------------------------------
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q, block_k, sk, causal):
+def _causal_keep(block_q: int, block_k: int, q_off, k_off):
+    """[block_q, block_k] keep-mask for absolute row offset ``q_off`` and
+    column offset ``k_off`` — the ONE causal boundary definition shared by
+    the forward and both backward kernels (they must never disagree)."""
+    rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + q_off
+    cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + k_off
+    return cols <= rows
+
+
+def _kv_upper(q_block_idx, block_q: int, block_k: int, num_kb: int,
+              causal: bool) -> int:
+    """Exclusive upper bound on k-block index a given q block attends to."""
+    if not causal:
+        return num_kb
+    return ((q_block_idx + 1) * block_q + block_k - 1) // block_k
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q, block_k,
+                  sk, causal):
     """One (batch*head, q-block) program; K/V blocks streamed via fori_loop.
     Block shapes carry a leading singleton (batch*head) dim: q [1, block_q,
-    hd], k/v [1, sk, hd], o [1, block_q, hd]."""
+    hd], k/v [1, sk, hd], o [1, block_q, hd]. Also writes the per-row
+    logsumexp (scaled-score space) consumed by the backward kernels."""
     import jax.experimental.pallas as pl  # local to keep CPU import cheap
 
     q_block_idx = pl.program_id(1)
@@ -150,9 +172,6 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q, block_k, sk, causal):
     q = q_ref[0].astype(jnp.float32) * scale
 
     num_kb = sk // block_k
-    rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) \
-        + q_block_idx * block_q
-    cols0 = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
 
     def body(j, carry):
         acc, row_max, row_sum = carry
@@ -162,7 +181,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q, block_k, sk, causal):
             q, kj, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)              # [bq, bk]
         if causal:
-            keep = cols0 + j * block_k <= rows
+            keep = _causal_keep(block_q, block_k,
+                                q_block_idx * block_q, j * block_k)
             scores = jnp.where(keep, scores, _NEG_INF)
         new_max = jnp.maximum(row_max, scores.max(axis=-1, keepdims=True))
         alpha = jnp.exp(row_max - new_max)
@@ -173,21 +193,22 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q, block_k, sk, causal):
         row_sum = row_sum * alpha + p.sum(axis=-1, keepdims=True)
         return acc, new_max, row_sum
 
-    # causal: block j only contributes while j*block_k <= q_block end
-    upper = num_kb if not causal else \
-        ((q_block_idx + 1) * block_q + block_k - 1) // block_k
+    upper = _kv_upper(q_block_idx, block_q, block_k, num_kb, causal)
     acc0 = jnp.zeros((block_q, hd), jnp.float32)
     max0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
     sum0 = jnp.zeros((block_q, 1), jnp.float32)
-    acc, _, row_sum = jax.lax.fori_loop(0, upper, body, (acc0, max0, sum0))
-    o_ref[0] = (acc / jnp.maximum(row_sum, 1e-37)).astype(o_ref.dtype)
+    acc, row_max, row_sum = jax.lax.fori_loop(
+        0, upper, body, (acc0, max0, sum0))
+    safe_sum = jnp.maximum(row_sum, 1e-37)
+    o_ref[0] = (acc / safe_sum).astype(o_ref.dtype)
+    lse_ref[0] = (row_max + jnp.log(safe_sum))[:, 0]
 
 
 def _flash_forward(q, k, v, causal, block_q=128, block_k=128,
                    interpret=False):
-    """q [b, sq, nh, hd]; k/v repeated to nh already. Returns [b, sq, nh, hd]."""
+    """q [b, sq, nh, hd]; k/v repeated to nh already.
+    Returns (out [b, sq, nh, hd], lse [b*nh, sq] float32)."""
     import jax.experimental.pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
 
     b, sq, nh, hd = q.shape
     sk = k.shape[1]
@@ -197,7 +218,7 @@ def _flash_forward(q, k, v, causal, block_q=128, block_k=128,
 
     kernel = functools.partial(_flash_kernel, block_q=block_q,
                                block_k=block_k, sk=sk, causal=causal)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(b * nh, sq // block_q),
         in_specs=[
@@ -205,30 +226,215 @@ def _flash_forward(q, k, v, causal, block_q=128, block_k=128,
             pl.BlockSpec((1, sk, hd), lambda i, j: (i, 0, 0)),
             pl.BlockSpec((1, sk, hd), lambda i, j: (i, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, hd), lambda i, j: (i, j, 0)),
-        out_shape=jax.ShapeDtypeStruct((b * nh, sq, hd), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, hd), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * nh, sq, hd), q.dtype),
+            jax.ShapeDtypeStruct((b * nh, sq), jnp.float32),
+        ],
         interpret=interpret,
     )(qh, kh, vh)
-    return jnp.swapaxes(out.reshape(b, nh, sq, hd), 1, 2)
+    return jnp.swapaxes(out.reshape(b, nh, sq, hd), 1, 2), lse
+
+
+# ---------------------------------------------------------------------------
+# pallas flash kernel (backward)
+# ---------------------------------------------------------------------------
+
+def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                     *, block_q, block_k, sk, causal):
+    """dQ for one (batch*head, q-block): stream K/V blocks, recompute
+    p = exp(s - lse), then ds = p * (dO·Vᵀ - Δ) and dq += ds · K.
+    Δ = rowsum(dO ∘ O) is precomputed outside (flash-2 backward)."""
+    import jax.experimental.pallas as pl
+
+    q_block_idx = pl.program_id(1)
+    hd = q_ref.shape[-1]
+    scale = 1.0 / math.sqrt(hd)
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0][:, None]                                # [bq, 1]
+    delta = delta_ref[0][:, None]                            # [bq, 1]
+
+    num_kb = sk // block_k
+
+    def body(j, dq_acc):
+        kj = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        vj = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        scores = jax.lax.dot_general(
+            q, kj, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale      # [bq, bk]
+        if causal:
+            keep = _causal_keep(block_q, block_k,
+                                q_block_idx * block_q, j * block_k)
+            scores = jnp.where(keep, scores, _NEG_INF)
+        p = jnp.exp(scores - lse)                            # masked -> 0
+        dp = jax.lax.dot_general(
+            do, vj, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)              # [bq, bk]
+        ds = p * (dp - delta)
+        return dq_acc + jax.lax.dot_general(
+            ds, kj, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    upper = _kv_upper(q_block_idx, block_q, block_k, num_kb, causal)
+    dq = jax.lax.fori_loop(
+        0, upper, body, jnp.zeros((block_q, hd), jnp.float32))
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dk_ref, dv_ref, *, block_q, block_k, sq, causal):
+    """dK/dV for one (batch*head, k-block): stream q blocks that can see
+    this k block, accumulate dv += pᵀ·dO and dk += dsᵀ·q."""
+    import jax.experimental.pallas as pl
+
+    k_block_idx = pl.program_id(1)
+    hd = k_ref.shape[-1]
+    scale = 1.0 / math.sqrt(hd)
+    kb = k_ref[0].astype(jnp.float32)                        # [bk, hd]
+    vb = v_ref[0].astype(jnp.float32)
+
+    num_qb = sq // block_q
+
+    def body(i, carry):
+        dk_acc, dv_acc = carry
+        qi = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        doi = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lsei = lse_ref[0, pl.ds(i * block_q, block_q)][:, None]
+        deltai = delta_ref[0, pl.ds(i * block_q, block_q)][:, None]
+        scores = jax.lax.dot_general(
+            qi, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale      # [bq, bk]
+        if causal:
+            keep = _causal_keep(block_q, block_k,
+                                i * block_q, k_block_idx * block_k)
+            scores = jnp.where(keep, scores, _NEG_INF)
+        p = jnp.exp(scores - lsei)
+        dv_acc = dv_acc + jax.lax.dot_general(
+            p, doi, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)              # [bk, hd]
+        dp = jax.lax.dot_general(
+            doi, vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)              # [bq, bk]
+        ds = p * (dp - deltai)
+        dk_acc = dk_acc + jax.lax.dot_general(
+            ds, qi, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)              # [bk, hd]
+        return dk_acc, dv_acc
+
+    # causal: q block i sees k block only when i*block_q + block_q - 1 >=
+    # k_block_idx*block_k, i.e. from the block containing the diagonal on
+    lower = 0 if not causal else (k_block_idx * block_k) // block_q
+    zeros = jnp.zeros((block_k, hd), jnp.float32)
+    dk, dv = jax.lax.fori_loop(lower, num_qb, body, (zeros, zeros))
+    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, o, lse, g, causal, block_q=128, block_k=128,
+                    interpret=False):
+    """Flash-2 backward. All of q/k/v/o/g are [b, s, nh, hd] (K/V already
+    GQA-repeated); lse is [b*nh, sq] from the forward. Returns (dq, dk, dv)
+    in repeated-head space."""
+    import jax.experimental.pallas as pl
+
+    b, sq, nh, hd = q.shape
+    sk = k.shape[1]
+    bh = b * nh
+    qh = jnp.swapaxes(q, 1, 2).reshape(bh, sq, hd)
+    kh = jnp.swapaxes(k, 1, 2).reshape(bh, sk, hd)
+    vh = jnp.swapaxes(v, 1, 2).reshape(bh, sk, hd)
+    oh = jnp.swapaxes(o, 1, 2).reshape(bh, sq, hd)
+    gh = jnp.swapaxes(g, 1, 2).reshape(bh, sq, hd)
+    # Δ rows: rowsum(dO ∘ O) — a cheap elementwise+reduce, fused by XLA
+    delta = (gh.astype(jnp.float32) * oh.astype(jnp.float32)).sum(-1)
+
+    dq_kernel = functools.partial(_flash_dq_kernel, block_q=block_q,
+                                  block_k=block_k, sk=sk, causal=causal)
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(bh, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, hd), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, sk, hd), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, sk, hd), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, block_q, hd), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, hd), lambda i, j: (i, j, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, hd), q.dtype),
+        interpret=interpret,
+    )(qh, kh, vh, gh, lse, delta)
+
+    dkv_kernel = functools.partial(_flash_dkv_kernel, block_q=block_q,
+                                   block_k=block_k, sq=sq, causal=causal)
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(bh, sk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, sq, hd), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, block_k, hd), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_k, hd), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, sq, hd), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, sq), lambda i, j: (i, 0)),
+            pl.BlockSpec((1, sq), lambda i, j: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, hd), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_k, hd), lambda i, j: (i, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk, hd), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk, hd), v.dtype),
+        ],
+        interpret=interpret,
+    )(qh, kh, vh, gh, lse, delta)
+
+    unflat = lambda x, s: jnp.swapaxes(x.reshape(b, nh, s, hd), 1, 2)  # noqa: E731
+    return unflat(dq, sq), unflat(dk, sk), unflat(dv, sk)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def _flash_attention(q, k, v, causal, interpret):
     nh = q.shape[2]
-    return _flash_forward(q, repeat_kv(k, nh), repeat_kv(v, nh), causal,
-                          interpret=interpret)
+    out, _ = _flash_forward(q, repeat_kv(k, nh), repeat_kv(v, nh), causal,
+                            interpret=interpret)
+    return out
 
 
 def _flash_fwd(q, k, v, causal, interpret):
-    return _flash_attention(q, k, v, causal, interpret), (q, k, v)
+    nh = q.shape[2]
+    out, lse = _flash_forward(q, repeat_kv(k, nh), repeat_kv(v, nh), causal,
+                              interpret=interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, interpret, residuals, g):
-    q, k, v = residuals
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: chunked_attention(q_, k_, v_, causal=causal),
-        q, k, v)
-    return vjp(g)
+    q, k, v, o, lse = residuals
+    nh = q.shape[2]
+    if os.environ.get("KUBEDL_FLASH_BWD", "pallas") == "chunked":
+        # safety valve: recompute through the differentiable chunked path.
+        # NOTE: read at TRACE time — set it before the first jit compile of
+        # the train step; already-compiled executables keep their backward.
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: chunked_attention(q_, k_, v_, causal=causal),
+            q, k, v)
+        return vjp(g)
+    dq, dk, dv = _flash_backward(q, repeat_kv(k, nh), repeat_kv(v, nh),
+                                 o, lse, g, causal, interpret=interpret)
+    nkv = k.shape[2]
+    if nkv != nh:
+        # GQA: fold the repeated-head grads back onto the shared kv heads
+        # (repeat_kv repeats each kv head `reps` times consecutively)
+        b, sk, _, hd = k.shape
+        reps = nh // nkv
+        dk = dk.reshape(b, sk, nkv, reps, hd).sum(3)
+        dv = dv.reshape(b, sk, nkv, reps, hd).sum(3)
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
 
 
 _flash_attention.defvjp(_flash_fwd, _flash_bwd)
