@@ -112,6 +112,7 @@ def init_backend():
     else:
         info = probe_backend()
     if info is None:
+        _no_cpu_fallback_check("tpu backend unreachable")
         note = "tpu_backend_unreachable; cpu fallback"
         _pin(jax, "cpu")
         return "cpu", jax.devices()[0], note
@@ -123,11 +124,21 @@ def init_backend():
     plat = dev.platform.lower()
     # the axon relay platform proxies a real TPU chip
     if plat not in ("tpu", "axon") and "tpu" not in kind:
+        # jax itself can fall back to a CpuDevice silently
+        _no_cpu_fallback_check(f"default device is {plat}, not a TPU")
         return "cpu", dev, note
     for gen in ("v6e", "v5p", "v5e", "v4"):
         if gen in kind or gen in str(dev).lower():
             return gen, dev, note
     return os.environ.get("PALLAS_AXON_TPU_GEN", "v5e"), dev, note
+
+
+def _no_cpu_fallback_check(why: str) -> None:
+    """Watcher mode (BENCH_NO_CPU_FALLBACK=1): a cpu number would be
+    discarded anyway — fail fast so the loop can go quiet instead of
+    burning 20+ min on a fallback bench."""
+    if os.environ.get("BENCH_NO_CPU_FALLBACK", "") == "1":
+        raise RuntimeError(f"{why} (BENCH_NO_CPU_FALLBACK)")
 
 
 def _pin(jax, platforms: str) -> None:

@@ -13,8 +13,8 @@ LOG="${TPU_LOOP_LOG:-/tmp/tpu_bench_loop.log}"
 
 while true; do
   echo "$(date -Is) attempting bench (single connection)" >>"$LOG"
-  if BENCH_SKIP_PROBE=1 BENCH_HARD_DEADLINE_S=2100 timeout 2200 \
-      python bench.py >/tmp/bench_tpu_out.json 2>>"$LOG"; then
+  if BENCH_SKIP_PROBE=1 BENCH_NO_CPU_FALLBACK=1 BENCH_HARD_DEADLINE_S=2100 \
+      timeout 2200 python bench.py >/tmp/bench_tpu_out.json 2>>"$LOG"; then
     line=$(tail -1 /tmp/bench_tpu_out.json)
     # only cache a real TPU result (not a cpu fallback / failure line)
     if python - "$line" <<'EOF'

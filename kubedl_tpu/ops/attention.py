@@ -151,11 +151,13 @@ def _causal_keep(block_q: int, block_k: int, q_off, k_off):
 
 
 def _kv_upper(q_block_idx, block_q: int, block_k: int, num_kb: int,
-              causal: bool) -> int:
-    """Exclusive upper bound on k-block index a given q block attends to."""
+              causal: bool):
+    """Exclusive upper bound on k-block index a given q block attends to
+    (clamped: with sq > sk the diagonal runs past the last k block)."""
     if not causal:
         return num_kb
-    return ((q_block_idx + 1) * block_q + block_k - 1) // block_k
+    return jnp.minimum(
+        num_kb, ((q_block_idx + 1) * block_q + block_k - 1) // block_k)
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q, block_k,
@@ -204,17 +206,26 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q, block_k,
     lse_ref[0] = (row_max + jnp.log(safe_sum))[:, 0]
 
 
+def _kv_index(i, nh: int, nkv: int):
+    """Flat (batch*q-head) program index -> flat (batch*kv-head) index:
+    GQA-native kernels read K/V straight from kv-head space via this
+    BlockSpec index map instead of materializing repeated K/V in HBM."""
+    reps = nh // nkv
+    return (i // nh) * nkv + (i % nh) // reps
+
+
 def _flash_forward(q, k, v, causal, block_q=128, block_k=128,
                    interpret=False):
-    """q [b, sq, nh, hd]; k/v repeated to nh already.
+    """q [b, sq, nh, hd]; k/v [b, sk, nkv, hd] (kv-head space, GQA-native).
     Returns (out [b, sq, nh, hd], lse [b*nh, sq] float32)."""
     import jax.experimental.pallas as pl
 
     b, sq, nh, hd = q.shape
-    sk = k.shape[1]
+    sk, nkv = k.shape[1], k.shape[2]
     qh = jnp.swapaxes(q, 1, 2).reshape(b * nh, sq, hd)
-    kh = jnp.swapaxes(k, 1, 2).reshape(b * nh, sk, hd)
-    vh = jnp.swapaxes(v, 1, 2).reshape(b * nh, sk, hd)
+    kh = jnp.swapaxes(k, 1, 2).reshape(b * nkv, sk, hd)
+    vh = jnp.swapaxes(v, 1, 2).reshape(b * nkv, sk, hd)
+    kv_of = functools.partial(_kv_index, nh=nh, nkv=nkv)
 
     kernel = functools.partial(_flash_kernel, block_q=block_q,
                                block_k=block_k, sk=sk, causal=causal)
@@ -223,8 +234,8 @@ def _flash_forward(q, k, v, causal, block_q=128, block_k=128,
         grid=(b * nh, sq // block_q),
         in_specs=[
             pl.BlockSpec((1, block_q, hd), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, sk, hd), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, sk, hd), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, sk, hd), lambda i, j: (kv_of(i), 0, 0)),
+            pl.BlockSpec((1, sk, hd), lambda i, j: (kv_of(i), 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, hd), lambda i, j: (i, j, 0)),
@@ -286,18 +297,30 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 
 def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                      dk_ref, dv_ref, *, block_q, block_k, sq, causal):
-    """dK/dV for one (batch*head, k-block): stream q blocks that can see
-    this k block, accumulate dv += pᵀ·dO and dk += dsᵀ·q."""
+                      dk_ref, dv_ref, dk_acc_ref, dv_acc_ref, *,
+                      block_q, block_k, sq, causal, reps):
+    """dK/dV for one (batch*kv-head, k-block, rep) program: stream the q
+    blocks that can see this k block, accumulate dv += pᵀ·dO and
+    dk += dsᵀ·q. GQA-native: the rep axis is the FASTEST grid dim, each
+    step loads only ONE of the group's query heads (VMEM stays
+    O(sq·hd), not O(reps·sq·hd)); float32 VMEM scratch carries the
+    cross-rep accumulation (scratch persists across grid steps on TPU),
+    and the kv-head-space output is written on the group's last rep."""
     import jax.experimental.pallas as pl
 
     k_block_idx = pl.program_id(1)
+    rep = pl.program_id(2)
     hd = k_ref.shape[-1]
     scale = 1.0 / math.sqrt(hd)
     kb = k_ref[0].astype(jnp.float32)                        # [bk, hd]
     vb = v_ref[0].astype(jnp.float32)
 
     num_qb = sq // block_q
+
+    @pl.when(rep == 0)
+    def _init():
+        dk_acc_ref[...] = jnp.zeros_like(dk_acc_ref)
+        dv_acc_ref[...] = jnp.zeros_like(dv_acc_ref)
 
     def body(i, carry):
         dk_acc, dv_acc = carry
@@ -330,27 +353,35 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     lower = 0 if not causal else (k_block_idx * block_k) // block_q
     zeros = jnp.zeros((block_k, hd), jnp.float32)
     dk, dv = jax.lax.fori_loop(lower, num_qb, body, (zeros, zeros))
-    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    dk_acc_ref[...] += dk
+    dv_acc_ref[...] += dv
+
+    @pl.when(rep == reps - 1)
+    def _flush():
+        dk_ref[0] = (dk_acc_ref[...] * scale).astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc_ref[...].astype(dv_ref.dtype)
 
 
 def _flash_backward(q, k, v, o, lse, g, causal, block_q=128, block_k=128,
                     interpret=False):
-    """Flash-2 backward. All of q/k/v/o/g are [b, s, nh, hd] (K/V already
-    GQA-repeated); lse is [b*nh, sq] from the forward. Returns (dq, dk, dv)
-    in repeated-head space."""
+    """Flash-2 backward, GQA-native. q/o/g are [b, sq, nh, hd]; k/v are
+    [b, sk, nkv, hd] (kv-head space, never repeated in HBM); lse is
+    [b*nh, sq] from the forward. Returns dq in q-head space and dk/dv
+    directly in kv-head space."""
     import jax.experimental.pallas as pl
 
     b, sq, nh, hd = q.shape
-    sk = k.shape[1]
-    bh = b * nh
+    sk, nkv = k.shape[1], k.shape[2]
+    reps = nh // nkv
+    bh, bkv = b * nh, b * nkv
     qh = jnp.swapaxes(q, 1, 2).reshape(bh, sq, hd)
-    kh = jnp.swapaxes(k, 1, 2).reshape(bh, sk, hd)
-    vh = jnp.swapaxes(v, 1, 2).reshape(bh, sk, hd)
+    kh = jnp.swapaxes(k, 1, 2).reshape(bkv, sk, hd)
+    vh = jnp.swapaxes(v, 1, 2).reshape(bkv, sk, hd)
     oh = jnp.swapaxes(o, 1, 2).reshape(bh, sq, hd)
     gh = jnp.swapaxes(g, 1, 2).reshape(bh, sq, hd)
     # Δ rows: rowsum(dO ∘ O) — a cheap elementwise+reduce, fused by XLA
     delta = (gh.astype(jnp.float32) * oh.astype(jnp.float32)).sum(-1)
+    kv_of = functools.partial(_kv_index, nh=nh, nkv=nkv)
 
     dq_kernel = functools.partial(_flash_dq_kernel, block_q=block_q,
                                   block_k=block_k, sk=sk, causal=causal)
@@ -359,8 +390,8 @@ def _flash_backward(q, k, v, o, lse, g, causal, block_q=128, block_k=128,
         grid=(bh, sq // block_q),
         in_specs=[
             pl.BlockSpec((1, block_q, hd), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, sk, hd), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, sk, hd), lambda i, j: (i, 0, 0)),
+            pl.BlockSpec((1, sk, hd), lambda i, j: (kv_of(i), 0, 0)),
+            pl.BlockSpec((1, sk, hd), lambda i, j: (kv_of(i), 0, 0)),
             pl.BlockSpec((1, block_q, hd), lambda i, j: (i, j, 0)),
             pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
             pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
@@ -370,52 +401,57 @@ def _flash_backward(q, k, v, o, lse, g, causal, block_q=128, block_k=128,
         interpret=interpret,
     )(qh, kh, vh, gh, lse, delta)
 
+    # dK/dV: one program per (batch*kv-head, k-block, rep). The rep axis is
+    # the fastest grid dim: each step streams ONE query head of the group
+    # (flat q-head index = reps*i + r), float32 scratch accumulates across
+    # the group, and the kv-head-space block is flushed on the last rep.
     dkv_kernel = functools.partial(_flash_dkv_kernel, block_q=block_q,
-                                   block_k=block_k, sq=sq, causal=causal)
+                                   block_k=block_k, sq=sq, causal=causal,
+                                   reps=reps)
+    from jax.experimental.pallas import tpu as pltpu
     dk, dv = pl.pallas_call(
         dkv_kernel,
-        grid=(bh, sk // block_k),
+        grid=(bkv, sk // block_k, reps),
         in_specs=[
-            pl.BlockSpec((1, sq, hd), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, block_k, hd), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block_k, hd), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, sq, hd), lambda i, j: (i, 0, 0)),
-            pl.BlockSpec((1, sq), lambda i, j: (i, 0)),
-            pl.BlockSpec((1, sq), lambda i, j: (i, 0)),
+            pl.BlockSpec((1, sq, hd), lambda i, j, r: (reps * i + r, 0, 0)),
+            pl.BlockSpec((1, block_k, hd), lambda i, j, r: (i, j, 0)),
+            pl.BlockSpec((1, block_k, hd), lambda i, j, r: (i, j, 0)),
+            pl.BlockSpec((1, sq, hd), lambda i, j, r: (reps * i + r, 0, 0)),
+            pl.BlockSpec((1, sq), lambda i, j, r: (reps * i + r, 0)),
+            pl.BlockSpec((1, sq), lambda i, j, r: (reps * i + r, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_k, hd), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block_k, hd), lambda i, j: (i, j, 0)),
+            pl.BlockSpec((1, block_k, hd), lambda i, j, r: (i, j, 0)),
+            pl.BlockSpec((1, block_k, hd), lambda i, j, r: (i, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, sk, hd), k.dtype),
-            jax.ShapeDtypeStruct((bh, sk, hd), v.dtype),
+            jax.ShapeDtypeStruct((bkv, sk, hd), k.dtype),
+            jax.ShapeDtypeStruct((bkv, sk, hd), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, hd), jnp.float32),
+            pltpu.VMEM((block_k, hd), jnp.float32),
         ],
         interpret=interpret,
     )(qh, kh, vh, gh, lse, delta)
 
-    unflat = lambda x, s: jnp.swapaxes(x.reshape(b, nh, s, hd), 1, 2)  # noqa: E731
-    return unflat(dq, sq), unflat(dk, sk), unflat(dv, sk)
+    unflat = lambda x, n, s: jnp.swapaxes(x.reshape(b, n, s, hd), 1, 2)  # noqa: E731
+    return unflat(dq, nh, sq), unflat(dk, nkv, sk), unflat(dv, nkv, sk)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def _flash_attention(q, k, v, causal, interpret):
-    nh = q.shape[2]
-    out, _ = _flash_forward(q, repeat_kv(k, nh), repeat_kv(v, nh), causal,
-                            interpret=interpret)
+    out, _ = _flash_forward(q, k, v, causal, interpret=interpret)
     return out
 
 
 def _flash_fwd(q, k, v, causal, interpret):
-    nh = q.shape[2]
-    out, lse = _flash_forward(q, repeat_kv(k, nh), repeat_kv(v, nh), causal,
-                              interpret=interpret)
+    out, lse = _flash_forward(q, k, v, causal, interpret=interpret)
     return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, interpret, residuals, g):
     q, k, v, o, lse = residuals
-    nh = q.shape[2]
     if os.environ.get("KUBEDL_FLASH_BWD", "pallas") == "chunked":
         # safety valve: recompute through the differentiable chunked path.
         # NOTE: read at TRACE time — set it before the first jit compile of
@@ -424,16 +460,8 @@ def _flash_bwd(causal, interpret, residuals, g):
             lambda q_, k_, v_: chunked_attention(q_, k_, v_, causal=causal),
             q, k, v)
         return vjp(g)
-    dq, dk, dv = _flash_backward(q, repeat_kv(k, nh), repeat_kv(v, nh),
-                                 o, lse, g, causal, interpret=interpret)
-    nkv = k.shape[2]
-    if nkv != nh:
-        # GQA: fold the repeated-head grads back onto the shared kv heads
-        # (repeat_kv repeats each kv head `reps` times consecutively)
-        b, sk, _, hd = k.shape
-        reps = nh // nkv
-        dk = dk.reshape(b, sk, nkv, reps, hd).sum(3)
-        dv = dv.reshape(b, sk, nkv, reps, hd).sum(3)
+    dq, dk, dv = _flash_backward(q, k, v, o, lse, g, causal,
+                                 interpret=interpret)
     return dq, dk.astype(k.dtype), dv.astype(v.dtype)
 
 

@@ -176,6 +176,23 @@ def test_pallas_interpret_longer_seq_and_bf16():
                            - pal.astype(jnp.float32))) < 3e-2
 
 
+def test_pallas_interpret_causal_sq_gt_sk():
+    """Causal cross-length attention (sq > sk): _kv_upper must clamp to the
+    actual number of K blocks or the kernel reads past the K/V refs."""
+    key = jax.random.PRNGKey(9)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (1, 256, 2, 128), jnp.float32)
+    k = jax.random.normal(kk, (1, 128, 2, 128), jnp.float32)
+    v = jax.random.normal(kv, (1, 128, 2, 128), jnp.float32)
+    ref = reference_attention(q, k, v, causal=True)
+    pal = multi_head_attention(q, k, v, causal=True, impl="pallas_interpret")
+    assert jnp.max(jnp.abs(ref - pal)) < 1e-5
+    gr = jax.grad(lambda k_: reference_attention(q, k_, v, True).sum())(k)
+    gp = jax.grad(lambda k_: multi_head_attention(
+        q, k_, v, True, impl="pallas_interpret").sum())(k)
+    assert jnp.max(jnp.abs(gr - gp)) < 5e-4
+
+
 def test_pallas_interpret_non_causal():
     key = jax.random.PRNGKey(4)
     kq, kk, kv = jax.random.split(key, 3)
