@@ -3,5 +3,7 @@
 ``llama`` is the flagship (BASELINE configs 3-4: Llama-3-8B SPMD fine-tune);
 ``resnet`` covers the vision config (BASELINE config 2); ``mlp`` is the
 CPU smoke-test model (BASELINE config 1); Gemma serving (config 5) reuses
-the llama transformer core with the family knobs in ``gemma``.
+the llama transformer core with the family knobs in ``gemma``; ``moe`` is
+the sparse Mixtral-style family on the same core, with experts sharded
+over the mesh's ``ep`` axis.
 """

@@ -225,13 +225,15 @@ def apply_rope(x, cos, sin):
     return out.astype(x.dtype)
 
 
-def _layer_forward(config: LlamaConfig, x, lp, cos, sin, segment_ids,
-                   mesh=None):
+def attention_block(config: LlamaConfig, x, lp, cos, sin, segment_ids,
+                    mesh=None):
+    """Pre-norm attention sublayer with residual: the shared transformer
+    attention used by the Llama/Gemma dense stack and the MoE stack
+    (``kubedl_tpu.models.moe``)."""
     c = config
     b, s, d = x.shape
     nh, nkv, hd = c.n_heads, c.n_kv_heads, c.hd
 
-    # -- attention block
     h = rms_norm(x, lp["attn_norm"], c.rms_eps, c.norm_weight_offset)
     q = (h @ lp["wq"]).reshape(b, s, nh, hd)
     k = (h @ lp["wk"]).reshape(b, s, nkv, hd)
@@ -245,7 +247,13 @@ def _layer_forward(config: LlamaConfig, x, lp, cos, sin, segment_ids,
     else:
         attn = multi_head_attention(q, k, v, causal=True,
                                     segment_ids=segment_ids)
-    x = x + (attn.reshape(b, s, nh * hd) @ lp["wo"])
+    return x + (attn.reshape(b, s, nh * hd) @ lp["wo"])
+
+
+def _layer_forward(config: LlamaConfig, x, lp, cos, sin, segment_ids,
+                   mesh=None):
+    c = config
+    x = attention_block(c, x, lp, cos, sin, segment_ids, mesh)
 
     # -- gated MLP (SwiGLU for Llama, GeGLU for Gemma)
     h = rms_norm(x, lp["mlp_norm"], c.rms_eps, c.norm_weight_offset)

@@ -1,0 +1,296 @@
+"""Mixture-of-Experts decoder with expert parallelism, TPU-first.
+
+Mixtral-style sparse MoE on the shared transformer core
+(``kubedl_tpu.models.llama``): every layer keeps the dense attention
+sublayer and replaces the gated MLP with a top-k router over ``n_experts``
+expert MLPs. The design follows the GShard/Switch einsum-dispatch recipe,
+which is the idiomatic GSPMD mapping on TPU:
+
+* expert weights are stacked ``[E, d, f]`` and sharded on the mesh's
+  ``ep`` axis (``parallel.sharding`` rule ``experts -> ep``);
+* tokens are dispatched with a capacity-bounded one-hot tensor and two
+  einsums — under jit, resharding from token-sharded ``[b, s, d]`` to
+  expert-sharded ``[E, ...]`` makes XLA insert the all-to-alls over
+  ``ep`` (ICI), exactly the manual A2A a CUDA MoE would hand-write;
+* the router runs in float32 (softmax + top-k are precision-sensitive),
+  experts run in bf16 with f32 MXU accumulation like the dense stack;
+* a Switch-style load-balancing auxiliary loss keeps experts utilized —
+  ``loss_fn`` returns ``ce + aux_weight * aux``.
+
+Capability parity note: the reference operator (mental2008/kubedl) ships no
+models — its training CRDs run user containers
+(``pkg/job_controller/api/v1/types.go:78-115`` defines the job shell).
+This module is a TPU-native payload for those jobs, extending the model
+zoo beyond the reference's capability surface.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.sharding import spec
+from . import llama
+from .llama import LlamaConfig, rms_norm
+
+
+@dataclass(frozen=True)
+class MoEConfig(LlamaConfig):
+    n_experts: int = 8
+    top_k: int = 2
+    #: expert slot budget = ceil(capacity_factor * tokens * top_k / E)
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 0.01
+
+    @property
+    def num_params(self) -> int:
+        d, hd = self.d_model, self.hd
+        attn = (d * hd * (self.n_heads + 2 * self.n_kv_heads)
+                + self.n_heads * hd * d)
+        moe = 3 * self.n_experts * d * self.d_ff + d * self.n_experts
+        per_layer = attn + moe + 2 * d
+        head = (1 if self.tie_embeddings else 2) * self.vocab_size * d
+        return self.n_layers * per_layer + head + d
+
+    @property
+    def active_params(self) -> int:
+        """Params touched per token (top-k of E experts) — the number that
+        sets per-token FLOPs for MFU accounting."""
+        d = self.d_model
+        dense = LlamaConfig.num_params.fget(self)  # type: ignore[attr-defined]
+        dense_mlp = self.n_layers * 3 * d * self.d_ff
+        return (dense - dense_mlp
+                + self.n_layers * (3 * self.top_k * d * self.d_ff
+                                   + d * self.n_experts))
+
+
+def mixtral_8x7b() -> MoEConfig:
+    return MoEConfig(vocab_size=32000, d_model=4096, n_layers=32,
+                     n_heads=32, n_kv_heads=8, d_ff=14336,
+                     rope_theta=1e6, n_experts=8, top_k=2)
+
+
+def tiny(vocab: int = 512, seq: int = 256) -> MoEConfig:
+    """CI/virtual-mesh config."""
+    return MoEConfig(vocab_size=vocab, d_model=128, n_layers=2, n_heads=4,
+                     n_kv_heads=2, d_ff=256, max_seq_len=seq,
+                     rope_theta=10000.0, n_experts=4, top_k=2)
+
+
+# -- params ------------------------------------------------------------------
+
+def init_params(config: MoEConfig, key) -> dict:
+    c = config
+    d, hd, nh, nkv, E = c.d_model, c.hd, c.n_heads, c.n_kv_heads, c.n_experts
+    k_embed, k_layers, k_out = jax.random.split(key, 3)
+    norm_init = 1.0 - c.norm_weight_offset
+
+    def dense(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32)
+                * (1.0 / math.sqrt(fan_in))).astype(c.dtype)
+
+    def layer(key):
+        ks = jax.random.split(key, 8)
+        return {
+            "attn_norm": jnp.full((d,), norm_init, jnp.float32),
+            "wq": dense(ks[0], (d, nh * hd), d),
+            "wk": dense(ks[1], (d, nkv * hd), d),
+            "wv": dense(ks[2], (d, nkv * hd), d),
+            "wo": dense(ks[3], (nh * hd, d), nh * hd),
+            "mlp_norm": jnp.full((d,), norm_init, jnp.float32),
+            # router stays float32: tiny, and top-k is precision-sensitive
+            "w_router": jax.random.normal(ks[4], (d, E), jnp.float32)
+            * (1.0 / math.sqrt(d)),
+            "w_gate": dense(ks[5], (E, d, c.d_ff), d),
+            "w_up": dense(ks[6], (E, d, c.d_ff), d),
+            "w_down": dense(ks[7], (E, c.d_ff, d), c.d_ff),
+        }
+
+    layer_keys = jax.random.split(k_layers, c.n_layers)
+    layers = (jax.vmap(layer)(layer_keys) if c.scan_layers
+              else [layer(k) for k in layer_keys])
+    params = {
+        "embed": dense(k_embed, (c.vocab_size, d), d),
+        "layers": layers,
+        "final_norm": jnp.full((d,), norm_init, jnp.float32),
+    }
+    if not c.tie_embeddings:
+        params["lm_head"] = dense(k_out, (d, c.vocab_size), d)
+    return params
+
+
+def param_specs(config: MoEConfig) -> dict:
+    lead = ("layers",) if config.scan_layers else ()
+
+    def ls(*axes) -> P:
+        return spec(*lead, *axes)
+
+    layer = {
+        "attn_norm": ls("norm"),
+        "wq": ls("embed", "heads"),
+        "wk": ls("embed", "kv_heads"),
+        "wv": ls("embed", "kv_heads"),
+        "wo": ls("heads", "embed"),
+        "mlp_norm": ls("norm"),
+        "w_router": ls("embed", None),
+        "w_gate": ls("experts", "embed", "mlp"),
+        "w_up": ls("experts", "embed", "mlp"),
+        "w_down": ls("experts", "mlp", "embed"),
+    }
+    layers = layer if config.scan_layers else [layer] * config.n_layers
+    specs = {
+        "embed": spec("vocab", "embed"),
+        "layers": layers,
+        "final_norm": spec("norm"),
+    }
+    if not config.tie_embeddings:
+        specs["lm_head"] = spec("embed", "vocab")
+    return specs
+
+
+# -- routing -----------------------------------------------------------------
+
+def route(config: MoEConfig, probs, capacity: int):
+    """Top-k routing with per-expert capacity.
+
+    probs: [b, s, E] float32 router softmax. Returns (dispatch, combine,
+    aux) where dispatch/combine are [b, s, E, C]: dispatch is the 0/1
+    token→(expert, slot) assignment and combine carries the normalized
+    top-k gate for the same slots. Slots fill in choice-major order
+    (GShard: everyone's first choice outranks any second choice), tokens
+    past an expert's capacity are dropped (their residual passes through).
+    aux is the Switch load-balancing loss (E * Σ_e frac_e · prob_e)."""
+    c = config
+    b, s, E = probs.shape
+    k = c.top_k
+    gate, idx = jax.lax.top_k(probs, k)                      # [b, s, k]
+    gate = gate / jnp.maximum(gate.sum(-1, keepdims=True), 1e-9)
+    oh = jax.nn.one_hot(idx, E, dtype=jnp.float32)           # [b, s, k, E]
+
+    # position of each (token, choice) in its expert's queue, choice-major
+    ohk = jnp.swapaxes(oh, 1, 2).reshape(b, k * s, E)        # [b, k*s, E]
+    pos = jnp.cumsum(ohk, axis=1) - ohk
+    pos = jnp.swapaxes(pos.reshape(b, k, s, E), 1, 2)        # [b, s, k, E]
+    keep = (pos < capacity) * oh                             # 0/1 float
+    slot = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
+                          dtype=jnp.float32)                 # [b, s, k, E, C]
+    slot = slot * keep[..., None]
+    dispatch = slot.sum(2)                                   # [b, s, E, C]
+    combine = (gate[..., None, None] * slot).sum(2)
+
+    # Switch aux loss from the top-1 assignment
+    top1 = oh[:, :, 0, :]                                    # [b, s, E]
+    frac = top1.mean(axis=(0, 1))
+    mean_prob = probs.mean(axis=(0, 1))
+    aux = E * jnp.sum(frac * mean_prob)
+    return dispatch, combine, aux
+
+
+def _moe_block(config: MoEConfig, x, lp, mesh=None):
+    """Sparse-MLP sublayer with residual. Returns (x, aux_loss)."""
+    c = config
+    b, s, d = x.shape
+    h = rms_norm(x, lp["mlp_norm"], c.rms_eps, c.norm_weight_offset)
+
+    logits = h.astype(jnp.float32) @ lp["w_router"]          # [b, s, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    capacity = max(1, int(math.ceil(
+        c.capacity_factor * s * c.top_k / c.n_experts)))
+    dispatch, combine, aux = route(c, probs, capacity)
+
+    # dispatch: [b, s, E, C] x [b, s, d] -> [E, b, C, d]; under a sharded
+    # mesh this boundary is where GSPMD inserts the all-to-all over ep
+    xe = jnp.einsum("bsec,bsd->ebcd", dispatch.astype(c.dtype), h)
+    if mesh is not None and mesh.shape.get("ep", 1) > 1:
+        xe = jax.lax.with_sharding_constraint(
+            xe, jax.sharding.NamedSharding(
+                mesh, P("ep", ("dp", "fsdp"), None, None)))
+    gated = llama._act(c)(
+        jnp.einsum("ebcd,edf->ebcf", xe, lp["w_gate"]).astype(jnp.float32)
+    ).astype(xe.dtype)
+    up = jnp.einsum("ebcd,edf->ebcf", xe, lp["w_up"])
+    ye = jnp.einsum("ebcf,efd->ebcd", gated * up, lp["w_down"])
+    out = jnp.einsum("bsec,ebcd->bsd", combine.astype(c.dtype), ye)
+    return x + out, aux
+
+
+def _layer_forward(config: MoEConfig, x, lp, cos, sin, segment_ids,
+                   mesh=None):
+    x = llama.attention_block(config, x, lp, cos, sin, segment_ids, mesh)
+    return _moe_block(config, x, lp, mesh=mesh)
+
+
+# -- model -------------------------------------------------------------------
+
+def forward_hidden(config: MoEConfig, params: dict, tokens,
+                   positions=None, segment_ids=None, mesh=None):
+    """tokens [b, s] int32 -> (hidden [b, s, d], aux_loss scalar)."""
+    c = config
+    b, s = tokens.shape
+    if positions is None:
+        positions = jnp.arange(s, dtype=jnp.int32)
+    cos, sin = llama.rope_frequencies(c, positions)
+
+    x = params["embed"][tokens].astype(c.dtype)
+    if c.embed_scale:
+        x = x * jnp.asarray(math.sqrt(c.d_model), c.dtype)
+
+    body = partial(_layer_forward, c, mesh=mesh)
+    if c.remat:
+        body = jax.checkpoint(
+            body,
+            policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims)
+
+    if c.scan_layers:
+        def scan_step(x, lp):
+            x, aux = body(x, lp, cos, sin, segment_ids)
+            return x, aux
+        x, auxes = jax.lax.scan(scan_step, x, params["layers"])
+        aux = auxes.sum()
+    else:
+        aux = jnp.zeros((), jnp.float32)
+        for lp in params["layers"]:
+            x, a = body(x, lp, cos, sin, segment_ids)
+            aux = aux + a
+
+    x = rms_norm(x, params["final_norm"], c.rms_eps, c.norm_weight_offset)
+    return x, aux
+
+
+def forward(config: MoEConfig, params: dict, tokens, positions=None,
+            segment_ids=None, mesh=None):
+    """tokens [b, s] -> logits [b, s, vocab] float32 (aux loss dropped —
+    use ``loss_fn`` for training)."""
+    x, _ = forward_hidden(config, params, tokens, positions, segment_ids,
+                          mesh)
+    logits = (x @ llama._lm_head(config, params)).astype(jnp.float32)
+    return llama._softcap(config, logits)
+
+
+def loss_fn(config: MoEConfig, params: dict, tokens, targets, mask=None,
+            mesh=None):
+    """Next-token cross-entropy + load-balancing aux, mean over targets."""
+    c = config
+    x, aux = forward_hidden(c, params, tokens, mesh=mesh)
+    head = llama._lm_head(c, params)
+    if c.loss_chunk > 0:
+        from ..ops.loss import chunked_softmax_xent
+        ce = chunked_softmax_xent(x, head, targets, mask=mask,
+                                  chunk=c.loss_chunk,
+                                  logit_softcap=c.logit_softcap)
+    else:
+        logits = llama._softcap(c, (x @ head).astype(jnp.float32))
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+        nll = logz - gold
+        if mask is None:
+            ce = jnp.mean(nll)
+        else:
+            m = mask.astype(jnp.float32)
+            ce = jnp.sum(nll * m) / jnp.maximum(jnp.sum(m), 1.0)
+    return ce + c.aux_loss_weight * aux

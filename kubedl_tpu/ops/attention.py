@@ -128,9 +128,12 @@ def chunked_attention(q, k, v, causal=True, segment_ids=None,
         row_sum = row_sum * alpha + p.sum(axis=-1)
         return (acc, new_max, row_sum), None
 
-    acc0 = jnp.zeros((b, nh, sq, hd), jnp.float32)
-    max0 = jnp.full((b, nh, sq), _NEG_INF, jnp.float32)
-    sum0 = jnp.zeros((b, nh, sq), jnp.float32)
+    # derive carries from qh (not fresh constants) so they inherit qh's
+    # varying-axes type when this runs inside shard_map (e.g. under the
+    # pp pipeline or ring attention) — see parallel/ring.py
+    acc0 = qh * 0.0
+    max0 = qh.sum(-1) * 0.0 + _NEG_INF
+    sum0 = qh.sum(-1) * 0.0
     (acc, _, row_sum), _ = jax.lax.scan(
         step, (acc0, max0, sum0), (kb, vb, blk_idx, seg_kb))
     out = acc / jnp.maximum(row_sum[..., None], 1e-37)

@@ -8,6 +8,11 @@ axes carry the parallelism taxonomy:
 * ``dp``   — pure data parallelism (gradient psum over DCN or ICI),
 * ``fsdp`` — data parallelism with parameter/optimizer sharding (ZeRO-3;
   params all-gathered per layer, gradients reduce-scattered),
+* ``ep``   — expert parallelism (MoE experts sharded across devices; token
+  dispatch/combine become all-to-alls over this axis, see
+  ``kubedl_tpu.models.moe``),
+* ``pp``   — pipeline parallelism (layer stages ring-pipelined with
+  ``ppermute``, see ``kubedl_tpu.parallel.pipeline``),
 * ``tp``   — tensor parallelism (megatron-style column/row sharding, rides
   the fastest ICI axis),
 * ``cp``   — context/sequence parallelism (ring attention over the sequence
@@ -15,7 +20,8 @@ axes carry the parallelism taxonomy:
 
 Axis order is outermost-to-innermost = slowest-to-fastest interconnect, so
 ``tp`` (highest traffic per step) lands on contiguous chips of a slice and
-``dp`` spans slice boundaries (DCN) in multislice jobs.
+``dp`` spans slice boundaries (DCN) in multislice jobs; ``ep`` sits between
+the data axes and ``cp``/``tp`` so expert all-to-alls stay on ICI.
 """
 
 from __future__ import annotations
@@ -28,21 +34,27 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-AXES = ("dp", "fsdp", "cp", "tp")
+AXES = ("dp", "fsdp", "ep", "pp", "cp", "tp")
 
 
 @dataclass(frozen=True)
 class MeshConfig:
     dp: int = 1
     fsdp: int = -1   # -1: absorb remaining devices
+    ep: int = 1
+    pp: int = 1
     cp: int = 1
     tp: int = 1
 
-    def resolve(self, n_devices: int) -> tuple[int, int, int, int]:
-        known = [d for d in (self.dp, self.fsdp, self.cp, self.tp) if d != -1]
+    def resolve(self, n_devices: int) -> tuple[int, ...]:
+        sizes = tuple(getattr(self, a) for a in AXES)
+        if any(d < 1 and d != -1 for d in sizes):
+            raise ValueError(
+                f"mesh axis sizes must be >= 1 (or -1 to absorb): "
+                f"{dict(zip(AXES, sizes))}")
+        known = [d for d in sizes if d != -1]
         rest = n_devices // math.prod(known) if known else n_devices
-        dims = tuple(rest if d == -1 else d for d in
-                     (self.dp, self.fsdp, self.cp, self.tp))
+        dims = tuple(rest if d == -1 else d for d in sizes)
         if math.prod(dims) != n_devices:
             raise ValueError(
                 f"mesh {dict(zip(AXES, dims))} needs {math.prod(dims)} devices, "

@@ -1,0 +1,115 @@
+"""Pipeline parallelism: GPipe-schedule stage pipeline over the ``pp`` axis.
+
+The last of the mesh's model-parallel axes (dp/fsdp/ep/cp/tp live in
+``mesh.py``): layers are split into ``pp`` contiguous stages, each device
+ring-position holds one stage's parameters, and microbatches flow through
+the ring via ``lax.ppermute`` (neighbor exchange on ICI — the same
+primitive ring attention uses for K/V blocks).
+
+TPU-first design notes:
+
+* the whole schedule is ONE ``lax.scan`` over ``num_micro + pp - 1`` time
+  steps inside ``shard_map`` — uniform SPMD control flow, no per-stage
+  Python branching, so XLA compiles a single program for every device;
+* during pipeline fill/drain a stage computes on don't-care data instead
+  of branching (the standard bubble trade: wasted FLOPs compile to dense
+  MXU work, divergent control flow would not compile at all);
+* gradients flow through ``ppermute`` automatically (its transpose is the
+  reverse permutation), so ``jax.grad`` of a pipelined loss just works —
+  no hand-written backward schedule.
+
+The reference operator never partitions models (SURVEY.md §2-P: TP/PP/SP
+are "absent — in-process parallelism is delegated to the user's
+framework"); this module is that in-container capability, TPU-native.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+
+def pipeline_apply(mesh: Mesh, stage_fn, stage_params, x, num_micro: int,
+                   axis_name: str = "pp"):
+    """Run ``x`` through a ``pp``-stage pipeline.
+
+    stage_fn(params_one_stage, x_micro) -> y_micro — applies ONE stage
+    (e.g. an inner scan over that stage's layers); must preserve shape.
+    stage_params: pytree whose leaves carry a leading stage axis of size
+    ``pp`` (sharded on the ``pp`` mesh axis).
+    x: [batch, ...] with batch divisible by ``num_micro``.
+
+    Returns y with x's shape, replicated over ``pp``. Schedule is GPipe:
+    ``num_micro + pp - 1`` time steps, bubble fraction
+    ``(pp - 1) / (num_micro + pp - 1)``.
+    """
+    S = mesh.shape[axis_name]
+    if S == 1:
+        return stage_fn(jax.tree.map(lambda p: p[0], stage_params), x)
+    b = x.shape[0]
+    if b % num_micro:
+        raise ValueError(f"batch {b} not divisible by num_micro={num_micro}")
+    xm = x.reshape((num_micro, b // num_micro) + x.shape[1:])
+    perm = [(i, (i + 1) % S) for i in range(S)]
+
+    def per_device(params_shard, xm):
+        stage = jax.lax.axis_index(axis_name)
+        p0 = jax.tree.map(lambda p: p[0], params_shard)
+
+        def step(carry, t):
+            act, outs = carry
+            # stage 0 feeds microbatch t (clamped during drain); every
+            # other stage consumes what its neighbor sent last step
+            x_in = jnp.where(stage == 0,
+                             xm[jnp.clip(t, 0, num_micro - 1)], act)
+            y = stage_fn(p0, x_in)
+            act_next = jax.lax.ppermute(y, axis_name, perm)
+            # the last stage banks microbatch t-(S-1) once it's real
+            out_idx = jnp.clip(t - (S - 1), 0, num_micro - 1)
+            write = jnp.logical_and(t >= S - 1, stage == S - 1)
+            outs = jnp.where(write, outs.at[out_idx].set(y), outs)
+            return (act_next, outs), None
+
+        # the carry becomes device-varying over pp (ppermute + stage
+        # masking); mark the zero init varying up front or scan's
+        # carry-type check rejects the loop
+        init = jax.lax.pcast((jnp.zeros_like(xm[0]), jnp.zeros_like(xm)),
+                             (axis_name,), to="varying")
+        (act, outs), _ = jax.lax.scan(
+            step, init, jnp.arange(num_micro + S - 1))
+        # replicate the last stage's banked outputs to every ring position
+        return jax.lax.psum(
+            jnp.where(stage == S - 1, outs, jnp.zeros_like(outs)),
+            axis_name)
+
+    # params shard on pp only; microbatches keep their (dp, fsdp) batch
+    # sharding (axis 1 after the reshape) so pp composes with data axes
+    pp_spec = jax.tree.map(lambda _: P(axis_name), stage_params)
+    data_spec = P(None, ("dp", "fsdp"))
+    fn = jax.shard_map(per_device, mesh=mesh,
+                       in_specs=(pp_spec, data_spec), out_specs=data_spec)
+    y = fn(stage_params, xm)
+    return y.reshape(x.shape)
+
+
+def stack_stages(layer_params, pp: int):
+    """[L, ...]-stacked layer params -> [pp, L/pp, ...] stage-stacked."""
+    def restack(p):
+        L = p.shape[0]
+        if L % pp:
+            raise ValueError(f"{L} layers not divisible by pp={pp}")
+        return p.reshape((pp, L // pp) + p.shape[1:])
+    return jax.tree.map(restack, layer_params)
+
+
+def stage_scan(layer_fn):
+    """Lift a per-layer fn into a stage fn scanning its own layers:
+    stage_fn(stage_params [L/pp, ...], x) -> x after those layers."""
+    def stage_fn(stage_params, x):
+        def body(x, lp):
+            return layer_fn(x, lp), None
+        x, _ = jax.lax.scan(body, x, stage_params)
+        return x
+    return stage_fn

@@ -30,6 +30,8 @@ LOGICAL_RULES = {
     "kv_heads": "tp",
     "mlp": "tp",
     "vocab": "tp",
+    "experts": "ep",      # MoE expert dim of stacked expert weights
+    "stages": "pp",       # leading stage dim of pipeline-stacked params
     "layers": None,
     "norm": None,
     "head_dim": None,

@@ -1,0 +1,122 @@
+"""Pipeline parallelism: GPipe schedule over the ``pp`` mesh axis.
+
+Numerics pin: a pp-staged pipeline must reproduce sequentially applying
+the same layers — forward AND grads (ppermute transposes give the
+backward schedule for free).
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from kubedl_tpu.models import llama
+from kubedl_tpu.parallel.mesh import MeshConfig, build_mesh
+from kubedl_tpu.parallel.pipeline import (pipeline_apply, stack_stages,
+                                          stage_scan)
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return build_mesh(MeshConfig(dp=1, fsdp=2, pp=4, cp=1, tp=1))
+
+
+def _mlp_layers(key, n_layers, d):
+    ks = jax.random.split(key, n_layers)
+    return {
+        "w": jax.vmap(lambda k: jax.random.normal(k, (d, d)) * 0.1)(ks),
+        "b": jnp.zeros((n_layers, d)),
+    }
+
+
+def _layer_fn(x, lp):
+    return jnp.tanh(x @ lp["w"] + lp["b"])
+
+
+def _sequential(layers, x):
+    def body(x, lp):
+        return _layer_fn(x, lp), None
+    x, _ = jax.lax.scan(body, x, layers)
+    return x
+
+
+def test_pipeline_matches_sequential(mesh):
+    d, L, pp = 16, 8, 4
+    layers = _mlp_layers(jax.random.PRNGKey(0), L, d)
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, d))
+
+    want = _sequential(layers, x)
+    got = pipeline_apply(mesh, stage_scan(_layer_fn),
+                         stack_stages(layers, pp), x, num_micro=4)
+    assert jnp.max(jnp.abs(want - got)) < 1e-5
+
+
+def test_pipeline_single_stage_degenerates():
+    mesh = build_mesh(MeshConfig(fsdp=8))
+    d, L = 16, 4
+    layers = _mlp_layers(jax.random.PRNGKey(0), L, d)
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, d))
+    got = pipeline_apply(mesh, stage_scan(_layer_fn),
+                         stack_stages(layers, 1), x, num_micro=2)
+    assert jnp.max(jnp.abs(_sequential(layers, x) - got)) < 1e-5
+
+
+def test_pipeline_grads_match_sequential(mesh):
+    d, L, pp = 16, 8, 4
+    layers = _mlp_layers(jax.random.PRNGKey(0), L, d)
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, d))
+
+    def loss_seq(layers):
+        return (_sequential(layers, x) ** 2).sum()
+
+    def loss_pp(stages):
+        y = pipeline_apply(mesh, stage_scan(_layer_fn), stages, x,
+                           num_micro=4)
+        return (y ** 2).sum()
+
+    g_seq = jax.grad(loss_seq)(layers)
+    g_pp = jax.grad(loss_pp)(stack_stages(layers, pp))
+    g_pp_flat = jax.tree.map(
+        lambda p: p.reshape((L,) + p.shape[2:]), g_pp)
+    for k in g_seq:
+        err = jnp.max(jnp.abs(g_seq[k] - g_pp_flat[k]))
+        assert err < 1e-4, (k, float(err))
+
+
+def test_pipelined_llama_stack(mesh):
+    """Real transformer layers through the pipeline: llama's layer forward
+    (attention + SwiGLU) staged over pp=4, vs the dense scan stack."""
+    cfg = llama.tiny(vocab=128, seq=64)
+    import dataclasses
+    cfg = dataclasses.replace(cfg, n_layers=4)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 64), 0,
+                                cfg.vocab_size)
+    x = params["embed"][tokens].astype(cfg.dtype)
+    positions = jnp.arange(64, dtype=jnp.int32)
+    cos, sin = llama.rope_frequencies(cfg, positions)
+
+    def layer_fn(x, lp):
+        return llama._layer_forward(cfg, x, lp, cos, sin, None)
+
+    def seq_apply(x):
+        def body(x, lp):
+            return layer_fn(x, lp), None
+        x, _ = jax.lax.scan(body, x, params["layers"])
+        return x
+
+    want = seq_apply(x)
+    got = pipeline_apply(mesh, stage_scan(layer_fn),
+                         stack_stages(params["layers"], 4), x, num_micro=2)
+    assert jnp.max(jnp.abs(want.astype(jnp.float32)
+                           - got.astype(jnp.float32))) < 2e-2  # bf16 path
+
+
+def test_bad_shapes_raise(mesh):
+    layers = _mlp_layers(jax.random.PRNGKey(0), 6, 8)
+    with pytest.raises(ValueError):
+        stack_stages(layers, 4)  # 6 layers not divisible by 4
+    with pytest.raises(ValueError):
+        pipeline_apply(mesh, stage_scan(_layer_fn),
+                       stack_stages(layers, 2),
+                       jax.random.normal(jax.random.PRNGKey(1), (5, 8)),
+                       num_micro=2)  # batch 5 not divisible by 2
