@@ -317,13 +317,14 @@ def init_cache(config: LlamaConfig, batch: int, max_len: int,
     return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
 
 
-def _layer_step(config: LlamaConfig, x, lp, kc, vc, cos, sin, start_pos,
-                valid=None):
-    """Cache-aware layer: write this chunk's K/V at ``start_pos`` and attend
-    against the whole cache with a position mask. Static shapes throughout —
-    the mask, not the shape, encodes how much of the cache is live.
-    ``valid`` [b, max_len] additionally masks cache slots that hold padding
-    (ragged prompt batches)."""
+def attention_step(config: LlamaConfig, x, lp, kc, vc, cos, sin, start_pos,
+                   valid=None):
+    """Cache-aware attention sublayer (with residual): write this chunk's
+    K/V at ``start_pos`` and attend against the whole cache with a position
+    mask. Static shapes throughout — the mask, not the shape, encodes how
+    much of the cache is live. ``valid`` [b, max_len] additionally masks
+    cache slots that hold padding (ragged prompt batches). Shared by the
+    dense and MoE decode paths. Returns (x, kc, vc)."""
     c = config
     b, s, d = x.shape
     nh, nkv, hd = c.n_heads, c.n_kv_heads, c.hd
@@ -349,8 +350,14 @@ def _layer_step(config: LlamaConfig, x, lp, kc, vc, cos, sin, start_pos,
     scores = jnp.where(mask, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
     attn = jnp.einsum("bhqk,bkhd->bqhd", probs, vf).astype(x.dtype)
-    x = x + (attn.reshape(b, s, nh * hd) @ lp["wo"])
+    return x + (attn.reshape(b, s, nh * hd) @ lp["wo"]), kc, vc
 
+
+def _layer_step(config: LlamaConfig, x, lp, kc, vc, cos, sin, start_pos,
+                valid=None):
+    """Cache-aware layer: attention step + dense gated MLP."""
+    c = config
+    x, kc, vc = attention_step(c, x, lp, kc, vc, cos, sin, start_pos, valid)
     h = rms_norm(x, lp["mlp_norm"], c.rms_eps, c.norm_weight_offset)
     gated = _act(c)((h @ lp["w_gate"]).astype(jnp.float32)).astype(h.dtype)
     x = x + ((gated * (h @ lp["w_up"])) @ lp["w_down"])
@@ -358,12 +365,16 @@ def _layer_step(config: LlamaConfig, x, lp, kc, vc, cos, sin, start_pos,
 
 
 def forward_step(config: LlamaConfig, params: dict, tokens, cache: dict,
-                 start_pos, valid=None):
+                 start_pos, valid=None, layer_body=None):
     """Prefill (s = prompt len) or decode (s = 1) step against the KV cache.
     tokens [b, s] + cache + scalar start_pos -> (last-token logits
     [b, vocab] float32, updated cache). jit with ``donate_argnums`` on the
     cache for in-place HBM updates. ``valid`` [b, max_len] marks live cache
-    slots for ragged prompt batches."""
+    slots for ragged prompt batches.
+
+    ``layer_body`` is the pluggable per-layer step — signature of
+    ``_layer_step`` — so other families (MoE) reuse this ONE decode driver
+    instead of copying it."""
     c = config
     b, s = tokens.shape
     positions = start_pos + jnp.arange(s, dtype=jnp.int32)
@@ -371,12 +382,12 @@ def forward_step(config: LlamaConfig, params: dict, tokens, cache: dict,
     x = params["embed"][tokens].astype(c.dtype)
     if c.embed_scale:
         x = x * jnp.asarray(math.sqrt(c.d_model), c.dtype)
+    body = layer_body or _layer_step
 
     if c.scan_layers:
         def scan_step(x, layer):
             lp, kc, vc = layer
-            x, kc, vc = _layer_step(c, x, lp, kc, vc, cos, sin, start_pos,
-                                    valid)
+            x, kc, vc = body(c, x, lp, kc, vc, cos, sin, start_pos, valid)
             return x, (kc, vc)
         x, (ks, vs) = jax.lax.scan(
             scan_step, x, (params["layers"], cache["k"], cache["v"]))
@@ -384,8 +395,8 @@ def forward_step(config: LlamaConfig, params: dict, tokens, cache: dict,
     else:
         ks, vs = [], []
         for i, lp in enumerate(params["layers"]):
-            x, kc, vc = _layer_step(c, x, lp, cache["k"][i], cache["v"][i],
-                                    cos, sin, start_pos, valid)
+            x, kc, vc = body(c, x, lp, cache["k"][i], cache["v"][i],
+                             cos, sin, start_pos, valid)
             ks.append(kc)
             vs.append(vc)
         new_cache = {"k": jnp.stack(ks), "v": jnp.stack(vs)}
@@ -396,21 +407,22 @@ def forward_step(config: LlamaConfig, params: dict, tokens, cache: dict,
     return _softcap(c, logits)[:, 0], new_cache
 
 
-def loss_fn(config: LlamaConfig, params: dict, tokens, targets,
-            mask=None, mesh=None) -> jnp.ndarray:
-    """Next-token cross-entropy, mean over unmasked targets.
+def lm_loss(config: LlamaConfig, x, params: dict, targets,
+            mask=None) -> jnp.ndarray:
+    """Next-token cross-entropy from final hidden states, mean over
+    unmasked targets — the ONE LM-head loss shared by every family.
 
     With ``config.loss_chunk > 0`` the LM-head projection + softmax run in
     sequence chunks (``ops.loss.chunked_softmax_xent``) so the [b, s,
     vocab] logits tensor is never materialized — numerically identical
     (same float32 softmax), chunk-fold smaller peak HBM."""
+    head = _lm_head(config, params)
     if config.loss_chunk > 0:
         from ..ops.loss import chunked_softmax_xent
-        x = forward_hidden(config, params, tokens, mesh=mesh)
         return chunked_softmax_xent(
-            x, _lm_head(config, params), targets, mask=mask,
+            x, head, targets, mask=mask,
             chunk=config.loss_chunk, logit_softcap=config.logit_softcap)
-    logits = forward(config, params, tokens, mesh=mesh)
+    logits = _softcap(config, (x @ head).astype(jnp.float32))
     logz = jax.nn.logsumexp(logits, axis=-1)
     gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
     nll = logz - gold
@@ -418,3 +430,10 @@ def loss_fn(config: LlamaConfig, params: dict, tokens, targets,
         return jnp.mean(nll)
     mask = mask.astype(jnp.float32)
     return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def loss_fn(config: LlamaConfig, params: dict, tokens, targets,
+            mask=None, mesh=None) -> jnp.ndarray:
+    """Next-token cross-entropy, mean over unmasked targets."""
+    x = forward_hidden(config, params, tokens, mesh=mesh)
+    return lm_loss(config, x, params, targets, mask=mask)

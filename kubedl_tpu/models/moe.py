@@ -155,7 +155,7 @@ def param_specs(config: MoEConfig) -> dict:
 
 # -- routing -----------------------------------------------------------------
 
-def route(config: MoEConfig, probs, capacity: int):
+def route(config: MoEConfig, probs, capacity: int, token_mask=None):
     """Top-k routing with per-expert capacity.
 
     probs: [b, s, E] float32 router softmax. Returns (dispatch, combine,
@@ -164,6 +164,9 @@ def route(config: MoEConfig, probs, capacity: int):
     top-k gate for the same slots. Slots fill in choice-major order
     (GShard: everyone's first choice outranks any second choice), tokens
     past an expert's capacity are dropped (their residual passes through).
+    ``token_mask`` [b, s] excludes padding tokens — pads must never
+    consume expert capacity ahead of real tokens (left-padded serving
+    batches) and are excluded from the aux statistics.
     aux is the Switch load-balancing loss (E * Σ_e frac_e · prob_e)."""
     c = config
     b, s, E = probs.shape
@@ -171,6 +174,8 @@ def route(config: MoEConfig, probs, capacity: int):
     gate, idx = jax.lax.top_k(probs, k)                      # [b, s, k]
     gate = gate / jnp.maximum(gate.sum(-1, keepdims=True), 1e-9)
     oh = jax.nn.one_hot(idx, E, dtype=jnp.float32)           # [b, s, k, E]
+    if token_mask is not None:
+        oh = oh * token_mask.astype(jnp.float32)[:, :, None, None]
 
     # position of each (token, choice) in its expert's queue, choice-major
     ohk = jnp.swapaxes(oh, 1, 2).reshape(b, k * s, E)        # [b, k*s, E]
@@ -183,15 +188,21 @@ def route(config: MoEConfig, probs, capacity: int):
     dispatch = slot.sum(2)                                   # [b, s, E, C]
     combine = (gate[..., None, None] * slot).sum(2)
 
-    # Switch aux loss from the top-1 assignment
+    # Switch aux loss from the top-1 assignment (masked tokens excluded)
     top1 = oh[:, :, 0, :]                                    # [b, s, E]
-    frac = top1.mean(axis=(0, 1))
-    mean_prob = probs.mean(axis=(0, 1))
+    if token_mask is None:
+        frac = top1.mean(axis=(0, 1))
+        mean_prob = probs.mean(axis=(0, 1))
+    else:
+        m = token_mask.astype(jnp.float32)[..., None]
+        n = jnp.maximum(m.sum(), 1.0)
+        frac = (top1 * m).sum(axis=(0, 1)) / n
+        mean_prob = (probs * m).sum(axis=(0, 1)) / n
     aux = E * jnp.sum(frac * mean_prob)
     return dispatch, combine, aux
 
 
-def _moe_block(config: MoEConfig, x, lp, mesh=None):
+def _moe_block(config: MoEConfig, x, lp, mesh=None, token_mask=None):
     """Sparse-MLP sublayer with residual. Returns (x, aux_loss)."""
     c = config
     b, s, d = x.shape
@@ -201,7 +212,7 @@ def _moe_block(config: MoEConfig, x, lp, mesh=None):
     probs = jax.nn.softmax(logits, axis=-1)
     capacity = max(1, int(math.ceil(
         c.capacity_factor * s * c.top_k / c.n_experts)))
-    dispatch, combine, aux = route(c, probs, capacity)
+    dispatch, combine, aux = route(c, probs, capacity, token_mask)
 
     # dispatch: [b, s, E, C] x [b, s, d] -> [E, b, C, d]; under a sharded
     # mesh this boundary is where GSPMD inserts the all-to-all over ep
@@ -272,25 +283,43 @@ def forward(config: MoEConfig, params: dict, tokens, positions=None,
     return llama._softcap(config, logits)
 
 
+# -- KV-cache inference path -------------------------------------------------
+
+init_cache = llama.init_cache  # cache layout is attention-only; identical
+
+
+def _decode_layer_body(c, x, lp, kc, vc, cos, sin, start_pos, valid):
+    """Per-layer decode body plugged into llama's decode driver: shared
+    cache-aware attention, then the sparse-MLP block. The chunk's token
+    mask is sliced out of ``valid`` so left-padding never consumes expert
+    capacity ahead of real tokens."""
+    x, kc, vc = llama.attention_step(c, x, lp, kc, vc, cos, sin,
+                                     start_pos, valid)
+    token_mask = None
+    if valid is not None:
+        token_mask = jax.lax.dynamic_slice_in_dim(
+            valid, start_pos, x.shape[1], axis=1)
+    x, _ = _moe_block(c, x, lp, token_mask=token_mask)
+    return x, kc, vc
+
+
+def forward_step(config: MoEConfig, params: dict, tokens, cache: dict,
+                 start_pos, valid=None):
+    """Prefill/decode step against the KV cache for the MoE stack — the
+    ONE llama decode driver with the MoE layer body plugged in, so the
+    serving engine (``kubedl_tpu.serving.engine``) drives either family
+    through the same contract. At decode (s=1) the router still picks
+    top-k experts per token; capacity degenerates to one slot per
+    expert."""
+    return llama.forward_step(config, params, tokens, cache, start_pos,
+                              valid, layer_body=_decode_layer_body)
+
+
 def loss_fn(config: MoEConfig, params: dict, tokens, targets, mask=None,
             mesh=None):
-    """Next-token cross-entropy + load-balancing aux, mean over targets."""
+    """Next-token cross-entropy (shared ``llama.lm_loss``) + the
+    load-balancing aux, mean over targets."""
     c = config
     x, aux = forward_hidden(c, params, tokens, mesh=mesh)
-    head = llama._lm_head(c, params)
-    if c.loss_chunk > 0:
-        from ..ops.loss import chunked_softmax_xent
-        ce = chunked_softmax_xent(x, head, targets, mask=mask,
-                                  chunk=c.loss_chunk,
-                                  logit_softcap=c.logit_softcap)
-    else:
-        logits = llama._softcap(c, (x @ head).astype(jnp.float32))
-        logz = jax.nn.logsumexp(logits, axis=-1)
-        gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-        nll = logz - gold
-        if mask is None:
-            ce = jnp.mean(nll)
-        else:
-            m = mask.astype(jnp.float32)
-            ce = jnp.sum(nll * m) / jnp.maximum(jnp.sum(m), 1.0)
-    return ce + c.aux_loss_weight * aux
+    return llama.lm_loss(c, x, params, targets, mask=mask) \
+        + c.aux_loss_weight * aux

@@ -48,11 +48,18 @@ class InferenceEngine:
         self.gen = gen or GenerateConfig()
 
         model_cfg = self.config
+        # family dispatch: every model family exposes the same
+        # forward_step/init_cache contract (llama/gemma share LlamaConfig;
+        # MoEConfig routes through the sparse stack)
+        from ..models import moe
+        self._family = moe if isinstance(config, moe.MoEConfig) else llama
+
+        family = self._family
 
         @partial(jax.jit, donate_argnums=(1,))
         def _step(params, cache, tokens, start_pos, valid):
-            return llama.forward_step(model_cfg, params, tokens, cache,
-                                      start_pos, valid)
+            return family.forward_step(model_cfg, params, tokens, cache,
+                                       start_pos, valid)
 
         self._step = _step
 
@@ -99,7 +106,7 @@ class InferenceEngine:
         valid = jnp.asarray(
             np.arange(gen.max_len)[None, :] >= pad[:, None])
 
-        cache = llama.init_cache(self.config, b, gen.max_len)
+        cache = self._family.init_cache(self.config, b, gen.max_len)
         logits, cache = self._step(self.params, cache, jnp.asarray(toks),
                                    jnp.int32(0), valid)
         key = jax.random.PRNGKey(seed)
