@@ -10,6 +10,7 @@ import { viewNotebooks, viewNotebookCreate } from "./pages/notebooks.js";
 import { viewWorkspaces, viewWorkspaceCreate } from "./pages/workspaces.js";
 import { viewDataSources, viewCodeSources } from "./pages/sources.js";
 import { viewCluster } from "./pages/cluster.js";
+import { viewAdmin } from "./pages/admin.js";
 
 // ---------------------------------------------------------------- api client
 
@@ -84,6 +85,9 @@ const MESSAGES = {
     "sources.data": "Data sources", "sources.code": "Code sources",
     "sources.add": "Add", "sources.save": "Save", "sources.edit": "edit",
     "cluster.title": "Cluster",
+    "nav.admin": "Admin", "admin.title": "Console users",
+    "admin.username": "Username", "admin.password": "Password",
+    "admin.role": "Role", "admin.add": "Add or update user",
     "login.title": "Sign in", "login.button": "Login",
     "login.failed": "login failed",
   },
@@ -104,6 +108,9 @@ const MESSAGES = {
     "sources.data": "数据源", "sources.code": "代码源",
     "sources.add": "新增", "sources.save": "保存", "sources.edit": "编辑",
     "cluster.title": "集群",
+    "nav.admin": "管理", "admin.title": "控制台用户",
+    "admin.username": "用户名", "admin.password": "密码",
+    "admin.role": "角色", "admin.add": "添加或更新用户",
     "login.title": "登录", "login.button": "登录",
     "login.failed": "登录失败",
   },
@@ -138,6 +145,7 @@ const routes = {
   "datasources": viewDataSources,
   "codesources": viewCodeSources,
   "cluster": viewCluster,
+  "admin": viewAdmin,
 };
 
 export async function route() {
@@ -150,6 +158,7 @@ export async function route() {
     try {
       const u = await api("/current-user");
       document.getElementById("user").textContent = u.loginId;
+      document.getElementById("nav-admin").hidden = !u.admin;
     } catch (e) { return; /* redirected to login */ }
   }
   document.querySelectorAll("nav a").forEach(a =>

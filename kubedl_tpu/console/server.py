@@ -5,6 +5,9 @@ Route-for-route analog of the reference Gin server
 
 * auth: ``POST /api/v1/login``, ``POST /api/v1/logout``,
   ``GET /api/v1/current-user`` (session-cookie auth, ``auth.go``)
+* users: ``GET/POST /api/v1/users``, ``DELETE /api/v1/users/{name}``
+  (admin-only management of console accounts, persisted to the
+  kubedl-console-config ConfigMap — reference Admin page)
 * jobs: ``/api/v1/job/{list,detail,statistics,running-jobs}``,
   ``/api/v1/job/{yaml,json}/{ns}/{name}``, ``POST /api/v1/job/stop``,
   ``POST /api/v1/job/submit``, ``DELETE /api/v1/job/{ns}/{name}``
@@ -120,6 +123,26 @@ def resolve_users(config: ConsoleConfig, api) -> dict:
     return {"admin": password}
 
 
+def resolve_admins(users: dict, api) -> set:
+    """Which users may manage console users (reference Admin page /
+    ``apiv1Routes.GET("/user", ...)``): an ``admins`` JSON list in the
+    console ConfigMap wins; else the conventional ``admin`` account; else
+    the first configured user (sole-user installs administer themselves)."""
+    cm = api.try_get("ConfigMap", CONSOLE_NAMESPACE, CONSOLE_CONFIGMAP)
+    if cm is not None:
+        try:
+            admins = set(json.loads((cm.get("data") or {}).get("admins", "[]")))
+            admins &= set(users)
+            if admins:
+                return admins
+        except (ValueError, TypeError) as e:
+            log.warning("bad admins list in %s ConfigMap: %s",
+                        CONSOLE_CONFIGMAP, e)
+    if "admin" in users:
+        return {"admin"}
+    return set(sorted(users)[:1])
+
+
 class _Sessions:
     def __init__(self):
         self._tokens: dict[str, str] = {}
@@ -139,6 +162,13 @@ class _Sessions:
         with self._lock:
             self._tokens.pop(token or "", None)
 
+    def revoke_user(self, user: str) -> None:
+        """Drop every session of ``user`` (account deleted or password
+        changed — revocation must be immediate, not cookie-lifetime)."""
+        with self._lock:
+            for tok in [t for t, u in self._tokens.items() if u == user]:
+                del self._tokens[tok]
+
 
 class ConsoleServer:
     """Owns the HTTP server; all state lives here, the handler is stateless."""
@@ -147,6 +177,8 @@ class ConsoleServer:
         self.proxy = proxy
         self.config = config or ConsoleConfig()
         self.users = resolve_users(self.config, proxy.api)
+        self.admins = resolve_admins(self.users, proxy.api)
+        self._users_lock = threading.Lock()
         self.sessions = _Sessions()
         self.cs = Clientset(proxy.api)
         self.datasources = DataSourceHandler(proxy.api)
@@ -208,19 +240,76 @@ class ConsoleServer:
             return 401, {"code": 401, "msg": "not logged in"}, []
         if path == "/api/v1/current-user":
             return 200, {"code": 200, "data": {
-                "loginId": user or "anonymous"}}, []
+                "loginId": user or "anonymous",
+                "admin": self._is_admin(user)}}, []
 
         try:
-            return self._dispatch(method, path, params, body)
+            return self._dispatch(method, path, params, body, user)
+        except PermissionError as e:
+            return 403, {"code": 403, "msg": str(e)}, []
         except NotFound as e:
             return 404, {"code": 404, "msg": str(e)}, []
         except (ApiError, ValueError, KeyError) as e:
             return 400, {"code": 400, "msg": f"{type(e).__name__}: {e}"}, []
 
+    def _is_admin(self, user) -> bool:
+        # auth disabled (explicit empty user map, dev mode): everyone admin
+        return not self.users or user in self.admins
+
     # -- endpoint implementations ----------------------------------------
 
-    def _dispatch(self, method: str, path: str, params: dict, body: bytes):
+    def _dispatch(self, method: str, path: str, params: dict, body: bytes,
+                  user=None):
         ok = lambda data: (200, {"code": 200, "data": data}, [])  # noqa: E731
+
+        # -- console user management (reference Admin page, auth.go) ------
+        # every route is admin-only: even the list is a credential-attack
+        # target (usernames + which accounts are admins)
+        if path == "/api/v1/users" and method == "GET":
+            if not self._is_admin(user):
+                raise PermissionError("admin role required")
+            with self._users_lock:
+                return ok([{"username": u, "admin": u in self.admins}
+                           for u in sorted(self.users)])
+        if path == "/api/v1/users" and method == "POST":
+            if not self._is_admin(user):
+                raise PermissionError("admin role required")
+            req = _parse_body(body)
+            uname = str(req.get("username", "")).strip()
+            pw = str(req.get("password", ""))
+            if not uname or not pw:
+                raise ValueError("username and password are required")
+            if not re.fullmatch(r"[A-Za-z0-9._-]{1,64}", uname):
+                raise ValueError(
+                    "username must be 1-64 chars of [A-Za-z0-9._-]")
+            with self._users_lock:
+                changed = self.users.get(uname) != pw
+                self.users[uname] = pw
+                if bool(req.get("admin")):
+                    self.admins.add(uname)
+                elif uname in self.admins and len(self.admins) > 1:
+                    self.admins.discard(uname)
+                self._persist_users()
+                is_admin = uname in self.admins
+            if changed:
+                self.sessions.revoke_user(uname)  # password reset = re-login
+            return ok({"username": uname, "admin": is_admin})
+        mt = re.fullmatch(r"/api/v1/users/([^/]+)", path)
+        if mt and method == "DELETE":
+            if not self._is_admin(user):
+                raise PermissionError("admin role required")
+            from urllib.parse import unquote
+            uname = unquote(mt.group(1))
+            with self._users_lock:
+                if uname not in self.users:
+                    raise NotFound(f"user {uname!r} not found")
+                if uname in self.admins and self.admins <= {uname}:
+                    raise ValueError("cannot delete the last admin")
+                del self.users[uname]
+                self.admins.discard(uname)
+                self._persist_users()
+            self.sessions.revoke_user(uname)
+            return ok("deleted")
 
         if path == "/api/v1/job/list":
             q = _query_from_params(params)
@@ -480,6 +569,32 @@ class ConsoleServer:
             if job is not None:
                 return job
         return None
+
+    def _persist_users(self) -> None:
+        """Write the live user set back to the console ConfigMap so edits
+        survive operator restarts (the reference keeps its user list in a
+        kubedl-system ConfigMap for the same reason)."""
+        api = self.proxy.api
+        data = {
+            "users": json.dumps([
+                {"username": u, "password": p}
+                for u, p in sorted(self.users.items())]),
+            "admins": json.dumps(sorted(self.admins)),
+        }
+        cm = api.try_get("ConfigMap", CONSOLE_NAMESPACE, CONSOLE_CONFIGMAP)
+        if cm is None:
+            try:
+                api.create({"apiVersion": "v1", "kind": "ConfigMap",
+                            "metadata": {"name": CONSOLE_CONFIGMAP,
+                                         "namespace": CONSOLE_NAMESPACE},
+                            "data": data})
+            except AlreadyExists:
+                cm = api.get("ConfigMap", CONSOLE_NAMESPACE, CONSOLE_CONFIGMAP)
+        if cm is not None:
+            cm = dict(cm)
+            # merge: other keys an operator keeps in this ConfigMap survive
+            cm["data"] = {**(cm.get("data") or {}), **data}
+            api.update(cm)
 
     def _login(self, body: bytes):
         req = _parse_body(body)

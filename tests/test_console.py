@@ -252,6 +252,54 @@ def test_frontend_module_contract():
         assert needle in all_src
 
 
+def test_user_management(stack, api):
+    """Admin CRUD over console users (reference Admin page): list shows
+    roles, non-admins get 403, mutations persist to the ConfigMap, the
+    last admin is protected, and a created user can log in."""
+    op, client = stack
+    login(client)
+
+    status, body = client.req("GET", "/api/v1/users")
+    assert status == 200
+    assert body["data"] == [{"username": "admin", "admin": True}]
+
+    # create a non-admin user; it lands in the ConfigMap
+    status, _ = client.req("POST", "/api/v1/users",
+                           {"username": "dev", "password": "pw1"})
+    assert status == 200
+    cm = api.get("ConfigMap", "kubedl-system", "kubedl-console-config")
+    assert any(u["username"] == "dev"
+               for u in json.loads(cm["data"]["users"]))
+
+    # the new user can log in but cannot manage OR list users
+    dev = Client(client.base)
+    status, _ = dev.req("POST", "/api/v1/login",
+                        {"username": "dev", "password": "pw1"})
+    assert status == 200
+    for method, path, body_ in (("GET", "/api/v1/users", None),
+                                ("POST", "/api/v1/users",
+                                 {"username": "x", "password": "y"}),
+                                ("DELETE", "/api/v1/users/admin", None)):
+        status, _ = dev.req(method, path, body_)
+        assert status == 403, (method, path)
+
+    # bad usernames rejected up front
+    status, _ = client.req("POST", "/api/v1/users",
+                           {"username": "a b/c", "password": "x"})
+    assert status == 400
+
+    # last-admin protection, then real deletion by the admin
+    status, body = client.req("DELETE", "/api/v1/users/admin")
+    assert status == 400 and "last admin" in body["msg"]
+    status, _ = client.req("DELETE", "/api/v1/users/dev")
+    assert status == 200
+    status, body = client.req("GET", "/api/v1/users")
+    assert [u["username"] for u in body["data"]] == ["admin"]
+    # deletion revoked dev's live session immediately
+    status, _ = dev.req("GET", "/api/v1/job/list")
+    assert status == 401
+
+
 def test_credential_resolution(api, monkeypatch):
     """No more hard-coded admin:kubedl (ADVICE r1/r2): explicit config >
     env > ConfigMap > generated random password."""
