@@ -38,7 +38,7 @@ from http import HTTPStatus
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from pathlib import Path
 from typing import Optional
-from urllib.parse import parse_qs, urlparse
+from urllib.parse import parse_qs, unquote, urlparse
 
 import yaml
 
@@ -77,10 +77,29 @@ class ConsoleConfig:
     cookie_secure: bool = False
 
 
+#: _persist_users marks the ConfigMap it writes; a marked ConfigMap holds
+#: the latest console-made edits and therefore outranks env/config seeds on
+#: restart (otherwise a deleted account would resurrect from the env var)
+MANAGED_ANNOTATION = "kubedl.io/managed-by"
+
+
 def resolve_users(config: ConsoleConfig, api) -> dict:
     """Credential sources, most-explicit first (reference
     ``model.GetUserInfoFromConfigMap``; the hard-coded admin:kubedl default
-    of earlier rounds is gone — ADVICE r1/r2)."""
+    of earlier rounds is gone — ADVICE r1/r2). Exception: a ConfigMap the
+    console itself wrote (managed-by annotation) carries admin edits made
+    through the Admin page and wins over the original env/config seed."""
+    cm = api.try_get("ConfigMap", CONSOLE_NAMESPACE, CONSOLE_CONFIGMAP)
+    managed = (cm is not None and (cm.get("metadata", {}).get(
+        "annotations") or {}).get(MANAGED_ANNOTATION) == "console")
+    if managed:
+        try:
+            infos = json.loads((cm.get("data") or {}).get("users", "[]"))
+            users = {u["username"]: u["password"] for u in infos}
+            if users:
+                return users
+        except (ValueError, TypeError, KeyError) as e:
+            log.warning("bad managed %s ConfigMap: %s", CONSOLE_CONFIGMAP, e)
     if config.users is not None:
         return dict(config.users)
     env = os.environ.get("KUBEDL_CONSOLE_USERS", "")
@@ -283,14 +302,26 @@ class ConsoleServer:
                 raise ValueError(
                     "username must be 1-64 chars of [A-Za-z0-9._-]")
             with self._users_lock:
-                changed = self.users.get(uname) != pw
-                self.users[uname] = pw
+                users = dict(self.users)
+                admins = set(self.admins)
+                changed = users.get(uname) != pw
+                users[uname] = pw
                 if bool(req.get("admin")):
-                    self.admins.add(uname)
-                elif uname in self.admins and len(self.admins) > 1:
-                    self.admins.discard(uname)
-                self._persist_users()
-                is_admin = uname in self.admins
+                    admins.add(uname)
+                elif uname in admins:
+                    if admins <= {uname}:
+                        raise ValueError("cannot demote the last admin")
+                    admins.discard(uname)
+                # dev-mode bootstrap: the first account created while auth
+                # was disabled must become admin, or the system ends up
+                # with auth on and zero admins (permanent lockout)
+                if users and not admins:
+                    admins.add(uname)
+                # persist FIRST: a failed ConfigMap write must not leave
+                # memory and storage disagreeing (or skip revocation)
+                self._persist_users(users, admins)
+                self.users, self.admins = users, admins
+                is_admin = uname in admins
             if changed:
                 self.sessions.revoke_user(uname)  # password reset = re-login
             return ok({"username": uname, "admin": is_admin})
@@ -298,16 +329,16 @@ class ConsoleServer:
         if mt and method == "DELETE":
             if not self._is_admin(user):
                 raise PermissionError("admin role required")
-            from urllib.parse import unquote
             uname = unquote(mt.group(1))
             with self._users_lock:
                 if uname not in self.users:
                     raise NotFound(f"user {uname!r} not found")
                 if uname in self.admins and self.admins <= {uname}:
                     raise ValueError("cannot delete the last admin")
-                del self.users[uname]
-                self.admins.discard(uname)
-                self._persist_users()
+                users = {u: p for u, p in self.users.items() if u != uname}
+                admins = self.admins - {uname}
+                self._persist_users(users, admins)
+                self.users, self.admins = users, admins
             self.sessions.revoke_user(uname)
             return ok("deleted")
 
@@ -570,31 +601,38 @@ class ConsoleServer:
                 return job
         return None
 
-    def _persist_users(self) -> None:
-        """Write the live user set back to the console ConfigMap so edits
-        survive operator restarts (the reference keeps its user list in a
-        kubedl-system ConfigMap for the same reason)."""
+    def _persist_users(self, users: dict, admins: set) -> None:
+        """Write a user set to the console ConfigMap so edits survive
+        operator restarts (the reference keeps its user list in a
+        kubedl-system ConfigMap for the same reason). The managed-by
+        annotation makes resolve_users prefer this ConfigMap over the
+        original env/config seed on the next start."""
         api = self.proxy.api
         data = {
             "users": json.dumps([
                 {"username": u, "password": p}
-                for u, p in sorted(self.users.items())]),
-            "admins": json.dumps(sorted(self.admins)),
+                for u, p in sorted(users.items())]),
+            "admins": json.dumps(sorted(admins)),
         }
+        annotations = {MANAGED_ANNOTATION: "console"}
         cm = api.try_get("ConfigMap", CONSOLE_NAMESPACE, CONSOLE_CONFIGMAP)
         if cm is None:
             try:
                 api.create({"apiVersion": "v1", "kind": "ConfigMap",
                             "metadata": {"name": CONSOLE_CONFIGMAP,
-                                         "namespace": CONSOLE_NAMESPACE},
+                                         "namespace": CONSOLE_NAMESPACE,
+                                         "annotations": annotations},
                             "data": data})
+                return
             except AlreadyExists:
                 cm = api.get("ConfigMap", CONSOLE_NAMESPACE, CONSOLE_CONFIGMAP)
-        if cm is not None:
-            cm = dict(cm)
-            # merge: other keys an operator keeps in this ConfigMap survive
-            cm["data"] = {**(cm.get("data") or {}), **data}
-            api.update(cm)
+        cm = dict(cm)
+        meta_ = cm.setdefault("metadata", {})
+        meta_["annotations"] = {**(meta_.get("annotations") or {}),
+                                **annotations}
+        # merge: other keys an operator keeps in this ConfigMap survive
+        cm["data"] = {**(cm.get("data") or {}), **data}
+        api.update(cm)
 
     def _login(self, body: bytes):
         req = _parse_body(body)

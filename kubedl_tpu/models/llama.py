@@ -30,6 +30,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..ops.attention import multi_head_attention, repeat_kv
+from ..ops.quant import mm as _mm
 from ..parallel.ring import ring_attention
 from ..parallel.sharding import spec
 
@@ -194,9 +195,15 @@ def _act(config: LlamaConfig):
 
 
 def _lm_head(config: LlamaConfig, params: dict):
-    """[d, vocab] projection; Gemma ties it to the embedding table."""
-    w = (params["embed"].T if config.tie_embeddings else params["lm_head"])
-    return w.astype(config.dtype)
+    """[d, vocab] projection; Gemma ties it to the embedding table. May be
+    a ``QTensor`` under int8 serving (consumers go through ``quant.mm`` /
+    ``quant.to_dense``)."""
+    if config.tie_embeddings:
+        return params["embed"].T.astype(config.dtype)
+    w = params["lm_head"]
+    if hasattr(w, "astype"):
+        return w.astype(config.dtype)
+    return w
 
 
 def _softcap(config: LlamaConfig, logits):
@@ -235,9 +242,9 @@ def attention_block(config: LlamaConfig, x, lp, cos, sin, segment_ids,
     nh, nkv, hd = c.n_heads, c.n_kv_heads, c.hd
 
     h = rms_norm(x, lp["attn_norm"], c.rms_eps, c.norm_weight_offset)
-    q = (h @ lp["wq"]).reshape(b, s, nh, hd)
-    k = (h @ lp["wk"]).reshape(b, s, nkv, hd)
-    v = (h @ lp["wv"]).reshape(b, s, nkv, hd)
+    q = _mm(h, lp["wq"]).reshape(b, s, nh, hd)
+    k = _mm(h, lp["wk"]).reshape(b, s, nkv, hd)
+    v = _mm(h, lp["wv"]).reshape(b, s, nkv, hd)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
     if mesh is not None and mesh.shape.get("cp", 1) > 1 and segment_ids is None:
@@ -247,7 +254,7 @@ def attention_block(config: LlamaConfig, x, lp, cos, sin, segment_ids,
     else:
         attn = multi_head_attention(q, k, v, causal=True,
                                     segment_ids=segment_ids)
-    return x + (attn.reshape(b, s, nh * hd) @ lp["wo"])
+    return x + _mm(attn.reshape(b, s, nh * hd), lp["wo"])
 
 
 def _layer_forward(config: LlamaConfig, x, lp, cos, sin, segment_ids,
@@ -257,8 +264,8 @@ def _layer_forward(config: LlamaConfig, x, lp, cos, sin, segment_ids,
 
     # -- gated MLP (SwiGLU for Llama, GeGLU for Gemma)
     h = rms_norm(x, lp["mlp_norm"], c.rms_eps, c.norm_weight_offset)
-    gated = _act(c)((h @ lp["w_gate"]).astype(jnp.float32)).astype(h.dtype)
-    x = x + ((gated * (h @ lp["w_up"])) @ lp["w_down"])
+    gated = _act(c)(_mm(h, lp["w_gate"]).astype(jnp.float32)).astype(h.dtype)
+    x = x + _mm(gated * _mm(h, lp["w_up"]), lp["w_down"])
     return x
 
 
@@ -301,7 +308,7 @@ def forward(config: LlamaConfig, params: dict, tokens,
     non-trivial ``cp`` axis; without it the sequence must fit one device's
     attention window."""
     x = forward_hidden(config, params, tokens, positions, segment_ids, mesh)
-    logits = (x @ _lm_head(config, params)).astype(jnp.float32)
+    logits = _mm(x, _lm_head(config, params)).astype(jnp.float32)
     return _softcap(config, logits)
 
 
@@ -331,9 +338,9 @@ def attention_step(config: LlamaConfig, x, lp, kc, vc, cos, sin, start_pos,
     max_len = kc.shape[1]
 
     h = rms_norm(x, lp["attn_norm"], c.rms_eps, c.norm_weight_offset)
-    q = apply_rope((h @ lp["wq"]).reshape(b, s, nh, hd), cos, sin)
-    k = apply_rope((h @ lp["wk"]).reshape(b, s, nkv, hd), cos, sin)
-    v = (h @ lp["wv"]).reshape(b, s, nkv, hd)
+    q = apply_rope(_mm(h, lp["wq"]).reshape(b, s, nh, hd), cos, sin)
+    k = apply_rope(_mm(h, lp["wk"]).reshape(b, s, nkv, hd), cos, sin)
+    v = _mm(h, lp["wv"]).reshape(b, s, nkv, hd)
     kc = jax.lax.dynamic_update_slice(kc, k.astype(kc.dtype), (0, start_pos, 0, 0))
     vc = jax.lax.dynamic_update_slice(vc, v.astype(vc.dtype), (0, start_pos, 0, 0))
 
@@ -350,7 +357,7 @@ def attention_step(config: LlamaConfig, x, lp, kc, vc, cos, sin, start_pos,
     scores = jnp.where(mask, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
     attn = jnp.einsum("bhqk,bkhd->bqhd", probs, vf).astype(x.dtype)
-    return x + (attn.reshape(b, s, nh * hd) @ lp["wo"]), kc, vc
+    return x + _mm(attn.reshape(b, s, nh * hd), lp["wo"]), kc, vc
 
 
 def _layer_step(config: LlamaConfig, x, lp, kc, vc, cos, sin, start_pos,
@@ -359,8 +366,8 @@ def _layer_step(config: LlamaConfig, x, lp, kc, vc, cos, sin, start_pos,
     c = config
     x, kc, vc = attention_step(c, x, lp, kc, vc, cos, sin, start_pos, valid)
     h = rms_norm(x, lp["mlp_norm"], c.rms_eps, c.norm_weight_offset)
-    gated = _act(c)((h @ lp["w_gate"]).astype(jnp.float32)).astype(h.dtype)
-    x = x + ((gated * (h @ lp["w_up"])) @ lp["w_down"])
+    gated = _act(c)(_mm(h, lp["w_gate"]).astype(jnp.float32)).astype(h.dtype)
+    x = x + _mm(gated * _mm(h, lp["w_up"]), lp["w_down"])
     return x, kc, vc
 
 
@@ -403,7 +410,7 @@ def forward_step(config: LlamaConfig, params: dict, tokens, cache: dict,
 
     x = rms_norm(x[:, -1:], params["final_norm"], c.rms_eps,
                  c.norm_weight_offset)
-    logits = (x @ _lm_head(c, params)).astype(jnp.float32)
+    logits = _mm(x, _lm_head(c, params)).astype(jnp.float32)
     return _softcap(c, logits)[:, 0], new_cache
 
 
@@ -416,7 +423,8 @@ def lm_loss(config: LlamaConfig, x, params: dict, targets,
     sequence chunks (``ops.loss.chunked_softmax_xent``) so the [b, s,
     vocab] logits tensor is never materialized — numerically identical
     (same float32 softmax), chunk-fold smaller peak HBM."""
-    head = _lm_head(config, params)
+    from ..ops.quant import to_dense
+    head = to_dense(_lm_head(config, params), config.dtype)
     if config.loss_chunk > 0:
         from ..ops.loss import chunked_softmax_xent
         return chunked_softmax_xent(

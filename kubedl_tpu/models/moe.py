@@ -221,11 +221,18 @@ def _moe_block(config: MoEConfig, x, lp, mesh=None, token_mask=None):
         xe = jax.lax.with_sharding_constraint(
             xe, jax.sharding.NamedSharding(
                 mesh, P("ep", ("dp", "fsdp"), None, None)))
+    # int8 serving: expert stacks may arrive quantized; densify per use
+    # (XLA fuses the int8->bf16 convert into the einsum, so HBM still
+    # streams half the bytes)
+    from ..ops.quant import to_dense
+    w_gate = to_dense(lp["w_gate"], xe.dtype)
+    w_up = to_dense(lp["w_up"], xe.dtype)
+    w_down = to_dense(lp["w_down"], xe.dtype)
     gated = llama._act(c)(
-        jnp.einsum("ebcd,edf->ebcf", xe, lp["w_gate"]).astype(jnp.float32)
+        jnp.einsum("ebcd,edf->ebcf", xe, w_gate).astype(jnp.float32)
     ).astype(xe.dtype)
-    up = jnp.einsum("ebcd,edf->ebcf", xe, lp["w_up"])
-    ye = jnp.einsum("ebcf,efd->ebcd", gated * up, lp["w_down"])
+    up = jnp.einsum("ebcd,edf->ebcf", xe, w_up)
+    ye = jnp.einsum("ebcf,efd->ebcd", gated * up, w_down)
     out = jnp.einsum("bsec,ebcd->bsd", combine.astype(c.dtype), ye)
     return x + out, aux
 
@@ -279,7 +286,8 @@ def forward(config: MoEConfig, params: dict, tokens, positions=None,
     use ``loss_fn`` for training)."""
     x, _ = forward_hidden(config, params, tokens, positions, segment_ids,
                           mesh)
-    logits = (x @ llama._lm_head(config, params)).astype(jnp.float32)
+    from ..ops.quant import mm as _qmm
+    logits = _qmm(x, llama._lm_head(config, params)).astype(jnp.float32)
     return llama._softcap(config, logits)
 
 

@@ -42,8 +42,16 @@ class InferenceEngine:
     """One loaded model + its compiled prefill/decode steps."""
 
     def __init__(self, config: llama.LlamaConfig, params: dict,
-                 gen: Optional[GenerateConfig] = None):
+                 gen: Optional[GenerateConfig] = None,
+                 quantize: Optional[str] = None):
         self.config = config
+        if quantize == "int8":
+            # weight-only int8: halves weight HBM + bandwidth; decode is
+            # bandwidth-bound so this is the cheap serving speedup
+            from ..ops.quant import quantize_params
+            params = quantize_params(params)
+        elif quantize:
+            raise ValueError(f"unknown quantize mode {quantize!r}")
         self.params = params
         self.gen = gen or GenerateConfig()
 

@@ -300,6 +300,72 @@ def test_user_management(stack, api):
     assert status == 401
 
 
+def test_user_edits_survive_restart_over_config_seed(stack, api):
+    """The console-managed ConfigMap outranks the original env/config seed
+    on restart — a deleted account must not resurrect, an added one must
+    not vanish (review finding)."""
+    op, client = stack
+    login(client)
+    status, body = client.req("POST", "/api/v1/users",
+                              {"username": "bob", "password": "pw2"})
+    assert status == 200
+    status, body = client.req("POST", "/api/v1/users",
+                              {"username": "admin", "password": "rotated",
+                               "admin": True})
+    assert status == 200
+
+    # "restart": a new server over the same apiserver with the ORIGINAL
+    # explicit seed must pick up the managed ConfigMap instead
+    from kubedl_tpu.console import ConsoleConfig, ConsoleServer, DataProxy
+    proxy = DataProxy(api, op.object_backend, op.event_backend)
+    server2 = ConsoleServer(proxy, ConsoleConfig(
+        port=0, users={"admin": "kubedl"}))
+    server2.start()
+    try:
+        c2 = Client(server2.url)
+        assert c2.req("POST", "/api/v1/login",
+                      {"username": "admin", "password": "kubedl"})[0] == 401
+        assert c2.req("POST", "/api/v1/login",
+                      {"username": "admin", "password": "rotated"})[0] == 200
+        assert c2.req("POST", "/api/v1/login",
+                      {"username": "bob", "password": "pw2"})[0] == 200
+    finally:
+        server2.stop()
+
+
+def test_sole_admin_cannot_demote_self(stack):
+    op, client = stack
+    login(client)
+    status, body = client.req("POST", "/api/v1/users",
+                              {"username": "admin", "password": "kubedl",
+                               "admin": False})
+    assert status == 400 and "demote" in body["msg"]
+
+
+def test_dev_mode_first_user_becomes_admin(api):
+    """Auth-disabled console: the first account created must become admin,
+    or enabling auth would lock user management forever (review finding)."""
+    from kubedl_tpu.console import ConsoleConfig, ConsoleServer, DataProxy
+    from kubedl_tpu.controllers.registry import OperatorConfig, build_operator
+    op = build_operator(api, OperatorConfig(
+        workloads=["PyTorchJob"], object_storage="sqlite",
+        event_storage="sqlite"))
+    proxy = DataProxy(api, op.object_backend, op.event_backend)
+    server = ConsoleServer(proxy, ConsoleConfig(port=0, users={}))
+    server.start()
+    try:
+        c = Client(server.url)
+        status, body = c.req("POST", "/api/v1/users",
+                             {"username": "first", "password": "pw"})
+        assert status == 200 and body["data"]["admin"] is True
+        # auth is now on; 'first' can log in and manage users
+        assert c.req("POST", "/api/v1/login",
+                     {"username": "first", "password": "pw"})[0] == 200
+        assert c.req("GET", "/api/v1/users")[0] == 200
+    finally:
+        server.stop()
+
+
 def test_credential_resolution(api, monkeypatch):
     """No more hard-coded admin:kubedl (ADVICE r1/r2): explicit config >
     env > ConfigMap > generated random password."""

@@ -1,0 +1,92 @@
+"""Weight-only int8 quantization: numerics, size, serving integration."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from kubedl_tpu.models import llama
+from kubedl_tpu.ops import quant
+
+
+def test_quantize_roundtrip_error_small():
+    w = jax.random.normal(jax.random.PRNGKey(0), (256, 512), jnp.float32)
+    qt = quant.quantize_int8(w)
+    assert qt.q.dtype == jnp.int8 and qt.scale.shape == (512,)
+    back = quant.to_dense(qt, jnp.float32)
+    # symmetric int8 per-channel: worst-case error = scale/2 per channel
+    err = jnp.abs(back - w)
+    assert float(err.max()) <= float(qt.scale.max()) * 0.51
+
+
+def test_mm_matches_dense_matmul():
+    kx, kw = jax.random.split(jax.random.PRNGKey(1))
+    x = jax.random.normal(kx, (4, 128), jnp.float32)
+    w = jax.random.normal(kw, (128, 64), jnp.float32)
+    want = x @ w
+    got = quant.mm(x, quant.quantize_int8(w))
+    rel = float(jnp.linalg.norm(got - want) / jnp.linalg.norm(want))
+    assert rel < 0.02, rel
+    # dense passthrough unchanged
+    assert jnp.allclose(quant.mm(x, w), want)
+
+
+def test_stacked_layer_weights_quantize():
+    """Scan-stacked [L, in, out] weights: per-(layer, out-channel) scales,
+    and lax.scan over the QTensor pytree slices both leaves."""
+    w = jax.random.normal(jax.random.PRNGKey(2), (3, 64, 32), jnp.float32)
+    qt = quant.quantize_int8(w)
+    assert qt.q.shape == (3, 64, 32) and qt.scale.shape == (3, 32)
+    x = jax.random.normal(jax.random.PRNGKey(3), (2, 64), jnp.float32)
+
+    def body(carry, layer_w):
+        return carry, quant.mm(x, layer_w)
+
+    _, ys = jax.lax.scan(body, 0.0, qt)
+    for i in range(3):
+        want = x @ w[i]
+        rel = float(jnp.linalg.norm(ys[i] - want) / jnp.linalg.norm(want))
+        assert rel < 0.02, (i, rel)
+
+
+def test_quantized_llama_forward_close_and_half_size():
+    cfg = dataclasses.replace(llama.tiny(vocab=128), dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    qparams = quant.quantize_params(params)
+    assert quant.tree_nbytes(qparams) < 0.6 * quant.tree_nbytes(params)
+
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0,
+                                cfg.vocab_size)
+    full = llama.forward(cfg, params, tokens)
+    q = llama.forward(cfg, qparams, tokens)
+    # quantization noise moves logits a little; argmax should mostly agree
+    agree = jnp.mean(
+        (jnp.argmax(full, -1) == jnp.argmax(q, -1)).astype(jnp.float32))
+    assert float(agree) > 0.9, float(agree)
+    rel = float(jnp.linalg.norm(q - full) / jnp.linalg.norm(full))
+    assert rel < 0.1, rel
+
+
+def test_engine_quantized_generation():
+    from kubedl_tpu.serving.engine import GenerateConfig, InferenceEngine
+    cfg = llama.tiny(vocab=128)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    eng = InferenceEngine(cfg, params, GenerateConfig(max_len=64),
+                          quantize="int8")
+    out = eng.generate([[5, 7, 11], [3]], max_new_tokens=4)
+    assert len(out) == 2 and all(len(o) == 4 for o in out)
+    with pytest.raises(ValueError):
+        InferenceEngine(cfg, params, quantize="int4")
+
+
+def test_training_path_untouched_by_quant_import():
+    """quantize_params never runs in training; grads still flow through
+    the dense path (the _mm dispatch is identity for arrays)."""
+    cfg = dataclasses.replace(llama.tiny(vocab=64), dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 16), 0, 64)
+    g = jax.grad(lambda p: llama.loss_fn(cfg, p, tokens[:, :-1],
+                                         tokens[:, 1:]))(params)
+    assert all(bool(jnp.isfinite(x).all())
+               for x in jax.tree_util.tree_leaves(g))
