@@ -214,20 +214,24 @@ def _softcap(config: LlamaConfig, logits):
 
 
 def rope_frequencies(config: LlamaConfig, positions):
-    """[seq] int positions -> (cos, sin) of shape [seq, hd/2], float32."""
+    """[seq] (or [b, seq]) int positions -> (cos, sin) of shape
+    [seq, hd/2] (or [b, seq, hd/2]), float32."""
     hd = config.hd
     inv_freq = 1.0 / (config.rope_theta
                       ** (jnp.arange(0, hd, 2, dtype=jnp.float32) / hd))
-    angles = positions.astype(jnp.float32)[:, None] * inv_freq[None, :]
+    angles = positions.astype(jnp.float32)[..., None] * inv_freq
     return jnp.cos(angles), jnp.sin(angles)
 
 
 def apply_rope(x, cos, sin):
-    """x: [b, s, h, hd]; cos/sin: [s, hd/2] (float32 rotation)."""
+    """x: [b, s, h, hd]; cos/sin: [s, hd/2] shared across the batch or
+    [b, s, hd/2] per-row (continuous batching). Float32 rotation."""
     xf = x.astype(jnp.float32)
     x1, x2 = jnp.split(xf, 2, axis=-1)
-    c = cos[None, :, None, :]
-    s = sin[None, :, None, :]
+    if cos.ndim == 2:
+        c, s = cos[None, :, None, :], sin[None, :, None, :]
+    else:
+        c, s = cos[:, :, None, :], sin[:, :, None, :]
     out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
     return out.astype(x.dtype)
 
@@ -329,29 +333,43 @@ def attention_step(config: LlamaConfig, x, lp, kc, vc, cos, sin, start_pos,
     """Cache-aware attention sublayer (with residual): write this chunk's
     K/V at ``start_pos`` and attend against the whole cache with a position
     mask. Static shapes throughout — the mask, not the shape, encodes how
-    much of the cache is live. ``valid`` [b, max_len] additionally masks
-    cache slots that hold padding (ragged prompt batches). Shared by the
-    dense and MoE decode paths. Returns (x, kc, vc)."""
+    much of the cache is live. ``start_pos`` is a scalar (whole batch at
+    one position) or a [b] vector (continuous batching: every row at its
+    own position). ``valid`` [b, max_len] additionally masks cache slots
+    that hold padding (ragged prompt batches). Shared by the dense and MoE
+    decode paths. Returns (x, kc, vc)."""
     c = config
     b, s, d = x.shape
     nh, nkv, hd = c.n_heads, c.n_kv_heads, c.hd
     max_len = kc.shape[1]
 
+    row_pos = getattr(start_pos, "ndim", 0) == 1   # [b] per-row positions
     h = rms_norm(x, lp["attn_norm"], c.rms_eps, c.norm_weight_offset)
     q = apply_rope(_mm(h, lp["wq"]).reshape(b, s, nh, hd), cos, sin)
     k = apply_rope(_mm(h, lp["wk"]).reshape(b, s, nkv, hd), cos, sin)
     v = _mm(h, lp["wv"]).reshape(b, s, nkv, hd)
-    kc = jax.lax.dynamic_update_slice(kc, k.astype(kc.dtype), (0, start_pos, 0, 0))
-    vc = jax.lax.dynamic_update_slice(vc, v.astype(vc.dtype), (0, start_pos, 0, 0))
+    if row_pos:
+        # continuous batching: every row writes its chunk at its own
+        # position (batched scatter); rows attend up to their own pos
+        rows = jnp.arange(b)[:, None]
+        cols = start_pos[:, None] + jnp.arange(s)[None, :]
+        kc = kc.at[rows, cols].set(k.astype(kc.dtype))
+        vc = vc.at[rows, cols].set(v.astype(vc.dtype))
+        q_pos = cols                                            # [b, s]
+    else:
+        kc = jax.lax.dynamic_update_slice(
+            kc, k.astype(kc.dtype), (0, start_pos, 0, 0))
+        vc = jax.lax.dynamic_update_slice(
+            vc, v.astype(vc.dtype), (0, start_pos, 0, 0))
+        q_pos = (start_pos + jnp.arange(s))[None, :]            # [1, s]
 
     kf = repeat_kv(kc, nh).astype(jnp.float32)
     vf = repeat_kv(vc, nh).astype(jnp.float32)
     qf = q.astype(jnp.float32) * (1.0 / math.sqrt(hd))
     scores = jnp.einsum("bqhd,bkhd->bhqk", qf, kf,
                         preferred_element_type=jnp.float32)
-    q_pos = start_pos + jnp.arange(s)
     k_pos = jnp.arange(max_len)
-    mask = (k_pos[None, :] <= q_pos[:, None])[None, None]  # causal prefix
+    mask = (k_pos[None, None, :] <= q_pos[:, :, None])[:, None]  # causal
     if valid is not None:
         mask = mask & valid[:, None, None, :]
     scores = jnp.where(mask, scores, -1e30)
@@ -372,19 +390,26 @@ def _layer_step(config: LlamaConfig, x, lp, kc, vc, cos, sin, start_pos,
 
 
 def forward_step(config: LlamaConfig, params: dict, tokens, cache: dict,
-                 start_pos, valid=None, layer_body=None):
+                 start_pos, valid=None, layer_body=None,
+                 all_logits: bool = False):
     """Prefill (s = prompt len) or decode (s = 1) step against the KV cache.
-    tokens [b, s] + cache + scalar start_pos -> (last-token logits
-    [b, vocab] float32, updated cache). jit with ``donate_argnums`` on the
-    cache for in-place HBM updates. ``valid`` [b, max_len] marks live cache
-    slots for ragged prompt batches.
+    tokens [b, s] + cache + start_pos -> (last-token logits [b, vocab]
+    float32, updated cache). jit with ``donate_argnums`` on the cache for
+    in-place HBM updates. ``valid`` [b, max_len] marks live cache slots for
+    ragged prompt batches. ``start_pos`` may be a [b] vector for
+    continuous batching (see ``attention_step``). ``all_logits`` returns
+    logits for the whole chunk ([b, s, vocab] — a right-padded prefill
+    gathers its real last position from these).
 
     ``layer_body`` is the pluggable per-layer step — signature of
     ``_layer_step`` — so other families (MoE) reuse this ONE decode driver
     instead of copying it."""
     c = config
     b, s = tokens.shape
-    positions = start_pos + jnp.arange(s, dtype=jnp.int32)
+    if getattr(start_pos, "ndim", 0) == 1:
+        positions = start_pos[:, None] + jnp.arange(s, dtype=jnp.int32)
+    else:
+        positions = start_pos + jnp.arange(s, dtype=jnp.int32)
     cos, sin = rope_frequencies(c, positions)
     x = params["embed"][tokens].astype(c.dtype)
     if c.embed_scale:
@@ -408,6 +433,11 @@ def forward_step(config: LlamaConfig, params: dict, tokens, cache: dict,
             vs.append(vc)
         new_cache = {"k": jnp.stack(ks), "v": jnp.stack(vs)}
 
+    if all_logits:
+        x = rms_norm(x, params["final_norm"], c.rms_eps,
+                     c.norm_weight_offset)
+        logits = _mm(x, _lm_head(c, params)).astype(jnp.float32)
+        return _softcap(c, logits), new_cache
     x = rms_norm(x[:, -1:], params["final_norm"], c.rms_eps,
                  c.norm_weight_offset)
     logits = _mm(x, _lm_head(c, params)).astype(jnp.float32)

@@ -305,14 +305,18 @@ def _decode_layer_body(c, x, lp, kc, vc, cos, sin, start_pos, valid):
                                      start_pos, valid)
     token_mask = None
     if valid is not None:
-        token_mask = jax.lax.dynamic_slice_in_dim(
-            valid, start_pos, x.shape[1], axis=1)
+        if getattr(start_pos, "ndim", 0) == 1:   # per-row positions
+            cols = start_pos[:, None] + jnp.arange(x.shape[1])
+            token_mask = jnp.take_along_axis(valid, cols, axis=1)
+        else:
+            token_mask = jax.lax.dynamic_slice_in_dim(
+                valid, start_pos, x.shape[1], axis=1)
     x, _ = _moe_block(c, x, lp, token_mask=token_mask)
     return x, kc, vc
 
 
 def forward_step(config: MoEConfig, params: dict, tokens, cache: dict,
-                 start_pos, valid=None):
+                 start_pos, valid=None, all_logits: bool = False):
     """Prefill/decode step against the KV cache for the MoE stack — the
     ONE llama decode driver with the MoE layer body plugged in, so the
     serving engine (``kubedl_tpu.serving.engine``) drives either family
@@ -320,7 +324,8 @@ def forward_step(config: MoEConfig, params: dict, tokens, cache: dict,
     top-k experts per token; capacity degenerates to one slot per
     expert."""
     return llama.forward_step(config, params, tokens, cache, start_pos,
-                              valid, layer_body=_decode_layer_body)
+                              valid, layer_body=_decode_layer_body,
+                              all_logits=all_logits)
 
 
 def loss_fn(config: MoEConfig, params: dict, tokens, targets, mask=None,

@@ -38,6 +38,39 @@ class GenerateConfig:
     eos_id: int = -1               # -1 = never stop early
 
 
+def resolve_family(config):
+    """Model family module for a config: every family exposes the same
+    forward_step/init_cache contract (llama/gemma share LlamaConfig;
+    MoEConfig routes through the sparse stack)."""
+    from ..models import moe
+    return moe if isinstance(config, moe.MoEConfig) else llama
+
+
+def maybe_quantize(params: dict, quantize):
+    """Apply a serving quantization mode ('int8' or None) to a param tree."""
+    if quantize == "int8":
+        # weight-only int8: halves weight HBM + bandwidth; decode is
+        # bandwidth-bound so this is the cheap serving speedup
+        from ..ops.quant import quantize_params
+        return quantize_params(params)
+    if quantize:
+        raise ValueError(f"unknown quantize mode {quantize!r}")
+    return params
+
+
+@partial(jax.jit, static_argnums=(2, 3))
+def sample_logits(logits, key, temperature, top_k):
+    """Greedy (temperature<=0) or temperature/top-k sampling — the ONE
+    sampler shared by the static and continuous engines."""
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -1e30, logits)
+    return jax.random.categorical(key, logits).astype(jnp.int32)
+
+
 class InferenceEngine:
     """One loaded model + its compiled prefill/decode steps."""
 
@@ -45,24 +78,11 @@ class InferenceEngine:
                  gen: Optional[GenerateConfig] = None,
                  quantize: Optional[str] = None):
         self.config = config
-        if quantize == "int8":
-            # weight-only int8: halves weight HBM + bandwidth; decode is
-            # bandwidth-bound so this is the cheap serving speedup
-            from ..ops.quant import quantize_params
-            params = quantize_params(params)
-        elif quantize:
-            raise ValueError(f"unknown quantize mode {quantize!r}")
-        self.params = params
+        self.params = maybe_quantize(params, quantize)
         self.gen = gen or GenerateConfig()
 
         model_cfg = self.config
-        # family dispatch: every model family exposes the same
-        # forward_step/init_cache contract (llama/gemma share LlamaConfig;
-        # MoEConfig routes through the sparse stack)
-        from ..models import moe
-        self._family = moe if isinstance(config, moe.MoEConfig) else llama
-
-        family = self._family
+        self._family = family = resolve_family(config)
 
         @partial(jax.jit, donate_argnums=(1,))
         def _step(params, cache, tokens, start_pos, valid):
@@ -70,18 +90,7 @@ class InferenceEngine:
                                        start_pos, valid)
 
         self._step = _step
-
-        @partial(jax.jit, static_argnums=(2, 3))
-        def _sample(logits, key, temperature, top_k):
-            if temperature <= 0.0:
-                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            logits = logits / temperature
-            if top_k > 0:
-                kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
-                logits = jnp.where(logits < kth, -1e30, logits)
-            return jax.random.categorical(key, logits).astype(jnp.int32)
-
-        self._sample = _sample
+        self._sample = sample_logits
 
     # -- public API -------------------------------------------------------
 

@@ -1,0 +1,106 @@
+"""Continuous batching: per-row-position decode numerics + scheduling."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from kubedl_tpu.models import llama, moe
+from kubedl_tpu.serving.batching import ContinuousBatchingEngine
+from kubedl_tpu.serving.engine import GenerateConfig, InferenceEngine
+
+
+@pytest.fixture(scope="module")
+def dense():
+    cfg = dataclasses.replace(llama.tiny(vocab=128), dtype=jnp.float32)
+    return cfg, llama.init_params(cfg, jax.random.PRNGKey(0))
+
+
+def _solo_greedy(cfg, params, prompt, n):
+    """Ground truth: unbatched greedy generation for one prompt."""
+    eng = InferenceEngine(cfg, params, GenerateConfig(max_len=96))
+    return eng.generate([prompt], n)[0]
+
+
+def test_continuous_matches_solo_greedy(dense):
+    """Each request in a continuously-batched run must reproduce its
+    unbatched greedy generation exactly (fp32): per-row positions + RoPE
+    relativity make co-batching invisible to the math."""
+    cfg, params = dense
+    requests = [([5, 7, 11], 6), ([3], 4), ([2, 4, 6, 8, 10, 12, 14], 5)]
+    eng = ContinuousBatchingEngine(cfg, params, lanes=2, max_len=96)
+    got = eng.run(requests)
+    for (prompt, n), toks in zip(requests, got):
+        assert toks == _solo_greedy(cfg, params, prompt, n), prompt
+
+
+def test_lane_reuse_more_requests_than_lanes(dense):
+    cfg, params = dense
+    requests = [([i + 1, i + 2], 3 + i % 3) for i in range(7)]
+    eng = ContinuousBatchingEngine(cfg, params, lanes=2, max_len=64)
+    got = eng.run(requests)
+    assert len(got) == 7
+    for (prompt, n), toks in zip(requests, got):
+        assert len(toks) == n
+        assert toks == _solo_greedy(cfg, params, prompt, n), prompt
+
+
+def test_eos_frees_lane_early(dense):
+    cfg, params = dense
+    # find what the model emits first for a probe prompt, use it as eos
+    first = _solo_greedy(cfg, params, [9, 9], 1)[0]
+    eng = ContinuousBatchingEngine(
+        cfg, params, lanes=1, max_len=64,
+        gen=GenerateConfig(max_len=64, eos_id=first))
+    got = eng.run([([9, 9], 8), ([1, 2], 2)])
+    assert got[0] == [first]          # stopped at eos immediately
+    assert len(got[1]) <= 2 and got[1]
+
+
+def test_capacity_guard(dense):
+    cfg, params = dense
+    eng = ContinuousBatchingEngine(cfg, params, lanes=1, max_len=32)
+    with pytest.raises(ValueError):
+        eng.run([([1] * 30, 8)])
+
+
+def test_moe_family_continuous(dense):
+    mcfg = dataclasses.replace(moe.tiny(vocab=128), dtype=jnp.float32,
+                               capacity_factor=4.0)
+    mparams = moe.init_params(mcfg, jax.random.PRNGKey(0))
+    eng = ContinuousBatchingEngine(mcfg, mparams, lanes=2, max_len=64)
+    got = eng.run([([5, 6], 4), ([7], 3)])
+    assert [len(t) for t in got] == [4, 3]
+    solo = InferenceEngine(mcfg, mparams, GenerateConfig(max_len=64))
+    assert got[0] == solo.generate([[5, 6]], 4)[0]
+
+
+def test_moe_prefill_pads_do_not_consume_capacity():
+    """With the prefill valid mask, right-pad bucket tokens must not eat
+    expert capacity: a short prompt's output at default capacity matches
+    the ample-capacity run (without the mask, ~14 pads would displace the
+    2 real tokens' experts)."""
+    outs = []
+    for cf in (1.25, 8.0):
+        mcfg = dataclasses.replace(moe.tiny(vocab=128), dtype=jnp.float32,
+                                   capacity_factor=cf)
+        mparams = moe.init_params(mcfg, jax.random.PRNGKey(0))
+        eng = ContinuousBatchingEngine(mcfg, mparams, lanes=1, max_len=64)
+        outs.append(eng.run([([5, 9], 4)])[0])
+    assert outs[0] == outs[1], outs
+
+
+def test_zero_budget_request_returns_empty(dense):
+    cfg, params = dense
+    eng = ContinuousBatchingEngine(cfg, params, lanes=1, max_len=64)
+    got = eng.run([([1, 2], 0), ([3], 2)])
+    assert got[0] == [] and len(got[1]) == 2
+
+
+def test_quantized_continuous(dense):
+    cfg, params = dense
+    eng = ContinuousBatchingEngine(cfg, params, lanes=2, max_len=64,
+                                   quantize="int8")
+    got = eng.run([([5, 7, 11], 4), ([3], 3)])
+    assert [len(t) for t in got] == [4, 3]
