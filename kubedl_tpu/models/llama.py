@@ -390,16 +390,16 @@ def _layer_step(config: LlamaConfig, x, lp, kc, vc, cos, sin, start_pos,
 
 
 def forward_step(config: LlamaConfig, params: dict, tokens, cache: dict,
-                 start_pos, valid=None, layer_body=None,
-                 all_logits: bool = False):
+                 start_pos, valid=None, layer_body=None, last_pos=None):
     """Prefill (s = prompt len) or decode (s = 1) step against the KV cache.
     tokens [b, s] + cache + start_pos -> (last-token logits [b, vocab]
     float32, updated cache). jit with ``donate_argnums`` on the cache for
     in-place HBM updates. ``valid`` [b, max_len] marks live cache slots for
     ragged prompt batches. ``start_pos`` may be a [b] vector for
-    continuous batching (see ``attention_step``). ``all_logits`` returns
-    logits for the whole chunk ([b, s, vocab] — a right-padded prefill
-    gathers its real last position from these).
+    continuous batching (see ``attention_step``). ``last_pos`` (traced
+    scalar) projects the logits at that chunk index instead of the chunk's
+    final one — a right-padded prefill reads its real last token without
+    paying the LM head over the whole bucket.
 
     ``layer_body`` is the pluggable per-layer step — signature of
     ``_layer_step`` — so other families (MoE) reuse this ONE decode driver
@@ -433,13 +433,11 @@ def forward_step(config: LlamaConfig, params: dict, tokens, cache: dict,
             vs.append(vc)
         new_cache = {"k": jnp.stack(ks), "v": jnp.stack(vs)}
 
-    if all_logits:
-        x = rms_norm(x, params["final_norm"], c.rms_eps,
-                     c.norm_weight_offset)
-        logits = _mm(x, _lm_head(c, params)).astype(jnp.float32)
-        return _softcap(c, logits), new_cache
-    x = rms_norm(x[:, -1:], params["final_norm"], c.rms_eps,
-                 c.norm_weight_offset)
+    if last_pos is not None:
+        x = jax.lax.dynamic_slice_in_dim(x, last_pos, 1, axis=1)
+    else:
+        x = x[:, -1:]
+    x = rms_norm(x, params["final_norm"], c.rms_eps, c.norm_weight_offset)
     logits = _mm(x, _lm_head(c, params)).astype(jnp.float32)
     return _softcap(c, logits)[:, 0], new_cache
 

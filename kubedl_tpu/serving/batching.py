@@ -26,7 +26,9 @@ reference analog — TPU-native capability beyond parity.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
+import threading
+from collections import deque
+from dataclasses import dataclass, field
 from functools import partial
 from typing import Optional, Sequence
 
@@ -46,11 +48,28 @@ def _bucket(n: int, lo: int = 16) -> int:
 
 
 @dataclass
+class Request:
+    """One in-flight generation; ``done`` fires when ``tokens`` is final
+    (or the engine stopped — then ``cancelled`` is set)."""
+    prompt: list
+    max_new: int
+    tokens: list = field(default_factory=list)
+    done: threading.Event = field(default_factory=threading.Event)
+    cancelled: bool = False
+
+    def result(self, timeout: Optional[float] = None) -> list:
+        if not self.done.wait(timeout):
+            raise TimeoutError("generation did not finish in time")
+        if self.cancelled:
+            raise RuntimeError("generation cancelled: engine stopped")
+        return self.tokens
+
+
+@dataclass
 class _Lane:
-    request: int = -1          # index into the submit order; -1 = free
+    request: Optional[Request] = None    # None = free
     pos: int = 0               # next write position (== tokens so far)
     remaining: int = 0
-    done_reason: str = ""
 
 
 class ContinuousBatchingEngine:
@@ -64,7 +83,7 @@ class ContinuousBatchingEngine:
     def __init__(self, config: llama.LlamaConfig, params: dict,
                  lanes: int = 4, max_len: int = 1024,
                  gen: Optional[GenerateConfig] = None,
-                 quantize: Optional[str] = None):
+                 quantize: Optional[str] = None, seed: int = 0):
         from .engine import maybe_quantize, resolve_family, sample_logits
         self.config = config
         self.family = family = resolve_family(config)
@@ -84,18 +103,18 @@ class ContinuousBatchingEngine:
         def _prefill(params, cache, tokens, lane, plen):
             # tokens [1, bucket] right-padded; lane and plen are TRACED so
             # only the bucket size (a handful of power-of-two shapes)
-            # triggers a compile. Returns the real last token's logits.
-            # valid marks the real prompt region: attention never sees the
-            # right-pad anyway (causal + overwrite-before-attend), but MoE
-            # ROUTING must not let pad tokens consume expert capacity.
+            # triggers a compile. Returns the real last token's logits
+            # (last_pos gathers it pre-LM-head: one vocab projection, not
+            # bucket of them). valid marks the real prompt region:
+            # attention never sees the right-pad anyway (causal +
+            # overwrite-before-attend), but MoE ROUTING must not let pad
+            # tokens consume expert capacity.
             row = {k: jax.lax.dynamic_slice_in_dim(v, lane, 1, axis=1)
                    for k, v in cache.items()}
             valid = (jnp.arange(row["k"].shape[2]) < plen)[None, :]
-            logits, row = family.forward_step(cfg, params, tokens, row,
-                                              jnp.int32(0), valid=valid,
-                                              all_logits=True)
-            last = jax.lax.dynamic_slice_in_dim(logits, plen - 1, 1,
-                                                axis=1)[:, 0]
+            last, row = family.forward_step(cfg, params, tokens, row,
+                                            jnp.int32(0), valid=valid,
+                                            last_pos=plen - 1)
             cache = {k: jax.lax.dynamic_update_slice_in_dim(
                 cache[k], row[k], lane, axis=1) for k in cache}
             return last, cache
@@ -104,81 +123,165 @@ class ContinuousBatchingEngine:
         self._prefill = _prefill
         self._sample = sample_logits
 
+        # live scheduler state: one shared cache + lane bookkeeping; the
+        # host mirrors (cur/pos) feed the per-tick decode call
+        self._cache = family.init_cache(config, lanes, max_len)
+        self._lane_state = [_Lane() for _ in range(lanes)]
+        self._cur = np.zeros((lanes, 1), np.int32)
+        self._pos = np.zeros((lanes,), np.int32)
+        self._key = jax.random.PRNGKey(seed)
+        self._queue: deque[Request] = deque()
+        self._cv = threading.Condition()
+        #: serializes the whole scheduler step (donated cache + lane
+        #: bookkeeping are shared mutable state): inline run() callers and
+        #: the background loop can never tick concurrently
+        self._sched_lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._stopped = False
+
+    # -- public API -------------------------------------------------------
+
+    def _validate(self, prompt: Sequence[int], max_new: int) -> None:
+        plen = max(len(prompt), 1)
+        if plen + max_new > self.max_len:
+            raise ValueError(
+                f"prompt {plen} + new {max_new} exceeds cache capacity "
+                f"{self.max_len}")
+
+    def submit(self, prompt: Sequence[int], max_new: int) -> Request:
+        """Enqueue one generation; returns a Request whose ``result()``
+        blocks until finished. Thread-safe."""
+        self._validate(prompt, max_new)
+        req = Request(prompt=list(prompt), max_new=max_new)
+        if max_new <= 0:
+            req.done.set()         # nothing requested: empty output
+            return req
+        with self._cv:
+            if self._stopped:
+                raise RuntimeError("engine stopped")
+            self._queue.append(req)
+            self._cv.notify()
+        return req
+
+    def run(self, requests: Sequence[tuple], seed: Optional[int] = None) -> list:
+        """requests: [(prompt_token_list, max_new_tokens), ...] in arrival
+        order. Returns one generated-id list per request. Inline when no
+        background loop is running; otherwise defers to it."""
+        # validate everything up front: a bad late request must not strand
+        # earlier ones in the queue
+        for prompt, max_new in requests:
+            self._validate(prompt, max_new)
+        if seed is not None:
+            self._key = jax.random.PRNGKey(seed)
+        reqs = [self.submit(p, n) for p, n in requests]
+        if self._thread is None:
+            with self._sched_lock:
+                while self._step_once():
+                    pass
+        return [r.result() for r in reqs]
+
+    def start(self) -> "ContinuousBatchingEngine":
+        """Run the scheduler on a background thread (HTTP serving mode)."""
+        def loop():
+            while True:
+                with self._cv:
+                    while (not self._stopped and not self._queue
+                           and not self._active()):
+                        self._cv.wait()
+                    if self._stopped:
+                        return
+                with self._sched_lock:
+                    self._step_once()
+
+        self._thread = threading.Thread(target=loop, name="kubedl-batching",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        """Stop the background loop; queued and in-flight requests are
+        cancelled (their waiters unblock with a RuntimeError)."""
+        with self._cv:
+            self._stopped = True
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        with self._sched_lock:
+            abandoned = list(self._queue)
+            self._queue.clear()
+            for lane in self._lane_state:
+                if lane.request is not None:
+                    abandoned.append(lane.request)
+                    lane.request = None
+            for req in abandoned:
+                req.cancelled = True
+                req.done.set()
+
     # -- scheduler --------------------------------------------------------
 
-    def run(self, requests: Sequence[tuple], seed: int = 0) -> list:
-        """requests: [(prompt_token_list, max_new_tokens), ...] in arrival
-        order. Returns one generated-id list per request."""
+    def _active(self) -> bool:
+        return any(l.request is not None for l in self._lane_state)
+
+    def _admit(self, lane_idx: int) -> None:
         gen = self.gen
-        cache = self.family.init_cache(self.config, self.lanes, self.max_len)
-        lanes = [_Lane() for _ in range(self.lanes)]
-        out: list[list[int]] = [[] for _ in requests]
-        queue = list(range(len(requests)))
-        key = jax.random.PRNGKey(seed)
-        # host mirrors of the device-side decode inputs
-        cur = np.zeros((self.lanes, 1), np.int32)
-        pos = np.zeros((self.lanes,), np.int32)
+        with self._cv:
+            if not self._queue:
+                return
+            req = self._queue.popleft()
+        prompt = req.prompt or [0]
+        plen = len(prompt)
+        bucket = min(_bucket(plen), self.max_len)
+        toks = np.zeros((1, bucket), np.int32)
+        toks[0, :plen] = prompt
+        logits, self._cache = self._prefill(self.params, self._cache,
+                                            jnp.asarray(toks),
+                                            jnp.int32(lane_idx),
+                                            jnp.int32(plen))
+        self._key, sub = jax.random.split(self._key)
+        first = int(self._sample(logits, sub, gen.temperature,
+                                 gen.top_k)[0])
+        req.tokens.append(first)
+        lane = self._lane_state[lane_idx]
+        lane.request, lane.pos = req, plen
+        lane.remaining = req.max_new - 1
+        self._cur[lane_idx, 0] = first
+        self._pos[lane_idx] = plen
+        if (lane.remaining <= 0
+                or (gen.eos_id >= 0 and first == gen.eos_id)):
+            lane.request = None    # finished in prefill
+            req.done.set()
 
-        def admit(lane_idx: int, cache):
-            req = queue.pop(0)
-            prompt, max_new = requests[req]
-            if max_new <= 0:
-                return cache       # nothing requested: empty output
-            prompt = list(prompt) or [0]
-            plen = len(prompt)
-            if plen + max_new > self.max_len:
-                raise ValueError(
-                    f"request {req}: prompt {plen} + new {max_new} exceeds "
-                    f"cache capacity {self.max_len}")
-            bucket = min(_bucket(plen), self.max_len)
-            toks = np.zeros((1, bucket), np.int32)
-            toks[0, :plen] = prompt
-            logits, cache = self._prefill(self.params, cache,
-                                          jnp.asarray(toks),
-                                          jnp.int32(lane_idx),
-                                          jnp.int32(plen))
-            nonlocal key
-            key, sub = jax.random.split(key)
-            first = int(self._sample(logits, sub, gen.temperature,
-                                     gen.top_k)[0])
-            out[req].append(first)
-            lane = lanes[lane_idx]
-            lane.request, lane.pos = req, plen
-            lane.remaining = max_new - 1
-            cur[lane_idx, 0] = first
-            pos[lane_idx] = plen
-            if (lane.remaining <= 0
-                    or (gen.eos_id >= 0 and first == gen.eos_id)):
-                lane.request = -1      # finished in prefill
-            return cache
-
-        while queue or any(l.request >= 0 for l in lanes):
-            # fill free lanes from the arrival queue
-            for i, lane in enumerate(lanes):
-                while queue and lane.request < 0:
-                    cache = admit(i, cache)
-                    lane = lanes[i]
-                if not queue:
-                    break
-            if not any(l.request >= 0 for l in lanes):
+    def _step_once(self) -> bool:
+        """Fill free lanes, run one decode tick. Returns False once idle."""
+        gen = self.gen
+        for i, lane in enumerate(self._lane_state):
+            while self._queue and lane.request is None:
+                self._admit(i)
+            if not self._queue:
+                break
+        if not self._active():
+            return bool(self._queue)
+        # one decode tick for every lane (dead lanes compute garbage)
+        logits, self._cache = self._decode(
+            self.params, self._cache, jnp.asarray(self._cur),
+            jnp.asarray(self._pos))
+        self._key, sub = jax.random.split(self._key)
+        nxt = np.asarray(self._sample(logits, sub, gen.temperature,
+                                      gen.top_k))
+        for i, lane in enumerate(self._lane_state):
+            req = lane.request
+            if req is None:
                 continue
-            # one decode tick for every lane (dead lanes compute garbage)
-            logits, cache = self._decode(self.params, cache,
-                                         jnp.asarray(cur), jnp.asarray(pos))
-            key, sub = jax.random.split(key)
-            nxt = np.asarray(self._sample(logits, sub, gen.temperature,
-                                          gen.top_k))
-            for i, lane in enumerate(lanes):
-                if lane.request < 0:
-                    continue
-                tok = int(nxt[i])
-                out[lane.request].append(tok)
-                lane.pos += 1
-                lane.remaining -= 1
-                cur[i, 0] = tok
-                pos[i] = lane.pos
-                if (lane.remaining <= 0
-                        or (gen.eos_id >= 0 and tok == gen.eos_id)
-                        or lane.pos + 1 >= self.max_len):
-                    lane.request = -1   # lane freed for the next arrival
-        return out
+            tok = int(nxt[i])
+            req.tokens.append(tok)
+            lane.pos += 1
+            lane.remaining -= 1
+            self._cur[i, 0] = tok
+            self._pos[i] = lane.pos
+            if (lane.remaining <= 0
+                    or (gen.eos_id >= 0 and tok == gen.eos_id)
+                    or lane.pos + 1 >= self.max_len):
+                lane.request = None   # lane freed for the next arrival
+                req.done.set()
+        return True

@@ -32,6 +32,9 @@ class ServerConfig:
     port: int = 8501               # TFServing's REST port
     max_batch: int = 16
     max_new_tokens: int = 256
+    #: continuous-batching mode: bound on one request's wall time so a
+    #: stopped/never-started engine surfaces as a JSON 500, not a hang
+    request_timeout_s: float = 600.0
 
 
 class InferenceServer:
@@ -90,7 +93,17 @@ class InferenceServer:
             prompts.append([int(t) for t in toks])
             caps.append(min(int(inst.get("max_tokens", 16)),
                             self.config.max_new_tokens))
-        # decode to the longest request, trim per instance to its own cap
+        if hasattr(self.engine, "submit"):
+            # continuous-batching engine: each instance rides its own lane
+            # (its background loop serializes device work — no lock), so a
+            # short request is never held back to the longest one's length
+            reqs = [self.engine.submit(p, cap)
+                    for p, cap in zip(prompts, caps)]
+            timeout = self.config.request_timeout_s
+            return {"predictions": [{"tokens": r.result(timeout=timeout)}
+                                    for r in reqs]}
+        # static engine: decode to the longest request in one lockstep
+        # batch, trim per instance to its own cap
         with self._gen_lock:
             outs = self.engine.generate(prompts, max(caps))
         return {"predictions": [{"tokens": o[:cap]}

@@ -98,6 +98,94 @@ def test_zero_budget_request_returns_empty(dense):
     assert got[0] == [] and len(got[1]) == 2
 
 
+def test_threaded_submit_from_many_clients(dense):
+    """Background-loop mode: concurrent submitters each get the exact
+    unbatched greedy continuation for their own prompt."""
+    import threading
+
+    cfg, params = dense
+    eng = ContinuousBatchingEngine(cfg, params, lanes=2, max_len=96).start()
+    try:
+        prompts = [[5, 7, 11], [3], [2, 4, 6, 8], [9, 1]]
+        results = [None] * len(prompts)
+
+        def client(i):
+            results[i] = eng.submit(prompts[i], 4).result(timeout=120)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(len(prompts))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        for p, toks in zip(prompts, results):
+            assert toks == _solo_greedy(cfg, params, p, 4), p
+    finally:
+        eng.stop()
+
+
+def test_http_server_with_continuous_engine(dense):
+    """The predictor HTTP server rides the continuous engine: instances in
+    one request get their own lanes, each trimmed to its own budget."""
+    import json
+    import urllib.request
+
+    from kubedl_tpu.serving.server import InferenceServer, ServerConfig
+
+    cfg, params = dense
+    eng = ContinuousBatchingEngine(cfg, params, lanes=2, max_len=96).start()
+    server = InferenceServer(eng, ServerConfig(
+        model_name="m", host="127.0.0.1", port=0)).start()
+    try:
+        req = urllib.request.Request(
+            server.url + "/v1/models/m:predict", method="POST",
+            data=json.dumps({"instances": [
+                {"prompt_tokens": [5, 7, 11], "max_tokens": 6},
+                {"prompt_tokens": [3], "max_tokens": 2},
+            ]}).encode(), headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as r:
+            preds = json.load(r)["predictions"]
+        assert [len(p["tokens"]) for p in preds] == [6, 2]
+        assert preds[0]["tokens"] == _solo_greedy(cfg, params, [5, 7, 11], 6)
+    finally:
+        server.stop()
+        eng.stop()
+
+
+def test_stop_cancels_waiters(dense):
+    """stop() must unblock queued waiters with an error, never hang them."""
+    import threading
+
+    cfg, params = dense
+    eng = ContinuousBatchingEngine(cfg, params, lanes=1, max_len=64)
+    # no loop started: the request just sits in the queue
+    req = eng.submit([1, 2], 4)
+    errs = []
+
+    def waiter():
+        try:
+            req.result(timeout=30)
+        except RuntimeError as e:
+            errs.append(str(e))
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    eng.stop()
+    t.join(timeout=30)
+    assert not t.is_alive()
+    assert errs and "cancelled" in errs[0]
+    with pytest.raises(RuntimeError):
+        eng.submit([1], 2)  # stopped engine refuses new work
+
+
+def test_run_validates_all_before_enqueueing(dense):
+    cfg, params = dense
+    eng = ContinuousBatchingEngine(cfg, params, lanes=1, max_len=32)
+    with pytest.raises(ValueError):
+        eng.run([([1, 2, 3], 5), ([1] * 30, 8)])
+    assert not eng._queue  # nothing stranded
+
+
 def test_quantized_continuous(dense):
     cfg, params = dense
     eng = ContinuousBatchingEngine(cfg, params, lanes=2, max_len=64,
