@@ -390,7 +390,8 @@ def _layer_step(config: LlamaConfig, x, lp, kc, vc, cos, sin, start_pos,
 
 
 def forward_step(config: LlamaConfig, params: dict, tokens, cache: dict,
-                 start_pos, valid=None, layer_body=None, last_pos=None):
+                 start_pos, valid=None, layer_body=None, last_pos=None,
+                 all_logits: bool = False):
     """Prefill (s = prompt len) or decode (s = 1) step against the KV cache.
     tokens [b, s] + cache + start_pos -> (last-token logits [b, vocab]
     float32, updated cache). jit with ``donate_argnums`` on the cache for
@@ -399,7 +400,9 @@ def forward_step(config: LlamaConfig, params: dict, tokens, cache: dict,
     continuous batching (see ``attention_step``). ``last_pos`` (traced
     scalar) projects the logits at that chunk index instead of the chunk's
     final one — a right-padded prefill reads its real last token without
-    paying the LM head over the whole bucket.
+    paying the LM head over the whole bucket. ``all_logits`` returns the
+    whole chunk's logits [b, s, vocab] (speculative verify needs every
+    drafted position; keep s small).
 
     ``layer_body`` is the pluggable per-layer step — signature of
     ``_layer_step`` — so other families (MoE) reuse this ONE decode driver
@@ -433,6 +436,11 @@ def forward_step(config: LlamaConfig, params: dict, tokens, cache: dict,
             vs.append(vc)
         new_cache = {"k": jnp.stack(ks), "v": jnp.stack(vs)}
 
+    if all_logits:
+        x = rms_norm(x, params["final_norm"], c.rms_eps,
+                     c.norm_weight_offset)
+        logits = _mm(x, _lm_head(c, params)).astype(jnp.float32)
+        return _softcap(c, logits), new_cache
     if last_pos is not None:
         x = jax.lax.dynamic_slice_in_dim(x, last_pos, 1, axis=1)
     else:

@@ -316,7 +316,8 @@ def _decode_layer_body(c, x, lp, kc, vc, cos, sin, start_pos, valid):
 
 
 def forward_step(config: MoEConfig, params: dict, tokens, cache: dict,
-                 start_pos, valid=None, last_pos=None):
+                 start_pos, valid=None, last_pos=None,
+                 all_logits: bool = False):
     """Prefill/decode step against the KV cache for the MoE stack — the
     ONE llama decode driver with the MoE layer body plugged in, so the
     serving engine (``kubedl_tpu.serving.engine``) drives either family
@@ -325,7 +326,7 @@ def forward_step(config: MoEConfig, params: dict, tokens, cache: dict,
     expert."""
     return llama.forward_step(config, params, tokens, cache, start_pos,
                               valid, layer_body=_decode_layer_body,
-                              last_pos=last_pos)
+                              last_pos=last_pos, all_logits=all_logits)
 
 
 def loss_fn(config: MoEConfig, params: dict, tokens, targets, mask=None,

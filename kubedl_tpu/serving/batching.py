@@ -141,7 +141,10 @@ class ContinuousBatchingEngine:
 
     # -- public API -------------------------------------------------------
 
-    def _validate(self, prompt: Sequence[int], max_new: int) -> None:
+    def validate(self, prompt: Sequence[int], max_new: int) -> None:
+        """Raise ValueError if the request can never fit the cache —
+        callers batching several submits should validate ALL of them
+        first so a bad late request doesn't strand earlier ones."""
         plen = max(len(prompt), 1)
         if plen + max_new > self.max_len:
             raise ValueError(
@@ -151,7 +154,7 @@ class ContinuousBatchingEngine:
     def submit(self, prompt: Sequence[int], max_new: int) -> Request:
         """Enqueue one generation; returns a Request whose ``result()``
         blocks until finished. Thread-safe."""
-        self._validate(prompt, max_new)
+        self.validate(prompt, max_new)
         req = Request(prompt=list(prompt), max_new=max_new)
         if max_new <= 0:
             req.done.set()         # nothing requested: empty output
@@ -170,9 +173,14 @@ class ContinuousBatchingEngine:
         # validate everything up front: a bad late request must not strand
         # earlier ones in the queue
         for prompt, max_new in requests:
-            self._validate(prompt, max_new)
+            self.validate(prompt, max_new)
         if seed is not None:
-            self._key = jax.random.PRNGKey(seed)
+            if self._thread is not None:
+                raise ValueError(
+                    "cannot reseed a running engine (other clients share "
+                    "the sampling stream)")
+            with self._sched_lock:
+                self._key = jax.random.PRNGKey(seed)
         reqs = [self.submit(p, n) for p, n in requests]
         if self._thread is None:
             with self._sched_lock:
@@ -183,6 +191,7 @@ class ContinuousBatchingEngine:
     def start(self) -> "ContinuousBatchingEngine":
         """Run the scheduler on a background thread (HTTP serving mode)."""
         def loop():
+            import logging
             while True:
                 with self._cv:
                     while (not self._stopped and not self._queue
@@ -190,8 +199,17 @@ class ContinuousBatchingEngine:
                         self._cv.wait()
                     if self._stopped:
                         return
-                with self._sched_lock:
-                    self._step_once()
+                try:
+                    with self._sched_lock:
+                        self._step_once()
+                except Exception:  # noqa: BLE001 — a dead loop must not
+                    # strand waiters: fail every request and stop accepting
+                    logging.getLogger("kubedl_tpu.serving").exception(
+                        "batching scheduler failed; cancelling requests")
+                    with self._cv:
+                        self._stopped = True
+                    self._cancel_all()
+                    return
 
         self._thread = threading.Thread(target=loop, name="kubedl-batching",
                                         daemon=True)
@@ -207,6 +225,9 @@ class ContinuousBatchingEngine:
         if self._thread is not None:
             self._thread.join(timeout=10)
             self._thread = None
+        self._cancel_all()
+
+    def _cancel_all(self) -> None:
         with self._sched_lock:
             abandoned = list(self._queue)
             self._queue.clear()

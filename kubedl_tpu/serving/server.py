@@ -96,7 +96,11 @@ class InferenceServer:
         if hasattr(self.engine, "submit"):
             # continuous-batching engine: each instance rides its own lane
             # (its background loop serializes device work — no lock), so a
-            # short request is never held back to the longest one's length
+            # short request is never held back to the longest one's length.
+            # Validate ALL instances before submitting any — a bad late
+            # instance must 400 without burning lanes on discarded output.
+            for p, cap in zip(prompts, caps):
+                self.engine.validate(p, cap)
             reqs = [self.engine.submit(p, cap)
                     for p, cap in zip(prompts, caps)]
             timeout = self.config.request_timeout_s

@@ -1,0 +1,77 @@
+"""Speculative decoding: greedy output identity + acceptance accounting."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from kubedl_tpu.models import llama
+from kubedl_tpu.serving.engine import GenerateConfig, InferenceEngine
+from kubedl_tpu.serving.speculative import SpecStats, SpeculativeEngine
+
+
+@pytest.fixture(scope="module")
+def models():
+    tcfg = dataclasses.replace(llama.tiny(vocab=128), dtype=jnp.float32)
+    tparams = llama.init_params(tcfg, jax.random.PRNGKey(0))
+    dcfg = dataclasses.replace(
+        llama.tiny(vocab=128), d_model=64, n_layers=1, n_heads=2,
+        n_kv_heads=2, d_ff=128, dtype=jnp.float32)
+    dparams = llama.init_params(dcfg, jax.random.PRNGKey(1))
+    return tcfg, tparams, dcfg, dparams
+
+
+def _plain_greedy(tcfg, tparams, prompt, n):
+    eng = InferenceEngine(tcfg, tparams, GenerateConfig(max_len=128))
+    return eng.generate([prompt], n)[0]
+
+
+def test_output_identical_to_plain_greedy(models):
+    """The defining property: speculative greedy == plain greedy, token
+    for token, regardless of how good the draft is."""
+    tcfg, tparams, dcfg, dparams = models
+    spec = SpeculativeEngine(tcfg, tparams, dcfg, dparams, k=4, max_len=128)
+    for prompt in ([5, 7, 11], [3], [2, 4, 6, 8, 10, 12]):
+        want = _plain_greedy(tcfg, tparams, prompt, 12)
+        got = spec.generate(prompt, 12)
+        assert got == want, (prompt, got, want)
+
+
+def test_self_draft_accepts_everything(models):
+    """Draft == target: every proposal must be accepted (k+1 tokens per
+    target pass) and the output still matches plain greedy."""
+    tcfg, tparams, _, _ = models
+    spec = SpeculativeEngine(tcfg, tparams, tcfg, tparams, k=3, max_len=128)
+    stats = SpecStats()
+    got = spec.generate([5, 7, 11], 10, stats=stats)
+    assert got == _plain_greedy(tcfg, tparams, [5, 7, 11], 10)
+    assert stats.proposed > 0
+    assert stats.acceptance_rate == 1.0
+
+
+def test_stats_and_vocab_guard(models):
+    tcfg, tparams, dcfg, dparams = models
+    stats = SpecStats()
+    spec = SpeculativeEngine(tcfg, tparams, dcfg, dparams, k=4, max_len=128)
+    spec.generate([9, 1], 8, stats=stats)
+    assert 0.0 <= stats.acceptance_rate <= 1.0
+    bad = dataclasses.replace(dcfg, vocab_size=64)
+    with pytest.raises(ValueError):
+        SpeculativeEngine(tcfg, tparams, bad,
+                          llama.init_params(bad, jax.random.PRNGKey(2)))
+
+
+def test_int8_draft(models):
+    tcfg, tparams, dcfg, dparams = models
+    spec = SpeculativeEngine(tcfg, tparams, dcfg, dparams, k=4, max_len=128,
+                             quantize_draft="int8")
+    got = spec.generate([5, 7, 11], 8)
+    assert got == _plain_greedy(tcfg, tparams, [5, 7, 11], 8)
+
+
+def test_capacity_guard(models):
+    tcfg, tparams, dcfg, dparams = models
+    spec = SpeculativeEngine(tcfg, tparams, dcfg, dparams, max_len=32)
+    with pytest.raises(ValueError):
+        spec.generate([1] * 30, 8)
