@@ -27,6 +27,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 _NEG_INF = -1e30
 
@@ -163,14 +164,30 @@ def _kv_upper(q_block_idx, block_q: int, block_k: int, num_kb: int,
         num_kb, ((q_block_idx + 1) * block_q + block_k - 1) // block_k)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q, block_k,
-                  sk, causal):
+def _seg_keep(seg_q_ref, seg_k_ref, j, block_k: int):
+    """[block_q, block_k] same-segment mask for k block ``j`` (packed
+    sequences: tokens attend only within their own segment)."""
+    import jax.experimental.pallas as pl
+
+    sq_ids = seg_q_ref[0]                                   # [block_q]
+    sk_ids = seg_k_ref[0, pl.ds(j * block_k, block_k)]      # [block_k]
+    return sq_ids[:, None] == sk_ids[None, :]
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, *rest, block_q, block_k,
+                  sk, causal, has_seg):
     """One (batch*head, q-block) program; K/V blocks streamed via fori_loop.
     Block shapes carry a leading singleton (batch*head) dim: q [1, block_q,
-    hd], k/v [1, sk, hd], o [1, block_q, hd]. Also writes the per-row
+    hd], k/v [1, sk, hd], o [1, block_q, hd]. With ``has_seg`` two extra
+    int refs (seg_q [1, block_q], seg_k [1, sk]) restrict attention to
+    same-segment pairs (packed sequences). Also writes the per-row
     logsumexp (scaled-score space) consumed by the backward kernels."""
     import jax.experimental.pallas as pl  # local to keep CPU import cheap
 
+    if has_seg:
+        seg_q_ref, seg_k_ref, o_ref, lse_ref = rest
+    else:
+        o_ref, lse_ref = rest
     q_block_idx = pl.program_id(1)
     hd = q_ref.shape[-1]
     scale = 1.0 / math.sqrt(hd)
@@ -185,9 +202,14 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q, block_k,
         scores = jax.lax.dot_general(
             q, kj, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)              # [bq, bk]
+        keep = None
         if causal:
             keep = _causal_keep(block_q, block_k,
                                 q_block_idx * block_q, j * block_k)
+        if has_seg:
+            seg = _seg_keep(seg_q_ref, seg_k_ref, j, block_k)
+            keep = seg if keep is None else keep & seg
+        if keep is not None:
             scores = jnp.where(keep, scores, _NEG_INF)
         new_max = jnp.maximum(row_max, scores.max(axis=-1, keepdims=True))
         alpha = jnp.exp(row_max - new_max)
@@ -217,9 +239,10 @@ def _kv_index(i, nh: int, nkv: int):
     return (i // nh) * nkv + (i % nh) // reps
 
 
-def _flash_forward(q, k, v, causal, block_q=128, block_k=128,
-                   interpret=False):
-    """q [b, sq, nh, hd]; k/v [b, sk, nkv, hd] (kv-head space, GQA-native).
+def _flash_forward(q, k, v, causal, segment_ids=None, block_q=128,
+                   block_k=128, interpret=False):
+    """q [b, sq, nh, hd]; k/v [b, sk, nkv, hd] (kv-head space, GQA-native);
+    segment_ids [b, s] (optional packed-sequence ids; sq == sk then).
     Returns (out [b, sq, nh, hd], lse [b*nh, sq] float32)."""
     import jax.experimental.pallas as pl
 
@@ -229,17 +252,30 @@ def _flash_forward(q, k, v, causal, block_q=128, block_k=128,
     kh = jnp.swapaxes(k, 1, 2).reshape(b * nkv, sk, hd)
     vh = jnp.swapaxes(v, 1, 2).reshape(b * nkv, sk, hd)
     kv_of = functools.partial(_kv_index, nh=nh, nkv=nkv)
+    has_seg = segment_ids is not None
+
+    in_specs = [
+        pl.BlockSpec((1, block_q, hd), lambda i, j: (i, j, 0)),
+        pl.BlockSpec((1, sk, hd), lambda i, j: (kv_of(i), 0, 0)),
+        pl.BlockSpec((1, sk, hd), lambda i, j: (kv_of(i), 0, 0)),
+    ]
+    operands = [qh, kh, vh]
+    if has_seg:
+        seg = segment_ids.astype(jnp.int32)                 # [b, s]
+        # segment ids are per BATCH row; the grid's first dim is b*nh
+        in_specs += [
+            pl.BlockSpec((1, block_q), lambda i, j: (i // nh, j)),
+            pl.BlockSpec((1, sk), lambda i, j: (i // nh, 0)),
+        ]
+        operands += [seg, seg]
 
     kernel = functools.partial(_flash_kernel, block_q=block_q,
-                               block_k=block_k, sk=sk, causal=causal)
+                               block_k=block_k, sk=sk, causal=causal,
+                               has_seg=has_seg)
     out, lse = pl.pallas_call(
         kernel,
         grid=(b * nh, sq // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, hd), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, sk, hd), lambda i, j: (kv_of(i), 0, 0)),
-            pl.BlockSpec((1, sk, hd), lambda i, j: (kv_of(i), 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, hd), lambda i, j: (i, j, 0)),
             pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
@@ -249,7 +285,7 @@ def _flash_forward(q, k, v, causal, block_q=128, block_k=128,
             jax.ShapeDtypeStruct((b * nh, sq), jnp.float32),
         ],
         interpret=interpret,
-    )(qh, kh, vh)
+    )(*operands)
     return jnp.swapaxes(out.reshape(b, nh, sq, hd), 1, 2), lse
 
 
@@ -257,13 +293,17 @@ def _flash_forward(q, k, v, causal, block_q=128, block_k=128,
 # pallas flash kernel (backward)
 # ---------------------------------------------------------------------------
 
-def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                     *, block_q, block_k, sk, causal):
+def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
+                     block_q, block_k, sk, causal, has_seg):
     """dQ for one (batch*head, q-block): stream K/V blocks, recompute
     p = exp(s - lse), then ds = p * (dO·Vᵀ - Δ) and dq += ds · K.
     Δ = rowsum(dO ∘ O) is precomputed outside (flash-2 backward)."""
     import jax.experimental.pallas as pl
 
+    if has_seg:
+        seg_q_ref, seg_k_ref, dq_ref = rest
+    else:
+        (dq_ref,) = rest
     q_block_idx = pl.program_id(1)
     hd = q_ref.shape[-1]
     scale = 1.0 / math.sqrt(hd)
@@ -280,9 +320,14 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         scores = jax.lax.dot_general(
             q, kj, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale      # [bq, bk]
+        keep = None
         if causal:
             keep = _causal_keep(block_q, block_k,
                                 q_block_idx * block_q, j * block_k)
+        if has_seg:
+            seg = _seg_keep(seg_q_ref, seg_k_ref, j, block_k)
+            keep = seg if keep is None else keep & seg
+        if keep is not None:
             scores = jnp.where(keep, scores, _NEG_INF)
         p = jnp.exp(scores - lse)                            # masked -> 0
         dp = jax.lax.dot_general(
@@ -300,8 +345,7 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 
 def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                      dk_ref, dv_ref, dk_acc_ref, dv_acc_ref, *,
-                      block_q, block_k, sq, causal, reps):
+                      *rest, block_q, block_k, sq, causal, reps, has_seg):
     """dK/dV for one (batch*kv-head, k-block, rep) program: stream the q
     blocks that can see this k block, accumulate dv += pᵀ·dO and
     dk += dsᵀ·q. GQA-native: the rep axis is the FASTEST grid dim, each
@@ -311,6 +355,11 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     and the kv-head-space output is written on the group's last rep."""
     import jax.experimental.pallas as pl
 
+    if has_seg:
+        (seg_q_ref, seg_k_ref, dk_ref, dv_ref,
+         dk_acc_ref, dv_acc_ref) = rest
+    else:
+        dk_ref, dv_ref, dk_acc_ref, dv_acc_ref = rest
     k_block_idx = pl.program_id(1)
     rep = pl.program_id(2)
     hd = k_ref.shape[-1]
@@ -334,9 +383,16 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         scores = jax.lax.dot_general(
             qi, kb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale      # [bq, bk]
+        keep = None
         if causal:
             keep = _causal_keep(block_q, block_k,
                                 i * block_q, k_block_idx * block_k)
+        if has_seg:
+            sq_ids = seg_q_ref[0, pl.ds(i * block_q, block_q)]
+            sk_ids = seg_k_ref[0]                            # [block_k]
+            seg = sq_ids[:, None] == sk_ids[None, :]
+            keep = seg if keep is None else keep & seg
+        if keep is not None:
             scores = jnp.where(keep, scores, _NEG_INF)
         p = jnp.exp(scores - lsei)
         dv_acc = dv_acc + jax.lax.dot_general(
@@ -365,8 +421,8 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_acc_ref[...].astype(dv_ref.dtype)
 
 
-def _flash_backward(q, k, v, o, lse, g, causal, block_q=128, block_k=128,
-                    interpret=False):
+def _flash_backward(q, k, v, o, lse, g, causal, segment_ids=None,
+                    block_q=128, block_k=128, interpret=False):
     """Flash-2 backward, GQA-native. q/o/g are [b, sq, nh, hd]; k/v are
     [b, sk, nkv, hd] (kv-head space, never repeated in HBM); lse is
     [b*nh, sq] from the forward. Returns dq in q-head space and dk/dv
@@ -385,24 +441,35 @@ def _flash_backward(q, k, v, o, lse, g, causal, block_q=128, block_k=128,
     # Δ rows: rowsum(dO ∘ O) — a cheap elementwise+reduce, fused by XLA
     delta = (gh.astype(jnp.float32) * oh.astype(jnp.float32)).sum(-1)
     kv_of = functools.partial(_kv_index, nh=nh, nkv=nkv)
+    has_seg = segment_ids is not None
+    seg = segment_ids.astype(jnp.int32) if has_seg else None
 
     dq_kernel = functools.partial(_flash_dq_kernel, block_q=block_q,
-                                  block_k=block_k, sk=sk, causal=causal)
+                                  block_k=block_k, sk=sk, causal=causal,
+                                  has_seg=has_seg)
+    dq_in_specs = [
+        pl.BlockSpec((1, block_q, hd), lambda i, j: (i, j, 0)),
+        pl.BlockSpec((1, sk, hd), lambda i, j: (kv_of(i), 0, 0)),
+        pl.BlockSpec((1, sk, hd), lambda i, j: (kv_of(i), 0, 0)),
+        pl.BlockSpec((1, block_q, hd), lambda i, j: (i, j, 0)),
+        pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+        pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+    ]
+    dq_operands = [qh, kh, vh, gh, lse, delta]
+    if has_seg:
+        dq_in_specs += [
+            pl.BlockSpec((1, block_q), lambda i, j: (i // nh, j)),
+            pl.BlockSpec((1, sk), lambda i, j: (i // nh, 0)),
+        ]
+        dq_operands += [seg, seg]
     dq = pl.pallas_call(
         dq_kernel,
         grid=(bh, sq // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, hd), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, sk, hd), lambda i, j: (kv_of(i), 0, 0)),
-            pl.BlockSpec((1, sk, hd), lambda i, j: (kv_of(i), 0, 0)),
-            pl.BlockSpec((1, block_q, hd), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
-            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
-        ],
+        in_specs=dq_in_specs,
         out_specs=pl.BlockSpec((1, block_q, hd), lambda i, j: (i, j, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, hd), q.dtype),
         interpret=interpret,
-    )(qh, kh, vh, gh, lse, delta)
+    )(*dq_operands)
 
     # dK/dV: one program per (batch*kv-head, k-block, rep). The rep axis is
     # the fastest grid dim: each step streams ONE query head of the group
@@ -410,19 +477,27 @@ def _flash_backward(q, k, v, o, lse, g, causal, block_q=128, block_k=128,
     # the group, and the kv-head-space block is flushed on the last rep.
     dkv_kernel = functools.partial(_flash_dkv_kernel, block_q=block_q,
                                    block_k=block_k, sq=sq, causal=causal,
-                                   reps=reps)
+                                   reps=reps, has_seg=has_seg)
     from jax.experimental.pallas import tpu as pltpu
+    dkv_in_specs = [
+        pl.BlockSpec((1, sq, hd), lambda i, j, r: (reps * i + r, 0, 0)),
+        pl.BlockSpec((1, block_k, hd), lambda i, j, r: (i, j, 0)),
+        pl.BlockSpec((1, block_k, hd), lambda i, j, r: (i, j, 0)),
+        pl.BlockSpec((1, sq, hd), lambda i, j, r: (reps * i + r, 0, 0)),
+        pl.BlockSpec((1, sq), lambda i, j, r: (reps * i + r, 0)),
+        pl.BlockSpec((1, sq), lambda i, j, r: (reps * i + r, 0)),
+    ]
+    dkv_operands = [qh, kh, vh, gh, lse, delta]
+    if has_seg:
+        dkv_in_specs += [
+            pl.BlockSpec((1, sq), lambda i, j, r: (i // nkv, 0)),
+            pl.BlockSpec((1, block_k), lambda i, j, r: (i // nkv, j)),
+        ]
+        dkv_operands += [seg, seg]
     dk, dv = pl.pallas_call(
         dkv_kernel,
         grid=(bkv, sk // block_k, reps),
-        in_specs=[
-            pl.BlockSpec((1, sq, hd), lambda i, j, r: (reps * i + r, 0, 0)),
-            pl.BlockSpec((1, block_k, hd), lambda i, j, r: (i, j, 0)),
-            pl.BlockSpec((1, block_k, hd), lambda i, j, r: (i, j, 0)),
-            pl.BlockSpec((1, sq, hd), lambda i, j, r: (reps * i + r, 0, 0)),
-            pl.BlockSpec((1, sq), lambda i, j, r: (reps * i + r, 0)),
-            pl.BlockSpec((1, sq), lambda i, j, r: (reps * i + r, 0)),
-        ],
+        in_specs=dkv_in_specs,
         out_specs=[
             pl.BlockSpec((1, block_k, hd), lambda i, j, r: (i, j, 0)),
             pl.BlockSpec((1, block_k, hd), lambda i, j, r: (i, j, 0)),
@@ -436,36 +511,44 @@ def _flash_backward(q, k, v, o, lse, g, causal, block_q=128, block_k=128,
             pltpu.VMEM((block_k, hd), jnp.float32),
         ],
         interpret=interpret,
-    )(qh, kh, vh, gh, lse, delta)
+    )(*dkv_operands)
 
     unflat = lambda x, n, s: jnp.swapaxes(x.reshape(b, n, s, hd), 1, 2)  # noqa: E731
     return unflat(dq, nh, sq), unflat(dk, nkv, sk), unflat(dv, nkv, sk)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash_attention(q, k, v, causal, interpret):
-    out, _ = _flash_forward(q, k, v, causal, interpret=interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _flash_attention(q, k, v, segment_ids, causal, interpret):
+    out, _ = _flash_forward(q, k, v, causal, segment_ids=segment_ids,
+                            interpret=interpret)
     return out
 
 
-def _flash_fwd(q, k, v, causal, interpret):
-    out, lse = _flash_forward(q, k, v, causal, interpret=interpret)
-    return out, (q, k, v, out, lse)
+def _flash_fwd(q, k, v, segment_ids, causal, interpret):
+    out, lse = _flash_forward(q, k, v, causal, segment_ids=segment_ids,
+                              interpret=interpret)
+    return out, (q, k, v, segment_ids, out, lse)
 
 
 def _flash_bwd(causal, interpret, residuals, g):
-    q, k, v, o, lse = residuals
+    q, k, v, segment_ids, o, lse = residuals
+    # segment ids are integers: their cotangent is the symbolic float0
+    dseg = (np.zeros(segment_ids.shape, jax.dtypes.float0)
+            if segment_ids is not None else None)
     if os.environ.get("KUBEDL_FLASH_BWD", "pallas") == "chunked":
         # safety valve: recompute through the differentiable chunked path.
         # NOTE: read at TRACE time — set it before the first jit compile of
         # the train step; already-compiled executables keep their backward.
         _, vjp = jax.vjp(
-            lambda q_, k_, v_: chunked_attention(q_, k_, v_, causal=causal),
+            lambda q_, k_, v_: chunked_attention(
+                q_, k_, v_, causal=causal, segment_ids=segment_ids),
             q, k, v)
-        return vjp(g)
+        dq, dk, dv = vjp(g)
+        return dq, dk, dv, dseg
     dq, dk, dv = _flash_backward(q, k, v, o, lse, g, causal,
+                                 segment_ids=segment_ids,
                                  interpret=interpret)
-    return dq, dk.astype(k.dtype), dv.astype(v.dtype)
+    return dq, dk.astype(k.dtype), dv.astype(v.dtype), dseg
 
 
 _flash_attention.defvjp(_flash_fwd, _flash_bwd)
@@ -492,12 +575,12 @@ def multi_head_attention(q, k, v, causal: bool = True, segment_ids=None,
     b, sq, nh, hd = q.shape
     if impl is None:
         aligned = (sq % 128 == 0 and k.shape[1] % 128 == 0
-                   and hd % 128 == 0 and segment_ids is None)
+                   and hd % 128 == 0)
         impl = "pallas" if (_on_tpu() and aligned) else "chunked"
     if impl == "pallas":
-        return _flash_attention(q, k, v, causal, False)
+        return _flash_attention(q, k, v, segment_ids, causal, False)
     if impl == "pallas_interpret":  # CI path for the kernel itself
-        return _flash_attention(q, k, v, causal, True)
+        return _flash_attention(q, k, v, segment_ids, causal, True)
     if impl == "chunked":
         return chunked_attention(q, k, v, causal=causal,
                                  segment_ids=segment_ids)

@@ -67,47 +67,42 @@ class SpeculativeEngine:
         self.dfam = resolve_family(draft_config)
         tc, dc, tfam, dfam = self.tc, self.dc, self.tfam, self.dfam
 
-        @partial(jax.jit, donate_argnums=(1,))
-        def _t_prefill(params, cache, tokens, plen):
-            # tokens right-padded to a power-of-two bucket (no per-length
-            # recompiles); last_pos reads the real last token's logits and
-            # the pad writes are causally invisible until overwritten
-            valid = (jnp.arange(cache["k"].shape[2]) < plen)[None, :]
-            logits, cache = tfam.forward_step(tc, params, tokens, cache,
-                                              jnp.int32(0), valid=valid,
-                                              last_pos=plen - 1)
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+        def make_prefill(cfg, fam):
+            @partial(jax.jit, donate_argnums=(1,))
+            def _prefill(params, cache, tokens, plen):
+                # tokens right-padded to a power-of-two bucket (no
+                # per-length recompiles); last_pos reads the real last
+                # token's logits and the pad writes are causally invisible
+                # until overwritten
+                valid = (jnp.arange(cache["k"].shape[2]) < plen)[None, :]
+                logits, cache = fam.forward_step(cfg, params, tokens, cache,
+                                                 jnp.int32(0), valid=valid,
+                                                 last_pos=plen - 1)
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+            return _prefill
 
-        @partial(jax.jit, donate_argnums=(1,))
-        def _d_prefill(params, cache, tokens, plen):
-            valid = (jnp.arange(cache["k"].shape[2]) < plen)[None, :]
-            _, cache = dfam.forward_step(dc, params, tokens, cache,
-                                         jnp.int32(0), valid=valid,
-                                         last_pos=plen - 1)
-            return cache
+        def make_step(cfg, fam, all_logits=False):
+            @partial(jax.jit, donate_argnums=(1,))
+            def _step(params, cache, tokens, start):
+                logits, cache = fam.forward_step(cfg, params, tokens, cache,
+                                                 start, all_logits=all_logits)
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+            return _step
 
-        @partial(jax.jit, donate_argnums=(1,))
-        def _t_verify(params, cache, tokens, start):
-            # chunk [1, k+1]: logits for every position (greedy targets)
-            logits, cache = tfam.forward_step(tc, params, tokens, cache,
-                                              start, all_logits=True)
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+        self._t_prefill = make_prefill(tc, tfam)
+        self._d_prefill = make_prefill(dc, dfam)
+        # verify: chunk [1, k+1], logits for every position (greedy targets)
+        self._t_verify = make_step(tc, tfam, all_logits=True)
+        self._t_step = make_step(tc, tfam)
+        self._d_step = make_step(dc, dfam)
+        self._reset_caches()
 
-        @partial(jax.jit, donate_argnums=(1,))
-        def _t_step(params, cache, tokens, start):
-            logits, cache = tfam.forward_step(tc, params, tokens, cache,
-                                              start)
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
-
-        @partial(jax.jit, donate_argnums=(1,))
-        def _d_step(params, cache, tokens, start):
-            logits, cache = dfam.forward_step(dc, params, tokens, cache,
-                                              start)
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
-
-        self._t_prefill, self._d_prefill = _t_prefill, _d_prefill
-        self._t_verify, self._t_step, self._d_step = (
-            _t_verify, _t_step, _d_step)
+    def _reset_caches(self) -> None:
+        """(Re)allocate the engine-held caches. Called at init and after a
+        failure mid-generate (an exception between a donating call and the
+        reassignment can leave a consumed buffer behind)."""
+        self._t_cache = self.tfam.init_cache(self.tc, 1, self.max_len)
+        self._d_cache = self.dfam.init_cache(self.dc, 1, self.max_len)
 
     def generate(self, prompt: Sequence[int], max_new_tokens: int,
                  stats: Optional[SpecStats] = None) -> list:
@@ -119,9 +114,20 @@ class SpeculativeEngine:
             raise ValueError(
                 f"prompt {plen} + new {max_new_tokens} exceeds "
                 f"cache capacity {self.max_len}")
+        try:
+            return self._generate(prompt, plen, max_new_tokens, stats)
+        except Exception:
+            # a failure between a donating call and its reassignment can
+            # leave a consumed buffer on self — restore invariants
+            self._reset_caches()
+            raise
+
+    def _generate(self, prompt, plen, max_new_tokens, stats):
         k = self.k
-        t_cache = self.tfam.init_cache(self.tc, 1, self.max_len)
-        d_cache = self.dfam.init_cache(self.dc, 1, self.max_len)
+        # engine-held caches, rewritten in place every call (stale slots
+        # from a previous request are causally invisible: the fresh
+        # prefill's masks start over at position 0)
+        t_cache, d_cache = self._t_cache, self._d_cache
 
         bucket = min(_bucket(plen), self.max_len)
         toks = np.zeros((1, bucket), np.int32)
@@ -131,11 +137,16 @@ class SpeculativeEngine:
                                        jnp.int32(plen))
         y = int(nxt[0])                              # first target token
         # draft prefills the same prompt; only its cache matters
-        d_cache = self._d_prefill(self.dp, d_cache, toks, jnp.int32(plen))
+        _, d_cache = self._d_prefill(self.dp, d_cache, toks, jnp.int32(plen))
 
         out = [y]
         pos = plen            # tokens verified into both caches so far
-        while len(out) < max_new_tokens and pos + k + 1 < self.max_len:
+        # a round only pays off when >= 2 tokens are still wanted (it
+        # costs k draft steps + one verify); the single-token tail below
+        # finishes the rest — this also keeps SpecStats free of trimmed
+        # proposals
+        while (max_new_tokens - len(out) >= 2
+               and pos + k + 1 < self.max_len):
             # 1) draft proposes k tokens autoregressively from y
             drafts = []
             cur = y
@@ -186,4 +197,5 @@ class SpeculativeEngine:
             y = int(nxt[0])
             out.append(y)
             pos += 1
+        self._t_cache, self._d_cache = t_cache, d_cache
         return out[:max_new_tokens]
