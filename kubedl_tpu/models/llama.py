@@ -477,7 +477,12 @@ def lm_loss(config: LlamaConfig, x, params: dict, targets,
 
 
 def loss_fn(config: LlamaConfig, params: dict, tokens, targets,
-            mask=None, mesh=None) -> jnp.ndarray:
-    """Next-token cross-entropy, mean over unmasked targets."""
-    x = forward_hidden(config, params, tokens, mesh=mesh)
+            mask=None, mesh=None, segment_ids=None,
+            positions=None) -> jnp.ndarray:
+    """Next-token cross-entropy, mean over unmasked targets.
+    ``segment_ids``/``positions`` [b, s] support packed documents
+    (``train.data.pack_documents``): attention stays within segments and
+    RoPE positions restart per document."""
+    x = forward_hidden(config, params, tokens, positions=positions,
+                       segment_ids=segment_ids, mesh=mesh)
     return lm_loss(config, x, params, targets, mask=mask)

@@ -330,10 +330,11 @@ def forward_step(config: MoEConfig, params: dict, tokens, cache: dict,
 
 
 def loss_fn(config: MoEConfig, params: dict, tokens, targets, mask=None,
-            mesh=None):
+            mesh=None, segment_ids=None, positions=None):
     """Next-token cross-entropy (shared ``llama.lm_loss``) + the
     load-balancing aux, mean over targets."""
     c = config
-    x, aux = forward_hidden(c, params, tokens, mesh=mesh)
+    x, aux = forward_hidden(c, params, tokens, positions=positions,
+                            segment_ids=segment_ids, mesh=mesh)
     return llama.lm_loss(c, x, params, targets, mask=mask) \
         + c.aux_loss_weight * aux

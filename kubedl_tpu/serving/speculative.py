@@ -116,9 +116,10 @@ class SpeculativeEngine:
                 f"cache capacity {self.max_len}")
         try:
             return self._generate(prompt, plen, max_new_tokens, stats)
-        except Exception:
-            # a failure between a donating call and its reassignment can
-            # leave a consumed buffer on self — restore invariants
+        except BaseException:
+            # ANY abort (including KeyboardInterrupt) between a donating
+            # call and its reassignment can leave a consumed buffer on
+            # self — restore invariants before propagating
             self._reset_caches()
             raise
 
@@ -143,8 +144,9 @@ class SpeculativeEngine:
         pos = plen            # tokens verified into both caches so far
         # a round only pays off when >= 2 tokens are still wanted (it
         # costs k draft steps + one verify); the single-token tail below
-        # finishes the rest — this also keeps SpecStats free of trimmed
-        # proposals
+        # finishes the last one. NOTE: a round near the budget can still
+        # propose more than remains — SpecStats counts those trimmed
+        # proposals, so measure acceptance with max_new >> k
         while (max_new_tokens - len(out) >= 2
                and pos + k + 1 < self.max_len):
             # 1) draft proposes k tokens autoregressively from y

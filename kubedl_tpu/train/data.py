@@ -29,6 +29,70 @@ def synthetic_lm_batches(batch_size: int, seq_len: int, vocab_size: int,
         yield {"tokens": toks[:, :-1], "targets": toks[:, 1:]}
 
 
+def pack_documents(docs, seq_len: int, batch_size: int,
+                   pad_id: int = 0) -> Iterator[dict]:
+    """Greedy first-fit packing of variable-length token documents into
+    fixed [batch, seq_len] training batches — the standard fine-tuning
+    data shape for the flash kernels' segment-ids path.
+
+    Yields {tokens, targets, segment_ids, positions, mask}:
+
+    * documents are packed back to back per row; a doc longer than
+      ``seq_len + 1`` is split into chunks (each chunk its own segment);
+    * ``segment_ids`` are unique per document within a row (pads get -1),
+      so attention never crosses documents;
+    * ``positions`` restart at 0 per document — RoPE sees every doc at
+      its natural offsets, exactly as if it were alone in the batch;
+    * ``mask`` zeroes loss terms whose (input, target) pair crosses a
+      document boundary or touches padding.
+
+    Leftover documents that don't fill a final batch are dropped (the
+    streaming contract: every yielded batch is full)."""
+    seq1 = seq_len + 1     # pack seq_len+1 then shift for (tokens, targets)
+    rows, row, seg_row, pos_row, seg_id = [], [], [], [], 0
+
+    def flush_row():
+        nonlocal row, seg_row, pos_row, seg_id
+        pad = seq1 - len(row)
+        rows.append((row + [pad_id] * pad,
+                     seg_row + [-1] * pad,
+                     pos_row + [0] * pad))
+        row, seg_row, pos_row, seg_id = [], [], [], 0
+
+    for doc in docs:
+        doc = list(doc)
+        for start in range(0, len(doc), seq1):
+            chunk = doc[start:start + seq1]
+            if len(chunk) < 2:
+                continue           # a 1-token chunk has no (input, target)
+            if len(row) + len(chunk) > seq1:
+                flush_row()
+            row.extend(chunk)
+            seg_row.extend([seg_id] * len(chunk))
+            pos_row.extend(range(len(chunk)))
+            seg_id += 1
+            if len(row) == seq1:
+                flush_row()
+            while len(rows) >= batch_size:
+                batch, rows = rows[:batch_size], rows[batch_size:]
+                yield _packed_batch(batch)
+    if row:
+        flush_row()
+    while len(rows) >= batch_size:
+        batch, rows = rows[:batch_size], rows[batch_size:]
+        yield _packed_batch(batch)
+
+
+def _packed_batch(rows) -> dict:
+    toks = np.asarray([r[0] for r in rows], np.int32)   # [b, seq+1]
+    seg = np.asarray([r[1] for r in rows], np.int32)
+    pos = np.asarray([r[2] for r in rows], np.int32)
+    mask = (seg[:, :-1] == seg[:, 1:]) & (seg[:, :-1] >= 0)
+    return {"tokens": toks[:, :-1], "targets": toks[:, 1:],
+            "segment_ids": seg[:, :-1], "positions": pos[:, :-1],
+            "mask": mask}
+
+
 def shard_batch(batch: dict, mesh: Mesh) -> dict:
     """Rank-aware batch sharding: the leading axis shards over the data
     axes, a rank-2 [b, s] leaf additionally shards its sequence axis over
