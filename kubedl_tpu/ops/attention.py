@@ -175,19 +175,29 @@ def _seg_keep(seg_q_ref, seg_k_ref, j, block_k: int):
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, *rest, block_q, block_k,
-                  sk, causal, has_seg):
+                  sk, causal, has_seg, has_off):
     """One (batch*head, q-block) program; K/V blocks streamed via fori_loop.
     Block shapes carry a leading singleton (batch*head) dim: q [1, block_q,
     hd], k/v [1, sk, hd], o [1, block_q, hd]. With ``has_seg`` two extra
     int refs (seg_q [1, block_q], seg_k [1, sk]) restrict attention to
-    same-segment pairs (packed sequences). Also writes the per-row
-    logsumexp (scaled-score space) consumed by the backward kernels."""
+    same-segment pairs (packed sequences). With ``has_off`` a [1, 2] int
+    ref carries GLOBAL (q, k) position offsets for the causal mask — ring
+    attention feeds sequence shards whose true positions differ from
+    their local indices. Also writes the per-row logsumexp (scaled-score
+    space) consumed by the backward kernels."""
     import jax.experimental.pallas as pl  # local to keep CPU import cheap
 
+    rest = list(rest)
+    seg_q_ref = seg_k_ref = offs_ref = None
     if has_seg:
-        seg_q_ref, seg_k_ref, o_ref, lse_ref = rest
-    else:
-        o_ref, lse_ref = rest
+        seg_q_ref, seg_k_ref = rest[:2]
+        rest = rest[2:]
+    if has_off:
+        offs_ref = rest[0]
+        rest = rest[1:]
+    o_ref, lse_ref = rest
+    q_off = offs_ref[0, 0] if has_off else 0
+    k_off = offs_ref[0, 1] if has_off else 0
     q_block_idx = pl.program_id(1)
     hd = q_ref.shape[-1]
     scale = 1.0 / math.sqrt(hd)
@@ -205,7 +215,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, *rest, block_q, block_k,
         keep = None
         if causal:
             keep = _causal_keep(block_q, block_k,
-                                q_block_idx * block_q, j * block_k)
+                                q_off + q_block_idx * block_q,
+                                k_off + j * block_k)
         if has_seg:
             seg = _seg_keep(seg_q_ref, seg_k_ref, j, block_k)
             keep = seg if keep is None else keep & seg
@@ -220,7 +231,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, *rest, block_q, block_k,
         row_sum = row_sum * alpha + p.sum(axis=-1, keepdims=True)
         return acc, new_max, row_sum
 
-    upper = _kv_upper(q_block_idx, block_q, block_k, num_kb, causal)
+    # the diagonal-skip is a local-index optimization; with global offsets
+    # the diagonal can sit anywhere, so run all blocks (mask is exact)
+    upper = (num_kb if has_off else
+             _kv_upper(q_block_idx, block_q, block_k, num_kb, causal))
     acc0 = jnp.zeros((block_q, hd), jnp.float32)
     max0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
     sum0 = jnp.zeros((block_q, 1), jnp.float32)
@@ -239,11 +253,13 @@ def _kv_index(i, nh: int, nkv: int):
     return (i // nh) * nkv + (i % nh) // reps
 
 
-def _flash_forward(q, k, v, causal, segment_ids=None, block_q=128,
-                   block_k=128, interpret=False):
+def _flash_forward(q, k, v, causal, segment_ids=None, offsets=None,
+                   block_q=128, block_k=128, interpret=False):
     """q [b, sq, nh, hd]; k/v [b, sk, nkv, hd] (kv-head space, GQA-native);
-    segment_ids [b, s] (optional packed-sequence ids; sq == sk then).
-    Returns (out [b, sq, nh, hd], lse [b*nh, sq] float32)."""
+    segment_ids [b, s] (optional packed-sequence ids; sq == sk then);
+    offsets (optional traced (q_off, k_off) global positions for the
+    causal mask — ring attention). Returns (out [b, sq, nh, hd],
+    lse [b*nh, sq] float32)."""
     import jax.experimental.pallas as pl
 
     b, sq, nh, hd = q.shape
@@ -253,6 +269,7 @@ def _flash_forward(q, k, v, causal, segment_ids=None, block_q=128,
     vh = jnp.swapaxes(v, 1, 2).reshape(b * nkv, sk, hd)
     kv_of = functools.partial(_kv_index, nh=nh, nkv=nkv)
     has_seg = segment_ids is not None
+    has_off = offsets is not None
 
     in_specs = [
         pl.BlockSpec((1, block_q, hd), lambda i, j: (i, j, 0)),
@@ -268,10 +285,15 @@ def _flash_forward(q, k, v, causal, segment_ids=None, block_q=128,
             pl.BlockSpec((1, sk), lambda i, j: (i // nh, 0)),
         ]
         operands += [seg, seg]
+    if has_off:
+        in_specs += [pl.BlockSpec((1, 2), lambda i, j: (0, 0))]
+        operands += [jnp.stack(
+            [jnp.asarray(offsets[0], jnp.int32),
+             jnp.asarray(offsets[1], jnp.int32)]).reshape(1, 2)]
 
     kernel = functools.partial(_flash_kernel, block_q=block_q,
                                block_k=block_k, sk=sk, causal=causal,
-                               has_seg=has_seg)
+                               has_seg=has_seg, has_off=has_off)
     out, lse = pl.pallas_call(
         kernel,
         grid=(b * nh, sq // block_q),
@@ -294,16 +316,23 @@ def _flash_forward(q, k, v, causal, segment_ids=None, block_q=128,
 # ---------------------------------------------------------------------------
 
 def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
-                     block_q, block_k, sk, causal, has_seg):
+                     block_q, block_k, sk, causal, has_seg, has_off):
     """dQ for one (batch*head, q-block): stream K/V blocks, recompute
     p = exp(s - lse), then ds = p * (dO·Vᵀ - Δ) and dq += ds · K.
     Δ = rowsum(dO ∘ O) is precomputed outside (flash-2 backward)."""
     import jax.experimental.pallas as pl
 
+    rest = list(rest)
+    seg_q_ref = seg_k_ref = offs_ref = None
     if has_seg:
-        seg_q_ref, seg_k_ref, dq_ref = rest
-    else:
-        (dq_ref,) = rest
+        seg_q_ref, seg_k_ref = rest[:2]
+        rest = rest[2:]
+    if has_off:
+        offs_ref = rest[0]
+        rest = rest[1:]
+    (dq_ref,) = rest
+    q_off = offs_ref[0, 0] if has_off else 0
+    k_off = offs_ref[0, 1] if has_off else 0
     q_block_idx = pl.program_id(1)
     hd = q_ref.shape[-1]
     scale = 1.0 / math.sqrt(hd)
@@ -323,7 +352,8 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
         keep = None
         if causal:
             keep = _causal_keep(block_q, block_k,
-                                q_block_idx * block_q, j * block_k)
+                                q_off + q_block_idx * block_q,
+                                k_off + j * block_k)
         if has_seg:
             seg = _seg_keep(seg_q_ref, seg_k_ref, j, block_k)
             keep = seg if keep is None else keep & seg
@@ -338,14 +368,16 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
             ds, kj, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    upper = _kv_upper(q_block_idx, block_q, block_k, num_kb, causal)
+    upper = (num_kb if has_off else
+             _kv_upper(q_block_idx, block_q, block_k, num_kb, causal))
     dq = jax.lax.fori_loop(
         0, upper, body, jnp.zeros((block_q, hd), jnp.float32))
     dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
 
 
 def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                      *rest, block_q, block_k, sq, causal, reps, has_seg):
+                      *rest, block_q, block_k, sq, causal, reps, has_seg,
+                      has_off):
     """dK/dV for one (batch*kv-head, k-block, rep) program: stream the q
     blocks that can see this k block, accumulate dv += pᵀ·dO and
     dk += dsᵀ·q. GQA-native: the rep axis is the FASTEST grid dim, each
@@ -355,11 +387,17 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     and the kv-head-space output is written on the group's last rep."""
     import jax.experimental.pallas as pl
 
+    rest = list(rest)
+    seg_q_ref = seg_k_ref = offs_ref = None
     if has_seg:
-        (seg_q_ref, seg_k_ref, dk_ref, dv_ref,
-         dk_acc_ref, dv_acc_ref) = rest
-    else:
-        dk_ref, dv_ref, dk_acc_ref, dv_acc_ref = rest
+        seg_q_ref, seg_k_ref = rest[:2]
+        rest = rest[2:]
+    if has_off:
+        offs_ref = rest[0]
+        rest = rest[1:]
+    dk_ref, dv_ref, dk_acc_ref, dv_acc_ref = rest
+    q_off = offs_ref[0, 0] if has_off else 0
+    k_off = offs_ref[0, 1] if has_off else 0
     k_block_idx = pl.program_id(1)
     rep = pl.program_id(2)
     hd = k_ref.shape[-1]
@@ -386,7 +424,8 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         keep = None
         if causal:
             keep = _causal_keep(block_q, block_k,
-                                i * block_q, k_block_idx * block_k)
+                                q_off + i * block_q,
+                                k_off + k_block_idx * block_k)
         if has_seg:
             sq_ids = seg_q_ref[0, pl.ds(i * block_q, block_q)]
             sk_ids = seg_k_ref[0]                            # [block_k]
@@ -409,7 +448,10 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     # causal: q block i sees k block only when i*block_q + block_q - 1 >=
     # k_block_idx*block_k, i.e. from the block containing the diagonal on
-    lower = 0 if not causal else (k_block_idx * block_k) // block_q
+    # (a local-index skip — with global offsets run every block, the mask
+    # is exact)
+    lower = (0 if (not causal or has_off)
+             else (k_block_idx * block_k) // block_q)
     zeros = jnp.zeros((block_k, hd), jnp.float32)
     dk, dv = jax.lax.fori_loop(lower, num_qb, body, (zeros, zeros))
     dk_acc_ref[...] += dk
@@ -422,7 +464,8 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_backward(q, k, v, o, lse, g, causal, segment_ids=None,
-                    block_q=128, block_k=128, interpret=False):
+                    offsets=None, block_q=128, block_k=128,
+                    interpret=False):
     """Flash-2 backward, GQA-native. q/o/g are [b, sq, nh, hd]; k/v are
     [b, sk, nkv, hd] (kv-head space, never repeated in HBM); lse is
     [b*nh, sq] from the forward. Returns dq in q-head space and dk/dv
@@ -443,10 +486,14 @@ def _flash_backward(q, k, v, o, lse, g, causal, segment_ids=None,
     kv_of = functools.partial(_kv_index, nh=nh, nkv=nkv)
     has_seg = segment_ids is not None
     seg = segment_ids.astype(jnp.int32) if has_seg else None
+    has_off = offsets is not None
+    offs = (jnp.stack([jnp.asarray(offsets[0], jnp.int32),
+                       jnp.asarray(offsets[1], jnp.int32)]).reshape(1, 2)
+            if has_off else None)
 
     dq_kernel = functools.partial(_flash_dq_kernel, block_q=block_q,
                                   block_k=block_k, sk=sk, causal=causal,
-                                  has_seg=has_seg)
+                                  has_seg=has_seg, has_off=has_off)
     dq_in_specs = [
         pl.BlockSpec((1, block_q, hd), lambda i, j: (i, j, 0)),
         pl.BlockSpec((1, sk, hd), lambda i, j: (kv_of(i), 0, 0)),
@@ -462,6 +509,9 @@ def _flash_backward(q, k, v, o, lse, g, causal, segment_ids=None,
             pl.BlockSpec((1, sk), lambda i, j: (i // nh, 0)),
         ]
         dq_operands += [seg, seg]
+    if has_off:
+        dq_in_specs += [pl.BlockSpec((1, 2), lambda i, j: (0, 0))]
+        dq_operands += [offs]
     dq = pl.pallas_call(
         dq_kernel,
         grid=(bh, sq // block_q),
@@ -477,7 +527,8 @@ def _flash_backward(q, k, v, o, lse, g, causal, segment_ids=None,
     # the group, and the kv-head-space block is flushed on the last rep.
     dkv_kernel = functools.partial(_flash_dkv_kernel, block_q=block_q,
                                    block_k=block_k, sq=sq, causal=causal,
-                                   reps=reps, has_seg=has_seg)
+                                   reps=reps, has_seg=has_seg,
+                                   has_off=has_off)
     from jax.experimental.pallas import tpu as pltpu
     dkv_in_specs = [
         pl.BlockSpec((1, sq, hd), lambda i, j, r: (reps * i + r, 0, 0)),
@@ -494,6 +545,9 @@ def _flash_backward(q, k, v, o, lse, g, causal, segment_ids=None,
             pl.BlockSpec((1, block_k), lambda i, j, r: (i // nkv, j)),
         ]
         dkv_operands += [seg, seg]
+    if has_off:
+        dkv_in_specs += [pl.BlockSpec((1, 2), lambda i, j, r: (0, 0))]
+        dkv_operands += [offs]
     dk, dv = pl.pallas_call(
         dkv_kernel,
         grid=(bkv, sk // block_k, reps),

@@ -13,7 +13,12 @@ computes it without ever materializing the full sequence on one device:
   (the same math as the flash kernel in ``kubedl_tpu.ops.attention``,
   applied across devices instead of across VMEM tiles);
 * compute and the next block's transfer overlap inside one ``lax.scan``
-  step, so the ring latency hides behind the matmuls for realistic sizes.
+  step, so the ring latency hides behind the matmuls for realistic sizes;
+* for 128-aligned shards the per-block attention itself runs the pallas
+  FLASH kernels (global-offset causal masks) and blocks merge by
+  logsumexp — true ring flash attention, O(tile) score memory, with a
+  two-ring flash backward (dQ accumulates locally, dK/dV accumulators
+  ride the ring home with their blocks).
 
 Causal jobs skip nothing structurally (SPMD needs uniform control flow)
 but fully-masked blocks contribute zeros, and the per-block mask is built
@@ -31,15 +36,136 @@ import jax.numpy as jnp
 from jax.sharding import Mesh
 from jax.sharding import PartitionSpec as P
 
+from ..ops import attention as _attn
 from ..ops.attention import repeat_kv as _repeat_kv
 
 _NEG_INF = -1e30
 
 
-def ring_attention_p(q, k, v, axis_name: str = "cp", causal: bool = True):
+# ---------------------------------------------------------------------------
+# ring FLASH attention: per-block pallas kernels + online lse merge
+# ---------------------------------------------------------------------------
+
+def _ring_perm(axis_name: str, axis_size: int):
+    return [(j, (j + 1) % axis_size) for j in range(axis_size)]
+
+
+def _ring_flash_eligible(q, k, cp: int = 1) -> bool:
+    """Flash per-block path: 128-aligned LOCAL shards (``cp`` divides the
+    given global sequence down to the per-device shard), GQA-divisible
+    heads, and a real TPU (interpret-mode pallas is for tests only)."""
+    b, sq, h, hd = q.shape
+    sk = k.shape[1]
+    return (sq % (128 * cp) == 0 and sk % (128 * cp) == 0
+            and hd % 128 == 0 and h % k.shape[2] == 0 and _attn._on_tpu())
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ring_flash(q, k, v, axis_name, causal, interpret):
+    out, _ = _ring_flash_fwd_impl(q, k, v, axis_name, causal, interpret)
+    return out
+
+
+def _ring_flash_fwd_impl(q, k, v, axis_name, causal, interpret):
+    """Forward ring: rotate K/V blocks, run the flash kernel per block with
+    GLOBAL causal offsets, merge normalized partials with the online
+    logsumexp update. Returns (out [b, sq, h, hd] in q.dtype,
+    lse [b*h, sq] float32 — the GLOBAL normalizer the backward needs)."""
+    axis_size = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    b, sq, h, hd = q.shape
+    sk = k.shape[1]
+    perm = _ring_perm(axis_name, axis_size)
+
+    def step(carry, i):
+        o_run, lse_run, k_blk, v_blk = carry
+        src = (my - i) % axis_size
+        o_i, lse_i = _attn._flash_forward(
+            q, k_blk, v_blk, causal,
+            offsets=(my * sq, src * sk), interpret=interpret)
+        # merge normalized partials: o = Σ o_j·Z_j / Σ Z_j in log space
+        m = jnp.maximum(lse_run, lse_i)
+        a = jnp.exp(lse_run - m)
+        bw = jnp.exp(lse_i - m)
+        denom = jnp.maximum(a + bw, 1e-37)
+        w_run = (a / denom).reshape(b, h, sq).transpose(0, 2, 1)[..., None]
+        w_i = (bw / denom).reshape(b, h, sq).transpose(0, 2, 1)[..., None]
+        o_run = o_run * w_run + o_i.astype(jnp.float32) * w_i
+        lse_run = m + jnp.log(denom)
+        k_next = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_next = jax.lax.ppermute(v_blk, axis_name, perm)
+        return (o_run, lse_run, k_next, v_next), None
+
+    o0 = q.astype(jnp.float32) * 0.0
+    # [b*h, sq] running logsumexp, derived from q for shard_map vma typing
+    lse0 = (jnp.swapaxes(q[..., 0], 1, 2).reshape(b * h, sq)
+            .astype(jnp.float32) * 0.0 + _NEG_INF)
+    (o, lse, _, _), _ = jax.lax.scan(
+        step, (o0, lse0, k, v), jnp.arange(axis_size))
+    return o.astype(q.dtype), lse
+
+
+def _ring_flash_fwd(q, k, v, axis_name, causal, interpret):
+    out, lse = _ring_flash_fwd_impl(q, k, v, axis_name, causal, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_bwd(axis_name, causal, interpret, residuals, g):
+    """Backward ring: rotate (K, V, dK-acc, dV-acc) together; per block the
+    flash-2 backward kernels run with the GLOBAL lse (so per-block p are
+    the true global probabilities), dQ accumulates locally and the dK/dV
+    accumulators ride the ring home with their blocks."""
+    q, k, v, o, lse = residuals
+    axis_size = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    b, sq, h, hd = q.shape
+    sk = k.shape[1]
+    perm = _ring_perm(axis_name, axis_size)
+
+    def step(carry, i):
+        dq_acc, k_blk, v_blk, dk_acc, dv_acc = carry
+        src = (my - i) % axis_size
+        dq_i, dk_i, dv_i = _attn._flash_backward(
+            q, k_blk, v_blk, o, lse, g, causal,
+            offsets=(my * sq, src * sk), interpret=interpret)
+        dq_acc = dq_acc + dq_i.astype(jnp.float32)
+        dk_acc = dk_acc + dk_i.astype(jnp.float32)
+        dv_acc = dv_acc + dv_i.astype(jnp.float32)
+        k_next = jax.lax.ppermute(k_blk, axis_name, perm)
+        dk_next = jax.lax.ppermute(dk_acc, axis_name, perm)
+        v_next = jax.lax.ppermute(v_blk, axis_name, perm)
+        dv_next = jax.lax.ppermute(dv_acc, axis_name, perm)
+        return (dq_acc, k_next, v_next, dk_next, dv_next), None
+
+    zeros_q = q.astype(jnp.float32) * 0.0
+    zeros_k = k.astype(jnp.float32) * 0.0
+    zeros_v = v.astype(jnp.float32) * 0.0
+    (dq, _, _, dk, dv), _ = jax.lax.scan(
+        step, (zeros_q, k, v, zeros_k, zeros_v), jnp.arange(axis_size))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
+def ring_attention_p(q, k, v, axis_name: str = "cp", causal: bool = True,
+                     impl: str = "auto"):
     """Per-shard ring attention; must run under ``shard_map`` with
     ``axis_name`` bound. q: [b, sq, h, hd]; k/v: [b, sk, nkv, hd] — all
-    *local* sequence shards. Returns [b, sq, h, hd] in q.dtype."""
+    *local* sequence shards. Returns [b, sq, h, hd] in q.dtype.
+
+    ``impl``: "flash" routes every ring step through the pallas flash
+    kernels (global-offset causal masks, online lse merge across blocks —
+    true ring flash attention, O(block) score memory); "dense" is the
+    einsum online-softmax path; "auto" picks flash for 128-aligned
+    shapes ON TPU (interpret-mode pallas on CPU would be orders of
+    magnitude slower than the einsum path, same convention as
+    ``multi_head_attention``)."""
+    if impl == "auto":
+        impl = "flash" if _ring_flash_eligible(q, k) else "dense"
+    if impl == "flash":
+        return _ring_flash(q, k, v, axis_name, causal,
+                           not _attn._on_tpu())
     axis_size = jax.lax.axis_size(axis_name)
     my = jax.lax.axis_index(axis_name)
     b, sq, h, hd = q.shape
@@ -55,7 +181,7 @@ def ring_attention_p(q, k, v, axis_name: str = "cp", causal: bool = True):
     o0 = qf * 0.0
     l0 = jnp.sum(qf, axis=-1).transpose(0, 2, 1) * 0.0  # [b, h, sq]
     m0 = l0 + _NEG_INF
-    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+    perm = _ring_perm(axis_name, axis_size)
     q_pos = my * sq + jnp.arange(sq)
 
     def step(carry, i):
@@ -91,9 +217,9 @@ def ring_attention_p(q, k, v, axis_name: str = "cp", causal: bool = True):
     return (o / l).astype(q.dtype)
 
 
-@functools.partial(jax.jit, static_argnums=(0, 4, 5))
+@functools.partial(jax.jit, static_argnums=(0, 4, 5, 6))
 def ring_attention(mesh: Mesh, q, k, v, causal: bool = True,
-                   axis_name: str = "cp"):
+                   axis_name: str = "cp", impl: str = "auto"):
     """Sharded entry point: wraps the per-shard kernel in ``shard_map``
     with the framework's activation layout ([batch, seq, heads, head_dim]
     → batch on (dp, fsdp), seq on cp, heads on tp). K/V heads replicate
@@ -116,8 +242,16 @@ def ring_attention(mesh: Mesh, q, k, v, causal: bool = True,
         # both divide: shard both, blocked local repeat stays aligned
         heads = "tp"
     spec = P(("dp", "fsdp"), axis_name, heads, None)
+    # resolve auto BEFORE shard_map (shapes are static) so check_vma is
+    # only relaxed for the flash route: pallas_call outputs carry no
+    # varying-axes type, which the strict vma checker cannot type — the
+    # dense path keeps the checker's trace-time protection
+    if impl == "auto":
+        impl = ("flash" if _ring_flash_eligible(
+            q, k, cp=mesh.shape.get(axis_name, 1)) else "dense")
     fn = jax.shard_map(
         functools.partial(ring_attention_p, axis_name=axis_name,
-                          causal=causal),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+                          causal=causal, impl=impl),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=(impl != "flash"))
     return fn(q, k, v)
