@@ -47,6 +47,11 @@ def _bucket(n: int, lo: int = 16) -> int:
     return b
 
 
+def _pow2_floor(n: int) -> int:
+    """Largest power of two <= n (n >= 1)."""
+    return 1 << (max(n, 1).bit_length() - 1)
+
+
 @dataclass
 class Request:
     """One in-flight generation; ``done`` fires when ``tokens`` is final
@@ -100,27 +105,53 @@ class ContinuousBatchingEngine:
                                        positions)
 
         @partial(jax.jit, donate_argnums=(1,))
-        def _prefill(params, cache, tokens, lane, plen):
-            # tokens [1, bucket] right-padded; lane and plen are TRACED so
-            # only the bucket size (a handful of power-of-two shapes)
-            # triggers a compile. Returns the real last token's logits
-            # (last_pos gathers it pre-LM-head: one vocab projection, not
-            # bucket of them). valid marks the real prompt region:
-            # attention never sees the right-pad anyway (causal +
+        def _prefill(params, cache, tokens, lane, start, n_real):
+            # tokens [1, bucket] right-padded; lane/start/n_real are
+            # TRACED so only the bucket size (a handful of power-of-two
+            # shapes) triggers a compile. The chunk lands at ``start``
+            # (0 for a plain prefill; the prefix length when a cached
+            # prefix was loaded first). Returns the real last token's
+            # logits (last_pos gathers it pre-LM-head: one vocab
+            # projection, not bucket of them). valid marks the live cache
+            # region: attention never sees the right-pad anyway (causal +
             # overwrite-before-attend), but MoE ROUTING must not let pad
             # tokens consume expert capacity.
             row = {k: jax.lax.dynamic_slice_in_dim(v, lane, 1, axis=1)
                    for k, v in cache.items()}
-            valid = (jnp.arange(row["k"].shape[2]) < plen)[None, :]
+            valid = (jnp.arange(row["k"].shape[2]) < start + n_real)[None, :]
             last, row = family.forward_step(cfg, params, tokens, row,
-                                            jnp.int32(0), valid=valid,
-                                            last_pos=plen - 1)
+                                            start, valid=valid,
+                                            last_pos=n_real - 1)
             cache = {k: jax.lax.dynamic_update_slice_in_dim(
                 cache[k], row[k], lane, axis=1) for k in cache}
             return last, cache
 
+        @partial(jax.jit)
+        def _fill_prefix(params, tokens, plen):
+            # build a shared-prefix KV block on a scratch single-lane
+            # cache sized to the bucket (stored bucket-padded; garbage
+            # beyond plen is causally invisible once loaded into a lane)
+            scratch = family.init_cache(cfg, 1, tokens.shape[1])
+            valid = (jnp.arange(tokens.shape[1]) < plen)[None, :]
+            _, scratch = family.forward_step(cfg, params, tokens, scratch,
+                                             jnp.int32(0), valid=valid,
+                                             last_pos=plen - 1)
+            return scratch
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def _load_prefix(cache, stored, lane):
+            # copy a stored prefix KV block into one lane's cache rows
+            def put(c, s):
+                return jax.lax.dynamic_update_slice(
+                    c, s.astype(c.dtype),
+                    (0, lane) + (0,) * (c.ndim - 2))
+            return {k: put(cache[k], stored[k]) for k in cache}
+
         self._decode = _decode
         self._prefill = _prefill
+        self._fill_prefix = _fill_prefix
+        self._load_prefix = _load_prefix
+        self._prefixes: list = []   # (tokens tuple, stored kv, plen)
         self._sample = sample_logits
 
         # live scheduler state: one shared cache + lane bookkeeping; the
@@ -140,6 +171,48 @@ class ContinuousBatchingEngine:
         self._stopped = False
 
     # -- public API -------------------------------------------------------
+
+    def register_prefix(self, tokens: Sequence[int]) -> None:
+        """Prefill a shared prompt prefix ONCE and stash its KV block;
+        later requests whose prompts start with it load the block into
+        their lane and prefill only the suffix — the standard
+        system-prompt optimization. Greedy outputs are unchanged (the
+        loaded KV is exactly what the full prefill would have written)."""
+        tokens = list(tokens)
+        if not tokens:
+            raise ValueError("empty prefix")
+        plen = len(tokens)
+        if plen >= self.max_len:
+            raise ValueError(
+                f"prefix {plen} exceeds cache capacity {self.max_len}")
+        bucket = min(_bucket(plen), self.max_len)
+        toks = np.zeros((1, bucket), np.int32)
+        toks[0, :plen] = tokens
+        stored = self._fill_prefix(self.params, jnp.asarray(toks),
+                                   jnp.int32(plen))
+        key = tuple(tokens)
+        with self._sched_lock:
+            # dedup (re-registering replaces) + longest-first ordering so
+            # the best match wins during admission; swap in a NEW list so
+            # concurrent _match_prefix iterations never see a mid-sort view
+            entries = [p for p in self._prefixes if p[0] != key]
+            entries.append((key, stored, plen))
+            entries.sort(key=lambda p: -p[2])
+            self._prefixes = entries
+
+    def clear_prefixes(self) -> None:
+        """Drop every stored prefix KV block (frees device memory)."""
+        with self._sched_lock:
+            self._prefixes = []
+
+    def _match_prefix(self, prompt: list):
+        for toks, stored, plen in self._prefixes:
+            if len(prompt) >= plen and tuple(prompt[:plen]) == toks:
+                # keep at least one suffix token so the prefill has a
+                # position to read logits from (re-running the prefix's
+                # last token overwrites its own slot with identical KV)
+                return stored, min(plen, len(prompt) - 1)
+        return None, 0
 
     def validate(self, prompt: Sequence[int], max_new: int) -> None:
         """Raise ValueError if the request can never fit the cache —
@@ -252,13 +325,32 @@ class ContinuousBatchingEngine:
             req = self._queue.popleft()
         prompt = req.prompt or [0]
         plen = len(prompt)
-        bucket = min(_bucket(plen), self.max_len)
-        toks = np.zeros((1, bucket), np.int32)
-        toks[0, :plen] = prompt
-        logits, self._cache = self._prefill(self.params, self._cache,
-                                            jnp.asarray(toks),
-                                            jnp.int32(lane_idx),
-                                            jnp.int32(plen))
+        stored, start = self._match_prefix(prompt)
+        if stored is not None:
+            self._cache = self._load_prefix(self._cache, stored,
+                                            jnp.int32(lane_idx))
+        suffix = prompt[start:]
+        plen_total = start + len(suffix)
+        # prefill the suffix in power-of-two chunks that fit the remaining
+        # cache space: keeps the compiled-shape set fixed AND never lets a
+        # padded chunk run past the cache end (jax clamps a too-far
+        # dynamic_update_slice start, which would overwrite the
+        # just-loaded prefix slots). validate() guarantees the suffix fits.
+        pos0, remaining = start, suffix
+        while remaining:
+            space = self.max_len - pos0
+            bucket = min(_bucket(len(remaining)), _pow2_floor(space))
+            n = min(len(remaining), bucket)
+            chunk, remaining = remaining[:n], remaining[n:]
+            toks = np.zeros((1, bucket), np.int32)
+            toks[0, :n] = chunk
+            logits, self._cache = self._prefill(self.params, self._cache,
+                                                jnp.asarray(toks),
+                                                jnp.int32(lane_idx),
+                                                jnp.int32(pos0),
+                                                jnp.int32(n))
+            pos0 += n
+        plen = plen_total
         self._key, sub = jax.random.split(self._key)
         first = int(self._sample(logits, sub, gen.temperature,
                                  gen.top_k)[0])

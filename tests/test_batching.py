@@ -152,6 +152,39 @@ def test_http_server_with_continuous_engine(dense):
         eng.stop()
 
 
+def test_prefix_caching_outputs_unchanged(dense):
+    """register_prefix must be output-invisible: prompts sharing the
+    prefix generate exactly the same greedy tokens as without it (the
+    loaded KV block is bit-what the full prefill writes)."""
+    cfg, params = dense
+    system = [7, 13, 21, 9, 2, 30, 17, 5]
+    requests = [(system + [40, 41], 5), (system + [50], 4),
+                (system, 3),                  # prompt == prefix exactly
+                ([1, 2, 3], 4)]               # no prefix match
+    plain = ContinuousBatchingEngine(cfg, params, lanes=2, max_len=96)
+    want = plain.run(requests)
+
+    cached = ContinuousBatchingEngine(cfg, params, lanes=2, max_len=96)
+    cached.register_prefix(system)
+    got = cached.run(requests)
+    assert got == want, (got, want)
+
+
+def test_prefix_caching_longest_match_wins(dense):
+    cfg, params = dense
+    eng = ContinuousBatchingEngine(cfg, params, lanes=1, max_len=96)
+    eng.register_prefix([7, 13])
+    eng.register_prefix([7, 13, 21, 9])
+    stored, start = eng._match_prefix([7, 13, 21, 9, 40])
+    assert stored is not None and start == 4
+    stored, start = eng._match_prefix([7, 13, 99])
+    assert stored is not None and start == 2
+    stored, start = eng._match_prefix([8, 13])
+    assert stored is None and start == 0
+    with pytest.raises(ValueError):
+        eng.register_prefix([])
+
+
 def test_stop_cancels_waiters(dense):
     """stop() must unblock queued waiters with an error, never hang them."""
     import threading
