@@ -353,7 +353,7 @@ class ContinuousBatchingEngine:
         plen = plen_total
         self._key, sub = jax.random.split(self._key)
         first = int(self._sample(logits, sub, gen.temperature,
-                                 gen.top_k)[0])
+                                 gen.top_k, gen.top_p)[0])
         req.tokens.append(first)
         lane = self._lane_state[lane_idx]
         lane.request, lane.pos = req, plen
@@ -381,7 +381,7 @@ class ContinuousBatchingEngine:
             jnp.asarray(self._pos))
         self._key, sub = jax.random.split(self._key)
         nxt = np.asarray(self._sample(logits, sub, gen.temperature,
-                                      gen.top_k))
+                                      gen.top_k, gen.top_p))
         for i, lane in enumerate(self._lane_state):
             req = lane.request
             if req is None:

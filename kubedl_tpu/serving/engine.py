@@ -35,6 +35,7 @@ class GenerateConfig:
     max_len: int = 1024            # cache capacity (prompt + generated)
     temperature: float = 0.0       # 0 = greedy
     top_k: int = 0                 # 0 = full softmax when sampling
+    top_p: float = 1.0             # nucleus sampling mass (1.0 = off)
     eos_id: int = -1               # -1 = never stop early
 
 
@@ -58,16 +59,31 @@ def maybe_quantize(params: dict, quantize):
     return params
 
 
-@partial(jax.jit, static_argnums=(2, 3))
-def sample_logits(logits, key, temperature, top_k):
-    """Greedy (temperature<=0) or temperature/top-k sampling — the ONE
-    sampler shared by the static and continuous engines."""
+@partial(jax.jit, static_argnums=(2, 3, 4))
+def sample_logits(logits, key, temperature, top_k, top_p=1.0):
+    """Greedy (temperature<=0) or temperature/top-k/top-p sampling — the
+    ONE sampler shared by the static and continuous engines. top-p keeps
+    the smallest set of tokens whose probability mass reaches ``top_p``
+    (nucleus sampling), applied after temperature and top-k."""
     if temperature <= 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits / temperature
     if top_k > 0:
         kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < kth, -1e30, logits)
+    if top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep tokens while the mass BEFORE them is < top_p; the nucleus
+        # ALWAYS includes the top token (even for top_p <= 0, which would
+        # otherwise empty the set and degrade to uniform-over-vocab)
+        keep_sorted = ((cum - probs) < top_p).at[..., 0].set(True)
+        # threshold = smallest kept logit; everything below is cut
+        cutoff = jnp.min(
+            jnp.where(keep_sorted, sorted_logits, jnp.inf),
+            axis=-1, keepdims=True)
+        logits = jnp.where(logits < cutoff, -1e30, logits)
     return jax.random.categorical(key, logits).astype(jnp.int32)
 
 
@@ -130,7 +146,7 @@ class InferenceEngine:
         out: list[list[int]] = [[] for _ in range(b)]
         done = np.zeros((b,), bool)
         cur = np.asarray(
-            self._sample(logits, key, gen.temperature, gen.top_k))
+            self._sample(logits, key, gen.temperature, gen.top_k, gen.top_p))
         pos = int(prompt_len)
         for _ in range(max_new_tokens):
             for i in range(b):
@@ -145,7 +161,7 @@ class InferenceEngine:
                                        jnp.asarray(cur)[:, None],
                                        jnp.int32(pos), valid)
             cur = np.asarray(
-                self._sample(logits, sub, gen.temperature, gen.top_k))
+                self._sample(logits, sub, gen.temperature, gen.top_k, gen.top_p))
             pos += 1
         return out
 

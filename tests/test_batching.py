@@ -152,6 +152,23 @@ def test_http_server_with_continuous_engine(dense):
         eng.stop()
 
 
+def test_top_p_sampler_masks_tail():
+    """Nucleus sampling: with a dominant token and top_p below its mass,
+    only that token can ever be drawn; top_p=1.0 can draw the tail."""
+    from kubedl_tpu.serving.engine import sample_logits
+    logits = jnp.log(jnp.asarray([[0.7, 0.2, 0.06, 0.04]]))
+    draws = {int(sample_logits(logits, jax.random.PRNGKey(i), 1.0, 0, 0.5)[0])
+             for i in range(64)}
+    assert draws == {0}, draws
+    draws_92 = {int(sample_logits(logits, jax.random.PRNGKey(i), 1.0, 0, 0.92)[0])
+                for i in range(200)}
+    assert draws_92 <= {0, 1, 2}    # 0.04-tail token 3 is cut
+    assert {0, 1} <= draws_92
+    draws_all = {int(sample_logits(logits, jax.random.PRNGKey(i), 1.0, 0, 1.0)[0])
+                 for i in range(400)}
+    assert 3 in draws_all
+
+
 def test_prefix_caching_outputs_unchanged(dense):
     """register_prefix must be output-invisible: prompts sharing the
     prefix generate exactly the same greedy tokens as without it (the
