@@ -1,0 +1,131 @@
+"""LoRA: low-rank adapter fine-tuning for the model zoo.
+
+Fine-tunes train two small matrices per weight (W + (x·A)·B·s, rank r)
+instead of the full model — optimizer state shrinks from 2×params to
+2×adapters and the base stays frozen. TPU-shaped design mirrors
+``ops.quant``:
+
+* ``LoraTensor`` is a registered-pytree leaf holding the frozen base and
+  the trainable (A, B) factors; the shared ``mm`` dispatch used by every
+  llama-family matmul computes ``x·W + (x·A)·B·s`` — two skinny matmuls
+  XLA fuses around the main one, no merged copy in HBM during training;
+* the TRAINABLE pytree contains only the adapters: ``merge_params``
+  grafts them onto a closed-over frozen base inside the loss, so the
+  Trainer's Adam state is rank-sized and the base is structurally frozen
+  (not stop-gradient'd — it is never an input to grad at all);
+* ``merge_to_dense`` folds adapters into plain weights for serving (and
+  int8 quantization) with zero inference overhead.
+
+The reference operator ships no training code at all (its jobs run user
+containers); this is TPU-native capability beyond parity.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+#: llama-family weight keys that take adapters by default (the attention
+#: projections — the standard LoRA placement; pass your own list to widen)
+DEFAULT_TARGETS = ("wq", "wk", "wv", "wo")
+
+
+@dataclass(frozen=True)
+class LoraTensor:
+    """Frozen base [in, out] + trainable A [in, r], B [r, out]. ``scale``
+    is pytree METADATA (a static float), so ``lax.scan`` over stacked
+    layers slices only the array leaves."""
+    base: jax.Array
+    a: jax.Array
+    b: jax.Array
+    scale: float
+
+    @property
+    def shape(self):
+        return self.base.shape
+
+
+jax.tree_util.register_dataclass(
+    LoraTensor, data_fields=["base", "a", "b"], meta_fields=["scale"])
+
+
+def mm_lora(x, w: LoraTensor):
+    """x·W + (x·A)·B·scale — called from ``quant.mm``'s dispatch."""
+    y = x @ w.base
+    low = (x @ w.a.astype(x.dtype)) @ w.b.astype(x.dtype)
+    return y + low * jnp.asarray(w.scale, y.dtype)
+
+
+def init_adapters(params: dict, rank: int = 8,
+                  targets=DEFAULT_TARGETS, key=None) -> dict:
+    """Build the trainable adapter pytree for a llama-family param tree:
+    {layer_key: {"a": [(L,) in, r], "b": [(L,) r, out]}} for each target.
+    A is gaussian/√in, B is zeros — the adapted model starts EXACTLY equal
+    to the base (standard LoRA init)."""
+    key = key if key is not None else jax.random.PRNGKey(0)
+    layers = params["layers"]
+    if not isinstance(layers, dict):
+        raise ValueError("LoRA adapters require scan-stacked layers")
+    adapters = {}
+    for i, name in enumerate(sorted(targets)):
+        if name not in layers:
+            raise ValueError(f"target {name!r} not in layer params")
+        w = layers[name]                      # [L, in, out]
+        L, d_in, d_out = w.shape
+        sub = jax.random.fold_in(key, i)
+        adapters[name] = {
+            "a": (jax.random.normal(sub, (L, d_in, rank), jnp.float32)
+                  * (1.0 / math.sqrt(d_in))),
+            "b": jnp.zeros((L, rank, d_out), jnp.float32),
+        }
+    return adapters
+
+
+def adapter_specs(base_specs: dict, adapters: dict) -> dict:
+    """PartitionSpecs for the adapter tree: A shards like the weight's
+    input dim, B like its output dim (rank replicates)."""
+    from jax.sharding import PartitionSpec as P
+    layer_specs = base_specs["layers"]
+    out = {}
+    for name, ab in adapters.items():
+        ws = layer_specs[name]                # P(layer?, in_ax, out_ax)
+        axes = list(ws)
+        lead, in_ax, out_ax = axes[0], axes[-2], axes[-1]
+        out[name] = {"a": P(lead, in_ax, None),
+                     "b": P(lead, None, out_ax)}
+    return out
+
+
+def merge_params(base_params: dict, adapters: dict,
+                 alpha: float = 16.0) -> dict:
+    """Graft adapters onto a frozen base: target weights become
+    LoraTensor leaves (rank read from A), everything else passes through
+    by reference. Call INSIDE the loss with the trainable ``adapters`` as
+    the grad argument and ``base_params`` closed over."""
+    layers = dict(base_params["layers"])
+    for name, ab in adapters.items():
+        rank = ab["a"].shape[-1]
+        layers[name] = LoraTensor(base=base_params["layers"][name],
+                                  a=ab["a"], b=ab["b"],
+                                  scale=alpha / rank)
+    merged = dict(base_params)
+    merged["layers"] = layers
+    return merged
+
+
+def merge_to_dense(base_params: dict, adapters: dict,
+                   alpha: float = 16.0) -> dict:
+    """Fold adapters into plain dense weights (W + A·B·s) for serving —
+    zero inference overhead, composes with int8 quantization."""
+    layers = dict(base_params["layers"])
+    for name, ab in adapters.items():
+        w = base_params["layers"][name]
+        rank = ab["a"].shape[-1]
+        delta = jnp.einsum("lir,lro->lio", ab["a"], ab["b"]) * (alpha / rank)
+        layers[name] = (w.astype(jnp.float32) + delta).astype(w.dtype)
+    merged = dict(base_params)
+    merged["layers"] = layers
+    return merged

@@ -58,10 +58,15 @@ def to_dense(w, dtype=jnp.bfloat16):
 
 
 def mm(x, w):
-    """x @ w for dense arrays or QTensor (dequantize-on-the-fly)."""
+    """x @ w — the ONE matmul dispatch for the llama-family weights:
+    dense arrays, QTensor (int8 dequantize-on-the-fly), or LoraTensor
+    (frozen base + trainable low-rank delta)."""
     if isinstance(w, QTensor):
         y = x @ w.q.astype(x.dtype)
         return y * w.scale.astype(y.dtype)
+    from .lora import LoraTensor, mm_lora
+    if isinstance(w, LoraTensor):
+        return mm_lora(x, w)
     return x @ w
 
 

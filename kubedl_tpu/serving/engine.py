@@ -12,8 +12,8 @@ TPU-shaped autoregressive decoding:
 * **prefill/decode split** — prefill runs the prompt chunk through the
   same cache-aware forward (``kubedl_tpu.models.llama.forward_step``),
   decode feeds one token back per step;
-* greedy or temperature/top-k sampling, per-request stop handling on the
-  host (control flow stays out of the compiled step).
+* greedy or temperature/top-k/top-p sampling, per-request stop handling
+  on the host (control flow stays out of the compiled step).
 """
 
 from __future__ import annotations
