@@ -66,6 +66,15 @@ class LlamaConfig:
     tie_embeddings: bool = False
     #: Gemma-2 final-logit softcap: cap * tanh(logits / cap); 0 = off
     logit_softcap: float = 0.0
+    #: >0: sliding-window (local) attention — every position attends
+    #: only the last ``sliding_window`` keys (Mistral/Gemma-2 style,
+    #: applied uniformly to all layers; incompatible with cp>1 ring)
+    sliding_window: int = 0
+
+    def __post_init__(self):
+        if self.sliding_window < 0:
+            raise ValueError(
+                f"sliding_window must be >= 0, got {self.sliding_window}")
 
     @property
     def hd(self) -> int:
@@ -254,10 +263,14 @@ def attention_block(config: LlamaConfig, x, lp, cos, sin, segment_ids,
     if mesh is not None and mesh.shape.get("cp", 1) > 1 and segment_ids is None:
         # sequence sharded on cp: ring attention keeps the full-sequence
         # attention exact while K/V blocks rotate over ICI
+        if c.sliding_window:
+            raise ValueError("sliding_window is not supported with a "
+                             "cp-sharded sequence (ring attention)")
         attn = ring_attention(mesh, q, k, v, causal=True)
     else:
         attn = multi_head_attention(q, k, v, causal=True,
-                                    segment_ids=segment_ids)
+                                    segment_ids=segment_ids,
+                                    window=c.sliding_window)
     return x + _mm(attn.reshape(b, s, nh * hd), lp["wo"])
 
 
@@ -370,6 +383,9 @@ def attention_step(config: LlamaConfig, x, lp, kc, vc, cos, sin, start_pos,
                         preferred_element_type=jnp.float32)
     k_pos = jnp.arange(max_len)
     mask = (k_pos[None, None, :] <= q_pos[:, :, None])[:, None]  # causal
+    if c.sliding_window:
+        mask = mask & (k_pos[None, None, :]
+                       > q_pos[:, :, None] - c.sliding_window)[:, None]
     if valid is not None:
         mask = mask & valid[:, None, None, :]
     scores = jnp.where(mask, scores, -1e30)

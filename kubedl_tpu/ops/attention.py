@@ -42,15 +42,17 @@ def repeat_kv(k, q_heads: int):
     return jnp.repeat(k, reps, axis=2)
 
 
-def reference_attention(q, k, v, causal=True, segment_ids=None):
+def reference_attention(q, k, v, causal=True, segment_ids=None,
+                        window: int = 0):
     """Naive [b, s, h, hd] attention; float32 softmax."""
+    _check_window(window, causal)
     b, sq, nh, hd = q.shape
     k = repeat_kv(k, nh)
     v = repeat_kv(v, nh)
     scale = 1.0 / math.sqrt(hd)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                         k.astype(jnp.float32)) * scale
-    mask = _build_mask(sq, k.shape[1], causal, segment_ids)
+    mask = _build_mask(sq, k.shape[1], causal, segment_ids, window)
     if mask is not None:
         scores = jnp.where(mask, scores, _NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
@@ -58,13 +60,26 @@ def reference_attention(q, k, v, causal=True, segment_ids=None):
     return out.astype(q.dtype)
 
 
-def _build_mask(sq, sk, causal, segment_ids):
+def _check_window(window: int, causal: bool) -> None:
+    if window < 0:
+        raise ValueError(f"window must be >= 0, got {window}")
+    if window > 0 and not causal:
+        raise ValueError(
+            "sliding window requires causal attention (a non-causal "
+            "local window is not implemented; this would otherwise "
+            "silently return dense attention)")
+
+
+def _build_mask(sq, sk, causal, segment_ids, window: int = 0):
     """[b or 1, 1, sq, sk] boolean keep-mask, or None."""
     mask = None
     if causal:
         rows = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
         cols = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
-        mask = (cols <= rows)[None, None]
+        keep = cols <= rows
+        if window > 0:
+            keep = keep & (cols > rows - window)
+        mask = keep[None, None]
     if segment_ids is not None:
         seg = segment_ids[:, None, :, None] == segment_ids[:, None, None, :]
         mask = seg if mask is None else jnp.logical_and(mask, seg)
@@ -76,8 +91,9 @@ def _build_mask(sq, sk, causal, segment_ids):
 # ---------------------------------------------------------------------------
 
 def chunked_attention(q, k, v, causal=True, segment_ids=None,
-                      block_k: int = 512):
+                      block_k: int = 512, window: int = 0):
     """Online-softmax attention, scanning K/V blocks: O(sq*block_k) memory."""
+    _check_window(window, causal)
     b, sq, nh, hd = q.shape
     sk = k.shape[1]
     k = repeat_kv(k, nh)
@@ -117,6 +133,9 @@ def chunked_attention(q, k, v, causal=True, segment_ids=None,
         keep = block_cols + j * block_k < sk
         if causal:
             keep = jnp.logical_and(keep, block_cols + j * block_k <= rows)
+            if window > 0:
+                keep = jnp.logical_and(
+                    keep, block_cols + j * block_k > rows - window)
         keep = keep[None, None]
         if segment_ids is not None:
             keep = jnp.logical_and(
@@ -145,13 +164,29 @@ def chunked_attention(q, k, v, causal=True, segment_ids=None,
 # pallas flash kernel (forward)
 # ---------------------------------------------------------------------------
 
-def _causal_keep(block_q: int, block_k: int, q_off, k_off):
+def _causal_keep(block_q: int, block_k: int, q_off, k_off, window: int = 0):
     """[block_q, block_k] keep-mask for absolute row offset ``q_off`` and
     column offset ``k_off`` — the ONE causal boundary definition shared by
-    the forward and both backward kernels (they must never disagree)."""
+    the forward and both backward kernels (they must never disagree).
+    ``window > 0`` additionally restricts each row to the last ``window``
+    positions (sliding-window / local attention, Mistral/Gemma-2 style:
+    a row attends keys in (row - window, row])."""
     rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0) + q_off
     cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1) + k_off
-    return cols <= rows
+    keep = cols <= rows
+    if window > 0:
+        keep = keep & (cols > rows - window)
+    return keep
+
+
+def _kv_lower(q_block_idx, block_q: int, block_k: int, window: int):
+    """Inclusive lower bound on k-block index a windowed q block can
+    see: blocks entirely before (first_row - window, ...] are skipped —
+    this is where sliding window earns its ~seq/window compute cut."""
+    if window <= 0:
+        return 0
+    first_col = q_block_idx * block_q - (window - 1)
+    return jnp.maximum(0, first_col // block_k)
 
 
 def _kv_upper(q_block_idx, block_q: int, block_k: int, num_kb: int,
@@ -175,7 +210,7 @@ def _seg_keep(seg_q_ref, seg_k_ref, j, block_k: int):
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, *rest, block_q, block_k,
-                  sk, causal, has_seg, has_off):
+                  sk, causal, has_seg, has_off, window=0):
     """One (batch*head, q-block) program; K/V blocks streamed via fori_loop.
     Block shapes carry a leading singleton (batch*head) dim: q [1, block_q,
     hd], k/v [1, sk, hd], o [1, block_q, hd]. With ``has_seg`` two extra
@@ -216,7 +251,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, *rest, block_q, block_k,
         if causal:
             keep = _causal_keep(block_q, block_k,
                                 q_off + q_block_idx * block_q,
-                                k_off + j * block_k)
+                                k_off + j * block_k, window)
         if has_seg:
             seg = _seg_keep(seg_q_ref, seg_k_ref, j, block_k)
             keep = seg if keep is None else keep & seg
@@ -231,15 +266,18 @@ def _flash_kernel(q_ref, k_ref, v_ref, *rest, block_q, block_k,
         row_sum = row_sum * alpha + p.sum(axis=-1, keepdims=True)
         return acc, new_max, row_sum
 
-    # the diagonal-skip is a local-index optimization; with global offsets
-    # the diagonal can sit anywhere, so run all blocks (mask is exact)
+    # the diagonal/window skips are local-index optimizations; with
+    # global offsets the diagonal can sit anywhere, so run all blocks
+    # (mask is exact)
     upper = (num_kb if has_off else
              _kv_upper(q_block_idx, block_q, block_k, num_kb, causal))
+    lower = (0 if has_off or not causal else
+             _kv_lower(q_block_idx, block_q, block_k, window))
     acc0 = jnp.zeros((block_q, hd), jnp.float32)
     max0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
     sum0 = jnp.zeros((block_q, 1), jnp.float32)
     acc, row_max, row_sum = jax.lax.fori_loop(
-        0, upper, body, (acc0, max0, sum0))
+        lower, upper, body, (acc0, max0, sum0))
     safe_sum = jnp.maximum(row_sum, 1e-37)
     o_ref[0] = (acc / safe_sum).astype(o_ref.dtype)
     lse_ref[0] = (row_max + jnp.log(safe_sum))[:, 0]
@@ -254,7 +292,7 @@ def _kv_index(i, nh: int, nkv: int):
 
 
 def _flash_forward(q, k, v, causal, segment_ids=None, offsets=None,
-                   block_q=128, block_k=128, interpret=False):
+                   window=0, block_q=128, block_k=128, interpret=False):
     """q [b, sq, nh, hd]; k/v [b, sk, nkv, hd] (kv-head space, GQA-native);
     segment_ids [b, s] (optional packed-sequence ids; sq == sk then);
     offsets (optional traced (q_off, k_off) global positions for the
@@ -293,7 +331,8 @@ def _flash_forward(q, k, v, causal, segment_ids=None, offsets=None,
 
     kernel = functools.partial(_flash_kernel, block_q=block_q,
                                block_k=block_k, sk=sk, causal=causal,
-                               has_seg=has_seg, has_off=has_off)
+                               has_seg=has_seg, has_off=has_off,
+                               window=window)
     out, lse = pl.pallas_call(
         kernel,
         grid=(b * nh, sq // block_q),
@@ -316,7 +355,8 @@ def _flash_forward(q, k, v, causal, segment_ids=None, offsets=None,
 # ---------------------------------------------------------------------------
 
 def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
-                     block_q, block_k, sk, causal, has_seg, has_off):
+                     block_q, block_k, sk, causal, has_seg, has_off,
+                     window=0):
     """dQ for one (batch*head, q-block): stream K/V blocks, recompute
     p = exp(s - lse), then ds = p * (dO·Vᵀ - Δ) and dq += ds · K.
     Δ = rowsum(dO ∘ O) is precomputed outside (flash-2 backward)."""
@@ -353,7 +393,7 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
         if causal:
             keep = _causal_keep(block_q, block_k,
                                 q_off + q_block_idx * block_q,
-                                k_off + j * block_k)
+                                k_off + j * block_k, window)
         if has_seg:
             seg = _seg_keep(seg_q_ref, seg_k_ref, j, block_k)
             keep = seg if keep is None else keep & seg
@@ -370,14 +410,16 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
 
     upper = (num_kb if has_off else
              _kv_upper(q_block_idx, block_q, block_k, num_kb, causal))
+    lower = (0 if has_off or not causal else
+             _kv_lower(q_block_idx, block_q, block_k, window))
     dq = jax.lax.fori_loop(
-        0, upper, body, jnp.zeros((block_q, hd), jnp.float32))
+        lower, upper, body, jnp.zeros((block_q, hd), jnp.float32))
     dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
 
 
 def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                       *rest, block_q, block_k, sq, causal, reps, has_seg,
-                      has_off):
+                      has_off, window=0):
     """dK/dV for one (batch*kv-head, k-block, rep) program: stream the q
     blocks that can see this k block, accumulate dv += pᵀ·dO and
     dk += dsᵀ·q. GQA-native: the rep axis is the FASTEST grid dim, each
@@ -425,7 +467,7 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if causal:
             keep = _causal_keep(block_q, block_k,
                                 q_off + i * block_q,
-                                k_off + k_block_idx * block_k)
+                                k_off + k_block_idx * block_k, window)
         if has_seg:
             sq_ids = seg_q_ref[0, pl.ds(i * block_q, block_q)]
             sk_ids = seg_k_ref[0]                            # [block_k]
@@ -452,8 +494,13 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     # is exact)
     lower = (0 if (not causal or has_off)
              else (k_block_idx * block_k) // block_q)
+    upper_q = num_qb
+    if causal and not has_off and window > 0:
+        # q rows past (last k col + window - 1) can't see this block
+        last_row = (k_block_idx + 1) * block_k - 1 + (window - 1)
+        upper_q = jnp.minimum(num_qb, last_row // block_q + 1)
     zeros = jnp.zeros((block_k, hd), jnp.float32)
-    dk, dv = jax.lax.fori_loop(lower, num_qb, body, (zeros, zeros))
+    dk, dv = jax.lax.fori_loop(lower, upper_q, body, (zeros, zeros))
     dk_acc_ref[...] += dk
     dv_acc_ref[...] += dv
 
@@ -464,7 +511,7 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_backward(q, k, v, o, lse, g, causal, segment_ids=None,
-                    offsets=None, block_q=128, block_k=128,
+                    offsets=None, window=0, block_q=128, block_k=128,
                     interpret=False):
     """Flash-2 backward, GQA-native. q/o/g are [b, sq, nh, hd]; k/v are
     [b, sk, nkv, hd] (kv-head space, never repeated in HBM); lse is
@@ -493,7 +540,8 @@ def _flash_backward(q, k, v, o, lse, g, causal, segment_ids=None,
 
     dq_kernel = functools.partial(_flash_dq_kernel, block_q=block_q,
                                   block_k=block_k, sk=sk, causal=causal,
-                                  has_seg=has_seg, has_off=has_off)
+                                  has_seg=has_seg, has_off=has_off,
+                                  window=window)
     dq_in_specs = [
         pl.BlockSpec((1, block_q, hd), lambda i, j: (i, j, 0)),
         pl.BlockSpec((1, sk, hd), lambda i, j: (kv_of(i), 0, 0)),
@@ -528,7 +576,7 @@ def _flash_backward(q, k, v, o, lse, g, causal, segment_ids=None,
     dkv_kernel = functools.partial(_flash_dkv_kernel, block_q=block_q,
                                    block_k=block_k, sq=sq, causal=causal,
                                    reps=reps, has_seg=has_seg,
-                                   has_off=has_off)
+                                   has_off=has_off, window=window)
     from jax.experimental.pallas import tpu as pltpu
     dkv_in_specs = [
         pl.BlockSpec((1, sq, hd), lambda i, j, r: (reps * i + r, 0, 0)),
@@ -571,20 +619,20 @@ def _flash_backward(q, k, v, o, lse, g, causal, segment_ids=None,
     return unflat(dq, nh, sq), unflat(dk, nkv, sk), unflat(dv, nkv, sk)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
-def _flash_attention(q, k, v, segment_ids, causal, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash_attention(q, k, v, segment_ids, causal, interpret, window=0):
     out, _ = _flash_forward(q, k, v, causal, segment_ids=segment_ids,
-                            interpret=interpret)
+                            window=window, interpret=interpret)
     return out
 
 
-def _flash_fwd(q, k, v, segment_ids, causal, interpret):
+def _flash_fwd(q, k, v, segment_ids, causal, interpret, window=0):
     out, lse = _flash_forward(q, k, v, causal, segment_ids=segment_ids,
-                              interpret=interpret)
+                              window=window, interpret=interpret)
     return out, (q, k, v, segment_ids, out, lse)
 
 
-def _flash_bwd(causal, interpret, residuals, g):
+def _flash_bwd(causal, interpret, window, residuals, g):
     q, k, v, segment_ids, o, lse = residuals
     # segment ids are integers: their cotangent is the symbolic float0
     dseg = (np.zeros(segment_ids.shape, jax.dtypes.float0)
@@ -595,12 +643,13 @@ def _flash_bwd(causal, interpret, residuals, g):
         # the train step; already-compiled executables keep their backward.
         _, vjp = jax.vjp(
             lambda q_, k_, v_: chunked_attention(
-                q_, k_, v_, causal=causal, segment_ids=segment_ids),
+                q_, k_, v_, causal=causal, segment_ids=segment_ids,
+                window=window),
             q, k, v)
         dq, dk, dv = vjp(g)
         return dq, dk, dv, dseg
     dq, dk, dv = _flash_backward(q, k, v, o, lse, g, causal,
-                                 segment_ids=segment_ids,
+                                 segment_ids=segment_ids, window=window,
                                  interpret=interpret)
     return dq, dk.astype(k.dtype), dv.astype(v.dtype), dseg
 
@@ -624,21 +673,27 @@ def _on_tpu() -> bool:
 
 
 def multi_head_attention(q, k, v, causal: bool = True, segment_ids=None,
-                         impl: Optional[str] = None):
-    """q [b, s, nh, hd]; k/v [b, s, nkv, hd] (GQA) -> [b, s, nh, hd]."""
+                         impl: Optional[str] = None, window: int = 0):
+    """q [b, s, nh, hd]; k/v [b, s, nkv, hd] (GQA) -> [b, s, nh, hd].
+    ``window > 0``: sliding-window (local) attention — each position
+    attends only the last ``window`` keys (causal only)."""
+    _check_window(window, causal)
     b, sq, nh, hd = q.shape
     if impl is None:
         aligned = (sq % 128 == 0 and k.shape[1] % 128 == 0
                    and hd % 128 == 0)
         impl = "pallas" if (_on_tpu() and aligned) else "chunked"
     if impl == "pallas":
-        return _flash_attention(q, k, v, segment_ids, causal, False)
+        return _flash_attention(q, k, v, segment_ids, causal, False,
+                                window)
     if impl == "pallas_interpret":  # CI path for the kernel itself
-        return _flash_attention(q, k, v, segment_ids, causal, True)
+        return _flash_attention(q, k, v, segment_ids, causal, True,
+                                window)
     if impl == "chunked":
         return chunked_attention(q, k, v, causal=causal,
-                                 segment_ids=segment_ids)
+                                 segment_ids=segment_ids, window=window)
     if impl == "reference":
         return reference_attention(q, k, v, causal=causal,
-                                   segment_ids=segment_ids)
+                                   segment_ids=segment_ids,
+                                   window=window)
     raise ValueError(f"unknown attention impl {impl!r}")

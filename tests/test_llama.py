@@ -248,3 +248,33 @@ def test_token_file_rejects_undersized_shard(tmp_path):
     np.arange(3 * (seq + 1), dtype=np.int32).tofile(tmp_path / "small.bin")
     with pytest.raises(ValueError, match="token file too small"):
         TokenFileDataset(str(tmp_path / "small.bin"), seq, batch_size=4)
+
+
+def test_sliding_window_model_paths_agree():
+    """sliding_window through the model: full forward vs incremental
+    decode agree, and both differ from the unwindowed model."""
+    import dataclasses
+
+    import numpy as np
+
+    cfg = dataclasses.replace(llama.tiny(vocab=64), dtype=jnp.float32,
+                              sliding_window=8)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 24), 0, 64)
+
+    full = llama.forward(cfg, params, tokens)
+    cache = llama.init_cache(cfg, 1, 32)
+    logits, cache = llama.forward_step(cfg, params, tokens[:, :12], cache,
+                                       jnp.int32(0))
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(full[:, 11]),
+                               rtol=2e-4, atol=2e-4)
+    for t in range(12, 24):
+        logits, cache = llama.forward_step(cfg, params, tokens[:, t:t + 1],
+                                           cache, jnp.int32(t))
+        np.testing.assert_allclose(np.asarray(logits),
+                                   np.asarray(full[:, t]),
+                                   rtol=2e-4, atol=2e-4)
+
+    nowin = dataclasses.replace(cfg, sliding_window=0)
+    assert float(jnp.max(jnp.abs(
+        llama.forward(nowin, params, tokens) - full))) > 1e-3
