@@ -37,7 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models import llama
-from .engine import GenerateConfig
+from .engine import GenerateConfig, token_logprobs
 
 
 def _bucket(n: int, lo: int = 16) -> int:
@@ -55,10 +55,14 @@ def _pow2_floor(n: int) -> int:
 @dataclass
 class Request:
     """One in-flight generation; ``done`` fires when ``tokens`` is final
-    (or the engine stopped — then ``cancelled`` is set)."""
+    (or the engine stopped — then ``cancelled`` is set). With
+    ``want_logprobs`` each generated token's full-softmax log p lands
+    in ``logprobs``."""
     prompt: list
     max_new: int
     tokens: list = field(default_factory=list)
+    logprobs: list = field(default_factory=list)
+    want_logprobs: bool = False
     done: threading.Event = field(default_factory=threading.Event)
     cancelled: bool = False
 
@@ -224,11 +228,13 @@ class ContinuousBatchingEngine:
                 f"prompt {plen} + new {max_new} exceeds cache capacity "
                 f"{self.max_len}")
 
-    def submit(self, prompt: Sequence[int], max_new: int) -> Request:
+    def submit(self, prompt: Sequence[int], max_new: int,
+               logprobs: bool = False) -> Request:
         """Enqueue one generation; returns a Request whose ``result()``
         blocks until finished. Thread-safe."""
         self.validate(prompt, max_new)
-        req = Request(prompt=list(prompt), max_new=max_new)
+        req = Request(prompt=list(prompt), max_new=max_new,
+                      want_logprobs=logprobs)
         if max_new <= 0:
             req.done.set()         # nothing requested: empty output
             return req
@@ -355,6 +361,9 @@ class ContinuousBatchingEngine:
         first = int(self._sample(logits, sub, gen.temperature,
                                  gen.top_k, gen.top_p)[0])
         req.tokens.append(first)
+        if req.want_logprobs:
+            req.logprobs.append(float(token_logprobs(
+                logits, jnp.asarray([first]))[0]))
         lane = self._lane_state[lane_idx]
         lane.request, lane.pos = req, plen
         lane.remaining = req.max_new - 1
@@ -382,12 +391,19 @@ class ContinuousBatchingEngine:
         self._key, sub = jax.random.split(self._key)
         nxt = np.asarray(self._sample(logits, sub, gen.temperature,
                                       gen.top_k, gen.top_p))
+        lane_lps = None
+        if any(l.request is not None and l.request.want_logprobs
+               for l in self._lane_state):
+            lane_lps = np.asarray(token_logprobs(logits,
+                                                 jnp.asarray(nxt)))
         for i, lane in enumerate(self._lane_state):
             req = lane.request
             if req is None:
                 continue
             tok = int(nxt[i])
             req.tokens.append(tok)
+            if req.want_logprobs:
+                req.logprobs.append(float(lane_lps[i]))
             lane.pos += 1
             lane.remaining -= 1
             self._cur[i, 0] = tok

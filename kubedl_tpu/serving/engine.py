@@ -59,6 +59,16 @@ def maybe_quantize(params: dict, quantize):
     return params
 
 
+@jax.jit
+def token_logprobs(logits, tokens):
+    """log p(token) under the FULL softmax of ``logits`` [b, vocab] for
+    the chosen ``tokens`` [b] — reported per generated token when the
+    client asks for logprobs (always the unfiltered distribution, so the
+    numbers are comparable across sampling settings)."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return jnp.take_along_axis(logp, tokens[:, None], axis=-1)[:, 0]
+
+
 @partial(jax.jit, static_argnums=(2, 3, 4))
 def sample_logits(logits, key, temperature, top_k, top_p=1.0):
     """Greedy (temperature<=0) or temperature/top-k/top-p sampling — the
@@ -111,9 +121,11 @@ class InferenceEngine:
     # -- public API -------------------------------------------------------
 
     def generate(self, prompts: Sequence[Sequence[int]], max_new_tokens: int,
-                 seed: int = 0) -> list:
+                 seed: int = 0, return_logprobs: bool = False) -> list:
         """Batch-generate continuations. ``prompts`` are token-id lists;
-        returns one list of generated ids per prompt (stops at eos).
+        returns one list of generated ids per prompt (stops at eos), or
+        (ids, logprobs) pairs with ``return_logprobs`` (full-softmax log
+        p of each generated token).
 
         Ragged batches are **left-padded**: every row's last real token sits
         at the bucket end, so one shared decode position works for the whole
@@ -144,14 +156,19 @@ class InferenceEngine:
                                    jnp.int32(0), valid)
         key = jax.random.PRNGKey(seed)
         out: list[list[int]] = [[] for _ in range(b)]
+        lps: list[list[float]] = [[] for _ in range(b)]
         done = np.zeros((b,), bool)
         cur = np.asarray(
             self._sample(logits, key, gen.temperature, gen.top_k, gen.top_p))
+        cur_lp = (np.asarray(token_logprobs(logits, jnp.asarray(cur)))
+                  if return_logprobs else None)
         pos = int(prompt_len)
         for _ in range(max_new_tokens):
             for i in range(b):
                 if not done[i]:
                     out[i].append(int(cur[i]))
+                    if return_logprobs:
+                        lps[i].append(float(cur_lp[i]))
                     if gen.eos_id >= 0 and int(cur[i]) == gen.eos_id:
                         done[i] = True
             if done.all() or pos + 1 > gen.max_len:
@@ -162,7 +179,11 @@ class InferenceEngine:
                                        jnp.int32(pos), valid)
             cur = np.asarray(
                 self._sample(logits, sub, gen.temperature, gen.top_k, gen.top_p))
+            if return_logprobs:
+                cur_lp = np.asarray(token_logprobs(logits, jnp.asarray(cur)))
             pos += 1
+        if return_logprobs:
+            return [(o, lp) for o, lp in zip(out, lps)]
         return out
 
     def score_throughput(self, batch: int, prompt_len: int,

@@ -85,7 +85,7 @@ class InferenceServer:
             raise ValueError(
                 f"batch {len(instances)} exceeds max_batch "
                 f"{self.config.max_batch}")
-        prompts, caps = [], []
+        prompts, caps, want_lp = [], [], []
         for inst in instances:
             toks = inst.get("prompt_tokens")
             if not isinstance(toks, list) or not toks:
@@ -93,6 +93,7 @@ class InferenceServer:
             prompts.append([int(t) for t in toks])
             caps.append(min(int(inst.get("max_tokens", 16)),
                             self.config.max_new_tokens))
+            want_lp.append(bool(inst.get("logprobs")))
         if hasattr(self.engine, "submit"):
             # continuous-batching engine: each instance rides its own lane
             # (its background loop serializes device work — no lock), so a
@@ -101,17 +102,30 @@ class InferenceServer:
             # instance must 400 without burning lanes on discarded output.
             for p, cap in zip(prompts, caps):
                 self.engine.validate(p, cap)
-            reqs = [self.engine.submit(p, cap)
-                    for p, cap in zip(prompts, caps)]
+            reqs = [self.engine.submit(p, cap, logprobs=lp)
+                    for p, cap, lp in zip(prompts, caps, want_lp)]
             timeout = self.config.request_timeout_s
-            return {"predictions": [{"tokens": r.result(timeout=timeout)}
-                                    for r in reqs]}
+            preds = []
+            for r, lp in zip(reqs, want_lp):
+                pred = {"tokens": r.result(timeout=timeout)}
+                if lp:
+                    pred["logprobs"] = r.logprobs
+                preds.append(pred)
+            return {"predictions": preds}
         # static engine: decode to the longest request in one lockstep
         # batch, trim per instance to its own cap
+        wl = any(want_lp)
         with self._gen_lock:
-            outs = self.engine.generate(prompts, max(caps))
-        return {"predictions": [{"tokens": o[:cap]}
-                                for o, cap in zip(outs, caps)]}
+            outs = self.engine.generate(prompts, max(caps),
+                                        return_logprobs=wl)
+        preds = []
+        for o, cap, lp in zip(outs, caps, want_lp):
+            toks, lps = o if wl else (o, None)
+            pred = {"tokens": toks[:cap]}
+            if lp:
+                pred["logprobs"] = lps[:cap]
+            preds.append(pred)
+        return {"predictions": preds}
 
     def status(self) -> dict:
         return {"model_version_status": [{

@@ -152,6 +152,28 @@ def test_http_server_with_continuous_engine(dense):
         eng.stop()
 
 
+def test_logprobs_reported_and_consistent(dense):
+    """Both engines report full-softmax logprobs for their greedy tokens;
+    greedy logprobs must agree between the static and continuous paths."""
+    import math
+
+    cfg, params = dense
+    prompt = [5, 7, 11]
+    static = InferenceEngine(cfg, params, GenerateConfig(max_len=96))
+    (toks_s, lps_s), = static.generate([prompt], 5, return_logprobs=True)
+    assert len(lps_s) == 5
+    assert all(-50.0 < lp <= 0.0 for lp in lps_s)
+    assert all(not math.isnan(lp) for lp in lps_s)
+
+    eng = ContinuousBatchingEngine(cfg, params, lanes=1, max_len=96)
+    req = eng.submit(prompt, 5, logprobs=True)
+    eng.run([])  # drain inline (request already queued)
+    toks_c = req.result()
+    assert toks_c == toks_s
+    for a, b in zip(req.logprobs, lps_s):
+        assert abs(a - b) < 1e-4, (req.logprobs, lps_s)
+
+
 def test_top_p_sampler_masks_tail():
     """Nucleus sampling: with a dominant token and top_p below its mass,
     only that token can ever be drawn; top_p=1.0 can draw the tail."""
