@@ -37,7 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models import llama
-from .engine import GenerateConfig, token_logprobs
+from .engine import GenerateConfig, hit_stop, token_logprobs
 
 
 def _bucket(n: int, lo: int = 16) -> int:
@@ -369,8 +369,7 @@ class ContinuousBatchingEngine:
         lane.remaining = req.max_new - 1
         self._cur[lane_idx, 0] = first
         self._pos[lane_idx] = plen
-        if (lane.remaining <= 0
-                or (gen.eos_id >= 0 and first == gen.eos_id)):
+        if lane.remaining <= 0 or hit_stop(req.tokens, gen):
             lane.request = None    # finished in prefill
             req.done.set()
 
@@ -408,8 +407,7 @@ class ContinuousBatchingEngine:
             lane.remaining -= 1
             self._cur[i, 0] = tok
             self._pos[i] = lane.pos
-            if (lane.remaining <= 0
-                    or (gen.eos_id >= 0 and tok == gen.eos_id)
+            if (lane.remaining <= 0 or hit_stop(req.tokens, gen)
                     or lane.pos + 1 >= self.max_len):
                 lane.request = None   # lane freed for the next arrival
                 req.done.set()

@@ -37,6 +37,23 @@ class GenerateConfig:
     top_k: int = 0                 # 0 = full softmax when sampling
     top_p: float = 1.0             # nucleus sampling mass (1.0 = off)
     eos_id: int = -1               # -1 = never stop early
+    #: multi-token stop sequences (host-side suffix match after each
+    #: generated token; the matched suffix stays in the output)
+    stop_sequences: tuple = ()
+
+
+def hit_stop(tokens: list, gen: "GenerateConfig") -> bool:
+    """True when the generated tokens end in eos or any stop sequence —
+    the ONE stop rule shared by the static and continuous engines."""
+    if not tokens:
+        return False
+    if gen.eos_id >= 0 and tokens[-1] == gen.eos_id:
+        return True
+    for seq in gen.stop_sequences:
+        seq = list(seq)
+        if seq and tokens[-len(seq):] == seq:
+            return True
+    return False
 
 
 def resolve_family(config):
@@ -123,9 +140,10 @@ class InferenceEngine:
     def generate(self, prompts: Sequence[Sequence[int]], max_new_tokens: int,
                  seed: int = 0, return_logprobs: bool = False) -> list:
         """Batch-generate continuations. ``prompts`` are token-id lists;
-        returns one list of generated ids per prompt (stops at eos), or
-        (ids, logprobs) pairs with ``return_logprobs`` (full-softmax log
-        p of each generated token).
+        returns one list of generated ids per prompt (stops at eos or any
+        configured stop sequence — see ``hit_stop``), or (ids, logprobs)
+        pairs with ``return_logprobs`` (full-softmax log p of each
+        generated token).
 
         Ragged batches are **left-padded**: every row's last real token sits
         at the bucket end, so one shared decode position works for the whole
@@ -169,7 +187,7 @@ class InferenceEngine:
                     out[i].append(int(cur[i]))
                     if return_logprobs:
                         lps[i].append(float(cur_lp[i]))
-                    if gen.eos_id >= 0 and int(cur[i]) == gen.eos_id:
+                    if hit_stop(out[i], gen):
                         done[i] = True
             if done.all() or pos + 1 > gen.max_len:
                 break

@@ -264,3 +264,21 @@ def test_quantized_continuous(dense):
                                    quantize="int8")
     got = eng.run([([5, 7, 11], 4), ([3], 3)])
     assert [len(t) for t in got] == [4, 3]
+
+
+def test_stop_sequences_both_engines(dense):
+    """A multi-token stop sequence halts generation the moment the output
+    ends with it — identically in the static and continuous engines."""
+    cfg, params = dense
+    # learn what greedy emits, then use its 2nd-3rd tokens as the stop seq
+    base = _solo_greedy(cfg, params, [5, 7, 11], 6)
+    stop = tuple(base[1:3])
+    gen = GenerateConfig(max_len=96, stop_sequences=(stop,))
+
+    static = InferenceEngine(cfg, params, gen)
+    out_s = static.generate([[5, 7, 11]], 6)[0]
+    assert out_s == base[:3]           # stops right after the match
+    cont = ContinuousBatchingEngine(cfg, params, lanes=1, max_len=96,
+                                    gen=gen)
+    out_c = cont.run([([5, 7, 11], 6)])[0]
+    assert out_c == out_s
