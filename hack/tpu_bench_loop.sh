@@ -5,33 +5,69 @@
 # rapid probe/timeout cycles keep re-wedging it for the next client. This
 # loop therefore makes ONE in-process connection per attempt (bench.py
 # BENCH_SKIP_PROBE=1, watchdog-guarded) and then goes fully quiet for a long
-# interval before retrying. Exits after the first successful TPU bench.
+# interval before retrying. The on-silicon kernel selftest
+# (hack/tpu_selftest.py, VERDICT r3 next #2) piggybacks on the bench's
+# connection; the loop exits once BOTH artifacts exist:
+# BENCH_TPU_CACHE.json and a complete TPU_SELFTEST.json.
 set -u
 cd "$(dirname "$0")/.."
 INTERVAL="${PROBE_INTERVAL:-900}"
-LOG="${TPU_LOOP_LOG:-/tmp/tpu_bench_loop.log}"
+# log INSIDE the repo (VERDICT r3 next #1: the attempt must be auditable
+# either way — the driver commits uncommitted files at round end, so the
+# log survives even if the round ends abruptly)
+LOG="${TPU_LOOP_LOG:-BENCH_TPU_LOOP_r04.log}"
+
+# artifacts committed by a PREVIOUS round must not suppress this round's
+# attempts: drop anything older than 12h (matches bench.py's cache age gate)
+find BENCH_TPU_CACHE.json TPU_SELFTEST.json -mmin +720 -delete 2>/dev/null
+
+selftest_complete() {
+  python - <<'EOF' 2>/dev/null
+import json, sys
+try:
+    st = json.load(open("TPU_SELFTEST.json"))
+except Exception:
+    sys.exit(1)
+sys.exit(0 if st.get("complete") else 1)
+EOF
+}
 
 while true; do
-  echo "$(date -Is) attempting bench (single connection)" >>"$LOG"
-  if BENCH_SKIP_PROBE=1 BENCH_NO_CPU_FALLBACK=1 BENCH_HARD_DEADLINE_S=2100 \
-      timeout 2200 python bench.py >/tmp/bench_tpu_out.json 2>>"$LOG"; then
-    line=$(tail -1 /tmp/bench_tpu_out.json)
-    # only cache a real TPU result (not a cpu fallback / failure line)
-    if python - "$line" <<'EOF'
+  if [ ! -f BENCH_TPU_CACHE.json ]; then
+    echo "$(date -Is) attempting bench (single connection, selftest piggybacked)" >>"$LOG"
+    # deadline covers bench (~10min incl. compile) + on-silicon selftest
+    # (hack/tpu_selftest.py rides the same connection, BENCH_RUN_SELFTEST=1)
+    if BENCH_SKIP_PROBE=1 BENCH_NO_CPU_FALLBACK=1 BENCH_RUN_SELFTEST=1 \
+        BENCH_HARD_DEADLINE_S=3300 \
+        timeout 3400 python bench.py >/tmp/bench_tpu_out.json 2>>"$LOG"; then
+      line=$(tail -1 /tmp/bench_tpu_out.json)
+      # only cache a real TPU result (not a cpu fallback / failure line)
+      if python - "$line" <<'EOF'
 import json, sys
 r = json.loads(sys.argv[1])
 ok = r.get("ok") and r.get("value", 0) > 0 \
      and not r.get("cached") and not r.get("error")
 sys.exit(0 if ok else 1)
 EOF
-    then
-      cp /tmp/bench_tpu_out.json BENCH_TPU_CACHE.json
-      echo "$(date -Is) cached TPU bench: $line" >>"$LOG"
-      exit 0
+      then
+        cp /tmp/bench_tpu_out.json BENCH_TPU_CACHE.json
+        echo "$(date -Is) cached TPU bench: $line" >>"$LOG"
+      else
+        echo "$(date -Is) bench ran but not a TPU number: $line" >>"$LOG"
+      fi
+    else
+      echo "$(date -Is) bench attempt failed/timed out" >>"$LOG"
     fi
-    echo "$(date -Is) bench ran but not a TPU number: $line" >>"$LOG"
   else
-    echo "$(date -Is) bench attempt failed/timed out" >>"$LOG"
+    # bench already cached this round; only the selftest is outstanding
+    echo "$(date -Is) bench cached; attempting standalone selftest" >>"$LOG"
+    timeout 1900 python hack/tpu_selftest.py >>"$LOG" 2>&1 \
+      || echo "$(date -Is) selftest attempt failed/timed out" >>"$LOG"
+  fi
+
+  if [ -f BENCH_TPU_CACHE.json ] && selftest_complete; then
+    echo "$(date -Is) bench + selftest both captured; watcher done" >>"$LOG"
+    exit 0
   fi
   echo "$(date -Is) going quiet for ${INTERVAL}s" >>"$LOG"
   sleep "$INTERVAL"
