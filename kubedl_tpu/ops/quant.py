@@ -72,7 +72,8 @@ def mm(x, w):
 
 #: param-dict keys that hold large matmul weights worth quantizing; embed
 #: stays fp (it is gathered, not matmul'd), norms/router are tiny/precision-
-#: sensitive, MoE expert stacks contract via einsum (not yet covered)
+#: sensitive. MoE expert stacks (w_gate/w_up/w_down) ARE quantized: they
+#: contract via einsum, so _moe_block densifies QTensor stacks per use
 QUANTIZABLE = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "lm_head")
 
 

@@ -80,6 +80,11 @@ class _Lane:
     pos: int = 0               # next write position (== tokens so far)
     remaining: int = 0
 
+    def reset(self) -> None:
+        self.request = None
+        self.pos = 0
+        self.remaining = 0
+
 
 class ContinuousBatchingEngine:
     """Slot-scheduled generation over one shared cache.
@@ -263,9 +268,41 @@ class ContinuousBatchingEngine:
         reqs = [self.submit(p, n) for p, n in requests]
         if self._thread is None:
             with self._sched_lock:
-                while self._step_once():
-                    pass
+                try:
+                    while self._step_once():
+                        pass
+                except BaseException:
+                    # _prefill/_decode donate self._cache: an abort
+                    # mid-step leaves a consumed buffer behind, and the
+                    # next inline call would hit a confusing
+                    # donated-buffer error. Restore invariants (mirrors
+                    # SpeculativeEngine's reset-on-failure) and cancel
+                    # in-flight requests so waiters unblock.
+                    self._recover_locked()
+                    raise
         return [r.result() for r in reqs]
+
+    def _recover_locked(self) -> None:
+        """Reinitialize the donated cache + lane state after a failed
+        inline step. Caller holds ``_sched_lock`` (``_cancel_all`` cannot
+        be used here: it takes the non-reentrant lock itself)."""
+        # queue snapshot must hold _cv: submit() appends under _cv only,
+        # so clearing under _sched_lock alone could silently drop (and
+        # forever block) a concurrently submitted request
+        with self._cv:
+            abandoned = list(self._queue)
+            self._queue.clear()
+        for lane in self._lane_state:
+            if lane.request is not None:
+                abandoned.append(lane.request)
+            lane.reset()
+        for req in abandoned:
+            req.cancelled = True
+            req.done.set()
+        self._cache = self.family.init_cache(self.config, self.lanes,
+                                             self.max_len)
+        self._cur = np.zeros((self.lanes, 1), np.int32)
+        self._pos = np.zeros((self.lanes,), np.int32)
 
     def start(self) -> "ContinuousBatchingEngine":
         """Run the scheduler on a background thread (HTTP serving mode)."""

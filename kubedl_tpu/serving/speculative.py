@@ -33,7 +33,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .batching import _bucket
-from .engine import maybe_quantize, resolve_family
+from .engine import GenerateConfig, hit_stop, maybe_quantize, resolve_family
 
 
 @dataclass
@@ -105,9 +105,15 @@ class SpeculativeEngine:
         self._d_cache = self.dfam.init_cache(self.dc, 1, self.max_len)
 
     def generate(self, prompt: Sequence[int], max_new_tokens: int,
-                 stats: Optional[SpecStats] = None) -> list:
+                 stats: Optional[SpecStats] = None,
+                 gen: Optional[GenerateConfig] = None) -> list:
         """Greedy continuation of ``prompt`` — identical tokens to the
-        target's own greedy decode, fewer target passes."""
+        target's own greedy decode, fewer target passes.
+
+        ``gen`` carries eos_id/stop_sequences; the shared ``hit_stop``
+        rule is applied to every emitted token (a verified chunk is
+        truncated at the first stop), so outputs stay identical to the
+        static/continuous engines' greedy decode under the same config."""
         prompt = list(prompt) or [0]
         plen = len(prompt)
         if plen + max_new_tokens > self.max_len:
@@ -115,7 +121,7 @@ class SpeculativeEngine:
                 f"prompt {plen} + new {max_new_tokens} exceeds "
                 f"cache capacity {self.max_len}")
         try:
-            return self._generate(prompt, plen, max_new_tokens, stats)
+            return self._generate(prompt, plen, max_new_tokens, stats, gen)
         except BaseException:
             # ANY abort (including KeyboardInterrupt) between a donating
             # call and its reassignment can leave a consumed buffer on
@@ -123,8 +129,19 @@ class SpeculativeEngine:
             self._reset_caches()
             raise
 
-    def _generate(self, prompt, plen, max_new_tokens, stats):
+    def _generate(self, prompt, plen, max_new_tokens, stats, gen=None):
         k = self.k
+
+        def stop_len(out, start):
+            """Length to truncate ``out`` to if a stop lands in
+            ``out[start:]`` (the suffix rule must see every token, not
+            just the last of a verified chunk); None = no stop."""
+            if gen is None:
+                return None
+            for i in range(start, len(out)):
+                if hit_stop(out[:i + 1], gen):
+                    return i + 1
+            return None
         # engine-held caches, rewritten in place every call (stale slots
         # from a previous request are causally invisible: the fresh
         # prefill's masks start over at position 0)
@@ -141,6 +158,12 @@ class SpeculativeEngine:
         _, d_cache = self._d_prefill(self.dp, d_cache, toks, jnp.int32(plen))
 
         out = [y]
+        cut = stop_len(out, 0)
+        if cut is not None:
+            self._t_cache, self._d_cache = t_cache, d_cache
+            # min(): never emit past the budget — the static engine stops
+            # at max_new_tokens without ever seeing a later stop token
+            return out[:min(cut, max_new_tokens)]
         pos = plen            # tokens verified into both caches so far
         # a round only pays off when >= 2 tokens are still wanted (it
         # costs k draft steps + one verify); the single-token tail below
@@ -176,7 +199,19 @@ class SpeculativeEngine:
                 stats.proposed += k
                 stats.accepted += accepted
             emitted = list(drafts[:accepted]) + [int(targets[accepted])]
+            before = len(out)
             out.extend(emitted)
+            cut = stop_len(out, before)
+            if cut is not None:
+                # a stop landed inside the verified chunk: both caches
+                # already hold the full chunk, but stale slots past any
+                # future pos are causally invisible, so truncating the
+                # host-side output is sufficient
+                self._t_cache, self._d_cache = t_cache, d_cache
+                # a full round can overshoot the budget by up to k+1;
+                # a stop past max_new_tokens is one the static engine
+                # never generates, so the budget wins
+                return out[:min(cut, max_new_tokens)]
             if accepted == k:
                 # fully accepted: d_k is now part of the sequence (slot
                 # pos+k) but the draft cache never ingested it (it was
@@ -199,5 +234,8 @@ class SpeculativeEngine:
             y = int(nxt[0])
             out.append(y)
             pos += 1
+            cut = stop_len(out, len(out) - 1)
+            if cut is not None:
+                break
         self._t_cache, self._d_cache = t_cache, d_cache
         return out[:max_new_tokens]

@@ -282,3 +282,30 @@ def test_stop_sequences_both_engines(dense):
                                     gen=gen)
     out_c = cont.run([([5, 7, 11], 6)])[0]
     assert out_c == out_s
+
+
+def test_inline_failure_recovers_cache(dense):
+    """An exception mid-inline-step must not strand the donated cache:
+    in-flight requests are cancelled and the NEXT inline run works
+    (ADVICE r3: inline callers used to hit donated-buffer errors)."""
+    cfg, params = dense
+    eng = ContinuousBatchingEngine(cfg, params, lanes=2, max_len=96)
+    want = eng.run([([3, 1], 6)])[0]          # healthy baseline
+
+    real_decode = eng._decode
+    calls = {"n": 0}
+
+    def boom(*a, **kw):
+        calls["n"] += 1
+        raise RuntimeError("injected decode failure")
+
+    eng._decode = boom
+    with pytest.raises(RuntimeError, match="injected"):
+        eng.run([([3, 1], 6), ([9, 2], 4)])
+    assert calls["n"] == 1
+    # lanes + queue fully drained, waiters unblocked as cancelled
+    assert all(l.request is None for l in eng._lane_state)
+    assert not eng._queue
+
+    eng._decode = real_decode
+    assert eng.run([([3, 1], 6)])[0] == want  # cache was reinitialized

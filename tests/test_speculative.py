@@ -75,3 +75,40 @@ def test_capacity_guard(models):
     spec = SpeculativeEngine(tcfg, tparams, dcfg, dparams, max_len=32)
     with pytest.raises(ValueError):
         spec.generate([1] * 30, 8)
+
+
+def test_stop_sequences_match_static_engine(models):
+    """gen.eos_id / stop_sequences truncate speculative output exactly
+    where the static engine's shared hit_stop rule truncates greedy
+    decoding (ADVICE r3: generate() used to ignore stops entirely)."""
+    tcfg, tparams, dcfg, dparams = models
+    spec = SpeculativeEngine(tcfg, tparams, dcfg, dparams, k=4, max_len=128)
+    prompt = [5, 7, 11]
+    plain = _plain_greedy(tcfg, tparams, prompt, 16)
+
+    # pick a token that actually occurs mid-stream as the stop anchor so
+    # the test exercises a truncation, not just the no-stop path
+    anchor_idx = len(plain) // 2
+    eos = plain[anchor_idx]
+    gen = GenerateConfig(max_len=128, eos_id=eos)
+    eng = InferenceEngine(tcfg, tparams, gen)
+    want = eng.generate([prompt], 16)[0]
+    got = spec.generate(prompt, 16, gen=gen)
+    assert got == want
+    assert len(got) <= anchor_idx + 1
+
+    # multi-token stop sequence ending at the anchor
+    if anchor_idx >= 1:
+        stop = tuple(plain[anchor_idx - 1:anchor_idx + 1])
+        gen2 = GenerateConfig(max_len=128, stop_sequences=(stop,))
+        eng2 = InferenceEngine(tcfg, tparams, gen2)
+        want2 = eng2.generate([prompt], 16)[0]
+        got2 = spec.generate(prompt, 16, gen=gen2)
+        assert got2 == want2
+
+
+def test_no_gen_config_is_unchanged(models):
+    """Without a GenerateConfig the engine still emits max_new_tokens."""
+    tcfg, tparams, dcfg, dparams = models
+    spec = SpeculativeEngine(tcfg, tparams, dcfg, dparams, k=3, max_len=128)
+    assert len(spec.generate([1, 2], 9)) == 9
