@@ -19,22 +19,15 @@ from __future__ import annotations
 from ...api import common as c
 from ...core import meta as m
 from ...tpu import placement as pl
+from ..elastic import (ANNOTATION_WORLD_SIZE, PODINFO_MOUNT_PATH,
+                       PODINFO_VOLUME, ElasticInPlaceMixin)
 from ..interface import TPUPolicy, WorkloadController
 
-ANNOTATION_WORLD_SIZE = "world-size"
-PODINFO_VOLUME = "kubedl-podinfo"
-PODINFO_MOUNT_PATH = "/etc/kubedl-podinfo"
+__all__ = ["PyTorchJobController", "ANNOTATION_WORLD_SIZE",
+           "PODINFO_VOLUME", "PODINFO_MOUNT_PATH"]
 
 
-def _restart_count(pod) -> int:
-    """Max container restartCount — the signal kubelet bumps on an
-    in-place container restart (the CRR completion analog)."""
-    statuses = m.get_in(pod, "status", "containerStatuses", default=[]) or []
-    return max((int(s.get("restartCount", 0) or 0) for s in statuses),
-               default=0)
-
-
-class PyTorchJobController(WorkloadController):
+class PyTorchJobController(ElasticInPlaceMixin, WorkloadController):
     kind = "PyTorchJob"
     api_version = "training.kubedl.io/v1alpha1"
     default_container_name = "pytorch"
@@ -93,8 +86,7 @@ class PyTorchJobController(WorkloadController):
         elif has_master:
             rank += 1  # workers follow the master (reference :238)
 
-        world = sum(int(rs.replicas or 1) for rt_, rs in replicas.items()
-                    if rt_ != c.REPLICA_AIMASTER)
+        world = self.elastic_world(replicas)
         elastic = self.enable_elastic_scaling(job, None)
         for ct in m.get_in(pod, "spec", "containers", default=[]) or []:
             pl.upsert_env(ct, "MASTER_PORT", master_port)
@@ -104,177 +96,6 @@ class PyTorchJobController(WorkloadController):
             if TPUPolicy.from_job(job) is not None:
                 pl.upsert_env(ct, "PJRT_DEVICE", "TPU")
             if elastic:
-                # world size via downward-API annotation so in-place restarts
-                # observe the resized world (reference :274-295)
-                m.set_in(pod, "metadata", "annotations",
-                         {**(m.get_in(pod, "metadata", "annotations") or {}),
-                          ANNOTATION_WORLD_SIZE: str(world)})
-                pl.upsert_env(ct, "WORLD_SIZE", value_from={
-                    "fieldRef": {"fieldPath":
-                                 f"metadata.annotations['{ANNOTATION_WORLD_SIZE}']"}})
-                pl.upsert_env(ct, "KUBEDL_PODINFO_ANNOTATIONS",
-                              PODINFO_MOUNT_PATH + "/annotations")
-                mounts = ct.setdefault("volumeMounts", [])
-                if not any(v.get("name") == PODINFO_VOLUME for v in mounts):
-                    mounts.append({"name": PODINFO_VOLUME,
-                                   "mountPath": PODINFO_MOUNT_PATH,
-                                   "readOnly": True})
-                pod["spec"]["restartPolicy"] = c.RESTART_ON_FAILURE
+                self.render_elastic_world(pod, ct, world)
             else:
                 pl.upsert_env(ct, "WORLD_SIZE", world)
-        if elastic:
-            # downward-API annotations file the restart agent tails (the
-            # file updates live when the operator patches the pod; env
-            # fieldRefs only re-resolve on container restart)
-            vols = pod["spec"].setdefault("volumes", [])
-            if not any(v.get("name") == PODINFO_VOLUME for v in vols):
-                vols.append({"name": PODINFO_VOLUME, "downwardAPI": {
-                    "items": [{"path": "annotations", "fieldRef": {
-                        "fieldPath": "metadata.annotations"}}]}})
-
-    def enable_elastic_scaling(self, job, run_policy):
-        return m.meta(job).get("annotations", {}).get(
-            c.ANNOTATION_ENABLE_ELASTIC) == "true"
-
-    # -- elastic checkpoint protocol (reference elastic_scale.go) ---------
-
-    def checkpoint_if_necessary(self, job, pods) -> bool:
-        """2-phase generation-versioned protocol (reference
-        elastic_scale.go:118-182): victims (deleting pods still held by the
-        preempt-protector finalizer) trigger a checkpoint *request* at the
-        job's current generation; the AIMaster acks by writing the matching
-        *completed* version; only then are victims released. Returns True
-        when no checkpoint is in flight (scaling may proceed)."""
-        if self.api is None:
-            return True
-        ann = m.annotations(job)
-        gen = m.generation(job)
-        victims = [p for p in pods if m.is_deleting(p)
-                   and c.FINALIZER_PREEMPT_PROTECTOR in m.finalizers(p)]
-        requested = int(ann.get(c.ANNOTATION_CKPT_REQUESTED_VERSION, 0) or 0)
-        completed = int(ann.get(c.ANNOTATION_CKPT_COMPLETED_VERSION, 0) or 0)
-        if not victims:
-            return completed >= requested
-        if requested < gen:
-            # phase 1: controller requests a checkpoint at this generation
-            self.api.patch_merge(self.kind, m.namespace(job), m.name(job), {
-                "metadata": {"annotations": {
-                    c.ANNOTATION_CKPT_REQUESTED_VERSION: str(gen)}}})
-            return False
-        if completed < requested:
-            return False  # phase 2 pending: AIMaster hasn't acked
-        # checkpoint done for this generation: release victims
-        for p in victims:
-            fresh = self.api.try_get("Pod", m.namespace(p), m.name(p))
-            if fresh is None:
-                continue
-            m.meta(fresh)["finalizers"] = [
-                f for f in m.finalizers(fresh)
-                if f != c.FINALIZER_PREEMPT_PROTECTOR]
-            self.api.update(fresh)
-        return True
-
-    def scale_out(self, job, replicas, pods, services):
-        return self._scale(job, replicas, pods)
-
-    def scale_in(self, job, replicas, pods, services):
-        return self._scale(job, replicas, pods)
-
-    #: seconds to wait for an in-place restart to be confirmed before
-    #: falling back to delete+recreate (trainers not wrapped in the
-    #: restart agent never restart in place)
-    restart_fallback_seconds = 120.0
-
-    def _scale(self, job, replicas, pods):
-        """Slice-preserving in-place restart (reference
-        ``elastic_scale.go:196-400``).
-
-        The reference restarts stale-generation containers through
-        OpenKruise ContainerRecreateRequests so each pod keeps its node —
-        and on GKE TPU, its slice — across a resize. The portable analog
-        is a 2-phase protocol per surviving stale pod:
-
-        1. *Request*: patch the pod in place — fresh ``world-size``
-           annotation, restart-request annotation at the job's generation,
-           plus the pod's current restartCount as the confirmation basis.
-           The in-container agent (``runtime.restart_agent``) sees the
-           annotation move through the downward-API file, exits the
-           trainer, and kubelet restarts the container inside the SAME
-           pod; the downward-API ``WORLD_SIZE`` env re-resolves on
-           restart. Pod UID, node binding, and the slice's PodGroup all
-           survive.
-        2. *Confirm*: when the pod's restartCount moves past the recorded
-           basis (the CRR-status analog), stamp the generation label so
-           the pod counts as current. If it never moves within
-           ``restart_fallback_seconds`` — the trainer isn't wrapped in
-           the agent, or the agent died — fall back to delete+recreate,
-           which is always correct but surrenders the slice.
-
-        Master is refreshed before workers (``elastic_scale.go:224-240``);
-        the master's name — hence its headless-service DNS — is stable, so
-        no service refresh is needed (the reference relabels its master
-        svc per generation because it re-creates the master pod). Pods
-        beyond the new replica count are deleted by the engine diff loop;
-        missing indexes are created at the new generation.
-
-        Returns a requeue delay while confirmations are pending.
-        """
-        if self.api is None:
-            return None
-        gen = m.generation(job)
-        ann = m.annotations(job)
-        if ann.get(c.ANNOTATION_READY_TO_START_WORKER, "true") == "false" and \
-                ann.get(c.ANNOTATION_IMMEDIATELY_START_WORKER) != "true":
-            return None
-        world = sum(int(rs.replicas or 1) for rt_, rs in replicas.items()
-                    if rt_ != c.REPLICA_AIMASTER)
-        counts = {rt_.lower(): int(rs.replicas or 1)
-                  for rt_, rs in replicas.items()}
-        stale = [p for p in pods
-                 if m.labels(p).get(c.LABEL_GENERATION, str(gen)) != str(gen)
-                 and not m.is_deleting(p)]
-        stale.sort(key=lambda p: (
-            0 if m.labels(p).get(c.LABEL_JOB_ROLE) == "master" else 1,
-            m.labels(p).get(c.LABEL_REPLICA_INDEX, "0")))
-        pending = False
-        for p in stale:
-            rt = m.labels(p).get(c.LABEL_REPLICA_TYPE, "")
-            try:
-                index = int(m.labels(p).get(c.LABEL_REPLICA_INDEX, "0"))
-            except ValueError:
-                index = 0
-            if index >= counts.get(rt, 0):
-                continue  # excess replica: engine diff loop deletes it
-            pod_ann = m.annotations(p)
-            if pod_ann.get(c.ANNOTATION_RESTART_REQUESTED_GENERATION) \
-                    != str(gen):
-                # phase 1: request the in-place restart
-                self.api.patch_merge("Pod", m.namespace(p), m.name(p), {
-                    "metadata": {"annotations": {
-                        ANNOTATION_WORLD_SIZE: str(world),
-                        c.ANNOTATION_RESTART_REQUESTED_GENERATION: str(gen),
-                        c.ANNOTATION_RESTART_BASIS_RESTARTS:
-                            str(_restart_count(p)),
-                        c.ANNOTATION_RESTART_REQUESTED_AT:
-                            m.rfc3339(self.api.now()),
-                    }}})
-                pending = True
-                continue
-            # phase 2: confirm or fall back
-            basis = int(pod_ann.get(c.ANNOTATION_RESTART_BASIS_RESTARTS, 0)
-                        or 0)
-            if _restart_count(p) > basis:
-                self.api.patch_merge("Pod", m.namespace(p), m.name(p), {
-                    "metadata": {"labels": {c.LABEL_GENERATION: str(gen)}}})
-                continue
-            requested_at = m.parse_rfc3339(
-                pod_ann.get(c.ANNOTATION_RESTART_REQUESTED_AT, ""))
-            if requested_at is not None and \
-                    self.api.now() - requested_at > self.restart_fallback_seconds:
-                try:
-                    self.api.delete("Pod", m.namespace(p), m.name(p))
-                except Exception:
-                    pass
-            else:
-                pending = True
-        return min(self.restart_fallback_seconds / 4, 30.0) if pending else None

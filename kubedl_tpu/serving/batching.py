@@ -366,6 +366,11 @@ class ContinuousBatchingEngine:
             if not self._queue:
                 return
             req = self._queue.popleft()
+        # attach BEFORE the prefill work: a failure mid-prefill must leave
+        # the request visible to _recover_locked (a popped-but-unattached
+        # request would never be cancelled and its waiter would hang)
+        lane = self._lane_state[lane_idx]
+        lane.request = req
         prompt = req.prompt or [0]
         plen = len(prompt)
         stored, start = self._match_prefix(prompt)
@@ -401,8 +406,7 @@ class ContinuousBatchingEngine:
         if req.want_logprobs:
             req.logprobs.append(float(token_logprobs(
                 logits, jnp.asarray([first]))[0]))
-        lane = self._lane_state[lane_idx]
-        lane.request, lane.pos = req, plen
+        lane.pos = plen
         lane.remaining = req.max_new - 1
         self._cur[lane_idx, 0] = first
         self._pos[lane_idx] = plen

@@ -132,14 +132,20 @@ class SpeculativeEngine:
     def _generate(self, prompt, plen, max_new_tokens, stats, gen=None):
         k = self.k
 
+        # longest suffix hit_stop can match: eos (1) or any stop sequence
+        win = 1 if gen is None else max(
+            [1] + [len(s) for s in gen.stop_sequences])
+
         def stop_len(out, start):
             """Length to truncate ``out`` to if a stop lands in
             ``out[start:]`` (the suffix rule must see every token, not
-            just the last of a verified chunk); None = no stop."""
+            just the last of a verified chunk); None = no stop. Only the
+            trailing ``win`` tokens per position are sliced, keeping the
+            scan O(win) per token instead of O(len(out))."""
             if gen is None:
                 return None
             for i in range(start, len(out)):
-                if hit_stop(out[:i + 1], gen):
+                if hit_stop(out[max(0, i + 1 - win):i + 1], gen):
                     return i + 1
             return None
         # engine-held caches, rewritten in place every call (stale slots

@@ -1,0 +1,117 @@
+"""Training payload for the operator<->compute e2e (not a test module).
+
+Plays the user's training container: reads the operator's rendezvous
+contract from the environment the engine rendered (KUBEDL_NUM_PROCESSES
+via the downward-API world-size annotation), trains the tiny Llama on a
+virtual CPU mesh whose data-parallel width IS the world size, and
+checkpoints every step via Orbax so an in-place elastic restart (the
+restart agent SIGTERMs this process) resumes with loss continuity at the
+new world size.
+
+Driven by tests/test_e2e_train.py, wrapped in
+``kubedl_tpu.runtime.restart_agent`` exactly as a real elastic container
+would be (docs/elastic.md). Env contract (set by the test's "kubelet"):
+
+* ``KUBEDL_NUM_PROCESSES`` — resolved fieldRef to the pod's world-size
+  annotation (re-resolves on each container restart)
+* ``KUBEDL_E2E_LOG`` — jsonl progress log the test asserts on
+* ``KUBEDL_E2E_CKPT`` — Orbax checkpoint directory
+* ``KUBEDL_E2E_TOTAL_STEPS`` / ``KUBEDL_E2E_STEP_SLEEP``
+"""
+
+import dataclasses
+import json
+import os
+import sys
+import time
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kubedl_tpu.runtime.bootstrap import pin_platform  # noqa: E402
+
+pin_platform("cpu")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from kubedl_tpu.models import llama  # noqa: E402
+from kubedl_tpu.parallel.mesh import MeshConfig, build_mesh  # noqa: E402
+from kubedl_tpu.train.checkpoint import (CheckpointConfig,  # noqa: E402
+                                         CheckpointManager)
+from kubedl_tpu.train.data import (shard_batch,  # noqa: E402
+                                   synthetic_lm_batches)
+from kubedl_tpu.train.trainer import TrainConfig, Trainer  # noqa: E402
+
+
+def log(rec: dict) -> None:
+    with open(os.environ["KUBEDL_E2E_LOG"], "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
+def main() -> None:
+    # the operator's rendezvous contract: world size from the
+    # downward-API annotation (via fieldRef env), like bootstrap's
+    # initialize_distributed would consume on a real slice
+    world = int(os.environ["KUBEDL_NUM_PROCESSES"])
+    total = int(os.environ.get("KUBEDL_E2E_TOTAL_STEPS", "20"))
+    pause = float(os.environ.get("KUBEDL_E2E_STEP_SLEEP", "0.05"))
+
+    cfg = dataclasses.replace(llama.tiny(vocab=128, seq=64),
+                              dtype=jnp.float32)
+    batch, seq = 4, 32
+    # the world size is the dp width of the mesh: a resize changes how
+    # the same global batch shards, and Orbax reshards the checkpoint
+    mesh = build_mesh(MeshConfig(dp=world, fsdp=1), jax.devices()[:world])
+
+    def loss(p, b):
+        return llama.loss_fn(cfg, p, b["tokens"], b["targets"])
+
+    trainer = Trainer(loss, llama.param_specs(cfg), mesh,
+                      TrainConfig(warmup_steps=2, decay_steps=100, seed=0))
+    ckpt = CheckpointManager(CheckpointConfig(
+        directory=os.environ["KUBEDL_E2E_CKPT"], save_interval_steps=1,
+        max_to_keep=3, async_save=False))
+
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    state = trainer.init_state(params)
+
+    # fixed eval batch: the continuity probe. Its loss depends only on
+    # the params, so eval(restored step) must equal eval(saved step)
+    # across the restart even though the mesh width changed.
+    fixed = next(synthetic_lm_batches(batch, seq, cfg.vocab_size, seed=123))
+
+    def eval_loss(st):
+        b = shard_batch(fixed, mesh)
+        return float(loss(st.params, b))
+
+    restored = ckpt.restore(trainer.abstract_state(state))
+    if restored is not None:
+        state = restored
+        log({"restored": int(jax.device_get(state.step)), "world": world,
+             "eval": eval_loss(state)})
+
+    stream = synthetic_lm_batches(batch, seq, cfg.vocab_size, seed=7)
+    step = int(jax.device_get(state.step))
+    # replay the stream to the current step so data follows the schedule
+    for _ in range(step):
+        next(stream)
+    while step < total:
+        b = shard_batch(next(stream), mesh)
+        state, l = trainer.step(state, b)
+        step += 1
+        ckpt.save(state, step=step, periodic=True)
+        log({"step": step, "loss": float(l), "eval": eval_loss(state),
+             "world": world})
+        time.sleep(pause)
+    ckpt.wait_until_finished()
+    log({"done": True, "world": world, "final_step": step})
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,231 @@
+"""Operator<->compute e2e (VERDICT r3 next #3): a JAXJob submitted to the
+standalone control plane whose container process REALLY runs the training
+stack on the virtual CPU mesh, resized mid-run through the in-place
+elastic path.
+
+The test plays kubelet: it resolves the engine-rendered env (downward-API
+fieldRefs included), renders the downward-API annotations file the
+restart agent tails, launches the container command — the real
+``kubedl_tpu.runtime.restart_agent`` wrapping ``tests/e2e_payload.py`` —
+and restarts the container (same pod!) when the agent exits, bumping
+restartCount exactly as kubelet would.
+
+Proves the two halves compose: ``kubectl apply`` -> pods with rendezvous
+env -> actual training steps -> operator-driven resize -> agent-driven
+in-place restart -> Orbax resume at the new world size with loss
+continuity. Reference shape: fake-reconcile-then-inspect of
+``controllers/tensorflow/tfjob_controller_test.go``, extended through the
+payload."""
+
+import json
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from kubedl_tpu.api import common as c
+from kubedl_tpu.controllers.elastic import ANNOTATION_WORLD_SIZE
+from kubedl_tpu.controllers.registry import OperatorConfig, build_operator
+from kubedl_tpu.core import meta as m
+
+REPO = str(pathlib.Path(__file__).resolve().parents[1])
+PAYLOAD = str(pathlib.Path(__file__).with_name("e2e_payload.py"))
+
+
+def jax_job(workers=1):
+    return {
+        "apiVersion": "training.kubedl.io/v1alpha1", "kind": "JAXJob",
+        "metadata": {"name": "tj", "namespace": "default",
+                     "annotations": {c.ANNOTATION_ENABLE_ELASTIC: "true"}},
+        "spec": {"jaxReplicaSpecs": {
+            "Worker": {"replicas": workers, "restartPolicy": "OnFailure",
+                       "template": {"spec": {"containers": [
+                           {"name": "jax", "image": "img",
+                            "command": ["python", "-m",
+                                        "kubedl_tpu.runtime.restart_agent",
+                                        "--", "python", "train.py"],
+                            "ports": [{"name": "jaxjob-port",
+                                       "containerPort": 8476}]}]}}},
+        }},
+    }
+
+
+@pytest.fixture
+def op(api):
+    return build_operator(api, OperatorConfig(
+        workloads=["JAXJob"], gang_scheduler_name="coscheduler"))
+
+
+def reconcile_running(api, op):
+    op.run_until_idle(max_iterations=100)
+    for pod in api.list("Pod"):
+        if not m.get_in(pod, "status", "phase"):
+            pod["status"] = {"phase": "Running"}
+            api.update_status(pod)
+    op.run_until_idle(max_iterations=100)
+
+
+def render_annotations_file(pod, path) -> None:
+    """kubelet's downward-API volume rendering of metadata.annotations."""
+    lines = []
+    for k, v in sorted(m.annotations(pod).items()):
+        v = str(v).replace("\\", r"\\").replace('"', r"\"")
+        lines.append(f'{k}="{v}"')
+    tmp = str(path) + ".tmp"
+    with open(tmp, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    os.replace(tmp, str(path))  # kubelet swaps atomically too
+
+
+def resolve_env(pod, extra) -> dict:
+    """kubelet's env resolution for the first container: literal values
+    pass through; annotation fieldRefs resolve against the pod object."""
+    env = dict(os.environ)
+    env.update(extra)
+    ct = pod["spec"]["containers"][0]
+    for e in ct.get("env", []):
+        if "value" in e:
+            env[e["name"]] = str(e["value"])
+            continue
+        ref = (e.get("valueFrom") or {}).get("fieldRef", {})
+        path = ref.get("fieldPath", "")
+        if path.startswith("metadata.annotations['"):
+            key = path[len("metadata.annotations['"):-2]
+            env[e["name"]] = str(m.annotations(pod).get(key, ""))
+    # the payload must not think it is on the axon relay
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def spawn_container(pod, ann_file, extra_env):
+    """Launch the pod's container command the way kubelet would: the
+    restart agent as PID 1 wrapping the payload."""
+    env = resolve_env(pod, extra_env)
+    env["KUBEDL_PODINFO_ANNOTATIONS"] = str(ann_file)
+    env["KUBEDL_RESTART_POLL_S"] = "0.1"
+    env["PYTHONPATH"] = REPO
+    return subprocess.Popen(
+        [sys.executable, "-m", "kubedl_tpu.runtime.restart_agent", "--",
+         sys.executable, "-u", PAYLOAD],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+
+
+def read_log(path):
+    try:
+        with open(path) as f:
+            return [json.loads(ln) for ln in f if ln.strip()]
+    except FileNotFoundError:
+        return []
+
+
+def wait_for(cond, timeout=180.0, interval=0.2, what="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        got = cond()
+        if got:
+            return got
+        time.sleep(interval)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def test_jaxjob_elastic_train_e2e(api, op, tmp_path):
+    log_file = tmp_path / "progress.jsonl"
+    ckpt_dir = tmp_path / "ckpt"
+    ann_file = tmp_path / "annotations"
+    extra = {"KUBEDL_E2E_LOG": str(log_file),
+             "KUBEDL_E2E_CKPT": str(ckpt_dir),
+             "KUBEDL_E2E_TOTAL_STEPS": "16",
+             "KUBEDL_E2E_STEP_SLEEP": "0.3"}
+
+    # kubectl apply -> reconcile -> one worker pod, Running
+    api.create(jax_job(workers=1))
+    reconcile_running(api, op)
+    pod = api.get("Pod", "default", "tj-worker-0")
+    uid0 = m.uid(pod)
+    assert m.annotations(pod)[ANNOTATION_WORLD_SIZE] == "1"
+    # the engine rendered the elastic contract: world size resolves
+    # through the downward-API annotation, not a baked literal
+    ct = pod["spec"]["containers"][0]
+    by_name = {e["name"]: e for e in ct["env"]}
+    ref = by_name["KUBEDL_NUM_PROCESSES"]["valueFrom"]["fieldRef"]["fieldPath"]
+    assert ANNOTATION_WORLD_SIZE in ref
+
+    # kubelet: mount the downward API + start the container
+    render_annotations_file(pod, ann_file)
+    proc = spawn_container(pod, ann_file, extra)
+    try:
+        # real training steps happen at world=1
+        steps = wait_for(
+            lambda: [r for r in read_log(log_file) if "step" in r],
+            what="first training steps")
+        wait_for(lambda: len([r for r in read_log(log_file)
+                              if "step" in r]) >= 3,
+                 what=">=3 training steps")
+        assert steps[0]["world"] == 1
+
+        # operator-driven resize 1 -> 2 workers mid-run
+        job = api.get("JAXJob", "default", "tj")
+        job["spec"]["jaxReplicaSpecs"]["Worker"]["replicas"] = 2
+        api.update(job)
+        op.run_until_idle(max_iterations=100)
+
+        # the pod was PATCHED in place, never deleted
+        pod = api.get("Pod", "default", "tj-worker-0")
+        assert m.uid(pod) == uid0
+        ann = m.annotations(pod)
+        assert ann[ANNOTATION_WORLD_SIZE] == "2"
+        gen = str(m.generation(api.get("JAXJob", "default", "tj")))
+        assert ann[c.ANNOTATION_RESTART_REQUESTED_GENERATION] == gen
+
+        # kubelet refreshes the downward-API file; the agent notices and
+        # exits the trainer with the restart code
+        render_annotations_file(pod, ann_file)
+        code = proc.wait(timeout=120)
+        assert code == 64 + signal.SIGTERM
+        pre = [r for r in read_log(log_file) if "step" in r]
+        assert pre, "no steps recorded before the restart"
+        last_saved = max(r["step"] for r in pre)
+
+        # kubelet restarts the container IN the same pod: restartCount
+        # moves, the operator confirms by stamping the generation label
+        pod["status"]["containerStatuses"] = [
+            {"name": "jax", "restartCount": 1}]
+        api.update_status(pod)
+        op.run_until_idle(max_iterations=100)
+        pod = api.get("Pod", "default", "tj-worker-0")
+        assert m.uid(pod) == uid0
+        assert m.labels(pod)[c.LABEL_GENERATION] == gen
+
+        # the restarted container re-resolves env from the patched pod
+        render_annotations_file(pod, ann_file)
+        proc = spawn_container(pod, ann_file, extra)
+        out, _ = proc.communicate(timeout=420)
+        assert proc.returncode == 0, out.decode(errors="replace")[-2000:]
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=30)
+
+    recs = read_log(log_file)
+    # resumed from the Orbax checkpoint, not from scratch
+    restored = [r for r in recs if "restored" in r]
+    assert restored, "no restore record after the in-place restart"
+    rr = restored[-1]
+    assert rr["world"] == 2
+    assert 0 < rr["restored"] <= last_saved
+
+    # loss continuity: the fixed-batch eval of the restored state equals
+    # the eval logged when that step was saved at world=1 — the restored
+    # params ARE the saved params, resharded across the new mesh
+    by_step = {r["step"]: r for r in recs if "step" in r and r["world"] == 1}
+    assert abs(rr["eval"] - by_step[rr["restored"]]["eval"]) < 1e-3
+
+    # training continued at the new world size to completion
+    post = [r for r in recs if "step" in r and r["world"] == 2]
+    assert post and min(r["step"] for r in post) == rr["restored"] + 1
+    assert any(r.get("done") and r["world"] == 2 for r in recs)
+    assert max(r["step"] for r in post) == 16
