@@ -38,6 +38,11 @@ FRAMEWORK_JAX = "JAXServing"
 
 SERVING_API_VERSION = "serving.kubedl.io/v1alpha1"
 
+#: Morphling-style chosen config (serving/autoconfig.py
+#: ``MultiConfigResult.to_dict()["best"]`` JSON: batch/quantize/
+#: speculativeK); rendered into every predictor container's env
+ANNOTATION_AUTOCONFIG = "serving.kubedl.io/autoconfig"
+
 _ISTIO_GATEWAY = "kubedl-serving-gateway"
 
 
@@ -274,6 +279,7 @@ class InferenceReconciler(Reconciler):
         if setter is not None:
             setter(template, mv, model_path)
 
+        self._apply_autoconfig(inf, template)
         self._apply_tpu_placement(inf, template)
 
         lbls = predictor_labels(inf, predictor)
@@ -304,6 +310,41 @@ class InferenceReconciler(Reconciler):
             deploy = self.api.get("Deployment", m.namespace(inf),
                                   predictor_name(inf, predictor))
         return deploy
+
+    def _apply_autoconfig(self, inf: dict, template: dict) -> None:
+        """Render the autoconfig annotation's chosen serving config into
+        predictor env (the write-back half of the Morphling loop; the
+        search half is ``serving/autoconfig.autoconfigure_multi``, run
+        offline or by a prober job against a staging predictor). The env
+        keys mirror ``serving.autoconfig.Candidate.to_env`` — kept
+        literal here so the operator process never imports the compute
+        stack (jax) just to copy three strings."""
+        import json as _json
+        raw = m.annotations(inf).get(ANNOTATION_AUTOCONFIG, "")
+        if not raw:
+            return
+        try:
+            chosen = _json.loads(raw)
+            if not isinstance(chosen, dict):
+                raise ValueError("not a JSON object")
+            env = {
+                "KUBEDL_SERVING_LANES":
+                    str(int(chosen.get("batch", 1) or 1)),
+                "KUBEDL_SERVING_QUANTIZE": str(chosen.get("quantize") or ""),
+                "KUBEDL_SERVING_SPEC_K":
+                    str(int(chosen.get("speculativeK", 0) or 0)),
+            }
+        except (ValueError, TypeError):
+            # bad values (e.g. {"batch": "fast"}) must degrade to a
+            # warning event, not a reconcile retry-loop
+            if self.recorder is not None:
+                self.recorder.event(inf, "Warning", "BadAutoconfig",
+                                    "unparseable autoconfig annotation")
+            return
+        for ct in m.get_in(template, "spec", "containers",
+                           default=[]) or []:
+            for k, v in env.items():
+                pl.upsert_env(ct, k, v)
 
     def _add_model_loader(self, template: dict, mv: dict,
                           model_path: str) -> None:

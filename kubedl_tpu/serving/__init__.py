@@ -8,9 +8,12 @@ prediction server, and a Morphling-style serving auto-configurator
 (reference ``README.md:33-35``).
 """
 
-from .autoconfig import AutoConfigResult, autoconfigure
+from .autoconfig import (AutoConfigResult, Candidate, MultiConfigResult,
+                         ServingSLO, autoconfigure, autoconfigure_multi)
 from .engine import GenerateConfig, InferenceEngine
 from .server import InferenceServer, ServerConfig
 
-__all__ = ["AutoConfigResult", "autoconfigure", "GenerateConfig",
-           "InferenceEngine", "InferenceServer", "ServerConfig"]
+__all__ = ["AutoConfigResult", "autoconfigure", "autoconfigure_multi",
+           "Candidate", "MultiConfigResult", "ServingSLO",
+           "GenerateConfig", "InferenceEngine", "InferenceServer",
+           "ServerConfig"]

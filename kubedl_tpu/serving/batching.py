@@ -57,7 +57,11 @@ class Request:
     """One in-flight generation; ``done`` fires when ``tokens`` is final
     (or the engine stopped — then ``cancelled`` is set). With
     ``want_logprobs`` each generated token's full-softmax log p lands
-    in ``logprobs``."""
+    in ``logprobs``.
+
+    Tokens are appended by the scheduler thread as they decode;
+    :meth:`stream` consumes them incrementally (the serving layer's SSE
+    path rides this), :meth:`result` waits for the final list."""
     prompt: list
     max_new: int
     tokens: list = field(default_factory=list)
@@ -65,6 +69,7 @@ class Request:
     want_logprobs: bool = False
     done: threading.Event = field(default_factory=threading.Event)
     cancelled: bool = False
+    _cond: threading.Condition = field(default_factory=threading.Condition)
 
     def result(self, timeout: Optional[float] = None) -> list:
         if not self.done.wait(timeout):
@@ -72,6 +77,51 @@ class Request:
         if self.cancelled:
             raise RuntimeError("generation cancelled: engine stopped")
         return self.tokens
+
+    def stream(self, timeout: Optional[float] = None):
+        """Yield ``(token_id, logprob_or_None)`` as the scheduler emits
+        them; returns when generation finishes. ``timeout`` bounds the
+        wait for EACH next token (a stalled engine surfaces as
+        TimeoutError instead of a silent hang)."""
+        sent = 0
+        while True:
+            with self._cond:
+                while len(self.tokens) <= sent and not self.done.is_set():
+                    if not self._cond.wait(timeout):
+                        raise TimeoutError(
+                            "no token within the streaming timeout")
+                # snapshot UNDER the lock: _push appends token+logprob as
+                # one critical section, so pairs read here are aligned (a
+                # lock-free read could see the token before its logprob)
+                fresh = []
+                while sent < len(self.tokens):
+                    lp = (self.logprobs[sent] if self.want_logprobs
+                          and sent < len(self.logprobs) else None)
+                    fresh.append((self.tokens[sent], lp))
+                    sent += 1
+                finished = self.done.is_set() and sent >= len(self.tokens)
+                cancelled = self.cancelled
+            yield from fresh
+            if finished:
+                if cancelled:
+                    raise RuntimeError(
+                        "generation cancelled: engine stopped")
+                return
+
+    # -- scheduler-side helpers (single writer: the scheduler thread) ----
+
+    def _push(self, tok: int, lp: Optional[float]) -> None:
+        with self._cond:
+            self.tokens.append(tok)
+            if lp is not None:
+                self.logprobs.append(lp)
+            self._cond.notify_all()
+
+    def _finish(self, cancelled: bool = False) -> None:
+        with self._cond:
+            self.cancelled = self.cancelled or cancelled
+            self.done.set()
+            self._cond.notify_all()
 
 
 @dataclass
@@ -241,7 +291,7 @@ class ContinuousBatchingEngine:
         req = Request(prompt=list(prompt), max_new=max_new,
                       want_logprobs=logprobs)
         if max_new <= 0:
-            req.done.set()         # nothing requested: empty output
+            req._finish()          # nothing requested: empty output
             return req
         with self._cv:
             if self._stopped:
@@ -297,8 +347,7 @@ class ContinuousBatchingEngine:
                 abandoned.append(lane.request)
             lane.reset()
         for req in abandoned:
-            req.cancelled = True
-            req.done.set()
+            req._finish(cancelled=True)
         self._cache = self.family.init_cache(self.config, self.lanes,
                                              self.max_len)
         self._cur = np.zeros((self.lanes, 1), np.int32)
@@ -352,8 +401,7 @@ class ContinuousBatchingEngine:
                     abandoned.append(lane.request)
                     lane.request = None
             for req in abandoned:
-                req.cancelled = True
-                req.done.set()
+                req._finish(cancelled=True)
 
     # -- scheduler --------------------------------------------------------
 
@@ -402,17 +450,15 @@ class ContinuousBatchingEngine:
         self._key, sub = jax.random.split(self._key)
         first = int(self._sample(logits, sub, gen.temperature,
                                  gen.top_k, gen.top_p)[0])
-        req.tokens.append(first)
-        if req.want_logprobs:
-            req.logprobs.append(float(token_logprobs(
-                logits, jnp.asarray([first]))[0]))
+        req._push(first, float(token_logprobs(
+            logits, jnp.asarray([first]))[0]) if req.want_logprobs else None)
         lane.pos = plen
         lane.remaining = req.max_new - 1
         self._cur[lane_idx, 0] = first
         self._pos[lane_idx] = plen
         if lane.remaining <= 0 or hit_stop(req.tokens, gen):
             lane.request = None    # finished in prefill
-            req.done.set()
+            req._finish()
 
     def _step_once(self) -> bool:
         """Fill free lanes, run one decode tick. Returns False once idle."""
@@ -441,9 +487,7 @@ class ContinuousBatchingEngine:
             if req is None:
                 continue
             tok = int(nxt[i])
-            req.tokens.append(tok)
-            if req.want_logprobs:
-                req.logprobs.append(float(lane_lps[i]))
+            req._push(tok, float(lane_lps[i]) if req.want_logprobs else None)
             lane.pos += 1
             lane.remaining -= 1
             self._cur[i, 0] = tok
@@ -451,5 +495,5 @@ class ContinuousBatchingEngine:
             if (lane.remaining <= 0 or hit_stop(req.tokens, gen)
                     or lane.pos + 1 >= self.max_len):
                 lane.request = None   # lane freed for the next arrival
-                req.done.set()
+                req._finish()
         return True

@@ -215,11 +215,17 @@ class InferenceEngine:
         t0 = time.perf_counter()
         self.generate(prompts, 1, seed)   # includes compile on first shape
         t_prefill = time.perf_counter() - t0
+        # warmed prefill + first token = what a streaming client waits
+        # for before its first SSE event (server.py streams per token)
+        t0 = time.perf_counter()
+        self.generate(prompts, 1, seed)
+        ttft = time.perf_counter() - t0
         t0 = time.perf_counter()
         self.generate(prompts, new_tokens, seed)
         dt = time.perf_counter() - t0
         decode_tps = batch * new_tokens / dt
         return {"batch": batch, "prompt_len": prompt_len,
                 "prefill_s": round(t_prefill, 4),
+                "ttft_ms": round(1000 * ttft, 3),
                 "decode_tokens_per_s": round(decode_tps, 2),
                 "latency_per_token_ms": round(1000 * dt / new_tokens, 3)}

@@ -9,6 +9,13 @@ TFServing REST convention the console/tooling already speak:
   ``{"instances": [{"prompt_tokens": [...], "max_tokens": N}]}`` →
   ``{"predictions": [{"tokens": [...]}]}``; instances in one request are
   batched into a single generate call (static-shape bucket);
+* ``POST /v1/models/{name}:predict`` with ``"stream": true`` (single
+  instance) — Server-Sent Events: one ``data: {"token": id}`` event per
+  generated token as it decodes (time-to-first-token = one prefill, not
+  the whole generation), then a final ``data: {"done": true, "tokens":
+  [...]}`` summary event. Rides the continuous-batching engine's
+  per-token lane output (``Request.stream``); on the static engine the
+  tokens are emitted after the batch completes (degraded but correct);
 * ``GET /v1/models/{name}`` — model status (readiness probe target);
 * ``GET /healthz`` — liveness.
 """
@@ -77,6 +84,17 @@ class InferenceServer:
 
     # -- request handling --------------------------------------------------
 
+    def _parse_instance(self, inst: dict) -> tuple:
+        """(prompt, cap, want_logprobs) — the ONE validation/coercion
+        rule for buffered and streaming predicts alike."""
+        toks = inst.get("prompt_tokens")
+        if not isinstance(toks, list) or not toks:
+            raise ValueError("each instance needs prompt_tokens")
+        prompt = [int(t) for t in toks]
+        cap = min(int(inst.get("max_tokens", 16)),
+                  self.config.max_new_tokens)
+        return prompt, cap, bool(inst.get("logprobs"))
+
     def predict(self, body: dict) -> dict:
         instances = body.get("instances") or []
         if not instances:
@@ -87,13 +105,10 @@ class InferenceServer:
                 f"{self.config.max_batch}")
         prompts, caps, want_lp = [], [], []
         for inst in instances:
-            toks = inst.get("prompt_tokens")
-            if not isinstance(toks, list) or not toks:
-                raise ValueError("each instance needs prompt_tokens")
-            prompts.append([int(t) for t in toks])
-            caps.append(min(int(inst.get("max_tokens", 16)),
-                            self.config.max_new_tokens))
-            want_lp.append(bool(inst.get("logprobs")))
+            p, cap, lp = self._parse_instance(inst)
+            prompts.append(p)
+            caps.append(cap)
+            want_lp.append(lp)
         if hasattr(self.engine, "submit"):
             # continuous-batching engine: each instance rides its own lane
             # (its background loop serializes device work — no lock), so a
@@ -127,6 +142,58 @@ class InferenceServer:
             preds.append(pred)
         return {"predictions": preds}
 
+    def predict_stream(self, body: dict):
+        """Yield SSE event dicts for a single-instance streaming request.
+
+        Validation errors raise BEFORE the first yield (the handler can
+        still send a 400); anything after the first event is reported as
+        a terminal ``{"error": ...}`` event on the open stream."""
+        instances = body.get("instances") or []
+        if len(instances) != 1:
+            raise ValueError("stream mode takes exactly one instance")
+        prompt, cap, want_lp = self._parse_instance(instances[0])
+
+        if hasattr(self.engine, "submit"):
+            self.engine.validate(prompt, cap)
+
+            def events():
+                req = self.engine.submit(prompt, cap, logprobs=want_lp)
+                out, lps = [], []
+                # per-token bound: a stalled engine surfaces as an error
+                # event, not a silently frozen stream
+                for tok, lp in req.stream(
+                        timeout=self.config.request_timeout_s):
+                    out.append(tok)
+                    ev = {"token": tok}
+                    if lp is not None:
+                        ev["logprob"] = lp
+                        lps.append(lp)
+                    yield ev
+                final = {"done": True, "tokens": out}
+                if want_lp:
+                    final["logprobs"] = lps
+                yield final
+            return events()
+
+        # static engine: no incremental lane output — generate fully,
+        # then emit token events (correctness-compatible fallback)
+        def events_static():
+            with self._gen_lock:
+                outs = self.engine.generate([prompt], cap,
+                                            return_logprobs=want_lp)
+            toks_out, lps = outs[0] if want_lp else (outs[0], None)
+            toks_out = toks_out[:cap]
+            for i, tok in enumerate(toks_out):
+                ev = {"token": tok}
+                if want_lp:
+                    ev["logprob"] = lps[i]
+                yield ev
+            final = {"done": True, "tokens": toks_out}
+            if want_lp:
+                final["logprobs"] = lps[:cap]
+            yield final
+        return events_static()
+
     def status(self) -> dict:
         return {"model_version_status": [{
             "version": "1", "state": "AVAILABLE",
@@ -148,6 +215,41 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(data)
 
+    def _respond_sse(self, events) -> None:
+        """Stream ``data: {json}`` events with chunked framing (we speak
+        raw HTTP/1.1 here, so the chunk lengths are written by hand).
+        Errors after the first byte can't change the status line — they
+        become a terminal error event instead."""
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+        def chunk(payload: dict) -> None:
+            data = f"data: {json.dumps(payload)}\n\n".encode()
+            self.wfile.write(f"{len(data):x}\r\n".encode()
+                             + data + b"\r\n")
+            self.wfile.flush()
+
+        try:
+            for ev in events:
+                chunk(ev)
+        except (BrokenPipeError, ConnectionResetError):
+            return  # client went away; generation completes server-side
+        except Exception as e:  # noqa: BLE001 — surface on the stream
+            logging.getLogger("kubedl_tpu.serving").exception(
+                "stream failed")
+            try:
+                chunk({"error": f"{type(e).__name__}: {e}"})
+            except OSError:
+                return
+        try:
+            self.wfile.write(b"0\r\n\r\n")
+            self.wfile.flush()
+        except OSError:
+            pass
+
     def do_GET(self):
         cfg = self.server_ref.config
         if self.path == "/healthz":
@@ -165,7 +267,12 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             length = int(self.headers.get("Content-Length") or 0)
             body = json.loads(self.rfile.read(length) or b"{}")
-            self._respond(200, self.server_ref.predict(body))
+            if body.get("stream"):
+                # validation happens before the first event, so a bad
+                # request still gets a clean 400 status
+                self._respond_sse(self.server_ref.predict_stream(body))
+            else:
+                self._respond(200, self.server_ref.predict(body))
         except (ValueError, KeyError, TypeError) as e:
             self._respond(400, {"error": str(e)})
         except Exception as e:  # noqa: BLE001 — a crashed predict must

@@ -1,0 +1,150 @@
+"""Token streaming (SSE) through the serving stack: per-token events from
+the continuous-batching lanes reach an HTTP client incrementally, with
+the same final tokens as a buffered predict (VERDICT r3 next #5)."""
+
+import dataclasses
+import json
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from kubedl_tpu.models import llama
+from kubedl_tpu.serving import InferenceEngine, InferenceServer, ServerConfig
+from kubedl_tpu.serving.batching import ContinuousBatchingEngine
+from kubedl_tpu.serving.engine import GenerateConfig
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = dataclasses.replace(llama.tiny(vocab=151, seq=128),
+                              dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(3))
+    return cfg, params
+
+
+def sse_events(resp):
+    """Parse data: lines off a live SSE response as they arrive."""
+    for raw in resp:
+        line = raw.decode().strip()
+        if line.startswith("data: "):
+            yield json.loads(line[len("data: "):])
+
+
+def post(url, body, stream=False):
+    req = urllib.request.Request(
+        url + "/v1/models/m:predict", method="POST",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    return urllib.request.urlopen(req)
+
+
+def test_stream_matches_buffered_and_is_incremental(model):
+    cfg, params = model
+    eng = ContinuousBatchingEngine(cfg, params, lanes=2, max_len=96).start()
+
+    # throttle each decode tick so incrementality is observable
+    real = eng._decode
+
+    def slow(*a, **kw):
+        time.sleep(0.05)
+        return real(*a, **kw)
+
+    eng._decode = slow
+    server = InferenceServer(eng, ServerConfig(
+        model_name="m", host="127.0.0.1", port=0)).start()
+    try:
+        body = {"instances": [{"prompt_tokens": [5, 9, 2],
+                               "max_tokens": 12}]}
+        with post(server.url, body) as r:
+            buffered = json.load(r)["predictions"][0]["tokens"]
+
+        with post(server.url, {**body, "stream": True}) as r:
+            assert r.headers["Content-Type"] == "text/event-stream"
+            events = sse_events(r)
+            first = next(events)
+            assert "token" in first
+            # the first token arrived while the request was still
+            # decoding: streaming really is incremental, not buffered
+            assert eng._active(), "stream delivered only after completion"
+            rest = list(events)
+        final = rest[-1]
+        assert final["done"] is True
+        toks = [first["token"]] + [e["token"] for e in rest if "token" in e]
+        # greedy decode: streamed tokens identical to the buffered path
+        assert toks == buffered
+        assert final["tokens"] == buffered
+        # one event per token preceded the summary
+        assert len(rest) - 1 == len(buffered) - 1
+    finally:
+        server.stop()
+        eng.stop()
+
+
+def test_stream_logprobs(model):
+    cfg, params = model
+    eng = ContinuousBatchingEngine(cfg, params, lanes=2, max_len=96).start()
+    server = InferenceServer(eng, ServerConfig(
+        model_name="m", host="127.0.0.1", port=0)).start()
+    try:
+        body = {"stream": True, "instances": [
+            {"prompt_tokens": [4, 4], "max_tokens": 5, "logprobs": True}]}
+        with post(server.url, body) as r:
+            evs = list(sse_events(r))
+        toks = [e for e in evs if "token" in e]
+        assert all("logprob" in e and e["logprob"] <= 0.0 for e in toks)
+        assert evs[-1]["logprobs"] == [e["logprob"] for e in toks]
+    finally:
+        server.stop()
+        eng.stop()
+
+
+def test_stream_static_engine_fallback(model):
+    """The static engine has no lanes; stream mode still yields per-token
+    events (post-hoc) with the same tokens as buffered predict."""
+    cfg, params = model
+    eng = InferenceEngine(cfg, params, GenerateConfig(max_len=64))
+    server = InferenceServer(eng, ServerConfig(
+        model_name="m", host="127.0.0.1", port=0)).start()
+    try:
+        body = {"instances": [{"prompt_tokens": [7, 1, 3],
+                               "max_tokens": 6}]}
+        with post(server.url, body) as r:
+            buffered = json.load(r)["predictions"][0]["tokens"]
+        with post(server.url, {**body, "stream": True}) as r:
+            evs = list(sse_events(r))
+        assert [e["token"] for e in evs if "token" in e] == buffered
+        assert evs[-1] == {"done": True, "tokens": buffered}
+    finally:
+        server.stop()
+
+
+def test_stream_validation_is_a_clean_400(model):
+    cfg, params = model
+    eng = InferenceEngine(cfg, params, GenerateConfig(max_len=64))
+    server = InferenceServer(eng, ServerConfig(
+        model_name="m", host="127.0.0.1", port=0)).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post(server.url, {"stream": True, "instances": [
+                {"prompt_tokens": [1], "max_tokens": 2},
+                {"prompt_tokens": [2], "max_tokens": 2}]})
+        assert ei.value.code == 400
+    finally:
+        server.stop()
+
+
+def test_request_stream_timeout(model):
+    """A stalled engine surfaces as TimeoutError per token, not a hang."""
+    from kubedl_tpu.serving.batching import Request
+
+    req = Request(prompt=[1], max_new=4)
+    req._push(11, None)
+    got = []
+    with pytest.raises(TimeoutError):
+        for tok, _ in req.stream(timeout=0.2):
+            got.append(tok)
+    assert got == [11]
