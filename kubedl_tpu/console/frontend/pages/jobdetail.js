@@ -1,17 +1,45 @@
-// Job detail page (reference pages/JobDetail): header + tabs for pods,
-// events, per-pod logs, TensorBoard status, and the raw manifest.
+// Job detail page (reference pages/JobDetail): header + per-replica
+// rollup + tabs for pods, events, per-pod logs, TensorBoard link-out,
+// and the raw manifest. Auto-refreshes while the job is live.
 import { api, esc, params, statusCell, t, tabbed } from "../app.js";
 
+const TERMINAL = new Set(["Succeeded", "Failed"]);
+let refreshTimer = null;
+
 export async function viewJobDetail(app) {
+  if (refreshTimer) { clearTimeout(refreshTimer); refreshTimer = null; }
   const q = params();
   const kind = q.get("kind") || "", ns = q.get("ns") || "";
   const name = q.get("name") || "";
   const qs = `kind=${encodeURIComponent(kind)}` +
              `&namespace=${encodeURIComponent(ns)}` +
              `&name=${encodeURIComponent(name)}`;
+  // carry the active tab across auto-refreshes: re-rendering must not
+  // snap a user reading Logs back to the Pods tab every 5 seconds
+  const activeTab = app.querySelector(
+    "#detail-tabs [data-tab].active")?.dataset.tab;
   const data = await api(`/job/detail?${qs}`);
   const status = (((data.job.status || {}).conditions || [])
     .filter(c => c.status === "True").map(c => c.type).pop()) || "Created";
+
+  // live jobs re-render every 5s until a terminal condition lands or the
+  // user navigates away (the timer checks the hash before re-entering)
+  if (!TERMINAL.has(status)) {
+    const hash = location.hash;
+    refreshTimer = setTimeout(() => {
+      refreshTimer = null;
+      if (location.hash === hash) viewJobDetail(app);
+    }, 5000);
+  }
+
+  // per-replica rollup: pod counts by replica type and phase
+  const byReplica = {};
+  for (const p of data.pods) {
+    const r = byReplica[p.replica_type] ||
+      (byReplica[p.replica_type] = { total: 0 });
+    r.total++;
+    r[p.status] = (r[p.status] || 0) + 1;
+  }
 
   app.innerHTML = `
     <div class="panel">
@@ -21,7 +49,16 @@ export async function viewJobDetail(app) {
         <span class="pill">${esc(ns)}</span>
         ${statusCell(status)}
         <span style="flex:1"></span>
+        ${TERMINAL.has(status) ? "" :
+          `<span class="muted">${esc(t("detail.autoRefresh"))}</span>`}
         <button id="refresh" class="ghost">&#8635; refresh</button>
+      </div>
+      <div class="replica-summary">
+        ${Object.entries(byReplica).map(([rt, r]) => `
+          <span class="pill">${esc(rt)}: ${r.total}
+            ${Object.entries(r).filter(([k]) => k !== "total")
+              .map(([k, v]) => `&middot; ${esc(k)} ${v}`).join(" ")}
+          </span>`).join("")}
       </div>
       <div id="detail-tabs"></div>
     </div>`;
@@ -30,10 +67,12 @@ export async function viewJobDetail(app) {
   const renderPods = el => {
     el.innerHTML = `
       <table><thead><tr><th>Name</th><th>Replica</th><th>Status</th>
-        <th>Pod IP</th><th>Host IP</th><th>Started</th><th>Finished</th>
+        <th>Restarts</th><th>Pod IP</th><th>Host IP</th><th>Started</th>
+        <th>Finished</th>
       </tr></thead><tbody>
       ${data.pods.map(p => `<tr><td>${esc(p.name)}</td>
         <td>${esc(p.replica_type)}</td><td>${statusCell(p.status)}</td>
+        <td class="muted">${esc(p.restarts ?? 0)}</td>
         <td class="muted">${esc(p.pod_ip)}</td>
         <td class="muted">${esc(p.host_ip)}</td>
         <td class="muted">${esc(p.gmt_started)}</td>
@@ -74,11 +113,31 @@ export async function viewJobDetail(app) {
   const renderTB = async el => {
     const tb = await api(`/tensorboard/status?namespace=` +
       `${encodeURIComponent(ns)}&name=${encodeURIComponent(name)}`);
+    const link = tb.service
+      ? `<a href="http://${esc(tb.service)}.${esc(ns)}.svc:6006"
+           target="_blank" rel="noopener">
+           http://${esc(tb.service)}.${esc(ns)}.svc:6006</a>
+         <span class="muted">(cluster-internal; port-forward from
+           outside)</span>`
+      : "—";
     el.innerHTML = `<div class="kv">
       <span class="muted">TensorBoard pod</span>
       <span>${statusCell(tb.phase)}</span>
-      <span class="muted">Service</span>
-      <span>${esc(tb.service || "—")}</span></div>`;
+      <span class="muted">Service</span><span>${link}</span>
+      <span class="muted">Profiles</span>
+      <span class="muted">XProf traces under the job logdir
+        appear in TensorBoard's Profile tab</span></div>
+      <div class="row" style="margin-top:8px">
+        <button id="tb-reapply" class="ghost">reapply</button>
+        <span id="tb-msg" class="muted"></span></div>`;
+    el.querySelector("#tb-reapply").onclick = async () => {
+      const msg = el.querySelector("#tb-msg");
+      try {
+        await api("/tensorboard/reapply", { method: "POST",
+          body: JSON.stringify({ kind, namespace: ns, name }) });
+        msg.textContent = "reapplied";
+      } catch (e) { msg.textContent = e.message; }
+    };
   };
 
   const renderManifest = async el => {
@@ -94,5 +153,5 @@ export async function viewJobDetail(app) {
     { id: "logs", label: t("detail.logs"), render: renderLogs },
     { id: "tensorboard", label: "TensorBoard", render: renderTB },
     { id: "manifest", label: t("detail.manifest"), render: renderManifest },
-  ]);
+  ], activeTab);
 }

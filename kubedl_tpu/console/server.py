@@ -517,6 +517,52 @@ class ConsoleServer:
         if path == "/api/v1/kinds":
             return ok(sorted(TRAINING_KINDS))
 
+        # -- TPU topology catalog (the JobCreate wizard's pickers; no
+        # reference analog — GPU consoles free-type resource strings, a
+        # TPU slice must be a valid (generation, topology) pair) --------
+        if path == "/api/v1/tpu/topologies":
+            from ..tpu import topology as topo
+            out = []
+            for gname in sorted(topo.GENERATIONS):
+                gen = topo.GENERATIONS[gname]
+                canon = (topo._CANONICAL_3D if gen.ndims == 3
+                         else topo._CANONICAL_2D)
+                choices = []
+                for chips in sorted(canon):
+                    if chips > gen.max_chips:
+                        continue
+                    try:
+                        spec = topo.from_chips(gname, chips)
+                    except ValueError:
+                        continue
+                    choices.append({
+                        "acceleratorType": spec.accelerator_type,
+                        "topology": spec.topology_str,
+                        "chips": spec.chips,
+                        "hosts": spec.num_hosts,
+                    })
+                out.append({"generation": gname,
+                            "gkeAccelerator": gen.gke_accelerator,
+                            "choices": choices})
+            return ok(out)
+        if path == "/api/v1/tpu/validate" and method == "POST":
+            # resolves an (acceleratorType, topology?) pair through the
+            # same tpu/topology.py the admission chain uses, so the wizard
+            # rejects exactly what the operator would
+            from ..tpu import topology as topo
+            req = _parse_body(body)
+            accel = str(req.get("acceleratorType", ""))
+            spec = topo.parse_accelerator(accel)   # ValueError -> 400
+            want_topo = str(req.get("topology", "") or "")
+            if want_topo and want_topo != spec.topology_str:
+                spec = topo.from_chips(spec.generation.name, spec.chips,
+                                       topology=want_topo)
+            return ok({"acceleratorType": spec.accelerator_type,
+                       "topology": spec.topology_str,
+                       "chips": spec.chips, "hosts": spec.num_hosts,
+                       "chipsPerHost": spec.chips_per_host,
+                       "gkeAccelerator": spec.gke_accelerator})
+
         # -- workspaces (reference routers/api/workspace.go:30-36) --------
         if path.startswith("/api/v1/workspace"):
             if self.workspaces is None:

@@ -304,7 +304,7 @@ CREATE INDEX IF NOT EXISTS idx_jobs_ns_name ON jobs (namespace, name);
 CREATE TABLE IF NOT EXISTS pods (
   pod_id TEXT PRIMARY KEY, name TEXT, namespace TEXT, version TEXT,
   status TEXT, image TEXT, job_id TEXT, replica_type TEXT, resources TEXT,
-  host_ip TEXT, pod_ip TEXT, deploy_region TEXT, deleted INTEGER,
+  restarts INTEGER, host_ip TEXT, pod_ip TEXT, deploy_region TEXT, deleted INTEGER,
   is_in_etcd INTEGER, remark TEXT, gmt_created TEXT, gmt_modified TEXT,
   gmt_started TEXT, gmt_finished TEXT);
 CREATE INDEX IF NOT EXISTS idx_pods_job ON pods (job_id);
@@ -324,6 +324,21 @@ CREATE TABLE IF NOT EXISTS events (
   PRIMARY KEY (obj_uid, name));
 CREATE INDEX IF NOT EXISTS idx_events_obj ON events (obj_namespace, obj_name);
 """
+
+
+#: idempotent column additions for databases created before a column
+#: existed — CREATE TABLE IF NOT EXISTS never amends a live table, so an
+#: in-place upgrade would otherwise crash every save with "no column"
+_MIGRATIONS = [
+    ("pods", "restarts", "INTEGER DEFAULT 0"),
+]
+
+
+def _migrate_sqlite(conn) -> None:
+    for table, col, decl in _MIGRATIONS:
+        have = {r[1] for r in conn.execute(f"PRAGMA table_info({table})")}
+        if col not in have:
+            conn.execute(f"ALTER TABLE {table} ADD COLUMN {col} {decl}")
 
 
 def _locked(fn):
@@ -371,6 +386,7 @@ class SQLiteBackend(ObjectBackend, EventBackend):
                 conn = sqlite3.connect(self.path, check_same_thread=False)
                 conn.row_factory = sqlite3.Row
                 conn.executescript(_SCHEMA)
+                _migrate_sqlite(conn)
                 self._connection = conn
             return self._connection
 

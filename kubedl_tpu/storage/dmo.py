@@ -77,6 +77,8 @@ class PodRecord:
     job_id: str = ""            # owning job's uid
     replica_type: str = ""
     resources: str = ""         # JSON ResourceRequirements summary
+    restarts: int = 0           # max container restartCount (in-place
+                                # elastic restarts move this, engine.py)
     host_ip: str = ""
     pod_ip: str = ""
     deploy_region: str = ""
@@ -233,7 +235,9 @@ def pod_to_record(pod: dict, region: str = "",
             break
     ref = m.get_controller_ref(pod) or {}
     started = finished = ""
+    restarts = 0
     for cs in status.get("containerStatuses", []) or []:
+        restarts = max(restarts, int(cs.get("restartCount", 0) or 0))
         st = cs.get("state", {}) or {}
         if "running" in st:
             started = started or st["running"].get("startedAt", "")
@@ -251,6 +255,7 @@ def pod_to_record(pod: dict, region: str = "",
         replica_type=m.labels(pod).get(c.LABEL_REPLICA_TYPE, ""),
         resources=json.dumps(pod_request(pod.get("spec", {}) or {}),
                              sort_keys=True),
+        restarts=restarts,
         host_ip=status.get("hostIP", "") or "",
         pod_ip=status.get("podIP", "") or "",
         deploy_region=region,

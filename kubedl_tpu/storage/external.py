@@ -143,6 +143,14 @@ class MySQLBackend(SQLiteBackend):
                     except Exception as e:  # duplicate index et al
                         if "Duplicate" not in str(e) and "exists" not in str(e):
                             raise
+                from .backends import _MIGRATIONS
+                for table, col, decl in _MIGRATIONS:
+                    try:
+                        conn.execute(
+                            f"ALTER TABLE {table} ADD COLUMN {col} {decl}")
+                    except Exception as e:  # column already present
+                        if "Duplicate" not in str(e):
+                            raise
                 self._connection = conn
             return self._connection
 

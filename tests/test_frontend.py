@@ -1,0 +1,194 @@
+"""Console frontend coverage (VERDICT r3 next #4): served-page smoke over
+the real HTTP stack plus DOM-less router/i18n checks that parse the SPA
+source (no node in the image, so JS is validated structurally: every
+route maps to an exported view, every t() key exists, locales agree)."""
+
+import json
+import re
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+from kubedl_tpu.console import ConsoleConfig, ConsoleServer, DataProxy
+from kubedl_tpu.controllers.registry import OperatorConfig, build_operator
+
+FRONTEND = (Path(__file__).resolve().parents[1]
+            / "kubedl_tpu" / "console" / "frontend")
+
+
+@pytest.fixture
+def stack(api):
+    op = build_operator(api, OperatorConfig(
+        workloads=["PyTorchJob", "JAXJob"],
+        object_storage="sqlite", event_storage="sqlite"))
+    proxy = DataProxy(api, op.object_backend, op.event_backend)
+    server = ConsoleServer(proxy, ConsoleConfig(
+        port=0, users={"admin": "kubedl", "bob": "pw"}))
+    server.start()
+    yield server
+    server.stop()
+
+
+def get(server, path, cookie=None):
+    req = urllib.request.Request(server.url + path)
+    if cookie:
+        req.add_header("Cookie", cookie)
+    try:
+        with urllib.request.urlopen(req) as r:
+            return r.status, r.headers.get("Content-Type", ""), r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.headers.get("Content-Type", ""), e.read()
+
+
+def login(server, user="admin", pw="kubedl"):
+    req = urllib.request.Request(server.url + "/api/v1/login", method="POST",
+                                 data=json.dumps({"username": user,
+                                                  "password": pw}).encode())
+    with urllib.request.urlopen(req) as r:
+        return r.headers["Set-Cookie"].split(";")[0]
+
+
+# ---------------------------------------------------------------- smoke
+
+
+def test_every_frontend_asset_served(stack):
+    """A broken route in the static handler must not ship green: every
+    file of the SPA is fetched over real HTTP with the right type."""
+    for path in sorted(FRONTEND.rglob("*")):
+        if not path.is_file():
+            continue
+        rel = "/" + str(path.relative_to(FRONTEND))
+        status, ctype, body = get(stack, rel)
+        assert status == 200, rel
+        assert body == path.read_bytes(), rel
+        want = {"html": "text/html", "js": "text/javascript",
+                "css": "text/css"}[path.suffix.lstrip(".")]
+        assert ctype == want, rel
+
+
+def test_index_wires_the_app(stack):
+    status, _, body = get(stack, "/")
+    assert status == 200
+    html = body.decode()
+    assert '<script type="module" src="/app.js">' in html
+    for route in ("#/jobs", "#/job-create", "#/datasheets", "#/cluster"):
+        assert route in html
+
+
+def test_unknown_path_serves_spa_fallback(stack):
+    status, ctype, body = get(stack, "/some/deep/link")
+    assert status == 200 and ctype == "text/html"
+    assert b"app.js" in body
+
+
+def test_admin_api_403_for_non_admin(stack):
+    cookie = login(stack, "bob", "pw")
+    status, _, body = get(stack, "/api/v1/users", cookie)
+    assert status == 403
+    assert json.loads(body)["code"] == 403
+
+
+def test_tpu_topology_catalog_and_validation(stack):
+    cookie = login(stack)
+    status, _, body = get(stack, "/api/v1/tpu/topologies", cookie)
+    assert status == 200
+    catalog = json.loads(body)["data"]
+    gens = {g["generation"] for g in catalog}
+    assert {"v4", "v5e", "v5p", "v6e"} <= gens
+    v5p = next(g for g in catalog if g["generation"] == "v5p")
+    assert {"acceleratorType": "v5p-32", "topology": "2x2x4",
+            "chips": 16, "hosts": 4} in v5p["choices"]
+
+    def validate(payload):
+        req = urllib.request.Request(
+            stack.url + "/api/v1/tpu/validate", method="POST",
+            data=json.dumps(payload).encode())
+        req.add_header("Cookie", cookie)
+        try:
+            with urllib.request.urlopen(req) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    status, out = validate({"acceleratorType": "v5p-32"})
+    assert status == 200 and out["data"]["topology"] == "2x2x4"
+    assert out["data"]["chipsPerHost"] == 4
+    # the wizard can never submit a slice the operator would reject
+    status, out = validate({"acceleratorType": "v9z-999"})
+    assert status == 400
+    status, out = validate({"acceleratorType": "v5p-32",
+                            "topology": "7x3x1"})
+    assert status == 400
+
+
+# ------------------------------------------------- DOM-less source checks
+
+
+def read(name: str) -> str:
+    return (FRONTEND / name).read_text()
+
+
+def test_router_routes_map_to_exported_views():
+    app_js = read("app.js")
+    table = re.search(r"const routes = \{(.*?)\};", app_js, re.S).group(1)
+    routes = dict(re.findall(r'"([\w-]+)":\s*(\w+)', table))
+    assert {"jobs", "job", "submit", "job-create", "datasheets",
+            "403", "404", "500", "login", "admin",
+            "cluster"} <= set(routes)
+    imported = set(re.findall(r"import \{([^}]*)\} from", app_js))
+    imported = {n.strip() for grp in imported for n in grp.split(",")}
+    exported = set()
+    for page in (FRONTEND / "pages").glob("*.js"):
+        exported |= set(re.findall(
+            r"export (?:async )?function (\w+)", page.read_text()))
+    for name, view in routes.items():
+        assert view in imported, f"route {name}: {view} not imported"
+        assert view in exported, f"route {name}: {view} not exported"
+
+
+def locale_blocks(app_js: str) -> dict:
+    block = re.search(r"const MESSAGES = \{(.*?)\n\};", app_js, re.S).group(1)
+    out = {}
+    for mt in re.finditer(r"\n  (\w+): \{(.*?)\n  \},", block, re.S):
+        out[mt.group(1)] = dict(re.findall(
+            r'"([\w.]+)":\s*"((?:[^"\\]|\\.)*)"', mt.group(2)))
+    return out
+
+
+def test_i18n_locales_cover_identical_keys():
+    locales = locale_blocks(read("app.js"))
+    assert set(locales) == {"en", "zh", "pt"}
+    en = set(locales["en"])
+    for lang in ("zh", "pt"):
+        missing = en - set(locales[lang])
+        extra = set(locales[lang]) - en
+        assert not missing, f"{lang} missing {sorted(missing)}"
+        assert not extra, f"{lang} extra {sorted(extra)}"
+    # pt is a real translation, not a copy of en
+    diff = sum(1 for k in en
+               if locales["pt"][k] != locales["en"][k])
+    assert diff > len(en) // 2
+
+
+def test_every_t_key_defined():
+    en = set(locale_blocks(read("app.js"))["en"])
+    used = set()
+    for path in [FRONTEND / "app.js", *(FRONTEND / "pages").glob("*.js")]:
+        used |= set(re.findall(r'\bt\("([\w.]+)"\)', path.read_text()))
+    undefined = used - en
+    assert not undefined, f"t() keys missing from MESSAGES.en: {undefined}"
+
+
+def test_reference_page_parity_documented():
+    """Every page dir in the reference frontend has a mapped analog (or a
+    documented won't-do) — the map lives in docs/console.md."""
+    doc = (Path(__file__).resolve().parents[1]
+           / "docs" / "console.md").read_text()
+    for ref_page in ("Jobs", "JobDetail", "JobSubmit", "JobCreate",
+                     "DataSheets", "DataConfig", "GitConfig", "CodeConfig",
+                     "ClusterInfo", "Notebooks", "NotebookCreate",
+                     "Workspaces", "WorkspaceCreate", "WorkspaceDetail",
+                     "logIn", "Admin", "user", "Authorized",
+                     "ConsoleInfo", "403", "404", "500"):
+        assert ref_page in doc, f"reference page {ref_page} unmapped"
