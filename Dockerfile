@@ -3,13 +3,17 @@
 # *workload* images, not the manager).
 FROM python:3.12-slim AS base
 WORKDIR /app
+# install the package (pyproject.toml) instead of copying the tree: the
+# same wheel users `pip install` into their training images, so the image
+# build catches packaging breakage
+COPY pyproject.toml README.md ./
 COPY kubedl_tpu/ kubedl_tpu/
+RUN pip install --no-cache-dir .
 COPY config/ config/
-RUN pip install --no-cache-dir pyyaml
 # jax is only needed by workload payloads and the serving runtime; the
 # manager itself runs without it. Install the CPU wheel for the console's
 # cluster-total fallback and local smoke tests.
 RUN pip install --no-cache-dir "jax[cpu]" optax orbax-checkpoint || true
 EXPOSE 8080 9090
-ENTRYPOINT ["python", "-m", "kubedl_tpu"]
+ENTRYPOINT ["kubedl-tpu"]
 CMD ["--workloads=*", "--console-port=9090"]

@@ -2,18 +2,22 @@
 
 PY ?= python
 
-.PHONY: test test-fast bench dryrun crds run-standalone lint
+.PHONY: test test-all test-fast bench dryrun crds run-standalone lint
 
-# full suite on the 8-device virtual CPU mesh (conftest pins the platform)
+# fast path (<3 min): everything except the compile-heavy compute suites
+# (those carry `pytestmark = pytest.mark.slow`)
 test:
-	$(PY) -m pytest tests/ -q
+	$(PY) -m pytest tests/ -q -m "not slow"
 
-# operator-only tests (skips the slow compute/jit suites)
-test-fast:
-	$(PY) -m pytest tests/ -q --ignore=tests/test_llama.py \
-	    --ignore=tests/test_ring.py --ignore=tests/test_attention.py \
-	    --ignore=tests/test_checkpoint.py --ignore=tests/test_model_zoo.py \
-	    --ignore=tests/test_inference.py --ignore=tests/test_dryrun.py
+# full suite on the 8-device virtual CPU mesh (conftest pins the platform);
+# -n auto spreads the compute compiles over workers when pytest-xdist is
+# present (pip install .[test]) and falls back to serial when not. The
+# dryrun wall-clock bound self-scales by PYTEST_XDIST_WORKER_COUNT.
+XDIST := $(shell $(PY) -c "import xdist" 2>/dev/null && echo "-n auto")
+test-all:
+	$(PY) -m pytest tests/ -q $(XDIST)
+
+test-fast: test
 
 # one-line JSON training benchmark (TPU when reachable, cpu smoke otherwise)
 bench:

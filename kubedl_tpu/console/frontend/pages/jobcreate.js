@@ -135,7 +135,7 @@ export async function viewJobCreate(app) {
         { count: role === "Worker" || role === "Master" ||
                  role === "Launcher" || role === "Chief" ||
                  role === "Scheduler" ? 1 : 0,
-          cpu: "", mem: "", tpu: "" };
+          cpu: "", mem: "" };
       return `
       <div class="replica-card"><h4>${role}</h4><div class="form-grid">
         <label>Replicas</label>

@@ -14,22 +14,30 @@ export async function viewJobDetail(app) {
   const qs = `kind=${encodeURIComponent(kind)}` +
              `&namespace=${encodeURIComponent(ns)}` +
              `&name=${encodeURIComponent(name)}`;
-  // carry the active tab across auto-refreshes: re-rendering must not
-  // snap a user reading Logs back to the Pods tab every 5 seconds
+  // carry the active tab AND the selected log pod across auto-refreshes:
+  // re-rendering must not snap a user reading worker-2's logs back to
+  // the Pods tab (or the first pod) every 5 seconds
   const activeTab = app.querySelector(
     "#detail-tabs [data-tab].active")?.dataset.tab;
+  const activeLogPod = app.querySelector("#log-pod")?.value;
   const data = await api(`/job/detail?${qs}`);
   const status = (((data.job.status || {}).conditions || [])
     .filter(c => c.status === "True").map(c => c.type).pop()) || "Created";
 
   // live jobs re-render every 5s until a terminal condition lands or the
-  // user navigates away (the timer checks the hash before re-entering)
+  // user navigates away (the timer checks the hash before re-entering).
+  // A transient API failure must not kill the loop silently — catch and
+  // keep ticking so the page recovers when the backend does.
   if (!TERMINAL.has(status)) {
     const hash = location.hash;
-    refreshTimer = setTimeout(() => {
+    const tick = () => {
       refreshTimer = null;
-      if (location.hash === hash) viewJobDetail(app);
-    }, 5000);
+      if (location.hash !== hash) return;
+      Promise.resolve(viewJobDetail(app)).catch(() => {
+        refreshTimer = setTimeout(tick, 5000);
+      });
+    };
+    refreshTimer = setTimeout(tick, 5000);
   }
 
   // per-replica rollup: pod counts by replica type and phase
@@ -93,9 +101,11 @@ export async function viewJobDetail(app) {
 
   const renderLogs = el => {
     const pods = data.pods.map(p => p.name);
+    const selected = pods.includes(activeLogPod) ? activeLogPod : pods[0];
     el.innerHTML = `
       <div class="row"><select id="log-pod">
-        ${pods.map(p => `<option>${esc(p)}</option>`).join("")}
+        ${pods.map(p => `<option ${p === selected ? "selected" : ""}>
+          ${esc(p)}</option>`).join("")}
       </select></div>
       <pre id="log-body">select a pod</pre>`;
     const load = async () => {

@@ -522,29 +522,7 @@ class ConsoleServer:
         # TPU slice must be a valid (generation, topology) pair) --------
         if path == "/api/v1/tpu/topologies":
             from ..tpu import topology as topo
-            out = []
-            for gname in sorted(topo.GENERATIONS):
-                gen = topo.GENERATIONS[gname]
-                canon = (topo._CANONICAL_3D if gen.ndims == 3
-                         else topo._CANONICAL_2D)
-                choices = []
-                for chips in sorted(canon):
-                    if chips > gen.max_chips:
-                        continue
-                    try:
-                        spec = topo.from_chips(gname, chips)
-                    except ValueError:
-                        continue
-                    choices.append({
-                        "acceleratorType": spec.accelerator_type,
-                        "topology": spec.topology_str,
-                        "chips": spec.chips,
-                        "hosts": spec.num_hosts,
-                    })
-                out.append({"generation": gname,
-                            "gkeAccelerator": gen.gke_accelerator,
-                            "choices": choices})
-            return ok(out)
+            return ok(topo.catalog())
         if path == "/api/v1/tpu/validate" and method == "POST":
             # resolves an (acceleratorType, topology?) pair through the
             # same tpu/topology.py the admission chain uses, so the wizard

@@ -168,3 +168,30 @@ def parse_topology(gen_name: str, topology: str) -> SliceSpec:
     """``("v5p", "2x2x4")`` → SliceSpec; the GKE-native entry point."""
     topo = tuple(int(x) for x in topology.lower().split("x"))
     return from_chips(gen_name, math.prod(topo), topology)
+
+
+def catalog() -> list:
+    """Canonical slice choices per generation — the ONE enumeration of
+    valid (acceleratorType, topology) pairs, consumed by the console's
+    ``/api/v1/tpu/topologies`` pickers. Kept here so refactors of the
+    canonical tables cannot desync the UI from ``from_chips``."""
+    out = []
+    for gname in sorted(GENERATIONS):
+        gen = GENERATIONS[gname]
+        canon = _CANONICAL_3D if gen.ndims == 3 else _CANONICAL_2D
+        choices = []
+        for chips in sorted(canon):
+            if chips > gen.max_chips:
+                continue
+            try:
+                spec = from_chips(gname, chips)
+            except ValueError:
+                continue
+            choices.append({"acceleratorType": spec.accelerator_type,
+                            "topology": spec.topology_str,
+                            "chips": spec.chips,
+                            "hosts": spec.num_hosts})
+        out.append({"generation": gname,
+                    "gkeAccelerator": gen.gke_accelerator,
+                    "choices": choices})
+    return out

@@ -8,6 +8,9 @@ import pytest
 from kubedl_tpu.ops.attention import (
     chunked_attention, multi_head_attention, reference_attention)
 
+#: compile-heavy compute suite: excluded from `make test`'s fast path
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def qkv():

@@ -13,6 +13,9 @@ from kubedl_tpu.models import llama
 from kubedl_tpu.serving import (Candidate, ServingSLO, autoconfigure_multi)
 from kubedl_tpu.serving.autoconfig import probe_candidate
 
+#: compile-heavy compute suite: excluded from `make test`'s fast path
+pytestmark = pytest.mark.slow
+
 
 def fake_measure(cand: Candidate):
     """Deterministic cost model: int8 halves per-token latency but

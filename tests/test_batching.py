@@ -10,6 +10,9 @@ from kubedl_tpu.models import llama, moe
 from kubedl_tpu.serving.batching import ContinuousBatchingEngine
 from kubedl_tpu.serving.engine import GenerateConfig, InferenceEngine
 
+#: compile-heavy compute suite: excluded from `make test`'s fast path
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def dense():

@@ -15,6 +15,9 @@ from kubedl_tpu.train.checkpoint import (CheckpointConfig, CheckpointManager,
 from kubedl_tpu.train.data import shard_batch, synthetic_lm_batches
 from kubedl_tpu.train.trainer import TrainConfig, Trainer
 
+#: compile-heavy compute suite: excluded from `make test`'s fast path
+pytestmark = pytest.mark.slow
+
 
 def make_trainer(mesh, cfg):
     def loss(p, b):

@@ -32,6 +32,9 @@ from kubedl_tpu.controllers.elastic import ANNOTATION_WORLD_SIZE
 from kubedl_tpu.controllers.registry import OperatorConfig, build_operator
 from kubedl_tpu.core import meta as m
 
+#: compile-heavy compute suite: excluded from `make test`'s fast path
+pytestmark = pytest.mark.slow
+
 REPO = str(pathlib.Path(__file__).resolve().parents[1])
 PAYLOAD = str(pathlib.Path(__file__).with_name("e2e_payload.py"))
 

@@ -15,6 +15,9 @@ from kubedl_tpu.models import llama
 from kubedl_tpu.serving import (GenerateConfig, InferenceEngine,
                                 InferenceServer, ServerConfig, autoconfigure)
 
+#: compile-heavy compute suite: excluded from `make test`'s fast path
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def model():

@@ -10,6 +10,9 @@ import pytest
 from kubedl_tpu.models import llama
 from kubedl_tpu.train.data import pack_documents
 
+#: compile-heavy compute suite: excluded from `make test`'s fast path
+pytestmark = pytest.mark.slow
+
 
 def test_packing_structure():
     docs = [[1, 2, 3], [4, 5, 6, 7, 8], [9, 10], [11, 12, 13, 14]]

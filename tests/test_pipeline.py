@@ -14,6 +14,9 @@ from kubedl_tpu.parallel.mesh import MeshConfig, build_mesh
 from kubedl_tpu.parallel.pipeline import (pipeline_apply, stack_stages,
                                           stage_scan)
 
+#: compile-heavy compute suite: excluded from `make test`'s fast path
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def mesh():

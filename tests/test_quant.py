@@ -9,6 +9,9 @@ import pytest
 from kubedl_tpu.models import llama
 from kubedl_tpu.ops import quant
 
+#: compile-heavy compute suite: excluded from `make test`'s fast path
+pytestmark = pytest.mark.slow
+
 
 def test_quantize_roundtrip_error_small():
     w = jax.random.normal(jax.random.PRNGKey(0), (256, 512), jnp.float32)

@@ -12,6 +12,9 @@ from kubedl_tpu.ops.attention import reference_attention
 from kubedl_tpu.parallel.mesh import MeshConfig, build_mesh
 from kubedl_tpu.parallel.ring import ring_attention
 
+#: compile-heavy compute suite: excluded from `make test`'s fast path
+pytestmark = pytest.mark.slow
+
 
 def qkv(b=2, s=128, h=4, nkv=4, hd=16, seed=0):
     ks = jax.random.split(jax.random.PRNGKey(seed), 3)

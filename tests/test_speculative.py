@@ -10,6 +10,9 @@ from kubedl_tpu.models import llama
 from kubedl_tpu.serving.engine import GenerateConfig, InferenceEngine
 from kubedl_tpu.serving.speculative import SpecStats, SpeculativeEngine
 
+#: compile-heavy compute suite: excluded from `make test`'s fast path
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def models():

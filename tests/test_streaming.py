@@ -17,6 +17,9 @@ from kubedl_tpu.serving import InferenceEngine, InferenceServer, ServerConfig
 from kubedl_tpu.serving.batching import ContinuousBatchingEngine
 from kubedl_tpu.serving.engine import GenerateConfig
 
+#: compile-heavy compute suite: excluded from `make test`'s fast path
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
 def model():
