@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test test-all test-fast bench dryrun crds run-standalone lint
+.PHONY: test test-all test-fast bench dryrun crds run-standalone lint native
 
 # fast path (<3 min): everything except the compile-heavy compute suites
 # (those carry `pytestmark = pytest.mark.slow`)
@@ -41,3 +41,7 @@ run-standalone:
 
 lint:
 	$(PY) -m compileall -q kubedl_tpu tests bench.py __graft_entry__.py
+
+# native runtime components (C++ data packer; auto-built on first use too)
+native:
+	$(PY) -c "from kubedl_tpu.native import ensure_built; print(ensure_built() or 'no compiler')"

@@ -70,6 +70,8 @@ class LlamaConfig:
     #: only the last ``sliding_window`` keys (Mistral/Gemma-2 style,
     #: applied uniformly to all layers; incompatible with cp>1 ring)
     sliding_window: int = 0
+    #: Qwen2-style additive biases on the q/k/v projections
+    qkv_bias: bool = False
 
     def __post_init__(self):
         if self.sliding_window < 0:
@@ -84,6 +86,8 @@ class LlamaConfig:
     def num_params(self) -> int:
         d, hd = self.d_model, self.hd
         attn = d * hd * (self.n_heads + 2 * self.n_kv_heads) + self.n_heads * hd * d
+        if self.qkv_bias:
+            attn += hd * (self.n_heads + 2 * self.n_kv_heads)
         mlp = 3 * d * self.d_ff
         per_layer = attn + mlp + 2 * d
         head = (1 if self.tie_embeddings else 2) * self.vocab_size * d
@@ -101,6 +105,24 @@ def llama2_7b() -> LlamaConfig:
     return LlamaConfig(vocab_size=32000, d_model=4096, n_layers=32,
                        n_heads=32, n_kv_heads=32, d_ff=11008,
                        rope_theta=10000.0)
+
+
+def mistral_7b() -> LlamaConfig:
+    """Mistral-7B-v0.1: Llama core + GQA + 4096-token sliding-window
+    attention (the long-context recipe this family's window knob
+    implements)."""
+    return LlamaConfig(vocab_size=32000, d_model=4096, n_layers=32,
+                       n_heads=32, n_kv_heads=8, d_ff=14336,
+                       rope_theta=10000.0, max_seq_len=32768,
+                       sliding_window=4096)
+
+
+def qwen2_7b() -> LlamaConfig:
+    """Qwen2-7B: GQA with q/k/v projection biases (``qkv_bias``) and a
+    1e6 rope base for 32k context."""
+    return LlamaConfig(vocab_size=152064, d_model=3584, n_layers=28,
+                       n_heads=28, n_kv_heads=4, d_ff=18944,
+                       rope_theta=1e6, max_seq_len=32768, qkv_bias=True)
 
 
 def tiny(vocab: int = 512, seq: int = 256) -> LlamaConfig:
@@ -127,7 +149,13 @@ def init_params(config: LlamaConfig, key) -> dict:
 
     def layer(key):
         ks = jax.random.split(key, 7)
+        biases = {
+            "bq": jnp.zeros((nh * hd,), jnp.float32),
+            "bk": jnp.zeros((nkv * hd,), jnp.float32),
+            "bv": jnp.zeros((nkv * hd,), jnp.float32),
+        } if c.qkv_bias else {}
         return {
+            **biases,
             "attn_norm": jnp.full((d,), norm_init, jnp.float32),
             "wq": dense(ks[0], (d, nh * hd), d),
             "wk": dense(ks[1], (d, nkv * hd), d),
@@ -165,6 +193,8 @@ def param_specs(config: LlamaConfig) -> dict:
     layer = {
         "attn_norm": ls("norm"),
         "wq": ls("embed", "heads"),
+        **({"bq": ls("heads"), "bk": ls("kv_heads"), "bv": ls("kv_heads")}
+           if config.qkv_bias else {}),
         "wk": ls("embed", "kv_heads"),
         "wv": ls("embed", "kv_heads"),
         "wo": ls("heads", "embed"),
@@ -190,6 +220,15 @@ def rms_norm(x, weight, eps: float, offset: float = 0.0):
     xf = x.astype(jnp.float32)
     scale = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
     return (xf * scale * (offset + weight)).astype(x.dtype)
+
+
+def _qkv(config: LlamaConfig, h, lp, w_name: str, b_name: str):
+    """One q/k/v projection, with the family's optional additive bias
+    (Qwen2). Bias lives in float32 next to the norms; cast at use."""
+    y = _mm(h, lp[w_name])
+    if config.qkv_bias:
+        y = y + lp[b_name].astype(y.dtype)
+    return y
 
 
 _ACTS = {"silu": jax.nn.silu, "gelu": jax.nn.gelu}
@@ -255,9 +294,9 @@ def attention_block(config: LlamaConfig, x, lp, cos, sin, segment_ids,
     nh, nkv, hd = c.n_heads, c.n_kv_heads, c.hd
 
     h = rms_norm(x, lp["attn_norm"], c.rms_eps, c.norm_weight_offset)
-    q = _mm(h, lp["wq"]).reshape(b, s, nh, hd)
-    k = _mm(h, lp["wk"]).reshape(b, s, nkv, hd)
-    v = _mm(h, lp["wv"]).reshape(b, s, nkv, hd)
+    q = _qkv(c, h, lp, "wq", "bq").reshape(b, s, nh, hd)
+    k = _qkv(c, h, lp, "wk", "bk").reshape(b, s, nkv, hd)
+    v = _qkv(c, h, lp, "wv", "bv").reshape(b, s, nkv, hd)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
     if mesh is not None and mesh.shape.get("cp", 1) > 1 and segment_ids is None:
@@ -358,9 +397,11 @@ def attention_step(config: LlamaConfig, x, lp, kc, vc, cos, sin, start_pos,
 
     row_pos = getattr(start_pos, "ndim", 0) == 1   # [b] per-row positions
     h = rms_norm(x, lp["attn_norm"], c.rms_eps, c.norm_weight_offset)
-    q = apply_rope(_mm(h, lp["wq"]).reshape(b, s, nh, hd), cos, sin)
-    k = apply_rope(_mm(h, lp["wk"]).reshape(b, s, nkv, hd), cos, sin)
-    v = _mm(h, lp["wv"]).reshape(b, s, nkv, hd)
+    q = apply_rope(_qkv(c, h, lp, "wq", "bq").reshape(b, s, nh, hd),
+                   cos, sin)
+    k = apply_rope(_qkv(c, h, lp, "wk", "bk").reshape(b, s, nkv, hd),
+                   cos, sin)
+    v = _qkv(c, h, lp, "wv", "bv").reshape(b, s, nkv, hd)
     if row_pos:
         # continuous batching: every row writes its chunk at its own
         # position (batched scatter); rows attend up to their own pos
