@@ -1,0 +1,97 @@
+"""Model artifact I/O: the on-disk format the serving runtime loads.
+
+The reference serves TFServing/Triton artifact directories
+(``controllers/serving/framework/tfserving.go`` MODEL_BASE_PATH); the
+TPU-native analog is a directory holding
+
+* ``config.json`` — the model family + its config dataclass fields
+  (dtype stored by name);
+* ``params.npz`` — the param pytree flattened to ``/``-joined key paths
+  (portable numpy, no framework state, loads without orbax).
+
+``save_model``/``load_model`` round-trip any llama-family or MoE config;
+the serving entrypoint (``python -m kubedl_tpu.serving``) consumes this
+via ``$KUBEDL_MODEL_PATH``, which the Inference controller points at the
+ModelVersion artifacts (``platform/serving.py``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import llama, moe
+
+_FAMILIES = {"llama": llama.LlamaConfig, "moe": moe.MoEConfig}
+
+_DTYPES = {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
+           "float16": jnp.float16}
+
+
+def _family_name(config) -> str:
+    return "moe" if isinstance(config, moe.MoEConfig) else "llama"
+
+
+def save_model(config, params, path: str) -> None:
+    """Write config.json + params.npz under ``path`` (atomic-ish: files
+    land under their final names only when fully written)."""
+    os.makedirs(path, exist_ok=True)
+    cfg = dataclasses.asdict(config)
+    cfg["dtype"] = jnp.dtype(config.dtype).name
+    doc = {"family": _family_name(config), "config": cfg}
+    flat = {}
+    for kp, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        key = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                       for p in kp)
+        # bfloat16 has no portable npz dtype: store as float32
+        arr = np.asarray(leaf)
+        if arr.dtype.name == "bfloat16":
+            arr = arr.astype(np.float32)
+        flat[key] = arr
+    tmp = os.path.join(path, ".params.npz.tmp")
+    with open(tmp, "wb") as f:
+        np.savez(f, **flat)
+    os.replace(tmp, os.path.join(path, "params.npz"))
+    tmp = os.path.join(path, ".config.json.tmp")
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+    os.replace(tmp, os.path.join(path, "config.json"))
+
+
+def load_model(path: str) -> Tuple[object, dict]:
+    """(config, params) from a ``save_model`` directory. Params come back
+    as a nested dict keyed like the family's ``init_params`` tree, cast
+    to the config's dtype for weights that were stored widened."""
+    with open(os.path.join(path, "config.json")) as f:
+        doc = json.load(f)
+    cls = _FAMILIES[doc["family"]]
+    fields = {f.name for f in dataclasses.fields(cls)}
+    raw = {k: v for k, v in doc["config"].items() if k in fields}
+    raw["dtype"] = _DTYPES[raw.get("dtype", "bfloat16")]
+    config = cls(**raw)
+
+    dtype = config.dtype
+    params: dict = {}
+    with np.load(os.path.join(path, "params.npz")) as z:
+        for key in z.files:
+            node = params
+            parts = key.split("/")
+            for p in parts[:-1]:
+                node = node.setdefault(p, {})
+            arr = z[key]
+            leaf_name = parts[-1]
+            target = (jnp.float32 if leaf_name in _F32_LEAVES else dtype)
+            node[leaf_name] = jnp.asarray(arr, target)
+    return config, params
+
+
+#: leaves init_params keeps in float32 (norm scales, projection biases,
+#: the MoE router) — everything else reloads at the config dtype
+_F32_LEAVES = {"attn_norm", "mlp_norm", "final_norm",
+               "bq", "bk", "bv", "w_router"}

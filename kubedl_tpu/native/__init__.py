@@ -80,15 +80,17 @@ def load() -> Optional[ctypes.CDLL]:
             return None
         try:
             lib = ctypes.CDLL(str(so))
-        except OSError:
+            lib.kubedl_pack_rows.restype = ctypes.c_long
+            lib.kubedl_pack_rows.argtypes = [
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_long,
+                ctypes.c_long, ctypes.c_int32,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_long,
+            ]
+        except (OSError, AttributeError):
+            # unloadable, or a stale/foreign .so without our symbol:
+            # degrade to the Python fallback, never crash the pipeline
             return None
-        lib.kubedl_pack_rows.restype = ctypes.c_long
-        lib.kubedl_pack_rows.argtypes = [
-            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_long,
-            ctypes.c_long, ctypes.c_int32,
-            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
-            ctypes.c_long,
-        ]
         _lib = lib
         return _lib
 

@@ -86,12 +86,22 @@ def _set_triton(template: dict, mv: Optional[dict], model_path: str) -> None:
 
 
 def _set_jax_serving(template: dict, mv: Optional[dict], model_path: str) -> None:
-    """TPU-native JAX server (kubedl_tpu.serve): PJRT on TPU."""
+    """TPU-native predictor: ``python -m kubedl_tpu.serving`` reading the
+    model artifacts from $KUBEDL_MODEL_PATH and the Morphling-chosen
+    config from the KUBEDL_SERVING_* env (serving/__main__.py)."""
     for ct in m.get_in(template, "spec", "containers", default=[]) or []:
         pl.upsert_env(ct, "PJRT_DEVICE", "TPU")
         if mv is not None:
-            pl.upsert_env(ct, "MODEL_NAME",
+            pl.upsert_env(ct, "KUBEDL_MODEL_NAME",
                           m.get_in(mv, "spec", "modelName", default=""))
+        if model_path:
+            pl.upsert_env(ct, MODEL_PATH_ENV, model_path)
+        # the Services render _DEFAULT_PORTS[JAXServing]; the entrypoint
+        # must bind the same port or every predict gets conn-refused
+        pl.upsert_env(ct, "KUBEDL_SERVING_PORT",
+                      _DEFAULT_PORTS[FRAMEWORK_JAX])
+        if not ct.get("command") and not ct.get("args"):
+            ct["command"] = ["python", "-m", "kubedl_tpu.serving"]
 
 
 FRAMEWORK_SETTERS = {
@@ -327,13 +337,26 @@ class InferenceReconciler(Reconciler):
             chosen = _json.loads(raw)
             if not isinstance(chosen, dict):
                 raise ValueError("not a JSON object")
+            spec_k = int(chosen.get("speculativeK", 0) or 0)
+            draft = str(chosen.get("draftPath") or "")
+            if spec_k > 0 and not draft:
+                # a speculative candidate is only servable with a draft
+                # model; without one the entrypoint would CrashLoop —
+                # degrade to the non-speculative config and say so
+                if self.recorder is not None:
+                    self.recorder.event(
+                        inf, "Warning", "AutoconfigNoDraft",
+                        "speculativeK set without draftPath; serving "
+                        "without speculative decoding")
+                spec_k = 0
             env = {
                 "KUBEDL_SERVING_LANES":
                     str(int(chosen.get("batch", 1) or 1)),
                 "KUBEDL_SERVING_QUANTIZE": str(chosen.get("quantize") or ""),
-                "KUBEDL_SERVING_SPEC_K":
-                    str(int(chosen.get("speculativeK", 0) or 0)),
+                "KUBEDL_SERVING_SPEC_K": str(spec_k),
             }
+            if spec_k > 0:
+                env["KUBEDL_SERVING_DRAFT_PATH"] = draft
         except (ValueError, TypeError):
             # bad values (e.g. {"batch": "fast"}) must degrade to a
             # warning event, not a reconcile retry-loop

@@ -1,0 +1,98 @@
+"""Predictor container entrypoint: ``python -m kubedl_tpu.serving``.
+
+The process the Inference controller's JAXServing predictors run
+(``platform/serving.py`` points ``$KUBEDL_MODEL_PATH`` at the
+ModelVersion artifacts and renders the Morphling-chosen config into
+env). Honors the autoconfig contract end to end:
+
+* ``KUBEDL_MODEL_PATH``   — ``models/io.py`` artifact directory
+* ``KUBEDL_MODEL_NAME``   — REST route name (default: dir basename)
+* ``KUBEDL_SERVING_LANES``    — continuous-batching lane count
+* ``KUBEDL_SERVING_QUANTIZE`` — "int8" or ""
+* ``KUBEDL_SERVING_SPEC_K``   — >0 enables speculative decoding with the
+  draft model at ``KUBEDL_SERVING_DRAFT_PATH`` (single-lane)
+* ``KUBEDL_SERVING_PORT``     — default 8501
+
+SIGTERM (pod shutdown) stops the HTTP server, drains the engine, and
+exits 0 so rolling predictor updates are graceful.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import sys
+import threading
+
+
+def build_engine(model_path: str, lanes: int, quantize: str, spec_k: int,
+                 draft_path: str = "", max_len: int = 1024):
+    """The ONE env-to-engine mapping (also used by tests): returns a
+    started engine honoring the autoconfig candidate."""
+    from ..models.io import load_model
+    from .engine import GenerateConfig
+
+    config, params = load_model(model_path)
+    if spec_k > 0:
+        if not draft_path:
+            raise ValueError("KUBEDL_SERVING_SPEC_K > 0 needs "
+                             "KUBEDL_SERVING_DRAFT_PATH")
+        from .engine import maybe_quantize
+        from .speculative import SpeculativeEngine, SpeculativeServingAdapter
+        dcfg, dparams = load_model(draft_path)
+        return SpeculativeServingAdapter(
+            SpeculativeEngine(
+                config, maybe_quantize(params, quantize or None),
+                dcfg, dparams, k=spec_k, max_len=max_len),
+            gen=GenerateConfig(max_len=max_len))
+    from .batching import ContinuousBatchingEngine
+    return ContinuousBatchingEngine(
+        config, params, lanes=lanes, max_len=max_len,
+        gen=GenerateConfig(max_len=max_len),
+        quantize=quantize or None).start()
+
+
+def main() -> int:
+    logging.basicConfig(level=logging.INFO)
+    log = logging.getLogger("kubedl_tpu.serving")
+    model_path = os.environ.get("KUBEDL_MODEL_PATH", "")
+    if not model_path:
+        log.error("KUBEDL_MODEL_PATH is required")
+        return 2
+    lanes = int(os.environ.get("KUBEDL_SERVING_LANES", "4") or 4)
+    quantize = os.environ.get("KUBEDL_SERVING_QUANTIZE", "")
+    spec_k = int(os.environ.get("KUBEDL_SERVING_SPEC_K", "0") or 0)
+    draft = os.environ.get("KUBEDL_SERVING_DRAFT_PATH", "")
+    max_len = int(os.environ.get("KUBEDL_SERVING_MAX_LEN", "1024") or 1024)
+
+    engine = build_engine(model_path, lanes, quantize, spec_k, draft,
+                          max_len)
+    from .server import InferenceServer, ServerConfig
+    server = InferenceServer(engine, ServerConfig(
+        # `or`, not a get() default: the controller injects the var even
+        # when the ModelVersion has no modelName (empty string)
+        model_name=(os.environ.get("KUBEDL_MODEL_NAME")
+                    or os.path.basename(model_path.rstrip("/"))
+                    or "model"),
+        port=int(os.environ.get("KUBEDL_SERVING_PORT", "8501") or 8501),
+    )).start()
+    log.info("serving %s on %s (lanes=%d quantize=%s)",
+             model_path, server.url, lanes, quantize or "off")
+
+    done = threading.Event()
+
+    def shutdown(signum, frame):
+        done.set()
+
+    signal.signal(signal.SIGTERM, shutdown)
+    signal.signal(signal.SIGINT, shutdown)
+    done.wait()
+    log.info("draining")
+    server.stop()
+    engine.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -165,6 +165,11 @@ def probe_candidate(model, cand: Candidate, prompt_len: int = 64,
     a draft model, or speculative with more than one lane)."""
     import numpy as np
 
+    if new_tokens < 4:
+        # the short/long differencing needs hi > lo by a real margin:
+        # with new_tokens <= lo both runs are identical and the "decode
+        # time" is clamped timing noise (absurd tps, ~0 latency)
+        raise ValueError("probe_candidate needs new_tokens >= 4")
     cfg, params = model
     max_len = max_len or prompt_len + new_tokens + 8
     rng = np.random.default_rng(0)

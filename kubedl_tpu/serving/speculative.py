@@ -46,6 +46,30 @@ class SpecStats:
         return self.accepted / self.proposed if self.proposed else 0.0
 
 
+class SpeculativeServingAdapter:
+    """Presents a SpeculativeEngine through the static-engine serving
+    contract (``generate(prompts, max_new)``), so the HTTP predictor can
+    serve the autoconfig's speculative candidates. Sequences decode one
+    at a time (the engine is single-lane); logprobs are not available on
+    the speculative path."""
+
+    def __init__(self, engine: "SpeculativeEngine",
+                 gen: Optional["GenerateConfig"] = None):
+        self.engine = engine
+        self.gen = gen
+
+    def generate(self, prompts, max_new_tokens: int,
+                 seed: int = 0, return_logprobs: bool = False):
+        if return_logprobs:
+            raise ValueError(
+                "logprobs are not available on the speculative path")
+        return [self.engine.generate(p, max_new_tokens, gen=self.gen)
+                for p in prompts]
+
+    def stop(self) -> None:
+        pass  # nothing running in the background
+
+
 class SpeculativeEngine:
     """Greedy speculative generation for one sequence at a time.
 

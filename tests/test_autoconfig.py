@@ -129,7 +129,8 @@ def test_operator_renders_autoconfig_env(api, op_serving):
         "metadata": {"name": "svc", "namespace": "default",
                      "annotations": {ANNOTATION_AUTOCONFIG: json.dumps(
                          {"batch": 4, "quantize": "int8",
-                          "speculativeK": 2})}},
+                          "speculativeK": 2,
+                          "draftPath": "/models/draft"})}},
         "spec": {"framework": "JAXServing", "predictors": [
             {"name": "main", "replicas": 1, "template": {"spec": {
                 "containers": [{"name": "srv", "image": "img"}]}}}]},
@@ -142,6 +143,33 @@ def test_operator_renders_autoconfig_env(api, op_serving):
     assert env["KUBEDL_SERVING_LANES"] == "4"
     assert env["KUBEDL_SERVING_QUANTIZE"] == "int8"
     assert env["KUBEDL_SERVING_SPEC_K"] == "2"
+    assert env["KUBEDL_SERVING_DRAFT_PATH"] == "/models/draft"
+    # the predictor Service targets the entrypoint's bound port
+    assert env["KUBEDL_SERVING_PORT"] == "8000"
+
+
+def test_speculative_without_draft_degrades(api, op_serving):
+    """speculativeK without draftPath must serve non-speculatively (the
+    entrypoint would CrashLoop otherwise), not render a broken config."""
+    from kubedl_tpu.core import meta as m
+    from kubedl_tpu.platform.serving import ANNOTATION_AUTOCONFIG
+
+    inf = {
+        "apiVersion": "serving.kubedl.io/v1alpha1", "kind": "Inference",
+        "metadata": {"name": "nodraft", "namespace": "default",
+                     "annotations": {ANNOTATION_AUTOCONFIG: json.dumps(
+                         {"batch": 2, "speculativeK": 4})}},
+        "spec": {"framework": "JAXServing", "predictors": [
+            {"name": "main", "replicas": 1, "template": {"spec": {
+                "containers": [{"name": "srv", "image": "img"}]}}}]},
+    }
+    api.create(inf)
+    op_serving.run_until_idle(max_iterations=50)
+    deploy = api.get("Deployment", "default", "nodraft-main")
+    ct = m.get_in(deploy, "spec", "template", "spec", "containers")[0]
+    env = {e["name"]: e.get("value") for e in ct["env"]}
+    assert env["KUBEDL_SERVING_SPEC_K"] == "0"
+    assert "KUBEDL_SERVING_DRAFT_PATH" not in env
 
 
 def test_operator_tolerates_bad_autoconfig_values(api, op_serving):
