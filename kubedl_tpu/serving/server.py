@@ -16,7 +16,13 @@ TFServing REST convention the console/tooling already speak:
   [...]}`` summary event. Rides the continuous-batching engine's
   per-token lane output (``Request.stream``); on the static engine the
   tokens are emitted after the batch completes (degraded but correct);
+* ``POST /v1/models/{name}:registerPrefix`` — body
+  ``{"prefix_tokens": [...]}``: prefill a shared system prompt once; later
+  prompts starting with it load the cached KV block and prefill only the
+  suffix (continuous-batching engine only);
 * ``GET /v1/models/{name}`` — model status (readiness probe target);
+* ``GET /metrics`` — Prometheus exposition (request counts/latency, TTFT,
+  generated-token totals), same registry format the operator exports;
 * ``GET /healthz`` — liveness.
 """
 
@@ -25,10 +31,12 @@ from __future__ import annotations
 import json
 import logging
 import threading
+import time
 from dataclasses import dataclass
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+from ..metrics.registry import Registry
 from .engine import InferenceEngine
 
 
@@ -52,6 +60,22 @@ class InferenceServer:
         # one generate at a time: the TPU is serial anyway, and interleaved
         # donated caches would alias
         self._gen_lock = threading.Lock()
+        self.metrics = Registry()
+        self._m_requests = self.metrics.counter(
+            "kubedl_serving_requests_total",
+            "Prediction requests by mode and outcome",
+            labels=("mode", "status"))
+        self._m_tokens = self.metrics.counter(
+            "kubedl_serving_generated_tokens_total",
+            "Tokens generated across all requests")
+        self._m_latency = self.metrics.histogram(
+            "kubedl_serving_request_seconds",
+            "Wall time per prediction request", labels=("mode",),
+            buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60))
+        self._m_ttft = self.metrics.histogram(
+            "kubedl_serving_ttft_seconds",
+            "Time to first streamed token",
+            buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10))
         server = self
 
         class Handler(_Handler):
@@ -126,6 +150,7 @@ class InferenceServer:
                 if lp:
                     pred["logprobs"] = r.logprobs
                 preds.append(pred)
+            self._m_tokens.inc(sum(len(p["tokens"]) for p in preds))
             return {"predictions": preds}
         # static engine: decode to the longest request in one lockstep
         # batch, trim per instance to its own cap
@@ -140,6 +165,7 @@ class InferenceServer:
             if lp:
                 pred["logprobs"] = lps[:cap]
             preds.append(pred)
+        self._m_tokens.inc(sum(len(p["tokens"]) for p in preds))
         return {"predictions": preds}
 
     def predict_stream(self, body: dict):
@@ -157,13 +183,19 @@ class InferenceServer:
             self.engine.validate(prompt, cap)
 
             def events():
+                t0 = time.perf_counter()
                 req = self.engine.submit(prompt, cap, logprobs=want_lp)
                 out, lps = [], []
                 # per-token bound: a stalled engine surfaces as an error
                 # event, not a silently frozen stream
                 for tok, lp in req.stream(
                         timeout=self.config.request_timeout_s):
+                    if not out:
+                        self._m_ttft.observe(time.perf_counter() - t0)
                     out.append(tok)
+                    # per token, not on completion: an aborted stream
+                    # must still account for what it already served
+                    self._m_tokens.inc()
                     ev = {"token": tok}
                     if lp is not None:
                         ev["logprob"] = lp
@@ -178,11 +210,17 @@ class InferenceServer:
         # static engine: no incremental lane output — generate fully,
         # then emit token events (correctness-compatible fallback)
         def events_static():
+            t0 = time.perf_counter()
             with self._gen_lock:
                 outs = self.engine.generate([prompt], cap,
                                             return_logprobs=want_lp)
             toks_out, lps = outs[0] if want_lp else (outs[0], None)
             toks_out = toks_out[:cap]
+            # post-hoc streaming: the first token arrives only after the
+            # whole batch generated — the honest TTFT for this engine
+            if toks_out:
+                self._m_ttft.observe(time.perf_counter() - t0)
+            self._m_tokens.inc(len(toks_out))
             for i, tok in enumerate(toks_out):
                 ev = {"token": tok}
                 if want_lp:
@@ -193,6 +231,18 @@ class InferenceServer:
                 final["logprobs"] = lps[:cap]
             yield final
         return events_static()
+
+    def register_prefix(self, body: dict) -> dict:
+        """Stash a shared prompt prefix's KV block (continuous-batching
+        engines only — the static engine has no shared cache to load)."""
+        toks = body.get("prefix_tokens")
+        if not isinstance(toks, list) or not toks:
+            raise ValueError("prefix_tokens is required")
+        if not hasattr(self.engine, "register_prefix"):
+            raise ValueError(
+                "this engine does not support prefix caching")
+        self.engine.register_prefix([int(t) for t in toks])
+        return {"registered": len(toks)}
 
     def status(self) -> dict:
         return {"model_version_status": [{
@@ -215,11 +265,13 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(data)
 
-    def _respond_sse(self, events) -> None:
+    def _respond_sse(self, events) -> bool:
         """Stream ``data: {json}`` events with chunked framing (we speak
         raw HTTP/1.1 here, so the chunk lengths are written by hand).
         Errors after the first byte can't change the status line — they
-        become a terminal error event instead."""
+        become a terminal error event instead. Returns True when the
+        stream completed cleanly (the caller's metrics need the real
+        outcome: a swallowed mid-stream failure must not count as ok)."""
         self.send_response(200)
         self.send_header("Content-Type", "text/event-stream")
         self.send_header("Cache-Control", "no-cache")
@@ -232,50 +284,78 @@ class _Handler(BaseHTTPRequestHandler):
                              + data + b"\r\n")
             self.wfile.flush()
 
+        ok = True
         try:
             for ev in events:
                 chunk(ev)
         except (BrokenPipeError, ConnectionResetError):
-            return  # client went away; generation completes server-side
+            return False  # client went away mid-stream
         except Exception as e:  # noqa: BLE001 — surface on the stream
+            ok = False
             logging.getLogger("kubedl_tpu.serving").exception(
                 "stream failed")
             try:
                 chunk({"error": f"{type(e).__name__}: {e}"})
             except OSError:
-                return
+                return False
         try:
             self.wfile.write(b"0\r\n\r\n")
             self.wfile.flush()
         except OSError:
-            pass
+            return False
+        return ok
 
     def do_GET(self):
         cfg = self.server_ref.config
         if self.path == "/healthz":
             self._respond(200, {"status": "ok"})
+        elif self.path == "/metrics":
+            data = self.server_ref.metrics.expose().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
         elif self.path == f"/v1/models/{cfg.model_name}":
             self._respond(200, self.server_ref.status())
         else:
             self._respond(404, {"error": f"no route {self.path}"})
 
     def do_POST(self):
-        cfg = self.server_ref.config
-        if self.path != f"/v1/models/{cfg.model_name}:predict":
+        srv = self.server_ref
+        cfg = srv.config
+        is_prefix = self.path == f"/v1/models/{cfg.model_name}:registerPrefix"
+        if self.path != f"/v1/models/{cfg.model_name}:predict" \
+                and not is_prefix:
             self._respond(404, {"error": f"no route {self.path}"})
             return
+        t0 = time.perf_counter()
+        mode = "prefix" if is_prefix else "predict"
+        ok = True
         try:
             length = int(self.headers.get("Content-Length") or 0)
             body = json.loads(self.rfile.read(length) or b"{}")
-            if body.get("stream"):
+            if is_prefix:
+                self._respond(200, srv.register_prefix(body))
+            elif body.get("stream"):
+                mode = "stream"
                 # validation happens before the first event, so a bad
-                # request still gets a clean 400 status
-                self._respond_sse(self.server_ref.predict_stream(body))
+                # request still gets a clean 400 status; mid-stream
+                # failures are swallowed into a terminal error event, so
+                # the boolean outcome feeds the metrics
+                ok = self._respond_sse(srv.predict_stream(body))
             else:
-                self._respond(200, self.server_ref.predict(body))
+                self._respond(200, srv.predict(body))
         except (ValueError, KeyError, TypeError) as e:
+            srv._m_requests.inc(mode=mode, status="error")
             self._respond(400, {"error": str(e)})
         except Exception as e:  # noqa: BLE001 — a crashed predict must
             # surface as a JSON 500, not a dropped connection (ADVICE r1)
+            srv._m_requests.inc(mode=mode, status="error")
             logging.getLogger("kubedl_tpu.serving").exception("predict failed")
             self._respond(500, {"error": f"{type(e).__name__}: {e}"})
+        else:
+            srv._m_requests.inc(mode=mode, status="ok" if ok else "error")
+            if ok:
+                srv._m_latency.observe(time.perf_counter() - t0, mode=mode)

@@ -1,0 +1,111 @@
+"""Predictor observability: /metrics Prometheus exposition + the HTTP
+prefix-registration route (the serving-side half of the operator's
+metrics convention)."""
+
+import dataclasses
+import json
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from kubedl_tpu.models import llama
+from kubedl_tpu.serving import (GenerateConfig, InferenceEngine,
+                                InferenceServer, ServerConfig)
+from kubedl_tpu.serving.batching import ContinuousBatchingEngine
+
+#: compile-heavy compute suite: excluded from `make test`'s fast path
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = dataclasses.replace(llama.tiny(vocab=128), dtype=jnp.float32)
+    return cfg, llama.init_params(cfg, jax.random.PRNGKey(0))
+
+
+def post(url, path, body):
+    req = urllib.request.Request(url + path, method="POST",
+                                 data=json.dumps(body).encode())
+    return urllib.request.urlopen(req)
+
+
+def scrape(url):
+    with urllib.request.urlopen(url + "/metrics") as r:
+        assert r.headers["Content-Type"].startswith("text/plain")
+        return r.read().decode()
+
+
+def test_metrics_track_requests_tokens_ttft(model):
+    cfg, params = model
+    eng = ContinuousBatchingEngine(cfg, params, lanes=2, max_len=96).start()
+    server = InferenceServer(eng, ServerConfig(
+        model_name="m", host="127.0.0.1", port=0)).start()
+    try:
+        with post(server.url, "/v1/models/m:predict", {
+                "instances": [{"prompt_tokens": [5, 2], "max_tokens": 4}]}):
+            pass
+        with post(server.url, "/v1/models/m:predict", {
+                "stream": True,
+                "instances": [{"prompt_tokens": [5, 2],
+                               "max_tokens": 3}]}) as r:
+            r.read()
+        # a bad request counts as an error, not a success
+        with pytest.raises(urllib.error.HTTPError):
+            post(server.url, "/v1/models/m:predict", {"instances": [{}]})
+        text = scrape(server.url)
+        assert ('kubedl_serving_requests_total'
+                '{mode="predict",status="ok"} 1') in text
+        assert ('kubedl_serving_requests_total'
+                '{mode="stream",status="ok"} 1') in text
+        assert ('kubedl_serving_requests_total'
+                '{mode="predict",status="error"} 1') in text
+        assert "kubedl_serving_generated_tokens_total 7" in text
+        assert 'kubedl_serving_ttft_seconds_count 1' in text
+        assert 'kubedl_serving_request_seconds_count{mode="predict"} 1' \
+            in text
+    finally:
+        server.stop()
+        eng.stop()
+
+
+def test_register_prefix_route_speeds_shared_prompts(model):
+    cfg, params = model
+    eng = ContinuousBatchingEngine(cfg, params, lanes=2, max_len=96).start()
+    server = InferenceServer(eng, ServerConfig(
+        model_name="m", host="127.0.0.1", port=0)).start()
+    try:
+        system = [9, 8, 7, 6, 5, 4, 3, 2]
+        with post(server.url, "/v1/models/m:registerPrefix",
+                  {"prefix_tokens": system}) as r:
+            assert json.load(r)["registered"] == len(system)
+        # prompts starting with the prefix produce the same greedy output
+        body = {"instances": [{"prompt_tokens": system + [1],
+                               "max_tokens": 4}]}
+        with post(server.url, "/v1/models/m:predict", body) as r:
+            got = json.load(r)["predictions"][0]["tokens"]
+        solo = InferenceEngine(cfg, params, GenerateConfig(max_len=96))
+        assert got == solo.generate([system + [1]], 4)[0]
+        # bad body -> 400
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post(server.url, "/v1/models/m:registerPrefix", {})
+        assert ei.value.code == 400
+    finally:
+        server.stop()
+        eng.stop()
+
+
+def test_register_prefix_rejected_on_static_engine(model):
+    cfg, params = model
+    eng = InferenceEngine(cfg, params, GenerateConfig(max_len=64))
+    server = InferenceServer(eng, ServerConfig(
+        model_name="m", host="127.0.0.1", port=0)).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post(server.url, "/v1/models/m:registerPrefix",
+                 {"prefix_tokens": [1, 2]})
+        assert ei.value.code == 400
+    finally:
+        server.stop()
