@@ -9,6 +9,19 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from .registry import Registry
 
 
+def write_exposition(handler: BaseHTTPRequestHandler,
+                     registry: Registry) -> None:
+    """Write the Prometheus text exposition onto an open handler — the
+    ONE copy of the scrape response contract (operator scrape server and
+    the serving predictor's /metrics both call this)."""
+    body = registry.expose().encode()
+    handler.send_response(200)
+    handler.send_header("Content-Type", "text/plain; version=0.0.4")
+    handler.send_header("Content-Length", str(len(body)))
+    handler.end_headers()
+    handler.wfile.write(body)
+
+
 def serve_metrics(registry: Registry, port: int = 8080,
                   host: str = "0.0.0.0") -> ThreadingHTTPServer:
     """Start the scrape endpoint on a daemon thread; returns the server
@@ -26,12 +39,7 @@ def serve_metrics(registry: Registry, port: int = 8080,
                 self.send_header("Content-Length", "0")
                 self.end_headers()
                 return
-            body = registry.expose().encode()
-            self.send_response(200)
-            self.send_header("Content-Type", "text/plain; version=0.0.4")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
+            write_exposition(self, registry)
 
     httpd = ThreadingHTTPServer((host, port), Handler)
     threading.Thread(target=httpd.serve_forever, name="kubedl-metrics",

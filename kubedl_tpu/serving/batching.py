@@ -259,6 +259,10 @@ class ContinuousBatchingEngine:
             entries.sort(key=lambda p: -p[2])
             self._prefixes = entries
 
+    @property
+    def prefix_count(self) -> int:
+        return len(self._prefixes)
+
     def clear_prefixes(self) -> None:
         """Drop every stored prefix KV block (frees device memory)."""
         with self._sched_lock:

@@ -50,6 +50,10 @@ class ServerConfig:
     #: continuous-batching mode: bound on one request's wall time so a
     #: stopped/never-started engine surfaces as a JSON 500, not a hang
     request_timeout_s: float = 600.0
+    #: cap on distinct registered prefixes — each holds a per-layer KV
+    #: block in HBM and the engine never evicts, so an uncapped route
+    #: would let clients OOM the device
+    max_prefixes: int = 8
 
 
 class InferenceServer:
@@ -145,12 +149,19 @@ class InferenceServer:
                     for p, cap, lp in zip(prompts, caps, want_lp)]
             timeout = self.config.request_timeout_s
             preds = []
-            for r, lp in zip(reqs, want_lp):
-                pred = {"tokens": r.result(timeout=timeout)}
-                if lp:
-                    pred["logprobs"] = r.logprobs
-                preds.append(pred)
-            self._m_tokens.inc(sum(len(p["tokens"]) for p in preds))
+            counted = 0
+            try:
+                for r, lp in zip(reqs, want_lp):
+                    pred = {"tokens": r.result(timeout=timeout)}
+                    if lp:
+                        pred["logprobs"] = r.logprobs
+                    preds.append(pred)
+            finally:
+                # tokens already generated by earlier requests in the
+                # batch are real device work even when a later request
+                # times out — account for the snapshot either way
+                counted = sum(len(r.tokens) for r in reqs)
+                self._m_tokens.inc(counted)
             return {"predictions": preds}
         # static engine: decode to the longest request in one lockstep
         # batch, trim per instance to its own cap
@@ -241,6 +252,11 @@ class InferenceServer:
         if not hasattr(self.engine, "register_prefix"):
             raise ValueError(
                 "this engine does not support prefix caching")
+        if getattr(self.engine, "prefix_count", 0) >= \
+                self.config.max_prefixes:
+            raise ValueError(
+                f"prefix limit {self.config.max_prefixes} reached "
+                "(each prefix pins a KV block in HBM)")
         self.engine.register_prefix([int(t) for t in toks])
         return {"registered": len(toks)}
 
@@ -265,13 +281,14 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(data)
 
-    def _respond_sse(self, events) -> bool:
+    def _respond_sse(self, events) -> str:
         """Stream ``data: {json}`` events with chunked framing (we speak
         raw HTTP/1.1 here, so the chunk lengths are written by hand).
         Errors after the first byte can't change the status line — they
-        become a terminal error event instead. Returns True when the
-        stream completed cleanly (the caller's metrics need the real
-        outcome: a swallowed mid-stream failure must not count as ok)."""
+        become a terminal error event instead. Returns "ok", "error"
+        (mid-stream server failure), or "cancelled" (client went away) —
+        the caller's metrics need the real outcome, and client aborts
+        must not inflate the server error rate."""
         self.send_response(200)
         self.send_header("Content-Type", "text/event-stream")
         self.send_header("Cache-Control", "no-cache")
@@ -284,39 +301,35 @@ class _Handler(BaseHTTPRequestHandler):
                              + data + b"\r\n")
             self.wfile.flush()
 
-        ok = True
+        outcome = "ok"
         try:
             for ev in events:
                 chunk(ev)
         except (BrokenPipeError, ConnectionResetError):
-            return False  # client went away mid-stream
+            # a client hitting Stop is normal, not a server fault
+            return "cancelled"
         except Exception as e:  # noqa: BLE001 — surface on the stream
-            ok = False
+            outcome = "error"
             logging.getLogger("kubedl_tpu.serving").exception(
                 "stream failed")
             try:
                 chunk({"error": f"{type(e).__name__}: {e}"})
             except OSError:
-                return False
+                return "error"
         try:
             self.wfile.write(b"0\r\n\r\n")
             self.wfile.flush()
         except OSError:
-            return False
-        return ok
+            return "cancelled" if outcome == "ok" else outcome
+        return outcome
 
     def do_GET(self):
         cfg = self.server_ref.config
         if self.path == "/healthz":
             self._respond(200, {"status": "ok"})
         elif self.path == "/metrics":
-            data = self.server_ref.metrics.expose().encode()
-            self.send_response(200)
-            self.send_header("Content-Type",
-                             "text/plain; version=0.0.4")
-            self.send_header("Content-Length", str(len(data)))
-            self.end_headers()
-            self.wfile.write(data)
+            from ..metrics.http import write_exposition
+            write_exposition(self, self.server_ref.metrics)
         elif self.path == f"/v1/models/{cfg.model_name}":
             self._respond(200, self.server_ref.status())
         else:
@@ -332,7 +345,7 @@ class _Handler(BaseHTTPRequestHandler):
             return
         t0 = time.perf_counter()
         mode = "prefix" if is_prefix else "predict"
-        ok = True
+        outcome = "ok"
         try:
             length = int(self.headers.get("Content-Length") or 0)
             body = json.loads(self.rfile.read(length) or b"{}")
@@ -343,8 +356,8 @@ class _Handler(BaseHTTPRequestHandler):
                 # validation happens before the first event, so a bad
                 # request still gets a clean 400 status; mid-stream
                 # failures are swallowed into a terminal error event, so
-                # the boolean outcome feeds the metrics
-                ok = self._respond_sse(srv.predict_stream(body))
+                # the returned outcome feeds the metrics
+                outcome = self._respond_sse(srv.predict_stream(body))
             else:
                 self._respond(200, srv.predict(body))
         except (ValueError, KeyError, TypeError) as e:
@@ -356,6 +369,6 @@ class _Handler(BaseHTTPRequestHandler):
             logging.getLogger("kubedl_tpu.serving").exception("predict failed")
             self._respond(500, {"error": f"{type(e).__name__}: {e}"})
         else:
-            srv._m_requests.inc(mode=mode, status="ok" if ok else "error")
-            if ok:
+            srv._m_requests.inc(mode=mode, status=outcome)
+            if outcome == "ok":
                 srv._m_latency.observe(time.perf_counter() - t0, mode=mode)

@@ -32,10 +32,19 @@ def post(url, path, body):
     return urllib.request.urlopen(req)
 
 
-def scrape(url):
-    with urllib.request.urlopen(url + "/metrics") as r:
-        assert r.headers["Content-Type"].startswith("text/plain")
-        return r.read().decode()
+def scrape(url, want_lines=(), timeout=10.0):
+    """Fetch /metrics; when ``want_lines`` is given, poll until all
+    appear — the client can observe a response's last byte before the
+    handler thread finishes its post-response metric increments."""
+    import time
+    deadline = time.time() + timeout
+    while True:
+        with urllib.request.urlopen(url + "/metrics") as r:
+            assert r.headers["Content-Type"].startswith("text/plain")
+            text = r.read().decode()
+        if all(w in text for w in want_lines) or time.time() > deadline:
+            return text
+        time.sleep(0.1)
 
 
 def test_metrics_track_requests_tokens_ttft(model):
@@ -55,7 +64,10 @@ def test_metrics_track_requests_tokens_ttft(model):
         # a bad request counts as an error, not a success
         with pytest.raises(urllib.error.HTTPError):
             post(server.url, "/v1/models/m:predict", {"instances": [{}]})
-        text = scrape(server.url)
+        text = scrape(server.url, want_lines=(
+            'kubedl_serving_requests_total{mode="stream",status="ok"} 1',
+            'kubedl_serving_requests_total{mode="predict",status="error"} 1',
+        ))
         assert ('kubedl_serving_requests_total'
                 '{mode="predict",status="ok"} 1') in text
         assert ('kubedl_serving_requests_total'
