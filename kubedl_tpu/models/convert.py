@@ -1,0 +1,139 @@
+"""HuggingFace checkpoint conversion for the llama-family models.
+
+Users of the reference bring torch checkpoints; this maps a HF
+``*ForCausalLM`` state dict (Llama / Mistral / Qwen2 / Gemma — all the
+families this core serves) into this framework's param tree and config,
+so real weights train/serve on TPU without a torch runtime in the
+container. Conversion is pure renaming + transposition: both sides use
+the half-split ("rotate_half") RoPE convention, so no head permutation
+is needed — pinned by the cross-framework logits test
+(tests/test_convert.py compares against transformers' own forward).
+
+Input tensors may be torch tensors (``detach``/``numpy`` duck-typed) or
+numpy arrays — loading the state dict (torch.load / safetensors) is the
+caller's job so this module never imports torch.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from .llama import LlamaConfig
+
+
+def _np(t) -> np.ndarray:
+    if hasattr(t, "detach"):           # torch tensor, cpu or otherwise
+        t = t.detach().cpu().float().numpy()
+    return np.asarray(t, np.float32)
+
+
+def config_from_hf(hf) -> LlamaConfig:
+    """LlamaConfig from a HF config object (or plain dict). Handles the
+    per-family knobs: Qwen2 qkv biases, Mistral sliding window, Gemma
+    norm-offset/GeGLU/tied-embeddings/embed-scale."""
+    get = (hf.get if isinstance(hf, dict)
+           else lambda k, d=None: getattr(hf, k, d))
+    model_type = str(get("model_type", "llama") or "llama").lower()
+    gemma = model_type.startswith("gemma")
+    return LlamaConfig(
+        vocab_size=int(get("vocab_size")),
+        d_model=int(get("hidden_size")),
+        n_layers=int(get("num_hidden_layers")),
+        n_heads=int(get("num_attention_heads")),
+        n_kv_heads=int(get("num_key_value_heads",
+                           get("num_attention_heads"))),
+        d_ff=int(get("intermediate_size")),
+        head_dim=(int(get("head_dim")) if get("head_dim") else None),
+        rope_theta=float(get("rope_theta", 10000.0) or 10000.0),
+        rms_eps=float(get("rms_norm_eps", 1e-5) or 1e-5),
+        max_seq_len=int(get("max_position_embeddings", 8192) or 8192),
+        sliding_window=int(get("sliding_window") or 0),
+        qkv_bias=bool(get("attention_bias", False)
+                      or model_type == "qwen2"),
+        act="gelu" if gemma else "silu",
+        norm_weight_offset=1.0 if gemma else 0.0,
+        embed_scale=gemma,
+        tie_embeddings=bool(get("tie_word_embeddings", gemma)),
+        logit_softcap=float(get("final_logit_softcapping") or 0.0),
+    )
+
+
+def from_hf(config: LlamaConfig, state_dict: dict,
+            dtype: Optional[object] = None) -> dict:
+    """HF ``model.*`` state dict -> this family's param tree (scan layout:
+    layer params stacked on a leading axis). HF linear weights are
+    [out, in]; ours are [in, out] — transposed here once at load."""
+    dtype = dtype or config.dtype
+    sd = {k.removeprefix("model."): v for k, v in state_dict.items()}
+
+    def w(key):                      # [out, in] -> [in, out]
+        return jnp.asarray(_np(sd[key]).T, dtype)
+
+    def vec(key, d=jnp.float32):
+        return jnp.asarray(_np(sd[key]), d)
+
+    layers = []
+    for i in range(config.n_layers):
+        p = f"layers.{i}."
+        lp = {
+            "attn_norm": vec(p + "input_layernorm.weight"),
+            "wq": w(p + "self_attn.q_proj.weight"),
+            "wk": w(p + "self_attn.k_proj.weight"),
+            "wv": w(p + "self_attn.v_proj.weight"),
+            "wo": w(p + "self_attn.o_proj.weight"),
+            "mlp_norm": vec(p + "post_attention_layernorm.weight"),
+            "w_gate": w(p + "mlp.gate_proj.weight"),
+            "w_up": w(p + "mlp.up_proj.weight"),
+            "w_down": w(p + "mlp.down_proj.weight"),
+        }
+        if config.qkv_bias:
+            lp["bq"] = vec(p + "self_attn.q_proj.bias")
+            lp["bk"] = vec(p + "self_attn.k_proj.bias")
+            lp["bv"] = vec(p + "self_attn.v_proj.bias")
+        layers.append(lp)
+
+    if config.scan_layers:
+        stacked = {k: jnp.stack([lp[k] for lp in layers])
+                   for k in layers[0]}
+    else:
+        stacked = layers
+    params = {
+        "embed": jnp.asarray(_np(sd["embed_tokens.weight"]), dtype),
+        "layers": stacked,
+        "final_norm": vec("norm.weight"),
+    }
+    if not config.tie_embeddings:
+        # lm_head lives OUTSIDE the HF "model." prefix
+        params["lm_head"] = jnp.asarray(
+            _np(state_dict["lm_head.weight"]).T, dtype)
+    return params
+
+
+def load_hf_checkpoint(path: str):
+    """(config, params) from a HF model directory (config.json +
+    safetensors/pytorch_model.bin). Imports torch/safetensors lazily —
+    only this loader needs them, conversion itself is numpy."""
+    import json
+    import os
+
+    with open(os.path.join(path, "config.json")) as f:
+        config = config_from_hf(json.load(f))
+    state = {}
+    st_files = sorted(f for f in os.listdir(path)
+                      if f.endswith(".safetensors"))
+    if st_files:
+        from safetensors.numpy import load_file
+        for fn in st_files:
+            state.update(load_file(os.path.join(path, fn)))
+    else:
+        import torch
+        for fn in sorted(f for f in os.listdir(path)
+                         if f.startswith("pytorch_model")
+                         and f.endswith(".bin")):
+            state.update(torch.load(os.path.join(path, fn),
+                                    map_location="cpu",
+                                    weights_only=True))
+    return config, from_hf(config, state)

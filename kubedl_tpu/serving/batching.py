@@ -231,7 +231,8 @@ class ContinuousBatchingEngine:
 
     # -- public API -------------------------------------------------------
 
-    def register_prefix(self, tokens: Sequence[int]) -> None:
+    def register_prefix(self, tokens: Sequence[int],
+                        max_prefixes: Optional[int] = None) -> None:
         """Prefill a shared prompt prefix ONCE and stash its KV block;
         later requests whose prompts start with it load the block into
         their lane and prefill only the suffix — the standard
@@ -255,6 +256,14 @@ class ContinuousBatchingEngine:
             # the best match wins during admission; swap in a NEW list so
             # concurrent _match_prefix iterations never see a mid-sort view
             entries = [p for p in self._prefixes if p[0] != key]
+            # cap enforced HERE, under the lock: a server-side
+            # check-then-call would race concurrent registrations past
+            # the limit, and an idempotent re-register (key already
+            # stored) must never be rejected — it pins no new HBM
+            if max_prefixes is not None and len(entries) >= max_prefixes:
+                raise ValueError(
+                    f"prefix limit {max_prefixes} reached "
+                    "(each prefix pins a KV block in HBM)")
             entries.append((key, stored, plen))
             entries.sort(key=lambda p: -p[2])
             self._prefixes = entries

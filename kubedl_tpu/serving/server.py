@@ -149,7 +149,6 @@ class InferenceServer:
                     for p, cap, lp in zip(prompts, caps, want_lp)]
             timeout = self.config.request_timeout_s
             preds = []
-            counted = 0
             try:
                 for r, lp in zip(reqs, want_lp):
                     pred = {"tokens": r.result(timeout=timeout)}
@@ -160,8 +159,7 @@ class InferenceServer:
                 # tokens already generated by earlier requests in the
                 # batch are real device work even when a later request
                 # times out — account for the snapshot either way
-                counted = sum(len(r.tokens) for r in reqs)
-                self._m_tokens.inc(counted)
+                self._m_tokens.inc(sum(len(r.tokens) for r in reqs))
             return {"predictions": preds}
         # static engine: decode to the longest request in one lockstep
         # batch, trim per instance to its own cap
@@ -252,12 +250,10 @@ class InferenceServer:
         if not hasattr(self.engine, "register_prefix"):
             raise ValueError(
                 "this engine does not support prefix caching")
-        if getattr(self.engine, "prefix_count", 0) >= \
-                self.config.max_prefixes:
-            raise ValueError(
-                f"prefix limit {self.config.max_prefixes} reached "
-                "(each prefix pins a KV block in HBM)")
-        self.engine.register_prefix([int(t) for t in toks])
+        # the engine enforces the cap under its own lock (atomic with the
+        # store; idempotent re-registration of a stored prefix passes)
+        self.engine.register_prefix([int(t) for t in toks],
+                                    max_prefixes=self.config.max_prefixes)
         return {"registered": len(toks)}
 
     def status(self) -> dict:

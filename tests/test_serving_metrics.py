@@ -121,3 +121,29 @@ def test_register_prefix_rejected_on_static_engine(model):
         assert ei.value.code == 400
     finally:
         server.stop()
+
+
+def test_prefix_cap_is_atomic_and_idempotent(model):
+    cfg, params = model
+    eng = ContinuousBatchingEngine(cfg, params, lanes=1, max_len=96).start()
+    server = InferenceServer(eng, ServerConfig(
+        model_name="m", host="127.0.0.1", port=0, max_prefixes=2)).start()
+    try:
+        for pfx in ([1, 2, 3], [4, 5, 6]):
+            with post(server.url, "/v1/models/m:registerPrefix",
+                      {"prefix_tokens": pfx}):
+                pass
+        # at the cap: a NEW prefix is rejected...
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post(server.url, "/v1/models/m:registerPrefix",
+                 {"prefix_tokens": [7, 8, 9]})
+        assert ei.value.code == 400
+        # ...but idempotent re-registration of a stored one still passes
+        # (it pins no new HBM)
+        with post(server.url, "/v1/models/m:registerPrefix",
+                  {"prefix_tokens": [1, 2, 3]}) as r:
+            assert json.load(r)["registered"] == 3
+        assert eng.prefix_count == 2
+    finally:
+        server.stop()
+        eng.stop()
