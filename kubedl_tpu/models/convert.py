@@ -25,9 +25,18 @@ from .llama import LlamaConfig
 
 
 def _np(t) -> np.ndarray:
+    """Host array in the SOURCE dtype where possible: upcasting a whole
+    checkpoint to f32 would double peak host RAM for nothing (the final
+    per-leaf cast happens once at jnp.asarray). safetensors.numpy hands
+    back ml_dtypes bf16 directly; torch bf16 has no numpy bridge, so
+    only that path pays an f32 copy."""
     if hasattr(t, "detach"):           # torch tensor, cpu or otherwise
-        t = t.detach().cpu().float().numpy()
-    return np.asarray(t, np.float32)
+        t = t.detach().cpu()
+        try:
+            return t.numpy()
+        except TypeError:              # torch bf16
+            return t.float().numpy()
+    return np.asarray(t)
 
 
 def config_from_hf(hf) -> LlamaConfig:
@@ -37,7 +46,13 @@ def config_from_hf(hf) -> LlamaConfig:
     get = (hf.get if isinstance(hf, dict)
            else lambda k, d=None: getattr(hf, k, d))
     model_type = str(get("model_type", "llama") or "llama").lower()
-    gemma = model_type.startswith("gemma")
+    if model_type not in ("llama", "mistral", "qwen2", "gemma"):
+        # gemma2/gemma3 add per-layer weights (pre/post-ffw norms, q/k
+        # norms) this converter would silently drop — refuse rather than
+        # produce a wrong model (from_hf also re-checks for leftovers)
+        raise ValueError(f"unsupported HF model_type {model_type!r} "
+                         "(supported: llama, mistral, qwen2, gemma)")
+    gemma = model_type == "gemma"
     return LlamaConfig(
         vocab_size=int(get("vocab_size")),
         d_model=int(get("hidden_size")),
@@ -50,7 +65,10 @@ def config_from_hf(hf) -> LlamaConfig:
         rope_theta=float(get("rope_theta", 10000.0) or 10000.0),
         rms_eps=float(get("rms_norm_eps", 1e-5) or 1e-5),
         max_seq_len=int(get("max_position_embeddings", 8192) or 8192),
-        sliding_window=int(get("sliding_window") or 0),
+        # HF gates the window on use_sliding_window (default on when a
+        # window is set; Qwen2 ships configs with the flag off)
+        sliding_window=(int(get("sliding_window") or 0)
+                        if get("use_sliding_window", True) else 0),
         qkv_bias=bool(get("attention_bias", False)
                       or model_type == "qwen2"),
         act="gelu" if gemma else "silu",
@@ -68,13 +86,18 @@ def from_hf(config: LlamaConfig, state_dict: dict,
     [out, in]; ours are [in, out] — transposed here once at load."""
     dtype = dtype or config.dtype
     sd = {k.removeprefix("model."): v for k, v in state_dict.items()}
+    consumed = set()
 
-    def w(key):                      # [out, in] -> [in, out]
-        return jnp.asarray(_np(sd[key]).T, dtype)
+    def w(key):                      # [out, in] -> [in, out], host-side
+        consumed.add(key)
+        return _np(sd[key]).T
 
-    def vec(key, d=jnp.float32):
-        return jnp.asarray(_np(sd[key]), d)
+    def vec(key):
+        consumed.add(key)
+        return _np(sd[key])
 
+    #: leaves kept float32 (norm scales, projection biases)
+    f32 = {"attn_norm", "mlp_norm", "bq", "bk", "bv"}
     layers = []
     for i in range(config.n_layers):
         p = f"layers.{i}."
@@ -95,15 +118,34 @@ def from_hf(config: LlamaConfig, state_dict: dict,
             lp["bv"] = vec(p + "self_attn.v_proj.bias")
         layers.append(lp)
 
+    # every layer-scoped weight must have been consumed: an unknown key
+    # means a family variant whose extra weights would be silently
+    # dropped (gemma2 pre/post-ffw norms, gemma3 q/k norms, ...)
+    leftovers = sorted(
+        k for k in sd
+        if k.startswith("layers.")
+        and k not in consumed
+        and not k.endswith((".rotary_emb.inv_freq",)))   # buffer, derived
+    if leftovers:
+        raise ValueError(
+            f"unconverted layer weights {leftovers[:4]}... — this HF "
+            "variant carries weights the converter does not map")
+
     if config.scan_layers:
-        stacked = {k: jnp.stack([lp[k] for lp in layers])
-                   for k in layers[0]}
+        # stack on the HOST, one device transfer per key: stacking device
+        # arrays would transiently double peak HBM during conversion
+        stacked = {
+            k: jnp.asarray(np.stack([lp[k] for lp in layers]),
+                           jnp.float32 if k in f32 else dtype)
+            for k in layers[0]}
     else:
-        stacked = layers
+        stacked = [
+            {k: jnp.asarray(v, jnp.float32 if k in f32 else dtype)
+             for k, v in lp.items()} for lp in layers]
     params = {
         "embed": jnp.asarray(_np(sd["embed_tokens.weight"]), dtype),
         "layers": stacked,
-        "final_norm": vec("norm.weight"),
+        "final_norm": jnp.asarray(vec("norm.weight"), jnp.float32),
     }
     if not config.tie_embeddings:
         # lm_head lives OUTSIDE the HF "model." prefix
@@ -122,8 +164,20 @@ def load_hf_checkpoint(path: str):
     with open(os.path.join(path, "config.json")) as f:
         config = config_from_hf(json.load(f))
     state = {}
-    st_files = sorted(f for f in os.listdir(path)
-                      if f.endswith(".safetensors"))
+    # honor the HF shard index when present; otherwise take the
+    # model*.safetensors shards only — official repos may also ship a
+    # consolidated.safetensors in the RAW (non-HF) key layout, and
+    # merging it would trip the unconsumed-weights check
+    index = os.path.join(path, "model.safetensors.index.json")
+    if os.path.exists(index):
+        with open(index) as f:
+            st_files = sorted(set(json.load(f)["weight_map"].values()))
+    else:
+        st_files = sorted(f for f in os.listdir(path)
+                          if f.endswith(".safetensors")
+                          and not f.startswith("consolidated"))
+        if any(f.startswith("model") for f in st_files):
+            st_files = [f for f in st_files if f.startswith("model")]
     if st_files:
         from safetensors.numpy import load_file
         for fn in st_files:

@@ -11,9 +11,9 @@ that distinguish it from Llama:
 * embeddings multiplied by ``sqrt(d_model)``,
 * LM head tied to the embedding table (no separate ``lm_head`` param),
 * Gemma-2 additionally softcaps final logits at 30 and uses 4096-token
-  sliding-window attention (uniform here; note this makes the gemma2
-  config incompatible with cp>1 ring attention — shard long sequences of
-  the gemma-1 configs, or drop the window, if you need cp).
+  sliding-window attention (uniform here; under cp>1 the window rides
+  the dense ring path with global positions, so long-context sharding
+  works for the windowed configs too).
 
 All of ``llama.forward`` / ``forward_step`` / ``loss_fn`` /
 ``init_params`` / ``param_specs`` / ``init_cache`` work unchanged on
@@ -54,8 +54,9 @@ def gemma2_2b() -> LlamaConfig:
     """Gemma-2 2B: GQA + final-logit softcap + sliding-window attention
     (Gemma-2 alternates 4096-window local and global layers; this core
     applies the window uniformly — the conservative approximation that
-    keeps every layer's receptive field within the reference's). The
-    window makes this config incompatible with cp>1 ring attention."""
+    keeps every layer's receptive field within the reference's).
+    Under cp>1 the window rides the dense ring path (global
+    positions), so long-context sharding still works."""
     return LlamaConfig(vocab_size=256128, d_model=2304, n_layers=26,
                        n_heads=8, n_kv_heads=4, d_ff=9216, head_dim=256,
                        max_seq_len=8192, logit_softcap=30.0,

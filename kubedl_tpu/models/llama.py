@@ -68,7 +68,8 @@ class LlamaConfig:
     logit_softcap: float = 0.0
     #: >0: sliding-window (local) attention — every position attends
     #: only the last ``sliding_window`` keys (Mistral/Gemma-2 style,
-    #: applied uniformly to all layers; incompatible with cp>1 ring)
+    #: applied uniformly to all layers; composes with cp>1 via the
+    #: dense ring path, global-position windows)
     sliding_window: int = 0
     #: Qwen2-style additive biases on the q/k/v projections
     qkv_bias: bool = False
@@ -301,11 +302,11 @@ def attention_block(config: LlamaConfig, x, lp, cos, sin, segment_ids,
     k = apply_rope(k, cos, sin)
     if mesh is not None and mesh.shape.get("cp", 1) > 1 and segment_ids is None:
         # sequence sharded on cp: ring attention keeps the full-sequence
-        # attention exact while K/V blocks rotate over ICI
-        if c.sliding_window:
-            raise ValueError("sliding_window is not supported with a "
-                             "cp-sharded sequence (ring attention)")
-        attn = ring_attention(mesh, q, k, v, causal=True)
+        # attention exact while K/V blocks rotate over ICI; a sliding
+        # window rides the ring with global positions (dense per-block
+        # path), so Mistral/Gemma-2-style models train long-context too
+        attn = ring_attention(mesh, q, k, v, causal=True,
+                              window=c.sliding_window)
     else:
         attn = multi_head_attention(q, k, v, causal=True,
                                     segment_ids=segment_ids,

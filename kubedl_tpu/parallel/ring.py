@@ -149,7 +149,7 @@ _ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
 
 
 def ring_attention_p(q, k, v, axis_name: str = "cp", causal: bool = True,
-                     impl: str = "auto"):
+                     impl: str = "auto", window: int = 0):
     """Per-shard ring attention; must run under ``shard_map`` with
     ``axis_name`` bound. q: [b, sq, h, hd]; k/v: [b, sk, nkv, hd] — all
     *local* sequence shards. Returns [b, sq, h, hd] in q.dtype.
@@ -160,10 +160,21 @@ def ring_attention_p(q, k, v, axis_name: str = "cp", causal: bool = True,
     einsum online-softmax path; "auto" picks flash for 128-aligned
     shapes ON TPU (interpret-mode pallas on CPU would be orders of
     magnitude slower than the einsum path, same convention as
-    ``multi_head_attention``)."""
+    ``multi_head_attention``).
+
+    ``window > 0``: sliding-window attention with GLOBAL positions —
+    the Mistral/Gemma-2 long-context recipe composed with context
+    parallelism (each query sees the last ``window`` keys across shard
+    boundaries). Runs on the dense path (the per-block flash kernels'
+    window pruning is not yet composed with ring offsets)."""
+    _attn._check_window(window, causal)
     if impl == "auto":
-        impl = "flash" if _ring_flash_eligible(q, k) else "dense"
+        impl = ("flash" if window == 0 and _ring_flash_eligible(q, k)
+                else "dense")
     if impl == "flash":
+        if window:
+            raise ValueError("ring flash does not support sliding "
+                             "windows; use impl='dense'")
         return _ring_flash(q, k, v, axis_name, causal,
                            not _attn._on_tpu())
     axis_size = jax.lax.axis_size(axis_name)
@@ -193,6 +204,10 @@ def ring_attention_p(q, k, v, axis_name: str = "cp", causal: bool = True,
         if causal:
             k_pos = src * sk + jnp.arange(sk)
             mask = q_pos[:, None] >= k_pos[None, :]
+            if window > 0:
+                # same rule as ops.attention._build_mask, on GLOBAL
+                # positions: keys in (q_pos - window, q_pos]
+                mask = mask & (k_pos[None, :] > q_pos[:, None] - window)
             s = jnp.where(mask[None, None], s, _NEG_INF)
         m_new = jnp.maximum(m_run, jnp.max(s, axis=-1))
         # exp(s - m) is 1, not 0, for rows where everything is masked so
@@ -217,9 +232,10 @@ def ring_attention_p(q, k, v, axis_name: str = "cp", causal: bool = True,
     return (o / l).astype(q.dtype)
 
 
-@functools.partial(jax.jit, static_argnums=(0, 4, 5, 6))
+@functools.partial(jax.jit, static_argnums=(0, 4, 5, 6, 7))
 def ring_attention(mesh: Mesh, q, k, v, causal: bool = True,
-                   axis_name: str = "cp", impl: str = "auto"):
+                   axis_name: str = "cp", impl: str = "auto",
+                   window: int = 0):
     """Sharded entry point: wraps the per-shard kernel in ``shard_map``
     with the framework's activation layout ([batch, seq, heads, head_dim]
     → batch on (dp, fsdp), seq on cp, heads on tp). K/V heads replicate
@@ -247,11 +263,11 @@ def ring_attention(mesh: Mesh, q, k, v, causal: bool = True,
     # varying-axes type, which the strict vma checker cannot type — the
     # dense path keeps the checker's trace-time protection
     if impl == "auto":
-        impl = ("flash" if _ring_flash_eligible(
+        impl = ("flash" if window == 0 and _ring_flash_eligible(
             q, k, cp=mesh.shape.get(axis_name, 1)) else "dense")
     fn = jax.shard_map(
         functools.partial(ring_attention_p, axis_name=axis_name,
-                          causal=causal, impl=impl),
+                          causal=causal, impl=impl, window=window),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=(impl != "flash"))
     return fn(q, k, v)
