@@ -67,8 +67,7 @@ def config_from_hf(hf) -> LlamaConfig:
         max_seq_len=int(get("max_position_embeddings", 8192) or 8192),
         # HF gates the window on use_sliding_window (default on when a
         # window is set; Qwen2 ships configs with the flag off)
-        sliding_window=(int(get("sliding_window") or 0)
-                        if get("use_sliding_window", True) else 0),
+        sliding_window=_window_from_hf(get),
         qkv_bias=bool(get("attention_bias", False)
                       or model_type == "qwen2"),
         act="gelu" if gemma else "silu",
@@ -77,6 +76,24 @@ def config_from_hf(hf) -> LlamaConfig:
         tie_embeddings=bool(get("tie_word_embeddings", gemma)),
         logit_softcap=float(get("final_logit_softcapping") or 0.0),
     )
+
+
+def _window_from_hf(get) -> int:
+    """HF sliding-window semantics -> the family's uniform window knob.
+    Qwen2's max_window_layers applies the window to a layer SUBSET; this
+    core is uniform, so a partial-window config is refused rather than
+    silently mis-converted (same policy as the gemma2 rejection)."""
+    if not get("use_sliding_window", True):
+        return 0
+    window = int(get("sliding_window") or 0)
+    if window:
+        mwl = get("max_window_layers")
+        if mwl is not None and int(mwl) < int(get("num_hidden_layers")):
+            raise ValueError(
+                f"max_window_layers={mwl} applies the sliding window to "
+                "a layer subset; this core's window is uniform — "
+                "refusing rather than converting a divergent model")
+    return window
 
 
 def from_hf(config: LlamaConfig, state_dict: dict,

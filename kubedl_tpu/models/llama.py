@@ -29,7 +29,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from ..ops.attention import multi_head_attention, repeat_kv
+from ..ops.attention import multi_head_attention
 from ..ops.quant import mm as _mm
 from ..parallel.ring import ring_attention
 from ..parallel.sharding import spec
@@ -418,21 +418,31 @@ def attention_step(config: LlamaConfig, x, lp, kc, vc, cos, sin, start_pos,
             vc, v.astype(vc.dtype), (0, start_pos, 0, 0))
         q_pos = (start_pos + jnp.arange(s))[None, :]            # [1, s]
 
-    kf = repeat_kv(kc, nh).astype(jnp.float32)
-    vf = repeat_kv(vc, nh).astype(jnp.float32)
-    qf = q.astype(jnp.float32) * (1.0 / math.sqrt(hd))
-    scores = jnp.einsum("bqhd,bkhd->bhqk", qf, kf,
+    # GQA-grouped attention straight against the cache: NO repeat_kv
+    # materialization and NO f32 cache copy — decode is HBM-bound, and
+    # the old path read (nh/nkv)x repeated K/V at 2x bytes. Products
+    # accumulate in f32 on the MXU (preferred_element_type), and the
+    # 1/sqrt(hd) scale applies to the f32 scores, so the math matches
+    # the upcast-everything path on the same stored values.
+    g = nh // nkv
+    qg = q.reshape(b, s, nkv, g, hd)
+    scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, kc,
                         preferred_element_type=jnp.float32)
+    scores = scores * jnp.float32(1.0 / math.sqrt(hd))
     k_pos = jnp.arange(max_len)
-    mask = (k_pos[None, None, :] <= q_pos[:, :, None])[:, None]  # causal
+    mask = (k_pos[None, None, :] <= q_pos[:, :, None])   # causal [b?,q,k]
     if c.sliding_window:
         mask = mask & (k_pos[None, None, :]
-                       > q_pos[:, :, None] - c.sliding_window)[:, None]
+                       > q_pos[:, :, None] - c.sliding_window)
     if valid is not None:
-        mask = mask & valid[:, None, None, :]
-    scores = jnp.where(mask, scores, -1e30)
+        mask = mask & valid[:, None, :]
+    scores = jnp.where(mask[:, None, None], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
-    attn = jnp.einsum("bhqk,bkhd->bqhd", probs, vf).astype(x.dtype)
+    # probs stay f32 (on-chip); V is read in cache dtype and upcast in
+    # registers inside the dot — HBM sees only the bf16 cache bytes
+    attn = jnp.einsum("bhgqk,bkhd->bqhgd", probs, vc,
+                      preferred_element_type=jnp.float32)
+    attn = attn.reshape(b, s, nh, hd).astype(x.dtype)
     return x + _mm(attn.reshape(b, s, nh * hd), lp["wo"]), kc, vc
 
 
