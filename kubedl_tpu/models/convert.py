@@ -80,19 +80,28 @@ def config_from_hf(hf) -> LlamaConfig:
 
 def _window_from_hf(get) -> int:
     """HF sliding-window semantics -> the family's uniform window knob.
-    Qwen2's max_window_layers applies the window to a layer SUBSET; this
-    core is uniform, so a partial-window config is refused rather than
-    silently mis-converted (same policy as the gemma2 rejection)."""
+    HF applies the window to layers ``i >= max_window_layers`` (the
+    FIRST max_window_layers layers run full attention — Qwen2 config
+    docs). This core is uniform, so only the two uniform shapes
+    convert: mwl == 0 (every layer slides -> keep the window) and
+    mwl >= n_layers (no layer slides -> window off); a mixed config is
+    refused rather than silently mis-converted (same policy as the
+    gemma2 rejection)."""
     if not get("use_sliding_window", True):
         return 0
     window = int(get("sliding_window") or 0)
     if window:
         mwl = get("max_window_layers")
-        if mwl is not None and int(mwl) < int(get("num_hidden_layers")):
-            raise ValueError(
-                f"max_window_layers={mwl} applies the sliding window to "
-                "a layer subset; this core's window is uniform — "
-                "refusing rather than converting a divergent model")
+        n_layers = int(get("num_hidden_layers"))
+        if mwl is not None:
+            mwl = int(mwl)
+            if mwl >= n_layers:
+                return 0       # HF runs every layer with full attention
+            if mwl > 0:
+                raise ValueError(
+                    f"max_window_layers={mwl} applies the sliding window "
+                    "to a layer subset; this core's window is uniform — "
+                    "refusing rather than converting a divergent model")
     return window
 
 

@@ -104,3 +104,23 @@ def test_roundtrip_through_model_io(tmp_path):
     np.testing.assert_allclose(
         np.asarray(llama.forward(cfg, params, toks)),
         np.asarray(llama.forward(cfg2, params2, toks)), atol=1e-6)
+
+
+def test_qwen2_window_layer_subset_semantics():
+    """HF slides layers i >= max_window_layers. Only uniform shapes
+    convert: mwl=0 keeps the window, mwl>=n turns it off, mixed refuses."""
+    base = dict(vocab_size=64, hidden_size=32, intermediate_size=64,
+                num_hidden_layers=4, num_attention_heads=2,
+                num_key_value_heads=2, use_sliding_window=True,
+                sliding_window=1024, model_type="qwen2")
+    # no layer slides in HF -> window off
+    assert config_from_hf({**base, "max_window_layers": 4}).sliding_window == 0
+    # every layer slides -> uniform window kept
+    assert config_from_hf({**base,
+                           "max_window_layers": 0}).sliding_window == 1024
+    # mixed subset -> refuse
+    with pytest.raises(ValueError, match="layer subset"):
+        config_from_hf({**base, "max_window_layers": 2})
+    # flag off -> no window regardless
+    assert config_from_hf({**base, "use_sliding_window": False,
+                           "max_window_layers": 2}).sliding_window == 0
