@@ -138,6 +138,38 @@ def _ring_block(b=1, s=256, nh=4, nkv=2, hd=128):
     return ferr, berr
 
 
+def _decode_exactness(b=2, s=64, steps=4):
+    """Serving decode path on silicon: cached prefill+decode (the
+    grouped-GQA attention_step) must reproduce the full forward's greedy
+    rollout — the contract every serving engine leans on. Uses the real
+    chip's bf16 default so the comparison covers the deployed dtype."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubedl_tpu.models import llama
+    from kubedl_tpu.serving.engine import GenerateConfig, InferenceEngine
+
+    cfg = llama.tiny(vocab=256, seq=128)   # bf16, MQA (nkv=1), all knobs
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    eng = InferenceEngine(cfg, params, GenerateConfig(max_len=s))
+    prompt = [3, 17, 42, 9]
+    got = eng.generate([prompt] * b, steps)
+    cur = list(prompt)
+    ref = []
+    for _ in range(steps):
+        logits = llama.forward(cfg, params, jnp.asarray([cur]))
+        nxt = int(jnp.argmax(logits[0, -1]))
+        ref.append(nxt)
+        cur.append(nxt)
+    # STRICT zero-mismatch: a bf16 argmax tie flip would cascade into
+    # every later token, so there is no meaningful partial budget — any
+    # divergence between the cached decode and the full forward is
+    # exactly what this config exists to surface (mism counted for the
+    # artifact; pass requires 0)
+    mism = sum(1 for row in got for a, w in zip(row, ref) if a != w)
+    return float(mism), 0.0
+
+
 def run_selftest(device=None) -> dict:
     """Run every config class on the already-initialized backend and
     write TPU_SELFTEST.json. Returns the result dict. Never raises —
@@ -172,11 +204,14 @@ def run_selftest(device=None) -> dict:
             f.write("\n")
         os.replace(tmp, OUT)
 
-    for name, kw in list(_configs()) + [("ring_flash_block", None)]:
+    extras = {"ring_flash_block": _ring_block,
+              "decode_exactness": _decode_exactness}
+    for name, kw in list(_configs()) + [(n, None) for n in extras]:
         t0 = time.time()
         try:
-            if name == "ring_flash_block":
-                ferr, berr = _ring_block()
+            fn = extras.get(name)
+            if fn is not None:
+                ferr, berr = fn()
             else:
                 ferr, berr = _one(name, **kw)
             passed = ferr <= FWD_TOL and berr <= BWD_TOL
