@@ -1,18 +1,23 @@
-"""Weight-only int8 quantization for serving.
+"""Weight-only quantization for serving: int8 and packed int4.
 
 TPU decode is HBM-bandwidth-bound: every step streams all weights once
-per token, so halving weight bytes (bf16 → int8 + per-channel f32 scale)
-directly raises decode tokens/s and halves the HBM a model occupies.
-Scheme: symmetric per-output-channel, dequantize-on-the-fly —
+per token, so shrinking weight bytes directly raises decode tokens/s and
+cuts the HBM a model occupies. Two schemes:
 
-    y = (x @ q.astype(x.dtype)) * scale        # scale: [out]
+* **int8** — symmetric per-output-channel, dequantize-on-the-fly:
+  ``y = (x @ q.astype(x.dtype)) * scale`` (scale [out]); XLA fuses the
+  rescale into the matmul epilogue, the MXU sees a bf16 contraction.
+* **int4** — two signed nibbles packed per int8 byte along the
+  contraction axis, with GROUP-wise scales (``group`` input rows share
+  one f32 scale per output channel) to hold accuracy at 4 bits. Unpack
+  (sign-extending shifts) + rescale are elementwise and fuse into the
+  dot's operand load, so HBM sees only the packed nibbles — half the
+  int8 bytes again.
 
-XLA fuses the rescale into the matmul epilogue; the MXU sees the usual
-bf16 contraction. Quantization is SERVING-only: training stays bf16
-master weights (the trainer never sees QTensor leaves).
-
-The reference has no quantization machinery anywhere (it ships no
-models); this is TPU-native capability beyond parity.
+Quantization is SERVING-only: training stays bf16 master weights (the
+trainer never sees quantized leaves). The reference has no quantization
+machinery anywhere (it ships no models); this is TPU-native capability
+beyond parity.
 """
 
 from __future__ import annotations
@@ -49,11 +54,73 @@ def quantize_int8(w) -> QTensor:
     return QTensor(q=q, scale=scale[..., 0, :])
 
 
+@dataclass(frozen=True)
+class Q4Tensor:
+    """Packed int4 weights: ``packed[..., in/2, out]`` int8 holds two
+    signed nibbles of consecutive input rows (low nibble = even row);
+    ``scale[..., in/group, out]`` float32."""
+    packed: jax.Array
+    scale: jax.Array
+    group: int
+
+    @property
+    def shape(self):
+        *lead, in2, out = self.packed.shape
+        return (*lead, in2 * 2, out)
+
+    @property
+    def nbytes(self) -> int:
+        return self.packed.size + self.scale.size * 4
+
+
+jax.tree_util.register_dataclass(
+    Q4Tensor, data_fields=["packed", "scale"], meta_fields=["group"])
+
+
+def quantize_int4(w, group: int = 64) -> Q4Tensor:
+    """[in, out] (or [..., in, out]) float weights -> packed signed int4
+    with group-wise scales over the contraction axis. ``in`` must be
+    even; a non-divisible ``group`` falls back to one group per tensor
+    (still int4 precision, coarser scaling)."""
+    wf = jnp.asarray(w, jnp.float32)
+    n_in = wf.shape[-2]
+    if n_in % 2:
+        raise ValueError(f"int4 packing needs an even in-dim, got {n_in}")
+    if n_in % group:
+        group = n_in
+    gshape = wf.shape[:-2] + (n_in // group, group, wf.shape[-1])
+    wg = wf.reshape(gshape)
+    amax = jnp.max(jnp.abs(wg), axis=-2, keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / 7.0
+    q = jnp.clip(jnp.round(wg / scale), -7, 7).astype(jnp.int8)
+    q = q.reshape(wf.shape[:-2] + (n_in // 2, 2, wf.shape[-1]))
+    lo, hi = q[..., 0, :], q[..., 1, :]
+    packed = ((hi << 4) | (lo & 0x0F)).astype(jnp.int8)
+    return Q4Tensor(packed=packed, scale=scale[..., 0, :], group=group)
+
+
+def _unpack_int4(w: Q4Tensor, dtype):
+    """Q4Tensor -> dense [..., in, out] in ``dtype``. Pure elementwise
+    (sign-extending shifts + group rescale): fuses into the consuming
+    dot's operand load under XLA."""
+    lo = ((w.packed << 4) >> 4).astype(jnp.int8)   # sign-extend low nibble
+    hi = (w.packed >> 4).astype(jnp.int8)          # arithmetic shift
+    *lead, in2, out = w.packed.shape
+    q = jnp.stack([lo, hi], axis=-2).reshape(*lead, in2 * 2, out)
+    n_in = in2 * 2
+    qg = q.reshape(*lead, n_in // w.group, w.group, out).astype(jnp.float32)
+    dense = qg * w.scale[..., :, None, :].astype(jnp.float32)
+    return dense.reshape(*lead, n_in, out).astype(dtype)
+
+
 def to_dense(w, dtype=jnp.bfloat16):
-    """QTensor -> dense float weights (or pass a dense array through)."""
+    """QTensor/Q4Tensor -> dense float weights (dense arrays pass
+    through)."""
     if isinstance(w, QTensor):
         return (w.q.astype(jnp.float32)
                 * w.scale[..., None, :].astype(jnp.float32)).astype(dtype)
+    if isinstance(w, Q4Tensor):
+        return _unpack_int4(w, dtype)
     return w
 
 
@@ -64,6 +131,11 @@ def mm(x, w):
     if isinstance(w, QTensor):
         y = x @ w.q.astype(x.dtype)
         return y * w.scale.astype(y.dtype)
+    if isinstance(w, Q4Tensor):
+        # group scales vary along the contraction axis, so the rescale
+        # cannot move to the epilogue; the unpacked operand is transient
+        # (fused into the dot), HBM reads only the packed nibbles
+        return x @ _unpack_int4(w, x.dtype)
     from .lora import LoraTensor, mm_lora
     if isinstance(w, LoraTensor):
         return mm_lora(x, w)
@@ -77,12 +149,19 @@ def mm(x, w):
 QUANTIZABLE = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "lm_head")
 
 
-def quantize_params(params: dict) -> dict:
-    """Quantize a llama/gemma-family param tree's matmul weights in place
-    (returns a new tree; non-quantizable leaves pass through)."""
+def quantize_params(params: dict, mode: str = "int8") -> dict:
+    """Quantize a llama/gemma-family param tree's matmul weights
+    (returns a new tree; non-quantizable leaves pass through).
+    ``mode``: "int8" (per-channel) or "int4" (packed, group scales)."""
+    modes = {"int8": quantize_int8, "int4": quantize_int4}
+    if mode not in modes:
+        raise ValueError(f"unknown quantize mode {mode!r} "
+                         f"(one of {sorted(modes)})")
+    quantize = modes[mode]
+
     def walk(node):
         if isinstance(node, dict):
-            return {k: (quantize_int8(v)
+            return {k: (quantize(v)
                         if k in QUANTIZABLE and _is_weight(v) else walk(v))
                     for k, v in node.items()}
         if isinstance(node, (list, tuple)):
@@ -96,7 +175,8 @@ def quantize_params(params: dict) -> dict:
 
 
 def tree_nbytes(params) -> int:
-    """Total parameter bytes (QTensor-aware) — the HBM the weights occupy."""
+    """Total parameter bytes (quantization-aware) — the HBM the weights
+    occupy."""
     return sum(
         leaf.nbytes for leaf in jax.tree_util.tree_leaves(
-            params, is_leaf=lambda x: isinstance(x, QTensor)))
+            params, is_leaf=lambda x: isinstance(x, (QTensor, Q4Tensor))))

@@ -8,7 +8,7 @@ env). Honors the autoconfig contract end to end:
 * ``KUBEDL_MODEL_PATH``   — ``models/io.py`` artifact directory
 * ``KUBEDL_MODEL_NAME``   — REST route name (default: dir basename)
 * ``KUBEDL_SERVING_LANES``    — continuous-batching lane count
-* ``KUBEDL_SERVING_QUANTIZE`` — "int8" or ""
+* ``KUBEDL_SERVING_QUANTIZE`` — "int8", "int4", or ""
 * ``KUBEDL_SERVING_SPEC_K``   — >0 enables speculative decoding with the
   draft model at ``KUBEDL_SERVING_DRAFT_PATH`` (single-lane)
 * ``KUBEDL_SERVING_PORT``     — default 8501

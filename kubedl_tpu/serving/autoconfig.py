@@ -7,8 +7,9 @@ SLOs. This is the TPU-native, in-process version, searching the knobs the
 in-tree serving stack actually has:
 
 * **lane count / batch** — continuous-batching lanes (HBM for cache rows);
-* **int8 weight quantization** — halves weight bandwidth, changes outputs
-  (excluded when the SLO pins quality);
+* **int8 / int4 weight quantization** — halves (or quarters) weight
+  bandwidth, changes outputs (excluded when the SLO pins quality; pass
+  ``quantize_opts=(None, "int8", "int4")`` to search all three);
 * **speculative decoding draft length k** — trades draft FLOPs for
   target-pass amortization; greedy-identical to the serving engine's own
   outputs, so it is quality-safe;
@@ -80,7 +81,7 @@ def autoconfigure(engine: InferenceEngine,
 class Candidate:
     """One point in the serving-config space."""
     batch: int = 1                    # continuous-batching lanes
-    quantize: Optional[str] = None    # target weights: None | "int8"
+    quantize: Optional[str] = None    # target weights: None|"int8"|"int4"
     speculative_k: int = 0            # 0 = off; >0 = draft lookahead
 
     def to_env(self) -> dict:

@@ -245,12 +245,21 @@ class ContinuousBatchingEngine:
         if plen >= self.max_len:
             raise ValueError(
                 f"prefix {plen} exceeds cache capacity {self.max_len}")
+        key = tuple(tokens)
+        if max_prefixes is not None and \
+                not any(p[0] == key for p in self._prefixes) and \
+                len(self._prefixes) >= max_prefixes:
+            # optimistic pre-check: a rejected registration must not
+            # first burn a full device prefill (the authoritative check
+            # below runs under the lock)
+            raise ValueError(
+                f"prefix limit {max_prefixes} reached "
+                "(each prefix pins a KV block in HBM)")
         bucket = min(_bucket(plen), self.max_len)
         toks = np.zeros((1, bucket), np.int32)
         toks[0, :plen] = tokens
         stored = self._fill_prefix(self.params, jnp.asarray(toks),
                                    jnp.int32(plen))
-        key = tuple(tokens)
         with self._sched_lock:
             # dedup (re-registering replaces) + longest-first ordering so
             # the best match wins during admission; swap in a NEW list so

@@ -65,12 +65,14 @@ def resolve_family(config):
 
 
 def maybe_quantize(params: dict, quantize):
-    """Apply a serving quantization mode ('int8' or None) to a param tree."""
-    if quantize == "int8":
-        # weight-only int8: halves weight HBM + bandwidth; decode is
-        # bandwidth-bound so this is the cheap serving speedup
+    """Apply a serving quantization mode ('int8', 'int4', or None) to a
+    param tree."""
+    if quantize in ("int8", "int4"):
+        # weight-only: int8 halves weight HBM + bandwidth, int4 (packed
+        # nibbles, group scales) halves it again; decode is
+        # bandwidth-bound so these are the cheap serving speedups
         from ..ops.quant import quantize_params
-        return quantize_params(params)
+        return quantize_params(params, mode=quantize)
     if quantize:
         raise ValueError(f"unknown quantize mode {quantize!r}")
     return params

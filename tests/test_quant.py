@@ -4,6 +4,7 @@ import dataclasses
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import pytest
 
 from kubedl_tpu.models import llama
@@ -80,7 +81,7 @@ def test_engine_quantized_generation():
     out = eng.generate([[5, 7, 11], [3]], max_new_tokens=4)
     assert len(out) == 2 and all(len(o) == 4 for o in out)
     with pytest.raises(ValueError):
-        InferenceEngine(cfg, params, quantize="int4")
+        InferenceEngine(cfg, params, quantize="fp8")  # unknown mode
 
 
 def test_training_path_untouched_by_quant_import():
@@ -93,3 +94,87 @@ def test_training_path_untouched_by_quant_import():
                                          tokens[:, 1:]))(params)
     assert all(bool(jnp.isfinite(x).all())
                for x in jax.tree_util.tree_leaves(g))
+
+
+# -- int4 --------------------------------------------------------------------
+
+
+def test_int4_pack_unpack_exact():
+    """Values already on the int4 grid survive quantize->dense exactly
+    (both nibbles, both signs)."""
+    from kubedl_tpu.ops.quant import Q4Tensor, quantize_int4, to_dense
+
+    rng = np.random.default_rng(0)
+    grid = rng.integers(-7, 8, (64, 16)).astype(np.float32)
+    scale = rng.uniform(0.5, 2.0, (1, 16)).astype(np.float32)
+    w = grid * scale          # per-channel scaling, exactly representable
+    q = quantize_int4(jnp.asarray(w), group=64)
+    assert isinstance(q, Q4Tensor)
+    assert q.packed.shape == (32, 16)
+    back = np.asarray(to_dense(q, jnp.float32))
+    np.testing.assert_allclose(back, w, rtol=1e-5, atol=1e-5)
+
+
+def test_int4_error_bounded_by_group_scale():
+    from kubedl_tpu.ops.quant import quantize_int4, to_dense
+
+    rng = np.random.default_rng(1)
+    w = rng.normal(size=(256, 32)).astype(np.float32)
+    q = quantize_int4(jnp.asarray(w), group=64)
+    back = np.asarray(to_dense(q, jnp.float32))
+    # per-group bound: |err| <= scale/2 = amax/14
+    wg = w.reshape(4, 64, 32)
+    amax = np.abs(wg).max(axis=1, keepdims=True)
+    err = np.abs(back.reshape(4, 64, 32) - wg)
+    assert (err <= amax / 14.0 + 1e-6).all()
+
+
+def test_int4_mm_matches_dense_of_quantized():
+    from kubedl_tpu.ops.quant import mm, quantize_int4, to_dense
+
+    rng = np.random.default_rng(2)
+    w = jnp.asarray(rng.normal(size=(128, 64)).astype(np.float32))
+    x = jnp.asarray(rng.normal(size=(4, 128)).astype(np.float32))
+    q = quantize_int4(w, group=32)
+    np.testing.assert_allclose(np.asarray(mm(x, q)),
+                               np.asarray(x @ to_dense(q, jnp.float32)),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_int4_halves_int8_bytes():
+    from kubedl_tpu.ops.quant import quantize_params, tree_nbytes
+
+    cfg = dataclasses.replace(llama.tiny(vocab=128), dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    n8 = tree_nbytes(quantize_params(params, mode="int8"))
+    n4 = tree_nbytes(quantize_params(params, mode="int4"))
+    # tiny-model ratio is ~0.63 (unquantized f32 embed + group-scale
+    # overhead loom large at this size; a 7B lands near 0.52)
+    assert n4 < 0.65 * n8
+
+
+def test_int4_serving_generates():
+    """int4 end to end through the continuous engine, plus the exactness
+    pin that matters: the dispatched int4 matmul computes the SAME
+    function as forwarding with the densified int4 weights (accuracy of
+    int4 itself is pinned by the weight-level bound tests — a random
+    tiny model's near-uniform logits make token agreement meaningless)."""
+    from kubedl_tpu.ops.quant import to_dense
+    from kubedl_tpu.serving.batching import ContinuousBatchingEngine
+
+    cfg = dataclasses.replace(llama.tiny(vocab=128), dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    q4 = quant.quantize_params(params, mode="int4")
+    dense_of_q4 = jax.tree.map(
+        lambda x: to_dense(x, jnp.float32),
+        q4, is_leaf=lambda x: isinstance(x, quant.Q4Tensor))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 128)
+    np.testing.assert_allclose(
+        np.asarray(llama.forward(cfg, q4, toks)),
+        np.asarray(llama.forward(cfg, dense_of_q4, toks)),
+        rtol=2e-4, atol=2e-4)
+
+    eng = ContinuousBatchingEngine(cfg, params, lanes=2, max_len=64,
+                                   quantize="int4")
+    got = eng.run([([3, 9, 1], 8), ([5], 4)])
+    assert len(got[0]) == 8 and len(got[1]) == 4
