@@ -37,7 +37,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models import llama
-from .engine import GenerateConfig, hit_stop, token_logprobs
+from .engine import (GenerateConfig, hit_stop, sample_logits_many,
+                     token_logprobs)
 
 
 def _bucket(n: int, lo: int = 16) -> int:
@@ -67,6 +68,10 @@ class Request:
     tokens: list = field(default_factory=list)
     logprobs: list = field(default_factory=list)
     want_logprobs: bool = False
+    #: per-request sampling overrides; None = the engine's GenerateConfig
+    temperature: Optional[float] = None
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
     done: threading.Event = field(default_factory=threading.Event)
     cancelled: bool = False
     _cond: threading.Condition = field(default_factory=threading.Condition)
@@ -306,12 +311,34 @@ class ContinuousBatchingEngine:
                 f"{self.max_len}")
 
     def submit(self, prompt: Sequence[int], max_new: int,
-               logprobs: bool = False) -> Request:
+               logprobs: bool = False, temperature: Optional[float] = None,
+               top_k: Optional[int] = None,
+               top_p: Optional[float] = None) -> Request:
         """Enqueue one generation; returns a Request whose ``result()``
-        blocks until finished. Thread-safe."""
+        blocks until finished. Thread-safe. ``temperature``/``top_k``/
+        ``top_p`` override the engine's GenerateConfig for THIS request
+        only (each lane samples with its own request's params)."""
         self.validate(prompt, max_new)
+        # bound the overrides HERE, in the caller's thread: a bad value
+        # must 400 the one request, never reach the scheduler loop (an
+        # exception there stops the engine and cancels every lane)
+        if temperature is not None:
+            temperature = float(temperature)
+            if not (0.0 <= temperature < 1e4):
+                raise ValueError(f"temperature out of range: {temperature}")
+        if top_k is not None:
+            top_k = int(top_k)
+            if not (0 <= top_k <= self.config.vocab_size):
+                raise ValueError(
+                    f"top_k out of range [0, {self.config.vocab_size}]: "
+                    f"{top_k}")
+        if top_p is not None:
+            top_p = float(top_p)
+            if not (0.0 < top_p <= 1.0):
+                raise ValueError(f"top_p out of range (0, 1]: {top_p}")
         req = Request(prompt=list(prompt), max_new=max_new,
-                      want_logprobs=logprobs)
+                      want_logprobs=logprobs, temperature=temperature,
+                      top_k=top_k, top_p=top_p)
         if max_new <= 0:
             req._finish()          # nothing requested: empty output
             return req
@@ -470,8 +497,19 @@ class ContinuousBatchingEngine:
             pos0 += n
         plen = plen_total
         self._key, sub = jax.random.split(self._key)
-        first = int(self._sample(logits, sub, gen.temperature,
-                                 gen.top_k, gen.top_p)[0])
+        t = gen.temperature if req.temperature is None else req.temperature
+        k_ = gen.top_k if req.top_k is None else req.top_k
+        p_ = gen.top_p if req.top_p is None else req.top_p
+        if t <= 0.0:
+            # default/greedy: the one static-arg compile (plain argmax)
+            first = int(self._sample(logits, sub, 0.0, 0, 1.0)[0])
+        else:
+            # TRACED params: distinct client triples must not each pay a
+            # fresh XLA trace of a static-arg sampler
+            first = int(sample_logits_many(
+                logits, sub, jnp.asarray([t], jnp.float32),
+                jnp.asarray([k_], jnp.int32),
+                jnp.asarray([p_], jnp.float32))[0])
         req._push(first, float(token_logprobs(
             logits, jnp.asarray([first]))[0]) if req.want_logprobs else None)
         lane.pos = plen
@@ -497,8 +535,23 @@ class ContinuousBatchingEngine:
             self.params, self._cache, jnp.asarray(self._cur),
             jnp.asarray(self._pos))
         self._key, sub = jax.random.split(self._key)
-        nxt = np.asarray(self._sample(logits, sub, gen.temperature,
-                                      gen.top_k, gen.top_p))
+
+        def lane_param(attr, default):
+            return [getattr(l.request, attr, None)
+                    if l.request is not None and
+                    getattr(l.request, attr) is not None else default
+                    for l in self._lane_state]
+
+        temps = lane_param("temperature", gen.temperature)
+        if all(t <= 0.0 for t in temps):
+            # all-greedy tick (the default deployment): one argmax, not
+            # two full-vocab sorts per decoded token
+            nxt = np.asarray(self._sample(logits, sub, 0.0, 0, 1.0))
+        else:
+            nxt = np.asarray(sample_logits_many(
+                logits, sub, jnp.asarray(temps, jnp.float32),
+                jnp.asarray(lane_param("top_k", gen.top_k), jnp.int32),
+                jnp.asarray(lane_param("top_p", gen.top_p), jnp.float32)))
         lane_lps = None
         if any(l.request is not None and l.request.want_logprobs
                for l in self._lane_state):

@@ -116,6 +116,36 @@ def sample_logits(logits, key, temperature, top_k, top_p=1.0):
     return jax.random.categorical(key, logits).astype(jnp.int32)
 
 
+@jax.jit
+def sample_logits_many(logits, key, temps, top_ks, top_ps):
+    """Vectorized per-row sampler: ``logits [n, V]`` with PER-ROW
+    temperature/top-k/top-p (the continuous engine's lanes each carry
+    their own request's sampling params). Rows with ``temps <= 0`` are
+    greedy. Same math as :func:`sample_logits` per row; top-k uses a
+    rank cut on the sorted logits so the k may differ per row inside
+    one jitted call."""
+    n, v = logits.shape
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+    order = jnp.argsort(scaled, axis=-1)[:, ::-1]
+    sorted_l = jnp.take_along_axis(scaled, order, axis=-1)
+    # top-k: cut everything below the k-th sorted logit (k=0: keep all)
+    idx = jnp.clip(top_ks - 1, 0, v - 1).astype(jnp.int32)
+    kth = jnp.take_along_axis(sorted_l, idx[:, None], axis=-1)
+    scaled = jnp.where((top_ks[:, None] > 0) & (scaled < kth),
+                       -1e30, scaled)
+    # top-p on the (possibly top-k-cut) logits, re-sorted
+    sorted_l = jnp.sort(scaled, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(sorted_l, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep_sorted = ((cum - probs) < top_ps[:, None]).at[:, 0].set(True)
+    cutoff = jnp.min(jnp.where(keep_sorted, sorted_l, jnp.inf),
+                     axis=-1, keepdims=True)
+    scaled = jnp.where(scaled < cutoff, -1e30, scaled)
+    sampled = jax.random.categorical(key, scaled).astype(jnp.int32)
+    return jnp.where(temps <= 0, greedy, sampled)
+
+
 class InferenceEngine:
     """One loaded model + its compiled prefill/decode steps."""
 

@@ -113,15 +113,24 @@ class InferenceServer:
     # -- request handling --------------------------------------------------
 
     def _parse_instance(self, inst: dict) -> tuple:
-        """(prompt, cap, want_logprobs) — the ONE validation/coercion
-        rule for buffered and streaming predicts alike."""
+        """(prompt, cap, want_logprobs, sampling) — the ONE validation/
+        coercion rule for buffered and streaming predicts alike.
+        ``sampling`` holds optional per-request temperature/top_k/top_p
+        overrides (continuous-batching engines apply them per lane)."""
         toks = inst.get("prompt_tokens")
         if not isinstance(toks, list) or not toks:
             raise ValueError("each instance needs prompt_tokens")
         prompt = [int(t) for t in toks]
         cap = min(int(inst.get("max_tokens", 16)),
                   self.config.max_new_tokens)
-        return prompt, cap, bool(inst.get("logprobs"))
+        sampling = {}
+        if "temperature" in inst:
+            sampling["temperature"] = float(inst["temperature"])
+        if "top_k" in inst:
+            sampling["top_k"] = int(inst["top_k"])
+        if "top_p" in inst:
+            sampling["top_p"] = float(inst["top_p"])
+        return prompt, cap, bool(inst.get("logprobs")), sampling
 
     def predict(self, body: dict) -> dict:
         instances = body.get("instances") or []
@@ -131,12 +140,13 @@ class InferenceServer:
             raise ValueError(
                 f"batch {len(instances)} exceeds max_batch "
                 f"{self.config.max_batch}")
-        prompts, caps, want_lp = [], [], []
+        prompts, caps, want_lp, samplings = [], [], [], []
         for inst in instances:
-            p, cap, lp = self._parse_instance(inst)
+            p, cap, lp, sampling = self._parse_instance(inst)
             prompts.append(p)
             caps.append(cap)
             want_lp.append(lp)
+            samplings.append(sampling)
         if hasattr(self.engine, "submit"):
             # continuous-batching engine: each instance rides its own lane
             # (its background loop serializes device work — no lock), so a
@@ -145,8 +155,9 @@ class InferenceServer:
             # instance must 400 without burning lanes on discarded output.
             for p, cap in zip(prompts, caps):
                 self.engine.validate(p, cap)
-            reqs = [self.engine.submit(p, cap, logprobs=lp)
-                    for p, cap, lp in zip(prompts, caps, want_lp)]
+            reqs = [self.engine.submit(p, cap, logprobs=lp, **s)
+                    for p, cap, lp, s in zip(prompts, caps, want_lp,
+                                             samplings)]
             timeout = self.config.request_timeout_s
             preds = []
             try:
@@ -162,7 +173,12 @@ class InferenceServer:
                 self._m_tokens.inc(sum(len(r.tokens) for r in reqs))
             return {"predictions": preds}
         # static engine: decode to the longest request in one lockstep
-        # batch, trim per instance to its own cap
+        # batch, trim per instance to its own cap. Its sampler is
+        # engine-wide — per-instance overrides need the lane engine.
+        if any(samplings):
+            raise ValueError(
+                "per-request sampling params need the continuous-"
+                "batching engine (this predictor runs the static one)")
         wl = any(want_lp)
         with self._gen_lock:
             outs = self.engine.generate(prompts, max(caps),
@@ -186,14 +202,15 @@ class InferenceServer:
         instances = body.get("instances") or []
         if len(instances) != 1:
             raise ValueError("stream mode takes exactly one instance")
-        prompt, cap, want_lp = self._parse_instance(instances[0])
+        prompt, cap, want_lp, sampling = self._parse_instance(instances[0])
 
         if hasattr(self.engine, "submit"):
             self.engine.validate(prompt, cap)
 
             def events():
                 t0 = time.perf_counter()
-                req = self.engine.submit(prompt, cap, logprobs=want_lp)
+                req = self.engine.submit(prompt, cap, logprobs=want_lp,
+                                         **sampling)
                 out, lps = [], []
                 # per-token bound: a stalled engine surfaces as an error
                 # event, not a silently frozen stream
@@ -218,6 +235,11 @@ class InferenceServer:
 
         # static engine: no incremental lane output — generate fully,
         # then emit token events (correctness-compatible fallback)
+        if sampling:
+            raise ValueError(
+                "per-request sampling params need the continuous-"
+                "batching engine (this predictor runs the static one)")
+
         def events_static():
             t0 = time.perf_counter()
             with self._gen_lock:

@@ -151,3 +151,43 @@ def test_request_stream_timeout(model):
         for tok, _ in req.stream(timeout=0.2):
             got.append(tok)
     assert got == [11]
+
+
+def test_per_instance_sampling_over_http(model):
+    cfg, params = model
+    eng = ContinuousBatchingEngine(cfg, params, lanes=2, max_len=96).start()
+    server = InferenceServer(eng, ServerConfig(
+        model_name="m", host="127.0.0.1", port=0)).start()
+    try:
+        # greedy instance co-batched with a hot one: greedy unchanged
+        with post(server.url, {"instances": [
+                {"prompt_tokens": [3, 1, 4], "max_tokens": 6},
+        ]}) as r:
+            want = json.load(r)["predictions"][0]["tokens"]
+        with post(server.url, {"instances": [
+                {"prompt_tokens": [3, 1, 4], "max_tokens": 6,
+                 "temperature": 0.0},
+                {"prompt_tokens": [3, 1, 4], "max_tokens": 6,
+                 "temperature": 1.8, "top_p": 0.9},
+        ]}) as r:
+            preds = json.load(r)["predictions"]
+        assert preds[0]["tokens"] == want
+        assert len(preds[1]["tokens"]) == 6
+    finally:
+        server.stop()
+        eng.stop()
+
+
+def test_sampling_params_rejected_on_static_engine(model):
+    cfg, params = model
+    eng = InferenceEngine(cfg, params, GenerateConfig(max_len=64))
+    server = InferenceServer(eng, ServerConfig(
+        model_name="m", host="127.0.0.1", port=0)).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post(server.url, {"instances": [
+                {"prompt_tokens": [1, 2], "max_tokens": 2,
+                 "temperature": 0.7}]})
+        assert ei.value.code == 400
+    finally:
+        server.stop()
