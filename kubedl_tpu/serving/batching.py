@@ -319,9 +319,27 @@ class ContinuousBatchingEngine:
         ``top_p`` override the engine's GenerateConfig for THIS request
         only (each lane samples with its own request's params)."""
         self.validate(prompt, max_new)
-        # bound the overrides HERE, in the caller's thread: a bad value
-        # must 400 the one request, never reach the scheduler loop (an
-        # exception there stops the engine and cancels every lane)
+        sampling = self.validate_sampling(temperature=temperature,
+                                          top_k=top_k, top_p=top_p)
+        req = Request(prompt=list(prompt), max_new=max_new,
+                      want_logprobs=logprobs, **sampling)
+        if max_new <= 0:
+            req._finish()          # nothing requested: empty output
+            return req
+        with self._cv:
+            if self._stopped:
+                raise RuntimeError("engine stopped")
+            self._queue.append(req)
+            self._cv.notify()
+        return req
+
+    def validate_sampling(self, temperature=None, top_k=None,
+                          top_p=None) -> dict:
+        """Bounds-check per-request sampling overrides in the CALLER's
+        thread (a bad value must 400 one request, never reach the
+        scheduler loop, where a raise stops the engine and cancels every
+        lane). Returns the normalized dict; the server pre-validates
+        every instance of a batch with this before submitting any."""
         if temperature is not None:
             temperature = float(temperature)
             if not (0.0 <= temperature < 1e4):
@@ -336,18 +354,7 @@ class ContinuousBatchingEngine:
             top_p = float(top_p)
             if not (0.0 < top_p <= 1.0):
                 raise ValueError(f"top_p out of range (0, 1]: {top_p}")
-        req = Request(prompt=list(prompt), max_new=max_new,
-                      want_logprobs=logprobs, temperature=temperature,
-                      top_k=top_k, top_p=top_p)
-        if max_new <= 0:
-            req._finish()          # nothing requested: empty output
-            return req
-        with self._cv:
-            if self._stopped:
-                raise RuntimeError("engine stopped")
-            self._queue.append(req)
-            self._cv.notify()
-        return req
+        return {"temperature": temperature, "top_k": top_k, "top_p": top_p}
 
     def run(self, requests: Sequence[tuple], seed: Optional[int] = None) -> list:
         """requests: [(prompt_token_list, max_new_tokens), ...] in arrival
@@ -543,7 +550,11 @@ class ContinuousBatchingEngine:
                     for l in self._lane_state]
 
         temps = lane_param("temperature", gen.temperature)
-        if all(t <= 0.0 for t in temps):
+        active_temps = [t for t, l in zip(temps, self._lane_state)
+                        if l.request is not None]
+        if all(t <= 0.0 for t in active_temps):
+            # free lanes carry the engine default but emit nothing —
+            # only live requests decide the fast path
             # all-greedy tick (the default deployment): one argmax, not
             # two full-vocab sorts per decoded token
             nxt = np.asarray(self._sample(logits, sub, 0.0, 0, 1.0))

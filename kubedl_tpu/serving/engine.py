@@ -134,8 +134,12 @@ def sample_logits_many(logits, key, temps, top_ks, top_ps):
     kth = jnp.take_along_axis(sorted_l, idx[:, None], axis=-1)
     scaled = jnp.where((top_ks[:, None] > 0) & (scaled < kth),
                        -1e30, scaled)
-    # top-p on the (possibly top-k-cut) logits, re-sorted
-    sorted_l = jnp.sort(scaled, axis=-1)[:, ::-1]
+    # top-p on the (possibly top-k-cut) logits. No second sort: the cut
+    # only pushed ranks >= k to -1e30, so masking those ranks in the
+    # ALREADY-sorted array reproduces sort(cut logits) descending.
+    ranks = jnp.arange(v)[None, :]
+    sorted_l = jnp.where((top_ks[:, None] > 0) & (ranks >= top_ks[:, None]),
+                         -1e30, sorted_l)
     probs = jax.nn.softmax(sorted_l, axis=-1)
     cum = jnp.cumsum(probs, axis=-1)
     keep_sorted = ((cum - probs) < top_ps[:, None]).at[:, 0].set(True)

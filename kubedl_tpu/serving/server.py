@@ -153,8 +153,9 @@ class InferenceServer:
             # short request is never held back to the longest one's length.
             # Validate ALL instances before submitting any — a bad late
             # instance must 400 without burning lanes on discarded output.
-            for p, cap in zip(prompts, caps):
+            for p, cap, s in zip(prompts, caps, samplings):
                 self.engine.validate(p, cap)
+                self.engine.validate_sampling(**s)
             reqs = [self.engine.submit(p, cap, logprobs=lp, **s)
                     for p, cap, lp, s in zip(prompts, caps, want_lp,
                                              samplings)]
@@ -206,6 +207,7 @@ class InferenceServer:
 
         if hasattr(self.engine, "submit"):
             self.engine.validate(prompt, cap)
+            self.engine.validate_sampling(**sampling)   # before the 200
 
             def events():
                 t0 = time.perf_counter()
