@@ -418,6 +418,36 @@ def attention_step(config: LlamaConfig, x, lp, kc, vc, cos, sin, start_pos,
             vc, v.astype(vc.dtype), (0, start_pos, 0, 0))
         q_pos = (start_pos + jnp.arange(s))[None, :]            # [1, s]
 
+    # Windowed configs never need keys older than (q_pos - window]:
+    # attend against a STATIC-size slice of the cache around the live
+    # window instead of all max_len slots. Writes still land in the full
+    # cache; only the attention READ shrinks — on a 32k cache with a 4k
+    # window that is ~8x less decode HBM traffic. The slice start is
+    # clamped per row, so early steps read from 0 like before.
+    ka, va, k_pos, valid_a = kc, vc, jnp.arange(max_len), valid
+    if c.sliding_window and c.sliding_window + s < max_len:
+        span = min(max_len, c.sliding_window + s)
+        last = q_pos[:, -1]                               # [b or 1]
+        start = jnp.clip(last + 1 - span, 0, max_len - span)
+
+        def slice_row(arr, st):
+            return jax.lax.dynamic_slice_in_dim(arr, st, span, axis=0)
+
+        if q_pos.shape[0] == 1:                           # scalar path
+            st = start[0]
+            ka = jax.lax.dynamic_slice_in_dim(kc, st, span, axis=1)
+            va = jax.lax.dynamic_slice_in_dim(vc, st, span, axis=1)
+            k_pos = st + jnp.arange(span)
+            if valid is not None:
+                valid_a = jax.lax.dynamic_slice_in_dim(valid, st, span,
+                                                       axis=1)
+        else:                                             # per-row path
+            ka = jax.vmap(slice_row)(kc, start)
+            va = jax.vmap(slice_row)(vc, start)
+            k_pos = start[:, None] + jnp.arange(span)[None, :]
+            if valid is not None:
+                valid_a = jax.vmap(slice_row)(valid, start)
+
     # GQA-grouped attention straight against the cache: NO repeat_kv
     # materialization and NO f32 cache copy — decode is HBM-bound, and
     # the old path read (nh/nkv)x repeated K/V at 2x bytes. Products
@@ -426,21 +456,23 @@ def attention_step(config: LlamaConfig, x, lp, kc, vc, cos, sin, start_pos,
     # the upcast-everything path on the same stored values.
     g = nh // nkv
     qg = q.reshape(b, s, nkv, g, hd)
-    scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, kc,
+    scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, ka,
                         preferred_element_type=jnp.float32)
     scores = scores * jnp.float32(1.0 / math.sqrt(hd))
-    k_pos = jnp.arange(max_len)
-    mask = (k_pos[None, None, :] <= q_pos[:, :, None])   # causal [b?,q,k]
+    if k_pos.ndim == 1:
+        k_pos = k_pos[None, None, :]       # [1, 1, K]
+    else:
+        k_pos = k_pos[:, None, :]          # [b, 1, K]
+    mask = (k_pos <= q_pos[:, :, None])    # causal [b?, q, K]
     if c.sliding_window:
-        mask = mask & (k_pos[None, None, :]
-                       > q_pos[:, :, None] - c.sliding_window)
-    if valid is not None:
-        mask = mask & valid[:, None, :]
+        mask = mask & (k_pos > q_pos[:, :, None] - c.sliding_window)
+    if valid_a is not None:
+        mask = mask & valid_a[:, None, :]
     scores = jnp.where(mask[:, None, None], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
     # probs stay f32 (on-chip); V is read in cache dtype and upcast in
     # registers inside the dot — HBM sees only the bf16 cache bytes
-    attn = jnp.einsum("bhgqk,bkhd->bqhgd", probs, vc,
+    attn = jnp.einsum("bhgqk,bkhd->bqhgd", probs, va,
                       preferred_element_type=jnp.float32)
     attn = attn.reshape(b, s, nh, hd).astype(x.dtype)
     return x + _mm(attn.reshape(b, s, nh * hd), lp["wo"]), kc, vc

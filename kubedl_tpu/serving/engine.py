@@ -134,9 +134,12 @@ def sample_logits_many(logits, key, temps, top_ks, top_ps):
     kth = jnp.take_along_axis(sorted_l, idx[:, None], axis=-1)
     scaled = jnp.where((top_ks[:, None] > 0) & (scaled < kth),
                        -1e30, scaled)
-    # top-p on the (possibly top-k-cut) logits. No second sort: the cut
-    # only pushed ranks >= k to -1e30, so masking those ranks in the
-    # ALREADY-sorted array reproduces sort(cut logits) descending.
+    # top-p on the (possibly top-k-cut) logits. No second sort: masking
+    # ranks >= k in the ALREADY-sorted array reproduces sort(cut logits)
+    # descending — except for exact float ties AT the k-th logit, where
+    # the strict value cut above keeps the ties but the rank mask drops
+    # them from the nucleus mass (a measure-zero divergence accepted for
+    # halving the per-token sort cost).
     ranks = jnp.arange(v)[None, :]
     sorted_l = jnp.where((top_ks[:, None] > 0) & (ranks >= top_ks[:, None]),
                          -1e30, sorted_l)

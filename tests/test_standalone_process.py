@@ -50,25 +50,31 @@ class Console:
 def test_standalone_operator_process(tmp_path):
     port = free_port()
     db = tmp_path / "kubedl.db"
+    log = open(tmp_path / "operator.log", "w+b", buffering=0)
     env = {**os.environ,
            "PYTHONPATH": REPO,
            "KUBEDL_CONSOLE_USERS": "admin:pw"}
+    # log to a FILE, not a PIPE: nobody drains a pipe while the process
+    # runs, and a chatty reconcile loop filling the OS buffer would block
+    # the operator mid-write and deadlock the test
     proc = subprocess.Popen(
         [sys.executable, "-m", "kubedl_tpu",
          "--workloads", "JAXJob,PyTorchJob",
          "--console-port", str(port),
          "--object-storage", f"sqlite:///{db}",
          "--event-storage", f"sqlite:///{db}"],
-        env=env, cwd=REPO,
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        env=env, cwd=REPO, stdout=log, stderr=subprocess.STDOUT)
+
+    def log_tail() -> str:
+        log.seek(0)
+        return log.read().decode(errors="replace")[-2000:]
     con = Console(port)
     try:
         # wait for the console to come up inside the real process
         deadline = time.time() + 60
         while True:
             if proc.poll() is not None:
-                raise AssertionError("operator died: "
-                                     + proc.stdout.read().decode()[-2000:])
+                raise AssertionError("operator died: " + log_tail())
             try:
                 con.req("POST", "/api/v1/login",
                         {"username": "admin", "password": "pw"})
@@ -115,3 +121,4 @@ def test_standalone_operator_process(tmp_path):
         if proc.poll() is None:
             proc.kill()
             proc.wait(timeout=10)
+        log.close()
