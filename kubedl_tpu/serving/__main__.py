@@ -11,6 +11,9 @@ env). Honors the autoconfig contract end to end:
 * ``KUBEDL_SERVING_QUANTIZE`` — "int8", "int4", or ""
 * ``KUBEDL_SERVING_SPEC_K``   — >0 enables speculative decoding with the
   draft model at ``KUBEDL_SERVING_DRAFT_PATH`` (single-lane)
+* ``KUBEDL_SERVING_TP``       — >1: tensor-parallel serving over that
+  many LOCAL chips (one host's mesh; params shard by their logical
+  specs, the KV cache by kv-heads). Not combinable with QUANTIZE.
 * ``KUBEDL_SERVING_PORT``     — default 8501
 
 SIGTERM (pod shutdown) stops the HTTP server, drains the engine, and
@@ -27,14 +30,30 @@ import threading
 
 
 def build_engine(model_path: str, lanes: int, quantize: str, spec_k: int,
-                 draft_path: str = "", max_len: int = 1024):
+                 draft_path: str = "", max_len: int = 1024, tp: int = 1):
     """The ONE env-to-engine mapping (also used by tests): returns a
     started engine honoring the autoconfig candidate."""
     from ..models.io import load_model
     from .engine import GenerateConfig
 
     config, params = load_model(model_path)
+    mesh = None
+    if tp > 1:
+        import jax
+
+        from ..parallel.mesh import MeshConfig, build_mesh
+        devices = jax.local_devices()
+        if len(devices) < tp:
+            raise ValueError(
+                f"KUBEDL_SERVING_TP={tp} but only {len(devices)} local "
+                "devices")
+        mesh = build_mesh(MeshConfig(dp=1, fsdp=1, tp=tp), devices[:tp])
     if spec_k > 0:
+        if mesh is not None:
+            # refusing beats silently serving unsharded (the model may
+            # not even fit one chip) — same policy as mesh+quantize
+            raise ValueError("KUBEDL_SERVING_TP does not compose with "
+                             "speculative decoding yet")
         if not draft_path:
             raise ValueError("KUBEDL_SERVING_SPEC_K > 0 needs "
                              "KUBEDL_SERVING_DRAFT_PATH")
@@ -50,7 +69,7 @@ def build_engine(model_path: str, lanes: int, quantize: str, spec_k: int,
     return ContinuousBatchingEngine(
         config, params, lanes=lanes, max_len=max_len,
         gen=GenerateConfig(max_len=max_len),
-        quantize=quantize or None).start()
+        quantize=quantize or None, mesh=mesh).start()
 
 
 def main() -> int:
@@ -65,9 +84,10 @@ def main() -> int:
     spec_k = int(os.environ.get("KUBEDL_SERVING_SPEC_K", "0") or 0)
     draft = os.environ.get("KUBEDL_SERVING_DRAFT_PATH", "")
     max_len = int(os.environ.get("KUBEDL_SERVING_MAX_LEN", "1024") or 1024)
+    tp = int(os.environ.get("KUBEDL_SERVING_TP", "1") or 1)
 
     engine = build_engine(model_path, lanes, quantize, spec_k, draft,
-                          max_len)
+                          max_len, tp=tp)
     from .server import InferenceServer, ServerConfig
     server = InferenceServer(engine, ServerConfig(
         # `or`, not a get() default: the controller injects the var even

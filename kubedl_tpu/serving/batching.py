@@ -152,14 +152,22 @@ class ContinuousBatchingEngine:
     def __init__(self, config: llama.LlamaConfig, params: dict,
                  lanes: int = 4, max_len: int = 1024,
                  gen: Optional[GenerateConfig] = None,
-                 quantize: Optional[str] = None, seed: int = 0):
-        from .engine import maybe_quantize, resolve_family, sample_logits
+                 quantize: Optional[str] = None, seed: int = 0,
+                 mesh=None):
+        from .engine import (init_mesh_serving, maybe_quantize,
+                             resolve_family, sample_logits)
         self.config = config
         self.family = family = resolve_family(config)
         self.params = maybe_quantize(params, quantize)
         self.lanes = lanes
         self.max_len = max_len
         self.gen = gen or GenerateConfig(max_len=max_len)
+        self.mesh = mesh
+        # tensor-parallel serving over a local mesh (one host's chips):
+        # params by logical specs, cache by kv-heads; the jitted steps
+        # are unchanged — GSPMD inserts the collectives
+        self.params, self._place_cache = init_mesh_serving(
+            config, self.params, quantize, mesh)
         cfg = config
 
         @partial(jax.jit, donate_argnums=(1,))
@@ -220,7 +228,8 @@ class ContinuousBatchingEngine:
 
         # live scheduler state: one shared cache + lane bookkeeping; the
         # host mirrors (cur/pos) feed the per-tick decode call
-        self._cache = family.init_cache(config, lanes, max_len)
+        self._cache = self._place_cache(
+            family.init_cache(config, lanes, max_len))
         self._lane_state = [_Lane() for _ in range(lanes)]
         self._cur = np.zeros((lanes, 1), np.int32)
         self._pos = np.zeros((lanes,), np.int32)
@@ -404,8 +413,8 @@ class ContinuousBatchingEngine:
             lane.reset()
         for req in abandoned:
             req._finish(cancelled=True)
-        self._cache = self.family.init_cache(self.config, self.lanes,
-                                             self.max_len)
+        self._cache = self._place_cache(
+            self.family.init_cache(self.config, self.lanes, self.max_len))
         self._cur = np.zeros((self.lanes, 1), np.int32)
         self._pos = np.zeros((self.lanes,), np.int32)
 

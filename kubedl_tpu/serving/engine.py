@@ -153,15 +153,67 @@ def sample_logits_many(logits, key, temps, top_ks, top_ps):
     return jnp.where(temps <= 0, greedy, sampled)
 
 
+def shard_for_serving(config, params, cache, mesh):
+    """Place a param tree + KV cache for model-parallel serving over a
+    local mesh (tp over the chips of ONE host — a v5e-8 serving VM).
+    Params follow the family's logical specs (heads/mlp on tp); the
+    cache shards its kv-head axis when it divides tp, else replicates
+    (MQA). GSPMD then inserts the serving collectives inside the same
+    jitted step — no engine code changes, just operand placement."""
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.sharding import tree_shardings
+
+    family = resolve_family(config)
+    if params is not None:
+        p_shard = tree_shardings(mesh, family.param_specs(config))
+        params = jax.tree.map(jax.device_put, params, p_shard)
+    tp = mesh.shape.get("tp", 1)
+    kv_axis = "tp" if config.n_kv_heads % tp == 0 else None
+    c_shard = NamedSharding(mesh, P(None, None, None, kv_axis, None))
+    cache = jax.tree.map(lambda x: jax.device_put(x, c_shard), cache)
+    return params, cache
+
+
+def init_mesh_serving(config, params, quantize, mesh):
+    """The ONE mesh-wiring path both engines share: validates the
+    (mesh, quantize) combination, shards params for serving, and returns
+    ``(params, place_cache)`` where ``place_cache`` re-places a fresh KV
+    cache (identity without a mesh)."""
+    if mesh is None:
+        return params, (lambda cache: cache)
+    if quantize:
+        raise ValueError(
+            "mesh-parallel serving does not compose with weight "
+            "quantization yet")
+    params, _ = shard_for_serving(config, params, {}, mesh)
+
+    def place_cache(cache):
+        _, cache = shard_for_serving(config, None, cache, mesh)
+        return cache
+
+    return params, place_cache
+
+
 class InferenceEngine:
-    """One loaded model + its compiled prefill/decode steps."""
+    """One loaded model + its compiled prefill/decode steps.
+
+    ``mesh`` (optional): a LOCAL device mesh for tensor-parallel serving
+    — params shard by their logical specs, the cache by kv-heads, and
+    XLA inserts the collectives inside the same jitted step. Not
+    composable with weight quantization (quantized leaves carry their
+    own scale trees; shard-then-quantize is future work)."""
 
     def __init__(self, config: llama.LlamaConfig, params: dict,
                  gen: Optional[GenerateConfig] = None,
-                 quantize: Optional[str] = None):
+                 quantize: Optional[str] = None, mesh=None):
         self.config = config
         self.params = maybe_quantize(params, quantize)
         self.gen = gen or GenerateConfig()
+        self.mesh = mesh
+        self.params, self._place_cache = init_mesh_serving(
+            config, self.params, quantize, mesh)
 
         model_cfg = self.config
         self._family = family = resolve_family(config)
@@ -208,7 +260,8 @@ class InferenceEngine:
         valid = jnp.asarray(
             np.arange(gen.max_len)[None, :] >= pad[:, None])
 
-        cache = self._family.init_cache(self.config, b, gen.max_len)
+        cache = self._place_cache(
+            self._family.init_cache(self.config, b, gen.max_len))
         logits, cache = self._step(self.params, cache, jnp.asarray(toks),
                                    jnp.int32(0), valid)
         key = jax.random.PRNGKey(seed)

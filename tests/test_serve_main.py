@@ -149,3 +149,30 @@ def test_speculative_predictor_subprocess(artifacts):
         if proc.poll() is None:
             proc.kill()
             proc.wait(timeout=10)
+
+
+def test_tp_predictor_subprocess(artifacts):
+    """KUBEDL_SERVING_TP=2 serves the model tensor-parallel over two
+    (virtual) local chips through the real entrypoint."""
+    root, cfg, params = artifacts
+    port = 38993
+    proc = spawn({"KUBEDL_MODEL_PATH": str(root / "target"),
+                  "KUBEDL_SERVING_LANES": "2",
+                  "KUBEDL_SERVING_TP": "2",
+                  "KUBEDL_SERVING_MAX_LEN": "96",
+                  "XLA_FLAGS": "--xla_force_host_platform_device_count=2"},
+                 port)
+    try:
+        wait_healthy(port, proc)
+        out = json.loads(predict(port, "target", {
+            "instances": [{"prompt_tokens": [5, 9, 2], "max_tokens": 6}]}))
+        toks = out["predictions"][0]["tokens"]
+        from kubedl_tpu.serving.engine import GenerateConfig, InferenceEngine
+        solo = InferenceEngine(cfg, params, GenerateConfig(max_len=96))
+        assert toks == solo.generate([[5, 9, 2]], 6)[0]
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=30) == 0
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
