@@ -46,13 +46,26 @@ def config_from_hf(hf) -> LlamaConfig:
     get = (hf.get if isinstance(hf, dict)
            else lambda k, d=None: getattr(hf, k, d))
     model_type = str(get("model_type", "llama") or "llama").lower()
-    if model_type not in ("llama", "mistral", "qwen2", "gemma"):
-        # gemma2/gemma3 add per-layer weights (pre/post-ffw norms, q/k
-        # norms) this converter would silently drop — refuse rather than
-        # produce a wrong model (from_hf also re-checks for leftovers)
-        raise ValueError(f"unsupported HF model_type {model_type!r} "
-                         "(supported: llama, mistral, qwen2, gemma)")
-    gemma = model_type == "gemma"
+    if model_type not in ("llama", "mistral", "qwen2", "gemma", "gemma2"):
+        # gemma3 adds q/k norms this converter would silently drop —
+        # refuse rather than produce a wrong model (from_hf also
+        # re-checks for leftover layer weights)
+        raise ValueError(
+            f"unsupported HF model_type {model_type!r} "
+            "(supported: llama, mistral, qwen2, gemma, gemma2)")
+    gemma = model_type in ("gemma", "gemma2")
+    gemma2 = model_type == "gemma2"
+    if gemma2:
+        # gemma2's window rule (even layers slide) must match the
+        # family's "alternate" pattern when layer_types is explicit
+        lt = get("layer_types")
+        if lt is not None:
+            want = ["sliding_attention" if i % 2 == 0 else "full_attention"
+                    for i in range(int(get("num_hidden_layers")))]
+            if list(lt) != want:
+                raise ValueError(
+                    "gemma2 layer_types deviates from the alternating "
+                    "even-sliding pattern; this core cannot express it")
     return LlamaConfig(
         vocab_size=int(get("vocab_size")),
         d_model=int(get("hidden_size")),
@@ -66,8 +79,16 @@ def config_from_hf(hf) -> LlamaConfig:
         rms_eps=float(get("rms_norm_eps", 1e-5) or 1e-5),
         max_seq_len=int(get("max_position_embeddings", 8192) or 8192),
         # HF gates the window on use_sliding_window (default on when a
-        # window is set; Qwen2 ships configs with the flag off)
-        sliding_window=_window_from_hf(get),
+        # window is set; Qwen2 ships configs with the flag off). gemma2
+        # always windows its even layers.
+        sliding_window=(int(get("sliding_window") or 0) if gemma2
+                        else _window_from_hf(get)),
+        window_pattern="alternate" if gemma2 else "uniform",
+        sandwich_norms=gemma2,
+        attn_logit_softcap=(float(get("attn_logit_softcapping") or 0.0)
+                            if gemma2 else 0.0),
+        query_scale=(float(get("query_pre_attn_scalar") or 0.0)
+                     if gemma2 else 0.0),
         qkv_bias=bool(get("attention_bias", False)
                       or model_type == "qwen2"),
         act="gelu" if gemma else "silu",
@@ -123,7 +144,8 @@ def from_hf(config: LlamaConfig, state_dict: dict,
         return _np(sd[key])
 
     #: leaves kept float32 (norm scales, projection biases)
-    f32 = {"attn_norm", "mlp_norm", "bq", "bk", "bv"}
+    f32 = {"attn_norm", "mlp_norm", "post_attn_norm", "post_ffw_norm",
+           "bq", "bk", "bv"}
     layers = []
     for i in range(config.n_layers):
         p = f"layers.{i}."
@@ -142,6 +164,15 @@ def from_hf(config: LlamaConfig, state_dict: dict,
             lp["bq"] = vec(p + "self_attn.q_proj.bias")
             lp["bk"] = vec(p + "self_attn.k_proj.bias")
             lp["bv"] = vec(p + "self_attn.v_proj.bias")
+        if config.sandwich_norms:
+            # gemma2: input_layernorm -> attn_norm (pre-attn),
+            # post_attention_layernorm -> post_attn_norm (pre-residual),
+            # pre/post_feedforward_layernorm -> mlp_norm/post_ffw_norm.
+            # NOTE post_attention_layernorm means DIFFERENT things in
+            # gemma2 (sandwich) vs llama (pre-mlp) — remap accordingly.
+            lp["post_attn_norm"] = lp.pop("mlp_norm")
+            lp["mlp_norm"] = vec(p + "pre_feedforward_layernorm.weight")
+            lp["post_ffw_norm"] = vec(p + "post_feedforward_layernorm.weight")
         layers.append(lp)
 
     # every layer-scoped weight must have been consumed: an unknown key

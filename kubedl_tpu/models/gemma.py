@@ -51,16 +51,18 @@ def gemma_7b() -> LlamaConfig:
 
 
 def gemma2_2b() -> LlamaConfig:
-    """Gemma-2 2B: GQA + final-logit softcap + sliding-window attention
-    (Gemma-2 alternates 4096-window local and global layers; this core
-    applies the window uniformly — the conservative approximation that
-    keeps every layer's receptive field within the reference's).
-    Under cp>1 the window rides the dense ring path (global
-    positions), so long-context sharding still works."""
+    """Gemma-2 2B, faithful: sandwich norms, attention-score softcap 50,
+    query_pre_attn_scalar 256, final-logit softcap 30, and the TRUE
+    alternating window pattern (even layers slide at 4096, odd are
+    global) — toggled per layer as data inside one scanned body.
+    Logits are pinned against transformers' Gemma2ForCausalLM
+    (tests/test_convert.py)."""
     return LlamaConfig(vocab_size=256128, d_model=2304, n_layers=26,
                        n_heads=8, n_kv_heads=4, d_ff=9216, head_dim=256,
                        max_seq_len=8192, logit_softcap=30.0,
-                       sliding_window=4096, **_GEMMA_KNOBS)
+                       sliding_window=4096, window_pattern="alternate",
+                       sandwich_norms=True, attn_logit_softcap=50.0,
+                       query_scale=256.0, **_GEMMA_KNOBS)
 
 
 def tiny(vocab: int = 512, seq: int = 256) -> LlamaConfig:

@@ -94,4 +94,5 @@ def load_model(path: str) -> Tuple[object, dict]:
 #: leaves init_params keeps in float32 (norm scales, projection biases,
 #: the MoE router) — everything else reloads at the config dtype
 _F32_LEAVES = {"attn_norm", "mlp_norm", "final_norm",
+               "post_attn_norm", "post_ffw_norm",
                "bq", "bk", "bv", "w_router"}

@@ -73,11 +73,30 @@ class LlamaConfig:
     sliding_window: int = 0
     #: Qwen2-style additive biases on the q/k/v projections
     qkv_bias: bool = False
+    # -- Gemma-2 knobs ----------------------------------------------------
+    #: sandwich norms: attn output normed BEFORE its residual add; MLP
+    #: normed before AND after (adds post_attn_norm/post_ffw_norm params)
+    sandwich_norms: bool = False
+    #: cap*tanh(scores/cap) on ATTENTION scores; 0 = off (Gemma-2: 50)
+    attn_logit_softcap: float = 0.0
+    #: >0: score scale = query_scale**-0.5 instead of head_dim**-0.5
+    #: (Gemma-2's query_pre_attn_scalar)
+    query_scale: float = 0.0
+    #: "uniform": the sliding window (if any) applies to every layer;
+    #: "alternate": EVEN layers slide, odd are global (Gemma-2's
+    #: layer_types rule) — toggled per layer as data inside one scan body
+    window_pattern: str = "uniform"
 
     def __post_init__(self):
         if self.sliding_window < 0:
             raise ValueError(
                 f"sliding_window must be >= 0, got {self.sliding_window}")
+        if self.window_pattern not in ("uniform", "alternate"):
+            raise ValueError(
+                f"unknown window_pattern {self.window_pattern!r}")
+        if self.window_pattern == "alternate" and not self.sliding_window:
+            raise ValueError(
+                "window_pattern='alternate' needs sliding_window > 0")
 
     @property
     def hd(self) -> int:
@@ -90,7 +109,7 @@ class LlamaConfig:
         if self.qkv_bias:
             attn += hd * (self.n_heads + 2 * self.n_kv_heads)
         mlp = 3 * d * self.d_ff
-        per_layer = attn + mlp + 2 * d
+        per_layer = attn + mlp + (4 if self.sandwich_norms else 2) * d
         head = (1 if self.tie_embeddings else 2) * self.vocab_size * d
         return self.n_layers * per_layer + head + d
 
@@ -155,8 +174,13 @@ def init_params(config: LlamaConfig, key) -> dict:
             "bk": jnp.zeros((nkv * hd,), jnp.float32),
             "bv": jnp.zeros((nkv * hd,), jnp.float32),
         } if c.qkv_bias else {}
+        sandwich = {
+            "post_attn_norm": jnp.full((d,), norm_init, jnp.float32),
+            "post_ffw_norm": jnp.full((d,), norm_init, jnp.float32),
+        } if c.sandwich_norms else {}
         return {
             **biases,
+            **sandwich,
             "attn_norm": jnp.full((d,), norm_init, jnp.float32),
             "wq": dense(ks[0], (d, nh * hd), d),
             "wk": dense(ks[1], (d, nkv * hd), d),
@@ -196,6 +220,8 @@ def param_specs(config: LlamaConfig) -> dict:
         "wq": ls("embed", "heads"),
         **({"bq": ls("heads"), "bk": ls("kv_heads"), "bv": ls("kv_heads")}
            if config.qkv_bias else {}),
+        **({"post_attn_norm": ls("norm"), "post_ffw_norm": ls("norm")}
+           if config.sandwich_norms else {}),
         "wk": ls("embed", "kv_heads"),
         "wv": ls("embed", "kv_heads"),
         "wo": ls("heads", "embed"),
@@ -221,6 +247,25 @@ def rms_norm(x, weight, eps: float, offset: float = 0.0):
     xf = x.astype(jnp.float32)
     scale = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
     return (xf * scale * (offset + weight)).astype(x.dtype)
+
+
+def window_flags(config: LlamaConfig):
+    """[n_layers] bool array of which layers apply the sliding window,
+    or None when the pattern is uniform (static behavior, no threading).
+    Gemma-2 rule: EVEN layers slide, odd are global."""
+    if config.window_pattern != "alternate":
+        return None
+    return jnp.asarray([i % 2 == 0 for i in range(config.n_layers)])
+
+
+def _attn_knobs(config: LlamaConfig) -> dict:
+    """Gemma-2 attention extras forwarded into the attention ops."""
+    out = {}
+    if config.query_scale:
+        out["scale"] = config.query_scale ** -0.5
+    if config.attn_logit_softcap:
+        out["logit_softcap"] = config.attn_logit_softcap
+    return out
 
 
 def _qkv(config: LlamaConfig, h, lp, w_name: str, b_name: str):
@@ -286,13 +331,15 @@ def apply_rope(x, cos, sin):
 
 
 def attention_block(config: LlamaConfig, x, lp, cos, sin, segment_ids,
-                    mesh=None):
+                    mesh=None, window_on=None):
     """Pre-norm attention sublayer with residual: the shared transformer
     attention used by the Llama/Gemma dense stack and the MoE stack
-    (``kubedl_tpu.models.moe``)."""
+    (``kubedl_tpu.models.moe``). ``window_on`` (traced bool) toggles the
+    sliding window per layer (Gemma-2's alternate pattern)."""
     c = config
     b, s, d = x.shape
     nh, nkv, hd = c.n_heads, c.n_kv_heads, c.hd
+    knobs = _attn_knobs(c)
 
     h = rms_norm(x, lp["attn_norm"], c.rms_eps, c.norm_weight_offset)
     q = _qkv(c, h, lp, "wq", "bq").reshape(b, s, nh, hd)
@@ -305,25 +352,39 @@ def attention_block(config: LlamaConfig, x, lp, cos, sin, segment_ids,
         # attention exact while K/V blocks rotate over ICI; a sliding
         # window rides the ring with global positions (dense per-block
         # path), so Mistral/Gemma-2-style models train long-context too
+        if knobs or window_on is not None:
+            raise ValueError(
+                "Gemma-2 attention knobs (query scale / attn softcap / "
+                "alternate window pattern) are not supported with a "
+                "cp-sharded sequence yet")
         attn = ring_attention(mesh, q, k, v, causal=True,
                               window=c.sliding_window)
     else:
         attn = multi_head_attention(q, k, v, causal=True,
                                     segment_ids=segment_ids,
-                                    window=c.sliding_window)
-    return x + _mm(attn.reshape(b, s, nh * hd), lp["wo"])
+                                    window=c.sliding_window,
+                                    window_on=window_on, **knobs)
+    delta = _mm(attn.reshape(b, s, nh * hd), lp["wo"])
+    if c.sandwich_norms:
+        delta = rms_norm(delta, lp["post_attn_norm"], c.rms_eps,
+                         c.norm_weight_offset)
+    return x + delta
 
 
 def _layer_forward(config: LlamaConfig, x, lp, cos, sin, segment_ids,
-                   mesh=None):
+                   mesh=None, window_on=None):
     c = config
-    x = attention_block(c, x, lp, cos, sin, segment_ids, mesh)
+    x = attention_block(c, x, lp, cos, sin, segment_ids, mesh, window_on)
 
-    # -- gated MLP (SwiGLU for Llama, GeGLU for Gemma)
+    # -- gated MLP (SwiGLU for Llama, GeGLU for Gemma); Gemma-2 wraps it
+    # in sandwich norms (pre AND post, before the residual add)
     h = rms_norm(x, lp["mlp_norm"], c.rms_eps, c.norm_weight_offset)
     gated = _act(c)(_mm(h, lp["w_gate"]).astype(jnp.float32)).astype(h.dtype)
-    x = x + _mm(gated * _mm(h, lp["w_up"]), lp["w_down"])
-    return x
+    y = _mm(gated * _mm(h, lp["w_up"]), lp["w_down"])
+    if c.sandwich_norms:
+        y = rms_norm(y, lp["post_ffw_norm"], c.rms_eps,
+                     c.norm_weight_offset)
+    return x + y
 
 
 def forward_hidden(config: LlamaConfig, params: dict, tokens,
@@ -345,14 +406,25 @@ def forward_hidden(config: LlamaConfig, params: dict, tokens,
     if c.remat:
         body = jax.checkpoint(
             body, policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims)
+    flags = window_flags(c)
 
     if c.scan_layers:
-        def scan_step(x, lp):
-            return body(x, lp, cos, sin, segment_ids), None
-        x, _ = jax.lax.scan(scan_step, x, params["layers"])
+        if flags is None:
+            def scan_step(x, lp):
+                return body(x, lp, cos, sin, segment_ids), None
+            x, _ = jax.lax.scan(scan_step, x, params["layers"])
+        else:
+            # per-layer window toggle rides the scan as DATA: one traced
+            # body, the flag flips the mask term per layer
+            def scan_step_w(x, layer):
+                lp, flag = layer
+                return body(x, lp, cos, sin, segment_ids,
+                            window_on=flag), None
+            x, _ = jax.lax.scan(scan_step_w, x, (params["layers"], flags))
     else:
-        for lp in params["layers"]:
-            x = body(x, lp, cos, sin, segment_ids)
+        for i, lp in enumerate(params["layers"]):
+            x = body(x, lp, cos, sin, segment_ids,
+                     window_on=None if flags is None else flags[i])
 
     return rms_norm(x, params["final_norm"], c.rms_eps, c.norm_weight_offset)
 
@@ -382,7 +454,7 @@ def init_cache(config: LlamaConfig, batch: int, max_len: int,
 
 
 def attention_step(config: LlamaConfig, x, lp, kc, vc, cos, sin, start_pos,
-                   valid=None):
+                   valid=None, window_on=None):
     """Cache-aware attention sublayer (with residual): write this chunk's
     K/V at ``start_pos`` and attend against the whole cache with a position
     mask. Static shapes throughout — the mask, not the shape, encodes how
@@ -425,7 +497,11 @@ def attention_step(config: LlamaConfig, x, lp, kc, vc, cos, sin, start_pos,
     # window that is ~8x less decode HBM traffic. The slice start is
     # clamped per row, so early steps read from 0 like before.
     ka, va, k_pos, valid_a = kc, vc, jnp.arange(max_len), valid
-    if c.sliding_window and c.sliding_window + s < max_len:
+    if c.sliding_window and c.sliding_window + s < max_len \
+            and window_on is None:
+        # (a per-layer window toggle means SOME layers are global — they
+        # need the whole cache, so the slice only applies to uniform
+        # patterns)
         span = min(max_len, c.sliding_window + s)
         last = q_pos[:, -1]                               # [b or 1]
         start = jnp.clip(last + 1 - span, 0, max_len - span)
@@ -458,14 +534,22 @@ def attention_step(config: LlamaConfig, x, lp, kc, vc, cos, sin, start_pos,
     qg = q.reshape(b, s, nkv, g, hd)
     scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, ka,
                         preferred_element_type=jnp.float32)
-    scores = scores * jnp.float32(1.0 / math.sqrt(hd))
+    scale = (c.query_scale ** -0.5 if c.query_scale
+             else 1.0 / math.sqrt(hd))
+    scores = scores * jnp.float32(scale)
+    if c.attn_logit_softcap:
+        cap = jnp.float32(c.attn_logit_softcap)
+        scores = cap * jnp.tanh(scores / cap)
     if k_pos.ndim == 1:
         k_pos = k_pos[None, None, :]       # [1, 1, K]
     else:
         k_pos = k_pos[:, None, :]          # [b, 1, K]
     mask = (k_pos <= q_pos[:, :, None])    # causal [b?, q, K]
     if c.sliding_window:
-        mask = mask & (k_pos > q_pos[:, :, None] - c.sliding_window)
+        win = k_pos > q_pos[:, :, None] - c.sliding_window
+        if window_on is not None:
+            win = win | jnp.logical_not(window_on)
+        mask = mask & win
     if valid_a is not None:
         mask = mask & valid_a[:, None, :]
     scores = jnp.where(mask[:, None, None], scores, -1e30)
@@ -475,18 +559,27 @@ def attention_step(config: LlamaConfig, x, lp, kc, vc, cos, sin, start_pos,
     attn = jnp.einsum("bhgqk,bkhd->bqhgd", probs, va,
                       preferred_element_type=jnp.float32)
     attn = attn.reshape(b, s, nh, hd).astype(x.dtype)
-    return x + _mm(attn.reshape(b, s, nh * hd), lp["wo"]), kc, vc
+    delta = _mm(attn.reshape(b, s, nh * hd), lp["wo"])
+    if c.sandwich_norms:
+        delta = rms_norm(delta, lp["post_attn_norm"], c.rms_eps,
+                         c.norm_weight_offset)
+    return x + delta, kc, vc
 
 
 def _layer_step(config: LlamaConfig, x, lp, kc, vc, cos, sin, start_pos,
-                valid=None):
-    """Cache-aware layer: attention step + dense gated MLP."""
+                valid=None, window_on=None):
+    """Cache-aware layer: attention step + dense gated MLP (sandwich
+    norms for Gemma-2)."""
     c = config
-    x, kc, vc = attention_step(c, x, lp, kc, vc, cos, sin, start_pos, valid)
+    x, kc, vc = attention_step(c, x, lp, kc, vc, cos, sin, start_pos,
+                               valid, window_on)
     h = rms_norm(x, lp["mlp_norm"], c.rms_eps, c.norm_weight_offset)
     gated = _act(c)(_mm(h, lp["w_gate"]).astype(jnp.float32)).astype(h.dtype)
-    x = x + _mm(gated * _mm(h, lp["w_up"]), lp["w_down"])
-    return x, kc, vc
+    y = _mm(gated * _mm(h, lp["w_up"]), lp["w_down"])
+    if c.sandwich_norms:
+        y = rms_norm(y, lp["post_ffw_norm"], c.rms_eps,
+                     c.norm_weight_offset)
+    return x + y, kc, vc
 
 
 def forward_step(config: LlamaConfig, params: dict, tokens, cache: dict,
@@ -518,20 +611,36 @@ def forward_step(config: LlamaConfig, params: dict, tokens, cache: dict,
     if c.embed_scale:
         x = x * jnp.asarray(math.sqrt(c.d_model), c.dtype)
     body = layer_body or _layer_step
+    flags = window_flags(c)
+    if flags is not None and layer_body is not None:
+        raise ValueError("window_pattern='alternate' is not supported "
+                         "with a custom layer_body")
 
     if c.scan_layers:
-        def scan_step(x, layer):
-            lp, kc, vc = layer
-            x, kc, vc = body(c, x, lp, kc, vc, cos, sin, start_pos, valid)
-            return x, (kc, vc)
-        x, (ks, vs) = jax.lax.scan(
-            scan_step, x, (params["layers"], cache["k"], cache["v"]))
+        if flags is None:
+            def scan_step(x, layer):
+                lp, kc, vc = layer
+                x, kc, vc = body(c, x, lp, kc, vc, cos, sin, start_pos,
+                                 valid)
+                return x, (kc, vc)
+            x, (ks, vs) = jax.lax.scan(
+                scan_step, x, (params["layers"], cache["k"], cache["v"]))
+        else:
+            def scan_step(x, layer):
+                lp, kc, vc, flag = layer
+                x, kc, vc = body(c, x, lp, kc, vc, cos, sin, start_pos,
+                                 valid, flag)
+                return x, (kc, vc)
+            x, (ks, vs) = jax.lax.scan(
+                scan_step, x,
+                (params["layers"], cache["k"], cache["v"], flags))
         new_cache = {"k": ks, "v": vs}
     else:
         ks, vs = [], []
         for i, lp in enumerate(params["layers"]):
             x, kc, vc = body(c, x, lp, cache["k"][i], cache["v"][i],
-                             cos, sin, start_pos, valid)
+                             cos, sin, start_pos, valid,
+                             *(() if flags is None else (flags[i],)))
             ks.append(kc)
             vs.append(vc)
         new_cache = {"k": jnp.stack(ks), "v": jnp.stack(vs)}

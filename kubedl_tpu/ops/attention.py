@@ -43,16 +43,23 @@ def repeat_kv(k, q_heads: int):
 
 
 def reference_attention(q, k, v, causal=True, segment_ids=None,
-                        window: int = 0):
-    """Naive [b, s, h, hd] attention; float32 softmax."""
+                        window: int = 0, scale=None,
+                        logit_softcap: float = 0.0, window_on=None):
+    """Naive [b, s, h, hd] attention; float32 softmax. ``scale``
+    overrides the 1/sqrt(hd) score scale (Gemma-2's
+    query_pre_attn_scalar); ``logit_softcap`` applies
+    cap*tanh(scores/cap) before masking."""
     _check_window(window, causal)
     b, sq, nh, hd = q.shape
     k = repeat_kv(k, nh)
     v = repeat_kv(v, nh)
-    scale = 1.0 / math.sqrt(hd)
+    scale = (1.0 / math.sqrt(hd)) if scale is None else scale
     scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                         k.astype(jnp.float32)) * scale
-    mask = _build_mask(sq, k.shape[1], causal, segment_ids, window)
+    if logit_softcap:
+        scores = logit_softcap * jnp.tanh(scores / logit_softcap)
+    mask = _build_mask(sq, k.shape[1], causal, segment_ids, window,
+                       window_on)
     if mask is not None:
         scores = jnp.where(mask, scores, _NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1)
@@ -70,15 +77,22 @@ def _check_window(window: int, causal: bool) -> None:
             "silently return dense attention)")
 
 
-def _build_mask(sq, sk, causal, segment_ids, window: int = 0):
-    """[b or 1, 1, sq, sk] boolean keep-mask, or None."""
+def _build_mask(sq, sk, causal, segment_ids, window: int = 0,
+                window_on=None):
+    """[b or 1, 1, sq, sk] boolean keep-mask, or None. ``window_on``
+    (optional traced bool) gates the window term per call — per-layer
+    window patterns (Gemma-2 alternates local/global layers) toggle it
+    as data inside one compiled scan body."""
     mask = None
     if causal:
         rows = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
         cols = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
         keep = cols <= rows
         if window > 0:
-            keep = keep & (cols > rows - window)
+            win = cols > rows - window
+            if window_on is not None:
+                win = win | jnp.logical_not(window_on)
+            keep = keep & win
         mask = keep[None, None]
     if segment_ids is not None:
         seg = segment_ids[:, None, :, None] == segment_ids[:, None, None, :]
@@ -91,8 +105,12 @@ def _build_mask(sq, sk, causal, segment_ids, window: int = 0):
 # ---------------------------------------------------------------------------
 
 def chunked_attention(q, k, v, causal=True, segment_ids=None,
-                      block_k: int = 512, window: int = 0):
-    """Online-softmax attention, scanning K/V blocks: O(sq*block_k) memory."""
+                      block_k: int = 512, window: int = 0, scale=None,
+                      logit_softcap: float = 0.0, window_on=None):
+    """Online-softmax attention, scanning K/V blocks: O(sq*block_k)
+    memory. ``scale``/``logit_softcap``/``window_on`` as in
+    :func:`reference_attention` (softcap is monotonic, so the online max
+    merge is unaffected)."""
     _check_window(window, causal)
     b, sq, nh, hd = q.shape
     sk = k.shape[1]
@@ -111,7 +129,7 @@ def chunked_attention(q, k, v, causal=True, segment_ids=None,
     else:
         seg_k = segment_ids
 
-    scale = 1.0 / math.sqrt(hd)
+    scale = (1.0 / math.sqrt(hd)) if scale is None else scale
     qh = jnp.swapaxes(q, 1, 2).astype(jnp.float32) * scale   # [b, h, sq, hd]
     kh = jnp.swapaxes(k, 1, 2).astype(jnp.float32)           # [b, h, skp, hd]
     vh = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
@@ -130,12 +148,16 @@ def chunked_attention(q, k, v, causal=True, segment_ids=None,
         acc, row_max, row_sum = carry
         kj, vj, j, sj = blk
         scores = jnp.einsum("bhqd,bhkd->bhqk", qh, kj)       # [b, h, sq, bk]
+        if logit_softcap:
+            scores = logit_softcap * jnp.tanh(scores / logit_softcap)
         keep = block_cols + j * block_k < sk
         if causal:
             keep = jnp.logical_and(keep, block_cols + j * block_k <= rows)
             if window > 0:
-                keep = jnp.logical_and(
-                    keep, block_cols + j * block_k > rows - window)
+                win = block_cols + j * block_k > rows - window
+                if window_on is not None:
+                    win = win | jnp.logical_not(window_on)
+                keep = jnp.logical_and(keep, win)
         keep = keep[None, None]
         if segment_ids is not None:
             keep = jnp.logical_and(
@@ -673,16 +695,28 @@ def _on_tpu() -> bool:
 
 
 def multi_head_attention(q, k, v, causal: bool = True, segment_ids=None,
-                         impl: Optional[str] = None, window: int = 0):
+                         impl: Optional[str] = None, window: int = 0,
+                         scale=None, logit_softcap: float = 0.0,
+                         window_on=None):
     """q [b, s, nh, hd]; k/v [b, s, nkv, hd] (GQA) -> [b, s, nh, hd].
     ``window > 0``: sliding-window (local) attention — each position
-    attends only the last ``window`` keys (causal only)."""
+    attends only the last ``window`` keys (causal only). ``scale``/
+    ``logit_softcap``/``window_on`` (Gemma-2's query scale, attention
+    softcap, per-layer window toggle) route through the chunked path:
+    the pallas kernel does not implement them."""
     _check_window(window, causal)
+    gemma2_knobs = (scale is not None or logit_softcap
+                    or window_on is not None)
     b, sq, nh, hd = q.shape
     if impl is None:
         aligned = (sq % 128 == 0 and k.shape[1] % 128 == 0
                    and hd % 128 == 0)
-        impl = "pallas" if (_on_tpu() and aligned) else "chunked"
+        impl = ("pallas" if (_on_tpu() and aligned and not gemma2_knobs)
+                else "chunked")
+    if impl in ("pallas", "pallas_interpret") and gemma2_knobs:
+        raise ValueError("scale/logit_softcap/window_on are not "
+                         "implemented in the pallas kernel; use "
+                         "impl='chunked'")
     if impl == "pallas":
         return _flash_attention(q, k, v, segment_ids, causal, False,
                                 window)
@@ -691,9 +725,13 @@ def multi_head_attention(q, k, v, causal: bool = True, segment_ids=None,
                                 window)
     if impl == "chunked":
         return chunked_attention(q, k, v, causal=causal,
-                                 segment_ids=segment_ids, window=window)
+                                 segment_ids=segment_ids, window=window,
+                                 scale=scale, logit_softcap=logit_softcap,
+                                 window_on=window_on)
     if impl == "reference":
         return reference_attention(q, k, v, causal=causal,
                                    segment_ids=segment_ids,
-                                   window=window)
+                                   window=window, scale=scale,
+                                   logit_softcap=logit_softcap,
+                                   window_on=window_on)
     raise ValueError(f"unknown attention impl {impl!r}")

@@ -158,16 +158,21 @@ class ContinuousBatchingEngine:
                              resolve_family, sample_logits)
         self.config = config
         self.family = family = resolve_family(config)
-        self.params = maybe_quantize(params, quantize)
         self.lanes = lanes
         self.max_len = max_len
         self.gen = gen or GenerateConfig(max_len=max_len)
         self.mesh = mesh
         # tensor-parallel serving over a local mesh (one host's chips):
         # params by logical specs, cache by kv-heads; the jitted steps
-        # are unchanged — GSPMD inserts the collectives
-        self.params, self._place_cache = init_mesh_serving(
-            config, self.params, quantize, mesh)
+        # are unchanged — GSPMD inserts the collectives. The unsupported
+        # mesh+quantize pair rejects BEFORE any quantization pass runs.
+        if mesh is not None:
+            self.params, self._place_cache = init_mesh_serving(
+                config, params, quantize, mesh)
+        else:
+            self.params = maybe_quantize(params, quantize)
+            _, self._place_cache = init_mesh_serving(
+                config, None, None, None)
         cfg = config
 
         @partial(jax.jit, donate_argnums=(1,))

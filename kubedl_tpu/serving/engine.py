@@ -209,11 +209,17 @@ class InferenceEngine:
                  gen: Optional[GenerateConfig] = None,
                  quantize: Optional[str] = None, mesh=None):
         self.config = config
-        self.params = maybe_quantize(params, quantize)
         self.gen = gen or GenerateConfig()
         self.mesh = mesh
-        self.params, self._place_cache = init_mesh_serving(
-            config, self.params, quantize, mesh)
+        if mesh is not None:
+            # reject the unsupported combination BEFORE paying a full
+            # quantization pass on a tree we are about to discard
+            self.params, self._place_cache = init_mesh_serving(
+                config, params, quantize, mesh)
+        else:
+            self.params = maybe_quantize(params, quantize)
+            _, self._place_cache = init_mesh_serving(
+                config, None, None, None)
 
         model_cfg = self.config
         self._family = family = resolve_family(config)

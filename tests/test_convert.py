@@ -124,3 +124,45 @@ def test_qwen2_window_layer_subset_semantics():
     # flag off -> no window regardless
     assert config_from_hf({**base, "use_sliding_window": False,
                            "max_window_layers": 2}).sliding_window == 0
+
+
+def test_gemma2_logits_match_transformers():
+    """The decisive gemma-2 pin: sandwich norms, attention softcap,
+    query_pre_attn_scalar, AND the alternating local/global window
+    pattern all reproduce transformers' logits. The probe sequence is
+    longer than the window so local and global layers genuinely
+    diverge."""
+    hf_cfg = transformers.Gemma2Config(
+        vocab_size=96, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=4, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=16, max_position_embeddings=64, sliding_window=4,
+        # deliberately != head_dim so a dropped query_scale path CANNOT
+        # hide behind the default 1/sqrt(head_dim)
+        query_pre_attn_scalar=32, attn_logit_softcapping=50.0,
+        final_logit_softcapping=30.0, attn_implementation="eager")
+    torch.manual_seed(5)
+    model = transformers.Gemma2ForCausalLM(hf_cfg)
+    tokens = [[3, 17, 42, 9, 1, 77, 5, 23, 11, 60, 2, 8]]
+    cfg = logits_match(model, tokens, atol=5e-4)
+    assert cfg.sandwich_norms and cfg.window_pattern == "alternate"
+    assert cfg.attn_logit_softcap == 50.0 and cfg.query_scale == 32.0
+    assert cfg.sliding_window == 4
+
+
+def test_gemma2_window_pattern_matters():
+    """Deleting the alternation (uniform window) must CHANGE the logits
+    on sequences longer than the window — proof the per-layer toggle is
+    real, not decorative."""
+    import dataclasses
+
+    from kubedl_tpu.models import llama as ll
+
+    cfg = dataclasses.replace(
+        ll.tiny(vocab=64, seq=64), n_layers=4, sandwich_norms=True,
+        sliding_window=4, window_pattern="alternate", dtype=jnp.float32)
+    params = ll.init_params(cfg, jax.random.PRNGKey(0))
+    toks = jnp.asarray([[5, 9, 1, 7, 3, 8, 2, 6, 4, 11]])
+    alt = ll.forward(cfg, params, toks)
+    uni = ll.forward(dataclasses.replace(cfg, window_pattern="uniform"),
+                     params, toks)
+    assert not np.allclose(np.asarray(alt), np.asarray(uni))

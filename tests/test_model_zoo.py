@@ -243,3 +243,28 @@ def test_qkv_bias_changes_the_function_and_trains():
         ref.append(nxt)
         cur.append(nxt)
     assert got == ref
+
+
+def test_gemma2_decode_matches_forward_rollout():
+    """The cached decode path carries its OWN copies of the gemma-2
+    logic (query scale, attn softcap, per-layer window toggle, the
+    window_on-gated cache-slice skip): pin it against the full forward's
+    greedy rollout well past the window so local/global layers diverge."""
+    import dataclasses
+
+    from kubedl_tpu.serving.engine import GenerateConfig, InferenceEngine
+
+    cfg = dataclasses.replace(
+        llama.tiny(vocab=64, seq=64), n_layers=4, sandwich_norms=True,
+        attn_logit_softcap=50.0, query_scale=32.0, sliding_window=4,
+        window_pattern="alternate", act="gelu", norm_weight_offset=1.0,
+        embed_scale=True, tie_embeddings=True, logit_softcap=30.0,
+        dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(3))
+    eng = InferenceEngine(cfg, params, GenerateConfig(max_len=48))
+    got = eng.generate([[3, 9, 1]], 20)[0]
+    cur = [3, 9, 1]
+    for want in got:
+        logits = llama.forward(cfg, params, jnp.asarray([cur]))
+        assert int(jnp.argmax(logits[0, -1])) == want, len(cur)
+        cur.append(want)
