@@ -221,14 +221,39 @@ def _kv_upper(q_block_idx, block_q: int, block_k: int, num_kb: int,
         num_kb, ((q_block_idx + 1) * block_q + block_k - 1) // block_k)
 
 
+# TPU vector tiling: the last two dims of every block must be (8k, 128k)
+# or match the array, and rank-1 layouts are second-class — so per-row
+# scalars (lse, delta) ride a lane-broadcast third dim and segment ids
+# ship lane-broadcast on the q side / sublane-broadcast on the kv side
+# (the upstream TPU flash kernel's layout). Interpret mode never enforces
+# this; the round-3 bench's first real chip contact did.
+_LSE_LANES = 128
+_SEG_LANES = 128
+_SEG_SUBLANES = 8
+
+
 def _seg_keep(seg_q_ref, seg_k_ref, j, block_k: int):
     """[block_q, block_k] same-segment mask for k block ``j`` (packed
-    sequences: tokens attend only within their own segment)."""
+    sequences: tokens attend only within their own segment). q ids
+    arrive as a [block_q, _SEG_LANES] lane-broadcast tile, kv ids as a
+    [_SEG_SUBLANES, sk] sublane-broadcast row — the mask is a 2-D
+    tile-vs-row compare, no rank-1 intermediates."""
     import jax.experimental.pallas as pl
 
-    sq_ids = seg_q_ref[0]                                   # [block_q]
-    sk_ids = seg_k_ref[0, pl.ds(j * block_k, block_k)]      # [block_k]
-    return sq_ids[:, None] == sk_ids[None, :]
+    q_ids = jnp.tile(seg_q_ref[0], (1, block_k // _SEG_LANES))
+    k_ids = seg_k_ref[0, :1, pl.ds(j * block_k, block_k)]   # [1, block_k]
+    return q_ids == k_ids
+
+
+def _scalar_spec(interpret: bool):
+    """BlockSpec for the tiny (1, 2) global-offset operand: scalars live
+    in SMEM on TPU; interpret mode keeps the plain whole-array spec."""
+    import jax.experimental.pallas as pl
+
+    if interpret:
+        return pl.BlockSpec((1, 2), lambda *_: (0, 0))
+    from jax.experimental.pallas import tpu as pltpu
+    return pl.BlockSpec(memory_space=pltpu.SMEM)
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, *rest, block_q, block_k,
@@ -236,12 +261,14 @@ def _flash_kernel(q_ref, k_ref, v_ref, *rest, block_q, block_k,
     """One (batch*head, q-block) program; K/V blocks streamed via fori_loop.
     Block shapes carry a leading singleton (batch*head) dim: q [1, block_q,
     hd], k/v [1, sk, hd], o [1, block_q, hd]. With ``has_seg`` two extra
-    int refs (seg_q [1, block_q], seg_k [1, sk]) restrict attention to
+    int refs (seg_q [1, block_q, _SEG_LANES] lane-broadcast, seg_k
+    [1, _SEG_SUBLANES, sk] sublane-broadcast) restrict attention to
     same-segment pairs (packed sequences). With ``has_off`` a [1, 2] int
-    ref carries GLOBAL (q, k) position offsets for the causal mask — ring
-    attention feeds sequence shards whose true positions differ from
+    SMEM ref carries GLOBAL (q, k) position offsets for the causal mask —
+    ring attention feeds sequence shards whose true positions differ from
     their local indices. Also writes the per-row logsumexp (scaled-score
-    space) consumed by the backward kernels."""
+    space, [1, block_q, _LSE_LANES] lane-broadcast) consumed by the
+    backward kernels."""
     import jax.experimental.pallas as pl  # local to keep CPU import cheap
 
     rest = list(rest)
@@ -302,7 +329,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, *rest, block_q, block_k,
         lower, upper, body, (acc0, max0, sum0))
     safe_sum = jnp.maximum(row_sum, 1e-37)
     o_ref[0] = (acc / safe_sum).astype(o_ref.dtype)
-    lse_ref[0] = (row_max + jnp.log(safe_sum))[:, 0]
+    lse_ref[0] = jnp.broadcast_to(row_max + jnp.log(safe_sum),
+                                  (block_q, _LSE_LANES))
 
 
 def _kv_index(i, nh: int, nkv: int):
@@ -339,14 +367,19 @@ def _flash_forward(q, k, v, causal, segment_ids=None, offsets=None,
     operands = [qh, kh, vh]
     if has_seg:
         seg = segment_ids.astype(jnp.int32)                 # [b, s]
-        # segment ids are per BATCH row; the grid's first dim is b*nh
+        # segment ids are per BATCH row; the grid's first dim is b*nh.
+        # Lane/sublane-broadcast so the blocks satisfy TPU tiling.
+        seg_q = jax.lax.broadcast_in_dim(seg, (b, sq, _SEG_LANES), (0, 1))
+        seg_k = jax.lax.broadcast_in_dim(seg, (b, _SEG_SUBLANES, sk), (0, 2))
         in_specs += [
-            pl.BlockSpec((1, block_q), lambda i, j: (i // nh, j)),
-            pl.BlockSpec((1, sk), lambda i, j: (i // nh, 0)),
+            pl.BlockSpec((1, block_q, _SEG_LANES),
+                         lambda i, j: (i // nh, j, 0)),
+            pl.BlockSpec((1, _SEG_SUBLANES, sk),
+                         lambda i, j: (i // nh, 0, 0)),
         ]
-        operands += [seg, seg]
+        operands += [seg_q, seg_k]
     if has_off:
-        in_specs += [pl.BlockSpec((1, 2), lambda i, j: (0, 0))]
+        in_specs += [_scalar_spec(interpret)]
         operands += [jnp.stack(
             [jnp.asarray(offsets[0], jnp.int32),
              jnp.asarray(offsets[1], jnp.int32)]).reshape(1, 2)]
@@ -361,15 +394,17 @@ def _flash_forward(q, k, v, causal, segment_ids=None, offsets=None,
         in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, hd), lambda i, j: (i, j, 0)),
-            pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+            pl.BlockSpec((1, block_q, _LSE_LANES), lambda i, j: (i, j, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * nh, sq, hd), q.dtype),
-            jax.ShapeDtypeStruct((b * nh, sq), jnp.float32),
+            jax.ShapeDtypeStruct((b * nh, sq, _LSE_LANES), jnp.float32),
         ],
         interpret=interpret,
     )(*operands)
-    return jnp.swapaxes(out.reshape(b, nh, sq, hd), 1, 2), lse
+    # callers see the logical rank-2 lse; the lane broadcast is a kernel
+    # layout detail
+    return jnp.swapaxes(out.reshape(b, nh, sq, hd), 1, 2), lse[:, :, 0]
 
 
 # ---------------------------------------------------------------------------
@@ -400,8 +435,8 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, *rest,
     scale = 1.0 / math.sqrt(hd)
     q = q_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0][:, None]                                # [bq, 1]
-    delta = delta_ref[0][:, None]                            # [bq, 1]
+    lse = lse_ref[0][:, :1]                                  # [bq, 1]
+    delta = delta_ref[0][:, :1]                              # [bq, 1]
 
     num_kb = sk // block_k
 
@@ -480,8 +515,8 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_acc, dv_acc = carry
         qi = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
         doi = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        lsei = lse_ref[0, pl.ds(i * block_q, block_q)][:, None]
-        deltai = delta_ref[0, pl.ds(i * block_q, block_q)][:, None]
+        lsei = lse_ref[0, pl.ds(i * block_q, block_q)][:, :1]
+        deltai = delta_ref[0, pl.ds(i * block_q, block_q)][:, :1]
         scores = jax.lax.dot_general(
             qi, kb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale      # [bq, bk]
@@ -491,9 +526,11 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                                 q_off + i * block_q,
                                 k_off + k_block_idx * block_k, window)
         if has_seg:
-            sq_ids = seg_q_ref[0, pl.ds(i * block_q, block_q)]
-            sk_ids = seg_k_ref[0]                            # [block_k]
-            seg = sq_ids[:, None] == sk_ids[None, :]
+            sq_ids = jnp.tile(
+                seg_q_ref[0, pl.ds(i * block_q, block_q)],
+                (1, block_k // _SEG_LANES))                  # [bq, bk]
+            sk_ids = seg_k_ref[0, :1]                        # [1, block_k]
+            seg = sq_ids == sk_ids
             keep = seg if keep is None else keep & seg
         if keep is not None:
             scores = jnp.where(keep, scores, _NEG_INF)
@@ -552,9 +589,16 @@ def _flash_backward(q, k, v, o, lse, g, causal, segment_ids=None,
     gh = jnp.swapaxes(g, 1, 2).reshape(bh, sq, hd)
     # Δ rows: rowsum(dO ∘ O) — a cheap elementwise+reduce, fused by XLA
     delta = (gh.astype(jnp.float32) * oh.astype(jnp.float32)).sum(-1)
+    # lane-broadcast the per-row scalars so their blocks tile on TPU
+    lse3 = jax.lax.broadcast_in_dim(lse, (bh, sq, _LSE_LANES), (0, 1))
+    delta3 = jax.lax.broadcast_in_dim(delta, (bh, sq, _LSE_LANES), (0, 1))
     kv_of = functools.partial(_kv_index, nh=nh, nkv=nkv)
     has_seg = segment_ids is not None
-    seg = segment_ids.astype(jnp.int32) if has_seg else None
+    seg_q = seg_k = None
+    if has_seg:
+        seg = segment_ids.astype(jnp.int32)
+        seg_q = jax.lax.broadcast_in_dim(seg, (b, sq, _SEG_LANES), (0, 1))
+        seg_k = jax.lax.broadcast_in_dim(seg, (b, _SEG_SUBLANES, sk), (0, 2))
     has_off = offsets is not None
     offs = (jnp.stack([jnp.asarray(offsets[0], jnp.int32),
                        jnp.asarray(offsets[1], jnp.int32)]).reshape(1, 2)
@@ -569,18 +613,20 @@ def _flash_backward(q, k, v, o, lse, g, causal, segment_ids=None,
         pl.BlockSpec((1, sk, hd), lambda i, j: (kv_of(i), 0, 0)),
         pl.BlockSpec((1, sk, hd), lambda i, j: (kv_of(i), 0, 0)),
         pl.BlockSpec((1, block_q, hd), lambda i, j: (i, j, 0)),
-        pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
-        pl.BlockSpec((1, block_q), lambda i, j: (i, j)),
+        pl.BlockSpec((1, block_q, _LSE_LANES), lambda i, j: (i, j, 0)),
+        pl.BlockSpec((1, block_q, _LSE_LANES), lambda i, j: (i, j, 0)),
     ]
-    dq_operands = [qh, kh, vh, gh, lse, delta]
+    dq_operands = [qh, kh, vh, gh, lse3, delta3]
     if has_seg:
         dq_in_specs += [
-            pl.BlockSpec((1, block_q), lambda i, j: (i // nh, j)),
-            pl.BlockSpec((1, sk), lambda i, j: (i // nh, 0)),
+            pl.BlockSpec((1, block_q, _SEG_LANES),
+                         lambda i, j: (i // nh, j, 0)),
+            pl.BlockSpec((1, _SEG_SUBLANES, sk),
+                         lambda i, j: (i // nh, 0, 0)),
         ]
-        dq_operands += [seg, seg]
+        dq_operands += [seg_q, seg_k]
     if has_off:
-        dq_in_specs += [pl.BlockSpec((1, 2), lambda i, j: (0, 0))]
+        dq_in_specs += [_scalar_spec(interpret)]
         dq_operands += [offs]
     dq = pl.pallas_call(
         dq_kernel,
@@ -605,18 +651,22 @@ def _flash_backward(q, k, v, o, lse, g, causal, segment_ids=None,
         pl.BlockSpec((1, block_k, hd), lambda i, j, r: (i, j, 0)),
         pl.BlockSpec((1, block_k, hd), lambda i, j, r: (i, j, 0)),
         pl.BlockSpec((1, sq, hd), lambda i, j, r: (reps * i + r, 0, 0)),
-        pl.BlockSpec((1, sq), lambda i, j, r: (reps * i + r, 0)),
-        pl.BlockSpec((1, sq), lambda i, j, r: (reps * i + r, 0)),
+        pl.BlockSpec((1, sq, _LSE_LANES),
+                     lambda i, j, r: (reps * i + r, 0, 0)),
+        pl.BlockSpec((1, sq, _LSE_LANES),
+                     lambda i, j, r: (reps * i + r, 0, 0)),
     ]
-    dkv_operands = [qh, kh, vh, gh, lse, delta]
+    dkv_operands = [qh, kh, vh, gh, lse3, delta3]
     if has_seg:
         dkv_in_specs += [
-            pl.BlockSpec((1, sq), lambda i, j, r: (i // nkv, 0)),
-            pl.BlockSpec((1, block_k), lambda i, j, r: (i // nkv, j)),
+            pl.BlockSpec((1, sq, _SEG_LANES),
+                         lambda i, j, r: (i // nkv, 0, 0)),
+            pl.BlockSpec((1, _SEG_SUBLANES, block_k),
+                         lambda i, j, r: (i // nkv, 0, j)),
         ]
-        dkv_operands += [seg, seg]
+        dkv_operands += [seg_q, seg_k]
     if has_off:
-        dkv_in_specs += [pl.BlockSpec((1, 2), lambda i, j, r: (0, 0))]
+        dkv_in_specs += [_scalar_spec(interpret)]
         dkv_operands += [offs]
     dk, dv = pl.pallas_call(
         dkv_kernel,
