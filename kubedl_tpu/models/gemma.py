@@ -10,10 +10,13 @@ that distinguish it from Llama:
 * RMSNorm scaling by ``(1 + weight)`` with zero-initialized weights,
 * embeddings multiplied by ``sqrt(d_model)``,
 * LM head tied to the embedding table (no separate ``lm_head`` param),
-* Gemma-2 additionally softcaps final logits at 30 and uses 4096-token
-  sliding-window attention (uniform here; under cp>1 the window rides
-  the dense ring path with global positions, so long-context sharding
-  works for the windowed configs too).
+* Gemma-2 additionally softcaps final logits at 30, sandwich-norms both
+  sublayers, softcaps attention logits, scales queries by its own
+  ``query_pre_attn_scalar``, and slides a 4096-token window on EVEN
+  layers only (``window_pattern="alternate"``). The Gemma-2 attention
+  knobs do not compose with a cp-sharded sequence yet —
+  ``attention_block`` refuses rather than mis-masking; plain-Gemma and
+  uniform-window configs ride the ring path fine.
 
 All of ``llama.forward`` / ``forward_step`` / ``loss_fn`` /
 ``init_params`` / ``param_specs`` / ``init_cache`` work unchanged on

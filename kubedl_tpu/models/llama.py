@@ -337,6 +337,13 @@ def attention_block(config: LlamaConfig, x, lp, cos, sin, segment_ids,
     (``kubedl_tpu.models.moe``). ``window_on`` (traced bool) toggles the
     sliding window per layer (Gemma-2's alternate pattern)."""
     c = config
+    if c.window_pattern == "alternate" and window_on is None:
+        # refuse rather than silently train every layer with the uniform
+        # window: any stack that forgets to thread window_flags() per
+        # layer (the MoE trap) must fail here, not diverge quietly
+        raise ValueError(
+            "window_pattern='alternate' requires a per-layer window_on "
+            "flag (thread window_flags(config) through the layer loop)")
     b, s, d = x.shape
     nh, nkv, hd = c.n_heads, c.n_kv_heads, c.hd
     knobs = _attn_knobs(c)
@@ -349,9 +356,10 @@ def attention_block(config: LlamaConfig, x, lp, cos, sin, segment_ids,
     k = apply_rope(k, cos, sin)
     if mesh is not None and mesh.shape.get("cp", 1) > 1 and segment_ids is None:
         # sequence sharded on cp: ring attention keeps the full-sequence
-        # attention exact while K/V blocks rotate over ICI; a sliding
-        # window rides the ring with global positions (dense per-block
-        # path), so Mistral/Gemma-2-style models train long-context too
+        # attention exact while K/V blocks rotate over ICI; a UNIFORM
+        # sliding window rides the ring with global positions (dense
+        # per-block path), so Mistral-style models train long-context
+        # too — the Gemma-2 knobs (checked below) do not compose yet
         if knobs or window_on is not None:
             raise ValueError(
                 "Gemma-2 attention knobs (query scale / attn softcap / "
@@ -612,9 +620,9 @@ def forward_step(config: LlamaConfig, params: dict, tokens, cache: dict,
         x = x * jnp.asarray(math.sqrt(c.d_model), c.dtype)
     body = layer_body or _layer_step
     flags = window_flags(c)
-    if flags is not None and layer_body is not None:
-        raise ValueError("window_pattern='alternate' is not supported "
-                         "with a custom layer_body")
+    # with an alternate window pattern the driver passes a per-layer
+    # window_on flag as a trailing positional — a custom layer_body that
+    # doesn't accept it fails loudly with a TypeError at trace time
 
     if c.scan_layers:
         if flags is None:

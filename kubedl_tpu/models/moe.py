@@ -238,8 +238,9 @@ def _moe_block(config: MoEConfig, x, lp, mesh=None, token_mask=None):
 
 
 def _layer_forward(config: MoEConfig, x, lp, cos, sin, segment_ids,
-                   mesh=None):
-    x = llama.attention_block(config, x, lp, cos, sin, segment_ids, mesh)
+                   mesh=None, window_on=None):
+    x = llama.attention_block(config, x, lp, cos, sin, segment_ids, mesh,
+                              window_on)
     return _moe_block(config, x, lp, mesh=mesh)
 
 
@@ -264,16 +265,30 @@ def forward_hidden(config: MoEConfig, params: dict, tokens,
             body,
             policy=jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims)
 
+    # per-layer sliding-window flags (Gemma-2-style alternate pattern);
+    # None when the pattern is uniform
+    flags = llama.window_flags(c)
     if c.scan_layers:
-        def scan_step(x, lp):
-            x, aux = body(x, lp, cos, sin, segment_ids)
-            return x, aux
-        x, auxes = jax.lax.scan(scan_step, x, params["layers"])
+        if flags is None:
+            def scan_step(x, lp):
+                x, aux = body(x, lp, cos, sin, segment_ids)
+                return x, aux
+            x, auxes = jax.lax.scan(scan_step, x, params["layers"])
+        else:
+            # per-layer window toggle rides the scan as DATA (one traced
+            # body, the flag flips the mask term per layer)
+            def scan_step_w(x, layer):
+                lp, flag = layer
+                x, aux = body(x, lp, cos, sin, segment_ids, window_on=flag)
+                return x, aux
+            x, auxes = jax.lax.scan(scan_step_w, x,
+                                    (params["layers"], flags))
         aux = auxes.sum()
     else:
         aux = jnp.zeros((), jnp.float32)
-        for lp in params["layers"]:
-            x, a = body(x, lp, cos, sin, segment_ids)
+        for i, lp in enumerate(params["layers"]):
+            x, a = body(x, lp, cos, sin, segment_ids,
+                        window_on=None if flags is None else flags[i])
             aux = aux + a
 
     x = rms_norm(x, params["final_norm"], c.rms_eps, c.norm_weight_offset)
@@ -296,13 +311,15 @@ def forward(config: MoEConfig, params: dict, tokens, positions=None,
 init_cache = llama.init_cache  # cache layout is attention-only; identical
 
 
-def _decode_layer_body(c, x, lp, kc, vc, cos, sin, start_pos, valid):
+def _decode_layer_body(c, x, lp, kc, vc, cos, sin, start_pos, valid,
+                       window_on=None):
     """Per-layer decode body plugged into llama's decode driver: shared
     cache-aware attention, then the sparse-MLP block. The chunk's token
     mask is sliced out of ``valid`` so left-padding never consumes expert
-    capacity ahead of real tokens."""
+    capacity ahead of real tokens. ``window_on`` arrives as a trailing
+    positional from the driver when the window pattern alternates."""
     x, kc, vc = llama.attention_step(c, x, lp, kc, vc, cos, sin,
-                                     start_pos, valid)
+                                     start_pos, valid, window_on)
     token_mask = None
     if valid is not None:
         if getattr(start_pos, "ndim", 0) == 1:   # per-row positions
