@@ -85,9 +85,9 @@ def config_from_hf(hf) -> LlamaConfig:
                         else _window_from_hf(get)),
         window_pattern="alternate" if gemma2 else "uniform",
         sandwich_norms=gemma2,
-        attn_logit_softcap=(float(get("attn_logit_softcapping") or 0.0)
+        attn_logit_softcap=(_require(get, "attn_logit_softcapping")
                             if gemma2 else 0.0),
-        query_scale=(float(get("query_pre_attn_scalar") or 0.0)
+        query_scale=(_require(get, "query_pre_attn_scalar")
                      if gemma2 else 0.0),
         qkv_bias=bool(get("attention_bias", False)
                       or model_type == "qwen2"),
@@ -97,6 +97,20 @@ def config_from_hf(hf) -> LlamaConfig:
         tie_embeddings=bool(get("tie_word_embeddings", gemma)),
         logit_softcap=float(get("final_logit_softcapping") or 0.0),
     )
+
+
+def _require(get, name: str) -> float:
+    """Gemma-2 scoring knobs must be present in the HF config: falling
+    back to 1/sqrt(head_dim) scaling / no softcap would quietly diverge
+    (e.g. gemma2-27b's query_pre_attn_scalar=144 != head_dim=128) — the
+    same refuse-rather-than-silently-misconvert policy as the
+    layer_types check."""
+    v = get(name)
+    if v is None:
+        raise ValueError(
+            f"gemma2 HF config is missing {name!r}; refusing to guess "
+            "(the default would silently change the model's scoring)")
+    return float(v)
 
 
 def _window_from_hf(get) -> int:

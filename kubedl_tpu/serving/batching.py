@@ -154,8 +154,7 @@ class ContinuousBatchingEngine:
                  gen: Optional[GenerateConfig] = None,
                  quantize: Optional[str] = None, seed: int = 0,
                  mesh=None):
-        from .engine import (init_mesh_serving, maybe_quantize,
-                             resolve_family, sample_logits)
+        from .engine import init_mesh_serving, resolve_family, sample_logits
         self.config = config
         self.family = family = resolve_family(config)
         self.lanes = lanes
@@ -164,15 +163,9 @@ class ContinuousBatchingEngine:
         self.mesh = mesh
         # tensor-parallel serving over a local mesh (one host's chips):
         # params by logical specs, cache by kv-heads; the jitted steps
-        # are unchanged — GSPMD inserts the collectives. The unsupported
-        # mesh+quantize pair rejects BEFORE any quantization pass runs.
-        if mesh is not None:
-            self.params, self._place_cache = init_mesh_serving(
-                config, params, quantize, mesh)
-        else:
-            self.params = maybe_quantize(params, quantize)
-            _, self._place_cache = init_mesh_serving(
-                config, None, None, None)
+        # are unchanged — GSPMD inserts the collectives.
+        self.params, self._place_cache = init_mesh_serving(
+            config, params, quantize, mesh)
         cfg = config
 
         @partial(jax.jit, donate_argnums=(1,))

@@ -177,12 +177,14 @@ def shard_for_serving(config, params, cache, mesh):
 
 
 def init_mesh_serving(config, params, quantize, mesh):
-    """The ONE mesh-wiring path both engines share: validates the
-    (mesh, quantize) combination, shards params for serving, and returns
-    ``(params, place_cache)`` where ``place_cache`` re-places a fresh KV
-    cache (identity without a mesh)."""
+    """The ONE param-preparation path both engines share: validates the
+    (mesh, quantize) combination, then either quantizes (no mesh) or
+    shards params for serving, returning ``(params, place_cache)`` where
+    ``place_cache`` re-places a fresh KV cache (identity without a
+    mesh). The unsupported mesh+quantize pair rejects BEFORE any
+    quantization pass runs."""
     if mesh is None:
-        return params, (lambda cache: cache)
+        return maybe_quantize(params, quantize), (lambda cache: cache)
     if quantize:
         raise ValueError(
             "mesh-parallel serving does not compose with weight "
@@ -211,15 +213,8 @@ class InferenceEngine:
         self.config = config
         self.gen = gen or GenerateConfig()
         self.mesh = mesh
-        if mesh is not None:
-            # reject the unsupported combination BEFORE paying a full
-            # quantization pass on a tree we are about to discard
-            self.params, self._place_cache = init_mesh_serving(
-                config, params, quantize, mesh)
-        else:
-            self.params = maybe_quantize(params, quantize)
-            _, self._place_cache = init_mesh_serving(
-                config, None, None, None)
+        self.params, self._place_cache = init_mesh_serving(
+            config, params, quantize, mesh)
 
         model_cfg = self.config
         self._family = family = resolve_family(config)
