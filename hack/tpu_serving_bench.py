@@ -1,0 +1,211 @@
+"""Serving throughput on silicon: TTFT + decode tokens/s for the serving
+stack, measured on the real chip.
+
+The train bench (bench.py) proves the training path on TPU; this script
+proves the SERVING path: the same ~1.1B-param Llama config the v5e train
+bench uses, decoded through ``kubedl_tpu.serving.engine.greedy_rollout``
+(prefill + on-device token loop in ONE device call — per-token host
+dispatch over the axon relay would otherwise dominate and measure the
+relay, not the chip). Writes ``SERVING_TPU.json`` incrementally after
+every phase so a relay hang mid-suite still leaves the phases that ran.
+
+Run standalone (``python hack/tpu_serving_bench.py``) over the single
+shared backend connection convention: one in-process connect, watchdog
+guarded, artifact always written.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# harness smoke runs (SERVING_BENCH_TINY=1) must never clobber the real
+# chip artifact with toy-model numbers
+OUT = os.path.join(
+    REPO, "SERVING_TPU_SMOKE.json"
+    if os.environ.get("SERVING_BENCH_TINY", "") == "1"
+    else "SERVING_TPU.json")
+sys.path.insert(0, REPO)
+
+#: whole-run deadline; the relay can wedge on connect and hang forever
+DEADLINE_S = float(os.environ.get("SERVING_BENCH_DEADLINE_S", 1500))
+
+
+def _arm_watchdog() -> None:
+    def fire() -> None:
+        print(f"# watchdog: {DEADLINE_S}s deadline hit; artifact reflects "
+              "completed phases only", file=sys.stderr, flush=True)
+        os._exit(3)
+
+    t = threading.Timer(DEADLINE_S, fire)
+    t.daemon = True
+    t.start()
+
+
+def _atomic_write(payload: dict) -> None:
+    tmp = OUT + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(json.dumps(payload, indent=1) + "\n")
+    os.replace(tmp, OUT)
+
+
+def serving_config():
+    """The v5e train bench's ~1.1B Llama shape (bench.py pick_config) so
+    train and serve numbers describe the same model. CI harness runs
+    (SERVING_BENCH_TINY=1, off-chip) shrink to a toy shape."""
+    from kubedl_tpu.models import llama
+    if os.environ.get("SERVING_BENCH_TINY", "") == "1":
+        return llama.tiny(vocab=256, seq=128)
+    return llama.LlamaConfig(vocab_size=32000, d_model=2048, n_layers=16,
+                             n_heads=16, n_kv_heads=8, d_ff=5632,
+                             max_seq_len=2048, rope_theta=10000.0)
+
+
+def run(device=None) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from kubedl_tpu.serving.engine import greedy_rollout, maybe_quantize
+
+    dev = device or jax.devices()[0]
+    plat = dev.platform.lower()
+    kind = (dev.device_kind or "").lower()
+    if (plat not in ("tpu", "axon") and "tpu" not in kind
+            and os.environ.get("SERVING_BENCH_TINY", "") != "1"):
+        raise RuntimeError(
+            f"serving bench needs a TPU backend, got platform={plat!r} "
+            f"kind={kind!r} (no cpu numbers: they would be mistaken for "
+            "chip results)")
+
+    cfg = serving_config()
+    t_start = time.time()
+    phases: dict = {}
+    out: dict = {}
+    ok = True
+
+    def _write(complete: bool) -> None:
+        out.clear()
+        out.update({
+            "ok": ok and complete,
+            "complete": complete,
+            "model": f"llama-{cfg.num_params / 1e9:.2f}B",
+            "device_kind": dev.device_kind or "",
+            "platform": dev.platform,
+            "total_secs": round(time.time() - t_start, 1),
+            "phases": phases,
+        })
+        _atomic_write(out)
+
+    _write(False)
+
+    # one fused on-device init (per-tensor eager init over a relayed chip
+    # pays a round trip per weight)
+    from kubedl_tpu.models import llama
+    params = jax.jit(lambda k: llama.init_params(cfg, k))(
+        jax.random.PRNGKey(0))
+    jax.block_until_ready(params)
+    phases["init"] = {"secs": round(time.time() - t_start, 1)}
+    _write(False)
+
+    rng = jax.random.PRNGKey(1)
+    tiny = os.environ.get("SERVING_BENCH_TINY", "") == "1"
+    # long generations amortize the relay's ~0.4s fixed per-call latency
+    # so the decode rate reflects the chip, not the link
+    plen, new = (32, 8) if tiny else (512, 512)
+
+    iters = int(os.environ.get("SERVING_BENCH_ITERS", 3))
+
+    def measure(name, p, batch, plen, max_new):
+        nonlocal ok
+        t0 = time.time()
+        try:
+            # DISTINCT prompts for warmup and for every timed iteration:
+            # the axon relay memoizes repeat executions with identical
+            # input buffers, so re-timing the warmup call measures the
+            # relay's cache, not the chip (observed: 0.2 ms "decodes")
+            keys = jax.random.split(jax.random.fold_in(rng, hash(name) % 2**31),
+                                    iters + 1)
+            prompt_sets = [jax.random.randint(k, (batch, plen), 1,
+                                              cfg.vocab_size, jnp.int32)
+                           for k in keys]
+            # device_get, not block_until_ready: the relay acks readiness
+            # optimistically, but a host fetch must wait for real data
+            toks = greedy_rollout(cfg, p, prompt_sets[0], max_new)
+            jax.device_get(toks)
+            compile_s = time.time() - t0
+            walls = []
+            for ps in prompt_sets[1:]:
+                t0 = time.time()
+                toks = greedy_rollout(cfg, p, ps, max_new)
+                jax.device_get(toks[:, -1])
+                walls.append(max(time.time() - t0, 1e-4))
+            # min over iters: the relay adds jittery per-call latency, and
+            # min is the cleanest estimate of achievable time; mean kept
+            # for honesty about the observed spread
+            dt = min(walls)
+            phases[name] = {
+                "batch": batch, "prompt_len": plen, "max_new": max_new,
+                "iters": iters,
+                "compile_s": round(compile_s, 1),
+                "wall_s": round(dt, 4),
+                "wall_mean_s": round(sum(walls) / len(walls), 4),
+                "tokens_per_s": round(batch * max_new / dt, 1),
+            }
+        except Exception as e:  # noqa: BLE001 — record and continue
+            ok = False
+            phases[name] = {"error": f"{type(e).__name__}: {e}"[:300]}
+        _write(False)
+        return phases[name]
+
+    # TTFT: prefill + first token, batch 1 — what one streaming client
+    # waits for before its first SSE event
+    ttft = measure("ttft", params, 1, plen, 1)
+    if "wall_s" in ttft:
+        ttft["ttft_ms"] = round(1000 * ttft["wall_s"], 1)
+
+    # interactive decode latency: batch 1, long generation
+    inter = measure("decode_b1", params, 1, plen, new)
+    if "wall_s" in inter and "wall_s" in ttft:
+        # subtract the prefill estimate so the number is per-DECODE-token
+        decode_s = max(inter["wall_s"] - ttft["wall_s"], 1e-4)
+        inter["ms_per_token"] = round(1000 * decode_s / (new - 1), 3)
+
+    # batch throughput: 8 concurrent streams
+    b8_pre = measure("prefill_b8", params, 8, plen, 1)
+    b8 = measure("decode_b8", params, 8, plen, new)
+    if "wall_s" in b8 and "wall_s" in b8_pre:
+        decode_s = max(b8["wall_s"] - b8_pre["wall_s"], 1e-4)
+        b8["decode_tokens_per_s"] = round(8 * (new - 1) / decode_s, 1)
+
+    # int8 weight-only quantization: serving's bandwidth lever
+    q = maybe_quantize(params, "int8")
+    q8_pre = measure("prefill_int8_b8", q, 8, plen, 1)
+    q8 = measure("decode_int8_b8", q, 8, plen, new)
+    if "wall_s" in q8 and "wall_s" in q8_pre:
+        decode_s = max(q8["wall_s"] - q8_pre["wall_s"], 1e-4)
+        q8["decode_tokens_per_s"] = round(8 * (new - 1) / decode_s, 1)
+
+    _write(True)
+    return out
+
+
+def main() -> None:
+    _arm_watchdog()
+    result = run()
+    print(json.dumps({
+        "metric": "serving_decode_tokens_per_s[b8,int8]",
+        "value": result["phases"].get("decode_int8_b8", {}).get(
+            "decode_tokens_per_s", 0.0),
+        "unit": "tokens/s",
+        "ok": result["ok"],
+        "ttft_ms": result["phases"].get("ttft", {}).get("ttft_ms"),
+        "device_kind": result["device_kind"],
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

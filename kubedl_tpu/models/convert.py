@@ -85,9 +85,11 @@ def config_from_hf(hf) -> LlamaConfig:
                         else _window_from_hf(get)),
         window_pattern="alternate" if gemma2 else "uniform",
         sandwich_norms=gemma2,
-        attn_logit_softcap=(_require(get, "attn_logit_softcapping")
+        attn_logit_softcap=(_gemma2_knob(get, "attn_logit_softcapping",
+                                         50.0, null_ok=True)
                             if gemma2 else 0.0),
-        query_scale=(_require(get, "query_pre_attn_scalar")
+        query_scale=(_gemma2_knob(get, "query_pre_attn_scalar",
+                                  256.0, null_ok=False)
                      if gemma2 else 0.0),
         qkv_bias=bool(get("attention_bias", False)
                       or model_type == "qwen2"),
@@ -99,17 +101,27 @@ def config_from_hf(hf) -> LlamaConfig:
     )
 
 
-def _require(get, name: str) -> float:
-    """Gemma-2 scoring knobs must be present in the HF config: falling
-    back to 1/sqrt(head_dim) scaling / no softcap would quietly diverge
-    (e.g. gemma2-27b's query_pre_attn_scalar=144 != head_dim=128) — the
-    same refuse-rather-than-silently-misconvert policy as the
-    layer_types check."""
-    v = get(name)
+_MISSING = object()
+
+
+def _gemma2_knob(get, name: str, default: float, null_ok: bool) -> float:
+    """Gemma-2 scoring knob with transformers' exact semantics: a key
+    that is ABSENT takes the Gemma2Config class default (what
+    ``transformers`` would instantiate, so the conversion stays exact —
+    never 1/sqrt(head_dim), which diverges e.g. on gemma2-27b where
+    query_pre_attn_scalar=144 != head_dim=128); an explicit ``null``
+    means "disabled" where HF's modeling code gates on ``is not None``
+    (attn softcapping) and is refused where HF itself would choke on it
+    (query_pre_attn_scalar)."""
+    v = get(name, _MISSING)
+    if v is _MISSING:
+        return default
     if v is None:
+        if null_ok:
+            return 0.0
         raise ValueError(
-            f"gemma2 HF config is missing {name!r}; refusing to guess "
-            "(the default would silently change the model's scoring)")
+            f"gemma2 HF config has {name!r}: null, which transformers "
+            "itself cannot score with; refusing to guess")
     return float(v)
 
 
