@@ -34,21 +34,15 @@ def _chunk_nll(x_chunk, w, targets_chunk, logit_softcap: float):
     return logz - gold
 
 
-def chunked_softmax_xent(x, w, targets, mask=None, chunk: int = 512,
-                         logit_softcap: float = 0.0):
-    """Cross-entropy of ``x @ w`` against ``targets`` without ever
-    holding the full [b, s, V] logits.
+def chunked_token_nll(x, w, targets, mask=None, chunk: int = 512,
+                      logit_softcap: float = 0.0):
+    """Per-ROW summed NLL [b] over unmasked targets, scanning the
+    sequence in chunks (peak logits HBM = b*chunk*V).
 
-    Args:
-      x: [b, s, d] final hidden states (any float dtype).
-      w: [d, V] LM head.
-      targets: [b, s] int32 target token ids.
-      mask: optional [b, s] {0,1} float/bool mask over targets.
-      chunk: sequence-chunk length; peak logits memory is b*chunk*V.
-
-    Returns the mean NLL over unmasked targets (scalar float32), exactly
-    matching the unchunked computation (same float32 softmax).
-    """
+    Row sums (not the batch mean) are what sequence-level objectives
+    need — DPO's per-sequence log-probabilities are ``-chunked_token_nll``
+    over the completion mask (train/dpo.py). ``chunked_softmax_xent``
+    derives the batch-mean loss from these row sums."""
     b, s, d = x.shape
     chunk = max(1, min(chunk, s))
     pad = (-s) % chunk
@@ -67,11 +61,32 @@ def chunked_softmax_xent(x, w, targets, mask=None, chunk: int = 512,
 
     step_fn = jax.checkpoint(  # backward recomputes chunk logits
         lambda xc, tc, mc: jnp.sum(
-            _chunk_nll(xc, w, tc, logit_softcap) * mc))
+            _chunk_nll(xc, w, tc, logit_softcap) * mc, axis=-1))
 
     def step(carry, inp):
         xc, tc, mc = inp
         return carry + step_fn(xc, tc, mc), None
 
-    total, _ = jax.lax.scan(step, jnp.float32(0.0), (xs, ts, ms))
-    return total / jnp.maximum(jnp.sum(mask), 1.0)
+    total, _ = jax.lax.scan(step, jnp.zeros((b,), jnp.float32),
+                            (xs, ts, ms))
+    return total
+
+
+def chunked_softmax_xent(x, w, targets, mask=None, chunk: int = 512,
+                         logit_softcap: float = 0.0):
+    """Mean NLL over unmasked targets (scalar float32), exactly matching
+    the unchunked computation (same float32 softmax); see
+    ``chunked_token_nll`` for the chunked scan itself.
+
+    Args:
+      x: [b, s, d] final hidden states (any float dtype).
+      w: [d, V] LM head.
+      targets: [b, s] int32 target token ids.
+      mask: optional [b, s] {0,1} float/bool mask over targets.
+      chunk: sequence-chunk length; peak logits memory is b*chunk*V.
+    """
+    rows = chunked_token_nll(x, w, targets, mask=mask, chunk=chunk,
+                             logit_softcap=logit_softcap)
+    denom = (jnp.sum(mask.astype(jnp.float32)) if mask is not None
+             else jnp.float32(x.shape[0] * x.shape[1]))
+    return jnp.sum(rows) / jnp.maximum(denom, 1.0)

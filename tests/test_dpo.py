@@ -1,0 +1,206 @@
+"""DPO preference fine-tuning: loss math, chunked sequence logprobs,
+batch assembly, and an end-to-end learns-the-preference run.
+
+No reference analog (the reference operator has no training stack,
+SURVEY.md §2); this covers the beyond-parity compute path
+``kubedl_tpu/train/dpo.py``.
+"""
+
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubedl_tpu.models import llama
+from kubedl_tpu.parallel.mesh import MeshConfig, build_mesh
+from kubedl_tpu.train import dpo
+from kubedl_tpu.train.data import shard_batch
+from kubedl_tpu.train.trainer import TrainConfig, Trainer
+
+
+def test_dpo_loss_hand_values():
+    """Sigmoid DPO against the formula computed by hand."""
+    pol_c = jnp.array([1.0, 0.0])
+    pol_r = jnp.array([0.0, 1.0])
+    ref = jnp.zeros(2)
+    cfg = dpo.DPOConfig(beta=0.5)
+    loss, m = dpo.dpo_loss(pol_c, pol_r, ref, ref, cfg)
+    # margins: 0.5*(1-0) = 0.5 and 0.5*(0-1) = -0.5
+    want = np.mean([-np.log(1 / (1 + np.exp(-0.5))),
+                    -np.log(1 / (1 + np.exp(0.5)))])
+    assert abs(float(loss) - want) < 1e-6
+    assert float(m["accuracy"]) == 0.5
+    assert abs(float(m["reward_margin"])) < 1e-6
+
+
+def test_dpo_loss_indifferent_pair_is_log2():
+    """chosen == rejected -> margin 0 -> loss log(2)."""
+    z = jnp.zeros(3)
+    loss, _ = dpo.dpo_loss(z, z, z, z, dpo.DPOConfig())
+    assert abs(float(loss) - math.log(2.0)) < 1e-6
+
+
+def test_label_smoothing_penalizes_confidence():
+    """With smoothing, a huge positive margin is no longer free."""
+    big = jnp.array([50.0])
+    zero = jnp.zeros(1)
+    plain, _ = dpo.dpo_loss(big, zero, zero, zero, dpo.DPOConfig(beta=1.0))
+    smooth, _ = dpo.dpo_loss(
+        big, zero, zero, zero,
+        dpo.DPOConfig(beta=1.0, label_smoothing=0.1))
+    assert float(smooth) > float(plain) + 1.0
+
+
+def test_ipo_regresses_to_half_beta_margin():
+    """IPO loss is exactly zero at margin 1/(2 beta), positive elsewhere."""
+    cfg = dpo.DPOConfig(beta=0.25, loss_type="ipo")
+    at_target = jnp.array([1.0 / (2 * 0.25)])
+    zero = jnp.zeros(1)
+    loss, _ = dpo.dpo_loss(at_target, zero, zero, zero, cfg)
+    assert abs(float(loss)) < 1e-6
+    loss2, _ = dpo.dpo_loss(at_target + 1.0, zero, zero, zero, cfg)
+    assert float(loss2) > 0.5
+
+
+def test_dpo_config_validation():
+    with pytest.raises(ValueError):
+        dpo.DPOConfig(loss_type="hinge")
+    with pytest.raises(ValueError):
+        dpo.DPOConfig(label_smoothing=0.5)
+    with pytest.raises(ValueError, match="IPO"):
+        dpo.DPOConfig(loss_type="ipo", label_smoothing=0.1)
+
+
+def test_preference_batch_rejects_empty_completion():
+    with pytest.raises(ValueError, match="no completion"):
+        dpo.preference_batch([[1, 2]], [[1, 3, 4]], [2])
+
+
+def test_preference_batch_layout():
+    """Padding to 128, shifted targets, completion-only mask."""
+    b = dpo.preference_batch(
+        prompt_and_chosen=[[5, 6, 7, 8, 9]],
+        prompt_and_rejected=[[5, 6, 3, 2]],
+        prompt_lens=[2], pad_id=0)
+    assert b["chosen_tokens"].shape == (1, 128)
+    # targets are tokens shifted left
+    np.testing.assert_array_equal(b["chosen_targets"][0, :4], [6, 7, 8, 9])
+    # completion targets start at prompt_len-1 (index 1 predicts token 2)
+    np.testing.assert_array_equal(b["chosen_mask"][0, :5],
+                                  [0.0, 1.0, 1.0, 1.0, 0.0])
+    np.testing.assert_array_equal(b["rejected_mask"][0, :4],
+                                  [0.0, 1.0, 1.0, 0.0])
+
+
+def test_preference_batch_rejects_ragged_pairs():
+    with pytest.raises(ValueError):
+        dpo.preference_batch([[1, 2]], [[1, 3], [1, 4]], [1])
+
+
+def test_preference_batch_rejects_zero_prompt():
+    """prompt_len 0 would wrap the mask slice to -1 and silently drop
+    the pair from the loss."""
+    with pytest.raises(ValueError, match="prompt_lens"):
+        dpo.preference_batch([[1, 2]], [[1, 3]], [0])
+
+
+def test_sequence_logprobs_moe_dispatch():
+    """MoE configs route through moe.forward_hidden and surface the
+    router aux loss."""
+    from kubedl_tpu.models import moe
+    cfg = dataclasses.replace(moe.tiny(vocab=64), dtype=jnp.float32)
+    params = moe.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, 64)
+    targets = jnp.roll(tokens, -1, axis=1)
+    lp, aux = dpo.sequence_logprobs(cfg, params, tokens, targets,
+                                    with_aux=True)
+    assert lp.shape == (2,)
+    assert float(aux) > 0.0  # a live load-balancing term, not the 0 stub
+    assert np.all(np.isfinite(np.asarray(lp)))
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = dataclasses.replace(llama.tiny(vocab=64), dtype=jnp.float32)
+    return cfg, llama.init_params(cfg, jax.random.PRNGKey(0))
+
+
+def test_sequence_logprobs_match_dense(tiny_model):
+    """Chunked per-row logprobs == dense log_softmax gather (masked)."""
+    cfg, params = tiny_model
+    key = jax.random.PRNGKey(1)
+    tokens = jax.random.randint(key, (3, 32), 0, cfg.vocab_size)
+    targets = jnp.roll(tokens, -1, axis=1)
+    mask = jnp.zeros((3, 32)).at[:, 4:20].set(1.0)
+
+    got = dpo.sequence_logprobs(cfg, params, tokens, targets, mask=mask,
+                                chunk=7)  # chunk !| 32: exercises padding
+    logits = llama.forward(cfg, params, tokens)
+    lsm = jax.nn.log_softmax(logits, axis=-1)
+    gold = jnp.take_along_axis(lsm, targets[..., None], axis=-1)[..., 0]
+    want = jnp.sum(gold * mask, axis=1)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_loss_fn_requires_reference(tiny_model):
+    cfg, params = tiny_model
+    b = {k: jnp.asarray(v) for k, v in dpo.preference_batch(
+        [[1, 2, 3]], [[1, 2, 4]], [2]).items()}
+    fn = dpo.make_dpo_loss_fn(cfg)  # no ref_params
+    with pytest.raises(ValueError, match="ref_"):
+        fn(params, b)
+
+
+def test_precomputed_ref_matches_inline_ref(tiny_model):
+    """Precomputing reference logps must not change the loss."""
+    cfg, params = tiny_model
+    batch = {k: jnp.asarray(v) for k, v in dpo.preference_batch(
+        [[1, 2, 3, 9], [4, 5, 6]],
+        [[1, 2, 8, 8], [4, 5, 7]],
+        [2, 2]).items()}
+    inline = dpo.make_dpo_loss_fn(cfg, ref_params=params)(params, batch)
+    ref_c, ref_r = dpo.reference_logps_fn(cfg, params)(batch)
+    batch2 = dict(batch, ref_chosen_logps=ref_c, ref_rejected_logps=ref_r)
+    pre = dpo.make_dpo_loss_fn(cfg)(params, batch2)
+    np.testing.assert_allclose(float(inline), float(pre), rtol=1e-5)
+    # identical policy and reference -> margin 0 -> log(2)
+    np.testing.assert_allclose(float(inline), math.log(2.0), rtol=1e-4)
+
+
+@pytest.mark.slow
+def test_dpo_training_learns_preference(tiny_model):
+    """A few Trainer steps push accuracy to 1 and margin > 0."""
+    cfg, params = tiny_model
+    mesh = build_mesh(MeshConfig(dp=2))  # 8 devices: dp=2 x fsdp fill
+    rng = np.random.RandomState(0)
+    chosen, rejected = [], []
+    for _ in range(8):  # batch divisible by the dp x fsdp plane
+        prompt = rng.randint(1, 32, size=3).tolist()
+        chosen.append(prompt + [40, 41, 42])
+        rejected.append(prompt + [50, 51])
+    batch = {k: jnp.asarray(v) for k, v in dpo.preference_batch(
+        chosen, rejected, [3] * 8).items()}
+    ref_c, ref_r = dpo.reference_logps_fn(cfg, params)(batch)
+    batch = dict(batch, ref_chosen_logps=ref_c, ref_rejected_logps=ref_r)
+
+    dcfg = dpo.DPOConfig(beta=0.2)
+    tr = Trainer(dpo.make_dpo_loss_fn(cfg, dcfg), llama.param_specs(cfg),
+                 mesh, TrainConfig(learning_rate=5e-3, warmup_steps=1,
+                                   decay_steps=100))
+    state = tr.init_state(params)
+    sb = shard_batch(batch, mesh)
+    loss0 = None
+    for _ in range(12):
+        state, loss = tr.step(state, sb)
+        loss0 = loss0 if loss0 is not None else float(loss)
+    assert float(loss) < loss0 < math.log(2.0) + 1e-3
+
+    pol_c, pol_r = dpo._pair_logprobs(cfg, state.params, batch,
+                                      None, 512)
+    _, m = dpo.dpo_loss(pol_c, pol_r, ref_c, ref_r, dcfg)
+    assert float(m["accuracy"]) == 1.0
+    assert float(m["reward_margin"]) > 0.1
