@@ -156,15 +156,8 @@ def main():
     sys.path.insert(0, os.path.join(os.path.dirname(
         os.path.abspath(__file__)), ".."))
     import jax
-    dev = jax.devices()[0]
-    RESULTS["device_kind"] = dev.device_kind or ""
-    RESULTS["platform"] = dev.platform
-    if dev.platform not in ("tpu", "axon") \
-            and "tpu" not in (dev.device_kind or "").lower():
-        log(f"not a TPU ({dev.platform}); aborting")
-        flush()
-        return
-    # resume BEFORE the first flush (which overwrites OUT): keep configs
+
+    # resume BEFORE any flush (every flush overwrites OUT): keep configs
     # an earlier partial run already measured
     try:
         with open(OUT) as f:
@@ -174,6 +167,16 @@ def main():
             RESULTS["train_step"].update(prev.get("train_step", {}))
     except Exception:  # noqa: BLE001 — fresh start
         pass
+
+    dev = jax.devices()[0]
+    if dev.platform not in ("tpu", "axon") \
+            and "tpu" not in (dev.device_kind or "").lower():
+        # never flush here: an accidental CPU-shell invocation must not
+        # clobber hours of measured TPU data with an ok=false stub
+        log(f"not a TPU ({dev.platform}); aborting without writing")
+        return
+    RESULTS["device_kind"] = dev.device_kind or ""
+    RESULTS["platform"] = dev.platform
     RESULTS["ok"] = True
     flush()
 

@@ -72,6 +72,35 @@ def chunked_token_nll(x, w, targets, mask=None, chunk: int = 512,
     return total
 
 
+def chunked_token_logps(x, w, targets, chunk: int = 512,
+                        logit_softcap: float = 0.0):
+    """Per-TOKEN log P(target) [b, s] via the same chunked scan.
+
+    Token granularity is what ratio-based RL objectives need (GRPO's
+    importance weights, train/grpo.py) — [b, s] floats are cheap; it is
+    only the [b, s, V] logits that must never materialize."""
+    b, s, d = x.shape
+    chunk = max(1, min(chunk, s))
+    pad = (-s) % chunk
+    if pad:
+        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+        targets = jnp.pad(targets, ((0, 0), (0, pad)))
+    n = (s + pad) // chunk
+    xs = x.reshape(b, n, chunk, d).swapaxes(0, 1)
+    ts = targets.reshape(b, n, chunk).swapaxes(0, 1)
+
+    step_fn = jax.checkpoint(  # backward recomputes chunk logits
+        lambda xc, tc: -_chunk_nll(xc, w, tc, logit_softcap))
+
+    def step(_, inp):
+        xc, tc = inp
+        return None, step_fn(xc, tc)
+
+    _, chunks = jax.lax.scan(step, None, (xs, ts))  # [n, b, chunk]
+    out = chunks.swapaxes(0, 1).reshape(b, s + pad)
+    return out[:, :s]
+
+
 def chunked_softmax_xent(x, w, targets, mask=None, chunk: int = 512,
                          logit_softcap: float = 0.0):
     """Mean NLL over unmasked targets (scalar float32), exactly matching

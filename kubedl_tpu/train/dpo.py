@@ -32,6 +32,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 from functools import partial
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -74,6 +75,52 @@ def _hidden(config, params, tokens, mesh):
     return llama.forward_hidden(config, params, tokens, mesh=mesh), 0.0
 
 
+def hidden_and_head(config, params, tokens, mesh=None):
+    """Shared scorer front half for every sequence-level objective
+    (DPO / GRPO / eval): final hidden states, densified LM head, and the
+    MoE router aux loss (0 for dense families)."""
+    from ..ops.quant import to_dense
+    x, aux = _hidden(config, params, tokens, mesh)
+    head = to_dense(llama._lm_head(config, params), config.dtype)
+    return x, head, aux
+
+
+def render_rows(rows, prompt_lens, pad_id: int = 0,
+                pad_to: Optional[int] = None):
+    """Render tokenized prompt+completion rows into the one batch layout
+    every sequence-level objective shares: right-padded ``tokens``
+    (128-aligned), left-shifted ``targets``, and a ``mask`` covering
+    completion targets only (target index ``pl-1`` predicts the first
+    completion token).
+
+    The pl-1 arithmetic silently zeroes the mask when a prompt is empty
+    (wraps to -1) or a completion is empty — both rejected here, once,
+    for all callers (DPO pairs, GRPO rollouts, eval options)."""
+    import numpy as np
+
+    n = len(rows)
+    if len(prompt_lens) != n:
+        raise ValueError("rows and prompt_lens must have equal length")
+    if any(pl < 1 for pl in prompt_lens):
+        raise ValueError("prompt_lens must be >= 1 (include BOS)")
+    if any(pl >= len(r) for pl, r in zip(prompt_lens, rows)):
+        raise ValueError("every row needs completion tokens past its "
+                         "prompt_len")
+    longest = max(len(r) for r in rows)
+    s = pad_to or -(-longest // 128) * 128
+    if longest > s:
+        raise ValueError(f"pad_to={s} shorter than longest row {longest}")
+    toks = np.full((n, s), pad_id, np.int32)
+    tgts = np.full((n, s), pad_id, np.int32)
+    mask = np.zeros((n, s), np.float32)
+    for i, (row, pl) in enumerate(zip(rows, prompt_lens)):
+        row = np.asarray(row, np.int32)
+        toks[i, :len(row)] = row
+        tgts[i, :len(row) - 1] = row[1:]
+        mask[i, pl - 1:len(row) - 1] = 1.0
+    return {"tokens": toks, "targets": tgts, "mask": mask}
+
+
 def sequence_logprobs(config, params, tokens, targets, mask=None,
                       mesh=None, chunk: int = 512, with_aux: bool = False):
     """Summed log P(targets | tokens) per row: [b, s] -> [b] float32.
@@ -82,9 +129,7 @@ def sequence_logprobs(config, params, tokens, targets, mask=None,
     nothing). Uses the chunked LM-head scan, so peak logits HBM is
     b*chunk*V regardless of sequence length. ``with_aux=True`` also
     returns the MoE load-balancing aux loss (0 for dense families)."""
-    from ..ops.quant import to_dense
-    x, aux = _hidden(config, params, tokens, mesh)
-    head = to_dense(llama._lm_head(config, params), config.dtype)
+    x, head, aux = hidden_and_head(config, params, tokens, mesh)
     lp = -chunked_token_nll(x, head, targets, mask=mask, chunk=chunk,
                             logit_softcap=config.logit_softcap)
     return (lp, aux) if with_aux else lp
@@ -189,44 +234,16 @@ def preference_batch(prompt_and_chosen, prompt_and_rejected,
         the full prompt+completion token sequence.
       prompt_lens: per-pair prompt length (masked out of the loss).
 
-    Rows are right-padded to the longest sequence (multiple of 128 for
-    pallas alignment); targets are tokens shifted left; the mask covers
-    completion targets only."""
-    import numpy as np
-
+    Both sides render through the shared ``render_rows`` layout (right
+    pad to one 128-aligned length, shifted targets, completion-only
+    mask)."""
     n = len(prompt_and_chosen)
     if not (n == len(prompt_and_rejected) == len(prompt_lens)):
         raise ValueError("pair lists must have equal length")
-    if any(pl < 1 for pl in prompt_lens):
-        # target index pl-1 predicts the first completion token; a
-        # 0-length prompt would wrap to -1 and silently zero the mask
-        raise ValueError("prompt_lens must be >= 1 (include BOS)")
-    for pl, c, r in zip(prompt_lens, prompt_and_chosen,
-                        prompt_and_rejected):
-        if pl >= len(c) or pl >= len(r):
-            # an empty completion would also zero the mask silently,
-            # injecting a bogus 0.0 logp into the margin
-            raise ValueError(
-                f"pair has no completion tokens past prompt_len={pl} "
-                f"(row lengths {len(c)}/{len(r)})")
     longest = max(len(r) for r in prompt_and_chosen + prompt_and_rejected)
     s = -(-longest // 128) * 128
-
-    def render(rows):
-        toks = np.full((n, s), pad_id, np.int32)
-        tgts = np.full((n, s), pad_id, np.int32)
-        mask = np.zeros((n, s), np.float32)
-        for i, row in enumerate(rows):
-            row = np.asarray(row, np.int32)
-            toks[i, :len(row)] = row
-            tgts[i, :len(row) - 1] = row[1:]
-            # target index t predicts token t+1: completion targets
-            # start at prompt_len - 1
-            mask[i, prompt_lens[i] - 1:len(row) - 1] = 1.0
-        return toks, tgts, mask
-
-    ct, ctg, cm = render(prompt_and_chosen)
-    rt, rtg, rm = render(prompt_and_rejected)
-    return {"chosen_tokens": ct, "chosen_targets": ctg, "chosen_mask": cm,
-            "rejected_tokens": rt, "rejected_targets": rtg,
-            "rejected_mask": rm}
+    c = render_rows(prompt_and_chosen, prompt_lens, pad_id, pad_to=s)
+    r = render_rows(prompt_and_rejected, prompt_lens, pad_id, pad_to=s)
+    return {"chosen_tokens": c["tokens"], "chosen_targets": c["targets"],
+            "chosen_mask": c["mask"], "rejected_tokens": r["tokens"],
+            "rejected_targets": r["targets"], "rejected_mask": r["mask"]}

@@ -1,0 +1,112 @@
+"""Evaluation harness: perplexity and log-likelihood scoring.
+
+Two primitives cover the standard LM evaluation surface:
+
+* ``perplexity`` — mean next-token NLL (and its exp) over a batch
+  stream, with one jitted eval step reused across batches. Runs the
+  exact training loss path (chunked LM-head scan, family dispatch incl.
+  MoE), so eval numbers are comparable to training loss by construction.
+* ``loglikelihood_ranks`` — per-option summed log P(continuation |
+  prompt) for multiple-choice scoring (the lm-eval-harness
+  "loglikelihood" contract): render each (prompt, option) pair with
+  continuation-only masking, score with the chunked per-row scan,
+  argmax per question.
+
+No reference analog: the reference operator (mental2008/kubedl) has no
+compute stack (SURVEY.md §2); this is beyond-parity tooling for the
+in-tree TPU training path. TPU-first: one compiled step per (rows, seq)
+shape — options pad to a shared 128-aligned length so every question
+reuses the same executable.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Iterable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.loss import chunked_token_nll
+from .dpo import hidden_and_head, render_rows
+
+
+def make_row_nll_fn(config, mesh=None, chunk: int = 512):
+    """Jitted ``(params, batch) -> per-row summed NLL [b]`` over
+    ``{tokens, targets[, mask]}`` — the one compiled step both
+    evaluators share."""
+
+    def rows(params, batch):
+        x, head, _ = hidden_and_head(config, params, batch["tokens"],
+                                     mesh)
+        return chunked_token_nll(x, head, batch["targets"],
+                                 mask=batch.get("mask"), chunk=chunk,
+                                 logit_softcap=config.logit_softcap)
+
+    return jax.jit(rows)
+
+
+def perplexity(config, params, batches: Iterable[dict], mesh=None,
+               chunk: int = 512, max_batches: Optional[int] = None):
+    """Corpus perplexity over ``batches`` of ``{tokens, targets[, mask]}``.
+
+    Returns ``{nll, perplexity, tokens}`` (token count covers unmasked
+    targets only). One compile per distinct batch shape."""
+    row_nll = make_row_nll_fn(config, mesh, chunk)
+    total = 0.0
+    count = 0.0
+    for i, batch in enumerate(batches):
+        if max_batches is not None and i >= max_batches:
+            break
+        total += float(jnp.sum(row_nll(params, batch)))
+        mask = batch.get("mask")
+        count += (float(jnp.sum(mask)) if mask is not None
+                  else batch["tokens"].shape[0] * batch["tokens"].shape[1])
+    if count == 0:
+        raise ValueError("no target tokens evaluated")
+    nll = total / count
+    return {"nll": nll, "perplexity": math.exp(min(nll, 80.0)),
+            "tokens": int(count)}
+
+
+def _render_options(prompt, options, pad_to: int, pad_id: int):
+    """Each option row renders through the shared completion layout."""
+    rows = [list(prompt) + list(opt) for opt in options]
+    b = render_rows(rows, [len(prompt)] * len(options), pad_id,
+                    pad_to=pad_to)
+    return {k: jnp.asarray(v) for k, v in b.items()}
+
+
+def loglikelihood_ranks(config, params, questions: Sequence[dict],
+                        mesh=None, chunk: int = 512, pad_id: int = 0,
+                        length_normalize: bool = False):
+    """Score multiple-choice questions by continuation log-likelihood.
+
+    ``questions``: each ``{"prompt": [ids], "options": [[ids], ...]}``
+    (prompt and every option non-empty). Returns per question
+    ``{"logps": [...], "choice": argmax}``; ``length_normalize`` divides
+    each option's logp by its token count (lm-eval-harness "acc_norm").
+    Questions with the same option count share one executable."""
+    if not questions:
+        return []
+    for q in questions:
+        if len(q["prompt"]) < 1:
+            raise ValueError("prompt must include at least one token")
+        if any(len(o) < 1 for o in q["options"]):
+            raise ValueError("options must be non-empty")
+    longest = max(len(q["prompt"]) + len(o)
+                  for q in questions for o in q["options"])
+    pad_to = -(-longest // 128) * 128
+    row_nll = make_row_nll_fn(config, mesh, chunk)
+
+    out = []
+    for q in questions:
+        batch = _render_options(q["prompt"], q["options"], pad_to, pad_id)
+        logps = -np.asarray(row_nll(params, batch), np.float32)
+        if length_normalize:
+            logps = logps / np.array([len(o) for o in q["options"]],
+                                     np.float32)
+        out.append({"logps": [float(v) for v in logps],
+                    "choice": int(np.argmax(logps))})
+    return out

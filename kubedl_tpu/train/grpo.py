@@ -1,0 +1,166 @@
+"""GRPO — group-relative policy optimization (RL from verifiable rewards).
+
+The critic-free PPO variant used for reasoning post-training (Shao et
+al. 2024, DeepSeekMath): sample a GROUP of completions per prompt, score
+them with a programmatic reward, normalize rewards within each group
+into advantages (no value network), and update with a token-level
+clipped importance-ratio objective plus a KL penalty to a frozen
+reference.
+
+TPU-first shape choices, matching the rest of ``train/``:
+
+* per-token log-probabilities come from ``forward_hidden`` + the chunked
+  LM-head scan (``ops.loss.chunked_token_logps``): [b, s] floats are
+  cheap, the [b, s, V] logits never materialize;
+* rollouts come from the in-tree serving engine
+  (``serving.engine.InferenceEngine.generate(return_logprobs=True)``),
+  whose sampled-token logprobs ARE the behavior-policy term — no second
+  scoring pass over the rollout batch;
+* the update is a plain ``Trainer`` loss function: the same sharded,
+  jitted, donated step as pre-training, DPO, and LoRA.
+
+No reference-repo analog (the reference operator has no training stack,
+SURVEY.md §2); beyond-parity compute for the in-tree TPU path.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.loss import chunked_token_logps
+from .dpo import hidden_and_head, render_rows
+
+
+@dataclass(frozen=True)
+class GRPOConfig:
+    #: completions sampled per prompt (the "group")
+    group_size: int = 8
+    #: PPO clip width for the token importance ratio
+    clip_eps: float = 0.2
+    #: weight of the k3 KL penalty to the frozen reference
+    kl_coef: float = 0.04
+    #: divide group-centered rewards by the group std (classic GRPO);
+    #: False = center only (the "Dr. GRPO" debiasing)
+    normalize_std: bool = True
+
+    def __post_init__(self):
+        if self.group_size < 2:
+            raise ValueError("group_size must be >= 2 (advantages are "
+                             "relative within a group)")
+        if self.clip_eps <= 0:
+            raise ValueError("clip_eps must be > 0")
+        if self.kl_coef < 0:
+            raise ValueError("kl_coef must be >= 0")
+
+
+def group_advantages(rewards, cfg: GRPOConfig = GRPOConfig()):
+    """[n_groups, group_size] rewards -> same-shape advantages.
+
+    Center within each group; optionally scale by the group std. A
+    group whose rewards are all equal gets exactly zero advantage
+    (epsilon guard, no NaN)."""
+    r = jnp.asarray(rewards, jnp.float32)
+    if r.ndim != 2:
+        raise ValueError(f"rewards must be [n_groups, group_size], got "
+                         f"shape {r.shape}")
+    centered = r - jnp.mean(r, axis=1, keepdims=True)
+    if cfg.normalize_std:
+        centered = centered / (jnp.std(r, axis=1, keepdims=True) + 1e-6)
+    return centered
+
+
+def token_logps(config, params, tokens, targets, mesh=None,
+                chunk: int = 512, with_aux: bool = False):
+    """Per-token log P(targets | tokens): [b, s] float32 (any family).
+    ``with_aux=True`` also returns the MoE router aux loss (0 dense)."""
+    x, head, aux = hidden_and_head(config, params, tokens, mesh)
+    lp = chunked_token_logps(x, head, targets, chunk=chunk,
+                             logit_softcap=config.logit_softcap)
+    return (lp, aux) if with_aux else lp
+
+
+def grpo_loss(logps, old_logps, ref_logps, advantages, mask,
+              cfg: GRPOConfig = GRPOConfig()):
+    """Token-level clipped surrogate + KL penalty.
+
+    Args: logps/old_logps/ref_logps [b, s] (policy, behavior, frozen
+    reference); advantages [b] (one per completion); mask [b, s] over
+    completion tokens. Returns (loss, metrics)."""
+    adv = advantages[:, None].astype(jnp.float32)
+    mask = mask.astype(jnp.float32)
+    denom = jnp.maximum(jnp.sum(mask), 1.0)
+
+    log_ratio = logps - jax.lax.stop_gradient(old_logps)
+    ratio = jnp.exp(log_ratio)
+    clipped = jnp.clip(ratio, 1.0 - cfg.clip_eps, 1.0 + cfg.clip_eps)
+    pg = -jnp.minimum(ratio * adv, clipped * adv)
+
+    # k3 estimator: non-negative, unbiased in expectation
+    ref_delta = jax.lax.stop_gradient(ref_logps) - logps
+    kl = jnp.exp(ref_delta) - ref_delta - 1.0
+
+    loss = jnp.sum((pg + cfg.kl_coef * kl) * mask) / denom
+    metrics = {
+        "kl": jnp.sum(kl * mask) / denom,
+        "clip_frac": jnp.sum(
+            (jnp.abs(ratio - 1.0) > cfg.clip_eps) * mask) / denom,
+        "ratio_mean": jnp.sum(ratio * mask) / denom,
+        "reward_advantage_mean": jnp.mean(advantages),
+    }
+    return loss, metrics
+
+
+def make_grpo_loss_fn(config, grpo: GRPOConfig = GRPOConfig(),
+                      mesh=None, chunk: int = 512):
+    """Build ``loss_fn(params, batch) -> scalar`` for ``train.Trainer``.
+
+    Batch keys: ``tokens``/``targets``/``mask`` [b, s],
+    ``advantages`` [b], ``old_logps``/``ref_logps`` [b, s] (behavior and
+    reference logps are data — precomputed, never differentiated)."""
+
+    def loss_fn(params, batch):
+        lp, aux = token_logps(config, params, batch["tokens"],
+                              batch["targets"], mesh=mesh, chunk=chunk,
+                              with_aux=True)
+        loss, _ = grpo_loss(lp, batch["old_logps"], batch["ref_logps"],
+                            batch["advantages"], batch["mask"], grpo)
+        # MoE: keep the router balanced through RL too (matches DPO)
+        aux_w = getattr(config, "aux_loss_weight", 0.0)
+        return loss + aux_w * aux
+
+    return loss_fn
+
+
+def rollout_batch(engine, prompts, reward_fn, max_new_tokens: int,
+                  cfg: GRPOConfig = GRPOConfig(), seed: int = 0,
+                  pad_id: int = 0):
+    """Sample a group of completions per prompt and assemble the GRPO
+    update batch.
+
+    ``engine`` is a ``serving.engine.InferenceEngine`` holding the
+    CURRENT policy weights; its sampled-token logprobs become
+    ``old_logps``. ``reward_fn(prompt_ids, completion_ids) -> float`` is
+    the verifiable reward. Returns the batch dict (numpy, 128-aligned)
+    WITHOUT ``ref_logps`` — score it with ``token_logps`` under the
+    frozen reference, then pass to the trainer."""
+    groups = [list(p) for p in prompts for _ in range(cfg.group_size)]
+    outs = engine.generate(groups, max_new_tokens, seed=seed,
+                           return_logprobs=True)
+    rewards = np.asarray(
+        [reward_fn(groups[i], ids) for i, (ids, _) in enumerate(outs)],
+        np.float32).reshape(len(prompts), cfg.group_size)
+    adv = np.asarray(group_advantages(rewards, cfg))
+
+    rows = [p + list(ids) for p, (ids, _) in zip(groups, outs)]
+    batch = render_rows(rows, [len(p) for p in groups], pad_id)
+    old = np.zeros_like(batch["mask"])
+    for i, (p, (ids, lps)) in enumerate(zip(groups, outs)):
+        pl = len(p)
+        old[i, pl - 1:pl - 1 + len(ids)] = np.asarray(lps, np.float32)
+    batch.update(old_logps=old, advantages=adv.reshape(-1),
+                 rewards=rewards)
+    return batch

@@ -75,7 +75,7 @@ def test_dpo_config_validation():
 
 
 def test_preference_batch_rejects_empty_completion():
-    with pytest.raises(ValueError, match="no completion"):
+    with pytest.raises(ValueError, match="completion"):
         dpo.preference_batch([[1, 2]], [[1, 3, 4]], [2])
 
 

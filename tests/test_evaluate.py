@@ -1,0 +1,96 @@
+"""Eval harness: perplexity math and loglikelihood multiple-choice
+scoring against dense recomputation (kubedl_tpu/train/evaluate.py)."""
+
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubedl_tpu.models import llama
+from kubedl_tpu.train import evaluate
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = dataclasses.replace(llama.tiny(vocab=64), dtype=jnp.float32)
+    return cfg, llama.init_params(cfg, jax.random.PRNGKey(0))
+
+
+def _dense_nll(cfg, params, tokens, targets, mask=None):
+    logits = llama.forward(cfg, params, tokens)
+    lsm = jax.nn.log_softmax(logits, axis=-1)
+    gold = jnp.take_along_axis(lsm, targets[..., None], axis=-1)[..., 0]
+    if mask is None:
+        mask = jnp.ones_like(gold)
+    return -jnp.sum(gold * mask), jnp.sum(mask)
+
+
+def test_perplexity_matches_dense(tiny_model):
+    cfg, params = tiny_model
+    key = jax.random.PRNGKey(1)
+    batches = []
+    want_total, want_count = 0.0, 0.0
+    for i in range(3):
+        k = jax.random.fold_in(key, i)
+        tokens = jax.random.randint(k, (2, 32), 0, cfg.vocab_size)
+        targets = jnp.roll(tokens, -1, axis=1)
+        batches.append({"tokens": tokens, "targets": targets})
+        t, n = _dense_nll(cfg, params, tokens, targets)
+        want_total += float(t)
+        want_count += float(n)
+    got = evaluate.perplexity(cfg, params, iter(batches), chunk=16)
+    want_nll = want_total / want_count
+    assert abs(got["nll"] - want_nll) < 1e-4
+    assert abs(got["perplexity"] - math.exp(want_nll)) < 1e-2
+    assert got["tokens"] == int(want_count)
+
+
+def test_perplexity_max_batches_and_empty(tiny_model):
+    cfg, params = tiny_model
+    tokens = jnp.zeros((1, 32), jnp.int32)
+    b = {"tokens": tokens, "targets": tokens}
+    r = evaluate.perplexity(cfg, params, iter([b, b, b]), max_batches=2)
+    assert r["tokens"] == 64  # 2 batches x 32
+    with pytest.raises(ValueError, match="no target"):
+        evaluate.perplexity(cfg, params, iter([]))
+
+
+def test_loglikelihood_prefers_trained_continuation(tiny_model):
+    """The ranked logps must equal dense per-option scoring, and a
+    continuation the model assigns higher probability must win."""
+    cfg, params = tiny_model
+    qs = [{"prompt": [1, 2, 3], "options": [[10, 11], [12], [13, 14, 15]]}]
+    res = evaluate.loglikelihood_ranks(cfg, params, qs, chunk=16)
+    assert len(res) == 1 and len(res[0]["logps"]) == 3
+
+    # dense recomputation of option 0
+    row = jnp.asarray([[1, 2, 3, 10, 11] + [0] * 123])
+    tgt = jnp.asarray([[2, 3, 10, 11] + [0] * 124])
+    mask = jnp.zeros((1, 128)).at[0, 2:4].set(1.0)
+    t, _ = _dense_nll(cfg, params, row, tgt, mask)
+    assert abs(res[0]["logps"][0] - float(-t)) < 1e-4
+    assert res[0]["choice"] == int(np.argmax(res[0]["logps"]))
+
+
+def test_loglikelihood_length_normalize(tiny_model):
+    cfg, params = tiny_model
+    qs = [{"prompt": [1], "options": [[5, 5, 5, 5], [7]]}]
+    raw = evaluate.loglikelihood_ranks(cfg, params, qs)
+    norm = evaluate.loglikelihood_ranks(cfg, params, qs,
+                                        length_normalize=True)
+    assert abs(norm[0]["logps"][0] - raw[0]["logps"][0] / 4.0) < 1e-6
+    assert abs(norm[0]["logps"][1] - raw[0]["logps"][1]) < 1e-6
+
+
+def test_loglikelihood_validation(tiny_model):
+    cfg, params = tiny_model
+    assert evaluate.loglikelihood_ranks(cfg, params, []) == []
+    with pytest.raises(ValueError, match="prompt"):
+        evaluate.loglikelihood_ranks(cfg, params,
+                                     [{"prompt": [], "options": [[1]]}])
+    with pytest.raises(ValueError, match="options"):
+        evaluate.loglikelihood_ranks(cfg, params,
+                                     [{"prompt": [1], "options": [[]]}])
