@@ -668,6 +668,19 @@ class JobEngine(Reconciler):
                 worker_hostnames=plan.global_dns,
                 coordinator_address=f"{plan.global_dns[0]}:{pl.DEFAULT_COORDINATOR_PORT}")
 
+        # job self-identity env: lets in-container agents (the elastic
+        # checkpoint half of the 2-phase protocol, train/checkpoint.py
+        # ElasticCheckpointAgent; python -m kubedl_tpu.train) find their
+        # own CR without guessing from pod labels
+        for container in m.get_in(pod, "spec", "containers",
+                                  default=[]) or []:
+            env = container.setdefault("env", [])
+            for k, v in (("KUBEDL_JOB_KIND", self.kind),
+                         ("KUBEDL_JOB_NAMESPACE", m.namespace(job)),
+                         ("KUBEDL_JOB_NAME", m.name(job))):
+                if not any(e.get("name") == k for e in env):
+                    env.append({"name": k, "value": v})
+
         # framework-specific rendezvous on top (THE plugin seam)
         self.controller.set_cluster_spec(job, pod, rtype, index)
 

@@ -1,0 +1,279 @@
+"""Training container entrypoint: ``python -m kubedl_tpu.train``.
+
+The training-side twin of ``python -m kubedl_tpu.serving``: a JAXJob /
+PyTorchJob container can run a full config-driven training job — model
+preset, data source, parallelism mesh, checkpointing, elastic protocol,
+model export — without shipping its own train.py. Everything the
+operator injects is honored:
+
+* rendezvous env (``TPU_WORKER_ID``/``TPU_WORKER_HOSTNAMES``/
+  coordinator) initializes ``jax.distributed`` via
+  ``runtime.bootstrap`` (multi-host slices rendezvous exactly as the
+  controller rendered them, SURVEY.md §2-P);
+* ``KUBEDL_MODEL_PATH`` (the ModelVersion artifact volume the engine
+  mounts on success-tracked jobs) receives the final exported model, so
+  `job succeeds -> ModelVersion -> Kaniko image -> Inference predictor`
+  composes end to end;
+* the 2-phase elastic checkpoint protocol runs when the job coordinates
+  are present (``KUBEDL_JOB_KIND/NAMESPACE/NAME`` + an in-cluster
+  api-server): ``ElasticCheckpointAgent`` answers
+  ``kubedl.io/ckpt-requested-version`` between steps.
+
+Config is JSON — ``--config /path.json``, or inline in
+``$KUBEDL_TRAIN_CONFIG``:
+
+    {"model": "llama.tiny", "mode": "pretrain",
+     "data": {"kind": "synthetic"},
+     "batch": 8, "seq": 256, "steps": 200,
+     "mesh": {"dp": 2, "fsdp": -1},
+     "optimizer": {"learning_rate": 3e-4},
+     "checkpoint": {"directory": "/ckpt", "save_interval_steps": 50}}
+
+``model`` is ``family.preset`` (``llama.llama3_8b``, ``gemma.gemma_2b``,
+``moe.mixtral_8x7b``, every zero-arg constructor in those modules), or
+``{"model_path": dir}`` to fine-tune a saved artifact;
+``model_overrides`` tweaks any config field. ``mode`` is ``pretrain``
+(next-token loss; data ``synthetic`` or a ``tokens`` memmap file) or
+``dpo`` (preference pairs from JSONL rows
+``{"chosen": [...], "rejected": [...], "prompt_len": n}``, frozen
+initial weights as the DPO reference).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import logging
+import os
+import sys
+
+log = logging.getLogger("kubedl.train")
+
+#: model families the preset resolver may import from
+_FAMILIES = ("llama", "gemma", "moe")
+
+
+def load_config(argv=None) -> dict:
+    p = argparse.ArgumentParser(prog="python -m kubedl_tpu.train")
+    p.add_argument("--config", help="path to the JSON training config")
+    args = p.parse_args(argv)
+    if args.config:
+        with open(args.config) as f:
+            return json.load(f)
+    raw = os.environ.get("KUBEDL_TRAIN_CONFIG", "")
+    if not raw:
+        raise SystemExit(
+            "no config: pass --config FILE or set $KUBEDL_TRAIN_CONFIG")
+    return json.loads(raw)
+
+
+def resolve_model(cfg: dict):
+    """``model`` -> (config, params-or-None). Params come back non-None
+    only for ``model_path`` artifacts (fine-tuning)."""
+    import importlib
+
+    model = cfg.get("model", "llama.tiny")
+    if isinstance(model, dict):
+        from ..models.io import load_model
+        config, params = load_model(model["model_path"])
+    else:
+        fam, _, preset = model.partition(".")
+        if fam not in _FAMILIES or not preset:
+            raise ValueError(
+                f"model must be one of {_FAMILIES} as 'family.preset', "
+                f"or {{'model_path': dir}}; got {model!r}")
+        mod = importlib.import_module(f"kubedl_tpu.models.{fam}")
+        try:
+            ctor = getattr(mod, preset)
+        except AttributeError:
+            raise ValueError(f"unknown preset {preset!r} in "
+                             f"models.{fam}") from None
+        config, params = ctor(), None
+    if cfg.get("model_overrides"):
+        config = dataclasses.replace(config, **cfg["model_overrides"])
+    if getattr(config, "loss_chunk", 0) == 0 \
+            and "loss_chunk" not in cfg.get("model_overrides", {}):
+        # presets default loss_chunk=0 (naive [b, s, V] logits) — at
+        # real vocab sizes that is tens of GB; the entrypoint always
+        # takes the chunked LM-head scan unless explicitly overridden
+        config = dataclasses.replace(config, loss_chunk=512)
+    return config, params
+
+
+def data_stream(cfg: dict, config, mesh, batch: int, seq: int):
+    """Pretrain batch iterator per the ``data`` section."""
+    import jax
+
+    from .data import (TokenFileDataset, prefetch_to_device,
+                       synthetic_lm_batches)
+
+    data = cfg.get("data", {"kind": "synthetic"})
+    kind = data.get("kind", "synthetic")
+    if kind == "synthetic":
+        raw = synthetic_lm_batches(batch, seq, config.vocab_size,
+                                   seed=data.get("seed", 0))
+    elif kind == "tokens":
+        raw = TokenFileDataset(
+            data["path"], seq, batch,
+            process_index=jax.process_index(),
+            process_count=jax.process_count(),
+            seed=data.get("seed", 0)).batches()
+    else:
+        raise ValueError(f"unknown data kind {kind!r} for pretrain")
+    return prefetch_to_device(raw, mesh, size=2)
+
+
+def dpo_batches(cfg: dict, config, params, mesh, batch: int):
+    """Infinite DPO batch stream from a pairs JSONL, reference logps
+    precomputed once per batch under the FROZEN initial weights."""
+    import jax.numpy as jnp
+
+    from . import dpo
+    from .data import shard_batch
+
+    data = cfg.get("data", {})
+    if data.get("kind") != "dpo_jsonl":
+        raise ValueError("mode=dpo needs data.kind='dpo_jsonl'")
+    rows = []
+    with open(data["path"]) as f:
+        for line in f:
+            if line.strip():
+                rows.append(json.loads(line))
+    if len(rows) < batch:
+        raise ValueError(f"{len(rows)} pairs < batch {batch}")
+    ref_fn = dpo.reference_logps_fn(config, params, mesh=mesh)
+
+    def stream():
+        i = 0
+        while True:
+            chunk = [rows[(i + j) % len(rows)] for j in range(batch)]
+            i = (i + batch) % len(rows)
+            b = dpo.preference_batch(
+                [r["chosen"] for r in chunk],
+                [r["rejected"] for r in chunk],
+                [r["prompt_len"] for r in chunk])
+            b = {k: jnp.asarray(v) for k, v in b.items()}
+            ref_c, ref_r = ref_fn(b)
+            b["ref_chosen_logps"] = ref_c
+            b["ref_rejected_logps"] = ref_r
+            yield shard_batch(b, mesh)
+
+    return stream()
+
+
+def _maybe_elastic_agent(manager):
+    """ElasticCheckpointAgent when the operator injected job coordinates
+    and an api-server is reachable; None otherwise (standalone runs)."""
+    kind = os.environ.get("KUBEDL_JOB_KIND", "")
+    ns = os.environ.get("KUBEDL_JOB_NAMESPACE", "")
+    name = os.environ.get("KUBEDL_JOB_NAME", "")
+    if not (kind and ns and name and manager):
+        return None
+    if not os.environ.get("KUBERNETES_SERVICE_HOST"):
+        return None
+    from ..core.kubeclient import ClusterConfig, KubeAPIServer
+    from .checkpoint import ElasticCheckpointAgent
+    api = KubeAPIServer(ClusterConfig.in_cluster())
+    return ElasticCheckpointAgent(api, kind, ns, name, manager)
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    cfg = load_config(argv)
+
+    from ..runtime import bootstrap
+    want = os.environ.get("JAX_PLATFORMS", "")
+    if want:
+        # the image may pre-initialize jax on the accelerator platform
+        # (sitecustomize); an explicit JAX_PLATFORMS (cpu smoke runs)
+        # must still win after that
+        bootstrap.pin_platform(want)
+    info = bootstrap.rendezvous_from_env()
+    if info is not None and info.is_distributed:
+        bootstrap.initialize_distributed(info)
+
+    import jax
+
+    from ..models import llama, moe
+    from ..parallel.mesh import MeshConfig, build_mesh
+    from .trainer import TrainConfig, Trainer
+
+    config, loaded_params = resolve_model(cfg)
+    family = moe if isinstance(config, moe.MoEConfig) else llama
+    mesh = build_mesh(MeshConfig(**cfg.get("mesh", {})))
+    batch = int(cfg.get("batch", 8))
+    seq = int(cfg.get("seq", min(getattr(config, "max_seq_len", 1024),
+                                 1024)))
+    steps = int(cfg.get("steps", 100))
+    log.info("model=%s params=%.2fM mesh=%s mode=%s", cfg.get("model"),
+             config.num_params / 1e6, dict(mesh.shape),
+             cfg.get("mode", "pretrain"))
+
+    if loaded_params is None:
+        params = jax.jit(lambda k: family.init_params(config, k))(
+            jax.random.PRNGKey(int(cfg.get("seed", 0))))
+    else:
+        params = loaded_params
+
+    mode = cfg.get("mode", "pretrain")
+    if mode == "pretrain":
+        def loss_fn(p, b):
+            return family.loss_fn(config, p, b["tokens"], b["targets"],
+                                  mesh=mesh)
+        batches = data_stream(cfg, config, mesh, batch, seq)
+    elif mode == "dpo":
+        import jax.numpy as jnp
+
+        from . import dpo as dpo_mod
+        dcfg = dpo_mod.DPOConfig(**cfg.get("dpo", {}))
+        loss_fn = dpo_mod.make_dpo_loss_fn(config, dcfg, mesh=mesh)
+        # the frozen DPO reference is the INITIAL weights — copy them:
+        # init_state/step donate the originals into the train state
+        ref_params = jax.tree.map(jnp.copy, params)
+        batches = dpo_batches(cfg, config, ref_params, mesh, batch)
+    else:
+        raise ValueError(f"unknown mode {mode!r}")
+
+    opt = cfg.get("optimizer", {})
+    trainer = Trainer(loss_fn, family.param_specs(config), mesh,
+                      TrainConfig(**opt))
+    state = trainer.init_state(params)
+
+    manager = None
+    ck = cfg.get("checkpoint")
+    if ck:
+        from .checkpoint import CheckpointConfig, CheckpointManager
+        manager = CheckpointManager(CheckpointConfig(**ck))
+        state = manager.restore_or(trainer.abstract_state(state),
+                                   lambda: state)
+        if manager.latest_step():
+            log.info("resumed from checkpoint step %s",
+                     manager.latest_step())
+
+    state = trainer.fit(state, batches, num_steps=steps,
+                        log_every=int(cfg.get("log_every", 10)),
+                        checkpoint_manager=manager,
+                        elastic_agent=_maybe_elastic_agent(manager))
+
+    export = cfg.get("export_path") or os.environ.get("KUBEDL_MODEL_PATH")
+    if export:
+        # fsdp-sharded params span non-addressable devices on multi-host
+        # runs: device_get on process 0 alone would raise. All hosts
+        # join the allgather; only process 0 touches the filesystem.
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            host_params = multihost_utils.process_allgather(state.params)
+        else:
+            host_params = jax.device_get(state.params)
+        if jax.process_index() == 0:
+            from ..models.io import save_model
+            save_model(config, host_params, export)
+            log.info("exported model to %s", export)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -39,6 +39,7 @@ import jax.numpy as jnp
 
 from ..models import llama
 from ..ops.loss import chunked_token_nll
+from .scoring import hidden_and_head, render_rows  # noqa: F401 — re-exported
 
 
 @dataclass(frozen=True)
@@ -63,62 +64,6 @@ class DPOConfig:
             raise ValueError(
                 "IPO has no label-smoothing term; it would be silently "
                 "ignored — use loss_type='sigmoid' for cDPO")
-
-
-def _hidden(config, params, tokens, mesh):
-    """Family dispatch: final hidden states + router aux loss (0 for
-    dense families; MoEConfig subclasses LlamaConfig so isinstance picks
-    the sparse path)."""
-    from ..models import moe
-    if isinstance(config, moe.MoEConfig):
-        return moe.forward_hidden(config, params, tokens, mesh=mesh)
-    return llama.forward_hidden(config, params, tokens, mesh=mesh), 0.0
-
-
-def hidden_and_head(config, params, tokens, mesh=None):
-    """Shared scorer front half for every sequence-level objective
-    (DPO / GRPO / eval): final hidden states, densified LM head, and the
-    MoE router aux loss (0 for dense families)."""
-    from ..ops.quant import to_dense
-    x, aux = _hidden(config, params, tokens, mesh)
-    head = to_dense(llama._lm_head(config, params), config.dtype)
-    return x, head, aux
-
-
-def render_rows(rows, prompt_lens, pad_id: int = 0,
-                pad_to: Optional[int] = None):
-    """Render tokenized prompt+completion rows into the one batch layout
-    every sequence-level objective shares: right-padded ``tokens``
-    (128-aligned), left-shifted ``targets``, and a ``mask`` covering
-    completion targets only (target index ``pl-1`` predicts the first
-    completion token).
-
-    The pl-1 arithmetic silently zeroes the mask when a prompt is empty
-    (wraps to -1) or a completion is empty — both rejected here, once,
-    for all callers (DPO pairs, GRPO rollouts, eval options)."""
-    import numpy as np
-
-    n = len(rows)
-    if len(prompt_lens) != n:
-        raise ValueError("rows and prompt_lens must have equal length")
-    if any(pl < 1 for pl in prompt_lens):
-        raise ValueError("prompt_lens must be >= 1 (include BOS)")
-    if any(pl >= len(r) for pl, r in zip(prompt_lens, rows)):
-        raise ValueError("every row needs completion tokens past its "
-                         "prompt_len")
-    longest = max(len(r) for r in rows)
-    s = pad_to or -(-longest // 128) * 128
-    if longest > s:
-        raise ValueError(f"pad_to={s} shorter than longest row {longest}")
-    toks = np.full((n, s), pad_id, np.int32)
-    tgts = np.full((n, s), pad_id, np.int32)
-    mask = np.zeros((n, s), np.float32)
-    for i, (row, pl) in enumerate(zip(rows, prompt_lens)):
-        row = np.asarray(row, np.int32)
-        toks[i, :len(row)] = row
-        tgts[i, :len(row) - 1] = row[1:]
-        mask[i, pl - 1:len(row) - 1] = 1.0
-    return {"tokens": toks, "targets": tgts, "mask": mask}
 
 
 def sequence_logprobs(config, params, tokens, targets, mask=None,

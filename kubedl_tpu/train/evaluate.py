@@ -29,7 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops.loss import chunked_token_nll
-from .dpo import hidden_and_head, render_rows
+from .scoring import hidden_and_head, render_rows
 
 
 def make_row_nll_fn(config, mesh=None, chunk: int = 512):
@@ -53,12 +53,16 @@ def perplexity(config, params, batches: Iterable[dict], mesh=None,
 
     Returns ``{nll, perplexity, tokens}`` (token count covers unmasked
     targets only). One compile per distinct batch shape."""
+    import itertools
+
     row_nll = make_row_nll_fn(config, mesh, chunk)
     total = 0.0
     count = 0.0
-    for i, batch in enumerate(batches):
-        if max_batches is not None and i >= max_batches:
-            break
+    if max_batches is not None:
+        # islice, not a loop-break: a break after enumerate would pull
+        # (and shard, and transfer) one extra batch just to discard it
+        batches = itertools.islice(batches, max_batches)
+    for batch in batches:
         total += float(jnp.sum(row_nll(params, batch)))
         mask = batch.get("mask")
         count += (float(jnp.sum(mask)) if mask is not None

@@ -32,7 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..ops.loss import chunked_token_logps
-from .dpo import hidden_and_head, render_rows
+from .scoring import hidden_and_head, render_rows
 
 
 @dataclass(frozen=True)
@@ -147,6 +147,18 @@ def rollout_batch(engine, prompts, reward_fn, max_new_tokens: int,
     the verifiable reward. Returns the batch dict (numpy, 128-aligned)
     WITHOUT ``ref_logps`` — score it with ``token_logps`` under the
     frozen reference, then pass to the trainer."""
+    gen = getattr(engine, "gen", None)
+    if gen is not None:
+        # the engine reports FULL-softmax logprobs (token_logprobs is
+        # deliberately sampling-agnostic); they equal the behavior
+        # policy only under plain temperature-1 sampling. Greedy would
+        # additionally make every group identical -> all advantages 0.
+        if gen.temperature != 1.0 or gen.top_k or gen.top_p != 1.0:
+            raise ValueError(
+                "GRPO rollouts need plain sampling (temperature=1, no "
+                f"top_k/top_p) so reported logprobs ARE the behavior "
+                f"policy; engine has temperature={gen.temperature}, "
+                f"top_k={gen.top_k}, top_p={gen.top_p}")
     groups = [list(p) for p in prompts for _ in range(cfg.group_size)]
     outs = engine.generate(groups, max_new_tokens, seed=seed,
                            return_logprobs=True)

@@ -1,0 +1,75 @@
+"""Shared scoring primitives for sequence-level objectives.
+
+Every post-training objective (DPO pairs, GRPO rollouts, eval options)
+needs the same two pieces; they live here — neutral ground — so
+eval-only or RL-only users don't transitively depend on the DPO module:
+
+* :func:`hidden_and_head` — family-dispatched forward to final hidden
+  states + densified LM head (+ MoE router aux loss), the front half of
+  every chunked logprob scan;
+* :func:`render_rows` — the one prompt/completion batch layout
+  (right-padded 128-aligned tokens, left-shifted targets,
+  completion-only mask) with the pl-1 mask arithmetic validated once
+  for all callers.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..models import llama
+
+
+def _hidden(config, params, tokens, mesh):
+    """Family dispatch: final hidden states + router aux loss (0 for
+    dense families; MoEConfig subclasses LlamaConfig so isinstance picks
+    the sparse path)."""
+    from ..models import moe
+    if isinstance(config, moe.MoEConfig):
+        return moe.forward_hidden(config, params, tokens, mesh=mesh)
+    return llama.forward_hidden(config, params, tokens, mesh=mesh), 0.0
+
+
+def hidden_and_head(config, params, tokens, mesh=None):
+    """Final hidden states, densified LM head, and the MoE router aux
+    loss (0 for dense families)."""
+    from ..ops.quant import to_dense
+    x, aux = _hidden(config, params, tokens, mesh)
+    head = to_dense(llama._lm_head(config, params), config.dtype)
+    return x, head, aux
+
+
+def render_rows(rows, prompt_lens, pad_id: int = 0,
+                pad_to: Optional[int] = None):
+    """Render tokenized prompt+completion rows into the one batch layout
+    every sequence-level objective shares: right-padded ``tokens``
+    (128-aligned), left-shifted ``targets``, and a ``mask`` covering
+    completion targets only (target index ``pl-1`` predicts the first
+    completion token).
+
+    The pl-1 arithmetic silently zeroes the mask when a prompt is empty
+    (wraps to -1) or a completion is empty — both rejected here, once,
+    for all callers (DPO pairs, GRPO rollouts, eval options)."""
+    import numpy as np
+
+    n = len(rows)
+    if len(prompt_lens) != n:
+        raise ValueError("rows and prompt_lens must have equal length")
+    if any(pl < 1 for pl in prompt_lens):
+        raise ValueError("prompt_lens must be >= 1 (include BOS)")
+    if any(pl >= len(r) for pl, r in zip(prompt_lens, rows)):
+        raise ValueError("every row needs completion tokens past its "
+                         "prompt_len")
+    longest = max(len(r) for r in rows)
+    s = pad_to or -(-longest // 128) * 128
+    if longest > s:
+        raise ValueError(f"pad_to={s} shorter than longest row {longest}")
+    toks = np.full((n, s), pad_id, np.int32)
+    tgts = np.full((n, s), pad_id, np.int32)
+    mask = np.zeros((n, s), np.float32)
+    for i, (row, pl) in enumerate(zip(rows, prompt_lens)):
+        row = np.asarray(row, np.int32)
+        toks[i, :len(row)] = row
+        tgts[i, :len(row) - 1] = row[1:]
+        mask[i, pl - 1:len(row) - 1] = 1.0
+    return {"tokens": toks, "targets": tgts, "mask": mask}

@@ -191,6 +191,19 @@ def test_scale_in_deletes_out_of_range(api, manager, engine):
     assert sorted(m.name(s) for s in api.list("Service")) == ["tj-worker-0"]
 
 
+def test_pods_carry_job_identity_env(api, manager, engine):
+    """Every container gets KUBEDL_JOB_KIND/NAMESPACE/NAME so in-pod
+    agents (elastic checkpoint, python -m kubedl_tpu.train) can find
+    their own CR."""
+    api.create(new_test_job("tj", workers=1))
+    reconcile(manager)
+    ct = api.get("Pod", "default", "tj-worker-0")["spec"]["containers"][0]
+    env = {e["name"]: e.get("value") for e in ct["env"]}
+    assert env["KUBEDL_JOB_KIND"] == "TestJob"
+    assert env["KUBEDL_JOB_NAMESPACE"] == "default"
+    assert env["KUBEDL_JOB_NAME"] == "tj"
+
+
 def test_tpu_policy_renders_and_gangs_per_slice(api, manager, engine):
     api.create(new_test_job("tj", workers=4,
                             tpu_policy={"acceleratorType": "v5p-32"}))

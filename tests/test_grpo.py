@@ -91,6 +91,23 @@ def tiny_model():
     return cfg, llama.init_params(cfg, jax.random.PRNGKey(0))
 
 
+def test_rollout_batch_rejects_biased_sampling(tiny_model):
+    """Greedy/filtered sampling would silently break the importance
+    ratio (full-softmax logprobs != behavior policy; greedy groups are
+    identical -> all-zero advantages)."""
+    cfg, params = tiny_model
+
+    class FakeEngine:
+        gen = GenerateConfig(max_len=64)  # temperature=0 greedy default
+
+    with pytest.raises(ValueError, match="temperature"):
+        grpo.rollout_batch(FakeEngine(), [[1]], lambda p, i: 0.0, 4)
+    FakeEngine.gen = GenerateConfig(max_len=64, temperature=1.0,
+                                    top_p=0.9)
+    with pytest.raises(ValueError, match="top_"):
+        grpo.rollout_batch(FakeEngine(), [[1]], lambda p, i: 0.0, 4)
+
+
 @pytest.mark.slow
 def test_rollout_batch_shapes_and_masks(tiny_model):
     cfg, params = tiny_model
