@@ -33,10 +33,16 @@ Config is JSON — ``--config /path.json``, or inline in
 ``moe.mixtral_8x7b``, every zero-arg constructor in those modules), or
 ``{"model_path": dir}`` to fine-tune a saved artifact;
 ``model_overrides`` tweaks any config field. ``mode`` is ``pretrain``
-(next-token loss; data ``synthetic`` or a ``tokens`` memmap file) or
+(next-token loss; data ``synthetic`` or a ``tokens`` memmap file),
 ``dpo`` (preference pairs from JSONL rows
 ``{"chosen": [...], "rejected": [...], "prompt_len": n}``, frozen
-initial weights as the DPO reference).
+initial weights as the DPO reference), or ``grpo`` (on-policy RL from a
+verifiable reward: prompts from JSONL rows ``{"prompt": [ids]}``, the
+reward a user-supplied callable named by ``reward`` —
+``"pkg.mod:fn"`` or ``"/path/rewards.py:fn"`` — called as
+``fn(prompt_ids, completion_ids) -> float``; each round samples a group
+per prompt from an in-process serving engine rebuilt on the current
+weights, then takes ``rollout.steps_per_round`` update steps).
 """
 
 from __future__ import annotations
@@ -162,6 +168,121 @@ def dpo_batches(cfg: dict, config, params, mesh, batch: int):
     return stream()
 
 
+def resolve_reward(spec: str):
+    """``"pkg.mod:fn"`` or ``"/path/file.py:fn"`` -> the reward callable
+    ``fn(prompt_ids, completion_ids) -> float``."""
+    import importlib
+    import importlib.util
+
+    mod_spec, _, fn_name = spec.partition(":")
+    if not fn_name:
+        raise ValueError(
+            f"reward must be 'module:function' or '/path.py:function', "
+            f"got {spec!r}")
+    if mod_spec.endswith(".py"):
+        py_spec = importlib.util.spec_from_file_location(
+            "kubedl_reward", mod_spec)
+        mod = importlib.util.module_from_spec(py_spec)
+        py_spec.loader.exec_module(mod)
+    else:
+        mod = importlib.import_module(mod_spec)
+    try:
+        return getattr(mod, fn_name)
+    except AttributeError:
+        raise ValueError(
+            f"no function {fn_name!r} in {mod_spec}") from None
+
+
+def run_grpo(cfg: dict, config, trainer, state, manager, ref_params,
+             elastic_agent=None):
+    """The on-policy RLVR loop: refresh the serving engine's weights to
+    the current policy each round, sample a group per prompt, score with
+    the verifiable reward, update for ``steps_per_round`` steps.
+
+    On-policy means ``old_logps`` from the freshly refreshed engine ARE
+    the current policy — the clipped ratio only engages within a round
+    as the weights move. ``ref_params`` is the frozen KL reference
+    (the INITIAL weights, copied before any checkpoint restore)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..serving.engine import GenerateConfig, InferenceEngine
+    from ..serving.engine import init_mesh_serving
+    from . import grpo as grpo_mod
+    from .data import shard_batch
+
+    data = cfg.get("data", {})
+    if data.get("kind") != "prompts_jsonl":
+        raise ValueError("mode=grpo needs data.kind='prompts_jsonl'")
+    prompts = []
+    with open(data["path"]) as f:
+        for line in f:
+            if line.strip():
+                prompts.append(json.loads(line)["prompt"])
+    if not prompts:
+        raise ValueError(f"no prompts in {data['path']}")
+    reward_fn = resolve_reward(cfg.get("reward", ""))
+
+    gcfg = grpo_mod.GRPOConfig(**cfg.get("grpo", {}))
+    roll = cfg.get("rollout", {})
+    rounds = int(roll.get("rounds", 10))
+    steps_per_round = int(roll.get("steps_per_round", 4))
+    max_new = int(roll.get("max_new_tokens", 64))
+    max_len = int(roll.get("max_len", 1024))
+    per_round = int(roll.get("prompts_per_round", 0)) or max(
+        1, 8 // gcfg.group_size)
+    if jax.process_count() > 1:
+        raise ValueError("mode=grpo is single-host for now: the rollout "
+                         "engine runs in-process on this host's chips")
+
+    interval = manager.config.save_interval_steps if manager else 0
+    last_saved = int(state.step)
+    mesh = trainer.mesh
+    engine = None
+    for rnd in range(rounds):
+        # device->host->device param refresh (training shards by fsdp,
+        # the engine places its own way); building the engine ONCE keeps
+        # its per-instance jit cache — only the buffers change per round
+        host_params = jax.device_get(state.params)
+        if engine is None:
+            engine = InferenceEngine(
+                config, host_params,
+                GenerateConfig(max_len=max_len, temperature=1.0))
+        else:
+            engine.params, _ = init_mesh_serving(
+                config, host_params, None, engine.mesh)
+        batch_prompts = [prompts[(rnd * per_round + j) % len(prompts)]
+                         for j in range(per_round)]
+        batch = grpo_mod.rollout_batch(
+            engine, batch_prompts, reward_fn, max_new, cfg=gcfg,
+            seed=int(cfg.get("seed", 0)) + rnd)
+        mean_reward = float(batch["rewards"].mean())
+        ref_lp = grpo_mod.token_logps(
+            config, ref_params, jnp.asarray(batch["tokens"]),
+            jnp.asarray(batch["targets"]))
+        train = {k: jnp.asarray(v) for k, v in batch.items()
+                 if k != "rewards"}
+        train["ref_logps"] = ref_lp
+        sb = shard_batch(train, mesh)
+        for _ in range(steps_per_round):
+            state, loss = trainer.step(state, sb)
+        log.info("grpo round %d/%d mean_reward %.4f loss %.4f",
+                 rnd + 1, rounds, mean_reward, float(loss))
+        if elastic_agent is not None:
+            elastic_agent.poll(state)
+        # host-side cadence: rounds advance step by steps_per_round, so
+        # the manager's `step % interval` periodic gate would only fire
+        # at lcm(steps_per_round, interval)
+        if manager is not None and interval \
+                and int(state.step) - last_saved >= interval:
+            manager.save(state, force=True)
+            last_saved = int(state.step)
+    if manager is not None:
+        manager.save(state, force=True)
+        manager.wait_until_finished()
+    return state
+
+
 def _maybe_elastic_agent(manager):
     """ElasticCheckpointAgent when the operator injected job coordinates
     and an api-server is reachable; None otherwise (standalone runs)."""
@@ -219,6 +340,7 @@ def main(argv=None) -> int:
         params = loaded_params
 
     mode = cfg.get("mode", "pretrain")
+    batches = None
     if mode == "pretrain":
         def loss_fn(p, b):
             return family.loss_fn(config, p, b["tokens"], b["targets"],
@@ -234,6 +356,17 @@ def main(argv=None) -> int:
         # init_state/step donate the originals into the train state
         ref_params = jax.tree.map(jnp.copy, params)
         batches = dpo_batches(cfg, config, ref_params, mesh, batch)
+    elif mode == "grpo":
+        import jax.numpy as jnp
+
+        from . import grpo as grpo_mod
+        loss_fn = grpo_mod.make_grpo_loss_fn(
+            config, grpo_mod.GRPOConfig(**cfg.get("grpo", {})),
+            mesh=mesh)
+        # the frozen KL reference must be the INITIAL weights: copy
+        # before init_state (donation) AND before checkpoint restore
+        # (a resumed run must not rebase the anchor to mid-training)
+        grpo_ref_params = jax.tree.map(jnp.copy, params)
     else:
         raise ValueError(f"unknown mode {mode!r}")
 
@@ -253,10 +386,15 @@ def main(argv=None) -> int:
             log.info("resumed from checkpoint step %s",
                      manager.latest_step())
 
-    state = trainer.fit(state, batches, num_steps=steps,
-                        log_every=int(cfg.get("log_every", 10)),
-                        checkpoint_manager=manager,
-                        elastic_agent=_maybe_elastic_agent(manager))
+    if mode == "grpo":
+        state = run_grpo(cfg, config, trainer, state, manager,
+                         grpo_ref_params,
+                         elastic_agent=_maybe_elastic_agent(manager))
+    else:
+        state = trainer.fit(state, batches, num_steps=steps,
+                            log_every=int(cfg.get("log_every", 10)),
+                            checkpoint_manager=manager,
+                            elastic_agent=_maybe_elastic_agent(manager))
 
     export = cfg.get("export_path") or os.environ.get("KUBEDL_MODEL_PATH")
     if export:

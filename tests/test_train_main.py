@@ -115,6 +115,42 @@ def test_dpo_run(tmp_path):
     assert os.path.isdir(tmp_path / "model_out")
 
 
+@pytest.mark.slow
+def test_grpo_run(tmp_path):
+    """On-policy RLVR through the entrypoint: prompts JSONL + a
+    file-path reward -> rounds of rollout/update -> exported model."""
+    prompts = tmp_path / "prompts.jsonl"
+    prompts.write_text("\n".join(
+        json.dumps({"prompt": [1, 2, i + 1]}) for i in range(4)))
+    rewards = tmp_path / "rewards.py"
+    rewards.write_text(
+        "def even_first(prompt_ids, completion_ids):\n"
+        "    return float(completion_ids[0] % 2 == 0)\n")
+    cfg = _base_config(
+        tmp_path, mode="grpo",
+        data={"kind": "prompts_jsonl", "path": str(prompts)},
+        reward=f"{rewards}:even_first",
+        grpo={"group_size": 4},
+        rollout={"rounds": 2, "steps_per_round": 2,
+                 "max_new_tokens": 4, "max_len": 128,
+                 "prompts_per_round": 2})
+    p = tmp_path / "cfg.json"
+    p.write_text(json.dumps(cfg))
+    assert main(["--config", str(p)]) == 0
+    assert os.listdir(tmp_path / "model_out")
+
+
+def test_resolve_reward_validation(tmp_path):
+    from kubedl_tpu.train.__main__ import resolve_reward
+    with pytest.raises(ValueError, match="module:function"):
+        resolve_reward("no_colon")
+    f = tmp_path / "r.py"
+    f.write_text("def fn(p, c):\n    return 0.0\n")
+    assert resolve_reward(f"{f}:fn")([1], [2]) == 0.0
+    with pytest.raises(ValueError, match="no function"):
+        resolve_reward(f"{f}:missing")
+
+
 def test_mode_and_data_validation(tmp_path):
     p = tmp_path / "cfg.json"
     p.write_text(json.dumps(_base_config(tmp_path, mode="rlhf")))
