@@ -15,6 +15,11 @@ env). Honors the autoconfig contract end to end:
   many LOCAL chips (one host's mesh; params shard by their logical
   specs, the KV cache by kv-heads). Not combinable with QUANTIZE.
 * ``KUBEDL_SERVING_PORT``     — default 8501
+* ``KUBEDL_TOKENIZER``        — "byte", or a local directory of
+  HuggingFace tokenizer assets (ship them with the ModelVersion):
+  enables ``{"text": ...}`` instances, decoded ``"text"`` in
+  predictions and stream events, and generation that stops at the
+  tokenizer's EOS
 
 SIGTERM (pod shutdown) stops the HTTP server, drains the engine, and
 exits 0 so rolling predictor updates are graceful.
@@ -30,13 +35,21 @@ import threading
 
 
 def build_engine(model_path: str, lanes: int, quantize: str, spec_k: int,
-                 draft_path: str = "", max_len: int = 1024, tp: int = 1):
+                 draft_path: str = "", max_len: int = 1024, tp: int = 1,
+                 eos_id: int = -1, tokenizer_vocab: int = 0):
     """The ONE env-to-engine mapping (also used by tests): returns a
     started engine honoring the autoconfig candidate."""
     from ..models.io import load_model
     from .engine import GenerateConfig
 
     config, params = load_model(model_path)
+    if eos_id >= config.vocab_size or tokenizer_vocab > config.vocab_size:
+        # a mismatched tokenizer would encode ids past the embedding
+        # table and serve garbage with a 200 — refuse at startup
+        raise ValueError(
+            f"tokenizer (vocab {tokenizer_vocab}, eos {eos_id}) does not "
+            f"fit the model vocab ({config.vocab_size}) — wrong "
+            "tokenizer for this model?")
     mesh = None
     if tp > 1:
         import jax
@@ -64,11 +77,11 @@ def build_engine(model_path: str, lanes: int, quantize: str, spec_k: int,
             SpeculativeEngine(
                 config, maybe_quantize(params, quantize or None),
                 dcfg, dparams, k=spec_k, max_len=max_len),
-            gen=GenerateConfig(max_len=max_len))
+            gen=GenerateConfig(max_len=max_len, eos_id=eos_id))
     from .batching import ContinuousBatchingEngine
     return ContinuousBatchingEngine(
         config, params, lanes=lanes, max_len=max_len,
-        gen=GenerateConfig(max_len=max_len),
+        gen=GenerateConfig(max_len=max_len, eos_id=eos_id),
         quantize=quantize or None, mesh=mesh).start()
 
 
@@ -85,9 +98,15 @@ def main() -> int:
     draft = os.environ.get("KUBEDL_SERVING_DRAFT_PATH", "")
     max_len = int(os.environ.get("KUBEDL_SERVING_MAX_LEN", "1024") or 1024)
     tp = int(os.environ.get("KUBEDL_SERVING_TP", "1") or 1)
+    from ..tokenizer import load_tokenizer
+    tokenizer = load_tokenizer(os.environ.get("KUBEDL_TOKENIZER", ""))
 
     engine = build_engine(model_path, lanes, quantize, spec_k, draft,
-                          max_len, tp=tp)
+                          max_len, tp=tp,
+                          eos_id=(tokenizer.eos_id if tokenizer is not None
+                                  else -1),
+                          tokenizer_vocab=(tokenizer.vocab_size
+                                           if tokenizer is not None else 0))
     from .server import InferenceServer, ServerConfig
     server = InferenceServer(engine, ServerConfig(
         # `or`, not a get() default: the controller injects the var even
@@ -96,9 +115,11 @@ def main() -> int:
                     or os.path.basename(model_path.rstrip("/"))
                     or "model"),
         port=int(os.environ.get("KUBEDL_SERVING_PORT", "8501") or 8501),
+        tokenizer=tokenizer,
     )).start()
-    log.info("serving %s on %s (lanes=%d quantize=%s)",
-             model_path, server.url, lanes, quantize or "off")
+    log.info("serving %s on %s (lanes=%d quantize=%s tokenizer=%s)",
+             model_path, server.url, lanes, quantize or "off",
+             os.environ.get("KUBEDL_TOKENIZER") or "off")
 
     done = threading.Event()
 

@@ -8,7 +8,10 @@ TFServing REST convention the console/tooling already speak:
 * ``POST /v1/models/{name}:predict`` — body
   ``{"instances": [{"prompt_tokens": [...], "max_tokens": N}]}`` →
   ``{"predictions": [{"tokens": [...]}]}``; instances in one request are
-  batched into a single generate call (static-shape bucket);
+  batched into a single generate call (static-shape bucket). When the
+  server has a tokenizer (``$KUBEDL_TOKENIZER``), an instance may say
+  ``{"text": "..."}`` instead of ``prompt_tokens`` and every prediction
+  gains a decoded ``"text"`` field — end-to-end text serving;
 * ``POST /v1/models/{name}:predict`` with ``"stream": true`` (single
   instance) — Server-Sent Events: one ``data: {"token": id}`` event per
   generated token as it decodes (time-to-first-token = one prefill, not
@@ -54,6 +57,9 @@ class ServerConfig:
     #: block in HBM and the engine never evicts, so an uncapped route
     #: would let clients OOM the device
     max_prefixes: int = 8
+    #: optional text codec (``kubedl_tpu.tokenizer``): enables "text"
+    #: instances and decoded "text" in predictions/stream events
+    tokenizer: Optional[object] = None
 
 
 class InferenceServer:
@@ -118,8 +124,18 @@ class InferenceServer:
         ``sampling`` holds optional per-request temperature/top_k/top_p
         overrides (continuous-batching engines apply them per lane)."""
         toks = inst.get("prompt_tokens")
+        if toks is None and "text" in inst:
+            tok = self.config.tokenizer
+            if tok is None:
+                raise ValueError(
+                    "this predictor has no tokenizer (set "
+                    "$KUBEDL_TOKENIZER); send prompt_tokens instead")
+            if not isinstance(inst["text"], str) or not inst["text"]:
+                raise ValueError("text must be a non-empty string")
+            from ..tokenizer import encode_prompt
+            toks = encode_prompt(tok, inst["text"])
         if not isinstance(toks, list) or not toks:
-            raise ValueError("each instance needs prompt_tokens")
+            raise ValueError("each instance needs prompt_tokens or text")
         prompt = [int(t) for t in toks]
         cap = min(int(inst.get("max_tokens", 16)),
                   self.config.max_new_tokens)
@@ -172,7 +188,7 @@ class InferenceServer:
                 # batch are real device work even when a later request
                 # times out — account for the snapshot either way
                 self._m_tokens.inc(sum(len(r.tokens) for r in reqs))
-            return {"predictions": preds}
+            return {"predictions": self._decorate_text(preds)}
         # static engine: decode to the longest request in one lockstep
         # batch, trim per instance to its own cap. Its sampler is
         # engine-wide — per-instance overrides need the lane engine.
@@ -192,7 +208,29 @@ class InferenceServer:
                 pred["logprobs"] = lps[:cap]
             preds.append(pred)
         self._m_tokens.inc(sum(len(p["tokens"]) for p in preds))
-        return {"predictions": preds}
+        return {"predictions": self._decorate_text(preds)}
+
+    def _decorate_text(self, preds: list) -> list:
+        if self.config.tokenizer is not None:
+            for p in preds:
+                p["text"] = self.config.tokenizer.decode(p["tokens"])
+        return preds
+
+    def _with_text_events(self, events):
+        """Add incremental ``"text"`` deltas to stream events (and the
+        full decode to the final summary) when a tokenizer is configured.
+        Token events whose bytes are mid-UTF-8-sequence carry an empty
+        delta; the missing text arrives with the completing token."""
+        from ..tokenizer import StreamDecoder
+        dec = StreamDecoder(self.config.tokenizer)
+        for ev in events:
+            if "token" in ev:
+                ev["text"] = dec.push(ev["token"])
+            elif ev.get("done"):
+                # full re-decode, not the decoder's held-back tail: the
+                # summary must equal decode(tokens) exactly
+                ev["text"] = self.config.tokenizer.decode(ev["tokens"])
+            yield ev
 
     def predict_stream(self, body: dict):
         """Yield SSE event dicts for a single-instance streaming request.
@@ -233,7 +271,8 @@ class InferenceServer:
                 if want_lp:
                     final["logprobs"] = lps
                 yield final
-            return events()
+            return (events() if self.config.tokenizer is None
+                    else self._with_text_events(events()))
 
         # static engine: no incremental lane output — generate fully,
         # then emit token events (correctness-compatible fallback)
@@ -263,7 +302,8 @@ class InferenceServer:
             if want_lp:
                 final["logprobs"] = lps[:cap]
             yield final
-        return events_static()
+        return (events_static() if self.config.tokenizer is None
+                else self._with_text_events(events_static()))
 
     def register_prefix(self, body: dict) -> dict:
         """Stash a shared prompt prefix's KV block (continuous-batching

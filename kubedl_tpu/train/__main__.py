@@ -33,7 +33,11 @@ Config is JSON — ``--config /path.json``, or inline in
 ``moe.mixtral_8x7b``, every zero-arg constructor in those modules), or
 ``{"model_path": dir}`` to fine-tune a saved artifact;
 ``model_overrides`` tweaks any config field. ``mode`` is ``pretrain``
-(next-token loss; data ``synthetic`` or a ``tokens`` memmap file),
+(next-token loss; data ``synthetic``, a ``tokens`` memmap file, or
+``text`` — a raw ``.jsonl``/``.txt`` corpus tokenized by
+``data.tokenizer`` ("byte" or a local HuggingFace tokenizer dir,
+``kubedl_tpu.tokenizer``) and document-packed into segment-isolated
+batches),
 ``dpo`` (preference pairs from JSONL rows
 ``{"chosen": [...], "rejected": [...], "prompt_len": n}``, frozen
 initial weights as the DPO reference), or ``grpo`` (on-policy RL from a
@@ -125,6 +129,50 @@ def data_stream(cfg: dict, config, mesh, batch: int, seq: int):
             process_index=jax.process_index(),
             process_count=jax.process_count(),
             seed=data.get("seed", 0)).batches()
+    elif kind == "text":
+        # raw text corpus (.jsonl {"text": ...} rows or plain lines):
+        # tokenize, then document-pack into segment-isolated batches —
+        # the packer's segment_ids/positions/mask flow through loss_fn
+        import numpy as np
+
+        from ..tokenizer import load_tokenizer, text_documents
+        from .data import pack_documents
+        tok = load_tokenizer(data.get("tokenizer", "byte"))
+        if tok is None:
+            raise ValueError("data.kind='text' needs data.tokenizer")
+        if tok.vocab_size > config.vocab_size:
+            raise ValueError(
+                f"tokenizer vocab {tok.vocab_size} exceeds model vocab "
+                f"{config.vocab_size} — wrong tokenizer for this model")
+        # materialize once: fine-tune corpora fit host RAM, and a list
+        # (not a generator) routes through the native C++ packer. Each
+        # host takes a disjoint stride of the corpus.
+        docs = [d for i, d in enumerate(
+                    text_documents(data["path"], tok,
+                                   text_key=data.get("text_key", "text")))
+                if i % jax.process_count() == jax.process_index()]
+        if not docs:
+            raise ValueError(f"no documents in {data['path']} for host "
+                             f"{jax.process_index()}")
+        rng = np.random.default_rng(
+            data.get("seed", 0) + jax.process_index())
+
+        def packed_epochs():
+            while True:
+                order = rng.permutation(len(docs))
+                n = 0
+                for b in pack_documents([docs[i] for i in order], seq,
+                                        batch, pad_id=tok.pad_id):
+                    n += 1
+                    yield b
+                if n == 0:
+                    # the packer only yields FULL batches; a corpus that
+                    # rounds down to zero would spin here forever
+                    raise ValueError(
+                        f"corpus {data['path']} packs into 0 full "
+                        f"batches of {batch}x{seq} — lower batch/seq or "
+                        "add data")
+        raw = packed_epochs()
     else:
         raise ValueError(f"unknown data kind {kind!r} for pretrain")
     return prefetch_to_device(raw, mesh, size=2)
@@ -227,6 +275,11 @@ def run_grpo(cfg: dict, config, trainer, state, manager, ref_params,
     roll = cfg.get("rollout", {})
     rounds = int(roll.get("rounds", 10))
     steps_per_round = int(roll.get("steps_per_round", 4))
+    if rounds < 1 or steps_per_round < 1:
+        # 0 steps would roll out + score for nothing (and hit an unbound
+        # `loss` in the log line) — refuse up front like GRPOConfig does
+        raise ValueError("rollout.rounds and rollout.steps_per_round "
+                         "must be >= 1")
     max_new = int(roll.get("max_new_tokens", 64))
     max_len = int(roll.get("max_len", 1024))
     per_round = int(roll.get("prompts_per_round", 0)) or max(
@@ -343,8 +396,12 @@ def main(argv=None) -> int:
     batches = None
     if mode == "pretrain":
         def loss_fn(p, b):
+            # packed text batches carry segment/position/mask planes;
+            # token/synthetic batches don't — one closure serves both
             return family.loss_fn(config, p, b["tokens"], b["targets"],
-                                  mesh=mesh)
+                                  mask=b.get("mask"),
+                                  segment_ids=b.get("segment_ids"),
+                                  positions=b.get("positions"), mesh=mesh)
         batches = data_stream(cfg, config, mesh, batch, seq)
     elif mode == "dpo":
         import jax.numpy as jnp

@@ -97,6 +97,39 @@ def test_pretrain_token_file(tmp_path):
 
 
 @pytest.mark.slow
+def test_pretrain_text_corpus(tmp_path):
+    """data.kind='text': a raw jsonl corpus tokenized with the byte
+    tokenizer and document-packed trains and exports end to end."""
+    corpus = tmp_path / "corpus.jsonl"
+    rows = [{"text": f"document number {i} about tpus"} for i in range(24)]
+    corpus.write_text("\n".join(json.dumps(r) for r in rows))
+    cfg = _base_config(tmp_path, steps=2, batch=8, seq=32,
+                       data={"kind": "text", "path": str(corpus),
+                             "tokenizer": "byte"})
+    # byte tokenizer vocab (259) must fit the model vocab
+    cfg["model_overrides"]["vocab_size"] = 288
+    p = tmp_path / "cfg.json"
+    p.write_text(json.dumps(cfg))
+    assert main(["--config", str(p)]) == 0
+    from kubedl_tpu.models.io import load_model
+    config, _ = load_model(str(tmp_path / "model_out"))
+    assert config.vocab_size == 288
+
+
+def test_text_corpus_vocab_mismatch(tmp_path):
+    corpus = tmp_path / "c.txt"
+    corpus.write_text("hello\n")
+    cfg = _base_config(tmp_path, data={"kind": "text",
+                                       "path": str(corpus),
+                                       "tokenizer": "byte"})
+    # model vocab 64 < byte tokenizer vocab 259 -> loud refusal
+    p = tmp_path / "cfg.json"
+    p.write_text(json.dumps(cfg))
+    with pytest.raises(ValueError, match="exceeds model vocab"):
+        main(["--config", str(p)])
+
+
+@pytest.mark.slow
 def test_dpo_run(tmp_path):
     rng = np.random.RandomState(0)
     rows = []
