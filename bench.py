@@ -216,12 +216,18 @@ def run(gen: str, dev, note: str) -> dict:
     get = lambda: next(stream)  # noqa: E731
 
     # warmup (compile), then fit the measured run into a wall-clock budget
-    # so the bench always completes on slow relays (BENCH_BUDGET_S)
+    # so the bench always completes on slow relays (BENCH_BUDGET_S).
+    # Timing rule: every measured window ends by PULLING THE SCALAR LOSS
+    # TO THE HOST, not by block_until_ready alone — over the axon relay,
+    # block_until_ready has been observed to return at dispatch (r04: a
+    # "refresh" measured 263x peak FLOPs). The loss value cannot exist on
+    # the host before every step it depends on actually executed, so
+    # device_get is unfakeable; on a scalar it costs one tiny round trip.
     state, loss = trainer.step(state, get())
-    jax.block_until_ready(loss)
+    float(jax.device_get(loss))
     t0 = time.perf_counter()
     state, loss = trainer.step(state, get())
-    jax.block_until_ready(loss)
+    float(jax.device_get(loss))
     step_time = max(time.perf_counter() - t0, 1e-4)
     budget = float(os.environ.get("BENCH_BUDGET_S", 240))
     steps = int(os.environ.get("BENCH_STEPS", 0)) or max(
@@ -230,7 +236,7 @@ def run(gen: str, dev, note: str) -> dict:
     t0 = time.perf_counter()
     for _ in range(steps):
         state, loss = trainer.step(state, get())
-    jax.block_until_ready(loss)
+    float(jax.device_get(loss))
     dt = time.perf_counter() - t0
 
     tokens_per_sec = batch * seq * steps / dt
@@ -251,6 +257,13 @@ def run(gen: str, dev, note: str) -> dict:
         "platform": dev.platform,
         "device_kind": dev.device_kind or "",
     }
+    if gen != "cpu" and mfu > 1.0:
+        # >100% of peak FLOPs is physically impossible: the timing was
+        # glitched (relay returning before execution) — never publish it
+        # as a real number
+        out["ok"] = False
+        out["error"] = (f"implausible mfu {mfu:.2f} (>1.0 of peak) — "
+                        "timing glitch, result discarded")
     if note:
         out["note"] = note
     # snapshot BEFORE the best-effort attention comparison: if the extra
@@ -301,11 +314,18 @@ def _attn_delta(cfg, batch: int, seq: int):
             # the chunked dK/dV work while the pallas VJP computes all
             # three, biasing the published speedup
             g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
-            jax.block_until_ready(g(q, k, v))  # compile
+
+            def force(out):
+                # pull one scalar of the last output to the host: the
+                # device executes programs in order, so this can't
+                # return before all queued iterations ran (relay-proof,
+                # unlike block_until_ready — see the train-loop note)
+                float(jax.device_get(out[0].ravel()[0]))
+            force(g(q, k, v))  # compile + drain
             t0 = time.perf_counter()
             for _ in range(8):
                 out = g(q, k, v)
-            jax.block_until_ready(out)
+            force(out)
             return time.perf_counter() - t0
 
         return time_impl("chunked") / time_impl("pallas")
@@ -365,7 +385,10 @@ def _cached_tpu_result():
         with open(TPU_CACHE) as f:
             cached = json.loads(f.read().strip().splitlines()[-1])
         if not isinstance(cached, dict) or not cached.get("ok") \
-                or cached.get("value", 0) <= 0:
+                or cached.get("value", 0) <= 0 \
+                or not (0 < cached.get("mfu", 0) <= 1.0):
+            # the mfu bound also retires pre-r04 caches measured with
+            # dispatch-only timing (physically impossible >1.0 values)
             return None
         cached["note"] = (
             "live TPU backend unreachable at bench time; result measured "

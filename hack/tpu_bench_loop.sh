@@ -46,7 +46,8 @@ while true; do
 import json, sys
 r = json.loads(sys.argv[1])
 ok = r.get("ok") and r.get("value", 0) > 0 \
-     and not r.get("cached") and not r.get("error")
+     and not r.get("cached") and not r.get("error") \
+     and 0 < r.get("mfu", 0) <= 1.0
 sys.exit(0 if ok else 1)
 EOF
       then

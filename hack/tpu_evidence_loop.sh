@@ -35,7 +35,8 @@ if BENCH_SKIP_PROBE=1 BENCH_NO_CPU_FALLBACK=1 BENCH_HARD_DEADLINE_S=2400 \
 import json, sys
 r = json.loads(sys.argv[1])
 ok = r.get("ok") and r.get("value", 0) > 0 \
-     and not r.get("cached") and not r.get("error")
+     and not r.get("cached") and not r.get("error") \
+     and 0 < r.get("mfu", 0) <= 1.0
 sys.exit(0 if ok else 1)
 EOF
   then

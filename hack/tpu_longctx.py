@@ -66,11 +66,18 @@ def time_attn(seq: int, batch: int, window: int = 0, iters: int = 8):
             # the chunked dK/dV work while the pallas custom VJP always
             # computes all three — an unfair comparison
             g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
-            jax.block_until_ready(g(q, k, v))  # compile
+
+            def force(out):
+                # host-pull one scalar: the device runs programs in
+                # order, so this cannot return before every queued
+                # iteration executed (block_until_ready over the axon
+                # relay has returned at dispatch — bench.py r04 note)
+                float(jax.device_get(out[0].ravel()[0]))
+            force(g(q, k, v))  # compile + drain
             t0 = time.perf_counter()
             for _ in range(iters):
                 out = g(q, k, v)
-            jax.block_until_ready(out)
+            force(out)
             times[impl] = (time.perf_counter() - t0) / iters
         except Exception as e:  # noqa: BLE001 — an OOM IS a datapoint:
             # chunked saves O(s^2) score residuals for the backward and
@@ -137,11 +144,11 @@ def train_step_at(seq: int, batch: int, steps: int = 6):
         synthetic_lm_batches(batch, seq, cfg.vocab_size), mesh, size=2)
 
     state, loss = trainer.step(state, next(stream))  # compile
-    jax.block_until_ready(loss)
+    float(jax.device_get(loss))  # drain: see bench.py timing rule
     t0 = time.perf_counter()
     for _ in range(steps):
         state, loss = trainer.step(state, next(stream))
-    jax.block_until_ready(loss)
+    float(jax.device_get(loss))  # unfakeable end of the timed window
     dt = time.perf_counter() - t0
     tok_s = batch * seq * steps / dt
     mfu = tok_s * bench.model_flops_per_token(cfg, seq) \
