@@ -274,3 +274,37 @@ def load_hf_checkpoint(path: str):
                                     map_location="cpu",
                                     weights_only=True))
     return config, from_hf(config, state)
+
+
+def main(argv=None) -> int:
+    """``python -m kubedl_tpu.models.convert HF_DIR OUT_DIR``: one
+    command from a HuggingFace checkpoint to a self-contained serving
+    artifact — converted weights (``models.io`` layout) plus the
+    checkpoint's tokenizer assets, so the predictor serves text with no
+    further configuration (``serving.__main__`` auto-detects them)."""
+    import argparse
+
+    p = argparse.ArgumentParser(prog="python -m kubedl_tpu.models.convert")
+    p.add_argument("src", help="HuggingFace model directory")
+    p.add_argument("dst", help="output artifact directory")
+    p.add_argument("--no-tokenizer", action="store_true",
+                   help="skip copying tokenizer assets")
+    args = p.parse_args(argv)
+
+    config, params = load_hf_checkpoint(args.src)
+    from .io import save_model
+    save_model(config, params, args.dst)
+    copied = []
+    if not args.no_tokenizer:
+        from ..tokenizer import copy_tokenizer_assets
+        copied = copy_tokenizer_assets(args.src, args.dst)
+    print(f"converted {args.src} -> {args.dst} "
+          f"({config.num_params / 1e6:.1f}M params"
+          + (f"; tokenizer assets: {', '.join(copied)}" if copied
+             else "; no tokenizer assets found") + ")")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

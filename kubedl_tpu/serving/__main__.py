@@ -19,7 +19,10 @@ env). Honors the autoconfig contract end to end:
   HuggingFace tokenizer assets (ship them with the ModelVersion):
   enables ``{"text": ...}`` instances, decoded ``"text"`` in
   predictions and stream events, and generation that stops at the
-  tokenizer's EOS
+  tokenizer's EOS. Unset: tokenizer assets found INSIDE the model
+  directory load automatically (``models.convert`` copies them there,
+  so converted checkpoints serve text with zero extra config); "off"
+  disables even that
 
 SIGTERM (pod shutdown) stops the HTTP server, drains the engine, and
 exits 0 so rolling predictor updates are graceful.
@@ -98,8 +101,13 @@ def main() -> int:
     draft = os.environ.get("KUBEDL_SERVING_DRAFT_PATH", "")
     max_len = int(os.environ.get("KUBEDL_SERVING_MAX_LEN", "1024") or 1024)
     tp = int(os.environ.get("KUBEDL_SERVING_TP", "1") or 1)
-    from ..tokenizer import load_tokenizer
-    tokenizer = load_tokenizer(os.environ.get("KUBEDL_TOKENIZER", ""))
+    from ..tokenizer import has_tokenizer_assets, load_tokenizer
+    tok_spec = os.environ.get("KUBEDL_TOKENIZER", "")
+    if not tok_spec and has_tokenizer_assets(model_path):
+        # self-contained artifact: models.convert ships the checkpoint's
+        # tokenizer alongside the weights
+        tok_spec = model_path
+    tokenizer = None if tok_spec == "off" else load_tokenizer(tok_spec)
 
     engine = build_engine(model_path, lanes, quantize, spec_k, draft,
                           max_len, tp=tp,

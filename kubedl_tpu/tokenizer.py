@@ -89,6 +89,38 @@ class HFTokenizer:
         return self._tk.decode(list(ids), skip_special_tokens=True)
 
 
+#: files that make a directory loadable by AutoTokenizer — the set the
+#: asset copier ships with converted checkpoints and the predictor's
+#: auto-detection looks for
+TOKENIZER_ASSETS = ("tokenizer.json", "tokenizer_config.json",
+                    "special_tokens_map.json", "vocab.json", "merges.txt",
+                    "tokenizer.model", "spiece.model", "vocab.txt")
+
+
+def has_tokenizer_assets(path: str) -> bool:
+    """True when ``path`` holds HuggingFace tokenizer files (the
+    predictor auto-loads them so ModelVersion artifacts are
+    self-contained)."""
+    return os.path.isdir(path) and any(
+        os.path.exists(os.path.join(path, f)) for f in
+        ("tokenizer.json", "tokenizer.model", "spiece.model", "vocab.json"))
+
+
+def copy_tokenizer_assets(src: str, dst: str) -> list:
+    """Copy tokenizer files from a HF checkpoint dir into a model
+    artifact dir (no-op for files that don't exist). Returns the copied
+    names — empty means the source shipped no tokenizer."""
+    import shutil
+    copied = []
+    for name in TOKENIZER_ASSETS:
+        s = os.path.join(src, name)
+        if os.path.exists(s):
+            os.makedirs(dst, exist_ok=True)
+            shutil.copy2(s, os.path.join(dst, name))
+            copied.append(name)
+    return copied
+
+
 def load_tokenizer(spec: str):
     """``"byte"`` -> ByteTokenizer; a local directory -> HFTokenizer.
 
@@ -181,4 +213,6 @@ def text_documents(path: str, tokenizer, add_bos: bool = True,
 
 
 __all__ = ["ByteTokenizer", "HFTokenizer", "StreamDecoder",
-           "load_tokenizer", "encode_prompt", "text_documents"]
+           "load_tokenizer", "encode_prompt", "text_documents",
+           "has_tokenizer_assets", "copy_tokenizer_assets",
+           "TOKENIZER_ASSETS"]

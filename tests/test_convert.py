@@ -166,3 +166,44 @@ def test_gemma2_window_pattern_matters():
     uni = ll.forward(dataclasses.replace(cfg, window_pattern="uniform"),
                      params, toks)
     assert not np.allclose(np.asarray(alt), np.asarray(uni))
+
+
+def test_convert_cli_self_contained_artifact(tmp_path):
+    """python -m kubedl_tpu.models.convert: HF dir -> weights artifact +
+    tokenizer assets, auto-detected by the predictor entrypoint."""
+    import json
+
+    from kubedl_tpu.models import convert as convert_mod
+    from kubedl_tpu.models import io as mio
+    from kubedl_tpu.tokenizer import has_tokenizer_assets, load_tokenizer
+
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=32, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=2, num_key_value_heads=2,
+        max_position_embeddings=32)
+    torch.manual_seed(1)
+    src = tmp_path / "hf"
+    transformers.LlamaForCausalLM(hf_cfg).save_pretrained(str(src))
+    # a minimal fast-tokenizer asset set alongside the weights
+    tokenizers = pytest.importorskip("tokenizers")
+    from tokenizers.models import WordLevel
+    from tokenizers.pre_tokenizers import Whitespace
+    tk = tokenizers.Tokenizer(WordLevel({"[UNK]": 0, "a": 1, "b": 2},
+                                        unk_token="[UNK]"))
+    tk.pre_tokenizer = Whitespace()
+    tk.save(str(src / "tokenizer.json"))
+    (src / "tokenizer_config.json").write_text(json.dumps(
+        {"tokenizer_class": "PreTrainedTokenizerFast"}))
+
+    dst = tmp_path / "artifact"
+    assert convert_mod.main([str(src), str(dst)]) == 0
+    cfg, params = mio.load_model(str(dst))
+    assert cfg.vocab_size == 32
+    assert has_tokenizer_assets(str(dst))       # predictor auto-detects
+    tok = load_tokenizer(str(dst))
+    assert tok.encode("a b") == [1, 2]
+
+    # --no-tokenizer leaves the artifact weights-only
+    dst2 = tmp_path / "bare"
+    assert convert_mod.main([str(src), str(dst2), "--no-tokenizer"]) == 0
+    assert not has_tokenizer_assets(str(dst2))
