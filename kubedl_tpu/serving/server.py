@@ -10,8 +10,10 @@ TFServing REST convention the console/tooling already speak:
   ``{"predictions": [{"tokens": [...]}]}``; instances in one request are
   batched into a single generate call (static-shape bucket). When the
   server has a tokenizer (``$KUBEDL_TOKENIZER``), an instance may say
-  ``{"text": "..."}`` instead of ``prompt_tokens`` and every prediction
-  gains a decoded ``"text"`` field — end-to-end text serving;
+  ``{"text": "..."}`` or ``{"messages": [{"role": ..., "content": ...},
+  ...]}`` (chat-templated for instruct checkpoints) instead of
+  ``prompt_tokens``, and every prediction gains a decoded ``"text"``
+  field — end-to-end text serving;
 * ``POST /v1/models/{name}:predict`` with ``"stream": true`` (single
   instance) — Server-Sent Events: one ``data: {"token": id}`` event per
   generated token as it decodes (time-to-first-token = one prefill, not
@@ -124,16 +126,20 @@ class InferenceServer:
         ``sampling`` holds optional per-request temperature/top_k/top_p
         overrides (continuous-batching engines apply them per lane)."""
         toks = inst.get("prompt_tokens")
-        if toks is None and "text" in inst:
+        if toks is None and ("text" in inst or "messages" in inst):
             tok = self.config.tokenizer
             if tok is None:
                 raise ValueError(
                     "this predictor has no tokenizer (set "
                     "$KUBEDL_TOKENIZER); send prompt_tokens instead")
-            if not isinstance(inst["text"], str) or not inst["text"]:
-                raise ValueError("text must be a non-empty string")
-            from ..tokenizer import encode_prompt
-            toks = encode_prompt(tok, inst["text"])
+            if "messages" in inst:
+                from ..tokenizer import render_chat
+                toks = render_chat(tok, inst["messages"])
+            else:
+                if not isinstance(inst["text"], str) or not inst["text"]:
+                    raise ValueError("text must be a non-empty string")
+                from ..tokenizer import encode_prompt
+                toks = encode_prompt(tok, inst["text"])
         if not isinstance(toks, list) or not toks:
             raise ValueError("each instance needs prompt_tokens or text")
         prompt = [int(t) for t in toks]

@@ -186,6 +186,39 @@ def encode_prompt(tokenizer, text: str) -> List[int]:
                                                   -1) >= 0)
 
 
+def render_chat(tokenizer, messages, add_generation_prompt: bool = True
+                ) -> List[int]:
+    """Token ids for a chat conversation.
+
+    HF tokenizers that ship a chat template (instruct checkpoints)
+    render through ``apply_chat_template`` — the exact format the model
+    was tuned on. Tokenizers without one (ByteTokenizer, base-model HF)
+    fall back to a simple tagged transcript::
+
+        <|role|>\\ncontent\\n ... <|assistant|>\\n
+
+    which is deterministic and round-trippable, for models fine-tuned
+    in-tree on the same convention.
+    """
+    if not isinstance(messages, list) or not messages:
+        raise ValueError("messages must be a non-empty list")
+    for m in messages:
+        if not isinstance(m, dict) or not isinstance(m.get("role"), str) \
+                or not isinstance(m.get("content"), str):
+            raise ValueError(
+                "each message needs string 'role' and 'content'")
+    tk = getattr(tokenizer, "_tk", None)
+    if tk is not None and getattr(tk, "chat_template", None):
+        return [int(t) for t in tk.apply_chat_template(
+            messages, tokenize=True,
+            add_generation_prompt=add_generation_prompt)]
+    text = "".join(f"<|{m['role']}|>\n{m['content']}\n" for m in messages)
+    if add_generation_prompt:
+        text += "<|assistant|>\n"
+    return tokenizer.encode(
+        text, add_bos=getattr(tokenizer, "bos_id", -1) >= 0)
+
+
 def text_documents(path: str, tokenizer, add_bos: bool = True,
                    add_eos: bool = True,
                    text_key: str = "text") -> Iterable[List[int]]:
@@ -213,6 +246,6 @@ def text_documents(path: str, tokenizer, add_bos: bool = True,
 
 
 __all__ = ["ByteTokenizer", "HFTokenizer", "StreamDecoder",
-           "load_tokenizer", "encode_prompt", "text_documents",
-           "has_tokenizer_assets", "copy_tokenizer_assets",
-           "TOKENIZER_ASSETS"]
+           "load_tokenizer", "encode_prompt", "render_chat",
+           "text_documents", "has_tokenizer_assets",
+           "copy_tokenizer_assets", "TOKENIZER_ASSETS"]

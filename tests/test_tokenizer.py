@@ -89,6 +89,53 @@ def test_hf_tokenizer_local_dir(tmp_path):
     assert hf.decode(ids) == "hello world"
 
 
+def test_render_chat_fallback_format():
+    from kubedl_tpu.tokenizer import render_chat
+    tok = ByteTokenizer()
+    ids = render_chat(tok, [{"role": "user", "content": "hi"}])
+    assert ids[0] == tok.bos_id
+    assert tok.decode(ids) == "<|user|>\nhi\n<|assistant|>\n"
+    no_gen = render_chat(tok, [{"role": "user", "content": "hi"}],
+                         add_generation_prompt=False)
+    assert tok.decode(no_gen) == "<|user|>\nhi\n"
+
+
+def test_render_chat_validation():
+    from kubedl_tpu.tokenizer import render_chat
+    tok = ByteTokenizer()
+    with pytest.raises(ValueError, match="non-empty list"):
+        render_chat(tok, [])
+    with pytest.raises(ValueError, match="role"):
+        render_chat(tok, [{"role": 3, "content": "x"}])
+
+
+def test_render_chat_hf_template(tmp_path):
+    """An HF tokenizer with a chat_template renders through it (the
+    instruct checkpoint's own format), not the fallback tags."""
+    tokenizers = pytest.importorskip("tokenizers")
+    from tokenizers.models import WordLevel
+    from tokenizers.pre_tokenizers import Whitespace
+
+    from kubedl_tpu.tokenizer import render_chat
+
+    vocab = {"[UNK]": 0, "[BOS]": 1, "[EOS]": 2, "user": 3, "bot": 4,
+             "hi": 5}
+    tk = tokenizers.Tokenizer(WordLevel(vocab, unk_token="[UNK]"))
+    tk.pre_tokenizer = Whitespace()
+    d = tmp_path / "tok"
+    d.mkdir()
+    tk.save(str(d / "tokenizer.json"))
+    (d / "tokenizer_config.json").write_text(json.dumps({
+        "tokenizer_class": "PreTrainedTokenizerFast",
+        "bos_token": "[BOS]", "eos_token": "[EOS]",
+        "chat_template": "{% for m in messages %}"
+                         "{{ m['role'] }} {{ m['content'] }} {% endfor %}"
+                         "{% if add_generation_prompt %}bot{% endif %}"}))
+    hf = load_tokenizer(str(d))
+    ids = render_chat(hf, [{"role": "user", "content": "hi"}])
+    assert ids == [3, 5, 4]          # "user hi bot" — template applied
+
+
 def test_text_documents_txt_and_jsonl(tmp_path):
     tok = ByteTokenizer()
     txt = tmp_path / "corpus.txt"
@@ -167,6 +214,24 @@ class TestTextServing:
             assert ei.value.code == 400
         finally:
             srv.config = old
+
+    def test_messages_instance(self, server):
+        srv, tok = server
+        from kubedl_tpu.tokenizer import render_chat
+        msgs = [{"role": "user", "content": "hello"}]
+        by_msgs = json.loads(self._post(srv.url, {"instances": [
+            {"messages": msgs, "max_tokens": 6}]}).read())
+        by_ids = json.loads(self._post(srv.url, {"instances": [
+            {"prompt_tokens": render_chat(tok, msgs),
+             "max_tokens": 6}]}).read())
+        assert by_msgs["predictions"][0]["tokens"] \
+            == by_ids["predictions"][0]["tokens"]
+
+    def test_bad_messages_is_400(self, server):
+        srv, _ = server
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            self._post(srv.url, {"instances": [{"messages": []}]})
+        assert ei.value.code == 400
 
     def test_stream_carries_text_deltas(self, server):
         srv, tok = server
