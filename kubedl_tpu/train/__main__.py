@@ -38,6 +38,9 @@ Config is JSON — ``--config /path.json``, or inline in
 ``data.tokenizer`` ("byte" or a local HuggingFace tokenizer dir,
 ``kubedl_tpu.tokenizer``) and document-packed into segment-isolated
 batches),
+``sft`` (instruction tuning from JSONL rows ``{"prompt": ...,
+"response": ...}`` — text with ``data.tokenizer``, or token-id lists —
+loss masked to response tokens only),
 ``dpo`` (preference pairs from JSONL rows
 ``{"chosen": [...], "rejected": [...], "prompt_len": n}``, frozen
 initial weights as the DPO reference), or ``grpo`` (on-policy RL from a
@@ -176,6 +179,48 @@ def data_stream(cfg: dict, config, mesh, batch: int, seq: int):
     else:
         raise ValueError(f"unknown data kind {kind!r} for pretrain")
     return prefetch_to_device(raw, mesh, size=2)
+
+
+def sft_stream(cfg: dict, config, mesh, batch: int, seq: int):
+    """Instruction-tuning batches from an ``sft_jsonl`` file: rows
+    ``{"prompt": ..., "response": ...}`` where each field is raw text
+    (requires ``data.tokenizer``) or a token-id list. Loss covers
+    response tokens only (``train.data.sft_batches``)."""
+    from ..tokenizer import load_tokenizer
+    from .data import prefetch_to_device, sft_batches
+
+    data = cfg.get("data", {})
+    if data.get("kind") != "sft_jsonl":
+        raise ValueError("mode=sft needs data.kind='sft_jsonl'")
+    tok = load_tokenizer(data.get("tokenizer", ""))
+    if tok is not None and tok.vocab_size > config.vocab_size:
+        raise ValueError(
+            f"tokenizer vocab {tok.vocab_size} exceeds model vocab "
+            f"{config.vocab_size} — wrong tokenizer for this model")
+
+    def ids_of(v, *, bos: bool, eos: bool):
+        if isinstance(v, list):
+            return [int(t) for t in v]
+        if tok is None:
+            raise ValueError(
+                "text prompt/response rows need data.tokenizer")
+        return tok.encode(v, add_bos=bos, add_eos=eos)
+
+    examples = []
+    with open(data["path"]) as f:
+        for line in f:
+            if not line.strip():
+                continue
+            row = json.loads(line)
+            p = ids_of(row["prompt"], bos=True, eos=False)
+            r = ids_of(row["response"], bos=False, eos=True)
+            examples.append((p + r, len(p)))
+    if not examples:
+        raise ValueError(f"no rows in {data['path']}")
+    stream = sft_batches(examples, seq, batch,
+                         pad_id=tok.pad_id if tok is not None else 0,
+                         seed=data.get("seed", 0))
+    return prefetch_to_device(stream, mesh, size=2)
 
 
 def dpo_batches(cfg: dict, config, params, mesh, batch: int):
@@ -394,7 +439,7 @@ def main(argv=None) -> int:
 
     mode = cfg.get("mode", "pretrain")
     batches = None
-    if mode == "pretrain":
+    if mode in ("pretrain", "sft"):
         def loss_fn(p, b):
             # packed text batches carry segment/position/mask planes;
             # token/synthetic batches don't — one closure serves both
@@ -402,7 +447,9 @@ def main(argv=None) -> int:
                                   mask=b.get("mask"),
                                   segment_ids=b.get("segment_ids"),
                                   positions=b.get("positions"), mesh=mesh)
-        batches = data_stream(cfg, config, mesh, batch, seq)
+        batches = (sft_stream(cfg, config, mesh, batch, seq)
+                   if mode == "sft"
+                   else data_stream(cfg, config, mesh, batch, seq))
     elif mode == "dpo":
         import jax.numpy as jnp
 

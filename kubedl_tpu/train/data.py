@@ -116,6 +116,46 @@ def _packed_arrays(toks, seg, pos) -> dict:
             "mask": mask}
 
 
+def sft_batches(examples, seq_len: int, batch_size: int,
+                pad_id: int = 0, seed: int = 0) -> Iterator[dict]:
+    """Infinite supervised fine-tuning stream from ``(ids, prompt_len)``
+    examples: each row is one example padded to ``seq_len``, loss masked
+    to the RESPONSE tokens only (the standard instruction-tuning rule —
+    the model is never trained to reproduce the prompt).
+
+    The loss element at column ``j`` scores predicting token ``j+1``:
+    it is kept iff ``j + 1 >= prompt_len`` (target is a response token)
+    and ``j + 1 < len(ids)`` (target is real, not padding). Examples
+    longer than ``seq_len + 1`` are truncated from the right; an example
+    whose prompt alone fills the window contributes no loss and is
+    rejected up front rather than silently training on nothing.
+    """
+    exs = []
+    for ids, plen in examples:
+        ids = list(ids)[:seq_len + 1]
+        if plen >= len(ids):
+            raise ValueError(
+                f"example with prompt_len {plen} leaves no response "
+                f"tokens inside seq_len {seq_len} — raise seq or trim "
+                "the prompt")
+        exs.append((ids, plen))
+    if len(exs) < batch_size:
+        raise ValueError(f"{len(exs)} examples < batch {batch_size}")
+    rng = np.random.default_rng(seed)
+    seq1 = seq_len + 1
+    while True:
+        order = rng.permutation(len(exs))
+        for start in range(0, len(order) - batch_size + 1, batch_size):
+            toks = np.full((batch_size, seq1), pad_id, np.int32)
+            mask = np.zeros((batch_size, seq_len), bool)
+            for r, idx in enumerate(order[start:start + batch_size]):
+                ids, plen = exs[idx]
+                toks[r, :len(ids)] = ids
+                mask[r, max(plen - 1, 0):len(ids) - 1] = True
+            yield {"tokens": toks[:, :-1], "targets": toks[:, 1:],
+                   "mask": mask}
+
+
 def shard_batch(batch: dict, mesh: Mesh) -> dict:
     """Rank-aware batch sharding: the leading axis shards over the data
     axes, a rank-2 [b, s] leaf additionally shards its sequence axis over

@@ -86,3 +86,35 @@ def test_pack_drops_incomplete_final_batch():
     # 3 docs at 3 tokens: rows hold one doc each (4+ would overflow seq1=5
     # with 3+3); only one FULL batch of 2 rows is yielded
     assert len(batches) == 1
+
+
+def test_sft_batches_mask_covers_response_only():
+    from kubedl_tpu.train.data import sft_batches
+
+    # example: prompt [1,2,3] (plen 3) + response [4,5] -> ids [1..5]
+    stream = sft_batches([([1, 2, 3, 4, 5], 3)] * 2, seq_len=6,
+                         batch_size=2, pad_id=0)
+    b = next(stream)
+    assert b["tokens"].shape == (2, 6)
+    row_t, row_y, row_m = b["tokens"][0], b["targets"][0], b["mask"][0]
+    assert list(row_t) == [1, 2, 3, 4, 5, 0]
+    assert list(row_y) == [2, 3, 4, 5, 0, 0]
+    # loss element j predicts target row_y[j]; only response targets
+    # (4 at j=2, 5 at j=3) are scored — prompt and padding are not
+    assert list(row_m) == [False, False, True, True, False, False]
+
+
+def test_sft_batches_truncation_and_validation():
+    from kubedl_tpu.train.data import sft_batches
+
+    # truncation from the right: ids [1..8] at seq_len 5 -> first 6 kept
+    b = next(sft_batches([([1, 2, 3, 4, 5, 6, 7, 8], 2)], seq_len=5,
+                         batch_size=1))
+    assert list(b["tokens"][0]) == [1, 2, 3, 4, 5]
+    assert list(b["mask"][0]) == [False, True, True, True, True]
+
+    # a prompt that fills the whole window trains on nothing -> refuse
+    with pytest.raises(ValueError, match="no response"):
+        next(sft_batches([([1, 2, 3], 3)], seq_len=2, batch_size=1))
+    with pytest.raises(ValueError, match="< batch"):
+        next(sft_batches([([1, 2], 1)], seq_len=4, batch_size=2))

@@ -130,6 +130,40 @@ def test_text_corpus_vocab_mismatch(tmp_path):
 
 
 @pytest.mark.slow
+def test_sft_run(tmp_path):
+    """mode=sft: text prompt/response rows train with response-only loss
+    and export."""
+    rows = [{"prompt": f"question {i}?", "response": f"answer {i}."}
+            for i in range(16)]
+    f = tmp_path / "sft.jsonl"
+    f.write_text("\n".join(json.dumps(r) for r in rows))
+    cfg = _base_config(tmp_path, mode="sft", steps=2, batch=8, seq=48,
+                       data={"kind": "sft_jsonl", "path": str(f),
+                             "tokenizer": "byte"})
+    cfg["model_overrides"]["vocab_size"] = 288
+    p = tmp_path / "cfg.json"
+    p.write_text(json.dumps(cfg))
+    assert main(["--config", str(p)]) == 0
+    assert (tmp_path / "model_out").exists()
+
+
+def test_sft_validation(tmp_path):
+    f = tmp_path / "sft.jsonl"
+    f.write_text(json.dumps({"prompt": "p", "response": "r"}))
+    cfg = _base_config(tmp_path, mode="sft",
+                       data={"kind": "sft_jsonl", "path": str(f)})
+    p = tmp_path / "cfg.json"
+    p.write_text(json.dumps(cfg))
+    # text rows without a tokenizer must fail loudly
+    with pytest.raises(ValueError, match="tokenizer"):
+        main(["--config", str(p)])
+    cfg["data"]["kind"] = "synthetic"
+    p.write_text(json.dumps(cfg))
+    with pytest.raises(ValueError, match="sft_jsonl"):
+        main(["--config", str(p)])
+
+
+@pytest.mark.slow
 def test_dpo_run(tmp_path):
     rng = np.random.RandomState(0)
     rows = []
