@@ -41,13 +41,17 @@ batches),
 ``sft`` (instruction tuning from JSONL rows ``{"prompt": ...,
 "response": ...}`` — text with ``data.tokenizer``, or token-id lists —
 loss masked to response tokens only),
+``evaluate`` (no training: corpus perplexity, or multiple-choice
+accuracy from ``eval_jsonl`` rows — results to INFO and
+``results_path``),
 ``dpo`` (preference pairs from JSONL rows
 ``{"chosen": [...], "rejected": [...], "prompt_len": n}``, frozen
 initial weights as the DPO reference), or ``grpo`` (on-policy RL from a
-verifiable reward: prompts from JSONL rows ``{"prompt": [ids]}``, the
-reward a user-supplied callable named by ``reward`` —
-``"pkg.mod:fn"`` or ``"/path/rewards.py:fn"`` — called as
-``fn(prompt_ids, completion_ids) -> float``; each round samples a group
+verifiable reward: prompts from JSONL rows ``{"prompt": [ids]}`` or raw
+text with ``data.tokenizer``, the reward a user-supplied callable named
+by ``reward`` — ``"pkg.mod:fn"`` or ``"/path/rewards.py:fn"`` — called
+as ``fn(prompt_ids, completion_ids) -> float``, with ``tokenizer=``
+bound when the function declares that parameter (text-level rewards); each round samples a group
 per prompt from an in-process serving engine rebuilt on the current
 weights, then takes ``rollout.steps_per_round`` update steps).
 """
@@ -261,6 +265,68 @@ def dpo_batches(cfg: dict, config, params, mesh, batch: int):
     return stream()
 
 
+def run_evaluate(cfg: dict, config, params, mesh) -> int:
+    """``mode=evaluate``: score a model without training — corpus
+    perplexity (data kinds ``synthetic``/``tokens``/``text``) or
+    multiple-choice accuracy (``eval_jsonl`` rows ``{"prompt": ...,
+    "options": [...], "answer": i?}``, text fields via
+    ``data.tokenizer``). Results log to INFO and, with
+    ``results_path``, land as one JSON file — so an eval is just a
+    JAXJob with this config."""
+    from ..tokenizer import load_tokenizer
+    from . import evaluate as ev
+
+    data = cfg.get("data", {})
+    ecfg = cfg.get("eval", {})
+    tok = load_tokenizer(data.get("tokenizer", ""))
+
+    if data.get("kind") == "eval_jsonl":
+        def ids_of(v, *, bos: bool):
+            if isinstance(v, list):
+                return [int(t) for t in v]
+            if tok is None:
+                raise ValueError("text eval rows need data.tokenizer")
+            return tok.encode(v, add_bos=bos)
+
+        rows = []
+        with open(data["path"]) as f:
+            for line in f:
+                if line.strip():
+                    rows.append(json.loads(line))
+        if not rows:
+            raise ValueError(f"no rows in {data['path']}")
+        questions = [{"prompt": ids_of(r["prompt"], bos=True),
+                      "options": [ids_of(o, bos=False)
+                                  for o in r["options"]]} for r in rows]
+        ranked = ev.loglikelihood_ranks(
+            config, params, questions, mesh=mesh,
+            length_normalize=bool(ecfg.get("length_normalize", False)))
+        results = {"kind": "loglikelihood", "questions": len(ranked),
+                   "choices": [r["choice"] for r in ranked]}
+        answers = [r.get("answer") for r in rows]
+        if all(a is not None for a in answers):
+            correct = sum(int(c == a) for c, a in
+                          zip(results["choices"], answers))
+            results["accuracy"] = correct / len(answers)
+    else:
+        batch = int(cfg.get("batch", 8))
+        seq = int(cfg.get("seq", 256))
+        batches = data_stream(cfg, config, mesh, batch, seq)
+        results = ev.perplexity(config, params, batches, mesh=mesh,
+                                max_batches=int(cfg.get("steps", 16)))
+        results["kind"] = "perplexity"
+
+    log.info("evaluate results: %s", json.dumps(results))
+    out = cfg.get("results_path")
+    if out:
+        import jax
+        if jax.process_index() == 0:
+            with open(out, "w") as f:
+                json.dump(results, f, indent=1)
+            log.info("results written to %s", out)
+    return 0
+
+
 def resolve_reward(spec: str):
     """``"pkg.mod:fn"`` or ``"/path/file.py:fn"`` -> the reward callable
     ``fn(prompt_ids, completion_ids) -> float``."""
@@ -307,14 +373,29 @@ def run_grpo(cfg: dict, config, trainer, state, manager, ref_params,
     data = cfg.get("data", {})
     if data.get("kind") != "prompts_jsonl":
         raise ValueError("mode=grpo needs data.kind='prompts_jsonl'")
+    from ..tokenizer import load_tokenizer
+    tok = load_tokenizer(data.get("tokenizer", ""))
     prompts = []
     with open(data["path"]) as f:
         for line in f:
             if line.strip():
-                prompts.append(json.loads(line)["prompt"])
+                p = json.loads(line)["prompt"]
+                if isinstance(p, str):
+                    if tok is None:
+                        raise ValueError(
+                            "text prompts need data.tokenizer")
+                    p = tok.encode(p, add_bos=True)
+                prompts.append(p)
     if not prompts:
         raise ValueError(f"no prompts in {data['path']}")
     reward_fn = resolve_reward(cfg.get("reward", ""))
+    if tok is not None:
+        import inspect
+        if "tokenizer" in inspect.signature(reward_fn).parameters:
+            # text-level rewards: fn(prompt_ids, completion_ids,
+            # tokenizer=...) decodes with the corpus tokenizer
+            import functools
+            reward_fn = functools.partial(reward_fn, tokenizer=tok)
 
     gcfg = grpo_mod.GRPOConfig(**cfg.get("grpo", {}))
     roll = cfg.get("rollout", {})
@@ -438,6 +519,8 @@ def main(argv=None) -> int:
         params = loaded_params
 
     mode = cfg.get("mode", "pretrain")
+    if mode == "evaluate":
+        return run_evaluate(cfg, config, params, mesh)
     batches = None
     if mode in ("pretrain", "sft"):
         def loss_fn(p, b):

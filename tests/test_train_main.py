@@ -164,6 +164,40 @@ def test_sft_validation(tmp_path):
 
 
 @pytest.mark.slow
+def test_evaluate_mode(tmp_path):
+    """mode=evaluate: multiple-choice accuracy from text rows and
+    perplexity over synthetic batches, results written to a JSON file."""
+    rows = [{"prompt": f"question {i}", "options": ["yes", "no"],
+             "answer": i % 2} for i in range(4)]
+    f = tmp_path / "eval.jsonl"
+    f.write_text("\n".join(json.dumps(r) for r in rows))
+    res_path = tmp_path / "results.json"
+    cfg = _base_config(tmp_path, mode="evaluate",
+                       data={"kind": "eval_jsonl", "path": str(f),
+                             "tokenizer": "byte"},
+                       results_path=str(res_path))
+    cfg["model_overrides"]["vocab_size"] = 288
+    del cfg["export_path"]
+    p = tmp_path / "cfg.json"
+    p.write_text(json.dumps(cfg))
+    assert main(["--config", str(p)]) == 0
+    res = json.loads(res_path.read_text())
+    assert res["kind"] == "loglikelihood" and res["questions"] == 4
+    assert 0.0 <= res["accuracy"] <= 1.0 and len(res["choices"]) == 4
+
+    # perplexity flavor over the synthetic stream
+    res2_path = tmp_path / "ppl.json"
+    cfg2 = _base_config(tmp_path, mode="evaluate", steps=2,
+                        data={"kind": "synthetic"},
+                        results_path=str(res2_path))
+    del cfg2["export_path"]
+    p.write_text(json.dumps(cfg2))
+    assert main(["--config", str(p)]) == 0
+    res2 = json.loads(res2_path.read_text())
+    assert res2["kind"] == "perplexity" and res2["perplexity"] > 1.0
+
+
+@pytest.mark.slow
 def test_dpo_run(tmp_path):
     rng = np.random.RandomState(0)
     rows = []
@@ -201,6 +235,34 @@ def test_grpo_run(tmp_path):
         rollout={"rounds": 2, "steps_per_round": 2,
                  "max_new_tokens": 4, "max_len": 128,
                  "prompts_per_round": 2})
+    p = tmp_path / "cfg.json"
+    p.write_text(json.dumps(cfg))
+    assert main(["--config", str(p)]) == 0
+    assert os.listdir(tmp_path / "model_out")
+
+
+@pytest.mark.slow
+def test_grpo_text_prompts_and_text_reward(tmp_path):
+    """Text prompts tokenize through data.tokenizer, and a reward that
+    declares a ``tokenizer`` parameter receives it (text-level RLVR)."""
+    prompts = tmp_path / "prompts.jsonl"
+    prompts.write_text("\n".join(
+        json.dumps({"prompt": f"compute {i}:"}) for i in range(4)))
+    rewards = tmp_path / "rewards.py"
+    rewards.write_text(
+        "def has_vowel(prompt_ids, completion_ids, tokenizer):\n"
+        "    text = tokenizer.decode(completion_ids)\n"
+        "    return float(any(c in 'aeiou' for c in text))\n")
+    cfg = _base_config(
+        tmp_path, mode="grpo",
+        data={"kind": "prompts_jsonl", "path": str(prompts),
+              "tokenizer": "byte"},
+        reward=f"{rewards}:has_vowel",
+        grpo={"group_size": 4},
+        rollout={"rounds": 1, "steps_per_round": 1,
+                 "max_new_tokens": 4, "max_len": 128,
+                 "prompts_per_round": 2})
+    cfg["model_overrides"]["vocab_size"] = 288
     p = tmp_path / "cfg.json"
     p.write_text(json.dumps(cfg))
     assert main(["--config", str(p)]) == 0
