@@ -29,6 +29,19 @@ TFServing REST convention the console/tooling already speak:
 * ``GET /metrics`` — Prometheus exposition (request counts/latency, TTFT,
   generated-token totals), same registry format the operator exports;
 * ``GET /healthz`` — liveness.
+
+With a tokenizer configured the server also speaks the **OpenAI
+convention** — the de-facto client standard — adapted onto the same
+engine paths (identical validation, metrics, and lane scheduling):
+
+* ``POST /v1/completions`` — ``prompt`` as a string, list of strings, or
+  token-id array; ``n``, ``max_tokens``, ``temperature``/``top_p``,
+  ``stop`` (host-side text match), ``stream`` (SSE chunks terminated by
+  ``data: [DONE]``);
+* ``POST /v1/chat/completions`` — ``messages`` rendered through the
+  tokenizer's chat template (``tokenizer.render_chat``), buffered or
+  streaming delta chunks;
+* ``GET /v1/models`` — model listing.
 """
 
 from __future__ import annotations
@@ -72,6 +85,7 @@ class InferenceServer:
         # one generate at a time: the TPU is serial anyway, and interleaved
         # donated caches would alias
         self._gen_lock = threading.Lock()
+        self._openai_count = 0     # request-id counter (monotonic)
         self.metrics = Registry()
         self._m_requests = self.metrics.counter(
             "kubedl_serving_requests_total",
@@ -311,6 +325,170 @@ class InferenceServer:
         return (events_static() if self.config.tokenizer is None
                 else self._with_text_events(events_static()))
 
+    # -- OpenAI-convention adapters ---------------------------------------
+
+    def _openai_tok(self):
+        tok = self.config.tokenizer
+        if tok is None:
+            raise ValueError(
+                "OpenAI routes need a tokenizer (set $KUBEDL_TOKENIZER "
+                "or ship tokenizer assets with the model)")
+        return tok
+
+    def _openai_parse(self, body: dict, chat: bool):
+        """(prompt id lists, cap, sampling, stop strings) — the one
+        request-to-instances rule for buffered and streaming flavors."""
+        tok = self._openai_tok()
+        from ..tokenizer import encode_prompt, render_chat
+        if chat:
+            prompts = [render_chat(tok, body.get("messages"))]
+        else:
+            p = body.get("prompt")
+            if isinstance(p, str):
+                prompts = [encode_prompt(tok, p)]
+            elif isinstance(p, list) and p and \
+                    all(isinstance(t, int) for t in p):
+                prompts = [p]                      # token-id array form
+            elif isinstance(p, list) and p and \
+                    all(isinstance(s, str) for s in p):
+                prompts = [encode_prompt(tok, s) for s in p]
+            else:
+                raise ValueError(
+                    "prompt must be a string, list of strings, or "
+                    "token-id array")
+        n = int(body.get("n", 1))
+        if n < 1:
+            raise ValueError("n must be >= 1")
+        prompts = [p for p in prompts for _ in range(n)]
+        cap = min(int(body.get("max_tokens", 16)),
+                  self.config.max_new_tokens)
+        sampling = {}
+        if "temperature" in body:
+            sampling["temperature"] = float(body["temperature"])
+        if "top_p" in body:
+            sampling["top_p"] = float(body["top_p"])
+        stop = body.get("stop") or []
+        if isinstance(stop, str):
+            stop = [stop]
+        if not (isinstance(stop, list)
+                and all(isinstance(s, str) and s for s in stop)):
+            raise ValueError("stop must be a string or list of strings")
+        return prompts, cap, sampling, stop
+
+    @staticmethod
+    def _apply_stop(text: str, stop: list):
+        """(text truncated at the earliest stop match, matched?)."""
+        cut = min((text.index(s) for s in stop if s in text),
+                  default=None)
+        return (text, False) if cut is None else (text[:cut], True)
+
+    def _openai_id(self, prefix: str) -> str:
+        self._openai_count += 1
+        return f"{prefix}-{self._openai_count}"
+
+    def openai_models(self) -> dict:
+        return {"object": "list", "data": [{
+            "id": self.config.model_name, "object": "model",
+            "owned_by": "kubedl-tpu"}]}
+
+    def openai_completions(self, body: dict, chat: bool) -> dict:
+        prompts, cap, sampling, stop = self._openai_parse(body, chat)
+        res = self.predict({"instances": [
+            {"prompt_tokens": p, "max_tokens": cap, **sampling}
+            for p in prompts]})
+        created = int(time.time())
+        choices = []
+        completion_tokens = 0
+        for i, pred in enumerate(res["predictions"]):
+            toks = pred["tokens"]
+            completion_tokens += len(toks)
+            text, matched = self._apply_stop(pred["text"], stop)
+            finish = "stop" if matched or len(toks) < cap else "length"
+            if chat:
+                choices.append({"index": i, "finish_reason": finish,
+                                "message": {"role": "assistant",
+                                            "content": text}})
+            else:
+                choices.append({"index": i, "finish_reason": finish,
+                                "text": text, "logprobs": None})
+        prompt_tokens = sum(len(p) for p in prompts)
+        return {
+            "id": self._openai_id("chatcmpl" if chat else "cmpl"),
+            "object": "chat.completion" if chat else "text_completion",
+            "created": created, "model": self.config.model_name,
+            "choices": choices,
+            "usage": {"prompt_tokens": prompt_tokens,
+                      "completion_tokens": completion_tokens,
+                      "total_tokens": prompt_tokens + completion_tokens},
+        }
+
+    def openai_stream(self, body: dict, chat: bool):
+        """SSE chunk generator (validates before the first yield).
+        Yields dicts (JSON chunks) and finally the raw ``[DONE]``
+        sentinel string."""
+        prompts, cap, sampling, stop = self._openai_parse(body, chat)
+        if len(prompts) != 1:
+            raise ValueError("stream mode takes one prompt with n=1")
+        events = self.predict_stream({"instances": [
+            {"prompt_tokens": prompts[0], "max_tokens": cap,
+             **sampling}]})
+        rid = self._openai_id("chatcmpl" if chat else "cmpl")
+        created = int(time.time())
+        obj = "chat.completion.chunk" if chat else "text_completion"
+
+        def chunk(piece=None, finish=None, role=None):
+            if chat:
+                delta = {}
+                if role is not None:
+                    delta["role"] = role
+                if piece:
+                    delta["content"] = piece
+                choice = {"index": 0, "delta": delta,
+                          "finish_reason": finish}
+            else:
+                choice = {"index": 0, "text": piece or "",
+                          "finish_reason": finish}
+            return {"id": rid, "object": obj, "created": created,
+                    "model": self.config.model_name, "choices": [choice]}
+
+        def gen():
+            if chat:
+                yield chunk(role="assistant")
+            # hold back enough text that a stop string split across
+            # token boundaries is still caught before it reaches the
+            # client
+            holdback = max((len(s) for s in stop), default=1) - 1
+            pending = ""
+            finish = None
+            n_out = 0
+            for ev in events:
+                if "token" not in ev:
+                    continue       # final summary handled after the loop
+                n_out += 1
+                pending += ev.get("text", "")
+                cut, matched = self._apply_stop(pending, stop)
+                if matched:
+                    if cut:
+                        yield chunk(piece=cut)
+                    finish = "stop"
+                    # the lane keeps decoding to its cap server-side
+                    # (requests have no cancel); the client stream ends
+                    # now — the remaining tokens are simply dropped
+                    break
+                emit = (pending[:-holdback] if holdback
+                        and len(pending) > holdback else
+                        ("" if holdback else pending))
+                if emit:
+                    yield chunk(piece=emit)
+                    pending = pending[len(emit):]
+            if finish is None:
+                if pending:
+                    yield chunk(piece=pending)
+                finish = "stop" if n_out < cap else "length"
+            yield chunk(finish=finish)
+            yield "[DONE]"
+        return gen()
+
     def register_prefix(self, body: dict) -> dict:
         """Stash a shared prompt prefix's KV block (continuous-batching
         engines only — the static engine has no shared cache to load)."""
@@ -361,8 +539,12 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Transfer-Encoding", "chunked")
         self.end_headers()
 
-        def chunk(payload: dict) -> None:
-            data = f"data: {json.dumps(payload)}\n\n".encode()
+        def chunk(payload) -> None:
+            # raw strings pass through unquoted (the OpenAI convention
+            # terminates streams with the literal `data: [DONE]`)
+            body = (payload if isinstance(payload, str)
+                    else json.dumps(payload))
+            data = f"data: {body}\n\n".encode()
             self.wfile.write(f"{len(data):x}\r\n".encode()
                              + data + b"\r\n")
             self.wfile.flush()
@@ -396,6 +578,8 @@ class _Handler(BaseHTTPRequestHandler):
         elif self.path == "/metrics":
             from ..metrics.http import write_exposition
             write_exposition(self, self.server_ref.metrics)
+        elif self.path == "/v1/models":
+            self._respond(200, self.server_ref.openai_models())
         elif self.path == f"/v1/models/{cfg.model_name}":
             self._respond(200, self.server_ref.status())
         else:
@@ -405,18 +589,29 @@ class _Handler(BaseHTTPRequestHandler):
         srv = self.server_ref
         cfg = srv.config
         is_prefix = self.path == f"/v1/models/{cfg.model_name}:registerPrefix"
+        is_chat = self.path == "/v1/chat/completions"
+        is_cmpl = self.path == "/v1/completions"
         if self.path != f"/v1/models/{cfg.model_name}:predict" \
-                and not is_prefix:
+                and not (is_prefix or is_chat or is_cmpl):
             self._respond(404, {"error": f"no route {self.path}"})
             return
         t0 = time.perf_counter()
-        mode = "prefix" if is_prefix else "predict"
+        mode = ("prefix" if is_prefix else "chat" if is_chat
+                else "completions" if is_cmpl else "predict")
         outcome = "ok"
         try:
             length = int(self.headers.get("Content-Length") or 0)
             body = json.loads(self.rfile.read(length) or b"{}")
             if is_prefix:
                 self._respond(200, srv.register_prefix(body))
+            elif is_chat or is_cmpl:
+                if body.get("stream"):
+                    outcome = self._respond_sse(
+                        srv.openai_stream(body, chat=is_chat))
+                else:
+                    self._respond(200,
+                                  srv.openai_completions(body,
+                                                         chat=is_chat))
             elif body.get("stream"):
                 mode = "stream"
                 # validation happens before the first event, so a bad

@@ -1,0 +1,171 @@
+"""OpenAI-compatible predictor surface: /v1/completions,
+/v1/chat/completions (buffered + SSE streaming), /v1/models — the
+de-facto client standard, adapted onto the same engine paths as the
+TFServing-convention routes (kubedl_tpu/serving/server.py)."""
+
+import dataclasses
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubedl_tpu.tokenizer import ByteTokenizer, render_chat
+
+#: compile-heavy compute suite: excluded from `make test`'s fast path
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture(scope="module")
+def server():
+    import jax
+    import jax.numpy as jnp
+
+    from kubedl_tpu.models import llama
+    from kubedl_tpu.serving import InferenceServer, ServerConfig
+    from kubedl_tpu.serving.batching import ContinuousBatchingEngine
+
+    tok = ByteTokenizer()
+    cfg = dataclasses.replace(llama.tiny(vocab=tok.vocab_size, seq=128),
+                              dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(7))
+    eng = ContinuousBatchingEngine(cfg, params, lanes=2, max_len=96).start()
+    srv = InferenceServer(eng, ServerConfig(
+        model_name="m", host="127.0.0.1", port=0, tokenizer=tok)).start()
+    yield srv, tok
+    srv.stop()
+    eng.stop()
+
+
+def post(url, path, body):
+    req = urllib.request.Request(
+        url + path, method="POST", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    return urllib.request.urlopen(req)
+
+
+def sse_lines(resp):
+    for raw in resp:
+        line = raw.decode().strip()
+        if line.startswith("data: "):
+            yield line[len("data: "):]
+
+
+def test_models_route(server):
+    srv, _ = server
+    got = json.loads(urllib.request.urlopen(srv.url + "/v1/models").read())
+    assert got["object"] == "list"
+    assert [m["id"] for m in got["data"]] == ["m"]
+
+
+def test_completions_buffered(server):
+    srv, tok = server
+    r = json.loads(post(srv.url, "/v1/completions", {
+        "model": "m", "prompt": "hello tpu", "max_tokens": 8}).read())
+    assert r["object"] == "text_completion"
+    assert r["id"].startswith("cmpl-")
+    ch = r["choices"][0]
+    assert ch["index"] == 0 and ch["finish_reason"] in ("stop", "length")
+    assert isinstance(ch["text"], str)
+    usage = r["usage"]
+    prompt_ids = tok.encode("hello tpu", add_bos=True)
+    assert usage["prompt_tokens"] == len(prompt_ids)
+    assert usage["completion_tokens"] >= 1
+    assert usage["total_tokens"] == (usage["prompt_tokens"]
+                                     + usage["completion_tokens"])
+
+
+def test_completions_prompt_list_and_token_ids(server):
+    srv, tok = server
+    r = json.loads(post(srv.url, "/v1/completions", {
+        "prompt": ["aa", "bb"], "max_tokens": 4}).read())
+    assert [c["index"] for c in r["choices"]] == [0, 1]
+    # OpenAI also accepts a token-id array prompt
+    ids = tok.encode("aa", add_bos=True)
+    r2 = json.loads(post(srv.url, "/v1/completions", {
+        "prompt": ids, "max_tokens": 4}).read())
+    assert r2["choices"][0]["text"] == r["choices"][0]["text"]
+
+
+def test_completions_deterministic_and_stop_sequence(server):
+    srv, _ = server
+    body = {"prompt": "abc", "max_tokens": 12}
+    t1 = json.loads(post(srv.url, "/v1/completions", body).read())
+    full = t1["choices"][0]["text"]
+    if len(full) >= 3:
+        stop = full[1:3]
+        t2 = json.loads(post(srv.url, "/v1/completions",
+                             {**body, "stop": stop}).read())
+        ch = t2["choices"][0]
+        assert stop not in ch["text"]
+        assert ch["text"] == full[:full.index(stop)]
+        assert ch["finish_reason"] == "stop"
+
+
+def test_chat_completions_matches_render_chat(server):
+    srv, tok = server
+    msgs = [{"role": "user", "content": "hi"}]
+    r = json.loads(post(srv.url, "/v1/chat/completions", {
+        "messages": msgs, "max_tokens": 6}).read())
+    assert r["object"] == "chat.completion"
+    msg = r["choices"][0]["message"]
+    assert msg["role"] == "assistant" and isinstance(msg["content"], str)
+
+    # same tokens as the TFServing route fed with render_chat ids
+    legacy = json.loads(post(srv.url, "/v1/models/m:predict", {
+        "instances": [{"prompt_tokens": render_chat(tok, msgs),
+                       "max_tokens": 6}]}).read())
+    assert msg["content"] == legacy["predictions"][0]["text"]
+
+
+def test_completions_stream(server):
+    srv, _ = server
+    resp = post(srv.url, "/v1/completions",
+                {"prompt": "xy", "max_tokens": 6, "stream": True})
+    assert resp.headers["Content-Type"].startswith("text/event-stream")
+    lines = list(sse_lines(resp))
+    assert lines[-1] == "[DONE]"
+    chunks = [json.loads(ln) for ln in lines[:-1]]
+    assert all(c["object"] == "text_completion" for c in chunks)
+    assert chunks[-1]["choices"][0]["finish_reason"] in ("stop", "length")
+    # deltas reassemble to the buffered result for the same prompt
+    text = "".join(c["choices"][0]["text"] for c in chunks)
+    buf = json.loads(post(srv.url, "/v1/completions",
+                          {"prompt": "xy", "max_tokens": 6}).read())
+    assert text == buf["choices"][0]["text"]
+
+
+def test_chat_completions_stream(server):
+    srv, _ = server
+    resp = post(srv.url, "/v1/chat/completions",
+                {"messages": [{"role": "user", "content": "go"}],
+                 "max_tokens": 5, "stream": True})
+    lines = list(sse_lines(resp))
+    assert lines[-1] == "[DONE]"
+    chunks = [json.loads(ln) for ln in lines[:-1]]
+    assert all(c["object"] == "chat.completion.chunk" for c in chunks)
+    assert chunks[0]["choices"][0]["delta"].get("role") == "assistant"
+    assert chunks[-1]["choices"][0]["finish_reason"] in ("stop", "length")
+
+
+def test_openai_routes_require_tokenizer(server):
+    srv, _ = server
+    bare = dataclasses.replace(srv.config, tokenizer=None)
+    old = srv.config
+    srv.config = bare
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post(srv.url, "/v1/completions", {"prompt": "x"})
+        assert ei.value.code == 400
+    finally:
+        srv.config = old
+
+
+def test_completions_validation(server):
+    srv, _ = server
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        post(srv.url, "/v1/completions", {})
+    assert ei.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        post(srv.url, "/v1/chat/completions", {"messages": "nope"})
+    assert ei.value.code == 400
