@@ -85,7 +85,10 @@ class InferenceServer:
         # one generate at a time: the TPU is serial anyway, and interleaved
         # donated caches would alias
         self._gen_lock = threading.Lock()
-        self._openai_count = 0     # request-id counter (monotonic)
+        # itertools.count: next() is a single C call, safe under
+        # ThreadingHTTPServer's concurrent handlers without a lock
+        import itertools
+        self._openai_ids = itertools.count(1)
         self.metrics = Registry()
         self._m_requests = self.metrics.counter(
             "kubedl_serving_requests_total",
@@ -359,7 +362,6 @@ class InferenceServer:
         n = int(body.get("n", 1))
         if n < 1:
             raise ValueError("n must be >= 1")
-        prompts = [p for p in prompts for _ in range(n)]
         cap = min(int(body.get("max_tokens", 16)),
                   self.config.max_new_tokens)
         sampling = {}
@@ -373,7 +375,7 @@ class InferenceServer:
         if not (isinstance(stop, list)
                 and all(isinstance(s, str) and s for s in stop)):
             raise ValueError("stop must be a string or list of strings")
-        return prompts, cap, sampling, stop
+        return prompts, n, cap, sampling, stop
 
     @staticmethod
     def _apply_stop(text: str, stop: list):
@@ -383,8 +385,7 @@ class InferenceServer:
         return (text, False) if cut is None else (text[:cut], True)
 
     def _openai_id(self, prefix: str) -> str:
-        self._openai_count += 1
-        return f"{prefix}-{self._openai_count}"
+        return f"{prefix}-{next(self._openai_ids)}"
 
     def openai_models(self) -> dict:
         return {"object": "list", "data": [{
@@ -392,10 +393,10 @@ class InferenceServer:
             "owned_by": "kubedl-tpu"}]}
 
     def openai_completions(self, body: dict, chat: bool) -> dict:
-        prompts, cap, sampling, stop = self._openai_parse(body, chat)
+        prompts, n, cap, sampling, stop = self._openai_parse(body, chat)
         res = self.predict({"instances": [
             {"prompt_tokens": p, "max_tokens": cap, **sampling}
-            for p in prompts]})
+            for p in prompts for _ in range(n)]})
         created = int(time.time())
         choices = []
         completion_tokens = 0
@@ -411,6 +412,8 @@ class InferenceServer:
             else:
                 choices.append({"index": i, "finish_reason": finish,
                                 "text": text, "logprobs": None})
+        # each distinct prompt counts once, regardless of n (the OpenAI
+        # usage contract clients build cost accounting on)
         prompt_tokens = sum(len(p) for p in prompts)
         return {
             "id": self._openai_id("chatcmpl" if chat else "cmpl"),
@@ -426,8 +429,8 @@ class InferenceServer:
         """SSE chunk generator (validates before the first yield).
         Yields dicts (JSON chunks) and finally the raw ``[DONE]``
         sentinel string."""
-        prompts, cap, sampling, stop = self._openai_parse(body, chat)
-        if len(prompts) != 1:
+        prompts, n, cap, sampling, stop = self._openai_parse(body, chat)
+        if len(prompts) != 1 or n != 1:
             raise ValueError("stream mode takes one prompt with n=1")
         events = self.predict_stream({"instances": [
             {"prompt_tokens": prompts[0], "max_tokens": cap,
@@ -623,12 +626,22 @@ class _Handler(BaseHTTPRequestHandler):
                 self._respond(200, srv.predict(body))
         except (ValueError, KeyError, TypeError) as e:
             srv._m_requests.inc(mode=mode, status="error")
-            self._respond(400, {"error": str(e)})
+            if is_chat or is_cmpl:
+                # the envelope OpenAI SDKs parse (error.message/.type)
+                self._respond(400, {"error": {
+                    "message": str(e), "type": "invalid_request_error",
+                    "param": None, "code": None}})
+            else:
+                self._respond(400, {"error": str(e)})
         except Exception as e:  # noqa: BLE001 — a crashed predict must
             # surface as a JSON 500, not a dropped connection (ADVICE r1)
             srv._m_requests.inc(mode=mode, status="error")
             logging.getLogger("kubedl_tpu.serving").exception("predict failed")
-            self._respond(500, {"error": f"{type(e).__name__}: {e}"})
+            msg = f"{type(e).__name__}: {e}"
+            self._respond(500, {"error": {
+                "message": msg, "type": "server_error",
+                "param": None, "code": None}}
+                if (is_chat or is_cmpl) else {"error": msg})
         else:
             srv._m_requests.inc(mode=mode, status=outcome)
             if outcome == "ok":

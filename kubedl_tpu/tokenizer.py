@@ -103,7 +103,8 @@ def has_tokenizer_assets(path: str) -> bool:
     self-contained)."""
     return os.path.isdir(path) and any(
         os.path.exists(os.path.join(path, f)) for f in
-        ("tokenizer.json", "tokenizer.model", "spiece.model", "vocab.json"))
+        ("tokenizer.json", "tokenizer.model", "spiece.model",
+         "vocab.json", "vocab.txt"))
 
 
 def copy_tokenizer_assets(src: str, dst: str) -> list:

@@ -197,10 +197,7 @@ def sft_stream(cfg: dict, config, mesh, batch: int, seq: int):
     if data.get("kind") != "sft_jsonl":
         raise ValueError("mode=sft needs data.kind='sft_jsonl'")
     tok = load_tokenizer(data.get("tokenizer", ""))
-    if tok is not None and tok.vocab_size > config.vocab_size:
-        raise ValueError(
-            f"tokenizer vocab {tok.vocab_size} exceeds model vocab "
-            f"{config.vocab_size} — wrong tokenizer for this model")
+    _check_tok_vocab(tok, config)
 
     def ids_of(v, *, bos: bool, eos: bool):
         if isinstance(v, list):
@@ -265,6 +262,16 @@ def dpo_batches(cfg: dict, config, params, mesh, batch: int):
     return stream()
 
 
+def _check_tok_vocab(tok, config) -> None:
+    """The ONE tokenizer-fits-model rule: ids past the embedding table
+    are clamped by the TPU gather, so a mismatch would produce silently
+    meaningless numbers rather than an error."""
+    if tok is not None and tok.vocab_size > config.vocab_size:
+        raise ValueError(
+            f"tokenizer vocab {tok.vocab_size} exceeds model vocab "
+            f"{config.vocab_size} — wrong tokenizer for this model")
+
+
 def run_evaluate(cfg: dict, config, params, mesh) -> int:
     """``mode=evaluate``: score a model without training — corpus
     perplexity (data kinds ``synthetic``/``tokens``/``text``) or
@@ -279,6 +286,7 @@ def run_evaluate(cfg: dict, config, params, mesh) -> int:
     data = cfg.get("data", {})
     ecfg = cfg.get("eval", {})
     tok = load_tokenizer(data.get("tokenizer", ""))
+    _check_tok_vocab(tok, config)
 
     if data.get("kind") == "eval_jsonl":
         def ids_of(v, *, bos: bool):
@@ -375,6 +383,7 @@ def run_grpo(cfg: dict, config, trainer, state, manager, ref_params,
         raise ValueError("mode=grpo needs data.kind='prompts_jsonl'")
     from ..tokenizer import load_tokenizer
     tok = load_tokenizer(data.get("tokenizer", ""))
+    _check_tok_vocab(tok, config)
     prompts = []
     with open(data["path"]) as f:
         for line in f:
@@ -389,13 +398,18 @@ def run_grpo(cfg: dict, config, trainer, state, manager, ref_params,
     if not prompts:
         raise ValueError(f"no prompts in {data['path']}")
     reward_fn = resolve_reward(cfg.get("reward", ""))
-    if tok is not None:
-        import inspect
-        if "tokenizer" in inspect.signature(reward_fn).parameters:
-            # text-level rewards: fn(prompt_ids, completion_ids,
-            # tokenizer=...) decodes with the corpus tokenizer
-            import functools
-            reward_fn = functools.partial(reward_fn, tokenizer=tok)
+    import inspect
+    if "tokenizer" in inspect.signature(reward_fn).parameters:
+        if tok is None:
+            # fail before the model loads, not at the first reward call
+            # mid-rollout
+            raise ValueError(
+                "reward function declares a tokenizer parameter but the "
+                "config sets no data.tokenizer")
+        # text-level rewards: fn(prompt_ids, completion_ids,
+        # tokenizer=...) decodes with the corpus tokenizer
+        import functools
+        reward_fn = functools.partial(reward_fn, tokenizer=tok)
 
     gcfg = grpo_mod.GRPOConfig(**cfg.get("grpo", {}))
     roll = cfg.get("rollout", {})

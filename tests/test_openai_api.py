@@ -161,11 +161,24 @@ def test_openai_routes_require_tokenizer(server):
         srv.config = old
 
 
+def test_completions_n_counts_prompt_once(server):
+    srv, tok = server
+    r = json.loads(post(srv.url, "/v1/completions", {
+        "prompt": "hello", "n": 2, "max_tokens": 4}).read())
+    assert [c["index"] for c in r["choices"]] == [0, 1]
+    # usage counts the prompt once regardless of n
+    assert r["usage"]["prompt_tokens"] \
+        == len(tok.encode("hello", add_bos=True))
+
+
 def test_completions_validation(server):
     srv, _ = server
     with pytest.raises(urllib.error.HTTPError) as ei:
         post(srv.url, "/v1/completions", {})
     assert ei.value.code == 400
+    # the error envelope OpenAI SDKs parse: error.message / error.type
+    err = json.loads(ei.value.read())["error"]
+    assert err["type"] == "invalid_request_error" and err["message"]
     with pytest.raises(urllib.error.HTTPError) as ei:
         post(srv.url, "/v1/chat/completions", {"messages": "nope"})
     assert ei.value.code == 400
