@@ -241,15 +241,23 @@ class InferenceReconciler(Reconciler):
         deploy = self.api.try_get("Deployment", ns, name)
         if deploy is None:
             deploy = self._create_predictor_deploy(inf, predictor, desired)
-        elif deploy["spec"] != desired:
-            # propagate every spec change (template, model version, replicas),
-            # not just the replica count
-            deploy["spec"] = desired
-            try:
-                deploy = self.api.update(deploy)
-            except (Conflict, NotFound):
-                pass
+        else:
+            if predictor.get("autoScale"):
+                # the HPA owns the replica count: adopting the live value
+                # keeps this diff from stomping every scale decision
+                desired["replicas"] = m.get_in(
+                    deploy, "spec", "replicas",
+                    default=desired["replicas"])
+            if deploy["spec"] != desired:
+                # propagate every spec change (template, model version,
+                # replicas), not just the replica count
+                deploy["spec"] = desired
+                try:
+                    deploy = self.api.update(deploy)
+                except (Conflict, NotFound):
+                    pass
         self._ensure_predictor_service(inf, predictor)
+        self._sync_autoscaler(inf, predictor)
         return {
             "name": predictor.get("name", ""),
             "replicas": int(m.get_in(deploy, "status", "replicas", default=0)),
@@ -301,6 +309,63 @@ class InferenceReconciler(Reconciler):
             "template": template,
             "strategy": {"type": "RollingUpdate"},
         }
+
+    def _sync_autoscaler(self, inf: dict, predictor: dict) -> None:
+        """``autoScale`` on a predictor renders a real autoscaling/v2
+        HPA targeting the predictor Deployment. The reference merely
+        stores an ObjectReference to an externally managed autoscaler
+        (``apis/serving/v1alpha1/inference_types.go:114-118``); here the
+        operator owns the child end to end — removing ``autoScale``
+        deletes the HPA, and the Deployment diff adopts the live replica
+        count so the two controllers never fight."""
+        ns = m.namespace(inf)
+        name = predictor_name(inf, predictor)
+        spec = predictor.get("autoScale")
+        existing = self.api.try_get("HorizontalPodAutoscaler", ns, name)
+        if not spec:
+            if existing is not None:
+                try:
+                    self.api.delete("HorizontalPodAutoscaler", ns, name)
+                except NotFound:
+                    pass
+            return
+        min_r = int(spec.get("minReplicas") or 1)
+        max_r = int(spec.get("maxReplicas") or 0)
+        if max_r < max(min_r, 1):
+            if self.recorder is not None:
+                self.recorder.event(
+                    inf, "Warning", "InvalidAutoScale",
+                    f"predictor {predictor.get('name', '')}: maxReplicas "
+                    f"{max_r} < minReplicas {min_r}; autoscaler skipped")
+            return
+        desired = {
+            "scaleTargetRef": {"apiVersion": "apps/v1",
+                               "kind": "Deployment", "name": name},
+            "minReplicas": min_r,
+            "maxReplicas": max_r,
+            "metrics": spec.get("metrics") or [{
+                "type": "Resource",
+                "resource": {"name": "cpu", "target": {
+                    "type": "Utilization",
+                    "averageUtilization": int(
+                        spec.get("targetCPUUtilization") or 80)}}}],
+        }
+        if existing is None:
+            hpa = m.new_obj("autoscaling/v2", "HorizontalPodAutoscaler",
+                            name, ns)
+            m.labels(hpa).update(predictor_labels(inf, predictor))
+            hpa["spec"] = desired
+            m.set_controller_ref(hpa, inf)
+            try:
+                self.api.create(hpa)
+            except AlreadyExists:
+                pass
+        elif existing["spec"] != desired:
+            existing["spec"] = desired
+            try:
+                self.api.update(existing)
+            except (Conflict, NotFound):
+                pass
 
     def _create_predictor_deploy(self, inf: dict, predictor: dict,
                                  spec: dict) -> dict:

