@@ -14,6 +14,7 @@ import { viewAdmin } from "./pages/admin.js";
 import { viewJobCreate } from "./pages/jobcreate.js";
 import { viewDataSheets } from "./pages/datasheets.js";
 import { view403, view404, view500 } from "./pages/errors.js";
+import { viewPlayground } from "./pages/playground.js";
 
 // ---------------------------------------------------------------- api client
 
@@ -95,6 +96,13 @@ const MESSAGES = {
     "sources.data": "Data sources", "sources.code": "Code sources",
     "sources.add": "Add", "sources.save": "Save", "sources.edit": "edit",
     "cluster.title": "Cluster",
+    "nav.playground": "Playground",
+    "playground.title": "Inference playground",
+    "playground.none": "no Inference objects deployed",
+    "playground.target": "Model", "playground.maxTokens": "Max tokens",
+    "playground.temperature": "Temperature",
+    "playground.placeholder": "Say something\u2026",
+    "playground.send": "Send", "playground.clear": "Clear",
     "nav.admin": "Admin", "admin.title": "Console users",
     "admin.username": "Username", "admin.password": "Password",
     "admin.role": "Role", "admin.add": "Add or update user",
@@ -137,6 +145,13 @@ const MESSAGES = {
     "sources.data": "数据源", "sources.code": "代码源",
     "sources.add": "新增", "sources.save": "保存", "sources.edit": "编辑",
     "cluster.title": "集群",
+    "nav.playground": "试用",
+    "playground.title": "推理试用",
+    "playground.none": "没有已部署的 Inference 对象",
+    "playground.target": "模型", "playground.maxTokens": "最大 token 数",
+    "playground.temperature": "温度",
+    "playground.placeholder": "输入内容\u2026",
+    "playground.send": "发送", "playground.clear": "清空",
     "nav.admin": "管理", "admin.title": "控制台用户",
     "admin.username": "用户名", "admin.password": "密码",
     "admin.role": "角色", "admin.add": "添加或更新用户",
@@ -182,6 +197,13 @@ const MESSAGES = {
     "sources.add": "Adicionar", "sources.save": "Salvar",
     "sources.edit": "editar",
     "cluster.title": "Cluster",
+    "nav.playground": "Playground",
+    "playground.title": "Playground de inferência",
+    "playground.none": "nenhum objeto Inference implantado",
+    "playground.target": "Modelo", "playground.maxTokens": "Máx. tokens",
+    "playground.temperature": "Temperatura",
+    "playground.placeholder": "Diga algo\u2026",
+    "playground.send": "Enviar", "playground.clear": "Limpar",
     "nav.admin": "Admin", "admin.title": "Usuários do console",
     "admin.username": "Usuário", "admin.password": "Senha",
     "admin.role": "Papel", "admin.add": "Adicionar ou atualizar",
@@ -246,6 +268,7 @@ const routes = {
   "admin": viewAdmin,
   "job-create": viewJobCreate,
   "datasheets": viewDataSheets,
+  "playground": viewPlayground,
   "403": view403,
   "404": view404,
   "500": view500,

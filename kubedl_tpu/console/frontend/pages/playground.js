@@ -1,0 +1,80 @@
+// Inference playground (beyond-parity: the reference console has no
+// serving surface): pick a deployed Inference, chat with it through the
+// console's predictor proxy (/api/v1/inference/predict -> the
+// predictor's OpenAI-convention routes).
+import { api, esc, t } from "../app.js";
+
+const history = [];   // [{role, content}] of the current conversation
+
+export async function viewPlayground(app) {
+  const infs = await api("/inference/list");
+  app.innerHTML = `
+    <div class="panel"><h2>${esc(t("playground.title"))}</h2>
+      ${infs.length ? "" : `<p class="muted">${esc(t("playground.none"))}</p>`}
+      <div class="kv">
+        <span class="muted">${esc(t("playground.target"))}</span>
+        <select id="pg-target">${infs.map(i =>
+          `<option value="${esc(i.namespace)}/${esc(i.name)}">
+             ${esc(i.namespace)}/${esc(i.name)} (${esc(i.framework)})
+           </option>`).join("")}</select>
+        <span class="muted">${esc(t("playground.maxTokens"))}</span>
+        <input id="pg-max" type="number" value="256" min="1">
+        <span class="muted">${esc(t("playground.temperature"))}</span>
+        <input id="pg-temp" type="number" value="0" min="0" step="0.1">
+      </div>
+      <div id="pg-chat" class="chat"></div>
+      <form id="pg-form">
+        <textarea id="pg-input" rows="3"
+          placeholder="${esc(t("playground.placeholder"))}"></textarea>
+        <div>
+          <button type="submit">${esc(t("playground.send"))}</button>
+          <button type="button" id="pg-clear" class="ghost">
+            ${esc(t("playground.clear"))}</button>
+        </div>
+      </form>
+    </div>`;
+
+  const chat = document.getElementById("pg-chat");
+  const render = () => {
+    chat.innerHTML = history.map(msg =>
+      `<div class="msg ${esc(msg.role)}">
+         <span class="muted">${esc(msg.role)}</span>
+         <div>${esc(msg.content)}</div></div>`).join("");
+    chat.scrollTop = chat.scrollHeight;
+  };
+  render();
+
+  document.getElementById("pg-clear").onclick = () => {
+    history.length = 0;
+    render();
+  };
+  document.getElementById("pg-form").onsubmit = async e => {
+    e.preventDefault();
+    const input = document.getElementById("pg-input");
+    const text = input.value.trim();
+    if (!text) return;
+    const [namespace, name] =
+      document.getElementById("pg-target").value.split("/");
+    history.push({ role: "user", content: text });
+    input.value = "";
+    render();
+    chat.insertAdjacentHTML("beforeend",
+      `<div class="msg assistant muted" id="pg-wait">…</div>`);
+    try {
+      const res = await api("/inference/predict", {
+        method: "POST",
+        body: JSON.stringify({
+          namespace, name, messages: history,
+          max_tokens: +document.getElementById("pg-max").value || 256,
+          temperature: +document.getElementById("pg-temp").value || 0,
+        }),
+      });
+      const content =
+        res.choices?.[0]?.message?.content ?? res.choices?.[0]?.text ?? "";
+      history.push({ role: "assistant", content });
+    } catch (err) {
+      history.push({ role: "assistant", content: `[error] ${err.message}` });
+    }
+    render();
+  };
+}

@@ -75,6 +75,13 @@ class ConsoleConfig:
     max_body: int = 4 << 20
     #: mark the session cookie Secure (set when serving behind TLS)
     cookie_secure: bool = False
+    #: playground proxy: Inference CR dict -> predictor base URL.
+    #: None = in-cluster DNS of the entry Service. The console only ever
+    #: talks to URLs this resolver returns for EXISTING Inference CRs —
+    #: user-supplied URLs are never fetched (no SSRF surface).
+    predictor_resolver: Optional[object] = None
+    #: upper bound on one proxied playground generation
+    predictor_timeout_s: float = 120.0
 
 
 #: _persist_users marks the ConfigMap it writes; a marked ConfigMap holds
@@ -514,6 +521,14 @@ class ConsoleServer:
                 m.name(p) for p in self.proxy.api.list(
                     "PersistentVolumeClaim", ns)))
 
+        # -- inference playground (beyond-parity: chat with a deployed
+        # predictor through the console; the reference console has no
+        # serving surface at all) --------------------------------------
+        if path == "/api/v1/inference/list":
+            return ok(self._inference_list(params))
+        if path == "/api/v1/inference/predict" and method == "POST":
+            return ok(self._inference_predict(json.loads(body or b"{}")))
+
         if path == "/api/v1/kinds":
             return ok(sorted(TRAINING_KINDS))
 
@@ -614,6 +629,80 @@ class ConsoleServer:
         if name:
             return ok(handler.get(name))
         return ok(handler.list())
+
+    # -- inference playground ---------------------------------------------
+
+    def _inference_list(self, params: dict) -> list:
+        ns = params.get("namespace") or None
+        out = []
+        for inf in self.proxy.api.list("Inference", ns):
+            out.append({
+                "name": m.name(inf), "namespace": m.namespace(inf),
+                "framework": m.get_in(inf, "spec", "framework",
+                                      default=""),
+                "predictors": [
+                    {"name": p.get("name", ""),
+                     "replicas": int(p.get("replicas") or 1)}
+                    for p in m.get_in(inf, "spec", "predictors",
+                                      default=[]) or []],
+                "status": m.get_in(inf, "status", default={}),
+            })
+        return out
+
+    def _predictor_base_url(self, inf: dict) -> str:
+        if self.config.predictor_resolver is not None:
+            return self.config.predictor_resolver(inf)
+        from ..platform.serving import _DEFAULT_PORTS
+        port = _DEFAULT_PORTS.get(
+            m.get_in(inf, "spec", "framework", default=""), 8000)
+        return (f"http://{m.name(inf)}.{m.namespace(inf)}.svc:{port}")
+
+    def _inference_predict(self, body: dict) -> dict:
+        """Proxy one buffered generation to a deployed predictor's
+        OpenAI-convention surface (fixed paths — no model name needed).
+        The target URL derives only from the Inference CR, never from
+        the request, so the console can't be steered at arbitrary
+        hosts."""
+        import urllib.error
+        import urllib.request
+
+        ns = body.get("namespace") or "default"
+        name = body.get("name") or ""
+        inf = self.proxy.api.try_get("Inference", ns, name)
+        if inf is None:
+            raise NotFound(f"inference {ns}/{name} not found")
+        fwd = {"max_tokens": int(body.get("max_tokens", 256))}
+        for k in ("temperature", "top_p", "stop"):
+            if k in body:
+                fwd[k] = body[k]
+        if body.get("messages"):
+            route, payload = "/v1/chat/completions", {
+                **fwd, "messages": body["messages"]}
+        elif body.get("prompt"):
+            route, payload = "/v1/completions", {
+                **fwd, "prompt": body["prompt"]}
+        else:
+            raise ValueError("need messages or prompt")
+        url = self._predictor_base_url(inf) + route
+        req = urllib.request.Request(
+            url, method="POST", data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=self.config.predictor_timeout_s) as r:
+                return json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            detail = ""
+            try:
+                err = json.loads(e.read()).get("error")
+                detail = (err or {}).get("message") if isinstance(
+                    err, dict) else str(err or "")
+            except Exception:  # noqa: BLE001 — upstream body is best-effort
+                pass
+            raise ValueError(
+                f"predictor returned {e.code}: {detail or e.reason}")
+        except (urllib.error.URLError, TimeoutError, OSError) as e:
+            raise ValueError(f"predictor unreachable: {e}")
 
     def _find_job(self, kind: str, ns: str, name: str) -> Optional[dict]:
         kinds = [kind] if kind else TRAINING_KINDS

@@ -584,3 +584,100 @@ def test_proxy_merges_live_and_persisted(api):
     op.run_until_idle(max_iterations=80)
     rows = proxy.list_jobs(Query())
     assert len(rows) == 1 and rows[0].is_in_etcd == 0
+
+
+def test_inference_playground_proxy(api):
+    """The playground routes: list Inference CRs, proxy a chat request to
+    the predictor's OpenAI surface via the resolver (which derives the
+    target from the CR, never from the request)."""
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    # a stub predictor speaking the OpenAI routes (no model needed —
+    # the real surface is pinned by tests/test_openai_api.py)
+    class Stub(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            body = json.loads(self.rfile.read(
+                int(self.headers["Content-Length"])))
+            assert self.path == "/v1/chat/completions"
+            out = json.dumps({
+                "object": "chat.completion",
+                "choices": [{"index": 0, "finish_reason": "stop",
+                             "message": {"role": "assistant",
+                                         "content": "echo: " +
+                                         body["messages"][-1]["content"]}}],
+            }).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(out)))
+            self.end_headers()
+            self.wfile.write(out)
+
+    stub = ThreadingHTTPServer(("127.0.0.1", 0), Stub)
+    threading.Thread(target=stub.serve_forever, daemon=True).start()
+    stub_url = f"http://127.0.0.1:{stub.server_address[1]}"
+
+    api.create({"apiVersion": "serving.kubedl.io/v1alpha1",
+                "kind": "Inference",
+                "metadata": {"name": "chatsvc", "namespace": "default"},
+                "spec": {"framework": "JAXServing", "predictors": [
+                    {"name": "main", "replicas": 1}]}})
+
+    proxy = DataProxy(api, None, None)
+    server = ConsoleServer(proxy, ConsoleConfig(
+        port=0, users={"admin": "kubedl"},
+        predictor_resolver=lambda inf: stub_url)).start()
+    client = Client(server.url)
+    try:
+        login(client)
+        status, res = client.req("GET", "/api/v1/inference/list")
+        assert status == 200
+        assert [i["name"] for i in res["data"]] == ["chatsvc"]
+        assert res["data"][0]["predictors"][0]["name"] == "main"
+
+        status, res = client.req("POST", "/api/v1/inference/predict", {
+            "namespace": "default", "name": "chatsvc",
+            "messages": [{"role": "user", "content": "hello"}]})
+        assert status == 200
+        msg = res["data"]["choices"][0]["message"]
+        assert msg["content"] == "echo: hello"
+
+        # unknown inference -> 404; no upstream call is attempted
+        status, res = client.req("POST", "/api/v1/inference/predict", {
+            "namespace": "default", "name": "ghost",
+            "messages": [{"role": "user", "content": "x"}]})
+        assert status == 404
+
+        # missing prompt/messages -> 400
+        status, res = client.req("POST", "/api/v1/inference/predict", {
+            "namespace": "default", "name": "chatsvc"})
+        assert status == 400
+    finally:
+        server.stop()
+        stub.shutdown()
+
+
+def test_inference_predict_unreachable_predictor(api):
+    api.create({"apiVersion": "serving.kubedl.io/v1alpha1",
+                "kind": "Inference",
+                "metadata": {"name": "down", "namespace": "default"},
+                "spec": {"framework": "JAXServing",
+                         "predictors": [{"name": "p"}]}})
+    proxy = DataProxy(api, None, None)
+    server = ConsoleServer(proxy, ConsoleConfig(
+        port=0, users={"admin": "kubedl"},
+        # a port nothing listens on
+        predictor_resolver=lambda inf: "http://127.0.0.1:1",
+        predictor_timeout_s=2)).start()
+    client = Client(server.url)
+    try:
+        login(client)
+        status, res = client.req("POST", "/api/v1/inference/predict", {
+            "namespace": "default", "name": "down",
+            "prompt": "hi"})
+        assert status == 400
+        assert "unreachable" in res["msg"]
+    finally:
+        server.stop()
