@@ -199,47 +199,69 @@ def run(gen: str, dev, note: str) -> dict:
                 f"bench shape (seq={seq}, hd={cfg.hd}) misses pallas alignment")
         attn_impl = "pallas"
 
-    # one fused on-device init: over a relayed chip, per-tensor eager init
-    # pays a round trip per weight — jit folds it into one executable
-    params = jax.jit(lambda k: llama.init_params(cfg, k))(jax.random.PRNGKey(0))
-    jax.block_until_ready(params)
-
     def loss_fn(p, b):
         return llama.loss_fn(cfg, p, b["tokens"], b["targets"])
 
-    trainer = Trainer(loss_fn, llama.param_specs(cfg), mesh,
-                      TrainConfig(warmup_steps=10, decay_steps=1000))
-    state = trainer.init_state(params)
-    # prefetch overlaps the host->device batch copy with the running step
-    stream = prefetch_to_device(
-        synthetic_lm_batches(batch, seq, cfg.vocab_size), mesh, size=2)
-    get = lambda: next(stream)  # noqa: E731
+    def measure(b: int):
+        """Tokens/s at batch ``b``; raises on OOM so the caller can step
+        down the ladder. Timing rule: every measured window ends by
+        PULLING THE SCALAR LOSS TO THE HOST, not by block_until_ready
+        alone — over the axon relay, block_until_ready has been observed
+        to return at dispatch (r04: a "refresh" measured 263x peak
+        FLOPs). The loss value cannot exist on the host before every
+        step it depends on actually executed, so device_get is
+        unfakeable; on a scalar it costs one tiny round trip."""
+        # one fused on-device init: over a relayed chip, per-tensor
+        # eager init pays a round trip per weight
+        params = jax.jit(lambda k: llama.init_params(cfg, k))(
+            jax.random.PRNGKey(0))
+        jax.block_until_ready(params)
+        trainer = Trainer(loss_fn, llama.param_specs(cfg), mesh,
+                          TrainConfig(warmup_steps=10, decay_steps=1000))
+        state = trainer.init_state(params)
+        # prefetch overlaps the host->device copy with the running step
+        stream = prefetch_to_device(
+            synthetic_lm_batches(b, seq, cfg.vocab_size), mesh, size=2)
+        get = lambda: next(stream)  # noqa: E731
 
-    # warmup (compile), then fit the measured run into a wall-clock budget
-    # so the bench always completes on slow relays (BENCH_BUDGET_S).
-    # Timing rule: every measured window ends by PULLING THE SCALAR LOSS
-    # TO THE HOST, not by block_until_ready alone — over the axon relay,
-    # block_until_ready has been observed to return at dispatch (r04: a
-    # "refresh" measured 263x peak FLOPs). The loss value cannot exist on
-    # the host before every step it depends on actually executed, so
-    # device_get is unfakeable; on a scalar it costs one tiny round trip.
-    state, loss = trainer.step(state, get())
-    float(jax.device_get(loss))
-    t0 = time.perf_counter()
-    state, loss = trainer.step(state, get())
-    float(jax.device_get(loss))
-    step_time = max(time.perf_counter() - t0, 1e-4)
-    budget = float(os.environ.get("BENCH_BUDGET_S", 240))
-    steps = int(os.environ.get("BENCH_STEPS", 0)) or max(
-        3, min(steps, int(budget / step_time)))
-
-    t0 = time.perf_counter()
-    for _ in range(steps):
+        state, loss = trainer.step(state, get())   # compile
+        float(jax.device_get(loss))
+        t0 = time.perf_counter()
         state, loss = trainer.step(state, get())
-    float(jax.device_get(loss))
-    dt = time.perf_counter() - t0
+        float(jax.device_get(loss))
+        step_time = max(time.perf_counter() - t0, 1e-4)
+        budget = float(os.environ.get("BENCH_BUDGET_S", 240))
+        n = int(os.environ.get("BENCH_STEPS", 0)) or max(
+            3, min(steps, int(budget / step_time)))
+        t0 = time.perf_counter()
+        for _ in range(n):
+            state, loss = trainer.step(state, get())
+        float(jax.device_get(loss))
+        return b * seq * n / (time.perf_counter() - t0)
 
-    tokens_per_sec = batch * seq * steps / dt
+    # bigger batches raise arithmetic intensity (better MFU) until the
+    # optimizer+activation footprint overflows HBM: walk a descending
+    # ladder, falling back on OOM. BENCH_BATCH pins a single size.
+    ladder = ([int(os.environ["BENCH_BATCH"])]
+              if os.environ.get("BENCH_BATCH") else
+              [batch] if gen == "cpu" else
+              sorted({batch * 2, batch}, reverse=True))
+    tokens_per_sec = None
+    for i, b in enumerate(ladder):
+        try:
+            tokens_per_sec = measure(b)
+            batch = b
+            break
+        except Exception as e:  # noqa: BLE001 — only OOM falls through
+            msg = str(e)
+            oom = ("RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg
+                   or "exceeds the limit" in msg)
+            if not oom or i == len(ladder) - 1:
+                raise
+            print(f"# batch {b} OOM, stepping down", file=sys.stderr,
+                  flush=True)
+            import gc
+            gc.collect()
     flops_per_tok = model_flops_per_token(cfg, seq)
     mfu = tokens_per_sec * flops_per_tok / PEAK_FLOPS[gen]
     target = TARGET_MFU * PEAK_FLOPS[gen] / flops_per_tok
