@@ -185,6 +185,44 @@ def data_stream(cfg: dict, config, mesh, batch: int, seq: int):
     return prefetch_to_device(raw, mesh, size=2)
 
 
+def build_eval_fn(cfg: dict, config, mesh, batch: int, seq: int):
+    """(eval_every, eval_fn) for in-training validation: ``eval``
+    section ``{"every": N, "data": {...}, "max_batches": M}`` draws a
+    FIXED held-out set once (every eval point scores the same tokens,
+    so the curve is comparable) and returns a closure the Trainer calls
+    between steps."""
+    import itertools
+    import math
+
+    ecfg = cfg.get("eval") or {}
+    every = int(ecfg.get("every", 0))
+    if not every:
+        return 0, None
+    if not ecfg.get("data"):
+        raise ValueError("eval.every needs eval.data (a held-out source)")
+    import jax.numpy as jnp
+
+    from . import evaluate as ev
+
+    n = int(ecfg.get("max_batches", 8))
+    stream = data_stream({**cfg, "data": ecfg["data"]}, config, mesh,
+                         batch, seq)
+    ev_batches = list(itertools.islice(stream, n))
+    row_nll = ev.make_row_nll_fn(config, mesh)
+
+    def eval_fn(state):
+        total = cnt = 0.0
+        for b in ev_batches:
+            total += float(jnp.sum(row_nll(state.params, b)))
+            mask = b.get("mask")
+            cnt += (float(jnp.sum(mask)) if mask is not None
+                    else b["tokens"].shape[0] * b["tokens"].shape[1])
+        nll = total / max(cnt, 1.0)
+        return {"val_nll": nll, "val_ppl": math.exp(min(nll, 80.0))}
+
+    return every, eval_fn
+
+
 def sft_stream(cfg: dict, config, mesh, batch: int, seq: int):
     """Instruction-tuning batches from an ``sft_jsonl`` file: rows
     ``{"prompt": ..., "response": ...}`` where each field is raw text
@@ -592,10 +630,14 @@ def main(argv=None) -> int:
                          grpo_ref_params,
                          elastic_agent=_maybe_elastic_agent(manager))
     else:
+        ev_every, ev_fn = ((0, None) if mode == "dpo"
+                           else build_eval_fn(cfg, config, mesh, batch,
+                                              seq))
         state = trainer.fit(state, batches, num_steps=steps,
                             log_every=int(cfg.get("log_every", 10)),
                             checkpoint_manager=manager,
-                            elastic_agent=_maybe_elastic_agent(manager))
+                            elastic_agent=_maybe_elastic_agent(manager),
+                            eval_every=ev_every, eval_fn=ev_fn)
 
     export = cfg.get("export_path") or os.environ.get("KUBEDL_MODEL_PATH")
     if export:

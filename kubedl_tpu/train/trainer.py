@@ -191,11 +191,14 @@ class Trainer:
 
     def fit(self, state: TrainState, batches, num_steps: int,
             log_every: int = 10, on_step=None, checkpoint_manager=None,
-            elastic_agent=None):
+            elastic_agent=None, eval_every: int = 0, eval_fn=None):
         """Training loop. ``checkpoint_manager`` saves on its configured
         interval plus a final save; ``elastic_agent`` is polled each step so
         operator-requested elastic checkpoints are taken between steps
-        (the AIMaster contract, ``kubedl_tpu.train.checkpoint``)."""
+        (the AIMaster contract, ``kubedl_tpu.train.checkpoint``).
+        ``eval_fn(state) -> dict`` runs every ``eval_every`` steps (and
+        once after the last step) on the CURRENT state — held-out
+        validation without leaving the loop."""
         t0 = time.time()
         tokens = 0
         step0 = int(jax.device_get(state.step))  # one sync, then host-side
@@ -229,6 +232,13 @@ class Trainer:
                     dt = time.time() - t0
                     print(f"step {int(state.step)} loss {float(loss):.4f} "
                           f"{tokens / dt:.0f} tok/s")
+                if eval_fn is not None and eval_every and \
+                        ((i + 1) % eval_every == 0 or i + 1 == num_steps):
+                    res = eval_fn(state)
+                    print(f"step {int(state.step)} eval "
+                          + " ".join(f"{k} {v:.4f}" if isinstance(v, float)
+                                     else f"{k} {v}"
+                                     for k, v in res.items()))
         finally:
             if tracing:
                 jax.profiler.stop_trace()

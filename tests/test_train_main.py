@@ -164,6 +164,31 @@ def test_sft_validation(tmp_path):
 
 
 @pytest.mark.slow
+def test_in_training_eval(tmp_path, capsys):
+    """eval.every runs held-out validation between steps: the Trainer
+    prints val_nll/val_ppl lines on the configured cadence."""
+    cfg = _base_config(tmp_path, steps=4,
+                       eval={"every": 2, "data": {"kind": "synthetic",
+                                                  "seed": 99},
+                             "max_batches": 2})
+    p = tmp_path / "cfg.json"
+    p.write_text(json.dumps(cfg))
+    assert main(["--config", str(p)]) == 0
+    out = capsys.readouterr().out
+    lines = [ln for ln in out.splitlines() if "val_ppl" in ln]
+    assert len(lines) == 2            # steps 2 and 4 (4 is also final)
+    assert "val_nll" in lines[0]
+
+
+def test_eval_every_requires_data(tmp_path):
+    cfg = _base_config(tmp_path, eval={"every": 2})
+    p = tmp_path / "cfg.json"
+    p.write_text(json.dumps(cfg))
+    with pytest.raises(ValueError, match="eval.data"):
+        main(["--config", str(p)])
+
+
+@pytest.mark.slow
 def test_evaluate_mode(tmp_path):
     """mode=evaluate: multiple-choice accuracy from text rows and
     perplexity over synthetic batches, results written to a JSON file."""
