@@ -185,7 +185,8 @@ def data_stream(cfg: dict, config, mesh, batch: int, seq: int):
     return prefetch_to_device(raw, mesh, size=2)
 
 
-def build_eval_fn(cfg: dict, config, mesh, batch: int, seq: int):
+def build_eval_fn(cfg: dict, config, mesh, batch: int, seq: int,
+                  params_of=None):
     """(eval_every, eval_fn) for in-training validation: ``eval``
     section ``{"every": N, "data": {...}, "max_batches": M}`` draws a
     FIXED held-out set once (every eval point scores the same tokens,
@@ -211,9 +212,10 @@ def build_eval_fn(cfg: dict, config, mesh, batch: int, seq: int):
     row_nll = ev.make_row_nll_fn(config, mesh)
 
     def eval_fn(state):
+        p = params_of(state) if params_of is not None else state.params
         total = cnt = 0.0
         for b in ev_batches:
-            total += float(jnp.sum(row_nll(state.params, b)))
+            total += float(jnp.sum(row_nll(p, b)))
             mask = b.get("mask")
             cnt += (float(jnp.sum(mask)) if mask is not None
                     else b["tokens"].shape[0] * b["tokens"].shape[1])
@@ -571,6 +573,11 @@ def main(argv=None) -> int:
         params = loaded_params
 
     mode = cfg.get("mode", "pretrain")
+    if cfg.get("lora") and mode not in ("pretrain", "sft"):
+        # before any data files open: adapter tuning only composes with
+        # the plain next-token losses
+        raise ValueError("lora applies to mode pretrain/sft (dpo and "
+                         "grpo tune full weights)")
     if mode == "evaluate":
         return run_evaluate(cfg, config, params, mesh)
     batches = None
@@ -610,9 +617,44 @@ def main(argv=None) -> int:
         raise ValueError(f"unknown mode {mode!r}")
 
     opt = cfg.get("optimizer", {})
-    trainer = Trainer(loss_fn, family.param_specs(config), mesh,
-                      TrainConfig(**opt))
-    state = trainer.init_state(params)
+    lora_cfg = cfg.get("lora")
+    lora_state = None
+    if lora_cfg:
+        # adapter-only fine-tuning: the base stays frozen (closed over),
+        # the optimizer state is adapter-sized, and export folds the
+        # adapters back into dense weights (ops/lora.py)
+        if mode not in ("pretrain", "sft"):
+            raise ValueError("lora applies to mode pretrain/sft (dpo and "
+                             "grpo tune full weights)")
+        from ..ops import lora as lora_mod
+        rank = int(lora_cfg.get("rank", 8))
+        alpha = float(lora_cfg.get("alpha", 16.0))
+        targets = tuple(lora_cfg.get("targets")
+                        or lora_mod.DEFAULT_TARGETS)
+        base_params = params
+        adapters = lora_mod.init_adapters(
+            base_params, rank=rank, targets=targets,
+            key=jax.random.PRNGKey(int(cfg.get("seed", 0)) + 1))
+        inner_loss = loss_fn
+
+        def loss_fn(ad, b):  # noqa: F811 — deliberate adapter rebind
+            return inner_loss(
+                lora_mod.merge_params(base_params, ad, alpha=alpha), b)
+
+        lora_state = (lora_mod, base_params, alpha)
+        trainer = Trainer(loss_fn,
+                          lora_mod.adapter_specs(
+                              family.param_specs(config), adapters),
+                          mesh, TrainConfig(**opt))
+        state = trainer.init_state(adapters)
+        log.info("lora: rank=%d alpha=%.1f targets=%s (%.2fM trainable)",
+                 rank, alpha, ",".join(sorted(targets)),
+                 sum(x.size for x in
+                     jax.tree_util.tree_leaves(state.params)) / 1e6)
+    else:
+        trainer = Trainer(loss_fn, family.param_specs(config), mesh,
+                          TrainConfig(**opt))
+        state = trainer.init_state(params)
 
     manager = None
     ck = cfg.get("checkpoint")
@@ -630,9 +672,15 @@ def main(argv=None) -> int:
                          grpo_ref_params,
                          elastic_agent=_maybe_elastic_agent(manager))
     else:
+        lora_params_of = None
+        if lora_state is not None:
+            lmod, lbase, lalpha = lora_state
+            lora_params_of = (lambda st: lmod.merge_params(
+                lbase, st.params, alpha=lalpha))
         ev_every, ev_fn = ((0, None) if mode == "dpo"
                            else build_eval_fn(cfg, config, mesh, batch,
-                                              seq))
+                                              seq,
+                                              params_of=lora_params_of))
         state = trainer.fit(state, batches, num_steps=steps,
                             log_every=int(cfg.get("log_every", 10)),
                             checkpoint_manager=manager,
@@ -641,14 +689,22 @@ def main(argv=None) -> int:
 
     export = cfg.get("export_path") or os.environ.get("KUBEDL_MODEL_PATH")
     if export:
+        export_params = state.params
+        if lora_state is not None:
+            # fold trained adapters into dense weights: the exported
+            # artifact serves with zero adapter overhead and composes
+            # with int8/int4 quantization
+            lmod, lbase, lalpha = lora_state
+            export_params = lmod.merge_to_dense(lbase, state.params,
+                                                alpha=lalpha)
         # fsdp-sharded params span non-addressable devices on multi-host
         # runs: device_get on process 0 alone would raise. All hosts
         # join the allgather; only process 0 touches the filesystem.
         if jax.process_count() > 1:
             from jax.experimental import multihost_utils
-            host_params = multihost_utils.process_allgather(state.params)
+            host_params = multihost_utils.process_allgather(export_params)
         else:
-            host_params = jax.device_get(state.params)
+            host_params = jax.device_get(export_params)
         if jax.process_index() == 0:
             from ..models.io import save_model
             save_model(config, host_params, export)

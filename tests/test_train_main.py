@@ -164,6 +164,36 @@ def test_sft_validation(tmp_path):
 
 
 @pytest.mark.slow
+def test_lora_sft_run(tmp_path):
+    """lora config trains adapters only and exports a dense fold-in that
+    the serving loader opens like any other artifact."""
+    rows = [{"prompt": f"q {i}", "response": f"a {i}"} for i in range(16)]
+    f = tmp_path / "sft.jsonl"
+    f.write_text("\n".join(json.dumps(r) for r in rows))
+    cfg = _base_config(tmp_path, mode="sft", steps=2, batch=8, seq=32,
+                       lora={"rank": 2},
+                       data={"kind": "sft_jsonl", "path": str(f),
+                             "tokenizer": "byte"})
+    cfg["model_overrides"]["vocab_size"] = 288
+    p = tmp_path / "cfg.json"
+    p.write_text(json.dumps(cfg))
+    assert main(["--config", str(p)]) == 0
+    from kubedl_tpu.models.io import load_model
+    config, params = load_model(str(tmp_path / "model_out"))
+    # dense export: plain arrays, full model shape
+    assert params["layers"]["wq"].ndim == 3
+
+
+def test_lora_rejects_full_weight_modes(tmp_path):
+    cfg = _base_config(tmp_path, mode="dpo", lora={"rank": 2},
+                       data={"kind": "dpo_jsonl", "path": "x"})
+    p = tmp_path / "cfg.json"
+    p.write_text(json.dumps(cfg))
+    with pytest.raises(ValueError, match="lora applies"):
+        main(["--config", str(p)])
+
+
+@pytest.mark.slow
 def test_in_training_eval(tmp_path, capsys):
     """eval.every runs held-out validation between steps: the Trainer
     prints val_nll/val_ppl lines on the configured cadence."""
