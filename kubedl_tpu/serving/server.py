@@ -394,10 +394,13 @@ class InferenceServer:
 
     def openai_completions(self, body: dict, chat: bool) -> dict:
         prompts, n, cap, sampling, stop = self._openai_parse(body, chat)
+        want_lp = bool(body.get("logprobs"))
         res = self.predict({"instances": [
-            {"prompt_tokens": p, "max_tokens": cap, **sampling}
+            {"prompt_tokens": p, "max_tokens": cap, "logprobs": want_lp,
+             **sampling}
             for p in prompts for _ in range(n)]})
         created = int(time.time())
+        tok = self.config.tokenizer
         choices = []
         completion_tokens = 0
         for i, pred in enumerate(res["predictions"]):
@@ -405,13 +408,27 @@ class InferenceServer:
             completion_tokens += len(toks)
             text, matched = self._apply_stop(pred["text"], stop)
             finish = "stop" if matched or len(toks) < cap else "length"
+            lp = None
+            if want_lp:
+                pieces = [tok.decode([t]) for t in toks]
+                if chat:
+                    # chat flavor: logprobs.content entries
+                    lp = {"content": [
+                        {"token": s, "logprob": float(v)}
+                        for s, v in zip(pieces, pred["logprobs"])]}
+                else:
+                    lp = {"tokens": pieces,
+                          "token_logprobs": [float(v)
+                                             for v in pred["logprobs"]],
+                          "top_logprobs": None, "text_offset": None}
             if chat:
                 choices.append({"index": i, "finish_reason": finish,
+                                "logprobs": lp,
                                 "message": {"role": "assistant",
                                             "content": text}})
             else:
                 choices.append({"index": i, "finish_reason": finish,
-                                "text": text, "logprobs": None})
+                                "text": text, "logprobs": lp})
         # each distinct prompt counts once, regardless of n (the OpenAI
         # usage contract clients build cost accounting on)
         prompt_tokens = sum(len(p) for p in prompts)

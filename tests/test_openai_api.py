@@ -171,6 +171,24 @@ def test_completions_n_counts_prompt_once(server):
         == len(tok.encode("hello", add_bos=True))
 
 
+def test_completions_logprobs(server):
+    srv, tok = server
+    r = json.loads(post(srv.url, "/v1/completions", {
+        "prompt": "lp", "max_tokens": 4, "logprobs": 1}).read())
+    lp = r["choices"][0]["logprobs"]
+    toks = json.loads(post(srv.url, "/v1/completions", {
+        "prompt": "lp", "max_tokens": 4}).read())["choices"][0]
+    assert len(lp["token_logprobs"]) == 4
+    assert all(v <= 0 for v in lp["token_logprobs"])
+    assert "".join(lp["tokens"]) == toks["text"]
+
+    c = json.loads(post(srv.url, "/v1/chat/completions", {
+        "messages": [{"role": "user", "content": "lp"}],
+        "max_tokens": 3, "logprobs": True}).read())
+    entries = c["choices"][0]["logprobs"]["content"]
+    assert len(entries) == 3 and all("logprob" in e for e in entries)
+
+
 def test_completions_validation(server):
     srv, _ = server
     with pytest.raises(urllib.error.HTTPError) as ei:
