@@ -246,7 +246,84 @@ def text_documents(path: str, tokenizer, add_bos: bool = True,
             yield tokenizer.encode(text, add_bos=add_bos, add_eos=add_eos)
 
 
+def train_tokenizer(corpus_paths, out_dir: str, vocab_size: int = 8192,
+                    min_frequency: int = 2) -> "HFTokenizer":
+    """Train a byte-level BPE tokenizer on raw corpora and save it as a
+    standard HuggingFace asset directory — loadable by
+    ``load_tokenizer``/``AutoTokenizer`` and shippable with ModelVersion
+    artifacts. Closes the from-scratch loop: corpus → tokenizer →
+    ``data.kind='text'`` pretrain → text serving, all in-tree.
+
+    ``corpus_paths``: plain-text or ``.jsonl`` (``{"text": ...}`` rows)
+    files. Specials are pinned to the ByteTokenizer convention
+    (pad=0 / bos=1 / eos=2) so configs transfer between the two.
+    """
+    import json as _json
+
+    from tokenizers import Tokenizer as _Tok
+    from tokenizers.decoders import ByteLevel as _BLDec
+    from tokenizers.models import BPE
+    from tokenizers.pre_tokenizers import ByteLevel as _BL
+    from tokenizers.trainers import BpeTrainer
+
+    if isinstance(corpus_paths, str):
+        corpus_paths = [corpus_paths]
+
+    def lines():
+        for p in corpus_paths:
+            is_jsonl = p.endswith(".jsonl")
+            with open(p, encoding="utf-8") as f:
+                for line in f:
+                    line = line.rstrip("\n")
+                    if not line.strip():
+                        continue
+                    yield (_json.loads(line)["text"] if is_jsonl
+                           else line)
+
+    tk = _Tok(BPE(unk_token=None))
+    tk.pre_tokenizer = _BL(add_prefix_space=False)
+    tk.decoder = _BLDec()
+    trainer = BpeTrainer(
+        vocab_size=vocab_size, min_frequency=min_frequency,
+        special_tokens=["<pad>", "<bos>", "<eos>"],
+        initial_alphabet=_BL.alphabet(),
+        show_progress=False)
+    tk.train_from_iterator(lines(), trainer=trainer)
+
+    os.makedirs(out_dir, exist_ok=True)
+    tk.save(os.path.join(out_dir, "tokenizer.json"))
+    with open(os.path.join(out_dir, "tokenizer_config.json"), "w") as f:
+        _json.dump({"tokenizer_class": "PreTrainedTokenizerFast",
+                    "pad_token": "<pad>", "bos_token": "<bos>",
+                    "eos_token": "<eos>"}, f, indent=1)
+    return HFTokenizer(out_dir)
+
+
+def main(argv=None) -> int:
+    """``python -m kubedl_tpu.tokenizer CORPUS [CORPUS...] OUT_DIR``."""
+    import argparse
+
+    p = argparse.ArgumentParser(prog="python -m kubedl_tpu.tokenizer")
+    p.add_argument("corpus", nargs="+",
+                   help="text/.jsonl corpus file(s), then the output dir")
+    p.add_argument("--vocab", type=int, default=8192)
+    p.add_argument("--min-frequency", type=int, default=2)
+    args = p.parse_args(argv)
+    if len(args.corpus) < 2:
+        p.error("need at least one corpus file and an output dir")
+    *paths, out = args.corpus
+    tok = train_tokenizer(paths, out, vocab_size=args.vocab,
+                          min_frequency=args.min_frequency)
+    print(f"trained tokenizer: vocab {tok.vocab_size} -> {out}")
+    return 0
+
+
 __all__ = ["ByteTokenizer", "HFTokenizer", "StreamDecoder",
            "load_tokenizer", "encode_prompt", "render_chat",
            "text_documents", "has_tokenizer_assets",
-           "copy_tokenizer_assets", "TOKENIZER_ASSETS"]
+           "copy_tokenizer_assets", "train_tokenizer",
+           "TOKENIZER_ASSETS"]
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -152,6 +152,43 @@ def test_text_documents_txt_and_jsonl(tmp_path):
     assert [tok.decode(d) for d in docs] == ["row a", "row b"]
 
 
+def test_train_tokenizer_from_corpus(tmp_path):
+    """BPE training on a raw corpus produces a standard HF asset dir:
+    round-trips text, pins the pad/bos/eos convention, and loads through
+    the same load_tokenizer seam as shipped checkpoints."""
+    pytest.importorskip("tokenizers")
+    from kubedl_tpu.tokenizer import train_tokenizer
+
+    corpus = tmp_path / "corpus.txt"
+    corpus.write_text("\n".join(
+        f"the quick brown fox jumps over the lazy dog {i}"
+        for i in range(50)))
+    out = tmp_path / "tok"
+    tok = train_tokenizer(str(corpus), str(out), vocab_size=400)
+    assert tok.pad_id == 0 and tok.bos_id == 1 and tok.eos_id == 2
+    assert tok.vocab_size <= 400
+    s = "the quick brown fox"
+    assert tok.decode(tok.encode(s)) == s
+    # loadable through the standard seam (predictor auto-detect included)
+    from kubedl_tpu.tokenizer import has_tokenizer_assets
+    assert has_tokenizer_assets(str(out))
+    again = load_tokenizer(str(out))
+    assert again.encode(s) == tok.encode(s)
+
+
+def test_tokenizer_cli(tmp_path, capsys):
+    pytest.importorskip("tokenizers")
+    from kubedl_tpu.tokenizer import main as tok_main
+
+    corpus = tmp_path / "c.jsonl"
+    corpus.write_text("\n".join(
+        json.dumps({"text": f"sample text number {i}"}) for i in range(30)))
+    out = tmp_path / "tok"
+    assert tok_main([str(corpus), str(out), "--vocab", "300"]) == 0
+    assert "trained tokenizer" in capsys.readouterr().out
+    assert load_tokenizer(str(out)) is not None
+
+
 # -- text through the serving stack --------------------------------------
 
 @pytest.mark.slow
