@@ -57,24 +57,49 @@ export async function viewPlayground(app) {
       document.getElementById("pg-target").value.split("/");
     history.push({ role: "user", content: text });
     input.value = "";
+    // stream tokens into a live assistant message (SSE pass-through:
+    // /api/v1/inference/stream -> the predictor's OpenAI chunk events)
+    const reply = { role: "assistant", content: "" };
+    history.push(reply);
     render();
-    chat.insertAdjacentHTML("beforeend",
-      `<div class="msg assistant muted" id="pg-wait">…</div>`);
     try {
-      const res = await api("/inference/predict", {
+      const res = await fetch("/api/v1/inference/stream", {
         method: "POST",
+        headers: { "Content-Type": "application/json" },
         body: JSON.stringify({
-          namespace, name, messages: history,
+          namespace, name, messages: history.slice(0, -1),
           max_tokens: +document.getElementById("pg-max").value || 256,
           temperature: +document.getElementById("pg-temp").value || 0,
         }),
       });
-      const content =
-        res.choices?.[0]?.message?.content ?? res.choices?.[0]?.text ?? "";
-      history.push({ role: "assistant", content });
+      if (!res.ok) {
+        const err = await res.json().catch(() => ({}));
+        throw new Error(err.msg || `HTTP ${res.status}`);
+      }
+      const reader = res.body.getReader();
+      const dec = new TextDecoder();
+      let buf = "";
+      for (;;) {
+        const { done, value } = await reader.read();
+        if (done) break;
+        buf += dec.decode(value, { stream: true });
+        let nl;
+        while ((nl = buf.indexOf("\n")) >= 0) {
+          const line = buf.slice(0, nl).trim();
+          buf = buf.slice(nl + 1);
+          if (!line.startsWith("data: ") || line === "data: [DONE]") continue;
+          const chunk = JSON.parse(line.slice(6));
+          const delta = chunk.choices?.[0]?.delta?.content
+            ?? chunk.choices?.[0]?.text ?? "";
+          if (delta) {
+            reply.content += delta;
+            render();
+          }
+        }
+      }
     } catch (err) {
-      history.push({ role: "assistant", content: `[error] ${err.message}` });
+      reply.content += `[error] ${err.message}`;
+      render();
     }
-    render();
   };
 }

@@ -704,6 +704,43 @@ class ConsoleServer:
         except (urllib.error.URLError, TimeoutError, OSError) as e:
             raise ValueError(f"predictor unreachable: {e}")
 
+    def inference_stream(self, body: dict):
+        """Open (and return, unread) the predictor's SSE response for a
+        streaming chat/completion — same CR-derived target rule as the
+        buffered proxy."""
+        import urllib.error
+        import urllib.request
+
+        ns = body.get("namespace") or "default"
+        name = body.get("name") or ""
+        inf = self.proxy.api.try_get("Inference", ns, name)
+        if inf is None:
+            raise NotFound(f"inference {ns}/{name} not found")
+        fwd = {"max_tokens": int(body.get("max_tokens", 256)),
+               "stream": True}
+        for k in ("temperature", "top_p", "stop"):
+            if k in body:
+                fwd[k] = body[k]
+        if body.get("messages"):
+            route = "/v1/chat/completions"
+            fwd["messages"] = body["messages"]
+        elif body.get("prompt"):
+            route = "/v1/completions"
+            fwd["prompt"] = body["prompt"]
+        else:
+            raise ValueError("need messages or prompt")
+        req = urllib.request.Request(
+            self._predictor_base_url(inf) + route, method="POST",
+            data=json.dumps(fwd).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            return urllib.request.urlopen(
+                req, timeout=self.config.predictor_timeout_s)
+        except urllib.error.HTTPError as e:
+            raise ValueError(f"predictor returned {e.code}")
+        except (urllib.error.URLError, TimeoutError, OSError) as e:
+            raise ValueError(f"predictor unreachable: {e}")
+
     def _find_job(self, kind: str, ns: str, name: str) -> Optional[dict]:
         kinds = [kind] if kind else TRAINING_KINDS
         for kd in kinds:
@@ -805,9 +842,45 @@ class _ConsoleHandler(BaseHTTPRequestHandler):
             self._respond(413, {"code": 413, "msg": "body too large"}, [])
             return
         body = self.rfile.read(length) if length else b""
+        if parsed.path == "/api/v1/inference/stream" and method == "POST":
+            # SSE pass-through: can't ride the buffered route machinery
+            return self._stream_inference(body)
         status, payload, headers = self.server_ref.route(
             method, parsed.path, params, body, self._token())
         self._respond(status, payload, headers)
+
+    def _stream_inference(self, body: bytes):
+        """Pipe the predictor's SSE stream to the browser. Auth and
+        target resolution reuse the buffered route's rules; only the
+        byte-copy loop differs."""
+        srv = self.server_ref
+        user = srv.sessions.user(self._token())
+        if srv.users and user is None:
+            self._respond(401, {"code": 401, "msg": "not logged in"}, [])
+            return
+        try:
+            upstream = srv.inference_stream(json.loads(body or b"{}"))
+        except NotFound as e:
+            self._respond(404, {"code": 404, "msg": str(e)}, [])
+            return
+        except (ApiError, ValueError, KeyError) as e:
+            self._respond(400, {"code": 400,
+                                "msg": f"{type(e).__name__}: {e}"}, [])
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        try:
+            with upstream:
+                for raw in upstream:
+                    self.wfile.write(f"{len(raw):x}\r\n".encode()
+                                     + raw + b"\r\n")
+                    self.wfile.flush()
+            self.wfile.write(b"0\r\n\r\n")
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            self.close_connection = True
 
     def _respond(self, status: int, payload, headers):
         data = (payload if isinstance(payload, bytes)

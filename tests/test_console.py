@@ -681,3 +681,80 @@ def test_inference_predict_unreachable_predictor(api):
         assert "unreachable" in res["msg"]
     finally:
         server.stop()
+
+
+def test_inference_stream_passthrough(api):
+    """/api/v1/inference/stream pipes the predictor's SSE chunks through
+    byte-for-byte (auth enforced, CR-derived target)."""
+    import threading
+    import urllib.request
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Stub(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            self.rfile.read(int(self.headers["Content-Length"]))
+            assert self.path == "/v1/chat/completions"
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            for piece in ("he", "llo"):
+                data = ("data: " + json.dumps({"choices": [{
+                    "index": 0, "delta": {"content": piece},
+                    "finish_reason": None}]}) + "\n\n").encode()
+                self.wfile.write(f"{len(data):x}\r\n".encode()
+                                 + data + b"\r\n")
+            done = b"data: [DONE]\n\n"
+            self.wfile.write(f"{len(done):x}\r\n".encode() + done
+                             + b"\r\n0\r\n\r\n")
+
+    stub = ThreadingHTTPServer(("127.0.0.1", 0), Stub)
+    threading.Thread(target=stub.serve_forever, daemon=True).start()
+
+    api.create({"apiVersion": "serving.kubedl.io/v1alpha1",
+                "kind": "Inference",
+                "metadata": {"name": "live", "namespace": "default"},
+                "spec": {"framework": "JAXServing",
+                         "predictors": [{"name": "p"}]}})
+    server = ConsoleServer(DataProxy(api, None, None), ConsoleConfig(
+        port=0, users={"admin": "kubedl"},
+        predictor_resolver=lambda inf:
+            f"http://127.0.0.1:{stub.server_address[1]}")).start()
+    client = Client(server.url)
+    try:
+        login(client)
+        req = urllib.request.Request(
+            server.url + "/api/v1/inference/stream", method="POST",
+            data=json.dumps({"namespace": "default", "name": "live",
+                             "messages": [{"role": "user",
+                                           "content": "x"}]}).encode(),
+            headers={"Content-Type": "application/json",
+                     "Cookie": client.cookie})
+        with urllib.request.urlopen(req) as resp:
+            assert resp.headers["Content-Type"].startswith(
+                "text/event-stream")
+            lines = [ln.decode().strip() for ln in resp
+                     if ln.decode().strip().startswith("data: ")]
+        assert lines[-1] == "data: [DONE]"
+        deltas = [json.loads(ln[6:])["choices"][0]["delta"]["content"]
+                  for ln in lines[:-1]]
+        assert "".join(deltas) == "hello"
+
+        # unauthenticated stream requests are refused before any
+        # upstream connection
+        bare = urllib.request.Request(
+            server.url + "/api/v1/inference/stream", method="POST",
+            data=b"{}", headers={"Content-Type": "application/json"})
+        try:
+            urllib.request.urlopen(bare)
+            assert False, "expected 401"
+        except urllib.error.HTTPError as e:
+            assert e.code == 401
+    finally:
+        server.stop()
+        stub.shutdown()
