@@ -199,10 +199,7 @@ def run(gen: str, dev, note: str) -> dict:
                 f"bench shape (seq={seq}, hd={cfg.hd}) misses pallas alignment")
         attn_impl = "pallas"
 
-    def loss_fn(p, b):
-        return llama.loss_fn(cfg, p, b["tokens"], b["targets"])
-
-    def measure(b: int):
+    def measure(b: int, variant_cfg):
         """Tokens/s at batch ``b``; raises on OOM so the caller can step
         down the ladder. Timing rule: every measured window ends by
         PULLING THE SCALAR LOSS TO THE HOST, not by block_until_ready
@@ -211,17 +208,21 @@ def run(gen: str, dev, note: str) -> dict:
         FLOPs). The loss value cannot exist on the host before every
         step it depends on actually executed, so device_get is
         unfakeable; on a scalar it costs one tiny round trip."""
+        def loss_fn(pp, bb):
+            return llama.loss_fn(variant_cfg, pp, bb["tokens"],
+                                 bb["targets"])
         # one fused on-device init: over a relayed chip, per-tensor
         # eager init pays a round trip per weight
-        params = jax.jit(lambda k: llama.init_params(cfg, k))(
+        params = jax.jit(lambda k: llama.init_params(variant_cfg, k))(
             jax.random.PRNGKey(0))
         jax.block_until_ready(params)
-        trainer = Trainer(loss_fn, llama.param_specs(cfg), mesh,
+        trainer = Trainer(loss_fn, llama.param_specs(variant_cfg), mesh,
                           TrainConfig(warmup_steps=10, decay_steps=1000))
         state = trainer.init_state(params)
         # prefetch overlaps the host->device copy with the running step
         stream = prefetch_to_device(
-            synthetic_lm_batches(b, seq, cfg.vocab_size), mesh, size=2)
+            synthetic_lm_batches(b, seq, variant_cfg.vocab_size), mesh,
+            size=2)
         get = lambda: next(stream)  # noqa: E731
 
         state, loss = trainer.step(state, get())   # compile
@@ -239,18 +240,26 @@ def run(gen: str, dev, note: str) -> dict:
         float(jax.device_get(loss))
         return b * seq * n / (time.perf_counter() - t0)
 
-    # bigger batches raise arithmetic intensity (better MFU) until the
-    # optimizer+activation footprint overflows HBM: walk a descending
-    # ladder, falling back on OOM. BENCH_BATCH pins a single size.
-    ladder = ([int(os.environ["BENCH_BATCH"])]
-              if os.environ.get("BENCH_BATCH") else
-              [batch] if gen == "cpu" else
-              sorted({batch * 2, batch}, reverse=True))
+    # two MFU levers, walked as a ladder with OOM fallback: bigger
+    # batches raise arithmetic intensity; remat=False skips the backward
+    # recompute entirely (model-FLOPs MFU counts recompute as overhead).
+    # BENCH_BATCH/BENCH_REMAT pin a single candidate.
+    import dataclasses as _dc
+    if os.environ.get("BENCH_BATCH"):
+        ladder = [(int(os.environ["BENCH_BATCH"]),
+                   os.environ.get("BENCH_REMAT", "1") == "1")]
+    elif gen == "cpu":
+        ladder = [(batch, True)]
+    else:
+        ladder = [(batch, False), (batch * 2, True), (batch, True)]
     tokens_per_sec = None
-    for i, b in enumerate(ladder):
+    for i, (b, remat) in enumerate(ladder):
+        vcfg = cfg if remat == cfg.remat else _dc.replace(cfg,
+                                                          remat=remat)
         try:
-            tokens_per_sec = measure(b)
+            tokens_per_sec = measure(b, vcfg)
             batch = b
+            cfg = vcfg
             break
         except Exception as e:  # noqa: BLE001 — only OOM falls through
             msg = str(e)
@@ -258,8 +267,8 @@ def run(gen: str, dev, note: str) -> dict:
                    or "exceeds the limit" in msg)
             if not oom or i == len(ladder) - 1:
                 raise
-            print(f"# batch {b} OOM, stepping down", file=sys.stderr,
-                  flush=True)
+            print(f"# batch {b} remat={remat} OOM, next candidate",
+                  file=sys.stderr, flush=True)
             import gc
             gc.collect()
     flops_per_tok = model_flops_per_token(cfg, seq)
