@@ -41,6 +41,8 @@ engine paths (identical validation, metrics, and lane scheduling):
 * ``POST /v1/chat/completions`` — ``messages`` rendered through the
   tokenizer's chat template (``tokenizer.render_chat``), buffered or
   streaming delta chunks;
+* ``POST /v1/embeddings`` — masked mean-pool of the final hidden
+  states, L2-normalized (decoder-as-embedder);
 * ``GET /v1/models`` — model listing.
 """
 
@@ -89,6 +91,7 @@ class InferenceServer:
         # ThreadingHTTPServer's concurrent handlers without a lock
         import itertools
         self._openai_ids = itertools.count(1)
+        self._embed_fns: dict = {}   # (rows, pad_len) -> jitted embedder
         self.metrics = Registry()
         self._m_requests = self.metrics.counter(
             "kubedl_serving_requests_total",
@@ -442,6 +445,71 @@ class InferenceServer:
                       "total_tokens": prompt_tokens + completion_tokens},
         }
 
+    def openai_embeddings(self, body: dict) -> dict:
+        """``POST /v1/embeddings``: masked mean-pool of the model's final
+        hidden states, L2-normalized — the standard decoder-as-embedder
+        recipe. One jitted forward per (rows, padded-length) bucket;
+        serialized with generation on the device."""
+        tok = self._openai_tok()
+        from ..tokenizer import encode_prompt
+        inp = body.get("input")
+        if isinstance(inp, str):
+            texts = [inp]
+        elif isinstance(inp, list) and inp and \
+                all(isinstance(s, str) for s in inp):
+            texts = inp
+        else:
+            raise ValueError("input must be a string or list of strings")
+        if len(texts) > self.config.max_batch:
+            raise ValueError(f"batch {len(texts)} exceeds max_batch "
+                             f"{self.config.max_batch}")
+        ids = [encode_prompt(tok, t) for t in texts]
+
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from .engine import resolve_family
+        eng = self.engine
+        config, params = eng.config, eng.params
+        family = resolve_family(config)
+        longest = max(len(r) for r in ids)
+        pad_to = min(-(-longest // 128) * 128,
+                     getattr(config, "max_seq_len", 2048))
+        if longest > pad_to:
+            raise ValueError(
+                f"input of {longest} tokens exceeds the model context "
+                f"{pad_to}")
+        key = (len(ids), pad_to)
+        fn = self._embed_fns.get(key)
+        if fn is None:
+            def embed(params, tokens, nreal):
+                out = family.forward_hidden(config, params, tokens)
+                x = out[0] if isinstance(out, tuple) else out  # moe aux
+                mask = (jnp.arange(x.shape[1])[None, :]
+                        < nreal[:, None]).astype(jnp.float32)
+                pooled = jnp.sum(x.astype(jnp.float32) * mask[..., None],
+                                 axis=1) / jnp.maximum(
+                    jnp.sum(mask, axis=1, keepdims=True), 1.0)
+                return pooled / jnp.maximum(
+                    jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-9)
+            fn = self._embed_fns[key] = jax.jit(embed)
+        toks = np.zeros((len(ids), pad_to), np.int32)
+        for i, r in enumerate(ids):
+            toks[i, :len(r)] = r
+        nreal = np.asarray([len(r) for r in ids], np.int32)
+        with self._gen_lock:
+            vecs = np.asarray(fn(params, jnp.asarray(toks),
+                                 jnp.asarray(nreal)))
+        n_tok = int(nreal.sum())
+        return {
+            "object": "list", "model": self.config.model_name,
+            "data": [{"object": "embedding", "index": i,
+                      "embedding": [float(v) for v in vec]}
+                     for i, vec in enumerate(vecs)],
+            "usage": {"prompt_tokens": n_tok, "total_tokens": n_tok},
+        }
+
     def openai_stream(self, body: dict, chat: bool):
         """SSE chunk generator (validates before the first yield).
         Yields dicts (JSON chunks) and finally the raw ``[DONE]``
@@ -611,19 +679,23 @@ class _Handler(BaseHTTPRequestHandler):
         is_prefix = self.path == f"/v1/models/{cfg.model_name}:registerPrefix"
         is_chat = self.path == "/v1/chat/completions"
         is_cmpl = self.path == "/v1/completions"
+        is_embed = self.path == "/v1/embeddings"
         if self.path != f"/v1/models/{cfg.model_name}:predict" \
-                and not (is_prefix or is_chat or is_cmpl):
+                and not (is_prefix or is_chat or is_cmpl or is_embed):
             self._respond(404, {"error": f"no route {self.path}"})
             return
         t0 = time.perf_counter()
         mode = ("prefix" if is_prefix else "chat" if is_chat
-                else "completions" if is_cmpl else "predict")
+                else "completions" if is_cmpl
+                else "embeddings" if is_embed else "predict")
         outcome = "ok"
         try:
             length = int(self.headers.get("Content-Length") or 0)
             body = json.loads(self.rfile.read(length) or b"{}")
             if is_prefix:
                 self._respond(200, srv.register_prefix(body))
+            elif is_embed:
+                self._respond(200, srv.openai_embeddings(body))
             elif is_chat or is_cmpl:
                 if body.get("stream"):
                     outcome = self._respond_sse(
@@ -643,7 +715,7 @@ class _Handler(BaseHTTPRequestHandler):
                 self._respond(200, srv.predict(body))
         except (ValueError, KeyError, TypeError) as e:
             srv._m_requests.inc(mode=mode, status="error")
-            if is_chat or is_cmpl:
+            if is_chat or is_cmpl or is_embed:
                 # the envelope OpenAI SDKs parse (error.message/.type)
                 self._respond(400, {"error": {
                     "message": str(e), "type": "invalid_request_error",
@@ -658,7 +730,7 @@ class _Handler(BaseHTTPRequestHandler):
             self._respond(500, {"error": {
                 "message": msg, "type": "server_error",
                 "param": None, "code": None}}
-                if (is_chat or is_cmpl) else {"error": msg})
+                if (is_chat or is_cmpl or is_embed) else {"error": msg})
         else:
             srv._m_requests.inc(mode=mode, status=outcome)
             if outcome == "ok":

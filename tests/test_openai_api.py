@@ -200,3 +200,34 @@ def test_completions_validation(server):
     with pytest.raises(urllib.error.HTTPError) as ei:
         post(srv.url, "/v1/chat/completions", {"messages": "nope"})
     assert ei.value.code == 400
+
+
+def test_embeddings(server):
+    srv, tok = server
+    r = json.loads(post(srv.url, "/v1/embeddings", {
+        "input": ["hello tpu", "completely different text"]}).read())
+    assert r["object"] == "list"
+    assert [d["index"] for d in r["data"]] == [0, 1]
+    import math
+    v0, v1 = r["data"][0]["embedding"], r["data"][1]["embedding"]
+    assert len(v0) == len(v1) > 8
+    # unit-normalized
+    assert abs(sum(x * x for x in v0) - 1.0) < 1e-3
+    # deterministic: same input -> same vector; different input -> not
+    r2 = json.loads(post(srv.url, "/v1/embeddings", {
+        "input": "hello tpu"}).read())
+    assert r2["data"][0]["embedding"] == pytest.approx(v0, abs=1e-5)
+    cos = sum(a * b for a, b in zip(v0, v1))
+    assert cos < 0.999
+    assert r["usage"]["prompt_tokens"] == \
+        len(tok.encode("hello tpu", add_bos=True)) \
+        + len(tok.encode("completely different text", add_bos=True))
+
+
+def test_embeddings_validation(server):
+    srv, _ = server
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        post(srv.url, "/v1/embeddings", {"input": 5})
+    assert ei.value.code == 400
+    err = json.loads(ei.value.read())["error"]
+    assert err["type"] == "invalid_request_error"
