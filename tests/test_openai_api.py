@@ -231,3 +231,28 @@ def test_embeddings_validation(server):
     assert ei.value.code == 400
     err = json.loads(ei.value.read())["error"]
     assert err["type"] == "invalid_request_error"
+
+
+def test_inference_client(server):
+    """The first-party typed client maps 1:1 onto the OpenAI routes."""
+    from kubedl_tpu.client.inference import InferenceClient, InferenceError
+
+    srv, _ = server
+    c = InferenceClient(srv.url)
+    assert c.healthy()
+    assert c.models() == ["m"]
+
+    outs = c.complete("hello", max_tokens=4)
+    assert len(outs) == 1 and isinstance(outs[0], str)
+    assert "".join(c.complete_stream("hello", max_tokens=4)) == outs[0]
+
+    msgs = [{"role": "user", "content": "hey"}]
+    reply = c.chat(msgs, max_tokens=4)
+    assert "".join(c.chat_stream(msgs, max_tokens=4)) == reply
+
+    vecs = c.embed(["a", "b"])
+    assert len(vecs) == 2 and len(vecs[0]) > 8
+
+    with pytest.raises(InferenceError) as ei:
+        c.complete([], max_tokens=4)
+    assert ei.value.status == 400
