@@ -26,6 +26,13 @@ env). Honors the autoconfig contract end to end:
 
 SIGTERM (pod shutdown) stops the HTTP server, drains the engine, and
 exits 0 so rolling predictor updates are graceful.
+
+Offline batch inference (no HTTP): ``python -m kubedl_tpu.serving
+--batch-input prompts.jsonl --batch-output out.jsonl`` reads rows
+``{"prompt": "text" | [ids], "max_tokens"?: N}``, generates through the
+same engine the server would use (lanes, quantization, tokenizer all
+honored), writes ``{"prompt", "tokens", "text"?}`` rows in input order,
+and exits — bulk generation runs as a plain JAXJob.
 """
 
 from __future__ import annotations
@@ -88,9 +95,70 @@ def build_engine(model_path: str, lanes: int, quantize: str, spec_k: int,
         quantize=quantize or None, mesh=mesh).start()
 
 
-def main() -> int:
+def run_batch(engine, tokenizer, in_path: str, out_path: str,
+              default_max_tokens: int = 256) -> int:
+    """Offline bulk generation: all rows ride the continuous-batching
+    lanes concurrently; output preserves input order."""
+    import json
+
+    from ..tokenizer import encode_prompt
+    log = logging.getLogger("kubedl_tpu.serving")
+    rows = []
+    with open(in_path) as f:
+        for line in f:
+            if line.strip():
+                rows.append(json.loads(line))
+    if not rows:
+        raise ValueError(f"no rows in {in_path}")
+    prompts = []
+    for i, row in enumerate(rows):
+        p = row.get("prompt")
+        if isinstance(p, str):
+            if tokenizer is None:
+                raise ValueError(
+                    f"row {i}: text prompt needs a tokenizer "
+                    "($KUBEDL_TOKENIZER or assets in the model dir)")
+            prompts.append(encode_prompt(tokenizer, p))
+        elif isinstance(p, list) and p:
+            prompts.append([int(t) for t in p])
+        else:
+            raise ValueError(f"row {i}: prompt must be text or id list")
+    caps = [int(r.get("max_tokens", default_max_tokens)) for r in rows]
+    if hasattr(engine, "submit"):
+        for p, cap in zip(prompts, caps):
+            engine.validate(p, cap)
+        outs = [r.result() for r in
+                [engine.submit(p, cap) for p, cap in zip(prompts, caps)]]
+    else:
+        # speculative adapter: buffered generate, whole-set batches
+        outs = engine.generate(prompts, max(caps))
+        outs = [o[:cap] for o, cap in zip(outs, caps)]
+    done = 0
+    with open(out_path, "w") as f:
+        for row, toks in zip(rows, outs):
+            out = {"prompt": row["prompt"], "tokens": toks}
+            if tokenizer is not None:
+                out["text"] = tokenizer.decode(toks)
+            f.write(json.dumps(out) + "\n")
+            done += 1
+            if done % 50 == 0:
+                log.info("batch inference: %d/%d rows", done, len(rows))
+    log.info("batch inference: wrote %d rows to %s", len(rows), out_path)
+    return 0
+
+
+def main(argv=None) -> int:
+    import argparse
+
     logging.basicConfig(level=logging.INFO)
     log = logging.getLogger("kubedl_tpu.serving")
+    ap = argparse.ArgumentParser(prog="python -m kubedl_tpu.serving")
+    ap.add_argument("--batch-input", help="JSONL prompts for offline "
+                    "batch inference (no HTTP server)")
+    ap.add_argument("--batch-output", help="JSONL output path")
+    args = ap.parse_args(argv)
+    if bool(args.batch_input) != bool(args.batch_output):
+        ap.error("--batch-input and --batch-output go together")
     model_path = os.environ.get("KUBEDL_MODEL_PATH", "")
     if not model_path:
         log.error("KUBEDL_MODEL_PATH is required")
@@ -115,6 +183,14 @@ def main() -> int:
                                   else -1),
                           tokenizer_vocab=(tokenizer.vocab_size
                                            if tokenizer is not None else 0))
+    if args.batch_input:
+        try:
+            return run_batch(engine, tokenizer, args.batch_input,
+                             args.batch_output,
+                             default_max_tokens=int(os.environ.get(
+                                 "KUBEDL_SERVING_MAX_NEW", "256") or 256))
+        finally:
+            engine.stop()
     from .server import InferenceServer, ServerConfig
     server = InferenceServer(engine, ServerConfig(
         # `or`, not a get() default: the controller injects the var even

@@ -176,3 +176,34 @@ def test_tp_predictor_subprocess(artifacts):
         if proc.poll() is None:
             proc.kill()
             proc.wait(timeout=10)
+
+
+def test_batch_inference_mode(artifacts, tmp_path, monkeypatch):
+    """--batch-input/--batch-output: offline bulk generation through the
+    same engine, output in input order, no HTTP server."""
+    import json
+
+    from kubedl_tpu.serving.__main__ import main as serve_main
+
+    root, cfg, params = artifacts
+    # model vocab is 128 < byte tokenizer's 259, so use token-id prompts
+    rows = [{"prompt": [1 + i, 2, 3], "max_tokens": 4} for i in range(5)]
+    inp = tmp_path / "in.jsonl"
+    inp.write_text("\n".join(json.dumps(r) for r in rows))
+    out = tmp_path / "out.jsonl"
+    monkeypatch.setenv("KUBEDL_MODEL_PATH", str(root / "target"))
+    monkeypatch.setenv("KUBEDL_SERVING_LANES", "2")
+    monkeypatch.delenv("KUBEDL_TOKENIZER", raising=False)
+    assert serve_main(["--batch-input", str(inp),
+                       "--batch-output", str(out)]) == 0
+    got = [json.loads(ln) for ln in out.read_text().splitlines()]
+    assert len(got) == 5
+    # input order preserved; caps respected
+    assert [g["prompt"] for g in got] == [r["prompt"] for r in rows]
+    assert all(1 <= len(g["tokens"]) <= 4 for g in got)
+
+
+def test_batch_inference_flag_validation(capsys):
+    from kubedl_tpu.serving.__main__ import main as serve_main
+    with pytest.raises(SystemExit):
+        serve_main(["--batch-input", "only-one-side.jsonl"])
