@@ -74,7 +74,16 @@ class Request:
     top_p: Optional[float] = None
     done: threading.Event = field(default_factory=threading.Event)
     cancelled: bool = False
+    #: client-requested stop (set via :meth:`cancel`): the scheduler
+    #: frees the lane at its next tick; tokens decoded so far remain
+    cancel_requested: bool = False
     _cond: threading.Condition = field(default_factory=threading.Condition)
+
+    def cancel(self) -> None:
+        """Stop generating for this request (client went away / got what
+        it needed). Unlike engine shutdown, ``result()`` still returns
+        the tokens decoded so far."""
+        self.cancel_requested = True
 
     def result(self, timeout: Optional[float] = None) -> list:
         if not self.done.wait(timeout):
@@ -474,6 +483,9 @@ class ContinuousBatchingEngine:
     def _admit(self, lane_idx: int) -> None:
         gen = self.gen
         with self._cv:
+            while self._queue and self._queue[0].cancel_requested:
+                # cancelled while queued: never pay the prefill
+                self._queue.popleft()._finish()
             if not self._queue:
                 return
             req = self._queue.popleft()
@@ -585,7 +597,8 @@ class ContinuousBatchingEngine:
             lane.remaining -= 1
             self._cur[i, 0] = tok
             self._pos[i] = lane.pos
-            if (lane.remaining <= 0 or hit_stop(req.tokens, gen)
+            if (req.cancel_requested or lane.remaining <= 0
+                    or hit_stop(req.tokens, gen)
                     or lane.pos + 1 >= self.max_len):
                 lane.request = None   # lane freed for the next arrival
                 req._finish()

@@ -278,21 +278,28 @@ class InferenceServer:
                 req = self.engine.submit(prompt, cap, logprobs=want_lp,
                                          **sampling)
                 out, lps = [], []
-                # per-token bound: a stalled engine surfaces as an error
-                # event, not a silently frozen stream
-                for tok, lp in req.stream(
-                        timeout=self.config.request_timeout_s):
-                    if not out:
-                        self._m_ttft.observe(time.perf_counter() - t0)
-                    out.append(tok)
-                    # per token, not on completion: an aborted stream
-                    # must still account for what it already served
-                    self._m_tokens.inc()
-                    ev = {"token": tok}
-                    if lp is not None:
-                        ev["logprob"] = lp
-                        lps.append(lp)
-                    yield ev
+                try:
+                    # per-token bound: a stalled engine surfaces as an
+                    # error event, not a silently frozen stream
+                    for tok, lp in req.stream(
+                            timeout=self.config.request_timeout_s):
+                        if not out:
+                            self._m_ttft.observe(time.perf_counter() - t0)
+                        out.append(tok)
+                        # per token, not on completion: an aborted stream
+                        # must still account for what it already served
+                        self._m_tokens.inc()
+                        ev = {"token": tok}
+                        if lp is not None:
+                            ev["logprob"] = lp
+                            lps.append(lp)
+                        yield ev
+                finally:
+                    # abandoned stream (client disconnect, stop-string
+                    # early exit): free the lane instead of decoding the
+                    # remaining cap into the void
+                    if not req.done.is_set():
+                        req.cancel()
                 final = {"done": True, "tokens": out}
                 if want_lp:
                     final["logprobs"] = lps
@@ -559,9 +566,10 @@ class InferenceServer:
                     if cut:
                         yield chunk(piece=cut)
                     finish = "stop"
-                    # the lane keeps decoding to its cap server-side
-                    # (requests have no cancel); the client stream ends
-                    # now — the remaining tokens are simply dropped
+                    # closing `events` (GeneratorExit -> its finally)
+                    # cancels the lane, so the device stops decoding
+                    # tokens nobody will read
+                    events.close()
                     break
                 emit = (pending[:-holdback] if holdback
                         and len(pending) > holdback else

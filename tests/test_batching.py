@@ -384,3 +384,46 @@ def test_bad_sampling_params_rejected_at_submit(dense):
             eng.submit([1, 2], 4, **kwargs)
     # the engine still works after the rejections
     assert len(eng.run([([1, 2], 4)])[0]) == 4
+
+
+def test_cancel_frees_lane_and_keeps_partial_tokens(dense):
+    """Request.cancel(): the scheduler retires the lane at its next tick,
+    result() returns the partial output, and the freed lane serves the
+    next request; a request cancelled while queued never prefills."""
+    import time
+
+    cfg, params = dense
+    eng = ContinuousBatchingEngine(cfg, params, lanes=1, max_len=96).start()
+    try:
+        # throttle decode so the cancel lands mid-generation
+        real = eng._decode
+
+        def slow(*a, **kw):
+            time.sleep(0.03)
+            return real(*a, **kw)
+
+        eng._decode = slow
+        long_req = eng.submit([1, 2, 3], 64)
+        got = []
+        for tok, _ in long_req.stream(timeout=30):
+            got.append(tok)
+            if len(got) >= 3:
+                long_req.cancel()
+                break
+        partial = long_req.result(timeout=30)
+        assert 3 <= len(partial) < 64
+        assert partial[:3] == got
+
+        # queued-cancel: occupy the lane, queue one, cancel it before
+        # admission — it finishes empty without prefilling
+        blocker = eng.submit([5, 6], 24)
+        queued = eng.submit([7, 8], 8)
+        queued.cancel()
+        assert queued.result(timeout=30) == []
+        assert len(blocker.result(timeout=30)) <= 24
+
+        # the freed lane still serves new work
+        eng._decode = real
+        assert len(eng.submit([9, 10], 4).result(timeout=30)) >= 1
+    finally:
+        eng.stop()
