@@ -281,15 +281,33 @@ def main(argv=None) -> int:
     command from a HuggingFace checkpoint to a self-contained serving
     artifact — converted weights (``models.io`` layout) plus the
     checkpoint's tokenizer assets, so the predictor serves text with no
-    further configuration (``serving.__main__`` auto-detects them)."""
+    further configuration (``serving.__main__`` auto-detects them).
+    ``--reverse`` goes the other way: a framework artifact becomes a
+    loadable HF directory (config.json + model.safetensors)."""
     import argparse
 
     p = argparse.ArgumentParser(prog="python -m kubedl_tpu.models.convert")
-    p.add_argument("src", help="HuggingFace model directory")
-    p.add_argument("dst", help="output artifact directory")
+    p.add_argument("src", help="HuggingFace model directory (or, with "
+                   "--reverse, a framework artifact directory)")
+    p.add_argument("dst", help="output directory")
     p.add_argument("--no-tokenizer", action="store_true",
                    help="skip copying tokenizer assets")
+    p.add_argument("--reverse", action="store_true",
+                   help="export a framework artifact to HF format")
     args = p.parse_args(argv)
+
+    if args.reverse:
+        from ..tokenizer import copy_tokenizer_assets
+        from .io import load_model
+        config, params = load_model(args.src)
+        save_hf_checkpoint(config, params, args.dst)
+        copied = ([] if args.no_tokenizer
+                  else copy_tokenizer_assets(args.src, args.dst))
+        print(f"exported {args.src} -> {args.dst} (HF "
+              f"{config_to_hf(config)['model_type']} format"
+              + (f"; tokenizer assets: {', '.join(copied)}" if copied
+                 else "") + ")")
+        return 0
 
     config, params = load_hf_checkpoint(args.src)
     from .io import save_model
@@ -308,3 +326,117 @@ def main(argv=None) -> int:
 if __name__ == "__main__":
     import sys
     sys.exit(main())
+
+
+# -- reverse direction: this framework -> HuggingFace ---------------------
+
+_HF_ARCH = {"llama": "LlamaForCausalLM", "mistral": "MistralForCausalLM",
+            "qwen2": "Qwen2ForCausalLM", "gemma": "GemmaForCausalLM",
+            "gemma2": "Gemma2ForCausalLM"}
+
+
+def config_to_hf(config: LlamaConfig) -> dict:
+    """HF config.json dict for a LlamaConfig — the inverse of
+    ``config_from_hf`` (pinned by the round-trip test). The family is
+    derived from the knobs: sandwich norms -> gemma2, GeGLU -> gemma,
+    qkv biases -> qwen2, sliding window -> mistral, else llama."""
+    c = config
+    if c.sandwich_norms:
+        model_type = "gemma2"
+    elif c.act == "gelu":
+        model_type = "gemma"
+    elif c.qkv_bias:
+        model_type = "qwen2"
+    elif c.sliding_window:
+        model_type = "mistral"
+    else:
+        model_type = "llama"
+    out = {
+        "model_type": model_type,
+        "architectures": [_HF_ARCH[model_type]],
+        "vocab_size": c.vocab_size,
+        "hidden_size": c.d_model,
+        "intermediate_size": c.d_ff,
+        "num_hidden_layers": c.n_layers,
+        "num_attention_heads": c.n_heads,
+        "num_key_value_heads": c.n_kv_heads,
+        "rope_theta": c.rope_theta,
+        "rms_norm_eps": c.rms_eps,
+        "max_position_embeddings": c.max_seq_len,
+        "tie_word_embeddings": bool(c.tie_embeddings),
+        "torch_dtype": "float32",
+    }
+    if c.head_dim:
+        out["head_dim"] = c.head_dim
+    if model_type in ("mistral", "qwen2") and c.sliding_window:
+        out["sliding_window"] = c.sliding_window
+        out["use_sliding_window"] = True
+    if model_type in ("gemma", "gemma2"):
+        out["hidden_activation"] = "gelu_pytorch_tanh"
+    if model_type == "gemma2":
+        out["sliding_window"] = c.sliding_window
+        out["attn_logit_softcapping"] = c.attn_logit_softcap or None
+        out["final_logit_softcapping"] = c.logit_softcap or None
+        out["query_pre_attn_scalar"] = c.query_scale or 256.0
+    return out
+
+
+def to_hf(config: LlamaConfig, params: dict) -> dict:
+    """This family's param tree -> a HF ``*ForCausalLM`` state dict
+    (numpy float32 leaves, [out, in] linear layout) — the exact inverse
+    of ``from_hf``, so models move OUT of the framework too."""
+    import jax
+
+    c = config
+    host = jax.tree.map(lambda x: np.asarray(
+        jax.device_get(x), np.float32), params)
+    layers = host["layers"]
+    if isinstance(layers, dict):   # scan-stacked: [L, ...] per key
+        per_layer = [{k: v[i] for k, v in layers.items()}
+                     for i in range(c.n_layers)]
+    else:
+        per_layer = layers
+    sd = {"model.embed_tokens.weight": host["embed"],
+          "model.norm.weight": host["final_norm"]}
+    if not c.tie_embeddings:
+        sd["lm_head.weight"] = host["lm_head"].T
+    for i, lp in enumerate(per_layer):
+        p = f"model.layers.{i}."
+        sd[p + "self_attn.q_proj.weight"] = lp["wq"].T
+        sd[p + "self_attn.k_proj.weight"] = lp["wk"].T
+        sd[p + "self_attn.v_proj.weight"] = lp["wv"].T
+        sd[p + "self_attn.o_proj.weight"] = lp["wo"].T
+        sd[p + "mlp.gate_proj.weight"] = lp["w_gate"].T
+        sd[p + "mlp.up_proj.weight"] = lp["w_up"].T
+        sd[p + "mlp.down_proj.weight"] = lp["w_down"].T
+        sd[p + "input_layernorm.weight"] = lp["attn_norm"]
+        if c.sandwich_norms:
+            # inverse of from_hf's gemma2 remap
+            sd[p + "post_attention_layernorm.weight"] = lp["post_attn_norm"]
+            sd[p + "pre_feedforward_layernorm.weight"] = lp["mlp_norm"]
+            sd[p + "post_feedforward_layernorm.weight"] = lp["post_ffw_norm"]
+        else:
+            sd[p + "post_attention_layernorm.weight"] = lp["mlp_norm"]
+        if c.qkv_bias:
+            sd[p + "self_attn.q_proj.bias"] = lp["bq"]
+            sd[p + "self_attn.k_proj.bias"] = lp["bk"]
+            sd[p + "self_attn.v_proj.bias"] = lp["bv"]
+    # .T produces non-contiguous views, which safetensors serializes from
+    # the raw buffer (i.e. UNtransposed) — materialize C-order copies
+    return {k: np.ascontiguousarray(v) for k, v in sd.items()}
+
+
+def save_hf_checkpoint(config: LlamaConfig, params: dict,
+                       path: str) -> None:
+    """Write a loadable HF model directory: config.json +
+    model.safetensors (+ tokenizer assets if the caller copies them)."""
+    import json
+    import os
+
+    from safetensors.numpy import save_file
+
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, "config.json"), "w") as f:
+        json.dump(config_to_hf(config), f, indent=1)
+    save_file(to_hf(config, params),
+              os.path.join(path, "model.safetensors"))

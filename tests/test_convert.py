@@ -3,6 +3,8 @@ param tree and reproduce transformers' own logits — the strongest
 correctness pin the compute stack has (two independent implementations,
 one function)."""
 
+import dataclasses
+
 import numpy as np
 import pytest
 
@@ -207,3 +209,72 @@ def test_convert_cli_self_contained_artifact(tmp_path):
     dst2 = tmp_path / "bare"
     assert convert_mod.main([str(src), str(dst2), "--no-tokenizer"]) == 0
     assert not has_tokenizer_assets(str(dst2))
+
+
+def test_to_hf_roundtrip_exact():
+    """to_hf is the exact inverse of from_hf: params survive a full
+    out-and-back conversion bit-for-bit (llama, qwen2-bias, gemma2
+    sandwich variants)."""
+    from kubedl_tpu.models.convert import config_to_hf, to_hf
+
+    for kw in ({}, {"qkv_bias": True},
+               {"sandwich_norms": True, "sliding_window": 8,
+                "window_pattern": "alternate", "act": "gelu",
+                "norm_weight_offset": 1.0, "embed_scale": True,
+                "tie_embeddings": True, "query_scale": 16.0,
+                "attn_logit_softcap": 50.0, "logit_softcap": 30.0}):
+        import dataclasses as dc
+        cfg = dc.replace(llama.tiny(vocab=64), dtype=jnp.float32, **kw)
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        hf_cfg_dict = config_to_hf(cfg)
+        cfg2 = config_from_hf(hf_cfg_dict)
+        assert cfg2.n_kv_heads == cfg.n_kv_heads
+        assert cfg2.qkv_bias == cfg.qkv_bias
+        assert cfg2.sandwich_norms == cfg.sandwich_norms
+        params2 = from_hf(cfg2, to_hf(cfg, params), dtype=jnp.float32)
+        for k in params:
+            a, b = params[k], params2[k]
+            if k == "layers":
+                for name in a:
+                    np.testing.assert_array_equal(np.asarray(a[name]),
+                                                  np.asarray(b[name]),
+                                                  err_msg=name)
+            else:
+                np.testing.assert_array_equal(np.asarray(a),
+                                              np.asarray(b), err_msg=k)
+
+
+def test_save_hf_checkpoint_loads_in_transformers(tmp_path):
+    """The exported HF directory loads with stock transformers and
+    reproduces this framework's logits — models move OUT too."""
+    import dataclasses
+
+    from kubedl_tpu.models.convert import save_hf_checkpoint
+
+    cfg = dataclasses.replace(llama.tiny(vocab=64), dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(4))
+    out = tmp_path / "hf_export"
+    save_hf_checkpoint(cfg, params, str(out))
+
+    model = transformers.AutoModelForCausalLM.from_pretrained(
+        str(out), attn_implementation="eager")
+    tokens = [[3, 17, 42, 9, 1, 60, 5, 23]]
+    with torch.no_grad():
+        hf_logits = model(torch.tensor(tokens)).logits.numpy()
+    ours = np.asarray(llama.forward(cfg, params, jnp.asarray(tokens)))
+    np.testing.assert_allclose(ours, hf_logits, rtol=2e-4, atol=2e-4)
+
+
+def test_convert_cli_reverse(tmp_path):
+    from kubedl_tpu.models import io as mio
+    from kubedl_tpu.models import convert as convert_mod
+
+    cfg = dataclasses.replace(llama.tiny(vocab=48), dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(6))
+    art = tmp_path / "artifact"
+    mio.save_model(cfg, params, str(art))
+    out = tmp_path / "hf_out"
+    assert convert_mod.main(["--reverse", str(art), str(out)]) == 0
+    model = transformers.AutoModelForCausalLM.from_pretrained(
+        str(out), attn_implementation="eager")
+    assert model.config.vocab_size == 48
