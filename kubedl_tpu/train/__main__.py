@@ -709,6 +709,17 @@ def main(argv=None) -> int:
             from ..models.io import save_model
             save_model(config, host_params, export)
             log.info("exported model to %s", export)
+            hf_out = cfg.get("export_hf_path")
+            if hf_out:
+                # straight to HuggingFace format (only llama-family
+                # cores have an HF analog; MoE configs raise)
+                from ..models import moe
+                if isinstance(config, moe.MoEConfig):
+                    raise ValueError(
+                        "export_hf_path: MoE configs have no HF mapping")
+                from ..models.convert import save_hf_checkpoint
+                save_hf_checkpoint(config, host_params, hf_out)
+                log.info("exported HF checkpoint to %s", hf_out)
     return 0
 
 

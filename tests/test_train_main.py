@@ -84,6 +84,21 @@ def test_pretrain_run_exports_model(tmp_path, monkeypatch):
 
 
 @pytest.mark.slow
+def test_export_hf_path(tmp_path):
+    """export_hf_path writes a transformers-loadable directory next to
+    the framework artifact."""
+    cfg = _base_config(tmp_path, steps=1,
+                       export_hf_path=str(tmp_path / "hf_out"))
+    p = tmp_path / "cfg.json"
+    p.write_text(json.dumps(cfg))
+    assert main(["--config", str(p)]) == 0
+    transformers = pytest.importorskip("transformers")
+    model = transformers.AutoModelForCausalLM.from_pretrained(
+        str(tmp_path / "hf_out"))
+    assert model.config.vocab_size == 64
+
+
+@pytest.mark.slow
 def test_pretrain_token_file(tmp_path):
     toks = np.random.default_rng(0).integers(
         0, 64, size=40 * 33, dtype=np.int32)
