@@ -275,7 +275,11 @@ class ConsoleServer:
             return 403, {"code": 403, "msg": str(e)}, []
         except NotFound as e:
             return 404, {"code": 404, "msg": str(e)}, []
-        except (ApiError, ValueError, KeyError) as e:
+        except (ApiError, ValueError, KeyError, TypeError,
+                AttributeError) as e:
+            # Type/AttributeError cover malformed bodies (null where a
+            # number belongs, non-dict JSON): a 400, never a dropped
+            # connection
             return 400, {"code": 400, "msg": f"{type(e).__name__}: {e}"}, []
 
     def _is_admin(self, user) -> bool:
@@ -657,21 +661,19 @@ class ConsoleServer:
             m.get_in(inf, "spec", "framework", default=""), 8000)
         return (f"http://{m.name(inf)}.{m.namespace(inf)}.svc:{port}")
 
-    def _inference_predict(self, body: dict) -> dict:
-        """Proxy one buffered generation to a deployed predictor's
-        OpenAI-convention surface (fixed paths — no model name needed).
-        The target URL derives only from the Inference CR, never from
-        the request, so the console can't be steered at arbitrary
-        hosts."""
-        import urllib.error
-        import urllib.request
-
+    def _inference_target(self, body: dict, stream: bool):
+        """(url, payload) for a playground generation — the ONE
+        CR-derived target rule for the buffered and streaming proxies
+        (the URL never derives from the request, so the console can't be
+        steered at arbitrary hosts)."""
         ns = body.get("namespace") or "default"
         name = body.get("name") or ""
         inf = self.proxy.api.try_get("Inference", ns, name)
         if inf is None:
             raise NotFound(f"inference {ns}/{name} not found")
         fwd = {"max_tokens": int(body.get("max_tokens", 256))}
+        if stream:
+            fwd["stream"] = True
         for k in ("temperature", "top_p", "stop"):
             if k in body:
                 fwd[k] = body[k]
@@ -683,7 +685,15 @@ class ConsoleServer:
                 **fwd, "prompt": body["prompt"]}
         else:
             raise ValueError("need messages or prompt")
-        url = self._predictor_base_url(inf) + route
+        return self._predictor_base_url(inf) + route, payload
+
+    def _inference_predict(self, body: dict) -> dict:
+        """Proxy one buffered generation to a deployed predictor's
+        OpenAI-convention surface (fixed paths — no model name needed)."""
+        import urllib.error
+        import urllib.request
+
+        url, payload = self._inference_target(body, stream=False)
         req = urllib.request.Request(
             url, method="POST", data=json.dumps(payload).encode(),
             headers={"Content-Type": "application/json"})
@@ -711,27 +721,9 @@ class ConsoleServer:
         import urllib.error
         import urllib.request
 
-        ns = body.get("namespace") or "default"
-        name = body.get("name") or ""
-        inf = self.proxy.api.try_get("Inference", ns, name)
-        if inf is None:
-            raise NotFound(f"inference {ns}/{name} not found")
-        fwd = {"max_tokens": int(body.get("max_tokens", 256)),
-               "stream": True}
-        for k in ("temperature", "top_p", "stop"):
-            if k in body:
-                fwd[k] = body[k]
-        if body.get("messages"):
-            route = "/v1/chat/completions"
-            fwd["messages"] = body["messages"]
-        elif body.get("prompt"):
-            route = "/v1/completions"
-            fwd["prompt"] = body["prompt"]
-        else:
-            raise ValueError("need messages or prompt")
+        url, payload = self._inference_target(body, stream=True)
         req = urllib.request.Request(
-            self._predictor_base_url(inf) + route, method="POST",
-            data=json.dumps(fwd).encode(),
+            url, method="POST", data=json.dumps(payload).encode(),
             headers={"Content-Type": "application/json"})
         try:
             return urllib.request.urlopen(
@@ -863,7 +855,8 @@ class _ConsoleHandler(BaseHTTPRequestHandler):
         except NotFound as e:
             self._respond(404, {"code": 404, "msg": str(e)}, [])
             return
-        except (ApiError, ValueError, KeyError) as e:
+        except (ApiError, ValueError, KeyError, TypeError,
+                AttributeError) as e:
             self._respond(400, {"code": 400,
                                 "msg": f"{type(e).__name__}: {e}"}, [])
             return

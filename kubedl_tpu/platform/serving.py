@@ -139,7 +139,8 @@ class InferenceReconciler(Reconciler):
     (reference ``inference_controller.go:93-145``)."""
 
     kind = "Inference"
-    owns = ("Deployment", "Service", "VirtualService")
+    owns = ("Deployment", "Service", "VirtualService",
+            "HorizontalPodAutoscaler")
 
     def __init__(self, api: APIServer, recorder=None):
         self.api = api
@@ -336,7 +337,14 @@ class InferenceReconciler(Reconciler):
                 self.recorder.event(
                     inf, "Warning", "InvalidAutoScale",
                     f"predictor {predictor.get('name', '')}: maxReplicas "
-                    f"{max_r} < minReplicas {min_r}; autoscaler skipped")
+                    f"{max_r} < minReplicas {min_r}; autoscaler removed")
+            if existing is not None:
+                # a stale HPA would keep scaling with the OLD bounds —
+                # worse than no autoscaler while the spec is invalid
+                try:
+                    self.api.delete("HorizontalPodAutoscaler", ns, name)
+                except NotFound:
+                    pass
             return
         desired = {
             "scaleTargetRef": {"apiVersion": "apps/v1",
@@ -536,10 +544,11 @@ class InferenceReconciler(Reconciler):
                 pass
 
     def _prune_removed_predictors(self, inf: dict, predictors: list) -> None:
-        """Drop Deployments/Services for predictors removed from the spec."""
+        """Drop Deployments/Services/HPAs for predictors removed from
+        the spec."""
         ns = m.namespace(inf)
         want = {predictor_name(inf, p) for p in predictors} | {m.name(inf)}
-        for kind in ("Deployment", "Service"):
+        for kind in ("Deployment", "Service", "HorizontalPodAutoscaler"):
             for obj in self.api.list(kind, ns):
                 if not m.is_controlled_by(obj, inf):
                     continue

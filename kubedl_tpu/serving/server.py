@@ -91,7 +91,7 @@ class InferenceServer:
         # ThreadingHTTPServer's concurrent handlers without a lock
         import itertools
         self._openai_ids = itertools.count(1)
-        self._embed_fns: dict = {}   # (rows, pad_len) -> jitted embedder
+        self._embed_fn = None        # lazily-built jitted embedder
         self.metrics = Registry()
         self._m_requests = self.metrics.counter(
             "kubedl_serving_requests_total",
@@ -418,6 +418,16 @@ class InferenceServer:
             completion_tokens += len(toks)
             text, matched = self._apply_stop(pred["text"], stop)
             finish = "stop" if matched or len(toks) < cap else "length"
+            if matched and want_lp:
+                # align logprobs with the truncated text: keep the
+                # shortest token prefix whose decode already contains a
+                # stop match (clients zip logprobs.tokens against text)
+                for j in range(1, len(toks) + 1):
+                    if self._apply_stop(tok.decode(toks[:j]), stop)[1]:
+                        toks = toks[:j]
+                        pred = {**pred,
+                                "logprobs": pred["logprobs"][:j]}
+                        break
             lp = None
             if want_lp:
                 pieces = [tok.decode([t]) for t in toks]
@@ -487,9 +497,7 @@ class InferenceServer:
             raise ValueError(
                 f"input of {longest} tokens exceeds the model context "
                 f"{pad_to}")
-        key = (len(ids), pad_to)
-        fn = self._embed_fns.get(key)
-        if fn is None:
+        if self._embed_fn is None:
             def embed(params, tokens, nreal):
                 out = family.forward_hidden(config, params, tokens)
                 x = out[0] if isinstance(out, tuple) else out  # moe aux
@@ -500,14 +508,22 @@ class InferenceServer:
                     jnp.sum(mask, axis=1, keepdims=True), 1.0)
                 return pooled / jnp.maximum(
                     jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-9)
-            fn = self._embed_fns[key] = jax.jit(embed)
-        toks = np.zeros((len(ids), pad_to), np.int32)
+            # jit caches per input SHAPE; row counts are bucketed below
+            # so compiles are bounded by length buckets, not by every
+            # distinct client batch size
+            self._embed_fn = jax.jit(embed)
+        rows = 1
+        while rows < len(ids):
+            rows *= 2
+        toks = np.zeros((rows, pad_to), np.int32)
         for i, r in enumerate(ids):
             toks[i, :len(r)] = r
-        nreal = np.asarray([len(r) for r in ids], np.int32)
+        nreal = np.zeros((rows,), np.int32)
+        nreal[:len(ids)] = [len(r) for r in ids]
         with self._gen_lock:
-            vecs = np.asarray(fn(params, jnp.asarray(toks),
-                                 jnp.asarray(nreal)))
+            vecs = np.asarray(self._embed_fn(
+                params, jnp.asarray(toks),
+                jnp.asarray(nreal)))[:len(ids)]
         n_tok = int(nreal.sum())
         return {
             "object": "list", "model": self.config.model_name,

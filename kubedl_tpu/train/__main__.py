@@ -622,10 +622,8 @@ def main(argv=None) -> int:
     if lora_cfg:
         # adapter-only fine-tuning: the base stays frozen (closed over),
         # the optimizer state is adapter-sized, and export folds the
-        # adapters back into dense weights (ops/lora.py)
-        if mode not in ("pretrain", "sft"):
-            raise ValueError("lora applies to mode pretrain/sft (dpo and "
-                             "grpo tune full weights)")
+        # adapters back into dense weights (ops/lora.py). Mode
+        # compatibility was validated before any data files opened.
         from ..ops import lora as lora_mod
         rank = int(lora_cfg.get("rank", 8))
         alpha = float(lora_cfg.get("alpha", 16.0))

@@ -262,3 +262,32 @@ def test_predictor_autoscale_invalid_is_skipped(api, op_serving):
     events = [e for e in api.list("Event", "default")
               if e.get("reason") == "InvalidAutoScale"]
     assert events
+
+
+def test_predictor_removal_prunes_hpa(api, op_serving):
+    """Removing a predictor (not just its autoScale) deletes its HPA
+    along with the Deployment/Service."""
+    inf = {
+        "apiVersion": "serving.kubedl.io/v1alpha1", "kind": "Inference",
+        "metadata": {"name": "prune", "namespace": "default"},
+        "spec": {"framework": "JAXServing", "predictors": [
+            {"name": "a", "replicas": 1,
+             "autoScale": {"minReplicas": 1, "maxReplicas": 3},
+             "template": {"spec": {"containers": [
+                 {"name": "srv", "image": "img"}]}}},
+            {"name": "b", "replicas": 1,
+             "template": {"spec": {"containers": [
+                 {"name": "srv", "image": "img"}]}}}]},
+    }
+    api.create(inf)
+    op_serving.run_until_idle(max_iterations=50)
+    assert api.get("HorizontalPodAutoscaler", "default", "prune-a")
+
+    inf = api.get("Inference", "default", "prune")
+    inf["spec"]["predictors"] = inf["spec"]["predictors"][1:]   # drop a
+    api.update(inf)
+    op_serving.run_until_idle(max_iterations=50)
+    assert api.try_get("Deployment", "default", "prune-a") is None
+    assert api.try_get("HorizontalPodAutoscaler", "default",
+                       "prune-a") is None
+    assert api.get("Deployment", "default", "prune-b")
