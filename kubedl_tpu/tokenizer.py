@@ -299,19 +299,59 @@ def train_tokenizer(corpus_paths, out_dir: str, vocab_size: int = 8192,
     return HFTokenizer(out_dir)
 
 
+def encode_corpus(corpus_paths, tokenizer, out_path: str) -> int:
+    """Tokenize raw corpora into the flat int32 token file that
+    ``train.data.TokenFileDataset`` memory-maps (the ``tokens`` data
+    kind): documents separated by bos/eos, streamed — a corpus is never
+    fully resident. Returns the token count."""
+    import numpy as np
+
+    if isinstance(corpus_paths, str):
+        corpus_paths = [corpus_paths]
+    n = 0
+    with open(out_path, "wb") as f:
+        for path in corpus_paths:
+            buf = []
+            for doc in text_documents(path, tokenizer):
+                buf.extend(doc)
+                if len(buf) >= 1 << 20:
+                    np.asarray(buf, np.int32).tofile(f)
+                    n += len(buf)
+                    buf = []
+            if buf:
+                np.asarray(buf, np.int32).tofile(f)
+                n += len(buf)
+    return n
+
+
 def main(argv=None) -> int:
-    """``python -m kubedl_tpu.tokenizer CORPUS [CORPUS...] OUT_DIR``."""
+    """``python -m kubedl_tpu.tokenizer CORPUS [CORPUS...] OUT_DIR``
+    trains a BPE tokenizer; with ``--encode TOK_SPEC`` it instead
+    tokenizes the corpora into a flat int32 token file (the ``tokens``
+    training-data kind), so corpus prep is one command either way."""
     import argparse
 
     p = argparse.ArgumentParser(prog="python -m kubedl_tpu.tokenizer")
     p.add_argument("corpus", nargs="+",
-                   help="text/.jsonl corpus file(s), then the output dir")
+                   help="text/.jsonl corpus file(s), then the output "
+                        "dir (train) or file (--encode)")
     p.add_argument("--vocab", type=int, default=8192)
     p.add_argument("--min-frequency", type=int, default=2)
+    p.add_argument("--encode", metavar="TOK_SPEC",
+                   help="skip training: tokenize the corpora with this "
+                        "tokenizer ('byte' or a local dir) into a flat "
+                        "int32 token file at the output path")
     args = p.parse_args(argv)
     if len(args.corpus) < 2:
-        p.error("need at least one corpus file and an output dir")
+        p.error("need at least one corpus file and an output path")
     *paths, out = args.corpus
+    if args.encode:
+        tok = load_tokenizer(args.encode)
+        if tok is None:
+            p.error("--encode needs a tokenizer spec")
+        n = encode_corpus(paths, tok, out)
+        print(f"encoded {n} tokens -> {out}")
+        return 0
     tok = train_tokenizer(paths, out, vocab_size=args.vocab,
                           min_frequency=args.min_frequency)
     print(f"trained tokenizer: vocab {tok.vocab_size} -> {out}")

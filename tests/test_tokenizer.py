@@ -285,3 +285,35 @@ class TestTextServing:
         token_evs = [e for e in events if "token" in e]
         assert len(token_evs) == len(final["tokens"])
         assert all("text" in e for e in token_evs)
+
+
+def test_encode_corpus_to_token_file(tmp_path):
+    """--encode produces the flat int32 file TokenFileDataset memmaps —
+    corpus prep for the `tokens` data kind in one command."""
+    import numpy as np
+
+    from kubedl_tpu.tokenizer import encode_corpus, main as tok_main
+
+    corpus = tmp_path / "c.txt"
+    corpus.write_text("hello world\nsecond doc\n")
+    out = tmp_path / "corpus.bin"
+    tok = ByteTokenizer()
+    n = encode_corpus(str(corpus), tok, str(out))
+    arr = np.fromfile(out, np.int32)
+    assert len(arr) == n
+    # bos/eos separate the documents; payload round-trips
+    docs = []
+    cur = []
+    for t in arr:
+        if t == tok.bos_id:
+            cur = []
+        elif t == tok.eos_id:
+            docs.append(tok.decode(cur))
+        else:
+            cur.append(int(t))
+    assert docs == ["hello world", "second doc"]
+
+    # the CLI flavor
+    out2 = tmp_path / "c2.bin"
+    assert tok_main([str(corpus), str(out2), "--encode", "byte"]) == 0
+    assert np.array_equal(np.fromfile(out2, np.int32), arr)
