@@ -119,14 +119,43 @@ def resolve_model(cfg: dict):
 
 
 def data_stream(cfg: dict, config, mesh, batch: int, seq: int):
-    """Pretrain batch iterator per the ``data`` section."""
-    import jax
-
-    from .data import (TokenFileDataset, prefetch_to_device,
-                       synthetic_lm_batches)
+    """Pretrain batch iterator per the ``data`` section (device-placed,
+    prefetched)."""
+    from .data import prefetch_to_device
 
     data = cfg.get("data", {"kind": "synthetic"})
+    return prefetch_to_device(_raw_stream(data, config, batch, seq),
+                              mesh, size=2)
+
+
+def _raw_stream(data: dict, config, batch: int, seq: int):
+    """Host-side batch stream for one ``data`` spec; ``mixture``
+    composes sub-streams by weight (domain mixing: each step draws its
+    batch from one source, in expectation proportional to the
+    weights)."""
+    import jax
+
+    from .data import TokenFileDataset, synthetic_lm_batches
+
     kind = data.get("kind", "synthetic")
+    if kind == "mixture":
+        import numpy as np
+        sources = data.get("sources") or []
+        if len(sources) < 2:
+            raise ValueError("mixture needs >= 2 sources")
+        weights = np.asarray([float(s.get("weight", 1.0))
+                              for s in sources])
+        if (weights <= 0).any():
+            raise ValueError("mixture weights must be > 0")
+        weights = weights / weights.sum()
+        streams = [_raw_stream(s, config, batch, seq) for s in sources]
+        rng = np.random.default_rng(data.get("seed", 0)
+                                    + jax.process_index())
+
+        def mixed():
+            while True:
+                yield next(streams[rng.choice(len(streams), p=weights)])
+        return mixed()
     if kind == "synthetic":
         raw = synthetic_lm_batches(batch, seq, config.vocab_size,
                                    seed=data.get("seed", 0))
@@ -182,7 +211,7 @@ def data_stream(cfg: dict, config, mesh, batch: int, seq: int):
         raw = packed_epochs()
     else:
         raise ValueError(f"unknown data kind {kind!r} for pretrain")
-    return prefetch_to_device(raw, mesh, size=2)
+    return raw
 
 
 def build_eval_fn(cfg: dict, config, mesh, batch: int, seq: int,

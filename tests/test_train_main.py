@@ -359,3 +359,32 @@ def test_mode_and_data_validation(tmp_path):
         tmp_path, data={"kind": "webdataset"})))
     with pytest.raises(ValueError, match="unknown data kind"):
         main(["--config", str(p)])
+
+
+@pytest.mark.slow
+def test_mixture_data_kind(tmp_path):
+    """data.kind='mixture' draws batches from weighted sources."""
+    toks = np.arange(40 * 33, dtype=np.int32) % 64
+    f = tmp_path / "corpus.bin"
+    toks.tofile(f)
+    cfg = _base_config(tmp_path, steps=3, data={
+        "kind": "mixture", "sources": [
+            {"kind": "synthetic", "weight": 1.0},
+            {"kind": "tokens", "path": str(f), "weight": 2.0}]})
+    p = tmp_path / "cfg.json"
+    p.write_text(json.dumps(cfg))
+    assert main(["--config", str(p)]) == 0
+
+
+def test_mixture_validation(tmp_path):
+    cfg = _base_config(tmp_path, data={"kind": "mixture", "sources": [
+        {"kind": "synthetic"}]})
+    p = tmp_path / "cfg.json"
+    p.write_text(json.dumps(cfg))
+    with pytest.raises(ValueError, match=">= 2 sources"):
+        main(["--config", str(p)])
+    cfg["data"]["sources"] = [{"kind": "synthetic", "weight": 0},
+                              {"kind": "synthetic"}]
+    p.write_text(json.dumps(cfg))
+    with pytest.raises(ValueError, match="weights must be > 0"):
+        main(["--config", str(p)])
