@@ -86,6 +86,11 @@ class LlamaConfig:
     #: "alternate": EVEN layers slide, odd are global (Gemma-2's
     #: layer_types rule) — toggled per layer as data inside one scan body
     window_pattern: str = "uniform"
+    #: context-parallel scheme when the mesh has cp > 1: "ring" (K/V
+    #: blocks rotate, O(s/cp) activations — max context length) or
+    #: "ulysses" (all-to-all heads<->seq — composes with packed
+    #: segments and every attention knob). parallel/{ring,ulysses}.py
+    cp_impl: str = "ring"
 
     def __post_init__(self):
         if self.sliding_window < 0:
@@ -97,6 +102,8 @@ class LlamaConfig:
         if self.window_pattern == "alternate" and not self.sliding_window:
             raise ValueError(
                 "window_pattern='alternate' needs sliding_window > 0")
+        if self.cp_impl not in ("ring", "ulysses"):
+            raise ValueError(f"unknown cp_impl {self.cp_impl!r}")
 
     @property
     def hd(self) -> int:
@@ -354,17 +361,27 @@ def attention_block(config: LlamaConfig, x, lp, cos, sin, segment_ids,
     v = _qkv(c, h, lp, "wv", "bv").reshape(b, s, nkv, hd)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
-    if mesh is not None and mesh.shape.get("cp", 1) > 1 and segment_ids is None:
+    cp_active = mesh is not None and mesh.shape.get("cp", 1) > 1
+    if cp_active and c.cp_impl == "ulysses":
+        # all-to-all sequence parallelism: every rank attends the FULL
+        # sequence for a head subset, so packed segments, windows, and
+        # the Gemma-2 knobs all compose (parallel/ulysses.py)
+        from ..parallel.ulysses import ulysses_attention
+        attn = ulysses_attention(mesh, q, k, v, segment_ids=segment_ids,
+                                 window_on=window_on, causal=True,
+                                 window=c.sliding_window, **knobs)
+    elif cp_active and segment_ids is None:
         # sequence sharded on cp: ring attention keeps the full-sequence
         # attention exact while K/V blocks rotate over ICI; a UNIFORM
         # sliding window rides the ring with global positions (dense
         # per-block path), so Mistral-style models train long-context
         # too — the Gemma-2 knobs (checked below) do not compose yet
+        # (cp_impl="ulysses" does support them)
         if knobs or window_on is not None:
             raise ValueError(
                 "Gemma-2 attention knobs (query scale / attn softcap / "
                 "alternate window pattern) are not supported with a "
-                "cp-sharded sequence yet")
+                "ring-sharded sequence; set cp_impl='ulysses'")
         attn = ring_attention(mesh, q, k, v, causal=True,
                               window=c.sliding_window)
     else:

@@ -1,0 +1,117 @@
+"""Ulysses-style sequence parallelism: all-to-all heads <-> sequence.
+
+The second context-parallel scheme beside ring attention
+(``parallel/ring.py``): instead of rotating K/V blocks around the cp
+ring, one ``all_to_all`` regroups the sharded activations so every cp
+rank holds the FULL sequence for a subset of heads, runs a completely
+ordinary local attention (the pallas flash kernel on TPU), and a second
+``all_to_all`` restores the sequence sharding.
+
+Trade-offs vs ring (why both exist):
+
+* Ulysses runs the unmodified single-device attention locally, so
+  EVERYTHING composes: packed segment ids, sliding windows, Gemma-2
+  query-scale/softcap/alternating windows — the combinations the ring
+  path refuses. Communication is two all-to-alls of the activations
+  (O(b·s·d/cp) per rank), independent of sequence length per step.
+* Ring never materializes the full sequence on any rank, so its
+  activation memory stays O(s/cp) — the choice for maximum context
+  length — and K/V transfers overlap with per-block compute.
+
+Select per model with ``LlamaConfig.cp_impl = "ring" | "ulysses"``.
+GQA/MQA K/V are expanded to full query heads before the split so the
+head chunks pair with their groups correctly (same policy as the ring
+entry's tp handling); cp therefore needs ``local query heads % cp == 0``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops import attention as _attn
+from ..ops.attention import repeat_kv as _repeat_kv
+
+
+def ulysses_attention_p(q, k, v, segment_ids=None, window_on=None,
+                        axis_name: str = "cp", causal: bool = True,
+                        window: int = 0, knobs=None):
+    """Per-shard body; must run under ``shard_map`` with ``axis_name``
+    bound. q/k/v: [b, s_local, h_local, hd] with K/V already expanded to
+    the query head count. Returns [b, s_local, h_local, hd]."""
+    a2a = functools.partial(jax.lax.all_to_all, axis_name=axis_name,
+                            tiled=True)
+    # seq-sharded -> head-sharded: every rank sees the whole sequence
+    q = a2a(q, split_axis=2, concat_axis=1)
+    k = a2a(k, split_axis=2, concat_axis=1)
+    v = a2a(v, split_axis=2, concat_axis=1)
+    if segment_ids is not None:
+        segment_ids = jax.lax.all_gather(segment_ids, axis_name, axis=1,
+                                         tiled=True)
+    attn = _attn.multi_head_attention(
+        q, k, v, causal=causal, segment_ids=segment_ids, window=window,
+        window_on=window_on, **(knobs or {}))
+    # head-sharded -> seq-sharded
+    return a2a(attn, split_axis=1, concat_axis=2)
+
+
+def ulysses_attention(mesh: Mesh, q, k, v, segment_ids=None,
+                      window_on=None, causal: bool = True,
+                      axis_name: str = "cp", window: int = 0, **knobs):
+    """Sharded entry point, mirroring ``ring_attention``'s layout:
+    [batch, seq, heads, head_dim] with batch on (dp, fsdp), seq on cp,
+    heads on tp."""
+    cp = mesh.shape.get(axis_name, 1)
+    tp = mesh.shape.get("tp", 1)
+    h, nkv = q.shape[2], k.shape[2]
+    heads = "tp" if (tp > 1 and h % tp == 0) else None
+    h_local = h // tp if heads else h
+    if h_local % cp:
+        raise ValueError(
+            f"ulysses needs the tp-local query head count ({h_local}) "
+            f"divisible by cp ({cp})")
+    if nkv != h:
+        # expand K/V to full query heads so each head chunk carries its
+        # own keys (chunked GQA grouping would otherwise pair head
+        # chunks with the wrong kv chunks)
+        k = _repeat_kv(k, h)
+        v = _repeat_kv(v, h)
+    spec = P(("dp", "fsdp"), axis_name, heads, None)
+    in_specs = [spec, spec, spec]
+    args = [q, k, v]
+    body = functools.partial(ulysses_attention_p, axis_name=axis_name,
+                             causal=causal, window=window, knobs=knobs)
+    if segment_ids is not None:
+        in_specs.append(P(("dp", "fsdp"), axis_name))
+        args.append(segment_ids)
+    else:
+        body = functools.partial(body, segment_ids=None)
+    if window_on is not None:
+        in_specs.append(P())          # traced scalar, replicated
+        args.append(window_on)
+    else:
+        body = functools.partial(body, window_on=None)
+
+    def wrapped(*xs):
+        q_, k_, v_ = xs[0], xs[1], xs[2]
+        rest = list(xs[3:])
+        seg = rest.pop(0) if segment_ids is not None else None
+        won = rest.pop(0) if window_on is not None else None
+        kw = {}
+        if segment_ids is not None:
+            kw["segment_ids"] = seg
+        if window_on is not None:
+            kw["window_on"] = won
+        return body(q_, k_, v_, **kw)
+
+    fn = jax.shard_map(wrapped, mesh=mesh, in_specs=tuple(in_specs),
+                       out_specs=spec,
+                       # pallas flash outputs carry no varying-axes type
+                       # on TPU (same relaxation as the ring flash path)
+                       check_vma=not _attn._on_tpu())
+    return fn(*args)
+
+
+__all__ = ["ulysses_attention", "ulysses_attention_p"]
