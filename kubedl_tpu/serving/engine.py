@@ -116,6 +116,33 @@ def sample_logits(logits, key, temperature, top_k, top_p=1.0):
     return jax.random.categorical(key, logits).astype(jnp.int32)
 
 
+def filtered_probs(logits, temperature: float, top_k: int = 0,
+                   top_p: float = 1.0):
+    """Host-side (numpy) probability vector after the SAME
+    temperature/top-k/top-p filtering as :func:`sample_logits` — the
+    speculative-sampling accept rule needs explicit p(token) for both
+    draft and target, which the jitted sampler never materializes. Keep
+    the two in sync."""
+    import numpy as np
+
+    x = np.asarray(logits, np.float64) / max(temperature, 1e-6)
+    if top_k > 0:
+        kth = np.sort(x)[-top_k]
+        x = np.where(x < kth, -np.inf, x)
+    if top_p < 1.0:
+        order = np.argsort(x)[::-1]
+        p_sorted = np.exp(x[order] - x[order[0]])
+        p_sorted = p_sorted / p_sorted.sum()
+        cum = np.cumsum(p_sorted)
+        keep_sorted = (cum - p_sorted) < top_p
+        keep_sorted[0] = True          # the nucleus never empties
+        cutoff = x[order][keep_sorted].min()
+        x = np.where(x < cutoff, -np.inf, x)
+    x = x - x.max()
+    p = np.exp(x)
+    return p / p.sum()
+
+
 @jax.jit
 def sample_logits_many(logits, key, temps, top_ks, top_ps):
     """Vectorized per-row sampler: ``logits [n, V]`` with PER-ROW

@@ -33,7 +33,30 @@ import jax.numpy as jnp
 import numpy as np
 
 from .batching import _bucket
-from .engine import GenerateConfig, hit_stop, maybe_quantize, resolve_family
+from .engine import (GenerateConfig, filtered_probs, hit_stop,
+                     maybe_quantize, resolve_family)
+
+
+def spec_accept(drafts, dprobs, tprobs, rng):
+    """The Leviathan et al. accept/resample rule, factored out so its
+    distribution guarantee is unit-testable without a model.
+
+    ``drafts``: k proposed tokens; ``dprobs``/``tprobs``: the draft's /
+    target's FILTERED probability vectors per slot (tprobs has k+1
+    entries — the last is the bonus slot). Returns ``(n_accepted,
+    next_token)`` where next_token is the resample on rejection or the
+    bonus sample on full acceptance. The marginal distribution of each
+    emitted token provably equals the target's."""
+    for i, x in enumerate(drafts):
+        if rng.random() >= min(1.0, float(tprobs[i][x])
+                               / max(float(dprobs[i][x]), 1e-20)):
+            resid = np.maximum(np.asarray(tprobs[i])
+                               - np.asarray(dprobs[i]), 0.0)
+            s = resid.sum()
+            p = resid / s if s > 0 else np.asarray(tprobs[i])
+            return i, int(rng.choice(len(p), p=p))
+    return len(drafts), int(rng.choice(len(tprobs[-1]),
+                                       p=np.asarray(tprobs[-1])))
 
 
 @dataclass
@@ -63,8 +86,9 @@ class SpeculativeServingAdapter:
         if return_logprobs:
             raise ValueError(
                 "logprobs are not available on the speculative path")
-        return [self.engine.generate(p, max_new_tokens, gen=self.gen)
-                for p in prompts]
+        return [self.engine.generate(p, max_new_tokens, gen=self.gen,
+                                     seed=seed + i)
+                for i, p in enumerate(prompts)]
 
     def stop(self) -> None:
         pass  # nothing running in the background
@@ -119,6 +143,34 @@ class SpeculativeEngine:
         self._t_verify = make_step(tc, tfam, all_logits=True)
         self._t_step = make_step(tc, tfam)
         self._d_step = make_step(dc, dfam)
+
+        def make_prefill_logits(cfg, fam):
+            @partial(jax.jit, donate_argnums=(1,))
+            def _prefill(params, cache, tokens, plen):
+                valid = (jnp.arange(cache["k"].shape[2]) < plen)[None, :]
+                logits, cache = fam.forward_step(
+                    cfg, params, tokens, cache, jnp.int32(0), valid=valid,
+                    last_pos=plen - 1)
+                return logits.astype(jnp.float32), cache
+            return _prefill
+
+        def make_step_logits(cfg, fam, all_logits=False):
+            @partial(jax.jit, donate_argnums=(1,))
+            def _step(params, cache, tokens, start):
+                logits, cache = fam.forward_step(cfg, params, tokens,
+                                                 cache, start,
+                                                 all_logits=all_logits)
+                return logits.astype(jnp.float32), cache
+            return _step
+
+        # sampled path (speculative SAMPLING): the accept rule needs the
+        # raw distributions, not argmaxes — built eagerly but compiled
+        # lazily by jit, so greedy-only deployments never pay for them
+        self._t_prefill_logits = make_prefill_logits(tc, tfam)
+        self._t_verify_logits = make_step_logits(tc, tfam,
+                                                 all_logits=True)
+        self._t_step_logits = make_step_logits(tc, tfam)
+        self._d_step_logits = make_step_logits(dc, dfam)
         self._reset_caches()
 
     def _reset_caches(self) -> None:
@@ -130,14 +182,19 @@ class SpeculativeEngine:
 
     def generate(self, prompt: Sequence[int], max_new_tokens: int,
                  stats: Optional[SpecStats] = None,
-                 gen: Optional[GenerateConfig] = None) -> list:
-        """Greedy continuation of ``prompt`` — identical tokens to the
-        target's own greedy decode, fewer target passes.
+                 gen: Optional[GenerateConfig] = None,
+                 seed: int = 0) -> list:
+        """Continuation of ``prompt``. Greedy (``gen.temperature <= 0``,
+        the default): token-identical to the target's own greedy decode,
+        fewer target passes. Sampled (``temperature > 0``): speculative
+        SAMPLING — the accept/resample rule (``spec_accept``) makes
+        every emitted token's marginal distribution exactly the
+        target's filtered distribution; ``seed`` pins the draw.
 
         ``gen`` carries eos_id/stop_sequences; the shared ``hit_stop``
         rule is applied to every emitted token (a verified chunk is
         truncated at the first stop), so outputs stay identical to the
-        static/continuous engines' greedy decode under the same config."""
+        static/continuous engines' decode contract."""
         prompt = list(prompt) or [0]
         plen = len(prompt)
         if plen + max_new_tokens > self.max_len:
@@ -145,6 +202,10 @@ class SpeculativeEngine:
                 f"prompt {plen} + new {max_new_tokens} exceeds "
                 f"cache capacity {self.max_len}")
         try:
+            if gen is not None and gen.temperature > 0.0:
+                return self._generate_sampled(prompt, plen,
+                                              max_new_tokens, stats, gen,
+                                              np.random.default_rng(seed))
             return self._generate(prompt, plen, max_new_tokens, stats, gen)
         except BaseException:
             # ANY abort (including KeyboardInterrupt) between a donating
@@ -152,6 +213,93 @@ class SpeculativeEngine:
             # self — restore invariants before propagating
             self._reset_caches()
             raise
+
+    def _generate_sampled(self, prompt, plen, max_new_tokens, stats, gen,
+                          rng):
+        """Speculative sampling round loop — same cache/position
+        bookkeeping as the greedy ``_generate`` (the verify chunk is
+        written once, rejected slots stay causally invisible after the
+        pointer rewind); only token selection differs: the draft SAMPLES
+        its proposals, and ``spec_accept`` keeps/replaces them so the
+        output distribution is exactly the target's."""
+        k = self.k
+        probs = partial(filtered_probs, temperature=gen.temperature,
+                        top_k=gen.top_k, top_p=gen.top_p)
+
+        win = max([1] + [len(s) for s in gen.stop_sequences])
+
+        def stop_len(out, start):
+            for i in range(start, len(out)):
+                if hit_stop(out[max(0, i + 1 - win):i + 1], gen):
+                    return i + 1
+            return None
+
+        t_cache, d_cache = self._t_cache, self._d_cache
+        bucket = min(_bucket(plen), self.max_len)
+        toks = np.zeros((1, bucket), np.int32)
+        toks[0, :plen] = prompt
+        toks = jnp.asarray(toks)
+        t_logits, t_cache = self._t_prefill_logits(self.tp, t_cache, toks,
+                                                   jnp.int32(plen))
+        p0 = probs(np.asarray(t_logits)[0])
+        y = int(rng.choice(len(p0), p=p0))
+        _, d_cache = self._d_prefill(self.dp, d_cache, toks,
+                                     jnp.int32(plen))
+        out = [y]
+        cut = stop_len(out, 0)
+        if cut is not None:
+            self._t_cache, self._d_cache = t_cache, d_cache
+            return out[:min(cut, max_new_tokens)]
+        pos = plen
+        while (max_new_tokens - len(out) >= 2
+               and pos + k + 1 < self.max_len):
+            drafts, dprobs = [], []
+            cur = y
+            for i in range(k):
+                d_logits, d_cache = self._d_step_logits(
+                    self.dp, d_cache,
+                    jnp.asarray([[cur]], jnp.int32), jnp.int32(pos + i))
+                dp = probs(np.asarray(d_logits)[0])
+                cur = int(rng.choice(len(dp), p=dp))
+                drafts.append(cur)
+                dprobs.append(dp)
+            chunk = jnp.asarray([[y] + drafts], jnp.int32)
+            t_logits, t_cache = self._t_verify_logits(
+                self.tp, t_cache, chunk, jnp.int32(pos))
+            tprobs = [probs(row) for row in np.asarray(t_logits)[0]]
+            accepted, nxt = spec_accept(drafts, dprobs, tprobs, rng)
+            if stats is not None:
+                stats.proposed += k
+                stats.accepted += accepted
+            emitted = list(drafts[:accepted]) + [nxt]
+            before = len(out)
+            out.extend(emitted)
+            cut = stop_len(out, before)
+            if cut is not None:
+                self._t_cache, self._d_cache = t_cache, d_cache
+                return out[:min(cut, max_new_tokens)]
+            if accepted == k:
+                # the k-th draft joined the sequence but never entered
+                # the draft cache (same backfill as the greedy loop)
+                _, d_cache = self._d_step(
+                    self.dp, d_cache,
+                    jnp.asarray([[drafts[-1]]], jnp.int32),
+                    jnp.int32(pos + k))
+            pos += accepted + 1
+            y = emitted[-1]
+        while len(out) < max_new_tokens and pos + 1 < self.max_len:
+            t_logits, t_cache = self._t_step_logits(
+                self.tp, t_cache, jnp.asarray([[y]], jnp.int32),
+                jnp.int32(pos))
+            pt = probs(np.asarray(t_logits)[0])
+            y = int(rng.choice(len(pt), p=pt))
+            out.append(y)
+            pos += 1
+            cut = stop_len(out, len(out) - 1)
+            if cut is not None:
+                break
+        self._t_cache, self._d_cache = t_cache, d_cache
+        return out[:max_new_tokens]
 
     def _generate(self, prompt, plen, max_new_tokens, stats, gen=None):
         k = self.k

@@ -1,0 +1,110 @@
+"""Speculative SAMPLING: the Leviathan accept/resample rule preserves
+the target distribution exactly, and the engine path produces
+deterministic-per-seed, stop-respecting sampled output
+(kubedl_tpu/serving/speculative.py)."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from kubedl_tpu.serving.speculative import spec_accept
+
+#: compile-heavy compute suite: excluded from `make test`'s fast path
+pytestmark = pytest.mark.slow
+
+
+def test_spec_accept_preserves_target_distribution():
+    """Classic speculative-sampling guarantee: the marginal of the first
+    emitted token equals the TARGET distribution, whatever the draft
+    proposes (k=1, tiny vocab, 60k trials, fixed seed)."""
+    dprobs = np.array([0.6, 0.3, 0.1])
+    tprobs = np.array([0.2, 0.5, 0.3])
+    rng = np.random.default_rng(0)
+    counts = np.zeros(3)
+    trials = 60_000
+    for _ in range(trials):
+        draft = int(rng.choice(3, p=dprobs))
+        accepted, nxt = spec_accept([draft], [dprobs],
+                                    [tprobs, tprobs], rng)
+        first = draft if accepted >= 1 else nxt
+        counts[first] += 1
+    np.testing.assert_allclose(counts / trials, tprobs, atol=0.01)
+
+
+def test_spec_accept_identical_distributions_accept_everything():
+    p = np.array([0.25, 0.25, 0.5])
+    rng = np.random.default_rng(1)
+    for _ in range(200):
+        draft = int(rng.choice(3, p=p))
+        accepted, nxt = spec_accept([draft], [p], [p, p], rng)
+        assert accepted == 1          # p_t/p_d == 1 -> always accepted
+        assert 0 <= nxt < 3           # bonus token from the target
+
+
+def test_spec_accept_disjoint_supports_reject_everything():
+    dprobs = np.array([1.0, 0.0, 0.0])
+    tprobs = np.array([0.0, 0.4, 0.6])
+    rng = np.random.default_rng(2)
+    for _ in range(100):
+        accepted, nxt = spec_accept([0], [dprobs], [tprobs, tprobs], rng)
+        assert accepted == 0
+        assert nxt in (1, 2)          # residual = target here
+
+
+def test_filtered_probs_matches_sampler_filtering():
+    from kubedl_tpu.serving.engine import filtered_probs
+
+    logits = np.array([3.0, 2.0, 1.0, 0.0, -1.0])
+    # plain temperature: softmax(logits / T)
+    p = filtered_probs(logits, temperature=2.0)
+    want = np.exp(logits / 2.0)
+    np.testing.assert_allclose(p, want / want.sum(), rtol=1e-6)
+    # top_k keeps the k largest, renormalized
+    p = filtered_probs(logits, temperature=1.0, top_k=2)
+    assert p[2:].sum() == 0 and abs(p.sum() - 1) < 1e-6
+    # top_p keeps the smallest prefix covering the mass
+    p = filtered_probs(logits, temperature=1.0, top_p=0.6)
+    assert p[0] > 0 and p[-1] == 0 and abs(p.sum() - 1) < 1e-6
+
+
+def test_sampled_speculative_engine():
+    import jax
+    import jax.numpy as jnp
+
+    from kubedl_tpu.models import llama
+    from kubedl_tpu.serving.engine import GenerateConfig
+    from kubedl_tpu.serving.speculative import SpeculativeEngine
+
+    tcfg = dataclasses.replace(llama.tiny(vocab=128), dtype=jnp.float32)
+    tparams = llama.init_params(tcfg, jax.random.PRNGKey(0))
+    dcfg = dataclasses.replace(
+        llama.tiny(vocab=128), d_model=64, n_layers=1, n_heads=2,
+        n_kv_heads=2, d_ff=128, dtype=jnp.float32)
+    dparams = llama.init_params(dcfg, jax.random.PRNGKey(1))
+    spec = SpeculativeEngine(tcfg, tparams, dcfg, dparams, k=3,
+                             max_len=128)
+    gen = GenerateConfig(max_len=128, temperature=1.0, top_p=0.9)
+
+    a = spec.generate([5, 7, 11], 12, gen=gen, seed=7)
+    b = spec.generate([5, 7, 11], 12, gen=gen, seed=7)
+    c = spec.generate([5, 7, 11], 12, gen=gen, seed=8)
+    assert a == b                      # deterministic per seed
+    assert len(a) == 12
+    assert all(0 <= t < 128 for t in a)
+    assert a != c or len(set(a)) == 1  # different seed -> (almost surely)
+    #                                    different sample
+
+    # greedy path untouched: temperature=0 still token-identical
+    from kubedl_tpu.serving.engine import InferenceEngine
+    want = InferenceEngine(tcfg, tparams,
+                           GenerateConfig(max_len=128)).generate(
+        [[5, 7, 11]], 12)[0]
+    assert spec.generate([5, 7, 11], 12,
+                         gen=GenerateConfig(max_len=128)) == want
+
+    # eos stops a sampled run
+    gen_eos = GenerateConfig(max_len=128, temperature=1.0, top_p=0.9,
+                             eos_id=a[2])
+    got = spec.generate([5, 7, 11], 12, gen=gen_eos, seed=7)
+    assert got == a[:3]
