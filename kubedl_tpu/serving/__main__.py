@@ -191,6 +191,17 @@ def main(argv=None) -> int:
                                  "KUBEDL_SERVING_MAX_NEW", "256") or 256))
         finally:
             engine.stop()
+    if os.environ.get("KUBEDL_SERVING_WARMUP", "1") == "1":
+        # pay the prefill+decode compiles BEFORE the HTTP server binds:
+        # the readiness probe then means "compiled and serving", and the
+        # first real request gets real-traffic latency
+        import time as _time
+        t0 = _time.perf_counter()
+        if hasattr(engine, "submit"):
+            engine.submit([1], 2).result(timeout=600)
+        else:
+            engine.generate([[1]], 2)
+        log.info("warmup compile done in %.1fs", _time.perf_counter() - t0)
     from .server import InferenceServer, ServerConfig
     server = InferenceServer(engine, ServerConfig(
         # `or`, not a get() default: the controller injects the var even
