@@ -570,13 +570,22 @@ class InferenceServer:
             # client
             holdback = max((len(s) for s in stop), default=1) - 1
             pending = ""
+            seen = ""       # all text received, incl. still-pending
             finish = None
             n_out = 0
             for ev in events:
-                if "token" not in ev:
-                    continue       # final summary handled after the loop
-                n_out += 1
-                pending += ev.get("text", "")
+                if "token" in ev:
+                    n_out += 1
+                    piece = ev.get("text", "")
+                elif ev.get("done"):
+                    # bytes the incremental decoder held back (a
+                    # generation cut mid-UTF-8-character) only appear in
+                    # the summary's full decode — emit the missing tail
+                    piece = ev.get("text", "")[len(seen):]
+                else:
+                    continue
+                seen += piece
+                pending += piece
                 cut, matched = self._apply_stop(pending, stop)
                 if matched:
                     if cut:

@@ -256,3 +256,54 @@ def test_inference_client(server):
     with pytest.raises(InferenceError) as ei:
         c.complete([], max_tokens=4)
     assert ei.value.status == 400
+
+
+def test_stream_emits_held_back_utf8_tail():
+    """A generation that ends mid-UTF-8-character: the incremental
+    decoder holds the bytes back, so the missing tail must come from the
+    done event's full decode (found by the round-4 end-to-end drive)."""
+    from kubedl_tpu.serving import InferenceServer, ServerConfig
+
+    import threading
+
+    class FakeReq:
+        # 'h' then an 0xE6 lead byte that never completes
+        toks = [ord("h") + 3, 0xE6 + 3]
+
+        def __init__(self):
+            self.tokens = self.toks
+            self.done = threading.Event()
+
+        def stream(self, timeout=None):
+            for t in self.toks:
+                yield t, None
+            self.done.set()
+
+    class FakeEngine:
+        config = None
+
+        def validate(self, p, n):
+            pass
+
+        def validate_sampling(self, **kw):
+            pass
+
+        def submit(self, p, n, logprobs=False, **kw):
+            return FakeReq()
+
+    srv = InferenceServer.__new__(InferenceServer)   # no HTTP socket
+    srv.engine = FakeEngine()
+    srv.config = ServerConfig(model_name="m", tokenizer=ByteTokenizer())
+    import itertools
+
+    from kubedl_tpu.metrics.registry import Registry
+    srv._openai_ids = itertools.count(1)
+    srv.metrics = Registry()
+    srv._m_ttft = srv.metrics.histogram("ttft", "t")
+    srv._m_tokens = srv.metrics.counter("toks", "t")
+    chunks = list(srv.openai_stream({"prompt": "x", "max_tokens": 4},
+                                    chat=False))
+    text = "".join(c["choices"][0]["text"] for c in chunks
+                   if isinstance(c, dict))
+    assert text == ByteTokenizer().decode(FakeReq.toks) == "h�"
+    assert chunks[-1] == "[DONE]"
