@@ -103,6 +103,7 @@ const MESSAGES = {
     "playground.temperature": "Temperature",
     "playground.placeholder": "Say something\u2026",
     "playground.send": "Send", "playground.clear": "Clear",
+    "playground.stop": "Stop",
     "nav.admin": "Admin", "admin.title": "Console users",
     "admin.username": "Username", "admin.password": "Password",
     "admin.role": "Role", "admin.add": "Add or update user",
@@ -152,6 +153,7 @@ const MESSAGES = {
     "playground.temperature": "温度",
     "playground.placeholder": "输入内容\u2026",
     "playground.send": "发送", "playground.clear": "清空",
+    "playground.stop": "停止",
     "nav.admin": "管理", "admin.title": "控制台用户",
     "admin.username": "用户名", "admin.password": "密码",
     "admin.role": "角色", "admin.add": "添加或更新用户",
@@ -204,6 +206,7 @@ const MESSAGES = {
     "playground.temperature": "Temperatura",
     "playground.placeholder": "Diga algo\u2026",
     "playground.send": "Enviar", "playground.clear": "Limpar",
+    "playground.stop": "Parar",
     "nav.admin": "Admin", "admin.title": "Usuários do console",
     "admin.username": "Usuário", "admin.password": "Senha",
     "admin.role": "Papel", "admin.add": "Adicionar ou atualizar",

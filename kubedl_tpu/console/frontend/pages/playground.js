@@ -28,6 +28,8 @@ export async function viewPlayground(app) {
           placeholder="${esc(t("playground.placeholder"))}"></textarea>
         <div>
           <button type="submit">${esc(t("playground.send"))}</button>
+          <button type="button" id="pg-stop" class="ghost" hidden>
+            ${esc(t("playground.stop"))}</button>
           <button type="button" id="pg-clear" class="ghost">
             ${esc(t("playground.clear"))}</button>
         </div>
@@ -62,9 +64,17 @@ export async function viewPlayground(app) {
     const reply = { role: "assistant", content: "" };
     history.push(reply);
     render();
+    // Stop aborts the fetch; the console proxy drops its upstream
+    // connection and the predictor cancels the lane (no tokens decoded
+    // into the void)
+    const abort = new AbortController();
+    const stopBtn = document.getElementById("pg-stop");
+    stopBtn.hidden = false;
+    stopBtn.onclick = () => abort.abort();
     try {
       const res = await fetch("/api/v1/inference/stream", {
         method: "POST",
+        signal: abort.signal,
         headers: { "Content-Type": "application/json" },
         body: JSON.stringify({
           namespace, name, messages: history.slice(0, -1),
@@ -98,8 +108,12 @@ export async function viewPlayground(app) {
         }
       }
     } catch (err) {
-      reply.content += `[error] ${err.message}`;
-      render();
+      if (err.name !== "AbortError") {
+        reply.content += `[error] ${err.message}`;
+        render();
+      }
+    } finally {
+      stopBtn.hidden = true;
     }
   };
 }
