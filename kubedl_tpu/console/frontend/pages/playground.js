@@ -69,6 +69,8 @@ export async function viewPlayground(app) {
     // into the void)
     const abort = new AbortController();
     const stopBtn = document.getElementById("pg-stop");
+    const sendBtn = e.target.querySelector("button[type=submit]");
+    sendBtn.disabled = true;       // one in-flight stream at a time
     stopBtn.hidden = false;
     stopBtn.onclick = () => abort.abort();
     try {
@@ -114,6 +116,7 @@ export async function viewPlayground(app) {
       }
     } finally {
       stopBtn.hidden = true;
+      sendBtn.disabled = false;
     }
   };
 }

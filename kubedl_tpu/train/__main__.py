@@ -149,8 +149,12 @@ def _raw_stream(data: dict, config, batch: int, seq: int):
             raise ValueError("mixture weights must be > 0")
         weights = weights / weights.sum()
         streams = [_raw_stream(s, config, batch, seq) for s in sources]
-        rng = np.random.default_rng(data.get("seed", 0)
-                                    + jax.process_index())
+        # the source-selection rng must be HOST-INVARIANT: hosts drawing
+        # different sources in the same step would trace different
+        # programs (packed vs plain batches) and desync the SPMD
+        # collectives. Per-host data divergence comes from each source's
+        # own host sharding.
+        rng = np.random.default_rng(data.get("seed", 0))
 
         def mixed():
             while True:
