@@ -702,7 +702,13 @@ class _Handler(BaseHTTPRequestHandler):
         elif self.path == "/v1/models":
             self._respond(200, self.server_ref.openai_models())
         elif self.path == f"/v1/models/{cfg.model_name}":
-            self._respond(200, self.server_ref.status())
+            # TFServing-convention status (readiness probes) AND the
+            # OpenAI retrieve shape in one payload — both client kinds
+            # read only their own fields
+            self._respond(200, {
+                **self.server_ref.status(),
+                "id": cfg.model_name, "object": "model",
+                "owned_by": "kubedl-tpu"})
         else:
             self._respond(404, {"error": f"no route {self.path}"})
 

@@ -307,3 +307,13 @@ def test_stream_emits_held_back_utf8_tail():
                    if isinstance(c, dict))
     assert text == ByteTokenizer().decode(FakeReq.toks) == "h�"
     assert chunks[-1] == "[DONE]"
+
+
+def test_model_retrieve_route(server):
+    """GET /v1/models/{id} serves both the TFServing status shape and
+    the OpenAI retrieve shape."""
+    srv, _ = server
+    got = json.loads(urllib.request.urlopen(
+        srv.url + "/v1/models/m").read())
+    assert got["id"] == "m" and got["object"] == "model"
+    assert got["model_version_status"][0]["state"] == "AVAILABLE"
