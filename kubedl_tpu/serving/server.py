@@ -108,6 +108,25 @@ class InferenceServer:
             "kubedl_serving_ttft_seconds",
             "Time to first streamed token",
             buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10))
+        self._m_spec = None
+        if hasattr(engine, "stats") and \
+                hasattr(engine.stats, "acceptance_rate"):
+            # speculative predictors: draft quality on the scrape page
+            self._m_spec = (
+                self.metrics.gauge("kubedl_serving_spec_proposed_total",
+                                   "Draft tokens proposed"),
+                self.metrics.gauge("kubedl_serving_spec_accepted_total",
+                                   "Draft tokens accepted"),
+                self.metrics.gauge("kubedl_serving_spec_acceptance_rate",
+                                   "Lifetime draft acceptance rate"))
+
+        def _refresh_engine_metrics():
+            if self._m_spec is not None:
+                st = engine.stats
+                self._m_spec[0].set(st.proposed)
+                self._m_spec[1].set(st.accepted)
+                self._m_spec[2].set(st.acceptance_rate)
+        self.refresh_engine_metrics = _refresh_engine_metrics
         server = self
 
         class Handler(_Handler):
@@ -698,6 +717,7 @@ class _Handler(BaseHTTPRequestHandler):
             self._respond(200, {"status": "ok"})
         elif self.path == "/metrics":
             from ..metrics.http import write_exposition
+            self.server_ref.refresh_engine_metrics()
             write_exposition(self, self.server_ref.metrics)
         elif self.path == "/v1/models":
             self._respond(200, self.server_ref.openai_models())

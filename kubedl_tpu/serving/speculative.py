@@ -80,6 +80,9 @@ class SpeculativeServingAdapter:
                  gen: Optional["GenerateConfig"] = None):
         self.engine = engine
         self.gen = gen
+        #: lifetime acceptance accounting, surfaced via the predictor's
+        #: /metrics (draft quality is THE speculative tuning signal)
+        self.stats = SpecStats()
 
     def generate(self, prompts, max_new_tokens: int,
                  seed: int = 0, return_logprobs: bool = False):
@@ -87,7 +90,7 @@ class SpeculativeServingAdapter:
             raise ValueError(
                 "logprobs are not available on the speculative path")
         return [self.engine.generate(p, max_new_tokens, gen=self.gen,
-                                     seed=seed + i)
+                                     seed=seed + i, stats=self.stats)
                 for i, p in enumerate(prompts)]
 
     def stop(self) -> None:

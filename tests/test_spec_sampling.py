@@ -108,3 +108,41 @@ def test_sampled_speculative_engine():
                              eos_id=a[2])
     got = spec.generate([5, 7, 11], 12, gen=gen_eos, seed=7)
     assert got == a[:3]
+
+
+def test_spec_acceptance_metrics_on_scrape_page():
+    """A speculative predictor's /metrics carries lifetime draft
+    acceptance accounting."""
+    import dataclasses as dc
+    import urllib.request
+
+    import jax
+    import jax.numpy as jnp
+
+    from kubedl_tpu.models import llama
+    from kubedl_tpu.serving import InferenceServer, ServerConfig
+    from kubedl_tpu.serving.engine import GenerateConfig
+    from kubedl_tpu.serving.speculative import (SpeculativeEngine,
+                                                SpeculativeServingAdapter)
+
+    tcfg = dc.replace(llama.tiny(vocab=64), dtype=jnp.float32)
+    tparams = llama.init_params(tcfg, jax.random.PRNGKey(0))
+    adapter = SpeculativeServingAdapter(
+        SpeculativeEngine(tcfg, tparams, tcfg, tparams, k=2, max_len=96),
+        gen=GenerateConfig(max_len=96))
+    srv = InferenceServer(adapter, ServerConfig(
+        model_name="m", host="127.0.0.1", port=0)).start()
+    try:
+        import json as _json
+        urllib.request.urlopen(urllib.request.Request(
+            srv.url + "/v1/models/m:predict", method="POST",
+            data=_json.dumps({"instances": [
+                {"prompt_tokens": [3, 5], "max_tokens": 8}]}).encode(),
+            headers={"Content-Type": "application/json"}))
+        page = urllib.request.urlopen(srv.url + "/metrics").read().decode()
+        assert "kubedl_serving_spec_proposed_total" in page
+        # self-draft: everything accepted -> rate 1
+        assert "kubedl_serving_spec_acceptance_rate 1.0" in page
+    finally:
+        srv.stop()
+        adapter.stop()
