@@ -51,9 +51,10 @@ verifiable reward: prompts from JSONL rows ``{"prompt": [ids]}`` or raw
 text with ``data.tokenizer``, the reward a user-supplied callable named
 by ``reward`` — ``"pkg.mod:fn"`` or ``"/path/rewards.py:fn"`` — called
 as ``fn(prompt_ids, completion_ids) -> float``, with ``tokenizer=``
-bound when the function declares that parameter (text-level rewards); each round samples a group
-per prompt from an in-process serving engine rebuilt on the current
-weights, then takes ``rollout.steps_per_round`` update steps).
+bound when the function declares that parameter (text-level rewards);
+each round samples a group per prompt from an in-process serving engine
+rebuilt on the current weights, then takes ``rollout.steps_per_round``
+update steps).
 """
 
 from __future__ import annotations
