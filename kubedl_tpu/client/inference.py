@@ -134,12 +134,20 @@ class InferenceClient:
             if delta:
                 yield delta
 
-    def embed(self, inputs) -> List[List[float]]:
+    def embed(self, inputs, chunk: int = 16) -> List[List[float]]:
         """L2-normalized embedding vectors for a string or list of
-        strings."""
-        res = self._post("/v1/embeddings", {"input": inputs})
-        return [d["embedding"]
-                for d in sorted(res["data"], key=lambda d: d["index"])]
+        strings. Inputs beyond the server's batch cap are chunked
+        transparently (``chunk`` should not exceed the predictor's
+        ``max_batch``)."""
+        if isinstance(inputs, str):
+            inputs = [inputs]
+        out: List[List[float]] = []
+        for start in range(0, len(inputs), max(chunk, 1)):
+            res = self._post("/v1/embeddings",
+                             {"input": inputs[start:start + chunk]})
+            out.extend(d["embedding"] for d in
+                       sorted(res["data"], key=lambda d: d["index"]))
+        return out
 
     # -- introspection -----------------------------------------------------
 

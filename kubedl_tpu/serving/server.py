@@ -466,6 +466,11 @@ class InferenceServer:
                                 "message": {"role": "assistant",
                                             "content": text}})
             else:
+                if body.get("echo"):
+                    # OpenAI echo: the prompt text precedes the
+                    # completion (distinct prompts repeat every n)
+                    text = tok.decode(
+                        prompts[i // max(n, 1)]) + text
                 choices.append({"index": i, "finish_reason": finish,
                                 "text": text, "logprobs": lp})
         # each distinct prompt counts once, regardless of n (the OpenAI

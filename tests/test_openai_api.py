@@ -317,3 +317,28 @@ def test_model_retrieve_route(server):
         srv.url + "/v1/models/m").read())
     assert got["id"] == "m" and got["object"] == "model"
     assert got["model_version_status"][0]["state"] == "AVAILABLE"
+
+
+def test_completions_echo(server):
+    srv, tok = server
+    r = json.loads(post(srv.url, "/v1/completions", {
+        "prompt": "pre", "max_tokens": 4, "echo": True}).read())
+    plain = json.loads(post(srv.url, "/v1/completions", {
+        "prompt": "pre", "max_tokens": 4}).read())
+    assert r["choices"][0]["text"] == "pre" + plain["choices"][0]["text"]
+
+
+def test_client_embed_chunking(server):
+    from kubedl_tpu.client.inference import InferenceClient
+
+    srv, _ = server
+    c = InferenceClient(srv.url)
+    # 20 inputs > the server's max_batch of 16: chunked client-side
+    texts = [f"text {i}" for i in range(20)]
+    vecs = c.embed(texts, chunk=8)
+    assert len(vecs) == 20
+    # chunking must not change the vectors
+    import numpy as np
+    direct = c.embed(texts[:3], chunk=16)
+    np.testing.assert_allclose(np.asarray(vecs[:3]),
+                               np.asarray(direct), atol=1e-6)
