@@ -141,8 +141,9 @@ class InferenceClient:
         ``max_batch``)."""
         if isinstance(inputs, str):
             inputs = [inputs]
+        chunk = max(chunk, 1)          # clamp ONCE: the slice uses it too
         out: List[List[float]] = []
-        for start in range(0, len(inputs), max(chunk, 1)):
+        for start in range(0, len(inputs), chunk):
             res = self._post("/v1/embeddings",
                              {"input": inputs[start:start + chunk]})
             out.extend(d["embedding"] for d in

@@ -91,6 +91,7 @@ class InferenceServer:
         # ThreadingHTTPServer's concurrent handlers without a lock
         import itertools
         self._openai_ids = itertools.count(1)
+        self._created = int(time.time())   # OpenAI model-object field
         self._embed_fn = None        # lazily-built jitted embedder
         self.metrics = Registry()
         self._m_requests = self.metrics.counter(
@@ -419,7 +420,7 @@ class InferenceServer:
     def openai_models(self) -> dict:
         return {"object": "list", "data": [{
             "id": self.config.model_name, "object": "model",
-            "owned_by": "kubedl-tpu"}]}
+            "created": self._created, "owned_by": "kubedl-tpu"}]}
 
     def openai_completions(self, body: dict, chat: bool) -> dict:
         prompts, n, cap, sampling, stop = self._openai_parse(body, chat)
@@ -447,9 +448,20 @@ class InferenceServer:
                         pred = {**pred,
                                 "logprobs": pred["logprobs"][:j]}
                         break
+            echo = (not chat) and bool(body.get("echo"))
+            prompt_ids = prompts[i // max(n, 1)] if echo else []
             lp = None
             if want_lp:
                 pieces = [tok.decode([t]) for t in toks]
+                if echo:
+                    # OpenAI echo contract: prompt tokens appear in the
+                    # logprobs zip too, with null logprobs (we do not
+                    # re-score the prompt)
+                    pieces = [tok.decode([t])
+                              for t in prompt_ids] + pieces
+                    pred = {**pred, "logprobs":
+                            [None] * len(prompt_ids)
+                            + list(pred["logprobs"])}
                 if chat:
                     # chat flavor: logprobs.content entries
                     lp = {"content": [
@@ -457,7 +469,8 @@ class InferenceServer:
                         for s, v in zip(pieces, pred["logprobs"])]}
                 else:
                     lp = {"tokens": pieces,
-                          "token_logprobs": [float(v)
+                          "token_logprobs": [None if v is None
+                                             else float(v)
                                              for v in pred["logprobs"]],
                           "top_logprobs": None, "text_offset": None}
             if chat:
@@ -466,11 +479,10 @@ class InferenceServer:
                                 "message": {"role": "assistant",
                                             "content": text}})
             else:
-                if body.get("echo"):
+                if echo:
                     # OpenAI echo: the prompt text precedes the
                     # completion (distinct prompts repeat every n)
-                    text = tok.decode(
-                        prompts[i // max(n, 1)]) + text
+                    text = tok.decode(prompt_ids) + text
                 choices.append({"index": i, "finish_reason": finish,
                                 "text": text, "logprobs": lp})
         # each distinct prompt counts once, regardless of n (the OpenAI
@@ -589,6 +601,10 @@ class InferenceServer:
         def gen():
             if chat:
                 yield chunk(role="assistant")
+            elif body.get("echo"):
+                # OpenAI streams the echoed prompt before the deltas
+                yield chunk(piece=self.config.tokenizer.decode(
+                    prompts[0]))
             # hold back enough text that a stop string split across
             # token boundaries is still caught before it reaches the
             # client
@@ -733,6 +749,7 @@ class _Handler(BaseHTTPRequestHandler):
             self._respond(200, {
                 **self.server_ref.status(),
                 "id": cfg.model_name, "object": "model",
+                "created": self.server_ref._created,
                 "owned_by": "kubedl-tpu"})
         else:
             self._respond(404, {"error": f"no route {self.path}"})

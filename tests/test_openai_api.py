@@ -322,10 +322,26 @@ def test_model_retrieve_route(server):
 def test_completions_echo(server):
     srv, tok = server
     r = json.loads(post(srv.url, "/v1/completions", {
-        "prompt": "pre", "max_tokens": 4, "echo": True}).read())
+        "prompt": "pre", "max_tokens": 4, "echo": True,
+        "logprobs": 1}).read())
     plain = json.loads(post(srv.url, "/v1/completions", {
         "prompt": "pre", "max_tokens": 4}).read())
-    assert r["choices"][0]["text"] == "pre" + plain["choices"][0]["text"]
+    ch = r["choices"][0]
+    assert ch["text"] == "pre" + plain["choices"][0]["text"]
+    # logprobs stay zip-aligned with the echoed text: prompt tokens
+    # carry null logprobs (the OpenAI echo contract)
+    lp = ch["logprobs"]
+    n_prompt = len(tok.encode("pre", add_bos=True))
+    assert lp["token_logprobs"][:n_prompt] == [None] * n_prompt
+    assert all(v is not None for v in lp["token_logprobs"][n_prompt:])
+    assert "".join(lp["tokens"]) .endswith(plain["choices"][0]["text"])
+
+    # streaming echo: the prompt text arrives as the first chunk
+    resp = post(srv.url, "/v1/completions", {
+        "prompt": "pre", "max_tokens": 4, "echo": True, "stream": True})
+    chunks = [json.loads(ln) for ln in sse_lines(resp) if ln != "[DONE]"]
+    text = "".join(c["choices"][0]["text"] for c in chunks)
+    assert text == ch["text"]
 
 
 def test_client_embed_chunking(server):
