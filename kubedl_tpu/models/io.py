@@ -57,6 +57,14 @@ def save_model(config, params, path: str) -> None:
     tmp = os.path.join(path, ".params.npz.tmp")
     with open(tmp, "wb") as f:
         np.savez(f, **flat)
+    # integrity pin: a truncated copy to/from GCS or a partially-written
+    # volume must fail at LOAD time, not as silent garbage weights
+    import hashlib
+    h = hashlib.sha256()
+    with open(tmp, "rb") as f:
+        for block in iter(lambda: f.read(1 << 20), b""):
+            h.update(block)
+    doc["params_sha256"] = h.hexdigest()
     os.replace(tmp, os.path.join(path, "params.npz"))
     tmp = os.path.join(path, ".config.json.tmp")
     with open(tmp, "w") as f:
@@ -75,6 +83,20 @@ def load_model(path: str) -> Tuple[object, dict]:
     raw = {k: v for k, v in doc["config"].items() if k in fields}
     raw["dtype"] = _DTYPES[raw.get("dtype", "bfloat16")]
     config = cls(**raw)
+
+    want_sha = doc.get("params_sha256")
+    if want_sha:
+        # artifacts written by older rounds carry no checksum (skip);
+        # when one is present, a mismatch means a corrupt/truncated copy
+        import hashlib
+        h = hashlib.sha256()
+        with open(os.path.join(path, "params.npz"), "rb") as f:
+            for block in iter(lambda: f.read(1 << 20), b""):
+                h.update(block)
+        if h.hexdigest() != want_sha:
+            raise ValueError(
+                f"params.npz checksum mismatch in {path}: the artifact "
+                "is corrupt or was partially copied")
 
     dtype = config.dtype
     params: dict = {}

@@ -268,3 +268,25 @@ def test_gemma2_decode_matches_forward_rollout():
         logits = llama.forward(cfg, params, jnp.asarray([cur]))
         assert int(jnp.argmax(logits[0, -1])) == want, len(cur)
         cur.append(want)
+
+
+def test_artifact_checksum_guards_corruption(tmp_path):
+    """save_model pins params.npz with a sha256; a corrupted or
+    truncated copy fails at load time instead of serving garbage."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from kubedl_tpu.models import io as mio
+    from kubedl_tpu.models import llama
+
+    cfg = dataclasses.replace(llama.tiny(vocab=32), dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    mio.save_model(cfg, params, str(tmp_path / "m"))
+    mio.load_model(str(tmp_path / "m"))        # intact artifact loads
+
+    blob = (tmp_path / "m" / "params.npz").read_bytes()
+    (tmp_path / "m" / "params.npz").write_bytes(blob[:-100])  # truncate
+    with pytest.raises(ValueError, match="checksum mismatch"):
+        mio.load_model(str(tmp_path / "m"))
