@@ -104,6 +104,8 @@ const MESSAGES = {
     "playground.placeholder": "Say something\u2026",
     "playground.send": "Send", "playground.clear": "Clear",
     "playground.stop": "Stop",
+    "playground.stopSeq": "Stop sequences",
+    "playground.stopHint": "comma-separated",
     "nav.admin": "Admin", "admin.title": "Console users",
     "admin.username": "Username", "admin.password": "Password",
     "admin.role": "Role", "admin.add": "Add or update user",
@@ -154,6 +156,8 @@ const MESSAGES = {
     "playground.placeholder": "输入内容\u2026",
     "playground.send": "发送", "playground.clear": "清空",
     "playground.stop": "停止",
+    "playground.stopSeq": "停止序列",
+    "playground.stopHint": "逗号分隔",
     "nav.admin": "管理", "admin.title": "控制台用户",
     "admin.username": "用户名", "admin.password": "密码",
     "admin.role": "角色", "admin.add": "添加或更新用户",
@@ -207,6 +211,8 @@ const MESSAGES = {
     "playground.placeholder": "Diga algo\u2026",
     "playground.send": "Enviar", "playground.clear": "Limpar",
     "playground.stop": "Parar",
+    "playground.stopSeq": "Sequências de parada",
+    "playground.stopHint": "separadas por vírgula",
     "nav.admin": "Admin", "admin.title": "Usuários do console",
     "admin.username": "Usuário", "admin.password": "Senha",
     "admin.role": "Papel", "admin.add": "Adicionar ou atualizar",

@@ -21,6 +21,9 @@ export async function viewPlayground(app) {
         <input id="pg-max" type="number" value="256" min="1">
         <span class="muted">${esc(t("playground.temperature"))}</span>
         <input id="pg-temp" type="number" value="0" min="0" step="0.1">
+        <span class="muted">${esc(t("playground.stopSeq"))}</span>
+        <input id="pg-stopseq" type="text"
+          placeholder="${esc(t("playground.stopHint"))}">
       </div>
       <div id="pg-chat" class="chat"></div>
       <form id="pg-form">
@@ -82,6 +85,10 @@ export async function viewPlayground(app) {
           namespace, name, messages: history.slice(0, -1),
           max_tokens: +document.getElementById("pg-max").value || 256,
           temperature: +document.getElementById("pg-temp").value || 0,
+          ...(document.getElementById("pg-stopseq").value.trim()
+            ? { stop: document.getElementById("pg-stopseq").value
+                  .split(",").map(s => s.trim()).filter(Boolean) }
+            : {}),
         }),
       });
       if (!res.ok) {
