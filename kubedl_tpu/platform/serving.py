@@ -10,7 +10,7 @@ renders weighted canary routes (reference: an Istio VirtualService,
 TPU-native redesign:
 
 * a ``JAXServing`` framework joins TFServing/Triton — it runs a JAX/PJRT
-  server (``kubedl_tpu.serve``) and gets ``PJRT_DEVICE=TPU``;
+  server (``python -m kubedl_tpu.serving``) and gets ``PJRT_DEVICE=TPU``;
 * an Inference may carry ``spec.tpuPolicy`` with a **single-host** slice
   (e.g. v5e-4): predictor replicas are independent one-host servers, so the
   controller renders chip resources + topology nodeSelectors per replica —
