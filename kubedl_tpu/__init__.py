@@ -13,8 +13,12 @@ The package has two halves:
 * the **operator** (``core``, ``api``, ``controllers``, ``tpu``,
   ``scheduling``, ``metrics``, ``storage``) — the control plane; and
 * the **runtime** (``models``, ``ops``, ``parallel``, ``train``,
-  ``runtime``, ``serving``) — the TPU-native JAX compute stack that the
-  operator's pods actually run.
+  ``runtime``, ``serving``, ``tokenizer``) — the TPU-native JAX compute
+  stack that the operator's pods actually run (plus the text seam:
+  tokenizers, chat templates, corpus tooling).
+
+``kubedl_tpu.client`` bridges both: CRD clientset/informers for the
+control plane and a typed predictor client for the data plane.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.4.0"
