@@ -15,6 +15,9 @@ env). Honors the autoconfig contract end to end:
   many LOCAL chips (one host's mesh; params shard by their logical
   specs, the KV cache by kv-heads). Not combinable with QUANTIZE.
 * ``KUBEDL_SERVING_PORT``     — default 8501
+* ``KUBEDL_SERVING_WARMUP``   — default 1: compile prefill+decode with
+  one tiny generation BEFORE the HTTP server binds (readiness then
+  means "compiled and serving"); 0 skips
 * ``KUBEDL_TOKENIZER``        — "byte", or a local directory of
   HuggingFace tokenizer assets (ship them with the ModelVersion):
   enables ``{"text": ...}`` instances, decoded ``"text"`` in
