@@ -110,3 +110,27 @@ def test_llama_trains_with_ulysses(mesh):
 def test_cp_impl_validation():
     with pytest.raises(ValueError, match="cp_impl"):
         llama.LlamaConfig(cp_impl="megatron")
+
+
+def test_moe_trains_with_ulysses(mesh):
+    """MoEConfig inherits cp_impl: the sparse stack trains a packed
+    batch through the all-to-all attention path on the ep-free mesh."""
+    from kubedl_tpu.models import moe
+
+    cfg = dataclasses.replace(moe.tiny(vocab=64, seq=32),
+                              dtype=jnp.float32, cp_impl="ulysses")
+    params = moe.init_params(cfg, jax.random.PRNGKey(0))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 3, 64)
+    tgts = jax.random.randint(jax.random.PRNGKey(2), (4, 32), 3, 64)
+    seg = jnp.zeros((4, 32), jnp.int32).at[:, 16:].set(1)
+    pos = jnp.concatenate([jnp.arange(16), jnp.arange(16)])[None, :]
+    pos = jnp.broadcast_to(pos, (4, 32))
+
+    from kubedl_tpu.train.data import shard_batch
+    b = shard_batch({"tokens": toks, "targets": tgts,
+                     "segment_ids": seg, "positions": pos}, mesh)
+    loss = jax.jit(lambda p, bb: moe.loss_fn(
+        cfg, p, bb["tokens"], bb["targets"],
+        segment_ids=bb["segment_ids"], positions=bb["positions"],
+        mesh=mesh))(params, b)
+    assert np.isfinite(float(loss))
