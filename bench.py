@@ -240,20 +240,30 @@ def run(gen: str, dev, note: str) -> dict:
         float(jax.device_get(loss))
         return b * seq * n / (time.perf_counter() - t0)
 
-    # two MFU levers, walked as a ladder with OOM fallback: bigger
+    # three MFU levers, walked as a ladder with OOM fallback: bigger
     # batches raise arithmetic intensity; remat=False skips the backward
-    # recompute entirely (model-FLOPs MFU counts recompute as overhead).
-    # BENCH_BATCH/BENCH_REMAT pin a single candidate.
+    # recompute entirely (model-FLOPs MFU counts recompute as overhead);
+    # flash block sizes (KUBEDL_FLASH_BQ/BK, ops/attention.py) trade VMEM
+    # for loop overhead — 256x256 measured 54.5% MFU on v5e vs 43.0% at
+    # the 128x128 default (r5 hunt, BENCH_TPU_LOOP_r05.log).
+    # BENCH_BATCH/BENCH_REMAT pin a single candidate (honoring ambient
+    # KUBEDL_FLASH_* env).
     import dataclasses as _dc
     if os.environ.get("BENCH_BATCH"):
         ladder = [(int(os.environ["BENCH_BATCH"]),
-                   os.environ.get("BENCH_REMAT", "1") == "1")]
+                   os.environ.get("BENCH_REMAT", "1") == "1", None)]
     elif gen == "cpu":
-        ladder = [(batch, True)]
+        ladder = [(batch, True, None)]
     else:
-        ladder = [(batch, False), (batch * 2, True), (batch, True)]
+        ladder = [(batch, True, (256, 256)), (batch, False, (256, 256)),
+                  (batch * 2, True, (256, 256)), (batch, True, (128, 128))]
     tokens_per_sec = None
-    for i, (b, remat) in enumerate(ladder):
+    for i, (b, remat, blocks) in enumerate(ladder):
+        if blocks is not None:
+            # read at TRACE time by the pallas kernel builder; each
+            # candidate builds a fresh jitted step, so this takes effect
+            os.environ["KUBEDL_FLASH_BQ"] = str(blocks[0])
+            os.environ["KUBEDL_FLASH_BK"] = str(blocks[1])
         vcfg = cfg if remat == cfg.remat else _dc.replace(cfg,
                                                           remat=remat)
         try:
@@ -290,6 +300,12 @@ def run(gen: str, dev, note: str) -> dict:
         "vs_baseline": round(tokens_per_sec / target, 4),
         "mfu": round(mfu, 4),
         "attn_impl": attn_impl,
+        # the flash block sizes the winning candidate traced with — the
+        # r5 MFU lever, recorded for auditability. Resolved through the
+        # same gate the kernel builder uses, so a clamped/fallen-back
+        # env request is reported as what actually ran, not as asked.
+        "flash_blocks": "%dx%d" % attention._env_blocks(
+            seq, seq, None, None),
         # machine-distinguishable outcome (ADVICE r2): ok means "a real
         # accelerator number", never a cpu fallback
         "ok": gen != "cpu",

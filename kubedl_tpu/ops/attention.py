@@ -341,8 +341,35 @@ def _kv_index(i, nh: int, nkv: int):
     return (i // nh) * nkv + (i % nh) // reps
 
 
+def _env_block(name: str, seq: int) -> int:
+    """One flash block size from env: clamped to ``seq``, and ANY invalid
+    value (non-integer, empty, <= 0, not a multiple of 128, doesn't tile
+    the sequence) falls back to the 128 default rather than crashing at
+    trace time inside every attention call."""
+    try:
+        b = int(os.environ.get(name, "") or 128)
+    except ValueError:
+        return 128
+    b = min(b, seq)
+    if b <= 0 or b % 128 or seq % b:
+        return 128
+    return b
+
+
+def _env_blocks(sq: int, sk: int, block_q, block_k):
+    """Resolve flash block sizes. ``KUBEDL_FLASH_BQ``/``KUBEDL_FLASH_BK``
+    (trace-time env, multiples of 128) override the 128/128 default so the
+    v5e VMEM sweet spot can be swept on hardware without a code change;
+    invalid or non-tiling values fall back to 128."""
+    if block_q is None:
+        block_q = _env_block("KUBEDL_FLASH_BQ", sq)
+    if block_k is None:
+        block_k = _env_block("KUBEDL_FLASH_BK", sk)
+    return block_q, block_k
+
+
 def _flash_forward(q, k, v, causal, segment_ids=None, offsets=None,
-                   window=0, block_q=128, block_k=128, interpret=False):
+                   window=0, block_q=None, block_k=None, interpret=False):
     """q [b, sq, nh, hd]; k/v [b, sk, nkv, hd] (kv-head space, GQA-native);
     segment_ids [b, s] (optional packed-sequence ids; sq == sk then);
     offsets (optional traced (q_off, k_off) global positions for the
@@ -352,6 +379,7 @@ def _flash_forward(q, k, v, causal, segment_ids=None, offsets=None,
 
     b, sq, nh, hd = q.shape
     sk, nkv = k.shape[1], k.shape[2]
+    block_q, block_k = _env_blocks(sq, sk, block_q, block_k)
     qh = jnp.swapaxes(q, 1, 2).reshape(b * nh, sq, hd)
     kh = jnp.swapaxes(k, 1, 2).reshape(b * nkv, sk, hd)
     vh = jnp.swapaxes(v, 1, 2).reshape(b * nkv, sk, hd)
@@ -570,7 +598,7 @@ def _flash_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_backward(q, k, v, o, lse, g, causal, segment_ids=None,
-                    offsets=None, window=0, block_q=128, block_k=128,
+                    offsets=None, window=0, block_q=None, block_k=None,
                     interpret=False):
     """Flash-2 backward, GQA-native. q/o/g are [b, sq, nh, hd]; k/v are
     [b, sk, nkv, hd] (kv-head space, never repeated in HBM); lse is
@@ -580,6 +608,7 @@ def _flash_backward(q, k, v, o, lse, g, causal, segment_ids=None,
 
     b, sq, nh, hd = q.shape
     sk, nkv = k.shape[1], k.shape[2]
+    block_q, block_k = _env_blocks(sq, sk, block_q, block_k)
     reps = nh // nkv
     bh, bkv = b * nh, b * nkv
     qh = jnp.swapaxes(q, 1, 2).reshape(bh, sq, hd)
