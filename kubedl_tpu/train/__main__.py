@@ -119,24 +119,33 @@ def resolve_model(cfg: dict):
     return config, params
 
 
-def data_stream(cfg: dict, config, mesh, batch: int, seq: int):
+def data_stream(cfg: dict, config, mesh, batch: int, seq: int,
+                skip: int = 0):
     """Pretrain batch iterator per the ``data`` section (device-placed,
-    prefetched)."""
-    from .data import prefetch_to_device
+    prefetched). ``skip`` fast-forwards the underlying host stream by
+    that many batches (checkpoint resume) — batch ``skip`` of the
+    returned iterator is bit-identical to batch ``skip`` of an
+    unskipped one. The result is a :class:`~.data.CountingIterator`
+    whose ``consumed`` is the absolute cursor the checkpoint layer
+    persists."""
+    from .data import CountingIterator, prefetch_to_device
 
     data = cfg.get("data", {"kind": "synthetic"})
-    return prefetch_to_device(_raw_stream(data, config, batch, seq),
-                              mesh, size=2)
+    raw = _raw_stream(data, config, batch, seq, skip=skip)
+    return CountingIterator(prefetch_to_device(raw, mesh, size=2),
+                            consumed=skip)
 
 
-def _raw_stream(data: dict, config, batch: int, seq: int):
+def _raw_stream(data: dict, config, batch: int, seq: int, skip: int = 0):
     """Host-side batch stream for one ``data`` spec; ``mixture``
     composes sub-streams by weight (domain mixing: each step draws its
     batch from one source, in expectation proportional to the
-    weights)."""
+    weights). ``skip`` fast-forwards: token files skip by index math,
+    synthetic replays rng draws, packed text / mixtures replay host-side
+    packing (no device work either way)."""
     import jax
 
-    from .data import TokenFileDataset, synthetic_lm_batches
+    from .data import TokenFileDataset, skip_batches, synthetic_lm_batches
 
     kind = data.get("kind", "synthetic")
     if kind == "mixture":
@@ -149,13 +158,22 @@ def _raw_stream(data: dict, config, batch: int, seq: int):
         if (weights <= 0).any():
             raise ValueError("mixture weights must be > 0")
         weights = weights / weights.sum()
-        streams = [_raw_stream(s, config, batch, seq) for s in sources]
         # the source-selection rng must be HOST-INVARIANT: hosts drawing
         # different sources in the same step would trace different
         # programs (packed vs plain batches) and desync the SPMD
         # collectives. Per-host data divergence comes from each source's
         # own host sharding.
         rng = np.random.default_rng(data.get("seed", 0))
+        # resume: replay ONLY the selection draws (one rng.choice per
+        # skipped batch — identical draw sequence to the unskipped
+        # stream), then hand each source its own per-source skip count so
+        # token files fast-forward by index math instead of materializing
+        # every skipped batch
+        counts = [0] * len(sources)
+        for _ in range(skip):
+            counts[int(rng.choice(len(sources), p=weights))] += 1
+        streams = [_raw_stream(s, config, batch, seq, skip=c)
+                   for s, c in zip(sources, counts)]
 
         def mixed():
             while True:
@@ -163,13 +181,15 @@ def _raw_stream(data: dict, config, batch: int, seq: int):
         return mixed()
     if kind == "synthetic":
         raw = synthetic_lm_batches(batch, seq, config.vocab_size,
-                                   seed=data.get("seed", 0))
+                                   seed=data.get("seed", 0), skip=skip)
+        skip = 0
     elif kind == "tokens":
         raw = TokenFileDataset(
             data["path"], seq, batch,
             process_index=jax.process_index(),
             process_count=jax.process_count(),
-            seed=data.get("seed", 0)).batches()
+            seed=data.get("seed", 0)).batches(skip=skip)
+        skip = 0
     elif kind == "text":
         # raw text corpus (.jsonl {"text": ...} rows or plain lines):
         # tokenize, then document-pack into segment-isolated batches —
@@ -216,7 +236,7 @@ def _raw_stream(data: dict, config, batch: int, seq: int):
         raw = packed_epochs()
     else:
         raise ValueError(f"unknown data kind {kind!r} for pretrain")
-    return raw
+    return skip_batches(raw, skip)
 
 
 def build_eval_fn(cfg: dict, config, mesh, batch: int, seq: int,
@@ -259,13 +279,16 @@ def build_eval_fn(cfg: dict, config, mesh, batch: int, seq: int,
     return every, eval_fn
 
 
-def sft_stream(cfg: dict, config, mesh, batch: int, seq: int):
+def sft_stream(cfg: dict, config, mesh, batch: int, seq: int,
+               skip: int = 0):
     """Instruction-tuning batches from an ``sft_jsonl`` file: rows
     ``{"prompt": ..., "response": ...}`` where each field is raw text
     (requires ``data.tokenizer``) or a token-id list. Loss covers
-    response tokens only (``train.data.sft_batches``)."""
+    response tokens only (``train.data.sft_batches``). ``skip``
+    fast-forwards for checkpoint resume (epoch-permutation index math,
+    no batch materialization)."""
     from ..tokenizer import load_tokenizer
-    from .data import prefetch_to_device, sft_batches
+    from .data import CountingIterator, prefetch_to_device, sft_batches
 
     data = cfg.get("data", {})
     if data.get("kind") != "sft_jsonl":
@@ -294,17 +317,22 @@ def sft_stream(cfg: dict, config, mesh, batch: int, seq: int):
         raise ValueError(f"no rows in {data['path']}")
     stream = sft_batches(examples, seq, batch,
                          pad_id=tok.pad_id if tok is not None else 0,
-                         seed=data.get("seed", 0))
-    return prefetch_to_device(stream, mesh, size=2)
+                         seed=data.get("seed", 0), skip=skip)
+    return CountingIterator(prefetch_to_device(stream, mesh, size=2),
+                            consumed=skip)
 
 
-def dpo_batches(cfg: dict, config, params, mesh, batch: int):
+def dpo_batches(cfg: dict, config, params, mesh, batch: int,
+                skip: int = 0):
     """Infinite DPO batch stream from a pairs JSONL, reference logps
-    precomputed once per batch under the FROZEN initial weights."""
+    precomputed once per batch under the FROZEN initial weights.
+    ``skip`` fast-forwards the round-robin cursor by index math —
+    crucially WITHOUT recomputing reference logps for skipped batches
+    (they are per-batch device work)."""
     import jax.numpy as jnp
 
     from . import dpo
-    from .data import shard_batch
+    from .data import CountingIterator, shard_batch
 
     data = cfg.get("data", {})
     if data.get("kind") != "dpo_jsonl":
@@ -319,7 +347,7 @@ def dpo_batches(cfg: dict, config, params, mesh, batch: int):
     ref_fn = dpo.reference_logps_fn(config, params, mesh=mesh)
 
     def stream():
-        i = 0
+        i = (skip * batch) % len(rows)
         while True:
             chunk = [rows[(i + j) % len(rows)] for j in range(batch)]
             i = (i + batch) % len(rows)
@@ -333,7 +361,17 @@ def dpo_batches(cfg: dict, config, params, mesh, batch: int):
             b["ref_rejected_logps"] = ref_r
             yield shard_batch(b, mesh)
 
-    return stream()
+    return CountingIterator(stream(), consumed=skip)
+
+
+def _data_fingerprint(cfg: dict, mode: str, batch: int, seq: int) -> dict:
+    """Identity of the data stream a checkpoint cursor belongs to. A
+    restored cursor only fast-forwards when the stream it counted is the
+    stream about to be built — after a config change (different corpus /
+    batch / seq / mode) the offset is meaningless, so the stream restarts
+    at 0 with a warning instead of silently misaligning."""
+    return {"mode": mode, "batch": batch, "seq": seq,
+            "data": cfg.get("data", {"kind": "synthetic"})}
 
 
 def _check_tok_vocab(tok, config) -> None:
@@ -506,7 +544,16 @@ def run_grpo(cfg: dict, config, trainer, state, manager, ref_params,
     last_saved = int(state.step)
     mesh = trainer.mesh
     engine = None
-    for rnd in range(rounds):
+    # resume: rounds advance the step by exactly steps_per_round, so the
+    # restored step IS the data cursor — start at the next round instead
+    # of replaying the prompt list from round 0 (a resumed GRPO run must
+    # roll out the same prompt schedule an uninterrupted one would)
+    start_rnd = min(int(state.step) // steps_per_round, rounds)
+    if start_rnd:
+        log.info("grpo resume: %d rounds already done (step %d), "
+                 "starting at round %d", start_rnd, int(state.step),
+                 start_rnd + 1)
+    for rnd in range(start_rnd, rounds):
         # device->host->device param refresh (training shards by fsdp,
         # the engine places its own way); building the engine ONCE keeps
         # its per-instance jit cache — only the buffers change per round
@@ -612,8 +659,41 @@ def main(argv=None) -> int:
         # the plain next-token losses
         raise ValueError("lora applies to mode pretrain/sft (dpo and "
                          "grpo tune full weights)")
+    if cfg.get("export_hf_path"):
+        # validate up front on ALL processes: the post-training check
+        # only ran on rank 0 after hours of work, leaving other hosts
+        # exiting 0 while rank 0 failed (ADVICE r4)
+        from ..models import moe as _moe
+        if isinstance(config, _moe.MoEConfig):
+            raise ValueError(
+                "export_hf_path: MoE configs have no HF mapping — drop "
+                "export_hf_path or use a llama-family model")
     if mode == "evaluate":
         return run_evaluate(cfg, config, params, mesh)
+
+    # the checkpoint manager opens BEFORE the data stream is built: the
+    # saved data cursor (consumed-batch count) decides how far to
+    # fast-forward the stream, so a resumed run continues at the exact
+    # batch boundary instead of replaying the corpus head
+    manager = None
+    resume_skip = 0
+    fingerprint = _data_fingerprint(cfg, mode, batch, seq)
+    ck = cfg.get("checkpoint")
+    if ck:
+        from .checkpoint import CheckpointConfig, CheckpointManager
+        manager = CheckpointManager(CheckpointConfig(**ck))
+        cursor = manager.latest_data_state()
+        if cursor:
+            if cursor.get("fingerprint") == fingerprint:
+                resume_skip = int(cursor.get("consumed_batches", 0))
+                log.info("data cursor: resuming stream at batch %d",
+                         resume_skip)
+            else:
+                log.warning(
+                    "data cursor fingerprint mismatch (saved %s != "
+                    "current %s); stream restarts at batch 0",
+                    cursor.get("fingerprint"), fingerprint)
+
     batches = None
     if mode in ("pretrain", "sft"):
         def loss_fn(p, b):
@@ -623,9 +703,11 @@ def main(argv=None) -> int:
                                   mask=b.get("mask"),
                                   segment_ids=b.get("segment_ids"),
                                   positions=b.get("positions"), mesh=mesh)
-        batches = (sft_stream(cfg, config, mesh, batch, seq)
+        batches = (sft_stream(cfg, config, mesh, batch, seq,
+                              skip=resume_skip)
                    if mode == "sft"
-                   else data_stream(cfg, config, mesh, batch, seq))
+                   else data_stream(cfg, config, mesh, batch, seq,
+                                    skip=resume_skip))
     elif mode == "dpo":
         import jax.numpy as jnp
 
@@ -635,7 +717,8 @@ def main(argv=None) -> int:
         # the frozen DPO reference is the INITIAL weights — copy them:
         # init_state/step donate the originals into the train state
         ref_params = jax.tree.map(jnp.copy, params)
-        batches = dpo_batches(cfg, config, ref_params, mesh, batch)
+        batches = dpo_batches(cfg, config, ref_params, mesh, batch,
+                              skip=resume_skip)
     elif mode == "grpo":
         import jax.numpy as jnp
 
@@ -688,16 +771,19 @@ def main(argv=None) -> int:
                           TrainConfig(**opt))
         state = trainer.init_state(params)
 
-    manager = None
-    ck = cfg.get("checkpoint")
-    if ck:
-        from .checkpoint import CheckpointConfig, CheckpointManager
-        manager = CheckpointManager(CheckpointConfig(**ck))
+    if manager is not None:
         state = manager.restore_or(trainer.abstract_state(state),
                                    lambda: state)
         if manager.latest_step():
             log.info("resumed from checkpoint step %s",
                      manager.latest_step())
+
+    from .data import CountingIterator
+    data_state_fn = None
+    if manager is not None and isinstance(batches, CountingIterator):
+        def data_state_fn():
+            return {"consumed_batches": batches.consumed,
+                    "fingerprint": fingerprint}
 
     if mode == "grpo":
         state = run_grpo(cfg, config, trainer, state, manager,
@@ -713,11 +799,15 @@ def main(argv=None) -> int:
                            else build_eval_fn(cfg, config, mesh, batch,
                                               seq,
                                               params_of=lora_params_of))
+        agent = _maybe_elastic_agent(manager)
+        if agent is not None:
+            agent.data_state_fn = data_state_fn
         state = trainer.fit(state, batches, num_steps=steps,
                             log_every=int(cfg.get("log_every", 10)),
                             checkpoint_manager=manager,
-                            elastic_agent=_maybe_elastic_agent(manager),
-                            eval_every=ev_every, eval_fn=ev_fn)
+                            elastic_agent=agent,
+                            eval_every=ev_every, eval_fn=ev_fn,
+                            data_state_fn=data_state_fn)
 
     export = cfg.get("export_path") or os.environ.get("KUBEDL_MODEL_PATH")
     if export:

@@ -55,7 +55,7 @@ class CheckpointManager:
             ))
 
     def save(self, state, force: bool = False, step: Optional[int] = None,
-             periodic: bool = False) -> bool:
+             periodic: bool = False, data_state: Optional[dict] = None) -> bool:
         """Save at ``state.step``.
 
         Three call shapes, disambiguated explicitly (the old force-only
@@ -71,7 +71,13 @@ class CheckpointManager:
         Pass ``step`` (host-side counter) to skip the per-call
         ``device_get`` sync — fit() does, so non-saving steps cost one
         modulo instead of a device round-trip. A step already on disk is a
-        no-op (the final forced save after an interval save of it)."""
+        no-op (the final forced save after an interval save of it).
+
+        ``data_state`` is the host-side data cursor (JSON-able dict —
+        consumed-batch count + source fingerprint): it rides the same
+        orbax step as a ``data`` item so model state and data position
+        can never diverge (VERDICT r4 next #1 — without it a resumed
+        pretrain silently replays the corpus head)."""
         if periodic and not force:
             if self.config.save_interval_steps <= 0:
                 return False  # interval saves disabled
@@ -83,10 +89,13 @@ class CheckpointManager:
             step = int(jax.device_get(state.step))
         if step in (self._mngr.all_steps() or []):
             return False
+        items = {"state": ocp.args.StandardSave(state)}
+        if data_state is not None:
+            items["data"] = ocp.args.JsonSave(data_state)
         # orbax applies its own interval gate to non-forced saves; explicit
         # (non-periodic) requests must bypass it or an off-interval step
         # would be silently skipped
-        saved = self._mngr.save(step, args=ocp.args.StandardSave(state),
+        saved = self._mngr.save(step, args=ocp.args.Composite(**items),
                                 force=force or not periodic)
         if saved:
             log.info("checkpoint saved at step %d", step)
@@ -102,8 +111,37 @@ class CheckpointManager:
         step = self._mngr.latest_step() if step is None else step
         if step is None:
             return None
+        if "state" not in self._items(step):
+            # checkpoint written by the pre-cursor layout (bare
+            # StandardSave, no named items): restore it the old way
+            # instead of crashing every pre-upgrade resume
+            return self._mngr.restore(
+                step, args=ocp.args.StandardRestore(abstract_state))
         return self._mngr.restore(
-            step, args=ocp.args.StandardRestore(abstract_state))
+            step, args=ocp.args.Composite(
+                state=ocp.args.StandardRestore(abstract_state))).state
+
+    def _items(self, step: int) -> set:
+        """Named items saved at ``step`` (empty set for the legacy
+        single-item layout or unreadable metadata)."""
+        try:
+            return set(self._mngr.item_metadata(step).keys())
+        except Exception:  # noqa: BLE001 — metadata shape varies by layout
+            return set()
+
+    def latest_data_state(self, step: Optional[int] = None) -> Optional[dict]:
+        """The data cursor saved alongside ``step`` (default latest), or
+        None when the step has no ``data`` item (pre-cursor checkpoints,
+        bench runs). Cheap — reads one small JSON file, no arrays — so
+        the entrypoint can learn the resume offset BEFORE it builds the
+        data stream."""
+        step = self._mngr.latest_step() if step is None else step
+        if step is None:
+            return None
+        if "data" not in self._items(step):
+            return None
+        return self._mngr.restore(
+            step, args=ocp.args.Composite(data=ocp.args.JsonRestore())).data
 
     def restore_or(self, abstract_state, init_fn: Callable):
         """Resume from the latest checkpoint, else initialize fresh — the
@@ -156,12 +194,15 @@ class ElasticCheckpointAgent:
     """
 
     def __init__(self, api, kind: str, namespace: str, name: str,
-                 manager: CheckpointManager):
+                 manager: CheckpointManager, data_state_fn=None):
         self.api = api
         self.kind = kind
         self.namespace = namespace
         self.name = name
         self.manager = manager
+        #: optional () -> dict supplying the data cursor, so an elastic
+        #: checkpoint resumes its stream exactly like a periodic one
+        self.data_state_fn = data_state_fn
         self._acked = 0
 
     def poll(self, state) -> bool:
@@ -175,7 +216,9 @@ class ElasticCheckpointAgent:
         completed = int(ann.get(c.ANNOTATION_CKPT_COMPLETED_VERSION, 0) or 0)
         if requested <= max(completed, self._acked):
             return False
-        self.manager.save(state, force=True)
+        self.manager.save(state, force=True,
+                          data_state=(self.data_state_fn()
+                                      if self.data_state_fn else None))
         self.manager.wait_until_finished()  # ack only after bytes are down
         self.api.patch_merge(self.kind, self.namespace, self.name, {
             "metadata": {"annotations": {

@@ -20,13 +20,61 @@ from ..parallel import mesh as mesh_lib
 
 
 def synthetic_lm_batches(batch_size: int, seq_len: int, vocab_size: int,
-                         seed: int = 0) -> Iterator[dict]:
-    """Deterministic stream of {tokens, targets} next-token batches."""
+                         seed: int = 0, skip: int = 0) -> Iterator[dict]:
+    """Deterministic stream of {tokens, targets} next-token batches.
+    ``skip`` fast-forwards the stream by that many batches (resume): the
+    rng advances through identical draws, so batch ``skip`` here is
+    bit-identical to batch ``skip`` of an unskipped stream."""
     rng = np.random.default_rng(seed)
+    for _ in range(skip):
+        rng.integers(0, vocab_size, (batch_size, seq_len + 1),
+                     dtype=np.int32)
     while True:
         toks = rng.integers(0, vocab_size, (batch_size, seq_len + 1),
                             dtype=np.int32)
         yield {"tokens": toks[:, :-1], "targets": toks[:, 1:]}
+
+
+class CountingIterator:
+    """Wraps a batch iterator and counts consumed batches — the host-side
+    data cursor the checkpoint layer persists (VERDICT r4 next #1: a
+    resumed run must not replay the corpus head). ``consumed`` starts at
+    the skip offset the underlying stream was fast-forwarded by, so it is
+    always the absolute position in the logical stream."""
+
+    def __init__(self, it: Iterator[dict], consumed: int = 0):
+        self._it = iter(it)
+        self.consumed = consumed
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> dict:
+        batch = next(self._it)
+        self.consumed += 1
+        return batch
+
+
+def skip_batches(stream: Iterator[dict], n: int) -> Iterator[dict]:
+    """Generic fast-forward: draw and discard ``n`` batches. Host-side
+    numpy work only (used for streams with no cheaper skip path — packed
+    text); datasets with index-level skip implement their own."""
+    for _ in range(n):
+        next(stream)
+    return stream
+
+
+def skip_epochs(skip: int, per_epoch: int, draw_epoch) -> int:
+    """Resume fast path shared by the epoch-shuffled datasets: burn every
+    whole skipped epoch by replaying the SAME rng draw an unskipped
+    stream made (``draw_epoch``), returning the remaining within-epoch
+    offset in batches. Keeps the batches-per-epoch invariant in one
+    place — the callers' epoch loops must yield exactly ``per_epoch``
+    batches per permutation."""
+    while skip >= per_epoch:
+        draw_epoch()
+        skip -= per_epoch
+    return skip
 
 
 def pack_documents(docs, seq_len: int, batch_size: int,
@@ -117,7 +165,8 @@ def _packed_arrays(toks, seg, pos) -> dict:
 
 
 def sft_batches(examples, seq_len: int, batch_size: int,
-                pad_id: int = 0, seed: int = 0) -> Iterator[dict]:
+                pad_id: int = 0, seed: int = 0,
+                skip: int = 0) -> Iterator[dict]:
     """Infinite supervised fine-tuning stream from ``(ids, prompt_len)``
     examples: each row is one example padded to ``seq_len``, loss masked
     to the RESPONSE tokens only (the standard instruction-tuning rule —
@@ -143,9 +192,15 @@ def sft_batches(examples, seq_len: int, batch_size: int,
         raise ValueError(f"{len(exs)} examples < batch {batch_size}")
     rng = np.random.default_rng(seed)
     seq1 = seq_len + 1
+    # resume fast path: skipped epochs advance the rng through identical
+    # permutation draws; the within-epoch offset is index math only
+    skip = skip_epochs(skip, len(exs) // batch_size,
+                       lambda: rng.permutation(len(exs)))
     while True:
         order = rng.permutation(len(exs))
-        for start in range(0, len(order) - batch_size + 1, batch_size):
+        start0 = skip * batch_size
+        skip = 0
+        for start in range(start0, len(order) - batch_size + 1, batch_size):
             toks = np.full((batch_size, seq1), pad_id, np.int32)
             mask = np.zeros((batch_size, seq_len), bool)
             for r, idx in enumerate(order[start:start + batch_size]):
@@ -249,12 +304,22 @@ class TokenFileDataset:
     def __len__(self) -> int:
         return len(self._indices)
 
-    def batches(self) -> Iterator[dict]:
-        """Infinite shuffled stream of {tokens, targets} (epoch reshuffle)."""
+    def batches(self, skip: int = 0) -> Iterator[dict]:
+        """Infinite shuffled stream of {tokens, targets} (epoch reshuffle).
+
+        ``skip`` fast-forwards by that many batches WITHOUT touching the
+        memmap: whole skipped epochs advance the rng through the same
+        permutation draws, and the within-epoch offset is pure index
+        math — so resuming at batch N is O(epochs) cheap and batch N is
+        bit-identical to batch N of an unskipped stream."""
         sl = self.seq_len
+        skip = skip_epochs(skip, len(self._indices) // self.batch_size,
+                           lambda: self._rng.permutation(self._indices))
         while True:
             order = self._rng.permutation(self._indices)
-            for start in range(0, len(order) - self.batch_size + 1,
+            start0 = skip * self.batch_size
+            skip = 0
+            for start in range(start0, len(order) - self.batch_size + 1,
                                self.batch_size):
                 rows = [self.tokens[i * (sl + 1):(i + 1) * (sl + 1)]
                         for i in order[start:start + self.batch_size]]

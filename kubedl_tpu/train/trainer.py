@@ -191,14 +191,17 @@ class Trainer:
 
     def fit(self, state: TrainState, batches, num_steps: int,
             log_every: int = 10, on_step=None, checkpoint_manager=None,
-            elastic_agent=None, eval_every: int = 0, eval_fn=None):
+            elastic_agent=None, eval_every: int = 0, eval_fn=None,
+            data_state_fn=None):
         """Training loop. ``checkpoint_manager`` saves on its configured
         interval plus a final save; ``elastic_agent`` is polled each step so
         operator-requested elastic checkpoints are taken between steps
         (the AIMaster contract, ``kubedl_tpu.train.checkpoint``).
         ``eval_fn(state) -> dict`` runs every ``eval_every`` steps (and
         once after the last step) on the CURRENT state — held-out
-        validation without leaving the loop."""
+        validation without leaving the loop. ``data_state_fn() -> dict``
+        supplies the data cursor stored with every checkpoint, so a
+        restore resumes the stream at the exact batch boundary."""
         t0 = time.time()
         tokens = 0
         step0 = int(jax.device_get(state.step))  # one sync, then host-side
@@ -226,8 +229,10 @@ class Trainer:
                 if elastic_agent is not None:
                     elastic_agent.poll(state)
                 if checkpoint_manager is not None:
-                    checkpoint_manager.save(state, step=step0 + i + 1,
-                                            periodic=True)
+                    checkpoint_manager.save(
+                        state, step=step0 + i + 1, periodic=True,
+                        data_state=(data_state_fn() if data_state_fn
+                                    else None))
                 if log_every and (i + 1) % log_every == 0:
                     dt = time.time() - t0
                     print(f"step {int(state.step)} loss {float(loss):.4f} "
@@ -243,7 +248,9 @@ class Trainer:
             if tracing:
                 jax.profiler.stop_trace()
         if checkpoint_manager is not None:
-            checkpoint_manager.save(state, force=True)
+            checkpoint_manager.save(
+                state, force=True,
+                data_state=(data_state_fn() if data_state_fn else None))
             checkpoint_manager.wait_until_finished()
         return state
 

@@ -232,3 +232,23 @@ def test_jaxjob_elastic_train_e2e(api, op, tmp_path):
     assert post and min(r["step"] for r in post) == rr["restored"] + 1
     assert any(r.get("done") and r["world"] == 2 for r in recs)
     assert max(r["step"] for r in post) == 16
+
+    # deterministic data resume (VERDICT r4 next #1): the restarted
+    # container restored the data cursor and consumed EXACTLY the batch
+    # an uninterrupted run would consume at each step — every logged
+    # batch digest (including the first post-restart one) matches the
+    # digest of batch step-1 of a fresh, never-interrupted stream
+    import hashlib
+
+    from kubedl_tpu.train.data import synthetic_lm_batches
+    cursors = [r for r in recs if "data_cursor" in r]
+    assert cursors and cursors[-1]["data_cursor"] == rr["restored"]
+    ref_stream = synthetic_lm_batches(4, 32, 128, seed=7)
+    expected = [hashlib.blake2s(next(ref_stream)["tokens"].tobytes(),
+                                digest_size=8).hexdigest()
+                for _ in range(16)]
+    digested = [r for r in recs if "batch_digest" in r]
+    assert digested, "payload logged no batch digests"
+    for r in digested:
+        assert r["batch_digest"] == expected[r["step"] - 1], (
+            f"step {r['step']} trained on the wrong batch after resume")
