@@ -94,6 +94,316 @@ def pipeline_apply(mesh: Mesh, stage_fn, stage_params, x, num_micro: int,
     return y.reshape(x.shape)
 
 
+# ---------------------------------------------------------------------------
+# 1F1B (PipeDream-flush) schedule
+# ---------------------------------------------------------------------------
+
+class Schedule1F1B:
+    """Static per-stage slot schedule for non-interleaved 1F1B.
+
+    GPipe runs all forwards then all backwards, so every stage holds
+    ``num_micro`` activation stashes at the bubble's peak; 1F1B starts
+    microbatch i's backward as soon as the last stage finishes its
+    forward, bounding stage ``s``'s live stashes to ``pp - s``. Both
+    schedules occupy ``2 * (num_micro + pp - 1)`` slots — 1F1B buys
+    memory, not bubble time (the interleaved variant would buy time too).
+
+    Attributes (numpy int32, ``-1`` = idle):
+
+    * ``fwd_mb[s, t]`` — microbatch whose FORWARD stage ``s`` runs at
+      slot ``t``;
+    * ``bwd_mb[s, t]`` — microbatch whose BACKWARD it runs;
+    * ``arr_f[s, t]`` / ``arr_b[s, t]`` — microbatch arriving on the
+      activation / cotangent wire at the top of slot ``t`` (what the
+      neighbor computed last slot — the executor banks it by this id);
+    * ``depth`` — smallest safe ring-buffer depth for the activation
+      stash and both arrival buffers (verified against the schedule, so
+      an executor indexing ``mb % depth`` can never overwrite a live
+      entry). ``depth <= pp + 1`` — the 1F1B memory bound — vs GPipe's
+      ``num_micro``.
+    """
+
+    def __init__(self, pp: int, num_micro: int):
+        import numpy as np
+
+        S, M = pp, num_micro
+        self.pp, self.num_micro = S, M
+        # op list per stage: warmup forwards, steady 1F1B, cooldown
+        ops = []
+        for s in range(S):
+            w = min(S - 1 - s, M)
+            seq = [("F", i) for i in range(w)]
+            nb = 0
+            for i in range(w, M):
+                seq.append(("F", i))
+                seq.append(("B", nb))
+                nb += 1
+            seq += [("B", j) for j in range(nb, M)]
+            ops.append(seq)
+
+        # greedy list scheduling under the data dependencies:
+        # F_s(i) after F_{s-1}(i);  B_s(i) after F_s(i) and B_{s+1}(i)
+        f_slot = [[-1] * M for _ in range(S)]
+        b_slot = [[-1] * M for _ in range(S)]
+        ptr = [0] * S
+        cols = []
+        t = 0
+        while any(ptr[s] < len(ops[s]) for s in range(S)):
+            col = []
+            for s in range(S):
+                op = ops[s][ptr[s]] if ptr[s] < len(ops[s]) else None
+                ok = False
+                if op is not None:
+                    kind, i = op
+                    if kind == "F":
+                        ok = s == 0 or 0 <= f_slot[s - 1][i] < t
+                    else:
+                        ok = 0 <= f_slot[s][i] < t and (
+                            s == S - 1 or 0 <= b_slot[s + 1][i] < t)
+                if ok:
+                    col.append(op)
+                    (f_slot if kind == "F" else b_slot)[s][i] = t
+                    ptr[s] += 1
+                else:
+                    col.append(None)
+            cols.append(col)
+            t += 1
+        T = t
+        assert T == 2 * (M + S - 1) or S == 1, (T, S, M)
+
+        self.slots = T
+        self.fwd_mb = np.full((S, T), -1, np.int32)
+        self.bwd_mb = np.full((S, T), -1, np.int32)
+        for tt, col in enumerate(cols):
+            for s, op in enumerate(col):
+                if op is not None:
+                    (self.fwd_mb if op[0] == "F" else
+                     self.bwd_mb)[s, tt] = op[1]
+        # arrivals: what the neighbor sent at the END of the previous slot
+        self.arr_f = np.full((S, T), -1, np.int32)
+        self.arr_b = np.full((S, T), -1, np.int32)
+        self.arr_f[1:, 1:] = self.fwd_mb[:-1, :-1]
+        self.arr_b[:-1, 1:] = self.bwd_mb[1:, :-1]
+
+        # smallest ring depth with no live-entry overwrite, verified
+        # against the actual slot assignment (mb % depth indexing):
+        #   stash:   B_s(i) strictly before F_s(i+D) writes its slot
+        #   act_in:  consumed at F_s(i); overwritten at F_{s-1}(i+D)+1
+        #   grad_in: consumed at B_s(i); overwritten at B_{s+1}(i+D)+1
+        def safe(D: int) -> bool:
+            for s in range(S):
+                for i in range(M - D):
+                    if not f_slot[s][i + D] > b_slot[s][i]:
+                        return False
+                    if s > 0 and not f_slot[s - 1][i + D] + 1 > f_slot[s][i]:
+                        return False
+                    if s < S - 1 and \
+                            not b_slot[s + 1][i + D] + 1 > b_slot[s][i]:
+                        return False
+            return True
+
+        D = 1
+        while not safe(D):
+            D += 1
+        assert D <= min(S + 1, M), (D, S, M)
+        self.depth = min(D, M)
+
+    def max_inflight(self, s: int) -> int:
+        """Peak count of microbatches whose forward ran at stage ``s``
+        but whose backward has not — the activation-memory bound the
+        schedule exists to shrink."""
+        import numpy as np
+
+        f = self.fwd_mb[s]
+        b = self.bwd_mb[s]
+        live = peak = 0
+        for t in range(self.slots):
+            if f[t] >= 0:
+                live += 1
+                peak = max(peak, live)
+            if b[t] >= 0:
+                live -= 1
+        return int(np.int32(peak))
+
+
+def pipeline_grads_1f1b(mesh: Mesh, stage_fn, stage_params, head_params,
+                        x, aux, num_micro: int, loss_fn_mb,
+                        axis_name: str = "pp"):
+    """Forward AND backward through a ``pp``-stage pipeline on the 1F1B
+    schedule; returns ``(loss, stage_grads, head_grads)``.
+
+    Unlike :func:`pipeline_apply` (GPipe: ``jax.grad`` differentiates the
+    forward scan, so every stage stashes all ``num_micro`` activations),
+    this schedules the backward explicitly: stage ``s`` holds at most
+    ``Schedule1F1B.depth <= pp + 1`` stashed microbatch INPUTS (the
+    backward recomputes its stage forward from the stash — remat-style),
+    which is the memory headroom 1F1B exists for at real ``pp``.
+
+    * ``stage_fn(params_one_stage, x_micro) -> y_micro`` (shape-preserving,
+      same contract as :func:`pipeline_apply`);
+    * ``stage_params``: leaves with leading stage axis ``pp``;
+    * ``head_params``: replicated pytree for the loss head (final norm /
+      lm head / targets projection) — consumed only by the LAST stage;
+    * ``x``: ``[batch, ...]``; ``aux``: pytree of ``[batch, ...]`` leaves
+      riding with the data (targets, masks), microbatched alongside x;
+    * ``loss_fn_mb(head_params, y_micro, aux_micro) -> scalar`` —
+      per-microbatch mean loss (local to the device's batch shard).
+
+    Loss is the mean over microbatches (matching a GPipe loss over the
+    same global batch); grads are d(loss)/d(stage_params) and
+    d(loss)/d(head_params), reduced over the data axes.
+    """
+    S = mesh.shape[axis_name]
+    b = x.shape[0]
+    if b % num_micro:
+        raise ValueError(f"batch {b} not divisible by num_micro={num_micro}")
+    xm = x.reshape((num_micro, b // num_micro) + x.shape[1:])
+    auxm = jax.tree.map(
+        lambda a: a.reshape((num_micro, b // num_micro) + a.shape[1:]), aux)
+
+    if S == 1:
+        p0 = jax.tree.map(lambda p: p[0], stage_params)
+
+        def mb_loss(p0_, hp, xmb, amb):
+            return loss_fn_mb(hp, stage_fn(p0_, xmb), amb)
+
+        def body(carry, mb):
+            lacc, gacc, hacc = carry
+            xmb, amb = mb
+            (l, (gp, gh)) = jax.value_and_grad(mb_loss, argnums=(0, 1))(
+                p0, head_params, xmb, amb)
+            return (lacc + l,
+                    jax.tree.map(jnp.add, gacc, gp),
+                    jax.tree.map(jnp.add, hacc, gh)), None
+
+        zeros_f32 = lambda t: jax.tree.map(  # noqa: E731
+            lambda p: jnp.zeros(p.shape, jnp.float32), t)
+        (loss, gp, gh), _ = jax.lax.scan(
+            body, (jnp.zeros((), jnp.float32), zeros_f32(p0),
+                   zeros_f32(head_params)), (xm, auxm))
+        return (loss / num_micro,
+                jax.tree.map(lambda g: g[None] / num_micro, gp),
+                jax.tree.map(lambda g: g / num_micro, gh))
+
+    sched = Schedule1F1B(S, num_micro)
+    D = sched.depth
+    T = sched.slots
+    fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+    bwd_perm = [(i, (i - 1) % S) for i in range(S)]
+    # the batch shards over exactly these axes (data_spec below); pmean
+    # over any other axis would be rejected — nothing varies over them
+    data_axes = ("dp", "fsdp")
+
+    import numpy as np
+    sched_rows = jnp.asarray(
+        np.stack([sched.fwd_mb, sched.bwd_mb, sched.arr_f, sched.arr_b],
+                 axis=1))                                   # [S, 4, T]
+
+    def per_device(params_shard, hp, xm, auxm, rows):
+        stage = jax.lax.axis_index(axis_name)
+        p0 = jax.tree.map(lambda p: p[0], params_shard)
+        # mark the (replicated) primals varying over the axes we reduce
+        # grads across BEFORE any vjp: the cotangent of an invariant
+        # primal comes back 'unreduced', and every accumulation into a
+        # varying accumulator would materialize an implicit psum — one
+        # param-tree collective per slot AND double-counted grads after
+        # the final pmean. Varying primals keep cotangents local; the
+        # single pmean at the end is the only cross-device reduction.
+        p0 = jax.lax.pcast(p0, data_axes, to="varying")
+        hp = jax.lax.pcast(hp, data_axes + (axis_name,), to="varying")
+        mb_zero = jnp.zeros_like(xm[0])
+        f32z = lambda t: jax.tree.map(  # noqa: E731
+            lambda p: jnp.zeros(p.shape, jnp.float32), t)
+
+        def fwd_and_loss(p, xx, h, amb):
+            y = stage_fn(p, xx)
+            return y, loss_fn_mb(h, y, amb)
+
+        def slot(carry, cols):
+            stash, act_in, grad_in, gacc, hacc, lacc, aw, gw = carry
+            fi, bi, af, ab = cols
+            # 1. bank last slot's arrivals under their microbatch id
+            act_in = jnp.where(af >= 0,
+                               act_in.at[jnp.clip(af, 0) % D].set(aw),
+                               act_in)
+            grad_in = jnp.where(ab >= 0,
+                                grad_in.at[jnp.clip(ab, 0) % D].set(gw),
+                                grad_in)
+            # 2. forward slot: stage 0 injects from xm, others consume
+            # the banked activation; the INPUT is stashed for the remat
+            # backward (1F1B's bounded stash)
+            fi_c = jnp.clip(fi, 0, num_micro - 1)
+            x_in = jnp.where(stage == 0, xm[fi_c], act_in[fi_c % D])
+            y = stage_fn(p0, x_in)
+            stash = jnp.where(fi >= 0, stash.at[fi_c % D].set(x_in), stash)
+            send_act = jnp.where(fi >= 0, y, mb_zero)
+            # 3. backward slot: recompute this stage's forward from the
+            # stash, seed the cotangent — 1.0 into the loss on the last
+            # stage, the banked neighbor cotangent elsewhere
+            bi_c = jnp.clip(bi, 0, num_micro - 1)
+            x_s = stash[bi_c % D]
+            amb = jax.tree.map(lambda a: a[bi_c], auxm)
+            (_, l), vjp = jax.vjp(
+                lambda p, xx, h: fwd_and_loss(p, xx, h, amb), p0, x_s, hp)
+            is_last = stage == S - 1
+            g_y = jnp.where(is_last, mb_zero, grad_in[bi_c % D]).astype(
+                x_s.dtype)
+            # ones_like/zeros_like inherit l's varying-axes type — a bare
+            # scalar would be pp-varying only and the vjp rejects it
+            g_l = jnp.where(is_last, jnp.ones_like(l), jnp.zeros_like(l))
+            dp, dx, dh = vjp((g_y, g_l))
+            live = bi >= 0
+            livef = jnp.where(live, 1.0, 0.0)
+            gacc = jax.tree.map(
+                lambda a, d: a + livef * d.astype(jnp.float32), gacc, dp)
+            hacc = jax.tree.map(
+                lambda a, d: a + livef * d.astype(jnp.float32), hacc, dh)
+            lacc = lacc + livef * jnp.where(is_last, l, 0.0).astype(
+                jnp.float32)
+            send_grad = jnp.where(live, dx, mb_zero.astype(x_s.dtype))
+            # 4. one neighbor exchange per direction per slot (ICI)
+            aw = jax.lax.ppermute(send_act, axis_name, fwd_perm)
+            gw = jax.lax.ppermute(send_grad, axis_name, bwd_perm)
+            return (stash, act_in, grad_in, gacc, hacc, lacc, aw, gw), None
+
+        # every carry component becomes varying over BOTH the data axes
+        # (batch-sharded activations flow in) and pp (ppermute + stage
+        # masking) — mark fresh zeros up front or scan's carry-type
+        # check rejects the loop
+        buf = jnp.zeros((D,) + xm.shape[1:], xm.dtype)
+        wire = jnp.zeros(xm.shape[1:], xm.dtype)
+        init = (buf, buf, buf, f32z(p0), f32z(hp),
+                jnp.zeros((), jnp.float32), wire, wire)
+        init = jax.lax.pcast(init, ("dp", "fsdp", axis_name),
+                             to="varying")
+        cols = jnp.moveaxis(rows[0], -1, 0)               # [T, 4]
+        (stash, act_in, grad_in, gacc, hacc, lacc, aw, gw), _ = \
+            jax.lax.scan(slot, init, cols)
+        # loss lives on the last stage; head grads too — psum over pp
+        # replicates both. Stage grads stay per-stage (pp-sharded) but
+        # reduce over the data axes, like GSPMD would for a jax.grad.
+        loss = jax.lax.psum(lacc, axis_name)
+        loss = jax.lax.pmean(loss, data_axes)
+        hg = jax.tree.map(lambda g: jax.lax.pmean(
+            jax.lax.psum(g, axis_name), data_axes), hacc)
+        sg = jax.tree.map(lambda g: jax.lax.pmean(g, data_axes)[None],
+                          gacc)
+        return loss, sg, hg
+
+    pp_spec = jax.tree.map(lambda _: P(axis_name), stage_params)
+    hp_spec = jax.tree.map(lambda _: P(), head_params)
+    data_spec = P(None, ("dp", "fsdp"))
+    aux_spec = jax.tree.map(lambda _: data_spec, aux)
+    fn = jax.shard_map(
+        per_device, mesh=mesh,
+        in_specs=(pp_spec, hp_spec, data_spec, aux_spec, P(axis_name)),
+        out_specs=(P(), pp_spec, hp_spec))
+    loss, sg, hg = fn(stage_params, head_params, xm, auxm, sched_rows)
+    n = num_micro
+    return (loss / n, jax.tree.map(lambda g: g / n, sg),
+            jax.tree.map(lambda g: g / n, hg))
+
+
 def stack_stages(layer_params, pp: int):
     """[L, ...]-stacked layer params -> [pp, L/pp, ...] stage-stacked."""
     def restack(p):

@@ -114,6 +114,123 @@ def test_pipelined_llama_stack(mesh):
                            - got.astype(jnp.float32))) < 2e-2  # bf16 path
 
 
+# -- 1F1B ------------------------------------------------------------------
+
+def test_1f1b_schedule_bubble_math():
+    """Slot count matches GPipe's 2(M+pp-1); every forward precedes its
+    backward; stage s holds at most min(pp - s, M) in-flight microbatches
+    (vs GPipe's M) — the memory bound 1F1B exists for."""
+    from kubedl_tpu.parallel.pipeline import Schedule1F1B
+    for pp, M in [(2, 4), (4, 8), (4, 4), (3, 9), (4, 2)]:
+        s = Schedule1F1B(pp, M)
+        assert s.slots == 2 * (M + pp - 1)
+        for st in range(pp):
+            fs = {int(m): t for t in range(s.slots)
+                  if (m := s.fwd_mb[st, t]) >= 0}
+            bs = {int(m): t for t in range(s.slots)
+                  if (m := s.bwd_mb[st, t]) >= 0}
+            assert set(fs) == set(bs) == set(range(M))
+            for i in range(M):
+                assert fs[i] < bs[i]
+            assert s.max_inflight(st) <= min(pp - st, M), (pp, M, st)
+        # the whole point: peak stash well under GPipe's M
+        if M > pp:
+            assert s.max_inflight(0) == pp
+        assert s.depth <= min(pp + 1, M)
+
+
+def test_1f1b_matches_sequential_loss_and_grads(mesh):
+    """1F1B executor parity: loss and grads (stages AND head) equal the
+    plain sequential computation."""
+    from kubedl_tpu.parallel.pipeline import pipeline_grads_1f1b
+    d, L, pp, M = 16, 8, 4, 4
+    layers = _mlp_layers(jax.random.PRNGKey(0), L, d)
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, d))
+    tgt = jax.random.normal(jax.random.PRNGKey(2), (8, d))
+    head = {"w": jax.random.normal(jax.random.PRNGKey(3), (d, d)) * 0.1}
+
+    def loss_mb(hp, y, aux):
+        return jnp.mean((y @ hp["w"] - aux["tgt"]) ** 2)
+
+    def loss_seq(layers, hp):
+        ys = _sequential(layers, x)
+        xm = ys.reshape(M, 8 // M, d)
+        tm = tgt.reshape(M, 8 // M, d)
+        return jnp.mean(jax.vmap(
+            lambda y, t: loss_mb(hp, y, {"tgt": t}))(xm, tm))
+
+    want_l, (want_g, want_h) = jax.value_and_grad(
+        loss_seq, argnums=(0, 1))(layers, head)
+
+    got_l, got_g, got_h = pipeline_grads_1f1b(
+        mesh, stage_scan(_layer_fn), stack_stages(layers, pp), head, x,
+        {"tgt": tgt}, M, loss_mb)
+    assert abs(float(want_l) - float(got_l)) < 1e-5
+    got_g_flat = jax.tree.map(
+        lambda p: p.reshape((L,) + p.shape[2:]), got_g)
+    for k in want_g:
+        err = jnp.max(jnp.abs(want_g[k] - got_g_flat[k]))
+        assert err < 1e-4, (k, float(err))
+    err = jnp.max(jnp.abs(want_h["w"] - got_h["w"]))
+    assert err < 1e-4, float(err)
+
+
+def test_1f1b_more_micro_than_stages(mesh):
+    """M > pp exercises the steady-state 1F1B interleave and the ring
+    buffers wrapping (depth < M)."""
+    from kubedl_tpu.parallel.pipeline import pipeline_grads_1f1b
+    d, L, pp, M = 8, 4, 4, 8
+    layers = _mlp_layers(jax.random.PRNGKey(4), L, d)
+    x = jax.random.normal(jax.random.PRNGKey(5), (16, d))
+    head = {"w": jnp.eye(d)}
+
+    def loss_mb(hp, y, aux):
+        return jnp.mean((y @ hp["w"]) ** 2)
+
+    def loss_seq(layers):
+        y = _sequential(layers, x)
+        ym = y.reshape(M, 16 // M, d)
+        return jnp.mean(jax.vmap(
+            lambda yy: loss_mb(head, yy, {}))(ym))
+
+    want_l = float(loss_seq(layers))
+    want_g = jax.grad(loss_seq)(layers)
+    got_l, got_g, _ = pipeline_grads_1f1b(
+        mesh, stage_scan(_layer_fn), stack_stages(layers, pp), head, x,
+        {}, M, loss_mb)
+    assert abs(want_l - float(got_l)) < 1e-5
+    got_g_flat = jax.tree.map(
+        lambda p: p.reshape((L,) + p.shape[2:]), got_g)
+    for k in want_g:
+        assert jnp.max(jnp.abs(want_g[k] - got_g_flat[k])) < 1e-4
+
+
+def test_1f1b_single_stage_degenerates():
+    from kubedl_tpu.parallel.pipeline import pipeline_grads_1f1b
+    mesh1 = build_mesh(MeshConfig(fsdp=8))
+    d, L = 8, 4
+    layers = _mlp_layers(jax.random.PRNGKey(0), L, d)
+    x = jax.random.normal(jax.random.PRNGKey(1), (8, d))
+    head = {"w": jnp.eye(d)}
+
+    def loss_mb(hp, y, aux):
+        return jnp.mean((y @ hp["w"]) ** 2)
+
+    def loss_seq(layers):
+        y = _sequential(layers, x)
+        ym = y.reshape(2, 4, d)
+        return jnp.mean(jax.vmap(lambda yy: loss_mb(head, yy, {}))(ym))
+
+    got_l, got_g, _ = pipeline_grads_1f1b(
+        mesh1, stage_scan(_layer_fn), stack_stages(layers, 1), head, x,
+        {}, 2, loss_mb)
+    assert abs(float(loss_seq(layers)) - float(got_l)) < 1e-5
+    want_g = jax.grad(loss_seq)(layers)
+    got_flat = jax.tree.map(lambda p: p.reshape((L,) + p.shape[2:]), got_g)
+    for k in want_g:
+        assert jnp.max(jnp.abs(want_g[k] - got_flat[k])) < 1e-4
+
+
 def test_bad_shapes_raise(mesh):
     layers = _mlp_layers(jax.random.PRNGKey(0), 6, 8)
     with pytest.raises(ValueError):
