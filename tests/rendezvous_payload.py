@@ -1,0 +1,47 @@
+"""Two-process rendezvous payload (not a test module).
+
+Launched by tests/test_bootstrap.py with the env the OPERATOR rendered
+for its pod: calls the real ``initialize_distributed()`` on the CPU
+backend, then proves the world actually formed with a cross-process
+collective. Any wrong ``process_id``/``num_processes`` rendering either
+trips the asserts or hangs the rendezvous (the test times out)."""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kubedl_tpu.runtime.bootstrap import (initialize_distributed,  # noqa: E402
+                                          pin_platform,
+                                          rendezvous_from_env)
+
+pin_platform("cpu")
+
+
+def main() -> None:
+    info = rendezvous_from_env()
+    assert info is not None, "no rendezvous contract in env"
+    initialize_distributed(info)
+
+    import jax
+    import jax.numpy as jnp
+
+    # the contract the operator rendered must be the world jax formed
+    assert jax.process_count() == info.num_processes, (
+        jax.process_count(), info)
+    assert jax.process_index() == info.process_id, (
+        jax.process_index(), info)
+
+    # cross-process proof: each process contributes 2**index, so the
+    # reduction is correct ONLY if both distinct processes participated
+    # (two rank-0s would deadlock or sum to 2)
+    from jax.experimental import multihost_utils
+    val = multihost_utils.process_allgather(
+        jnp.asarray([2 ** jax.process_index()]))
+    print(f"RDV_OK total={int(val.sum())} count={jax.process_count()} "
+          f"index={jax.process_index()}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
