@@ -215,9 +215,11 @@ def main(argv=None) -> int:
         port=int(os.environ.get("KUBEDL_SERVING_PORT", "8501") or 8501),
         tokenizer=tokenizer,
     )).start()
+    # log the RESOLVED tokenizer spec: auto-detected in-model assets make
+    # the raw env var read 'off' while text serving is on (ADVICE r4)
     log.info("serving %s on %s (lanes=%d quantize=%s tokenizer=%s)",
              model_path, server.url, lanes, quantize or "off",
-             os.environ.get("KUBEDL_TOKENIZER") or "off")
+             tok_spec if tokenizer is not None else "off")
 
     done = threading.Event()
 

@@ -127,6 +127,10 @@ def filtered_probs(logits, temperature: float, top_k: int = 0,
 
     x = np.asarray(logits, np.float64) / max(temperature, 1e-6)
     if top_k > 0:
+        # tie semantics deliberately match sample_logits / sample_logits_many:
+        # both cut with `value < kth`, so every token TIED with the k-th
+        # logit stays in the set on all three samplers (ADVICE r4 review:
+        # lax.top_k only supplies the threshold there, never the cut)
         kth = np.sort(x)[-top_k]
         x = np.where(x < kth, -np.inf, x)
     if top_p < 1.0:

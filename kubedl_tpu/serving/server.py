@@ -229,6 +229,15 @@ class InferenceServer:
                     if lp:
                         pred["logprobs"] = r.logprobs
                     preds.append(pred)
+            except BaseException:
+                # a timed-out (or aborted) buffered batch must not keep
+                # burning lanes: every request still decoding would run
+                # to its full cap into discarded output (ADVICE r4) —
+                # cancel them before surfacing the error
+                for r in reqs:
+                    if not r.done.is_set():
+                        r.cancel()
+                raise
             finally:
                 # tokens already generated by earlier requests in the
                 # batch are real device work even when a later request
@@ -524,6 +533,9 @@ class InferenceServer:
 
         from .engine import resolve_family
         eng = self.engine
+        # every engine kind exposes config/params (the speculative
+        # adapter forwards its TARGET model's — ADVICE r4: embeddings on
+        # a speculative predictor used to 500 with AttributeError)
         config, params = eng.config, eng.params
         family = resolve_family(config)
         longest = max(len(r) for r in ids)

@@ -84,6 +84,19 @@ class SpeculativeServingAdapter:
         #: /metrics (draft quality is THE speculative tuning signal)
         self.stats = SpecStats()
 
+    @property
+    def config(self):
+        """The TARGET model's config — the serving contract the other
+        engines expose; lets model-introspecting routes (embeddings)
+        work unchanged on a speculative predictor."""
+        return self.engine.tc
+
+    @property
+    def params(self):
+        """The TARGET model's params (the draft only affects decode
+        speed, never representations)."""
+        return self.engine.tp
+
     def generate(self, prompts, max_new_tokens: int,
                  seed: int = 0, return_logprobs: bool = False):
         if return_logprobs:
