@@ -62,6 +62,40 @@ def test_mqa_cache_replicates(setup):
     assert tp.generate([[4, 4, 2]], 6) == solo.generate([[4, 4, 2]], 6)
 
 
+def test_http_server_over_tp_mesh(setup):
+    """The FULL serving path — InferenceServer HTTP predict — over a
+    mesh-sharded continuous-batching engine (VERDICT r4 next #2: the
+    BASELINE config-5 v5e-8 shape, previously never executed end to
+    end). Predictions must be token-identical to the unsharded engine."""
+    import json
+    import urllib.request
+
+    from kubedl_tpu.serving.server import InferenceServer, ServerConfig
+
+    cfg, params, mesh = setup
+    solo = InferenceEngine(cfg, params, GenerateConfig(max_len=64))
+    # .start() runs the scheduler loop — the HTTP predict path submits
+    # to lanes and waits; without the loop nothing ever ticks
+    eng = ContinuousBatchingEngine(cfg, params, lanes=2, max_len=64,
+                                   mesh=mesh).start()
+    server = InferenceServer(eng, ServerConfig(
+        model_name="tp", host="127.0.0.1", port=0)).start()
+    try:
+        req = urllib.request.Request(
+            server.url + "/v1/models/tp:predict", method="POST",
+            data=json.dumps({"instances": [
+                {"prompt_tokens": [5, 7, 11], "max_tokens": 6},
+                {"prompt_tokens": [3], "max_tokens": 4},
+            ]}).encode(), headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req) as r:
+            preds = json.load(r)["predictions"]
+        assert preds[0]["tokens"] == solo.generate([[5, 7, 11]], 6)[0]
+        assert preds[1]["tokens"] == solo.generate([[3]], 4)[0]
+    finally:
+        server.stop()
+        eng.stop()
+
+
 def test_mesh_rejects_quantization(setup):
     cfg, params, mesh = setup
     with pytest.raises(ValueError, match="quantization"):
