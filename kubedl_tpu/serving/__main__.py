@@ -10,7 +10,10 @@ env). Honors the autoconfig contract end to end:
 * ``KUBEDL_SERVING_LANES``    — continuous-batching lane count
 * ``KUBEDL_SERVING_QUANTIZE`` — "int8", "int4", or ""
 * ``KUBEDL_SERVING_SPEC_K``   — >0 enables speculative decoding with the
-  draft model at ``KUBEDL_SERVING_DRAFT_PATH`` (single-lane)
+  draft model at ``KUBEDL_SERVING_DRAFT_PATH``; it rides the
+  continuous-batching lanes (every lane drafts k tokens per round, one
+  [lanes, k+1] target pass verifies them), so concurrent requests keep
+  streaming/cancel/per-request sampling
 * ``KUBEDL_SERVING_TP``       — >1: tensor-parallel serving over that
   many LOCAL chips (one host's mesh; params shard by their logical
   specs, the KV cache by kv-heads). Not combinable with QUANTIZE.
@@ -74,6 +77,7 @@ def build_engine(model_path: str, lanes: int, quantize: str, spec_k: int,
                 f"KUBEDL_SERVING_TP={tp} but only {len(devices)} local "
                 "devices")
         mesh = build_mesh(MeshConfig(dp=1, fsdp=1, tp=tp), devices[:tp])
+    from .batching import ContinuousBatchingEngine
     if spec_k > 0:
         if mesh is not None:
             # refusing beats silently serving unsharded (the model may
@@ -83,15 +87,16 @@ def build_engine(model_path: str, lanes: int, quantize: str, spec_k: int,
         if not draft_path:
             raise ValueError("KUBEDL_SERVING_SPEC_K > 0 needs "
                              "KUBEDL_SERVING_DRAFT_PATH")
-        from .engine import maybe_quantize
-        from .speculative import SpeculativeEngine, SpeculativeServingAdapter
+        # speculative decoding rides the continuous-batching lanes:
+        # every lane drafts spec_k tokens per round and ONE [lanes, k+1]
+        # target pass verifies them all — concurrent requests keep their
+        # streaming/cancel/per-request-sampling semantics
         dcfg, dparams = load_model(draft_path)
-        return SpeculativeServingAdapter(
-            SpeculativeEngine(
-                config, maybe_quantize(params, quantize or None),
-                dcfg, dparams, k=spec_k, max_len=max_len),
-            gen=GenerateConfig(max_len=max_len, eos_id=eos_id))
-    from .batching import ContinuousBatchingEngine
+        return ContinuousBatchingEngine(
+            config, params, lanes=lanes, max_len=max_len,
+            gen=GenerateConfig(max_len=max_len, eos_id=eos_id),
+            quantize=quantize or None, draft_config=dcfg,
+            draft_params=dparams, spec_k=spec_k).start()
     return ContinuousBatchingEngine(
         config, params, lanes=lanes, max_len=max_len,
         gen=GenerateConfig(max_len=max_len, eos_id=eos_id),

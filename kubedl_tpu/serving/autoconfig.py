@@ -163,7 +163,7 @@ def probe_candidate(model, cand: Candidate, prompt_len: int = 64,
       decode one token per tick).
 
     Returns None when the candidate is unbuildable (speculative without
-    a draft model, or speculative with more than one lane)."""
+    a draft model)."""
     import numpy as np
 
     if new_tokens < 4:
@@ -176,27 +176,27 @@ def probe_candidate(model, cand: Candidate, prompt_len: int = 64,
     rng = np.random.default_rng(0)
     prompt = rng.integers(1, cfg.vocab_size, prompt_len).tolist()
 
+    from .batching import ContinuousBatchingEngine
     if cand.speculative_k > 0:
-        if draft is None or cand.batch != 1:
-            return None  # the in-tree speculative engine is single-lane
-        from .engine import maybe_quantize
-        from .speculative import SpeculativeEngine
-        eng = SpeculativeEngine(
-            cfg, maybe_quantize(params, cand.quantize), draft[0], draft[1],
-            k=cand.speculative_k, max_len=max_len)
-        gen = lambda n: eng.generate(prompt, n)        # noqa: E731
-        gen_one = gen
+        if draft is None:
+            return None  # speculative points need a draft model
+        # the production shape: speculative decoding ON the
+        # continuous-batching lanes, so the draft-k dimension is measured
+        # with concurrent lanes — exactly what the predictor deploys
+        eng = ContinuousBatchingEngine(
+            cfg, params, lanes=cand.batch, max_len=max_len,
+            quantize=cand.quantize, draft_config=draft[0],
+            draft_params=draft[1], spec_k=cand.speculative_k)
     else:
-        from .batching import ContinuousBatchingEngine
         eng = ContinuousBatchingEngine(cfg, params, lanes=cand.batch,
                                        max_len=max_len,
                                        quantize=cand.quantize)
 
-        def gen(n):
-            return eng.run([(prompt, n)] * cand.batch)
+    def gen(n):
+        return eng.run([(prompt, n)] * cand.batch)
 
-        def gen_one(n):
-            return eng.run([(prompt, n)])
+    def gen_one(n):
+        return eng.run([(prompt, n)])
 
     lo, hi = min(2, new_tokens), new_tokens
     gen_one(1)                     # compile prefill + first decode shape

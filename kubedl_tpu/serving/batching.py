@@ -162,8 +162,10 @@ class ContinuousBatchingEngine:
                  lanes: int = 4, max_len: int = 1024,
                  gen: Optional[GenerateConfig] = None,
                  quantize: Optional[str] = None, seed: int = 0,
-                 mesh=None):
-        from .engine import init_mesh_serving, resolve_family, sample_logits
+                 mesh=None, draft_config=None, draft_params=None,
+                 spec_k: int = 0, quantize_draft: Optional[str] = None):
+        from .engine import (SpecStats, init_mesh_serving, maybe_quantize,
+                             resolve_family, sample_logits)
         self.config = config
         self.family = family = resolve_family(config)
         self.lanes = lanes
@@ -177,33 +179,67 @@ class ContinuousBatchingEngine:
             config, params, quantize, mesh)
         cfg = config
 
-        @partial(jax.jit, donate_argnums=(1,))
-        def _decode(params, cache, tokens, positions):
-            # tokens [lanes, 1], positions [lanes] — per-row cache writes
-            return family.forward_step(cfg, params, tokens, cache,
-                                       positions)
+        # -- speculative decoding per lane (draft model proposes spec_k
+        # tokens for EVERY lane, the target verifies all lanes' chunks in
+        # one [lanes, k+1] pass) — concurrent speculative serving
+        self.spec_k = int(spec_k) if draft_params is not None else 0
+        if self.spec_k:
+            if draft_config.vocab_size != config.vocab_size:
+                raise ValueError(
+                    "target and draft must share a vocabulary")
+            if mesh is not None:
+                raise ValueError("speculative lanes do not compose with "
+                                 "mesh-parallel serving yet")
+            self.dcfg = draft_config
+            self.dfam = resolve_family(draft_config)
+            self.dparams = maybe_quantize(draft_params, quantize_draft)
+            #: aggregate + per-lane acceptance accounting (/metrics)
+            self.stats = SpecStats()
+            self.lane_stats = [SpecStats() for _ in range(lanes)]
+
+        def make_decode(cfg_, fam):
+            @partial(jax.jit, donate_argnums=(1,))
+            def _decode(params, cache, tokens, positions):
+                # tokens [lanes, 1], positions [lanes] — per-row writes
+                return fam.forward_step(cfg_, params, tokens, cache,
+                                        positions)
+            return _decode
+
+        def make_prefill(cfg_, fam):
+            @partial(jax.jit, donate_argnums=(1,))
+            def _prefill(params, cache, tokens, lane, start, n_real):
+                # tokens [1, bucket] right-padded; lane/start/n_real are
+                # TRACED so only the bucket size (a handful of
+                # power-of-two shapes) triggers a compile. The chunk
+                # lands at ``start`` (0 for a plain prefill; the prefix
+                # length when a cached prefix was loaded first). Returns
+                # the real last token's logits (last_pos gathers it
+                # pre-LM-head: one vocab projection, not bucket of
+                # them). valid marks the live cache region: attention
+                # never sees the right-pad anyway (causal +
+                # overwrite-before-attend), but MoE ROUTING must not let
+                # pad tokens consume expert capacity.
+                row = {k: jax.lax.dynamic_slice_in_dim(v, lane, 1, axis=1)
+                       for k, v in cache.items()}
+                valid = (jnp.arange(row["k"].shape[2])
+                         < start + n_real)[None, :]
+                last, row = fam.forward_step(cfg_, params, tokens, row,
+                                             start, valid=valid,
+                                             last_pos=n_real - 1)
+                cache = {k: jax.lax.dynamic_update_slice_in_dim(
+                    cache[k], row[k], lane, axis=1) for k in cache}
+                return last, cache
+            return _prefill
+
+        _decode = make_decode(cfg, family)
+        _prefill = make_prefill(cfg, family)
 
         @partial(jax.jit, donate_argnums=(1,))
-        def _prefill(params, cache, tokens, lane, start, n_real):
-            # tokens [1, bucket] right-padded; lane/start/n_real are
-            # TRACED so only the bucket size (a handful of power-of-two
-            # shapes) triggers a compile. The chunk lands at ``start``
-            # (0 for a plain prefill; the prefix length when a cached
-            # prefix was loaded first). Returns the real last token's
-            # logits (last_pos gathers it pre-LM-head: one vocab
-            # projection, not bucket of them). valid marks the live cache
-            # region: attention never sees the right-pad anyway (causal +
-            # overwrite-before-attend), but MoE ROUTING must not let pad
-            # tokens consume expert capacity.
-            row = {k: jax.lax.dynamic_slice_in_dim(v, lane, 1, axis=1)
-                   for k, v in cache.items()}
-            valid = (jnp.arange(row["k"].shape[2]) < start + n_real)[None, :]
-            last, row = family.forward_step(cfg, params, tokens, row,
-                                            start, valid=valid,
-                                            last_pos=n_real - 1)
-            cache = {k: jax.lax.dynamic_update_slice_in_dim(
-                cache[k], row[k], lane, axis=1) for k in cache}
-            return last, cache
+        def _spec_verify(params, cache, tokens, positions):
+            # tokens [lanes, k+1] at per-row positions: ONE target pass
+            # verifies every lane's draft chunk (all-position logits)
+            return family.forward_step(cfg, params, tokens, cache,
+                                       positions, all_logits=True)
 
         @partial(jax.jit)
         def _fill_prefix(params, tokens, plen):
@@ -232,6 +268,15 @@ class ContinuousBatchingEngine:
         self._load_prefix = _load_prefix
         self._prefixes: list = []   # (tokens tuple, stored kv, plen)
         self._sample = sample_logits
+        if self.spec_k:
+            self._d_decode = make_decode(self.dcfg, self.dfam)
+            self._d_prefill = make_prefill(self.dcfg, self.dfam)
+            self._spec_verify = _spec_verify
+            self._d_cache = self.dfam.init_cache(self.dcfg, lanes,
+                                                 max_len)
+            #: per-request host rng for the sampled accept rule,
+            #: allocated at admission (seed + admission ordinal)
+            self._spec_admitted = 0
 
         # live scheduler state: one shared cache + lane bookkeeping; the
         # host mirrors (cur/pos) feed the per-tick decode call
@@ -240,6 +285,7 @@ class ContinuousBatchingEngine:
         self._lane_state = [_Lane() for _ in range(lanes)]
         self._cur = np.zeros((lanes, 1), np.int32)
         self._pos = np.zeros((lanes,), np.int32)
+        self._seed = seed
         self._key = jax.random.PRNGKey(seed)
         self._queue: deque[Request] = deque()
         self._cv = threading.Condition()
@@ -387,6 +433,12 @@ class ContinuousBatchingEngine:
                     "the sampling stream)")
             with self._sched_lock:
                 self._key = jax.random.PRNGKey(seed)
+                if self.spec_k:
+                    # the speculative accept rule draws from per-request
+                    # host rngs (seed + admission ordinal): rebase both
+                    # or a reseeded sampled run would not reproduce
+                    self._seed = seed
+                    self._spec_admitted = 0
         reqs = [self.submit(p, n) for p, n in requests]
         if self._thread is None:
             with self._sched_lock:
@@ -422,6 +474,10 @@ class ContinuousBatchingEngine:
             req._finish(cancelled=True)
         self._cache = self._place_cache(
             self.family.init_cache(self.config, self.lanes, self.max_len))
+        if self.spec_k:
+            # the draft cache is donated into _d_decode/_d_prefill too
+            self._d_cache = self.dfam.init_cache(self.dcfg, self.lanes,
+                                                 self.max_len)
         self._cur = np.zeros((self.lanes, 1), np.int32)
         self._pos = np.zeros((self.lanes,), np.int32)
 
@@ -480,6 +536,130 @@ class ContinuousBatchingEngine:
     def _active(self) -> bool:
         return any(l.request is not None for l in self._lane_state)
 
+    def _lane_sampling(self, req: Request):
+        """(temperature, top_k, top_p) for a request — per-request
+        overrides over the engine GenerateConfig."""
+        gen = self.gen
+        t = gen.temperature if req.temperature is None else req.temperature
+        k_ = gen.top_k if req.top_k is None else req.top_k
+        p_ = gen.top_p if req.top_p is None else req.top_p
+        return t, k_, p_
+
+    def _spec_round_k(self) -> int:
+        """Draft lookahead this round: spec_k clamped so every ACTIVE
+        lane's [k+1] verify chunk (and the draft backfill at pos+k) stays
+        inside the cache. The chunk shape is compiled per k, so at most
+        spec_k shapes exist."""
+        space = min(self.max_len - 1 - l.pos
+                    for l in self._lane_state if l.request is not None)
+        return min(self.spec_k, space)
+
+    def _spec_round(self, k: int) -> None:
+        """One speculative round for EVERY lane: k draft proposals each
+        (k batched [lanes, 1] draft steps), one [lanes, k+1] target
+        verify, per-lane acceptance — greedy prefix-match for greedy
+        lanes (output token-identical to the non-speculative engine),
+        the ``spec_accept`` distribution rule for sampled lanes (each
+        emitted token's marginal distribution is exactly the target's).
+        Cache bookkeeping per lane is pointer math: rejected slots stay
+        causally invisible until overwritten (the single-sequence
+        engine's rewind argument, per row)."""
+        from .engine import filtered_probs, spec_accept
+        gen = self.gen
+        lanes_n = self.lanes
+        active = np.asarray([l.request is not None
+                             for l in self._lane_state])
+        # dead lanes still compute (uniform SPMD) but their writes must
+        # stay in range: park them at position 0 — those slots are fully
+        # rewritten by the next admission's bucket prefill
+        pos = np.where(active, self._pos, 0).astype(np.int32)
+        cur = self._cur.copy()
+        sampled = [l.request is not None
+                   and self._lane_sampling(l.request)[0] > 0.0
+                   for l in self._lane_state]
+        drafts = np.zeros((lanes_n, k), np.int32)
+        dprobs = [[None] * k for _ in range(lanes_n)]
+        dcur = cur.copy()
+        for j in range(k):
+            d_logits, self._d_cache = self._d_decode(
+                self.dparams, self._d_cache, jnp.asarray(dcur),
+                jnp.asarray(pos + j))
+            dl = np.asarray(d_logits, np.float32)
+            greedy_next = dl.argmax(-1)
+            for i, lane in enumerate(self._lane_state):
+                if sampled[i]:
+                    t, tk, tp = self._lane_sampling(lane.request)
+                    p = filtered_probs(dl[i], t, tk, tp)
+                    drafts[i, j] = int(
+                        lane.request._spec_rng.choice(len(p), p=p))
+                    dprobs[i][j] = p
+                else:
+                    drafts[i, j] = int(greedy_next[i])
+            dcur[:, 0] = drafts[:, j]
+        chunk = np.concatenate([cur, drafts], axis=1)
+        t_logits, self._cache = self._spec_verify(
+            self.params, self._cache, jnp.asarray(chunk),
+            jnp.asarray(pos))
+        tl = np.asarray(t_logits, np.float32)       # [lanes, k+1, V]
+        # draft backfill: the k-th proposal joined sequences that accept
+        # fully but its KV never entered the draft cache (it was only an
+        # output); one batched step ingests it at pos+k for every lane —
+        # lanes that accepted less overwrite that slot before it is ever
+        # attendable, so the unconditional write is safe and uniform
+        _, self._d_cache = self._d_decode(
+            self.dparams, self._d_cache, jnp.asarray(drafts[:, k - 1:k]),
+            jnp.asarray(pos + k))
+        for i, lane in enumerate(self._lane_state):
+            req = lane.request
+            if req is None:
+                continue
+            if req.cancel_requested:
+                lane.request = None
+                req._finish()
+                continue
+            if sampled[i]:
+                t, tk, tp = self._lane_sampling(req)
+                tpro = [filtered_probs(tl[i, j], t, tk, tp)
+                        for j in range(k + 1)]
+                accepted, nxt = spec_accept(drafts[i], dprobs[i], tpro,
+                                            req._spec_rng)
+            else:
+                targets = tl[i].argmax(-1)          # [k+1]
+                accepted = 0
+                while accepted < k and \
+                        drafts[i, accepted] == targets[accepted]:
+                    accepted += 1
+                nxt = int(targets[accepted])
+            self.stats.proposed += k
+            self.stats.accepted += accepted
+            self.lane_stats[i].proposed += k
+            self.lane_stats[i].accepted += accepted
+            emitted = [int(x) for x in drafts[i, :accepted]] + [int(nxt)]
+            lp_rows = None
+            if req.want_logprobs:
+                # full-softmax log p of each emitted token under the
+                # verify logits of ITS slot — identical numbers to the
+                # per-token decode path
+                row = tl[i, :len(emitted)]
+                row = row - row.max(-1, keepdims=True)
+                lp_all = row - np.log(np.exp(row).sum(-1, keepdims=True))
+                lp_rows = [float(lp_all[j, emitted[j]])
+                           for j in range(len(emitted))]
+            finished = False
+            for j, tok in enumerate(emitted):
+                req._push(tok, lp_rows[j] if lp_rows else None)
+                lane.pos += 1
+                lane.remaining -= 1
+                if (lane.remaining <= 0 or hit_stop(req.tokens, gen)
+                        or lane.pos + 1 >= self.max_len):
+                    finished = True
+                    break
+            self._cur[i, 0] = req.tokens[-1]
+            self._pos[i] = lane.pos
+            if finished:
+                lane.request = None
+                req._finish()
+
     def _admit(self, lane_idx: int) -> None:
         gen = self.gen
         with self._cv:
@@ -523,9 +703,7 @@ class ContinuousBatchingEngine:
             pos0 += n
         plen = plen_total
         self._key, sub = jax.random.split(self._key)
-        t = gen.temperature if req.temperature is None else req.temperature
-        k_ = gen.top_k if req.top_k is None else req.top_k
-        p_ = gen.top_p if req.top_p is None else req.top_p
+        t, k_, p_ = self._lane_sampling(req)
         if t <= 0.0:
             # default/greedy: the one static-arg compile (plain argmax)
             first = int(self._sample(logits, sub, 0.0, 0, 1.0)[0])
@@ -545,9 +723,32 @@ class ContinuousBatchingEngine:
         if lane.remaining <= 0 or hit_stop(req.tokens, gen):
             lane.request = None    # finished in prefill
             req._finish()
+        elif self.spec_k:
+            # draft prefills the FULL prompt into ITS lane (prefix KV
+            # blocks are target-model state; the draft pays its own
+            # prefill so its cache is exact and proposals stay sharp —
+            # a stale draft cache would only cost acceptance, but a
+            # deterministic one keeps rounds reproducible)
+            pos0, remaining = 0, prompt
+            while remaining:
+                space = self.max_len - pos0
+                bucket = min(_bucket(len(remaining)), _pow2_floor(space))
+                n = min(len(remaining), bucket)
+                chunk, remaining = remaining[:n], remaining[n:]
+                toks = np.zeros((1, bucket), np.int32)
+                toks[0, :n] = chunk
+                _, self._d_cache = self._d_prefill(
+                    self.dparams, self._d_cache, jnp.asarray(toks),
+                    jnp.int32(lane_idx), jnp.int32(pos0), jnp.int32(n))
+                pos0 += n
+            # per-request host rng drives the sampled accept rule
+            req._spec_rng = np.random.default_rng(
+                self._seed + 1000003 * self._spec_admitted)
+            self._spec_admitted += 1
 
     def _step_once(self) -> bool:
-        """Fill free lanes, run one decode tick. Returns False once idle."""
+        """Fill free lanes, run one decode tick (or a speculative round
+        when a draft model is configured). Returns False once idle."""
         gen = self.gen
         for i, lane in enumerate(self._lane_state):
             while self._queue and lane.request is None:
@@ -556,10 +757,26 @@ class ContinuousBatchingEngine:
                 break
         if not self._active():
             return bool(self._queue)
+        if self.spec_k:
+            k = self._spec_round_k()
+            if k >= 1:
+                self._spec_round(k)
+                return True
+            # near the cache cap a verify chunk no longer fits: finish
+            # with plain single-token ticks (same as the single-sequence
+            # engine's tail loop)
         # one decode tick for every lane (dead lanes compute garbage)
         logits, self._cache = self._decode(
             self.params, self._cache, jnp.asarray(self._cur),
             jnp.asarray(self._pos))
+        if self.spec_k:
+            # near-cap fallback ticks must keep the DRAFT cache in
+            # lockstep (ingest the same token at the same position the
+            # target just did) — otherwise later spec rounds on other
+            # lanes attend stale draft KV and acceptance silently decays
+            _, self._d_cache = self._d_decode(
+                self.dparams, self._d_cache, jnp.asarray(self._cur),
+                jnp.asarray(self._pos))
         self._key, sub = jax.random.split(self._key)
 
         def lane_param(attr, default):

@@ -64,6 +64,42 @@ def resolve_family(config):
     return moe if isinstance(config, moe.MoEConfig) else llama
 
 
+def spec_accept(drafts, dprobs, tprobs, rng):
+    """The Leviathan et al. speculative accept/resample rule, factored
+    out so its distribution guarantee is unit-testable without a model.
+    Shared by the single-sequence SpeculativeEngine and the per-lane
+    speculative path of the continuous-batching engine.
+
+    ``drafts``: k proposed tokens; ``dprobs``/``tprobs``: the draft's /
+    target's FILTERED probability vectors per slot (tprobs has k+1
+    entries — the last is the bonus slot). Returns ``(n_accepted,
+    next_token)`` where next_token is the resample on rejection or the
+    bonus sample on full acceptance. The marginal distribution of each
+    emitted token provably equals the target's."""
+    for i, x in enumerate(drafts):
+        if rng.random() >= min(1.0, float(tprobs[i][x])
+                               / max(float(dprobs[i][x]), 1e-20)):
+            resid = np.maximum(np.asarray(tprobs[i])
+                               - np.asarray(dprobs[i]), 0.0)
+            s = resid.sum()
+            p = resid / s if s > 0 else np.asarray(tprobs[i])
+            return i, int(rng.choice(len(p), p=p))
+    return len(drafts), int(rng.choice(len(tprobs[-1]),
+                                       p=np.asarray(tprobs[-1])))
+
+
+@dataclass
+class SpecStats:
+    """Lifetime draft proposal/acceptance accounting — the speculative
+    tuning signal, surfaced via the predictor's /metrics."""
+    proposed: int = 0
+    accepted: int = 0
+
+    @property
+    def acceptance_rate(self) -> float:
+        return self.accepted / self.proposed if self.proposed else 0.0
+
+
 def maybe_quantize(params: dict, quantize):
     """Apply a serving quantization mode ('int8', 'int4', or None) to a
     param tree."""

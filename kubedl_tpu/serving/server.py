@@ -110,6 +110,7 @@ class InferenceServer:
             "Time to first streamed token",
             buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10))
         self._m_spec = None
+        self._m_spec_lane = None
         if hasattr(engine, "stats") and \
                 hasattr(engine.stats, "acceptance_rate"):
             # speculative predictors: draft quality on the scrape page
@@ -120,6 +121,14 @@ class InferenceServer:
                                    "Draft tokens accepted"),
                 self.metrics.gauge("kubedl_serving_spec_acceptance_rate",
                                    "Lifetime draft acceptance rate"))
+            if hasattr(engine, "lane_stats"):
+                # the continuous engine's per-lane acceptance: a lane
+                # whose requests draft poorly shows up here, not just in
+                # the lifetime aggregate
+                self._m_spec_lane = self.metrics.gauge(
+                    "kubedl_serving_spec_lane_acceptance_rate",
+                    "Draft acceptance rate per continuous-batching lane",
+                    labels=("lane",))
 
         def _refresh_engine_metrics():
             if self._m_spec is not None:
@@ -127,6 +136,10 @@ class InferenceServer:
                 self._m_spec[0].set(st.proposed)
                 self._m_spec[1].set(st.accepted)
                 self._m_spec[2].set(st.acceptance_rate)
+            if self._m_spec_lane is not None:
+                for i, ls in enumerate(engine.lane_stats):
+                    self._m_spec_lane.set(ls.acceptance_rate,
+                                          lane=str(i))
         self.refresh_engine_metrics = _refresh_engine_metrics
         server = self
 

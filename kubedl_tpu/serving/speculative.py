@@ -24,7 +24,6 @@ TPU-shaped details:
 
 from __future__ import annotations
 
-from dataclasses import dataclass
 from functools import partial
 from typing import Optional, Sequence
 
@@ -33,40 +32,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from .batching import _bucket
-from .engine import (GenerateConfig, filtered_probs, hit_stop,
-                     maybe_quantize, resolve_family)
-
-
-def spec_accept(drafts, dprobs, tprobs, rng):
-    """The Leviathan et al. accept/resample rule, factored out so its
-    distribution guarantee is unit-testable without a model.
-
-    ``drafts``: k proposed tokens; ``dprobs``/``tprobs``: the draft's /
-    target's FILTERED probability vectors per slot (tprobs has k+1
-    entries — the last is the bonus slot). Returns ``(n_accepted,
-    next_token)`` where next_token is the resample on rejection or the
-    bonus sample on full acceptance. The marginal distribution of each
-    emitted token provably equals the target's."""
-    for i, x in enumerate(drafts):
-        if rng.random() >= min(1.0, float(tprobs[i][x])
-                               / max(float(dprobs[i][x]), 1e-20)):
-            resid = np.maximum(np.asarray(tprobs[i])
-                               - np.asarray(dprobs[i]), 0.0)
-            s = resid.sum()
-            p = resid / s if s > 0 else np.asarray(tprobs[i])
-            return i, int(rng.choice(len(p), p=p))
-    return len(drafts), int(rng.choice(len(tprobs[-1]),
-                                       p=np.asarray(tprobs[-1])))
-
-
-@dataclass
-class SpecStats:
-    proposed: int = 0
-    accepted: int = 0
-
-    @property
-    def acceptance_rate(self) -> float:
-        return self.accepted / self.proposed if self.proposed else 0.0
+# spec_accept / SpecStats moved to engine.py (the continuous-batching
+# engine's per-lane speculative path shares them; importing from here
+# would be circular) — re-exported for compatibility
+from .engine import (GenerateConfig, SpecStats, filtered_probs,  # noqa: F401
+                     hit_stop, maybe_quantize, resolve_family, spec_accept)
 
 
 class SpeculativeServingAdapter:

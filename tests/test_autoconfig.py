@@ -20,9 +20,9 @@ pytestmark = pytest.mark.slow
 def fake_measure(cand: Candidate):
     """Deterministic cost model: int8 halves per-token latency but
     changes outputs; speculative amortizes target passes (faster, still
-    greedy-identical); bigger batches raise throughput AND latency."""
-    if cand.speculative_k > 0 and cand.batch != 1:
-        return None   # mirror the real engine: speculative is one-lane
+    greedy-identical); bigger batches raise throughput AND latency.
+    (Speculative composes with any lane count — the engine runs draft
+    rounds per lane.)"""
     lat = 10.0
     if cand.quantize == "int8":
         lat *= 0.55
@@ -100,7 +100,10 @@ def test_live_probe_all_dimensions(tiny_models):
     model, draft = tiny_models
     for cand in (Candidate(batch=2),
                  Candidate(batch=1, quantize="int8"),
-                 Candidate(batch=1, speculative_k=2)):
+                 Candidate(batch=1, speculative_k=2),
+                 # speculative x continuous batching: the draft-k
+                 # dimension probes the LANE path (VERDICT r4 next #3)
+                 Candidate(batch=2, speculative_k=2)):
         probe = probe_candidate(model, cand, prompt_len=8, new_tokens=4,
                                 draft=draft, repeats=2)
         assert probe is not None
