@@ -206,3 +206,96 @@ class DataProxy:
                 "labels": m.labels(node),
             })
         return out
+
+    #: every gang plugin's pod->group membership label (scheduling/gang.py)
+    _GANG_POD_LABELS = (
+        "pod-group.scheduling.sigs.k8s.io/name",     # coscheduler
+        "scheduling.k8s.io/group-name",              # volcano / kube-batch
+    )
+
+    def cluster_occupancy(self) -> dict:
+        """The TPU operator's day-one view (reference ClusterInfo depth,
+        re-pointed at slice semantics): the gang/PodGroup table — which
+        slices are gang-held, by whom, how many members are up, how long
+        pending gangs have been waiting — plus per-node TPU chips in use
+        vs allocatable."""
+        from ..api import common as c
+        now = self.api.now() if hasattr(self.api, "now") else None
+
+        pods = self.api.list("Pod")
+        gangs = []
+        for pg in self.api.list("PodGroup"):
+            ns, name = m.namespace(pg), m.name(pg)
+            mm = int(m.get_in(pg, "spec", "minMember", default=0) or 0)
+            members = [p for p in pods if m.namespace(p) == ns and any(
+                m.labels(p).get(k) == name for k in self._GANG_POD_LABELS)]
+            running = sum(1 for p in members if m.get_in(
+                p, "status", "phase", default="Pending") == "Running")
+            scheduled = sum(1 for p in members
+                            if m.get_in(p, "spec", "nodeName"))
+            tpu = sum(quota.pod_request(p.get("spec", {}) or {}).get(
+                "google.com/tpu", 0) for p in members)
+            phase = "Running" if mm and running >= mm else "Pending"
+            age = None
+            if phase == "Pending" and now is not None:
+                # age since the gang BECAME pending, not since it was
+                # created: the newest not-yet-running member marks when
+                # the wait (re)started — a gang that ran for hours and
+                # lost one pod ages from the replacement pod, not from
+                # job submission
+                waiting = [m.parse_rfc3339(
+                    m.meta(p).get("creationTimestamp"))
+                    for p in members
+                    if m.get_in(p, "status", "phase",
+                                default="Pending") != "Running"]
+                waiting = [w for w in waiting if w is not None]
+                since = (max(waiting) if waiting else m.parse_rfc3339(
+                    m.meta(pg).get("creationTimestamp")))
+                if since is not None:
+                    age = max(0.0, now - since)
+            gangs.append({
+                "namespace": ns, "name": name,
+                "job": m.labels(pg).get(c.LABEL_GANG_JOB_NAME, ""),
+                "minMember": mm, "members": len(members),
+                "running": running, "scheduled": scheduled,
+                "tpuChips": tpu, "phase": phase,
+                "pendingSeconds": (round(age, 1)
+                                   if age is not None else None),
+            })
+        gangs.sort(key=lambda g: (g["phase"] != "Pending",
+                                  -(g["pendingSeconds"] or 0.0),
+                                  g["name"]))
+
+        nodes = []
+        for node in self.api.list("Node"):
+            nname = m.name(node)
+            alloc = m.get_in(node, "status", "allocatable",
+                             default={}) or {}
+            chips = dmo.parse_quantity(alloc.get("google.com/tpu", 0))
+            used = sum(
+                quota.pod_request(p.get("spec", {}) or {}).get(
+                    "google.com/tpu", 0)
+                for p in pods
+                if m.get_in(p, "spec", "nodeName") == nname
+                and m.get_in(p, "status", "phase",
+                             default="Pending") not in ("Succeeded",
+                                                        "Failed"))
+            labels = m.labels(node)
+            nodes.append({
+                "name": nname,
+                "tpuAllocatable": chips, "tpuInUse": used,
+                "tpuIdle": max(chips - used, 0),
+                "accelerator": labels.get(
+                    "cloud.google.com/gke-tpu-accelerator", ""),
+                "topology": labels.get(
+                    "cloud.google.com/gke-tpu-topology", ""),
+            })
+        nodes.sort(key=lambda n: n["name"])
+        return {
+            "gangs": gangs,
+            "nodes": nodes,
+            "totalChips": sum(n["tpuAllocatable"] for n in nodes),
+            "chipsInUse": sum(n["tpuInUse"] for n in nodes),
+            "pendingGangs": sum(1 for g in gangs
+                                if g["phase"] == "Pending"),
+        }

@@ -415,6 +415,10 @@ class ConsoleServer:
             return ok(self.proxy.cluster_total())
         if path == "/api/v1/data/nodeInfos":
             return ok(self.proxy.node_infos())
+        if path == "/api/v1/data/occupancy":
+            # slice/gang occupancy for the cluster dashboard (reference
+            # ClusterInfo depth, TPU-first: PodGroup gangs + chips idle)
+            return ok(self.proxy.cluster_occupancy())
         mt = re.fullmatch(r"/api/v1/data/request/([^/]+)", path)
         if mt:
             return ok(self.proxy.cluster_request(mt.group(1)))

@@ -193,6 +193,82 @@ def test_cluster_endpoints(stack):
     assert status == 200
 
 
+def test_cluster_occupancy(api, clock):
+    """The slice-occupancy dashboard route (VERDICT r4 next #7): a
+    gang-scheduled job's PodGroup shows who holds which slice, member
+    rollup, pending-gang aging, and per-node chips-in-use vs
+    allocatable."""
+    op = build_operator(api, OperatorConfig(
+        workloads=["JAXJob"], gang_scheduler_name="coscheduler",
+        object_storage="sqlite", event_storage="sqlite"))
+    proxy = DataProxy(api, op.object_backend, op.event_backend)
+    from kubedl_tpu.console import ConsoleConfig, ConsoleServer
+    server = ConsoleServer(proxy, ConsoleConfig(
+        port=0, users={"admin": "kubedl"})).start()
+    client = Client(server.url)
+    try:
+        login(client)
+        for i in range(2):
+            node = m.new_obj("v1", "Node", f"tpu-n{i}", labels={
+                "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite",
+                "cloud.google.com/gke-tpu-topology": "2x4"})
+            node["status"] = {"allocatable": {"cpu": "96",
+                                              "google.com/tpu": "4"}}
+            api.create(node)
+        job = m.new_obj("training.kubedl.io/v1alpha1", "JAXJob", "occ",
+                        spec={"jaxReplicaSpecs": {"Worker": {
+                            "replicas": 2, "template": {"spec": {
+                                "containers": [{
+                                    "name": "jax", "image": "i",
+                                    "resources": {"limits": {
+                                        "google.com/tpu": "4"}}}]}}}}})
+        api.create(job)
+        op.run_until_idle()
+
+        # kubelet: bind worker-0 to a node and mark it Running; worker-1
+        # stays pending — the gang is NOT up
+        pod = api.get("Pod", "default", "occ-worker-0")
+        pod["spec"]["nodeName"] = "tpu-n0"
+        api.update(pod)
+        pod = api.get("Pod", "default", "occ-worker-0")
+        pod["status"] = {"phase": "Running"}
+        api.update_status(pod)
+        clock.advance(120)
+
+        status, body = client.req("GET", "/api/v1/data/occupancy")
+        assert status == 200
+        occ = body["data"]
+        [g] = occ["gangs"]
+        assert g["job"] == "occ" and g["minMember"] == 2
+        assert g["members"] == 2 and g["running"] == 1
+        assert g["scheduled"] == 1
+        assert g["tpuChips"] == 8.0
+        assert g["phase"] == "Pending"
+        assert g["pendingSeconds"] >= 120
+        by_name = {n["name"]: n for n in occ["nodes"]}
+        assert by_name["tpu-n0"]["tpuInUse"] == 4.0
+        assert by_name["tpu-n0"]["tpuIdle"] == 0.0
+        assert by_name["tpu-n1"]["tpuInUse"] == 0.0
+        assert occ["totalChips"] == 8.0 and occ["chipsInUse"] == 4.0
+        assert occ["pendingGangs"] == 1
+
+        # the second member comes up: the gang flips to Running and the
+        # pending age clears
+        pod = api.get("Pod", "default", "occ-worker-1")
+        pod["spec"]["nodeName"] = "tpu-n1"
+        api.update(pod)
+        pod = api.get("Pod", "default", "occ-worker-1")
+        pod["status"] = {"phase": "Running"}
+        api.update_status(pod)
+        status, body = client.req("GET", "/api/v1/data/occupancy")
+        [g] = body["data"]["gangs"]
+        assert g["phase"] == "Running" and g["pendingSeconds"] is None
+        assert body["data"]["chipsInUse"] == 8.0
+        assert body["data"]["pendingGangs"] == 0
+    finally:
+        server.stop()
+
+
 def test_frontend_served(stack):
     op, client = stack
     status, text = client.req("GET", "/", raw=True)
