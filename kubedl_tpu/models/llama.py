@@ -413,10 +413,16 @@ def _layer_forward(config: LlamaConfig, x, lp, cos, sin, segment_ids,
 
 
 def forward_hidden(config: LlamaConfig, params: dict, tokens,
-                   positions=None, segment_ids=None, mesh=None):
+                   positions=None, segment_ids=None, mesh=None,
+                   apply_layers=None):
     """tokens [b, s] int32 -> final hidden states [b, s, d] (pre-LM-head),
     so callers can choose how to project to the vocabulary (the chunked
-    loss never materializes full logits)."""
+    loss never materializes full logits).
+
+    ``apply_layers(x, cos, sin) -> x`` (optional) replaces the layer
+    stack while keeping the prologue (embed/embed_scale/rope) and the
+    final norm SHARED — the pipeline-parallel trainer routes its staged
+    layers through here so the two forwards can never drift."""
     c = config
     b, s = tokens.shape
     if positions is None:
@@ -426,6 +432,10 @@ def forward_hidden(config: LlamaConfig, params: dict, tokens,
     x = params["embed"][tokens].astype(c.dtype)
     if c.embed_scale:
         x = x * jnp.asarray(math.sqrt(c.d_model), c.dtype)
+
+    if apply_layers is not None:
+        return rms_norm(apply_layers(x, cos, sin), params["final_norm"],
+                        c.rms_eps, c.norm_weight_offset)
 
     body = partial(_layer_forward, c, mesh=mesh)
     if c.remat:

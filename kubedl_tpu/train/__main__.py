@@ -364,6 +364,89 @@ def dpo_batches(cfg: dict, config, params, mesh, batch: int,
     return CountingIterator(stream(), consumed=skip)
 
 
+def build_pp_pretrain(config, mesh, num_micro: int):
+    """``mesh: {"pp": n}`` with n > 1: GPipe pipeline training through
+    the entrypoint (llama-family, plain/SFT batches). Layers are
+    stage-stacked ``[pp, L/pp, ...]`` and flow through
+    ``parallel.pipeline.pipeline_apply``; ``jax.grad`` differentiates
+    straight through the ppermute ring, so EVERY param (embedding and
+    head included) trains. Returns ``(loss_fn, to_pp, from_pp,
+    specs_of)`` — to_pp/from_pp restack params between the flat
+    checkpoint/export layout and the staged training layout.
+    ``pipeline_grads_1f1b`` remains the library-level memory-bound
+    scheduler. Reference analog: none (SURVEY §2-P: in-process
+    parallelism is delegated to the user's framework)."""
+    import jax
+
+    from ..models import llama
+    from ..parallel.pipeline import (pipeline_apply, stack_stages,
+                                     stage_scan)
+    from ..parallel.sharding import spec as logical_spec
+
+    pp = mesh.shape["pp"]
+    if llama.window_flags(config) is not None:
+        raise ValueError(
+            "pp training does not support per-layer window patterns "
+            "(Gemma-2 alternating windows) yet")
+    if not config.scan_layers:
+        # stack_stages restacks the leading LAYER axis; per-layer dict
+        # lists have no such axis and would restack d_model instead
+        raise ValueError("pp training needs scan_layers=True "
+                         "(stacked layer params)")
+    if config.n_layers % pp:
+        raise ValueError(
+            f"{config.n_layers} layers not divisible by pp={pp}")
+
+    def to_pp(params):
+        out = {k: v for k, v in params.items() if k != "layers"}
+        out["stages"] = stack_stages(params["layers"], pp)
+        return out
+
+    def from_pp(params):
+        out = {k: v for k, v in params.items() if k != "stages"}
+        out["layers"] = jax.tree.map(
+            lambda p: p.reshape((config.n_layers,) + p.shape[2:]),
+            params["stages"])
+        return out
+
+    def specs_of(params_pp):
+        base = llama.param_specs(config)
+        sp = {k: v for k, v in base.items() if k != "layers"}
+        sp["stages"] = jax.tree.map(lambda _: logical_spec("stages"),
+                                    params_pp["stages"])
+        return sp
+
+    def loss_fn(params, batch):
+        if "segment_ids" in batch:
+            # backstop — the entrypoint rejects packed data kinds before
+            # any data opens
+            raise ValueError(
+                "pp training does not support packed (segment-id) "
+                "batches yet — use data.kind tokens/synthetic/sft_jsonl")
+
+        def apply_layers(x, cos, sin):
+            def body(x, lp):
+                return llama._layer_forward(config, x, lp, cos, sin,
+                                            None)
+            if config.remat:
+                body = jax.checkpoint(
+                    body,
+                    policy=jax.checkpoint_policies
+                    .checkpoint_dots_with_no_batch_dims)
+            return pipeline_apply(mesh, stage_scan(body),
+                                  params["stages"], x, num_micro)
+
+        # prologue (embed/embed_scale/rope) and final norm are SHARED
+        # with the flat forward via the apply_layers hook — the two
+        # forwards cannot drift as model knobs accrue
+        x = llama.forward_hidden(config, params, batch["tokens"],
+                                 apply_layers=apply_layers)
+        return llama.lm_loss(config, x, params, batch["targets"],
+                             mask=batch.get("mask"))
+
+    return loss_fn, to_pp, from_pp, specs_of
+
+
 def _data_fingerprint(cfg: dict, mode: str, batch: int, seq: int) -> dict:
     """Identity of the data stream a checkpoint cursor belongs to. A
     restored cursor only fast-forwards when the stream it counted is the
@@ -659,6 +742,37 @@ def main(argv=None) -> int:
         # the plain next-token losses
         raise ValueError("lora applies to mode pretrain/sft (dpo and "
                          "grpo tune full weights)")
+    ppn = int(mesh.shape.get("pp", 1))
+    pp_build = None
+    if ppn > 1:
+        # pipeline training: validated up front, before any data opens
+        if mode not in ("pretrain", "sft"):
+            raise ValueError("pp training supports mode pretrain/sft")
+        if cfg.get("lora"):
+            raise ValueError("pp does not compose with lora adapters")
+        if family is not llama:
+            raise ValueError("pp training supports the dense llama "
+                             "family only (MoE scales with ep instead)")
+        if mesh.shape.get("cp", 1) > 1 or mesh.shape.get("tp", 1) > 1:
+            # the staged loss path shards stage params on pp only and
+            # runs layers without mesh-aware sharding constraints —
+            # cp/tp axes would silently replicate work instead of
+            # activating ring/ulysses or tensor parallelism
+            raise ValueError(
+                "pp training composes with dp/fsdp only; set cp=1 and "
+                "tp=1 (cp/tp inside pipeline stages is not wired yet)")
+
+        def _kinds(d):
+            if d.get("kind") == "mixture":
+                return [s.get("kind") for s in d.get("sources", [])]
+            return [d.get("kind", "synthetic")]
+        if mode == "pretrain" and \
+                "text" in _kinds(cfg.get("data", {"kind": "synthetic"})):
+            # rejected BEFORE the corpus is tokenized/packed, not at the
+            # first trainer step after minutes of data prep
+            raise ValueError(
+                "pp training does not support packed text batches yet — "
+                "use data.kind tokens/synthetic (or mode sft)")
     if cfg.get("export_hf_path"):
         # validate up front on ALL processes: the post-training check
         # only ran on rank 0 after hours of work, leaving other hosts
@@ -696,13 +810,24 @@ def main(argv=None) -> int:
 
     batches = None
     if mode in ("pretrain", "sft"):
-        def loss_fn(p, b):
-            # packed text batches carry segment/position/mask planes;
-            # token/synthetic batches don't — one closure serves both
-            return family.loss_fn(config, p, b["tokens"], b["targets"],
-                                  mask=b.get("mask"),
-                                  segment_ids=b.get("segment_ids"),
-                                  positions=b.get("positions"), mesh=mesh)
+        if ppn > 1:
+            num_micro = int(cfg.get("pipeline", {})
+                            .get("num_micro", 0)) or max(2, ppn)
+            loss_fn, pp_to, pp_from, pp_specs = build_pp_pretrain(
+                config, mesh, num_micro)
+            pp_build = (pp_to, pp_from, pp_specs)
+            log.info("pipeline training: pp=%d num_micro=%d (GPipe)",
+                     ppn, num_micro)
+        else:
+            def loss_fn(p, b):
+                # packed text batches carry segment/position/mask
+                # planes; token/synthetic batches don't — one closure
+                # serves both
+                return family.loss_fn(config, p, b["tokens"],
+                                      b["targets"], mask=b.get("mask"),
+                                      segment_ids=b.get("segment_ids"),
+                                      positions=b.get("positions"),
+                                      mesh=mesh)
         batches = (sft_stream(cfg, config, mesh, batch, seq,
                               skip=resume_skip)
                    if mode == "sft"
@@ -766,6 +891,12 @@ def main(argv=None) -> int:
                  rank, alpha, ",".join(sorted(targets)),
                  sum(x.size for x in
                      jax.tree_util.tree_leaves(state.params)) / 1e6)
+    elif pp_build is not None:
+        pp_to, pp_from, pp_specs = pp_build
+        params = pp_to(params)
+        trainer = Trainer(loss_fn, pp_specs(params), mesh,
+                          TrainConfig(**opt))
+        state = trainer.init_state(params)
     else:
         trainer = Trainer(loss_fn, family.param_specs(config), mesh,
                           TrainConfig(**opt))
@@ -795,6 +926,9 @@ def main(argv=None) -> int:
             lmod, lbase, lalpha = lora_state
             lora_params_of = (lambda st: lmod.merge_params(
                 lbase, st.params, alpha=lalpha))
+        elif pp_build is not None:
+            # eval runs the flat (non-staged) forward on restacked params
+            lora_params_of = (lambda st: pp_build[1](st.params))
         ev_every, ev_fn = ((0, None) if mode == "dpo"
                            else build_eval_fn(cfg, config, mesh, batch,
                                               seq,
@@ -812,6 +946,10 @@ def main(argv=None) -> int:
     export = cfg.get("export_path") or os.environ.get("KUBEDL_MODEL_PATH")
     if export:
         export_params = state.params
+        if pp_build is not None:
+            # restack [pp, L/pp, ...] stages to the flat [L, ...] layout
+            # every other consumer (serving, HF export) reads
+            export_params = pp_build[1](export_params)
         if lora_state is not None:
             # fold trained adapters into dense weights: the exported
             # artifact serves with zero adapter overhead and composes
