@@ -773,6 +773,17 @@ def main(argv=None) -> int:
             raise ValueError(
                 "pp training does not support packed text batches yet — "
                 "use data.kind tokens/synthetic (or mode sft)")
+        pp_num_micro = int(cfg.get("pipeline", {})
+                           .get("num_micro", 0)) or max(2, ppn)
+        if batch % pp_num_micro:
+            raise ValueError(
+                f"batch {batch} not divisible by pipeline.num_micro="
+                f"{pp_num_micro}")
+        data_width = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
+        if (batch // pp_num_micro) % data_width:
+            raise ValueError(
+                f"microbatch {batch // pp_num_micro} rows must divide "
+                f"the dp*fsdp width {data_width}")
     if cfg.get("export_hf_path"):
         # validate up front on ALL processes: the post-training check
         # only ran on rank 0 after hours of work, leaving other hosts
@@ -811,13 +822,11 @@ def main(argv=None) -> int:
     batches = None
     if mode in ("pretrain", "sft"):
         if ppn > 1:
-            num_micro = int(cfg.get("pipeline", {})
-                            .get("num_micro", 0)) or max(2, ppn)
             loss_fn, pp_to, pp_from, pp_specs = build_pp_pretrain(
-                config, mesh, num_micro)
+                config, mesh, pp_num_micro)
             pp_build = (pp_to, pp_from, pp_specs)
             log.info("pipeline training: pp=%d num_micro=%d (GPipe)",
-                     ppn, num_micro)
+                     ppn, pp_num_micro)
         else:
             def loss_fn(p, b):
                 # packed text batches carry segment/position/mask
@@ -921,18 +930,17 @@ def main(argv=None) -> int:
                          grpo_ref_params,
                          elastic_agent=_maybe_elastic_agent(manager))
     else:
-        lora_params_of = None
+        params_of = None
         if lora_state is not None:
             lmod, lbase, lalpha = lora_state
-            lora_params_of = (lambda st: lmod.merge_params(
+            params_of = (lambda st: lmod.merge_params(
                 lbase, st.params, alpha=lalpha))
         elif pp_build is not None:
             # eval runs the flat (non-staged) forward on restacked params
-            lora_params_of = (lambda st: pp_build[1](st.params))
+            params_of = (lambda st: pp_build[1](st.params))
         ev_every, ev_fn = ((0, None) if mode == "dpo"
                            else build_eval_fn(cfg, config, mesh, batch,
-                                              seq,
-                                              params_of=lora_params_of))
+                                              seq, params_of=params_of))
         agent = _maybe_elastic_agent(manager)
         if agent is not None:
             agent.data_state_fn = data_state_fn

@@ -169,6 +169,23 @@ def test_stop_and_cap_respected_on_spec_lanes(models):
         small.stop()
 
 
+def test_int8_target_with_spec_lanes(models):
+    """Weight-only int8 on the TARGET composes with speculative lanes
+    (the serving bandwidth lever + the latency lever together): outputs
+    match the int8 engine's own greedy decode."""
+    tcfg, tparams, dcfg, dparams = models
+    solo = InferenceEngine(tcfg, tparams, GenerateConfig(max_len=96),
+                           quantize="int8")
+    eng = ContinuousBatchingEngine(
+        tcfg, tparams, lanes=2, max_len=96, quantize="int8",
+        draft_config=dcfg, draft_params=dparams, spec_k=2)
+    try:
+        got = eng.run([(p, 8) for p in PROMPTS[:2]])
+        assert got == [solo.generate([p], 8)[0] for p in PROMPTS[:2]]
+    finally:
+        eng.stop()
+
+
 def test_spec_rejects_mesh_and_vocab_mismatch(models):
     tcfg, tparams, dcfg, dparams = models
     bad = dataclasses.replace(dcfg, vocab_size=64)
