@@ -79,24 +79,20 @@ def build_engine(model_path: str, lanes: int, quantize: str, spec_k: int,
         mesh = build_mesh(MeshConfig(dp=1, fsdp=1, tp=tp), devices[:tp])
     from .batching import ContinuousBatchingEngine
     if spec_k > 0:
-        if mesh is not None:
-            # refusing beats silently serving unsharded (the model may
-            # not even fit one chip) — same policy as mesh+quantize
-            raise ValueError("KUBEDL_SERVING_TP does not compose with "
-                             "speculative decoding yet")
         if not draft_path:
             raise ValueError("KUBEDL_SERVING_SPEC_K > 0 needs "
                              "KUBEDL_SERVING_DRAFT_PATH")
         # speculative decoding rides the continuous-batching lanes:
         # every lane drafts spec_k tokens per round and ONE [lanes, k+1]
         # target pass verifies them all — concurrent requests keep their
-        # streaming/cancel/per-request-sampling semantics
+        # streaming/cancel/per-request-sampling semantics. Composes with
+        # KUBEDL_SERVING_TP (target AND draft shard over the local mesh).
         dcfg, dparams = load_model(draft_path)
         return ContinuousBatchingEngine(
             config, params, lanes=lanes, max_len=max_len,
             gen=GenerateConfig(max_len=max_len, eos_id=eos_id),
             quantize=quantize or None, draft_config=dcfg,
-            draft_params=dparams, spec_k=spec_k).start()
+            draft_params=dparams, spec_k=spec_k, mesh=mesh).start()
     return ContinuousBatchingEngine(
         config, params, lanes=lanes, max_len=max_len,
         gen=GenerateConfig(max_len=max_len, eos_id=eos_id),

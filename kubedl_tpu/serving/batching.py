@@ -164,8 +164,8 @@ class ContinuousBatchingEngine:
                  quantize: Optional[str] = None, seed: int = 0,
                  mesh=None, draft_config=None, draft_params=None,
                  spec_k: int = 0, quantize_draft: Optional[str] = None):
-        from .engine import (SpecStats, init_mesh_serving, maybe_quantize,
-                             resolve_family, sample_logits)
+        from .engine import (SpecStats, init_mesh_serving, resolve_family,
+                             sample_logits)
         self.config = config
         self.family = family = resolve_family(config)
         self.lanes = lanes
@@ -187,12 +187,14 @@ class ContinuousBatchingEngine:
             if draft_config.vocab_size != config.vocab_size:
                 raise ValueError(
                     "target and draft must share a vocabulary")
-            if mesh is not None:
-                raise ValueError("speculative lanes do not compose with "
-                                 "mesh-parallel serving yet")
             self.dcfg = draft_config
             self.dfam = resolve_family(draft_config)
-            self.dparams = maybe_quantize(draft_params, quantize_draft)
+            # the draft rides the same mesh as the target (its params by
+            # ITS logical specs, its cache by ITS kv-heads) — spec lanes
+            # compose with tensor-parallel serving; draft quantization
+            # only without a mesh (same rule as the target)
+            self.dparams, self._place_d_cache = init_mesh_serving(
+                draft_config, draft_params, quantize_draft, mesh)
             #: aggregate + per-lane acceptance accounting (/metrics)
             self.stats = SpecStats()
             self.lane_stats = [SpecStats() for _ in range(lanes)]
@@ -272,8 +274,8 @@ class ContinuousBatchingEngine:
             self._d_decode = make_decode(self.dcfg, self.dfam)
             self._d_prefill = make_prefill(self.dcfg, self.dfam)
             self._spec_verify = _spec_verify
-            self._d_cache = self.dfam.init_cache(self.dcfg, lanes,
-                                                 max_len)
+            self._d_cache = self._place_d_cache(
+                self.dfam.init_cache(self.dcfg, lanes, max_len))
             #: per-request host rng for the sampled accept rule,
             #: allocated at admission (seed + admission ordinal)
             self._spec_admitted = 0
@@ -476,8 +478,9 @@ class ContinuousBatchingEngine:
             self.family.init_cache(self.config, self.lanes, self.max_len))
         if self.spec_k:
             # the draft cache is donated into _d_decode/_d_prefill too
-            self._d_cache = self.dfam.init_cache(self.dcfg, self.lanes,
-                                                 self.max_len)
+            self._d_cache = self._place_d_cache(
+                self.dfam.init_cache(self.dcfg, self.lanes,
+                                     self.max_len))
         self._cur = np.zeros((self.lanes, 1), np.int32)
         self._pos = np.zeros((self.lanes,), np.int32)
 

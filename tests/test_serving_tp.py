@@ -96,6 +96,26 @@ def test_http_server_over_tp_mesh(setup):
         eng.stop()
 
 
+def test_speculative_lanes_over_tp_mesh(setup):
+    """Speculative decoding composes with tensor-parallel serving: the
+    draft shards over the same mesh as the target and greedy outputs
+    stay token-identical to the unsharded engine."""
+    cfg, params, mesh = setup
+    dcfg = dataclasses.replace(cfg, d_model=64, n_layers=1, d_ff=128)
+    dparams = llama.init_params(dcfg, jax.random.PRNGKey(2))
+    solo = InferenceEngine(cfg, params, GenerateConfig(max_len=64))
+    eng = ContinuousBatchingEngine(cfg, params, lanes=2, max_len=64,
+                                   mesh=mesh, draft_config=dcfg,
+                                   draft_params=dparams, spec_k=2)
+    try:
+        reqs = [([5, 7, 11], 6), ([3], 4)]
+        got = eng.run(reqs)
+        assert got == [solo.generate([p], n)[0] for p, n in reqs]
+        assert eng.stats.proposed > 0
+    finally:
+        eng.stop()
+
+
 def test_mesh_rejects_quantization(setup):
     cfg, params, mesh = setup
     with pytest.raises(ValueError, match="quantization"):
