@@ -31,7 +31,7 @@ _WORKERS = int(os.environ.get("PYTEST_XDIST_WORKER_COUNT", "1") or 1)
 DRYRUN_BOUND_S = 240 * max(1, _WORKERS // 2)
 
 
-def test_dryrun_multichip_8_wallclock():
+def test_dryrun_multichip_8_wallclock(capsys):
     # SIGALRM, not a post-hoc timer: a hang (the round-1 failure mode)
     # must FAIL the test, not stall CI
     def on_alarm(signum, frame):
@@ -44,6 +44,15 @@ def test_dryrun_multichip_8_wallclock():
     finally:
         signal.alarm(0)
         signal.signal(signal.SIGALRM, old)
+    # every config the driver artifact (MULTICHIP_rNN.json) is judged on
+    # must actually print — a silently dropped line is a coverage
+    # regression, not a pass. (Under `pytest -s` capture is off and out
+    # is empty; the sentinels only apply when capture is active.)
+    out = capsys.readouterr().out
+    if out:
+        for line in ("mesh=", "windowed-cp", "moe", "pp ", "pp-1f1b",
+                     "lora+packed", "serving tp="):
+            assert line in out, f"dryrun output lost the {line!r} config"
 
 
 def test_entry_compiles_single_chip():
