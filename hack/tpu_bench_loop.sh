@@ -18,8 +18,8 @@ INTERVAL="${PROBE_INTERVAL:-900}"
 LOG="${TPU_LOOP_LOG:-BENCH_TPU_LOOP_r04.log}"
 
 # artifacts committed by a PREVIOUS round must not suppress this round's
-# attempts: drop anything older than 12h (matches bench.py's cache age gate)
-find BENCH_TPU_CACHE.json TPU_SELFTEST.json -mmin +720 -delete 2>/dev/null
+# attempts: drop anything older than 16h (matches bench.py's cache age gate)
+find BENCH_TPU_CACHE.json TPU_SELFTEST.json -mmin +960 -delete 2>/dev/null
 
 selftest_complete() {
   python - <<'EOF' 2>/dev/null

@@ -22,6 +22,10 @@ cd "$(dirname "$0")/.."
 LOG="${TPU_LOOP_LOG:-BENCH_TPU_LOOP_r05.log}"
 INTERVAL="${PROBE_INTERVAL:-1500}"
 
+# a cache predating this evidence window must not masquerade as fresh
+# (matches bench.py's 16h age gate)
+find BENCH_TPU_CACHE.json -mmin +960 -delete 2>/dev/null
+
 valid_fresh() {  # $1 = JSON line; exit 0 iff a real fresh TPU number
   python - "$1" <<'EOF'
 import json, sys

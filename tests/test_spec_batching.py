@@ -169,6 +169,28 @@ def test_stop_and_cap_respected_on_spec_lanes(models):
         small.stop()
 
 
+def test_sliding_window_with_spec_lanes(models):
+    """Sliding-window attention through the [lanes, k+1] verify chunk:
+    the per-row windowed cache slice must hold for multi-token chunks —
+    prompts run PAST the window so the slice actually clips."""
+    tcfg, tparams, _, _ = models
+    # the window changes only attention masking, never param shapes —
+    # the fixture's weights serve the windowed config directly
+    tcfg = dataclasses.replace(tcfg, sliding_window=16)
+    dcfg = dataclasses.replace(tcfg, d_model=64, n_layers=1, d_ff=128)
+    dparams = llama.init_params(dcfg, jax.random.PRNGKey(1))
+    solo = InferenceEngine(tcfg, tparams, GenerateConfig(max_len=96))
+    eng = ContinuousBatchingEngine(
+        tcfg, tparams, lanes=2, max_len=96, draft_config=dcfg,
+        draft_params=dparams, spec_k=3)
+    try:
+        reqs = [([5, 7, 11] * 8, 20), ([3, 9], 24)]
+        got = eng.run(reqs)
+        assert got == [solo.generate([p], n)[0] for p, n in reqs]
+    finally:
+        eng.stop()
+
+
 def test_int8_target_with_spec_lanes(models):
     """Weight-only int8 on the TARGET composes with speculative lanes
     (the serving bandwidth lever + the latency lever together): outputs
