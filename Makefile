@@ -2,12 +2,18 @@
 
 PY ?= python
 
-.PHONY: test test-all test-fast bench dryrun crds run-standalone lint native
+.PHONY: test test-all test-fast test-chaos bench dryrun crds run-standalone lint native
 
 # fast path (<3 min): everything except the compile-heavy compute suites
-# (those carry `pytestmark = pytest.mark.slow`)
+# (those carry `pytestmark = pytest.mark.slow`). Chaos tests are fast and
+# deterministic, so they ride in this tier by default.
 test:
 	$(PY) -m pytest tests/ -q -m "not slow"
+
+# just the fault-injection suite; set KUBEDL_CHAOS_SEED=<n> to replay a
+# failing seed (every chaos test prints the seed it ran with)
+test-chaos:
+	$(PY) -m pytest tests/ -q -m chaos
 
 # full suite on the 8-device virtual CPU mesh (conftest pins the platform);
 # -n auto spreads the compute compiles over workers when pytest-xdist is

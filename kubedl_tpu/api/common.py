@@ -116,6 +116,11 @@ POD_SUCCEEDED = "Succeeded"
 POD_FAILED = "Failed"
 POD_UNKNOWN = "Unknown"
 
+#: pod condition set by kube-scheduler/kubelet when a pod is about to be
+#: terminated by a voluntary disruption (preemption, drain, spot reclaim);
+#: the engine treats any gang member carrying it as a whole-slice loss
+POD_COND_DISRUPTION_TARGET = "DisruptionTarget"
+
 
 def _drop_none(d: dict) -> dict:
     return {k: v for k, v in d.items() if v is not None and v != {} and v != []}
@@ -353,6 +358,13 @@ class JobStatus:
     #: restartCounts, job.go:555-594; delete+recreate restart policies need
     #: this durable counter as well)
     failure_rounds: int = 0
+    #: slice-atomic failover bookkeeping, also durable in status so the
+    #: backoff gate survives operator restarts: total restarts performed,
+    #: the current backoff round (reset after a stable running window),
+    #: and when the last restart fired
+    restart_count: int = 0
+    restart_rounds: int = 0
+    last_restart_time: Optional[str] = None
 
     @classmethod
     def from_dict(cls, d: Optional[dict]):
@@ -367,6 +379,9 @@ class JobStatus:
             model_version_name=d.get("modelVersionName", ""),
             cache_backend_name=d.get("cacheBackendName", ""),
             failure_rounds=int(d.get("failureRounds", 0) or 0),
+            restart_count=int(d.get("restartCount", 0) or 0),
+            restart_rounds=int(d.get("restartRounds", 0) or 0),
+            last_restart_time=d.get("lastRestartTime"),
         )
 
     def to_dict(self) -> dict:
@@ -379,4 +394,7 @@ class JobStatus:
             "modelVersionName": self.model_version_name or None,
             "cacheBackendName": self.cache_backend_name or None,
             "failureRounds": self.failure_rounds or None,
+            "restartCount": self.restart_count or None,
+            "restartRounds": self.restart_rounds or None,
+            "lastRestartTime": self.last_restart_time,
         })

@@ -23,13 +23,16 @@ from __future__ import annotations
 
 import copy
 import logging
+import random
+import time
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import Callable, Optional
 
 from ..api import common as c
 from ..api.common import JobStatus, ReplicaSpec, RunPolicy
 from ..core import meta as m
-from ..core.apiserver import AlreadyExists, APIServer, Conflict, NotFound
+from ..core.apiserver import (AlreadyExists, APIServer, Conflict, NotFound,
+                              ServerError)
 from ..core.events import Recorder, TYPE_NORMAL, TYPE_WARNING
 from ..core.manager import Reconciler, Request, Result
 from ..metrics import JobMetrics
@@ -41,6 +44,7 @@ from ..scheduling.gang import GangScheduler
 from ..tpu import placement as pl
 from ..utils import status as st
 from ..utils import train
+from ..utils.retry import RetryPolicy, restart_delay, retry_transient
 from . import hostnetwork as hn
 from .expectations import Expectations
 from .interface import TPUPolicy, WorkloadController
@@ -60,6 +64,23 @@ class EngineConfig:
     #: HostNetWithHeadlessSvc gate: keep headless services even in
     #: hostnetwork mode (reference features.go:36-40)
     hostnet_with_headless_svc: bool = False
+    #: transient-error (5xx/timeout) retry bounds for every api write the
+    #: engine issues; ``retry_sleep`` is injectable so deterministic tests
+    #: advance a fake clock instead of blocking
+    retry_policy: RetryPolicy = field(default_factory=RetryPolicy)
+    retry_sleep: Callable = time.sleep
+    #: slice-atomic failover backoff: round r waits a decorrelated-jitter
+    #: delay in [base, cap] (docs/failover.md has the formula); the round
+    #: counter resets after ``restart_backoff_reset`` seconds of stability
+    restart_backoff_base: float = 10.0
+    restart_backoff_cap: float = 300.0
+    restart_backoff_reset: float = 600.0
+    #: seeds the retry/backoff jitter; None (default) takes OS entropy so
+    #: operator replicas de-correlate — pin in tests for reproducibility
+    backoff_jitter_seed: Optional[int] = None
+    #: how long an unobserved create/delete expectation blocks reconciles
+    #: before it is declared lost (dropped watch event) and cleared
+    expectation_timeout: float = Expectations.TIMEOUT
 
 
 @dataclass
@@ -79,6 +100,19 @@ class _ReplicaPlan:
     global_dns: list = field(default_factory=list)  # hostname per global id
 
 
+@dataclass
+class _FailoverDecision:
+    """What ``_slice_failover`` decided this round: ``fail`` (permanent
+    exit code — job dies via ``_fail_permanently``), ``wait`` (disruption
+    seen but the backoff gate holds; the ``frozen`` slices must not be
+    touched by the diff loops while reconciliation otherwise proceeds), or
+    ``restart`` (slice torn down; recreation rides the next reconcile)."""
+    action: str
+    requeue: float = 0.0
+    message: str = ""
+    frozen: tuple = ()
+
+
 class JobEngine(Reconciler):
     def __init__(self, api: APIServer, controller: WorkloadController,
                  config: Optional[EngineConfig] = None,
@@ -91,7 +125,9 @@ class JobEngine(Reconciler):
         self.metrics = metrics or JobMetrics()
         self.recorder = recorder or Recorder(api)
         self.gang = gang
-        self.expectations = Expectations(clock=api.now)
+        self.expectations = Expectations(
+            clock=api.now, timeout=self.config.expectation_timeout)
+        self._jitter_rng = random.Random(self.config.backoff_jitter_seed)
         self.kind = controller.kind
         self.owns = ("Pod", "Service")
         self._job_states: dict[str, str] = {}  # job uid -> running|pending
@@ -102,6 +138,15 @@ class JobEngine(Reconciler):
         #: events while deleting; only the transition counts)
         self._deletion_seen: set = set()
         api.watch(self._observe)
+
+    def _retry(self, fn):
+        """Run one api write with bounded decorrelated-jitter retries on
+        transient (5xx/timeout) errors; anything else propagates."""
+        return retry_transient(
+            fn, self.config.retry_policy, retry_on=(ServerError,),
+            rng=self._jitter_rng, sleep=self.config.retry_sleep,
+            on_retry=lambda n, delay, e: log.warning(
+                "transient api error (retry %d in %.3fs): %s", n, delay, e))
 
     # ------------------------------------------------------------------
     # watch observation (expectations bookkeeping + deletion metrics)
@@ -175,11 +220,17 @@ class JobEngine(Reconciler):
         run_policy = self.controller.get_run_policy(job)
         job_key = m.key(job)
 
-        # stale-cache gate (reference SatisfyExpectations, job.go:129 area)
+        # stale-cache gate (reference SatisfyExpectations, job.go:129 area).
+        # When blocked, requeue for when the expectation would expire: if
+        # the awaited watch event was dropped, nothing else is guaranteed
+        # to re-trigger this reconcile, and the expiry path in
+        # Expectations.satisfied can only run when somebody calls it
         for rt in replicas:
-            if not (self.expectations.satisfied(Expectations.pods_key(job_key, rt))
-                    and self.expectations.satisfied(Expectations.services_key(job_key, rt))):
-                return None
+            for key in (Expectations.pods_key(job_key, rt),
+                        Expectations.services_key(job_key, rt)):
+                if not self.expectations.satisfied(key):
+                    return Result(requeue_after=max(
+                        0.01, self.expectations.expires_in(key)))
 
         status = JobStatus.from_dict(job.get("status"))
         old_status = copy.deepcopy(status)
@@ -202,7 +253,11 @@ class JobEngine(Reconciler):
         services = self.get_services_for_job(job)
 
         # ---- backoff limit / active deadline ---------------------------
-        failed_now = sum(1 for p in pods if _pod_phase(p) == c.POD_FAILED)
+        # preempted pods (DisruptionTarget) are a voluntary disruption, not
+        # the job's fault: spot/preemptible TPU training must survive any
+        # number of them without burning backoffLimit budget
+        failed_now = sum(1 for p in pods if _pod_phase(p) == c.POD_FAILED
+                         and not _has_disruption_target(p))
         prev_failed = sum(rs.failed for rs in status.replica_statuses.values())
         exceeds, failure_msg = False, ""
         if run_policy.backoff_limit is not None:
@@ -271,8 +326,39 @@ class JobEngine(Reconciler):
 
         # ---- gang: one PodGroup per slice ------------------------------
         if self.config.enable_gang_scheduling and self.gang is not None:
-            self.gang.create_gang(job, self._gang_min_members(replicas, plan),
-                                  run_policy.scheduling_policy)
+            self._retry(lambda: self.gang.create_gang(
+                job, self._gang_min_members(replicas, plan),
+                run_policy.scheduling_policy))
+
+        # ---- slice-atomic failover (TPU jobs only) ---------------------
+        # A gang-scheduled slice whose member was preempted/killed is a
+        # dead world: the PJRT coordinator topology is fixed at startup,
+        # so recovery replaces the whole slice, never a single pod
+        slice_wait, slice_frozen = None, ()
+        if plan.policy is not None:
+            dec = self._slice_failover(job, status, old_status, pods,
+                                       replicas, plan)
+            if dec is not None:
+                if dec.action == "fail":
+                    return self._fail_permanently(
+                        job, dec.message, "PermanentExitCode",
+                        status, old_status)
+                if dec.action == "restart":
+                    # recount replica statuses from the (pre-teardown) pods
+                    # before the early return: leaving them stale would make
+                    # the failure-round accounting above re-count the same
+                    # failed pod next round
+                    self._recount_replica_statuses(status, replicas, pods)
+                    flushed = self._flush_status(job, status, old_status)
+                    # deletion events re-trigger reconcile; a failed flush
+                    # still needs a timed nudge
+                    return None if flushed else Result(requeue_after=1.0)
+                # wait: the disrupted slices are frozen until the backoff
+                # gate opens, but reconciliation continues so *other*
+                # slices (e.g. one torn down just before this disruption)
+                # still get their pods recreated on time
+                slice_wait, slice_frozen = dec.requeue, dec.frozen
+                slice_wait_msg = dec.message
 
         # ---- elastic scaling hook --------------------------------------
         # scale_out/scale_in may return a requeue delay while waiting to
@@ -292,7 +378,9 @@ class JobEngine(Reconciler):
                         job, replicas, pods, services)
 
         # ---- per-replica-type diff loops -------------------------------
-        restart = [False]
+        # a pending (backoff-gated) slice restart counts as restarting so
+        # _update_job_status keeps the job Restarting instead of Failed
+        restart = [slice_wait is not None]
         # hostnetwork: replica -> live port, re-learned every round so
         # service targetPorts track fail-overed pods (reference pod.go:337-340)
         hostnet_ports: Optional[dict] = \
@@ -311,7 +399,8 @@ class JobEngine(Reconciler):
                 continue
             try:
                 self._reconcile_pods(job, status, pods, rtype, spec, replicas,
-                                     run_policy, plan, restart, hostnet_ports)
+                                     run_policy, plan, restart, hostnet_ports,
+                                     frozen_slices=slice_frozen)
             except ValueError as e:
                 return self._fail_permanently(
                     job, f"invalid {self.kind} spec: {e}", "InvalidJobSpec",
@@ -321,6 +410,14 @@ class JobEngine(Reconciler):
                                          hostnet_ports)
 
         self._update_job_status(job, replicas, status, restart[0], pods)
+        if slice_wait is not None:
+            # the surviving members of the frozen slice look healthy, so
+            # _update_job_status just promoted Running — but their PJRT
+            # world is dead; the honest state until the gate opens is
+            # Restarting (Running and Restarting are mutually exclusive)
+            st.update_job_conditions(status, c.JOB_RESTARTING,
+                                     st.REASON_JOB_RESTARTING,
+                                     slice_wait_msg, now=self.api.now())
         self.controller.on_job_running(job)
         tb_requeue = self._reconcile_tb(job, status, replicas)
 
@@ -344,9 +441,12 @@ class JobEngine(Reconciler):
                     self.metrics.gang_to_all_running.observe(
                         self.api.now() - min(gang_ts), kind=self.kind)
 
-        self._flush_status(job, status, old_status)
-        requeues = [r for r in (deadline_requeue, tb_requeue, elastic_requeue)
+        flushed = self._flush_status(job, status, old_status)
+        requeues = [r for r in (deadline_requeue, tb_requeue, elastic_requeue,
+                                slice_wait)
                     if r and r > 0]
+        if not flushed:
+            requeues.append(1.0)  # status write kept failing: try again soon
         if requeues:
             return Result(requeue_after=min(requeues))
         return None
@@ -400,7 +500,8 @@ class JobEngine(Reconciler):
         if status.completion_time is None:
             status.completion_time = m.rfc3339(self.api.now())
         self.metrics.failed.inc(kind=self.kind)
-        self._flush_status(job, status, old_status)
+        if not self._flush_status(job, status, old_status):
+            return Result(requeue_after=1.0)
         return None
 
     # ------------------------------------------------------------------
@@ -432,9 +533,11 @@ class JobEngine(Reconciler):
         self.controller.on_job_finished(job, pods)
         # TensorBoard outlives the job for its own TTL (tensorboard.go:99-135)
         tb_requeue = self._reconcile_tb(job, status, replicas)
-        self._flush_status(job, status, old_status)
+        flushed = self._flush_status(job, status, old_status)
 
         requeues = [tb_requeue] if tb_requeue else []
+        if not flushed:
+            requeues.append(1.0)
         # TTL-after-finished cleanup (reference job.go:596-620)
         ttl = run_policy.ttl_seconds_after_finished
         if ttl is None:
@@ -461,12 +564,14 @@ class JobEngine(Reconciler):
             if policy == c.CLEAN_POD_RUNNING and _pod_phase(pod) != c.POD_RUNNING:
                 continue
             try:
-                self.api.delete("Pod", m.namespace(pod), m.name(pod))
+                self._retry(lambda p=pod: self.api.delete(
+                    "Pod", m.namespace(p), m.name(p)))
             except NotFound:
                 pass
             # services share the pod's name (reference job.go:60-64)
             try:
-                self.api.delete("Service", m.namespace(pod), m.name(pod))
+                self._retry(lambda p=pod: self.api.delete(
+                    "Service", m.namespace(p), m.name(p)))
             except NotFound:
                 pass
 
@@ -527,8 +632,15 @@ class JobEngine(Reconciler):
     def _reconcile_pods(self, job, status: JobStatus, all_pods, rtype: str,
                         spec: ReplicaSpec, replicas, run_policy: RunPolicy,
                         plan: _ReplicaPlan, restart: list,
-                        hostnet_ports: Optional[dict] = None) -> None:
+                        hostnet_ports: Optional[dict] = None,
+                        frozen_slices: tuple = ()) -> None:
         rt = rtype.lower()
+        tpu_managed = plan.policy is not None and rtype in plan.offsets
+
+        def slice_of(index: int):
+            if not tpu_managed:
+                return None
+            return (plan.offsets[rtype] + index) // plan.slice_spec.num_hosts
         pods = [p for p in all_pods
                 if m.labels(p).get(c.LABEL_REPLICA_TYPE) == rt]
         num = int(spec.replicas or 1)
@@ -556,6 +668,11 @@ class JobEngine(Reconciler):
             elif not slice_pods:
                 if index >= num:
                     continue
+                if slice_of(index) in frozen_slices:
+                    # this slice's teardown is waiting out restart backoff:
+                    # recreating members piecemeal would patch pods into
+                    # the dead world the wait exists to replace
+                    continue
                 self.expectations.expect_creations(
                     Expectations.pods_key(job_key, rtype), 1)
                 try:
@@ -570,6 +687,13 @@ class JobEngine(Reconciler):
                     # permanent config error from set_cluster_spec (e.g. two
                     # PyTorch masters): balance the expectation, then let
                     # reconcile() fail the job loudly
+                    self.expectations.creation_observed(
+                        Expectations.pods_key(job_key, rtype))
+                    raise
+                except ServerError:
+                    # transient retries exhausted: balance the expectation
+                    # (nothing was created) and surface the error so the
+                    # manager requeues with backoff
                     self.expectations.creation_observed(
                         Expectations.pods_key(job_key, rtype))
                     raise
@@ -591,8 +715,12 @@ class JobEngine(Reconciler):
                         self._delete_pod(job_key, rtype, pod)
                     continue
                 exit_code = _exit_code(pod, self.controller.default_container_name)
+                # TPU replicas are restarted slice-atomically by
+                # _slice_failover; the per-pod delete below would patch a
+                # single pod into a dead PJRT world
                 if spec.restart_policy == c.RESTART_EXIT_CODE \
-                        and _pod_phase(pod) == c.POD_FAILED:
+                        and _pod_phase(pod) == c.POD_FAILED \
+                        and not tpu_managed:
                     reason = m.get_in(pod, "status", "reason", default="")
                     if (exit_code is not None and train.is_retryable_exit_code(exit_code)) \
                             or train.is_retryable_pod_failed_reason(reason):
@@ -608,8 +736,12 @@ class JobEngine(Reconciler):
     def _delete_pod(self, job_key: str, rtype: str, pod) -> None:
         self.expectations.expect_deletions(Expectations.pods_key(job_key, rtype), 1)
         try:
-            self.api.delete("Pod", m.namespace(pod), m.name(pod))
-        except NotFound:
+            self._retry(lambda: self.api.delete("Pod", m.namespace(pod),
+                                                m.name(pod)))
+        except (NotFound, ServerError):
+            # NotFound: already gone (a timed-out delete may have landed);
+            # exhausted transient errors: the pod is still there, so balance
+            # the expectation and let the next reconcile retry the delete
             self.expectations.deletion_observed(Expectations.pods_key(job_key, rtype))
 
     def _create_pod(self, job, rtype: str, index: int, spec: ReplicaSpec,
@@ -697,7 +829,7 @@ class JobEngine(Reconciler):
                 md["labels"].update(spec.spot_replica_spec.labels)
 
         m.set_controller_ref(pod, job)
-        self.api.create(pod)
+        self._retry(lambda: self.api.create(pod))
         # record the host port only once the pod really exists; on
         # AlreadyExists the next round re-learns the live pod's port instead
         if hostnet_ports is not None and hostnet_port is not None:
@@ -733,15 +865,18 @@ class JobEngine(Reconciler):
                     Expectations.services_key(job_key, rtype), 1)
                 try:
                     self._create_service(job, rtype, index, spec, hostnet_ports)
-                except AlreadyExists:
+                except (AlreadyExists, ServerError) as e:
                     self.expectations.creation_observed(
                         Expectations.services_key(job_key, rtype))
+                    if isinstance(e, ServerError):
+                        raise
             elif index >= num and not m.is_deleting(group[0]):
                 self.expectations.expect_deletions(
                     Expectations.services_key(job_key, rtype), 1)
                 try:
-                    self.api.delete("Service", m.namespace(group[0]), m.name(group[0]))
-                except NotFound:
+                    self._retry(lambda g=group[0]: self.api.delete(
+                        "Service", m.namespace(g), m.name(g)))
+                except (NotFound, ServerError):
                     self.expectations.deletion_observed(
                         Expectations.services_key(job_key, rtype))
             elif hostnet_ports is not None:
@@ -786,7 +921,7 @@ class JobEngine(Reconciler):
                        "port": port, "targetPort": target_port}],
         }
         m.set_controller_ref(svc, job)
-        self.api.create(svc)
+        self._retry(lambda: self.api.create(svc))
 
     # ------------------------------------------------------------------
     # status
@@ -875,19 +1010,35 @@ class JobEngine(Reconciler):
                 return _pod_phase(p) == c.POD_SUCCEEDED and (code in (0, None))
         return False
 
-    def _flush_status(self, job, status: JobStatus, old_status: JobStatus) -> None:
+    def _flush_status(self, job, status: JobStatus, old_status: JobStatus) -> bool:
+        """Write the round's status back. A 409 means another writer moved
+        the object under us: re-read for a fresh resourceVersion and
+        re-apply our status delta (the controller owns ``.status``, and
+        this round's conditions were computed from live pods — dropping
+        them would lose a phase transition), bounded so a pathological
+        conflict storm degrades to a requeue instead of a livelock.
+        Transient 5xx/timeouts retry with jitter inside each attempt.
+        Returns False only when the flush could not land (caller requeues)."""
         status.last_reconcile_time = m.rfc3339(self.api.now())
         old_status.last_reconcile_time = status.last_reconcile_time
         if status.to_dict() == old_status.to_dict():
-            return
-        fresh = self.api.try_get(self.kind, m.namespace(job), m.name(job))
-        if fresh is None:
-            return
-        fresh["status"] = status.to_dict()
-        try:
-            self.api.update_status(fresh)
-        except Conflict:
-            pass  # events will re-trigger reconcile
+            return True
+        for _ in range(8):
+            fresh = self.api.try_get(self.kind, m.namespace(job), m.name(job))
+            if fresh is None:
+                return True  # job deleted: nothing to flush
+            fresh["status"] = status.to_dict()
+            try:
+                self._retry(lambda f=fresh: self.api.update_status(f))
+                return True
+            except Conflict:
+                continue
+            except ServerError as e:
+                log.warning("status flush for %s failed: %s", m.key(job), e)
+                return False
+        log.warning("status flush for %s kept conflicting; will requeue",
+                    m.key(job))
+        return False
 
     # ------------------------------------------------------------------
     # TPU plan / gang membership / DAG / cron
@@ -956,6 +1107,141 @@ class JobEngine(Reconciler):
                 members[0] += n
         return members
 
+    def _slice_failover(self, job, status: JobStatus, old_status: JobStatus,
+                        pods, replicas, plan: _ReplicaPlan
+                        ) -> Optional[_FailoverDecision]:
+        """Slice-atomic recovery for gang-scheduled TPU jobs.
+
+        A slice is *disrupted* when any member pod carries a
+        ``DisruptionTarget`` condition, failed with a retryable exit code /
+        reason, or — once the job has been running — is simply missing
+        (preemption deleted it). Recovery tears down the **whole** slice
+        and re-enters gang admission: the surviving pods belong to a PJRT
+        world whose membership died with the lost worker, so patching one
+        replacement in can never converge. Permanent exit codes fail the
+        job instead; a *failed* pod under restartPolicy ``Never`` defers to
+        the normal failure path, while a *missing* pod is self-heal
+        territory — the engine has always recreated missing pods for any
+        policy, and on TPU the slice-atomic form of that self-heal is the
+        only one that converges. Repeated restarts wait out a growing
+        decorrelated-jitter
+        delay persisted in ``JobStatus`` (restartRounds/lastRestartTime) so
+        a flapping node can't hot-loop slice recreation.
+        """
+        hosts = plan.slice_spec.num_hosts
+        container = self.controller.default_container_name
+        rt_of = {rt.lower(): rt for rt in plan.offsets}
+        members: dict[int, list] = {sid: [] for sid in range(plan.num_slices)}
+        for p in pods:
+            lbl = m.labels(p)
+            rtype = rt_of.get(lbl.get(c.LABEL_REPLICA_TYPE, ""))
+            idx = lbl.get(c.LABEL_REPLICA_INDEX, "")
+            if rtype is None or not idx.isdigit():
+                continue  # non-TPU roles keep per-pod semantics
+            sid = (plan.offsets[rtype] + int(idx)) // hosts
+            if 0 <= sid < plan.num_slices:
+                members[sid].append((rtype, p))
+
+        was_up = st.is_running(old_status) or st.is_restarting(old_status)
+        disrupted: set[int] = set()
+        for sid in range(plan.num_slices):
+            mem = members[sid]
+            if was_up and 0 < len(mem) < hosts \
+                    and any(_pod_phase(p) != c.POD_PENDING for _, p in mem):
+                # a member vanished out from under a slice whose world had
+                # started. An all-Pending partial slice is just a rollout
+                # interrupted mid-create (e.g. a transient error aborted
+                # the diff loop): no world formed yet, so completing the
+                # creation converges — tearing it down would burn a
+                # backoff round per hiccup
+                disrupted.add(sid)
+            for rtype, p in mem:
+                spec = replicas.get(rtype)
+                policy = (spec.restart_policy if spec else "") or c.RESTART_NEVER
+                if _pod_disrupted(p, container):
+                    if policy != c.RESTART_NEVER:
+                        disrupted.add(sid)
+                elif policy == c.RESTART_EXIT_CODE \
+                        and _pod_phase(p) == c.POD_FAILED:
+                    code = _exit_code(p, container)
+                    if code is not None and not train.is_retryable_exit_code(code):
+                        return _FailoverDecision(
+                            "fail", message=(
+                                f"replica {m.name(p)} exited with permanent "
+                                f"code {code}; not restarting the slice"))
+        if not disrupted:
+            return None
+
+        # ---- backoff gate (persisted in JobStatus) ---------------------
+        now = self.api.now()
+        rounds = status.restart_rounds
+        last = _parse_ts(status.last_restart_time)
+        if last is not None and rounds \
+                and now - last >= self.config.restart_backoff_reset:
+            rounds = status.restart_rounds = 0  # stable long enough: decay
+        # seed 0 unless pinned: the per-job delay must be stable across
+        # operator restarts (the job uid already de-correlates jobs)
+        delay = restart_delay(rounds, self.config.restart_backoff_base,
+                              self.config.restart_backoff_cap,
+                              key=m.uid(job),
+                              seed=self.config.backoff_jitter_seed or 0)
+        if last is not None and delay > 0:
+            remaining = last + delay - now
+            if remaining > 0:
+                st.update_job_conditions(
+                    status, c.JOB_RESTARTING, st.REASON_JOB_RESTARTING,
+                    f"{self.kind} {m.name(job)} slice restart backing off "
+                    f"{delay:.1f}s (round {rounds})", now=now)
+                return _FailoverDecision(
+                    "wait", requeue=remaining,
+                    message=(f"{self.kind} {m.name(job)} slice restart "
+                             f"backing off {delay:.1f}s (round {rounds})"),
+                    frozen=tuple(sorted(disrupted)))
+
+        # ---- teardown: the whole slice goes together -------------------
+        job_key = m.key(job)
+        deleted = 0
+        for sid in sorted(disrupted):
+            for rtype, p in members[sid]:
+                if not m.is_deleting(p):
+                    self._delete_pod(job_key, rtype, p)
+                    deleted += 1
+            if self.config.enable_gang_scheduling and self.gang is not None:
+                try:
+                    self._retry(lambda s=sid: self.gang.readmit_slice(
+                        job, s, plan.num_slices))
+                except ServerError as e:
+                    # pods are already gone: keep the restart bookkeeping
+                    # below (losing it would defeat the backoff gate) and
+                    # accept the stale PodGroup — create_gang reconciles
+                    # its minMember on the next pass
+                    log.warning("gang re-admission for slice %d of %s "
+                                "failed: %s", sid, job_key, e)
+        status.restart_count += 1
+        status.restart_rounds = rounds + 1
+        status.last_restart_time = m.rfc3339(now)
+        msg = (f"slice(s) {sorted(disrupted)} of {self.kind} {m.name(job)} "
+               f"disrupted; restarting all {deleted} slice pod(s) together "
+               f"(restart #{status.restart_count})")
+        st.update_job_conditions(status, c.JOB_RESTARTING,
+                                 st.REASON_JOB_RESTARTING, msg, now=now)
+        self.recorder.event(job, TYPE_WARNING, "SliceRestart", msg)
+        self.metrics.restarted.inc(kind=self.kind)
+        return _FailoverDecision("restart")
+
+    def _recount_replica_statuses(self, status: JobStatus, replicas,
+                                  pods) -> None:
+        """Refresh per-type active/succeeded/failed counters from live pods
+        without running the create/delete diff (used when slice failover
+        short-circuits the normal per-replica loops)."""
+        for rtype in replicas:
+            rt = rtype.lower()
+            rs = status.replica_statuses.setdefault(rtype, c.ReplicaStatus())
+            rs.active = rs.succeeded = rs.failed = rs.evicted = 0
+            for p in pods:
+                if m.labels(p).get(c.LABEL_REPLICA_TYPE) == rt:
+                    _count_pod(rs, p)
+
     def _dag_ready(self, pods, conditions) -> bool:
         """DAG stage gating (reference ``dag_sched.go:29-67``): all upstream
         replicas must have reached the condition's phase."""
@@ -1023,7 +1309,11 @@ def _pod_phase(pod) -> str:
 
 def _count_pod(rs, pod) -> None:
     """Reference ``status.go:19-41``: Pending counts as active only once
-    scheduled with init containers passed."""
+    scheduled with init containers passed. Disruption-marked failures are
+    tracked as ``evicted``, not ``failed`` — keeping ``rs.failed``
+    symmetric with the backoff-limit accounting's live count, which also
+    excludes voluntary disruptions (a preemption must never mask or fake
+    a genuine failure round)."""
     phase = _pod_phase(pod)
     if phase == c.POD_PENDING:
         if m.get_in(pod, "spec", "nodeName") and _init_containers_passed(pod):
@@ -1033,9 +1323,36 @@ def _count_pod(rs, pod) -> None:
     elif phase == c.POD_SUCCEEDED:
         rs.succeeded += 1
     elif phase == c.POD_FAILED:
-        rs.failed += 1
-        if m.get_in(pod, "status", "reason", default="") == "Evicted":
+        if _has_disruption_target(pod):
             rs.evicted += 1
+        else:
+            rs.failed += 1
+            if m.get_in(pod, "status", "reason", default="") == "Evicted":
+                rs.evicted += 1
+
+
+def _has_disruption_target(pod) -> bool:
+    """True when the scheduler/kubelet marked this pod for voluntary
+    disruption (preemption, drain, spot reclaim)."""
+    for cond in m.get_in(pod, "status", "conditions", default=[]) or []:
+        if cond.get("type") == c.POD_COND_DISRUPTION_TARGET \
+                and cond.get("status", "True") == "True":
+            return True
+    return False
+
+
+def _pod_disrupted(pod, container_name: str) -> bool:
+    """A transiently-lost pod: disruption-marked, or failed in a way the
+    exit-code taxonomy (``utils.train``) classifies as retryable."""
+    if _has_disruption_target(pod):
+        return True
+    if _pod_phase(pod) != c.POD_FAILED:
+        return False
+    if train.is_retryable_pod_failed_reason(
+            m.get_in(pod, "status", "reason", default="")):
+        return True
+    code = _exit_code(pod, container_name)
+    return code is not None and train.is_retryable_exit_code(code)
 
 
 def _init_containers_passed(pod) -> bool:

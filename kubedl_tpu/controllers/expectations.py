@@ -20,8 +20,9 @@ import time
 class Expectations:
     TIMEOUT = 5 * 60.0  # stale expectations expire, like upstream
 
-    def __init__(self, clock=time.time):
+    def __init__(self, clock=time.time, timeout: float = TIMEOUT):
         self._clock = clock
+        self.timeout = timeout
         self._lock = threading.Lock()
         # key -> [pending_creations, pending_deletions, timestamp]
         self._exp: dict[str, list] = {}
@@ -65,9 +66,25 @@ class Expectations:
                 return True
             if e[0] <= 0 and e[1] <= 0:
                 return True
-            if self._clock() - e[2] > self.TIMEOUT:
-                return True  # expired: something was missed, reconcile anyway
+            if self._clock() - e[2] > self.timeout:
+                # expired: a watch event was dropped or never came.
+                # Clear the stale record too — leaving the phantom counts
+                # behind would poison every future expect_* on this key
+                # (each new expectation would start from the missed debt)
+                del self._exp[key]
+                return True
             return False
+
+    def expires_in(self, key: str) -> float:
+        """Seconds until an unsatisfied expectation on ``key`` expires
+        (0 when none is pending) — what a blocked reconcile should
+        requeue after, so recovery from a dropped watch event does not
+        depend on some unrelated event happening to arrive."""
+        with self._lock:
+            e = self._exp.get(key)
+            if e is None:
+                return 0.0
+            return max(0.0, self.timeout - (self._clock() - e[2]))
 
     def delete_expectations(self, key: str) -> None:
         with self._lock:

@@ -57,9 +57,18 @@ def new_test_job(name: str, namespace: str = "default", *, workers: int = 2,
 
 
 # -- kubelet simulation helpers ---------------------------------------------
+#
+# These act as the node agent, which has its own apiserver connection — so
+# they bypass a ChaosAPIServer wrapper (``.inner``) when handed one: chaos
+# aimed at the operator must not crash the simulated kubelet.
+
+def _raw(api):
+    return getattr(api, "inner", api)
+
 
 def set_pod_phase(api, pod, phase: str, exit_code: int | None = None,
                   reason: str = "", container: str = "test-container") -> None:
+    api = _raw(api)
     pod = api.get("Pod", m.namespace(pod), m.name(pod))
     status = pod.setdefault("status", {})
     status["phase"] = phase
@@ -78,5 +87,15 @@ def set_pod_phase(api, pod, phase: str, exit_code: int | None = None,
 
 def run_all_pods(api, namespace: str = "default",
                  container: str = "test-container") -> None:
-    for pod in api.list("Pod", namespace):
+    for pod in _raw(api).list("Pod", namespace):
         set_pod_phase(api, pod, "Running", container=container)
+
+
+def set_pod_disrupted(api, pod, *, delete: bool = False,
+                      exit_code: int = 143) -> None:
+    """Mark one pod preempted (DisruptionTarget + Failed(143)), optionally
+    deleting it like the real eviction flow — the stimulus every
+    slice-atomic failover test starts from."""
+    from .chaos import preempt_pod
+    preempt_pod(_raw(api), m.namespace(pod), m.name(pod), delete=delete,
+                exit_code=exit_code)

@@ -53,6 +53,18 @@ class Invalid(ApiError):
     pass
 
 
+class ServerError(ApiError):
+    """Transient 5xx-class failure (apiserver overloaded, etcd leader
+    election, connection reset). The in-memory store never raises this on
+    its own; the chaos harness (``controllers.chaos``) injects it, and the
+    engine's jittered retry helper is what must absorb it."""
+
+
+class Timeout(ServerError):
+    """Request timed out — the caller cannot know whether the write
+    committed, so retries must tolerate AlreadyExists/NotFound echoes."""
+
+
 _ts = m.rfc3339
 
 

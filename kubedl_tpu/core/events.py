@@ -9,9 +9,12 @@ can ``kubectl get events``-equivalently inspect job lifecycle decisions.
 from __future__ import annotations
 
 import itertools
+import logging
 
 from . import meta as m
-from .apiserver import APIServer
+from .apiserver import ApiError, APIServer
+
+log = logging.getLogger("kubedl_tpu.events")
 
 TYPE_NORMAL = "Normal"
 TYPE_WARNING = "Warning"
@@ -31,6 +34,16 @@ class Recorder:
         self._dedup: dict[tuple, str] = {}  # (uid, type, reason, message) -> name
 
     def event(self, obj: dict, event_type: str, reason: str, message: str) -> None:
+        """Record an event; best-effort like the real recorder — an
+        apiserver hiccup (or injected chaos fault) writing an Event must
+        never fail the reconcile that emitted it."""
+        try:
+            self._record(obj, event_type, reason, message)
+        except ApiError as e:
+            log.warning("dropping event %s/%s for %s: %s",
+                        event_type, reason, m.key(obj), e)
+
+    def _record(self, obj: dict, event_type: str, reason: str, message: str) -> None:
         key = (m.uid(obj), event_type, reason, message)
         existing_name = self._dedup.get(key)
         if existing_name is not None:

@@ -49,15 +49,16 @@ def parse_annotations_file(text: str) -> dict:
     out = {}
     for line in text.splitlines():
         key, sep, val = line.partition("=")
-        if not sep:
-            continue
+        key = key.strip()
+        if not sep or not key:
+            continue  # malformed / orphan line: skip, don't crash PID 1
         val = val.strip()
         if val.startswith('"') and val.endswith('"') and len(val) >= 2:
             val = val[1:-1]
             # unescape the common Go quoting (\" \\ \n)
             val = (val.replace(r"\\", "\x00").replace(r"\"", '"')
                       .replace(r"\n", "\n").replace("\x00", "\\"))
-        out[key.strip()] = val
+        out[key] = val
     return out
 
 
@@ -92,10 +93,14 @@ class RestartAgent:
 
         The agent usually runs as PID 1, and the child lives in its own
         session (trainers fork dataloaders; we signal the whole group) —
-        so pod termination signals land on the agent only. They are
-        forwarded to the child's group, preserving graceful
-        checkpoint-on-preempt (the point of the preempt-protector
-        protocol)."""
+        so pod termination signals land on the agent only. The *received*
+        signal (SIGTERM on pod stop, SIGINT on ^C) is forwarded to the
+        child's whole process group, and the agent then exits with the
+        child's own exit code: a trainer that checkpoints and exits 0 on
+        SIGTERM yields a clean container exit (no spurious OnFailure
+        restart), while one killed by the signal yields the conventional
+        128+signum — which the engine's exit-code taxonomy classifies as
+        retryable."""
         baseline = read_requested_generation(self.annotations_path)
         child = subprocess.Popen(argv, start_new_session=True)
         stop = {"sig": None}
@@ -115,8 +120,7 @@ class RestartAgent:
                 if code is not None:
                     return code
                 if stop["sig"] is not None:
-                    self._terminate(child)
-                    return 128 + stop["sig"]
+                    return self._forward_and_reap(child, stop["sig"])
                 current = read_requested_generation(self.annotations_path)
                 if current > baseline:
                     if self.on_restart is not None:
@@ -129,6 +133,27 @@ class RestartAgent:
                 self._terminate(child)
             for sig, handler in prev_handlers.items():
                 signal.signal(sig, handler)
+
+    def _forward_and_reap(self, child: subprocess.Popen, signum: int) -> int:
+        """Forward ``signum`` to the child's whole process group, wait out
+        the grace period (SIGKILL escalation like kubelet), and surface the
+        child's exit code (128+N when it died by signal N)."""
+        try:
+            os.killpg(child.pid, signum)
+        except (ProcessLookupError, PermissionError):
+            pass
+        deadline = time.monotonic() + self.grace_period
+        while time.monotonic() < deadline:
+            if child.poll() is not None:
+                break
+            time.sleep(0.1)
+        else:
+            try:
+                os.killpg(child.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+        code = child.wait()
+        return code if code >= 0 else 128 - code
 
     def _terminate(self, child: subprocess.Popen) -> None:
         """SIGTERM the whole process group (trainers fork dataloaders),

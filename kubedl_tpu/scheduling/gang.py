@@ -80,6 +80,20 @@ class GangScheduler:
         return self.api.list(self.pod_group_kind, m.namespace(job),
                              selector={c.LABEL_GANG_JOB_NAME: m.name(job)})
 
+    def readmit_slice(self, job: dict, slice_id: int = 0,
+                      num_slices: int = 1) -> None:
+        """Delete one slice's PodGroup so the next reconcile's
+        ``create_gang`` recreates it from scratch — the disrupted slice
+        re-enters gang admission as a unit instead of its surviving pods
+        keeping a half-dead gang alive (slice-atomic failover: the PJRT
+        world is fixed at startup, so a patched-in replacement pod can
+        never rejoin the old world anyway)."""
+        name = gang_name(m.name(job), slice_id, num_slices)
+        try:
+            self.api.delete(self.pod_group_kind, m.namespace(job), name)
+        except NotFound:
+            pass
+
     def bind_pod_to_gang(self, job: dict, pod_template: dict,
                          slice_id: int = 0, num_slices: int = 1) -> None:
         """Label/annotate the pod into its slice's gang and pin the

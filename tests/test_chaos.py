@@ -1,0 +1,505 @@
+"""Chaos suite: the engine under injected faults.
+
+Every test here is deterministic — faults come from a seeded RNG or an
+explicit script (``ChaosAPIServer``), so a failure reproduces exactly by
+re-running with the printed seed (``KUBEDL_CHAOS_SEED=<n> pytest ...``).
+
+Covers the two acceptance scenarios from the failover work — slice-atomic
+recovery of a gang-scheduled TPU job after a worker preemption, and phase
+transitions surviving injected 409s on status writes — plus transient
+create/delete errors, committed-but-timed-out writes, dropped/duplicated
+watch events, and a probabilistic soak of a full job lifecycle.
+"""
+
+import pytest
+
+from kubedl_tpu.api import common as c
+from kubedl_tpu.api.common import JobStatus
+from kubedl_tpu.controllers.chaos import (ChaosAPIServer, ChaosConfig,
+                                          chaos_seed)
+from kubedl_tpu.controllers.engine import EngineConfig, JobEngine
+from kubedl_tpu.controllers.expectations import Expectations
+from kubedl_tpu.controllers.testing import (
+    TestJobController, new_test_job, run_all_pods, set_pod_disrupted,
+    set_pod_phase)
+from kubedl_tpu.core import meta as m
+from kubedl_tpu.core.apiserver import (APIServer, Conflict, ServerError,
+                                       Timeout)
+from kubedl_tpu.core.manager import Manager, Request
+from kubedl_tpu.scheduling.gang import CoschedulerPlugin
+from kubedl_tpu.utils import status as st
+from kubedl_tpu.utils.retry import RetryPolicy, restart_delay, retry_transient
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _print_seed():
+    # pytest shows captured stdout on failure: the repro seed rides along
+    print(f"chaos seed: {chaos_seed()} (override with KUBEDL_CHAOS_SEED)")
+
+
+def _engine_config(clock, **overrides):
+    kw = dict(enable_gang_scheduling=True,
+              retry_policy=RetryPolicy(attempts=4, base=0.01, cap=0.05),
+              retry_sleep=clock.advance,  # deterministic, instant "sleeps"
+              restart_backoff_base=10.0,
+              restart_backoff_cap=60.0,
+              restart_backoff_reset=600.0,
+              expectation_timeout=30.0)
+    kw.update(overrides)
+    return EngineConfig(**kw)
+
+
+def make_stack(clock, config: ChaosConfig, **engine_overrides):
+    """A full operator stack behind a chaos wrapper with custom fault
+    rates (the fixtures below cover the no-fault default)."""
+    api = ChaosAPIServer(APIServer(clock=clock), config)
+    manager = Manager(api, clock=clock)
+    engine = JobEngine(api, TestJobController(),
+                       _engine_config(clock, **engine_overrides),
+                       gang=CoschedulerPlugin(api))
+    manager.register(engine)
+    return api, manager, engine
+
+
+@pytest.fixture
+def api(clock):
+    # overrides conftest's plain APIServer; conftest's manager picks it up
+    return ChaosAPIServer(APIServer(clock=clock), ChaosConfig())
+
+
+@pytest.fixture
+def engine(api, manager, clock):
+    eng = JobEngine(api, TestJobController(), _engine_config(clock),
+                    gang=CoschedulerPlugin(api))
+    manager.register(eng)
+    return eng
+
+
+def reconcile(manager, n=100):
+    manager.run_until_idle(max_iterations=n)
+
+
+def job_status(api, name="tj", ns="default"):
+    return JobStatus.from_dict(api.get("TestJob", ns, name).get("status"))
+
+
+def tpu_gang_job(api, manager, workers=4):
+    api.create(new_test_job("tj", workers=workers, restart_policy="ExitCode",
+                            tpu_policy={"acceleratorType": "v5p-32"}))
+    reconcile(manager)
+    run_all_pods(api)
+    reconcile(manager)
+    assert st.is_running(job_status(api))
+
+
+# ---------------------------------------------------------------------------
+# slice-atomic failover
+# ---------------------------------------------------------------------------
+
+
+def test_preempt_one_worker_recreates_whole_slice(api, manager, engine):
+    """Acceptance: preempting 1 of 4 gang-scheduled TPU workers recreates
+    all 4 pods together (same job generation, gang re-admitted), the job
+    returns to Running, and restart_count/backoff state advance."""
+    tpu_gang_job(api, manager)
+    before = {m.name(p): m.uid(p) for p in api.list("Pod")}
+    assert len(before) == 4
+    [pg] = api.list("PodGroup")
+    pg_uid, gen_before = m.uid(pg), m.generation(api.get("TestJob", "default", "tj"))
+
+    api.preempt("default", "tj-worker-2")  # DisruptionTarget + deletion
+    reconcile(manager)
+
+    pods = api.list("Pod")
+    assert sorted(m.name(p) for p in pods) == sorted(before)
+    # every pod is a fresh object: the slice was replaced as a unit
+    assert all(m.uid(p) != before[m.name(p)] for p in pods)
+    assert all(m.get_in(p, "status", "phase", default="Pending") == "Pending"
+               for p in pods)
+    # gang re-admitted: a brand-new PodGroup with the same minMember
+    [pg] = api.list("PodGroup")
+    assert m.uid(pg) != pg_uid
+    assert pg["spec"]["minMember"] == 4
+    # spec untouched: same generation
+    assert m.generation(api.get("TestJob", "default", "tj")) == gen_before
+
+    status = job_status(api)
+    assert status.restart_count == 1
+    assert status.restart_rounds == 1
+    assert status.last_restart_time
+    assert any(e["reason"] == "SliceRestart" for e in api.list("Event"))
+    assert engine.metrics.restarted.value(kind="TestJob") == 1
+
+    run_all_pods(api)
+    reconcile(manager)
+    assert st.is_running(job_status(api))
+
+
+def test_disruption_condition_without_deletion_also_restarts(api, manager, engine):
+    """GKE leaves the Failed+DisruptionTarget pod visible for a while; the
+    condition alone must drive slice recovery — and, being a voluntary
+    disruption, must not burn backoffLimit budget (failure_rounds)."""
+    tpu_gang_job(api, manager)
+    set_pod_disrupted(api, api.get("Pod", "default", "tj-worker-1"))
+    reconcile(manager)
+    status = job_status(api)
+    assert status.restart_count == 1
+    assert status.failure_rounds == 0  # preemption is not the job's fault
+    run_all_pods(api)
+    reconcile(manager)
+    assert st.is_running(job_status(api))
+
+
+def test_retryable_exit_code_restarts_slice_not_single_pod(api, manager, engine):
+    """A SIGKILLed (137) worker in a gang slice is a dead PJRT world: the
+    engine must replace the whole slice, never patch one pod back in."""
+    api.create(new_test_job("tj", workers=4, restart_policy="ExitCode",
+                            tpu_policy={"acceleratorType": "v5p-32"},
+                            run_policy={"backoffLimit": 5}))
+    reconcile(manager)
+    run_all_pods(api)
+    reconcile(manager)
+    assert st.is_running(job_status(api))
+    before = {m.name(p): m.uid(p) for p in api.list("Pod")}
+    set_pod_phase(api, api.get("Pod", "default", "tj-worker-3"), "Failed",
+                  exit_code=137)
+    reconcile(manager)
+    pods = api.list("Pod")
+    assert len(pods) == 4
+    assert all(m.uid(p) != before[m.name(p)] for p in pods)
+    status = job_status(api)
+    assert status.restart_count == 1
+    assert status.failure_rounds == 1  # a real failure does count
+
+
+def test_permanent_exit_code_fails_job_via_fail_permanently(api, manager, engine):
+    tpu_gang_job(api, manager)
+    set_pod_phase(api, api.get("Pod", "default", "tj-worker-0"), "Failed",
+                  exit_code=1)
+    reconcile(manager)
+    status = job_status(api)
+    assert st.is_failed(status)
+    assert "permanent code 1" in status.conditions[-1].message
+    assert status.restart_count == 0
+    evs = [e for e in api.list("Event") if e["reason"] == "PermanentExitCode"]
+    assert evs and evs[0]["type"] == "Warning"
+
+
+def test_second_disruption_waits_out_jittered_backoff(api, manager, engine, clock):
+    """Slice recreation backs off with a growing, jittered delay persisted
+    in JobStatus — a flapping node cannot hot-loop the slice."""
+    tpu_gang_job(api, manager)
+    api.preempt("default", "tj-worker-0")
+    reconcile(manager)
+    run_all_pods(api)
+    reconcile(manager)
+    assert st.is_running(job_status(api))
+    assert job_status(api).restart_count == 1
+
+    api.preempt("default", "tj-worker-0")
+    reconcile(manager)
+    # round 2 gates on restart_delay(1) == base (10s): nothing recreated yet
+    status = job_status(api)
+    assert status.restart_count == 1
+    assert st.is_restarting(status)
+    assert len(api.list("Pod")) == 3
+
+    clock.advance(restart_delay(1, 10.0, 60.0, key="x") + 1)  # > base
+    manager.run_until_idle(include_delayed=True, max_iterations=200)
+    status = job_status(api)
+    assert status.restart_count == 2
+    assert status.restart_rounds == 2
+    assert len(api.list("Pod")) == 4
+    run_all_pods(api)
+    reconcile(manager)
+    assert st.is_running(job_status(api))
+
+
+def test_backoff_rounds_reset_after_stable_window(api, manager, engine, clock):
+    tpu_gang_job(api, manager)
+    api.preempt("default", "tj-worker-0")
+    reconcile(manager)
+    run_all_pods(api)
+    reconcile(manager)
+    assert job_status(api).restart_rounds == 1
+
+    clock.advance(601)  # stable past restart_backoff_reset: rounds decay
+    api.preempt("default", "tj-worker-0")
+    reconcile(manager)
+    status = job_status(api)
+    assert status.restart_count == 2
+    assert status.restart_rounds == 1  # reset to 0, then this restart
+    assert len(api.list("Pod")) == 4  # immediate, no backoff wait
+
+
+def test_scheduled_preemption_on_nth_create(api, manager, engine):
+    """The seeded schedule preempts the 3rd pod the operator ever creates;
+    recovery converges without any test intervention."""
+    api.schedule_preemption(3)
+    api.create(new_test_job("tj", workers=4, restart_policy="ExitCode",
+                            tpu_policy={"acceleratorType": "v5p-32"}))
+    reconcile(manager)
+    assert job_status(api).restart_count == 1
+    pods = api.list("Pod")
+    assert len(pods) == 4
+    assert all(m.get_in(p, "status", "phase", default="Pending") == "Pending"
+               for p in pods)
+    run_all_pods(api)
+    reconcile(manager)
+    assert st.is_running(job_status(api))
+
+
+def test_multislice_preemption_restarts_only_the_disrupted_slice(api, manager,
+                                                                 engine):
+    """2 slices x 2 hosts: preempting a slice-1 worker replaces slice 1 as
+    a unit while slice 0's pods and PodGroup are untouched."""
+    api.create(new_test_job("tj", workers=4, restart_policy="ExitCode",
+                            tpu_policy={"acceleratorType": "v5p-16",
+                                        "numSlices": 2}))
+    reconcile(manager)
+    run_all_pods(api)
+    reconcile(manager)
+    assert st.is_running(job_status(api))
+    before = {m.name(p): m.uid(p) for p in api.list("Pod")}
+    pgs = {m.name(g): m.uid(g) for g in api.list("PodGroup")}
+    assert sorted(pgs) == ["tj-slice-0", "tj-slice-1"]
+
+    api.preempt("default", "tj-worker-3")  # slice 1 member
+    reconcile(manager)
+    after = {m.name(p): m.uid(p) for p in api.list("Pod")}
+    assert after["tj-worker-0"] == before["tj-worker-0"]  # slice 0 untouched
+    assert after["tj-worker-1"] == before["tj-worker-1"]
+    assert after["tj-worker-2"] != before["tj-worker-2"]  # slice 1 replaced
+    assert after["tj-worker-3"] != before["tj-worker-3"]
+    pgs_after = {m.name(g): m.uid(g) for g in api.list("PodGroup")}
+    assert pgs_after["tj-slice-0"] == pgs["tj-slice-0"]
+    assert pgs_after["tj-slice-1"] != pgs["tj-slice-1"]
+    assert job_status(api).restart_count == 1
+    run_all_pods(api)
+    reconcile(manager)
+    assert st.is_running(job_status(api))
+
+
+# ---------------------------------------------------------------------------
+# status-write conflicts
+# ---------------------------------------------------------------------------
+
+
+def test_injected_409s_never_lose_phase_transition(api, manager, engine):
+    """Acceptance: scripted conflicts on consecutive status writes — the
+    engine re-reads, re-applies the delta, and the Succeeded transition
+    lands anyway."""
+    api.create(new_test_job("tj", workers=2))
+    reconcile(manager)
+    run_all_pods(api)
+    reconcile(manager)
+    assert st.is_running(job_status(api))
+
+    for pod in api.list("Pod"):
+        set_pod_phase(api, pod, "Succeeded", exit_code=0)
+    api.fail_next("update_status", Conflict, times=3, kind="TestJob")
+    reconcile(manager)
+
+    status = job_status(api)
+    assert st.is_succeeded(status)
+    assert status.completion_time
+    running = st.get_condition(status, c.JOB_RUNNING)
+    assert running is not None and running.status == "False"
+    assert len([f for f in api.faults if f[0] == "update_status"]) == 3
+
+
+def test_conflicting_restart_transition_survives(api, manager, engine):
+    """The Restarting transition of a slice failover also rides the
+    conflict-retry loop."""
+    tpu_gang_job(api, manager)
+    api.fail_next("update_status", Conflict, times=2, kind="TestJob")
+    api.preempt("default", "tj-worker-1")
+    reconcile(manager)
+    status = job_status(api)
+    assert status.restart_count == 1  # backoff state not lost to the 409s
+    assert len([f for f in api.faults if f[0] == "update_status"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# transient create/delete errors
+# ---------------------------------------------------------------------------
+
+
+def test_transient_create_errors_absorbed_by_retry(api, manager, engine):
+    api.create(new_test_job("tj", workers=2))
+    api.fail_next("create", ServerError, times=2, kind="Pod")
+    reconcile(manager)
+    assert len(api.list("Pod")) == 2
+    assert len([f for f in api.faults if f[0] == "create"]) == 2
+    assert st.is_created(job_status(api))
+
+
+def test_create_retries_exhausted_then_requeue_recovers(api, manager, engine):
+    """More consecutive faults than retry attempts: the reconcile errors
+    out (expectation balanced), the manager backs off and the next pass
+    finishes the rollout."""
+    api.create(new_test_job("tj", workers=2))
+    api.fail_next("create", ServerError, times=4, kind="Pod")
+    manager.run_until_idle(include_delayed=True, max_iterations=300)
+    assert len(api.list("Pod")) == 2
+
+
+def test_create_timeout_after_commit_is_idempotent(api, manager, engine):
+    """The nastiest transient: the create lands but the response times
+    out. The retry sees AlreadyExists, which the engine already treats as
+    success — no duplicate pods, no stuck expectations."""
+    api.create(new_test_job("tj", workers=3))
+    api.fail_next("create", Timeout, kind="Pod", after=True)
+    reconcile(manager)
+    pods = api.list("Pod")
+    assert sorted(m.name(p) for p in pods) == \
+        ["tj-worker-0", "tj-worker-1", "tj-worker-2"]
+    run_all_pods(api)
+    reconcile(manager)
+    assert st.is_running(job_status(api))
+
+
+def test_transient_delete_errors_retried_on_scale_in(api, manager, engine):
+    api.create(new_test_job("tj", workers=3))
+    reconcile(manager)
+    job = api.get("TestJob", "default", "tj")
+    job["spec"]["testReplicaSpecs"]["Worker"]["replicas"] = 1
+    api.update(job)
+    api.fail_next("delete", ServerError, times=1, kind="Pod")
+    manager.run_until_idle(include_delayed=True, max_iterations=300)
+    assert sorted(m.name(p) for p in api.list("Pod")) == ["tj-worker-0"]
+
+
+# ---------------------------------------------------------------------------
+# watch-stream chaos
+# ---------------------------------------------------------------------------
+
+
+def test_dropped_watch_events_recovered_by_expectation_expiry(clock):
+    """Every Pod watch event is dropped: creations are never observed, so
+    the stale-cache gate blocks — until the expectation expires, clears
+    its phantom debt, and reconciliation proceeds from live lists."""
+    api, manager, engine = make_stack(
+        clock, ChaosConfig(drop_watch_events=1.0, watch_kinds=("Pod",)))
+    api.create(new_test_job("tj", workers=2))
+    manager.run_until_idle(max_iterations=100)
+    assert len(api.list("Pod")) == 2  # creates landed; their events didn't
+    key = Expectations.pods_key("default/tj", "Worker")
+    assert not engine.expectations.satisfied(key)
+    # the blocked reconcile self-requeued for the expectation's expiry —
+    # recovery must not depend on some unrelated event arriving
+    assert manager.pending() > 0
+
+    clock.advance(31)  # past expectation_timeout
+    manager.run_until_idle(include_delayed=True, max_iterations=100)
+    assert engine.expectations.satisfied(key)
+
+    # pod status MODIFIED events are dropped too: nudging the job stands in
+    # for the informer relist a real cluster performs
+    run_all_pods(api)
+    manager.enqueue(Request("TestJob", "default", "tj"))
+    manager.run_until_idle(include_delayed=True, max_iterations=200)
+    assert st.is_running(JobStatus.from_dict(
+        api.get("TestJob", "default", "tj").get("status")))
+    assert any(f[0] == "watch_drop" for f in api.faults)
+
+
+def test_duplicated_watch_events_are_harmless(clock):
+    api, manager, engine = make_stack(
+        clock, ChaosConfig(duplicate_watch_events=1.0))
+    api.create(new_test_job("tj", workers=2))
+    manager.run_until_idle(max_iterations=200)
+    run_all_pods(api)
+    manager.run_until_idle(max_iterations=200)
+    for pod in api.list("Pod"):
+        set_pod_phase(api, pod, "Succeeded", exit_code=0)
+    manager.run_until_idle(max_iterations=200)
+    status = JobStatus.from_dict(
+        api.get("TestJob", "default", "tj").get("status"))
+    assert st.is_succeeded(status)
+    assert len(api.list("Pod")) == 2  # no double-counting, no double-create
+    assert any(f[0] == "watch_dup" for f in api.faults)
+
+
+# ---------------------------------------------------------------------------
+# seeded soak: a full lifecycle through a fault storm
+# ---------------------------------------------------------------------------
+
+
+def test_soak_lifecycle_survives_fault_storm(clock):
+    """Probabilistic conflicts + transient errors + duplicated events, all
+    from the printed seed, with a fault budget so the storm provably ends:
+    the job must still create, run, and succeed."""
+    cfg = ChaosConfig(conflict_on_status_update=0.25, error_on_create=0.2,
+                      error_on_delete=0.2, duplicate_watch_events=0.15,
+                      max_faults=40)
+    api, manager, engine = make_stack(clock, cfg)
+    # submit like a user's kubectl: its own connection, not the operator's
+    api.inner.create(new_test_job("tj", workers=2, restart_policy="ExitCode"))
+
+    def drain():
+        for _ in range(40):
+            manager.run_until_idle(include_delayed=True, max_iterations=400)
+            clock.advance(2)
+            manager.enqueue(Request("TestJob", "default", "tj"))
+            manager.run_until_idle(include_delayed=True, max_iterations=400)
+            yield JobStatus.from_dict(
+                api.get("TestJob", "default", "tj").get("status"))
+
+    for status in drain():
+        if len(api.list("Pod")) == 2:
+            break
+    run_all_pods(api)
+    for status in drain():
+        if st.is_running(status):
+            break
+    for pod in api.list("Pod"):
+        set_pod_phase(api, pod, "Succeeded", exit_code=0)
+    for status in drain():
+        if st.is_succeeded(status):
+            break
+    assert st.is_succeeded(JobStatus.from_dict(
+        api.get("TestJob", "default", "tj").get("status"))), \
+        f"seed {cfg.seed}: job never succeeded (faults: {api.faults})"
+
+
+# ---------------------------------------------------------------------------
+# retry/backoff math
+# ---------------------------------------------------------------------------
+
+
+def test_retry_transient_backs_off_with_jitter():
+    calls, sleeps = [], []
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ServerError("boom")
+        return "ok"
+    out = retry_transient(flaky, RetryPolicy(attempts=4, base=0.5, cap=2.0),
+                          retry_on=(ServerError,), sleep=sleeps.append)
+    assert out == "ok" and len(calls) == 3
+    assert len(sleeps) == 2 and all(0.5 <= s <= 2.0 for s in sleeps)
+
+
+def test_retry_transient_raises_after_attempts_and_passes_others():
+    with pytest.raises(ServerError):
+        retry_transient(lambda: (_ for _ in ()).throw(ServerError("x")),
+                        RetryPolicy(attempts=3, base=0.0),
+                        retry_on=(ServerError,), sleep=lambda s: None)
+    with pytest.raises(Conflict):  # not in retry_on: propagates immediately
+        retry_transient(lambda: (_ for _ in ()).throw(Conflict("x")),
+                        retry_on=(ServerError,), sleep=lambda s: None)
+
+
+def test_restart_delay_deterministic_growing_bounded():
+    assert restart_delay(0, 10, 300, key="u1") == 0.0
+    assert restart_delay(1, 10, 300, key="u1") == 10.0
+    for r in range(1, 12):
+        d = restart_delay(r, 10, 300, key="u1")
+        assert d == restart_delay(r, 10, 300, key="u1")  # stable per round
+        assert 10.0 <= d <= 300.0
+    # decorrelated across jobs
+    assert restart_delay(5, 10, 300, key="u1") != restart_delay(5, 10, 300,
+                                                                key="u2")

@@ -281,13 +281,25 @@ def test_agent_passes_through_child_exit(tmp_path):
     assert agent.run([sys.executable, "-c", "pass"]) == 0
 
 
-def test_agent_forwards_sigterm_to_child(tmp_path):
-    """Pod termination: kubelet SIGTERMs the agent (PID 1); the agent must
-    forward it to the trainer's process group and exit 128+15, preserving
-    graceful checkpoint-on-preempt."""
+def _run_agent_subprocess(tmp_path, child_code):
     import pathlib
 
     repo_root = str(pathlib.Path(__file__).resolve().parents[1])
+    env = {**os.environ,
+           "KUBEDL_PODINFO_ANNOTATIONS": str(tmp_path / "annotations"),
+           "KUBEDL_RESTART_POLL_S": "0.1",
+           "PYTHONPATH": repo_root}
+    return subprocess.Popen(
+        [sys.executable, "-m", "kubedl_tpu.runtime.restart_agent", "--",
+         sys.executable, "-u", "-c", child_code],
+        env=env, stdout=subprocess.PIPE)
+
+
+def test_agent_forwards_sigterm_to_child(tmp_path):
+    """Pod termination: kubelet SIGTERMs the agent (PID 1); the agent must
+    forward it to the trainer's whole process group and exit with the
+    *child's* code — a trainer that checkpoints and exits 0 yields a clean
+    container exit, no spurious OnFailure restart."""
     marker = tmp_path / "child-terminated"
     child_code = (
         "import signal, sys, time, pathlib\n"
@@ -296,19 +308,73 @@ def test_agent_forwards_sigterm_to_child(tmp_path):
         " lambda *a: (mark.write_text('x'), sys.exit(0)))\n"
         "print('ready', flush=True)\n"
         "time.sleep(60)\n")
-    env = {**os.environ,
-           "KUBEDL_PODINFO_ANNOTATIONS": str(tmp_path / "annotations"),
-           "KUBEDL_RESTART_POLL_S": "0.1",
-           "PYTHONPATH": repo_root}
-    proc = subprocess.Popen(
-        [sys.executable, "-m", "kubedl_tpu.runtime.restart_agent", "--",
-         sys.executable, "-u", "-c", child_code],
-        env=env, stdout=subprocess.PIPE)
+    proc = _run_agent_subprocess(tmp_path, child_code)
     assert proc.stdout.readline().strip() == b"ready"
     proc.send_signal(signal.SIGTERM)
     code = proc.wait(timeout=15)
-    assert code == 128 + signal.SIGTERM
+    assert code == 0  # the child's graceful exit code, not 128+15
     deadline = time.time() + 5
     while not marker.exists() and time.time() < deadline:
         time.sleep(0.05)
     assert marker.exists(), "child never saw the forwarded SIGTERM"
+
+
+def test_agent_surfaces_child_exit_code_on_sigterm(tmp_path):
+    """A trainer that exits nonzero during SIGTERM shutdown propagates that
+    exact code; one that ignores the signal is reaped as 128+N."""
+    child_code = (
+        "import signal, sys, time\n"
+        "signal.signal(signal.SIGTERM, lambda *a: sys.exit(7))\n"
+        "print('ready', flush=True)\n"
+        "time.sleep(60)\n")
+    proc = _run_agent_subprocess(tmp_path, child_code)
+    assert proc.stdout.readline().strip() == b"ready"
+    proc.send_signal(signal.SIGTERM)
+    assert proc.wait(timeout=15) == 7
+
+
+def test_agent_forwards_sigint_in_process(tmp_path):
+    """SIGINT (^C / batch-system interrupt) is forwarded as SIGINT — not
+    rewritten to SIGTERM — so trainers can distinguish the two."""
+    import threading
+
+    marker = tmp_path / "child-interrupted"
+    agent = RestartAgent(annotations_path=str(tmp_path / "annotations"),
+                         poll_interval=0.05, grace_period=10.0)
+    child_code = (
+        "import signal, sys, time, pathlib\n"
+        f"mark = pathlib.Path({str(marker)!r})\n"
+        "signal.signal(signal.SIGINT,"
+        " lambda *a: (mark.write_text('x'), sys.exit(5)))\n"
+        "time.sleep(60)\n")
+    threading.Timer(0.4, os.kill, (os.getpid(), signal.SIGINT)).start()
+    code = agent.run([sys.executable, "-u", "-c", child_code])
+    assert code == 5
+    assert marker.exists(), "child never saw the forwarded SIGINT"
+
+
+def test_parse_annotations_edge_cases():
+    """Kubelet renderings in the wild: unquoted values, malformed/orphan
+    lines, surrounding whitespace — the PID-1 parser must shrug them off."""
+    text = ("unquoted=3\n"
+            "spaced =  7  \n"
+            "noequalsign\n"
+            "=orphanvalue\n"
+            "\n"
+            'quoted="ok"\n')
+    anns = parse_annotations_file(text)
+    assert anns["unquoted"] == "3"
+    assert anns["spaced"] == "7"
+    assert anns["quoted"] == "ok"
+    assert "" not in anns
+    assert "noequalsign" not in anns
+
+
+def test_read_requested_generation_edge_cases(tmp_path):
+    # missing file and unreadable path both report generation 0
+    assert read_requested_generation(str(tmp_path / "nope")) == 0
+    assert read_requested_generation(str(tmp_path)) == 0  # a directory
+    # unquoted downward-API value still parses
+    path = tmp_path / "annotations"
+    path.write_text(f"{RESTART_ANNOTATION}=4\n")
+    assert read_requested_generation(str(path)) == 4

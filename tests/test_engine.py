@@ -150,6 +150,34 @@ def test_backoff_limit(api, manager, engine):
     assert st.is_failed(job_status(api))
 
 
+def test_backoff_limit_counts_each_failure_round_exactly_once(api, manager, engine):
+    """backoffLimit: 3 tolerates exactly 3 restart rounds — each observed
+    failure advances failure_rounds by exactly 1 (no double-counting
+    between the durable counter and live pod restartCounts), so the job
+    fails on the 4th failure round and never earlier."""
+    api.create(new_test_job("tj", workers=1, restart_policy="ExitCode",
+                            run_policy={"backoffLimit": 3}))
+    reconcile(manager)
+    for round_no in (1, 2, 3):
+        set_pod_phase(api, api.get("Pod", "default", "tj-worker-0"),
+                      "Failed", exit_code=137)
+        reconcile(manager)
+        status = job_status(api)
+        assert status.failure_rounds == round_no  # exactly +1 per round
+        assert not st.is_failed(status), \
+            f"failed early at round {round_no} of backoffLimit 3"
+        # the restart budget really was spent on a fresh pod
+        pod = api.get("Pod", "default", "tj-worker-0")
+        assert m.get_in(pod, "status", "phase", default="Pending") == "Pending"
+    set_pod_phase(api, api.get("Pod", "default", "tj-worker-0"),
+                  "Failed", exit_code=137)
+    reconcile(manager)
+    status = job_status(api)
+    assert st.is_failed(status)
+    assert status.failure_rounds == 4
+    assert "backoff limit" in status.conditions[-1].message
+
+
 def test_active_deadline(api, manager, engine, clock):
     api.create(new_test_job("tj", workers=1,
                             run_policy={"activeDeadlineSeconds": 60}))
