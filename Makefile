@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test test-all test-fast test-chaos bench dryrun crds run-standalone lint native
+.PHONY: test test-all test-fast test-chaos bench bench-controlplane dryrun crds run-standalone lint native
 
 # fast path (<3 min): everything except the compile-heavy compute suites
 # (those carry `pytestmark = pytest.mark.slow`). Chaos tests are fast and
@@ -28,6 +28,12 @@ test-fast: test
 # one-line JSON training benchmark (TPU when reachable, cpu smoke otherwise)
 bench:
 	$(PY) bench.py
+
+# control-plane settle throughput: 200 jobs x 8 replicas, indexed read path
+# vs the pre-index scan baseline -> BENCH_CONTROLPLANE.json (docs/
+# control-plane-perf.md); the fast tier-1 guard is tests/test_controlplane_perf.py
+bench-controlplane:
+	JAX_PLATFORMS=cpu $(PY) bench_controlplane.py
 
 # multi-chip sharding compile+execute proof on a virtual mesh
 dryrun:

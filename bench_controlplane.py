@@ -1,0 +1,149 @@
+"""Control-plane scale benchmark: settle N jobs × M replicas, one JSON line.
+
+The training bench (``bench.py``) measures tokens/sec; this one measures
+the other half of the ROADMAP's "fast as the hardware allows": how fast
+the operator itself turns submitted jobs into Running jobs. It creates N
+PyTorchJobs of M replicas against the in-memory API server, drives the
+manager to settlement with a simulated kubelet (every Pending pod flips
+Running between drain rounds), and reports settle throughput, reconcile
+latency percentiles, and queue depth.
+
+Modes (``--mode``):
+
+* ``index`` — the indexed copy-on-write read path (default server mode),
+* ``scan``  — the pre-index brute-force path (full world scan + deepcopy
+  per match on every list) kept inside the server as the baseline,
+* ``both``  — run both and report the speedup (the acceptance gate:
+  ``make bench-controlplane`` writes BENCH_CONTROLPLANE.json).
+
+Usage::
+
+    python bench_controlplane.py [--jobs 200] [--replicas 8]
+                                 [--mode both] [--out BENCH_CONTROLPLANE.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+from kubedl_tpu.api.common import JobStatus
+from kubedl_tpu.controllers.registry import OperatorConfig, build_operator
+from kubedl_tpu.core import meta as m
+from kubedl_tpu.core.apiserver import APIServer
+from kubedl_tpu.utils import status as st
+
+CONTAINER = "pytorch"
+
+
+def make_job(name: str, replicas: int) -> dict:
+    template = {"spec": {"containers": [{
+        "name": CONTAINER, "image": "bench:latest",
+        "ports": [{"name": "pytorchjob-port", "containerPort": 23456}],
+    }]}}
+    specs = {"Master": {"replicas": 1, "restartPolicy": "Never",
+                        "template": template}}
+    if replicas > 1:
+        specs["Worker"] = {"replicas": replicas - 1, "restartPolicy": "Never",
+                           "template": template}
+    return m.new_obj("training.kubedl.io/v1alpha1", "PyTorchJob", name,
+                     spec={"pytorchReplicaSpecs": specs})
+
+
+def flip_running(api, pod: dict) -> None:
+    """The simulated kubelet: write the status subresource directly (a real
+    kubelet PATCHes status; it does not round-trip the whole pod)."""
+    api.update_status({
+        "kind": "Pod",
+        "metadata": {"name": m.name(pod), "namespace": m.namespace(pod)},
+        "status": {"phase": "Running",
+                   "containerStatuses": [{"name": CONTAINER,
+                                          "state": {"running": {}}}]},
+    })
+
+
+def _settled(api, n: int) -> bool:
+    jobs = api.list("PyTorchJob")
+    return len(jobs) == n and all(
+        st.is_running(JobStatus.from_dict(j.get("status"))) for j in jobs)
+
+
+def run_once(jobs: int, replicas: int, mode: str) -> dict:
+    api = APIServer(list_mode=mode)
+    op = build_operator(api, OperatorConfig(workloads=["PyTorchJob"]))
+    op.manager.record_latency = True
+
+    t0 = time.perf_counter()
+    for i in range(jobs):
+        api.create(make_job(f"bench-{i:04d}", replicas))
+    for _ in range(10_000):
+        op.manager.run_until_idle(max_iterations=10_000_000)
+        pending = [p for p in api.list("Pod")
+                   if (p.get("status") or {}).get("phase",
+                                                  "Pending") != "Running"]
+        if not pending and _settled(api, jobs) and op.manager.pending() == 0:
+            break
+        for pod in pending:  # the simulated kubelet: everything schedules
+            flip_running(api, pod)
+    else:
+        raise RuntimeError(f"{jobs}x{replicas} did not settle in mode={mode}")
+    elapsed = time.perf_counter() - t0
+
+    lat = sorted(op.manager.latency_samples)
+
+    def pct(q: float) -> float:
+        return lat[min(int(len(lat) * q), len(lat) - 1)] if lat else 0.0
+
+    return {
+        "mode": mode,
+        "settle_seconds": round(elapsed, 3),
+        "jobs_per_sec_settled": round(jobs / elapsed, 2),
+        "reconciles": op.manager.reconcile_count,
+        "reconcile_p50_ms": round(pct(0.50) * 1e3, 3),
+        "reconcile_p99_ms": round(pct(0.99) * 1e3, 3),
+        "max_queue_depth": op.manager.max_queue_depth,
+        "world_objects": len(api),
+    }
+
+
+def main() -> dict:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--jobs", type=int, default=200)
+    ap.add_argument("--replicas", type=int, default=8)
+    ap.add_argument("--mode", choices=("index", "scan", "both"),
+                    default="both")
+    ap.add_argument("--repeat", type=int, default=3,
+                    help="runs per mode; the fastest settle is reported "
+                         "(damps CPU-scheduler noise, standard for "
+                         "throughput benchmarks)")
+    ap.add_argument("--out", default="BENCH_CONTROLPLANE.json")
+    args = ap.parse_args()
+
+    result = {
+        "benchmark": "controlplane_settle",
+        "jobs": args.jobs,
+        "replicas": args.replicas,
+        "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+    }
+    result["repeat"] = max(args.repeat, 1)
+    modes = ("index", "scan") if args.mode == "both" else (args.mode,)
+    for mode in modes:
+        runs = [run_once(args.jobs, args.replicas, mode)
+                for _ in range(result["repeat"])]
+        result[mode] = min(runs, key=lambda r: r["settle_seconds"])
+        print(json.dumps({k: v for k, v in result[mode].items()}))
+    if "index" in result and "scan" in result:
+        result["speedup_settle_throughput"] = round(
+            result["scan"]["settle_seconds"]
+            / max(result["index"]["settle_seconds"], 1e-9), 2)
+    print(json.dumps(result))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=2, sort_keys=True)
+            f.write("\n")
+    return result
+
+
+if __name__ == "__main__":
+    main()

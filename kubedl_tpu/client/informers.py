@@ -3,9 +3,14 @@
 The analog of the reference's generated informer/lister tree
 (``client/informers/externalversions``, ``client/listers``): a shared
 factory hands out one informer per kind; each informer keeps a local cache
-(indexed by namespace/name) synced from the API server's watch stream,
-replays the initial list to late-added handlers, and exposes a ``Lister``
-over the cache so reads don't hit the store.
+(indexed by namespace/name, bucketed by namespace for listers) synced from
+the API server's watch stream, replays the initial list to late-added
+handlers, and exposes a ``Lister`` over the cache so reads don't hit the
+store.
+
+Ownership rule (docs/control-plane-perf.md): cached objects are the API
+server's shared snapshots — handlers and lister callers must treat them as
+frozen and copy before mutating, exactly like client-go informer caches.
 """
 
 from __future__ import annotations
@@ -39,6 +44,9 @@ class Informer:
         self.api = api
         self.kind = kind
         self._cache: dict[tuple[str, str], dict] = {}
+        #: namespace -> {key -> obj}: listers filter per-namespace without
+        #: scanning the whole cache (mirror of the server-side ns index)
+        self._by_ns: dict[str, dict[tuple[str, str], dict]] = {}
         self._handlers: list[dict] = []
         self._lock = threading.RLock()
         self._synced = False
@@ -68,7 +76,7 @@ class Informer:
                 # skip keys the watch already saw — including DELETED
                 # events for snapshot objects, which must not resurrect
                 if key not in self._cache and key not in self._sync_tombstones:
-                    self._cache[key] = obj
+                    self._cache_put(key, obj)
                     self._dispatch("add", None, obj)
             self._syncing = False
             self._sync_tombstones.clear()
@@ -104,6 +112,18 @@ class Informer:
 
     # -- internals --------------------------------------------------------
 
+    def _cache_put(self, key: tuple[str, str], obj: dict) -> None:
+        self._cache[key] = obj
+        self._by_ns.setdefault(key[0], {})[key] = obj
+
+    def _cache_pop(self, key: tuple[str, str]) -> None:
+        self._cache.pop(key, None)
+        bucket = self._by_ns.get(key[0])
+        if bucket is not None:
+            bucket.pop(key, None)
+            if not bucket:
+                del self._by_ns[key[0]]
+
     def _on_event(self, event_type: str, obj: dict) -> None:
         if m.kind(obj) != self.kind:
             return
@@ -117,11 +137,11 @@ class Informer:
                     # created while start() held the lock would otherwise be
                     # dispatched as 'add' twice
                     return
-                self._cache[key] = obj
+                self._cache_put(key, obj)
                 self._dispatch("add", None, obj)
             elif event_type == "MODIFIED":
                 old = self._cache.get(key)
-                self._cache[key] = obj
+                self._cache_put(key, obj)
                 if old is None:
                     self._dispatch("add", None, obj)
                 else:
@@ -129,7 +149,7 @@ class Informer:
             elif event_type == "DELETED":
                 if self._syncing:
                     self._sync_tombstones.add(key)
-                self._cache.pop(key, None)
+                self._cache_pop(key)
                 self._dispatch("delete", None, obj)
 
     def _dispatch(self, which: str, old: Optional[dict], obj: dict) -> None:
@@ -149,12 +169,14 @@ class Informer:
     def _cache_list(self, namespace: Optional[str],
                     selector: Optional[dict]) -> list:
         with self._lock:
+            if namespace is not None:
+                candidates = list(self._by_ns.get(namespace, {}).values())
+            else:
+                candidates = list(self._cache.values())
             out = []
-            for (ns, _), obj in self._cache.items():
-                if namespace is not None and ns != namespace:
-                    continue
+            for obj in candidates:
                 if selector is not None and not m.match_labels(
-                        m.labels(obj), selector):
+                        m.get_labels(obj), selector):
                     continue
                 out.append(obj)
         out.sort(key=lambda o: (m.namespace(o), m.name(o)))

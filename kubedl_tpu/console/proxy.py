@@ -77,8 +77,13 @@ class DataProxy:
         job = self.api.try_get(kind, namespace, name)
         if job is not None:
             uid = m.uid(job)
-            pods = [p for p in self.api.list("Pod", namespace)
-                    if m.is_controlled_by(p, job)]
+            if hasattr(self.api, "list_owned"):
+                # ownerRef-UID index: O(job's pods), not O(namespace)
+                pods = [p for p in self.api.list_owned("Pod", uid, namespace)
+                        if m.is_controlled_by(p, job)]
+            else:
+                pods = [p for p in self.api.list("Pod", namespace)
+                        if m.is_controlled_by(p, job)]
             if pods:
                 return [dmo.pod_to_record(p) for p in pods]
         else:
@@ -144,8 +149,15 @@ class DataProxy:
                 for e in self.list_events(namespace, pod_name)]
 
     def list_events(self, namespace: str, obj_name: str) -> list:
-        live = [dmo.event_to_record(e) for e in self.api.list("Event", namespace)
-                if e.get("involvedObject", {}).get("name") == obj_name]
+        if hasattr(self.api, "list_indexed"):
+            # involvedObject-name index: O(object's events) per page load,
+            # not a scan of every Event in the namespace
+            evs = self.api.list_indexed("Event", "involved-name", obj_name,
+                                        namespace=namespace)
+        else:
+            evs = [e for e in self.api.list("Event", namespace)
+                   if e.get("involvedObject", {}).get("name") == obj_name]
+        live = [dmo.event_to_record(e) for e in evs]
         if live:
             return sorted(live, key=lambda r: r.last_timestamp)
         if self.event_backend is not None:
@@ -203,7 +215,7 @@ class DataProxy:
                 "name": m.name(node),
                 "allocatable": m.get_in(node, "status", "allocatable",
                                         default={}) or {},
-                "labels": m.labels(node),
+                "labels": m.get_labels(node),
             })
         return out
 
@@ -228,7 +240,7 @@ class DataProxy:
             ns, name = m.namespace(pg), m.name(pg)
             mm = int(m.get_in(pg, "spec", "minMember", default=0) or 0)
             members = [p for p in pods if m.namespace(p) == ns and any(
-                m.labels(p).get(k) == name for k in self._GANG_POD_LABELS)]
+                m.get_labels(p).get(k) == name for k in self._GANG_POD_LABELS)]
             running = sum(1 for p in members if m.get_in(
                 p, "status", "phase", default="Pending") == "Running")
             scheduled = sum(1 for p in members
@@ -255,7 +267,7 @@ class DataProxy:
                     age = max(0.0, now - since)
             gangs.append({
                 "namespace": ns, "name": name,
-                "job": m.labels(pg).get(c.LABEL_GANG_JOB_NAME, ""),
+                "job": m.get_labels(pg).get(c.LABEL_GANG_JOB_NAME, ""),
                 "minMember": mm, "members": len(members),
                 "running": running, "scheduled": scheduled,
                 "tpuChips": tpu, "phase": phase,
@@ -280,7 +292,7 @@ class DataProxy:
                 and m.get_in(p, "status", "phase",
                              default="Pending") not in ("Succeeded",
                                                         "Failed"))
-            labels = m.labels(node)
+            labels = m.get_labels(node)
             nodes.append({
                 "name": nname,
                 "tpuAllocatable": chips, "tpuInUse": used,

@@ -34,6 +34,7 @@ import random
 from dataclasses import dataclass, field
 from typing import Optional
 
+from ..api import common as c
 from ..core import meta as m
 from ..core.apiserver import APIServer, Conflict, ServerError, Timeout
 
@@ -42,8 +43,9 @@ log = logging.getLogger("kubedl_tpu.chaos")
 ENV_CHAOS_SEED = "KUBEDL_CHAOS_SEED"
 DEFAULT_SEED = 20260804
 
-#: pod condition kubelet/scheduler set on voluntary disruption (k8s >=1.26)
-DISRUPTION_TARGET = "DisruptionTarget"
+#: pod condition kubelet/scheduler set on voluntary disruption (k8s >=1.26);
+#: re-exported so chaos and the engine can never disagree on the string
+DISRUPTION_TARGET = c.POD_COND_DISRUPTION_TARGET
 
 
 def chaos_seed(default: int = DEFAULT_SEED) -> int:
@@ -125,7 +127,16 @@ class ChaosAPIServer:
         script = self._scripted.get(op)
         if script:
             for i, (exc, want_kind, after) in enumerate(script):
-                if want_kind is None or want_kind == kind:
+                if want_kind is None:
+                    # a kind-unqualified fault must not be burned on a
+                    # best-effort write (the Recorder swallows Event
+                    # faults, neutering the scripted test); target an
+                    # exempt kind explicitly via fail_next(kind=...)
+                    if kind in self.config.exempt_kinds:
+                        continue
+                    script.pop(i)
+                    return self._record(op, kind, target, exc), after
+                if want_kind == kind:
                     script.pop(i)
                     return self._record(op, kind, target, exc), after
         if kind in self.config.exempt_kinds:

@@ -184,7 +184,9 @@ class ElasticInPlaceMixin:
                 index = 0
             if index >= counts.get(rt, 0):
                 continue  # excess replica: engine diff loop deletes it
-            pod_ann = m.annotations(p)
+            # p is a shared list() snapshot: read annotations without the
+            # setdefault mutation (docs/control-plane-perf.md ownership)
+            pod_ann = m.get_annotations(p)
             if pod_ann.get(c.ANNOTATION_RESTART_REQUESTED_GENERATION) \
                     != str(gen):
                 # phase 1: request the in-place restart

@@ -609,10 +609,12 @@ class JobEngine(Reconciler):
         for obj in self.api.list(kind, m.namespace(job), selector=sel):
             ref = m.get_controller_ref(obj)
             if ref is None and not m.is_deleting(job):
-                lbl = m.labels(obj)
+                lbl = m.get_labels(obj)
                 if not (lbl.get(c.LABEL_REPLICA_TYPE)
                         and lbl.get(c.LABEL_REPLICA_INDEX, "").isdigit()):
                     continue  # orphan we couldn't manage; leave it alone
+                # list() hands out shared snapshots: copy before adopting
+                obj = m.deep_copy(obj)
                 m.set_controller_ref(obj, job)
                 try:
                     obj = self.api.update(obj)
@@ -731,7 +733,7 @@ class JobEngine(Reconciler):
                 # the failed pod still counts this round (reference pod.go:
                 # 356-360 falls through to updateJobReplicaStatuses), which is
                 # what lets UpdateJobStatus flip the job to Restarting
-                _count_pod(rs, pod)
+                _count_pod(rs, pod, spec.restart_policy)
 
     def _delete_pod(self, job_key: str, rtype: str, pod) -> None:
         self.expectations.expect_deletions(Expectations.pods_key(job_key, rtype), 1)
@@ -748,12 +750,12 @@ class JobEngine(Reconciler):
                     replicas, run_policy: RunPolicy, plan: _ReplicaPlan,
                     hostnet_ports: Optional[dict] = None) -> None:
         rt = rtype.lower()
-        template = copy.deepcopy(spec.template) or {}
+        template = m.deep_copy(spec.template) or {}
         pod = {
             "apiVersion": "v1",
             "kind": "Pod",
-            "metadata": copy.deepcopy(template.get("metadata", {})),
-            "spec": copy.deepcopy(template.get("spec", {})),
+            "metadata": m.deep_copy(template.get("metadata", {})),
+            "spec": m.deep_copy(template.get("spec", {})),
         }
         labels = self.gen_labels(m.name(job))
         labels[c.LABEL_REPLICA_TYPE] = rt
@@ -888,7 +890,9 @@ class JobEngine(Reconciler):
                 ports = m.get_in(svc, "spec", "ports", default=[]) or []
                 if live is not None and ports \
                         and ports[0].get("targetPort") != live:
-                    ports[0]["targetPort"] = live
+                    # svc is a shared list() snapshot: mutate a copy
+                    svc = m.deep_copy(svc)
+                    svc["spec"]["ports"][0]["targetPort"] = live
                     try:
                         self.api.update(svc)
                     except (Conflict, NotFound):
@@ -1240,7 +1244,7 @@ class JobEngine(Reconciler):
             rs.active = rs.succeeded = rs.failed = rs.evicted = 0
             for p in pods:
                 if m.labels(p).get(c.LABEL_REPLICA_TYPE) == rt:
-                    _count_pod(rs, p)
+                    _count_pod(rs, p, replicas[rtype].restart_policy)
 
     def _dag_ready(self, pods, conditions) -> bool:
         """DAG stage gating (reference ``dag_sched.go:29-67``): all upstream
@@ -1307,13 +1311,16 @@ def _pod_phase(pod) -> str:
     return m.get_in(pod, "status", "phase", default=c.POD_PENDING)
 
 
-def _count_pod(rs, pod) -> None:
+def _count_pod(rs, pod, restart_policy: str = "") -> None:
     """Reference ``status.go:19-41``: Pending counts as active only once
     scheduled with init containers passed. Disruption-marked failures are
     tracked as ``evicted``, not ``failed`` — keeping ``rs.failed``
     symmetric with the backoff-limit accounting's live count, which also
     excludes voluntary disruptions (a preemption must never mask or fake
-    a genuine failure round)."""
+    a genuine failure round). Exception: under restartPolicy ``Never``
+    (the default) there is no restart path to absorb the disruption, so
+    it also counts as ``failed`` — otherwise a preempted-but-not-deleted
+    pod would leave the job Running forever."""
     phase = _pod_phase(pod)
     if phase == c.POD_PENDING:
         if m.get_in(pod, "spec", "nodeName") and _init_containers_passed(pod):
@@ -1325,6 +1332,8 @@ def _count_pod(rs, pod) -> None:
     elif phase == c.POD_FAILED:
         if _has_disruption_target(pod):
             rs.evicted += 1
+            if (restart_policy or c.RESTART_NEVER) == c.RESTART_NEVER:
+                rs.failed += 1
         else:
             rs.failed += 1
             if m.get_in(pod, "status", "reason", default="") == "Evicted":

@@ -18,7 +18,7 @@ from . import hostnetwork as hn
 from ..core.events import Recorder
 from ..core.manager import Manager
 from ..utils import workloadgate
-from ..metrics import JobMetrics, Registry
+from ..metrics import ControlPlaneMetrics, JobMetrics, Registry
 from ..core.deployment import DeploymentReconciler
 from ..platform.cache import CacheBackendReconciler
 from ..platform.cron import CronReconciler
@@ -93,8 +93,8 @@ def build_operator(api: Optional[APIServer] = None,
     # falsy and `api or APIServer()` would silently discard the caller's
     api = api if api is not None else APIServer()
     config = config or OperatorConfig()
-    manager = Manager(api)
     registry = Registry()
+    manager = Manager(api, metrics=ControlPlaneMetrics(registry))
     metrics = JobMetrics(registry)
     recorder = Recorder(api)
     gates = config.feature_gates

@@ -1,4 +1,4 @@
-"""In-memory Kubernetes-compatible API server.
+"""In-memory Kubernetes-compatible API server with an indexed read path.
 
 Plays two roles, mirroring how the reference tests everything against
 controller-runtime's fake client (reference ``controllers/suite_tests/
@@ -19,11 +19,32 @@ Semantics implemented (the subset the operator relies on):
 * watch fan-out: subscribers receive ``(event_type, obj)`` tuples for
   ADDED / MODIFIED / DELETED, the signal controller-runtime feeds workqueues
   from (reference ``controllers/pytorch/pytorchjob_controller.go:148-185``).
+
+Read-path scale model (docs/control-plane-perf.md):
+
+* **Copy-on-write storage.** Every write commits a fresh object (the store
+  never mutates a committed object in place) plus one shared read snapshot.
+  ``list()``/``list_indexed()``/``list_owned()`` and watch callbacks all
+  hand out that *shared* snapshot — mutating it cannot corrupt the store
+  (the canonical object is separate), but readers must treat what they are
+  handed as frozen; copy before mutating (``get()`` still returns a private
+  copy, it is the mutate-then-``update()`` API).
+* **Informer-style indexes**, maintained incrementally on every commit:
+  kind, (kind, namespace), label postings, ownerReference UID, plus custom
+  indexers registered with :meth:`add_indexer` (client-go ``cache.Indexer``
+  shape). ``list(kind, ns, selector)`` touches only matching objects
+  instead of scanning the world.
+* **Modes** (``list_mode`` attribute, env ``KUBEDL_LIST_MODE``):
+  ``index`` (default), ``scan`` (the pre-index brute-force path with a
+  deepcopy per match — kept as the benchmark baseline), and ``parity``
+  (compute both, raise if they ever diverge — chaos/property tests run in
+  this mode to keep the indexes honest).
 """
 
 from __future__ import annotations
 
 import copy
+import os
 import threading
 import time
 from typing import Callable, Iterable, Optional
@@ -31,6 +52,9 @@ from typing import Callable, Iterable, Optional
 from . import meta as m
 
 Obj = dict
+
+ENV_LIST_MODE = "KUBEDL_LIST_MODE"
+LIST_MODES = ("index", "scan", "parity")
 
 
 class ApiError(Exception):
@@ -65,22 +89,69 @@ class Timeout(ServerError):
     committed, so retries must tolerate AlreadyExists/NotFound echoes."""
 
 
+class IndexParityError(AssertionError):
+    """Raised in ``parity`` mode when an indexed read disagrees with the
+    brute-force scan — an index-maintenance bug (or a reader mutating a
+    shared snapshot it was handed)."""
+
+
 _ts = m.rfc3339
+
+#: the JSON-tree copier (``meta.deep_copy``); the ``scan`` baseline keeps
+#: stock ``copy.deepcopy`` so benchmarks compare the true pre-index path
+_fast_deepcopy = m.deep_copy
+
+
+_labels_of = m.get_labels
+
+
+def _owner_refs_of(obj: Obj) -> list:
+    return (obj.get("metadata") or {}).get("ownerReferences") or []
+
+
+def _event_involved_uid(ev: Obj) -> list:
+    uid = (ev.get("involvedObject") or {}).get("uid")
+    return [uid] if uid else []
+
+
+def _event_involved_name(ev: Obj) -> list:
+    name = (ev.get("involvedObject") or {}).get("name")
+    return [name] if name else []
 
 
 class APIServer:
     """Thread-safe in-memory object store with watch fan-out."""
 
     def __init__(self, clock: Callable[[], float] = time.time,
-                 admission=None):
+                 admission=None, list_mode: Optional[str] = None):
         self._clock = clock
+        #: canonical committed objects — server-private, never handed out
         self._objs: dict[tuple[str, str, str], Obj] = {}
+        #: shared read snapshots, one per object, replaced on every commit;
+        #: what list()/watch hand out (readers share them, the store does
+        #: not read them back, so a misbehaving reader cannot corrupt state)
+        self._snaps: dict[tuple[str, str, str], Obj] = {}
+        # -- incremental indexes (all map to key sets into _objs) ----------
+        self._kind_keys: dict[str, set] = {}
+        self._ns_keys: dict[tuple[str, str], set] = {}
+        self._label_idx: dict[tuple[str, str, str], set] = {}
+        self._owner_idx: dict[str, set] = {}
+        self._custom_idx: dict[tuple[str, str, str], set] = {}
+        self._indexers: dict[str, dict[str, Callable[[Obj], Iterable]]] = {}
         self._rv = 0
         self._lock = threading.RLock()
         self._watchers: list[Callable[[str, Obj], None]] = []
         #: optional AdmissionChain run at create/update (webhook analog:
         #: defaulting + validation happen at admission, not mid-reconcile)
         self.admission = admission
+        mode = list_mode or os.environ.get(ENV_LIST_MODE, "") or "index"
+        if mode not in LIST_MODES:
+            raise ValueError(f"unknown list mode {mode!r} (know {LIST_MODES})")
+        self.list_mode = mode
+        # Event lookups the Recorder/console need (involvedObject is not an
+        # ownerReference when the involved object had no uid yet)
+        self.add_indexer("Event", "involved-uid", _event_involved_uid)
+        self.add_indexer("Event", "involved-name", _event_involved_name)
 
     # -- helpers ----------------------------------------------------------
 
@@ -94,12 +165,29 @@ class APIServer:
         self._rv += 1
         return self._rv
 
-    def _emit(self, event_type: str, obj: Obj):
+    def _dc(self, o):
+        """The store's object copier: seed-exact ``copy.deepcopy`` in scan
+        mode, the JSON-tree fast path otherwise."""
+        return copy.deepcopy(o) if self.list_mode == "scan" else _fast_deepcopy(o)
+
+    def _emit(self, event_type: str, snap: Obj):
+        """Fan an event out to every watcher. All watchers share ONE
+        snapshot per event (it is already distinct from the canonical
+        stored object). ``scan`` mode keeps the pre-index behavior —
+        one deepcopy per watcher — as the benchmark baseline."""
+        if self.list_mode == "scan":
+            for w in list(self._watchers):
+                w(event_type, copy.deepcopy(snap))
+            return
         for w in list(self._watchers):
-            w(event_type, copy.deepcopy(obj))
+            w(event_type, snap)
 
     def watch(self, fn: Callable[[str, Obj], None]) -> Callable[[], None]:
-        """Subscribe to all object events. Returns an unsubscribe fn."""
+        """Subscribe to all object events. Returns an unsubscribe fn.
+
+        Delivered objects are shared snapshots: treat them as frozen.
+        Mutating one cannot corrupt the store, but it will corrupt what
+        every other watcher and cached reader of the same event sees."""
         with self._lock:
             self._watchers.append(fn)
 
@@ -109,10 +197,81 @@ class APIServer:
                     self._watchers.remove(fn)
         return cancel
 
+    # -- index maintenance -------------------------------------------------
+
+    def add_indexer(self, kind: str, name: str,
+                    fn: Callable[[Obj], Iterable]) -> None:
+        """Register a custom index over ``kind`` (client-go ``cache.Indexer``
+        shape): ``fn(obj)`` returns the index values the object files under.
+        Existing objects are backfilled; query with :meth:`list_indexed`."""
+        with self._lock:
+            self._indexers.setdefault(kind, {})[name] = fn
+            for k in self._kind_keys.get(kind, ()):
+                obj = self._objs[k]
+                for v in fn(obj) or ():
+                    self._custom_idx.setdefault((kind, name, str(v)),
+                                                set()).add(k)
+
+    def _index_add(self, k, obj: Obj) -> None:
+        kind, ns = k[0], k[1]
+        self._kind_keys.setdefault(kind, set()).add(k)
+        self._ns_keys.setdefault((kind, ns), set()).add(k)
+        for lk, lv in _labels_of(obj).items():
+            self._label_idx.setdefault((kind, lk, str(lv)), set()).add(k)
+        for ref in _owner_refs_of(obj):
+            uid = ref.get("uid")
+            if uid:
+                self._owner_idx.setdefault(uid, set()).add(k)
+        for name, fn in self._indexers.get(kind, {}).items():
+            for v in fn(obj) or ():
+                self._custom_idx.setdefault((kind, name, str(v)), set()).add(k)
+
+    def _index_remove(self, k, obj: Obj) -> None:
+        kind, ns = k[0], k[1]
+
+        def drop(table: dict, tk) -> None:
+            keys = table.get(tk)
+            if keys is not None:
+                keys.discard(k)
+                if not keys:
+                    del table[tk]
+
+        drop(self._kind_keys, kind)
+        drop(self._ns_keys, (kind, ns))
+        for lk, lv in _labels_of(obj).items():
+            drop(self._label_idx, (kind, lk, str(lv)))
+        for ref in _owner_refs_of(obj):
+            uid = ref.get("uid")
+            if uid:
+                drop(self._owner_idx, uid)
+        for name, fn in self._indexers.get(kind, {}).items():
+            for v in fn(obj) or ():
+                drop(self._custom_idx, (kind, name, str(v)))
+
+    def _commit(self, k, new: Obj) -> Obj:
+        """Replace (or insert) the canonical object at ``k`` and cut the
+        shared read snapshot. Caller holds the lock and relinquishes all
+        references to ``new``. Returns the snapshot to emit.
+
+        Baseline-cost accounting (scan mode): the snapshot deepcopy here
+        stands in for the pre-index path's store-side deepcopy (the seed
+        did ``self._objs[k] = copy.deepcopy(new)`` on every write), so
+        scan-mode writes pay the same copy count as the seed; the only
+        extra is the index bookkeeping (~2% of write cost), which keeps
+        the benchmark baseline honest without forking the write path."""
+        old = self._objs.get(k)
+        if old is not None:
+            self._index_remove(k, old)
+        self._objs[k] = new
+        self._index_add(k, new)
+        snap = self._dc(new)
+        self._snaps[k] = snap
+        return snap
+
     # -- CRUD -------------------------------------------------------------
 
     def create(self, obj: Obj) -> Obj:
-        obj = copy.deepcopy(obj)
+        obj = self._dc(obj)
         md = m.meta(obj)
         if not md.get("name"):
             if md.get("generateName"):
@@ -131,16 +290,18 @@ class APIServer:
             md["resourceVersion"] = self._next_rv()
             md["generation"] = 1
             md["creationTimestamp"] = _ts(self.now())
-            self._objs[k] = copy.deepcopy(obj)
-        self._emit("ADDED", obj)
-        return copy.deepcopy(obj)
+            snap = self._commit(k, obj)
+        self._emit("ADDED", snap)
+        return self._dc(snap)
 
     def get(self, kind: str, namespace: str, name: str) -> Obj:
+        """A private deep copy — the one read API whose result the caller
+        may mutate and hand back to ``update()``."""
         with self._lock:
             k = self._key(kind, namespace, name)
             if k not in self._objs:
                 raise NotFound(f"{kind} {namespace}/{name} not found")
-            return copy.deepcopy(self._objs[k])
+            return self._dc(self._objs[k])
 
     def try_get(self, kind: str, namespace: str, name: str) -> Optional[Obj]:
         try:
@@ -148,26 +309,159 @@ class APIServer:
         except NotFound:
             return None
 
+    # -- list (indexed read path) -----------------------------------------
+
     def list(self, kind: str, namespace: Optional[str] = None,
              selector: Optional[dict] = None,
              field_selector: Optional[object] = None) -> list[Obj]:
+        """Objects of ``kind`` matching namespace/label/field filters,
+        sorted by (namespace, name). Returns shared snapshots — treat them
+        as frozen (copy before mutating)."""
         fields = _parse_field_selector(field_selector)
         with self._lock:
-            out = []
-            for (kd, ns, _), obj in self._objs.items():
-                if kd != kind:
-                    continue
-                if namespace is not None and ns != namespace:
-                    continue
-                if selector is not None and not m.match_labels(
-                        m.meta(obj).get("labels", {}) or {}, selector):
-                    continue
-                if any(str(m.get_in(obj, *path.split("."), default=""))
-                       != want for path, want in fields):
-                    continue
-                out.append(copy.deepcopy(obj))
-            out.sort(key=lambda o: (m.namespace(o), m.name(o)))
+            if self.list_mode == "scan":
+                return self._scan_list(kind, namespace, selector, fields,
+                                       copy_out=True)
+            out = self._indexed_list(kind, namespace, selector, fields)
+            if self.list_mode == "parity":
+                want = self._scan_list(kind, namespace, selector, fields,
+                                       copy_out=False)
+                self._check_parity("list", (kind, namespace, selector,
+                                            field_selector), out, want)
             return out
+
+    def list_indexed(self, kind: str, index: str, value,
+                     namespace: Optional[str] = None) -> list[Obj]:
+        """Objects of ``kind`` filed under ``value`` in the custom ``index``
+        (see :meth:`add_indexer`). Shared snapshots, sorted."""
+        with self._lock:
+            fn = self._indexers.get(kind, {}).get(index)
+            if fn is None:
+                raise KeyError(f"no index {index!r} on kind {kind!r}")
+            if self.list_mode != "scan":
+                keys = self._custom_idx.get((kind, index, str(value)), ())
+                if namespace is not None:
+                    keys = [k for k in keys if k[1] == namespace]
+                out = sorted((self._snaps[k] for k in keys),
+                             key=lambda o: (m.namespace(o), m.name(o)))
+                if self.list_mode == "parity":
+                    want = self._scan_indexed(kind, fn, value, namespace)
+                    self._check_parity("list_indexed",
+                                       (kind, index, value, namespace),
+                                       out, want)
+                return out
+            return [copy.deepcopy(o)
+                    for o in self._scan_indexed(kind, fn, value, namespace)]
+
+    def list_owned(self, kind: str, owner_uid: str,
+                   namespace: Optional[str] = None) -> list[Obj]:
+        """Objects of ``kind`` carrying an ownerReference to ``owner_uid``
+        — the owner-pod lookup every reconcile does, without scanning the
+        namespace. Shared snapshots, sorted."""
+        with self._lock:
+            if self.list_mode != "scan":
+                keys = [k for k in self._owner_idx.get(owner_uid, ())
+                        if k[0] == kind
+                        and (namespace is None or k[1] == namespace)]
+                out = sorted((self._snaps[k] for k in keys),
+                             key=lambda o: (m.namespace(o), m.name(o)))
+                if self.list_mode == "parity":
+                    want = self._scan_owned(kind, owner_uid, namespace)
+                    self._check_parity("list_owned",
+                                       (kind, owner_uid, namespace),
+                                       out, want)
+                return out
+            return [copy.deepcopy(o)
+                    for o in self._scan_owned(kind, owner_uid, namespace)]
+
+    def _candidate_keys(self, kind: str, namespace: Optional[str],
+                        selector: Optional[dict]):
+        base = (self._ns_keys.get((kind, namespace), set())
+                if namespace is not None
+                else self._kind_keys.get(kind, set()))
+        if not base or not selector:
+            return base
+        ml = (selector.get("matchLabels", {})
+              if ("matchLabels" in selector or "matchExpressions" in selector)
+              else selector)
+        postings = [self._label_idx.get((kind, lk, str(lv)), set())
+                    for lk, lv in (ml or {}).items()]
+        if not postings:
+            return base
+        if any(not p for p in postings):
+            return set()
+        # intersect starting from the rarest posting list
+        postings.sort(key=len)
+        out = postings[0] & base
+        for p in postings[1:]:
+            out &= p
+        return out
+
+    def _indexed_list(self, kind, namespace, selector, fields) -> list[Obj]:
+        out = []
+        for k in self._candidate_keys(kind, namespace, selector):
+            obj = self._objs[k]
+            # label postings prefilter only; matchExpressions (and exact
+            # equality semantics) are re-applied so index and scan agree
+            if selector is not None and not m.match_labels(
+                    _labels_of(obj), selector):
+                continue
+            if any(str(m.get_in(obj, *path.split("."), default=""))
+                   != want for path, want in fields):
+                continue
+            out.append(self._snaps[k])
+        out.sort(key=lambda o: (m.namespace(o), m.name(o)))
+        return out
+
+    def _scan_list(self, kind, namespace, selector, fields,
+                   copy_out: bool) -> list[Obj]:
+        """The pre-index brute-force path, verbatim: scan the world, filter,
+        deepcopy each match (``copy_out=False`` skips the copies when the
+        result is only compared for parity)."""
+        out = []
+        for (kd, ns, _), obj in self._objs.items():
+            if kd != kind:
+                continue
+            if namespace is not None and ns != namespace:
+                continue
+            if selector is not None and not m.match_labels(
+                    _labels_of(obj), selector):
+                continue
+            if any(str(m.get_in(obj, *path.split("."), default=""))
+                   != want for path, want in fields):
+                continue
+            out.append(copy.deepcopy(obj) if copy_out else obj)
+        out.sort(key=lambda o: (m.namespace(o), m.name(o)))
+        return out
+
+    def _scan_indexed(self, kind, fn, value, namespace) -> list[Obj]:
+        out = [obj for k, obj in self._objs.items()
+               if k[0] == kind and (namespace is None or k[1] == namespace)
+               and str(value) in {str(v) for v in fn(obj) or ()}]
+        out.sort(key=lambda o: (m.namespace(o), m.name(o)))
+        return out
+
+    def _scan_owned(self, kind, owner_uid, namespace) -> list[Obj]:
+        out = [obj for k, obj in self._objs.items()
+               if k[0] == kind and (namespace is None or k[1] == namespace)
+               and any(r.get("uid") == owner_uid for r in _owner_refs_of(obj))]
+        out.sort(key=lambda o: (m.namespace(o), m.name(o)))
+        return out
+
+    def _check_parity(self, op: str, query, indexed: list, scanned: list):
+        if indexed != scanned:
+            got = [(m.namespace(o), m.name(o), m.resource_version(o))
+                   for o in indexed]
+            want = [(m.namespace(o), m.name(o), m.resource_version(o))
+                    for o in scanned]
+            raise IndexParityError(
+                f"index/scan divergence in {op}{query!r}: "
+                f"indexed={got} scan={want}"
+                + ("" if got != want else
+                   " (same objects, differing content — a reader mutated "
+                   "a shared snapshot)"))
+
+    # -- writes ------------------------------------------------------------
 
     def update(self, obj: Obj, subresource: Optional[str] = None) -> Obj:
         """Full replace with optimistic concurrency.
@@ -176,11 +470,16 @@ class APIServer:
         bumped); otherwise spec/meta are replaced and generation bumps when
         the spec changed.
         """
-        obj = copy.deepcopy(obj)
-        if (subresource is None and self.admission is not None
-                and self.admission.handles(m.kind(obj))):
-            obj = self.admission.admit(obj)
-        md = m.meta(obj)
+        if subresource == "status":
+            # the status path only reads metadata (RV check) and copies
+            # ``.status``; skip deepcopying the caller's whole object
+            md = obj.get("metadata") or {}
+        else:
+            obj = self._dc(obj)
+            if (self.admission is not None
+                    and self.admission.handles(m.kind(obj))):
+                obj = self.admission.admit(obj)
+            md = m.meta(obj)
         k = self._key(m.kind(obj), md.get("namespace", "default"), md.get("name", ""))
         with self._lock:
             if k not in self._objs:
@@ -192,9 +491,14 @@ class APIServer:
                     f"resourceVersion mismatch for {k}: stored {cur_rv}, "
                     f"caller supplied {md.get('resourceVersion')}")
             if subresource == "status":
-                new = copy.deepcopy(cur)
+                # copy-on-write: the new canonical object shares spec/meta
+                # subtrees with the one it replaces — committed objects are
+                # never mutated in place, so sharing between server-private
+                # versions is safe (readers get full-copy snapshots)
+                new = dict(cur)
+                new["metadata"] = dict(cur.get("metadata") or {})
                 if "status" in obj:
-                    new["status"] = obj["status"]
+                    new["status"] = self._dc(obj["status"])
                 else:
                     new.pop("status", None)
             else:
@@ -206,20 +510,25 @@ class APIServer:
                 if m.is_deleting(cur):  # deletionTimestamp is immutable once set
                     nm["deletionTimestamp"] = m.deletion_timestamp(cur)
                 if "status" not in new and "status" in cur:
-                    new["status"] = copy.deepcopy(cur["status"])
+                    # shared with the outgoing canonical version (see the
+                    # status-path comment: committed objects are frozen)
+                    new["status"] = cur["status"]
                 if new.get("spec") != cur.get("spec"):
                     nm["generation"] = m.generation(cur) + 1
                 else:
                     nm["generation"] = m.generation(cur)
             m.meta(new)["resourceVersion"] = self._next_rv()
-            self._objs[k] = copy.deepcopy(new)
-            finalizing = (m.is_deleting(new) and not m.finalizers(new))
+            # non-mutating read, and BEFORE the snapshot is cut: a
+            # setdefault here would fork canonical from snapshot
+            finalizing = (m.is_deleting(new) and not
+                          (new.get("metadata") or {}).get("finalizers"))
+            snap = self._commit(k, new)
         if finalizing:
             # last finalizer removed while deleting -> actually remove
-            self._remove(new)
+            self._remove_key(k)
         else:
-            self._emit("MODIFIED", new)
-        return copy.deepcopy(new)
+            self._emit("MODIFIED", snap)
+        return self._dc(snap)
 
     def update_status(self, obj: Obj) -> Obj:
         return self.update(obj, subresource="status")
@@ -245,38 +554,47 @@ class APIServer:
         raise Conflict(f"patch of {kind} {namespace}/{name} kept conflicting")
 
     def delete(self, kind: str, namespace: str, name: str) -> None:
+        k = self._key(kind, namespace, name)
+        snap = None
         with self._lock:
-            k = self._key(kind, namespace, name)
             if k not in self._objs:
                 raise NotFound(f"{kind} {namespace}/{name} not found")
             obj = self._objs[k]
             if m.meta(obj).get("finalizers"):
                 if not m.is_deleting(obj):
-                    m.meta(obj)["deletionTimestamp"] = _ts(self.now())
-                    m.meta(obj)["resourceVersion"] = self._next_rv()
-                    obj = copy.deepcopy(obj)
-                    self._emit("MODIFIED", obj)
-                return
-        self._remove(self.get(kind, namespace, name))
+                    # copy-on-write: commit a new object (sharing frozen
+                    # subtrees) rather than mutating the stored one under
+                    # readers' feet
+                    new = dict(obj)
+                    new["metadata"] = dict(obj.get("metadata") or {})
+                    new["metadata"]["deletionTimestamp"] = _ts(self.now())
+                    new["metadata"]["resourceVersion"] = self._next_rv()
+                    snap = self._commit(k, new)
+                if snap is None:
+                    return
+        if snap is not None:
+            self._emit("MODIFIED", snap)
+            return
+        self._remove_key(k)
 
-    def _remove(self, obj: Obj) -> None:
-        k = self._key(m.kind(obj), m.namespace(obj), m.name(obj))
+    def _remove_key(self, k) -> None:
         with self._lock:
             removed = self._objs.pop(k, None)
-        if removed is None:
-            return
-        self._emit("DELETED", removed)
+            if removed is None:
+                return
+            self._index_remove(k, removed)
+            snap = self._snaps.pop(k, None)
+            if snap is None:
+                snap = self._dc(removed)
+        self._emit("DELETED", snap)
         self._gc_dependents(removed)
 
     def _gc_dependents(self, owner: Obj) -> None:
-        """Background-policy cascading GC of controller-owned dependents."""
+        """Background-policy cascading GC of controller-owned dependents
+        (owner-UID index lookup, not a world scan)."""
         owner_uid = m.uid(owner)
         with self._lock:
-            dependents = [
-                (m.kind(o), m.namespace(o), m.name(o))
-                for o in self._objs.values()
-                if any(r.get("uid") == owner_uid for r in m.meta(o).get("ownerReferences", []) or [])
-            ]
+            dependents = list(self._owner_idx.get(owner_uid, ()))
         for kd, ns, nm in dependents:
             try:
                 self.delete(kd, ns, nm)
@@ -292,7 +610,7 @@ class APIServer:
 
     def kinds(self) -> set:
         with self._lock:
-            return {k[0] for k in self._objs}
+            return {k for k, keys in self._kind_keys.items() if keys}
 
     def __len__(self):
         with self._lock:

@@ -81,5 +81,11 @@ class Recorder:
         self.api.create(ev)
 
     def events_for(self, obj: dict) -> list:
+        """Events whose involvedObject is ``obj`` — an involved-uid index
+        lookup on the in-memory server (O(events-for-obj)); a real-cluster
+        api adapter without indexes falls back to the namespace scan."""
+        if m.uid(obj) and hasattr(self.api, "list_indexed"):
+            return self.api.list_indexed("Event", "involved-uid", m.uid(obj),
+                                         namespace=m.namespace(obj))
         return [e for e in self.api.list("Event", m.namespace(obj))
                 if e.get("involvedObject", {}).get("uid") == m.uid(obj)]

@@ -10,7 +10,15 @@ Two execution modes:
 * ``run_until_idle()`` — synchronous draining, the test mode (the reference
   tests drive reconciles by hand against the fake client; this is the same
   determinism with the routing kept honest), and
-* ``run()`` — a background thread pool for standalone operation.
+* ``run()`` — a background thread pool for standalone operation. Workers
+  block on the queue's condition variable until the next heap deadline
+  (or an ``enqueue`` notify) instead of polling on a fixed tick.
+
+Hot-path structure (docs/control-plane-perf.md): events route through
+kind→reconcilers maps built at registration (``_on_event`` never iterates
+reconcilers that cannot care), and a key that receives an event while its
+reconcile is in flight is re-queued the moment that reconcile finishes —
+not parked on a busy-spin timer.
 """
 
 from __future__ import annotations
@@ -20,11 +28,13 @@ import logging
 import threading
 import time
 import traceback
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Optional
 
 from . import meta as m
 from .apiserver import APIServer
+from ..metrics.registry import ControlPlaneMetrics
 
 log = logging.getLogger("kubedl_tpu.manager")
 
@@ -58,19 +68,34 @@ class Reconciler:
 
 
 class Manager:
-    def __init__(self, api: APIServer, clock=None):
+    def __init__(self, api: APIServer, clock=None,
+                 metrics: Optional[ControlPlaneMetrics] = None):
         self.api = api
         self._clock = clock or api.now
         self._reconcilers: list[Reconciler] = []
         self._by_kind: dict[str, list[Reconciler]] = {}
+        # event-routing maps, built at register() time so _on_event is a
+        # dict lookup instead of a scan over every reconciler
+        self._route_primary: dict[str, list[Reconciler]] = {}
+        self._route_owner: dict[str, list[Reconciler]] = {}
         self._queue: list[tuple[float, int, Request]] = []  # (ready_at, seq, req)
         self._queued: dict[Request, float] = {}  # req -> earliest ready_at queued
         self._inflight: set = set()  # keys being reconciled right now
+        self._respin: set = set()  # in-flight keys that took an event; rerun on finish
         self._seq = 0
         self._lock = threading.Condition()
         self._stopped = False
         self._max_retries_backoff = 64.0
         self._failures: dict[Request, int] = {}
+        self.metrics = metrics or ControlPlaneMetrics()
+        #: total reconciles dispatched (cheap regression guard for tests)
+        self.reconcile_count = 0
+        #: high-water mark of distinct queued keys
+        self.max_queue_depth = 0
+        #: when True, per-dispatch wall-clock latencies are appended to
+        #: ``latency_samples`` (bench_controlplane's p50/p99 source)
+        self.record_latency = False
+        self.latency_samples: deque = deque(maxlen=200_000)
         api.watch(self._on_event)
 
     # -- registration -----------------------------------------------------
@@ -78,6 +103,12 @@ class Manager:
     def register(self, rec: Reconciler):
         self._reconcilers.append(rec)
         self._by_kind.setdefault(rec.kind, []).append(rec)
+        primary = {rec.kind, *rec.watches}
+        primary.discard("")
+        for kd in primary:
+            self._route_primary.setdefault(kd, []).append(rec)
+        for kd in rec.owns:
+            self._route_owner.setdefault(kd, []).append(rec)
         return rec
 
     def watched_kinds(self) -> set:
@@ -95,19 +126,24 @@ class Manager:
 
     def _on_event(self, event_type: str, obj: dict):
         kd = m.kind(obj)
-        for rec in self._reconcilers:
-            if rec.kind == kd or kd in rec.watches:
-                # primary event, or a watched kind mapped by same ns/name
-                self.enqueue(Request(rec.kind, m.namespace(obj), m.name(obj)))
-            if kd in rec.owns:
-                # route via ANY owner ref of the matching kind, not just the
-                # controller ref: a ModelVersion is controller-owned by the
-                # job that produced it but also owned by its Model, and both
-                # owners' reconcilers need the event
-                for ref in m.meta(obj).get("ownerReferences", []) or []:
+        primary = self._route_primary.get(kd)
+        owners = self._route_owner.get(kd)
+        if not primary and not owners:
+            return
+        ns, name = m.namespace(obj), m.name(obj)
+        for rec in primary or ():
+            # primary event, or a watched kind mapped by same ns/name
+            self.enqueue(Request(rec.kind, ns, name))
+        if owners:
+            # route via ANY owner ref of the matching kind, not just the
+            # controller ref: a ModelVersion is controller-owned by the
+            # job that produced it but also owned by its Model, and both
+            # owners' reconcilers need the event
+            refs = m.meta(obj).get("ownerReferences", []) or []
+            for rec in owners:
+                for ref in refs:
                     if ref.get("kind") == rec.kind:
-                        self.enqueue(Request(rec.kind, m.namespace(obj),
-                                             ref["name"]))
+                        self.enqueue(Request(rec.kind, ns, ref["name"]))
 
     def enqueue(self, req: Request, after: float = 0.0):
         """Add with dedup. An immediate event always supersedes a pending
@@ -115,48 +151,60 @@ class Manager:
         requeue_after window must not wait out the timer — controller-runtime
         workqueue semantics)."""
         with self._lock:
-            ready_at = self._clock() + max(after, 0.0)
-            prev = self._queued.get(req)
-            if prev is not None and prev <= ready_at:
-                return  # an equal-or-sooner entry is already queued
-            self._queued[req] = ready_at
-            self._seq += 1
-            heapq.heappush(self._queue, (ready_at, self._seq, req))
-            self._lock.notify_all()
+            self._enqueue_locked(req, after)
+
+    def _enqueue_locked(self, req: Request, after: float = 0.0):
+        ready_at = self._clock() + max(after, 0.0)
+        prev = self._queued.get(req)
+        if prev is not None and prev <= ready_at:
+            return  # an equal-or-sooner entry is already queued
+        self._queued[req] = ready_at
+        self._seq += 1
+        heapq.heappush(self._queue, (ready_at, self._seq, req))
+        depth = len(self._queued)
+        if depth > self.max_queue_depth:
+            self.max_queue_depth = depth
+        self.metrics.queue_depth.set(depth)
+        self._lock.notify_all()
 
     # -- execution --------------------------------------------------------
 
     def _pop_ready(self) -> Optional[Request]:
         with self._lock:
-            deferred = []
-            try:
-                while self._queue:
-                    ready_at, _, req = self._queue[0]
-                    if self._queued.get(req) != ready_at:
-                        heapq.heappop(self._queue)  # superseded (stale) entry
-                        continue
-                    if ready_at > self._clock():
-                        return None
-                    heapq.heappop(self._queue)
-                    if req in self._inflight:
-                        # single-reconcile-per-key: another worker is on this
-                        # key right now (controller-runtime semantics — the
-                        # engine's expectations/counters rely on it); defer
-                        del self._queued[req]
-                        deferred.append(req)
-                        continue
-                    del self._queued[req]
-                    self._inflight.add(req)
-                    return req
-                return None
-            finally:
-                for d in deferred:
-                    self._seq += 1
-                    ready = self._clock() + 0.005
-                    self._queued[d] = ready
-                    heapq.heappush(self._queue, (ready, self._seq, d))
+            return self._pop_ready_locked()[0]
+
+    def _pop_ready_locked(self):
+        """Pop the next ready request, skipping stale heap entries.
+
+        Returns ``(req, None)`` when a request was claimed, ``(None, wait)``
+        when the head is scheduled ``wait`` seconds in the future, and
+        ``(None, None)`` when the queue is empty. A ready key whose
+        reconcile is still in flight moves to the respin set — it is
+        re-queued by ``_dispatch`` the moment that reconcile finishes
+        (single-reconcile-per-key, controller-runtime semantics: the
+        engine's expectations/counters rely on it)."""
+        now = self._clock()
+        while self._queue:
+            ready_at, _, req = self._queue[0]
+            if self._queued.get(req) != ready_at:
+                heapq.heappop(self._queue)  # superseded (stale) entry
+                continue
+            if ready_at > now:
+                return None, ready_at - now
+            heapq.heappop(self._queue)
+            del self._queued[req]
+            if req in self._inflight:
+                self._respin.add(req)
+                continue
+            self._inflight.add(req)
+            self.metrics.queue_depth.set(len(self._queued))
+            self.metrics.queue_inflight.set(len(self._inflight))
+            self.metrics.queue_latency.observe(max(now - ready_at, 0.0))
+            return req, None
+        return None, None
 
     def _dispatch(self, req: Request) -> None:
+        t0 = self._clock()
         try:
             for rec in self._by_kind.get(req.kind, []):
                 try:
@@ -173,8 +221,20 @@ class Manager:
                 if res and (res.requeue or res.requeue_after > 0):
                     self.enqueue(req, after=max(res.requeue_after, 0.0))
         finally:
+            elapsed = max(self._clock() - t0, 0.0)
+            self.metrics.reconciles.inc(kind=req.kind)
+            self.metrics.reconcile_latency.observe(elapsed, kind=req.kind)
             with self._lock:
+                self.reconcile_count += 1
+                if self.record_latency:
+                    self.latency_samples.append(elapsed)
                 self._inflight.discard(req)
+                self.metrics.queue_inflight.set(len(self._inflight))
+                if req in self._respin:
+                    # an event arrived mid-reconcile: the run just finished
+                    # may have read stale state, so go again now
+                    self._respin.discard(req)
+                    self._enqueue_locked(req)
 
     def run_until_idle(self, max_iterations: int = 10000,
                        include_delayed: bool = False) -> int:
@@ -206,16 +266,23 @@ class Manager:
             return len(self._queue)
 
     def run(self, workers: int = 1):
-        """Background processing loop (standalone mode)."""
+        """Background processing loop (standalone mode). Workers sleep on
+        the condition variable until the next heap deadline; ``enqueue``
+        wakes them. The wait is capped so a fake-clock advance (tests) or a
+        missed notify degrades to a 1 s tick, never a hang."""
         self._stopped = False
 
         def worker():
-            while not self._stopped:
-                req = self._pop_ready()
-                if req is None:
-                    with self._lock:
-                        self._lock.wait(timeout=0.05)
-                    continue
+            while True:
+                with self._lock:
+                    while True:
+                        if self._stopped:
+                            return
+                        req, delay = self._pop_ready_locked()
+                        if req is not None:
+                            break
+                        timeout = 1.0 if delay is None else min(delay, 1.0)
+                        self._lock.wait(timeout=timeout)
                 self._dispatch(req)
 
         threads = [threading.Thread(target=worker, daemon=True) for _ in range(workers)]

@@ -1,3 +1,4 @@
 """Prometheus-style metrics (counters/gauges/histograms + text exposition)."""
 
-from .registry import Counter, Gauge, Histogram, Registry, JobMetrics  # noqa: F401
+from .registry import (ControlPlaneMetrics, Counter, Gauge,  # noqa: F401
+                       Histogram, JobMetrics, Registry)

@@ -141,6 +141,41 @@ def _merge(lbl: str, extra: str) -> str:
     return f"{{{lbl},{extra}}}" if lbl else f"{{{extra}}}"
 
 
+#: reconcile latencies are control-plane-fast (sub-ms to seconds), not the
+#: job-launch-delay scale the default buckets cover
+_LATENCY_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+class ControlPlaneMetrics:
+    """Workqueue + reconcile instrumentation (the controller-runtime
+    workqueue/controller metric set): queue depth and in-flight gauges,
+    queue-wait and reconcile-latency histograms, dispatch counter. The
+    Manager maintains these on its hot path; ``bench_controlplane.py``
+    and the ``/metrics`` endpoint read them."""
+
+    def __init__(self, registry: Optional[Registry] = None):
+        self.registry = registry or Registry()
+        r = self.registry
+        self.queue_depth = r.gauge(
+            "kubedl_workqueue_depth",
+            "Distinct request keys waiting in the controller workqueue")
+        self.queue_inflight = r.gauge(
+            "kubedl_workqueue_inflight",
+            "Request keys being reconciled right now")
+        self.queue_latency = r.histogram(
+            "kubedl_workqueue_duration_seconds",
+            "Time from a request becoming ready to a worker claiming it",
+            buckets=_LATENCY_BUCKETS)
+        self.reconciles = r.counter(
+            "kubedl_reconciles_total",
+            "Reconcile dispatches by primary kind", ("kind",))
+        self.reconcile_latency = r.histogram(
+            "kubedl_reconcile_latency_seconds",
+            "Wall-clock latency of one reconcile dispatch",
+            ("kind",), buckets=_LATENCY_BUCKETS)
+
+
 class JobMetrics:
     """The reference's per-kind job metric set (``pkg/metrics/job_metrics.go``)."""
 

@@ -509,7 +509,7 @@ def node_for_model_output(pods) -> str:
     from ..api import common as c
     worker0, any0 = "", ""
     for pod in pods:
-        lbls = m.labels(pod)
+        lbls = m.get_labels(pod)
         node = m.get_in(pod, "spec", "nodeName", default="")
         if not node or lbls.get(c.LABEL_REPLICA_INDEX) != "0":
             continue

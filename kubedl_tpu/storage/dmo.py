@@ -252,7 +252,7 @@ def pod_to_record(pod: dict, region: str = "",
         status=status.get("phase", c.POD_PENDING),
         image=image,
         job_id=ref.get("uid", ""),
-        replica_type=m.labels(pod).get(c.LABEL_REPLICA_TYPE, ""),
+        replica_type=m.get_labels(pod).get(c.LABEL_REPLICA_TYPE, ""),
         resources=json.dumps(pod_request(pod.get("spec", {}) or {}),
                              sort_keys=True),
         restarts=restarts,

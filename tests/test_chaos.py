@@ -372,6 +372,45 @@ def test_transient_delete_errors_retried_on_scale_in(api, manager, engine):
     assert sorted(m.name(p) for p in api.list("Pod")) == ["tj-worker-0"]
 
 
+def test_preempt_without_delete_under_restart_never_fails_job(api, manager,
+                                                              engine, clock):
+    """GKE-style preemption (DisruptionTarget + Failed(143), pod left
+    visible) under restartPolicy Never: no restart path exists, so the
+    disruption must reach the normal failure accounting and fail the job —
+    not park it Running forever with a dead pod."""
+    api.create(new_test_job("tj", workers=4, restart_policy="Never",
+                            tpu_policy={"acceleratorType": "v5p-32"}))
+    reconcile(manager)
+    run_all_pods(api)
+    reconcile(manager)
+    assert st.is_running(job_status(api))
+
+    api.preempt("default", "tj-worker-2", delete=False)
+    manager.run_until_idle(include_delayed=True, max_iterations=300)
+
+    status = job_status(api)
+    assert st.is_failed(status), status.conditions
+    rs = status.replica_statuses["Worker"]
+    assert rs.failed == 1 and rs.evicted == 1
+
+
+def test_unqualified_scripted_fault_skips_exempt_event_writes(api, manager,
+                                                              engine):
+    """A kind-unqualified fail_next must land on the next *real* write, not
+    be silently burned on a best-effort Event create (which the Recorder
+    swallows, turning the scripted test into a no-op)."""
+    api.create(new_test_job("tj", workers=2))
+    # armed before the engine's first write round: the JobCreated Event is
+    # created first and must NOT consume this fault
+    api.fail_next("create", ServerError, times=1)
+    manager.run_until_idle(include_delayed=True, max_iterations=300)
+    # the fault was spent on a non-Event kind...
+    spent = [f for f in api.faults if f[0] == "create"]
+    assert spent and all(f[1] != "Event" for f in spent), api.faults
+    # ...and the engine retried through it: the job still reaches its pods
+    assert len(api.list("Pod")) == 2
+
+
 # ---------------------------------------------------------------------------
 # watch-stream chaos
 # ---------------------------------------------------------------------------

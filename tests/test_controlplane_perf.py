@@ -1,0 +1,84 @@
+"""Control-plane regression guards: reconcile-count budgets, not timers.
+
+Wall-clock assertions flake in CI, so the tier-1 guard counts *work*: an
+accidental O(N²) on the read path (every event re-enqueueing every job, a
+lost dedup, a respin busy-loop) multiplies the reconcile count long before
+it shows up in latency. ``bench_controlplane.py`` owns the timing story;
+this file just has to fail fast when the asymptotics regress.
+"""
+
+import pytest
+
+from kubedl_tpu.api.common import JobStatus
+from kubedl_tpu.controllers.registry import OperatorConfig, build_operator
+from kubedl_tpu.controllers.testing import set_pod_phase
+from kubedl_tpu.core import meta as m
+from kubedl_tpu.core.apiserver import APIServer
+from kubedl_tpu.utils import status as st
+
+pytestmark = pytest.mark.perf
+
+JOBS = 50
+REPLICAS = 4
+CONTAINER = "pytorch"
+
+
+def _job(name):
+    template = {"spec": {"containers": [{
+        "name": CONTAINER, "image": "img:v1",
+        "ports": [{"name": "pytorchjob-port", "containerPort": 23456}],
+    }]}}
+    return m.new_obj("training.kubedl.io/v1alpha1", "PyTorchJob", name,
+                     spec={"pytorchReplicaSpecs": {
+                         "Master": {"replicas": 1, "restartPolicy": "Never",
+                                    "template": template},
+                         "Worker": {"replicas": REPLICAS - 1,
+                                    "restartPolicy": "Never",
+                                    "template": template}}})
+
+
+def test_settle_50x4_within_reconcile_budget():
+    api = APIServer()
+    op = build_operator(api, OperatorConfig(workloads=["PyTorchJob"]))
+    for i in range(JOBS):
+        api.create(_job(f"guard-{i:03d}"))
+    for _ in range(50):
+        op.manager.run_until_idle(max_iterations=1_000_000)
+        pending = [p for p in api.list("Pod")
+                   if (p.get("status") or {}).get("phase",
+                                                  "Pending") != "Running"]
+        if not pending:
+            break
+        for pod in pending:
+            set_pod_phase(api, pod, "Running", container=CONTAINER)
+    op.manager.run_until_idle(max_iterations=1_000_000)
+
+    jobs = api.list("PyTorchJob")
+    assert len(jobs) == JOBS
+    assert all(st.is_running(JobStatus.from_dict(j.get("status")))
+               for j in jobs), "not every job settled to Running"
+
+    # Budget: settling one job takes a handful of reconciles (create pods,
+    # observe each flip Running, final status flush). 20 per job is ~4x the
+    # measured value — generous headroom against legitimate drift, but an
+    # O(N²) event fan-out (N jobs x N events) lands orders of magnitude over.
+    budget = JOBS * 20
+    assert op.manager.reconcile_count <= budget, (
+        f"settling {JOBS}x{REPLICAS} took {op.manager.reconcile_count} "
+        f"reconciles (budget {budget}): the control-plane hot path regressed")
+
+    # queue high-water mark stays O(jobs), not O(events)
+    assert op.manager.max_queue_depth <= JOBS * 3
+
+
+def test_metrics_exposed_for_workqueue_and_reconciles():
+    """The new gauges/histograms ride the operator's registry so /metrics
+    serves them (docs/control-plane-perf.md)."""
+    api = APIServer()
+    op = build_operator(api, OperatorConfig(workloads=["PyTorchJob"]))
+    api.create(_job("one"))
+    op.manager.run_until_idle()
+    text = op.metrics_registry.expose()
+    assert "kubedl_workqueue_depth" in text
+    assert "kubedl_reconcile_latency_seconds_bucket" in text
+    assert op.manager.metrics.reconciles.value(kind="PyTorchJob") >= 1

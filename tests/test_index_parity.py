@@ -1,0 +1,242 @@
+"""Index/scan parity: the indexed read path must be observationally
+identical to the brute-force scan it replaced.
+
+The server's ``parity`` list mode computes every ``list``/``list_indexed``/
+``list_owned`` twice — index lookup and world scan — and raises
+``IndexParityError`` on any divergence. These tests drive randomized
+create/update/patch/delete walks (including through ``ChaosAPIServer``,
+whose injected faults abort writes at every stage) with that mode on, so
+any index-maintenance bug trips the assert at the next read. Plus the
+copy-on-write contract: snapshots handed to watchers can never mutate
+server state.
+"""
+
+import random
+
+import pytest
+
+from kubedl_tpu.controllers.chaos import ChaosAPIServer, ChaosConfig
+from kubedl_tpu.core import meta as m
+from kubedl_tpu.core.apiserver import (APIServer, ApiError,
+                                       IndexParityError, NotFound)
+
+pytestmark = pytest.mark.chaos
+
+KINDS = ("Pod", "Service", "TestJob", "Event")
+NAMESPACES = ("default", "team-a", "team-b")
+LABEL_KEYS = ("app", "tier", "job-name")
+LABEL_VALUES = ("alpha", "beta", "gamma")
+
+SEEDS = (7, 20260804, 424242)
+
+
+def _random_labels(rng):
+    return {k: rng.choice(LABEL_VALUES)
+            for k in LABEL_KEYS if rng.random() < 0.6}
+
+
+def _random_selector(rng):
+    roll = rng.random()
+    if roll < 0.3:
+        return None
+    if roll < 0.6:
+        return {rng.choice(LABEL_KEYS): rng.choice(LABEL_VALUES)}
+    if roll < 0.8:
+        return {"matchLabels": {rng.choice(LABEL_KEYS):
+                                rng.choice(LABEL_VALUES)}}
+    return {"matchExpressions": [{
+        "key": rng.choice(LABEL_KEYS),
+        "operator": rng.choice(("In", "NotIn", "Exists", "DoesNotExist")),
+        "values": [rng.choice(LABEL_VALUES)],
+    }]}
+
+
+def _queries(api, rng, uids):
+    """A burst of reads; parity mode asserts index == scan inside each."""
+    for _ in range(3):
+        api.list(rng.choice(KINDS), rng.choice((None,) + NAMESPACES),
+                 _random_selector(rng))
+    if uids:
+        api.list_owned(rng.choice(KINDS), rng.choice(sorted(uids)),
+                       rng.choice((None,) + NAMESPACES))
+        api.list_indexed("Event", "involved-uid", rng.choice(sorted(uids)))
+
+
+def _walk(api, rng, steps):
+    """Randomized CRUD walk. Returns every uid ever seen."""
+    created = []  # (kind, ns, name) that have existed at some point
+    uids = set()
+    seq = 0
+    for _ in range(steps):
+        roll = rng.random()
+        try:
+            if roll < 0.35 or not created:
+                kind = rng.choice(KINDS[:3])
+                ns = rng.choice(NAMESPACES)
+                seq += 1
+                obj = m.new_obj("test/v1", kind, f"{kind.lower()}-{seq}", ns,
+                                labels=_random_labels(rng),
+                                spec={"step": seq})
+                if rng.random() < 0.2:
+                    obj["metadata"]["finalizers"] = ["test/hold"]
+                if created and rng.random() < 0.4:
+                    owner = api.try_get(*rng.choice(created))
+                    if owner is not None and m.namespace(owner) == ns:
+                        m.set_controller_ref(obj, owner)
+                out = api.create(obj)
+                created.append((m.kind(out), m.namespace(out), m.name(out)))
+                uids.add(m.uid(out))
+            elif roll < 0.55:
+                cur = api.try_get(*rng.choice(created))
+                if cur is not None:
+                    m.meta(cur)["labels"] = _random_labels(rng)
+                    if rng.random() < 0.5:
+                        cur["spec"] = {"step": seq, "mut": rng.random() < 0.5}
+                    if m.is_deleting(cur) and rng.random() < 0.7:
+                        m.meta(cur)["finalizers"] = []
+                    api.update(cur)
+            elif roll < 0.7:
+                cur = api.try_get(*rng.choice(created))
+                if cur is not None:
+                    cur["status"] = {"phase": rng.choice(
+                        ("Pending", "Running", "Succeeded"))}
+                    api.update_status(cur)
+            elif roll < 0.8:
+                kind, ns, name = rng.choice(created)
+                api.patch_merge(kind, ns, name, {"metadata": {"labels": {
+                    rng.choice(LABEL_KEYS): rng.choice(LABEL_VALUES + (None,))
+                }}})
+            else:
+                api.delete(*rng.choice(created))
+        except IndexParityError:
+            raise
+        except ApiError:
+            pass  # chaos faults / NotFound / AlreadyExists / Conflict: expected
+        _queries(api, rng, uids)
+    return uids
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_randomized_walk_parity(seed):
+    rng = random.Random(seed)
+    api = APIServer(list_mode="parity")
+    _walk(api, rng, steps=250)
+
+
+@pytest.mark.parametrize("seed", SEEDS)
+def test_randomized_walk_parity_under_chaos(seed):
+    """Same walk, through the fault-injecting proxy: writes that abort
+    before/after commit must leave the indexes exactly as consistent as
+    the store."""
+    rng = random.Random(seed)
+    inner = APIServer(list_mode="parity")
+    api = ChaosAPIServer(inner, ChaosConfig(
+        seed=seed,
+        conflict_on_status_update=0.2,
+        error_on_create=0.15,
+        error_on_delete=0.15,
+        max_faults=80,
+    ))
+    uids = _walk(api, rng, steps=250)
+    # teardown sweep: strip finalizers, delete everything, and confirm the
+    # indexes drain with the store (no leaked postings)
+    for _ in range(10):
+        for kind in inner.kinds() | {"Event"}:
+            for obj in inner.list(kind):
+                cur = inner.try_get(kind, m.namespace(obj), m.name(obj))
+                if cur is None:
+                    continue
+                if m.finalizers(cur):
+                    m.meta(cur)["finalizers"] = []
+                    try:
+                        inner.update(cur)
+                        continue
+                    except ApiError:
+                        continue
+                try:
+                    inner.delete(kind, m.namespace(cur), m.name(cur))
+                except NotFound:
+                    pass
+        if len(inner) == 0:
+            break
+    assert len(inner) == 0
+    assert not inner._kind_keys and not inner._ns_keys
+    assert not inner._label_idx and not inner._owner_idx
+    assert not inner._custom_idx and not inner._snaps
+    assert uids  # the walk actually created things
+
+
+def test_parity_detects_poisoned_snapshot():
+    """The honesty mechanism itself: a reader that mutates a shared
+    snapshot is exactly the divergence parity mode must catch."""
+    api = APIServer(list_mode="parity")
+    api.create(m.new_obj("v1", "Pod", "p0", labels={"app": "a"}))
+    [snap] = api.list("Pod")
+    snap["spec"] = {"evil": True}  # violates the frozen-snapshot contract
+    with pytest.raises(IndexParityError):
+        api.list("Pod")
+
+
+def test_watch_snapshot_cannot_mutate_server_state():
+    """Watch callbacks get shared snapshots, not the stored object: a
+    hostile handler must not be able to alter what the server returns.
+
+    Pinned to index mode: the hostile handler deliberately poisons shared
+    snapshots, which parity mode would (correctly) flag as divergence —
+    this test is about the canonical store staying untouched."""
+    api = APIServer(list_mode="index")
+
+    def hostile(event_type, obj):
+        obj["spec"] = {"hacked": True}
+        m.meta(obj)["labels"] = {"hacked": "yes"}
+        obj["status"] = {"phase": "Evil"}
+
+    api.watch(hostile)
+    api.create(m.new_obj("v1", "Pod", "p0", labels={"app": "a"},
+                         spec={"x": 1}))
+    got = api.get("Pod", "default", "p0")
+    assert got["spec"] == {"x": 1}
+    assert m.meta(got)["labels"] == {"app": "a"}
+    assert "status" not in got
+    # and the label index was built from the real labels, not the hacked ones
+    assert api.list("Pod", selector={"hacked": "yes"}) == []
+    assert len(api.list("Pod", selector={"app": "a"})) == 1
+
+    # updates emit snapshots too
+    got["spec"] = {"x": 2}
+    api.update(got)
+    again = api.get("Pod", "default", "p0")
+    assert again["spec"] == {"x": 2}
+    assert m.meta(again)["labels"] == {"app": "a"}
+
+
+def test_list_owned_and_indexed_match_scan():
+    """Spot-check the two auxiliary lookups against hand-computed truth
+    (the randomized walks cover them statistically)."""
+    api = APIServer(list_mode="parity")
+    job = api.create(m.new_obj("t/v1", "TestJob", "j1"))
+    other = api.create(m.new_obj("t/v1", "TestJob", "j2"))
+    for i in range(4):
+        pod = m.new_obj("v1", "Pod", f"j1-w-{i}")
+        m.set_controller_ref(pod, job if i < 3 else other)
+        api.create(pod)
+    assert [m.name(p) for p in api.list_owned("Pod", m.uid(job))] == [
+        "j1-w-0", "j1-w-1", "j1-w-2"]
+    assert [m.name(p) for p in api.list_owned("Pod", m.uid(other))] == [
+        "j1-w-3"]
+    assert api.list_owned("Service", m.uid(job)) == []
+
+    ev = m.new_obj("v1", "Event", "j1.1")
+    ev["involvedObject"] = {"kind": "TestJob", "name": "j1",
+                            "uid": m.uid(job)}
+    api.create(ev)
+    assert [m.name(e) for e in
+            api.list_indexed("Event", "involved-uid", m.uid(job))] == ["j1.1"]
+    assert [m.name(e) for e in
+            api.list_indexed("Event", "involved-name", "j1")] == ["j1.1"]
+    assert api.list_indexed("Event", "involved-name", "j2") == []
+
+    # ownerRef-UID index follows deletes (cascading GC included)
+    api.delete("TestJob", "default", "j1")
+    assert api.list_owned("Pod", m.uid(job)) == []
+    assert [m.name(p) for p in api.list("Pod")] == ["j1-w-3"]

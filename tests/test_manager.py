@@ -85,3 +85,66 @@ def test_dedup_same_key(api, manager):
     manager.enqueue(Request("TestJob", "default", "j1"))
     manager.run_until_idle()
     assert len(rec.calls) == 1
+
+
+def test_inflight_event_respins_when_reconcile_finishes(api, manager):
+    """An event for a key whose reconcile is in flight must not busy-spin
+    on a retry timer: it parks in the respin set and is re-queued the
+    moment the in-flight dispatch finishes (it may have read stale state)."""
+    rec = manager.register(Recording())
+    req = Request("TestJob", "default", "j1")
+    manager.enqueue(req)
+    claimed = manager._pop_ready()
+    assert claimed == req  # worker A is now reconciling j1
+
+    manager.enqueue(req)  # watch event lands mid-reconcile
+    assert manager._pop_ready() is None  # not claimable: key is in flight
+    assert req in manager._respin
+    assert req not in manager._queued  # no delayed-retry entry parked
+
+    manager._dispatch(claimed)  # worker A finishes -> immediate re-queue
+    assert req not in manager._respin
+    assert manager._pop_ready() == req  # ready NOW, no 5ms spin
+
+
+def test_event_routing_uses_kind_maps(api, manager):
+    """Routing is a dict lookup: an event for a kind no reconciler cares
+    about touches no queues, and primary/owned maps are built at register
+    time."""
+    rec = manager.register(Recording())
+    assert set(manager._route_primary) == {"TestJob"}
+    assert set(manager._route_owner) == {"Pod"}
+    api.create(m.new_obj("v1", "ConfigMap", "cm"))  # nobody watches this
+    assert manager.pending() == 0
+    job = api.create(m.new_obj("t/v1", "TestJob", "j1"))
+    pod = m.new_obj("v1", "Pod", "j1-w-0")
+    m.set_controller_ref(pod, job)
+    api.create(pod)
+    manager.run_until_idle()
+    assert rec.calls and all(r == Request("TestJob", "default", "j1")
+                             for r in rec.calls)
+
+
+def test_run_workers_block_and_wake_on_events():
+    """run() workers sleep on the condition variable and wake on enqueue:
+    an event is reconciled promptly, and a requeue_after deadline fires
+    without a poll storm."""
+    import time as _time
+
+    from kubedl_tpu.core.apiserver import APIServer
+
+    api = APIServer()  # real clock: workers sleep on it
+    manager = Manager(api)
+    rec = manager.register(Recording(result=Result(requeue_after=0.25)))
+    manager.run(workers=2)
+    try:
+        api.create(m.new_obj("t/v1", "TestJob", "j1"))
+        deadline = _time.monotonic() + 5.0
+        while len(rec.calls) < 1 and _time.monotonic() < deadline:
+            _time.sleep(0.01)
+        assert len(rec.calls) >= 1  # woken by enqueue, not a timer
+        while len(rec.calls) < 2 and _time.monotonic() < deadline:
+            _time.sleep(0.01)
+        assert len(rec.calls) >= 2  # the +0.25s heap deadline fired
+    finally:
+        manager.stop()
