@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test test-all test-fast test-chaos bench bench-controlplane dryrun crds run-standalone lint native
+.PHONY: test test-all test-fast test-chaos bench bench-controlplane bench-serving-paged dryrun crds run-standalone lint native
 
 # fast path (<3 min): everything except the compile-heavy compute suites
 # (those carry `pytestmark = pytest.mark.slow`). Chaos tests are fast and
@@ -34,6 +34,12 @@ bench:
 # control-plane-perf.md); the fast tier-1 guard is tests/test_controlplane_perf.py
 bench-controlplane:
 	JAX_PLATFORMS=cpu $(PY) bench_controlplane.py
+
+# serving capacity at a fixed KV-memory budget: paged block pool vs the
+# dense per-lane slab on a mixed-length workload -> BENCH_SERVING_PAGED.json
+# (docs/serving.md "Paged KV cache"); gate: >= 2x peak concurrency
+bench-serving-paged:
+	JAX_PLATFORMS=cpu $(PY) bench_serving_paged.py
 
 # multi-chip sharding compile+execute proof on a virtual mesh
 dryrun:

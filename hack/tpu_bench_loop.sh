@@ -12,14 +12,26 @@
 set -u
 cd "$(dirname "$0")/.."
 INTERVAL="${PROBE_INTERVAL:-900}"
+# Cached-result acceptance window, pinned HERE so it tracks the round
+# cadence this loop actually runs at (ADVICE r5: bench.py's built-in
+# 16h default could accept a previous round's artifact if cadence ever
+# shortens). Rounds run ~12h; 12h accepts anything measured within this
+# round while rejecting the previous round's artifacts. Override
+# ROUND_CADENCE_S if the cadence changes — both bench.py's age gate and
+# the stale-artifact sweep below derive from it.
+ROUND_CADENCE_S="${ROUND_CADENCE_S:-43200}"
+CACHE_MAX_AGE_S="${BENCH_TPU_CACHE_MAX_AGE_S:-$ROUND_CADENCE_S}"
+CACHE_MAX_AGE_MIN=$((CACHE_MAX_AGE_S / 60))
 # log INSIDE the repo (VERDICT r3 next #1: the attempt must be auditable
 # either way — the driver commits uncommitted files at round end, so the
 # log survives even if the round ends abruptly)
 LOG="${TPU_LOOP_LOG:-BENCH_TPU_LOOP_r04.log}"
 
 # artifacts committed by a PREVIOUS round must not suppress this round's
-# attempts: drop anything older than 16h (matches bench.py's cache age gate)
-find BENCH_TPU_CACHE.json TPU_SELFTEST.json -mmin +960 -delete 2>/dev/null
+# attempts: drop anything older than the pinned window (the same bound
+# bench.py enforces via BENCH_TPU_CACHE_MAX_AGE_S below)
+find BENCH_TPU_CACHE.json TPU_SELFTEST.json \
+  -mmin +"$CACHE_MAX_AGE_MIN" -delete 2>/dev/null
 
 selftest_complete() {
   python - <<'EOF' 2>/dev/null
@@ -39,6 +51,7 @@ while true; do
     # (hack/tpu_selftest.py rides the same connection, BENCH_RUN_SELFTEST=1)
     if BENCH_SKIP_PROBE=1 BENCH_NO_CPU_FALLBACK=1 BENCH_RUN_SELFTEST=1 \
         BENCH_HARD_DEADLINE_S=3300 \
+        BENCH_TPU_CACHE_MAX_AGE_S="$CACHE_MAX_AGE_S" \
         timeout 3400 python bench.py >/tmp/bench_tpu_out.json 2>>"$LOG"; then
       line=$(tail -1 /tmp/bench_tpu_out.json)
       # only cache a real TPU result (not a cpu fallback / failure line)

@@ -488,6 +488,70 @@ def init_cache(config: LlamaConfig, batch: int, max_len: int,
     return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
 
 
+def init_block_pool(config: LlamaConfig, num_blocks: int, block: int,
+                    dtype=None) -> dict:
+    """Paged KV pool [n_layers, num_blocks, block, n_kv_heads, hd]: one
+    shared arena of fixed-size token blocks instead of a dense per-lane
+    slab. The layer axis leads (scanned with the params, like
+    :func:`init_cache`); block 0 is conventionally the garbage sink —
+    free/dead lanes point their table entries at it, so uniform-SPMD
+    writes from inactive rows never land in a live block."""
+    c = config
+    shape = (c.n_layers, num_blocks, block, c.n_kv_heads, c.hd)
+    dt = dtype or c.dtype
+    return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+
+def paged_layer_body(tables, inner_body=None):
+    """Wrap a dense per-layer decode body (``_layer_step`` signature) so
+    its KV cache reads/writes go through a block pool.
+
+    ``tables`` [b, blocks_per_row] int32 maps each row's logical block
+    index to a physical pool block. Per layer, the rows' blocks are
+    gathered into a dense ``[b, L, nkv, hd]`` view (``L = blocks_per_row
+    * block``), the wrapped body runs UNCHANGED against that view (same
+    attention math, masks, and sliding-window slicing as the dense
+    cache), and the view is scattered back onto the pool. Rows sharing
+    blocks (copy-on-write prefixes) scatter identical bytes — the host
+    scheduler guarantees no row ever writes inside a shared block — and
+    duplicate garbage-block entries all carry causally-invisible data,
+    so the non-unique scatter is safe."""
+    inner = inner_body or _layer_step
+
+    def body(c, x, lp, kp, vp, cos, sin, start_pos, valid=None, *rest):
+        b = x.shape[0]
+        bpr = tables.shape[1]
+        blk = kp.shape[1]
+        nkv, hd = kp.shape[2], kp.shape[3]
+        kc = kp[tables].reshape(b, bpr * blk, nkv, hd)
+        vc = vp[tables].reshape(b, bpr * blk, nkv, hd)
+        x, kc, vc = inner(c, x, lp, kc, vc, cos, sin, start_pos, valid,
+                          *rest)
+        kp = kp.at[tables].set(kc.reshape(b, bpr, blk, nkv, hd))
+        vp = vp.at[tables].set(vc.reshape(b, bpr, blk, nkv, hd))
+        return x, kp, vp
+
+    return body
+
+
+def forward_step_paged(config: LlamaConfig, params: dict, tokens,
+                      pool: dict, tables, start_pos, valid=None,
+                      inner_body=None, last_pos=None,
+                      all_logits: bool = False):
+    """:func:`forward_step` against a paged KV pool: same contract, but
+    the cache operand is an ``init_block_pool`` arena plus per-row block
+    ``tables`` [b, blocks_per_row]. The gather/scatter happens INSIDE the
+    layer scan, so the transient dense view is one layer's, not the whole
+    cache's — persistent HBM is the pool, sized to live tokens rather
+    than ``rows * max_len``. The compiled program stays uniform SPMD:
+    tables are a traced operand, so growing/shrinking/sharing blocks
+    never recompiles. ``valid`` masks against the view length
+    ``blocks_per_row * block``."""
+    return forward_step(config, params, tokens, pool, start_pos, valid,
+                        layer_body=paged_layer_body(tables, inner_body),
+                        last_pos=last_pos, all_logits=all_logits)
+
+
 def attention_step(config: LlamaConfig, x, lp, kc, vc, cos, sin, start_pos,
                    valid=None, window_on=None):
     """Cache-aware attention sublayer (with residual): write this chunk's

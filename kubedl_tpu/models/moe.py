@@ -309,6 +309,7 @@ def forward(config: MoEConfig, params: dict, tokens, positions=None,
 # -- KV-cache inference path -------------------------------------------------
 
 init_cache = llama.init_cache  # cache layout is attention-only; identical
+init_block_pool = llama.init_block_pool  # paged pool layout likewise
 
 
 def _decode_layer_body(c, x, lp, kc, vc, cos, sin, start_pos, valid,
@@ -344,6 +345,18 @@ def forward_step(config: MoEConfig, params: dict, tokens, cache: dict,
     return llama.forward_step(config, params, tokens, cache, start_pos,
                               valid, layer_body=_decode_layer_body,
                               last_pos=last_pos, all_logits=all_logits)
+
+
+def forward_step_paged(config: MoEConfig, params: dict, tokens,
+                       pool: dict, tables, start_pos, valid=None,
+                       last_pos=None, all_logits: bool = False):
+    """Paged-pool decode step for the MoE stack: llama's paged driver
+    with the sparse layer body plugged in (same seam as
+    :func:`forward_step`)."""
+    return llama.forward_step_paged(
+        config, params, tokens, pool, tables, start_pos, valid,
+        inner_body=_decode_layer_body, last_pos=last_pos,
+        all_logits=all_logits)
 
 
 def loss_fn(config: MoEConfig, params: dict, tokens, targets, mask=None,

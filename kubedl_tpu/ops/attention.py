@@ -360,7 +360,14 @@ def _env_blocks(sq: int, sk: int, block_q, block_k):
     """Resolve flash block sizes. ``KUBEDL_FLASH_BQ``/``KUBEDL_FLASH_BK``
     (trace-time env, multiples of 128) override the 128/128 default so the
     v5e VMEM sweet spot can be swept on hardware without a code change;
-    invalid or non-tiling values fall back to 128."""
+    invalid or non-tiling values fall back to 128.
+
+    **Retrace required**: the env is read when a function is TRACED and
+    is NOT part of any jit cache key — changing it after a step function
+    compiled silently keeps the old block sizes. Sweep block sizes by
+    rebuilding the jitted function per candidate (``bench.py`` does
+    exactly this); re-setting the env mid-process does nothing to
+    already-compiled callables (ADVICE r5; docs/debugging.md)."""
     if block_q is None:
         block_q = _env_block("KUBEDL_FLASH_BQ", sq)
     if block_k is None:

@@ -86,8 +86,10 @@ def pipeline_apply(mesh: Mesh, stage_fn, stage_params, x, num_micro: int,
 
     # params shard on pp only; microbatches keep their (dp, fsdp) batch
     # sharding (axis 1 after the reshape) so pp composes with data axes
+    # — derived from mesh.shape so a bare ("pp",) mesh works too
     pp_spec = jax.tree.map(lambda _: P(axis_name), stage_params)
-    data_spec = P(None, ("dp", "fsdp"))
+    data_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.shape)
+    data_spec = P(None, data_axes) if data_axes else P(None)
     fn = jax.shard_map(per_device, mesh=mesh,
                        in_specs=(pp_spec, data_spec), out_specs=data_spec)
     y = fn(stage_params, xm)
@@ -290,9 +292,15 @@ def pipeline_grads_1f1b(mesh: Mesh, stage_fn, stage_params, head_params,
     T = sched.slots
     fwd_perm = [(i, (i + 1) % S) for i in range(S)]
     bwd_perm = [(i, (i - 1) % S) for i in range(S)]
-    # the batch shards over exactly these axes (data_spec below); pmean
-    # over any other axis would be rejected — nothing varies over them
-    data_axes = ("dp", "fsdp")
+    # the batch shards over exactly the data axes the mesh actually HAS
+    # (intersection with the canonical ("dp", "fsdp") pair, preserving
+    # order): a bare ("pp",)-only mesh is legal — there is then nothing
+    # to reduce over and every data-axis pmean/pcast drops out, instead
+    # of shard_map rejecting the hardcoded names (ADVICE r5).
+    data_axes = tuple(a for a in ("dp", "fsdp") if a in mesh.shape)
+
+    def dmean(x):
+        return jax.lax.pmean(x, data_axes) if data_axes else x
 
     import numpy as np
     sched_rows = jnp.asarray(
@@ -309,7 +317,8 @@ def pipeline_grads_1f1b(mesh: Mesh, stage_fn, stage_params, head_params,
         # param-tree collective per slot AND double-counted grads after
         # the final pmean. Varying primals keep cotangents local; the
         # single pmean at the end is the only cross-device reduction.
-        p0 = jax.lax.pcast(p0, data_axes, to="varying")
+        if data_axes:
+            p0 = jax.lax.pcast(p0, data_axes, to="varying")
         hp = jax.lax.pcast(hp, data_axes + (axis_name,), to="varying")
         mb_zero = jnp.zeros_like(xm[0])
         f32z = lambda t: jax.tree.map(  # noqa: E731
@@ -374,7 +383,7 @@ def pipeline_grads_1f1b(mesh: Mesh, stage_fn, stage_params, head_params,
         wire = jnp.zeros(xm.shape[1:], xm.dtype)
         init = (buf, buf, buf, f32z(p0), f32z(hp),
                 jnp.zeros((), jnp.float32), wire, wire)
-        init = jax.lax.pcast(init, ("dp", "fsdp", axis_name),
+        init = jax.lax.pcast(init, data_axes + (axis_name,),
                              to="varying")
         cols = jnp.moveaxis(rows[0], -1, 0)               # [T, 4]
         (stash, act_in, grad_in, gacc, hacc, lacc, aw, gw), _ = \
@@ -382,17 +391,15 @@ def pipeline_grads_1f1b(mesh: Mesh, stage_fn, stage_params, head_params,
         # loss lives on the last stage; head grads too — psum over pp
         # replicates both. Stage grads stay per-stage (pp-sharded) but
         # reduce over the data axes, like GSPMD would for a jax.grad.
-        loss = jax.lax.psum(lacc, axis_name)
-        loss = jax.lax.pmean(loss, data_axes)
-        hg = jax.tree.map(lambda g: jax.lax.pmean(
-            jax.lax.psum(g, axis_name), data_axes), hacc)
-        sg = jax.tree.map(lambda g: jax.lax.pmean(g, data_axes)[None],
-                          gacc)
+        loss = dmean(jax.lax.psum(lacc, axis_name))
+        hg = jax.tree.map(lambda g: dmean(jax.lax.psum(g, axis_name)),
+                          hacc)
+        sg = jax.tree.map(lambda g: dmean(g)[None], gacc)
         return loss, sg, hg
 
     pp_spec = jax.tree.map(lambda _: P(axis_name), stage_params)
     hp_spec = jax.tree.map(lambda _: P(), head_params)
-    data_spec = P(None, ("dp", "fsdp"))
+    data_spec = P(None, data_axes) if data_axes else P(None)
     aux_spec = jax.tree.map(lambda _: data_spec, aux)
     fn = jax.shard_map(
         per_device, mesh=mesh,

@@ -40,7 +40,8 @@ SERVING_API_VERSION = "serving.kubedl.io/v1alpha1"
 
 #: Morphling-style chosen config (serving/autoconfig.py
 #: ``MultiConfigResult.to_dict()["best"]`` JSON: batch/quantize/
-#: speculativeK); rendered into every predictor container's env
+#: speculativeK/kvBlock/poolBlocks); rendered into every predictor
+#: container's env
 ANNOTATION_AUTOCONFIG = "serving.kubedl.io/autoconfig"
 
 _ISTIO_GATEWAY = "kubedl-serving-gateway"
@@ -427,6 +428,13 @@ class InferenceReconciler(Reconciler):
                     str(int(chosen.get("batch", 1) or 1)),
                 "KUBEDL_SERVING_QUANTIZE": str(chosen.get("quantize") or ""),
                 "KUBEDL_SERVING_SPEC_K": str(spec_k),
+                # paged-KV geometry (0 = engine defaults): dropping
+                # these would silently lose the pool overcommit the
+                # candidate was chosen for (and its HBM-budget fit)
+                "KUBEDL_SERVING_KV_BLOCK":
+                    str(int(chosen.get("kvBlock", 0) or 0)),
+                "KUBEDL_SERVING_POOL_BLOCKS":
+                    str(int(chosen.get("poolBlocks", 0) or 0)),
             }
             if spec_k > 0:
                 env["KUBEDL_SERVING_DRAFT_PATH"] = draft

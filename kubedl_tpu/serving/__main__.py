@@ -17,6 +17,13 @@ env). Honors the autoconfig contract end to end:
 * ``KUBEDL_SERVING_TP``       — >1: tensor-parallel serving over that
   many LOCAL chips (one host's mesh; params shard by their logical
   specs, the KV cache by kv-heads). Not combinable with QUANTIZE.
+* ``KUBEDL_KV_MODE``          — KV layout: "paged" (default; block-pool
+  cache, prefix block sharing, watermark preemption), "dense" (per-lane
+  slab baseline), or "parity" (both + per-step assertions)
+* ``KUBEDL_SERVING_KV_BLOCK`` / ``KUBEDL_SERVING_POOL_BLOCKS`` — paged
+  pool geometry: tokens per block and usable block count (0 = engine
+  defaults; the pool defaults to dense capacity, shrink it to
+  overcommit lanes against real sequence lengths)
 * ``KUBEDL_SERVING_PORT``     — default 8501
 * ``KUBEDL_SERVING_WARMUP``   — default 1: compile prefill+decode with
   one tiny generation BEFORE the HTTP server binds (readiness then
@@ -52,9 +59,12 @@ import threading
 
 def build_engine(model_path: str, lanes: int, quantize: str, spec_k: int,
                  draft_path: str = "", max_len: int = 1024, tp: int = 1,
-                 eos_id: int = -1, tokenizer_vocab: int = 0):
+                 eos_id: int = -1, tokenizer_vocab: int = 0,
+                 kv_block: int = 0, pool_blocks: int = 0):
     """The ONE env-to-engine mapping (also used by tests): returns a
-    started engine honoring the autoconfig candidate."""
+    started engine honoring the autoconfig candidate. ``kv_block`` /
+    ``pool_blocks`` (0 = engine defaults) size the paged KV pool; the
+    layout itself is ``$KUBEDL_KV_MODE`` (paged by default)."""
     from ..models.io import load_model
     from .engine import GenerateConfig
 
@@ -78,6 +88,11 @@ def build_engine(model_path: str, lanes: int, quantize: str, spec_k: int,
                 "devices")
         mesh = build_mesh(MeshConfig(dp=1, fsdp=1, tp=tp), devices[:tp])
     from .batching import ContinuousBatchingEngine
+    kv_kwargs = {}
+    if kv_block:
+        kv_kwargs["kv_block"] = kv_block
+    if pool_blocks:
+        kv_kwargs["pool_blocks"] = pool_blocks
     if spec_k > 0:
         if not draft_path:
             raise ValueError("KUBEDL_SERVING_SPEC_K > 0 needs "
@@ -92,11 +107,12 @@ def build_engine(model_path: str, lanes: int, quantize: str, spec_k: int,
             config, params, lanes=lanes, max_len=max_len,
             gen=GenerateConfig(max_len=max_len, eos_id=eos_id),
             quantize=quantize or None, draft_config=dcfg,
-            draft_params=dparams, spec_k=spec_k, mesh=mesh).start()
+            draft_params=dparams, spec_k=spec_k, mesh=mesh,
+            **kv_kwargs).start()
     return ContinuousBatchingEngine(
         config, params, lanes=lanes, max_len=max_len,
         gen=GenerateConfig(max_len=max_len, eos_id=eos_id),
-        quantize=quantize or None, mesh=mesh).start()
+        quantize=quantize or None, mesh=mesh, **kv_kwargs).start()
 
 
 def run_batch(engine, tokenizer, in_path: str, out_path: str,
@@ -173,6 +189,9 @@ def main(argv=None) -> int:
     draft = os.environ.get("KUBEDL_SERVING_DRAFT_PATH", "")
     max_len = int(os.environ.get("KUBEDL_SERVING_MAX_LEN", "1024") or 1024)
     tp = int(os.environ.get("KUBEDL_SERVING_TP", "1") or 1)
+    kv_block = int(os.environ.get("KUBEDL_SERVING_KV_BLOCK", "0") or 0)
+    pool_blocks = int(os.environ.get("KUBEDL_SERVING_POOL_BLOCKS", "0")
+                      or 0)
     from ..tokenizer import has_tokenizer_assets, load_tokenizer
     tok_spec = os.environ.get("KUBEDL_TOKENIZER", "")
     if not tok_spec and has_tokenizer_assets(model_path):
@@ -186,7 +205,8 @@ def main(argv=None) -> int:
                           eos_id=(tokenizer.eos_id if tokenizer is not None
                                   else -1),
                           tokenizer_vocab=(tokenizer.vocab_size
-                                           if tokenizer is not None else 0))
+                                           if tokenizer is not None else 0),
+                          kv_block=kv_block, pool_blocks=pool_blocks)
     if args.batch_input:
         try:
             return run_batch(engine, tokenizer, args.batch_input,

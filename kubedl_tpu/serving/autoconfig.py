@@ -83,6 +83,13 @@ class Candidate:
     batch: int = 1                    # continuous-batching lanes
     quantize: Optional[str] = None    # target weights: None|"int8"|"int4"
     speculative_k: int = 0            # 0 = off; >0 = draft lookahead
+    #: paged-KV block size in tokens (0 = engine default); the pool is
+    #: the HBM knob — smaller pools admit fewer concurrent prompts,
+    #: bigger ones trade weight/activation headroom for cache
+    kv_block: int = 0
+    #: usable pool blocks (0 = lanes * ceil(max_len/block), the
+    #: dense-capacity default — no overcommit)
+    pool_blocks: int = 0
 
     def to_env(self) -> dict:
         """Env contract the predictor container reads at startup."""
@@ -90,7 +97,24 @@ class Candidate:
             "KUBEDL_SERVING_LANES": str(self.batch),
             "KUBEDL_SERVING_QUANTIZE": self.quantize or "",
             "KUBEDL_SERVING_SPEC_K": str(self.speculative_k),
+            "KUBEDL_SERVING_KV_BLOCK": str(self.kv_block),
+            "KUBEDL_SERVING_POOL_BLOCKS": str(self.pool_blocks),
         }
+
+
+def kv_cache_bytes(config, cand: Candidate, max_len: int) -> int:
+    """The candidate's KV-cache HBM footprint. Paged serving is sized in
+    BLOCKS, not ``lanes * max_len``: the pool (plus its one garbage
+    block) is the allocation, however many lanes share it — that is the
+    whole point of paging, lanes stop being an HBM commitment. Dense
+    sizing (kv_block == 0 with no pool) falls out as the
+    no-overcommit case."""
+    from .batching import fit_block
+    from .engine import kv_bytes_per_token
+    block = fit_block(cand.kv_block or 64, max_len)
+    bpl = max_len // block
+    blocks = (cand.pool_blocks or cand.batch * bpl) + 1
+    return blocks * block * kv_bytes_per_token(config)
 
 
 @dataclass(frozen=True)
@@ -136,7 +160,9 @@ class MultiConfigResult:
     def to_dict(self) -> dict:
         return {"best": {"batch": self.best.batch,
                          "quantize": self.best.quantize,
-                         "speculativeK": self.best.speculative_k},
+                         "speculativeK": self.best.speculative_k,
+                         "kvBlock": self.best.kv_block,
+                         "poolBlocks": self.best.pool_blocks},
                 "probe": self.best_probe,
                 "measurements": self.measurements}
 
@@ -177,6 +203,11 @@ def probe_candidate(model, cand: Candidate, prompt_len: int = 64,
     prompt = rng.integers(1, cfg.vocab_size, prompt_len).tolist()
 
     from .batching import ContinuousBatchingEngine
+    kv = {}
+    if cand.kv_block:
+        kv["kv_block"] = cand.kv_block
+    if cand.pool_blocks:
+        kv["pool_blocks"] = cand.pool_blocks
     if cand.speculative_k > 0:
         if draft is None:
             return None  # speculative points need a draft model
@@ -186,11 +217,11 @@ def probe_candidate(model, cand: Candidate, prompt_len: int = 64,
         eng = ContinuousBatchingEngine(
             cfg, params, lanes=cand.batch, max_len=max_len,
             quantize=cand.quantize, draft_config=draft[0],
-            draft_params=draft[1], spec_k=cand.speculative_k)
+            draft_params=draft[1], spec_k=cand.speculative_k, **kv)
     else:
         eng = ContinuousBatchingEngine(cfg, params, lanes=cand.batch,
                                        max_len=max_len,
-                                       quantize=cand.quantize)
+                                       quantize=cand.quantize, **kv)
 
     def gen(n):
         return eng.run([(prompt, n)] * cand.batch)
@@ -219,6 +250,7 @@ def probe_candidate(model, cand: Candidate, prompt_len: int = 64,
     return {
         "batch": cand.batch, "quantize": cand.quantize or "",
         "speculative_k": cand.speculative_k,
+        "kv_block": cand.kv_block, "pool_blocks": cand.pool_blocks,
         "decode_tokens_per_s": round(tps, 2),
         "p50_latency_ms": round(
             1000 * sorted(samples)[len(samples) // 2], 3),
@@ -232,26 +264,42 @@ def autoconfigure_multi(
         batches: Sequence[int] = (1, 2, 4, 8),
         quantize_opts: Sequence[Optional[str]] = (None, "int8"),
         spec_ks: Sequence[int] = (0, 4),
+        kv_blocks: Sequence[int] = (0,),
         prompt_len: int = 64, new_tokens: int = 16,
         slo: Optional[ServingSLO] = None,
         measure: Optional[Callable[[Candidate], Optional[dict]]] = None,
+        hbm_budget_bytes: Optional[int] = None,
+        max_len: int = 0,
 ) -> MultiConfigResult:
-    """Search {batch x int8 x speculative-k} under the SLO.
+    """Search {batch x int8 x speculative-k x kv-block} under the SLO.
 
     ``measure`` defaults to :func:`probe_candidate` over live engines
     built from ``model``/``draft``; tests (and remote probers) may inject
-    their own. Within each (quantize, k) family the batch dimension keeps
-    Morphling's unimodal early-stop: once throughput drops well below the
-    family's best, bigger batches only add latency. Selection: the
+    their own. Within each (quantize, k, block) family the batch
+    dimension keeps Morphling's unimodal early-stop: once throughput
+    drops well below the family's best, bigger batches only add latency.
+    ``hbm_budget_bytes`` prunes candidates whose KV footprint exceeds
+    the cache budget BEFORE probing — and the footprint is the
+    block-pool model (:func:`kv_cache_bytes`), not ``lanes * max_len``:
+    under paging, big lane counts stay searchable as long as the pool
+    fits, which is exactly where the paged engine wins. Selection: the
     highest-throughput candidate meeting the SLO; if none do, the
     least-violating one (Morphling's nearest-feasible fallback)."""
     slo = slo or ServingSLO()
+    if hbm_budget_bytes is not None and model is None:
+        # the budget prunes via kv_cache_bytes(model[0], ...): without a
+        # model config it would be silently ignored and over-budget
+        # candidates could win — refuse loudly instead
+        raise ValueError(
+            "hbm_budget_bytes needs a (config, params) model to price "
+            "candidates (pass model= even with a custom measure fn)")
     if measure is None:
         if model is None:
             raise ValueError("need a (config, params) model or a measure fn")
         measure = lambda c: probe_candidate(        # noqa: E731
             model, c, prompt_len=prompt_len, new_tokens=new_tokens,
-            draft=draft)
+            max_len=max_len, draft=draft)
+    budget_len = max_len or prompt_len + new_tokens + 8
 
     measurements = []
     best: Optional[Candidate] = None
@@ -259,27 +307,33 @@ def autoconfigure_multi(
     fallback, fb_probe, fb_viol = None, None, math.inf
     for q in quantize_opts:
         for k in spec_ks:
-            family_best = -1.0
-            for b in batches:
-                cand = Candidate(batch=b, quantize=q, speculative_k=k)
-                if not slo.allows(cand):
-                    continue
-                probe = measure(cand)
-                if probe is None:
-                    continue   # unbuildable point (no draft, multi-lane k)
-                measurements.append(probe)
-                tps = probe["decode_tokens_per_s"]
-                if slo.met_by(probe):
-                    if best_probe is None or \
-                            tps > best_probe["decode_tokens_per_s"]:
-                        best, best_probe = cand, probe
-                else:
-                    v = slo.violation(probe)
-                    if v < fb_viol:
-                        fallback, fb_probe, fb_viol = cand, probe, v
-                if family_best > 0 and tps < family_best * 0.9:
-                    break   # past saturation in this family
-                family_best = max(family_best, tps)
+            for blk in kv_blocks:
+                family_best = -1.0
+                for b in batches:
+                    cand = Candidate(batch=b, quantize=q, speculative_k=k,
+                                     kv_block=blk)
+                    if not slo.allows(cand):
+                        continue
+                    if hbm_budget_bytes is not None \
+                            and kv_cache_bytes(model[0], cand,
+                                               budget_len) > hbm_budget_bytes:
+                        continue   # cache alone busts the HBM budget
+                    probe = measure(cand)
+                    if probe is None:
+                        continue   # unbuildable (no draft, multi-lane k)
+                    measurements.append(probe)
+                    tps = probe["decode_tokens_per_s"]
+                    if slo.met_by(probe):
+                        if best_probe is None or \
+                                tps > best_probe["decode_tokens_per_s"]:
+                            best, best_probe = cand, probe
+                    else:
+                        v = slo.violation(probe)
+                        if v < fb_viol:
+                            fallback, fb_probe, fb_viol = cand, probe, v
+                    if family_best > 0 and tps < family_best * 0.9:
+                        break   # past saturation in this family
+                    family_best = max(family_best, tps)
     if best is None:
         # nothing met the SLO: surface the least-bad config rather than
         # guessing (the caller sees the probe and the violation)

@@ -19,6 +19,23 @@ vLLM-style recipe, shaped for TPU:
 * scheduling (arrivals, eos, lane reuse) is host-side Python between
   ticks, exactly where dynamic control flow belongs on TPU.
 
+**Paged KV (the default, ``KUBEDL_KV_MODE=paged``)**: instead of a dense
+``max_len`` slab per lane, KV lives in ONE pool of fixed-size token
+blocks (``models.llama.init_block_pool``) indexed through per-lane
+host-side block tables that grow on demand. Block tables are a traced
+operand of the same jitted steps (gather on the block axis), so the
+compiled program stays uniform SPMD while HBM tracks *live tokens*, not
+``lanes * max_len``. Registered prefixes pin their full blocks once and
+every matching request's table references them (copy-on-write sharing
+with refcounts — a lane's own writes always land in fresh private
+blocks); admission requires free blocks for the prompt plus headroom,
+and when the pool runs dry mid-decode the lowest-progress lane is
+preempted back to the queue (resumed later by re-prefilling prompt +
+generated-so-far) instead of OOMing. ``KUBEDL_KV_MODE=dense`` keeps the
+original slab; ``parity`` runs both and asserts token-identical logits
+every step — how the test suite keeps the paged rewrite honest
+(mirroring the control plane's ``KUBEDL_LIST_MODE`` pattern).
+
 The reference operator serves models via fixed Deployments
 (``controllers/serving``); request-level scheduling like this has no
 reference analog — TPU-native capability beyond parity.
@@ -53,6 +70,113 @@ def _pow2_floor(n: int) -> int:
     return 1 << (max(n, 1).bit_length() - 1)
 
 
+ENV_KV_MODE = "KUBEDL_KV_MODE"
+KV_MODES = ("dense", "paged", "parity")
+
+
+def resolve_kv_mode(mode: Optional[str] = None) -> str:
+    """KV layout mode: explicit arg wins, then ``$KUBEDL_KV_MODE``, then
+    the paged default. ``dense`` keeps the per-lane slab (the baseline
+    the bench compares against); ``parity`` runs both and asserts
+    token-identical logits each step."""
+    import os
+    mode = mode or os.environ.get(ENV_KV_MODE, "") or "paged"
+    if mode not in KV_MODES:
+        raise ValueError(
+            f"unknown KV mode {mode!r}; one of {KV_MODES}")
+    return mode
+
+
+def fit_block(block: int, max_len: int) -> int:
+    """Largest block size <= ``block`` that divides ``max_len`` (halving
+    search, floor 1). Divisibility makes the paged gather view EXACTLY
+    ``max_len`` slots, so parity mode's logits are bit-comparable to the
+    dense slab (same reduction lengths, same masked tail)."""
+    b = max(int(block), 1)
+    while max_len % b:
+        b //= 2
+    return max(b, 1)
+
+
+class BlockPool:
+    """Host-side allocator for the paged KV pool.
+
+    Physical block ids run ``1..total`` — id 0 is the reserved garbage
+    sink every free table entry points at (dead lanes keep computing
+    under uniform SPMD; their writes must land somewhere that is never
+    attendable). Blocks are refcounted so registered prefixes can pin
+    blocks that many lanes reference concurrently: ``alloc`` starts a
+    block at refcount 1, ``incref`` adds a sharer, ``decref`` returns
+    the block to the free list at zero. ``allocs`` counts lifetime block
+    allocations — the budget the tier-1 perf guard asserts on (work
+    counters, not wall clocks)."""
+
+    def __init__(self, total: int):
+        if total < 1:
+            raise ValueError(f"pool needs >= 1 usable block, got {total}")
+        self.total = total
+        # pop() hands out low ids first
+        self._free = list(range(total, 0, -1))
+        self._ref: dict[int, int] = {}
+        self.allocs = 0
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_count(self) -> int:
+        return self.total - len(self._free)
+
+    @property
+    def shared_count(self) -> int:
+        """Blocks referenced by more than one holder (prefix sharing)."""
+        return sum(1 for r in self._ref.values() if r > 1)
+
+    def alloc(self, n: int) -> Optional[list]:
+        """n fresh blocks at refcount 1, or None when the pool is dry
+        (all-or-nothing: a partial grant would leak on the retry path)."""
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        if n > len(self._free):
+            return None
+        out = [self._free.pop() for _ in range(n)]
+        for b in out:
+            self._ref[b] = 1
+        self.allocs += n
+        return out
+
+    def incref(self, blocks: Sequence[int]) -> None:
+        for b in blocks:
+            self._ref[b] += 1
+
+    def decref(self, blocks: Sequence[int]) -> None:
+        for b in blocks:
+            r = self._ref[b] - 1
+            if r:
+                self._ref[b] = r
+            else:
+                del self._ref[b]
+                self._free.append(b)
+
+    def refcounts(self) -> dict:
+        """Live block -> refcount snapshot (leak checks)."""
+        return dict(self._ref)
+
+
+@dataclass(frozen=True)
+class _Prefix:
+    """One registered prompt prefix. ``stored`` is the dense-mode full
+    KV copy (legacy ``_load_prefix`` path); ``blocks`` are the paged
+    pool blocks pinned for the prefix's FULL blocks only — the partial
+    tail block is never shared (two lanes would write different tokens
+    into it), it is re-prefilled per lane instead."""
+    key: tuple
+    plen: int
+    stored: Optional[dict] = None
+    blocks: tuple = ()
+
+
 @dataclass
 class Request:
     """One in-flight generation; ``done`` fires when ``tokens`` is final
@@ -77,6 +201,11 @@ class Request:
     #: client-requested stop (set via :meth:`cancel`): the scheduler
     #: frees the lane at its next tick; tokens decoded so far remain
     cancel_requested: bool = False
+    #: scheduler-reported failure (e.g. a request that can never be
+    #: admitted because the KV pool is too small after prefix pins) —
+    #: surfaces through result()/stream() instead of the generic
+    #: engine-stopped message
+    error: Optional[str] = None
     _cond: threading.Condition = field(default_factory=threading.Condition)
 
     def cancel(self) -> None:
@@ -89,7 +218,8 @@ class Request:
         if not self.done.wait(timeout):
             raise TimeoutError("generation did not finish in time")
         if self.cancelled:
-            raise RuntimeError("generation cancelled: engine stopped")
+            raise RuntimeError(
+                self.error or "generation cancelled: engine stopped")
         return self.tokens
 
     def stream(self, timeout: Optional[float] = None):
@@ -119,7 +249,7 @@ class Request:
             if finished:
                 if cancelled:
                     raise RuntimeError(
-                        "generation cancelled: engine stopped")
+                        self.error or "generation cancelled: engine stopped")
                 return
 
     # -- scheduler-side helpers (single writer: the scheduler thread) ----
@@ -143,11 +273,16 @@ class _Lane:
     request: Optional[Request] = None    # None = free
     pos: int = 0               # next write position (== tokens so far)
     remaining: int = 0
+    #: paged modes: pool blocks this lane references, in logical order
+    #: (shared prefix blocks first, then private). Freed via decref when
+    #: the lane finishes/cancels/preempts.
+    blocks: list = field(default_factory=list)
 
     def reset(self) -> None:
         self.request = None
         self.pos = 0
         self.remaining = 0
+        self.blocks = []
 
 
 class ContinuousBatchingEngine:
@@ -163,7 +298,10 @@ class ContinuousBatchingEngine:
                  gen: Optional[GenerateConfig] = None,
                  quantize: Optional[str] = None, seed: int = 0,
                  mesh=None, draft_config=None, draft_params=None,
-                 spec_k: int = 0, quantize_draft: Optional[str] = None):
+                 spec_k: int = 0, quantize_draft: Optional[str] = None,
+                 kv_mode: Optional[str] = None, kv_block: int = 64,
+                 pool_blocks: Optional[int] = None,
+                 headroom_blocks: int = 1):
         from .engine import (SpecStats, init_mesh_serving, resolve_family,
                              sample_logits)
         self.config = config
@@ -172,6 +310,32 @@ class ContinuousBatchingEngine:
         self.max_len = max_len
         self.gen = gen or GenerateConfig(max_len=max_len)
         self.mesh = mesh
+        #: KV layout: "paged" (default), "dense" (per-lane slab
+        #: baseline), or "parity" (both, asserted token-identical)
+        self.kv_mode = resolve_kv_mode(kv_mode)
+        #: tokens per pool block, clamped so it divides max_len (keeps
+        #: the gather view exactly max_len slots — see fit_block)
+        self.kv_block = fit_block(kv_block, max_len)
+        self._bpl = max_len // self.kv_block   # table entries per lane
+        #: usable pool blocks (the garbage sink rides on top); the
+        #: default matches the dense slab's capacity so plain
+        #: deployments behave identically — shrink it to overcommit
+        #: lanes against actual sequence lengths (the paged win)
+        self.pool_blocks = (int(pool_blocks) if pool_blocks
+                            else lanes * self._bpl)
+        if self.pool_blocks < self._bpl:
+            raise ValueError(
+                f"pool_blocks {self.pool_blocks} < {self._bpl} blocks "
+                f"needed for one full-length request (max_len {max_len} "
+                f"/ block {self.kv_block})")
+        #: admission watermark: free blocks required beyond the prompt's
+        #: so a fresh lane can decode a while before growing
+        self.headroom_blocks = max(int(headroom_blocks), 0)
+        #: lifetime preemption count (pool ran dry; /metrics counter)
+        self.preempted = 0
+        #: peak simultaneously-active lanes (the bench's concurrency
+        #: number; admission caps it by blocks, not just lane count)
+        self.peak_active = 0
         # tensor-parallel serving over a local mesh (one host's chips):
         # params by logical specs, cache by kv-heads; the jitted steps
         # are unchanged — GSPMD inserts the collectives.
@@ -236,12 +400,42 @@ class ContinuousBatchingEngine:
         _decode = make_decode(cfg, family)
         _prefill = make_prefill(cfg, family)
 
+        def make_decode_paged(cfg_, fam):
+            @partial(jax.jit, donate_argnums=(1,))
+            def _decode_p(params, pool, tokens, positions, tables):
+                # the pool is donated like the dense cache (decode is
+                # HBM-bound); tables are traced so block growth /
+                # sharing never recompiles
+                return fam.forward_step_paged(cfg_, params, tokens, pool,
+                                              tables, positions)
+            return _decode_p
+
+        def make_prefill_paged(cfg_, fam):
+            @partial(jax.jit, donate_argnums=(1,))
+            def _prefill_p(params, pool, tokens, table_row, start, n_real):
+                # tokens [1, bucket] right-padded; table_row [bpl] is the
+                # ONE lane's block map (host-grown before the call).
+                # Same bucket-shape compile story as the dense prefill.
+                blk = pool["k"].shape[2]
+                view = table_row.shape[0] * blk
+                valid = (jnp.arange(view) < start + n_real)[None, :]
+                return fam.forward_step_paged(
+                    cfg_, params, tokens, pool, table_row[None, :], start,
+                    valid=valid, last_pos=n_real - 1)
+            return _prefill_p
+
         @partial(jax.jit, donate_argnums=(1,))
         def _spec_verify(params, cache, tokens, positions):
             # tokens [lanes, k+1] at per-row positions: ONE target pass
             # verifies every lane's draft chunk (all-position logits)
             return family.forward_step(cfg, params, tokens, cache,
                                        positions, all_logits=True)
+
+        @partial(jax.jit, donate_argnums=(1,))
+        def _spec_verify_paged(params, pool, tokens, positions, tables):
+            return family.forward_step_paged(cfg, params, tokens, pool,
+                                             tables, positions,
+                                             all_logits=True)
 
         @partial(jax.jit)
         def _fill_prefix(params, tokens, plen):
@@ -268,7 +462,7 @@ class ContinuousBatchingEngine:
         self._prefill = _prefill
         self._fill_prefix = _fill_prefix
         self._load_prefix = _load_prefix
-        self._prefixes: list = []   # (tokens tuple, stored kv, plen)
+        self._prefixes: list = []   # sorted [_Prefix], longest first
         self._sample = sample_logits
         if self.spec_k:
             self._d_decode = make_decode(self.dcfg, self.dfam)
@@ -280,10 +474,20 @@ class ContinuousBatchingEngine:
             #: allocated at admission (seed + admission ordinal)
             self._spec_admitted = 0
 
-        # live scheduler state: one shared cache + lane bookkeeping; the
-        # host mirrors (cur/pos) feed the per-tick decode call
-        self._cache = self._place_cache(
-            family.init_cache(config, lanes, max_len))
+        # live scheduler state: one shared cache (dense slab and/or
+        # paged pool per kv_mode) + lane bookkeeping; the host mirrors
+        # (cur/pos/tables) feed the per-tick decode call
+        if self.kv_mode in ("dense", "parity"):
+            self._cache = self._place_cache(
+                family.init_cache(config, lanes, max_len))
+        if self.kv_mode in ("paged", "parity"):
+            self._pool = self._place_cache(family.init_block_pool(
+                config, self.pool_blocks + 1, self.kv_block))
+            self._bpool = BlockPool(self.pool_blocks)
+            self._tables = np.zeros((lanes, self._bpl), np.int32)
+            self._decode_p = make_decode_paged(cfg, family)
+            self._prefill_p = make_prefill_paged(cfg, family)
+            self._spec_verify_p = _spec_verify_paged
         self._lane_state = [_Lane() for _ in range(lanes)]
         self._cur = np.zeros((lanes, 1), np.int32)
         self._pos = np.zeros((lanes,), np.int32)
@@ -302,11 +506,18 @@ class ContinuousBatchingEngine:
 
     def register_prefix(self, tokens: Sequence[int],
                         max_prefixes: Optional[int] = None) -> None:
-        """Prefill a shared prompt prefix ONCE and stash its KV block;
-        later requests whose prompts start with it load the block into
-        their lane and prefill only the suffix — the standard
+        """Prefill a shared prompt prefix ONCE; later requests whose
+        prompts start with it skip re-prefilling it — the standard
         system-prompt optimization. Greedy outputs are unchanged (the
-        loaded KV is exactly what the full prefill would have written)."""
+        shared KV is exactly what the full prefill would have written).
+
+        Dense mode stashes a full KV copy that ``_load_prefix`` writes
+        into each matching lane. Paged modes pin the prefix's FULL
+        blocks in the pool instead: matching lanes point their block
+        tables at them (refcounted copy-on-write sharing, no device
+        copy at admission); the partial tail block — where a lane's own
+        tokens would land next to prefix tokens — is never shared and
+        is re-prefilled per lane."""
         tokens = list(tokens)
         if not tokens:
             raise ValueError("empty prefix")
@@ -316,7 +527,7 @@ class ContinuousBatchingEngine:
                 f"prefix {plen} exceeds cache capacity {self.max_len}")
         key = tuple(tokens)
         if max_prefixes is not None and \
-                not any(p[0] == key for p in self._prefixes) and \
+                not any(p.key == key for p in self._prefixes) and \
                 len(self._prefixes) >= max_prefixes:
             # optimistic pre-check: a rejected registration must not
             # first burn a full device prefill (the authoritative check
@@ -324,16 +535,18 @@ class ContinuousBatchingEngine:
             raise ValueError(
                 f"prefix limit {max_prefixes} reached "
                 "(each prefix pins a KV block in HBM)")
-        bucket = min(_bucket(plen), self.max_len)
-        toks = np.zeros((1, bucket), np.int32)
-        toks[0, :plen] = tokens
-        stored = self._fill_prefix(self.params, jnp.asarray(toks),
-                                   jnp.int32(plen))
+        stored = None
+        if self.kv_mode == "dense":
+            bucket = min(_bucket(plen), self.max_len)
+            toks = np.zeros((1, bucket), np.int32)
+            toks[0, :plen] = tokens
+            stored = self._fill_prefix(self.params, jnp.asarray(toks),
+                                       jnp.int32(plen))
         with self._sched_lock:
             # dedup (re-registering replaces) + longest-first ordering so
             # the best match wins during admission; swap in a NEW list so
             # concurrent _match_prefix iterations never see a mid-sort view
-            entries = [p for p in self._prefixes if p[0] != key]
+            entries = [p for p in self._prefixes if p.key != key]
             # cap enforced HERE, under the lock: a server-side
             # check-then-call would race concurrent registrations past
             # the limit, and an idempotent re-register (key already
@@ -342,27 +555,121 @@ class ContinuousBatchingEngine:
                 raise ValueError(
                     f"prefix limit {max_prefixes} reached "
                     "(each prefix pins a KV block in HBM)")
-            entries.append((key, stored, plen))
-            entries.sort(key=lambda p: -p[2])
+            blocks: tuple = ()
+            if self.kv_mode != "dense":
+                # release a replaced pin BEFORE allocating the new one:
+                # an idempotent re-register must never need net-new
+                # blocks (on a tight pool, alloc-then-decref would
+                # refuse a same-key refresh that frees as much as it
+                # takes). The entry list is swapped in first so a failed
+                # re-fill can never leave a registered entry pointing at
+                # freed blocks — the old registration is simply gone.
+                for old in self._prefixes:
+                    if old.key == key and old.blocks:
+                        self._bpool.decref(old.blocks)
+                self._prefixes = entries
+                # KV at position p depends only on tokens <= p, so the
+                # shareable full blocks need exactly the first
+                # n_full*block tokens prefilled — the tail is per-lane
+                n_full = plen // self.kv_block
+                if n_full:
+                    got = self._bpool.alloc(n_full)
+                    if got is None:
+                        raise ValueError(
+                            f"KV pool exhausted: prefix needs {n_full} "
+                            f"blocks, {self._bpool.free_count} free")
+                    blocks = tuple(got)
+                    try:
+                        self._fill_prefix_blocks(
+                            blocks, tokens[:n_full * self.kv_block])
+                    except BaseException:
+                        # _prefill_p donates the LIVE pool (unlike the
+                        # dense _fill_prefix, which runs on a scratch
+                        # buffer): an abort mid-fill may have consumed
+                        # it AND strands `got` at refcount 1 with no
+                        # owner. Same remedy as a failed inline step —
+                        # rebuild pool + allocator + surviving pins
+                        # (we hold _sched_lock, as _recover_locked
+                        # requires).
+                        self._recover_locked()
+                        raise
+            entries = entries + [_Prefix(key=key, plen=plen,
+                                         stored=stored, blocks=blocks)]
+            entries.sort(key=lambda p: -p.plen)
             self._prefixes = entries
+
+    def _chunked_prefill(self, step, seq: list, start: int):
+        """THE chunking rule, shared by every prefill path (dense lane,
+        paged lane, prefix fill, draft): feed ``seq[start:]`` through
+        ``step(toks [1, bucket] np.int32, pos0, n) -> logits`` in
+        right-padded power-of-two chunks that fit the remaining cache
+        space. That clamp is load-bearing twice over: it keeps the
+        compiled-shape set fixed AND never lets a padded chunk run past
+        the cache end (jax clamps a too-far dynamic_update_slice start,
+        which would overwrite just-loaded prefix slots). Returns the
+        last chunk's logits. validate() guarantees the fit."""
+        logits = None
+        pos0, remaining = start, list(seq[start:])
+        while remaining:
+            space = self.max_len - pos0
+            bucket = min(_bucket(len(remaining)), _pow2_floor(space))
+            n = min(len(remaining), bucket)
+            chunk, remaining = remaining[:n], remaining[n:]
+            toks = np.zeros((1, bucket), np.int32)
+            toks[0, :n] = chunk
+            logits = step(toks, pos0, n)
+            pos0 += n
+        return logits
+
+    def _fill_prefix_blocks(self, blocks: Sequence[int],
+                            tokens: list) -> None:
+        """Chunk-prefill ``tokens`` into ``blocks`` through a scratch
+        table row (caller holds ``_sched_lock``; the pool is donated
+        through ``_prefill_p`` like every other step)."""
+        row = np.zeros((self._bpl,), np.int32)
+        row[:len(blocks)] = blocks
+        row_j = jnp.asarray(row)
+
+        def step(toks, pos0, n):
+            logits, self._pool = self._prefill_p(
+                self.params, self._pool, jnp.asarray(toks), row_j,
+                jnp.int32(pos0), jnp.int32(n))
+            return logits
+
+        self._chunked_prefill(step, list(tokens), 0)
 
     @property
     def prefix_count(self) -> int:
         return len(self._prefixes)
 
     def clear_prefixes(self) -> None:
-        """Drop every stored prefix KV block (frees device memory)."""
+        """Drop every stored prefix KV block (frees device memory /
+        unpins pool blocks)."""
         with self._sched_lock:
+            for p in self._prefixes:
+                if p.blocks:
+                    self._bpool.decref(p.blocks)
             self._prefixes = []
 
     def _match_prefix(self, prompt: list):
-        for toks, stored, plen in self._prefixes:
-            if len(prompt) >= plen and tuple(prompt[:plen]) == toks:
+        """Dense-mode match: (stored KV, suffix start)."""
+        for p in self._prefixes:
+            if len(prompt) >= p.plen and tuple(prompt[:p.plen]) == p.key:
                 # keep at least one suffix token so the prefill has a
                 # position to read logits from (re-running the prefix's
                 # last token overwrites its own slot with identical KV)
-                return stored, min(plen, len(prompt) - 1)
+                return p.stored, min(p.plen, len(prompt) - 1)
         return None, 0
+
+    def _match_prefix_blocks(self, prompt: list):
+        """Paged-mode match: (shareable block ids, suffix start). Shares
+        only FULL blocks, clamped so at least one suffix token remains
+        to prefill (start = n_shared * block <= len(prompt) - 1)."""
+        for p in self._prefixes:
+            if len(prompt) >= p.plen and tuple(prompt[:p.plen]) == p.key:
+                n = min(len(p.blocks), (len(prompt) - 1) // self.kv_block)
+                return list(p.blocks[:n]), n * self.kv_block
+        return [], 0
 
     def validate(self, prompt: Sequence[int], max_new: int) -> None:
         """Raise ValueError if the request can never fit the cache —
@@ -474,8 +781,30 @@ class ContinuousBatchingEngine:
             lane.reset()
         for req in abandoned:
             req._finish(cancelled=True)
-        self._cache = self._place_cache(
-            self.family.init_cache(self.config, self.lanes, self.max_len))
+        if self.kv_mode in ("dense", "parity"):
+            self._cache = self._place_cache(
+                self.family.init_cache(self.config, self.lanes,
+                                       self.max_len))
+        if self.kv_mode in ("paged", "parity"):
+            # the pool was donated into the failed step too: rebuild the
+            # arena AND the allocator, then re-pin + re-prefill every
+            # registered prefix (their blocks lived in the dead buffer)
+            self._tables[:] = 0
+            self._bpool = BlockPool(self.pool_blocks)
+            self._pool = self._place_cache(self.family.init_block_pool(
+                self.config, self.pool_blocks + 1, self.kv_block))
+            entries = []
+            for p in self._prefixes:
+                blocks: tuple = ()
+                if p.blocks:
+                    # cannot fail: a fresh pool has at least as much
+                    # room as when the prefix was first registered
+                    blocks = tuple(self._bpool.alloc(len(p.blocks)))
+                    self._fill_prefix_blocks(
+                        blocks, list(p.key)[:len(blocks) * self.kv_block])
+                entries.append(_Prefix(key=p.key, plen=p.plen,
+                                       stored=p.stored, blocks=blocks))
+            self._prefixes = entries
         if self.spec_k:
             # the draft cache is donated into _d_decode/_d_prefill too
             self._d_cache = self._place_d_cache(
@@ -527,17 +856,119 @@ class ContinuousBatchingEngine:
         with self._sched_lock:
             abandoned = list(self._queue)
             self._queue.clear()
-            for lane in self._lane_state:
+            for i, lane in enumerate(self._lane_state):
                 if lane.request is not None:
                     abandoned.append(lane.request)
-                    lane.request = None
+                self._free_lane(i)
             for req in abandoned:
                 req._finish(cancelled=True)
+
+    def pool_stats(self) -> dict:
+        """Pool occupancy + scheduler counters for /metrics. Dense mode
+        reports only the mode (no pool exists). Takes the scheduler lock:
+        allocator state mutates under it on the scheduler thread, and an
+        unsynchronized scrape could catch the refcount dict mid-resize
+        (RuntimeError) or report mutually inconsistent numbers."""
+        out = {"kv_mode": self.kv_mode, "peak_active": self.peak_active}
+        if self.kv_mode == "dense":
+            return out
+        with self._sched_lock:
+            bp = self._bpool
+            out.update({
+                "kv_block": self.kv_block,
+                "blocks_total": bp.total,
+                "blocks_free": bp.free_count,
+                "blocks_used": bp.used_count,
+                "blocks_shared": bp.shared_count,
+                "blocks_pinned": sum(len(p.blocks)
+                                     for p in self._prefixes),
+                "block_allocs": bp.allocs,
+                "preempted": self.preempted,
+            })
+        return out
 
     # -- scheduler --------------------------------------------------------
 
     def _active(self) -> bool:
         return any(l.request is not None for l in self._lane_state)
+
+    # -- paged-pool bookkeeping (host side; caller holds _sched_lock) -----
+
+    def _blocks_for(self, n_tokens: int) -> int:
+        return -(-max(n_tokens, 1) // self.kv_block)
+
+    def _ensure_blocks(self, i: int, last_pos: int) -> bool:
+        """Grow lane i's block table to cover a write at ``last_pos``.
+        False when the pool is dry (caller preempts or waits)."""
+        lane = self._lane_state[i]
+        need = last_pos // self.kv_block + 1
+        have = len(lane.blocks)
+        if have >= need:
+            return True
+        got = self._bpool.alloc(need - have)
+        if got is None:
+            return False
+        self._tables[i, have:need] = got
+        lane.blocks.extend(got)
+        return True
+
+    def _free_lane(self, i: int) -> None:
+        """Detach lane i's request and return its pool blocks (shared
+        prefix blocks drop one refcount; private ones free)."""
+        lane = self._lane_state[i]
+        if lane.blocks:
+            self._bpool.decref(lane.blocks)
+            lane.blocks = []
+            self._tables[i, :] = 0
+        lane.request = None
+
+    def _preempt_for_blocks(self) -> bool:
+        """Pool ran dry mid-step: evict the lowest-progress active lane
+        back to the queue HEAD (resumed later by re-prefilling prompt +
+        generated-so-far — greedy-deterministic, so the resumed stream
+        continues exactly). Returns False when nothing is evictable."""
+        cands = [(len(l.request.tokens), i)
+                 for i, l in enumerate(self._lane_state)
+                 if l.request is not None]
+        if not cands:
+            return False
+        _, victim = min(cands)
+        req = self._lane_state[victim].request
+        self._free_lane(victim)
+        self.preempted += 1
+        with self._cv:
+            self._queue.appendleft(req)
+        return True
+
+    def _grow_active(self, extra: int) -> None:
+        """Ensure every active lane's table covers a write at
+        ``pos + extra``, preempting lowest-progress lanes while the pool
+        is dry (the growing lane itself can be the victim — it is then
+        simply requeued)."""
+        for i, lane in enumerate(self._lane_state):
+            while lane.request is not None and \
+                    not self._ensure_blocks(i, lane.pos + extra):
+                if not self._preempt_for_blocks():
+                    break
+
+    def _assert_parity(self, dense_logits, paged_logits, what: str,
+                       rows: Optional[list] = None) -> None:
+        """Parity mode's contract: on every ACTIVE lane the paged path's
+        logits pick the same tokens as the dense path's (and track them
+        numerically). Dead-lane rows are garbage in both layouts and
+        legitimately differ."""
+        act = rows if rows is not None else \
+            [i for i, l in enumerate(self._lane_state)
+             if l.request is not None]
+        if not act:
+            return
+        ld = np.asarray(dense_logits, np.float32)[act]
+        lp = np.asarray(paged_logits, np.float32)[act]
+        if not np.array_equal(ld.argmax(-1), lp.argmax(-1)) or \
+                not np.allclose(ld, lp, rtol=1e-4, atol=1e-5):
+            raise AssertionError(
+                f"KV parity violation in {what}: dense and paged logits "
+                f"diverge (max abs diff {np.abs(ld - lp).max():.3e})")
 
     def _lane_sampling(self, req: Request):
         """(temperature, top_k, top_p) for a request — per-request
@@ -600,9 +1031,21 @@ class ContinuousBatchingEngine:
                     drafts[i, j] = int(greedy_next[i])
             dcur[:, 0] = drafts[:, j]
         chunk = np.concatenate([cur, drafts], axis=1)
-        t_logits, self._cache = self._spec_verify(
-            self.params, self._cache, jnp.asarray(chunk),
-            jnp.asarray(pos))
+        chunk_j, pos_j = jnp.asarray(chunk), jnp.asarray(pos)
+        if self.kv_mode == "dense":
+            t_logits, self._cache = self._spec_verify(
+                self.params, self._cache, chunk_j, pos_j)
+        elif self.kv_mode == "paged":
+            t_logits, self._pool = self._spec_verify_p(
+                self.params, self._pool, chunk_j, pos_j,
+                jnp.asarray(self._tables))
+        else:
+            t_logits, self._cache = self._spec_verify(
+                self.params, self._cache, chunk_j, pos_j)
+            t_logits_p, self._pool = self._spec_verify_p(
+                self.params, self._pool, chunk_j, pos_j,
+                jnp.asarray(self._tables))
+            self._assert_parity(t_logits, t_logits_p, "spec_verify")
         tl = np.asarray(t_logits, np.float32)       # [lanes, k+1, V]
         # draft backfill: the k-th proposal joined sequences that accept
         # fully but its KV never entered the draft cache (it was only an
@@ -617,7 +1060,7 @@ class ContinuousBatchingEngine:
             if req is None:
                 continue
             if req.cancel_requested:
-                lane.request = None
+                self._free_lane(i)
                 req._finish()
                 continue
             if sampled[i]:
@@ -633,10 +1076,6 @@ class ContinuousBatchingEngine:
                         drafts[i, accepted] == targets[accepted]:
                     accepted += 1
                 nxt = int(targets[accepted])
-            self.stats.proposed += k
-            self.stats.accepted += accepted
-            self.lane_stats[i].proposed += k
-            self.lane_stats[i].accepted += accepted
             emitted = [int(x) for x in drafts[i, :accepted]] + [int(nxt)]
             lp_rows = None
             if req.want_logprobs:
@@ -649,62 +1088,146 @@ class ContinuousBatchingEngine:
                 lp_rows = [float(lp_all[j, emitted[j]])
                            for j in range(len(emitted))]
             finished = False
+            pushed = 0
             for j, tok in enumerate(emitted):
                 req._push(tok, lp_rows[j] if lp_rows else None)
+                pushed += 1
                 lane.pos += 1
                 lane.remaining -= 1
                 if (lane.remaining <= 0 or hit_stop(req.tokens, gen)
                         or lane.pos + 1 >= self.max_len):
                     finished = True
                     break
+            # acceptance accounting clamped to tokens actually EMITTED
+            # (ADVICE r5): a lane stopping mid-chunk at eos/max_new only
+            # counts the drafts that reached the client — drafts past
+            # the stop were never consulted, so counting all k would
+            # skew the /metrics rate low for short completions. When the
+            # bonus/resample token was reached (pushed > accepted), all
+            # k drafts were judged and count in full.
+            acc_inc = min(pushed, accepted)
+            prop_inc = k if pushed > accepted else pushed
+            self.stats.proposed += prop_inc
+            self.stats.accepted += acc_inc
+            self.lane_stats[i].proposed += prop_inc
+            self.lane_stats[i].accepted += acc_inc
             self._cur[i, 0] = req.tokens[-1]
             self._pos[i] = lane.pos
             if finished:
-                lane.request = None
+                self._free_lane(i)
                 req._finish()
 
-    def _admit(self, lane_idx: int) -> None:
+    def _prefill_dense(self, lane_idx: int, seq: list, start: int):
+        """Chunked dense-slab prefill of ``seq[start:]`` into one lane
+        (``_chunked_prefill`` owns the chunking rule)."""
+        def step(toks, pos0, n):
+            logits, self._cache = self._prefill(self.params, self._cache,
+                                                jnp.asarray(toks),
+                                                jnp.int32(lane_idx),
+                                                jnp.int32(pos0),
+                                                jnp.int32(n))
+            return logits
+
+        return self._chunked_prefill(step, seq, start)
+
+    def _prefill_paged(self, lane_idx: int, seq: list, start: int):
+        """Chunked paged prefill of ``seq[start:]`` through lane
+        ``lane_idx``'s block table (grown by the caller)."""
+        row = jnp.asarray(self._tables[lane_idx])
+
+        def step(toks, pos0, n):
+            logits, self._pool = self._prefill_p(
+                self.params, self._pool, jnp.asarray(toks), row,
+                jnp.int32(pos0), jnp.int32(n))
+            return logits
+
+        return self._chunked_prefill(step, seq, start)
+
+    def _admit(self, lane_idx: int) -> bool:
+        """Admit the queue head onto free lane ``lane_idx``. Returns
+        False when admission must stop this tick: queue empty, or (paged
+        modes) the head needs more free blocks than the pool has — FCFS,
+        the head is never skipped, it waits at the front until lanes
+        finish and free blocks. A head that can NEVER be admitted (pool
+        too small after prefix pins, nothing running to preempt) is
+        failed with a descriptive error instead of wedging the queue."""
         gen = self.gen
         with self._cv:
             while self._queue and self._queue[0].cancel_requested:
                 # cancelled while queued: never pay the prefill
                 self._queue.popleft()._finish()
             if not self._queue:
-                return
-            req = self._queue.popleft()
+                return False
+            req = self._queue[0]
+            shared, start_p = [], 0
+            if self.kv_mode != "dense":
+                # admission watermark: the prompt's private blocks plus
+                # headroom must be free, or the head waits (degrading to
+                # fewer concurrent lanes instead of OOM/preempt-thrash).
+                # The match is reused by the attach path below — nothing
+                # can change it in between (we hold _sched_lock, which
+                # register_prefix also needs).
+                seq = (req.prompt or [0]) + req.tokens
+                shared, start_p = self._match_prefix_blocks(seq)
+                need = self._blocks_for(len(seq)) - len(shared)
+                free = self._bpool.free_count
+                if not self._active():
+                    # nothing running: nothing will ever free blocks
+                    # (only prefix pins hold them) — the request either
+                    # fits its WHOLE generation now or never will
+                    total = self._blocks_for(min(
+                        len(seq) + req.max_new - len(req.tokens),
+                        self.max_len)) - len(shared)
+                    if total > free:
+                        self._queue.popleft()
+                        req.error = (
+                            f"request needs {total} free KV blocks but "
+                            f"only {free} are free (pool "
+                            f"{self.pool_blocks}, "
+                            f"{sum(len(p.blocks) for p in self._prefixes)}"
+                            " pinned by prefixes)")
+                        req._finish(cancelled=True)
+                        return True
+                elif free < need + self.headroom_blocks:
+                    return False
+            self._queue.popleft()
         # attach BEFORE the prefill work: a failure mid-prefill must leave
         # the request visible to _recover_locked (a popped-but-unattached
         # request would never be cancelled and its waiter would hang)
         lane = self._lane_state[lane_idx]
         lane.request = req
-        prompt = req.prompt or [0]
-        plen = len(prompt)
-        stored, start = self._match_prefix(prompt)
-        if stored is not None:
-            self._cache = self._load_prefix(self._cache, stored,
-                                            jnp.int32(lane_idx))
-        suffix = prompt[start:]
-        plen_total = start + len(suffix)
-        # prefill the suffix in power-of-two chunks that fit the remaining
-        # cache space: keeps the compiled-shape set fixed AND never lets a
-        # padded chunk run past the cache end (jax clamps a too-far
-        # dynamic_update_slice start, which would overwrite the
-        # just-loaded prefix slots). validate() guarantees the suffix fits.
-        pos0, remaining = start, suffix
-        while remaining:
-            space = self.max_len - pos0
-            bucket = min(_bucket(len(remaining)), _pow2_floor(space))
-            n = min(len(remaining), bucket)
-            chunk, remaining = remaining[:n], remaining[n:]
-            toks = np.zeros((1, bucket), np.int32)
-            toks[0, :n] = chunk
-            logits, self._cache = self._prefill(self.params, self._cache,
-                                                jnp.asarray(toks),
-                                                jnp.int32(lane_idx),
-                                                jnp.int32(pos0),
-                                                jnp.int32(n))
-            pos0 += n
-        plen = plen_total
+        # resume-aware: a preempted request re-prefills prompt PLUS the
+        # tokens it already streamed, then continues its budget — the
+        # client-visible stream never replays
+        prior = len(req.tokens)
+        seq = (req.prompt or [0]) + req.tokens
+        plen = len(seq)
+        logits = logits_p = None
+        if self.kv_mode in ("dense", "parity"):
+            if self.kv_mode == "dense":
+                stored, start = self._match_prefix(seq)
+                if stored is not None:
+                    self._cache = self._load_prefix(self._cache, stored,
+                                                    jnp.int32(lane_idx))
+            else:
+                # parity's dense shadow prefills from 0: prefix KV lives
+                # only in the pool there, and a full prefill writes
+                # bit-identical KV anyway (position-exact chunks)
+                start = 0
+            logits = self._prefill_dense(lane_idx, seq, start)
+        if self.kv_mode in ("paged", "parity"):
+            if shared:
+                self._bpool.incref(shared)
+                lane.blocks = list(shared)
+                self._tables[lane_idx, :len(shared)] = shared
+            # the admission gate reserved capacity under the same
+            # scheduler lock, so this cannot fail
+            self._ensure_blocks(lane_idx, plen - 1)
+            logits_p = self._prefill_paged(lane_idx, seq, start_p)
+            if self.kv_mode == "parity":
+                self._assert_parity(logits, logits_p, "prefill", rows=[0])
+            else:
+                logits = logits_p
         self._key, sub = jax.random.split(self._key)
         t, k_, p_ = self._lane_sampling(req)
         if t <= 0.0:
@@ -720,58 +1243,89 @@ class ContinuousBatchingEngine:
         req._push(first, float(token_logprobs(
             logits, jnp.asarray([first]))[0]) if req.want_logprobs else None)
         lane.pos = plen
-        lane.remaining = req.max_new - 1
+        lane.remaining = req.max_new - prior - 1
         self._cur[lane_idx, 0] = first
         self._pos[lane_idx] = plen
         if lane.remaining <= 0 or hit_stop(req.tokens, gen):
-            lane.request = None    # finished in prefill
+            self._free_lane(lane_idx)    # finished in prefill
             req._finish()
         elif self.spec_k:
-            # draft prefills the FULL prompt into ITS lane (prefix KV
+            # draft prefills the FULL sequence into ITS lane (prefix KV
             # blocks are target-model state; the draft pays its own
             # prefill so its cache is exact and proposals stay sharp —
             # a stale draft cache would only cost acceptance, but a
-            # deterministic one keeps rounds reproducible)
-            pos0, remaining = 0, prompt
-            while remaining:
-                space = self.max_len - pos0
-                bucket = min(_bucket(len(remaining)), _pow2_floor(space))
-                n = min(len(remaining), bucket)
-                chunk, remaining = remaining[:n], remaining[n:]
-                toks = np.zeros((1, bucket), np.int32)
-                toks[0, :n] = chunk
-                _, self._d_cache = self._d_prefill(
+            # deterministic one keeps rounds reproducible). The draft
+            # cache stays a dense slab in every kv mode: it is small,
+            # and paging it would double the host bookkeeping for no
+            # capacity win.
+            def d_step(toks, pos0, n):
+                logits, self._d_cache = self._d_prefill(
                     self.dparams, self._d_cache, jnp.asarray(toks),
                     jnp.int32(lane_idx), jnp.int32(pos0), jnp.int32(n))
-                pos0 += n
-            # per-request host rng drives the sampled accept rule
-            req._spec_rng = np.random.default_rng(
-                self._seed + 1000003 * self._spec_admitted)
-            self._spec_admitted += 1
+                return logits
+
+            self._chunked_prefill(d_step, seq, 0)
+            # per-request host rng drives the sampled accept rule; a
+            # RESUMED request keeps its rng (the stream must continue,
+            # not restart)
+            if not hasattr(req, "_spec_rng"):
+                req._spec_rng = np.random.default_rng(
+                    self._seed + 1000003 * self._spec_admitted)
+                self._spec_admitted += 1
+        return True
 
     def _step_once(self) -> bool:
         """Fill free lanes, run one decode tick (or a speculative round
         when a draft model is configured). Returns False once idle."""
         gen = self.gen
+        stalled = False
         for i, lane in enumerate(self._lane_state):
             while self._queue and lane.request is None:
-                self._admit(i)
-            if not self._queue:
+                if not self._admit(i):
+                    # FCFS: the head is waiting on pool capacity —
+                    # every other free lane would stall on it too
+                    stalled = True
+                    break
+            if stalled or not self._queue:
                 break
+        self.peak_active = max(self.peak_active, sum(
+            1 for l in self._lane_state if l.request is not None))
         if not self._active():
             return bool(self._queue)
         if self.spec_k:
             k = self._spec_round_k()
             if k >= 1:
+                if self.kv_mode != "dense":
+                    # the verify chunk writes pos..pos+k; grow (and
+                    # preempt if dry) BEFORE the uniform device round
+                    self._grow_active(k)
+                    if not self._active():
+                        return bool(self._queue)
                 self._spec_round(k)
                 return True
             # near the cache cap a verify chunk no longer fits: finish
             # with plain single-token ticks (same as the single-sequence
             # engine's tail loop)
+        if self.kv_mode != "dense":
+            self._grow_active(0)
+            if not self._active():
+                return bool(self._queue)
         # one decode tick for every lane (dead lanes compute garbage)
-        logits, self._cache = self._decode(
-            self.params, self._cache, jnp.asarray(self._cur),
-            jnp.asarray(self._pos))
+        cur, pos = jnp.asarray(self._cur), jnp.asarray(self._pos)
+        if self.kv_mode == "dense":
+            logits, self._cache = self._decode(self.params, self._cache,
+                                               cur, pos)
+        elif self.kv_mode == "paged":
+            logits, self._pool = self._decode_p(
+                self.params, self._pool, cur, pos,
+                jnp.asarray(self._tables))
+        else:
+            logits, self._cache = self._decode(self.params, self._cache,
+                                               cur, pos)
+            logits_p, self._pool = self._decode_p(
+                self.params, self._pool, cur, pos,
+                jnp.asarray(self._tables))
+            self._assert_parity(logits, logits_p, "decode")
         if self.spec_k:
             # near-cap fallback ticks must keep the DRAFT cache in
             # lockstep (ingest the same token at the same position the
@@ -820,6 +1374,6 @@ class ContinuousBatchingEngine:
             if (req.cancel_requested or lane.remaining <= 0
                     or hit_stop(req.tokens, gen)
                     or lane.pos + 1 >= self.max_len):
-                lane.request = None   # lane freed for the next arrival
+                self._free_lane(i)   # lane freed for the next arrival
                 req._finish()
         return True

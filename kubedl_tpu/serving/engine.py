@@ -220,13 +220,31 @@ def sample_logits_many(logits, key, temps, top_ks, top_ps):
     return jnp.where(temps <= 0, greedy, sampled)
 
 
+def kv_bytes_per_token(config, dtype_bytes: Optional[int] = None) -> int:
+    """HBM bytes one cached token costs across all layers (K and V) —
+    the unit both the dense slab (``lanes * max_len`` tokens) and the
+    paged pool (``num_blocks * block`` tokens) are sized in. The serving
+    auto-configurator's memory model and ``bench_serving_paged.py``
+    budget with this."""
+    if dtype_bytes is None:
+        dtype_bytes = jnp.dtype(config.dtype).itemsize
+    return 2 * config.n_layers * config.n_kv_heads * config.hd * dtype_bytes
+
+
 def shard_for_serving(config, params, cache, mesh):
     """Place a param tree + KV cache for model-parallel serving over a
     local mesh (tp over the chips of ONE host — a v5e-8 serving VM).
     Params follow the family's logical specs (heads/mlp on tp); the
     cache shards its kv-head axis when it divides tp, else replicates
     (MQA). GSPMD then inserts the serving collectives inside the same
-    jitted step — no engine code changes, just operand placement."""
+    jitted step — no engine code changes, just operand placement.
+
+    Works unchanged for BOTH cache layouts: the dense slab ``[layers,
+    lanes, max_len, kv_heads, hd]`` and the paged block pool ``[layers,
+    num_blocks, block, kv_heads, hd]`` carry kv-heads on the same axis,
+    so one spec shards either — the pool's block axis stays replicated
+    (every chip holds every block's shard of its kv-heads, and the
+    block-table gather is local)."""
     from jax.sharding import NamedSharding
     from jax.sharding import PartitionSpec as P
 

@@ -109,6 +109,13 @@ class InferenceServer:
             "kubedl_serving_ttft_seconds",
             "Time to first streamed token",
             buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10))
+        self._m_kv = None
+        if hasattr(engine, "pool_stats"):
+            # continuous-batching predictors: paged KV pool occupancy,
+            # prefix-sharing ratio and preemption counter on the scrape
+            # page (dense mode still reports peak active lanes)
+            from ..metrics.registry import PagedKVMetrics
+            self._m_kv = PagedKVMetrics(self.metrics)
         self._m_spec = None
         self._m_spec_lane = None
         if hasattr(engine, "stats") and \
@@ -131,6 +138,8 @@ class InferenceServer:
                     labels=("lane",))
 
         def _refresh_engine_metrics():
+            if self._m_kv is not None:
+                self._m_kv.refresh(engine.pool_stats())
             if self._m_spec is not None:
                 st = engine.stats
                 self._m_spec[0].set(st.proposed)

@@ -76,11 +76,14 @@ def test_nothing_feasible_returns_least_violating():
 
 
 def test_env_contract_roundtrip():
-    cand = Candidate(batch=4, quantize="int8", speculative_k=2)
+    cand = Candidate(batch=4, quantize="int8", speculative_k=2,
+                     kv_block=32, pool_blocks=64)
     env = cand.to_env()
     assert env == {"KUBEDL_SERVING_LANES": "4",
                    "KUBEDL_SERVING_QUANTIZE": "int8",
-                   "KUBEDL_SERVING_SPEC_K": "2"}
+                   "KUBEDL_SERVING_SPEC_K": "2",
+                   "KUBEDL_SERVING_KV_BLOCK": "32",
+                   "KUBEDL_SERVING_POOL_BLOCKS": "64"}
 
 
 @pytest.fixture(scope="module")

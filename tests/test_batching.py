@@ -214,7 +214,8 @@ def test_prefix_caching_outputs_unchanged(dense):
 
 def test_prefix_caching_longest_match_wins(dense):
     cfg, params = dense
-    eng = ContinuousBatchingEngine(cfg, params, lanes=1, max_len=96)
+    eng = ContinuousBatchingEngine(cfg, params, lanes=1, max_len=96,
+                                   kv_mode="dense")
     eng.register_prefix([7, 13])
     eng.register_prefix([7, 13, 21, 9])
     stored, start = eng._match_prefix([7, 13, 21, 9, 40])
@@ -225,6 +226,21 @@ def test_prefix_caching_longest_match_wins(dense):
     assert stored is None and start == 0
     with pytest.raises(ValueError):
         eng.register_prefix([])
+
+    # the paged layout's match rule: longest prefix still wins, sharing
+    # its FULL blocks (the tail is re-prefilled per lane)
+    paged = ContinuousBatchingEngine(cfg, params, lanes=1, max_len=96,
+                                     kv_mode="paged", kv_block=2)
+    paged.register_prefix([7, 13])
+    paged.register_prefix([7, 13, 21, 9])
+    blocks, start = paged._match_prefix_blocks([7, 13, 21, 9, 40])
+    assert len(blocks) == 2 and start == 4
+    blocks, start = paged._match_prefix_blocks([7, 13, 99])
+    assert len(blocks) == 1 and start == 2
+    blocks, start = paged._match_prefix_blocks([8, 13])
+    assert blocks == [] and start == 0
+    with pytest.raises(ValueError):
+        paged.register_prefix([])
 
 
 def test_stop_cancels_waiters(dense):
@@ -295,14 +311,18 @@ def test_inline_failure_recovers_cache(dense):
     eng = ContinuousBatchingEngine(cfg, params, lanes=2, max_len=96)
     want = eng.run([([3, 1], 6)])[0]          # healthy baseline
 
-    real_decode = eng._decode
     calls = {"n": 0}
 
     def boom(*a, **kw):
         calls["n"] += 1
         raise RuntimeError("injected decode failure")
 
-    eng._decode = boom
+    # stub whichever decode step(s) the KV mode runs (dense slab, paged
+    # pool, or both under parity — the dense one fires first there)
+    real = {n: getattr(eng, n) for n in ("_decode", "_decode_p")
+            if hasattr(eng, n)}
+    for n in real:
+        setattr(eng, n, boom)
     with pytest.raises(RuntimeError, match="injected"):
         eng.run([([3, 1], 6), ([9, 2], 4)])
     assert calls["n"] == 1
@@ -310,7 +330,8 @@ def test_inline_failure_recovers_cache(dense):
     assert all(l.request is None for l in eng._lane_state)
     assert not eng._queue
 
-    eng._decode = real_decode
+    for n, fn in real.items():
+        setattr(eng, n, fn)
     assert eng.run([([3, 1], 6)])[0] == want  # cache was reinitialized
 
 

@@ -1,0 +1,342 @@
+"""Paged KV-cache block pool: parity walks, CoW refcounts, preemption.
+
+Two tiers. The fast tests (tier-1) exercise the HOST side — the block
+allocator, admission math, and the alloc-count budget guard (work
+counters, not wall clocks, following tests/test_controlplane_perf.py).
+The ``slow``-marked tests drive real engines in ``KUBEDL_KV_MODE=parity``
+— every jitted step runs BOTH layouts and asserts token-identical
+logits — through randomized mixed-length walks with prefix hits,
+cancels, and preemption under a deliberately tiny pool.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from kubedl_tpu.serving.batching import (BlockPool, fit_block,
+                                         resolve_kv_mode)
+
+# ---------------------------------------------------------------------------
+# fast tier: host-side allocator + config resolution
+# ---------------------------------------------------------------------------
+
+
+def test_fit_block_divides_max_len():
+    assert fit_block(64, 1024) == 64
+    assert fit_block(64, 96) == 32      # 64 does not divide 96; 32 does
+    assert fit_block(16, 96) == 16
+    assert fit_block(64, 100) == 4
+    assert fit_block(64, 7) == 1        # degenerate but always legal
+
+
+def test_resolve_kv_mode(monkeypatch):
+    assert resolve_kv_mode("dense") == "dense"
+    monkeypatch.setenv("KUBEDL_KV_MODE", "parity")
+    assert resolve_kv_mode() == "parity"
+    monkeypatch.delenv("KUBEDL_KV_MODE")
+    assert resolve_kv_mode() == "paged"   # the default
+    with pytest.raises(ValueError):
+        resolve_kv_mode("slab")
+
+
+def test_block_pool_alloc_free_refcounts():
+    pool = BlockPool(4)
+    a = pool.alloc(2)
+    assert sorted(a) == [1, 2] and pool.free_count == 2
+    assert pool.alloc(3) is None          # all-or-nothing
+    assert pool.free_count == 2           # the refusal leaked nothing
+    pool.incref(a)                        # a sharer arrives
+    pool.decref(a)                        # sharer leaves: still held
+    assert pool.free_count == 2 and pool.refcounts() == {1: 1, 2: 1}
+    pool.decref(a)
+    assert pool.free_count == 4 and pool.refcounts() == {}
+
+
+def test_block_pool_shared_count():
+    pool = BlockPool(4)
+    a = pool.alloc(2)
+    pool.incref(a[:1])
+    assert pool.shared_count == 1
+    pool.decref(a[:1])
+    assert pool.shared_count == 0
+    pool.decref(a)
+
+
+@pytest.mark.perf
+def test_block_allocation_budget():
+    """Tier-1 perf guard: serving a mixed workload costs exactly
+    ceil(tokens/block) allocations per request — an accidental
+    per-token (or per-tick) allocation path multiplies ``allocs`` long
+    before it shows up in latency."""
+    block = 16
+    pool = BlockPool(64)
+    rng = np.random.default_rng(0)
+    expected = 0
+    for _ in range(50):
+        total = int(rng.integers(1, 257))        # prompt + generated
+        need = -(-total // block)
+        expected += need
+        held = pool.alloc(need)
+        assert held is not None
+        pool.decref(held)
+    assert pool.allocs == expected
+    assert pool.free_count == pool.total and pool.refcounts() == {}
+
+
+@pytest.mark.perf
+def test_engine_growth_allocates_blockwise():
+    """Engine-level alloc budget (host bookkeeping only — no jitted call
+    ever runs): growing a lane position by position must allocate once
+    per BLOCK, and freeing the lane must drain every refcount."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubedl_tpu.models import llama
+    from kubedl_tpu.serving.batching import ContinuousBatchingEngine
+
+    cfg = dataclasses.replace(llama.tiny(vocab=64), dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    eng = ContinuousBatchingEngine(cfg, params, lanes=2, max_len=64,
+                                   kv_mode="paged", kv_block=8)
+    for pos in range(40):
+        assert eng._ensure_blocks(0, pos)
+    assert eng._bpool.allocs == -(-40 // 8)      # 5 blocks, not 40
+    assert list(eng._tables[0, :5]) == eng._lane_state[0].blocks
+    eng._free_lane(0)
+    assert eng._bpool.refcounts() == {}
+    assert (eng._tables[0] == 0).all()
+
+
+def test_pool_too_small_rejected():
+    import jax
+    import jax.numpy as jnp
+
+    from kubedl_tpu.models import llama
+    from kubedl_tpu.serving.batching import ContinuousBatchingEngine
+
+    cfg = dataclasses.replace(llama.tiny(vocab=64), dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    with pytest.raises(ValueError, match="pool_blocks"):
+        ContinuousBatchingEngine(cfg, params, lanes=2, max_len=64,
+                                 kv_mode="paged", kv_block=8,
+                                 pool_blocks=4)   # < one full request
+
+
+def test_paged_kv_metrics_refresh():
+    from kubedl_tpu.metrics.registry import PagedKVMetrics, Registry
+
+    reg = Registry()
+    m = PagedKVMetrics(reg)
+    m.refresh({"kv_mode": "paged", "peak_active": 3, "kv_block": 16,
+               "blocks_total": 32, "blocks_free": 20, "blocks_used": 12,
+               "blocks_shared": 3, "blocks_pinned": 2, "block_allocs": 40,
+               "preempted": 1})
+    page = reg.expose()
+    assert "kubedl_serving_kv_blocks_total 32" in page
+    assert "kubedl_serving_kv_blocks_free 20" in page
+    assert "kubedl_serving_kv_blocks_pinned 2" in page
+    assert "kubedl_serving_kv_shared_block_ratio 0.25" in page
+    assert "kubedl_serving_kv_preemptions_total 1" in page
+    assert "kubedl_serving_peak_active_lanes 3" in page
+    # dense engines report only peak lanes; pool gauges stay untouched
+    m.refresh({"kv_mode": "dense", "peak_active": 4})
+    assert "kubedl_serving_peak_active_lanes 4" in reg.expose()
+
+
+def test_kv_cache_bytes_blocks_not_lanes():
+    """The autoconfig memory model prices the POOL, so lane count stops
+    being an HBM commitment once pool_blocks is pinned."""
+    from kubedl_tpu.models import llama
+    from kubedl_tpu.serving.autoconfig import Candidate, kv_cache_bytes
+    from kubedl_tpu.serving.engine import kv_bytes_per_token
+
+    cfg = llama.tiny()
+    per_tok = kv_bytes_per_token(cfg)
+    dense_like = kv_cache_bytes(cfg, Candidate(batch=4, kv_block=16), 128)
+    assert dense_like == (4 * 8 + 1) * 16 * per_tok
+    pooled = kv_cache_bytes(
+        cfg, Candidate(batch=32, kv_block=16, pool_blocks=32), 128)
+    assert pooled == 33 * 16 * per_tok        # 32 lanes, same bytes
+
+
+# ---------------------------------------------------------------------------
+# slow tier: real engines under KUBEDL_KV_MODE=parity
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def model():
+    import jax
+    import jax.numpy as jnp
+
+    from kubedl_tpu.models import llama
+    cfg = dataclasses.replace(llama.tiny(vocab=128), dtype=jnp.float32)
+    return cfg, llama.init_params(cfg, jax.random.PRNGKey(0))
+
+
+PREFIX = list(range(1, 11))       # 10 tokens: 1 full block of 8 + tail
+
+
+@pytest.fixture(scope="module")
+def parity_engine(model):
+    """One parity engine shared by the walk seeds (compiles amortized):
+    3 lanes over a 12-block pool of 8-token blocks — deliberately
+    smaller than 3 full lanes (24 blocks), so concurrent walks preempt."""
+    from kubedl_tpu.serving.batching import ContinuousBatchingEngine
+    cfg, params = model
+    eng = ContinuousBatchingEngine(cfg, params, lanes=3, max_len=64,
+                                   kv_mode="parity", kv_block=8,
+                                   pool_blocks=12)
+    eng.register_prefix(PREFIX)
+    return eng
+
+
+def _walk_requests(seed: int) -> list:
+    rng = np.random.default_rng(seed)
+    reqs = []
+    for i in range(8):
+        plen = int(rng.integers(1, 20))
+        prompt = rng.integers(1, 127, plen).tolist()
+        if i % 3 == 0:
+            prompt = PREFIX + prompt        # prefix hit -> block sharing
+        reqs.append((prompt, int(rng.integers(1, 7))))
+    return reqs
+
+
+@pytest.fixture(scope="module")
+def dense_engine(model):
+    from kubedl_tpu.serving.batching import ContinuousBatchingEngine
+    cfg, params = model
+    eng = ContinuousBatchingEngine(cfg, params, lanes=3, max_len=64,
+                                   kv_mode="dense")
+    eng.register_prefix(PREFIX)
+    return eng
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_parity_randomized_walk(parity_engine, dense_engine, seed):
+    """Mixed prompt lengths, prefix hits, and pool-pressure preemption:
+    the parity engine asserts dense==paged logits INSIDE every step, and
+    the emitted streams must equal a plain dense engine's."""
+    reqs = _walk_requests(seed)
+    got = parity_engine.run(reqs)
+    want = dense_engine.run(reqs)
+    assert got == want
+
+    st = parity_engine.pool_stats()
+    # between walks every non-pinned block must be back in the pool
+    assert st["blocks_used"] == st["blocks_pinned"] == 1, st
+
+
+@pytest.mark.slow
+def test_parity_cancel_midstream(model):
+    """Background-loop mode: cancelling one stream mid-flight frees its
+    blocks while parity keeps asserting on the survivors. Own engine:
+    stop() retires it, so the shared fixture must not be used."""
+    from kubedl_tpu.serving.batching import ContinuousBatchingEngine
+    cfg, params = model
+    eng = ContinuousBatchingEngine(cfg, params, lanes=3, max_len=64,
+                                   kv_mode="parity", kv_block=8,
+                                   pool_blocks=12)
+    eng.register_prefix(PREFIX)
+    eng.start()
+    try:
+        long_req = eng.submit(list(range(20, 30)), 30)
+        short = eng.submit([5, 7], 4)
+        stream = long_req.stream(timeout=120)
+        next(stream)                      # one token, then walk away
+        long_req.cancel()
+        assert len(short.result(timeout=120)) == 4
+        long_req.done.wait(timeout=120)
+        assert len(long_req.tokens) < 30  # stopped early, kept partials
+    finally:
+        eng.stop()
+    # stop() cancelled everything: only the prefix pin may remain
+    assert eng.pool_stats()["blocks_used"] == 1
+
+
+@pytest.mark.slow
+def test_block_refcounts_drain_after_cancel_all_and_clear(model):
+    """The leak check the ISSUE asks for: after _cancel_all AND
+    clear_prefixes every refcount is zero and the whole pool is free."""
+    from kubedl_tpu.serving.batching import ContinuousBatchingEngine
+    cfg, params = model
+    eng = ContinuousBatchingEngine(cfg, params, lanes=2, max_len=64,
+                                   kv_mode="paged", kv_block=8,
+                                   pool_blocks=10)
+    eng.register_prefix(PREFIX)
+    eng.run([(PREFIX + [40], 3), ([41, 42], 2)])
+    # park work mid-flight: submit without a scheduler, then cancel all
+    eng.submit([1, 2, 3], 5)
+    eng._cancel_all()
+    eng.clear_prefixes()
+    assert eng._bpool.refcounts() == {}
+    assert eng._bpool.free_count == eng._bpool.total
+    assert (eng._tables == 0).all()
+
+
+@pytest.mark.slow
+def test_paged_request_never_fitting_errors_not_wedges(model):
+    """A request whose whole generation cannot fit the pool (prefix pins
+    included) must fail with a descriptive error, not wedge the queue."""
+    from kubedl_tpu.serving.batching import ContinuousBatchingEngine
+    cfg, params = model
+    eng = ContinuousBatchingEngine(cfg, params, lanes=2, max_len=64,
+                                   kv_mode="paged", kv_block=8,
+                                   pool_blocks=8)
+    # pin 6 of 8 blocks: 16 free tokens left, request needs 40
+    eng.register_prefix(list(range(1, 49)))
+    assert eng.pool_stats()["blocks_pinned"] == 6
+    req = eng.submit([200, 201], 38)
+    with pytest.raises(RuntimeError, match="free KV blocks"):
+        eng.run([])                      # drive the scheduler inline
+        req.result(timeout=5)
+    # a fitting request still goes through afterwards
+    assert len(eng.run([([7, 7], 2)])[0]) == 2
+
+
+@pytest.mark.slow
+def test_prefix_reregister_on_tight_pool(model):
+    """Idempotent re-registration frees the replaced pin BEFORE
+    allocating the new one, so it needs no net-new blocks — a tight
+    pool must accept it (review finding: alloc-then-decref refused a
+    same-key refresh that frees as much as it takes)."""
+    from kubedl_tpu.serving.batching import ContinuousBatchingEngine
+    cfg, params = model
+    eng = ContinuousBatchingEngine(cfg, params, lanes=1, max_len=64,
+                                   kv_mode="paged", kv_block=8,
+                                   pool_blocks=8)
+    prefix = list(range(1, 49))          # pins 6 of 8 blocks
+    eng.register_prefix(prefix)
+    assert eng.pool_stats()["blocks_pinned"] == 6
+    eng.register_prefix(prefix)          # refresh in place
+    st = eng.pool_stats()
+    assert st["blocks_pinned"] == 6 and st["blocks_used"] == 6, st
+    assert eng.prefix_count == 1
+    # the refreshed pin still serves matches
+    got = eng.run([(prefix + [60], 2)])
+    assert len(got[0]) == 2
+    eng.clear_prefixes()
+    assert eng._bpool.refcounts() == {}
+
+
+@pytest.mark.slow
+def test_moe_paged_parity():
+    """The MoE family rides the same paged driver (pluggable layer
+    body): parity holds and outputs match the dense run."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubedl_tpu.models import moe
+    from kubedl_tpu.serving.batching import ContinuousBatchingEngine
+    mcfg = dataclasses.replace(moe.tiny(vocab=128), dtype=jnp.float32,
+                               capacity_factor=4.0)
+    mparams = moe.init_params(mcfg, jax.random.PRNGKey(0))
+    reqs = [([5, 6], 4), ([7], 3)]
+    want = ContinuousBatchingEngine(mcfg, mparams, lanes=2, max_len=64,
+                                    kv_mode="dense").run(reqs)
+    got = ContinuousBatchingEngine(mcfg, mparams, lanes=2, max_len=64,
+                                   kv_mode="parity", kv_block=16).run(reqs)
+    assert got == want

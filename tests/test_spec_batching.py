@@ -90,6 +90,32 @@ def test_self_draft_accepts_everything(models):
         eng.stop()
 
 
+def test_specstats_exact_for_one_token_to_eos(models):
+    """ADVICE r5 regression: a lane that stops on its FIRST emitted
+    draft token (eos right after prefill's token) must count exactly
+    one proposed and one accepted — not the whole k-chunk. With the old
+    accounting a self-draft run here reported proposed=k, skewing the
+    /metrics acceptance rate for short completions."""
+    tcfg, tparams, _, _ = models
+    solo = InferenceEngine(tcfg, tparams, GenerateConfig(max_len=96))
+    want = solo.generate([PROMPTS[0]], 2)[0]
+    # self-draft: every draft matches the target greedily, so the spec
+    # round's first draft IS the eos token and the lane stops mid-chunk
+    eng = ContinuousBatchingEngine(
+        tcfg, tparams, lanes=1, max_len=96, draft_config=tcfg,
+        draft_params=tparams, spec_k=3,
+        gen=GenerateConfig(max_len=96, eos_id=want[1]))
+    try:
+        got = eng.run([(PROMPTS[0], 12)])
+        assert got[0] == want          # prefill token + eos
+        assert eng.stats.proposed == 1
+        assert eng.stats.accepted == 1
+        assert eng.stats.acceptance_rate == 1.0
+        assert eng.lane_stats[0].proposed == 1
+    finally:
+        eng.stop()
+
+
 def test_logprobs_on_spec_lanes(models):
     """Logprobs ride the verify logits: same numbers the per-token
     decode path reports."""
