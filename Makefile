@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test test-all test-fast test-chaos bench bench-controlplane bench-serving-paged dryrun crds run-standalone lint native
+.PHONY: test test-all test-fast test-chaos test-scheduler bench bench-controlplane bench-scheduler bench-serving-paged dryrun crds run-standalone lint native
 
 # fast path (<3 min): everything except the compile-heavy compute suites
 # (those carry `pytestmark = pytest.mark.slow`). Chaos tests are fast and
@@ -34,6 +34,16 @@ bench:
 # control-plane-perf.md); the fast tier-1 guard is tests/test_controlplane_perf.py
 bench-controlplane:
 	JAX_PLATFORMS=cpu $(PY) bench_controlplane.py
+
+# slice-scheduler policy suite (queues/quota/preemption/backfill)
+test-scheduler:
+	$(PY) -m pytest tests/ -q -m scheduler
+
+# slice-scheduler policy value on a deterministic synthetic trace: FCFS
+# head-of-line baseline vs queues+quota+backfill -> BENCH_SCHEDULER.json
+# (docs/scheduling.md); gate: >=1.3x slice utilization, no worse makespan
+bench-scheduler:
+	JAX_PLATFORMS=cpu $(PY) bench_scheduler.py
 
 # serving capacity at a fixed KV-memory budget: paged block pool vs the
 # dense per-lane slab on a mixed-length workload -> BENCH_SERVING_PAGED.json

@@ -119,7 +119,7 @@ PLATFORM = {
 
 
 def crd(group: str, kind: str, plural: str, schema: dict,
-        categories=("kubedl",)) -> dict:
+        categories=("kubedl",), scope: str = "Namespaced") -> dict:
     return {
         "apiVersion": "apiextensions.k8s.io/v1",
         "kind": "CustomResourceDefinition",
@@ -129,7 +129,7 @@ def crd(group: str, kind: str, plural: str, schema: dict,
             "names": {"kind": kind, "listKind": f"{kind}List",
                       "plural": plural, "singular": kind.lower(),
                       "categories": list(categories)},
-            "scope": "Namespaced",
+            "scope": scope,
             "versions": [{
                 "name": "v1alpha1",
                 "served": True,
@@ -211,6 +211,23 @@ def main() -> None:
         path = OUT / f"{group}_{plural}.yaml"
         path.write_text(yaml.safe_dump(doc, sort_keys=False))
         written.append(path.name)
+    # slice-scheduler Queue: cluster-scoped elastic quota (docs/scheduling.md)
+    queue_doc = crd("scheduling.kubedl.io", "Queue", "queues",
+                    generic_schema({
+                        "type": "object",
+                        "properties": {
+                            "quota": {"type": "object", "properties": {
+                                "min": {"type": "integer", "minimum": 0},
+                                "max": {"type": "integer", "minimum": 0},
+                            }},
+                            "priority": {"type": "integer"},
+                            "tenants": {"type": "array",
+                                        "items": {"type": "string"}},
+                        }}),
+                    scope="Cluster")
+    path = OUT / "scheduling.kubedl.io_queues.yaml"
+    path.write_text(yaml.safe_dump(queue_doc, sort_keys=False))
+    written.append(path.name)
     print(f"wrote {len(written)} CRDs to {OUT}")
 
 

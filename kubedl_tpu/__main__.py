@@ -28,6 +28,14 @@ def parse_args(argv=None) -> argparse.Namespace:
                         'prefix "-" disables a kind')
     p.add_argument("--gang-scheduler-name", default="coscheduler",
                    help='gang plugin: coscheduler|volcano|kube-batch|"" (off)')
+    p.add_argument("--enable-slice-scheduler", action="store_true",
+                   help="multi-tenant slice scheduler: queues, elastic "
+                        "quota, priority preemption, backfill "
+                        "(docs/scheduling.md; also TPUSliceScheduler gate)")
+    p.add_argument("--slice-capacity", default="",
+                   help='static slice inventory "POOL=N,..." (e.g. '
+                        '"tpu-v5p-slice/2x2x4=4") when the control plane '
+                        "has no Node objects; default derives from Nodes")
     p.add_argument("--max-reconciles", type=int, default=4)
     p.add_argument("--model-image-builder", default="",
                    help="builder image for ModelVersion image builds")
@@ -95,6 +103,8 @@ def config_from_args(args: argparse.Namespace) -> OperatorConfig:
         deploy_region=args.deploy_region,
         dns_domain=args.dns_domain,
         kubectl_delivery_image=args.kubectl_delivery_image,
+        enable_slice_scheduler=args.enable_slice_scheduler,
+        slice_capacity=args.slice_capacity,
     )
 
 

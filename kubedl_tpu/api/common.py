@@ -53,6 +53,20 @@ LABEL_SLICE_INDEX = KUBEDL_PREFIX + "/tpu-slice-index"  # TPU-native: multislice
 
 FINALIZER_PREEMPT_PROTECTOR = KUBEDL_PREFIX + "/preempt-protector"
 
+# slice-scheduler vocabulary (docs/scheduling.md): the engine stamps every
+# PodGroup it creates with the gang's pool / queue / shape so the scheduler
+# (and the console) never have to re-derive them from the owning job
+ANNOTATION_SCHED_POOL = KUBEDL_PREFIX + "/scheduler-pool"
+ANNOTATION_SCHED_QUEUE = KUBEDL_PREFIX + "/scheduler-queue"
+ANNOTATION_SCHED_NUM_SLICES = KUBEDL_PREFIX + "/scheduler-num-slices"
+ANNOTATION_SCHED_PRIORITY = KUBEDL_PREFIX + "/scheduler-priority"
+
+#: PodGroup conditions the slice scheduler owns: ``Admitted`` gates the job
+#: controllers' pod creation; ``Preempted`` marks a gang whose eviction is
+#: in flight (so a scheduling pass never double-preempts it)
+PG_COND_ADMITTED = "Admitted"
+PG_COND_PREEMPTED = "Preempted"
+
 # elastic checkpoint 2-phase protocol (controllers/pytorch/elastic_scale.go:35-39)
 ANNOTATION_CKPT_REQUESTED_VERSION = KUBEDL_PREFIX + "/ckpt-requested-version"
 ANNOTATION_CKPT_COMPLETED_VERSION = KUBEDL_PREFIX + "/ckpt-completed-version"
@@ -87,6 +101,8 @@ RESOURCE_TPU = "google.com/tpu"  # TPU-native analog of nvidia.com/gpu
 # ---------------------------------------------------------------------------
 
 JOB_CREATED = "Created"
+#: Queuing = the gang exists but the slice scheduler has not admitted it;
+#: the job controllers hold off creating pods until admission lands
 JOB_QUEUING = "Queuing"
 JOB_RUNNING = "Running"
 JOB_RESTARTING = "Restarting"

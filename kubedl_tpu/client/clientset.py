@@ -53,6 +53,9 @@ KIND_TABLE = {
     "Deployment": ResourceInfo("Deployment", "apps/v1", "deployments"),
     "Ingress": ResourceInfo("Ingress", "networking.k8s.io/v1", "ingresses"),
     "PodGroup": ResourceInfo("PodGroup", "scheduling.sigs.k8s.io/v1alpha1", "podgroups"),
+    # slice-scheduler tenancy quota (docs/scheduling.md)
+    "Queue": ResourceInfo("Queue", "scheduling.kubedl.io/v1alpha1", "queues",
+                          namespaced=False),
 }
 
 TRAINING_KINDS = tuple(k for k, v in KIND_TABLE.items()

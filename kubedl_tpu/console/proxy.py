@@ -14,13 +14,28 @@ import json
 import logging
 from typing import Optional
 
+from ..api import common as c
+from ..api.queue import DEFAULT_QUEUE, QueueSpec
 from ..client.clientset import TRAINING_KINDS
 from ..core import meta as m
 from ..core.apiserver import APIServer
+from ..scheduling.gang import GANG_POD_LABELS
 from ..storage import dmo
 from ..utils import quota
 from ..storage.backends import (EventBackend, ObjectBackend, Query, _match,
                                 _paginate)
+
+
+def pod_resource_request(pod: dict) -> dict:
+    """Effective resource request of one pod object — the ONE
+    ``quota.pod_request`` rollup every cluster view shares (it used to be
+    re-derived inline in three places)."""
+    return quota.pod_request(pod.get("spec", {}) or {})
+
+
+def pod_tpu_request(pod: dict) -> float:
+    """TPU chips one pod requests (the per-pod slice-occupancy rollup)."""
+    return pod_resource_request(pod).get(c.RESOURCE_TPU, 0)
 
 
 class DataProxy:
@@ -203,8 +218,7 @@ class DataProxy:
             if pod_phase and phase != pod_phase:
                 continue
             count += 1
-            for key, val in quota.pod_request(
-                    pod.get("spec", {}) or {}).items():
+            for key, val in pod_resource_request(pod).items():
                 total[key] = total.get(key, 0) + val
         return {"pods": count, "request": total}
 
@@ -219,11 +233,9 @@ class DataProxy:
             })
         return out
 
-    #: every gang plugin's pod->group membership label (scheduling/gang.py)
-    _GANG_POD_LABELS = (
-        "pod-group.scheduling.sigs.k8s.io/name",     # coscheduler
-        "scheduling.k8s.io/group-name",              # volcano / kube-batch
-    )
+    #: every gang plugin's pod->group membership label, derived from the
+    #: plugin registry in scheduling/gang.py
+    _GANG_POD_LABELS = GANG_POD_LABELS
 
     def cluster_occupancy(self) -> dict:
         """The TPU operator's day-one view (reference ClusterInfo depth,
@@ -231,7 +243,6 @@ class DataProxy:
         slices are gang-held, by whom, how many members are up, how long
         pending gangs have been waiting — plus per-node TPU chips in use
         vs allocatable."""
-        from ..api import common as c
         now = self.api.now() if hasattr(self.api, "now") else None
 
         pods = self.api.list("Pod")
@@ -245,8 +256,7 @@ class DataProxy:
                 p, "status", "phase", default="Pending") == "Running")
             scheduled = sum(1 for p in members
                             if m.get_in(p, "spec", "nodeName"))
-            tpu = sum(quota.pod_request(p.get("spec", {}) or {}).get(
-                "google.com/tpu", 0) for p in members)
+            tpu = sum(pod_tpu_request(p) for p in members)
             phase = "Running" if mm and running >= mm else "Pending"
             age = None
             if phase == "Pending" and now is not None:
@@ -285,8 +295,7 @@ class DataProxy:
                              default={}) or {}
             chips = dmo.parse_quantity(alloc.get("google.com/tpu", 0))
             used = sum(
-                quota.pod_request(p.get("spec", {}) or {}).get(
-                    "google.com/tpu", 0)
+                pod_tpu_request(p)
                 for p in pods
                 if m.get_in(p, "spec", "nodeName") == nname
                 and m.get_in(p, "status", "phase",
@@ -311,3 +320,65 @@ class DataProxy:
             "pendingGangs": sum(1 for g in gangs
                                 if g["phase"] == "Pending"),
         }
+
+    # -- queues (slice scheduler, docs/scheduling.md) ---------------------
+
+    def list_queues(self) -> list:
+        """Per-queue quota + usage table: declared Queue objects (plus the
+        implicit default and any queue PodGroups actually reference), with
+        held/pending gang counts and the TPU chips the queue's pods request
+        (the shared ``pod_tpu_request`` rollup)."""
+        from ..scheduling.gang import is_gang_admitted
+        rows: dict[str, dict] = {}
+
+        def row(name: str, spec: Optional[QueueSpec] = None) -> dict:
+            if name not in rows:
+                spec = spec or QueueSpec(name=name)
+                rows[name] = {
+                    "name": name,
+                    "quotaMin": spec.min,
+                    "quotaMax": spec.max,
+                    "priority": spec.priority,
+                    "tenants": list(spec.tenants),
+                    "heldSlices": 0,
+                    "pendingPodGroups": 0,
+                    "tpuChipsInUse": 0,
+                }
+            return rows[name]
+
+        row(DEFAULT_QUEUE)
+        for obj in self.api.list("Queue"):
+            spec = QueueSpec.from_obj(obj)
+            row(spec.name, spec)
+
+        pg_queue: dict[tuple, str] = {}
+        for pg in self.api.list("PodGroup"):
+            ann = m.get_annotations(pg)
+            qname = ann.get(c.ANNOTATION_SCHED_QUEUE, "") or DEFAULT_QUEUE
+            pg_queue[(m.namespace(pg), m.name(pg))] = qname
+            r = row(qname)
+            if is_gang_admitted(pg):
+                if ann.get(c.ANNOTATION_SCHED_POOL, ""):
+                    r["heldSlices"] += 1
+            else:
+                r["pendingPodGroups"] += 1
+
+        for pod in self.api.list("Pod"):
+            if m.get_in(pod, "status", "phase",
+                        default="Pending") in ("Succeeded", "Failed"):
+                continue
+            lbl = m.get_labels(pod)
+            for key in self._GANG_POD_LABELS:
+                gname = lbl.get(key)
+                if gname:
+                    qname = pg_queue.get((m.namespace(pod), gname))
+                    if qname is not None:
+                        row(qname)["tpuChipsInUse"] += pod_tpu_request(pod)
+                    break
+        return sorted(rows.values(), key=lambda r: r["name"])
+
+    def queue_usage(self, name: str) -> Optional[dict]:
+        for r in self.list_queues():
+            if r["name"] == name:
+                return r
+        return None

@@ -423,6 +423,16 @@ class ConsoleServer:
         if mt:
             return ok(self.proxy.cluster_request(mt.group(1)))
 
+        # slice-scheduler queues: quota + live usage (docs/scheduling.md)
+        if path == "/api/v1/queue/list":
+            return ok(self.proxy.list_queues())
+        mt = re.fullmatch(r"/api/v1/queue/usage/([^/]+)", path)
+        if mt:
+            row = self.proxy.queue_usage(mt.group(1))
+            if row is None:
+                raise NotFound(f"queue {mt.group(1)} not found")
+            return ok(row)
+
         mt = re.fullmatch(r"/api/v1/event/events/([^/]+)/([^/]+)", path)
         if mt:
             ns, name = mt.groups()

@@ -40,7 +40,8 @@ from ..platform.cache import CacheError, reconcile_job_cache
 from ..platform.codesync import inject_code_sync_init_containers
 from ..platform.models import add_model_path_env, build_model_version_spec
 from ..platform.tensorboard import reconcile_tensorboard
-from ..scheduling.gang import GangScheduler
+from ..scheduling import queue as qsched
+from ..scheduling.gang import GangScheduler, is_gang_admitted
 from ..tpu import placement as pl
 from ..utils import status as st
 from ..utils import train
@@ -56,6 +57,11 @@ log = logging.getLogger("kubedl_tpu.engine")
 class EngineConfig:
     enable_gang_scheduling: bool = True
     enable_dag_scheduling: bool = True
+    #: slice-scheduler admission gate (docs/scheduling.md): when True, no
+    #: pod is created until every PodGroup of the job carries the
+    #: scheduler's ``Admitted`` condition — the job waits in its queue
+    #: (``Queuing`` condition) instead of racing pods into the cluster
+    gate_on_gang_admission: bool = False
     dns_domain: str = ""
     default_ttl_seconds: Optional[int] = None
     #: (base, size) for hostnetwork random ports (reference main.go:69
@@ -129,7 +135,10 @@ class JobEngine(Reconciler):
             clock=api.now, timeout=self.config.expectation_timeout)
         self._jitter_rng = random.Random(self.config.backoff_jitter_seed)
         self.kind = controller.kind
-        self.owns = ("Pod", "Service")
+        # PodGroup admission flips must re-trigger the owning job when the
+        # scheduler gate is on (PodGroups are controller-owned by the job)
+        self.owns = ("Pod", "Service") + (
+            ("PodGroup",) if self.config.gate_on_gang_admission else ())
         self._job_states: dict[str, str] = {}  # job uid -> running|pending
         self._tb_jobs: set = set()  # uids that have carried a TB annotation
         self._tb_reap_checked: set = set()  # uids whose TB reap ran at least once
@@ -328,7 +337,10 @@ class JobEngine(Reconciler):
         if self.config.enable_gang_scheduling and self.gang is not None:
             self._retry(lambda: self.gang.create_gang(
                 job, self._gang_min_members(replicas, plan),
-                run_policy.scheduling_policy))
+                run_policy.scheduling_policy,
+                annotations=qsched.gang_annotations(
+                    job, run_policy.scheduling_policy, plan.slice_spec,
+                    plan.num_slices if plan.policy is not None else 1)))
 
         # ---- slice-atomic failover (TPU jobs only) ---------------------
         # A gang-scheduled slice whose member was preempted/killed is a
@@ -359,6 +371,37 @@ class JobEngine(Reconciler):
                 # still get their pods recreated on time
                 slice_wait, slice_frozen = dec.requeue, dec.frozen
                 slice_wait_msg = dec.message
+
+        # ---- slice-scheduler admission gate ----------------------------
+        # pods are never created ahead of admission: the job sits Queuing
+        # until the scheduler stamps every PodGroup Admitted. Placed after
+        # the failover block on purpose — a preempted slice must finish its
+        # teardown (which deletes the PodGroup via readmit_slice) before
+        # the gate sees the recreated, un-admitted gang and parks the job
+        if self.config.gate_on_gang_admission \
+                and self.config.enable_gang_scheduling and self.gang is not None:
+            waiting = [m.name(g) for g in self.gang.get_gangs(job)
+                       if not is_gang_admitted(g)]
+            if waiting:
+                st.update_job_conditions(
+                    status, c.JOB_QUEUING, st.REASON_JOB_QUEUING,
+                    f"{self.kind} {req.name} waiting for gang admission "
+                    f"({len(waiting)} PodGroup(s) pending)",
+                    now=self.api.now())
+                self._recount_replica_statuses(status, replicas, pods)
+                flushed = self._flush_status(job, status, old_status)
+                # admission flips re-trigger via the PodGroup watch; the
+                # timed requeue is the safety net for a dropped event (a
+                # failed flush polls faster)
+                return Result(requeue_after=5.0 if flushed else 1.0)
+            for cond in status.conditions:
+                # admitted: the queue wait is over even though pods are
+                # only now being created (Running flips it too, but the
+                # gap between admission and first pod running should not
+                # read as still-queued)
+                if cond.type == c.JOB_QUEUING and cond.status == "True":
+                    cond.status = "False"
+                    cond.message = "gang admitted"
 
         # ---- elastic scaling hook --------------------------------------
         # scale_out/scale_in may return a requeue delay while waiting to

@@ -64,6 +64,15 @@ class OperatorConfig:
     event_storage: str = ""
     #: physical region stamped into persisted records (DeployRegion)
     deploy_region: str = ""
+    #: multi-tenant slice scheduler (queues / elastic quota / preemption /
+    #: backfill, docs/scheduling.md). Also switchable via the
+    #: TPUSliceScheduler feature gate; either turns it on. Requires gang
+    #: scheduling (the PodGroup is the admission unit).
+    enable_slice_scheduler: bool = False
+    #: static slice capacity "POOL=N,..." (e.g.
+    #: "tpu-v5p-slice/2x2x4=4") for control planes without Node objects;
+    #: empty = derive from Nodes ($KUBEDL_SLICE_CAPACITY overrides)
+    slice_capacity: str = ""
 
 
 @dataclass
@@ -76,6 +85,8 @@ class Operator:
     object_backend: object = None
     event_backend: object = None
     admission: object = None
+    #: the SliceScheduler when enabled (None otherwise)
+    scheduler: object = None
 
     def run_until_idle(self, **kw):
         return self.manager.run_until_idle(**kw)
@@ -104,13 +115,17 @@ def build_operator(api: Optional[APIServer] = None,
     gang = (new_gang_scheduler(config.gang_scheduler_name, api)
             if config.gang_scheduler_name
             and gates.enabled(ft.GANG_SCHEDULING) else None)
+    sched_enabled = gang is not None and (
+        config.enable_slice_scheduler
+        or gates.enabled(ft.TPU_SLICE_SCHEDULER))
     engine_config = EngineConfig(
         enable_gang_scheduling=gang is not None,
         enable_dag_scheduling=(config.enable_dag_scheduling
                                and gates.enabled(ft.DAG_SCHEDULING)),
         dns_domain=config.dns_domain,
         hostnetwork_port_range=config.hostnetwork_port_range,
-        hostnet_with_headless_svc=gates.enabled(ft.HOSTNET_WITH_HEADLESS_SVC))
+        hostnet_with_headless_svc=gates.enabled(ft.HOSTNET_WITH_HEADLESS_SVC),
+        gate_on_gang_admission=sched_enabled)
 
     engines = {}
     enabled = set(config.workloads) if config.workloads is not None else None
@@ -146,6 +161,22 @@ def build_operator(api: Optional[APIServer] = None,
     # control plane (no kube-controller-manager underneath in standalone)
     manager.register(DeploymentReconciler(api))
 
+    # multi-tenant slice scheduler (docs/scheduling.md): owns admission of
+    # gangs to slice capacity; the engines above gate pod creation on it
+    scheduler = None
+    if sched_enabled:
+        from ..metrics.registry import SchedulerMetrics
+        from ..scheduling.inventory import SliceInventory, parse_capacity_spec
+        from ..scheduling.scheduler import SliceScheduler
+        cap_spec = (os.environ.get("KUBEDL_SLICE_CAPACITY", "")
+                    or config.slice_capacity)
+        inventory = SliceInventory(
+            api, static_capacity=parse_capacity_spec(cap_spec))
+        scheduler = SliceScheduler(api, inventory=inventory,
+                                   metrics=SchedulerMetrics(registry),
+                                   recorder=recorder)
+        manager.register(scheduler)
+
     # admission chain: defaulting + validation at create/update (reference
     # config/webhook/ registers the same as webhooks; in standalone mode
     # the in-memory api-server runs it inline)
@@ -170,7 +201,8 @@ def build_operator(api: Optional[APIServer] = None,
     return Operator(api=api, manager=manager, engines=engines,
                     metrics_registry=registry, config=config,
                     object_backend=object_backend,
-                    event_backend=event_backend, admission=admission)
+                    event_backend=event_backend, admission=admission,
+                    scheduler=scheduler)
 
 
 def _storage_backend(spec: str, for_events: bool = False):

@@ -19,6 +19,10 @@ HOSTNET_WITH_HEADLESS_SVC = "HostNetWithHeadlessSvc"
 # TPU-native gates
 TPU_MULTISLICE = "TPUMultislice"          # allow numSlices > 1 (DCN megascale env)
 JAX_PROFILER_UPLOAD = "JAXProfilerUpload"  # render XProf profile-dir env
+#: multi-tenant slice scheduler (queues/quota/preemption/backfill,
+#: docs/scheduling.md); off by default so the pre-scheduler behavior —
+#: every gang races pod creation — is preserved until opted into
+TPU_SLICE_SCHEDULER = "TPUSliceScheduler"
 
 _DEFAULTS = {
     GANG_SCHEDULING: True,           # Beta
@@ -27,6 +31,7 @@ _DEFAULTS = {
     HOSTNET_WITH_HEADLESS_SVC: False,  # Alpha
     TPU_MULTISLICE: True,
     JAX_PROFILER_UPLOAD: False,
+    TPU_SLICE_SCHEDULER: False,      # Alpha
 }
 
 ENV_FEATURE_GATES = "KUBEDL_FEATURE_GATES"

@@ -1,4 +1,4 @@
 """Prometheus-style metrics (counters/gauges/histograms + text exposition)."""
 
 from .registry import (ControlPlaneMetrics, Counter, Gauge,  # noqa: F401
-                       Histogram, JobMetrics, Registry)
+                       Histogram, JobMetrics, Registry, SchedulerMetrics)

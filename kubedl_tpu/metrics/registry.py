@@ -227,6 +227,55 @@ class PagedKVMetrics:
             self._preempt_seen = stats["preempted"]
 
 
+#: queue waits span sub-second test admissions to hours of real quota
+#: starvation; reuse launch-delay-style buckets with a short head
+_QUEUE_WAIT_BUCKETS = (0.1, 0.5, 1, 5, 15, 60, 300, 900, 1800, 3600,
+                       7200, 14400, 43200)
+
+
+class SchedulerMetrics:
+    """Slice-scheduler instrumentation (docs/scheduling.md): pending work
+    per queue, admission/preemption/backfill counters, the queue-wait
+    histogram, and the inventory resync health pair (a rising drift count
+    means watch events are being lost faster than resyncs repair them)."""
+
+    def __init__(self, registry: Optional[Registry] = None):
+        self.registry = registry or Registry()
+        r = self.registry
+        self.pending_gangs = r.gauge(
+            "kubedl_scheduler_pending_gangs",
+            "Complete gangs waiting for admission, per queue", ("queue",))
+        self.held_slices = r.gauge(
+            "kubedl_scheduler_held_slices",
+            "Slices held by admitted gangs, per queue", ("queue",))
+        self.free_slices = r.gauge(
+            "kubedl_scheduler_free_slices",
+            "Unheld slices per pool (pools with known capacity)", ("pool",))
+        self.admitted = r.counter(
+            "kubedl_scheduler_admitted_total",
+            "Gangs admitted, per queue", ("queue",))
+        self.preempted = r.counter(
+            "kubedl_scheduler_preempted_total",
+            "Gangs evicted to reclaim min quota, per victim queue",
+            ("queue",))
+        self.backfills = r.counter(
+            "kubedl_scheduler_backfills_total",
+            "Admissions that jumped a capacity-blocked queue head",
+            ("queue",))
+        self.passes = r.counter(
+            "kubedl_scheduler_passes_total", "Scheduling passes run")
+        self.resyncs = r.counter(
+            "kubedl_scheduler_inventory_resyncs_total",
+            "Full inventory rescans performed")
+        self.drift = r.counter(
+            "kubedl_scheduler_inventory_drift_total",
+            "Rescans that found divergence (lost watch events repaired)")
+        self.queue_wait = r.histogram(
+            "kubedl_scheduler_queue_wait_seconds",
+            "Gang creation to admission, per queue", ("queue",),
+            buckets=_QUEUE_WAIT_BUCKETS)
+
+
 class JobMetrics:
     """The reference's per-kind job metric set (``pkg/metrics/job_metrics.go``)."""
 

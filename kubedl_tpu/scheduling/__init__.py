@@ -1,6 +1,8 @@
-"""Scheduling & placement: gang schedulers (PodGroup per TPU slice)."""
+"""Scheduling & placement: gang schedulers (PodGroup per TPU slice) and
+the multi-tenant slice scheduler (queues / elastic quota / preemption /
+backfill — docs/scheduling.md)."""
 
 from .gang import (  # noqa: F401
     GangScheduler, CoschedulerPlugin, VolcanoPlugin, KubeBatchPlugin,
-    gang_registry, new_gang_scheduler,
+    gang_registry, is_gang_admitted, is_gang_preempted, new_gang_scheduler,
 )

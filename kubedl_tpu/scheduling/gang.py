@@ -25,6 +25,41 @@ def gang_name(job_name: str, slice_id: int = 0, num_slices: int = 1) -> str:
     return job_name if num_slices <= 1 else f"{job_name}-slice-{slice_id}"
 
 
+# -- PodGroup condition vocabulary (the slice scheduler's write surface) ----
+
+def pg_has_condition(pg: dict, cond_type: str) -> bool:
+    for cond in m.get_in(pg, "status", "conditions", default=[]) or []:
+        if cond.get("type") == cond_type and cond.get("status", "True") == "True":
+            return True
+    return False
+
+
+def is_gang_admitted(pg: dict) -> bool:
+    """True when the slice scheduler has granted this gang its slice; the
+    job controllers gate pod creation on it (engine ``gate_on_gang_admission``)."""
+    return pg_has_condition(pg, c.PG_COND_ADMITTED)
+
+
+def is_gang_preempted(pg: dict) -> bool:
+    """True while a scheduler-initiated eviction of this gang is in flight
+    (pods marked DisruptionTarget, slice-atomic teardown pending)."""
+    return pg_has_condition(pg, c.PG_COND_PREEMPTED)
+
+
+def set_gang_condition(pg: dict, cond_type: str, reason: str = "",
+                       message: str = "", now: float = None) -> None:
+    """Idempotently set one True condition on a (mutable) PodGroup copy."""
+    conds = pg.setdefault("status", {}).setdefault("conditions", [])
+    for cond in conds:
+        if cond.get("type") == cond_type:
+            cond["status"] = "True"
+            cond["reason"] = reason or cond.get("reason", "")
+            return
+    conds.append({"type": cond_type, "status": "True", "reason": reason,
+                  "message": message,
+                  "lastTransitionTime": m.rfc3339(now)})
+
+
 class GangScheduler:
     """Interface (reference ``interface.go:33-57``)."""
 
@@ -40,11 +75,15 @@ class GangScheduler:
     # -- lifecycle --------------------------------------------------------
 
     def create_gang(self, job: dict, min_members: list[int],
-                    policy: Optional[SchedulingPolicy] = None) -> list[dict]:
+                    policy: Optional[SchedulingPolicy] = None,
+                    annotations: Optional[dict] = None) -> list[dict]:
         """Ensure one PodGroup per slice exists; returns them.
 
         ``min_members[i]`` is the pod count required for slice i's gang to
         go (hosts-per-slice, plus non-TPU roles folded into slice 0).
+        ``annotations`` (the scheduler pool/queue/priority stamps) are set
+        on creation and reconciled on existing groups, so a job moved to a
+        new queue re-routes without recreating its gangs.
         """
         groups = []
         n = len(min_members)
@@ -52,14 +91,23 @@ class GangScheduler:
             name = gang_name(m.name(job), sid, n)
             existing = self.api.try_get(self.pod_group_kind, m.namespace(job), name)
             if existing is not None:
+                changed = False
                 if self._min_member_of(existing) != mm:
                     self._set_min_member(existing, mm)
+                    changed = True
+                if annotations and any(
+                        m.get_annotations(existing).get(k) != v
+                        for k, v in annotations.items()):
+                    m.annotations(existing).update(annotations)
+                    changed = True
+                if changed:
                     existing = self.api.update(existing)
                 groups.append(existing)
                 continue
             pg = m.new_obj(self.pod_group_api_version, self.pod_group_kind,
                            name, m.namespace(job),
-                           labels={c.LABEL_GANG_JOB_NAME: m.name(job)})
+                           labels={c.LABEL_GANG_JOB_NAME: m.name(job)},
+                           annotations=annotations)
             pg["spec"] = self._pod_group_spec(mm, policy)
             m.set_controller_ref(pg, job)
             try:
@@ -167,6 +215,12 @@ class KubeBatchPlugin(GangScheduler):
 
 
 gang_registry = {p.name: p for p in (CoschedulerPlugin, VolcanoPlugin, KubeBatchPlugin)}
+
+#: every plugin's pod→group membership label, derived from the registry so
+#: a new plugin cannot silently desync the slice scheduler's victim-pod
+#: lookup or the console's gang/queue tables
+GANG_POD_LABELS = tuple(dict.fromkeys(
+    p.pod_group_label for p in gang_registry.values()))
 
 
 def new_gang_scheduler(name: str, api: APIServer) -> GangScheduler:

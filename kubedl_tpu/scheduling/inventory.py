@@ -1,0 +1,275 @@
+"""Slice inventory: TPU capacity and admitted-gang usage per node pool.
+
+The scheduler's ground truth about the cluster, kept with the same index
+discipline as the API server's read path (docs/control-plane-perf.md):
+state is maintained *incrementally* from watch events — Node events move
+pool capacity, PodGroup events move the held set — so a scheduling pass
+never lists the world. A from-scratch :meth:`rescan` exists for two jobs:
+the parity check that keeps the incremental bookkeeping honest (the
+``KUBEDL_LIST_MODE=parity`` analog) and the periodic :meth:`resync` that
+reconverges the inventory after dropped watch events (chaos / real
+informer relists).
+
+A **pool** is one ``(gke-accelerator, topology)`` node-pool shape, keyed
+``"tpu-v5p-slice/2x2x4"``. Capacity is denominated in slices: each Node
+carrying the GKE TPU labels contributes one host; ``hosts //
+hosts_per_slice`` whole slices are schedulable (``tpu/topology.py`` owns
+the host math). Usage is one slice per *admitted* PodGroup (the gang layer
+already guarantees one PodGroup per slice). A pool with no Nodes and no
+static capacity entry has **unknown** capacity and is treated as
+unlimited — the scheduler then only enforces queue quota, which is what
+lets the subsystem run against control planes that don't model Nodes.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, replace
+from typing import Optional
+
+from ..api import common as c
+from ..core import meta as m
+from ..tpu import topology
+from .gang import is_gang_admitted
+
+#: GKE node labels that identify a TPU node pool (tpu/placement renders
+#: the same pair as pod nodeSelectors)
+GKE_ACCELERATOR_LABEL = "cloud.google.com/gke-tpu-accelerator"
+GKE_TOPOLOGY_LABEL = "cloud.google.com/gke-tpu-topology"
+
+_BY_GKE_ACCEL = {g.gke_accelerator: g for g in topology.GENERATIONS.values()}
+
+
+class SchedulerParityError(AssertionError):
+    """Incremental inventory disagrees with a from-scratch rescan — an
+    inventory-maintenance bug (or genuinely lost watch events; chaos tests
+    distinguish the two by whether a resync repairs it)."""
+
+
+def pool_key(accelerator: str, topo: str) -> str:
+    return f"{accelerator}/{topo}"
+
+
+def hosts_per_slice(pool: str) -> int:
+    """Hosts one slice of this pool occupies (1 when the pool shape is
+    unknown — degrade to per-node slices rather than refusing to count)."""
+    accel, _, topo = pool.partition("/")
+    gen = _BY_GKE_ACCEL.get(accel)
+    if gen is None or not topo:
+        return 1
+    try:
+        return topology.parse_topology(gen.name, topo).num_hosts
+    except (ValueError, KeyError):
+        return 1
+
+
+def parse_capacity_spec(spec: str) -> dict:
+    """``"tpu-v5p-slice/2x2x4=4,tpu-v5e-lite-podslice/4x4=8"`` → static
+    slice capacity per pool (``--slice-capacity`` / KUBEDL_SLICE_CAPACITY),
+    for control planes that don't model Nodes."""
+    out: dict[str, int] = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        pool, _, n = part.rpartition("=")
+        if not pool:
+            raise ValueError(f"slice capacity entry {part!r} is not POOL=N")
+        out[pool] = int(n)
+    return out
+
+
+@dataclass(frozen=True)
+class HeldSlice:
+    """One admitted PodGroup = one held slice."""
+    namespace: str
+    name: str
+    pool: str
+    queue: str
+    job: str
+    priority: int
+    admitted_at: float  # creationTimestamp (FIFO/victim ordering)
+    preempted: bool = False  # eviction in flight; still holds its slice
+
+
+def _held_from_pg(pg: dict) -> Optional[HeldSlice]:
+    if not is_gang_admitted(pg):
+        return None
+    ann = m.get_annotations(pg)
+    pool = ann.get(c.ANNOTATION_SCHED_POOL, "")
+    if not pool:
+        return None  # non-TPU gang: holds no slice
+    from .gang import is_gang_preempted
+    try:
+        prio = int(ann.get(c.ANNOTATION_SCHED_PRIORITY, "0") or 0)
+    except ValueError:
+        prio = 0
+    return HeldSlice(
+        namespace=m.namespace(pg), name=m.name(pg), pool=pool,
+        queue=ann.get(c.ANNOTATION_SCHED_QUEUE, "") or "default",
+        job=m.get_labels(pg).get(c.LABEL_GANG_JOB_NAME, m.name(pg)),
+        priority=prio,
+        admitted_at=m.parse_rfc3339(
+            m.meta(pg).get("creationTimestamp")) or 0.0,
+        preempted=is_gang_preempted(pg))
+
+
+def _node_pool_of(node: dict) -> Optional[str]:
+    lbl = m.get_labels(node)
+    accel = lbl.get(GKE_ACCELERATOR_LABEL)
+    topo = lbl.get(GKE_TOPOLOGY_LABEL)
+    if not accel or not topo:
+        return None
+    return pool_key(accel, topo)
+
+
+class SliceInventory:
+    """Thread-safe incremental pool capacity + held-slice tracker."""
+
+    def __init__(self, api=None, static_capacity: Optional[dict] = None):
+        self._lock = threading.Lock()
+        self.static_capacity = dict(static_capacity or {})
+        self._node_pool: dict[str, str] = {}    # node name -> pool
+        self._hosts: dict[str, int] = {}        # pool -> live host count
+        self._held: dict[tuple, HeldSlice] = {}  # (ns, pg-name) -> record
+        self._api = api
+        if api is not None:
+            api.watch(self.observe)
+
+    # -- incremental maintenance (watch-event fed) ------------------------
+
+    def observe(self, event_type: str, obj: dict) -> None:
+        kd = m.kind(obj)
+        if kd == "Node":
+            self._observe_node(event_type, obj)
+        elif kd == "PodGroup":
+            self._observe_pg(event_type, obj)
+
+    def _observe_node(self, event_type: str, node: dict) -> None:
+        name = m.name(node)
+        pool = None if event_type == "DELETED" else _node_pool_of(node)
+        with self._lock:
+            old = self._node_pool.pop(name, None)
+            if old is not None:
+                left = self._hosts.get(old, 0) - 1
+                if left > 0:
+                    self._hosts[old] = left
+                else:
+                    self._hosts.pop(old, None)
+            if pool is not None:
+                self._node_pool[name] = pool
+                self._hosts[pool] = self._hosts.get(pool, 0) + 1
+
+    def _observe_pg(self, event_type: str, pg: dict) -> None:
+        key = (m.namespace(pg), m.name(pg))
+        rec = None if event_type == "DELETED" else _held_from_pg(pg)
+        with self._lock:
+            if rec is not None:
+                self._held[key] = rec
+            else:
+                self._held.pop(key, None)
+
+    def mark_admitted(self, pg: dict) -> None:
+        """Synchronous update at admission time — correctness must not ride
+        on the watch event making it back (it may be chaos-dropped)."""
+        rec = _held_from_pg(pg)
+        if rec is not None:
+            with self._lock:
+                self._held[(rec.namespace, rec.name)] = rec
+
+    def mark_preempted(self, namespace: str, name: str) -> None:
+        with self._lock:
+            rec = self._held.get((namespace, name))
+            if rec is not None and not rec.preempted:
+                self._held[(namespace, name)] = replace(rec, preempted=True)
+
+    # -- reads ------------------------------------------------------------
+
+    def capacity_slices(self, pool: str) -> Optional[int]:
+        """Whole slices this pool can host; None = unknown (unlimited)."""
+        with self._lock:
+            if pool in self.static_capacity:
+                return int(self.static_capacity[pool])
+            hosts = self._hosts.get(pool)
+        if hosts is None:
+            return None
+        return hosts // hosts_per_slice(pool)
+
+    def held_slices(self, pool: str) -> int:
+        with self._lock:
+            return sum(1 for h in self._held.values() if h.pool == pool)
+
+    def free_slices(self, pool: str) -> Optional[int]:
+        cap = self.capacity_slices(pool)
+        if cap is None:
+            return None
+        return max(cap - self.held_slices(pool), 0)
+
+    def held_records(self) -> list:
+        with self._lock:
+            return list(self._held.values())
+
+    def held_by_queue(self) -> dict:
+        out: dict[str, int] = {}
+        for h in self.held_records():
+            out[h.queue] = out.get(h.queue, 0) + 1
+        return out
+
+    def pools(self) -> set:
+        with self._lock:
+            return set(self.static_capacity) | set(self._hosts) \
+                | {h.pool for h in self._held.values()}
+
+    # -- rescan / parity / resync ----------------------------------------
+
+    def _scan(self, api) -> tuple:
+        """From-scratch (node_pool, held) maps — the ground truth the
+        incremental state must match."""
+        node_pool = {}
+        for node in api.list("Node"):
+            pool = _node_pool_of(node)
+            if pool is not None:
+                node_pool[m.name(node)] = pool
+        held = {}
+        for pg in api.list("PodGroup"):
+            rec = _held_from_pg(pg)
+            if rec is not None:
+                held[(rec.namespace, rec.name)] = rec
+        return node_pool, held
+
+    def drift(self, api=None) -> dict:
+        """Divergence between incremental state and a from-scratch scan;
+        empty dict = converged (the parity-style full-rescan check)."""
+        api = api or self._api
+        node_pool, held = self._scan(api)
+        with self._lock:
+            out = {}
+            if node_pool != self._node_pool:
+                out["nodes"] = {"incremental": dict(self._node_pool),
+                                "scan": node_pool}
+            if held != self._held:
+                out["held"] = {
+                    "incremental": sorted(self._held),
+                    "scan": sorted(held)}
+            return out
+
+    def check_parity(self, api=None) -> None:
+        d = self.drift(api)
+        if d:
+            raise SchedulerParityError(
+                f"slice inventory diverged from full rescan: {d}")
+
+    def resync(self, api=None) -> bool:
+        """Replace incremental state with a from-scratch scan; returns True
+        when the scan found drift (lost watch events repaired)."""
+        api = api or self._api
+        node_pool, held = self._scan(api)
+        with self._lock:
+            drifted = node_pool != self._node_pool or held != self._held
+            self._node_pool = node_pool
+            hosts: dict[str, int] = {}
+            for pool in node_pool.values():
+                hosts[pool] = hosts.get(pool, 0) + 1
+            self._hosts = hosts
+            self._held = held
+        return drifted

@@ -1,0 +1,66 @@
+"""Queue resolution: which queue a job's gangs wait in, and the PodGroup
+annotations that carry the scheduling facts (pool / queue / shape /
+priority) from the job controllers to the slice scheduler.
+
+Routing order (docs/scheduling.md):
+
+1. ``runPolicy.schedulingPolicy.queue`` — the explicit Volcano-shaped seam
+   the reference already passes through (``volcano_scheduler.go:54-189``);
+2. the ``kubedl.io/tenancy`` annotation's ``tenant`` (``utils/tenancy``) —
+   multi-tenant clusters route by attribution without touching job specs;
+3. the implicit ``default`` queue.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..api import common as c
+from ..api.common import SchedulingPolicy
+from ..api.queue import DEFAULT_QUEUE, IMPLICIT_DEFAULT, QueueSpec
+from ..core import meta as m
+from ..utils import tenancy
+
+
+def job_queue_name(job: dict,
+                   policy: Optional[SchedulingPolicy] = None) -> str:
+    if policy is not None and policy.queue:
+        return policy.queue
+    try:
+        ten = tenancy.get_tenancy(job)
+    except ValueError:
+        ten = None  # malformed tenancy must not wedge scheduling
+    if ten is not None and ten.tenant:
+        return ten.tenant
+    return DEFAULT_QUEUE
+
+
+def gang_annotations(job: dict, policy: Optional[SchedulingPolicy],
+                     slice_spec=None, num_slices: int = 1) -> dict:
+    """The stamps ``GangScheduler.create_gang`` writes on every PodGroup.
+
+    ``slice_spec`` is the job's resolved ``tpu.topology.SliceSpec`` (None
+    for CPU-only gangs, which hold no slice and carry an empty pool)."""
+    pool = ""
+    if slice_spec is not None:
+        pool = f"{slice_spec.gke_accelerator}/{slice_spec.topology_str}"
+    priority = 0
+    if policy is not None and policy.priority is not None:
+        priority = int(policy.priority)
+    return {
+        c.ANNOTATION_SCHED_POOL: pool,
+        c.ANNOTATION_SCHED_QUEUE: job_queue_name(job, policy),
+        c.ANNOTATION_SCHED_NUM_SLICES: str(max(int(num_slices or 1), 1)),
+        c.ANNOTATION_SCHED_PRIORITY: str(priority),
+    }
+
+
+def load_queue_specs(api) -> dict:
+    """Name → QueueSpec for every Queue object, plus the implicit default.
+    (The scheduler keeps its own incremental cache; this is the scan path
+    used by rescans and the console.)"""
+    out = {DEFAULT_QUEUE: IMPLICIT_DEFAULT}
+    for obj in api.list("Queue"):
+        spec = QueueSpec.from_obj(obj)
+        out[spec.name] = spec
+    return out

@@ -5,24 +5,50 @@ capacity checks."""
 
 from __future__ import annotations
 
+import math
+
 from ..api import common as c
 from ..core import meta as m
 
+#: the full k8s suffix table (apimachinery ``resource.Quantity``):
+#: decimalSI m/k/M/G/T/P/E and binarySI Ki..Ei. ``E`` (exa) is a suffix
+#: only when it terminates the string — ``12E6`` is the decimalExponent
+#: form (12 x 10^6), handled by the plain-float path below.
+_SUFFIXES = {
+    "m": 1e-3, "k": 1e3, "M": 1e6, "G": 1e9, "T": 1e12, "P": 1e15,
+    "E": 1e18,
+    "Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40, "Pi": 2**50,
+    "Ei": 2**60,
+}
+#: binary suffixes first so "Ei"/"Ki"... win over the bare decimal suffix
+#: their final letter would otherwise match
+_SUFFIX_ORDER = ("Ki", "Mi", "Gi", "Ti", "Pi", "Ei",
+                 "m", "k", "M", "G", "T", "P", "E")
+
 
 def parse_quantity(v) -> float:
-    """Parse a k8s resource quantity ("2", "500m", "10Gi") to a float in
-    base units (cores / bytes / chips)."""
+    """Parse a k8s resource quantity to a float in base units (cores /
+    bytes / chips): plain and signed numbers ("2", "-3", "1.5"),
+    decimalExponent forms ("123e6", "1E2"), decimalSI ("500m", "10k",
+    "2M".."3E") and binarySI ("10Ki".."2Ei") suffixes. Raises ValueError
+    on anything else (including inf/nan, which are not quantities)."""
     if isinstance(v, (int, float)):
         return float(v)
     s = str(v).strip()
-    suffixes = {
-        "m": 1e-3, "k": 1e3, "M": 1e6, "G": 1e9, "T": 1e12, "P": 1e15,
-        "Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40, "Pi": 2**50,
-    }
-    for suf in ("Ki", "Mi", "Gi", "Ti", "Pi", "m", "k", "M", "G", "T", "P"):
+    for suf in _SUFFIX_ORDER:
         if s.endswith(suf):
-            return float(s[: -len(suf)]) * suffixes[suf]
-    return float(s)
+            try:
+                num = float(s[: -len(suf)])
+            except ValueError:
+                break  # suffix matched but the prefix is not a number
+                       # ("xKi"): let the plain parse raise on the whole
+            if math.isinf(num) or math.isnan(num):
+                raise ValueError(f"invalid k8s quantity {v!r}")
+            return num * _SUFFIXES[suf]
+    f = float(s)  # ValueError on garbage propagates
+    if math.isinf(f) or math.isnan(f):
+        raise ValueError(f"invalid k8s quantity {v!r}")
+    return f
 
 
 def sum_containers(containers: list) -> dict:

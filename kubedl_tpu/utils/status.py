@@ -16,6 +16,7 @@ from ..api.common import JobCondition, JobStatus
 from ..core.meta import rfc3339
 
 REASON_JOB_CREATED = "JobCreated"
+REASON_JOB_QUEUING = "JobQueuing"
 REASON_JOB_SUCCEEDED = "JobSucceeded"
 REASON_JOB_RUNNING = "JobRunning"
 REASON_JOB_FAILED = "JobFailed"
@@ -52,6 +53,10 @@ def is_created(status: JobStatus) -> bool:
 
 def is_restarting(status: JobStatus) -> bool:
     return has_condition(status, c.JOB_RESTARTING)
+
+
+def is_queuing(status: JobStatus) -> bool:
+    return has_condition(status, c.JOB_QUEUING)
 
 
 def is_evicted(status: JobStatus) -> bool:
@@ -93,6 +98,11 @@ def _filter_out(conditions: list, cond_type: str) -> list:
         if cond.type == cond_type:
             continue
         if cond_type in (c.JOB_FAILED, c.JOB_SUCCEEDED) and cond.type == c.JOB_RUNNING:
+            cond = JobCondition(**{**cond.__dict__, "status": "False"})
+        # leaving the queue (running/restarting/terminal) ends Queuing
+        if cond_type in (c.JOB_RUNNING, c.JOB_RESTARTING, c.JOB_FAILED,
+                         c.JOB_SUCCEEDED) and cond.type == c.JOB_QUEUING \
+                and cond.status == "True":
             cond = JobCondition(**{**cond.__dict__, "status": "False"})
         out.append(cond)
     return out

@@ -269,6 +269,60 @@ def test_cluster_occupancy(api, clock):
         server.stop()
 
 
+@pytest.mark.scheduler
+def test_queue_endpoints(api, clock):
+    """The slice-scheduler queue table (docs/scheduling.md): declared
+    Queue quota, held/pending gang counts, and the TPU-chip rollup riding
+    the shared ``pod_tpu_request`` helper."""
+    from kubedl_tpu.api.queue import new_queue
+    op = build_operator(api, OperatorConfig(
+        workloads=["JAXJob"], enable_slice_scheduler=True,
+        slice_capacity="tpu-v5-lite-podslice/2x4=1"))
+    api.create(new_queue("tenant-a", min=1, max=2, priority=50,
+                         tenants=["a"]))
+    proxy = DataProxy(api)
+    from kubedl_tpu.console import ConsoleConfig, ConsoleServer
+    server = ConsoleServer(proxy, ConsoleConfig(
+        port=0, users={"admin": "kubedl"})).start()
+    client = Client(server.url)
+    try:
+        login(client)
+        for i, (name, queue) in enumerate(
+                [("qa", "tenant-a"), ("qb", "tenant-a")]):
+            job = m.new_obj(
+                "training.kubedl.io/v1alpha1", "JAXJob", name,
+                spec={"tpuPolicy": {"generation": "v5e",
+                                    "topology": "2x4"},
+                      "schedulingPolicy": {"queue": queue},
+                      "jaxReplicaSpecs": {"Worker": {
+                          "replicas": 1, "template": {"spec": {
+                              "containers": [{
+                                  "name": "jax", "image": "i",
+                                  "resources": {"limits": {
+                                      "google.com/tpu": "8"}}}]}}}}})
+            api.create(job)
+        op.run_until_idle(max_iterations=2000)
+
+        status, body = client.req("GET", "/api/v1/queue/list")
+        assert status == 200
+        rows = {r["name"]: r for r in body["data"]}
+        assert "default" in rows
+        ta = rows["tenant-a"]
+        assert ta["quotaMin"] == 1 and ta["quotaMax"] == 2
+        assert ta["priority"] == 50 and ta["tenants"] == ["a"]
+        # capacity 1 slice: one gang admitted with live pods, one queued
+        assert ta["heldSlices"] == 1
+        assert ta["pendingPodGroups"] == 1
+        assert ta["tpuChipsInUse"] == 8.0  # 1 single-host worker x 8 chips
+
+        status, body = client.req("GET", "/api/v1/queue/usage/tenant-a")
+        assert status == 200 and body["data"]["name"] == "tenant-a"
+        status, _ = client.req("GET", "/api/v1/queue/usage/nope")
+        assert status == 404
+    finally:
+        server.stop()
+
+
 def test_frontend_served(stack):
     op, client = stack
     status, text = client.req("GET", "/", raw=True)

@@ -1,0 +1,104 @@
+"""Gang layer edge paths the slice scheduler now leans on (ISSUE 4
+satellite): min-member *updates* on existing PodGroups, annotation
+reconciliation, and multi-slice ``gang_name``/``readmit_slice``
+round-trips."""
+
+import pytest
+
+from kubedl_tpu.api import common as c
+from kubedl_tpu.api.common import SchedulingPolicy
+from kubedl_tpu.controllers.testing import new_test_job
+from kubedl_tpu.core import meta as m
+from kubedl_tpu.scheduling.gang import (CoschedulerPlugin, VolcanoPlugin,
+                                        gang_name, is_gang_admitted,
+                                        set_gang_condition)
+
+
+@pytest.fixture
+def gang(api):
+    return CoschedulerPlugin(api)
+
+
+@pytest.fixture
+def job(api):
+    return api.create(new_test_job("tj", workers=4))
+
+
+def test_gang_name_round_trips():
+    assert gang_name("j") == "j"
+    assert gang_name("j", 0, 1) == "j"
+    assert gang_name("j", 0, 2) == "j-slice-0"
+    assert gang_name("j", 3, 4) == "j-slice-3"
+
+
+def test_create_gang_updates_min_member_in_place(api, gang, job):
+    [pg] = gang.create_gang(job, [4])
+    uid = m.uid(pg)
+    assert pg["spec"]["minMember"] == 4
+    # an elastic resize changes the required member count: the existing
+    # PodGroup is UPDATED (same uid), never recreated — recreating would
+    # drop the scheduler's Admitted condition and bounce the job back
+    # through the queue
+    [pg2] = gang.create_gang(job, [6])
+    assert m.uid(pg2) == uid
+    assert pg2["spec"]["minMember"] == 6
+    assert m.resource_version(pg2) > m.resource_version(pg)
+    # idempotent: same min -> no write
+    [pg3] = gang.create_gang(job, [6])
+    assert m.resource_version(pg3) == m.resource_version(pg2)
+
+
+def test_create_gang_preserves_admitted_condition_across_update(api, gang, job):
+    [pg] = gang.create_gang(job, [4])
+    live = api.get("PodGroup", "default", "tj")
+    set_gang_condition(live, c.PG_COND_ADMITTED, "GangAdmitted")
+    api.update_status(live)
+    [pg2] = gang.create_gang(job, [6], annotations={
+        c.ANNOTATION_SCHED_QUEUE: "tenant-a"})
+    assert pg2["spec"]["minMember"] == 6
+    assert is_gang_admitted(api.get("PodGroup", "default", "tj"))
+    assert m.get_annotations(
+        api.get("PodGroup", "default", "tj"))[c.ANNOTATION_SCHED_QUEUE] \
+        == "tenant-a"
+
+
+def test_create_gang_reconciles_changed_annotations(api, gang, job):
+    ann = {c.ANNOTATION_SCHED_QUEUE: "alpha", c.ANNOTATION_SCHED_POOL: "p"}
+    [pg] = gang.create_gang(job, [4], annotations=ann)
+    assert m.get_annotations(pg)[c.ANNOTATION_SCHED_QUEUE] == "alpha"
+    # job moved to another queue: the stamp follows without recreation
+    [pg2] = gang.create_gang(job, [4], annotations={
+        **ann, c.ANNOTATION_SCHED_QUEUE: "beta"})
+    assert m.uid(pg2) == m.uid(pg)
+    assert m.get_annotations(pg2)[c.ANNOTATION_SCHED_QUEUE] == "beta"
+    # unchanged annotations -> no write
+    [pg3] = gang.create_gang(job, [4], annotations={
+        **ann, c.ANNOTATION_SCHED_QUEUE: "beta"})
+    assert m.resource_version(pg3) == m.resource_version(pg2)
+
+
+def test_multislice_readmit_slice_round_trip(api, gang, job):
+    pgs = gang.create_gang(job, [2, 2])
+    assert [m.name(g) for g in pgs] == ["tj-slice-0", "tj-slice-1"]
+    uid0 = m.uid(pgs[0])
+    # readmit slice 1: only its PodGroup is deleted
+    gang.readmit_slice(job, 1, 2)
+    assert api.try_get("PodGroup", "default", "tj-slice-1") is None
+    assert m.uid(api.get("PodGroup", "default", "tj-slice-0")) == uid0
+    # the next reconcile's create_gang recreates it from scratch
+    pgs2 = gang.create_gang(job, [2, 2])
+    assert [m.name(g) for g in pgs2] == ["tj-slice-0", "tj-slice-1"]
+    assert m.uid(pgs2[0]) == uid0
+    assert m.uid(pgs2[1]) != m.uid(pgs[1])
+    # readmitting an already-deleted slice is a no-op, not an error
+    gang.readmit_slice(job, 1, 2)
+    gang.readmit_slice(job, 1, 2)
+
+
+def test_volcano_plugin_carries_queue_through_spec(api, job):
+    gang = VolcanoPlugin(api)
+    [pg] = gang.create_gang(job, [4], SchedulingPolicy(
+        queue="tenant-a", priority_class_name="high"))
+    assert pg["spec"]["queue"] == "tenant-a"
+    assert pg["spec"]["priorityClassName"] == "high"
+    assert pg["apiVersion"] == "scheduling.volcano.sh/v1beta1"

@@ -1,0 +1,82 @@
+"""Scheduler regression guard: scheduling-pass budgets, not timers.
+
+Mirrors ``tests/test_controlplane_perf.py`` (docs/control-plane-perf.md):
+wall clocks flake, so the tier-1 guard counts *work*. A pass is O(pending
++ queues + held) over incremental state — it never lists the cluster — so
+the pass count must stay linear in the number of PodGroup events. An
+accidental O(N²) (a pass per pending gang per event, a lost dedup, a
+self-triggering write loop that never converges) multiplies the count
+long before it shows up in latency; ``bench_scheduler.py`` owns the
+timing story."""
+
+import pytest
+
+from kubedl_tpu.core import meta as m
+from kubedl_tpu.core.manager import Manager
+from kubedl_tpu.scheduling.gang import is_gang_admitted
+from kubedl_tpu.scheduling.inventory import SliceInventory
+from kubedl_tpu.scheduling.scheduler import SliceScheduler
+
+from tests.test_scheduler import POOL, make_pg
+
+pytestmark = [pytest.mark.perf, pytest.mark.scheduler]
+
+GANGS = 24
+CAPACITY = 4
+
+
+def test_schedule_passes_within_budget(api, manager, clock):
+    inv = SliceInventory(api, static_capacity={POOL: CAPACITY})
+    sched = SliceScheduler(api, inventory=inv)
+    manager.register(sched)
+
+    for i in range(GANGS):
+        make_pg(api, f"g{i:03d}", queue=("alpha" if i % 2 else "beta"))
+        clock.advance(1.0)
+
+    completed = 0
+    for _ in range(GANGS * 3):
+        manager.run_until_idle(max_iterations=100_000)
+        admitted = [g for g in api.list("PodGroup") if is_gang_admitted(g)]
+        if not admitted and completed == GANGS:
+            break
+        for g in admitted:
+            api.delete("PodGroup", m.namespace(g), m.name(g))
+            completed += 1
+    manager.run_until_idle(max_iterations=100_000)
+
+    assert completed == GANGS, f"only {completed}/{GANGS} gangs ran"
+    assert sched.metrics.admitted.value(queue="alpha") == GANGS // 2
+    assert sched.metrics.admitted.value(queue="beta") == GANGS // 2
+
+    # Budget: each gang's lifecycle is ~3 PodGroup events (create, admit,
+    # delete), each triggering at most one pass, plus the initial seed
+    # pass fan-in. 6 per gang is ~2x the measured value — headroom for
+    # legitimate drift, but a pass-per-pending-per-event quadratic blows
+    # through it immediately.
+    budget = GANGS * 6
+    assert sched.passes <= budget, (
+        f"running {GANGS} gangs took {sched.passes} scheduling passes "
+        f"(budget {budget}): the scheduler hot path regressed")
+
+    # converged: an idle system stops scheduling (no self-triggering
+    # write loop) — one more drain adds no passes
+    before = sched.passes
+    manager.run_until_idle(max_iterations=100_000)
+    assert sched.passes == before
+
+
+def test_pass_is_idempotent_without_work(api, manager, clock):
+    """A pass over settled state writes nothing (resourceVersions hold),
+    so the event->pass->event cascade provably terminates."""
+    inv = SliceInventory(api, static_capacity={POOL: CAPACITY})
+    sched = SliceScheduler(api, inventory=inv)
+    manager.register(sched)
+    for i in range(3):
+        make_pg(api, f"s{i}")
+    manager.run_until_idle(max_iterations=10_000)
+    rvs = {m.name(g): m.resource_version(g) for g in api.list("PodGroup")}
+    sched.schedule_pass()
+    sched.schedule_pass()
+    assert {m.name(g): m.resource_version(g)
+            for g in api.list("PodGroup")} == rvs

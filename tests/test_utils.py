@@ -13,6 +13,35 @@ from kubedl_tpu.utils.concurrent import Semaphore
 from kubedl_tpu.utils.tenancy import get_tenancy
 
 
+@pytest.mark.parametrize("raw,want", [
+    # plain / signed / float forms
+    ("2", 2.0), (2, 2.0), (1.5, 1.5), ("-3", -3.0), ("+4", 4.0),
+    ("0.5", 0.5), (".5", 0.5), ("1.", 1.0),
+    # decimalExponent (k8s <decimalExponent>: e or E + signed number)
+    ("123e6", 123e6), ("1E2", 100.0), ("12e-3", 0.012), ("2.5e3", 2500.0),
+    # decimalSI
+    ("500m", 0.5), ("-500m", -0.5), ("10k", 10_000.0), ("2M", 2e6),
+    ("3G", 3e9), ("4T", 4e12), ("5P", 5e15), ("6E", 6e18), ("1.5k", 1500.0),
+    # binarySI — the full ladder, incl. the previously-missing Ei
+    ("1Ki", 2**10), ("1Mi", 2**20), ("10Gi", 10 * 2**30), ("2Ti", 2 * 2**40),
+    ("3Pi", 3 * 2**50), ("2Ei", 2 * 2**60), ("1.5Gi", 1.5 * 2**30),
+    ("+5Gi", 5 * 2**30),
+])
+def test_parse_quantity_full_grammar(raw, want):
+    """The full apimachinery Quantity surface queue quotas now ride on
+    (ISSUE 4 satellite): exponents, every decimalSI/binarySI suffix."""
+    assert quota.parse_quantity(raw) == want
+
+
+@pytest.mark.parametrize("raw", [
+    "", "abc", "xKi", "1ZZ", "inf", "-inf", "nan", "12K",  # K is not a suffix
+    "infm", "nanKi", "infGi",  # inf/nan rejected through the suffix path too
+])
+def test_parse_quantity_rejects_garbage(raw):
+    with pytest.raises(ValueError):
+        quota.parse_quantity(raw)
+
+
 def test_pod_request_scheduler_rule():
     pod_spec = {
         "containers": [
