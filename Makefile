@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test test-all test-fast test-chaos test-scheduler bench bench-controlplane bench-scheduler bench-serving-paged dryrun crds run-standalone lint native
+.PHONY: test test-all test-fast test-chaos test-scheduler test-trace bench bench-controlplane bench-scheduler bench-serving-paged bench-trace dryrun crds run-standalone lint native
 
 # fast path (<3 min): everything except the compile-heavy compute suites
 # (those carry `pytestmark = pytest.mark.slow`). Chaos tests are fast and
@@ -50,6 +50,17 @@ bench-scheduler:
 # (docs/serving.md "Paged KV cache"); gate: >= 2x peak concurrency
 bench-serving-paged:
 	JAX_PLATFORMS=cpu $(PY) bench_serving_paged.py
+
+# end-to-end tracing suite (span recorder, lifecycle spans, exporters,
+# console endpoints; docs/tracing.md)
+test-trace:
+	$(PY) -m pytest tests/ -q -m trace
+
+# tracer overhead microbench: disabled vs enabled span cost in ns/op ->
+# BENCH_TRACE.json (docs/tracing.md); the tier-1 guard is the
+# `perf`-marker op-budget test in tests/test_trace.py
+bench-trace:
+	JAX_PLATFORMS=cpu $(PY) bench_trace.py
 
 # multi-chip sharding compile+execute proof on a virtual mesh
 dryrun:

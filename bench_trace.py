@@ -1,0 +1,79 @@
+"""Tracer overhead microbench (`make bench-trace` -> BENCH_TRACE.json).
+
+Measures the per-call cost of the span recorder in its three states:
+
+* **disabled** — the production-off hot path (`tracer.span(...)` with
+  ``enabled=False``): must be nanoseconds, because every reconcile /
+  scheduling pass / decode tick pays it once tracing ships everywhere;
+* **enabled (with)** — the context-manager path components use for
+  in-line measurement;
+* **enabled (record)** — the explicit-timestamp path the scheduler and
+  lifecycle tracer use.
+
+The wall-clock-free tier-1 guard is the ``perf``-marked op-budget test
+in ``tests/test_trace.py``; this script puts real numbers on the same
+path for the record. Gate: the disabled path must cost at most
+``DISABLED_MAX_FRACTION`` of the enabled path — if disabling tracing
+doesn't make it (much) cheaper, the gate is broken.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+from kubedl_tpu.trace import Tracer
+
+N = 200_000
+DISABLED_MAX_FRACTION = 0.5
+
+
+def _bench(fn, n: int = N) -> float:
+    # warmup, then best-of-3 (ns per op)
+    for _ in range(1000):
+        fn()
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            fn()
+        best = min(best, time.perf_counter() - t0)
+    return best / n * 1e9
+
+
+def main() -> int:
+    disabled = Tracer(enabled=False)
+    enabled = Tracer(enabled=True, capacity=4096)
+
+    def span_disabled():
+        with disabled.span("x", component="bench"):
+            pass
+
+    def span_enabled():
+        with enabled.span("x", component="bench"):
+            pass
+
+    def record_enabled():
+        enabled.record("x", 0.0, 1.0, component="bench")
+
+    out = {
+        "n": N,
+        "disabled_span_ns": round(_bench(span_disabled), 1),
+        "enabled_span_ns": round(_bench(span_enabled), 1),
+        "enabled_record_ns": round(_bench(record_enabled), 1),
+        "ring_capacity": enabled.capacity,
+        "gate": {"disabled_max_fraction_of_enabled": DISABLED_MAX_FRACTION},
+    }
+    out["disabled_fraction_of_enabled"] = round(
+        out["disabled_span_ns"] / max(out["enabled_span_ns"], 1e-9), 4)
+    out["gate_ok"] = (out["disabled_fraction_of_enabled"]
+                      <= DISABLED_MAX_FRACTION)
+    with open("BENCH_TRACE.json", "w") as f:
+        json.dump(out, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(json.dumps(out, indent=2, sort_keys=True))
+    return 0 if out["gate_ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

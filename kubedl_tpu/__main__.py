@@ -32,6 +32,13 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help="multi-tenant slice scheduler: queues, elastic "
                         "quota, priority preemption, backfill "
                         "(docs/scheduling.md; also TPUSliceScheduler gate)")
+    p.add_argument("--enable-tracing", action="store_true",
+                   help="end-to-end tracing: job-lifecycle spans, "
+                        "scheduler/serving traces, console "
+                        "/api/v1/trace endpoints (docs/tracing.md; "
+                        "also Tracing gate)")
+    p.add_argument("--trace-buffer", type=int, default=8192,
+                   help="span ring-buffer capacity when tracing is on")
     p.add_argument("--slice-capacity", default="",
                    help='static slice inventory "POOL=N,..." (e.g. '
                         '"tpu-v5p-slice/2x2x4=4") when the control plane '
@@ -105,6 +112,8 @@ def config_from_args(args: argparse.Namespace) -> OperatorConfig:
         kubectl_delivery_image=args.kubectl_delivery_image,
         enable_slice_scheduler=args.enable_slice_scheduler,
         slice_capacity=args.slice_capacity,
+        enable_tracing=args.enable_tracing,
+        trace_buffer=args.trace_buffer,
     )
 
 
@@ -176,7 +185,8 @@ def main(argv=None) -> int:
         from .console import ConsoleConfig, ConsoleServer, DataProxy
         proxy = DataProxy(operator.api, operator.object_backend,
                           operator.event_backend,
-                          job_kinds=tuple(operator.engines))
+                          job_kinds=tuple(operator.engines),
+                          tracer=operator.tracer)
         console = ConsoleServer(
             proxy, ConsoleConfig(host=args.console_host,
                                  port=args.console_port))

@@ -60,6 +60,10 @@ ANNOTATION_SCHED_POOL = KUBEDL_PREFIX + "/scheduler-pool"
 ANNOTATION_SCHED_QUEUE = KUBEDL_PREFIX + "/scheduler-queue"
 ANNOTATION_SCHED_NUM_SLICES = KUBEDL_PREFIX + "/scheduler-num-slices"
 ANNOTATION_SCHED_PRIORITY = KUBEDL_PREFIX + "/scheduler-priority"
+#: W3C-traceparent-style trace context (docs/tracing.md): client-settable
+#: on jobs; the engine stamps it when tracing is on and propagates it to
+#: PodGroups (for the scheduler) and into pods via $KUBEDL_TRACEPARENT
+ANNOTATION_TRACEPARENT = KUBEDL_PREFIX + "/traceparent"
 
 #: PodGroup conditions the slice scheduler owns: ``Admitted`` gates the job
 #: controllers' pod creation; ``Preempted`` marks a gang whose eviction is

@@ -42,11 +42,14 @@ class DataProxy:
     def __init__(self, api: APIServer,
                  object_backend: Optional[ObjectBackend] = None,
                  event_backend: Optional[EventBackend] = None,
-                 job_kinds=TRAINING_KINDS):
+                 job_kinds=TRAINING_KINDS, tracer=None):
         self.api = api
         self.object_backend = object_backend
         self.event_backend = event_backend
         self.job_kinds = tuple(job_kinds)
+        #: the operator's span recorder (kubedl_tpu.trace.Tracer); None
+        #: or disabled = the /api/v1/trace endpoints answer 501
+        self.tracer = tracer
 
     # -- jobs -------------------------------------------------------------
 
@@ -382,3 +385,67 @@ class DataProxy:
             if r["name"] == name:
                 return r
         return None
+
+    # -- traces (docs/tracing.md) -----------------------------------------
+
+    @property
+    def tracing_enabled(self) -> bool:
+        return self.tracer is not None and self.tracer.enabled
+
+    def _job_trace_id(self, namespace: str, name: str) -> Optional[str]:
+        """Resolve a job's trace id: from the live object when present
+        (annotation / UID derivation), else by searching recorded spans
+        for the ``job=ns/name`` attribute (the job may be TTL-deleted
+        while its trace is still in the ring)."""
+        from ..trace import job_trace_context
+        for kind in self.job_kinds:
+            obj = self.api.try_get(kind, namespace, name)
+            if obj is not None:
+                return job_trace_context(obj)[0]
+        ids = self.tracer.find_trace_ids(job=f"{namespace}/{name}")
+        return ids[0] if ids else None
+
+    def job_trace(self, namespace: str, name: str) -> Optional[dict]:
+        """Timeline + critical-path breakdown for one job's trace, or
+        None when no spans exist (job unknown / tracing just enabled)."""
+        from ..trace import trace_breakdown
+        trace_id = self._job_trace_id(namespace, name)
+        if trace_id is None:
+            return None
+        spans = self.tracer.spans(trace_id=trace_id)
+        if not spans:
+            return None
+        out = trace_breakdown(spans, trace_id)
+        out["job"] = f"{namespace}/{name}"
+        return out
+
+    def trace_spans(self, trace_id: str) -> list:
+        """Raw spans of one trace (the serving request endpoint)."""
+        return self.tracer.spans(trace_id=trace_id)
+
+    def job_queue_wait(self, job: dict) -> Optional[float]:
+        """Per-job queue wait in seconds for the job-detail view: the
+        trace breakdown's Queuing total (closed stints) PLUS the live
+        Queuing condition's age when the job is waiting right now — a
+        re-queued-after-preemption job's current stint is an open phase
+        with no span yet, so the two sources are disjoint and additive.
+        None when neither exists (the aggregate picture stays on the
+        PR 4 scheduler queue-wait histogram)."""
+        closed = None
+        if self.tracing_enabled:
+            from ..trace import job_trace_context, trace_breakdown
+            spans = self.tracer.spans(
+                trace_id=job_trace_context(job)[0])
+            if spans:
+                closed = trace_breakdown(spans)["byPhase"].get("Queuing")
+        live = None
+        for cond in m.get_in(job, "status", "conditions",
+                             default=[]) or []:
+            if cond.get("type") == c.JOB_QUEUING \
+                    and cond.get("status") == "True":
+                since = m.parse_rfc3339(cond.get("lastTransitionTime"))
+                if since is not None:
+                    live = max(self.api.now() - since, 0.0)
+        if closed is None and live is None:
+            return None
+        return round((closed or 0.0) + (live or 0.0), 3)

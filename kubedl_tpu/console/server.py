@@ -368,7 +368,10 @@ class ConsoleServer:
             pods = self.proxy.list_job_pods(m.kind(job), ns, name)
             events = self.proxy.list_events(ns, name)
             return ok({"job": job, "pods": [p.to_row() for p in pods],
-                       "events": [e.to_row() for e in events]})
+                       "events": [e.to_row() for e in events],
+                       # per-job queue wait (trace breakdown when traced,
+                       # else the live Queuing condition's age)
+                       "queueWaitSeconds": self.proxy.job_queue_wait(job)})
         if path == "/api/v1/job/statistics":
             return ok(self.proxy.job_statistics(_query_from_params(params)))
         if path == "/api/v1/job/running-jobs":
@@ -422,6 +425,41 @@ class ConsoleServer:
         mt = re.fullmatch(r"/api/v1/data/request/([^/]+)", path)
         if mt:
             return ok(self.proxy.cluster_request(mt.group(1)))
+
+        # trace endpoints (docs/tracing.md): a job's timeline + critical-
+        # path breakdown, and raw serving request traces by id. Optional
+        # ?format=chrome|otlp renders the exporter output instead.
+        if path.startswith("/api/v1/trace/"):
+            if not self.proxy.tracing_enabled:
+                return 501, {"code": 501,
+                             "msg": "tracing disabled (--enable-tracing / "
+                                    "Tracing gate)"}, []
+            from ..trace import to_chrome_trace, to_otlp_json
+            mt = re.fullmatch(r"/api/v1/trace/request/([0-9a-fA-F]{8,64})",
+                              path)
+            if mt:
+                spans = self.proxy.trace_spans(mt.group(1).lower())
+                if not spans:
+                    raise NotFound(f"no spans for trace {mt.group(1)}")
+                fmt = params.get("format", "")
+                if fmt == "chrome":
+                    return ok(to_chrome_trace(spans))
+                if fmt == "otlp":
+                    return ok(to_otlp_json(spans))
+                return ok({"traceId": mt.group(1).lower(),
+                           "spans": [s.to_dict() for s in spans]})
+            mt = re.fullmatch(r"/api/v1/trace/([^/]+)/([^/]+)", path)
+            if mt:
+                ns, name = mt.groups()
+                breakdown = self.proxy.job_trace(ns, name)
+                if breakdown is None:
+                    raise NotFound(f"no trace for job {ns}/{name}")
+                fmt = params.get("format", "")
+                if fmt in ("chrome", "otlp"):
+                    spans = self.proxy.trace_spans(breakdown["traceId"])
+                    return ok(to_chrome_trace(spans) if fmt == "chrome"
+                              else to_otlp_json(spans))
+                return ok(breakdown)
 
         # slice-scheduler queues: quota + live usage (docs/scheduling.md)
         if path == "/api/v1/queue/list":

@@ -43,6 +43,8 @@ from ..platform.tensorboard import reconcile_tensorboard
 from ..scheduling import queue as qsched
 from ..scheduling.gang import GangScheduler, is_gang_admitted
 from ..tpu import placement as pl
+from ..trace import (ENV_TRACEPARENT, NOOP_TRACER, JobLifecycleTracer,
+                     derive_phase, format_traceparent, job_trace_context)
 from ..utils import status as st
 from ..utils import train
 from ..utils.retry import RetryPolicy, restart_delay, retry_transient
@@ -124,13 +126,18 @@ class JobEngine(Reconciler):
                  config: Optional[EngineConfig] = None,
                  metrics: Optional[JobMetrics] = None,
                  recorder: Optional[Recorder] = None,
-                 gang: Optional[GangScheduler] = None):
+                 gang: Optional[GangScheduler] = None,
+                 tracer=None):
         self.api = api
         self.controller = controller
         self.config = config or EngineConfig()
         self.metrics = metrics or JobMetrics()
         self.recorder = recorder or Recorder(api)
         self.gang = gang
+        #: span recorder (docs/tracing.md); the shared disabled tracer by
+        #: default, so every trace call below is one attribute check
+        self.tracer = tracer if tracer is not None else NOOP_TRACER
+        self.lifecycle = JobLifecycleTracer(self.tracer)
         self.expectations = Expectations(
             clock=api.now, timeout=self.config.expectation_timeout)
         self._jitter_rng = random.Random(self.config.backoff_jitter_seed)
@@ -170,6 +177,7 @@ class JobEngine(Reconciler):
             if event_type == "DELETED":
                 self.metrics.deleted.inc(kind=self.kind)
                 self._job_states.pop(uid, None)
+                self.lifecycle.forget(uid)
                 self._tb_jobs.discard(uid)
                 self._tb_reap_checked.discard(uid)
                 self.expectations.delete_prefix(m.key(obj))
@@ -257,6 +265,8 @@ class JobEngine(Reconciler):
             self.metrics.created.inc(kind=self.kind)
             self.recorder.event(job, TYPE_NORMAL, st.REASON_JOB_CREATED,
                                 f"{self.kind} {req.name} is created.")
+        if self.tracer.enabled:
+            self._ensure_traceparent(job)
 
         pods = self.get_pods_for_job(job)
         services = self.get_services_for_job(job)
@@ -335,12 +345,18 @@ class JobEngine(Reconciler):
 
         # ---- gang: one PodGroup per slice ------------------------------
         if self.config.enable_gang_scheduling and self.gang is not None:
+            gang_ann = qsched.gang_annotations(
+                job, run_policy.scheduling_policy, plan.slice_spec,
+                plan.num_slices if plan.policy is not None else 1)
+            if self.tracer.enabled:
+                # the scheduler attaches its queue-wait / preemption spans
+                # to the job's trace via this PodGroup annotation
+                gang_ann = {**gang_ann,
+                            c.ANNOTATION_TRACEPARENT:
+                                format_traceparent(*job_trace_context(job))}
             self._retry(lambda: self.gang.create_gang(
                 job, self._gang_min_members(replicas, plan),
-                run_policy.scheduling_policy,
-                annotations=qsched.gang_annotations(
-                    job, run_policy.scheduling_policy, plan.slice_spec,
-                    plan.num_slices if plan.policy is not None else 1)))
+                run_policy.scheduling_policy, annotations=gang_ann))
 
         # ---- slice-atomic failover (TPU jobs only) ---------------------
         # A gang-scheduled slice whose member was preempted/killed is a
@@ -361,6 +377,7 @@ class JobEngine(Reconciler):
                     # the failure-round accounting above re-count the same
                     # failed pod next round
                     self._recount_replica_statuses(status, replicas, pods)
+                    self._trace_phase(job, status, pods, replicas)
                     flushed = self._flush_status(job, status, old_status)
                     # deletion events re-trigger reconcile; a failed flush
                     # still needs a timed nudge
@@ -389,6 +406,7 @@ class JobEngine(Reconciler):
                     f"({len(waiting)} PodGroup(s) pending)",
                     now=self.api.now())
                 self._recount_replica_statuses(status, replicas, pods)
+                self._trace_phase(job, status, pods, replicas)
                 flushed = self._flush_status(job, status, old_status)
                 # admission flips re-trigger via the PodGroup watch; the
                 # timed requeue is the safety net for a dropped event (a
@@ -402,6 +420,12 @@ class JobEngine(Reconciler):
                 if cond.type == c.JOB_QUEUING and cond.status == "True":
                     cond.status = "False"
                     cond.message = "gang admitted"
+                    # the Admitted phase marks the queue-exit instant; pod
+                    # creation (below, same pass) opens PodsCreated
+                    self.lifecycle.transition(
+                        job, "Admitted", self.api.now(),
+                        created_at=_parse_ts(
+                            m.meta(job).get("creationTimestamp")))
 
         # ---- elastic scaling hook --------------------------------------
         # scale_out/scale_in may return a requeue delay while waiting to
@@ -483,7 +507,16 @@ class JobEngine(Reconciler):
                 if gang_ts:
                     self.metrics.gang_to_all_running.observe(
                         self.api.now() - min(gang_ts), kind=self.kind)
+                # rendezvous-ready timestamp: every gang pod reports
+                # Running, so the PJRT world can form — the event's
+                # timestamp bounds rendezvous latency for traces and
+                # humans alike instead of leaving it inferred
+                self.recorder.event(
+                    job, TYPE_NORMAL, st.REASON_RENDEZVOUS_READY,
+                    f"all {total} gang pod(s) of {self.kind} {req.name} "
+                    f"are running; rendezvous can complete")
 
+        self._trace_phase(job, status, pods, replicas)
         flushed = self._flush_status(job, status, old_status)
         requeues = [r for r in (deadline_requeue, tb_requeue, elastic_requeue,
                                 slice_wait)
@@ -543,9 +576,45 @@ class JobEngine(Reconciler):
         if status.completion_time is None:
             status.completion_time = m.rfc3339(self.api.now())
         self.metrics.failed.inc(kind=self.kind)
+        self._trace_phase(job, status, attrs={"reason": reason})
         if not self._flush_status(job, status, old_status):
             return Result(requeue_after=1.0)
         return None
+
+    # ------------------------------------------------------------------
+    # tracing (docs/tracing.md) — every hook is a no-op unless enabled
+    # ------------------------------------------------------------------
+
+    def _trace_phase(self, job, status: JobStatus, pods=None, replicas=None,
+                     attrs: Optional[dict] = None) -> None:
+        """Report the job's current lifecycle phase to the span recorder
+        (the lifecycle tracer turns phase *changes* into spans)."""
+        if not self.tracer.enabled:
+            return
+        phase = derive_phase(status, pods, replicas, st, m)
+        attributes = dict(attrs or {})
+        if phase == "Restarting":
+            attributes.setdefault("restartRound", status.restart_rounds)
+            attributes.setdefault("restartCount", status.restart_count)
+        self.lifecycle.transition(
+            job, phase, self.api.now(), attributes=attributes,
+            created_at=_parse_ts(m.meta(job).get("creationTimestamp")))
+
+    def _ensure_traceparent(self, job) -> None:
+        """Stamp the job with its (UID-derived) traceparent annotation so
+        clients and out-of-process tools see the trace id. Best-effort:
+        the derivation is deterministic, so a failed patch only loses the
+        annotation's visibility, never span correlation."""
+        if c.ANNOTATION_TRACEPARENT in m.get_annotations(job):
+            return
+        value = format_traceparent(*job_trace_context(job))
+        try:
+            self.api.patch_merge(
+                self.kind, m.namespace(job), m.name(job),
+                {"metadata": {"annotations": {
+                    c.ANNOTATION_TRACEPARENT: value}}})
+        except (Conflict, NotFound, ServerError):
+            pass
 
     # ------------------------------------------------------------------
     # terminal path
@@ -576,6 +645,7 @@ class JobEngine(Reconciler):
         self.controller.on_job_finished(job, pods)
         # TensorBoard outlives the job for its own TTL (tensorboard.go:99-135)
         tb_requeue = self._reconcile_tb(job, status, replicas)
+        self._trace_phase(job, status, pods, replicas)
         flushed = self._flush_status(job, status, old_status)
 
         requeues = [tb_requeue] if tb_requeue else []
@@ -849,12 +919,18 @@ class JobEngine(Reconciler):
         # checkpoint half of the 2-phase protocol, train/checkpoint.py
         # ElasticCheckpointAgent; python -m kubedl_tpu.train) find their
         # own CR without guessing from pod labels
+        identity_env = [("KUBEDL_JOB_KIND", self.kind),
+                        ("KUBEDL_JOB_NAMESPACE", m.namespace(job)),
+                        ("KUBEDL_JOB_NAME", m.name(job))]
+        if self.tracer.enabled:
+            # in-container payloads (trainer step/checkpoint spans) join
+            # the job's trace through this context
+            identity_env.append((ENV_TRACEPARENT,
+                                 format_traceparent(*job_trace_context(job))))
         for container in m.get_in(pod, "spec", "containers",
                                   default=[]) or []:
             env = container.setdefault("env", [])
-            for k, v in (("KUBEDL_JOB_KIND", self.kind),
-                         ("KUBEDL_JOB_NAMESPACE", m.namespace(job)),
-                         ("KUBEDL_JOB_NAME", m.name(job))):
+            for k, v in identity_env:
                 if not any(e.get("name") == k for e in env):
                     env.append({"name": k, "value": v})
 

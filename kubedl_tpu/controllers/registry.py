@@ -73,6 +73,14 @@ class OperatorConfig:
     #: "tpu-v5p-slice/2x2x4=4") for control planes without Node objects;
     #: empty = derive from Nodes ($KUBEDL_SLICE_CAPACITY overrides)
     slice_capacity: str = ""
+    #: end-to-end tracing (docs/tracing.md): job-lifecycle spans,
+    #: scheduler queue-wait/preemption spans, reconcile spans, console
+    #: trace endpoints. Also switchable via the Tracing feature gate;
+    #: either turns it on. Off by default — the disabled tracer costs one
+    #: attribute check per hook.
+    enable_tracing: bool = False
+    #: span ring-buffer capacity when tracing is enabled
+    trace_buffer: int = 8192
 
 
 @dataclass
@@ -87,6 +95,9 @@ class Operator:
     admission: object = None
     #: the SliceScheduler when enabled (None otherwise)
     scheduler: object = None
+    #: the span recorder (kubedl_tpu.trace.Tracer); disabled unless
+    #: --enable-tracing / the Tracing gate turned it on
+    tracer: object = None
 
     def run_until_idle(self, **kw):
         return self.manager.run_until_idle(**kw)
@@ -105,13 +116,23 @@ def build_operator(api: Optional[APIServer] = None,
     api = api if api is not None else APIServer()
     config = config or OperatorConfig()
     registry = Registry()
-    manager = Manager(api, metrics=ControlPlaneMetrics(registry))
     metrics = JobMetrics(registry)
     recorder = Recorder(api)
     gates = config.feature_gates
     if gates is None:
         gates = ft.default_gates
         gates.parse_env()  # KUBEDL_FEATURE_GATES honored in standalone mode
+    # end-to-end tracing (docs/tracing.md): one tracer shared by the
+    # manager, every engine, the scheduler, and the console endpoints.
+    # TraceMetrics families register unconditionally (dashboards see
+    # zeroes when off); the tracer only feeds them while enabled.
+    from ..metrics.registry import TraceMetrics
+    from ..trace import Tracer
+    trace_enabled = config.enable_tracing or gates.enabled(ft.TRACING)
+    tracer = Tracer(enabled=trace_enabled, capacity=config.trace_buffer,
+                    clock=api.now, metrics=TraceMetrics(registry))
+    manager = Manager(api, metrics=ControlPlaneMetrics(registry),
+                      tracer=tracer)
     gang = (new_gang_scheduler(config.gang_scheduler_name, api)
             if config.gang_scheduler_name
             and gates.enabled(ft.GANG_SCHEDULING) else None)
@@ -143,7 +164,7 @@ def build_operator(api: Optional[APIServer] = None,
                 and hasattr(ctrl, "kubectl_delivery_image"):
             ctrl.kubectl_delivery_image = config.kubectl_delivery_image
         engine = JobEngine(api, ctrl, engine_config, metrics=metrics,
-                           recorder=recorder, gang=gang)
+                           recorder=recorder, gang=gang, tracer=tracer)
         manager.register(engine)
         engines[ctrl_cls.kind] = engine
 
@@ -174,7 +195,7 @@ def build_operator(api: Optional[APIServer] = None,
             api, static_capacity=parse_capacity_spec(cap_spec))
         scheduler = SliceScheduler(api, inventory=inventory,
                                    metrics=SchedulerMetrics(registry),
-                                   recorder=recorder)
+                                   recorder=recorder, tracer=tracer)
         manager.register(scheduler)
 
     # admission chain: defaulting + validation at create/update (reference
@@ -202,7 +223,7 @@ def build_operator(api: Optional[APIServer] = None,
                     metrics_registry=registry, config=config,
                     object_backend=object_backend,
                     event_backend=event_backend, admission=admission,
-                    scheduler=scheduler)
+                    scheduler=scheduler, tracer=tracer)
 
 
 def _storage_backend(spec: str, for_events: bool = False):

@@ -23,6 +23,11 @@ JAX_PROFILER_UPLOAD = "JAXProfilerUpload"  # render XProf profile-dir env
 #: docs/scheduling.md); off by default so the pre-scheduler behavior —
 #: every gang races pod creation — is preserved until opted into
 TPU_SLICE_SCHEDULER = "TPUSliceScheduler"
+#: end-to-end tracing (docs/tracing.md): job-lifecycle spans, scheduler
+#: and serving request traces, console trace endpoints; off by default —
+#: the disabled tracer's hot path is one attribute check (the `perf`
+#: budget test in tests/test_trace.py holds it there)
+TRACING = "Tracing"
 
 _DEFAULTS = {
     GANG_SCHEDULING: True,           # Beta
@@ -32,6 +37,7 @@ _DEFAULTS = {
     TPU_MULTISLICE: True,
     JAX_PROFILER_UPLOAD: False,
     TPU_SLICE_SCHEDULER: False,      # Alpha
+    TRACING: False,                  # Alpha
 }
 
 ENV_FEATURE_GATES = "KUBEDL_FEATURE_GATES"

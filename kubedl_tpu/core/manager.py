@@ -69,8 +69,12 @@ class Reconciler:
 
 class Manager:
     def __init__(self, api: APIServer, clock=None,
-                 metrics: Optional[ControlPlaneMetrics] = None):
+                 metrics: Optional[ControlPlaneMetrics] = None,
+                 tracer=None):
         self.api = api
+        #: span recorder (kubedl_tpu.trace.Tracer); None or disabled =
+        #: the dispatch hot path pays one attribute check and nothing else
+        self.tracer = tracer
         self._clock = clock or api.now
         self._reconcilers: list[Reconciler] = []
         self._by_kind: dict[str, list[Reconciler]] = {}
@@ -222,6 +226,12 @@ class Manager:
                     self.enqueue(req, after=max(res.requeue_after, 0.0))
         finally:
             elapsed = max(self._clock() - t0, 0.0)
+            tr = self.tracer
+            if tr is not None and tr.enabled:
+                tr.record("reconcile", t0, t0 + elapsed, component="manager",
+                          attributes={"kind": req.kind,
+                                      "namespace": req.namespace,
+                                      "name": req.name})
             self.metrics.reconciles.inc(kind=req.kind)
             self.metrics.reconcile_latency.observe(elapsed, kind=req.kind)
             with self._lock:

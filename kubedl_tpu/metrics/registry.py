@@ -129,8 +129,17 @@ class Registry:
         return "\n".join(out) + "\n"
 
 
+def _escape_label(value: str) -> str:
+    """Prometheus text-format label-value escaping: backslash, double
+    quote, and newline must be escaped or the exposition line is
+    unparseable (label values are user-influenced — queue names, kinds)."""
+    return (str(value).replace("\\", r"\\").replace('"', r'\"')
+            .replace("\n", r"\n"))
+
+
 def _fmt_labels(names: tuple, values: tuple) -> str:
-    return ",".join(f'{n}="{v}"' for n, v in zip(names, values) if v != "")
+    return ",".join(f'{n}="{_escape_label(v)}"'
+                    for n, v in zip(names, values) if v != "")
 
 
 def _wrap(lbl: str) -> str:
@@ -274,6 +283,28 @@ class SchedulerMetrics:
             "kubedl_scheduler_queue_wait_seconds",
             "Gang creation to admission, per queue", ("queue",),
             buckets=_QUEUE_WAIT_BUCKETS)
+
+
+class TraceMetrics:
+    """Span-recorder health (docs/tracing.md): recorded-span throughput
+    per component, ring-buffer occupancy, and the overflow-drop counter
+    (a rising drop rate means the buffer is undersized for the span
+    volume — raise ``--trace-buffer`` capacity or narrow what's traced).
+    Maintained by :class:`kubedl_tpu.trace.Tracer` only while tracing is
+    enabled; with the gate off the families exist but stay at zero."""
+
+    def __init__(self, registry: Optional[Registry] = None):
+        self.registry = registry or Registry()
+        r = self.registry
+        self.spans = r.counter(
+            "kubedl_trace_spans_total",
+            "Spans recorded, by instrumented component", ("component",))
+        self.dropped = r.counter(
+            "kubedl_trace_spans_dropped_total",
+            "Spans evicted from the ring buffer on overflow")
+        self.buffered = r.gauge(
+            "kubedl_trace_buffer_spans",
+            "Spans currently held in the ring buffer")
 
 
 class JobMetrics:

@@ -47,6 +47,7 @@ from ..core.apiserver import Conflict, NotFound, ServerError
 from ..core.events import Recorder, TYPE_NORMAL, TYPE_WARNING
 from ..core.manager import Reconciler, Request, Result
 from ..metrics import SchedulerMetrics
+from ..trace import NOOP_TRACER, derive_context, parse_traceparent
 from ..utils.retry import RetryPolicy, retry_transient
 from . import queue as qresolve
 from .gang import (GANG_POD_LABELS, is_gang_admitted, is_gang_preempted,
@@ -104,8 +105,12 @@ class SliceScheduler(Reconciler):
                  recorder: Optional[Recorder] = None,
                  resync_every: int = 16,
                  retry_policy: Optional[RetryPolicy] = None,
-                 retry_sleep: Callable = time.sleep):
+                 retry_sleep: Callable = time.sleep,
+                 tracer=None):
         self.api = api
+        #: span recorder (docs/tracing.md): pass spans, per-gang
+        #: queue-wait spans on the owning job's trace, preemption marks
+        self.tracer = tracer if tracer is not None else NOOP_TRACER
         self.inventory = inventory if inventory is not None \
             else SliceInventory(api)
         self.metrics = metrics or SchedulerMetrics()
@@ -233,6 +238,7 @@ class SliceScheduler(Reconciler):
     def schedule_pass(self) -> None:
         """One idempotent pass: reclaim, then admit (FIFO + quota +
         reservation backfill) per queue in priority order."""
+        t0 = self.api.now()
         with self._lock:
             self.passes += 1
             self.metrics.passes.inc()
@@ -266,10 +272,15 @@ class SliceScheduler(Reconciler):
                 queues.setdefault(h.queue, QueueSpec(name=h.queue))
 
             reserved: dict[str, int] = {}
+            pending_n = sum(len(v) for v in by_queue.values())
             for qname in sorted(queues, key=lambda n: (-queues[n].priority, n)):
                 self._schedule_queue(queues[qname], by_queue.get(qname, []),
                                      queues, held_by_queue, reserved)
             self._refresh_gauges(queues, by_queue, held_by_queue)
+        if self.tracer.enabled:
+            self.tracer.record(
+                "scheduler.pass", t0, self.api.now(), component="scheduler",
+                attributes={"pass": self.passes, "pending": pending_n})
 
     def _schedule_queue(self, q: QueueSpec, fifo: list, queues: dict,
                         held_by_queue: dict, reserved: dict) -> None:
@@ -322,12 +333,15 @@ class SliceScheduler(Reconciler):
         wait = max(now - gs.first_seen(), 0.0)
         landed = 0
         all_landed = True
+        first_pg = None
         for name in sorted(gs.pgs):
             committed = self._write_status(
                 "PodGroup", gs.namespace, name, self._mutate_admit)
             if committed is None:
                 all_landed = False
                 continue
+            if first_pg is None:
+                first_pg = committed
             self.inventory.mark_admitted(committed)
             gs.pgs.pop(name, None)
             landed += 1
@@ -341,7 +355,33 @@ class SliceScheduler(Reconciler):
             if backfill:
                 self.metrics.backfills.inc(queue=gs.queue)
             self.metrics.queue_wait.observe(wait, queue=gs.queue)
+            if self.tracer.enabled:
+                trace_id, root = self._job_ctx(first_pg, gs.namespace,
+                                               gs.job)
+                self.tracer.record(
+                    "scheduler.queue-wait", now - wait, now,
+                    trace_id=trace_id, parent_id=root,
+                    component="scheduler",
+                    attributes={"queue": gs.queue, "backfill": backfill,
+                                "job": f"{gs.namespace}/{gs.job}",
+                                "slices": landed})
         return landed
+
+    def _job_ctx(self, pg: Optional[dict], ns: str, job: str) -> tuple:
+        """(trace_id, root_span_id) of the job owning a PodGroup: the
+        engine-stamped traceparent annotation when present, else derived
+        from the controller-owner UID (ns/job as a last resort), so the
+        scheduler's spans land in the same trace the engine's lifecycle
+        spans do — with zero cross-component plumbing."""
+        if pg is not None:
+            ctx = parse_traceparent(m.get_annotations(pg).get(
+                c.ANNOTATION_TRACEPARENT, ""))
+            if ctx is not None:
+                return ctx
+            ref = m.get_controller_ref(pg)
+            if ref and ref.get("uid"):
+                return derive_context(ref["uid"])
+        return derive_context(f"{ns}/{job}")
 
     def _mutate_admit(self, pg: dict) -> bool:
         if is_gang_admitted(pg) or m.is_deleting(pg):
@@ -426,10 +466,13 @@ class SliceScheduler(Reconciler):
         (PR 1) tears the slices down and deletes the PodGroups, which is
         what actually frees the inventory."""
         victim_queue = slices[0].queue
+        victim_pg = None
         for rec in slices:
             pg = self.api.try_get("PodGroup", rec.namespace, rec.name)
             if pg is None:
                 continue
+            if victim_pg is None:
+                victim_pg = pg
             if is_gang_preempted(pg):
                 self.inventory.mark_preempted(rec.namespace, rec.name)
                 continue
@@ -455,6 +498,15 @@ class SliceScheduler(Reconciler):
                 f"gang {rec.name} (queue {victim_queue}) preempted to "
                 f"reclaim min quota for queue {for_queue}")
         self.metrics.preempted.inc(queue=victim_queue)
+        if self.tracer.enabled:
+            now = self.api.now()
+            trace_id, root = self._job_ctx(victim_pg, ns, job)
+            self.tracer.record(
+                "scheduler.preempt", now, now, trace_id=trace_id,
+                parent_id=root, component="scheduler",
+                attributes={"job": f"{ns}/{job}", "queue": victim_queue,
+                            "forQueue": for_queue,
+                            "slices": len(slices)})
         log.info("preempted gang-set %s/%s (%d slice(s), queue %s) for "
                  "queue %s", ns, job, len(slices), victim_queue, for_queue)
 

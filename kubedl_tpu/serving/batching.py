@@ -54,6 +54,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models import llama
+from ..trace import NOOP_TRACER
 from .engine import (GenerateConfig, hit_stop, sample_logits_many,
                      token_logprobs)
 
@@ -206,7 +207,17 @@ class Request:
     #: surfaces through result()/stream() instead of the generic
     #: engine-stopped message
     error: Optional[str] = None
+    #: request trace id (docs/tracing.md), assigned at submit when the
+    #: engine carries an enabled tracer; "" otherwise. The console's
+    #: /api/v1/trace/request/{id} endpoint looks spans up by it.
+    trace_id: str = ""
     _cond: threading.Condition = field(default_factory=threading.Condition)
+    # trace bookkeeping (engine-side; meaningless when trace_id == "")
+    _span_root: str = ""
+    _t_submit: float = 0.0
+    _t_queue: float = 0.0     # when the request (re-)entered the queue
+    _t_decode: float = 0.0
+    _preempts: int = 0
 
     def cancel(self) -> None:
         """Stop generating for this request (client went away / got what
@@ -301,10 +312,13 @@ class ContinuousBatchingEngine:
                  spec_k: int = 0, quantize_draft: Optional[str] = None,
                  kv_mode: Optional[str] = None, kv_block: int = 64,
                  pool_blocks: Optional[int] = None,
-                 headroom_blocks: int = 1):
+                 headroom_blocks: int = 1, tracer=None):
         from .engine import (SpecStats, init_mesh_serving, resolve_family,
                              sample_logits)
         self.config = config
+        #: per-request span recorder (queue/prefill/decode/preemption
+        #: spans, docs/tracing.md); the shared disabled tracer by default
+        self.tracer = tracer if tracer is not None else NOOP_TRACER
         self.family = family = resolve_family(config)
         self.lanes = lanes
         self.max_len = max_len
@@ -694,6 +708,10 @@ class ContinuousBatchingEngine:
                                           top_k=top_k, top_p=top_p)
         req = Request(prompt=list(prompt), max_new=max_new,
                       want_logprobs=logprobs, **sampling)
+        if self.tracer.enabled:
+            req.trace_id = self.tracer.new_trace_id()
+            req._span_root = self.tracer.new_span_id()
+            req._t_submit = req._t_queue = self.tracer.clock()
         if max_new <= 0:
             req._finish()          # nothing requested: empty output
             return req
@@ -781,6 +799,7 @@ class ContinuousBatchingEngine:
             lane.reset()
         for req in abandoned:
             req._finish(cancelled=True)
+            self._trace_finish(req, status="error")
         if self.kv_mode in ("dense", "parity"):
             self._cache = self._place_cache(
                 self.family.init_cache(self.config, self.lanes,
@@ -862,6 +881,10 @@ class ContinuousBatchingEngine:
                 self._free_lane(i)
             for req in abandoned:
                 req._finish(cancelled=True)
+                # the root span must still land: children with no
+                # recorded parent read as orphans forever, and failed
+                # requests are exactly the ones worth debugging
+                self._trace_finish(req, status="error")
 
     def pool_stats(self) -> dict:
         """Pool occupancy + scheduler counters for /metrics. Dense mode
@@ -888,6 +911,28 @@ class ContinuousBatchingEngine:
         return out
 
     # -- scheduler --------------------------------------------------------
+
+    def _trace_finish(self, req: Request, status: str = "ok") -> None:
+        """Record the request's decode span and its root span (the whole
+        submit→finish window). No-op for untraced requests."""
+        if not (self.tracer.enabled and req.trace_id and req._span_root):
+            return
+        now = self.tracer.clock()
+        if req._t_decode:
+            self.tracer.record(
+                "request.decode", req._t_decode, now,
+                trace_id=req.trace_id, parent_id=req._span_root,
+                component="serving",
+                attributes={"tokens": len(req.tokens)})
+        self.tracer.record(
+            "serving.request", req._t_submit, now,
+            trace_id=req.trace_id, span_id=req._span_root,
+            component="serving", status=status,
+            attributes={"tokens": len(req.tokens),
+                        "promptTokens": len(req.prompt),
+                        "preemptions": req._preempts,
+                        **({"error": req.error} if req.error else {})})
+        req._span_root = ""   # finalized: never re-record this root
 
     def _active(self) -> bool:
         return any(l.request is not None for l in self._lane_state)
@@ -936,6 +981,22 @@ class ContinuousBatchingEngine:
         req = self._lane_state[victim].request
         self._free_lane(victim)
         self.preempted += 1
+        if self.tracer.enabled and req.trace_id:
+            now = self.tracer.clock()
+            if req._t_decode:
+                self.tracer.record(
+                    "request.decode", req._t_decode, now,
+                    trace_id=req.trace_id, parent_id=req._span_root,
+                    component="serving",
+                    attributes={"tokens": len(req.tokens),
+                                "preempted": True})
+                req._t_decode = 0.0
+            self.tracer.record(
+                "request.preempted", now, now, trace_id=req.trace_id,
+                parent_id=req._span_root, component="serving",
+                attributes={"tokens": len(req.tokens)})
+            req._t_queue = now
+            req._preempts += 1
         with self._cv:
             self._queue.appendleft(req)
         return True
@@ -1062,6 +1123,7 @@ class ContinuousBatchingEngine:
             if req.cancel_requested:
                 self._free_lane(i)
                 req._finish()
+                self._trace_finish(req)
                 continue
             if sampled[i]:
                 t, tk, tp = self._lane_sampling(req)
@@ -1116,6 +1178,7 @@ class ContinuousBatchingEngine:
             if finished:
                 self._free_lane(i)
                 req._finish()
+                self._trace_finish(req)
 
     def _prefill_dense(self, lane_idx: int, seq: list, start: int):
         """Chunked dense-slab prefill of ``seq[start:]`` into one lane
@@ -1155,7 +1218,9 @@ class ContinuousBatchingEngine:
         with self._cv:
             while self._queue and self._queue[0].cancel_requested:
                 # cancelled while queued: never pay the prefill
-                self._queue.popleft()._finish()
+                r = self._queue.popleft()
+                r._finish()
+                self._trace_finish(r)
             if not self._queue:
                 return False
             req = self._queue[0]
@@ -1187,10 +1252,13 @@ class ContinuousBatchingEngine:
                             f"{sum(len(p.blocks) for p in self._prefixes)}"
                             " pinned by prefixes)")
                         req._finish(cancelled=True)
+                        self._trace_finish(req, status="error")
                         return True
                 elif free < need + self.headroom_blocks:
                     return False
             self._queue.popleft()
+        t_admit = (self.tracer.clock()
+                   if self.tracer.enabled and req.trace_id else 0.0)
         # attach BEFORE the prefill work: a failure mid-prefill must leave
         # the request visible to _recover_locked (a popped-but-unattached
         # request would never be cancelled and its waiter would hang)
@@ -1203,9 +1271,11 @@ class ContinuousBatchingEngine:
         seq = (req.prompt or [0]) + req.tokens
         plen = len(seq)
         logits = logits_p = None
+        prefill_from = 0      # first position actually prefilled (traces)
         if self.kv_mode in ("dense", "parity"):
             if self.kv_mode == "dense":
                 stored, start = self._match_prefix(seq)
+                prefill_from = start
                 if stored is not None:
                     self._cache = self._load_prefix(self._cache, stored,
                                                     jnp.int32(lane_idx))
@@ -1223,6 +1293,7 @@ class ContinuousBatchingEngine:
             # the admission gate reserved capacity under the same
             # scheduler lock, so this cannot fail
             self._ensure_blocks(lane_idx, plen - 1)
+            prefill_from = start_p
             logits_p = self._prefill_paged(lane_idx, seq, start_p)
             if self.kv_mode == "parity":
                 self._assert_parity(logits, logits_p, "prefill", rows=[0])
@@ -1246,9 +1317,25 @@ class ContinuousBatchingEngine:
         lane.remaining = req.max_new - prior - 1
         self._cur[lane_idx, 0] = first
         self._pos[lane_idx] = plen
+        if self.tracer.enabled and req.trace_id:
+            now_t = self.tracer.clock()
+            self.tracer.record(
+                "request.queue", req._t_queue, t_admit,
+                trace_id=req.trace_id, parent_id=req._span_root,
+                component="serving",
+                attributes={"resumed": prior > 0, "lane": lane_idx})
+            self.tracer.record(
+                "request.prefill", t_admit, now_t,
+                trace_id=req.trace_id, parent_id=req._span_root,
+                component="serving",
+                attributes={"tokens": plen - prefill_from,
+                            "lane": lane_idx,
+                            "sharedBlocks": len(shared)})
+            req._t_decode = now_t
         if lane.remaining <= 0 or hit_stop(req.tokens, gen):
             self._free_lane(lane_idx)    # finished in prefill
             req._finish()
+            self._trace_finish(req)
         elif self.spec_k:
             # draft prefills the FULL sequence into ITS lane (prefix KV
             # blocks are target-model state; the draft pays its own
@@ -1376,4 +1463,5 @@ class ContinuousBatchingEngine:
                     or lane.pos + 1 >= self.max_len):
                 self._free_lane(i)   # lane freed for the next arrival
                 req._finish()
+                self._trace_finish(req)
         return True

@@ -347,10 +347,14 @@ class InferenceEngine:
 
     def __init__(self, config: llama.LlamaConfig, params: dict,
                  gen: Optional[GenerateConfig] = None,
-                 quantize: Optional[str] = None, mesh=None):
+                 quantize: Optional[str] = None, mesh=None, tracer=None):
+        from ..trace import NOOP_TRACER
         self.config = config
         self.gen = gen or GenerateConfig()
         self.mesh = mesh
+        #: span recorder (docs/tracing.md): per-generate prefill/decode
+        #: spans; the shared disabled tracer by default
+        self.tracer = tracer if tracer is not None else NOOP_TRACER
         self.params, self._place_cache = init_mesh_serving(
             config, params, quantize, mesh)
 
@@ -399,10 +403,22 @@ class InferenceEngine:
         valid = jnp.asarray(
             np.arange(gen.max_len)[None, :] >= pad[:, None])
 
+        tr = self.tracer if self.tracer.enabled else None
+        trace_id = root_id = None
+        t_start = 0.0
+        if tr is not None:
+            trace_id, root_id = tr.new_trace_id(), tr.new_span_id()
+            t_start = tr.clock()
         cache = self._place_cache(
             self._family.init_cache(self.config, b, gen.max_len))
         logits, cache = self._step(self.params, cache, jnp.asarray(toks),
                                    jnp.int32(0), valid)
+        if tr is not None:
+            t_prefill = tr.clock()
+            tr.record("inference.prefill", t_start, t_prefill,
+                      trace_id=trace_id, parent_id=root_id,
+                      component="serving",
+                      attributes={"batch": b, "promptTokens": prompt_len})
         key = jax.random.PRNGKey(seed)
         out: list[list[int]] = [[] for _ in range(b)]
         lps: list[list[float]] = [[] for _ in range(b)]
@@ -431,6 +447,17 @@ class InferenceEngine:
             if return_logprobs:
                 cur_lp = np.asarray(token_logprobs(logits, jnp.asarray(cur)))
             pos += 1
+        if tr is not None:
+            t_end = tr.clock()
+            generated = sum(len(o) for o in out)
+            tr.record("inference.decode", t_prefill, t_end,
+                      trace_id=trace_id, parent_id=root_id,
+                      component="serving",
+                      attributes={"tokens": generated})
+            tr.record("inference.generate", t_start, t_end,
+                      trace_id=trace_id, span_id=root_id,
+                      component="serving",
+                      attributes={"batch": b, "tokens": generated})
         if return_logprobs:
             return [(o, lp) for o, lp in zip(out, lps)]
         return out

@@ -1,0 +1,97 @@
+"""Critical-path analysis over one trace's spans.
+
+:func:`trace_breakdown` rolls a job's lifecycle spans into the per-phase
+latency table the console serves (``/api/v1/trace/{ns}/{job}``): where
+did startup time go — queue wait vs pod creation vs PJRT rendezvous vs
+run — plus restart-round accounting and orphan detection (a span whose
+parent is missing from the trace means a component recorded against a
+context nobody opened: an instrumentation bug, surfaced instead of
+silently mis-rooted).
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+from .tracer import Span
+
+
+def find_orphans(spans: Iterable[Span]) -> list:
+    """Spans whose ``parent_id`` names no span in the set.
+
+    One shared missing parent is exempt when no root (parentless) span
+    exists yet: a *live* job's children all hang off the deterministic
+    root that only gets recorded at terminal — that is the designed
+    in-flight shape, not an orphan."""
+    spans = list(spans)
+    ids = {s.span_id for s in spans}
+    has_root = any(s.parent_id is None for s in spans)
+    missing = [s for s in spans
+               if s.parent_id is not None and s.parent_id not in ids]
+    if not has_root:
+        implicit = {s.parent_id for s in missing}
+        if len(implicit) == 1 and len(missing) == len(spans):
+            return []
+    return missing
+
+
+def trace_breakdown(spans: Iterable[Span],
+                    trace_id: Optional[str] = None) -> dict:
+    """Per-phase critical path for one trace.
+
+    Returns the chronologically ordered phase spans (``phases``), the
+    aggregate seconds per phase name (``byPhase`` — restart rounds
+    repeat phases, so e.g. two Queuing stints sum), the root span when
+    recorded, non-lifecycle child spans (``events``: scheduler
+    queue-wait, preemptions, reconciles attached to the trace), and the
+    orphan list (must be empty for a healthy trace)."""
+    spans = [s for s in spans
+             if trace_id is None or s.trace_id == trace_id]
+    if trace_id is None and spans:
+        trace_id = spans[0].trace_id
+    phases = sorted(
+        (s for s in spans
+         if s.component == "lifecycle" and "phase" in s.attributes),
+        key=lambda s: (s.start, s.end))
+    root = next((s for s in spans
+                 if s.parent_id is None and s.component == "lifecycle"),
+                None)
+    by_phase: dict[str, float] = {}
+    for s in phases:
+        name = s.attributes["phase"]
+        by_phase[name] = by_phase.get(name, 0.0) + s.duration
+    events = [s for s in spans if s not in phases and s is not root]
+    total = (root.duration if root is not None
+             else (phases[-1].end - phases[0].start if phases else 0.0))
+    return {
+        "traceId": trace_id or "",
+        "root": root.to_dict() if root is not None else None,
+        "phases": [s.to_dict() for s in phases],
+        "byPhase": {k: round(v, 9) for k, v in sorted(by_phase.items())},
+        "events": [s.to_dict() for s in events],
+        "totalSeconds": round(total, 9),
+        "spanCount": len(spans),
+        "orphans": [s.to_dict() for s in find_orphans(spans)],
+    }
+
+
+def assert_well_formed(spans: Iterable[Span]) -> None:
+    """Raise AssertionError when the trace has orphans or its phase
+    spans are not monotonically ordered (each phase must start no
+    earlier than the one before it) — the e2e acceptance contract."""
+    spans = list(spans)
+    orphans = find_orphans(spans)
+    if orphans:
+        raise AssertionError(
+            f"{len(orphans)} orphan span(s): "
+            f"{[(s.name, s.parent_id) for s in orphans]}")
+    phases = sorted(
+        (s for s in spans
+         if s.component == "lifecycle" and "phase" in s.attributes),
+        key=lambda s: (s.start, s.end))
+    for prev, cur in zip(phases, phases[1:]):
+        if cur.start < prev.start or cur.start < prev.end - 1e-9:
+            raise AssertionError(
+                f"phase spans out of order: {prev.name} "
+                f"[{prev.start}, {prev.end}] then {cur.name} "
+                f"[{cur.start}, {cur.end}]")

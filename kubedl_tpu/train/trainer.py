@@ -192,7 +192,7 @@ class Trainer:
     def fit(self, state: TrainState, batches, num_steps: int,
             log_every: int = 10, on_step=None, checkpoint_manager=None,
             elastic_agent=None, eval_every: int = 0, eval_fn=None,
-            data_state_fn=None):
+            data_state_fn=None, tracer=None):
         """Training loop. ``checkpoint_manager`` saves on its configured
         interval plus a final save; ``elastic_agent`` is polled each step so
         operator-requested elastic checkpoints are taken between steps
@@ -201,7 +201,21 @@ class Trainer:
         once after the last step) on the CURRENT state — held-out
         validation without leaving the loop. ``data_state_fn() -> dict``
         supplies the data cursor stored with every checkpoint, so a
-        restore resumes the stream at the exact batch boundary."""
+        restore resumes the stream at the exact batch boundary.
+        ``tracer`` (``kubedl_tpu.trace.Tracer``, enabled) records one
+        ``train.step`` span per step and ``train.checkpoint`` spans,
+        attached to the owning job's trace when the operator injected
+        ``$KUBEDL_TRACEPARENT`` (docs/tracing.md)."""
+        tr = tracer if tracer is not None and tracer.enabled else None
+        trace_id = parent_id = None
+        if tr is not None:
+            import os
+            from ..trace import ENV_TRACEPARENT, parse_traceparent
+            ctx = parse_traceparent(os.environ.get(ENV_TRACEPARENT, ""))
+            if ctx is not None:
+                trace_id, parent_id = ctx
+            else:
+                trace_id = tr.new_trace_id()
         t0 = time.time()
         tokens = 0
         step0 = int(jax.device_get(state.step))  # one sync, then host-side
@@ -219,7 +233,13 @@ class Trainer:
                     tracing = True
                 batch = next(batches)
                 tokens += _batch_tokens(batch)
+                t_step = time.time() if tr is not None else 0.0
                 state, loss = self.step(state, batch)
+                if tr is not None:
+                    tr.record("train.step", t_step, time.time(),
+                              trace_id=trace_id, parent_id=parent_id,
+                              component="train",
+                              attributes={"step": step0 + i + 1})
                 if tracing and i + 1 >= profile_at + cfg.profile_steps:
                     jax.block_until_ready(loss)  # close open device events
                     jax.profiler.stop_trace()
@@ -229,10 +249,17 @@ class Trainer:
                 if elastic_agent is not None:
                     elastic_agent.poll(state)
                 if checkpoint_manager is not None:
+                    t_ck = time.time() if tr is not None else 0.0
                     checkpoint_manager.save(
                         state, step=step0 + i + 1, periodic=True,
                         data_state=(data_state_fn() if data_state_fn
                                     else None))
+                    if tr is not None:
+                        tr.record("train.checkpoint", t_ck, time.time(),
+                                  trace_id=trace_id, parent_id=parent_id,
+                                  component="train",
+                                  attributes={"step": step0 + i + 1,
+                                              "periodic": True})
                 if log_every and (i + 1) % log_every == 0:
                     dt = time.time() - t0
                     print(f"step {int(state.step)} loss {float(loss):.4f} "
@@ -248,10 +275,17 @@ class Trainer:
             if tracing:
                 jax.profiler.stop_trace()
         if checkpoint_manager is not None:
+            t_ck = time.time() if tr is not None else 0.0
             checkpoint_manager.save(
                 state, force=True,
                 data_state=(data_state_fn() if data_state_fn else None))
             checkpoint_manager.wait_until_finished()
+            if tr is not None:
+                tr.record("train.checkpoint", t_ck, time.time(),
+                          trace_id=trace_id, parent_id=parent_id,
+                          component="train",
+                          attributes={"step": int(jax.device_get(state.step)),
+                                      "periodic": False})
         return state
 
     def abstract_state(self, state: TrainState):

@@ -22,6 +22,9 @@ REASON_JOB_RUNNING = "JobRunning"
 REASON_JOB_FAILED = "JobFailed"
 REASON_JOB_RESTARTING = "JobRestarting"
 REASON_JOB_EVICTED = "JobEvicted"
+#: event reason stamped when every gang pod reports Running — the
+#: timestamp that bounds PJRT rendezvous latency (docs/tracing.md)
+REASON_RENDEZVOUS_READY = "RendezvousReady"
 
 
 def get_condition(status: JobStatus, cond_type: str) -> Optional[JobCondition]:

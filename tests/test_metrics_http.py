@@ -1,0 +1,114 @@
+"""Prometheus text-exposition format contract (metrics/http.py +
+registry.expose): the scrape response a real Prometheus must be able to
+parse — histogram ``_bucket``/``_sum``/``_count`` lines, CUMULATIVE
+``le`` bucket semantics, and label-value escaping (satellite of the
+tracing PR: these families now carry user-influenced label values like
+queue names)."""
+
+import urllib.request
+
+import pytest
+
+from kubedl_tpu.metrics.http import serve_metrics
+from kubedl_tpu.metrics.registry import Registry
+
+pytestmark = pytest.mark.trace
+
+
+def scrape(port: int, path: str = "/metrics"):
+    req = urllib.request.Request(f"http://127.0.0.1:{port}{path}")
+    with urllib.request.urlopen(req, timeout=5) as r:
+        return r.status, r.read().decode(), dict(r.headers)
+
+
+@pytest.fixture
+def served():
+    reg = Registry()
+    httpd = serve_metrics(reg, port=0, host="127.0.0.1")
+    try:
+        yield reg, httpd.server_address[1]
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_http_endpoint_serves_exposition(served):
+    reg, port = served
+    ctr = reg.counter("kubedl_test_total", "help text", ("kind",))
+    ctr.inc(kind="TFJob")
+    ctr.inc(2, kind="TFJob")
+    status, body, headers = scrape(port)
+    assert status == 200
+    assert headers["Content-Type"].startswith("text/plain")
+    assert int(headers["Content-Length"]) == len(body.encode())
+    assert "# HELP kubedl_test_total help text" in body
+    assert "# TYPE kubedl_test_total counter" in body
+    assert 'kubedl_test_total{kind="TFJob"} 3.0' in body
+    assert body.endswith("\n")
+
+
+def test_http_unknown_path_404(served):
+    _, port = served
+    try:
+        status, _, _ = scrape(port, "/nope")
+    except urllib.error.HTTPError as e:  # noqa: F821 — urllib.request import
+        status = e.code
+    assert status == 404
+
+
+def _lines(body, prefix):
+    return [ln for ln in body.splitlines() if ln.startswith(prefix)]
+
+
+def test_histogram_bucket_sum_count_lines(served):
+    reg, port = served
+    h = reg.histogram("kubedl_lat_seconds", "latency", ("queue",),
+                      buckets=(1, 5, 10))
+    for v in (0.5, 3.0, 7.0, 42.0):
+        h.observe(v, queue="prod")
+    _, body, _ = scrape(port)
+    buckets = _lines(body, "kubedl_lat_seconds_bucket")
+    # cumulative le semantics: every observation <= le counts, +Inf = all
+    assert buckets == [
+        'kubedl_lat_seconds_bucket{queue="prod",le="1"} 1',
+        'kubedl_lat_seconds_bucket{queue="prod",le="5"} 2',
+        'kubedl_lat_seconds_bucket{queue="prod",le="10"} 3',
+        'kubedl_lat_seconds_bucket{queue="prod",le="+Inf"} 4',
+    ]
+    assert _lines(body, "kubedl_lat_seconds_sum") == [
+        'kubedl_lat_seconds_sum{queue="prod"} 52.5']
+    assert _lines(body, "kubedl_lat_seconds_count") == [
+        'kubedl_lat_seconds_count{queue="prod"} 4']
+
+
+def test_histogram_unlabeled_wraps_le_alone(served):
+    reg, port = served
+    h = reg.histogram("kubedl_plain_seconds", "plain", buckets=(1,))
+    h.observe(0.5)
+    _, body, _ = scrape(port)
+    assert 'kubedl_plain_seconds_bucket{le="1"} 1' in body
+    assert 'kubedl_plain_seconds_bucket{le="+Inf"} 1' in body
+    # no labels: _sum/_count lines carry no brace block at all
+    assert _lines(body, "kubedl_plain_seconds_sum") == [
+        "kubedl_plain_seconds_sum 0.5"]
+    assert _lines(body, "kubedl_plain_seconds_count") == [
+        "kubedl_plain_seconds_count 1"]
+
+
+def test_label_value_escaping(served):
+    reg, port = served
+    g = reg.gauge("kubedl_esc", "escapes", ("name",))
+    g.set(1, name='we"ird\\queue\nx')
+    h = reg.histogram("kubedl_esc_h", "escapes", ("name",), buckets=(1,))
+    h.observe(0.5, name='a"b')
+    _, body, _ = scrape(port)
+    # backslash, quote, and newline are escaped per the text format spec
+    assert 'kubedl_esc{name="we\\"ird\\\\queue\\nx"} 1.0' in body
+    assert "\nx\"" not in body          # the raw newline never leaks
+    assert 'kubedl_esc_h_bucket{name="a\\"b",le="1"} 1' in body
+    assert 'kubedl_esc_h_sum{name="a\\"b"} 0.5' in body
+    # every non-comment line still parses as `name{labels} value`
+    for ln in body.splitlines():
+        if ln.startswith("#") or not ln:
+            continue
+        assert ln.count(" ") >= 1 and not ln.startswith("{")

@@ -1,0 +1,778 @@
+"""End-to-end tracing (docs/tracing.md).
+
+Four layers:
+
+* unit — traceparent context, ring-buffer bounds, exporters (Chrome
+  trace-event / OTLP-JSON), critical-path analysis and orphan detection;
+* engine — lifecycle phase spans, annotation/env propagation, the
+  rendezvous-ready event, and the disabled-path contract (no artifacts,
+  fixed op budget — the ``perf`` guard);
+* stack — THE acceptance e2e: a chaos-seeded submit → queue → admit →
+  preempt → readmit → run → succeed flow whose full critical path must
+  reconstruct with no orphan spans, with the Chrome export round-tripping
+  through ``json.loads`` in monotonic phase order;
+* console — ``/api/v1/trace/{ns}/{job}`` + ``/api/v1/trace/request/{id}``
+  endpoints and the per-job queue-wait surfaced in job detail.
+"""
+
+import json
+import sys
+
+import pytest
+
+from kubedl_tpu import trace
+from kubedl_tpu.api import common as c
+from kubedl_tpu.api.queue import new_queue
+from kubedl_tpu.console.proxy import DataProxy
+from kubedl_tpu.console.server import ConsoleConfig, ConsoleServer
+from kubedl_tpu.controllers.chaos import ChaosAPIServer, ChaosConfig
+from kubedl_tpu.controllers.engine import EngineConfig, JobEngine
+from kubedl_tpu.controllers.registry import OperatorConfig, build_operator
+from kubedl_tpu.controllers.testing import (TestJobController, new_test_job,
+                                            run_all_pods, set_pod_phase)
+from kubedl_tpu.core import features as ft
+from kubedl_tpu.core import meta as m
+from kubedl_tpu.core.apiserver import APIServer
+from kubedl_tpu.core.manager import Manager
+from kubedl_tpu.metrics.registry import Registry, TraceMetrics
+from kubedl_tpu.scheduling.gang import CoschedulerPlugin
+from kubedl_tpu.scheduling.inventory import SliceInventory
+from kubedl_tpu.scheduling.scheduler import SliceScheduler
+from kubedl_tpu.utils import status as st
+from kubedl_tpu.utils.retry import RetryPolicy
+
+pytestmark = pytest.mark.trace
+
+POOL = "tpu-v5p-slice/2x2x4"
+
+
+def make_tracer(clock, capacity=8192, registry=None):
+    return trace.Tracer(enabled=True, capacity=capacity, clock=clock,
+                        metrics=TraceMetrics(registry or Registry()))
+
+
+# ---------------------------------------------------------------------------
+# unit: context, recorder, exporters, analysis
+# ---------------------------------------------------------------------------
+
+
+def test_traceparent_roundtrip_and_rejects():
+    tid, sid = trace.derive_context("uid-1")
+    assert len(tid) == 32 and len(sid) == 16
+    assert trace.parse_traceparent(
+        trace.format_traceparent(tid, sid)) == (tid, sid)
+    # derivation is deterministic and key-sensitive
+    assert trace.derive_context("uid-1") == (tid, sid)
+    assert trace.derive_context("uid-2") != (tid, sid)
+    for bad in ("", "junk", "00-zz-ff-01", "00-" + "a" * 31 + "-" + "b" * 16
+                + "-01", None):
+        assert trace.parse_traceparent(bad) is None
+
+
+def test_job_trace_context_annotation_wins():
+    job = {"metadata": {"uid": "u1", "namespace": "ns", "name": "j"}}
+    derived = trace.job_trace_context(job)
+    assert derived == trace.derive_context("u1")
+    job["metadata"]["annotations"] = {
+        c.ANNOTATION_TRACEPARENT: trace.format_traceparent("ab" * 16,
+                                                           "cd" * 8)}
+    assert trace.job_trace_context(job) == ("ab" * 16, "cd" * 8)
+
+
+def test_ring_buffer_bounds_and_metrics(clock):
+    reg = Registry()
+    tr = make_tracer(clock, capacity=4, registry=reg)
+    for i in range(6):
+        tr.record(f"s{i}", 0.0, 1.0, component="x")
+    spans = tr.spans()
+    assert [s.name for s in spans] == ["s2", "s3", "s4", "s5"]  # oldest out
+    assert tr.dropped == 2
+    assert tr.metrics.dropped.value() == 2
+    assert tr.metrics.spans.value(component="x") == 6
+    assert tr.metrics.buffered.value() == 4
+
+
+def test_disabled_tracer_records_nothing(clock):
+    tr = trace.Tracer(enabled=False, clock=clock)
+    assert tr.record("x", 0.0, 1.0) is None
+    with tr.span("y"):
+        pass
+    assert tr.spans() == []
+    assert tr.span("z") is trace.NOOP_TRACER.span("z")  # shared singleton
+
+
+def test_span_context_manager_and_error_status(clock):
+    tr = make_tracer(clock)
+    with tr.span("ok-span", component="t") as sp:
+        clock.advance(2.5)
+        sp.set(foo="bar")
+    with pytest.raises(RuntimeError):
+        with tr.span("bad-span", component="t"):
+            raise RuntimeError("boom")
+    ok, bad = tr.spans()
+    assert ok.name == "ok-span" and ok.duration == pytest.approx(2.5)
+    assert ok.attributes["foo"] == "bar" and ok.status == "ok"
+    assert bad.status == "error" and bad.name == "bad-span"
+
+
+def _fake_job_trace(tr, tid="ab" * 16, root="cd" * 8):
+    """Hand-built lifecycle trace: Created(0-1) Queuing(1-4)
+    PodsCreated(4-5) Running(5-9) Succeeded(9) + root."""
+    phases = [("Created", 0, 1), ("Queuing", 1, 4), ("PodsCreated", 4, 5),
+              ("Running", 5, 9), ("Succeeded", 9, 9)]
+    for name, s, e in phases:
+        tr.record(name, s, e, trace_id=tid, parent_id=root,
+                  component="lifecycle",
+                  attributes={"phase": name, "job": "ns/j"})
+    tr.record("scheduler.queue-wait", 1, 4, trace_id=tid, parent_id=root,
+              component="scheduler", attributes={"queue": "default"})
+    tr.record("job ns/j", 0, 9, trace_id=tid, span_id=root,
+              component="lifecycle", attributes={"job": "ns/j"})
+    return tid
+
+
+def test_breakdown_phases_events_and_totals(clock):
+    tr = make_tracer(clock)
+    tid = _fake_job_trace(tr)
+    bd = trace.trace_breakdown(tr.spans(trace_id=tid))
+    assert bd["traceId"] == tid
+    assert [p["name"] for p in bd["phases"]] == [
+        "Created", "Queuing", "PodsCreated", "Running", "Succeeded"]
+    assert bd["byPhase"] == {"Created": 1.0, "Queuing": 3.0,
+                             "PodsCreated": 1.0, "Running": 4.0,
+                             "Succeeded": 0.0}
+    assert bd["root"]["name"] == "job ns/j"
+    assert bd["totalSeconds"] == 9.0
+    assert [e["name"] for e in bd["events"]] == ["scheduler.queue-wait"]
+    assert bd["orphans"] == []
+    # restart rounds: repeated phases aggregate
+    tr.record("Queuing", 10, 12, trace_id=tid, parent_id="cd" * 8,
+              component="lifecycle", attributes={"phase": "Queuing"})
+    bd2 = trace.trace_breakdown(tr.spans(trace_id=tid))
+    assert bd2["byPhase"]["Queuing"] == 5.0
+
+
+def test_orphan_detection_and_implicit_root(clock):
+    tr = make_tracer(clock)
+    tid = "12" * 16
+    # all children of ONE missing parent, no root recorded yet: that is
+    # the designed live-job shape, not an orphan set
+    for i in range(3):
+        tr.record(f"p{i}", i, i + 1, trace_id=tid, parent_id="ee" * 8,
+                  component="lifecycle", attributes={"phase": f"p{i}"})
+    assert trace.find_orphans(tr.spans(trace_id=tid)) == []
+    # a root exists but one span points at a DIFFERENT missing parent
+    tr.record("root", 0, 3, trace_id=tid, span_id="ee" * 8,
+              component="lifecycle")
+    tr.record("stray", 0, 1, trace_id=tid, parent_id="ff" * 8)
+    orphans = trace.find_orphans(tr.spans(trace_id=tid))
+    assert [s.name for s in orphans] == ["stray"]
+    with pytest.raises(AssertionError):
+        trace.assert_well_formed(tr.spans(trace_id=tid))
+
+
+def test_assert_well_formed_rejects_out_of_order(clock):
+    tr = make_tracer(clock)
+    tid = "34" * 16
+    tr.record("Running", 5, 9, trace_id=tid, component="lifecycle",
+              attributes={"phase": "Running"})
+    tr.record("Queuing", 1, 7, trace_id=tid, component="lifecycle",
+              attributes={"phase": "Queuing"})   # overlaps into Running
+    with pytest.raises(AssertionError):
+        trace.assert_well_formed(tr.spans(trace_id=tid))
+
+
+def test_chrome_export_roundtrips_and_orders(clock):
+    tr = make_tracer(clock)
+    tid = _fake_job_trace(tr)
+    raw = trace.chrome_trace_json(tr.spans(trace_id=tid))
+    doc = json.loads(raw)                      # the acceptance round-trip
+    evs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert evs and all(e["dur"] >= 0 for e in evs)
+    phase_ts = [e["ts"] for e in evs
+                if e["args"].get("parentId") and e["cat"] == "lifecycle"]
+    assert phase_ts == sorted(phase_ts)        # monotonic phase order
+    pids = {e["pid"] for e in evs}
+    assert len(pids) == 1                      # one trace -> one pid group
+
+
+def test_otlp_export_shape(clock):
+    tr = make_tracer(clock)
+    tr.record("x", 1.5, 2.5, trace_id="ab" * 16, component="engine",
+              attributes={"n": 3, "flag": True, "s": "v"}, status="error")
+    doc = trace.to_otlp_json(tr.spans())
+    doc = json.loads(json.dumps(doc))          # JSON-serializable
+    rs = doc["resourceSpans"][0]
+    assert rs["resource"]["attributes"][0]["value"]["stringValue"] \
+        == "kubedl-tpu"
+    span = rs["scopeSpans"][0]["spans"][0]
+    assert span["traceId"] == "ab" * 16
+    assert span["startTimeUnixNano"] == str(int(1.5e9))
+    assert span["endTimeUnixNano"] == str(int(2.5e9))
+    assert span["status"]["code"] == 2
+    attrs = {a["key"]: a["value"] for a in span["attributes"]}
+    assert attrs["n"] == {"intValue": "3"}
+    assert attrs["flag"] == {"boolValue": True}
+    assert attrs["s"] == {"stringValue": "v"}
+
+
+@pytest.mark.perf
+def test_disabled_tracer_op_budget(clock):
+    """The disabled hot path must stay within a fixed op budget: at most
+    4 Python-level calls per span() with-block and 1 per record() — an
+    accidental allocation/formatting slip on the off path shows up here
+    as a budget breach, not a vague slowdown (work counters, no wall
+    clocks, same discipline as the other perf guards)."""
+    tr = trace.Tracer(enabled=False, clock=clock)
+    n = 200
+    counts = {"calls": 0}
+
+    def profiler(frame, event, arg):
+        if event == "call":
+            counts["calls"] += 1
+
+    sys.setprofile(profiler)
+    try:
+        for _ in range(n):
+            with tr.span("x", component="engine",
+                         attributes={"k": "v"}):
+                pass
+        for _ in range(n):
+            tr.record("x", 0.0, 1.0, component="engine")
+    finally:
+        sys.setprofile(None)
+    # span(): the call itself + __enter__ + __exit__ (+1 slack);
+    # record(): the call itself (+1 slack)
+    assert counts["calls"] <= n * 4 + n * 2, counts
+    assert tr.spans() == []
+
+
+# ---------------------------------------------------------------------------
+# engine: lifecycle spans, propagation, disabled parity
+# ---------------------------------------------------------------------------
+
+
+def tpu_job(name, queue=None, workers=4):
+    run_policy = ({"schedulingPolicy": {"queue": queue}} if queue else None)
+    return new_test_job(name, workers=workers, restart_policy="ExitCode",
+                        tpu_policy={"acceleratorType": "v5p-32"},
+                        run_policy=run_policy)
+
+
+def make_engine(api, manager, clock, tracer=None, gate=False):
+    engine = JobEngine(
+        api, TestJobController(),
+        EngineConfig(enable_gang_scheduling=True,
+                     gate_on_gang_admission=gate,
+                     retry_policy=RetryPolicy(attempts=4, base=0.01,
+                                              cap=0.05),
+                     retry_sleep=clock.advance,
+                     backoff_jitter_seed=1),
+        gang=CoschedulerPlugin(api), tracer=tracer)
+    manager.register(engine)
+    return engine
+
+
+def _pod_env(pod, name):
+    for ct in m.get_in(pod, "spec", "containers", default=[]) or []:
+        for e in ct.get("env", []) or []:
+            if e.get("name") == name:
+                return e.get("value")
+    return None
+
+
+def test_engine_disabled_leaves_no_trace_artifacts(api, manager, clock):
+    make_engine(api, manager, clock, tracer=None)
+    api.create(tpu_job("j0"))
+    manager.run_until_idle(max_iterations=500)
+    run_all_pods(api)
+    manager.run_until_idle(max_iterations=500)
+    job = api.get("TestJob", "default", "j0")
+    assert c.ANNOTATION_TRACEPARENT not in m.get_annotations(job)
+    for pod in api.list("Pod"):
+        assert _pod_env(pod, trace.ENV_TRACEPARENT) is None
+    for pg in api.list("PodGroup"):
+        assert c.ANNOTATION_TRACEPARENT not in m.get_annotations(pg)
+    assert trace.NOOP_TRACER.spans() == []
+
+
+def test_engine_lifecycle_spans_and_propagation(api, manager, clock):
+    tr = make_tracer(clock)
+    make_engine(api, manager, clock, tracer=tr)
+    api.create(tpu_job("j1"))
+    manager.run_until_idle(max_iterations=500)
+    clock.advance(3.0)
+    run_all_pods(api)
+    manager.run_until_idle(max_iterations=500)
+
+    job = api.get("TestJob", "default", "j1")
+    # traceparent stamped on the job and propagated to pods + PodGroups
+    ann = m.get_annotations(job).get(c.ANNOTATION_TRACEPARENT)
+    assert ann and trace.parse_traceparent(ann) \
+        == trace.job_trace_context(job)
+    tid, root = trace.job_trace_context(job)
+    for pod in api.list("Pod"):
+        assert _pod_env(pod, trace.ENV_TRACEPARENT) == ann
+    for pg in api.list("PodGroup"):
+        assert m.get_annotations(pg).get(c.ANNOTATION_TRACEPARENT) == ann
+    # rendezvous-ready event fired at the all-running transition
+    reasons = [e.get("reason") for e in api.list("Event")]
+    assert st.REASON_RENDEZVOUS_READY in reasons
+
+    clock.advance(5.0)
+    for pod in api.list("Pod"):
+        set_pod_phase(api, pod, "Succeeded", exit_code=0)
+    manager.run_until_idle(max_iterations=500)
+    assert st.is_succeeded(
+        c.JobStatus.from_dict(api.get("TestJob", "default",
+                                      "j1").get("status")))
+    spans = tr.spans(trace_id=tid)
+    trace.assert_well_formed(spans)
+    bd = trace.trace_breakdown(spans, tid)
+    names = [p["name"] for p in bd["phases"]]
+    for want in ("Created", "PodsCreated", "Rendezvous", "Running",
+                 "Succeeded"):
+        assert want in names, names
+    assert names[0] == "Created" and names[-1] == "Succeeded"
+    assert bd["root"] is not None and bd["root"]["spanId"] == root
+    assert bd["byPhase"]["Running"] == pytest.approx(5.0)
+    assert bd["orphans"] == []
+
+
+def test_manager_records_reconcile_spans(api, clock):
+    tr = make_tracer(clock)
+    mgr = Manager(api, clock=clock, tracer=tr)
+    make_engine(api, mgr, clock, tracer=tr)
+    api.create(new_test_job("plain", workers=1))
+    mgr.run_until_idle(max_iterations=200)
+    recs = tr.spans(component="manager")
+    assert recs and all(s.name == "reconcile" for s in recs)
+    assert any(s.attributes.get("kind") == "TestJob"
+               and s.attributes.get("name") == "plain" for s in recs)
+
+
+def test_operator_gate_wiring():
+    op = build_operator(APIServer(), OperatorConfig(workloads=[]))
+    assert op.tracer is not None and not op.tracer.enabled
+    gates = ft.FeatureGates()
+    gates.set(ft.TRACING, True)
+    op2 = build_operator(APIServer(), OperatorConfig(workloads=[],
+                                                     feature_gates=gates))
+    assert op2.tracer.enabled
+    op3 = build_operator(APIServer(), OperatorConfig(workloads=[],
+                                                     enable_tracing=True,
+                                                     trace_buffer=128))
+    assert op3.tracer.enabled and op3.tracer.capacity == 128
+    assert op3.manager.tracer is op3.tracer
+
+
+# ---------------------------------------------------------------------------
+# scheduler spans
+# ---------------------------------------------------------------------------
+
+
+def test_scheduler_pass_and_queue_wait_spans(api, clock):
+    tr = make_tracer(clock)
+    inv = SliceInventory(api, static_capacity={POOL: 1})
+    sched = SliceScheduler(api, inventory=inv, tracer=tr,
+                           retry_policy=RetryPolicy(attempts=3, base=0.0,
+                                                    cap=0.0),
+                           retry_sleep=lambda s: None)
+    pg = m.new_obj("scheduling.sigs.k8s.io/v1alpha1", "PodGroup", "g1",
+                   "default", labels={c.LABEL_GANG_JOB_NAME: "g1"},
+                   annotations={c.ANNOTATION_SCHED_POOL: POOL,
+                                c.ANNOTATION_SCHED_QUEUE: "alpha",
+                                c.ANNOTATION_SCHED_NUM_SLICES: "1"})
+    pg["spec"] = {"minMember": 4}
+    api.create(pg)
+    clock.advance(6.0)
+    sched.schedule_pass()
+    passes = tr.spans(component="scheduler")
+    assert any(s.name == "scheduler.pass" for s in passes)
+    qw = [s for s in passes if s.name == "scheduler.queue-wait"]
+    assert len(qw) == 1
+    assert qw[0].duration == pytest.approx(6.0)
+    assert qw[0].attributes["queue"] == "alpha"
+    # no owner/annotation on the hand-built PG: ns/job-derived context
+    assert qw[0].trace_id == trace.derive_context("default/g1")[0]
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance e2e: chaos-seeded full critical path
+# ---------------------------------------------------------------------------
+
+
+def _traced_stack(api, clock, capacity):
+    tr = make_tracer(clock)
+    manager = Manager(api, clock=clock, tracer=tr)
+    engine = JobEngine(
+        api, TestJobController(),
+        EngineConfig(enable_gang_scheduling=True,
+                     gate_on_gang_admission=True,
+                     retry_policy=RetryPolicy(attempts=4, base=0.01,
+                                              cap=0.05),
+                     retry_sleep=clock.advance,
+                     backoff_jitter_seed=1),
+        gang=CoschedulerPlugin(api), tracer=tr)
+    manager.register(engine)
+    inv = SliceInventory(api, static_capacity=capacity)
+    sched = SliceScheduler(api, inventory=inv, tracer=tr,
+                           retry_policy=RetryPolicy(attempts=4, base=0.01,
+                                                    cap=0.05),
+                           retry_sleep=clock.advance)
+    manager.register(sched)
+    return tr, manager, engine, sched
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_e2e_critical_path_reconstructs_under_chaos(clock, seed):
+    """Acceptance: submit → queue → admit → preempt → readmit → run →
+    succeed, under seeded api chaos (status-write conflicts + transient
+    create errors). The borrower job's trace must reconstruct the FULL
+    critical path — every declared phase, both queue stints, the
+    scheduler's queue-wait and preemption spans — with no orphan spans,
+    and the Chrome export must round-trip through ``json.loads`` with
+    monotonically ordered phase spans."""
+    inner = APIServer(clock=clock)
+    chaos = ChaosAPIServer(inner, ChaosConfig(
+        seed=seed, conflict_on_status_update=0.15, error_on_create=0.1,
+        max_faults=12))
+    tr, manager, engine, sched = _traced_stack(chaos, clock, {POOL: 1})
+    # client/kubelet-side writes go to the raw store (chaos targets the
+    # OPERATOR's api calls, same convention as the kubelet helpers)
+    inner.create(new_queue("prod", min=1, priority=100))
+    inner.create(new_queue("best", min=0, priority=0))
+
+    inner.create(tpu_job("borrower", "best"))
+    manager.run_until_idle(max_iterations=800)
+    clock.advance(4.0)
+    run_all_pods(chaos)
+    manager.run_until_idle(max_iterations=800)
+    clock.advance(5.0)
+
+    # prod arrives under its min -> borrower preempted slice-atomically
+    inner.create(tpu_job("guaranteed", "prod"))
+    manager.run_until_idle(max_iterations=2500)
+    clock.advance(7.0)
+    run_all_pods(chaos)
+    manager.run_until_idle(max_iterations=800)
+    for pod in inner.list("Pod"):
+        set_pod_phase(chaos, pod, "Succeeded", exit_code=0)
+    manager.run_until_idle(max_iterations=2500)
+    clock.advance(2.0)
+    run_all_pods(chaos)
+    manager.run_until_idle(max_iterations=800)
+    for pod in inner.list("Pod"):
+        if m.get_in(pod, "status", "phase") == "Running":
+            set_pod_phase(chaos, pod, "Succeeded", exit_code=0)
+    manager.run_until_idle(max_iterations=800)
+
+    for name in ("borrower", "guaranteed"):
+        job = inner.get("TestJob", "default", name)
+        assert st.is_succeeded(c.JobStatus.from_dict(job.get("status"))), \
+            (name, seed)
+
+    borrower = inner.get("TestJob", "default", "borrower")
+    tid, root = trace.job_trace_context(borrower)
+    spans = tr.spans(trace_id=tid)
+    trace.assert_well_formed(spans)            # no orphans, ordered phases
+    bd = trace.trace_breakdown(spans, tid)
+    assert bd["orphans"] == []
+    names = [p["name"] for p in bd["phases"]]
+    for want in ("Created", "Queuing", "Admitted", "PodsCreated",
+                 "Rendezvous", "Running", "Restarting", "Succeeded"):
+        assert want in names, (seed, names)
+    assert names[0] == "Created" and names[-1] == "Succeeded"
+    assert names.count("Queuing") >= 2         # initial + post-preemption
+    assert bd["root"] is not None
+    # the scheduler's spans landed in the SAME trace with the SAME root
+    ev_names = [e["name"] for e in bd["events"]]
+    assert ev_names.count("scheduler.queue-wait") >= 2, (seed, ev_names)
+    assert "scheduler.preempt" in ev_names
+    assert all(e["parentId"] == root for e in bd["events"]
+               if e["name"].startswith("scheduler.")), (seed, bd["events"])
+    # restart round attribution survived into the Restarting span
+    restarting = [p for p in bd["phases"] if p["name"] == "Restarting"]
+    assert any(p["attributes"].get("restartRound", 0) >= 1
+               for p in restarting), restarting
+
+    # Chrome export: json.loads round-trip, phases monotonic by ts
+    doc = json.loads(trace.chrome_trace_json(spans))
+    phase_ts = [e["ts"] for e in doc["traceEvents"]
+                if e.get("ph") == "X" and e.get("cat") == "lifecycle"
+                and e["args"].get("parentId")]
+    assert phase_ts == sorted(phase_ts)
+
+    # the guaranteed job never restarted and reconstructs cleanly too
+    gtid, _ = trace.job_trace_context(
+        inner.get("TestJob", "default", "guaranteed"))
+    gspans = tr.spans(trace_id=gtid)
+    trace.assert_well_formed(gspans)
+    gnames = [p["name"]
+              for p in trace.trace_breakdown(gspans, gtid)["phases"]]
+    assert "Restarting" not in gnames and gnames[-1] == "Succeeded"
+    sched.check_parity()
+
+
+# ---------------------------------------------------------------------------
+# console endpoints
+# ---------------------------------------------------------------------------
+
+
+def _route(server, method, path, params=None):
+    status, payload, _ = server.route(method, path, params or {}, b"", None)
+    return status, payload
+
+
+def test_console_trace_endpoints_and_queue_wait(api, clock):
+    tr, manager, engine, sched = _traced_stack(api, clock, {POOL: 1})
+    api.create(tpu_job("j1"))
+    api.create(tpu_job("j2"))
+    manager.run_until_idle(max_iterations=800)
+    run_all_pods(api)
+    manager.run_until_idle(max_iterations=800)
+    clock.advance(9.0)                          # j2 waits 9s in queue
+    queued = next(n for n in ("j1", "j2") if st.is_queuing(
+        c.JobStatus.from_dict(api.get("TestJob", "default",
+                                      n).get("status"))))
+    running = "j1" if queued == "j2" else "j2"
+
+    proxy = DataProxy(api, None, None, job_kinds=("TestJob",), tracer=tr)
+    server = ConsoleServer(proxy, ConsoleConfig(port=0, users={}))
+    try:
+        # a still-queuing job reports its live wait (condition fallback:
+        # its Queuing phase span is still open)
+        assert proxy.job_queue_wait(
+            api.get("TestJob", "default", queued)) >= 9.0
+
+        # finish the running job; the queued one admits and completes
+        for pod in api.list("Pod"):
+            set_pod_phase(api, pod, "Succeeded", exit_code=0)
+        manager.run_until_idle(max_iterations=800)
+        run_all_pods(api)
+        manager.run_until_idle(max_iterations=800)
+        for pod in api.list("Pod"):
+            if m.get_in(pod, "status", "phase") == "Running":
+                set_pod_phase(api, pod, "Succeeded", exit_code=0)
+        manager.run_until_idle(max_iterations=800)
+
+        status, payload = _route(server, "GET",
+                                 f"/api/v1/trace/default/{queued}")
+        assert status == 200
+        bd = payload["data"]
+        assert bd["orphans"] == []
+        assert bd["byPhase"]["Queuing"] >= 9.0
+        assert [p["name"] for p in bd["phases"]][-1] == "Succeeded"
+
+        # completed job: the trace-derived queue wait survives the
+        # condition flipping off
+        assert proxy.job_queue_wait(
+            api.get("TestJob", "default", queued)) >= 9.0
+        status, payload = _route(server, "GET",
+                                 f"/api/v1/trace/default/{running}")
+        assert status == 200
+
+        # exporter formats
+        status, payload = _route(server, "GET",
+                                 f"/api/v1/trace/default/{queued}",
+                                 {"format": "chrome"})
+        assert status == 200 and "traceEvents" in payload["data"]
+        status, payload = _route(server, "GET",
+                                 f"/api/v1/trace/default/{queued}",
+                                 {"format": "otlp"})
+        assert status == 200 and "resourceSpans" in payload["data"]
+
+        # request traces by id (the serving endpoint)
+        rid = "5a" * 16
+        tr.record("serving.request", 0.0, 2.0, trace_id=rid,
+                  span_id="6b" * 8, component="serving")
+        tr.record("request.decode", 0.5, 2.0, trace_id=rid,
+                  parent_id="6b" * 8, component="serving")
+        status, payload = _route(server, "GET",
+                                 f"/api/v1/trace/request/{rid}")
+        assert status == 200
+        assert {s["name"] for s in payload["data"]["spans"]} == {
+            "serving.request", "request.decode"}
+
+        # unknowns 404
+        assert _route(server, "GET",
+                      "/api/v1/trace/default/nope")[0] == 404
+        assert _route(server, "GET",
+                      f"/api/v1/trace/request/{'9f' * 16}")[0] == 404
+    finally:
+        server._httpd.server_close()
+
+
+def test_job_detail_route_serves_queue_wait(api, clock):
+    """The job-detail proxy response carries queueWaitSeconds (satellite):
+    condition-fallback path through the real console route, using a kind
+    the console's KIND_TABLE knows."""
+    job = m.new_obj("training.kubedl.io/v1alpha1", "PyTorchJob", "pj",
+                    "default", spec={"pytorchReplicaSpecs": {}})
+    api.create(job)
+    fresh = api.get("PyTorchJob", "default", "pj")
+    fresh["status"] = {"conditions": [{
+        "type": c.JOB_QUEUING, "status": "True",
+        "reason": st.REASON_JOB_QUEUING,
+        "lastTransitionTime": m.rfc3339(api.now())}]}
+    api.update_status(fresh)
+    clock.advance(11.0)
+    proxy = DataProxy(api, None, None)
+    server = ConsoleServer(proxy, ConsoleConfig(port=0, users={}))
+    try:
+        status, payload = _route(server, "GET", "/api/v1/job/detail",
+                                 {"kind": "PyTorchJob", "name": "pj",
+                                  "namespace": "default"})
+        assert status == 200
+        assert payload["data"]["queueWaitSeconds"] == pytest.approx(11.0)
+    finally:
+        server._httpd.server_close()
+
+
+def test_console_trace_disabled_501(api):
+    proxy = DataProxy(api, None, None, job_kinds=("TestJob",), tracer=None)
+    server = ConsoleServer(proxy, ConsoleConfig(port=0, users={}))
+    try:
+        assert _route(server, "GET", "/api/v1/trace/default/x")[0] == 501
+        assert _route(server, "GET",
+                      f"/api/v1/trace/request/{'aa' * 16}")[0] == 501
+        # queue-wait falls back to the Queuing condition without a tracer
+        api.create(new_test_job("q", workers=1))
+        job = api.get("TestJob", "default", "q")
+        assert proxy.job_queue_wait(job) is None
+    finally:
+        server._httpd.server_close()
+
+
+# ---------------------------------------------------------------------------
+# serving + trainer spans (compile-heavy: slow tier)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_serving_request_spans():
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from kubedl_tpu.models import llama
+    from kubedl_tpu.serving.batching import ContinuousBatchingEngine
+    from kubedl_tpu.serving.engine import GenerateConfig, InferenceEngine
+
+    cfg = dataclasses.replace(llama.tiny(vocab=128), dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    tr = trace.Tracer(enabled=True)
+    eng = ContinuousBatchingEngine(cfg, params, lanes=2, max_len=96,
+                                   tracer=tr)
+    requests = [([5, 7, 11], 6), ([3], 4), ([2, 4, 6], 5)]
+    got = eng.run(requests)
+    assert [len(t) for t in got] == [6, 4, 5]
+    roots = [s for s in tr.spans(component="serving")
+             if s.name == "serving.request"]
+    assert len(roots) == 3
+    for root in roots:
+        spans = tr.spans(trace_id=root.trace_id)
+        names = {s.name for s in spans}
+        assert {"request.queue", "request.prefill",
+                "request.decode"} <= names
+        trace.assert_well_formed(spans)
+        for s in spans:
+            if s.name != "serving.request":
+                assert s.parent_id == root.span_id
+        assert root.attributes["preemptions"] == 0
+    tokens = {r.attributes["tokens"] for r in roots}
+    assert tokens == {6, 4, 5}
+
+    # the lockstep engine records prefill/decode under one generate root
+    tr2 = trace.Tracer(enabled=True)
+    solo = InferenceEngine(cfg, params, GenerateConfig(max_len=96),
+                           tracer=tr2)
+    solo.generate([[5, 7, 11]], 4)
+    names = [s.name for s in tr2.spans()]
+    assert names == ["inference.prefill", "inference.decode",
+                     "inference.generate"]
+    trace.assert_well_formed(tr2.spans())
+
+
+@pytest.mark.slow
+def test_serving_untraced_requests_record_nothing():
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from kubedl_tpu.models import llama
+    from kubedl_tpu.serving.batching import ContinuousBatchingEngine
+
+    cfg = dataclasses.replace(llama.tiny(vocab=128), dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    eng = ContinuousBatchingEngine(cfg, params, lanes=2, max_len=64)
+    got = eng.run([([1, 2], 3)])
+    assert len(got[0]) == 3
+    assert eng.tracer.spans() == []      # the shared NOOP tracer
+
+
+@pytest.mark.slow
+def test_trainer_step_and_checkpoint_spans(tmp_path, monkeypatch):
+    import jax
+
+    from kubedl_tpu.models import llama
+    from kubedl_tpu.parallel.mesh import MeshConfig, build_mesh
+    from kubedl_tpu.train.checkpoint import (CheckpointConfig,
+                                             CheckpointManager)
+    from kubedl_tpu.train.data import shard_batch, synthetic_lm_batches
+    from kubedl_tpu.train.trainer import TrainConfig, Trainer
+
+    cfg = llama.tiny(vocab=256, seq=64)
+    mesh = build_mesh(MeshConfig(fsdp=8))
+
+    def loss(p, b):
+        return llama.loss_fn(cfg, p, b["tokens"], b["targets"], mesh=mesh)
+
+    trainer = Trainer(loss, llama.param_specs(cfg), mesh,
+                      TrainConfig(warmup_steps=1, decay_steps=10))
+    state = trainer.init_state(llama.init_params(cfg, jax.random.PRNGKey(0)))
+    # the engine-injected context: trainer spans join the job's trace
+    tid, root = trace.derive_context("job-uid-7")
+    monkeypatch.setenv(trace.ENV_TRACEPARENT,
+                       trace.format_traceparent(tid, root))
+    tr = trace.Tracer(enabled=True)
+    mngr = CheckpointManager(CheckpointConfig(str(tmp_path / "ckpt"),
+                                              async_save=False))
+    batches = synthetic_lm_batches(8, 64, cfg.vocab_size, seed=3)
+    sharded = (shard_batch(b, mesh) for b in batches)
+    trainer.fit(state, sharded, num_steps=2, log_every=0,
+                checkpoint_manager=mngr, tracer=tr)
+    mngr.close()
+    steps = tr.spans(component="train")
+    assert [s.name for s in steps].count("train.step") == 2
+    assert any(s.name == "train.checkpoint" for s in steps)
+    for s in steps:
+        assert s.trace_id == tid and s.parent_id == root
+    assert [s.attributes["step"] for s in steps
+            if s.name == "train.step"] == [1, 2]
+
+
+def test_job_queue_wait_adds_live_stint_to_closed_spans(api, clock):
+    """Review regression: a job re-queued after preemption has CLOSED
+    Queuing spans in its trace AND a live Queuing condition — the
+    reported wait must be their sum, not the frozen historical total."""
+    tr = make_tracer(clock)
+    job = m.new_obj("training.kubedl.io/v1alpha1", "PyTorchJob", "rq",
+                    "default", spec={"pytorchReplicaSpecs": {}})
+    api.create(job)
+    fresh = api.get("PyTorchJob", "default", "rq")
+    tid, root = trace.job_trace_context(fresh)
+    tr.record("Queuing", api.now(), api.now() + 10.0, trace_id=tid,
+              parent_id=root, component="lifecycle",
+              attributes={"phase": "Queuing"})
+    fresh["status"] = {"conditions": [{
+        "type": c.JOB_QUEUING, "status": "True",
+        "lastTransitionTime": m.rfc3339(api.now() + 60.0)}]}
+    api.update_status(fresh)
+    clock.advance(90.0)   # live stint = 30s on top of the closed 10s
+    proxy = DataProxy(api, None, None, tracer=tr)
+    assert proxy.job_queue_wait(
+        api.get("PyTorchJob", "default", "rq")) == pytest.approx(40.0)
