@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test test-all test-fast test-chaos test-scheduler test-trace bench bench-controlplane bench-scheduler bench-serving-paged bench-trace dryrun crds run-standalone lint native
+.PHONY: test test-all test-fast test-chaos test-scheduler test-trace test-replay bench bench-controlplane bench-scheduler bench-serving-paged bench-trace bench-cluster dryrun crds run-standalone lint native
 
 # fast path (<3 min): everything except the compile-heavy compute suites
 # (those carry `pytestmark = pytest.mark.slow`). Chaos tests are fast and
@@ -61,6 +61,21 @@ test-trace:
 # `perf`-marker op-budget test in tests/test_trace.py
 bench-trace:
 	JAX_PLATFORMS=cpu $(PY) bench_trace.py
+
+# cluster-scale trace-replay suite (workload generator, smoke replay
+# through the real stack, scorecard gates; docs/benchmarks.md)
+test-replay:
+	$(PY) -m pytest tests/ -q -m replay
+
+# THE fleet scorecard: a production-shaped day (thousands of jobs, tens
+# of thousands of serving requests, chaos faults) through the real
+# control plane + scheduler + serving engine on a sim clock ->
+# BENCH_CLUSTER.json (docs/benchmarks.md). Bit-for-bit reproducible for
+# a fixed seed; FAILS on absolute-gate misses AND on regression vs the
+# committed scorecard. The tier-1 guard is the `perf`-marked smoke
+# replay in tests/test_replay.py.
+bench-cluster:
+	JAX_PLATFORMS=cpu $(PY) bench_cluster.py --profile day
 
 # multi-chip sharding compile+execute proof on a virtual mesh
 dryrun:

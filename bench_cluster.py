@@ -1,0 +1,106 @@
+"""Cluster-scale trace-replay bench: one fleet scorecard, one JSON.
+
+Replays a production-shaped day (``--profile day``: thousands of jobs
+with bursty arrivals and chaos faults, tens of thousands of serving
+requests with Zipf-shared prefixes) through the REAL control plane +
+slice scheduler + paged-KV serving engine on a simulated clock, with
+tracing enabled, and emits ``BENCH_CLUSTER.json`` — settle throughput,
+queue-delay p50/p99, slice utilization, TTFT p99, restart MTTR,
+preemption/backfill counts — derived entirely from the system's own
+traces and metrics (docs/benchmarks.md has the schema).
+
+The scorecard is bit-for-bit reproducible for a fixed ``--seed``: no
+wall clocks enter the document (the run's wall time goes to stderr).
+When a committed scorecard exists at ``--out``, the fresh run is also
+checked against it and the bench FAILS on regression — one number every
+future PR must move, never backslide.
+
+Usage::
+
+    python bench_cluster.py [--profile smoke|day] [--seed 0]
+                            [--out BENCH_CLUSTER.json] [--no-check]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def main() -> dict:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--profile", choices=("smoke", "day"), default="day")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default="BENCH_CLUSTER.json")
+    ap.add_argument("--no-check", action="store_true",
+                    help="skip the regression check against the "
+                         "committed scorecard at --out")
+    ap.add_argument("--skip-serving", action="store_true",
+                    help="job day only (debugging aid; gates involving "
+                         "serving will fail)")
+    args = ap.parse_args()
+
+    from kubedl_tpu.replay import (ClusterReplay, ServingReplay,
+                                   build_scorecard, check_regression,
+                                   evaluate_gates, generate)
+
+    workload = generate(args.profile, args.seed)
+    print(f"workload: {len(workload.jobs)} jobs, "
+          f"{len(workload.serving)} serving requests, "
+          f"fingerprint {workload.fingerprint()[:16]}", file=sys.stderr)
+
+    t0 = time.perf_counter()
+    cluster = ClusterReplay(workload).run()
+    t1 = time.perf_counter()
+    print(f"job day replayed in {t1 - t0:.1f}s wall "
+          f"({cluster['rounds']} rounds, "
+          f"{cluster['controlplane']['reconciles']} reconciles)",
+          file=sys.stderr)
+    if args.skip_serving:
+        serving = {"requests_submitted": 0, "requests_completed": 0,
+                   "requests_unfinished": 0, "errors": 0,
+                   "resumed_admissions": 0, "shared_prefix_admissions": 0,
+                   "tokens_generated": 0, "engine_ticks": 0,
+                   "sim_span_s": 0.0, "queue_waits_s": [], "ttfts_s": [],
+                   "kv": {}}
+    else:
+        serving = ServingReplay(workload).run()
+        print(f"serving day replayed in {time.perf_counter() - t1:.1f}s "
+              f"wall ({serving['engine_ticks']} ticks, "
+              f"{serving['tokens_generated']} tokens)", file=sys.stderr)
+
+    scorecard = build_scorecard(workload, cluster, serving)
+    scorecard["gates"] = evaluate_gates(scorecard)
+
+    problems = []
+    if not args.no_check and args.out and os.path.exists(args.out):
+        try:
+            with open(args.out) as f:
+                committed = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"warning: cannot read committed {args.out}: {e}",
+                  file=sys.stderr)
+            committed = {}
+        problems = check_regression(scorecard, committed)
+
+    print(json.dumps(scorecard))
+    if not scorecard["gates"]["passed"]:
+        failed = [c for c in scorecard["gates"]["checks"]
+                  if not c["passed"]]
+        raise SystemExit(f"GATE FAILED: {failed}")
+    if problems:
+        # keep the committed baseline intact on regression
+        raise SystemExit("REGRESSION vs committed scorecard:\n  "
+                         + "\n  ".join(problems))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(scorecard, f, indent=2, sort_keys=True)
+            f.write("\n")
+    return scorecard
+
+
+if __name__ == "__main__":
+    main()

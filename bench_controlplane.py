@@ -33,6 +33,7 @@ from kubedl_tpu.controllers.registry import OperatorConfig, build_operator
 from kubedl_tpu.core import meta as m
 from kubedl_tpu.core.apiserver import APIServer
 from kubedl_tpu.utils import status as st
+from kubedl_tpu.utils.stats import percentile
 
 CONTAINER = "pytorch"
 
@@ -90,18 +91,15 @@ def run_once(jobs: int, replicas: int, mode: str) -> dict:
         raise RuntimeError(f"{jobs}x{replicas} did not settle in mode={mode}")
     elapsed = time.perf_counter() - t0
 
-    lat = sorted(op.manager.latency_samples)
-
-    def pct(q: float) -> float:
-        return lat[min(int(len(lat) * q), len(lat) - 1)] if lat else 0.0
+    lat = op.manager.latency_samples
 
     return {
         "mode": mode,
         "settle_seconds": round(elapsed, 3),
         "jobs_per_sec_settled": round(jobs / elapsed, 2),
         "reconciles": op.manager.reconcile_count,
-        "reconcile_p50_ms": round(pct(0.50) * 1e3, 3),
-        "reconcile_p99_ms": round(pct(0.99) * 1e3, 3),
+        "reconcile_p50_ms": round(percentile(lat, 0.50, default=0.0) * 1e3, 3),
+        "reconcile_p99_ms": round(percentile(lat, 0.99, default=0.0) * 1e3, 3),
         "max_queue_depth": op.manager.max_queue_depth,
         "world_objects": len(api),
     }

@@ -38,11 +38,13 @@ from kubedl_tpu.api import common as c
 from kubedl_tpu.api.queue import new_queue
 from kubedl_tpu.core import meta as m
 from kubedl_tpu.core.apiserver import APIServer
+from kubedl_tpu.core.clock import SimClock
 from kubedl_tpu.core.manager import Manager
 from kubedl_tpu.metrics.registry import SchedulerMetrics
 from kubedl_tpu.scheduling.gang import is_gang_admitted
 from kubedl_tpu.scheduling.inventory import SliceInventory
 from kubedl_tpu.scheduling.scheduler import SliceScheduler
+from kubedl_tpu.utils.stats import percentile
 
 POOL_A = "tpu-v5p-slice/2x2x4"        # 3D torus training pool
 POOL_B = "tpu-v5-lite-podslice/4x4"   # 2D inference/finetune pool
@@ -86,16 +88,13 @@ def _stats(records: dict, capacity: dict, arrivals: dict) -> dict:
     makespan = end - t0
     busy = sum(r[2] * r[3] for r in records.values())
     total = sum(capacity.values())
-    delays = sorted(r[0] - arrivals[j] for j, r in records.items())
-
-    def pct(q: float) -> float:
-        return delays[min(int(len(delays) * q), len(delays) - 1)]
+    delays = [r[0] - arrivals[j] for j, r in records.items()]
 
     return {
         "makespan_s": round(makespan, 1),
         "slice_utilization": round(busy / (total * makespan), 4),
-        "queue_delay_p50_s": round(pct(0.50), 1),
-        "queue_delay_p99_s": round(pct(0.99), 1),
+        "queue_delay_p50_s": round(percentile(delays, 0.50), 1),
+        "queue_delay_p99_s": round(percentile(delays, 0.99), 1),
         "jobs": len(records),
     }
 
@@ -141,17 +140,6 @@ def run_fcfs(trace: list) -> dict:
 # ---------------------------------------------------------------------------
 # the real scheduler over the in-memory control plane
 # ---------------------------------------------------------------------------
-
-
-class SimClock:
-    def __init__(self, t0: float = 1_700_000_000.0):
-        self.t0 = self.t = t0
-
-    def __call__(self) -> float:
-        return self.t
-
-    def advance_to(self, sim_t: float) -> None:
-        self.t = max(self.t, self.t0 + sim_t)
 
 
 def make_pgs(api, job, queue, pool, slices, priority=0):

@@ -89,6 +89,12 @@ class EngineConfig:
     #: how long an unobserved create/delete expectation blocks reconciles
     #: before it is declared lost (dropped watch event) and cleared
     expectation_timeout: float = Expectations.TIMEOUT
+    #: admission-gate dropped-event net: a Queuing job re-reconciles this
+    #: often even if no PodGroup admission event arrives. The event path
+    #: (PodGroup watch) does the real work — at fleet scale (hundreds of
+    #: queued jobs) a tight poll is a thundering herd, so the cluster
+    #: replay widens it; 5s keeps the historical single-job snappiness
+    gate_requeue_s: float = 5.0
 
 
 @dataclass
@@ -153,6 +159,10 @@ class JobEngine(Reconciler):
         #: deletion expectation (finalizer-held pods emit several MODIFIED
         #: events while deleting; only the transition counts)
         self._deletion_seen: set = set()
+        #: job uid -> outage start (first restart-round stamp of the
+        #: current outage); popped into the restart-MTTR histogram on the
+        #: first all-active reconcile after it
+        self._mttr_start: dict[str, float] = {}
         api.watch(self._observe)
 
     def _retry(self, fn):
@@ -180,6 +190,7 @@ class JobEngine(Reconciler):
                 self.lifecycle.forget(uid)
                 self._tb_jobs.discard(uid)
                 self._tb_reap_checked.discard(uid)
+                self._mttr_start.pop(uid, None)
                 self.expectations.delete_prefix(m.key(obj))
             else:
                 s = JobStatus.from_dict(obj.get("status"))
@@ -411,7 +422,8 @@ class JobEngine(Reconciler):
                 # admission flips re-trigger via the PodGroup watch; the
                 # timed requeue is the safety net for a dropped event (a
                 # failed flush polls faster)
-                return Result(requeue_after=5.0 if flushed else 1.0)
+                return Result(requeue_after=self.config.gate_requeue_s
+                              if flushed else 1.0)
             for cond in status.conditions:
                 # admitted: the queue wait is over even though pods are
                 # only now being created (Running flips it too, but the
@@ -515,6 +527,15 @@ class JobEngine(Reconciler):
                     job, TYPE_NORMAL, st.REASON_RENDEZVOUS_READY,
                     f"all {total} gang pod(s) of {self.kind} {req.name} "
                     f"are running; rendezvous can complete")
+        # restart-MTTR: first disruption of the outage (marked when
+        # _slice_failover stamps a restart round) -> every replica active
+        # again. Consecutive restart rounds extend one outage window.
+        uid = m.uid(job)
+        if (total and uid in self._mttr_start
+                and sum(rs.active
+                        for rs in status.replica_statuses.values()) == total):
+            self.metrics.restart_mttr.observe(
+                self.api.now() - self._mttr_start.pop(uid), kind=self.kind)
 
         self._trace_phase(job, status, pods, replicas)
         flushed = self._flush_status(job, status, old_status)
@@ -1343,6 +1364,10 @@ class JobEngine(Reconciler):
         status.restart_count += 1
         status.restart_rounds = rounds + 1
         status.last_restart_time = m.rfc3339(now)
+        # outage-start mark for the restart-MTTR histogram: only the
+        # FIRST round of an outage sets it (round 2 of the same outage
+        # must not shrink the measured window)
+        self._mttr_start.setdefault(m.uid(job), now)
         msg = (f"slice(s) {sorted(disrupted)} of {self.kind} {m.name(job)} "
                f"disrupted; restarting all {deleted} slice pod(s) together "
                f"(restart #{status.restart_count})")

@@ -1,1 +1,3 @@
 """Kubernetes substrate: object model, in-memory API server, client, manager."""
+
+from .clock import SimClock  # noqa: F401  (the shared injectable clock)

@@ -123,8 +123,15 @@ class APIServer:
     """Thread-safe in-memory object store with watch fan-out."""
 
     def __init__(self, clock: Callable[[], float] = time.time,
-                 admission=None, list_mode: Optional[str] = None):
+                 admission=None, list_mode: Optional[str] = None,
+                 uid_factory: Optional[Callable[[], str]] = None):
         self._clock = clock
+        #: uid source for created objects. Defaults to random uuid4; the
+        #: replay rig injects a counter-derived factory because uids feed
+        #: deterministic derivations downstream (trace ids, per-job
+        #: restart-backoff jitter keys) and the scorecard must be
+        #: bit-for-bit reproducible for a fixed seed
+        self._new_uid = uid_factory or m.new_uid
         #: canonical committed objects — server-private, never handed out
         self._objs: dict[tuple[str, str, str], Obj] = {}
         #: shared read snapshots, one per object, replaced on every commit;
@@ -275,7 +282,10 @@ class APIServer:
         md = m.meta(obj)
         if not md.get("name"):
             if md.get("generateName"):
-                md["name"] = md["generateName"] + m.new_uid()[:8]
+                # the uid's TAIL: unique under both uuid4 (random hex)
+                # and counter-based factories ("replay-0-00000042",
+                # whose first 8 chars are a constant prefix)
+                md["name"] = md["generateName"] + self._new_uid()[-8:]
             else:
                 raise Invalid("object has no metadata.name")
         md.setdefault("namespace", "default")
@@ -286,7 +296,7 @@ class APIServer:
         with self._lock:
             if k in self._objs:
                 raise AlreadyExists(f"{m.kind(obj)} {md['namespace']}/{md['name']} already exists")
-            md["uid"] = m.new_uid()
+            md["uid"] = self._new_uid()
             md["resourceVersion"] = self._next_rv()
             md["generation"] = 1
             md["creationTimestamp"] = _ts(self.now())

@@ -275,6 +275,18 @@ class Manager:
         with self._lock:
             return len(self._queue)
 
+    def next_deadline(self) -> Optional[float]:
+        """Earliest ``ready_at`` (absolute clock time) among live queued
+        requests, or None when the queue is empty. Event-driven drivers
+        (the cluster replay harness) advance their sim clock to
+        ``min(next external event, next_deadline())`` so delayed requeues
+        — admission-gate nets, restart backoffs, TTL reaps — fire instead
+        of being starved between external events. ``_queued`` holds each
+        request's single live deadline (heap entries it superseded are
+        skipped on pop), so its min is exact. Read-only."""
+        with self._lock:
+            return min(self._queued.values()) if self._queued else None
+
     def run(self, workers: int = 1):
         """Background processing loop (standalone mode). Workers sleep on
         the condition variable until the next heap deadline; ``enqueue``

@@ -307,6 +307,21 @@ class TraceMetrics:
             "Spans currently held in the ring buffer")
 
 
+#: job launch delays at fleet scale INCLUDE queue wait (the admission
+#: gate holds pod creation until the scheduler admits the gang), so the
+#: distribution runs from sub-second test admissions to hours of quota
+#: starvation. The generic ``_DEFAULT_BUCKETS`` top out at 600s — under
+#: the measured fleet-shape queue delays (BENCH_SCHEDULER.json p50
+#: 295-595s) that clamps most of the mass into +Inf.
+_JOB_DELAY_BUCKETS = (0.5, 1, 2.5, 5, 10, 30, 60, 120, 300, 600,
+                      1200, 1800, 3600, 7200, 14400, 43200)
+
+#: restart MTTR runs from seconds (in-place slice recreation) through
+#: backoff rounds and a re-queue stint to, pathologically, hours
+_MTTR_BUCKETS = (1, 2.5, 5, 10, 20, 40, 60, 120, 300, 600,
+                 1200, 1800, 3600, 7200)
+
+
 class JobMetrics:
     """The reference's per-kind job metric set (``pkg/metrics/job_metrics.go``)."""
 
@@ -323,13 +338,22 @@ class JobMetrics:
         self.first_pod_launch_delay = r.histogram(
             "kubedl_jobs_first_pod_launch_delay_seconds",
             "Histogram for recording launch delay duration (from job created to first pod running)",
-            ("kind",))
+            ("kind",), buckets=_JOB_DELAY_BUCKETS)
         self.all_pods_launch_delay = r.histogram(
             "kubedl_jobs_all_pods_launch_delay_seconds",
             "Histogram for recording launch delay duration (from job created to all pods running)",
-            ("kind",))
+            ("kind",), buckets=_JOB_DELAY_BUCKETS)
         # TPU-native: the operator half of gang-schedule-to-first-step
         self.gang_to_all_running = r.histogram(
             "kubedl_jobs_gang_schedule_to_all_running_seconds",
             "Histogram from gang (PodGroup) creation to all slice workers running",
-            ("kind",))
+            ("kind",), buckets=_JOB_DELAY_BUCKETS)
+        # TPU-native: slice disruption -> every replica active again (the
+        # whole outage window: teardown + backoff + re-queue + recreate +
+        # rendezvous). The engine marks the outage start when it stamps a
+        # restart round and observes here on the first all-active
+        # reconcile after it.
+        self.restart_mttr = r.histogram(
+            "kubedl_jobs_restart_mttr_seconds",
+            "Histogram from slice disruption to all replicas active again",
+            ("kind",), buckets=_MTTR_BUCKETS)

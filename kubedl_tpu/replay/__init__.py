@@ -1,0 +1,20 @@
+"""Cluster-scale trace-replay harness (docs/benchmarks.md).
+
+The per-subsystem benches answer "is this layer fast"; this package
+answers "does the fleet hold up for a production-shaped day". It replays
+a seeded, bit-for-bit reproducible workload — thousands of jobs across
+queues and pools with bursty arrivals and chaos faults, tens of
+thousands of serving requests with Zipf-shared prefixes — through the
+**real** control plane (``core/apiserver.py`` + ``core/manager.py``),
+slice scheduler (``scheduling/scheduler.py``), and paged-KV serving
+engine (``serving/batching.py``) on the shared :class:`SimClock`, with
+tracing enabled. The scorecard (``BENCH_CLUSTER.json``) is derived from
+the system's own observability — lifecycle traces, request spans, and
+the metric registries — never from bench-local bookkeeping.
+"""
+
+from .workload import PROFILES, Profile, Workload, generate  # noqa: F401
+from .harness import ClusterReplay  # noqa: F401
+from .serving import ServingReplay  # noqa: F401
+from .scorecard import (build_scorecard, check_regression,  # noqa: F401
+                        evaluate_gates)

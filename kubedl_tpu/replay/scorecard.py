@@ -1,0 +1,156 @@
+"""The fleet scorecard: one JSON every future PR must move.
+
+``build_scorecard`` folds the two replay legs' raw observations into the
+``BENCH_CLUSTER.json`` document: deterministic (no wall clocks, floats
+rounded, keys sorted at serialization) so a fixed ``(profile, seed)``
+reproduces it bit-for-bit. ``evaluate_gates`` applies the absolute
+acceptance gates; ``check_regression`` compares a fresh scorecard
+against the committed artifact so ``make bench-cluster`` fails when a PR
+regresses the fleet numbers it is supposed to move.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..utils.stats import summarize
+from .workload import Workload
+
+#: absolute gates per profile: (path into the scorecard, op, threshold).
+#: Thresholds carry headroom over the seeded baseline — they catch
+#: collapses, while drift is caught by check_regression against the
+#: committed artifact.
+_GATES = {
+    "smoke": (
+        ("jobs.completed_fraction", ">=", 1.0),
+        ("jobs.trace.orphan_violations", "<=", 0),
+        ("jobs.slice_utilization", ">=", 0.10),
+        ("jobs.controlplane.reconciles_per_job", "<=", 120.0),
+        ("serving.completed_fraction", ">=", 1.0),
+        ("serving.errors", "<=", 0),
+    ),
+    "day": (
+        ("jobs.completed_fraction", ">=", 1.0),
+        ("jobs.trace.orphan_violations", "<=", 0),
+        ("jobs.slice_utilization", ">=", 0.30),
+        ("jobs.queue_delay_s.p99", "<=", 28800.0),
+        ("jobs.controlplane.reconciles_per_job", "<=", 120.0),
+        ("jobs.chaos_preemptions_executed", ">=", 1),
+        ("serving.completed_fraction", ">=", 1.0),
+        ("serving.errors", "<=", 0),
+        ("serving.ttft_s.p99", "<=", 600.0),
+    ),
+}
+
+#: regression tolerances vs the committed artifact:
+#: (path, direction, relative slack, absolute grace)
+_REGRESSION = (
+    ("jobs.slice_utilization", "higher_better", 0.05, 0.01),
+    ("jobs.queue_delay_s.p99", "lower_better", 0.12, 10.0),
+    ("jobs.restart_mttr_s.p99", "lower_better", 0.20, 10.0),
+    ("jobs.controlplane.reconciles_per_job", "lower_better", 0.15, 1.0),
+    ("jobs.scheduler.passes", "lower_better", 0.20, 50.0),
+    ("serving.ttft_s.p99", "lower_better", 0.12, 0.5),
+    ("serving.queue_s.p99", "lower_better", 0.12, 0.5),
+)
+
+
+def _get(doc: dict, path: str):
+    cur = doc
+    for part in path.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur
+
+
+def build_scorecard(workload: Workload, cluster: dict,
+                    serving: dict) -> dict:
+    profile = workload.profile
+    jobs = dict(cluster)
+    q_delays = jobs.pop("queue_delays_s")
+    mttrs = jobs.pop("restart_mttrs_s")
+    jobs["completed_fraction"] = round(
+        jobs["jobs_completed"] / max(jobs["jobs_submitted"], 1), 4)
+    jobs["queue_delay_s"] = summarize(q_delays, percentiles=(0.5, 0.9, 0.99),
+                                      ndigits=1)
+    jobs["restart_mttr_s"] = summarize(mttrs, percentiles=(0.5, 0.99),
+                                       ndigits=1)
+    jobs["jobs_per_sim_hour"] = round(
+        jobs["jobs_completed"] / (jobs["makespan_s"] / 3600.0), 2)
+
+    srv = dict(serving)
+    q_waits = srv.pop("queue_waits_s")
+    ttfts = srv.pop("ttfts_s")
+    srv["completed_fraction"] = round(
+        srv["requests_completed"] / max(srv["requests_submitted"], 1), 4)
+    srv["queue_s"] = summarize(q_waits, percentiles=(0.5, 0.9, 0.99),
+                               ndigits=3)
+    srv["ttft_s"] = summarize(ttfts, percentiles=(0.5, 0.9, 0.99),
+                              ndigits=3)
+
+    return {
+        "benchmark": "cluster_trace_replay",
+        "profile": profile.name,
+        "seed": workload.seed,
+        "workload_fingerprint": workload.fingerprint(),
+        "workload": {
+            "sim_day_s": profile.sim_seconds,
+            "jobs": len(workload.jobs),
+            "chaos_preemptions_planned": len(workload.preemptions),
+            "serving_requests": len(workload.serving),
+            "capacity_slices": dict(profile.capacity),
+            "queues": sorted({j.queue for j in workload.jobs}),
+        },
+        "jobs": jobs,
+        "serving": srv,
+    }
+
+
+def evaluate_gates(scorecard: dict,
+                   profile_name: Optional[str] = None) -> dict:
+    """Apply the profile's absolute gates; returns the gate table with
+    an overall ``passed``. The table is embedded into the scorecard (it
+    is deterministic too)."""
+    name = profile_name or scorecard.get("profile", "day")
+    results = []
+    ok = True
+    for path, op, threshold in _GATES.get(name, ()):
+        value = _get(scorecard, path)
+        passed = (value is not None
+                  and (value >= threshold if op == ">=" else
+                       value <= threshold))
+        ok = ok and passed
+        results.append({"metric": path, "op": op, "threshold": threshold,
+                        "value": value, "passed": passed})
+    return {"checks": results, "passed": ok}
+
+
+def check_regression(new: dict, old: dict) -> list:
+    """Compare a fresh scorecard against the committed artifact.
+    Returns a list of human-readable regression strings (empty = pass).
+    Only applies when profile and seed match — a re-scaled run is a new
+    baseline, not a regression."""
+    if old.get("profile") != new.get("profile") \
+            or old.get("seed") != new.get("seed"):
+        return []
+    problems = []
+    for path, direction, rel, grace in _REGRESSION:
+        ov, nv = _get(old, path), _get(new, path)
+        if ov is None or nv is None:
+            continue
+        if direction == "higher_better":
+            floor = ov * (1.0 - rel) - grace
+            if nv < floor:
+                problems.append(
+                    f"{path}: {nv} < {round(floor, 4)} "
+                    f"(committed {ov}, tolerance -{rel * 100:g}%)")
+        else:
+            ceil = ov * (1.0 + rel) + grace
+            if nv > ceil:
+                problems.append(
+                    f"{path}: {nv} > {round(ceil, 4)} "
+                    f"(committed {ov}, tolerance +{rel * 100:g}%)")
+    if _get(new, "jobs.trace.orphan_violations"):
+        problems.append("jobs.trace.orphan_violations must stay 0")
+    return problems

@@ -129,6 +129,19 @@ class SliceScheduler(Reconciler):
         self._gauge_queues: set = set()
         #: scheduling passes run (the tier-1 perf budget counts these)
         self.passes = 0
+        #: dropped-event safety poll while gangs wait: interval, plus the
+        #: single request key currently carrying it (see reconcile)
+        self.poll_interval = 5.0
+        self._poll_key: Optional[tuple] = None
+        self._poll_due = 0.0
+        #: preemption debt: ``(pool, queue) -> slices`` reclaimed FOR an
+        #: under-min queue whose head has not consumed them yet. Other
+        #: queues' admissions (including backfill) must not touch debted
+        #: capacity — without this earmark a higher-priority queue's
+        #: backfill re-takes the freed slice every pass and the reclaim
+        #: loop live-locks (admit/preempt ping-pong; found by the
+        #: cluster replay harness at fleet shape)
+        self._reclaim_debt: dict[tuple, int] = {}
         api.watch(self._observe)
         self.resync()  # seed from pre-existing objects (operator restart)
 
@@ -228,11 +241,23 @@ class SliceScheduler(Reconciler):
     def reconcile(self, req: Request) -> Optional[Result]:
         self.schedule_pass()
         with self._lock:
-            if self._pending:
-                # self-sustaining slow poll while work is waiting: the
-                # safety net for a dropped watch event on the PodGroup
-                # that would otherwise have triggered the next pass
-                return Result(requeue_after=5.0)
+            if not self._pending:
+                self._poll_key = None
+                return None
+            # self-sustaining slow poll while work is waiting: the safety
+            # net for a dropped watch event on the PodGroup that would
+            # otherwise have triggered the next pass. Armed on AT MOST
+            # one request key — the manager requeues per key, so handing
+            # every queued PodGroup its own 5s poll multiplies into a
+            # full-pass thundering herd at fleet scale (the cluster
+            # replay measured ~850 passes/job before this coalesce)
+            now = self.api.now()
+            key = (req.namespace, req.name)
+            if (self._poll_key is None or self._poll_key == key
+                    or now >= self._poll_due - 1e-6):
+                self._poll_key = key
+                self._poll_due = now + self.poll_interval
+                return Result(requeue_after=self.poll_interval)
         return None
 
     def schedule_pass(self) -> None:
@@ -271,6 +296,15 @@ class SliceScheduler(Reconciler):
             for h in held:
                 queues.setdefault(h.queue, QueueSpec(name=h.queue))
 
+            # drop stale preemption debts: the claiming queue no longer
+            # has ANY pending gang wanting that pool (head admitted
+            # elsewhere, deleted, or re-shaped) — the earmark would
+            # otherwise strand capacity forever
+            for pool, qname in list(self._reclaim_debt):
+                if not any(g.queue == qname and g.pool == pool
+                           for g in self._pending.values()):
+                    del self._reclaim_debt[(pool, qname)]
+
             reserved: dict[str, int] = {}
             pending_n = sum(len(v) for v in by_queue.values())
             for qname in sorted(queues, key=lambda n: (-queues[n].priority, n)):
@@ -298,8 +332,11 @@ class SliceScheduler(Reconciler):
                 self._warn_infeasible(gs, cap)
                 continue  # can never fit: do not let it block the queue
             free = self.inventory.free_slices(gs.pool) if demand else None
+            # debted capacity (reclaimed for ANOTHER under-min queue)
+            # is off limits; this queue's own debt stays available
             avail = None if free is None \
-                else max(free - reserved.get(gs.pool, 0), 0)
+                else max(free - reserved.get(gs.pool, 0)
+                         - self._debt_other(gs.pool, q.name), 0)
             if avail is None or avail >= demand:
                 landed = self._admit(gs, backfill=head_blocked)
                 if gs.pool:
@@ -350,6 +387,15 @@ class SliceScheduler(Reconciler):
                                 f"{' (backfill)' if backfill else ''}")
         if not gs.pgs:
             self._pending.pop((gs.namespace, gs.job), None)
+        if landed:
+            # the queue consumed (part of) the capacity reclaimed for it
+            dk = (gs.pool, gs.queue)
+            owed = self._reclaim_debt.get(dk, 0)
+            if owed:
+                if owed > landed:
+                    self._reclaim_debt[dk] = owed - landed
+                else:
+                    del self._reclaim_debt[dk]
         if all_landed:
             self.metrics.admitted.inc(queue=gs.queue)
             if backfill:
@@ -366,6 +412,12 @@ class SliceScheduler(Reconciler):
                                 "job": f"{gs.namespace}/{gs.job}",
                                 "slices": landed})
         return landed
+
+    def _debt_other(self, pool: str, queue: str) -> int:
+        """Slices of ``pool`` earmarked by reclaims for queues other than
+        ``queue`` (the caller's own debt is its to spend)."""
+        return sum(n for (p, q), n in self._reclaim_debt.items()
+                   if p == pool and q != queue)
 
     def _job_ctx(self, pg: Optional[dict], ns: str, job: str) -> tuple:
         """(trace_id, root_span_id) of the job owning a PodGroup: the
@@ -453,6 +505,12 @@ class SliceScheduler(Reconciler):
             self._preempt_gang(ns, job, slices, for_queue=q.name)
             held_by_queue[vq_name] = held_by_queue.get(vq_name, 0) \
                 - len(slices)
+            # earmark the capacity being freed for the claiming queue:
+            # without the debt, another queue's backfill re-takes it the
+            # moment teardown lands and the reclaim never converges
+            dk = (gs.pool, q.name)
+            self._reclaim_debt[dk] = self._reclaim_debt.get(dk, 0) \
+                + len(slices)
             needed -= len(slices)
         if needed > 0:
             log.info("queue %s under min still short %d slice(s) of %s "

@@ -783,6 +783,30 @@ class ContinuousBatchingEngine:
                     raise
         return [r.result() for r in reqs]
 
+    def step(self) -> bool:
+        """Run ONE inline scheduler tick: admit queue heads onto free
+        lanes, then one decode round across all lanes. Returns True while
+        there is work left (active lanes or queued requests).
+
+        This is the replay harness's seam: an external event-driven
+        driver submits arrivals, calls ``step()`` per simulated tick, and
+        advances its sim clock between calls — so every request span the
+        tracer records (queue wait, TTFT) is measured in deterministic
+        simulated time instead of wall time. Mutually exclusive with the
+        background loop (:meth:`start`). Same abort-recovery contract as
+        inline :meth:`run`: a failed step restores cache/pool invariants
+        and cancels in-flight requests before re-raising."""
+        if self._thread is not None:
+            raise RuntimeError(
+                "step() is an inline driver; stop() the background loop "
+                "first")
+        with self._sched_lock:
+            try:
+                return self._step_once()
+            except BaseException:
+                self._recover_locked()
+                raise
+
     def _recover_locked(self) -> None:
         """Reinitialize the donated cache + lane state after a failed
         inline step. Caller holds ``_sched_lock`` (``_cancel_all`` cannot

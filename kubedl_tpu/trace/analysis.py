@@ -36,7 +36,8 @@ def find_orphans(spans: Iterable[Span]) -> list:
 
 
 def trace_breakdown(spans: Iterable[Span],
-                    trace_id: Optional[str] = None) -> dict:
+                    trace_id: Optional[str] = None,
+                    dropped: int = 0) -> dict:
     """Per-phase critical path for one trace.
 
     Returns the chronologically ordered phase spans (``phases``), the
@@ -44,7 +45,14 @@ def trace_breakdown(spans: Iterable[Span],
     repeat phases, so e.g. two Queuing stints sum), the root span when
     recorded, non-lifecycle child spans (``events``: scheduler
     queue-wait, preemptions, reconciles attached to the trace), and the
-    orphan list (must be empty for a healthy trace)."""
+    orphan list (must be empty for a healthy trace).
+
+    ``dropped`` is the recorder's overflow counter
+    (:attr:`~kubedl_tpu.trace.Tracer.dropped`): when a long replay wraps
+    the bounded ring buffer, parents of surviving spans may have been
+    evicted — the breakdown stays well-formed, and a non-zero
+    ``droppedSpans`` field tells the reader the listed orphans are
+    attributable to eviction rather than an instrumentation bug."""
     spans = [s for s in spans
              if trace_id is None or s.trace_id == trace_id]
     if trace_id is None and spans:
@@ -72,6 +80,7 @@ def trace_breakdown(spans: Iterable[Span],
         "totalSeconds": round(total, 9),
         "spanCount": len(spans),
         "orphans": [s.to_dict() for s in find_orphans(spans)],
+        "droppedSpans": int(dropped),
     }
 
 

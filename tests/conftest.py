@@ -21,18 +21,13 @@ pin_platform("cpu")
 import pytest  # noqa: E402
 
 from kubedl_tpu.core.apiserver import APIServer  # noqa: E402
+from kubedl_tpu.core.clock import SimClock  # noqa: E402
 from kubedl_tpu.core.manager import Manager  # noqa: E402
 
 
-class FakeClock:
-    def __init__(self, t0: float = 1_700_000_000.0):
-        self.t = t0
-
-    def __call__(self) -> float:
-        return self.t
-
-    def advance(self, dt: float):
-        self.t += dt
+# the shared injectable simulation clock (kubedl_tpu/core/clock.py) —
+# tests, benches, and the replay rig all drive the same implementation
+FakeClock = SimClock
 
 
 @pytest.fixture

@@ -99,7 +99,8 @@ def tpu_gang_job(api, manager, workers=4):
 # ---------------------------------------------------------------------------
 
 
-def test_preempt_one_worker_recreates_whole_slice(api, manager, engine):
+def test_preempt_one_worker_recreates_whole_slice(api, manager, engine,
+                                                  clock):
     """Acceptance: preempting 1 of 4 gang-scheduled TPU workers recreates
     all 4 pods together (same job generation, gang re-admitted), the job
     returns to Running, and restart_count/backoff state advance."""
@@ -131,10 +132,24 @@ def test_preempt_one_worker_recreates_whole_slice(api, manager, engine):
     assert status.last_restart_time
     assert any(e["reason"] == "SliceRestart" for e in api.list("Event"))
     assert engine.metrics.restarted.value(kind="TestJob") == 1
+    # mid-outage: the MTTR mark is set but nothing observed yet
+    assert engine.metrics.restart_mttr.count(kind="TestJob") == 0
 
+    clock.advance(42.0)           # recreation + rendezvous wall time
     run_all_pods(api)
     reconcile(manager)
     assert st.is_running(job_status(api))
+    # restart-MTTR observed exactly once: disruption -> all active again
+    assert engine.metrics.restart_mttr.count(kind="TestJob") == 1
+    assert engine.metrics.restart_mttr.sum(kind="TestJob") >= 42.0
+
+    # a second recovery round observes a second sample (the mark clears)
+    api.preempt("default", "tj-worker-1")
+    reconcile(manager)
+    clock.advance(10.0)
+    run_all_pods(api)
+    reconcile(manager)
+    assert engine.metrics.restart_mttr.count(kind="TestJob") == 2
 
 
 def test_disruption_condition_without_deletion_also_restarts(api, manager, engine):

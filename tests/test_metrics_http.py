@@ -95,6 +95,49 @@ def test_histogram_unlabeled_wraps_le_alone(served):
         "kubedl_plain_seconds_count 1"]
 
 
+def test_fleet_scale_bucket_boundaries_in_exposition(served):
+    """Pin the metric-appropriate bucket sets in the exposition format:
+    queue-wait, job launch delays, and restart-MTTR must resolve
+    fleet-scale values (BENCH_SCHEDULER.json queue delays are already
+    p50 295-595s) instead of clamping into +Inf at the generic 600s
+    ceiling."""
+    from kubedl_tpu.metrics.registry import JobMetrics, SchedulerMetrics
+    reg, port = served
+    jm = JobMetrics(reg)
+    sm = SchedulerMetrics(reg)
+    # a fleet-shape observation: a 40-minute queue-gated launch
+    jm.all_pods_launch_delay.observe(2400.0, kind="TestJob")
+    jm.restart_mttr.observe(95.0, kind="TestJob")
+    sm.queue_wait.observe(2400.0, queue="batch")
+    _, body, _ = scrape(port)
+
+    def les(prefix, label):
+        pre = f"{prefix}_bucket{{{label},le=\""
+        return [ln.split('le="')[1].split('"')[0]
+                for ln in _lines(body, prefix + "_bucket")
+                if ln.startswith(pre)]
+
+    delay_les = les("kubedl_jobs_all_pods_launch_delay_seconds",
+                    'kind="TestJob"')
+    assert delay_les == ["0.5", "1", "2.5", "5", "10", "30", "60", "120",
+                         "300", "600", "1200", "1800", "3600", "7200",
+                         "14400", "43200", "+Inf"]
+    mttr_les = les("kubedl_jobs_restart_mttr_seconds", 'kind="TestJob"')
+    assert mttr_les == ["1", "2.5", "5", "10", "20", "40", "60", "120",
+                        "300", "600", "1200", "1800", "3600", "7200",
+                        "+Inf"]
+    qw_les = les("kubedl_scheduler_queue_wait_seconds", 'queue="batch"')
+    assert qw_les == ["0.1", "0.5", "1", "5", "15", "60", "300", "900",
+                      "1800", "3600", "7200", "14400", "43200", "+Inf"]
+    # the 2400s observations land in a FINITE bucket (le=3600), not +Inf
+    assert ('kubedl_jobs_all_pods_launch_delay_seconds_bucket'
+            '{kind="TestJob",le="3600"} 1') in body
+    assert ('kubedl_scheduler_queue_wait_seconds_bucket'
+            '{queue="batch",le="3600"} 1') in body
+    assert ('kubedl_jobs_restart_mttr_seconds_bucket'
+            '{kind="TestJob",le="120"} 1') in body
+
+
 def test_label_value_escaping(served):
     reg, port = served
     g = reg.gauge("kubedl_esc", "escapes", ("name",))

@@ -1,0 +1,220 @@
+"""Cluster-scale trace-replay harness (docs/benchmarks.md).
+
+Four layers:
+
+* workload — the seeded generator is bit-for-bit deterministic and
+  shape-correct (burstiness, Zipf prefixes, feasible requests);
+* smoke replay — the tier-1 fleet proof: a real-stack job day + serving
+  day at smoke scale, asserted on op-count budgets and trace-derived
+  outcomes (NEVER wall clocks);
+* scorecard — aggregation, absolute gates, and the regression check
+  ``make bench-cluster`` applies against the committed artifact;
+* determinism — identical scorecards for identical (profile, seed).
+"""
+
+import dataclasses
+
+import pytest
+
+from kubedl_tpu.replay import (ClusterReplay, ServingReplay,
+                               build_scorecard, check_regression,
+                               evaluate_gates, generate)
+from kubedl_tpu.replay.workload import PROFILES, POOL_V5E, POOL_V5P
+
+pytestmark = pytest.mark.replay
+
+
+# ---------------------------------------------------------------------------
+# workload generator
+# ---------------------------------------------------------------------------
+
+
+def small_profile(**overrides):
+    base = dataclasses.replace(
+        PROFILES["smoke"], jobs=30, chaos_preemptions=2,
+        serving_requests=40, sample_traces=8, chaos_max_faults=10)
+    return dataclasses.replace(base, **overrides)
+
+
+def test_workload_deterministic_for_fixed_seed():
+    p = small_profile()
+    a, b = generate(p, 7), generate(p, 7)
+    assert a.fingerprint() == b.fingerprint()
+    assert a.jobs == b.jobs and a.serving == b.serving
+    assert a.preemptions == b.preemptions
+    assert generate(p, 8).fingerprint() != a.fingerprint()
+
+
+def test_workload_shape():
+    wl = generate(small_profile(), 0)
+    p = wl.profile
+    assert len(wl.jobs) == p.jobs
+    assert len(wl.serving) == p.serving_requests
+    assert len(wl.preemptions) == p.chaos_preemptions
+    # arrival-sorted, inside the day, feasible shapes
+    arr = [j.arrival_s for j in wl.jobs]
+    assert arr == sorted(arr) and 0 <= arr[0] and arr[-1] < p.sim_seconds
+    assert {j.pool for j in wl.jobs} <= {POOL_V5P, POOL_V5E}
+    assert all(j.num_slices in (1, 2, 4) for j in wl.jobs)
+    assert all(j.duration_s >= 120.0 for j in wl.jobs)
+    # every serving request fits the cache with room for one new token
+    assert all(len(s.prompt) + s.max_new < p.max_len for s in wl.serving)
+    # Zipf sharing: a majority of requests reuse a registered prefix,
+    # and low ranks dominate high ranks
+    ranks = [s.prefix_rank for s in wl.serving if s.prefix_rank >= 0]
+    assert len(ranks) > len(wl.serving) // 2
+    assert ranks.count(0) >= ranks.count(p.prefixes - 1)
+
+
+# ---------------------------------------------------------------------------
+# the smoke replay (module-scoped: one real-stack run, several asserts)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def smoke_cluster():
+    wl = generate("smoke", 0)
+    return wl, ClusterReplay(wl).run()
+
+
+@pytest.mark.perf
+def test_smoke_job_day_completes_with_op_budgets(smoke_cluster):
+    """The tier-1 fleet guard: the whole smoke day settles through the
+    real manager + scheduler + engine under chaos, within op-count
+    budgets (work counters, never wall clocks)."""
+    wl, res = smoke_cluster
+    assert res["jobs_completed"] == res["jobs_submitted"] == len(wl.jobs)
+    # op budgets: reconciles and scheduler passes per job (the admit/
+    # preempt live-lock this harness caught would blow these 100x)
+    assert res["controlplane"]["reconciles_per_job"] <= 120.0
+    assert res["scheduler"]["passes"] <= 40 * len(wl.jobs)
+    assert res["rounds"] <= 80 * len(wl.jobs)
+
+
+def test_smoke_traces_are_well_formed_and_chaos_ran(smoke_cluster):
+    wl, res = smoke_cluster
+    # zero orphans across every sampled completed-job trace
+    assert res["trace"]["sampled_jobs"] > 0
+    assert res["trace"]["orphan_violations"] == 0, \
+        res["trace"]["orphan_examples"]
+    assert res["trace"]["spans_dropped"] == 0
+    # chaos preemptions executed and produced restart rounds the traces
+    # AND the engine's restart-MTTR metric both observed
+    assert res["chaos_preemptions_executed"] >= 1
+    assert res["restart_rounds_traced"] >= res["chaos_preemptions_executed"]
+    assert len(res["restart_mttrs_s"]) >= 1
+    assert res["engine_metrics"]["mttr_observed"] >= 1
+    # the scheduler exercised its whole policy surface during the day
+    assert res["scheduler"]["preempted"] >= 1
+    assert res["scheduler"]["backfills"] >= 1
+    assert res["scheduler"]["drift"] == 0
+    # queue delays are trace-derived, one per completed job
+    assert len(res["queue_delays_s"]) == len(wl.jobs)
+    assert max(res["queue_delays_s"]) > 0
+
+
+@pytest.mark.perf
+def test_smoke_serving_day_completes(smoke_serving):
+    wl, res = smoke_serving
+    assert res["requests_completed"] == len(wl.serving)
+    assert res["errors"] == 0 and res["requests_unfinished"] == 0
+    assert len(res["ttfts_s"]) == len(wl.serving)
+    # op budget: the engine batches — ticks must stay well below one
+    # tick per generated token
+    assert res["engine_ticks"] <= res["tokens_generated"]
+    assert res["shared_prefix_admissions"] > len(wl.serving) // 2
+
+
+@pytest.fixture(scope="module")
+def smoke_serving():
+    wl = generate("smoke", 0)
+    return wl, ServingReplay(wl).run()
+
+
+def test_smoke_scorecard_gates_pass(smoke_cluster, smoke_serving):
+    wl, cluster = smoke_cluster
+    _, serving = smoke_serving
+    sc = build_scorecard(wl, cluster, serving)
+    gates = evaluate_gates(sc)
+    assert gates["passed"], [c for c in gates["checks"] if not c["passed"]]
+    assert sc["workload_fingerprint"] == wl.fingerprint()
+    # schema spots every future PR moves (docs/benchmarks.md)
+    assert {"p50", "p99", "count"} <= set(sc["jobs"]["queue_delay_s"])
+    assert {"p50", "p99"} <= set(sc["serving"]["ttft_s"])
+    assert sc["jobs"]["slice_utilization"] > 0
+    assert sc["jobs"]["jobs_per_sim_hour"] > 0
+
+
+# ---------------------------------------------------------------------------
+# determinism of the replay itself (tiny scale: two full job-leg runs)
+# ---------------------------------------------------------------------------
+
+
+def test_job_replay_deterministic_bit_for_bit():
+    import json
+    p = small_profile()
+    wl = generate(p, 3)
+    a = ClusterReplay(wl).run()
+    b = ClusterReplay(generate(p, 3)).run()
+    assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+
+
+# ---------------------------------------------------------------------------
+# scorecard gates + regression check (synthetic, no replay needed)
+# ---------------------------------------------------------------------------
+
+
+def _mini_scorecard(**jobs_overrides):
+    sc = {
+        "benchmark": "cluster_trace_replay", "profile": "day", "seed": 0,
+        "jobs": {
+            "completed_fraction": 1.0,
+            "slice_utilization": 0.55,
+            "chaos_preemptions_executed": 10,
+            "queue_delay_s": {"p99": 1200.0},
+            "restart_mttr_s": {"p99": 300.0},
+            "controlplane": {"reconciles_per_job": 50.0},
+            "scheduler": {"passes": 20000},
+            "trace": {"orphan_violations": 0},
+        },
+        "serving": {
+            "completed_fraction": 1.0, "errors": 0,
+            "ttft_s": {"p99": 2.0}, "queue_s": {"p99": 1.5},
+        },
+    }
+    sc["jobs"].update(jobs_overrides)
+    return sc
+
+
+def test_evaluate_gates_pass_and_fail():
+    ok = evaluate_gates(_mini_scorecard(), "day")
+    assert ok["passed"]
+    bad = evaluate_gates(
+        _mini_scorecard(completed_fraction=0.98), "day")
+    assert not bad["passed"]
+    failing = [c["metric"] for c in bad["checks"] if not c["passed"]]
+    assert failing == ["jobs.completed_fraction"]
+
+
+def test_check_regression_detects_backslide_and_respects_tolerance():
+    old = _mini_scorecard()
+    # within tolerance: fine
+    assert check_regression(_mini_scorecard(slice_utilization=0.54),
+                            old) == []
+    # a real utilization collapse: flagged
+    probs = check_regression(_mini_scorecard(slice_utilization=0.40), old)
+    assert any("slice_utilization" in p for p in probs)
+    # queue p99 blow-up: flagged
+    worse = _mini_scorecard(queue_delay_s={"p99": 2000.0})
+    assert any("queue_delay_s.p99" in p
+               for p in check_regression(worse, old))
+    # orphans can never appear
+    orphaned = _mini_scorecard(trace={"orphan_violations": 2})
+    assert any("orphan" in p for p in check_regression(orphaned, old))
+
+
+def test_check_regression_ignores_mismatched_baseline():
+    old = _mini_scorecard()
+    other_seed = _mini_scorecard(slice_utilization=0.10)
+    other_seed["seed"] = 99
+    assert check_regression(other_seed, old) == []

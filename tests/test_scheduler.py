@@ -297,6 +297,55 @@ def test_reclaim_never_pushes_a_victim_queue_below_its_own_min(api, clock):
     assert sched.inventory.held_by_queue().get("donor") == 2
 
 
+def test_reclaimed_capacity_is_earmarked_for_the_claiming_queue(api, clock):
+    """Preemption-debt regression (found by the cluster replay at fleet
+    shape): capacity freed by an under-min queue's reclaim must go to
+    THAT queue's head — a higher-priority queue's 1-slice backfill used
+    to re-take the slice every pass, and the reclaim loop live-locked in
+    an admit/preempt ping-pong that starved the entitled queue forever."""
+    api.create(new_queue("prod", min=2, priority=100))
+    api.create(new_queue("batch", min=2, priority=10))
+    api.create(new_queue("best", min=0, priority=0))
+    sched = make_sched(api, capacity={POOL: 3, POOL2: 2})
+    # prod holds 2 x POOL (exactly its min: never an eligible victim);
+    # batch holds 1 x POOL2; best borrows 1 x POOL -> POOL is full
+    make_pg(api, "p-held-0", queue="prod")
+    make_pg(api, "p-held-1", queue="prod")
+    make_pg(api, "b-held", queue="batch", pool=POOL2)
+    make_pg(api, "e-held", queue="best")
+    sched.schedule_pass()
+    assert len(admitted_names(api)) == 4
+    # prod's head wants 2 x POOL2 (1 free: blocked, reserves it); a
+    # 1-slice POOL gang sits behind it — the backfill candidate
+    clock.advance(1.0)
+    make_pg(api, "p-big-slice-0", job="p-big", queue="prod",
+            pool=POOL2, want=2)
+    make_pg(api, "p-big-slice-1", job="p-big", queue="prod",
+            pool=POOL2, want=2)
+    clock.advance(1.0)
+    make_pg(api, "p-one", queue="prod")
+    # batch (held 1 < min 2) head wants 1 x POOL -> reclaim evicts the
+    # best borrower (podless: released by deletion)
+    make_pg(api, "b-head", queue="batch")
+    sched.schedule_pass()
+    assert api.try_get("PodGroup", "default", "e-held") is None
+    assert sched.metrics.preempted.value(queue="best") == 1
+    # the freed POOL slice is DEBTED to batch: prod's backfill must not
+    # take it, and batch's head admits on the next pass
+    sched.schedule_pass()
+    adm = admitted_names(api)
+    assert "b-head" in adm, adm
+    assert "p-one" not in adm, adm          # waits: capacity was owed
+    # no ping-pong: nothing beyond the single reclaim was preempted
+    assert sched.metrics.preempted.value(queue="prod") == 0
+    assert sched.metrics.preempted.value(queue="best") == 1
+    # with the debt settled, ordinary backfill resumes once space frees
+    api.delete("PodGroup", "default", "b-head")
+    sched.schedule_pass()
+    assert "p-one" in admitted_names(api)
+    sched.check_parity()
+
+
 def test_partial_admission_counts_toward_quota_ceiling(api, clock,
                                                        monkeypatch):
     """A gang-set whose second status write fails still HOLDS its landed

@@ -1,0 +1,86 @@
+"""Shared percentile/mean/summary helpers (``utils/stats.py``).
+
+These back every bench JSON and the cluster scorecard, so edge cases
+(empty, single sample, interpolation, method parity with the historical
+inline ``pct()`` closures) are pinned here.
+"""
+
+import pytest
+
+from kubedl_tpu.utils.stats import mean, percentile, summarize
+
+
+def test_percentile_nearest_matches_legacy_bench_pct():
+    # the exact closure bench_controlplane/bench_scheduler carried:
+    # sorted[min(int(n*q), n-1)]
+    data = [5.0, 1.0, 3.0, 2.0, 4.0]
+    legacy = sorted(data)
+
+    def pct(q):
+        return legacy[min(int(len(legacy) * q), len(legacy) - 1)]
+
+    for q in (0.0, 0.25, 0.5, 0.75, 0.9, 0.99, 1.0):
+        assert percentile(data, q) == pct(q)
+
+
+def test_percentile_single_sample_both_methods():
+    assert percentile([7.5], 0.0) == 7.5
+    assert percentile([7.5], 0.99) == 7.5
+    assert percentile([7.5], 1.0, method="linear") == 7.5
+
+
+def test_percentile_linear_interpolates():
+    data = [0.0, 10.0]
+    assert percentile(data, 0.5, method="linear") == 5.0
+    assert percentile(data, 0.25, method="linear") == 2.5
+    assert percentile(data, 1.0, method="linear") == 10.0
+    # 5 samples: rank 0.5*(5-1)=2 lands exactly on a sample
+    assert percentile([1, 2, 3, 4, 5], 0.5, method="linear") == 3.0
+
+
+def test_percentile_empty_raises_or_defaults():
+    with pytest.raises(ValueError):
+        percentile([], 0.5)
+    assert percentile([], 0.5, default=0.0) == 0.0
+    assert percentile([], 0.99, default=-1.0) == -1.0
+
+
+def test_percentile_rejects_bad_inputs():
+    with pytest.raises(ValueError):
+        percentile([1.0], 1.5)
+    with pytest.raises(ValueError):
+        percentile([1.0], -0.1)
+    with pytest.raises(ValueError):
+        percentile([1.0], 0.5, method="cubic")
+
+
+def test_mean_basic_and_empty():
+    assert mean([1.0, 2.0, 3.0]) == 2.0
+    with pytest.raises(ValueError):
+        mean([])
+    assert mean([], default=0.0) == 0.0
+
+
+def test_summarize_shape_and_values():
+    s = summarize([4.0, 1.0, 3.0, 2.0], percentiles=(0.5, 0.99))
+    assert s["count"] == 4
+    assert s["mean"] == 2.5
+    assert s["min"] == 1.0 and s["max"] == 4.0
+    assert s["p50"] == 3.0          # nearest: sorted[int(4*0.5)] = sorted[2]
+    assert s["p99"] == 4.0
+
+
+def test_summarize_empty_is_zeros_not_error():
+    s = summarize([], percentiles=(0.5, 0.999))
+    assert s == {"count": 0, "mean": 0.0, "min": 0.0, "max": 0.0,
+                 "p50": 0.0, "p99.9": 0.0}
+
+
+def test_summarize_percentile_key_naming():
+    s = summarize([1.0], percentiles=(0.5, 0.9, 0.999))
+    assert set(s) == {"count", "mean", "min", "max", "p50", "p90", "p99.9"}
+
+
+def test_summarize_rounding():
+    s = summarize([1.0 / 3.0], ndigits=2)
+    assert s["mean"] == 0.33
