@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test test-all test-fast test-chaos test-scheduler test-trace test-replay bench bench-controlplane bench-scheduler bench-serving-paged bench-trace bench-cluster dryrun crds run-standalone lint native
+.PHONY: test test-all test-fast test-chaos test-scheduler test-trace test-replay test-telemetry bench bench-controlplane bench-scheduler bench-serving-paged bench-trace bench-cluster dryrun crds run-standalone lint native
 
 # fast path (<3 min): everything except the compile-heavy compute suites
 # (those carry `pytestmark = pytest.mark.slow`). Chaos tests are fast and
@@ -66,6 +66,12 @@ bench-trace:
 # through the real stack, scorecard gates; docs/benchmarks.md)
 test-replay:
 	$(PY) -m pytest tests/ -q -m replay
+
+# fleet goodput & straggler telemetry suite (goodput accounting,
+# throughput profiles, SlowSlice detection, pending-job explainer;
+# docs/telemetry.md)
+test-telemetry:
+	$(PY) -m pytest tests/ -q -m telemetry
 
 # THE fleet scorecard: a production-shaped day (thousands of jobs, tens
 # of thousands of serving requests, chaos faults) through the real

@@ -35,6 +35,8 @@ import dataclasses
 import json
 import time
 
+from kubedl_tpu.utils.stats import summarize
+
 
 def build_workload(n: int, seed: int, max_len: int) -> list:
     """Mixed-length (prompt, max_new) pairs: mostly short chat-style
@@ -72,6 +74,8 @@ def run_engine(model, workload, *, kv_mode, lanes, max_len, kv_block,
     t0 = time.perf_counter()
     outs = eng.run(workload)
     dt = time.perf_counter() - t0
+    per_request = summarize([len(o) for o in outs],
+                            percentiles=(0.5, 0.9), ndigits=2)
     n_tokens = sum(len(o) for o in outs)
     stats = eng.pool_stats()
     slot_tokens = (max_len * lanes if kv_mode == "dense"
@@ -86,6 +90,7 @@ def run_engine(model, workload, *, kv_mode, lanes, max_len, kv_block,
         "max_concurrent": stats["peak_active"],
         "preemptions": stats.get("preempted", 0),
         "tokens_generated": n_tokens,
+        "tokens_per_request": per_request,
         "tokens_per_s": round(n_tokens / max(dt, 1e-9), 2),
         "wall_seconds": round(dt, 3),
     }
@@ -128,6 +133,11 @@ def main():
         "requests": args.requests,
         "workload_prompt_tokens": sum(len(p) for p, _ in workload),
         "workload_new_tokens": sum(n for _, n in workload),
+        # the shared stats module (utils/stats.py) replaces any bench-
+        # local aggregation, same as bench_controlplane/bench_scheduler
+        "workload_prompt_len": summarize([len(p) for p, _ in workload],
+                                         percentiles=(0.5, 0.9),
+                                         ndigits=2),
         "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         "dense": run_engine(model, workload, kv_mode="dense",
                             lanes=dense_lanes, max_len=args.max_len,

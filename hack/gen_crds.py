@@ -228,6 +228,19 @@ def main() -> None:
     path = OUT / "scheduling.kubedl.io_queues.yaml"
     path.write_text(yaml.safe_dump(queue_doc, sort_keys=False))
     written.append(path.name)
+    # fleet-telemetry ThroughputProfile: cluster-scoped persisted
+    # per-(profile, pool) throughput estimates (docs/telemetry.md)
+    profile_doc = crd("telemetry.kubedl.io", "ThroughputProfile",
+                      "throughputprofiles",
+                      generic_schema({
+                          "type": "object",
+                          "properties": {
+                              "key": {"type": "string"},
+                          }}),
+                      scope="Cluster")
+    path = OUT / "telemetry.kubedl.io_throughputprofiles.yaml"
+    path.write_text(yaml.safe_dump(profile_doc, sort_keys=False))
+    written.append(path.name)
     print(f"wrote {len(written)} CRDs to {OUT}")
 
 

@@ -39,6 +39,12 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "also Tracing gate)")
     p.add_argument("--trace-buffer", type=int, default=8192,
                    help="span ring-buffer capacity when tracing is on")
+    p.add_argument("--enable-telemetry", action="store_true",
+                   help="fleet goodput & straggler telemetry: goodput "
+                        "accounting, throughput profiles, SlowSlice "
+                        "detection, /api/v1/explain endpoint "
+                        "(docs/telemetry.md; also FleetTelemetry gate; "
+                        "implies tracing)")
     p.add_argument("--slice-capacity", default="",
                    help='static slice inventory "POOL=N,..." (e.g. '
                         '"tpu-v5p-slice/2x2x4=4") when the control plane '
@@ -114,6 +120,7 @@ def config_from_args(args: argparse.Namespace) -> OperatorConfig:
         slice_capacity=args.slice_capacity,
         enable_tracing=args.enable_tracing,
         trace_buffer=args.trace_buffer,
+        enable_telemetry=args.enable_telemetry,
     )
 
 
@@ -186,7 +193,9 @@ def main(argv=None) -> int:
         proxy = DataProxy(operator.api, operator.object_backend,
                           operator.event_backend,
                           job_kinds=tuple(operator.engines),
-                          tracer=operator.tracer)
+                          tracer=operator.tracer,
+                          scheduler=operator.scheduler,
+                          telemetry=operator.telemetry)
         console = ConsoleServer(
             proxy, ConsoleConfig(host=args.console_host,
                                  port=args.console_port))

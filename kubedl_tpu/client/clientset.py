@@ -56,6 +56,11 @@ KIND_TABLE = {
     # slice-scheduler tenancy quota (docs/scheduling.md)
     "Queue": ResourceInfo("Queue", "scheduling.kubedl.io/v1alpha1", "queues",
                           namespaced=False),
+    # fleet telemetry: persisted per-(profile, pool) throughput estimates
+    # (docs/telemetry.md)
+    "ThroughputProfile": ResourceInfo(
+        "ThroughputProfile", "telemetry.kubedl.io/v1alpha1",
+        "throughputprofiles", namespaced=False),
 }
 
 TRAINING_KINDS = tuple(k for k, v in KIND_TABLE.items()

@@ -42,7 +42,8 @@ class DataProxy:
     def __init__(self, api: APIServer,
                  object_backend: Optional[ObjectBackend] = None,
                  event_backend: Optional[EventBackend] = None,
-                 job_kinds=TRAINING_KINDS, tracer=None):
+                 job_kinds=TRAINING_KINDS, tracer=None, scheduler=None,
+                 telemetry=None):
         self.api = api
         self.object_backend = object_backend
         self.event_backend = event_backend
@@ -50,6 +51,12 @@ class DataProxy:
         #: the operator's span recorder (kubedl_tpu.trace.Tracer); None
         #: or disabled = the /api/v1/trace endpoints answer 501
         self.tracer = tracer
+        #: the live SliceScheduler (docs/scheduling.md); None = the
+        #: /api/v1/explain endpoint answers 501
+        self.scheduler = scheduler
+        #: the FleetTelemetry bundle (docs/telemetry.md); None = the job
+        #: detail carries no goodput field (disabled path byte-identical)
+        self.telemetry = telemetry
 
     # -- jobs -------------------------------------------------------------
 
@@ -449,3 +456,43 @@ class DataProxy:
         if closed is None and live is None:
             return None
         return round((closed or 0.0) + (live or 0.0), 3)
+
+    # -- fleet telemetry (docs/telemetry.md) ------------------------------
+
+    @property
+    def telemetry_enabled(self) -> bool:
+        return self.telemetry is not None
+
+    def job_goodput(self, job: dict) -> Optional[dict]:
+        """Per-job goodput decomposition for the job-detail view, from
+        the job's trace (live jobs show the decomposition so far). None
+        when the job has no trace spans."""
+        if not self.tracing_enabled:
+            return None
+        from ..telemetry import goodput_breakdown
+        from ..trace import job_trace_context, trace_breakdown
+        spans = self.tracer.spans(trace_id=job_trace_context(job)[0])
+        if not spans:
+            return None
+        return goodput_breakdown(trace_breakdown(spans))
+
+    def explain_pending(self, namespace: str, name: str) -> Optional[dict]:
+        """The pending-job explainer verdict (requires the scheduler);
+        falls back to a phase-shaped answer for jobs the scheduler has
+        never seen (running pre-gate, terminal, unknown)."""
+        from ..telemetry import explain_pending
+        verdict = explain_pending(self.scheduler, namespace, name)
+        if verdict is not None:
+            return verdict
+        for kind in self.job_kinds:
+            job = self.api.try_get(kind, namespace, name)
+            if job is not None:
+                conds = m.get_in(job, "status", "conditions",
+                                 default=[]) or []
+                state = next((cd.get("type") for cd in reversed(conds)
+                              if cd.get("status") == "True"), "Unknown")
+                return {"job": f"{namespace}/{name}",
+                        "verdict": "NotQueued", "state": state,
+                        "message": "the slice scheduler holds no pending "
+                                   "gang-set for this job"}
+        return None

@@ -367,11 +367,17 @@ class ConsoleServer:
                 raise NotFound(f"job {ns}/{name} not found")
             pods = self.proxy.list_job_pods(m.kind(job), ns, name)
             events = self.proxy.list_events(ns, name)
-            return ok({"job": job, "pods": [p.to_row() for p in pods],
-                       "events": [e.to_row() for e in events],
-                       # per-job queue wait (trace breakdown when traced,
-                       # else the live Queuing condition's age)
-                       "queueWaitSeconds": self.proxy.job_queue_wait(job)})
+            detail = {"job": job, "pods": [p.to_row() for p in pods],
+                      "events": [e.to_row() for e in events],
+                      # per-job queue wait (trace breakdown when traced,
+                      # else the live Queuing condition's age)
+                      "queueWaitSeconds": self.proxy.job_queue_wait(job)}
+            if self.proxy.telemetry_enabled:
+                # goodput decomposition (docs/telemetry.md) — the key is
+                # only present with the FleetTelemetry gate on, so the
+                # disabled response stays byte-identical
+                detail["goodput"] = self.proxy.job_goodput(job)
+            return ok(detail)
         if path == "/api/v1/job/statistics":
             return ok(self.proxy.job_statistics(_query_from_params(params)))
         if path == "/api/v1/job/running-jobs":
@@ -460,6 +466,23 @@ class ConsoleServer:
                     return ok(to_chrome_trace(spans) if fmt == "chrome"
                               else to_otlp_json(spans))
                 return ok(breakdown)
+
+        # pending-job explainer (docs/telemetry.md): a structured "why is
+        # this job not running" verdict from live scheduler state; 501
+        # when the slice scheduler is disabled, matching the trace
+        # endpoints' convention
+        mt = re.fullmatch(r"/api/v1/explain/([^/]+)/([^/]+)", path)
+        if mt:
+            if self.proxy.scheduler is None:
+                return 501, {"code": 501,
+                             "msg": "slice scheduler disabled "
+                                    "(--enable-slice-scheduler / "
+                                    "TPUSliceScheduler gate)"}, []
+            ns, name = mt.groups()
+            verdict = self.proxy.explain_pending(ns, name)
+            if verdict is None:
+                raise NotFound(f"job {ns}/{name} not found")
+            return ok(verdict)
 
         # slice-scheduler queues: quota + live usage (docs/scheduling.md)
         if path == "/api/v1/queue/list":

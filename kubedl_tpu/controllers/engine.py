@@ -133,13 +133,17 @@ class JobEngine(Reconciler):
                  metrics: Optional[JobMetrics] = None,
                  recorder: Optional[Recorder] = None,
                  gang: Optional[GangScheduler] = None,
-                 tracer=None):
+                 tracer=None, telemetry=None):
         self.api = api
         self.controller = controller
         self.config = config or EngineConfig()
         self.metrics = metrics or JobMetrics()
         self.recorder = recorder or Recorder(api)
         self.gang = gang
+        #: fleet telemetry bundle (docs/telemetry.md): goodput harvest at
+        #: job retirement + the straggler scan driver; None when the
+        #: FleetTelemetry gate is off (every hook is one None check)
+        self.telemetry = telemetry
         #: span recorder (docs/tracing.md); the shared disabled tracer by
         #: default, so every trace call below is one attribute check
         self.tracer = tracer if tracer is not None else NOOP_TRACER
@@ -188,6 +192,8 @@ class JobEngine(Reconciler):
                 self.metrics.deleted.inc(kind=self.kind)
                 self._job_states.pop(uid, None)
                 self.lifecycle.forget(uid)
+                if self.telemetry is not None:
+                    self.telemetry.forget(uid)
                 self._tb_jobs.discard(uid)
                 self._tb_reap_checked.discard(uid)
                 self._mttr_start.pop(uid, None)
@@ -234,6 +240,10 @@ class JobEngine(Reconciler):
     # ------------------------------------------------------------------
 
     def reconcile(self, req: Request) -> Optional[Result]:
+        if self.telemetry is not None:
+            # rate-limited straggler scan rides the reconcile stream (the
+            # detector itself bounds how often a scan actually runs)
+            self.telemetry.maybe_scan(self.api.now())
         job = self.api.try_get(self.kind, req.namespace, req.name)
         if job is None or m.is_deleting(job):
             return None
@@ -667,6 +677,12 @@ class JobEngine(Reconciler):
         # TensorBoard outlives the job for its own TTL (tensorboard.go:99-135)
         tb_requeue = self._reconcile_tb(job, status, replicas)
         self._trace_phase(job, status, pods, replicas)
+        if self.telemetry is not None:
+            # the lifecycle root span is closed by the _trace_phase above,
+            # so the full trace is harvestable — goodput decomposition +
+            # throughput-profile observations. Idempotent per job UID
+            # (terminal reconciles repeat on TTL requeues)
+            self.telemetry.on_job_terminal(job)
         flushed = self._flush_status(job, status, old_status)
 
         requeues = [tb_requeue] if tb_requeue else []

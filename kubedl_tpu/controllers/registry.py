@@ -81,6 +81,14 @@ class OperatorConfig:
     enable_tracing: bool = False
     #: span ring-buffer capacity when tracing is enabled
     trace_buffer: int = 8192
+    #: fleet goodput & straggler telemetry (docs/telemetry.md): goodput
+    #: accounting at job retirement, online throughput profiles,
+    #: SlowSlice detection, the pending-job explainer endpoint. Also
+    #: switchable via the FleetTelemetry gate; either turns it on (and
+    #: with it the tracer — the layer distills trace spans). Off by
+    #: default: no telemetry object exists, no ThroughputProfile writes,
+    #: console explain answers 501.
+    enable_telemetry: bool = False
 
 
 @dataclass
@@ -98,6 +106,8 @@ class Operator:
     #: the span recorder (kubedl_tpu.trace.Tracer); disabled unless
     #: --enable-tracing / the Tracing gate turned it on
     tracer: object = None
+    #: the FleetTelemetry bundle when enabled (None otherwise)
+    telemetry: object = None
 
     def run_until_idle(self, **kw):
         return self.manager.run_until_idle(**kw)
@@ -128,7 +138,12 @@ def build_operator(api: Optional[APIServer] = None,
     # zeroes when off); the tracer only feeds them while enabled.
     from ..metrics.registry import TraceMetrics
     from ..trace import Tracer
-    trace_enabled = config.enable_tracing or gates.enabled(ft.TRACING)
+    telemetry_enabled = (config.enable_telemetry
+                         or gates.enabled(ft.FLEET_TELEMETRY))
+    # telemetry distills trace spans (goodput, step-skew, profiles), so
+    # enabling it implies the tracer even when the Tracing gate is off
+    trace_enabled = (config.enable_tracing or gates.enabled(ft.TRACING)
+                     or telemetry_enabled)
     tracer = Tracer(enabled=trace_enabled, capacity=config.trace_buffer,
                     clock=api.now, metrics=TraceMetrics(registry))
     manager = Manager(api, metrics=ControlPlaneMetrics(registry),
@@ -139,6 +154,18 @@ def build_operator(api: Optional[APIServer] = None,
     sched_enabled = gang is not None and (
         config.enable_slice_scheduler
         or gates.enabled(ft.TPU_SLICE_SCHEDULER))
+    # fleet telemetry bundle (docs/telemetry.md): one instance shared by
+    # every engine (goodput harvest + straggler scans) and the console
+    # (explainer / job-detail goodput); None keeps the disabled path free
+    telemetry = None
+    if telemetry_enabled:
+        from ..client.clientset import TRAINING_KINDS
+        from ..metrics.registry import TelemetryMetrics
+        from ..telemetry import FleetTelemetry
+        telemetry = FleetTelemetry(api, tracer,
+                                   metrics=TelemetryMetrics(registry),
+                                   recorder=recorder,
+                                   job_kinds=TRAINING_KINDS)
     engine_config = EngineConfig(
         enable_gang_scheduling=gang is not None,
         enable_dag_scheduling=(config.enable_dag_scheduling
@@ -164,9 +191,14 @@ def build_operator(api: Optional[APIServer] = None,
                 and hasattr(ctrl, "kubectl_delivery_image"):
             ctrl.kubectl_delivery_image = config.kubectl_delivery_image
         engine = JobEngine(api, ctrl, engine_config, metrics=metrics,
-                           recorder=recorder, gang=gang, tracer=tracer)
+                           recorder=recorder, gang=gang, tracer=tracer,
+                           telemetry=telemetry)
         manager.register(engine)
         engines[ctrl_cls.kind] = engine
+    if telemetry is not None and engines:
+        # the straggler detector resolves jobs by kind; scope it to the
+        # kinds this operator actually reconciles
+        telemetry.straggler.job_kinds = tuple(engines)
 
     # platform-service controllers (SURVEY.md §1.6)
     manager.register(ModelVersionReconciler(
@@ -223,7 +255,8 @@ def build_operator(api: Optional[APIServer] = None,
                     metrics_registry=registry, config=config,
                     object_backend=object_backend,
                     event_backend=event_backend, admission=admission,
-                    scheduler=scheduler, tracer=tracer)
+                    scheduler=scheduler, tracer=tracer,
+                    telemetry=telemetry)
 
 
 def _storage_backend(spec: str, for_events: bool = False):

@@ -28,6 +28,11 @@ TPU_SLICE_SCHEDULER = "TPUSliceScheduler"
 #: the disabled tracer's hot path is one attribute check (the `perf`
 #: budget test in tests/test_trace.py holds it there)
 TRACING = "Tracing"
+#: fleet goodput & straggler telemetry (docs/telemetry.md): goodput
+#: accounting, online throughput profiles, SlowSlice detection, the
+#: pending-job explainer endpoint; off by default — enabling it also
+#: turns the tracer on (the telemetry layer distills trace spans)
+FLEET_TELEMETRY = "FleetTelemetry"
 
 _DEFAULTS = {
     GANG_SCHEDULING: True,           # Beta
@@ -38,6 +43,7 @@ _DEFAULTS = {
     JAX_PROFILER_UPLOAD: False,
     TPU_SLICE_SCHEDULER: False,      # Alpha
     TRACING: False,                  # Alpha
+    FLEET_TELEMETRY: False,          # Alpha
 }
 
 ENV_FEATURE_GATES = "KUBEDL_FEATURE_GATES"

@@ -2,4 +2,4 @@
 
 from .registry import (ControlPlaneMetrics, Counter, Gauge,  # noqa: F401
                        Histogram, JobMetrics, Registry, SchedulerMetrics,
-                       TraceMetrics)
+                       TelemetryMetrics, TraceMetrics)

@@ -285,6 +285,45 @@ class SchedulerMetrics:
             buckets=_QUEUE_WAIT_BUCKETS)
 
 
+class TelemetryMetrics:
+    """Fleet goodput / straggler / throughput-profile families
+    (docs/telemetry.md): the operator-facing products distilled from the
+    trace spans and metric registries by ``kubedl_tpu.telemetry``. The
+    families register unconditionally like TraceMetrics; they only move
+    while the FleetTelemetry gate is on (off = all zeroes)."""
+
+    def __init__(self, registry: Optional[Registry] = None):
+        self.registry = registry or Registry()
+        r = self.registry
+        self.fleet_goodput = r.gauge(
+            "kubedl_goodput_fleet_ratio",
+            "Fraction of observed chip wall-clock spent in productive "
+            "train.step time, across all retired jobs")
+        self.goodput_seconds = r.counter(
+            "kubedl_goodput_seconds_total",
+            "Retired-job wall-clock seconds by goodput category "
+            "(productive plus each overhead bucket)", ("category",))
+        self.jobs_observed = r.counter(
+            "kubedl_goodput_jobs_observed_total",
+            "Retired jobs whose traces were folded into the goodput "
+            "accounting")
+        self.slow_slices = r.counter(
+            "kubedl_telemetry_slow_slices_total",
+            "SlowSlice detections (one per skew onset, not per scan)",
+            ("kind",))
+        self.slow_slice_active = r.gauge(
+            "kubedl_telemetry_slow_slice_active",
+            "Jobs currently carrying a True SlowSlice condition")
+        self.profile_tokens_per_s = r.gauge(
+            "kubedl_throughput_profile_tokens_per_s",
+            "Online decayed throughput estimate per (profile, pool)",
+            ("profile", "pool"))
+        self.profile_samples = r.counter(
+            "kubedl_throughput_profile_samples_total",
+            "Observations folded into each throughput profile",
+            ("profile", "pool"))
+
+
 class TraceMetrics:
     """Span-recorder health (docs/tracing.md): recorded-span throughput
     per component, ring-buffer occupancy, and the overflow-drop counter

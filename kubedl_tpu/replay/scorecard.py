@@ -25,6 +25,7 @@ _GATES = {
         ("jobs.completed_fraction", ">=", 1.0),
         ("jobs.trace.orphan_violations", "<=", 0),
         ("jobs.slice_utilization", ">=", 0.10),
+        ("jobs.fleet_goodput", ">=", 0.10),
         ("jobs.controlplane.reconciles_per_job", "<=", 120.0),
         ("serving.completed_fraction", ">=", 1.0),
         ("serving.errors", "<=", 0),
@@ -33,6 +34,7 @@ _GATES = {
         ("jobs.completed_fraction", ">=", 1.0),
         ("jobs.trace.orphan_violations", "<=", 0),
         ("jobs.slice_utilization", ">=", 0.30),
+        ("jobs.fleet_goodput", ">=", 0.20),
         ("jobs.queue_delay_s.p99", "<=", 28800.0),
         ("jobs.controlplane.reconciles_per_job", "<=", 120.0),
         ("jobs.chaos_preemptions_executed", ">=", 1),
@@ -46,6 +48,7 @@ _GATES = {
 #: (path, direction, relative slack, absolute grace)
 _REGRESSION = (
     ("jobs.slice_utilization", "higher_better", 0.05, 0.01),
+    ("jobs.fleet_goodput", "higher_better", 0.05, 0.01),
     ("jobs.queue_delay_s.p99", "lower_better", 0.12, 10.0),
     ("jobs.restart_mttr_s.p99", "lower_better", 0.20, 10.0),
     ("jobs.controlplane.reconciles_per_job", "lower_better", 0.15, 1.0),
@@ -78,6 +81,11 @@ def build_scorecard(workload: Workload, cluster: dict,
                                        ndigits=1)
     jobs["jobs_per_sim_hour"] = round(
         jobs["jobs_completed"] / (jobs["makespan_s"] / 3600.0), 2)
+    # the telemetry layer's goodput decomposition at day scale: the
+    # headline ratio is lifted to a first-class column so the gates and
+    # the regression check can hold it like utilization
+    jobs["fleet_goodput"] = (jobs.get("goodput") or {}).get(
+        "fleetGoodput", 0.0)
 
     srv = dict(serving)
     q_waits = srv.pop("queue_waits_s")

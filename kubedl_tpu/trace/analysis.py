@@ -53,10 +53,14 @@ def trace_breakdown(spans: Iterable[Span],
     evicted — the breakdown stays well-formed, and a non-zero
     ``droppedSpans`` field tells the reader the listed orphans are
     attributable to eviction rather than an instrumentation bug."""
-    spans = [s for s in spans
-             if trace_id is None or s.trace_id == trace_id]
+    spans = list(spans)
     if trace_id is None and spans:
+        # infer from the first span AND filter by it: a recorder ring
+        # holds many concurrent jobs' spans interleaved, and folding a
+        # second trace's phases into the first's byPhase silently
+        # corrupts the breakdown (goodput reads these numbers)
         trace_id = spans[0].trace_id
+    spans = [s for s in spans if s.trace_id == trace_id]
     phases = sorted(
         (s for s in spans
          if s.component == "lifecycle" and "phase" in s.attributes),

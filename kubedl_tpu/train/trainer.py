@@ -208,6 +208,7 @@ class Trainer:
         ``$KUBEDL_TRACEPARENT`` (docs/tracing.md)."""
         tr = tracer if tracer is not None and tracer.enabled else None
         trace_id = parent_id = None
+        replica = ""
         if tr is not None:
             import os
             from ..trace import ENV_TRACEPARENT, parse_traceparent
@@ -216,6 +217,12 @@ class Trainer:
                 trace_id, parent_id = ctx
             else:
                 trace_id = tr.new_trace_id()
+            # which slice worker this is: the operator injects
+            # TPU_WORKER_ID (tpu/placement.py); the telemetry layer's
+            # straggler detector compares step-time skew across replicas
+            from ..tpu.placement import ENV_TPU_WORKER_ID
+            replica = (os.environ.get(ENV_TPU_WORKER_ID)
+                       or os.environ.get("HOSTNAME", ""))
         t0 = time.time()
         tokens = 0
         step0 = int(jax.device_get(state.step))  # one sync, then host-side
@@ -232,14 +239,21 @@ class Trainer:
                     jax.profiler.start_trace(cfg.profile_dir)
                     tracing = True
                 batch = next(batches)
-                tokens += _batch_tokens(batch)
+                step_tokens = _batch_tokens(batch)
+                tokens += step_tokens
                 t_step = time.time() if tr is not None else 0.0
                 state, loss = self.step(state, batch)
                 if tr is not None:
+                    # tokens + replica make the span throughput-derivable:
+                    # the telemetry layer builds per-(model, pool)
+                    # profiles and cross-replica skew detection from
+                    # exactly these attributes (docs/telemetry.md)
                     tr.record("train.step", t_step, time.time(),
                               trace_id=trace_id, parent_id=parent_id,
                               component="train",
-                              attributes={"step": step0 + i + 1})
+                              attributes={"step": step0 + i + 1,
+                                          "tokens": step_tokens,
+                                          "replica": replica})
                 if tracing and i + 1 >= profile_at + cfg.profile_steps:
                     jax.block_until_ready(loss)  # close open device events
                     jax.profiler.stop_trace()

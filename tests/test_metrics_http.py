@@ -138,6 +138,45 @@ def test_fleet_scale_bucket_boundaries_in_exposition(served):
             '{kind="TestJob",le="120"} 1') in body
 
 
+def test_telemetry_families_in_exposition(served):
+    """Pin the goodput / straggler / throughput-profile families
+    (docs/telemetry.md): names, label sets, and escaping — profile keys
+    and pool names are user-influenced label values, so they ride the
+    same escaping contract the queue labels do."""
+    from kubedl_tpu.metrics.registry import TelemetryMetrics
+    reg, port = served
+    tm = TelemetryMetrics(reg)
+    tm.fleet_goodput.set(0.62)
+    tm.goodput_seconds.inc(120.5, category="productive")
+    tm.goodput_seconds.inc(30.0, category="queue")
+    tm.jobs_observed.inc()
+    tm.slow_slices.inc(kind="TFJob")
+    tm.slow_slice_active.set(1)
+    tm.profile_tokens_per_s.set(48211.5, profile="llama-3",
+                                pool="tpu-v5p-slice/2x2x4")
+    tm.profile_samples.inc(profile="llama-3", pool="tpu-v5p-slice/2x2x4")
+    tm.profile_tokens_per_s.set(9.5, profile='we"ird', pool="p\\q")
+    _, body, _ = scrape(port)
+    assert "# TYPE kubedl_goodput_fleet_ratio gauge" in body
+    assert "kubedl_goodput_fleet_ratio 0.62" in body
+    assert "# TYPE kubedl_goodput_seconds_total counter" in body
+    assert 'kubedl_goodput_seconds_total{category="productive"} 120.5' \
+        in body
+    assert 'kubedl_goodput_seconds_total{category="queue"} 30.0' in body
+    assert "kubedl_goodput_jobs_observed_total 1.0" in body
+    assert "# TYPE kubedl_telemetry_slow_slices_total counter" in body
+    assert 'kubedl_telemetry_slow_slices_total{kind="TFJob"} 1.0' in body
+    assert "kubedl_telemetry_slow_slice_active 1.0" in body
+    assert "# TYPE kubedl_throughput_profile_tokens_per_s gauge" in body
+    assert ('kubedl_throughput_profile_tokens_per_s{profile="llama-3",'
+            'pool="tpu-v5p-slice/2x2x4"} 48211.5') in body
+    assert ('kubedl_throughput_profile_samples_total{profile="llama-3",'
+            'pool="tpu-v5p-slice/2x2x4"} 1.0') in body
+    # escaping: quote in the profile key, backslash in the pool name
+    assert ('kubedl_throughput_profile_tokens_per_s{profile="we\\"ird",'
+            'pool="p\\\\q"} 9.5') in body
+
+
 def test_label_value_escaping(served):
     reg, port = served
     g = reg.gauge("kubedl_esc", "escapes", ("name",))

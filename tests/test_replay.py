@@ -143,6 +143,14 @@ def test_smoke_scorecard_gates_pass(smoke_cluster, smoke_serving):
     assert {"p50", "p99"} <= set(sc["serving"]["ttft_s"])
     assert sc["jobs"]["slice_utilization"] > 0
     assert sc["jobs"]["jobs_per_sim_hour"] > 0
+    # the telemetry layer's goodput column (docs/telemetry.md): every
+    # completed job's trace folded in, headline ratio lifted for gates
+    gp = sc["jobs"]["goodput"]
+    assert gp["jobsObserved"] == len(wl.jobs)
+    assert sc["jobs"]["fleet_goodput"] == gp["fleetGoodput"]
+    assert 0 < sc["jobs"]["fleet_goodput"] < 1
+    parts = gp["productiveSeconds"] + sum(gp["overheadSeconds"].values())
+    assert abs(parts - gp["wallSeconds"]) <= 0.01 * gp["wallSeconds"]
 
 
 # ---------------------------------------------------------------------------
@@ -170,6 +178,7 @@ def _mini_scorecard(**jobs_overrides):
         "jobs": {
             "completed_fraction": 1.0,
             "slice_utilization": 0.55,
+            "fleet_goodput": 0.45,
             "chaos_preemptions_executed": 10,
             "queue_delay_s": {"p99": 1200.0},
             "restart_mttr_s": {"p99": 300.0},
@@ -204,6 +213,11 @@ def test_check_regression_detects_backslide_and_respects_tolerance():
     # a real utilization collapse: flagged
     probs = check_regression(_mini_scorecard(slice_utilization=0.40), old)
     assert any("slice_utilization" in p for p in probs)
+    # a fleet-goodput backslide: flagged (the new telemetry column rides
+    # the same tolerance machinery)
+    probs = check_regression(_mini_scorecard(fleet_goodput=0.30), old)
+    assert any("fleet_goodput" in p for p in probs)
+    assert check_regression(_mini_scorecard(fleet_goodput=0.44), old) == []
     # queue p99 blow-up: flagged
     worse = _mini_scorecard(queue_delay_s={"p99": 2000.0})
     assert any("queue_delay_s.p99" in p

@@ -1,0 +1,790 @@
+"""Fleet goodput & straggler telemetry (docs/telemetry.md).
+
+Five layers:
+
+* goodput — the trace-breakdown → decomposition math (categories,
+  checkpoint carve-out, components summing to wall-clock) and the fleet
+  accountant's rollup + metric families;
+* profiles — the exponentially-decayed running mean, Gavel-style
+  normalization, and the ThroughputProfile persistence round-trip;
+* straggler — injected step-span skew raises exactly one ``SlowSlice``
+  condition + Event and clears when the skew stops;
+* explainer — one verdict per blocking rule (quota ceiling, pool
+  capacity, backfill reservation, reclaim earmark, infeasible,
+  incomplete) plus the console endpoint (501 when the scheduler is off);
+* e2e — THE acceptance flow: chaos-seeded queued → admitted → preempted
+  → re-admitted → succeeded, with the goodput decomposition summing to
+  the trace wall-clock within 1% and the explainer returning the correct
+  blocking-queue verdict at two distinct pending stages; and the
+  disabled path leaving zero new artifacts.
+"""
+
+import pytest
+
+from kubedl_tpu import trace
+from kubedl_tpu.api import common as c
+from kubedl_tpu.api.queue import new_queue
+from kubedl_tpu.api.throughputprofile import (PROFILE_KIND,
+                                              profile_object_name)
+from kubedl_tpu.console.proxy import DataProxy
+from kubedl_tpu.console.server import ConsoleConfig, ConsoleServer
+from kubedl_tpu.controllers.chaos import ChaosAPIServer, ChaosConfig
+from kubedl_tpu.controllers.engine import EngineConfig, JobEngine
+from kubedl_tpu.controllers.registry import OperatorConfig, build_operator
+from kubedl_tpu.controllers.testing import (TestJobController, new_test_job,
+                                            run_all_pods, set_pod_phase)
+from kubedl_tpu.core import features as ft
+from kubedl_tpu.core import meta as m
+from kubedl_tpu.core.apiserver import APIServer
+from kubedl_tpu.core.manager import Manager
+from kubedl_tpu.metrics.registry import Registry, TelemetryMetrics
+from kubedl_tpu.scheduling.gang import CoschedulerPlugin
+from kubedl_tpu.scheduling.inventory import SliceInventory
+from kubedl_tpu.scheduling.scheduler import SliceScheduler
+from kubedl_tpu.telemetry import (FleetTelemetry, GoodputAccountant,
+                                  JOB_SLOW_SLICE, REASON_SLOW_SLICE,
+                                  REASON_SLOW_SLICE_RESOLVED,
+                                  StragglerDetector, ThroughputProfileStore,
+                                  explain_pending, goodput_breakdown,
+                                  job_pool)
+from kubedl_tpu.utils import status as st
+from kubedl_tpu.utils.retry import RetryPolicy
+
+pytestmark = pytest.mark.telemetry
+
+POOL = "tpu-v5p-slice/2x2x4"
+
+
+def make_tracer(clock, capacity=8192):
+    return trace.Tracer(enabled=True, capacity=capacity, clock=clock)
+
+
+def tpu_job(name, queue=None, workers=4):
+    run_policy = ({"schedulingPolicy": {"queue": queue}} if queue else None)
+    return new_test_job(name, workers=workers, restart_policy="ExitCode",
+                        tpu_policy={"acceleratorType": "v5p-32"},
+                        run_policy=run_policy)
+
+
+# ---------------------------------------------------------------------------
+# goodput decomposition
+# ---------------------------------------------------------------------------
+
+
+def _fake_breakdown(tr, clock, ckpt_s=0.0):
+    """Record a full synthetic lifecycle into ``tr`` and return the
+    job's trace_breakdown: Created 2s, Queuing 10s, Admitted 1s,
+    PodsCreated 3s, Rendezvous 4s, Running 30s (minus ckpt), Succeeded."""
+    tid, root = trace.derive_context("gp-job")
+    t = clock()
+    plan = (("Created", 2.0), ("Queuing", 10.0), ("Admitted", 1.0),
+            ("PodsCreated", 3.0), ("Rendezvous", 4.0), ("Running", 30.0),
+            ("Succeeded", 0.0))
+    for phase, dur in plan:
+        tr.record(phase, t, t + dur, trace_id=tid, parent_id=root,
+                  component="lifecycle",
+                  attributes={"phase": phase, "job": "default/gp-job"})
+        t += dur
+    if ckpt_s:
+        tr.record("train.checkpoint", t - 20.0, t - 20.0 + ckpt_s,
+                  trace_id=tid, parent_id=root, component="train",
+                  attributes={"step": 5, "periodic": True})
+    tr.record("job default/gp-job", clock(), t, trace_id=tid,
+              span_id=root, component="lifecycle",
+              attributes={"terminal": "Succeeded"})
+    return trace.trace_breakdown(tr.spans(trace_id=tid), tid)
+
+
+def test_goodput_breakdown_categories_and_sum(clock):
+    tr = make_tracer(clock)
+    gp = goodput_breakdown(_fake_breakdown(tr, clock, ckpt_s=2.5))
+    ov = gp["overheadSeconds"]
+    assert ov["queue"] == pytest.approx(10.0)
+    assert ov["scheduling"] == pytest.approx(3.0)     # Created + Admitted
+    assert ov["podStart"] == pytest.approx(3.0)
+    assert ov["rendezvous"] == pytest.approx(4.0)
+    assert ov["restart"] == 0.0
+    # checkpoint time is carved out of Running, total preserved
+    assert ov["checkpoint"] == pytest.approx(2.5)
+    assert gp["productiveSeconds"] == pytest.approx(27.5)
+    assert gp["wallSeconds"] == pytest.approx(50.0)
+    assert gp["goodput"] == pytest.approx(27.5 / 50.0)
+    # the acceptance identity: components sum to wall-clock
+    total = gp["productiveSeconds"] + sum(ov.values())
+    assert total == pytest.approx(gp["wallSeconds"], rel=1e-9)
+
+
+def test_goodput_breakdown_none_without_phases():
+    assert goodput_breakdown({"byPhase": {}, "phases": []}) is None
+
+
+def test_goodput_accountant_rollup_and_metrics(clock):
+    reg = Registry()
+    acct = GoodputAccountant(metrics=TelemetryMetrics(reg))
+    tr = make_tracer(clock)
+    gp = acct.observe(_fake_breakdown(tr, clock))
+    assert gp["goodput"] == pytest.approx(0.6)
+    assert acct.jobs == 1
+    assert acct.fleet_goodput() == pytest.approx(0.6)
+    summ = acct.summary()
+    assert summ["jobsObserved"] == 1
+    assert summ["fleetGoodput"] == pytest.approx(0.6)
+    assert summ["wallSeconds"] == pytest.approx(50.0)
+    mt = acct.metrics
+    assert mt.jobs_observed.value() == 1
+    assert mt.fleet_goodput.value() == pytest.approx(0.6)
+    assert mt.goodput_seconds.value(category="productive") == \
+        pytest.approx(30.0)
+    assert mt.goodput_seconds.value(category="queue") == pytest.approx(10.0)
+
+
+# ---------------------------------------------------------------------------
+# throughput profiles
+# ---------------------------------------------------------------------------
+
+
+def test_profile_store_decayed_mean_math():
+    store = ThroughputProfileStore(halflife_s=100.0, clock=lambda: 0.0)
+    store.observe("llama", POOL, tokens=1000.0, seconds=1.0, now=0.0)
+    assert store.estimate("llama", POOL) == pytest.approx(1000.0)
+    # one half-life later the old estimate carries weight 0.5:
+    # rate = (1000 * 0.5 + 400) / 1.5
+    store.observe_rate("llama", POOL, 400.0, now=100.0)
+    assert store.estimate("llama", POOL) == pytest.approx(600.0)
+    # same-timestamp observations still update (sim-clock contract)
+    store.observe_rate("llama", POOL, 600.0, now=100.0)
+    assert store.estimate("llama", POOL) == pytest.approx(600.0)
+    assert store.estimate("llama", "other") is None
+    # zero/negative observations are ignored, not folded in
+    store.observe("llama", POOL, tokens=0.0, seconds=1.0, now=101.0)
+    store.observe("llama", POOL, tokens=10.0, seconds=0.0, now=101.0)
+    assert store.estimate("llama", POOL) == pytest.approx(600.0)
+
+
+def test_profile_normalization_is_gavel_currency():
+    store = ThroughputProfileStore(clock=lambda: 0.0)
+    store.observe_rate("llama", "tpu-v5p-slice/2x2x4", 800.0, now=0.0)
+    store.observe_rate("llama", "tpu-v5e-slice/4x4", 200.0, now=0.0)
+    norm = store.normalized("llama")
+    assert norm["tpu-v5p-slice/2x2x4"] == pytest.approx(1.0)
+    assert norm["tpu-v5e-slice/4x4"] == pytest.approx(0.25)
+    assert store.normalized("unknown") == {}
+
+
+def test_profile_persistence_roundtrip(api):
+    store = ThroughputProfileStore(clock=api.now)
+    store.observe_rate("TestJob", POOL, 1234.5, now=api.now())
+    store.observe_rate("TestJob", "tpu-v5e-slice/4x4", 99.0, now=api.now())
+    assert store.flush(api) == 1
+    objs = api.list(PROFILE_KIND)
+    assert len(objs) == 1
+    obj = objs[0]
+    assert m.name(obj) == profile_object_name("TestJob") == "testjob"
+    pools = obj["status"]["pools"]
+    assert pools[POOL]["tokensPerSecond"] == pytest.approx(1234.5)
+    assert pools[POOL]["samples"] == 1
+    # a fresh store (operator restart) reloads the persisted estimates
+    fresh = ThroughputProfileStore(clock=api.now)
+    assert fresh.load(api) == 1
+    assert fresh.estimate("TestJob", POOL) == pytest.approx(1234.5)
+    # re-flush updates in place (no AlreadyExists, no duplicate objects)
+    store.observe_rate("TestJob", POOL, 1000.0, now=api.now())
+    assert store.flush(api) == 1
+    assert len(api.list(PROFILE_KIND)) == 1
+
+
+def test_profile_object_name_sanitization():
+    # case-only normalization is lossless: no hash suffix
+    assert profile_object_name("TestJob") == "testjob"
+    # lossy sanitization appends a short hash so distinct keys can
+    # never collide on one persisted object
+    lossy = profile_object_name("Meta/Llama-3 70B")
+    assert lossy.startswith("meta-llama-3-70b-") and len(lossy) <= 63
+    assert profile_object_name("llama_3") != profile_object_name("llama-3")
+    assert profile_object_name("llama_3") != profile_object_name("llama.3")
+    assert profile_object_name("___").startswith("profile-")
+    assert len(profile_object_name("x" * 200)) <= 63
+    # deterministic
+    assert profile_object_name("Meta/Llama-3 70B") == lossy
+
+
+def test_job_pool_derivation():
+    job = tpu_job("p1")
+    assert job_pool(job) == POOL
+    assert job_pool(new_test_job("cpu", workers=1)) == ""
+    bad = new_test_job("bad", workers=1, tpu_policy={
+        "acceleratorType": "nonsense-999"})
+    assert job_pool(bad) == ""
+
+
+# ---------------------------------------------------------------------------
+# straggler / slow-slice detection
+# ---------------------------------------------------------------------------
+
+
+def _inject_steps(tr, tid, root, t0, per_replica: dict, tokens=512):
+    """per_replica: replica -> list of step durations, laid out serially."""
+    t = t0
+    for replica, durs in sorted(per_replica.items()):
+        for d in durs:
+            tr.record("train.step", t, t + d, trace_id=tid, parent_id=root,
+                      component="train",
+                      attributes={"step": 1, "tokens": tokens,
+                                  "replica": replica})
+            t += d
+
+
+def test_straggler_flags_once_and_clears(api, clock):
+    tr = make_tracer(clock)
+    api.create(tpu_job("skewed"))
+    job = api.get("TestJob", "default", "skewed")
+    tid, root = trace.job_trace_context(job)
+    # the job attribute (any span in the trace carries it) maps the
+    # trace back to the object the condition lands on
+    tr.record("Running", clock(), clock(), trace_id=tid, parent_id=root,
+              component="lifecycle",
+              attributes={"phase": "Running", "job": "default/skewed"})
+    det = StragglerDetector(api, tr, metrics=TelemetryMetrics(Registry()),
+                            job_kinds=("TestJob",), skew_factor=2.0,
+                            min_samples=4, window=8)
+    # replica 1 is 10x slower than the gang median
+    _inject_steps(tr, tid, root, clock(),
+                  {"0": [0.1] * 6, "1": [1.0] * 6, "2": [0.1] * 6})
+    verdicts = det.scan()
+    assert [v["verdict"] for v in verdicts] == ["SlowSlice"]
+    assert verdicts[0]["replica"] == "1"
+    job = api.get("TestJob", "default", "skewed")
+    slow = [cd for cd in job["status"]["conditions"]
+            if cd.get("type") == JOB_SLOW_SLICE]
+    assert len(slow) == 1 and slow[0]["status"] == "True"
+    events = [e for e in api.list("Event")
+              if e.get("reason") == REASON_SLOW_SLICE]
+    assert len(events) == 1
+    assert det.metrics.slow_slices.value(kind="TestJob") == 1
+    assert det.metrics.slow_slice_active.value() == 1
+
+    # skew persists: the second scan is idempotent — STILL exactly one
+    # condition and one Event
+    assert det.scan() == []
+    job = api.get("TestJob", "default", "skewed")
+    assert len([cd for cd in job["status"]["conditions"]
+                if cd.get("type") == JOB_SLOW_SLICE]) == 1
+    assert len([e for e in api.list("Event")
+                if e.get("reason") == REASON_SLOW_SLICE]) == 1
+    assert det.metrics.slow_slices.value(kind="TestJob") == 1
+
+    # the skew stops: fresh fast steps push the slow window out
+    _inject_steps(tr, tid, root, clock(), {"1": [0.1] * 8})
+    cleared = det.scan()
+    assert [v["verdict"] for v in cleared] == ["Resolved"]
+    job = api.get("TestJob", "default", "skewed")
+    slow = [cd for cd in job["status"]["conditions"]
+            if cd.get("type") == JOB_SLOW_SLICE]
+    assert len(slow) == 1 and slow[0]["status"] == "False"
+    assert any(e.get("reason") == REASON_SLOW_SLICE_RESOLVED
+               for e in api.list("Event"))
+    assert det.metrics.slow_slice_active.value() == 0
+
+
+def test_straggler_detects_in_two_replica_gang(api, clock):
+    """Review regression: a 2-slice gang's all-replica nearest-rank
+    median IS the slow replica's p50, so the old check could never fire;
+    the leave-one-out median must flag it."""
+    tr = make_tracer(clock)
+    api.create(tpu_job("pair"))
+    job = api.get("TestJob", "default", "pair")
+    tid, root = trace.job_trace_context(job)
+    tr.record("Running", clock(), clock(), trace_id=tid, parent_id=root,
+              component="lifecycle",
+              attributes={"phase": "Running", "job": "default/pair"})
+    det = StragglerDetector(api, tr, job_kinds=("TestJob",),
+                            skew_factor=2.0, min_samples=4, window=8)
+    _inject_steps(tr, tid, root, clock(),
+                  {"0": [0.1] * 6, "1": [1.0] * 6})
+    verdicts = det.scan()
+    assert [v["verdict"] for v in verdicts] == ["SlowSlice"]
+    assert verdicts[0]["replica"] == "1"
+    job = api.get("TestJob", "default", "pair")
+    assert any(cd.get("type") == JOB_SLOW_SLICE
+               and cd.get("status") == "True"
+               for cd in job["status"]["conditions"])
+    # and the fast replica is never the one flagged
+    assert not any(v.get("replica") == "0" for v in verdicts)
+
+
+def test_straggler_clears_when_evidence_degrades(api, clock):
+    """Review regression: a flagged trace whose ready-replica count
+    drops below 2 (ring eviction squeezed one replica's samples out)
+    must clear the SlowSlice flag, not carry it forever."""
+    tr = make_tracer(clock, capacity=16)
+    api.create(tpu_job("fading"))
+    job = api.get("TestJob", "default", "fading")
+    tid, root = trace.job_trace_context(job)
+    det = StragglerDetector(api, tr, job_kinds=("TestJob",),
+                            min_samples=4, window=8)
+    tr.record("Running", clock(), clock(), trace_id=tid, parent_id=root,
+              component="lifecycle",
+              attributes={"phase": "Running", "job": "default/fading"})
+    _inject_steps(tr, tid, root, clock(),
+                  {"0": [0.1] * 5, "1": [1.0] * 5})
+    assert [v["verdict"] for v in det.scan()] == ["SlowSlice"]
+    # 16 fresh fast steps for replica 0 wrap the ring: replica 1's
+    # samples are evicted, only one ready replica remains
+    _inject_steps(tr, tid, root, clock(), {"0": [0.1] * 16})
+    assert [v["verdict"] for v in det.scan()] == ["Resolved"]
+    job = api.get("TestJob", "default", "fading")
+    slow = [cd for cd in job["status"]["conditions"]
+            if cd.get("type") == JOB_SLOW_SLICE]
+    assert slow and slow[0]["status"] == "False"
+
+
+def test_straggler_needs_samples_and_second_replica(api, clock):
+    tr = make_tracer(clock)
+    tid, root = trace.derive_context("lonely")
+    det = StragglerDetector(api, tr, job_kinds=("TestJob",), min_samples=4)
+    _inject_steps(tr, tid, root, clock(), {"0": [1.0] * 6})   # one replica
+    assert det.scan() == []
+    _inject_steps(tr, tid, root, clock(), {"1": [0.1] * 2})   # too few
+    assert det.scan() == []
+
+
+def test_telemetry_maybe_scan_rate_limits(api, clock):
+    tr = make_tracer(clock)
+    tel = FleetTelemetry(api, tr, job_kinds=("TestJob",),
+                         scan_interval_s=30.0)
+    assert tel.maybe_scan(clock()) == []        # first scan runs (empty)
+    assert tel.maybe_scan(clock()) is None      # rate-limited
+    clock.advance(31.0)
+    assert tel.maybe_scan(clock()) == []        # window reopened
+
+
+# ---------------------------------------------------------------------------
+# pending-job explainer
+# ---------------------------------------------------------------------------
+
+
+def _make_pg(api, job, queue, *, num_slices=1, index=0, priority=0,
+             pool=POOL):
+    name = job if num_slices == 1 else f"{job}-{index}"
+    pg = m.new_obj("scheduling.sigs.k8s.io/v1alpha1", "PodGroup", name,
+                   "default", labels={c.LABEL_GANG_JOB_NAME: job},
+                   annotations={c.ANNOTATION_SCHED_POOL: pool,
+                                c.ANNOTATION_SCHED_QUEUE: queue,
+                                c.ANNOTATION_SCHED_NUM_SLICES:
+                                    str(num_slices),
+                                c.ANNOTATION_SCHED_PRIORITY: str(priority)})
+    pg["spec"] = {"minMember": 4}
+    api.create(pg)
+    return pg
+
+
+def _scheduler(api, capacity=2, queues=()):
+    for q in queues:
+        api.create(new_queue(**q))
+    inv = SliceInventory(api, static_capacity={POOL: capacity})
+    return SliceScheduler(api, inventory=inv,
+                          retry_policy=RetryPolicy(attempts=3, base=0.0,
+                                                   cap=0.0),
+                          retry_sleep=lambda s: None)
+
+
+def test_explainer_admissible_admitted_and_unknown(api, clock):
+    sched = _scheduler(api, capacity=2)
+    _make_pg(api, "j1", "default")
+    v = explain_pending(sched, "default", "j1")
+    assert v["verdict"] == "Admissible"
+    sched.schedule_pass()
+    v = explain_pending(sched, "default", "j1")
+    assert v["verdict"] == "Admitted" and v["heldSlices"] == 1
+    assert explain_pending(sched, "default", "nope") is None
+
+
+def test_explainer_quota_ceiling(api, clock):
+    sched = _scheduler(api, capacity=4,
+                       queues=[{"name": "best", "max": 1}])
+    _make_pg(api, "a", "best")
+    sched.schedule_pass()
+    _make_pg(api, "b", "best")
+    v = explain_pending(sched, "default", "b")
+    assert v["verdict"] == "QuotaCeiling"
+    assert v["blockingQueue"] == "best"
+    assert v["quotaMax"] == 1 and v["heldSlices"] == 1
+    # strict FIFO: a gang BEHIND the ceiling-blocked head reads the same
+    _make_pg(api, "b2", "best")
+    v2 = explain_pending(sched, "default", "b2")
+    assert v2["verdict"] == "QuotaCeiling"
+
+
+def test_explainer_pool_capacity_names_blocking_queue(api, clock):
+    sched = _scheduler(api, capacity=2, queues=[
+        {"name": "prod", "min": 2, "priority": 100},
+        {"name": "best", "max": 4}])
+    _make_pg(api, "hog", "best", num_slices=2, index=0)
+    _make_pg(api, "hog", "best", num_slices=2, index=1)
+    sched.schedule_pass()
+    _make_pg(api, "want", "prod")
+    v = explain_pending(sched, "default", "want")
+    assert v["verdict"] == "PoolCapacity"
+    assert v["blockingPool"] == POOL
+    assert v["blockingQueue"] == "best"
+    assert v["holders"] == {"best": 2}
+    assert v["reclaimEligible"] is True      # prod is under its min
+    assert v["freeSlices"] == 0
+
+
+def test_explainer_backfill_reservation(api, clock):
+    sched = _scheduler(api, capacity=2, queues=[{"name": "q1"}])
+    # one slice held by default queue, one free
+    _make_pg(api, "other", "default")
+    sched.schedule_pass()
+    # head H wants 2 (blocked, reserves the free slice); S wants 1 behind
+    _make_pg(api, "h", "q1", num_slices=2, index=0)
+    _make_pg(api, "h", "q1", num_slices=2, index=1)
+    _make_pg(api, "s", "q1")
+    v = explain_pending(sched, "default", "s")
+    assert v["verdict"] == "BackfillReservation"
+    assert v["blockingQueue"] == "q1"
+    assert v["blockingJob"] == "default/h"
+    assert v["reservedSlices"] == 1
+    # the head itself is plain pool capacity
+    vh = explain_pending(sched, "default", "h")
+    assert vh["verdict"] == "PoolCapacity"
+
+
+def test_explainer_skips_infeasible_gang_like_the_scheduler(api, clock):
+    """Review regression: the real pass skips infeasible gangs
+    (`continue` at scheduler._schedule_queue); the simulation must too,
+    or an infeasible head fabricates a reservation that wrongly blocks
+    everything behind it."""
+    sched = _scheduler(api, capacity=2)
+    for i in range(5):
+        _make_pg(api, "whale", "default", num_slices=5, index=i)
+    clock.advance(1.0)               # whale is the older (head) gang
+    _make_pg(api, "minnow", "default")
+    v = explain_pending(sched, "default", "minnow")
+    assert v["verdict"] == "Admissible", v
+    assert explain_pending(sched, "default",
+                           "whale")["verdict"] == "GangInfeasible"
+
+
+def test_explainer_quota_outranks_infeasibility_like_the_scheduler(
+        api, clock):
+    """Review regression: the real pass checks the quota ceiling BEFORE
+    gang feasibility (scheduler._schedule_queue), so an infeasible head
+    that also trips the ceiling blocks its whole queue forever — the
+    explainer must answer QuotaCeiling, not Admissible."""
+    sched = _scheduler(api, capacity=4, queues=[{"name": "q", "max": 4}])
+    for i in range(6):
+        _make_pg(api, "whale", "q", num_slices=6, index=i)
+    clock.advance(1.0)
+    _make_pg(api, "minnow", "q", num_slices=2, index=0)
+    _make_pg(api, "minnow", "q", num_slices=2, index=1)
+    v = explain_pending(sched, "default", "minnow")
+    assert v["verdict"] == "QuotaCeiling", v
+    assert v["headJob"] == "default/whale"
+
+
+def test_explainer_survives_unknown_pool_gang_ahead(api, clock):
+    """Review regression: a non-target gang on a pool the inventory
+    doesn't know (free_slices None = unlimited) simulates as admitted;
+    the free-slice debit must not TypeError on None."""
+    sched = _scheduler(api, capacity=2)
+    _make_pg(api, "ghost", "default", pool="mystery-accel/9x9")
+    clock.advance(1.0)
+    _make_pg(api, "real", "default")
+    v = explain_pending(sched, "default", "real")
+    assert v["verdict"] == "Admissible", v
+
+
+def test_explainer_infeasible_and_incomplete(api, clock):
+    sched = _scheduler(api, capacity=2)
+    for i in range(3):
+        _make_pg(api, "big", "default", num_slices=3, index=i)
+    v = explain_pending(sched, "default", "big")
+    assert v["verdict"] == "GangInfeasible"
+    assert v["poolCapacity"] == 2 and v["demandSlices"] == 3
+    _make_pg(api, "half", "default", num_slices=2, index=0)
+    v = explain_pending(sched, "default", "half")
+    assert v["verdict"] == "GangIncomplete"
+    assert v["wantSlices"] == 2 and v["demandSlices"] == 1
+
+
+# ---------------------------------------------------------------------------
+# console surface
+# ---------------------------------------------------------------------------
+
+
+def _console(proxy):
+    return ConsoleServer(proxy, ConsoleConfig(host="127.0.0.1", port=0,
+                                              users={}))
+
+
+def _route(server, method, path, params=None):
+    status, payload, _ = server.route(method, path, params or {}, b"", None)
+    return status, payload
+
+
+def test_console_explain_501_without_scheduler(api):
+    server = _console(DataProxy(api, None, None, job_kinds=("TestJob",)))
+    try:
+        status, payload = _route(server, "GET",
+                                 "/api/v1/explain/default/j1")
+        assert status == 501
+        assert "scheduler" in payload["msg"]
+    finally:
+        server._httpd.server_close()
+
+
+def test_console_explain_endpoint_verdicts(api, clock):
+    sched = _scheduler(api, capacity=1, queues=[
+        {"name": "prod", "min": 1, "priority": 100}])
+    _make_pg(api, "holder", "prod")
+    sched.schedule_pass()
+    _make_pg(api, "waiter", "default")
+    api.create(tpu_job("loose"))            # a job the scheduler never saw
+    proxy = DataProxy(api, None, None, job_kinds=("TestJob",),
+                      scheduler=sched)
+    server = _console(proxy)
+    try:
+        status, payload = _route(server, "GET",
+                                 "/api/v1/explain/default/waiter")
+        assert status == 200
+        assert payload["data"]["verdict"] == "PoolCapacity"
+        assert payload["data"]["blockingQueue"] == "prod"
+        status, payload = _route(server, "GET",
+                                 "/api/v1/explain/default/loose")
+        assert status == 200
+        assert payload["data"]["verdict"] == "NotQueued"
+        status, _ = _route(server, "GET", "/api/v1/explain/default/ghost")
+        assert status == 404
+    finally:
+        server._httpd.server_close()
+
+
+def test_job_detail_goodput_field_gated(api, clock):
+    tr = make_tracer(clock)
+    # a kind the console's KIND_TABLE knows (same convention as the
+    # trace suite's job-detail test)
+    api.create(m.new_obj("training.kubedl.io/v1alpha1", "PyTorchJob", "gp",
+                         "default", spec={"pytorchReplicaSpecs": {}}))
+    job = api.get("PyTorchJob", "default", "gp")
+    tid, root = trace.job_trace_context(job)
+    tr.record("Running", clock(), clock() + 5.0, trace_id=tid,
+              parent_id=root, component="lifecycle",
+              attributes={"phase": "Running", "job": "default/gp"})
+    tel = FleetTelemetry(api, tr, job_kinds=("PyTorchJob",))
+    on = _console(DataProxy(api, None, None, tracer=tr, telemetry=tel))
+    off = _console(DataProxy(api, None, None, tracer=tr))
+    try:
+        _, payload = _route(on, "GET", "/api/v1/job/detail",
+                            {"kind": "PyTorchJob", "name": "gp"})
+        gp = payload["data"]["goodput"]
+        assert gp["goodput"] == pytest.approx(1.0)
+        assert gp["wallSeconds"] == pytest.approx(5.0)
+        # telemetry off: the key is ABSENT, not null — byte-identical
+        # disabled responses
+        _, payload = _route(off, "GET", "/api/v1/job/detail",
+                            {"kind": "PyTorchJob", "name": "gp"})
+        assert "goodput" not in payload["data"]
+    finally:
+        on._httpd.server_close()
+        off._httpd.server_close()
+
+
+def test_operator_gate_wiring():
+    op = build_operator(APIServer(), OperatorConfig(workloads=[]))
+    assert op.telemetry is None
+    gates = ft.FeatureGates()
+    gates.set(ft.FLEET_TELEMETRY, True)
+    op2 = build_operator(APIServer(), OperatorConfig(workloads=[],
+                                                     feature_gates=gates))
+    assert op2.telemetry is not None
+    # telemetry implies the tracer (it distills trace spans)
+    assert op2.tracer.enabled
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance e2e
+# ---------------------------------------------------------------------------
+
+
+def _telemetry_stack(api, clock, capacity):
+    tr = make_tracer(clock)
+    tel = FleetTelemetry(api, tr, metrics=TelemetryMetrics(Registry()),
+                         job_kinds=("TestJob",))
+    manager = Manager(api, clock=clock)
+    engine = JobEngine(
+        api, TestJobController(),
+        EngineConfig(enable_gang_scheduling=True,
+                     gate_on_gang_admission=True,
+                     retry_policy=RetryPolicy(attempts=4, base=0.01,
+                                              cap=0.05),
+                     retry_sleep=clock.advance,
+                     backoff_jitter_seed=1),
+        gang=CoschedulerPlugin(api), tracer=tr, telemetry=tel)
+    manager.register(engine)
+    inv = SliceInventory(api, static_capacity=capacity)
+    sched = SliceScheduler(api, inventory=inv, tracer=tr,
+                           retry_policy=RetryPolicy(attempts=4, base=0.01,
+                                                    cap=0.05),
+                           retry_sleep=clock.advance)
+    manager.register(sched)
+    return tr, tel, manager, engine, sched
+
+
+def _succeed_running_pods(api, chaos, manager):
+    for pod in api.list("Pod"):
+        if m.get_in(pod, "status", "phase") == "Running":
+            set_pod_phase(chaos, pod, "Succeeded", exit_code=0)
+    manager.run_until_idle(max_iterations=2500)
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("seed", [1, 2])
+def test_e2e_goodput_and_explainer_under_chaos(clock, seed):
+    """Acceptance: a job that is queued, admitted, preempted, re-admitted
+    and succeeds yields a goodput decomposition whose components sum to
+    its trace wall-clock within 1%, and the explainer names the correct
+    blocking queue at BOTH pending stages — all under seeded api chaos."""
+    inner = APIServer(clock=clock)
+    chaos = ChaosAPIServer(inner, ChaosConfig(
+        seed=seed, conflict_on_status_update=0.15, error_on_create=0.1,
+        max_faults=12))
+    tr, tel, manager, engine, sched = _telemetry_stack(chaos, clock,
+                                                       {POOL: 1})
+    inner.create(new_queue("prod", min=1, priority=100))
+    inner.create(new_queue("best", min=0, priority=0))
+
+    # stage 0: prod's holder owns the only slice
+    inner.create(tpu_job("holder", "prod"))
+    manager.run_until_idle(max_iterations=800)
+    clock.advance(3.0)
+    run_all_pods(chaos)
+    manager.run_until_idle(max_iterations=800)
+
+    # stage 1: borrower pends on pool capacity — the explainer must name
+    # prod as the blocking queue
+    inner.create(tpu_job("borrower", "best"))
+    manager.run_until_idle(max_iterations=800)
+    borrower = inner.get("TestJob", "default", "borrower")
+    assert st.is_queuing(c.JobStatus.from_dict(borrower.get("status")))
+    v1 = explain_pending(sched, "default", "borrower")
+    assert v1["verdict"] == "PoolCapacity", (seed, v1)
+    assert v1["blockingQueue"] == "prod"
+    assert v1["holders"] == {"prod": 1}
+
+    # holder finishes -> borrower admits and runs
+    clock.advance(4.0)
+    for pod in inner.list("Pod"):
+        set_pod_phase(chaos, pod, "Succeeded", exit_code=0)
+    manager.run_until_idle(max_iterations=2500)
+    clock.advance(2.0)
+    run_all_pods(chaos)
+    manager.run_until_idle(max_iterations=800)
+    clock.advance(5.0)
+
+    # inject trainer step spans so profiles have a throughput signal
+    btid, broot = trace.job_trace_context(
+        inner.get("TestJob", "default", "borrower"))
+    _inject_steps(tr, btid, broot, clock(),
+                  {"0": [0.5] * 3, "1": [0.5] * 3}, tokens=2048)
+
+    # stage 2: guaranteed prod job arrives under min -> borrower is
+    # preempted slice-atomically and re-enters its queue
+    inner.create(tpu_job("guaranteed", "prod"))
+    manager.run_until_idle(max_iterations=2500)
+    clock.advance(4.0)
+    run_all_pods(chaos)
+    manager.run_until_idle(max_iterations=800)
+    borrower = inner.get("TestJob", "default", "borrower")
+    assert st.is_queuing(c.JobStatus.from_dict(borrower.get("status"))), \
+        seed
+    v2 = explain_pending(sched, "default", "borrower")
+    assert v2["verdict"] in ("PoolCapacity", "ReclaimEarmarked"), (seed, v2)
+    assert v2["blockingQueue"] == "prod", (seed, v2)
+
+    # guaranteed finishes -> borrower re-admits and completes
+    clock.advance(3.0)
+    _succeed_running_pods(inner, chaos, manager)
+    clock.advance(2.0)
+    run_all_pods(chaos)
+    manager.run_until_idle(max_iterations=800)
+    clock.advance(3.0)
+    _succeed_running_pods(inner, chaos, manager)
+
+    for name in ("holder", "borrower", "guaranteed"):
+        job = inner.get("TestJob", "default", name)
+        assert st.is_succeeded(c.JobStatus.from_dict(job.get("status"))), \
+            (name, seed)
+
+    # goodput harvested at terminal: the borrower's decomposition
+    # components sum to its trace wall-clock within 1%
+    spans = tr.spans(trace_id=btid)
+    bd = trace.trace_breakdown(spans, btid)
+    gp = goodput_breakdown(bd)
+    parts = gp["productiveSeconds"] + sum(gp["overheadSeconds"].values())
+    assert parts == pytest.approx(gp["wallSeconds"], rel=1e-9)
+    assert abs(gp["wallSeconds"] - bd["totalSeconds"]) \
+        <= 0.01 * bd["totalSeconds"], (seed, gp, bd["totalSeconds"])
+    assert gp["overheadSeconds"]["queue"] > 0      # both queue stints
+    assert gp["overheadSeconds"]["restart"] > 0    # the preemption round
+    assert gp["restartRounds"] >= 1
+    assert 0 < gp["goodput"] < 1
+    # the fleet accountant saw all three retirements
+    assert tel.goodput.jobs == 3
+    assert 0 < tel.goodput.fleet_goodput() < 1
+    # and the step spans became a persisted ThroughputProfile for the pool
+    profiles = inner.list(PROFILE_KIND)
+    assert len(profiles) == 1
+    pools = profiles[0]["status"]["pools"]
+    assert pools[POOL]["tokensPerSecond"] == pytest.approx(4096.0)
+    assert pools[POOL]["samples"] == 6
+    sched.check_parity()
+
+
+# ---------------------------------------------------------------------------
+# disabled path: byte-identical behavior
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_path_leaves_no_artifacts(api, manager, clock):
+    """Gate off (the default): no telemetry object, no ThroughputProfile
+    writes, no SlowSlice conditions, no goodput key in job detail, 501
+    from the explain endpoint — and the NOOP tracer stays empty."""
+    engine = JobEngine(
+        api, TestJobController(),
+        EngineConfig(enable_gang_scheduling=True,
+                     retry_policy=RetryPolicy(attempts=4, base=0.01,
+                                              cap=0.05),
+                     retry_sleep=clock.advance,
+                     backoff_jitter_seed=1),
+        gang=CoschedulerPlugin(api))
+    assert engine.telemetry is None
+    manager.register(engine)
+    api.create(tpu_job("plain"))
+    manager.run_until_idle(max_iterations=500)
+    run_all_pods(api)
+    manager.run_until_idle(max_iterations=500)
+    for pod in api.list("Pod"):
+        set_pod_phase(api, pod, "Succeeded", exit_code=0)
+    manager.run_until_idle(max_iterations=500)
+    job = api.get("TestJob", "default", "plain")
+    assert st.is_succeeded(c.JobStatus.from_dict(job.get("status")))
+    assert api.list(PROFILE_KIND) == []
+    assert not any(cd.get("type") == JOB_SLOW_SLICE
+                   for cd in job["status"]["conditions"])
+    assert trace.NOOP_TRACER.spans() == []
+    # console detail uses a KIND_TABLE kind; the gate-off contract is
+    # the same regardless of kind
+    api.create(m.new_obj("training.kubedl.io/v1alpha1", "PyTorchJob",
+                         "plain", "default",
+                         spec={"pytorchReplicaSpecs": {}}))
+    server = _console(DataProxy(api, None, None))
+    try:
+        _, payload = _route(server, "GET", "/api/v1/job/detail",
+                            {"kind": "PyTorchJob", "name": "plain"})
+        assert "goodput" not in payload["data"]
+        status, _ = _route(server, "GET", "/api/v1/explain/default/plain")
+        assert status == 501
+    finally:
+        server._httpd.server_close()

@@ -250,6 +250,71 @@ def test_assert_well_formed_rejects_out_of_order(clock):
         trace.assert_well_formed(tr.spans(trace_id=tid))
 
 
+def test_breakdown_filters_interleaved_concurrent_jobs(clock):
+    """Two concurrent jobs share ONE recorder ring, their spans
+    interleaved in arrival order. trace_breakdown must scope every field
+    — phases, byPhase, events, orphans, spanCount — to a single trace,
+    including when the trace id is inferred rather than given (the
+    telemetry goodput math reads byPhase and a cross-job leak would
+    silently corrupt it)."""
+    tr = make_tracer(clock)
+    tid_a, root_a = trace.derive_context("job-a")
+    tid_b, root_b = trace.derive_context("job-b")
+    # interleave: a.Queuing, b.Queuing, a.Running, b.scheduler event,
+    # b.Running, a.scheduler event — one shared ring, arrival order
+    tr.record("Queuing", 0.0, 4.0, trace_id=tid_a, parent_id=root_a,
+              component="lifecycle", attributes={"phase": "Queuing"})
+    tr.record("Queuing", 1.0, 11.0, trace_id=tid_b, parent_id=root_b,
+              component="lifecycle", attributes={"phase": "Queuing"})
+    tr.record("Running", 4.0, 10.0, trace_id=tid_a, parent_id=root_a,
+              component="lifecycle", attributes={"phase": "Running"})
+    tr.record("scheduler.queue-wait", 1.0, 11.0, trace_id=tid_b,
+              parent_id=root_b, component="scheduler")
+    tr.record("Running", 11.0, 14.0, trace_id=tid_b, parent_id=root_b,
+              component="lifecycle", attributes={"phase": "Running"})
+    tr.record("scheduler.queue-wait", 0.0, 4.0, trace_id=tid_a,
+              parent_id=root_a, component="scheduler")
+    everything = tr.spans()                # BOTH jobs, interleaved
+    assert len(everything) == 6
+
+    bd_a = trace.trace_breakdown(everything, tid_a)
+    assert bd_a["traceId"] == tid_a and bd_a["spanCount"] == 3
+    assert bd_a["byPhase"] == {"Queuing": 4.0, "Running": 6.0}
+    assert [e["traceId"] for e in bd_a["events"]] == [tid_a]
+    assert bd_a["orphans"] == []           # implicit-root exemption holds
+    bd_b = trace.trace_breakdown(everything, tid_b)
+    assert bd_b["byPhase"] == {"Queuing": 10.0, "Running": 3.0}
+    assert bd_b["spanCount"] == 3
+    # trace id INFERRED from the first span: still filters to one trace
+    # instead of folding job b's phases into job a's byPhase
+    bd_inferred = trace.trace_breakdown(everything)
+    assert bd_inferred["traceId"] == tid_a
+    assert bd_inferred["byPhase"] == bd_a["byPhase"]
+    assert bd_inferred["spanCount"] == 3
+
+
+def test_train_step_attrs_survive_export(clock):
+    """Satellite contract: the trainer's train.step spans carry tokens +
+    replica, and both exporters preserve them (the telemetry layer's
+    profiles and straggler detection read these attributes downstream
+    of export pipelines)."""
+    tr = make_tracer(clock)
+    tid, root = trace.derive_context("uid-t")
+    tr.record("train.step", 1.0, 1.5, trace_id=tid, parent_id=root,
+              component="train",
+              attributes={"step": 7, "tokens": 4096, "replica": "3"})
+    doc = json.loads(trace.chrome_trace_json(tr.spans()))
+    ev = next(e for e in doc["traceEvents"]
+              if e.get("ph") == "X" and e["name"] == "train.step")
+    assert ev["args"]["tokens"] == 4096
+    assert ev["args"]["replica"] == "3"
+    otlp = json.loads(json.dumps(trace.to_otlp_json(tr.spans())))
+    span = otlp["resourceSpans"][0]["scopeSpans"][0]["spans"][0]
+    attrs = {a["key"]: a["value"] for a in span["attributes"]}
+    assert attrs["tokens"] == {"intValue": "4096"}
+    assert attrs["replica"] == {"stringValue": "3"}
+
+
 def test_chrome_export_roundtrips_and_orders(clock):
     tr = make_tracer(clock)
     tid = _fake_job_trace(tr)
@@ -821,6 +886,12 @@ def test_trainer_step_and_checkpoint_spans(tmp_path, monkeypatch):
         assert s.trace_id == tid and s.parent_id == root
     assert [s.attributes["step"] for s in steps
             if s.name == "train.step"] == [1, 2]
+    # throughput-derivable payload (docs/telemetry.md): every step span
+    # carries the batch's token count and the replica identity
+    for s in steps:
+        if s.name == "train.step":
+            assert s.attributes["tokens"] == 8 * 64
+            assert "replica" in s.attributes
 
 
 def test_job_queue_wait_adds_live_stint_to_closed_spans(api, clock):
