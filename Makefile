@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test test-all test-fast test-chaos test-scheduler test-trace test-replay test-telemetry bench bench-controlplane bench-scheduler bench-serving-paged bench-trace bench-cluster dryrun crds run-standalone lint native
+.PHONY: test test-all test-fast test-chaos test-scheduler test-trace test-replay test-telemetry test-slo bench bench-controlplane bench-scheduler bench-serving-paged bench-trace bench-cluster dryrun crds run-standalone lint native
 
 # fast path (<3 min): everything except the compile-heavy compute suites
 # (those carry `pytestmark = pytest.mark.slow`). Chaos tests are fast and
@@ -72,6 +72,11 @@ test-replay:
 # docs/telemetry.md)
 test-telemetry:
 	$(PY) -m pytest tests/ -q -m telemetry
+
+# SLO engine suite (objective grammar, error budgets, multi-window
+# burn-rate alerting, console endpoints; docs/slo.md)
+test-slo:
+	$(PY) -m pytest tests/ -q -m slo
 
 # THE fleet scorecard: a production-shaped day (thousands of jobs, tens
 # of thousands of serving requests, chaos faults) through the real

@@ -64,7 +64,8 @@ def main() -> dict:
                    "requests_unfinished": 0, "errors": 0,
                    "resumed_admissions": 0, "shared_prefix_admissions": 0,
                    "tokens_generated": 0, "engine_ticks": 0,
-                   "sim_span_s": 0.0, "queue_waits_s": [], "ttfts_s": [],
+                   "sim_span_s": 0.0, "slo": {},
+                   "queue_waits_s": [], "ttfts_s": [],
                    "kv": {}}
     else:
         serving = ServingReplay(workload).run()

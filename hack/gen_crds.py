@@ -241,6 +241,45 @@ def main() -> None:
     path = OUT / "telemetry.kubedl.io_throughputprofiles.yaml"
     path.write_text(yaml.safe_dump(profile_doc, sort_keys=False))
     written.append(path.name)
+    # SLO engine: cluster-scoped objectives over fleet signals with
+    # error budgets and burn-rate alerting (docs/slo.md)
+    slo_doc = crd("slo.kubedl.io", "SLO", "slos",
+                  generic_schema({
+                      "type": "object",
+                      "required": ["signal", "objective"],
+                      "properties": {
+                          "signal": {
+                              "type": "string",
+                              "description": "signal grammar (docs/"
+                                             "slo.md): <base>_pNN, "
+                                             "fleet_goodput, or "
+                                             "metric:<family>[:pNN]"},
+                          "objective": {"type": "object", "properties": {
+                              "target": {"type": "number"},
+                              "goal": {"type": "number",
+                                       "exclusiveMinimum": 0,
+                                       "exclusiveMaximum": 1},
+                              "comparator": {"type": "string",
+                                             "enum": ["lte", "gte"]},
+                              "quantile": {"type": "number"},
+                          }},
+                          "windowSeconds": {"type": "number",
+                                            "exclusiveMinimum": 0},
+                          "selector": {
+                              "type": "object",
+                              "additionalProperties": {"type": "string"}},
+                          "alerting": {"type": "array", "items": {
+                              "type": "object", "properties": {
+                                  "severity": {"type": "string"},
+                                  "shortSeconds": {"type": "number"},
+                                  "longSeconds": {"type": "number"},
+                                  "burn": {"type": "number"},
+                              }}},
+                      }}),
+                  scope="Cluster")
+    path = OUT / "slo.kubedl.io_slos.yaml"
+    path.write_text(yaml.safe_dump(slo_doc, sort_keys=False))
+    written.append(path.name)
     print(f"wrote {len(written)} CRDs to {OUT}")
 
 

@@ -45,6 +45,11 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "detection, /api/v1/explain endpoint "
                         "(docs/telemetry.md; also FleetTelemetry gate; "
                         "implies tracing)")
+    p.add_argument("--enable-slo", action="store_true",
+                   help="SLO engine: objective CRD, error budgets, "
+                        "multi-window burn-rate alerting, console "
+                        "/api/v1/slo endpoints (docs/slo.md; also "
+                        "SLOEngine gate; implies telemetry + tracing)")
     p.add_argument("--slice-capacity", default="",
                    help='static slice inventory "POOL=N,..." (e.g. '
                         '"tpu-v5p-slice/2x2x4=4") when the control plane '
@@ -121,6 +126,7 @@ def config_from_args(args: argparse.Namespace) -> OperatorConfig:
         enable_tracing=args.enable_tracing,
         trace_buffer=args.trace_buffer,
         enable_telemetry=args.enable_telemetry,
+        enable_slo=args.enable_slo,
     )
 
 

@@ -61,6 +61,9 @@ KIND_TABLE = {
     "ThroughputProfile": ResourceInfo(
         "ThroughputProfile", "telemetry.kubedl.io/v1alpha1",
         "throughputprofiles", namespaced=False),
+    # SLO engine: declared objectives over fleet signals (docs/slo.md)
+    "SLO": ResourceInfo("SLO", "slo.kubedl.io/v1alpha1", "slos",
+                        namespaced=False),
 }
 
 TRAINING_KINDS = tuple(k for k, v in KIND_TABLE.items()
@@ -175,6 +178,7 @@ class Clientset:
                 "apps": "k8s_apps",
                 "networking.k8s.io": "networking",
                 "scheduling.sigs.k8s.io": "scheduling",
+                "slo.kubedl.io": "slo",
             }.get(group, group.replace(".", "_"))
             by_group.setdefault(alias, []).append(kind)
         for alias, kinds in by_group.items():

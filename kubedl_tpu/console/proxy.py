@@ -463,6 +463,27 @@ class DataProxy:
     def telemetry_enabled(self) -> bool:
         return self.telemetry is not None
 
+    def fleet_goodput(self) -> dict:
+        """The GoodputAccountant's fleet rollup — the number
+        BENCH_CLUSTER gates on, served live (docs/telemetry.md)."""
+        return self.telemetry.goodput.summary()
+
+    # -- SLO engine (docs/slo.md) -----------------------------------------
+
+    @property
+    def slo_enabled(self) -> bool:
+        return (self.telemetry is not None
+                and getattr(self.telemetry, "slo", None) is not None)
+
+    def slo_list(self) -> list:
+        """Every objective's live status (windows, budget, burn rates,
+        alert state), name-sorted; invalid SLO objects appear with their
+        parse error."""
+        return self.telemetry.slo.statuses()
+
+    def slo_status(self, name: str) -> Optional[dict]:
+        return self.telemetry.slo.status(name)
+
     def job_goodput(self, job: dict) -> Optional[dict]:
         """Per-job goodput decomposition for the job-detail view, from
         the job's trace (live jobs show the decomposition so far). None

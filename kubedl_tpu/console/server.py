@@ -484,6 +484,32 @@ class ConsoleServer:
                 raise NotFound(f"job {ns}/{name} not found")
             return ok(verdict)
 
+        # fleet goodput rollup (docs/telemetry.md): the live fleet-wide
+        # number BENCH_CLUSTER gates on; 501 with the telemetry gate off
+        if path == "/api/v1/telemetry/goodput":
+            if not self.proxy.telemetry_enabled:
+                return 501, {"code": 501,
+                             "msg": "telemetry disabled "
+                                    "(--enable-telemetry / "
+                                    "FleetTelemetry gate)"}, []
+            return ok(self.proxy.fleet_goodput())
+
+        # SLO engine (docs/slo.md): objective statuses with error budget
+        # and burn-rate verdicts; 501 when the SLOEngine gate is off
+        if path.startswith("/api/v1/slo/"):
+            if not self.proxy.slo_enabled:
+                return 501, {"code": 501,
+                             "msg": "SLO engine disabled (--enable-slo / "
+                                    "SLOEngine gate)"}, []
+            if path == "/api/v1/slo/list":
+                return ok(self.proxy.slo_list())
+            mt = re.fullmatch(r"/api/v1/slo/status/([^/]+)", path)
+            if mt:
+                status = self.proxy.slo_status(unquote(mt.group(1)))
+                if status is None:
+                    raise NotFound(f"SLO {mt.group(1)} not found")
+                return ok(status)
+
         # slice-scheduler queues: quota + live usage (docs/scheduling.md)
         if path == "/api/v1/queue/list":
             return ok(self.proxy.list_queues())

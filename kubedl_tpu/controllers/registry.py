@@ -89,6 +89,14 @@ class OperatorConfig:
     #: default: no telemetry object exists, no ThroughputProfile writes,
     #: console explain answers 501.
     enable_telemetry: bool = False
+    #: SLO engine (docs/slo.md): cluster-scoped SLO objects, error
+    #: budgets, multi-window multi-burn-rate alerting, console
+    #: /api/v1/slo endpoints. Also switchable via the SLOEngine gate;
+    #: either turns it on (and with it telemetry + tracing — the
+    #: evaluator samples the signals those layers produce). Off by
+    #: default: no evaluator exists, no kubedl_slo_* metric families
+    #: register, the slo endpoints answer 501.
+    enable_slo: bool = False
 
 
 @dataclass
@@ -138,8 +146,12 @@ def build_operator(api: Optional[APIServer] = None,
     # zeroes when off); the tracer only feeds them while enabled.
     from ..metrics.registry import TraceMetrics
     from ..trace import Tracer
+    slo_enabled = config.enable_slo or gates.enabled(ft.SLO_ENGINE)
+    # the SLO engine judges telemetry signals, so enabling it implies
+    # the telemetry layer (which in turn implies the tracer)
     telemetry_enabled = (config.enable_telemetry
-                         or gates.enabled(ft.FLEET_TELEMETRY))
+                         or gates.enabled(ft.FLEET_TELEMETRY)
+                         or slo_enabled)
     # telemetry distills trace spans (goodput, step-skew, profiles), so
     # enabling it implies the tracer even when the Tracing gate is off
     trace_enabled = (config.enable_tracing or gates.enabled(ft.TRACING)
@@ -162,10 +174,21 @@ def build_operator(api: Optional[APIServer] = None,
         from ..client.clientset import TRAINING_KINDS
         from ..metrics.registry import TelemetryMetrics
         from ..telemetry import FleetTelemetry
+        slo_eval = None
+        if slo_enabled:
+            # SLO engine (docs/slo.md): kubedl_slo_* families register
+            # only here, so the disabled exposition stays byte-identical
+            from ..metrics.registry import SLOMetrics
+            from ..telemetry.slo import SLOEvaluator
+            slo_eval = SLOEvaluator(api=api, clock=api.now,
+                                    metrics=SLOMetrics(registry),
+                                    recorder=recorder, registry=registry,
+                                    tracer=tracer)
         telemetry = FleetTelemetry(api, tracer,
                                    metrics=TelemetryMetrics(registry),
                                    recorder=recorder,
-                                   job_kinds=TRAINING_KINDS)
+                                   job_kinds=TRAINING_KINDS,
+                                   slo=slo_eval)
     engine_config = EngineConfig(
         enable_gang_scheduling=gang is not None,
         enable_dag_scheduling=(config.enable_dag_scheduling

@@ -124,8 +124,16 @@ class APIServer:
 
     def __init__(self, clock: Callable[[], float] = time.time,
                  admission=None, list_mode: Optional[str] = None,
-                 uid_factory: Optional[Callable[[], str]] = None):
+                 uid_factory: Optional[Callable[[], str]] = None,
+                 preset_uid_kinds: tuple = ("SLO",)):
         self._clock = clock
+        #: kinds whose creates honor a caller-supplied metadata.uid (the
+        #: deterministic-replay seam — see create()). Deliberately an
+        #: explicit allowlist of cluster-scoped control objects: honoring
+        #: preset uids globally would let a stale fetched dict recreate
+        #: an object under its OLD uid, confusing every uid-keyed
+        #: controller state map
+        self._preset_uid_kinds = tuple(preset_uid_kinds)
         #: uid source for created objects. Defaults to random uuid4; the
         #: replay rig injects a counter-derived factory because uids feed
         #: deterministic derivations downstream (trace ids, per-job
@@ -296,7 +304,16 @@ class APIServer:
         with self._lock:
             if k in self._objs:
                 raise AlreadyExists(f"{m.kind(obj)} {md['namespace']}/{md['name']} already exists")
-            md["uid"] = self._new_uid()
+            # a pre-set uid is honored for allowlisted control kinds
+            # only (deterministic-replay seam: the cluster replay
+            # creates its default SLO set with explicit uids so control
+            # objects never consume the counter-derived factory that
+            # job trace ids and backoff jitter key on); every other
+            # kind always gets a fresh uid — uid-keyed controller state
+            # must never see a recreated object under its old identity
+            if not md.get("uid") \
+                    or m.kind(obj) not in self._preset_uid_kinds:
+                md["uid"] = self._new_uid()
             md["resourceVersion"] = self._next_rv()
             md["generation"] = 1
             md["creationTimestamp"] = _ts(self.now())

@@ -33,6 +33,11 @@ TRACING = "Tracing"
 #: pending-job explainer endpoint; off by default — enabling it also
 #: turns the tracer on (the telemetry layer distills trace spans)
 FLEET_TELEMETRY = "FleetTelemetry"
+#: SLO engine (docs/slo.md): objective CRD, error budgets, multi-window
+#: burn-rate alerting, console /api/v1/slo endpoints; off by default —
+#: enabling it also turns on telemetry (and with it the tracer), since
+#: the evaluator samples the signals those layers produce
+SLO_ENGINE = "SLOEngine"
 
 _DEFAULTS = {
     GANG_SCHEDULING: True,           # Beta
@@ -44,6 +49,7 @@ _DEFAULTS = {
     TPU_SLICE_SCHEDULER: False,      # Alpha
     TRACING: False,                  # Alpha
     FLEET_TELEMETRY: False,          # Alpha
+    SLO_ENGINE: False,               # Alpha
 }
 
 ENV_FEATURE_GATES = "KUBEDL_FEATURE_GATES"

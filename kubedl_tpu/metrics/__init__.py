@@ -1,5 +1,5 @@
 """Prometheus-style metrics (counters/gauges/histograms + text exposition)."""
 
 from .registry import (ControlPlaneMetrics, Counter, Gauge,  # noqa: F401
-                       Histogram, JobMetrics, Registry, SchedulerMetrics,
-                       TelemetryMetrics, TraceMetrics)
+                       Histogram, JobMetrics, Registry, SLOMetrics,
+                       SchedulerMetrics, TelemetryMetrics, TraceMetrics)

@@ -27,6 +27,23 @@ class _Metric:
     def _key(self, labels: dict) -> tuple:
         return tuple(str(labels.get(ln, "")) for ln in self.label_names)
 
+    def sample(self, **labels):
+        """``value()`` that distinguishes "never written" from a real
+        0.0 — returns None for an absent label series. The SLO engine's
+        ``metric:`` reader uses this so a typo'd family/selector yields
+        no samples instead of a fabricated always-0.0 signal."""
+        with self._lock:
+            return self._values.get(self._key(labels))
+
+    def remove(self, **labels) -> None:
+        """Drop one label series from the exposition entirely. Gauges
+        describing a deleted object (an SLO's budget/burn series) must
+        disappear, not freeze at their last value — dashboards alerting
+        on 'budget < X' would keep acting on an objective that no
+        longer exists."""
+        with self._lock:
+            self._values.pop(self._key(labels), None)
+
 
 class Counter(_Metric):
     kind = "counter"
@@ -77,6 +94,30 @@ class Histogram(_Metric):
     def sum(self, **labels) -> float:
         return self._sums.get(self._key(labels), 0.0)
 
+    def quantile(self, q: float, **labels):
+        """Estimate the ``q``-quantile from the cumulative bucket counts
+        (the SLO engine's read point for ``metric:`` signals over
+        histograms, docs/slo.md): linear interpolation within the
+        winning bucket, the way ``histogram_quantile`` does it. Samples
+        landing only in the ``+Inf`` bucket clamp to the largest finite
+        bound — a histogram cannot say more. Returns None when no
+        samples were observed."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile must be in [0, 1], got {q}")
+        with self._lock:
+            counts = list(self._counts.get(self._key(labels), ()))
+        if not counts or counts[-1] == 0:
+            return None
+        rank = q * counts[-1]
+        prev_cum, lower = 0, 0.0
+        for i, bound in enumerate(self.buckets):
+            cum = counts[i]
+            if cum >= rank and cum > prev_cum:
+                frac = (rank - prev_cum) / (cum - prev_cum)
+                return lower + (bound - lower) * frac
+            prev_cum, lower = cum, bound
+        return float(self.buckets[-1])      # +Inf bucket: clamp
+
 
 class Registry:
     def __init__(self):
@@ -100,6 +141,15 @@ class Registry:
         with self._lock:
             self._metrics.append(mt)
         return mt
+
+    def find(self, name: str):
+        """The registered metric with this exposition name, or None (the
+        SLO engine resolves ``metric:<family>`` signals through this)."""
+        with self._lock:
+            for mt in self._metrics:
+                if mt.name == name:
+                    return mt
+        return None
 
     def expose(self) -> str:
         """Prometheus text exposition format. Snapshots each metric under
@@ -322,6 +372,35 @@ class TelemetryMetrics:
             "kubedl_throughput_profile_samples_total",
             "Observations folded into each throughput profile",
             ("profile", "pool"))
+
+
+class SLOMetrics:
+    """SLO engine families (docs/slo.md): how much error budget each
+    objective has left, the live burn rates behind the multi-window
+    verdicts, and alert onsets. Constructed only when the SLOEngine gate
+    is on — the disabled operator's exposition carries no ``kubedl_slo_*``
+    family at all (the PR 5/7 byte-identical-disabled convention)."""
+
+    def __init__(self, registry: Optional[Registry] = None):
+        self.registry = registry or Registry()
+        r = self.registry
+        self.budget_remaining = r.gauge(
+            "kubedl_slo_budget_remaining_ratio",
+            "Error budget left over the objective's compliance window "
+            "(1.0 = untouched, 0.0 = spent, negative = violated)",
+            ("slo",))
+        self.burn_rate = r.gauge(
+            "kubedl_slo_burn_rate",
+            "Error-budget burn rate per alert window (1.0 = spending "
+            "exactly the budget over the compliance window)",
+            ("slo", "window"))
+        self.alerts = r.counter(
+            "kubedl_slo_alerts_total",
+            "Burn-rate alert onsets (one per onset, not per evaluation)",
+            ("slo", "severity"))
+        self.alerts_active = r.gauge(
+            "kubedl_slo_alerts_active",
+            "Alert severities currently firing per objective", ("slo",))
 
 
 class TraceMetrics:

@@ -31,6 +31,7 @@ from typing import Optional
 
 from ..api.common import JobStatus
 from ..api.queue import new_queue
+from ..api.slo import new_slo
 from ..controllers.chaos import ChaosAPIServer, ChaosConfig
 from ..controllers.engine import EngineConfig, JobEngine
 from ..controllers.testing import TestJobController, new_test_job, \
@@ -42,11 +43,13 @@ from ..metrics.registry import (ControlPlaneMetrics, JobMetrics, Registry,
                                 SchedulerMetrics, TelemetryMetrics,
                                 TraceMetrics)
 from ..telemetry import GoodputAccountant
+from ..telemetry.slo import SLOEvaluator
 from ..scheduling.gang import CoschedulerPlugin
 from ..scheduling.inventory import SliceInventory
 from ..scheduling.scheduler import SliceScheduler
 from ..trace import Tracer, job_trace_context
-from ..trace.analysis import assert_well_formed, trace_breakdown
+from ..trace.analysis import (assert_well_formed, restart_mttrs,
+                              trace_breakdown)
 from ..utils import status as st
 from ..utils.retry import RetryPolicy
 from .workload import (HOSTS_PER_SLICE, POOL_ACCELERATOR, QUEUES, Workload)
@@ -59,6 +62,24 @@ _EV_ARRIVAL, _EV_COMPLETE, _EV_PREEMPT, _EV_RETIRE = 0, 1, 2, 3
 #: day-epoch magnitudes, so strict ``<=`` against ``clock.elapsed``
 #: would spin forever on an event the clock just advanced to
 _EPS = 1e-6
+
+
+def default_job_slos(profile) -> list:
+    """The replay's declared objectives over the job day (docs/slo.md),
+    scaled to the profile (the goodput floor tracks the profile's
+    absolute gate). Every object carries an explicit uid so its create
+    never consumes the deterministic uid factory the job timeline keys
+    on — adding an SLO must not move a single job's trace id."""
+    window = 4.0 * profile.sim_seconds      # covers day + settle tail
+    goodput_floor = {"smoke": 0.10, "day": 0.20}.get(profile.name, 0.20)
+    return [
+        new_slo("fleet-goodput", "fleet_goodput", goodput_floor,
+                goal=0.95, window_s=window, uid="slo-fleet-goodput"),
+        new_slo("queue-delay-p99", "queue_delay_p99", 28800.0,
+                window_s=window, uid="slo-queue-delay-p99"),
+        new_slo("restart-mttr-p50", "restart_mttr_p50", 1800.0,
+                window_s=window, uid="slo-restart-mttr-p50"),
+    ]
 
 
 class _JobState:
@@ -155,6 +176,21 @@ class ClusterReplay:
         # day scale — the proof the layer works, not a bench-local copy
         self.goodput = GoodputAccountant(
             metrics=TelemetryMetrics(self.registry))
+
+        # SLO engine over the job day (docs/slo.md): the replay installs
+        # a default objective set and rides the real evaluator, so the
+        # scorecard's slo block is the engine's own math at day scale.
+        # recorder=None: alert Events would consume the uid factory and
+        # shift every later job's trace id; conditions (update_status)
+        # don't allocate uids, so the lifecycle still lands on the
+        # objects. SLOMetrics rides the same registry as everything else.
+        from ..metrics.registry import SLOMetrics
+        for obj in default_job_slos(profile):
+            self.inner.create(obj)
+        self.slo = SLOEvaluator(api=self.inner, clock=self.clock,
+                                metrics=SLOMetrics(self.registry),
+                                goodput=self.goodput,
+                                evaluate_interval_s=60.0)
 
         # observation accumulators (trace-derived samples + counters)
         self.queue_delays: list = []
@@ -311,8 +347,17 @@ class ClusterReplay:
         spans = self.tracer.spans(trace_id=tid)
         bd = trace_breakdown(spans, tid, dropped=self.tracer.dropped)
         self.goodput.observe(bd)
-        self.queue_delays.append(bd["byPhase"].get("Queuing", 0.0))
-        self.mttrs.extend(_restart_mttrs(bd["phases"]))
+        queue_delay = bd["byPhase"].get("Queuing", 0.0)
+        mttrs = restart_mttrs(bd["phases"])
+        # the SLO engine sees exactly the samples the scorecard reports
+        now = self.clock()
+        self.slo.observe("queue_delay", queue_delay, now,
+                         {"queue": rec.spec.queue})
+        for v in mttrs:
+            self.slo.observe("restart_mttr", v, now,
+                             {"queue": rec.spec.queue})
+        self.queue_delays.append(queue_delay)
+        self.mttrs.extend(mttrs)
         self.restart_rounds_seen += sum(
             1 for p in bd["phases"] if p["name"] == "Restarting")
         profile = self.workload.profile
@@ -382,6 +427,8 @@ class ClusterReplay:
             self.manager.run_until_idle(max_iterations=1_000_000)
             self._kubelet_round()
             self._integrate_util()
+            self.slo.maybe_evaluate(self.clock())
+        self.slo.evaluate(self.clock())     # final windows + verdicts
         if hasattr(self.scheduler, "check_parity"):
             self.scheduler.check_parity()
         return self._result()
@@ -434,6 +481,7 @@ class ClusterReplay:
                     kind="TestJob"), 1),
             },
             "goodput": self.goodput.summary(ndigits=4),
+            "slo": self.slo.summary(ndigits=4),
             "trace": {
                 "sampled_jobs": self.sampled_traces,
                 "orphan_violations": len(self.orphan_violations),
@@ -443,16 +491,3 @@ class ClusterReplay:
         }
 
 
-def _restart_mttrs(phases: list) -> list:
-    """Trace-derived MTTR samples: for each outage (first ``Restarting``
-    phase span after a ``Running``), seconds until the next ``Running``
-    phase begins. Phases arrive chronologically from trace_breakdown."""
-    out = []
-    outage_start = None
-    for p in phases:
-        if p["name"] == "Restarting" and outage_start is None:
-            outage_start = p["start"]
-        elif p["name"] == "Running" and outage_start is not None:
-            out.append(p["start"] - outage_start)
-            outage_start = None
-    return out

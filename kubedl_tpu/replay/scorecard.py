@@ -29,6 +29,15 @@ _GATES = {
         ("jobs.controlplane.reconciles_per_job", "<=", 120.0),
         ("serving.completed_fraction", ">=", 1.0),
         ("serving.errors", "<=", 0),
+        # SLO engine (docs/slo.md): every installed objective must have
+        # seen samples, and the latency objectives must end the day with
+        # budget to spare (the compliance window covers the whole run,
+        # so this is "the fleet met its declared SLOs")
+        ("slo.objectives.fleet-goodput.samples", ">=", 1),
+        ("slo.objectives.queue-delay-p99.samples", ">=", 1),
+        ("slo.objectives.serving-ttft-p99.samples", ">=", 1),
+        ("slo.objectives.serving-ttft-p99.budgetRemaining", ">=", 0.0),
+        ("slo.objectives.queue-delay-p99.budgetRemaining", ">=", 0.0),
     ),
     "day": (
         ("jobs.completed_fraction", ">=", 1.0),
@@ -41,6 +50,15 @@ _GATES = {
         ("serving.completed_fraction", ">=", 1.0),
         ("serving.errors", "<=", 0),
         ("serving.ttft_s.p99", "<=", 600.0),
+        ("slo.objectives.fleet-goodput.samples", ">=", 1),
+        ("slo.objectives.queue-delay-p99.samples", ">=", 1),
+        ("slo.objectives.restart-mttr-p50.samples", ">=", 1),
+        ("slo.objectives.serving-ttft-p99.samples", ">=", 1),
+        ("slo.objectives.serving-ttft-p99.budgetRemaining", ">=", 0.0),
+        ("slo.objectives.serving-queue-p99.budgetRemaining", ">=", 0.0),
+        ("slo.objectives.queue-delay-p99.budgetRemaining", ">=", 0.0),
+        ("slo.objectives.restart-mttr-p50.budgetRemaining", ">=", 0.0),
+        ("slo.objectives.fleet-goodput.budgetRemaining", ">=", 0.0),
     ),
 }
 
@@ -55,6 +73,22 @@ _REGRESSION = (
     ("jobs.scheduler.passes", "lower_better", 0.20, 50.0),
     ("serving.ttft_s.p99", "lower_better", 0.12, 0.5),
     ("serving.queue_s.p99", "lower_better", 0.12, 0.5),
+    # SLO columns (docs/slo.md): compliance and remaining budget must
+    # not backslide past tolerance — an objective quietly burning more
+    # budget than the committed day is a fleet regression even when the
+    # absolute gate still passes
+    ("slo.objectives.serving-ttft-p99.compliance",
+     "higher_better", 0.02, 0.002),
+    ("slo.objectives.serving-ttft-p99.budgetRemaining",
+     "higher_better", 0.10, 0.05),
+    ("slo.objectives.serving-queue-p99.budgetRemaining",
+     "higher_better", 0.10, 0.05),
+    ("slo.objectives.queue-delay-p99.budgetRemaining",
+     "higher_better", 0.10, 0.05),
+    ("slo.objectives.restart-mttr-p50.budgetRemaining",
+     "higher_better", 0.10, 0.05),
+    ("slo.objectives.fleet-goodput.budgetRemaining",
+     "higher_better", 0.10, 0.05),
 )
 
 
@@ -87,7 +121,13 @@ def build_scorecard(workload: Workload, cluster: dict,
     jobs["fleet_goodput"] = (jobs.get("goodput") or {}).get(
         "fleetGoodput", 0.0)
 
+    # SLO engine rollup (docs/slo.md): one block merging both legs'
+    # objectives (names are disjoint by construction: the job-day set
+    # vs the serving-* set)
+    slo_objectives = {**(jobs.pop("slo", None) or {})}
+
     srv = dict(serving)
+    slo_objectives.update(srv.pop("slo", None) or {})
     q_waits = srv.pop("queue_waits_s")
     ttfts = srv.pop("ttfts_s")
     srv["completed_fraction"] = round(
@@ -112,6 +152,8 @@ def build_scorecard(workload: Workload, cluster: dict,
         },
         "jobs": jobs,
         "serving": srv,
+        "slo": {"objectives": {k: slo_objectives[k]
+                               for k in sorted(slo_objectives)}},
     }
 
 

@@ -18,10 +18,26 @@ from __future__ import annotations
 
 import dataclasses
 
+from ..api.slo import new_slo
 from ..core.clock import SimClock
 from ..metrics.registry import PagedKVMetrics, Registry, TraceMetrics
+from ..telemetry.slo import RequestSpanHarvester, SLOEvaluator
 from ..trace import Tracer
 from .workload import Workload
+
+
+def default_serving_slos(profile) -> list:
+    """The serving day's declared objectives (docs/slo.md): 99% of
+    requests admitted (queue) and first-token-served (ttft) within the
+    target, tracked over the whole day so the scorecard can gate on
+    budget remaining. Targets sit above the committed p99 (2.75s) with
+    headroom for flash-crowd tails, not above the max — a real queueing
+    collapse burns the budget."""
+    window = 4.0 * profile.sim_seconds
+    return [
+        new_slo("serving-ttft-p99", "ttft_p99", 5.0, window_s=window),
+        new_slo("serving-queue-p99", "queue_p99", 5.0, window_s=window),
+    ]
 
 
 def _tiny_model():
@@ -43,11 +59,25 @@ class ServingReplay:
     """One serving-day replay. ``run()`` returns the raw observation
     dict (span-derived latency samples + pool metrics reads)."""
 
-    def __init__(self, workload: Workload, model=None):
+    def __init__(self, workload: Workload, model=None, slo=None,
+                 drain_every: int = 512):
         from ..serving.batching import ContinuousBatchingEngine
         profile = workload.profile
         self.workload = workload
         self.clock = SimClock()
+        #: ticks between span drains (and therefore SLO evaluations /
+        #: pool-metric samples); the default matches the committed
+        #: scorecard cadence, tests lower it to watch burn windows live
+        self.drain_every = int(drain_every)
+        #: SLO engine over the serving signals (docs/slo.md): headless
+        #: (no api) by default with the profile's default objectives; an
+        #: injected evaluator (the e2e test's api-backed one) sees the
+        #: identical sample stream
+        self.slo = slo if slo is not None else SLOEvaluator(
+            clock=self.clock, evaluate_interval_s=30.0)
+        if slo is None:
+            for obj in default_serving_slos(profile):
+                self.slo.add(obj)
         self.registry = Registry()
         self.tracer = Tracer(enabled=True,
                              capacity=profile.serving_trace_capacity,
@@ -70,8 +100,11 @@ class ServingReplay:
         self.errors = 0
         self.tokens_out = 0
         self.shared_block_admissions = 0
-        self._qstart: dict = {}      # trace id -> submit (first queue start)
-        self._ttft_seen: set = set()
+        # the ONE ttft/queue span derivation (docs/slo.md): shared with
+        # the operator-side SLO engine so the scorecard's ttfts_s and
+        # the SLO samples can never drift apart. prune=False because
+        # _drain clears the ring between feeds.
+        self._harvester = RequestSpanHarvester(prune=False)
         self.shared_ratio_peak = 0.0
         self.ticks = 0
 
@@ -82,39 +115,41 @@ class ServingReplay:
         if not spans:
             return
         self.tracer.clear()
+        for signal, value, t in self._harvester.feed(spans):
+            if signal == "ttft":
+                self.ttfts.append(value)
+            self.slo.observe(signal, value, t)
         for s in spans:
             if s.name == "request.queue":
                 self.queue_waits.append(s.duration)
                 if s.attributes.get("resumed"):
                     self.resumes += 1
-                elif s.trace_id not in self._ttft_seen:
-                    self._qstart.setdefault(s.trace_id, s.start)
             elif s.name == "request.prefill":
                 if s.attributes.get("sharedBlocks", 0) > 0:
                     self.shared_block_admissions += 1
-                t0 = self._qstart.pop(s.trace_id, None)
-                if t0 is not None and s.trace_id not in self._ttft_seen:
-                    self._ttft_seen.add(s.trace_id)
-                    self.ttfts.append(s.end - t0)
             elif s.name == "serving.request":
                 self.completed += 1
                 if s.status != "ok":
                     self.errors += 1
                 self.tokens_out += int(s.attributes.get("tokens", 0))
-                self._ttft_seen.discard(s.trace_id)
         self.kv_metrics.refresh(self.engine.pool_stats())
         self.shared_ratio_peak = max(self.shared_ratio_peak,
                                      self.kv_metrics.shared_ratio.value())
+        self.slo.maybe_evaluate(self.clock())
 
     # -- the day loop ----------------------------------------------------
 
     def run(self) -> dict:
         profile = self.workload.profile
+        # register api-listed objectives BEFORE the first samples land
+        # (an injected api-backed evaluator discovers SLO objects on
+        # evaluation; samples observed earlier would route nowhere)
+        self.slo.evaluate(self.clock())
         arrivals = self.workload.serving
         requests = []
         i, n = 0, len(arrivals)
         active = False
-        drain_every = 512
+        drain_every = self.drain_every
         while i < n or active:
             if not active and i < n \
                     and arrivals[i].arrival_s > self.clock.elapsed:
@@ -136,6 +171,7 @@ class ServingReplay:
             if self.ticks % drain_every == 0:
                 self._drain()
         self._drain()
+        self.slo.evaluate(self.clock())     # final windows + verdicts
         undone = sum(1 for r in requests if not r.done.is_set())
         return {
             "requests_submitted": len(requests),
@@ -147,6 +183,7 @@ class ServingReplay:
             "tokens_generated": self.tokens_out,
             "engine_ticks": self.ticks,
             "sim_span_s": round(self.clock.elapsed, 1),
+            "slo": self.slo.summary(ndigits=4),
             "queue_waits_s": self.queue_waits,
             "ttfts_s": self.ttfts,
             "kv": {

@@ -30,11 +30,13 @@ import logging
 from typing import Optional
 
 from ..core import meta as m
-from ..trace import job_trace_context, trace_breakdown
+from ..trace import job_trace_context, restart_mttrs, trace_breakdown
 from .explainer import explain_pending  # noqa: F401
 from .goodput import (GoodputAccountant, OVERHEAD_CATEGORIES,  # noqa: F401
                       goodput_breakdown)
 from .profiles import ThroughputProfileStore  # noqa: F401
+from .slo import (REASON_SLO_BURN, REASON_SLO_RECOVERED,  # noqa: F401
+                  SLO_BURN_RATE, SLOEvaluator)
 from .straggler import (JOB_SLOW_SLICE, REASON_SLOW_SLICE,  # noqa: F401
                         REASON_SLOW_SLICE_RESOLVED, StragglerDetector)
 
@@ -43,9 +45,10 @@ log = logging.getLogger("kubedl_tpu.telemetry")
 __all__ = [
     "FleetTelemetry", "GoodputAccountant", "JOB_SLOW_SLICE",
     "OVERHEAD_CATEGORIES", "REASON_SLOW_SLICE",
-    "REASON_SLOW_SLICE_RESOLVED", "StragglerDetector",
-    "ThroughputProfileStore", "explain_pending", "goodput_breakdown",
-    "job_pool",
+    "REASON_SLOW_SLICE_RESOLVED", "REASON_SLO_BURN",
+    "REASON_SLO_RECOVERED", "SLO_BURN_RATE", "SLOEvaluator",
+    "StragglerDetector", "ThroughputProfileStore", "explain_pending",
+    "goodput_breakdown", "job_pool",
 ]
 
 
@@ -75,7 +78,7 @@ class FleetTelemetry:
     def __init__(self, api, tracer, metrics=None, recorder=None,
                  job_kinds=(), scan_interval_s: float = 30.0,
                  profile_halflife_s: float = 3600.0,
-                 skew_factor: float = 2.0):
+                 skew_factor: float = 2.0, slo=None):
         self.api = api
         self.tracer = tracer
         self.metrics = metrics
@@ -85,6 +88,12 @@ class FleetTelemetry:
         self.straggler = StragglerDetector(
             api, tracer, recorder=recorder, metrics=metrics,
             job_kinds=job_kinds, skew_factor=skew_factor)
+        #: the SLO engine (docs/slo.md) when the SLOEngine gate is on;
+        #: None otherwise — telemetry can run without judgment
+        self.slo = slo
+        if slo is not None and slo.goodput is None:
+            # the fleet_goodput gauge signal reads this bundle's accountant
+            slo.goodput = self.goodput
         self.scan_interval_s = float(scan_interval_s)
         self._next_scan = 0.0
         self._harvested: set = set()
@@ -106,6 +115,17 @@ class FleetTelemetry:
             return None
         bd = trace_breakdown(spans, tid, dropped=self.tracer.dropped)
         gp = self.goodput.observe(bd)
+        if self.slo is not None:
+            # lifecycle-trace signals (docs/slo.md): one queue-delay
+            # sample per retired job, one restart-MTTR sample per outage
+            now = self.api.now()
+            labels = {"queue": self._job_queue(job),
+                      "kind": job.get("kind") or ""}
+            self.slo.observe("queue_delay",
+                             bd["byPhase"].get("Queuing", 0.0), now,
+                             labels)
+            for v in restart_mttrs(bd["phases"]):
+                self.slo.observe("restart_mttr", v, now, labels)
         pool = job_pool(job)
         default_key = (job.get("kind") or "job").lower()
         for s in spans:
@@ -141,9 +161,25 @@ class FleetTelemetry:
 
     def maybe_scan(self, now: Optional[float] = None) -> Optional[list]:
         """Rate-limited :meth:`StragglerDetector.scan` (engines call this
-        once per reconcile; one scan per interval actually runs)."""
+        once per reconcile; one scan per interval actually runs). The
+        SLO engine's own rate-limited evaluation rides the same hook."""
         now = self.api.now() if now is None else now
+        if self.slo is not None:
+            self.slo.maybe_evaluate(now)
         if now < self._next_scan:
             return None
         self._next_scan = now + self.scan_interval_s
         return self.straggler.scan()
+
+    @staticmethod
+    def _job_queue(job: dict) -> str:
+        """The queue a job's gangs route to (the scheduler's own routing
+        rule), labelling its SLO samples for tenant/queue selectors.
+        Kinds disagree on where runPolicy lives (some inline its fields
+        directly into spec), so both shapes are read."""
+        from ..api.common import SchedulingPolicy
+        from ..scheduling.queue import job_queue_name
+        sp = (m.get_in(job, "spec", "runPolicy", "schedulingPolicy")
+              or m.get_in(job, "spec", "schedulingPolicy"))
+        return job_queue_name(job, SchedulingPolicy.from_dict(sp)
+                              if sp else None)

@@ -2,7 +2,8 @@
 critical-path analysis (docs/tracing.md). Feature-gated off by default
 (``--enable-tracing`` / the ``Tracing`` gate)."""
 
-from .analysis import assert_well_formed, find_orphans, trace_breakdown
+from .analysis import (assert_well_formed, find_orphans, restart_mttrs,
+                       trace_breakdown)
 from .export import chrome_trace_json, to_chrome_trace, to_otlp_json
 from .lifecycle import PHASES, JobLifecycleTracer, derive_phase
 from .tracer import (ANNOTATION_TRACEPARENT, ENV_TRACEPARENT, NOOP_TRACER,
@@ -14,5 +15,5 @@ __all__ = [
     "JobLifecycleTracer", "Span", "Tracer", "assert_well_formed",
     "chrome_trace_json", "derive_context", "derive_phase", "find_orphans",
     "format_traceparent", "job_trace_context", "parse_traceparent",
-    "to_chrome_trace", "to_otlp_json", "trace_breakdown",
+    "restart_mttrs", "to_chrome_trace", "to_otlp_json", "trace_breakdown",
 ]

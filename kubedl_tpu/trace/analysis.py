@@ -88,6 +88,24 @@ def trace_breakdown(spans: Iterable[Span],
     }
 
 
+def restart_mttrs(phases: list) -> list:
+    """Trace-derived restart-MTTR samples from a breakdown's ``phases``
+    list: for each outage (first ``Restarting`` phase span after a
+    ``Running``), seconds until the next ``Running`` phase begins.
+    Phases arrive chronologically from :func:`trace_breakdown`. Shared
+    by the cluster replay's scorecard leg and the SLO engine's
+    ``restart_mttr`` signal — one derivation, one number."""
+    out = []
+    outage_start = None
+    for p in phases:
+        if p["name"] == "Restarting" and outage_start is None:
+            outage_start = p["start"]
+        elif p["name"] == "Running" and outage_start is not None:
+            out.append(p["start"] - outage_start)
+            outage_start = None
+    return out
+
+
 def assert_well_formed(spans: Iterable[Span]) -> None:
     """Raise AssertionError when the trace has orphans or its phase
     spans are not monotonically ordered (each phase must start no

@@ -177,6 +177,36 @@ def test_telemetry_families_in_exposition(served):
             'pool="p\\\\q"} 9.5') in body
 
 
+def test_slo_families_in_exposition(served):
+    """Pin the SLO engine families (docs/slo.md): names, label sets,
+    and escaping — SLO names are user-chosen object names riding the
+    same escaping contract as queue labels."""
+    from kubedl_tpu.metrics.registry import SLOMetrics
+    reg, port = served
+    sm = SLOMetrics(reg)
+    sm.budget_remaining.set(0.78, slo="serving-ttft")
+    sm.burn_rate.set(2.5, slo="serving-ttft", window="300s")
+    sm.burn_rate.set(0.9, slo="serving-ttft", window="3600s")
+    sm.alerts.inc(slo="serving-ttft", severity="page")
+    sm.alerts_active.set(1, slo="serving-ttft")
+    sm.budget_remaining.set(1.0, slo='we"ird')
+    _, body, _ = scrape(port)
+    assert "# TYPE kubedl_slo_budget_remaining_ratio gauge" in body
+    assert ('kubedl_slo_budget_remaining_ratio{slo="serving-ttft"} 0.78'
+            in body)
+    assert "# TYPE kubedl_slo_burn_rate gauge" in body
+    assert ('kubedl_slo_burn_rate{slo="serving-ttft",window="300s"} 2.5'
+            in body)
+    assert ('kubedl_slo_burn_rate{slo="serving-ttft",window="3600s"} 0.9'
+            in body)
+    assert "# TYPE kubedl_slo_alerts_total counter" in body
+    assert ('kubedl_slo_alerts_total{slo="serving-ttft",severity="page"}'
+            ' 1.0') in body
+    assert 'kubedl_slo_alerts_active{slo="serving-ttft"} 1.0' in body
+    # escaping: a quote in the SLO name stays parseable
+    assert 'kubedl_slo_budget_remaining_ratio{slo="we\\"ird"} 1.0' in body
+
+
 def test_label_value_escaping(served):
     reg, port = served
     g = reg.gauge("kubedl_esc", "escapes", ("name",))

@@ -151,6 +151,18 @@ def test_smoke_scorecard_gates_pass(smoke_cluster, smoke_serving):
     assert 0 < sc["jobs"]["fleet_goodput"] < 1
     parts = gp["productiveSeconds"] + sum(gp["overheadSeconds"].values())
     assert abs(parts - gp["wallSeconds"]) <= 0.01 * gp["wallSeconds"]
+    # the SLO engine's block (docs/slo.md): both legs' default
+    # objectives, merged, every one with real samples and the
+    # compliance/budget columns the new gates hold
+    slo = sc["slo"]["objectives"]
+    assert {"fleet-goodput", "queue-delay-p99", "restart-mttr-p50",
+            "serving-ttft-p99", "serving-queue-p99"} <= set(slo)
+    assert slo["queue-delay-p99"]["samples"] == len(wl.jobs)
+    assert slo["serving-ttft-p99"]["samples"] == len(wl.serving)
+    for obj in slo.values():
+        assert obj["samples"] >= 1
+        assert 0.0 <= obj["compliance"] <= 1.0
+        assert obj["budgetRemaining"] <= 1.0
 
 
 # ---------------------------------------------------------------------------
@@ -190,6 +202,13 @@ def _mini_scorecard(**jobs_overrides):
             "completed_fraction": 1.0, "errors": 0,
             "ttft_s": {"p99": 2.0}, "queue_s": {"p99": 1.5},
         },
+        "slo": {"objectives": {
+            name: {"samples": 100, "compliance": 0.999,
+                   "budgetRemaining": 0.9, "alertsFired": 0}
+            for name in ("fleet-goodput", "queue-delay-p99",
+                         "restart-mttr-p50", "serving-ttft-p99",
+                         "serving-queue-p99")
+        }},
     }
     sc["jobs"].update(jobs_overrides)
     return sc

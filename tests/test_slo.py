@@ -1,0 +1,821 @@
+"""SLO engine (docs/slo.md): objective grammar, error budgets,
+multi-window multi-burn-rate alerting, console endpoints.
+
+Layers:
+
+* spec — the signal grammar (``<base>_pNN`` / ``fleet_goodput`` /
+  ``metric:<family>[:pNN]``), validation, and object round-trips;
+* windows — sliding-window budget math: burn rates, budget consumed,
+  the long-window guard, and the short-window reset;
+* lifecycle — one ``SLOBudgetBurn`` Event + a True ``SLOBurnRate``
+  condition per onset (idempotent while the burn persists), cleared
+  with ``SLOBudgetRecovered``; spec edits reset windows, deletes drop
+  state;
+* signals — lifecycle-trace feeds (queue_delay / restart_mttr), the
+  request-span harvester (ttft / queue), the fleet_goodput gauge, and
+  registry ``metric:`` reads through the new ``Histogram.quantile``;
+* console — ``/api/v1/slo/list`` + ``/api/v1/slo/status/{name}``
+  (501 when gated off) and operator gate wiring;
+* e2e — THE acceptance flow: a TTFT SLO over the serving replay fires
+  exactly one burn alert during the flash-crowd window, reports budget
+  consumed within 1% of the hand-computed value from the same spans,
+  and clears after recovery (2 seeds); and the disabled path leaves a
+  chaos-seeded day byte-identical (no SLO objects, no conditions, no
+  ``kubedl_slo_*`` families, 501 endpoints).
+"""
+
+import pytest
+
+from kubedl_tpu import trace
+from kubedl_tpu.api import common as c
+from kubedl_tpu.api.slo import (BurnWindow, DEFAULT_ALERTING, SLOSpec,
+                                new_slo, parse_signal)
+from kubedl_tpu.console.proxy import DataProxy
+from kubedl_tpu.console.server import ConsoleConfig, ConsoleServer
+from kubedl_tpu.controllers.chaos import ChaosAPIServer, ChaosConfig
+from kubedl_tpu.controllers.engine import EngineConfig, JobEngine
+from kubedl_tpu.controllers.registry import OperatorConfig, build_operator
+from kubedl_tpu.controllers.testing import (TestJobController, new_test_job,
+                                            run_all_pods, set_pod_phase)
+from kubedl_tpu.core import features as ft
+from kubedl_tpu.core import meta as m
+from kubedl_tpu.core.apiserver import APIServer
+from kubedl_tpu.core.clock import SimClock
+from kubedl_tpu.core.events import Recorder
+from kubedl_tpu.core.manager import Manager
+from kubedl_tpu.metrics.registry import Registry, SLOMetrics
+from kubedl_tpu.telemetry import (FleetTelemetry, REASON_SLO_BURN,
+                                  REASON_SLO_RECOVERED, SLO_BURN_RATE,
+                                  SLOEvaluator)
+from kubedl_tpu.utils import status as st
+from kubedl_tpu.utils.retry import RetryPolicy
+from kubedl_tpu.utils.stats import percentile
+
+pytestmark = pytest.mark.slo
+
+
+def make_eval(clock, api=None, **kw):
+    return SLOEvaluator(api=api, clock=clock, **kw)
+
+
+def feed(ev, clock, signal, values, step=1.0, labels=None):
+    for v in values:
+        clock.advance(step)
+        ev.observe(signal, v, clock(), labels)
+
+
+# ---------------------------------------------------------------------------
+# spec / signal grammar
+# ---------------------------------------------------------------------------
+
+
+def test_signal_grammar():
+    assert parse_signal("ttft_p99") == ("event", "ttft", 0.99, None)
+    assert parse_signal("queue_p90") == ("event", "queue", 0.90, None)
+    assert parse_signal("queue_delay_p99") == \
+        ("event", "queue_delay", 0.99, None)
+    assert parse_signal("restart_mttr_p50") == \
+        ("event", "restart_mttr", 0.50, None)
+    assert parse_signal("fleet_goodput") == \
+        ("gauge", "fleet_goodput", None, None)
+    assert parse_signal("metric:kubedl_x_seconds") == \
+        ("metric", "kubedl_x_seconds", None, 0.99)
+    assert parse_signal("metric:kubedl_x_seconds:p50") == \
+        ("metric", "kubedl_x_seconds", None, 0.50)
+    for bad in ("", "nope", "ttft", "nope_p99", "metric:",
+                "metric:x:q50"):
+        if bad == "ttft":
+            # a bare event base is legal only with an explicit goal
+            assert parse_signal("ttft") == ("event", "ttft", None, None)
+            continue
+        with pytest.raises(ValueError):
+            parse_signal(bad)
+
+
+def test_spec_from_obj_defaults_and_validation():
+    spec = SLOSpec.from_obj(new_slo("t", "ttft_p99", 30.0))
+    assert spec.goal == 0.99 and spec.comparator == "lte"
+    assert spec.budget == pytest.approx(0.01)
+    assert spec.alerting == DEFAULT_ALERTING
+    assert spec.good(30.0) and not spec.good(30.1)
+    # fleet_goodput flips the comparator: bigger is better
+    gp = SLOSpec.from_obj(new_slo("g", "fleet_goodput", 0.3, goal=0.95))
+    assert gp.comparator == "gte"
+    assert gp.good(0.3) and not gp.good(0.29)
+    # explicit goal overrides the suffix; goal 1.0 leaves no budget
+    s2 = SLOSpec.from_obj(new_slo("t2", "ttft_p99", 1.0, goal=0.9))
+    assert s2.goal == 0.9
+    with pytest.raises(ValueError):
+        new_slo("bad", "ttft_p99", 1.0, goal=1.0)
+    with pytest.raises(ValueError):
+        SLOSpec.from_obj({"metadata": {"name": "x"},
+                          "spec": {"signal": "ttft_p99",
+                                   "objective": {}}})   # no target
+    with pytest.raises(ValueError):
+        new_slo("bad", "ttft_p99", 1.0,
+                alerting=[{"severity": "page", "shortSeconds": 60,
+                           "longSeconds": 30, "burn": 2.0}])  # long<short
+    # selector round-trips sorted
+    s3 = SLOSpec.from_obj(new_slo("t3", "queue_delay_p99", 60.0,
+                                  selector={"queue": "prod"}))
+    assert s3.selector == (("queue", "prod"),)
+    assert s3.matches({"queue": "prod", "kind": "TFJob"})
+    assert not s3.matches({"queue": "best"}) and not s3.matches(None)
+    # review regressions: an explicit windowSeconds 0 is rejected, not
+    # silently replaced by the 30d default; duplicate alerting
+    # severities are rejected (state is severity-keyed — shared names
+    # would clobber each other's firing flag and flap every pass)
+    with pytest.raises(ValueError):
+        new_slo("bad", "ttft_p99", 1.0, window_s=0.0)
+    with pytest.raises(ValueError):
+        new_slo("bad", "ttft_p99", 1.0, alerting=[
+            {"severity": "page", "shortSeconds": 60, "longSeconds": 300,
+             "burn": 10.0},
+            {"severity": "page", "shortSeconds": 300,
+             "longSeconds": 1800, "burn": 5.0}])
+
+
+# ---------------------------------------------------------------------------
+# window math / budget accounting
+# ---------------------------------------------------------------------------
+
+
+def _single_pair(short=60.0, long_=300.0, burn=10.0):
+    return [{"severity": "page", "shortSeconds": short,
+             "longSeconds": long_, "burn": burn}]
+
+
+def test_budget_consumed_matches_hand_math(clock):
+    ev = make_eval(clock)
+    ev.add(new_slo("t", "ttft_p99", 1.0, goal=0.9, window_s=10_000.0,
+                   alerting=_single_pair()))
+    feed(ev, clock, "ttft", [0.5] * 90 + [2.0] * 10)
+    s = ev.evaluate(clock())[0]
+    # 10 bad / 100 total / (1 - 0.9) budget = consumed exactly 1.0
+    assert s["samples"] == 100 and s["goodSamples"] == 90
+    assert s["compliance"] == pytest.approx(0.9)
+    assert s["budgetConsumed"] == pytest.approx(1.0)
+    assert s["budgetRemaining"] == pytest.approx(0.0)
+
+
+def test_windows_slide_and_prune(clock):
+    ev = make_eval(clock)
+    ev.add(new_slo("t", "ttft_p99", 1.0, goal=0.5, window_s=50.0,
+                   alerting=_single_pair(short=10.0, long_=20.0)))
+    feed(ev, clock, "ttft", [5.0] * 10)       # all bad, 1/s
+    s = ev.evaluate(clock())[0]
+    assert s["budgetConsumed"] == pytest.approx(2.0)
+    # 100s later every sample has aged out of the 50s window
+    clock.advance(100.0)
+    s = ev.evaluate(clock())[0]
+    assert s["samples"] == 0 and s["budgetConsumed"] is None
+    assert s["budgetRemaining"] == 1.0
+
+
+def test_long_window_guards_and_short_window_resets(clock):
+    """The SRE shape: a short bad blip alone must not page (the long
+    window vetoes it); once paging, fresh good samples in the short
+    window clear the alert even while the long window stays bad."""
+    ev = make_eval(clock)
+    ev.add(new_slo("t", "ttft_p99", 1.0, goal=0.5, window_s=100_000.0,
+                   alerting=_single_pair(short=20.0, long_=2_000.0,
+                                         burn=1.5)))
+    # a long good history, then a blip of 3 bad samples: the 20s window
+    # burns hot but the 2000s window stays quiet -> no alert
+    feed(ev, clock, "ttft", [0.5] * 200, step=5.0)
+    feed(ev, clock, "ttft", [9.9] * 3, step=1.0)
+    s = ev.evaluate(clock())[0]
+    assert s["alerts"]["page"]["firing"] is False
+    # sustained badness floods both windows -> fire
+    feed(ev, clock, "ttft", [9.9] * 300, step=5.0)
+    s = ev.evaluate(clock())[0]
+    assert s["alerts"]["page"]["firing"] is True
+    assert s["alerts"]["page"]["fired"] == 1
+    # recovery: good samples push the SHORT window clean; the long
+    # window is still mostly bad, but the alert resets
+    feed(ev, clock, "ttft", [0.5] * 30, step=1.0)
+    s = ev.evaluate(clock())[0]
+    assert s["alerts"]["page"]["firing"] is False
+    assert s["burnRates"]["2000s"] > 1.0      # long window still hot
+
+
+def test_selector_routes_samples(clock):
+    ev = make_eval(clock)
+    ev.add(new_slo("prod-q", "queue_delay_p99", 60.0, goal=0.5,
+                   window_s=1e6, selector={"queue": "prod"}))
+    feed(ev, clock, "queue_delay", [10.0] * 4, labels={"queue": "prod"})
+    feed(ev, clock, "queue_delay", [999.0] * 4, labels={"queue": "best"})
+    s = ev.evaluate(clock())[0]
+    assert s["samples"] == 4 and s["compliance"] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# alert lifecycle on the SLO object (condition + Events, idempotent)
+# ---------------------------------------------------------------------------
+
+
+def _api_eval(api, clock, metrics=None):
+    return SLOEvaluator(api=api, clock=clock, metrics=metrics,
+                        recorder=Recorder(api))
+
+
+def test_alert_lifecycle_condition_and_events_idempotent(api, clock):
+    api.create(new_slo("ttft", "ttft_p99", 1.0, goal=0.9,
+                       window_s=100_000.0,
+                       alerting=_single_pair(short=60.0, long_=300.0,
+                                             burn=2.0)))
+    mt = SLOMetrics(Registry())
+    ev = _api_eval(api, clock, metrics=mt)
+    ev.evaluate(clock())                       # discover the object
+    feed(ev, clock, "ttft", [9.0] * 50)        # sustained burn
+    ev.evaluate(clock())
+    obj = api.get("SLO", "default", "ttft")
+    conds = [cd for cd in obj["status"]["conditions"]
+             if cd.get("type") == SLO_BURN_RATE]
+    assert len(conds) == 1 and conds[0]["status"] == "True"
+    assert conds[0]["reason"] == REASON_SLO_BURN
+    burns = [e for e in api.list("Event")
+             if e.get("reason") == REASON_SLO_BURN]
+    assert len(burns) == 1 and burns[0]["type"] == "Warning"
+    assert mt.alerts.value(slo="ttft", severity="page") == 1
+    assert mt.alerts_active.value(slo="ttft") == 1
+    assert mt.budget_remaining.value(slo="ttft") < 1.0
+
+    # burn persists: repeated evaluation writes NOTHING new
+    feed(ev, clock, "ttft", [9.0] * 20)
+    ev.evaluate(clock())
+    assert len([e for e in api.list("Event")
+                if e.get("reason") == REASON_SLO_BURN]) == 1
+    assert mt.alerts.value(slo="ttft", severity="page") == 1
+
+    # recovery: the short window drains -> condition False + one
+    # Recovered event
+    feed(ev, clock, "ttft", [0.1] * 80)
+    ev.evaluate(clock())
+    obj = api.get("SLO", "default", "ttft")
+    conds = [cd for cd in obj["status"]["conditions"]
+             if cd.get("type") == SLO_BURN_RATE]
+    assert len(conds) == 1 and conds[0]["status"] == "False"
+    assert conds[0]["reason"] == REASON_SLO_RECOVERED
+    rec = [e for e in api.list("Event")
+           if e.get("reason") == REASON_SLO_RECOVERED]
+    assert len(rec) == 1 and rec[0]["type"] == "Normal"
+    assert mt.alerts_active.value(slo="ttft") == 0
+    assert [a["event"] for a in ev.alert_log] == ["fire", "clear"]
+
+
+def test_retired_state_clears_alert_and_gauges(api, clock):
+    """Review regression: a firing SLO whose spec is edited (or whose
+    object is deleted) must close out its alert lifecycle — condition
+    False + Recovered event + zeroed gauges — never strand a True
+    SLOBurnRate on the object or a stale alerts_active=1 in the
+    exposition."""
+    api.create(new_slo("t", "ttft_p99", 1.0, goal=0.9, window_s=1e6,
+                       alerting=_single_pair(short=60.0, long_=300.0,
+                                             burn=2.0)))
+    mt = SLOMetrics(Registry())
+    ev = _api_eval(api, clock, metrics=mt)
+    ev.evaluate(clock())
+    feed(ev, clock, "ttft", [9.0] * 50)
+    ev.evaluate(clock())
+    assert mt.alerts_active.value(slo="t") == 1
+    # spec edit while firing: windows reset AND the alert clears
+    obj = api.get("SLO", "default", "t")
+    obj["spec"]["objective"]["target"] = 2.0
+    api.update(obj)
+    ev.evaluate(clock())
+    assert mt.alerts_active.value(slo="t") == 0
+    assert mt.burn_rate.value(slo="t", window="60s") == 0.0
+    obj = api.get("SLO", "default", "t")
+    cond = [cd for cd in obj["status"]["conditions"]
+            if cd.get("type") == SLO_BURN_RATE]
+    assert cond and cond[0]["status"] == "False"
+    assert any(e.get("reason") == REASON_SLO_RECOVERED
+               for e in api.list("Event"))
+    assert [a["event"] for a in ev.alert_log] == ["fire", "clear"]
+    # delete while firing: gauges reset (no object left to write on)
+    feed(ev, clock, "ttft", [9.0] * 50)
+    ev.evaluate(clock())
+    assert mt.alerts_active.value(slo="t") == 1
+    api.delete("SLO", "default", "t")
+    ev.evaluate(clock())
+    assert mt.alerts_active.value(slo="t") == 0
+    assert [a["event"] for a in ev.alert_log] == \
+        ["fire", "clear", "fire", "clear"]
+    # the deleted objective's gauge series VANISH from the exposition
+    # (a frozen budget_remaining would keep dashboards alerting on an
+    # objective that no longer exists)
+    expo = mt.registry.expose()
+    assert 'kubedl_slo_budget_remaining_ratio{slo="t"}' not in expo
+    assert 'kubedl_slo_alerts_active{slo="t"}' not in expo
+    assert 'kubedl_slo_burn_rate{slo="t"' not in expo
+    # the onset COUNTER keeps its history (counter semantics)
+    assert 'kubedl_slo_alerts_total{slo="t",severity="page"} 2.0' in expo
+
+
+def test_mixed_severity_clear_keeps_condition_truthful(api, clock):
+    """Review regression: when the page pair clears while the ticket
+    pair still fires, the condition must stay True and name the
+    still-firing severity — never carry a 'back under threshold'
+    message mid-incident."""
+    api.create(new_slo(
+        "t", "ttft_p99", 1.0, goal=0.5, window_s=1e6,
+        alerting=[
+            {"severity": "page", "shortSeconds": 20, "longSeconds": 100,
+             "burn": 1.5},
+            {"severity": "ticket", "shortSeconds": 100,
+             "longSeconds": 300, "burn": 1.0},
+        ]))
+    ev = _api_eval(api, clock)
+    ev.evaluate(clock())
+    feed(ev, clock, "ttft", [9.0] * 50)       # both pairs fire
+    s = ev.evaluate(clock())[0]
+    assert s["alerts"]["page"]["firing"] and s["alerts"]["ticket"]["firing"]
+    obj = api.get("SLO", "default", "t")
+    cond = next(cd for cd in obj["status"]["conditions"]
+                if cd.get("type") == SLO_BURN_RATE)
+    assert "page" in cond["message"] and "ticket" in cond["message"]
+    # 25 fresh good samples clear the 20s page window; the ticket
+    # windows still hold the bad run
+    feed(ev, clock, "ttft", [0.1] * 25)
+    s = ev.evaluate(clock())[0]
+    assert not s["alerts"]["page"]["firing"]
+    assert s["alerts"]["ticket"]["firing"]
+    obj = api.get("SLO", "default", "t")
+    cond = next(cd for cd in obj["status"]["conditions"]
+                if cd.get("type") == SLO_BURN_RATE)
+    assert cond["status"] == "True"           # still an incident
+    assert cond["reason"] == REASON_SLO_BURN
+    assert "ticket" in cond["message"]
+    assert "back under threshold" not in cond["message"]
+    # ...while the Event stream records the page recovery itself
+    assert any(e.get("reason") == REASON_SLO_RECOVERED
+               and e["message"].startswith("page:")
+               for e in api.list("Event"))
+
+
+def test_metric_quantile_p0_not_treated_as_unset(clock):
+    """Review regression: an explicit p0 (the declared minimum) must
+    not fall back to the p99 through a falsy-zero default."""
+    reg = Registry()
+    h = reg.histogram("kubedl_min_seconds", "", (), buckets=(1.0, 10.0))
+    for v in (0.5, 9.0, 9.0, 9.0):
+        h.observe(v)
+    ev = make_eval(clock, registry=reg)
+    ev.add(new_slo("min", "metric:kubedl_min_seconds:p0", 2.0,
+                   goal=0.5, window_s=1e6))
+    clock.advance(1.0)
+    ev.evaluate(clock())
+    s = ev.status("min")
+    # p0 estimate sits in the first bucket (< 2.0) -> good; the p99
+    # (~10) would have been judged bad
+    assert s["samples"] == 1 and s["goodSamples"] == 1
+
+
+def test_spec_edit_resets_windows_and_delete_drops_state(api, clock):
+    api.create(new_slo("t", "ttft_p99", 1.0, window_s=1e6))
+    ev = _api_eval(api, clock)
+    ev.evaluate(clock())
+    feed(ev, clock, "ttft", [0.5] * 5)
+    assert ev.evaluate(clock())[0]["samples"] == 5
+    # target edit = a new objective: windows restart from zero
+    obj = api.get("SLO", "default", "t")
+    obj["spec"]["objective"]["target"] = 2.0
+    api.update(obj)
+    assert ev.evaluate(clock())[0]["samples"] == 0
+    api.delete("SLO", "default", "t")
+    assert ev.evaluate(clock()) == []
+    assert ev.status("t") is None
+
+
+def test_invalid_slo_object_is_skipped_not_fatal(api, clock):
+    api.create({"apiVersion": "slo.kubedl.io/v1alpha1", "kind": "SLO",
+                "metadata": {"name": "broken"},
+                "spec": {"signal": "nope_p99",
+                         "objective": {"target": 1.0}}})
+    # an out-of-range quantile must be rejected at PARSE time — an
+    # unchecked one would crash every evaluation pass (and with it
+    # every reconcile riding maybe_scan) inside Histogram.quantile
+    api.create({"apiVersion": "slo.kubedl.io/v1alpha1", "kind": "SLO",
+                "metadata": {"name": "bad-q"},
+                "spec": {"signal": "metric:kubedl_x",
+                         "objective": {"target": 1.0, "quantile": 5.0}}})
+    api.create(new_slo("ok", "ttft_p99", 1.0, window_s=1e6))
+    ev = _api_eval(api, clock, metrics=None)
+    ev.registry = Registry()
+    statuses = ev.evaluate(clock())
+    assert [s["name"] for s in statuses] == ["ok"]
+    listed = ev.statuses()
+    assert [s["name"] for s in listed] == ["ok", "bad-q", "broken"]
+    assert "quantile" in listed[1]["invalid"]
+    assert "unknown signal" in listed[2]["invalid"]
+
+
+def test_preset_uid_honored_for_slo_only(api, clock):
+    """The deterministic-replay seam: SLO creates keep a caller-set uid
+    (so the replay's control objects never consume the uid factory),
+    while every other kind still gets a fresh server-assigned uid — a
+    stale fetched dict must never recreate a job under its old
+    identity."""
+    obj = api.create(new_slo("pinned", "ttft_p99", 1.0, uid="slo-pinned"))
+    assert m.uid(obj) == "slo-pinned"
+    job = new_test_job("j", workers=1)
+    job["metadata"]["uid"] = "stale-uid"
+    created = api.create(job)
+    assert m.uid(created) != "stale-uid"
+
+
+# ---------------------------------------------------------------------------
+# signal feeds: gauge, registry metric, lifecycle traces, request spans
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_goodput_gauge_signal(clock):
+    class Acct:
+        jobs = 0
+
+        def fleet_goodput(self):
+            return self.ratio
+    acct = Acct()
+    ev = make_eval(clock, goodput=acct)
+    ev.add(new_slo("gp", "fleet_goodput", 0.3, goal=0.5, window_s=1e6))
+    ev.evaluate(clock())                      # jobs == 0: no sample yet
+    assert ev.status("gp")["samples"] == 0
+    acct.jobs, acct.ratio = 5, 0.6
+    clock.advance(1.0)
+    ev.evaluate(clock())
+    acct.ratio = 0.1
+    clock.advance(1.0)
+    s = ev.evaluate(clock())[0]
+    assert s["samples"] == 2 and s["goodSamples"] == 1
+
+
+def test_registry_metric_signals_histogram_and_gauge(clock):
+    reg = Registry()
+    h = reg.histogram("kubedl_step_seconds", "", (),
+                      buckets=(0.1, 0.5, 1.0, 5.0))
+    g = reg.gauge("kubedl_depth", "", ())
+    ev = make_eval(clock, registry=reg)
+    ev.add(new_slo("step-p50", "metric:kubedl_step_seconds:p50", 0.6,
+                   goal=0.5, window_s=1e6))
+    ev.add(new_slo("depth", "metric:kubedl_depth", 10.0, goal=0.5,
+                   window_s=1e6))
+    # never-written series yield NO samples (a typo'd family/selector
+    # must not fabricate an always-0.0 signal)
+    ev.evaluate(clock())
+    assert ev.status("step-p50")["samples"] == 0
+    assert ev.status("depth")["samples"] == 0
+    for v in (0.2, 0.2, 0.2, 2.0):
+        h.observe(v)
+    g.set(99.0)
+    clock.advance(1.0)
+    ev.evaluate(clock())
+    s = ev.status("step-p50")
+    assert s["samples"] == 1 and s["goodSamples"] == 1   # p50 ~ 0.3
+    d = ev.status("depth")
+    assert d["samples"] == 1 and d["goodSamples"] == 0   # 99 > 10
+    g.set(3.0)
+    clock.advance(1.0)
+    ev.evaluate(clock())
+    d = ev.status("depth")
+    assert d["samples"] == 2 and d["goodSamples"] == 1
+    # a selector key the family doesn't carry must yield NO samples —
+    # _Metric._key would silently drop it and read the wrong (global)
+    # series while the operator believes the objective is scoped
+    ev.add(new_slo("scoped", "metric:kubedl_depth", 10.0, goal=0.5,
+                   window_s=1e6, selector={"queue": "prod"}))
+    clock.advance(1.0)
+    ev.evaluate(clock())
+    assert ev.status("scoped")["samples"] == 0
+
+
+def test_histogram_quantile_against_percentile():
+    """The quantile estimator vs utils/stats.percentile on samples
+    spread uniformly through the buckets: linear interpolation within a
+    bucket must land within one bucket's width of the sample truth."""
+    reg = Registry()
+    h = reg.histogram("h", "", (), buckets=(0.25, 0.5, 0.75, 1.0))
+    samples = [i / 100.0 for i in range(1, 101)]          # 0.01..1.00
+    for v in samples:
+        h.observe(v)
+    for q in (0.25, 0.5, 0.9, 0.99):
+        est = h.quantile(q)
+        truth = percentile(samples, q, method="linear")
+        assert abs(est - truth) <= 0.05, (q, est, truth)
+    # exact at bucket boundaries
+    assert h.quantile(0.5) == pytest.approx(0.5)
+    assert h.quantile(1.0) == pytest.approx(1.0)
+
+
+def test_histogram_quantile_edges():
+    reg = Registry()
+    h = reg.histogram("h", "", ("kind",), buckets=(1.0, 2.0))
+    assert h.quantile(0.5, kind="a") is None              # empty
+    h.observe(99.0, kind="a")                             # +Inf only
+    assert h.quantile(0.99, kind="a") == pytest.approx(2.0)  # clamped
+    h.observe(0.5, kind="b")                              # labels route
+    assert h.quantile(0.5, kind="b") == pytest.approx(0.5)
+    assert h.quantile(0.5, kind="a") == pytest.approx(2.0)
+    with pytest.raises(ValueError):
+        h.quantile(1.5)
+
+
+def test_lifecycle_trace_feed_via_fleet_telemetry(api, clock):
+    """on_job_terminal feeds queue_delay + restart_mttr samples labelled
+    with the job's queue, and maybe_scan drives the evaluator."""
+    tr = trace.Tracer(enabled=True, clock=clock)
+    ev = _api_eval(api, clock)
+    api.create(new_slo("qd", "queue_delay_p99", 5.0, goal=0.5,
+                       window_s=1e6, selector={"queue": "prod"}))
+    api.create(new_slo("mttr", "restart_mttr_p50", 10.0, goal=0.5,
+                       window_s=1e6))
+    tel = FleetTelemetry(api, tr, job_kinds=("TestJob",), slo=ev)
+    api.create(new_test_job(
+        "j1", workers=1,
+        run_policy={"schedulingPolicy": {"queue": "prod"}}))
+    job = api.get("TestJob", "default", "j1")
+    tid, root = trace.job_trace_context(job)
+    t = clock()
+    plan = (("Queuing", 10.0), ("Running", 5.0), ("Restarting", 4.0),
+            ("Running", 20.0), ("Succeeded", 0.0))
+    for phase, dur in plan:
+        tr.record(phase, t, t + dur, trace_id=tid, parent_id=root,
+                  component="lifecycle",
+                  attributes={"phase": phase, "job": "default/j1"})
+        t += dur
+    tel.maybe_scan(clock())                  # registers the objectives
+    tel.on_job_terminal(job)
+    qd = ev.status("qd")
+    assert qd["samples"] == 1 and qd["goodSamples"] == 0   # 10s > 5s
+    mttr = ev.status("mttr")
+    assert mttr["samples"] == 1 and mttr["goodSamples"] == 1   # 4s <= 10s
+
+
+def test_request_span_harvester_dedup(clock):
+    tr = trace.Tracer(enabled=True, clock=clock)
+    ev = make_eval(clock, tracer=tr)
+    ev.add(new_slo("ttft", "ttft_p99", 1.0, window_s=1e6))
+    ev.add(new_slo("q", "queue_p99", 1.0, window_s=1e6))
+    t = clock()
+    tr.record("request.queue", t, t + 0.4, trace_id="a" * 32,
+              component="serving")
+    tr.record("request.prefill", t + 0.4, t + 0.9, trace_id="a" * 32,
+              component="serving")
+    tr.record("request.queue", t, t + 2.0, trace_id="b" * 32,
+              component="serving", attributes={"resumed": True})
+    ev.evaluate(clock())
+    assert ev.status("ttft")["samples"] == 1       # 0.9s TTFT, good
+    assert ev.status("ttft")["goodSamples"] == 1
+    assert ev.status("q")["samples"] == 1          # resumed excluded
+    ev.evaluate(clock())                           # same ring: no dupes
+    assert ev.status("ttft")["samples"] == 1
+    assert ev.status("q")["samples"] == 1
+
+
+def test_harvester_ring_clearing_mode_frees_completed_requests(clock):
+    """Review regression: in prune=False (ring-clearing) mode the
+    harvester frees a request's bookkeeping when its root span
+    completes — a day of tens of thousands of requests must not grow
+    _seen/_done/_qstart for the whole run."""
+    from kubedl_tpu.telemetry.slo import RequestSpanHarvester
+    harv = RequestSpanHarvester(prune=False)
+    t = clock()
+    for i in range(5):
+        tid = f"{i:032x}"
+        spans = [
+            trace.Span(tid, f"q{i}", "request.queue", t, t + 0.2),
+            trace.Span(tid, f"p{i}", "request.prefill", t + 0.2, t + 0.5),
+            trace.Span(tid, f"r{i}", "serving.request", t, t + 1.0),
+        ]
+        out = harv.feed(spans)        # cleared-ring batches
+        assert [o[0] for o in out] == ["queue", "ttft"]
+        t += 2.0
+    assert harv._seen == {} and harv._done == {}
+    assert harv._qstart == {} and harv._trace_spans == {}
+
+
+# ---------------------------------------------------------------------------
+# console + operator wiring
+# ---------------------------------------------------------------------------
+
+
+def _console(proxy):
+    return ConsoleServer(proxy, ConsoleConfig(host="127.0.0.1", port=0,
+                                              users={}))
+
+
+def _route(server, method, path, params=None):
+    status, payload, _ = server.route(method, path, params or {}, b"", None)
+    return status, payload
+
+
+def test_console_slo_endpoints(api, clock):
+    api.create(new_slo("ttft", "ttft_p99", 1.0, window_s=1e6))
+    ev = _api_eval(api, clock)
+    ev.evaluate(clock())
+    tr = trace.Tracer(enabled=True, clock=clock)
+    tel = FleetTelemetry(api, tr, job_kinds=("TestJob",), slo=ev)
+    server = _console(DataProxy(api, None, None, telemetry=tel))
+    try:
+        status, payload = _route(server, "GET", "/api/v1/slo/list")
+        assert status == 200
+        assert [s["name"] for s in payload["data"]] == ["ttft"]
+        status, payload = _route(server, "GET", "/api/v1/slo/status/ttft")
+        assert status == 200
+        assert payload["data"]["budgetRemaining"] == 1.0
+        status, _ = _route(server, "GET", "/api/v1/slo/status/ghost")
+        assert status == 404
+        # an EXISTING object with a bad spec answers 200 + the parse
+        # error (the drill-down must agree with the listing, not 404)
+        api.create({"apiVersion": "slo.kubedl.io/v1alpha1", "kind": "SLO",
+                    "metadata": {"name": "broke"},
+                    "spec": {"signal": "nope_p99",
+                             "objective": {"target": 1.0}}})
+        ev.evaluate(clock())
+        status, payload = _route(server, "GET",
+                                 "/api/v1/slo/status/broke")
+        assert status == 200
+        assert "unknown signal" in payload["data"]["invalid"]
+    finally:
+        server._httpd.server_close()
+
+
+def test_console_slo_501_when_gated_off(api, clock):
+    # telemetry on but SLO off is STILL 501 — the gates are separate
+    tr = trace.Tracer(enabled=True, clock=clock)
+    tel = FleetTelemetry(api, tr, job_kinds=("TestJob",))
+    for proxy in (DataProxy(api, None, None),
+                  DataProxy(api, None, None, telemetry=tel)):
+        server = _console(proxy)
+        try:
+            status, payload = _route(server, "GET", "/api/v1/slo/list")
+            assert status == 501 and "SLO engine" in payload["msg"]
+            status, _ = _route(server, "GET", "/api/v1/slo/status/x")
+            assert status == 501
+        finally:
+            server._httpd.server_close()
+
+
+def test_operator_gate_wiring_slo():
+    op = build_operator(APIServer(), OperatorConfig(workloads=[]))
+    assert op.telemetry is None
+    assert "kubedl_slo_" not in op.metrics_registry.expose()
+    gates = ft.FeatureGates()
+    gates.set(ft.SLO_ENGINE, True)
+    op2 = build_operator(APIServer(), OperatorConfig(workloads=[],
+                                                     feature_gates=gates))
+    # SLO implies telemetry implies tracing
+    assert op2.telemetry is not None and op2.telemetry.slo is not None
+    assert op2.tracer.enabled
+    assert "kubedl_slo_budget_remaining_ratio" in \
+        op2.metrics_registry.expose()
+    # the flag route works too, and telemetry-without-slo stays slo-less
+    op3 = build_operator(APIServer(), OperatorConfig(workloads=[],
+                                                     enable_slo=True))
+    assert op3.telemetry.slo is not None
+    op4 = build_operator(APIServer(), OperatorConfig(workloads=[],
+                                                     enable_telemetry=True))
+    assert op4.telemetry is not None and op4.telemetry.slo is None
+    assert "kubedl_slo_" not in op4.metrics_registry.expose()
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance e2e: TTFT SLO over the serving replay
+# ---------------------------------------------------------------------------
+
+
+def _crowd_window(arrivals, width=15.0):
+    """The densest ``width``-second arrival window (the flash crowd)."""
+    times = sorted(a.arrival_s for a in arrivals)
+    best, best_n, j = times[0], 0, 0
+    for i, t in enumerate(times):
+        while times[j] < t - width:
+            j += 1
+        if i - j + 1 > best_n:
+            best_n, best = i - j + 1, times[j]
+    return best, best + width
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("seed", [3, 11])
+def test_e2e_ttft_slo_burn_fires_once_and_clears(seed):
+    """Acceptance: one flash crowd in the serving day pushes TTFT past
+    the objective; the SLO fires exactly one SLOBudgetBurn Event + True
+    condition inside the crowd window, reports budget consumed within
+    1% of the hand-computed value from the same spans, and clears after
+    recovery."""
+    from kubedl_tpu.replay import ServingReplay
+    from kubedl_tpu.replay.workload import Profile, generate
+
+    profile = Profile(
+        name="slo-e2e", sim_seconds=3600.0, jobs=0, job_bursts=0,
+        burst_frac=0.0, chaos_preemptions=0, capacity={},
+        serving_requests=220, serving_bursts=1, serving_burst_frac=0.85,
+        lanes=2, max_len=64, kv_block=8, pool_blocks=48, prefixes=4,
+        serving_trace_capacity=16384)
+    wl = generate(profile, seed)
+    # drain_every=32: evaluate the burn windows while the crowd is hot
+    # (the bench default of 512 samples too coarsely for a 15s crowd)
+    replay = ServingReplay(wl, drain_every=32)
+    api = APIServer(clock=replay.clock)
+    target, goal = 0.6, 0.9
+    api.create(new_slo(
+        "serving-ttft", "ttft_p99", target, goal=goal,
+        window_s=4.0 * profile.sim_seconds,
+        alerting=_single_pair(short=30.0, long_=120.0, burn=3.0)))
+    mt = SLOMetrics(Registry())
+    replay.slo = SLOEvaluator(api=api, clock=replay.clock, metrics=mt,
+                              recorder=Recorder(api),
+                              evaluate_interval_s=5.0)
+    res = replay.run()
+    assert res["errors"] == 0
+
+    # exactly one onset + one recovery, in order
+    burns = [e for e in api.list("Event")
+             if e.get("reason") == REASON_SLO_BURN]
+    recovered = [e for e in api.list("Event")
+                 if e.get("reason") == REASON_SLO_RECOVERED]
+    assert len(burns) == 1, (seed, [a for a in replay.slo.alert_log])
+    assert len(recovered) == 1, (seed, replay.slo.alert_log)
+    assert [a["event"] for a in replay.slo.alert_log] == \
+        ["fire", "clear"], seed
+    assert mt.alerts.value(slo="serving-ttft", severity="page") == 1
+
+    # the onset lands inside the flash-crowd window (plus evaluation
+    # cadence slack)
+    lo, hi = _crowd_window(wl.serving)
+    t0 = replay.clock.t0
+    fire_t = replay.slo.alert_log[0]["t"] - t0
+    assert lo - 1.0 <= fire_t <= hi + 60.0, (seed, fire_t, lo, hi)
+
+    # cleared after recovery: condition False, Recovered event after Burn
+    obj = api.get("SLO", "default", "serving-ttft")
+    cond = [cd for cd in obj["status"]["conditions"]
+            if cd.get("type") == SLO_BURN_RATE]
+    assert len(cond) == 1 and cond[0]["status"] == "False"
+    assert cond[0]["reason"] == REASON_SLO_RECOVERED
+
+    # budget consumed matches the hand-computed value from the SAME
+    # spans the replay reports (the compliance window spans the run)
+    status = replay.slo.status("serving-ttft")
+    assert status["samples"] == len(res["ttfts_s"]) == len(wl.serving)
+    bad = sum(1 for v in res["ttfts_s"] if v > target)
+    hand = (bad / len(res["ttfts_s"])) / (1.0 - goal)
+    assert bad > 0, seed
+    assert status["budgetConsumed"] == pytest.approx(hand, rel=0.01), seed
+
+
+# ---------------------------------------------------------------------------
+# disabled path: byte-identical behavior (the PR 5/7 convention)
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_path_leaves_no_artifacts(clock):
+    """Gate off (the default): a chaos-seeded day leaves no SLO objects,
+    no SLOBurnRate conditions, no kubedl_slo_* metric families, and the
+    console endpoints answer 501."""
+    inner = APIServer(clock=clock)
+    chaos = ChaosAPIServer(inner, ChaosConfig(
+        seed=7, conflict_on_status_update=0.1, error_on_create=0.08,
+        max_faults=10))
+    op = build_operator(chaos, OperatorConfig(workloads=[]))
+    assert op.telemetry is None
+    manager = Manager(chaos, clock=clock)
+    engine = JobEngine(
+        chaos, TestJobController(),
+        EngineConfig(retry_policy=RetryPolicy(attempts=4, base=0.01,
+                                              cap=0.05),
+                     retry_sleep=clock.advance,
+                     backoff_jitter_seed=1))
+    assert engine.telemetry is None
+    manager.register(engine)
+    for i in range(3):
+        inner.create(new_test_job(f"plain-{i}", workers=2))
+        clock.advance(1.0)
+    manager.run_until_idle(max_iterations=2000)
+    run_all_pods(chaos)
+    manager.run_until_idle(max_iterations=2000)
+    for pod in inner.list("Pod"):
+        set_pod_phase(chaos, pod, "Succeeded", exit_code=0)
+    manager.run_until_idle(max_iterations=2000)
+    for i in range(3):
+        job = inner.get("TestJob", "default", f"plain-{i}")
+        assert st.is_succeeded(c.JobStatus.from_dict(job.get("status")))
+        assert not any(cd.get("type") == SLO_BURN_RATE
+                       for cd in m.get_in(job, "status", "conditions",
+                                          default=[]) or [])
+    assert inner.list("SLO") == []
+    assert not any(e.get("reason") in (REASON_SLO_BURN,
+                                       REASON_SLO_RECOVERED)
+                   for e in inner.list("Event"))
+    assert "kubedl_slo_" not in op.metrics_registry.expose()
+    server = _console(DataProxy(inner, None, None))
+    try:
+        status, _ = _route(server, "GET", "/api/v1/slo/list")
+        assert status == 501
+        status, _ = _route(server, "GET", "/api/v1/slo/status/x")
+        assert status == 501
+        status, _ = _route(server, "GET", "/api/v1/telemetry/goodput")
+        assert status == 501
+    finally:
+        server._httpd.server_close()

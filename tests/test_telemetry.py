@@ -591,6 +591,31 @@ def test_job_detail_goodput_field_gated(api, clock):
         off._httpd.server_close()
 
 
+def test_console_fleet_goodput_endpoint(api, clock):
+    """/api/v1/telemetry/goodput serves the GoodputAccountant's fleet
+    rollup — the number BENCH_CLUSTER gates on — and answers 501 with
+    the telemetry gate off (byte-identical disabled path)."""
+    tr = make_tracer(clock)
+    tel = FleetTelemetry(api, tr, job_kinds=("TestJob",))
+    bd = _fake_breakdown(tr, clock, ckpt_s=2.5)
+    tel.goodput.observe(bd)
+    on = _console(DataProxy(api, None, None, tracer=tr, telemetry=tel))
+    off = _console(DataProxy(api, None, None, tracer=tr))
+    try:
+        status, payload = _route(on, "GET", "/api/v1/telemetry/goodput")
+        assert status == 200
+        data = payload["data"]
+        assert data["jobsObserved"] == 1
+        assert data["fleetGoodput"] == pytest.approx(27.5 / 50.0)
+        assert data["overheadSeconds"]["checkpoint"] == pytest.approx(2.5)
+        status, payload = _route(off, "GET", "/api/v1/telemetry/goodput")
+        assert status == 501
+        assert "telemetry" in payload["msg"]
+    finally:
+        on._httpd.server_close()
+        off._httpd.server_close()
+
+
 def test_operator_gate_wiring():
     op = build_operator(APIServer(), OperatorConfig(workloads=[]))
     assert op.telemetry is None
