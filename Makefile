@@ -39,9 +39,13 @@ bench-controlplane:
 test-scheduler:
 	$(PY) -m pytest tests/ -q -m scheduler
 
-# slice-scheduler policy value on a deterministic synthetic trace: FCFS
-# head-of-line baseline vs queues+quota+backfill -> BENCH_SCHEDULER.json
-# (docs/scheduling.md); gate: >=1.3x slice utilization, no worse makespan
+# slice-scheduler policy value on deterministic synthetic traces: FCFS
+# head-of-line baseline vs queues+quota+backfill, plus the heterogeneous
+# placement leg (unscored vs scored pool choice with a spot outage) ->
+# BENCH_SCHEDULER.json (docs/scheduling.md). Gates: >=1.3x slice
+# utilization at no worse makespan, >=1.25x normalized throughput with
+# >=90% ICI-packed multislice gangs; FAILS on regression vs the
+# committed artifact (per-metric tolerances, like bench-cluster)
 bench-scheduler:
 	JAX_PLATFORMS=cpu $(PY) bench_scheduler.py
 

@@ -1,5 +1,5 @@
-"""Slice-scheduler policy benchmark: one deterministic synthetic trace,
-two admission policies, one JSON line.
+"""Slice-scheduler policy benchmark: deterministic synthetic traces,
+three admission policies, one JSON line.
 
 ``bench_controlplane.py`` measures how fast the operator settles jobs;
 this one measures how well the *scheduler* uses finite slice inventory.
@@ -18,13 +18,28 @@ Both runs report makespan, slice utilization (busy slice-seconds over
 capacity x makespan), and p50/p99 queueing delay. Gate (the ISSUE 4
 acceptance): scheduler utilization >= 1.3x FCFS at no worse makespan.
 
-The trace is the classic head-of-line pathology: a large multislice job
-blocks the FIFO while a different pool sits idle. Everything is seeded /
-literal — no wall clock, no RNG — so the JSON is reproducible bit-for-bit.
+A second, **heterogeneous** trace (ISSUE 9) replays a mixed fleet —
+per-(kind, pool) tokens/s spread >= 2x, a premium on-demand v5p pool vs
+a cheap spot v5e pool, multi-slice gangs, and a scripted mid-day spot
+outage — twice through the same scheduler: once unscored (jobs pinned
+to their routed pool) and once with ``--enable-placement-scoring``
+semantics (pool-eligibility sets + the throughput/contention/cost
+score, seeded from measured rates). Job durations are honest:
+``tokens / rate(kind, chosen pool)``, so a bad placement costs real
+simulated time. Gate: scored placement >= 1.25x aggregate normalized
+throughput at no worse makespan, with >= 90% of multi-slice gangs
+ICI-domain-packed.
+
+The JSON also self-checks against the committed artifact at ``--out``
+(per-metric tolerances, exactly like the cluster scorecard) and exits
+non-zero on regression.
+
+Everything is seeded / literal — no wall clock, no RNG — so the JSON is
+reproducible bit-for-bit (the ``timestamp``/wall fields aside).
 
 Usage::
 
-    python bench_scheduler.py [--out BENCH_SCHEDULER.json]
+    python bench_scheduler.py [--out BENCH_SCHEDULER.json] [--no-check]
 """
 
 from __future__ import annotations
@@ -32,6 +47,8 @@ from __future__ import annotations
 import argparse
 import heapq
 import json
+import os
+import sys
 import time
 
 from kubedl_tpu.api import common as c
@@ -247,19 +264,284 @@ def run_scheduler(trace: list) -> dict:
     return out
 
 
+# ---------------------------------------------------------------------------
+# the heterogeneous placement leg (ISSUE 9): unscored vs scored placement
+# ---------------------------------------------------------------------------
+
+PLACEMENT_CAPACITY = {POOL_A: 8, POOL_B: 8}
+#: measured tokens/s per slice, per (kind, pool) — the BENCH_r0*-style
+#: seed the ThroughputProfileStore is primed with (>= 2x spread for
+#: train, near-parity for the others so cost decides them)
+PLACEMENT_RATES = {
+    "train":    {POOL_A: 4000.0, POOL_B: 800.0},
+    "finetune": {POOL_A: 1500.0, POOL_B: 1400.0},
+    "serve":    {POOL_A: 1000.0, POOL_B: 1100.0},
+}
+#: $/chip-hour: premium on-demand v5p vs cheap spot v5e
+PLACEMENT_COSTS = {POOL_A: (3.0, False), POOL_B: (1.0, True)}
+#: the scripted spot outage: every admitted POOL_B gang is evicted at
+#: t=OUT and the pool stays dry until t=BACK (evictions ride the same
+#: delete-and-readmit path scheduler preemptions use)
+SPOT_OUTAGE = (700.0, 1500.0)
+
+
+def build_placement_trace() -> list:
+    """(arrival_s, job, kind, primary_pool, slices, tokens) —
+    deterministic. The primary pool is the legacy routing (whatever pool
+    the job kind historically ran on): heavy train jobs land on the
+    cheap-but-5x-slower spot pool, light finetune/serve jobs hog the
+    premium pool — exactly the misrouting throughput-aware scoring is
+    supposed to fix."""
+    trace = []
+    for i in range(10):
+        # big multislice pretrain gangs, legacy-routed to the SLOW pool
+        trace.append((10.0 * i, f"tr-{i:02d}", "train", POOL_B, 2,
+                      1_200_000.0))
+    for i in range(16):
+        # light finetunes, legacy-routed to the premium pool
+        trace.append((5.0 + 10.0 * i, f"ft-{i:02d}", "finetune", POOL_A,
+                      1, 450_000.0))
+    for i in range(12):
+        # serving bake-offs: near-parity throughput, cost should decide
+        trace.append((8.0 + 15.0 * i, f"sv-{i:02d}", "serve", POOL_A, 1,
+                      300_000.0))
+    return sorted(trace, key=lambda t: (t[0], t[1]))
+
+
+def _placement_pgs(api, job, kind, pool, slices):
+    names = []
+    for sid in range(slices):
+        name = job if slices == 1 else f"{job}-slice-{sid}"
+        pg = m.new_obj("scheduling.sigs.k8s.io/v1alpha1", "PodGroup", name,
+                       labels={c.LABEL_GANG_JOB_NAME: job},
+                       annotations={
+                           c.ANNOTATION_SCHED_POOL: pool,
+                           c.ANNOTATION_SCHED_QUEUE: "default",
+                           c.ANNOTATION_SCHED_NUM_SLICES: str(slices),
+                           c.ANNOTATION_SCHED_PRIORITY: "0",
+                           c.ANNOTATION_SCHED_POOLS:
+                               f"{POOL_A},{POOL_B}",
+                           c.ANNOTATION_SCHED_PROFILE: kind,
+                       })
+        pg["spec"] = {"minMember": 1}
+        api.create(pg)
+        names.append(name)
+    return names
+
+
+def run_placement(trace: list, scored: bool) -> dict:
+    """Replay the heterogeneous trace through the real scheduler; with
+    ``scored`` the scheduler carries a PlacementScorer primed from
+    PLACEMENT_RATES (the measured-seed path), without it jobs stay on
+    their routed primary pool. Durations are tokens / rate(kind, chosen
+    pool); a spot outage mid-day evicts every POOL_B gang."""
+    from kubedl_tpu.core.apiserver import NotFound
+    from kubedl_tpu.scheduling.inventory import PoolEconomics
+    from kubedl_tpu.scheduling.scoring import PlacementScorer
+    from kubedl_tpu.telemetry.profiles import ThroughputProfileStore
+
+    clock = SimClock()
+    api = APIServer(clock=clock)
+    manager = Manager(api, clock=clock)
+    inv = SliceInventory(
+        api, static_capacity=dict(PLACEMENT_CAPACITY),
+        economics={p: PoolEconomics(cost, spot=spot)
+                   for p, (cost, spot) in PLACEMENT_COSTS.items()})
+    scorer = None
+    if scored:
+        store = ThroughputProfileStore(clock=clock)
+        for kind, rates in sorted(PLACEMENT_RATES.items()):
+            for pool, rate in sorted(rates.items()):
+                store.observe_rate(kind, pool, rate)
+        scorer = PlacementScorer(inv, profiles=store)
+    sched = SliceScheduler(api, inventory=inv,
+                           metrics=SchedulerMetrics(), scorer=scorer)
+    manager.register(sched)
+
+    meta = {t[1]: t for t in trace}
+    pg_names: dict[str, list] = {}
+    tokens_left = {t[1]: t[5] for t in trace}
+    admit_info: dict[str, tuple] = {}    # job -> (admit_t, rate, pool)
+    pending_arrivals = list(trace)
+    completions: list = []               # (end_t, job, admit_t token)
+    finished: set = set()
+    records: dict[str, tuple] = {}       # job -> (first_admit_t, end_t)
+    arrivals = {t[1]: t[0] for t in trace}
+    ms_observed = ms_packed = 0
+    spot_evictions = 0
+    outage_events = [(SPOT_OUTAGE[0], "out"), (SPOT_OUTAGE[1], "back")]
+    cost_dollars = 0.0
+    norm_weighted = norm_weight = 0.0
+
+    def drop_gang(job):
+        for name in pg_names[job]:
+            try:
+                api.delete("PodGroup", "default", name)
+            except NotFound:
+                pass
+
+    def settle(job, now):
+        """Bank a running job's progress up to ``now`` and clear it.
+        Normalized-throughput weights accrue here over the seconds the
+        job ACTUALLY ran on its pool — weighting planned durations at
+        admission would double-count the never-run tail of every
+        evicted gang, and differently per leg."""
+        nonlocal cost_dollars, norm_weighted, norm_weight
+        t_adm, rate, pool = admit_info.pop(job)
+        ran = max(now - t_adm, 0.0)
+        tokens_left[job] = max(tokens_left[job] - rate * ran, 0.0)
+        _, _, kind, _pp, slices, _tok = meta[job]
+        cost, _spot = PLACEMENT_COSTS[pool]
+        cost_dollars += slices * 16 * cost * ran / 3600.0
+        best = max(PLACEMENT_RATES[kind].values())
+        norm_weighted += (rate / best) * slices * ran
+        norm_weight += slices * ran
+
+    while len(finished) < len(trace):
+        nxt = []
+        if pending_arrivals:
+            nxt.append(pending_arrivals[0][0])
+        if completions:
+            nxt.append(completions[0][0])
+        if outage_events:
+            nxt.append(outage_events[0][0])
+        if not nxt:
+            raise RuntimeError("placement leg wedged")
+        sim_t = min(nxt)
+        clock.advance_to(sim_t)
+        while pending_arrivals and pending_arrivals[0][0] <= sim_t:
+            _, job, kind, pool, slices, _tok = pending_arrivals.pop(0)
+            pg_names[job] = _placement_pgs(api, job, kind, pool, slices)
+        while completions and completions[0][0] <= sim_t:
+            _, job, token = heapq.heappop(completions)
+            if job in finished or admit_info.get(job, (None,))[0] != token:
+                continue                 # stale (evicted meanwhile)
+            settle(job, sim_t)
+            records[job] = (records[job][0], sim_t)
+            drop_gang(job)
+            finished.add(job)
+        while outage_events and outage_events[0][0] <= sim_t:
+            _, what = outage_events.pop(0)
+            if what == "out":
+                inv.static_capacity[POOL_B] = 0
+                for job in sorted(admit_info):
+                    if admit_info[job][2] == POOL_B:
+                        settle(job, sim_t)
+                        drop_gang(job)
+                        spot_evictions += 1
+                        _, _, kind, pool, slices, _tok = meta[job]
+                        pg_names[job] = _placement_pgs(
+                            api, job, kind, pool, slices)
+            else:
+                inv.static_capacity[POOL_B] = PLACEMENT_CAPACITY[POOL_B]
+            sched.schedule_pass()
+        manager.run_until_idle(max_iterations=1_000_000)
+        # collect fresh admissions; duration derives from the CHOSEN pool
+        for job in sorted(pg_names):
+            if job in finished or job in admit_info:
+                continue
+            pgs = [api.try_get("PodGroup", "default", n)
+                   for n in pg_names[job]]
+            if not all(p is not None and is_gang_admitted(p)
+                       for p in pgs):
+                continue
+            pool = m.get_annotations(pgs[0])[c.ANNOTATION_SCHED_POOL]
+            _, _, kind, _pp, slices, _tok = meta[job]
+            rate = PLACEMENT_RATES[kind][pool]
+            dur = tokens_left[job] / rate
+            admit_info[job] = (sim_t, rate, pool)
+            records.setdefault(job, (sim_t, sim_t))
+            heapq.heappush(completions, (sim_t + dur, job, sim_t))
+            if slices > 1:
+                spans = inv.gang_domains("default", job, pool)
+                if spans is not None:
+                    ms_observed += 1
+                    ms_packed += 1 if spans <= 1 else 0
+
+    makespan = max(r[1] for r in records.values()) - min(
+        arrivals.values())
+    total_tokens = sum(t[5] for t in trace)
+    out = {
+        "jobs": len(trace),
+        "makespan_s": round(makespan, 1),
+        "tokens_per_s": round(total_tokens / makespan, 1),
+        "normalized_throughput": round(
+            norm_weighted / norm_weight, 4) if norm_weight else 0.0,
+        "ici_packed_fraction": round(ms_packed / ms_observed, 4)
+        if ms_observed else 1.0,
+        "multi_slice_gangs": ms_observed,
+        "spot_evictions": spot_evictions,
+        "spot_evictions_survived": spot_evictions,  # all jobs complete
+        "cost_dollars": round(cost_dollars, 2),
+        "scheduling_passes": sched.passes,
+    }
+    if scored:
+        out["scored_placements"] = sum(
+            sched.metrics.scored_placements.value(pool=p)
+            for p in PLACEMENT_CAPACITY)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# regression check vs the committed artifact (satellite of ISSUE 9 —
+# the scheduler bench gets the same teeth the cluster scorecard has)
+# ---------------------------------------------------------------------------
+
+#: (path, direction, relative slack, absolute grace)
+REGRESSION_RULES = (
+    ("utilization_ratio", "higher_better", 0.03, 0.02),
+    ("scheduler.slice_utilization", "higher_better", 0.03, 0.01),
+    ("scheduler.makespan_s", "lower_better", 0.05, 5.0),
+    ("scheduler.queue_delay_p50_s", "lower_better", 0.10, 5.0),
+    ("scheduler.scheduling_passes", "lower_better", 0.20, 20.0),
+    ("placement.throughput_ratio", "higher_better", 0.03, 0.02),
+    ("placement.normalized_throughput_ratio", "higher_better",
+     0.03, 0.02),
+    ("placement.scored.ici_packed_fraction", "higher_better",
+     0.03, 0.02),
+    ("placement.scored.cost_dollars", "lower_better", 0.10, 5.0),
+)
+
+
+def check_regression(new: dict, old: dict) -> list:
+    """Per-metric tolerance comparison against the committed
+    BENCH_SCHEDULER.json — the cluster scorecard's shared tolerance
+    engine with this bench's rule table. Metrics absent from either
+    side are skipped, so a first run against an older artifact only
+    checks what both know."""
+    from kubedl_tpu.replay.scorecard import check_tolerances
+    return check_tolerances(new, old, REGRESSION_RULES)
+
+
 def main() -> dict:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--out", default="BENCH_SCHEDULER.json")
+    ap.add_argument("--no-check", action="store_true",
+                    help="skip the regression check against the "
+                         "committed artifact at --out")
     args = ap.parse_args()
 
     trace = build_trace()
     t0 = time.perf_counter()
     fcfs = run_fcfs(trace)
     sched = run_scheduler(trace)
+
+    # the heterogeneous placement leg: same scheduler, unscored vs scored
+    ptrace = build_placement_trace()
+    unscored = run_placement(ptrace, scored=False)
+    scored = run_placement(ptrace, scored=True)
     wall = time.perf_counter() - t0
 
     ratio = round(sched["slice_utilization"]
                   / max(fcfs["slice_utilization"], 1e-9), 2)
+    tokens_ratio = round(scored["tokens_per_s"]
+                         / max(unscored["tokens_per_s"], 1e-9), 2)
+    norm_ratio = round(scored["normalized_throughput"]
+                       / max(unscored["normalized_throughput"], 1e-9), 2)
+    placement_gate = bool(
+        norm_ratio >= 1.25
+        and scored["makespan_s"] <= unscored["makespan_s"] + 1e-6
+        and scored["ici_packed_fraction"] >= 0.9)
     result = {
         "benchmark": "slice_scheduler_trace",
         "capacity_slices": CAPACITY,
@@ -270,6 +552,23 @@ def main() -> dict:
         "utilization_ratio": ratio,
         "makespan_ratio": round(fcfs["makespan_s"]
                                 / max(sched["makespan_s"], 1e-9), 2),
+        "placement": {
+            "capacity_slices": PLACEMENT_CAPACITY,
+            "rates_tokens_per_s": PLACEMENT_RATES,
+            "cost_per_chip_hour": {p: c for p, (c, _s)
+                                   in PLACEMENT_COSTS.items()},
+            "spot_pools": [p for p, (_c, s)
+                           in PLACEMENT_COSTS.items() if s],
+            "spot_outage_s": list(SPOT_OUTAGE),
+            "trace_jobs": len(ptrace),
+            "unscored": unscored,
+            "scored": scored,
+            "throughput_ratio": tokens_ratio,
+            "normalized_throughput_ratio": norm_ratio,
+            "gate_normalized_min": 1.25,
+            "gate_packed_min": 0.9,
+            "gate_passed": placement_gate,
+        },
         "bench_wall_seconds": round(wall, 2),
         "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
         # the acceptance gate: >=1.3x utilization at no worse makespan
@@ -284,6 +583,26 @@ def main() -> dict:
             f"GATE FAILED: utilization ratio {ratio} (need >= 1.3) or "
             f"makespan regressed ({sched['makespan_s']} vs "
             f"{fcfs['makespan_s']})")
+    if not placement_gate:
+        raise SystemExit(
+            f"PLACEMENT GATE FAILED: normalized-throughput ratio "
+            f"{norm_ratio} (need >= 1.25) at makespan "
+            f"{scored['makespan_s']} vs {unscored['makespan_s']}, "
+            f"packed fraction {scored['ici_packed_fraction']} "
+            f"(need >= 0.9)")
+    if not args.no_check and args.out and os.path.exists(args.out):
+        try:
+            with open(args.out) as f:
+                committed = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"warning: cannot read committed {args.out}: {e}",
+                  file=sys.stderr)
+            committed = {}
+        problems = check_regression(result, committed)
+        if problems:
+            # keep the committed baseline intact on regression
+            raise SystemExit("REGRESSION vs committed scheduler bench:"
+                             "\n  " + "\n  ".join(problems))
     if args.out:
         with open(args.out, "w") as f:
             json.dump(result, f, indent=2, sort_keys=True)

@@ -54,6 +54,18 @@ def parse_args(argv=None) -> argparse.Namespace:
                    help='static slice inventory "POOL=N,..." (e.g. '
                         '"tpu-v5p-slice/2x2x4=4") when the control plane '
                         "has no Node objects; default derives from Nodes")
+    p.add_argument("--enable-placement-scoring", action="store_true",
+                   help="throughput-, contention-, and cost-aware slice "
+                        "placement: pool-eligibility sets, scored "
+                        "admission, ICI-domain packing, spot pools "
+                        "(docs/scheduling.md; also TPUPlacementScoring "
+                        "gate; requires the slice scheduler)")
+    p.add_argument("--pool-cost", default="",
+                   help='static pool economics "POOL=COST[:spot],..." in '
+                        "$/chip-hour for the placement score; default "
+                        "derives from Node labels "
+                        "(kubedl.io/cost-per-chip-hour, "
+                        "cloud.google.com/gke-spot)")
     p.add_argument("--max-reconciles", type=int, default=4)
     p.add_argument("--model-image-builder", default="",
                    help="builder image for ModelVersion image builds")
@@ -127,6 +139,8 @@ def config_from_args(args: argparse.Namespace) -> OperatorConfig:
         trace_buffer=args.trace_buffer,
         enable_telemetry=args.enable_telemetry,
         enable_slo=args.enable_slo,
+        enable_placement_scoring=args.enable_placement_scoring,
+        pool_cost=args.pool_cost,
     )
 
 

@@ -60,6 +60,17 @@ ANNOTATION_SCHED_POOL = KUBEDL_PREFIX + "/scheduler-pool"
 ANNOTATION_SCHED_QUEUE = KUBEDL_PREFIX + "/scheduler-queue"
 ANNOTATION_SCHED_NUM_SLICES = KUBEDL_PREFIX + "/scheduler-num-slices"
 ANNOTATION_SCHED_PRIORITY = KUBEDL_PREFIX + "/scheduler-priority"
+#: comma-joined pool eligibility set (docs/scheduling.md "Placement
+#: scoring"): every pool that can host the gang's shape — compatible
+#: generations from tpu/topology.py, or the job's explicit
+#: schedulingPolicy.pools allowlist. Consumed only when the
+#: TPUPlacementScoring gate is on; the primary scheduler-pool annotation
+#: stays authoritative otherwise.
+ANNOTATION_SCHED_POOLS = KUBEDL_PREFIX + "/scheduler-pools"
+#: throughput-profile key of the job (kind, lowercased — the same default
+#: key the telemetry layer folds train.step spans under), letting the
+#: scheduler look the gang up in the ThroughputProfileStore
+ANNOTATION_SCHED_PROFILE = KUBEDL_PREFIX + "/scheduler-profile"
 #: W3C-traceparent-style trace context (docs/tracing.md): client-settable
 #: on jobs; the engine stamps it when tracing is on and propagates it to
 #: PodGroups (for the scheduler) and into pods via $KUBEDL_TRACEPARENT
@@ -223,6 +234,16 @@ class SchedulingPolicy:
     priority: Optional[int] = None
     priority_class_name: str = ""
     queue: str = ""
+    #: explicit pool-eligibility allowlist (docs/scheduling.md "Placement
+    #: scoring"): restricts the scored candidate set to exactly these
+    #: inventory pool keys; empty = shape-compatible pools
+    pools: tuple = ()
+    #: throughput-profile key override for the placement scorer: set it
+    #: to the model id the job trains/serves so placement reads the
+    #: MODEL's learned ThroughputProfile (train.step spans with a model
+    #: attribute and all serving stats persist under model keys); empty
+    #: = the job kind, lowercased
+    profile: str = ""
 
     @classmethod
     def from_dict(cls, d: Optional[dict]):
@@ -233,6 +254,8 @@ class SchedulingPolicy:
             priority=d.get("priority"),
             priority_class_name=d.get("priorityClassName", ""),
             queue=d.get("queue", ""),
+            pools=tuple(d.get("pools", []) or []),
+            profile=str(d.get("profile", "") or ""),
         )
 
     def to_dict(self) -> dict:

@@ -390,8 +390,64 @@ class DataProxy:
     def queue_usage(self, name: str) -> Optional[dict]:
         for r in self.list_queues():
             if r["name"] == name:
+                if self.placement_enabled:
+                    # scored-placement detail (docs/scheduling.md
+                    # "Placement scoring"): where this queue's slices
+                    # actually sit, priced — only with the gate on, so
+                    # the ungated response stays byte-identical
+                    inv = self.scheduler.inventory
+                    by_pool: dict[str, int] = {}
+                    for h in inv.held_records():
+                        if h.queue == name:
+                            by_pool[h.pool] = by_pool.get(h.pool, 0) + 1
+                    r["pools"] = {
+                        pool: {
+                            "heldSlices": n,
+                            "costPerChipHour":
+                                inv.economics(pool).cost_per_chip_hour,
+                            "spot": inv.economics(pool).spot,
+                        } for pool, n in sorted(by_pool.items())}
                 return r
         return None
+
+    # -- pools (placement scoring, docs/scheduling.md) --------------------
+
+    @property
+    def placement_enabled(self) -> bool:
+        return (self.scheduler is not None
+                and getattr(self.scheduler, "scorer", None) is not None)
+
+    def pool_table(self) -> list:
+        """Per-pool placement facts for ``/api/v1/pools``: capacity /
+        held / free, $/chip-hour + spot class, the ICI-domain free map,
+        the static throughput seed, and per-profile normalized
+        throughput from the live ThroughputProfileStore."""
+        from ..scheduling import scoring
+        from ..tpu import topology
+        inv = self.scheduler.inventory
+        scorer = self.scheduler.scorer
+        norm_by_pool: dict[str, dict] = {}
+        store = scorer.profiles if scorer is not None else None
+        if store is not None:
+            for key in store.snapshot():
+                for pool, v in store.normalized(key).items():
+                    norm_by_pool.setdefault(pool, {})[key] = round(v, 4)
+        rows = []
+        for pool in sorted(inv.pools()):
+            econ = inv.economics(pool)
+            rows.append({
+                "pool": pool,
+                "capacitySlices": inv.capacity_slices(pool),
+                "heldSlices": inv.held_slices(pool),
+                "freeSlices": inv.free_slices(pool),
+                "costPerChipHour": econ.cost_per_chip_hour,
+                "spot": econ.spot,
+                "slicesPerIciDomain": topology.pool_ici_slices(pool),
+                "iciDomainFree": inv.domain_free_map(pool),
+                "seedTokensPerSecond": round(scoring.seed_rate(pool), 4),
+                "normalizedThroughput": norm_by_pool.get(pool, {}),
+            })
+        return rows
 
     # -- traces (docs/tracing.md) -----------------------------------------
 

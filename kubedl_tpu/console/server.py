@@ -520,6 +520,18 @@ class ConsoleServer:
                 raise NotFound(f"queue {mt.group(1)} not found")
             return ok(row)
 
+        # per-pool placement table (docs/scheduling.md "Placement
+        # scoring"): cost, spot class, ICI-domain free map, normalized
+        # throughput; 501 with the scoring gate off, matching the trace
+        # endpoints' convention
+        if path == "/api/v1/pools":
+            if not self.proxy.placement_enabled:
+                return 501, {"code": 501,
+                             "msg": "placement scoring disabled "
+                                    "(--enable-placement-scoring / "
+                                    "TPUPlacementScoring gate)"}, []
+            return ok(self.proxy.pool_table())
+
         mt = re.fullmatch(r"/api/v1/event/events/([^/]+)/([^/]+)", path)
         if mt:
             ns, name = mt.groups()

@@ -97,6 +97,17 @@ class OperatorConfig:
     #: default: no evaluator exists, no kubedl_slo_* metric families
     #: register, the slo endpoints answer 501.
     enable_slo: bool = False
+    #: throughput-, contention-, and cost-aware slice placement
+    #: (docs/scheduling.md "Placement scoring"). Also switchable via the
+    #: TPUPlacementScoring gate; either turns it on. Requires the slice
+    #: scheduler; off by default — the unscored admission pass stays
+    #: byte-identical.
+    enable_placement_scoring: bool = False
+    #: static per-pool economics "POOL=COST[:spot],..." in $/chip-hour
+    #: (e.g. "tpu-v5p-slice/2x2x4=4.2,tpu-v5-lite-podslice/4x4=1.1:spot")
+    #: for control planes whose Nodes carry no cost/spot labels; empty =
+    #: derive from Node labels ($KUBEDL_POOL_COST overrides)
+    pool_cost: str = ""
 
 
 @dataclass
@@ -242,15 +253,32 @@ def build_operator(api: Optional[APIServer] = None,
     scheduler = None
     if sched_enabled:
         from ..metrics.registry import SchedulerMetrics
-        from ..scheduling.inventory import SliceInventory, parse_capacity_spec
+        from ..scheduling.inventory import (SliceInventory,
+                                            parse_capacity_spec,
+                                            parse_pool_cost_spec)
         from ..scheduling.scheduler import SliceScheduler
         cap_spec = (os.environ.get("KUBEDL_SLICE_CAPACITY", "")
                     or config.slice_capacity)
+        cost_spec = (os.environ.get("KUBEDL_POOL_COST", "")
+                     or config.pool_cost)
         inventory = SliceInventory(
-            api, static_capacity=parse_capacity_spec(cap_spec))
+            api, static_capacity=parse_capacity_spec(cap_spec),
+            economics=parse_pool_cost_spec(cost_spec))
+        scorer = None
+        if config.enable_placement_scoring \
+                or gates.enabled(ft.TPU_PLACEMENT_SCORING):
+            # scored placement (docs/scheduling.md): profiles come from
+            # the telemetry bundle when it exists (learned online), else
+            # the scorer runs on the static generation seeds alone
+            from ..scheduling.scoring import PlacementScorer
+            scorer = PlacementScorer(
+                inventory,
+                profiles=telemetry.profiles
+                if telemetry is not None else None)
         scheduler = SliceScheduler(api, inventory=inventory,
                                    metrics=SchedulerMetrics(registry),
-                                   recorder=recorder, tracer=tracer)
+                                   recorder=recorder, tracer=tracer,
+                                   scorer=scorer)
         manager.register(scheduler)
 
     # admission chain: defaulting + validation at create/update (reference

@@ -38,6 +38,14 @@ FLEET_TELEMETRY = "FleetTelemetry"
 #: enabling it also turns on telemetry (and with it the tracer), since
 #: the evaluator samples the signals those layers produce
 SLO_ENGINE = "SLOEngine"
+#: throughput-, contention-, and cost-aware slice placement
+#: (docs/scheduling.md "Placement scoring"): gangs carry pool-eligibility
+#: sets, admission scores every eligible pool as normalized-throughput /
+#: (ICI-contention-penalty x $/chip-hour), multi-slice gangs pack into
+#: one ICI domain when possible, spot pools join the fleet; off by
+#: default — the unscored pass stays byte-identical (pinned by test).
+#: Requires the slice scheduler (the gate is a no-op without it).
+TPU_PLACEMENT_SCORING = "TPUPlacementScoring"
 
 _DEFAULTS = {
     GANG_SCHEDULING: True,           # Beta
@@ -50,6 +58,7 @@ _DEFAULTS = {
     TRACING: False,                  # Alpha
     FLEET_TELEMETRY: False,          # Alpha
     SLO_ENGINE: False,               # Alpha
+    TPU_PLACEMENT_SCORING: False,    # Alpha
 }
 
 ENV_FEATURE_GATES = "KUBEDL_FEATURE_GATES"

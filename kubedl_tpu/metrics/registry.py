@@ -333,6 +333,16 @@ class SchedulerMetrics:
             "kubedl_scheduler_queue_wait_seconds",
             "Gang creation to admission, per queue", ("queue",),
             buckets=_QUEUE_WAIT_BUCKETS)
+        # placement scoring (docs/scheduling.md "Placement scoring");
+        # the families register unconditionally, they only move while
+        # the TPUPlacementScoring gate is on
+        self.scored_placements = r.counter(
+            "kubedl_scheduler_scored_placements_total",
+            "Scored gang placements, per chosen pool", ("pool",))
+        self.ici_straddled = r.counter(
+            "kubedl_scheduler_ici_straddled_total",
+            "Scored placements spanning more than one ICI domain",
+            ("pool",))
 
 
 class TelemetryMetrics:

@@ -52,7 +52,8 @@ from ..trace.analysis import (assert_well_formed, restart_mttrs,
                               trace_breakdown)
 from ..utils import status as st
 from ..utils.retry import RetryPolicy
-from .workload import (HOSTS_PER_SLICE, POOL_ACCELERATOR, QUEUES, Workload)
+from .workload import (HOSTS_PER_SLICE, POOL_ACCELERATOR, POOL_CHIPS,
+                       POOL_COSTS, POOL_SPOT, QUEUES, Workload)
 
 #: event kinds, in same-time processing order (arrivals before
 #: completions before preemptions before retirements keeps ties stable)
@@ -203,6 +204,18 @@ class ClusterReplay:
         self._util_slice_seconds = 0.0
         self._last_t: Optional[float] = None
         self.rounds = 0
+        # placement telemetry (docs/scheduling.md "Placement scoring"):
+        # derived observations only — the replay's scheduling decisions
+        # are untouched, so every pre-existing scorecard metric stays
+        # byte-identical and the placement block is purely additive
+        self._util_by_pool: dict = {p: 0.0 for p in profile.capacity}
+        self._ms_gangs_observed = 0
+        self._ms_gangs_packed = 0
+        #: jobs that took a scripted chaos node preemption (the replay's
+        #: model of a spot eviction) — scheduler-reclaim restarts must
+        #: NOT count as spot evictions
+        self._chaos_preempted_jobs: set = set()
+        self.spot_evictions_survived = 0
 
     # ------------------------------------------------------------------
     # watch-fed job state
@@ -234,6 +247,15 @@ class ClusterReplay:
             rec.token += 1
             self._push(now - self.clock.t0 + rec.remaining, _EV_COMPLETE,
                        (name, rec.token))
+            if rec.spec.num_slices > 1:
+                # ICI packedness of the multi-slice gang as placed (the
+                # inventory's domain assignment; read-only)
+                spans = self.inventory.gang_domains(
+                    "default", name, rec.spec.pool)
+                if spans is not None:
+                    self._ms_gangs_observed += 1
+                    if spans <= 1:
+                        self._ms_gangs_packed += 1
         elif not running and rec.running:
             # preempted / restarting mid-run: bank the progress made
             rec.running = False
@@ -335,6 +357,7 @@ class ClusterReplay:
             return
         self.chaos.preempt("default", m.name(victims[0]))
         self.chaos_preempts_executed += 1
+        self._chaos_preempted_jobs.add(name)
 
     def _on_retire(self, name: str) -> None:
         """Harvest the job's trace (the scorecard's per-job samples),
@@ -343,6 +366,13 @@ class ClusterReplay:
         if job is None:
             return
         rec = self._jobs[name]
+        if rec.spec.pool in POOL_SPOT and rec.token > 1 \
+                and name in self._chaos_preempted_jobs:
+            # a spot-pool gang that lost slices to a node preemption
+            # (the spot-eviction model) yet rode the slice-atomic
+            # failover to completion; scheduler-reclaim restarts are
+            # deliberately excluded
+            self.spot_evictions_survived += 1
         tid, _root = job_trace_context(job)
         spans = self.tracer.spans(trace_id=tid)
         bd = trace_breakdown(spans, tid, dropped=self.tracer.dropped)
@@ -381,9 +411,13 @@ class ClusterReplay:
     def _integrate_util(self) -> None:
         now = self.clock()
         if self._last_t is not None and now > self._last_t:
-            held = sum(self.inventory.held_slices(p)
-                       for p in self.workload.profile.capacity)
-            self._util_slice_seconds += held * (now - self._last_t)
+            dt = now - self._last_t
+            held = 0
+            for p in self.workload.profile.capacity:
+                h = self.inventory.held_slices(p)
+                held += h
+                self._util_by_pool[p] += h * dt
+            self._util_slice_seconds += held * dt
         self._last_t = now
 
     def run(self) -> dict:
@@ -433,6 +467,40 @@ class ClusterReplay:
             self.scheduler.check_parity()
         return self._result()
 
+    def _placement_block(self) -> dict:
+        """The scorecard's placement telemetry (docs/scheduling.md
+        "Placement scoring"): ICI-packed fraction of multi-slice gangs,
+        spot evictions survived, $-weighted slice-hours, and the
+        normalized-throughput weighting of fleet goodput — all derived
+        from observations the replay already makes, so the block is
+        additive and deterministic."""
+        from ..scheduling.scoring import seed_rate
+        pools = sorted(self.workload.profile.capacity)
+        seeds = {p: seed_rate(p) for p in pools}
+        best = max(seeds.values(), default=0.0) or 1.0
+        norm = {p: seeds[p] / best for p in pools}
+        busy_total = sum(self._util_by_pool.values())
+        norm_util = (sum(norm[p] * self._util_by_pool[p] for p in pools)
+                     / busy_total) if busy_total > 0 else 0.0
+        cost_hours = sum(
+            self._util_by_pool[p] / 3600.0
+            * POOL_COSTS.get(p, 1.0) * POOL_CHIPS.get(p, 1)
+            for p in pools)
+        goodput = self.goodput.summary(ndigits=6).get("fleetGoodput", 0.0)
+        return {
+            "ici_packed_fraction": round(
+                self._ms_gangs_packed / self._ms_gangs_observed, 4)
+            if self._ms_gangs_observed else 1.0,
+            "multi_slice_gangs_observed": self._ms_gangs_observed,
+            "spot_evictions_survived": self.spot_evictions_survived,
+            "cost_weighted_slice_hours": round(cost_hours, 2),
+            "normalized_throughput_utilization": round(norm_util, 4),
+            "normalized_throughput_weighted_goodput": round(
+                goodput * norm_util, 4),
+            "util_slice_seconds_by_pool": {
+                p: round(self._util_by_pool[p], 1) for p in pools},
+        }
+
     def _result(self) -> dict:
         profile = self.workload.profile
         capacity = sum(profile.capacity.values())
@@ -481,6 +549,7 @@ class ClusterReplay:
                     kind="TestJob"), 1),
             },
             "goodput": self.goodput.summary(ndigits=4),
+            "placement": self._placement_block(),
             "slo": self.slo.summary(ndigits=4),
             "trace": {
                 "sampled_jobs": self.sampled_traces,

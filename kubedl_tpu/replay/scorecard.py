@@ -71,6 +71,13 @@ _REGRESSION = (
     ("jobs.restart_mttr_s.p99", "lower_better", 0.20, 10.0),
     ("jobs.controlplane.reconciles_per_job", "lower_better", 0.15, 1.0),
     ("jobs.scheduler.passes", "lower_better", 0.20, 50.0),
+    # placement telemetry (docs/scheduling.md "Placement scoring"):
+    # multi-slice gangs quietly fragmenting across ICI domains, or the
+    # fleet's throughput-weighted goodput sliding toward slow pools, is
+    # a placement regression even when raw utilization holds
+    ("jobs.placement.ici_packed_fraction", "higher_better", 0.05, 0.02),
+    ("jobs.placement.normalized_throughput_weighted_goodput",
+     "higher_better", 0.05, 0.01),
     ("serving.ttft_s.p99", "lower_better", 0.12, 0.5),
     ("serving.queue_s.p99", "lower_better", 0.12, 0.5),
     # SLO columns (docs/slo.md): compliance and remaining budget must
@@ -176,16 +183,15 @@ def evaluate_gates(scorecard: dict,
     return {"checks": results, "passed": ok}
 
 
-def check_regression(new: dict, old: dict) -> list:
-    """Compare a fresh scorecard against the committed artifact.
-    Returns a list of human-readable regression strings (empty = pass).
-    Only applies when profile and seed match — a re-scaled run is a new
-    baseline, not a regression."""
-    if old.get("profile") != new.get("profile") \
-            or old.get("seed") != new.get("seed"):
-        return []
+def check_tolerances(new: dict, old: dict, rules) -> list:
+    """The ONE per-metric tolerance engine: compare ``new`` against the
+    committed ``old`` under ``rules`` — tuples of (dotted path,
+    "higher_better"|"lower_better", relative slack, absolute grace).
+    Metrics absent from either side are skipped, so a freshly-added rule
+    only bites once both artifacts know the metric. Shared by the
+    cluster scorecard and ``bench_scheduler.py``'s regression gate."""
     problems = []
-    for path, direction, rel, grace in _REGRESSION:
+    for path, direction, rel, grace in rules:
         ov, nv = _get(old, path), _get(new, path)
         if ov is None or nv is None:
             continue
@@ -201,6 +207,18 @@ def check_regression(new: dict, old: dict) -> list:
                 problems.append(
                     f"{path}: {nv} > {round(ceil, 4)} "
                     f"(committed {ov}, tolerance +{rel * 100:g}%)")
+    return problems
+
+
+def check_regression(new: dict, old: dict) -> list:
+    """Compare a fresh scorecard against the committed artifact.
+    Returns a list of human-readable regression strings (empty = pass).
+    Only applies when profile and seed match — a re-scaled run is a new
+    baseline, not a regression."""
+    if old.get("profile") != new.get("profile") \
+            or old.get("seed") != new.get("seed"):
+        return []
+    problems = check_tolerances(new, old, _REGRESSION)
     if _get(new, "jobs.trace.orphan_violations"):
         problems.append("jobs.trace.orphan_violations must stay 0")
     return problems

@@ -17,6 +17,7 @@ ring), and pool health comes from ``pool_stats()`` via
 from __future__ import annotations
 
 import dataclasses
+from typing import Optional
 
 from ..api.slo import new_slo
 from ..core.clock import SimClock
@@ -60,11 +61,25 @@ class ServingReplay:
     dict (span-derived latency samples + pool metrics reads)."""
 
     def __init__(self, workload: Workload, model=None, slo=None,
-                 drain_every: int = 512):
+                 drain_every: int = 512, telemetry=None,
+                 serving_pool: Optional[str] = None,
+                 model_key: str = "serving"):
         from ..serving.batching import ContinuousBatchingEngine
+        from .workload import POOL_V5E
         profile = workload.profile
         self.workload = workload
         self.clock = SimClock()
+        #: FleetTelemetry bundle (docs/telemetry.md): when present, every
+        #: span drain folds the window's decode tokens/s into the model's
+        #: ThroughputProfile via the observe_serving_stats seam — the
+        #: serving half of the Gavel placement currency — and run() ends
+        #: with a profile flush, so a serving day leaves a PERSISTED
+        #: profile the scheduler can score with
+        self.telemetry = telemetry
+        self.serving_pool = serving_pool or POOL_V5E
+        self.model_key = model_key
+        self._last_stats_t = 0.0
+        self._last_stats_tokens = 0
         #: ticks between span drains (and therefore SLO evaluations /
         #: pool-metric samples); the default matches the committed
         #: scorecard cadence, tests lower it to watch burn windows live
@@ -135,6 +150,18 @@ class ServingReplay:
         self.kv_metrics.refresh(self.engine.pool_stats())
         self.shared_ratio_peak = max(self.shared_ratio_peak,
                                      self.kv_metrics.shared_ratio.value())
+        if self.telemetry is not None:
+            # decode tokens/s over the drained window, in simulated
+            # seconds — the observe_serving_stats seam (docs/telemetry.md)
+            now = self.clock.elapsed
+            dt = now - self._last_stats_t
+            dtok = self.tokens_out - self._last_stats_tokens
+            if dt > 0 and dtok > 0:
+                self.telemetry.observe_serving_stats(
+                    self.model_key, self.serving_pool,
+                    {"decode_tokens_per_s": dtok / dt})
+            self._last_stats_t = now
+            self._last_stats_tokens = self.tokens_out
         self.slo.maybe_evaluate(self.clock())
 
     # -- the day loop ----------------------------------------------------
@@ -172,6 +199,10 @@ class ServingReplay:
                 self._drain()
         self._drain()
         self.slo.evaluate(self.clock())     # final windows + verdicts
+        if self.telemetry is not None:
+            # leave a PERSISTED ThroughputProfile behind (the scheduler
+            # loads these on restart; docs/scheduling.md seeding order)
+            self.telemetry.profiles.flush(self.telemetry.api)
         undone = sum(1 for r in requests if not r.done.is_set())
         return {
             "requests_submitted": len(requests),

@@ -27,6 +27,16 @@ POOL_V5E = "tpu-v5-lite-podslice/4x4"
 POOL_ACCELERATOR = {POOL_V5P: "v5p-32", POOL_V5E: "v5e-16"}
 HOSTS_PER_SLICE = {POOL_V5P: 4, POOL_V5E: 4}
 
+#: fleet economics for the scorecard's placement block (docs/scheduling.md
+#: "Placement scoring"): $/chip-hour per pool, and which pools are the
+#: spot/preemptible class. Module constants, NOT Profile fields — the
+#: workload fingerprint (asdict(profile)) must not change under feet of
+#: the committed scorecards.
+POOL_COSTS = {POOL_V5P: 4.2, POOL_V5E: 1.2}
+POOL_SPOT = frozenset({POOL_V5E})
+#: chips per slice (cost weighting: $/chip-hour x chips x hours)
+POOL_CHIPS = {POOL_V5P: 16, POOL_V5E: 16}
+
 
 @dataclass(frozen=True)
 class Profile:

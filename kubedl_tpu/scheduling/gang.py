@@ -95,10 +95,19 @@ class GangScheduler:
                 if self._min_member_of(existing) != mm:
                     self._set_min_member(existing, mm)
                     changed = True
-                if annotations and any(
+                desired = dict(annotations or {})
+                if desired and is_gang_admitted(existing):
+                    # the slice scheduler owns the pool stamp once the
+                    # gang is admitted: scored placement may have moved
+                    # it off the routed primary (docs/scheduling.md),
+                    # and re-stamping here would flap the inventory's
+                    # pool accounting against the scheduler every
+                    # reconcile
+                    desired.pop(c.ANNOTATION_SCHED_POOL, None)
+                if desired and any(
                         m.get_annotations(existing).get(k) != v
-                        for k, v in annotations.items()):
-                    m.annotations(existing).update(annotations)
+                        for k, v in desired.items()):
+                    m.annotations(existing).update(desired)
                     changed = True
                 if changed:
                     existing = self.api.update(existing)

@@ -36,6 +36,13 @@ from .gang import is_gang_admitted
 #: the same pair as pod nodeSelectors)
 GKE_ACCELERATOR_LABEL = "cloud.google.com/gke-tpu-accelerator"
 GKE_TOPOLOGY_LABEL = "cloud.google.com/gke-tpu-topology"
+#: GKE's spot/preemptible node marker — a pool whose nodes carry it is a
+#: spot pool: cheaper in the placement score, evictable at any time (the
+#: eviction rides the engine's slice-atomic failover, docs/failover.md)
+GKE_SPOT_LABEL = "cloud.google.com/gke-spot"
+#: operator-declared $/chip-hour on the Node (the static --pool-cost
+#: config wins over labels when both are set)
+COST_LABEL = "kubedl.io/cost-per-chip-hour"
 
 _BY_GKE_ACCEL = {g.gke_accelerator: g for g in topology.GENERATIONS.values()}
 
@@ -61,6 +68,34 @@ def hosts_per_slice(pool: str) -> int:
         return topology.parse_topology(gen.name, topo).num_hosts
     except (ValueError, KeyError):
         return 1
+
+
+@dataclass(frozen=True)
+class PoolEconomics:
+    """Per-pool placement economics (docs/scheduling.md "Placement
+    scoring"): $/chip-hour and the spot/preemptible class."""
+    cost_per_chip_hour: float = 1.0
+    spot: bool = False
+
+
+def parse_pool_cost_spec(spec: str) -> dict:
+    """``"tpu-v5p-slice/2x2x4=4.2,tpu-v5-lite-podslice/4x4=1.1:spot"`` →
+    pool → PoolEconomics (``--pool-cost`` / KUBEDL_POOL_COST). The
+    ``:spot`` suffix marks the preemptible pool class."""
+    out: dict[str, PoolEconomics] = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        pool, _, val = part.rpartition("=")
+        if not pool:
+            raise ValueError(f"pool cost entry {part!r} is not POOL=COST")
+        cost, _, cls = val.partition(":")
+        if cls not in ("", "spot"):
+            raise ValueError(f"pool class {cls!r} is not 'spot'")
+        out[pool] = PoolEconomics(cost_per_chip_hour=float(cost),
+                                  spot=cls == "spot")
+    return out
 
 
 def parse_capacity_spec(spec: str) -> dict:
@@ -123,15 +158,44 @@ def _node_pool_of(node: dict) -> Optional[str]:
     return pool_key(accel, topo)
 
 
+def _econ_from_labels(labels: dict) -> Optional[PoolEconomics]:
+    """PoolEconomics from Node labels, or None when the node declares
+    neither cost nor spot class (a malformed cost label degrades to the
+    default rather than wedging node accounting)."""
+    spot = str(labels.get(GKE_SPOT_LABEL, "")).lower() == "true"
+    raw = labels.get(COST_LABEL)
+    cost = 1.0
+    if raw is not None:
+        try:
+            cost = float(raw)
+        except (TypeError, ValueError):
+            raw = None
+    if raw is None and not spot:
+        return None
+    return PoolEconomics(cost_per_chip_hour=cost, spot=spot)
+
+
 class SliceInventory:
     """Thread-safe incremental pool capacity + held-slice tracker."""
 
-    def __init__(self, api=None, static_capacity: Optional[dict] = None):
+    def __init__(self, api=None, static_capacity: Optional[dict] = None,
+                 economics: Optional[dict] = None):
         self._lock = threading.Lock()
         self.static_capacity = dict(static_capacity or {})
+        #: static pool → PoolEconomics (--pool-cost); wins over Node labels
+        self.static_economics = dict(economics or {})
         self._node_pool: dict[str, str] = {}    # node name -> pool
         self._hosts: dict[str, int] = {}        # pool -> live host count
         self._held: dict[tuple, HeldSlice] = {}  # (ns, pg-name) -> record
+        #: economics learned from Node labels (kubedl.io/cost-per-chip-hour,
+        #: cloud.google.com/gke-spot); last-observed node wins, resync
+        #: rebuilds — cost is config-shaped, not high-churn state
+        self._label_econ: dict[str, PoolEconomics] = {}
+        #: ICI-domain assignment cache: (pool, capacity) -> layout, valid
+        #: for one held-set generation (the assignment is a pure function
+        #: of (held records, capacity) — see _domain_assignment)
+        self._domain_gen = 0
+        self._domain_cache: dict = {}
         self._api = api
         if api is not None:
             api.watch(self.observe)
@@ -159,6 +223,10 @@ class SliceInventory:
             if pool is not None:
                 self._node_pool[name] = pool
                 self._hosts[pool] = self._hosts.get(pool, 0) + 1
+                econ = _econ_from_labels(m.get_labels(node))
+                if econ is not None:
+                    self._label_econ[pool] = econ
+            self._domain_gen += 1
 
     def _observe_pg(self, event_type: str, pg: dict) -> None:
         key = (m.namespace(pg), m.name(pg))
@@ -168,6 +236,7 @@ class SliceInventory:
                 self._held[key] = rec
             else:
                 self._held.pop(key, None)
+            self._domain_gen += 1
 
     def mark_admitted(self, pg: dict) -> None:
         """Synchronous update at admission time — correctness must not ride
@@ -176,6 +245,7 @@ class SliceInventory:
         if rec is not None:
             with self._lock:
                 self._held[(rec.namespace, rec.name)] = rec
+                self._domain_gen += 1
 
     def mark_preempted(self, namespace: str, name: str) -> None:
         with self._lock:
@@ -219,6 +289,138 @@ class SliceInventory:
         with self._lock:
             return set(self.static_capacity) | set(self._hosts) \
                 | {h.pool for h in self._held.values()}
+
+    # -- economics (docs/scheduling.md "Placement scoring") ---------------
+
+    def economics(self, pool: str) -> PoolEconomics:
+        """The pool's $/chip-hour + spot class: static --pool-cost config
+        first, then Node labels, else the neutral default (cost 1.0,
+        on-demand)."""
+        with self._lock:
+            econ = self.static_economics.get(pool) \
+                or self._label_econ.get(pool)
+        return econ if econ is not None else PoolEconomics()
+
+    def is_spot(self, pool: str) -> bool:
+        return self.economics(pool).spot
+
+    # -- ICI-domain accounting (derived, docs/scheduling.md) --------------
+    #
+    # A pool's slices are grouped into ICI domains (tpu/topology.py owns
+    # the chips-per-domain math). The slice→domain assignment is a PURE
+    # FUNCTION of (held records, capacity): gangs are packed best-fit in
+    # admission order, so the incremental state and a from-scratch rescan
+    # derive the identical occupancy by construction — there is no extra
+    # incremental state to drift. Results are cached per held-set
+    # generation; a pass touches each pool's assignment once.
+
+    def _capacity_unlocked(self, pool: str) -> Optional[int]:
+        if pool in self.static_capacity:
+            return int(self.static_capacity[pool])
+        hosts = self._hosts.get(pool)
+        if hosts is None:
+            return None
+        return hosts // hosts_per_slice(pool)
+
+    @staticmethod
+    def _assign_groups(free: list, groups: list) -> dict:
+        """Best-fit gang packing over per-domain free-slot counts (mutated
+        in place): a gang goes whole into the fullest domain that still
+        fits it, else spreads over the emptiest domains. Returns
+        group key -> sorted list of domain indexes used."""
+        placed: dict = {}
+        for gkey, size in groups:
+            used: set = set()
+            fit = [i for i, f in enumerate(free) if f >= size]
+            if fit:
+                # tightest domain that fits (ties: lowest index) — keeps
+                # big holes open for the next multi-slice gang
+                i = min(fit, key=lambda j: (free[j], j))
+                free[i] -= size
+                used.add(i)
+            else:
+                left = size
+                while left > 0:
+                    avail = [i for i, f in enumerate(free) if f > 0]
+                    if not avail:
+                        # capacity shrank below held (drained pool):
+                        # overflow into domain 0 rather than wedging
+                        free[0] -= left
+                        used.add(0)
+                        break
+                    i = max(avail, key=lambda j: (free[j], -j))
+                    take = min(left, free[i])
+                    free[i] -= take
+                    left -= take
+                    used.add(i)
+            placed[gkey] = sorted(used)
+        return placed
+
+    def _domain_assignment(self, pool: str) -> Optional[dict]:
+        """{"free": [slots/domain], "gangs": {(ns, job): [domains]},
+        "per_domain": n} for a pool with known capacity and a known ICI
+        shape; None otherwise. Caller must NOT hold the lock."""
+        per = topology.pool_ici_slices(pool)
+        with self._lock:
+            cap = self._capacity_unlocked(pool)
+            if per is None or cap is None or cap <= 0:
+                return None
+            key = (pool, cap, per)
+            cached = self._domain_cache.get(key)
+            if cached is not None and cached[0] == self._domain_gen:
+                return cached[1]
+            held = [h for h in self._held.values() if h.pool == pool]
+            gen = self._domain_gen
+        ndom = (cap + per - 1) // per
+        free = [per] * (ndom - 1) + [cap - per * (ndom - 1)] if ndom \
+            else []
+        by_gang: dict = {}
+        for h in held:
+            gk = (h.namespace, h.job)
+            by_gang.setdefault(gk, [h.admitted_at, 0])
+            by_gang[gk][0] = min(by_gang[gk][0], h.admitted_at)
+            by_gang[gk][1] += 1
+        groups = sorted(((gk, n) for gk, (_at, n) in by_gang.items()),
+                        key=lambda t: (by_gang[t[0]][0], t[0]))
+        gangs = self._assign_groups(free, groups)
+        out = {"free": free, "gangs": gangs, "per_domain": per}
+        with self._lock:
+            # keep only entries of the current generation (stale ones can
+            # never be read again; capacity churn must not grow the cache)
+            self._domain_cache = {k: v for k, v in
+                                  self._domain_cache.items()
+                                  if v[0] == self._domain_gen}
+            self._domain_cache[key] = (gen, out)
+        return out
+
+    def domain_free_map(self, pool: str) -> Optional[list]:
+        """Free slice slots per ICI domain (index order), or None when
+        the pool has no domain math (unknown capacity/shape)."""
+        asg = self._domain_assignment(pool)
+        return None if asg is None else list(asg["free"])
+
+    def gang_domains(self, namespace: str, job: str,
+                     pool: str) -> Optional[int]:
+        """ICI domains a held gang spans (1 = packed), or None when the
+        gang holds nothing there / the pool has no domain math."""
+        asg = self._domain_assignment(pool)
+        if asg is None:
+            return None
+        used = asg["gangs"].get((namespace, job))
+        return len(used) if used else None
+
+    def placement_spans(self, pool: str, demand: int) -> Optional[int]:
+        """ICI domains a NEW gang of ``demand`` slices would span given
+        the current occupancy — the scheduler's contention input. None
+        when the pool has no domain math (penalty-neutral)."""
+        if demand <= 1:
+            return 1
+        asg = self._domain_assignment(pool)
+        if asg is None:
+            return None
+        free = list(asg["free"])
+        placed = self._assign_groups(free, [(("", ""), demand)])
+        return len(placed[("", "")])
 
     # -- rescan / parity / resync ----------------------------------------
 
@@ -264,6 +466,12 @@ class SliceInventory:
         when the scan found drift (lost watch events repaired)."""
         api = api or self._api
         node_pool, held = self._scan(api)
+        label_econ: dict[str, PoolEconomics] = {}
+        for node in api.list("Node"):
+            pool = _node_pool_of(node)
+            econ = _econ_from_labels(m.get_labels(node))
+            if pool is not None and econ is not None:
+                label_econ[pool] = econ
         with self._lock:
             drifted = node_pool != self._node_pool or held != self._held
             self._node_pool = node_pool
@@ -272,4 +480,6 @@ class SliceInventory:
                 hosts[pool] = hosts.get(pool, 0) + 1
             self._hosts = hosts
             self._held = held
+            self._label_econ = label_econ
+            self._domain_gen += 1
         return drifted

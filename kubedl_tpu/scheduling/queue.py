@@ -40,18 +40,38 @@ def gang_annotations(job: dict, policy: Optional[SchedulingPolicy],
     """The stamps ``GangScheduler.create_gang`` writes on every PodGroup.
 
     ``slice_spec`` is the job's resolved ``tpu.topology.SliceSpec`` (None
-    for CPU-only gangs, which hold no slice and carry an empty pool)."""
+    for CPU-only gangs, which hold no slice and carry an empty pool).
+    Besides the routed primary pool, the gang carries its **eligibility
+    set** — every pool that can host its shape (``schedulingPolicy.pools``
+    allowlist when given, else shape-compatible generations from
+    ``tpu/topology.py``) — and its throughput-profile key, so the scored
+    placement pass (docs/scheduling.md) never re-derives facts from the
+    job."""
     pool = ""
+    eligible: list = []
     if slice_spec is not None:
         pool = f"{slice_spec.gke_accelerator}/{slice_spec.topology_str}"
+        if policy is not None and policy.pools:
+            eligible = [str(p) for p in policy.pools]
+        else:
+            from ..tpu import topology
+            eligible = topology.compatible_pools(slice_spec)
     priority = 0
     if policy is not None and policy.priority is not None:
         priority = int(policy.priority)
+    # profile key: the job's declared model (schedulingPolicy.profile —
+    # model-keyed profiles are what train.step spans with a model
+    # attribute and serving stats persist under), else the kind-level
+    # default the telemetry layer folds anonymous step spans into
+    profile = ((policy.profile if policy is not None else "")
+               or (job.get("kind") or "job")).lower()
     return {
         c.ANNOTATION_SCHED_POOL: pool,
         c.ANNOTATION_SCHED_QUEUE: job_queue_name(job, policy),
         c.ANNOTATION_SCHED_NUM_SLICES: str(max(int(num_slices or 1), 1)),
         c.ANNOTATION_SCHED_PRIORITY: str(priority),
+        c.ANNOTATION_SCHED_POOLS: ",".join(eligible),
+        c.ANNOTATION_SCHED_PROFILE: profile,
     }
 
 

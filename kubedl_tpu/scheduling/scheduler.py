@@ -70,6 +70,12 @@ class GangSet:
     want: int = 1                       # total slices (PodGroups) of the job
     queue: str = DEFAULT_QUEUE
     priority: int = 0
+    #: pool-eligibility set (docs/scheduling.md "Placement scoring"):
+    #: every pool that can host the gang's shape; consumed only by the
+    #: scored placement pass (the primary ``pool`` rules otherwise)
+    pools: tuple = ()
+    #: throughput-profile key (job kind / model) for the scorer
+    profile: str = ""
     pgs: dict = field(default_factory=dict)  # un-admitted pg name -> created ts
 
     def first_seen(self) -> float:
@@ -86,10 +92,14 @@ def _pg_gangset_fields(pg: dict) -> tuple:
         want = max(int(ann.get(c.ANNOTATION_SCHED_NUM_SLICES, "1") or 1), 1)
     except ValueError:
         want = 1
+    pools = tuple(p for p in ann.get(
+        c.ANNOTATION_SCHED_POOLS, "").split(",") if p)
     return (ann.get(c.ANNOTATION_SCHED_POOL, ""),
             want,
             ann.get(c.ANNOTATION_SCHED_QUEUE, "") or DEFAULT_QUEUE,
-            prio)
+            prio,
+            pools,
+            ann.get(c.ANNOTATION_SCHED_PROFILE, ""))
 
 
 class SliceScheduler(Reconciler):
@@ -106,8 +116,13 @@ class SliceScheduler(Reconciler):
                  resync_every: int = 16,
                  retry_policy: Optional[RetryPolicy] = None,
                  retry_sleep: Callable = time.sleep,
-                 tracer=None):
+                 tracer=None, scorer=None):
         self.api = api
+        #: placement scorer (docs/scheduling.md "Placement scoring"):
+        #: a scheduling.scoring.PlacementScorer when the
+        #: TPUPlacementScoring gate is on; None = the pre-scoring pass,
+        #: byte-identical to PR 4 behavior (pinned by test)
+        self.scorer = scorer
         #: span recorder (docs/tracing.md): pass spans, per-gang
         #: queue-wait spans on the owning job's trace, preemption marks
         self.tracer = tracer if tracer is not None else NOOP_TRACER
@@ -177,7 +192,8 @@ class SliceScheduler(Reconciler):
             gs = self._pending.get(key)
             if gs is None:
                 gs = self._pending[key] = GangSet(namespace=ns, job=job)
-            gs.pool, gs.want, gs.queue, gs.priority = _pg_gangset_fields(obj)
+            (gs.pool, gs.want, gs.queue, gs.priority,
+                 gs.pools, gs.profile) = _pg_gangset_fields(obj)
             gs.pgs[name] = m.parse_rfc3339(
                 m.meta(obj).get("creationTimestamp")) or self.api.now()
 
@@ -197,7 +213,8 @@ class SliceScheduler(Reconciler):
             job = m.get_labels(pg).get(c.LABEL_GANG_JOB_NAME, name)
             gs = pending.setdefault((ns, job),
                                     GangSet(namespace=ns, job=job))
-            gs.pool, gs.want, gs.queue, gs.priority = _pg_gangset_fields(pg)
+            (gs.pool, gs.want, gs.queue, gs.priority,
+             gs.pools, gs.profile) = _pg_gangset_fields(pg)
             gs.pgs[name] = m.parse_rfc3339(
                 m.meta(pg).get("creationTimestamp")) or 0.0
         with self._lock:
@@ -277,10 +294,12 @@ class SliceScheduler(Reconciler):
             held = self.inventory.held_records()
             held_by_queue: dict[str, int] = {}
             held_jobs: dict[tuple, int] = {}
+            held_pool: dict[tuple, str] = {}
             for h in held:
                 held_by_queue[h.queue] = held_by_queue.get(h.queue, 0) + 1
                 hk = (h.namespace, h.job)
                 held_jobs[hk] = held_jobs.get(hk, 0) + 1
+                held_pool[hk] = h.pool
 
             # complete gang-sets only: a job whose slices are still being
             # created (or partially admitted last pass) counts the already-
@@ -309,7 +328,8 @@ class SliceScheduler(Reconciler):
             pending_n = sum(len(v) for v in by_queue.values())
             for qname in sorted(queues, key=lambda n: (-queues[n].priority, n)):
                 self._schedule_queue(queues[qname], by_queue.get(qname, []),
-                                     queues, held_by_queue, reserved)
+                                     queues, held_by_queue, reserved,
+                                     held_pool=held_pool)
             self._refresh_gauges(queues, by_queue, held_by_queue)
         if self.tracer.enabled:
             self.tracer.record(
@@ -317,7 +337,8 @@ class SliceScheduler(Reconciler):
                 attributes={"pass": self.passes, "pending": pending_n})
 
     def _schedule_queue(self, q: QueueSpec, fifo: list, queues: dict,
-                        held_by_queue: dict, reserved: dict) -> None:
+                        held_by_queue: dict, reserved: dict,
+                        held_pool: Optional[dict] = None) -> None:
         head_blocked = False
         for gs in list(fifo):
             demand = len(gs.pgs) if gs.pool else 0
@@ -327,45 +348,142 @@ class SliceScheduler(Reconciler):
                 # jumping here would consume quota the head needs, which
                 # IS delaying the head's earliest start
                 break
-            cap = self.inventory.capacity_slices(gs.pool) if demand else None
-            if cap is not None and demand > cap:
-                self._warn_infeasible(gs, cap)
+            if not demand:
+                self._admit(gs, backfill=head_blocked)
+                continue
+            # a gang whose earlier slices already landed is PINNED to
+            # THEIR pool (the held record's, not the annotation's — the
+            # gang layer may have re-stamped un-admitted members back to
+            # the routed primary meanwhile): re-scoring or following the
+            # flipped stamp would split the set across pools
+            pin = (held_pool or {}).get((gs.namespace, gs.job))
+            verdict, detail = self.place(gs, q.name, reserved,
+                                         pin_pool=pin)
+            if verdict == "infeasible":
+                self._warn_infeasible(gs, detail)
                 continue  # can never fit: do not let it block the queue
-            free = self.inventory.free_slices(gs.pool) if demand else None
+            if verdict == "admit":
+                pool, rows = detail
+                landed = self._admit(gs, backfill=head_blocked,
+                                     pool=pool, score_rows=rows)
+                # count exactly what landed: a partially-landed set
+                # really holds its admitted slices, and counting less
+                # would let the next gang sail past the max ceiling
+                held_by_queue[q.name] = \
+                    held_by_queue.get(q.name, 0) + landed
+                continue
+            avail = detail
+            anchor = pin or gs.pool
+            if not head_blocked:
+                head_blocked = True
+                # the head reserves every free slice it could use in its
+                # ANCHOR pool; later gangs backfill only from the
+                # remainder, so same-pool backfill cannot delay the
+                # head's earliest start there. Known scoring limitation
+                # (ROADMAP follow-up): the head's OTHER eligible pools
+                # are not reserved, so a scored backfill may consume
+                # capacity the head could later have used elsewhere.
+                reserved[anchor] = reserved.get(anchor, 0) + avail
+                if held_by_queue.get(q.name, 0) + demand <= q.min:
+                    # entitled but starved: reclaim borrowed capacity —
+                    # on the ANCHOR pool (a pinned gang can only ever be
+                    # admitted there; evicting borrowers elsewhere would
+                    # free capacity the claimant cannot use)
+                    self._reclaim(gs, q, queues, needed=demand - avail,
+                                  pool=anchor)
+            # blocked non-head gangs simply wait their turn
+
+    def place(self, gs: GangSet, qname: str, reserved: dict,
+              pin_pool: Optional[str] = None) -> tuple:
+        """One gang's placement decision against current inventory state
+        (pure read — shared verbatim by the pending-job explainer):
+
+        * ``("admit", (pool, score_rows))`` — fits; ``pool`` is the
+          scored choice (score_rows best-first) or the routed primary
+          when scoring is off / only one candidate fits;
+        * ``("infeasible", primary_cap)`` — demand exceeds every
+          eligible pool's total capacity;
+        * ``("blocked", avail_primary)`` — fits nowhere right now.
+
+        ``pin_pool`` (the pool a partially-landed set already holds
+        slices in) restricts the candidates to exactly that pool when
+        scoring is on. Without a scorer the candidate set is exactly
+        the primary pool, which makes every branch byte-identical to
+        the pre-scoring pass.
+        """
+        demand = len(gs.pgs)
+        candidates = self.candidates_for(gs, pin_pool)
+        anchor = candidates[0]   # primary, or the pinned held pool
+        caps = {p: self.inventory.capacity_slices(p) for p in candidates}
+        if all(caps[p] is not None and demand > caps[p]
+               for p in candidates):
+            return ("infeasible", caps[anchor])
+        fitting = []
+        for p in candidates:
+            if caps[p] is not None and demand > caps[p]:
+                continue
+            free = self.inventory.free_slices(p)
             # debted capacity (reclaimed for ANOTHER under-min queue)
             # is off limits; this queue's own debt stays available
             avail = None if free is None \
-                else max(free - reserved.get(gs.pool, 0)
-                         - self._debt_other(gs.pool, q.name), 0)
+                else max(free - reserved.get(p, 0)
+                         - self._debt_other(p, qname), 0)
             if avail is None or avail >= demand:
-                landed = self._admit(gs, backfill=head_blocked)
-                if gs.pool:
-                    # count exactly what landed: a partially-landed set
-                    # really holds its admitted slices, and counting less
-                    # would let the next gang sail past the max ceiling
-                    held_by_queue[q.name] = \
-                        held_by_queue.get(q.name, 0) + landed
-                continue
-            if not head_blocked:
-                head_blocked = True
-                # the head reserves every free slice it could use; later
-                # gangs backfill only from the remainder, so admitting
-                # them cannot delay the head's earliest start
-                reserved[gs.pool] = reserved.get(gs.pool, 0) + avail
-                if held_by_queue.get(q.name, 0) + demand <= q.min:
-                    # entitled but starved: reclaim borrowed capacity
-                    self._reclaim(gs, q, queues, needed=demand - avail)
-            # blocked non-head gangs simply wait their turn
+                fitting.append(p)
+        if fitting:
+            if self.scorer is None:
+                return ("admit", (fitting[0], None))
+            rows = self.scorer.rank(gs.profile, fitting, demand)
+            return ("admit", (rows[0]["pool"], rows))
+        free = self.inventory.free_slices(anchor)
+        avail = 0 if free is None else max(
+            free - reserved.get(anchor, 0)
+            - self._debt_other(anchor, qname), 0)
+        return ("blocked", avail)
+
+    def candidates_for(self, gs: GangSet,
+                       pin_pool: Optional[str] = None) -> list:
+        """The ONE candidate-pool rule (the explainer simulates with
+        exactly this list): primary only when scoring is off; the pinned
+        held pool alone for a partially-landed set; else the primary
+        plus eligible ALTERNATES the inventory actually has a capacity
+        record for — a shape-compatible pool nobody has nodes for must
+        not win the score and strand the gang (only the primary keeps
+        the unknown-capacity = unlimited semantics)."""
+        if self.scorer is None:
+            return [gs.pool]
+        if pin_pool:
+            return [pin_pool]
+        out = [gs.pool]
+        for p in gs.pools:
+            if p and p != gs.pool and p not in out \
+                    and self.inventory.capacity_slices(p) is not None:
+                out.append(p)
+        return out
 
     # ------------------------------------------------------------------
     # admission
     # ------------------------------------------------------------------
 
-    def _admit(self, gs: GangSet, backfill: bool = False) -> int:
+    def _admit(self, gs: GangSet, backfill: bool = False,
+               pool: Optional[str] = None,
+               score_rows: Optional[list] = None) -> int:
         """Admit every un-admitted PodGroup of the set. Returns how many
         writes landed (partial admission leaves the rest pending; the next
         pass finishes the set — the held part counts toward both its
-        completeness and its queue's quota, so capacity math stays honest)."""
+        completeness and its queue's quota, so capacity math stays honest).
+
+        ``pool`` is the scored placement choice; every PodGroup's pool
+        annotation is re-stamped FIRST (idempotent per-PG: matching
+        stamps are skipped) so the inventory (and a partial admission's
+        next pass) count the slices where they actually landed. The
+        stamp pass runs even when the choice equals ``gs.pool`` — a
+        partially-failed earlier re-pool leaves DIVERGENT stamps across
+        the set (``gs.pool`` tracks the last-observed member), and
+        admitting them as-is would split the gang across pools."""
+        if pool and self.scorer is not None:
+            if not self._repool(gs, pool):
+                return 0            # patch did not land; retry next pass
         now = self.api.now()
         wait = max(now - gs.first_seen(), 0.0)
         landed = 0
@@ -401,17 +519,57 @@ class SliceScheduler(Reconciler):
             if backfill:
                 self.metrics.backfills.inc(queue=gs.queue)
             self.metrics.queue_wait.observe(wait, queue=gs.queue)
+            if score_rows:
+                best = score_rows[0]
+                self.metrics.scored_placements.inc(pool=best["pool"])
+                if (best.get("spansDomains") or 1) > 1:
+                    self.metrics.ici_straddled.inc(pool=best["pool"])
             if self.tracer.enabled:
                 trace_id, root = self._job_ctx(first_pg, gs.namespace,
                                                gs.job)
+                attrs = {"queue": gs.queue, "backfill": backfill,
+                         "job": f"{gs.namespace}/{gs.job}",
+                         "slices": landed}
+                if score_rows:
+                    attrs["pool"] = score_rows[0]["pool"]
+                    attrs["score"] = score_rows[0]["score"]
                 self.tracer.record(
                     "scheduler.queue-wait", now - wait, now,
                     trace_id=trace_id, parent_id=root,
                     component="scheduler",
-                    attributes={"queue": gs.queue, "backfill": backfill,
-                                "job": f"{gs.namespace}/{gs.job}",
-                                "slices": landed})
+                    attributes=attrs)
         return landed
+
+    def _repool(self, gs: GangSet, pool: str) -> bool:
+        """Re-stamp every PodGroup of the set with the scored pool choice
+        (merge-patch with transient retries; members already stamped are
+        skipped). Returns False when any stamp failed — the admission is
+        then skipped this pass, and the next pass re-scores from
+        wherever the stamps landed (a partially re-stamped set converges
+        because the primary becomes the new stamp and candidates always
+        include it)."""
+        stamped = 0
+        for name in sorted(gs.pgs):
+            pg = self.api.try_get("PodGroup", gs.namespace, name)
+            if pg is None:
+                continue
+            if m.get_annotations(pg).get(c.ANNOTATION_SCHED_POOL) == pool:
+                continue
+            try:
+                self._retry(lambda n=name: self.api.patch_merge(
+                    "PodGroup", gs.namespace, n,
+                    {"metadata": {"annotations": {
+                        c.ANNOTATION_SCHED_POOL: pool}}}))
+            except (Conflict, NotFound, ServerError) as e:
+                log.warning("re-pooling %s/%s to %s failed: %s",
+                            gs.namespace, name, pool, e)
+                return False
+            stamped += 1
+        if stamped and pool != gs.pool:
+            log.info("scored placement: gang-set %s/%s routed %s -> %s",
+                     gs.namespace, gs.job, gs.pool, pool)
+        gs.pool = pool
+        return True
 
     def _debt_other(self, pool: str, queue: str) -> int:
         """Slices of ``pool`` earmarked by reclaims for queues other than
@@ -463,15 +621,17 @@ class SliceScheduler(Reconciler):
     # ------------------------------------------------------------------
 
     def _reclaim(self, gs: GangSet, q: QueueSpec, queues: dict,
-                 needed: int) -> None:
+                 needed: int, pool: str = "") -> None:
         """Evict borrowing gangs (whole, slice-atomically) until ``needed``
-        slices of ``gs.pool`` are on their way back. Runs entirely in one
-        pass: a queue at/under ``min`` never waits a second pass for its
-        reclaim decision (the capacity physically frees when the engine's
-        failover finishes the teardown)."""
+        slices of ``pool`` (default: the gang's routed pool) are on their
+        way back. Runs entirely in one pass: a queue at/under ``min``
+        never waits a second pass for its reclaim decision (the capacity
+        physically frees when the engine's failover finishes the
+        teardown)."""
+        pool = pool or gs.pool
         held = self.inventory.held_records()
         in_flight = sum(1 for h in held
-                        if h.pool == gs.pool and h.preempted)
+                        if h.pool == pool and h.preempted)
         needed -= in_flight
         if needed <= 0:
             return
@@ -480,7 +640,7 @@ class SliceScheduler(Reconciler):
             held_by_queue[h.queue] = held_by_queue.get(h.queue, 0) + 1
         groups: dict[tuple, list] = {}
         for h in held:
-            if h.pool != gs.pool or h.preempted or h.queue == q.name:
+            if h.pool != pool or h.preempted or h.queue == q.name:
                 continue
             groups.setdefault((h.namespace, h.job), []).append(h)
         candidates = []
@@ -508,14 +668,14 @@ class SliceScheduler(Reconciler):
             # earmark the capacity being freed for the claiming queue:
             # without the debt, another queue's backfill re-takes it the
             # moment teardown lands and the reclaim never converges
-            dk = (gs.pool, q.name)
+            dk = (pool, q.name)
             self._reclaim_debt[dk] = self._reclaim_debt.get(dk, 0) \
                 + len(slices)
             needed -= len(slices)
         if needed > 0:
             log.info("queue %s under min still short %d slice(s) of %s "
                      "after reclaim (no eligible borrowers)",
-                     q.name, needed, gs.pool)
+                     q.name, needed, pool)
 
     def _preempt_gang(self, ns: str, job: str, slices: list,
                       for_queue: str) -> None:

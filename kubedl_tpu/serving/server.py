@@ -77,6 +77,13 @@ class ServerConfig:
     #: optional text codec (``kubedl_tpu.tokenizer``): enables "text"
     #: instances and decoded "text" in predictions/stream events
     tokenizer: Optional[object] = None
+    #: periodic stats hook (docs/telemetry.md): called on every metrics
+    #: refresh with ``{"decode_tokens_per_s": ...}`` measured from the
+    #: token counter since the last refresh. Operator-side embeddings
+    #: pass ``FleetTelemetry.observe_serving_stats`` partially applied
+    #: with (model, pool), closing the Gavel-currency loop from serving
+    #: into the ThroughputProfileStore the placement scorer reads.
+    stats_hook: Optional[object] = None
 
 
 class InferenceServer:
@@ -137,7 +144,23 @@ class InferenceServer:
                     "Draft acceptance rate per continuous-batching lane",
                     labels=("lane",))
 
+        self._stats_last = (time.monotonic(), 0.0)
+
         def _refresh_engine_metrics():
+            if self.config.stats_hook is not None:
+                now_m = time.monotonic()
+                tokens = self._m_tokens.value()
+                last_t, last_tok = self._stats_last
+                dt, dtok = now_m - last_t, tokens - last_tok
+                if dt > 0 and dtok > 0:
+                    self._stats_last = (now_m, tokens)
+                    try:
+                        self.config.stats_hook(
+                            {"decode_tokens_per_s": dtok / dt})
+                    except Exception as e:  # noqa: BLE001 — telemetry
+                        # must never take the serving path down with it
+                        logging.getLogger("kubedl.serving").warning(
+                            "stats hook failed: %s", e)
             if self._m_kv is not None:
                 self._m_kv.refresh(engine.pool_stats())
             if self._m_spec is not None:

@@ -25,6 +25,14 @@ Verdict grammar (docs/telemetry.md):
                      in-flight reclaim
 ``PoolCapacity``     the pool is simply full; the holders map names who
 ==================== =====================================================
+
+With placement scoring on (docs/scheduling.md "Placement scoring") the
+simulation replays the SCORED pass: every gang's eligible pools are
+ranked with the scheduler's own scorer and simulated admissions debit
+the chosen pool, not the routed one — an explainer that simulated the
+old unscored pass would name the wrong blocking pool the moment scoring
+ships. An ``Admissible`` verdict then carries a ``scoredPlacement``
+detail: the chosen pool's full score row and the runner-up.
 """
 
 from __future__ import annotations
@@ -47,11 +55,13 @@ def explain_pending(scheduler, namespace: str, job: str) -> Optional[dict]:
         # between the reads would double-count its slices)
         held = inv.held_records()
     held_jobs: dict[tuple, int] = {}
+    held_pool: dict[tuple, str] = {}
     held_by_queue: dict[str, int] = {}
     for h in held:
         held_by_queue[h.queue] = held_by_queue.get(h.queue, 0) + 1
         hk = (h.namespace, h.job)
         held_jobs[hk] = held_jobs.get(hk, 0) + 1
+        held_pool[hk] = h.pool
 
     key = (namespace, job)
     target = pending.get(key)
@@ -79,13 +89,33 @@ def explain_pending(scheduler, namespace: str, job: str) -> Optional[dict]:
         return {**base, "verdict": "GangIncomplete",
                 "message": f"only {demand} of {target.want} PodGroup(s) "
                            f"exist; the gang-set is not yet complete"}
-    cap = inv.capacity_slices(target.pool) if target.pool else None
-    if cap is not None and demand > cap:
-        return {**base, "verdict": "GangInfeasible", "blockingPool":
-                target.pool, "poolCapacity": cap,
-                "message": f"needs {demand} slice(s) of {target.pool} but "
-                           f"the pool holds only {cap}; it will never be "
-                           f"admitted as shaped"}
+
+    #: the scorer the scheduler itself admits with (None = unscored
+    #: pass); candidate sets and pool choices must mirror it exactly
+    scorer = getattr(scheduler, "scorer", None)
+
+    def candidates_of(gs) -> list:
+        """THE scheduler's own candidate rule (scored gangs expand to
+        their known-capacity eligibility set, a partially-landed gang
+        is pinned to the pool its held slices sit in) — shared, not
+        mirrored, so the two can never drift."""
+        return scheduler.candidates_for(
+            gs, held_pool.get((gs.namespace, gs.job)))
+
+    if target.pool:
+        tcands = candidates_of(target)
+        tcaps = {p: inv.capacity_slices(p) for p in tcands}
+        if all(tcaps[p] is not None and demand > tcaps[p]
+               for p in tcands):
+            # anchor pool mirrors scheduler.place: the pinned held pool
+            # when slices already landed, else the routed primary
+            anchor = tcands[0]
+            cap = tcaps[anchor]
+            return {**base, "verdict": "GangInfeasible", "blockingPool":
+                    anchor, "poolCapacity": cap,
+                    "message": f"needs {demand} slice(s) of {anchor} "
+                               f"but the pool holds only {cap}; it will "
+                               f"never be admitted as shaped"}
 
     # -- simulate the pass, in the scheduler's exact order --------------
     by_queue: dict[str, list] = {}
@@ -131,46 +161,82 @@ def explain_pending(scheduler, namespace: str, job: str) -> Optional[dict]:
                                        f"admission waits for capacity to "
                                        f"release inside the queue"}
                 break
+            chosen, rows = None, None
             if d:
-                gcap = inv.capacity_slices(gs.pool)
-                if gcap is not None and d > gcap:
+                gcands = candidates_of(gs)
+                gcaps = {p: inv.capacity_slices(p) for p in gcands}
+                if all(gcaps[p] is not None and d > gcaps[p]
+                       for p in gcands):
                     # infeasible gangs never block the queue in the real
                     # pass (scheduler._schedule_queue `continue`s them) —
                     # but only AFTER the quota-ceiling check above, whose
                     # ordering the simulation must match. The target
                     # itself was already answered GangInfeasible earlier.
                     continue
-            f = free_for(gs.pool) if d else None
-            avail = None if f is None else max(
-                f - reserved.get(gs.pool, 0) - debt_other(gs.pool, qname), 0)
-            if avail is None or avail >= d:
+                fitting = []
+                for p in gcands:
+                    if gcaps[p] is not None and d > gcaps[p]:
+                        continue
+                    fp = free_for(p)
+                    availp = None if fp is None else max(
+                        fp - reserved.get(p, 0) - debt_other(p, qname), 0)
+                    if availp is None or availp >= d:
+                        fitting.append(p)
+                if fitting:
+                    if scorer is None:
+                        chosen = fitting[0]
+                    else:
+                        rows = scorer.rank(gs.profile, fitting, d)
+                        chosen = rows[0]["pool"]
+            if not d or chosen is not None:
                 if is_target:
-                    return {**base, "verdict": "Admissible",
-                            "message": "nothing blocks this gang; the "
-                                       "next scheduling pass admits it"}
+                    out = {**base, "verdict": "Admissible",
+                           "message": "nothing blocks this gang; the "
+                                      "next scheduling pass admits it"}
+                    if rows:
+                        # the scored pass's own ranking (ScoredPlacement
+                        # detail): chosen pool, its score, the runner-up
+                        out["scoredPlacement"] = {
+                            "chosen": rows[0],
+                            "runnerUp": rows[1] if len(rows) > 1
+                            else None}
+                        if chosen != gs.pool:
+                            out["message"] += (
+                                f"; scoring places it on {chosen} "
+                                f"instead of the routed {gs.pool}")
+                    return out
                 held_q += d
-                if d and f is not None:
-                    # unknown pool (f None) = unlimited: nothing to debit
-                    free[gs.pool] = f - d
+                if chosen is not None and free_for(chosen) is not None:
+                    # unknown pool (free None) = unlimited: nothing to
+                    # debit; otherwise the CHOSEN pool pays, exactly as
+                    # the scored admission would
+                    free[chosen] = free_for(chosen) - d
                 continue
+            # blocked: anchor on the pinned held pool when one exists,
+            # exactly as SliceScheduler._schedule_queue does
+            anchor = gcands[0]
+            f = free_for(anchor)
             if is_target:
-                return _capacity_verdict(base, gs, qq, d, f, reserved,
-                                         reserved_by, debt, debt_other,
-                                         held, held_q)
+                return _capacity_verdict(base, gs, anchor, qq, d, f,
+                                         reserved, reserved_by, debt,
+                                         debt_other, held, held_q)
+            avail = 0 if f is None else max(
+                f - reserved.get(anchor, 0) - debt_other(anchor, qname),
+                0)
             if not head_blocked:
                 head_blocked = True
-                reserved[gs.pool] = reserved.get(gs.pool, 0) + avail
+                reserved[anchor] = reserved.get(anchor, 0) + avail
                 reserved_by.setdefault(
-                    gs.pool, (qname, f"{gs.namespace}/{gs.job}"))
+                    anchor, (qname, f"{gs.namespace}/{gs.job}"))
             # blocked non-head gangs just wait their turn
     # unreachable for a complete pending target, but degrade gracefully
     return {**base, "verdict": "PoolCapacity",
             "message": "blocked on pool capacity"}
 
 
-def _capacity_verdict(base, gs, q, demand, free_now, reserved, reserved_by,
-                      debt, debt_other, held, held_q) -> dict:
-    pool = gs.pool
+def _capacity_verdict(base, gs, pool, q, demand, free_now, reserved,
+                      reserved_by, debt, debt_other, held,
+                      held_q) -> dict:
     foreign_debt = debt_other(pool, q.name)
     out = dict(base)
     out["freeSlices"] = free_now

@@ -30,13 +30,18 @@ class TPUGeneration:
     ndims: int                # 3D torus (v4/v5p) or 2D (v5e/v6e)
     max_chips: int
     suffix_unit: str          # "cores" (v4/v5p: v5p-32 = 32 cores) or "chips"
+    #: chips sharing one contiguous ICI fabric block. The v4/v5p pods are
+    #: composed of 4x4x4 cubes behind optical circuit switches, so two
+    #: slices land on the same all-ICI path only inside one cube; the 2D
+    #: generations wire the whole pod as one fabric. 0 = whole pod.
+    ici_domain_chips: int = 0
 
 
 GENERATIONS: dict[str, TPUGeneration] = {
     "v2":  TPUGeneration("v2", "tpu-v2-podslice", 4, 2, 2, 512, "cores"),
     "v3":  TPUGeneration("v3", "tpu-v3-podslice", 4, 2, 2, 2048, "cores"),
-    "v4":  TPUGeneration("v4", "tpu-v4-podslice", 4, 2, 3, 4096, "cores"),
-    "v5p": TPUGeneration("v5p", "tpu-v5p-slice", 4, 2, 3, 8960, "cores"),
+    "v4":  TPUGeneration("v4", "tpu-v4-podslice", 4, 2, 3, 4096, "cores", 64),
+    "v5p": TPUGeneration("v5p", "tpu-v5p-slice", 4, 2, 3, 8960, "cores", 64),
     "v5e": TPUGeneration("v5e", "tpu-v5-lite-podslice", 4, 1, 2, 256, "chips"),
     "v6e": TPUGeneration("v6e", "tpu-v6e-slice", 4, 1, 2, 256, "chips"),
 }
@@ -168,6 +173,99 @@ def parse_topology(gen_name: str, topology: str) -> SliceSpec:
     """``("v5p", "2x2x4")`` → SliceSpec; the GKE-native entry point."""
     topo = tuple(int(x) for x in topology.lower().split("x"))
     return from_chips(gen_name, math.prod(topo), topology)
+
+
+# ---------------------------------------------------------------------------
+# ICI-domain math (docs/scheduling.md "Placement scoring"): the scheduler's
+# contention model. A pool's slices are grouped into ICI domains; a
+# multi-slice gang packed inside one domain rides all-ICI collectives, a
+# gang straddling domains pays the cross-domain (OCS / DCN) hop.
+# ---------------------------------------------------------------------------
+
+
+def ici_domain_chips(gen: TPUGeneration) -> int:
+    """Chips sharing one contiguous ICI fabric block (whole pod when the
+    generation declares no sub-pod granularity)."""
+    return gen.ici_domain_chips or gen.max_chips
+
+
+def slices_per_ici_domain(gen_name: str, topology: str) -> int:
+    """How many slices of this shape one ICI domain holds (>= 1: a slice
+    larger than the domain granularity spans domains by construction and
+    still counts as occupying one)."""
+    spec = parse_topology(gen_name, topology)
+    return max(ici_domain_chips(spec.generation) // spec.chips, 1)
+
+
+_BY_GKE_ACCELERATOR = {g.gke_accelerator: g for g in GENERATIONS.values()}
+
+
+def pool_generation(pool: str) -> Optional[TPUGeneration]:
+    """The generation behind an inventory pool key
+    (``gke-accelerator/topology``); the ONE accel→generation lookup the
+    scorer, the inventory, and the console all resolve pools through."""
+    return _BY_GKE_ACCELERATOR.get(pool.partition("/")[0])
+
+
+def pool_slice_chips(pool: str) -> Optional[int]:
+    """Chips in one slice of an inventory pool key, or None when the
+    shape is unknown (the placement scorer then prices the slice as one
+    chip rather than refusing to score)."""
+    gen = pool_generation(pool)
+    topo = pool.partition("/")[2]
+    if gen is None or not topo:
+        return None
+    try:
+        return parse_topology(gen.name, topo).chips
+    except (ValueError, KeyError):
+        return None
+
+
+def pool_ici_slices(pool: str) -> Optional[int]:
+    """Slices per ICI domain for an inventory pool key; None when the
+    shape is unknown — the caller then skips domain accounting for that
+    pool."""
+    gen = pool_generation(pool)
+    topo = pool.partition("/")[2]
+    if gen is None or not topo:
+        return None
+    try:
+        return slices_per_ici_domain(gen.name, topo)
+    except (ValueError, KeyError):
+        return None
+
+
+#: generations whose slices a gang can move between without changing its
+#: gang shape (same chips/host, same torus dimensionality — the worker
+#: count and the collective topology survive the move)
+_COMPATIBLE_GENERATIONS = {
+    "v4": ("v4", "v5p"), "v5p": ("v5p", "v4"),
+    "v5e": ("v5e", "v6e"), "v6e": ("v6e", "v5e"),
+}
+
+
+def compatible_pools(spec: SliceSpec) -> list:
+    """Every inventory pool key that can host this slice shape: the
+    spec's own pool first, then same-chip-count pools of compatible
+    generations. Pure shape math — the scheduler intersects the result
+    with pools it actually has capacity records for."""
+    own = f"{spec.gke_accelerator}/{spec.topology_str}"
+    out = [own]
+    for gname in _COMPATIBLE_GENERATIONS.get(spec.generation.name, ()):
+        if gname == spec.generation.name:
+            continue
+        try:
+            alt = from_chips(gname, spec.chips,
+                             host_chips=spec.chips_per_host
+                             if gname in _SINGLE_HOST_GENS else None)
+        except (ValueError, KeyError):
+            continue
+        if alt.num_hosts != spec.num_hosts:
+            continue  # different worker count = a different gang shape
+        key = f"{alt.gke_accelerator}/{alt.topology_str}"
+        if key not in out:
+            out.append(key)
+    return out
 
 
 def catalog() -> list:

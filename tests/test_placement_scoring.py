@@ -1,0 +1,599 @@
+"""Throughput-, contention-, and cost-aware slice placement
+(docs/scheduling.md "Placement scoring", ISSUE 9).
+
+Four layers:
+
+* topology — ICI-domain math (chips per fabric block, slices per
+  domain, shape-compatible pool expansion);
+* inventory — per-domain slice accounting: gang-aware packing,
+  fragmentation edge cases, incremental-vs-rescan parity, pool
+  economics from static config and Node labels;
+* scoring — the normalized-throughput / (contention x cost) ranking,
+  seed calibration against half-learned profiles;
+* scheduler — the scored pass end to end: cross-pool redirects, sticky
+  partial placements, the byte-identical disabled-gate pin, and THE
+  acceptance chaos e2e: a spot-pool gang evicted mid-run rides
+  slice-atomic failover, is re-scored onto on-demand while the spot
+  pool stays dry, and completes with loss of one restart round.
+"""
+
+import pytest
+
+from kubedl_tpu.api import common as c
+from kubedl_tpu.api.common import JobStatus
+from kubedl_tpu.controllers.chaos import preempt_pod
+from kubedl_tpu.controllers.engine import EngineConfig, JobEngine
+from kubedl_tpu.controllers.testing import (TestJobController, new_test_job,
+                                            run_all_pods, set_pod_phase)
+from kubedl_tpu.core import meta as m
+from kubedl_tpu.scheduling.gang import CoschedulerPlugin, is_gang_admitted
+from kubedl_tpu.scheduling.inventory import (PoolEconomics, SliceInventory,
+                                             parse_pool_cost_spec)
+from kubedl_tpu.scheduling.scheduler import SliceScheduler
+from kubedl_tpu.scheduling.scoring import PlacementScorer, seed_rate
+from kubedl_tpu.telemetry.profiles import ThroughputProfileStore
+from kubedl_tpu.tpu import topology
+from kubedl_tpu.utils import status as st
+from kubedl_tpu.utils.retry import RetryPolicy
+
+pytestmark = pytest.mark.scheduler
+
+POOL_P = "tpu-v5p-slice/2x2x4"     # 16 chips/slice, 4 slices per 64-chip cube
+POOL_4 = "tpu-v4-podslice/2x2x4"   # shape-compatible with POOL_P
+POOL_E = "tpu-v5-lite-podslice/4x4"
+
+
+# ---------------------------------------------------------------------------
+# topology: ICI-domain math
+# ---------------------------------------------------------------------------
+
+
+def test_ici_domain_chips_per_generation():
+    gens = topology.GENERATIONS
+    # 3D generations compose pods from 4x4x4 OCS cubes
+    assert topology.ici_domain_chips(gens["v4"]) == 64
+    assert topology.ici_domain_chips(gens["v5p"]) == 64
+    # 2D generations wire the whole pod as one fabric
+    assert topology.ici_domain_chips(gens["v5e"]) == 256
+    assert topology.ici_domain_chips(gens["v6e"]) == 256
+
+
+def test_slices_per_ici_domain():
+    assert topology.slices_per_ici_domain("v5p", "2x2x4") == 4   # 64/16
+    assert topology.slices_per_ici_domain("v5p", "2x2x2") == 8   # 64/8
+    assert topology.slices_per_ici_domain("v5e", "4x4") == 16    # 256/16
+    # a slice larger than the domain granularity still occupies >= 1
+    assert topology.slices_per_ici_domain("v5p", "4x4x8") == 1
+    assert topology.pool_ici_slices(POOL_P) == 4
+    assert topology.pool_ici_slices("nonsense") is None
+    assert topology.pool_ici_slices("tpu-v5p-slice/3x3x3") is None
+
+
+def test_pool_slice_chips():
+    assert topology.pool_slice_chips(POOL_P) == 16
+    assert topology.pool_slice_chips(POOL_E) == 16
+    assert topology.pool_slice_chips("bogus/2x2") is None
+
+
+def test_compatible_pools_same_shape_generations():
+    spec = topology.parse_accelerator("v5p-32")
+    assert topology.compatible_pools(spec) == [POOL_P, POOL_4]
+    spec = topology.parse_accelerator("v5e-16")
+    assert topology.compatible_pools(spec) == [
+        POOL_E, "tpu-v6e-slice/4x4"]
+    # the compatible pool must preserve the gang shape (same host count)
+    for spec in (topology.parse_accelerator("v4-32"),
+                 topology.parse_accelerator("v6e-8")):
+        for pool in topology.compatible_pools(spec):
+            accel, _, topo = pool.partition("/")
+            gen = next(g for g in topology.GENERATIONS.values()
+                       if g.gke_accelerator == accel)
+            assert topology.parse_topology(gen.name, topo).num_hosts \
+                == spec.num_hosts
+
+
+# ---------------------------------------------------------------------------
+# inventory: per-domain accounting + economics
+# ---------------------------------------------------------------------------
+
+
+def make_pg(api, name, job=None, queue="default", pool=POOL_P, want=1,
+            pools=(), profile="testjob", priority=0):
+    ann = {c.ANNOTATION_SCHED_POOL: pool,
+           c.ANNOTATION_SCHED_QUEUE: queue,
+           c.ANNOTATION_SCHED_NUM_SLICES: str(want),
+           c.ANNOTATION_SCHED_PRIORITY: str(priority),
+           c.ANNOTATION_SCHED_PROFILE: profile}
+    if pools:
+        ann[c.ANNOTATION_SCHED_POOLS] = ",".join(pools)
+    pg = m.new_obj("scheduling.sigs.k8s.io/v1alpha1", "PodGroup", name,
+                   labels={c.LABEL_GANG_JOB_NAME: job or name},
+                   annotations=ann)
+    pg["spec"] = {"minMember": 1}
+    return api.create(pg)
+
+
+def make_sched(api, capacity=None, economics=None, scorer_profiles=None,
+               scored=False, **kw):
+    inv = SliceInventory(api, static_capacity=capacity or {},
+                         economics=economics or {})
+    scorer = PlacementScorer(inv, profiles=scorer_profiles) if scored \
+        else None
+    kw.setdefault("retry_policy", RetryPolicy(attempts=3, base=0.0, cap=0.0))
+    kw.setdefault("retry_sleep", lambda s: None)
+    return SliceScheduler(api, inventory=inv, scorer=scorer, **kw)
+
+
+def test_domain_accounting_packs_gangs(api, clock):
+    sched = make_sched(api, capacity={POOL_P: 8})   # 2 domains of 4
+    inv = sched.inventory
+    assert inv.domain_free_map(POOL_P) == [4, 4]
+    # a 2-slice gang packs into one domain
+    make_pg(api, "a-slice-0", job="a", want=2)
+    make_pg(api, "a-slice-1", job="a", want=2)
+    clock.advance(1.0)
+    make_pg(api, "b")
+    sched.schedule_pass()
+    assert inv.gang_domains("default", "a", POOL_P) == 1
+    assert inv.gang_domains("default", "b", POOL_P) == 1
+    assert sorted(inv.domain_free_map(POOL_P)) == [1, 4]
+    # preview: a 4-slice gang still fits the empty domain whole
+    assert inv.placement_spans(POOL_P, 4) == 1
+    # a 5-slice gang must straddle
+    assert inv.placement_spans(POOL_P, 5) == 2
+    assert inv.gang_domains("default", "nope", POOL_P) is None
+
+
+def test_domain_straddling_gang_and_drained_pool(api, clock):
+    """Fragmentation edge cases: a gang bigger than any single domain's
+    free room straddles; a pool drained to one free slot per domain
+    forces every multi-slice gang to straddle."""
+    sched = make_sched(api, capacity={POOL_P: 8})
+    inv = sched.inventory
+    # drain to one free slot per domain: two 3-slice gangs
+    for jb in ("x", "y"):
+        for i in range(3):
+            make_pg(api, f"{jb}-slice-{i}", job=jb, want=3)
+        clock.advance(1.0)
+    sched.schedule_pass()
+    assert inv.domain_free_map(POOL_P) == [1, 1]
+    assert inv.placement_spans(POOL_P, 2) == 2   # must straddle
+    assert inv.placement_spans(POOL_P, 1) == 1
+    # admit the straddler and check its actual placement
+    make_pg(api, "z-slice-0", job="z", want=2)
+    make_pg(api, "z-slice-1", job="z", want=2)
+    sched.schedule_pass()
+    assert inv.gang_domains("default", "z", POOL_P) == 2
+
+
+def test_domain_occupancy_parity_incremental_vs_rescan(api, clock):
+    """The satellite parity requirement: domain occupancy derived from
+    incremental held state must equal a from-scratch rescan's (the
+    assignment is a pure function of held records, so parity of held
+    implies parity of domains — assert both)."""
+    sched = make_sched(api, capacity={POOL_P: 8})
+    inv = sched.inventory
+    for i in range(3):
+        make_pg(api, f"g{i}")
+        clock.advance(1.0)
+    make_pg(api, "mm-slice-0", job="mm", want=2)
+    make_pg(api, "mm-slice-1", job="mm", want=2)
+    sched.schedule_pass()
+    api.delete("PodGroup", "default", "g1")
+    before_free = inv.domain_free_map(POOL_P)
+    before_gangs = {j: inv.gang_domains("default", j, POOL_P)
+                    for j in ("g0", "g2", "mm")}
+    assert inv.resync(api) is False      # no drift
+    assert inv.domain_free_map(POOL_P) == before_free
+    assert {j: inv.gang_domains("default", j, POOL_P)
+            for j in ("g0", "g2", "mm")} == before_gangs
+    inv.check_parity(api)
+    # unknown-capacity / unknown-shape pools have no domain math
+    assert inv.domain_free_map(POOL_E) is None
+    assert SliceInventory(static_capacity={"weird/1x1": 4}
+                          ).domain_free_map("weird/1x1") is None
+
+
+def test_pool_cost_spec_and_node_label_economics(api):
+    econ = parse_pool_cost_spec(f"{POOL_P}=4.2,{POOL_E}=1.1:spot")
+    assert econ[POOL_P] == PoolEconomics(4.2, spot=False)
+    assert econ[POOL_E] == PoolEconomics(1.1, spot=True)
+    assert parse_pool_cost_spec("") == {}
+    with pytest.raises(ValueError):
+        parse_pool_cost_spec("nocost")
+    with pytest.raises(ValueError):
+        parse_pool_cost_spec(f"{POOL_P}=1.0:gold")
+    # static config wins over Node labels; labels win over the default
+    inv = SliceInventory(api, economics=econ)
+    api.create(m.new_obj("v1", "Node", "n0", labels={
+        "cloud.google.com/gke-tpu-accelerator": "tpu-v4-podslice",
+        "cloud.google.com/gke-tpu-topology": "2x2x4",
+        "kubedl.io/cost-per-chip-hour": "0.8",
+        "cloud.google.com/gke-spot": "true",
+    }))
+    assert inv.economics(POOL_4) == PoolEconomics(0.8, spot=True)
+    assert inv.is_spot(POOL_4)
+    assert inv.economics(POOL_P).cost_per_chip_hour == 4.2
+    assert inv.economics("unknown/pool") == PoolEconomics()
+    inv.resync(api)                      # label econ survives a rescan
+    assert inv.economics(POOL_4) == PoolEconomics(0.8, spot=True)
+
+
+# ---------------------------------------------------------------------------
+# scoring
+# ---------------------------------------------------------------------------
+
+
+def test_seed_rates_order_and_scorer_seeds(api):
+    assert seed_rate(POOL_P) > seed_rate(POOL_4) > 0
+    assert seed_rate("bogus") == 1.0
+    inv = SliceInventory(api, static_capacity={POOL_P: 4, POOL_4: 4})
+    rows = PlacementScorer(inv).rank("anyjob", [POOL_P, POOL_4], 1)
+    # equal cost: the faster v5p generation wins on the seed alone
+    assert rows[0]["pool"] == POOL_P
+    assert rows[0]["normalizedThroughput"] == 1.0
+
+
+def test_scorer_cost_and_contention(api, clock):
+    inv = SliceInventory(
+        api, static_capacity={POOL_P: 8, POOL_4: 8},
+        economics={POOL_P: PoolEconomics(4.0),
+                   POOL_4: PoolEconomics(0.5, spot=True)})
+    store = ThroughputProfileStore(clock=clock)
+    store.observe_rate("train", POOL_P, 4000.0)
+    store.observe_rate("train", POOL_4, 3600.0)
+    scorer = PlacementScorer(inv, profiles=store)
+    rows = scorer.rank("train", [POOL_P, POOL_4], 2)
+    # near-parity throughput: the 8x cheaper spot pool wins
+    assert rows[0]["pool"] == POOL_4 and rows[0]["spot"]
+    assert rows[1]["pool"] == POOL_P
+    assert rows[0]["contentionPenalty"] == 1.0    # empty pool: packed
+    # fragment POOL_4 so a 2-slice gang must straddle -> penalty grows
+    sched = SliceScheduler(api, inventory=inv)
+    for jb in ("x", "y"):
+        for i in range(3):
+            make_pg(api, f"{jb}-slice-{i}", job=jb, want=3, pool=POOL_4)
+        clock.advance(1.0)
+    sched.schedule_pass()
+    rows = scorer.rank("train", [POOL_P, POOL_4], 2)
+    frag = next(r for r in rows if r["pool"] == POOL_4)
+    assert frag["spansDomains"] == 2
+    assert frag["contentionPenalty"] > 1.0
+
+
+def test_scorer_calibrates_seeds_to_halflearned_profile(api, clock):
+    """A profile that learned ONE pool must not make unknown pools look
+    absurdly slow just because seeds are in relative units: seeds are
+    rescaled by the learned/seed ratio."""
+    inv = SliceInventory(api, static_capacity={POOL_P: 4, POOL_4: 4})
+    store = ThroughputProfileStore(clock=clock)
+    store.observe_rate("train", POOL_P, 48000.0)   # 3000x the seed scale
+    rates = PlacementScorer(inv, profiles=store).rates(
+        "train", [POOL_P, POOL_4])
+    assert rates[POOL_P] == pytest.approx(48000.0)
+    # v4 seed is 0.45/1.0 of v5p per chip -> calibrated near 21600
+    assert rates[POOL_4] == pytest.approx(21600.0, rel=0.01)
+
+
+# ---------------------------------------------------------------------------
+# scheduler: the scored pass
+# ---------------------------------------------------------------------------
+
+
+def test_scored_admission_redirects_to_better_pool(api, clock):
+    store = ThroughputProfileStore(clock=clock)
+    store.observe_rate("train", POOL_P, 4000.0)
+    store.observe_rate("train", POOL_4, 500.0)    # 8x slower
+    sched = make_sched(
+        api, capacity={POOL_P: 4, POOL_4: 4},
+        economics={POOL_P: PoolEconomics(1.0), POOL_4: PoolEconomics(1.0)},
+        scored=True, scorer_profiles=store)
+    # routed to the slow pool, eligible on both
+    make_pg(api, "j1", pool=POOL_4, pools=(POOL_4, POOL_P),
+            profile="train")
+    sched.schedule_pass()
+    pg = api.get("PodGroup", "default", "j1")
+    assert is_gang_admitted(pg)
+    assert m.get_annotations(pg)[c.ANNOTATION_SCHED_POOL] == POOL_P
+    assert sched.inventory.held_slices(POOL_P) == 1
+    assert sched.inventory.held_slices(POOL_4) == 0
+    assert sched.metrics.scored_placements.value(pool=POOL_P) == 1
+    sched.check_parity()
+
+
+def test_unknown_capacity_alternates_are_not_candidates(api, clock):
+    """A shape-compatible pool NOBODY has nodes/capacity for must not
+    win the score and strand the gang: alternates require a capacity
+    record; only the routed primary keeps unknown-capacity=unlimited."""
+    sched = make_sched(api, capacity={POOL_4: 4}, scored=True)
+    # primary v4 (known, slower seed); eligible v5p has NO record and
+    # would out-seed it — it must not even be a candidate
+    make_pg(api, "j1", pool=POOL_4, pools=(POOL_4, POOL_P),
+            profile="train")
+    gs = next(iter(sched._pending.values()))
+    assert sched.candidates_for(gs) == [POOL_4]
+    sched.schedule_pass()
+    pg = api.get("PodGroup", "default", "j1")
+    assert is_gang_admitted(pg)
+    assert m.get_annotations(pg)[c.ANNOTATION_SCHED_POOL] == POOL_4
+    assert sched.inventory.held_slices(POOL_4) == 1
+
+
+def test_scored_admission_spills_when_best_pool_is_full(api, clock):
+    store = ThroughputProfileStore(clock=clock)
+    store.observe_rate("train", POOL_P, 4000.0)
+    store.observe_rate("train", POOL_4, 2000.0)
+    sched = make_sched(api, capacity={POOL_P: 1, POOL_4: 4},
+                       scored=True, scorer_profiles=store)
+    for name in ("a", "b"):
+        make_pg(api, name, pool=POOL_P, pools=(POOL_P, POOL_4),
+                profile="train")
+        clock.advance(1.0)
+    sched.schedule_pass()
+    pools = {n: m.get_annotations(api.get("PodGroup", "default", n))[
+        c.ANNOTATION_SCHED_POOL] for n in ("a", "b")}
+    # work-conserving: the second gang runs NOW on the slower pool
+    # rather than queueing for the fast one
+    assert pools == {"a": POOL_P, "b": POOL_4}
+
+
+def test_partially_landed_gang_is_pinned_to_its_pool(api, clock,
+                                                     monkeypatch):
+    store = ThroughputProfileStore(clock=clock)
+    store.observe_rate("train", POOL_P, 4000.0)
+    store.observe_rate("train", POOL_4, 3900.0)
+    sched = make_sched(api, capacity={POOL_P: 4, POOL_4: 4},
+                       scored=True, scorer_profiles=store)
+    make_pg(api, "a-slice-0", job="a", pool=POOL_P,
+            pools=(POOL_P, POOL_4), want=2, profile="train")
+    make_pg(api, "a-slice-1", job="a", pool=POOL_P,
+            pools=(POOL_P, POOL_4), want=2, profile="train")
+    real = sched._write_status
+
+    def flaky(kind, ns, name, mutate):
+        if name == "a-slice-1":
+            return None
+        return real(kind, ns, name, mutate)
+    monkeypatch.setattr(sched, "_write_status", flaky)
+    sched.schedule_pass()
+    assert sched.inventory.held_slices(POOL_P) == 1
+    # flip the profile so POOL_4 now scores higher — the half-landed
+    # gang must STAY on POOL_P (re-scoring would split it)
+    store.observe_rate("train", POOL_4, 9000.0, now=clock() + 1)
+    monkeypatch.setattr(sched, "_write_status", real)
+    sched.schedule_pass()
+    pools = {m.get_annotations(api.get("PodGroup", "default", n))[
+        c.ANNOTATION_SCHED_POOL] for n in ("a-slice-0", "a-slice-1")}
+    assert pools == {POOL_P}
+    assert sched.inventory.held_slices(POOL_P) == 2
+    sched.check_parity()
+
+
+def test_pinning_survives_gang_layer_restamping(api, clock, monkeypatch):
+    """A redirected gang whose admission landed PARTIALLY is pinned to
+    the pool its held slices sit in — even if the gang layer re-stamps
+    the un-admitted members back to the routed primary in between (the
+    job reconciles on PodGroup events): the next pass re-patches them
+    to the held pool instead of splitting the set."""
+    store = ThroughputProfileStore(clock=clock)
+    store.observe_rate("train", POOL_P, 500.0)
+    store.observe_rate("train", POOL_4, 4000.0)     # redirect target
+    sched = make_sched(api, capacity={POOL_P: 4, POOL_4: 4},
+                       scored=True, scorer_profiles=store)
+    make_pg(api, "a-slice-0", job="a", pool=POOL_P,
+            pools=(POOL_P, POOL_4), want=2, profile="train")
+    make_pg(api, "a-slice-1", job="a", pool=POOL_P,
+            pools=(POOL_P, POOL_4), want=2, profile="train")
+    real = sched._write_status
+
+    def flaky(kind, ns, name, mutate):
+        if name == "a-slice-1":
+            return None
+        return real(kind, ns, name, mutate)
+    monkeypatch.setattr(sched, "_write_status", flaky)
+    sched.schedule_pass()
+    assert sched.inventory.held_slices(POOL_4) == 1   # redirected
+    # the gang layer flips the pending member's stamp back to primary
+    api.patch_merge("PodGroup", "default", "a-slice-1",
+                    {"metadata": {"annotations": {
+                        c.ANNOTATION_SCHED_POOL: POOL_P}}})
+    monkeypatch.setattr(sched, "_write_status", real)
+    sched.schedule_pass()
+    pools = {m.get_annotations(api.get("PodGroup", "default", n))[
+        c.ANNOTATION_SCHED_POOL] for n in ("a-slice-0", "a-slice-1")}
+    assert pools == {POOL_4}, "set must not split across pools"
+    assert sched.inventory.held_slices(POOL_4) == 2
+    assert sched.inventory.held_slices(POOL_P) == 0
+    sched.check_parity()
+
+
+def test_partial_repool_failure_never_splits_the_set(api, clock,
+                                                     monkeypatch):
+    """A re-pool that lands on only SOME members (patch error) must not
+    leave the set divergently stamped at admission: the next pass
+    re-stamps the stragglers even though gs.pool already tracks the
+    chosen pool (the last-observed member's annotation)."""
+    store = ThroughputProfileStore(clock=clock)
+    store.observe_rate("train", POOL_P, 500.0)
+    store.observe_rate("train", POOL_4, 4000.0)     # redirect target
+    sched = make_sched(api, capacity={POOL_P: 4, POOL_4: 4},
+                       scored=True, scorer_profiles=store)
+    make_pg(api, "a-slice-0", job="a", pool=POOL_P,
+            pools=(POOL_P, POOL_4), want=2, profile="train")
+    make_pg(api, "a-slice-1", job="a", pool=POOL_P,
+            pools=(POOL_P, POOL_4), want=2, profile="train")
+    real = api.patch_merge
+    calls = {"n": 0}
+
+    def flaky(kind, ns, name, patch):
+        if name == "a-slice-1":
+            calls["n"] += 1
+            from kubedl_tpu.core.apiserver import ServerError
+            raise ServerError("chaos: patch dropped")
+        return real(kind, ns, name, patch)
+    monkeypatch.setattr(api, "patch_merge", flaky)
+    sched.schedule_pass()
+    # half re-stamped, nothing admitted (the pass backed off)
+    assert admitted_pools(api) == {}
+    monkeypatch.setattr(api, "patch_merge", real)
+    sched.schedule_pass()
+    assert calls["n"] >= 1
+    assert admitted_pools(api) == {"a-slice-0": POOL_4,
+                                   "a-slice-1": POOL_4}
+    assert sched.inventory.held_slices(POOL_4) == 2
+    assert sched.inventory.held_slices(POOL_P) == 0
+    sched.check_parity()
+
+
+def admitted_pools(api):
+    return {m.name(g): m.get_annotations(g)[c.ANNOTATION_SCHED_POOL]
+            for g in api.list("PodGroup") if is_gang_admitted(g)}
+
+
+def test_disabled_gate_is_byte_identical(api, clock):
+    """THE pin: without a scorer, gangs carrying eligibility sets behave
+    exactly as before scoring existed — admitted on their primary pool
+    with exactly one status write, annotations untouched."""
+    sched = make_sched(api, capacity={POOL_P: 1, POOL_4: 4},
+                       scored=False)
+    rvs = {}
+    for name in ("a", "b"):
+        pg = make_pg(api, name, pool=POOL_P, pools=(POOL_P, POOL_4),
+                     profile="train")
+        rvs[name] = int(m.resource_version(pg))
+        clock.advance(1.0)
+    sched.schedule_pass()
+    a = api.get("PodGroup", "default", "a")
+    b = api.get("PodGroup", "default", "b")
+    # a admitted on its primary; b blocked despite POOL_4 sitting idle
+    # and eligible — the unscored pass never strays
+    assert is_gang_admitted(a) and not is_gang_admitted(b)
+    assert m.get_annotations(a)[c.ANNOTATION_SCHED_POOL] == POOL_P
+    # exactly ONE write in the whole pass (a's admit condition): the
+    # global resourceVersion counter sat at rvs["b"] before the pass,
+    # so a's stamped rv is the very next one and b is untouched
+    assert int(m.resource_version(a)) == rvs["b"] + 1
+    assert int(m.resource_version(b)) == rvs["b"]
+    assert sched.inventory.held_slices(POOL_4) == 0
+    assert sched.metrics.scored_placements.value(pool=POOL_P) == 0
+    sched.check_parity()
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance chaos e2e: spot eviction -> failover -> re-score
+# ---------------------------------------------------------------------------
+
+
+def _stack(api, manager, clock, capacity, economics, scored):
+    engine = JobEngine(
+        api, TestJobController(),
+        EngineConfig(enable_gang_scheduling=True,
+                     gate_on_gang_admission=True,
+                     retry_policy=RetryPolicy(attempts=4, base=0.01,
+                                              cap=0.05),
+                     retry_sleep=clock.advance,
+                     backoff_jitter_seed=1),
+        gang=CoschedulerPlugin(api))
+    manager.register(engine)
+    inv = SliceInventory(api, static_capacity=capacity,
+                         economics=economics)
+    scorer = PlacementScorer(inv) if scored else None
+    sched = SliceScheduler(api, inventory=inv, scorer=scorer,
+                           retry_policy=RetryPolicy(attempts=4, base=0.01,
+                                                    cap=0.05),
+                           retry_sleep=clock.advance)
+    manager.register(sched)
+    return engine, sched
+
+
+def job_status(api, name):
+    return JobStatus.from_dict(
+        api.get("TestJob", "default", name).get("status"))
+
+
+@pytest.mark.chaos
+def test_spot_eviction_rescores_onto_ondemand(api, manager, clock):
+    """A gang scored onto the cheap spot pool is evicted mid-run (node
+    preemption + the pool goes dry); the slice-atomic failover tears it
+    down, re-admission re-scores, the gang lands on the on-demand pool
+    and completes having lost exactly the one restart round."""
+    economics = {POOL_P: PoolEconomics(3.0),
+                 POOL_4: PoolEconomics(0.4, spot=True)}
+    _, sched = _stack(api, manager, clock,
+                      capacity={POOL_P: 1, POOL_4: 1},
+                      economics=economics, scored=True)
+    # v5p-32 resolves POOL_P primary with POOL_4 shape-compatible; the
+    # 7.5x cost gap beats the seed throughput gap -> spot wins the score
+    api.create(new_test_job(
+        "spotty", workers=4, restart_policy="ExitCode",
+        tpu_policy={"acceleratorType": "v5p-32"}))
+    manager.run_until_idle(max_iterations=2000)
+    run_all_pods(api)
+    manager.run_until_idle(max_iterations=2000)
+    assert st.is_running(job_status(api, "spotty"))
+    assert sched.inventory.held_slices(POOL_4) == 1
+    assert sched.inventory.held_slices(POOL_P) == 0
+
+    # the spot eviction: one worker preempted, the pool goes dry
+    sched.inventory.static_capacity[POOL_4] = 0
+    victim = sorted(m.name(p) for p in api.list("Pod"))[0]
+    preempt_pod(api, "default", victim)
+    for _ in range(40):
+        manager.run_until_idle(max_iterations=5000)
+        run_all_pods(api)
+        manager.run_until_idle(max_iterations=5000)
+        if st.is_running(job_status(api, "spotty")) \
+                and sched.inventory.held_slices(POOL_P) == 1:
+            break
+        clock.advance(6.0)   # restart backoff + requeue timers
+    s = job_status(api, "spotty")
+    assert not st.is_failed(s), "spot eviction must not fail the job"
+    assert st.is_running(s)
+    assert s.restart_count == 1, "loss bounded to the one restart round"
+    # re-scored: the gang now holds the ON-DEMAND pool
+    assert sched.inventory.held_slices(POOL_P) == 1
+    assert sched.inventory.held_slices(POOL_4) == 0
+    for pod in api.list("Pod"):
+        if m.get_in(pod, "status", "phase") == "Running":
+            set_pod_phase(api, pod, "Succeeded", exit_code=0)
+    manager.run_until_idle(max_iterations=5000)
+    assert st.is_succeeded(job_status(api, "spotty"))
+    sched.check_parity()
+
+
+def test_engine_stamps_eligibility_and_profile(api, manager, clock):
+    """The gang layer carries the scored pass's inputs: eligibility set
+    (shape-compatible pools) and the profile key, derived once at gang
+    creation."""
+    _stack(api, manager, clock, capacity={POOL_P: 2}, economics={},
+           scored=False)
+    api.create(new_test_job(
+        "tj", workers=4, restart_policy="ExitCode",
+        tpu_policy={"acceleratorType": "v5p-32"}))
+    manager.run_until_idle(max_iterations=2000)
+    pgs = api.list("PodGroup")
+    assert pgs
+    ann = m.get_annotations(pgs[0])
+    assert ann[c.ANNOTATION_SCHED_POOLS] == f"{POOL_P},{POOL_4}"
+    assert ann[c.ANNOTATION_SCHED_PROFILE] == "testjob"
+
+
+# ---------------------------------------------------------------------------
+# the bench gate, pinned in tier-1 (op-count scale: ~40 podless gangs)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.perf
+def test_bench_placement_leg_gate():
+    import bench_scheduler as bs
+    trace = bs.build_placement_trace()
+    unscored = bs.run_placement(trace, scored=False)
+    scored = bs.run_placement(trace, scored=True)
+    ratio = scored["normalized_throughput"] \
+        / max(unscored["normalized_throughput"], 1e-9)
+    assert ratio >= 1.25, (scored, unscored)
+    assert scored["makespan_s"] <= unscored["makespan_s"] + 1e-6
+    assert scored["ici_packed_fraction"] >= 0.9
+    assert scored["spot_evictions"] >= 1
+    assert scored["spot_evictions_survived"] == scored["spot_evictions"]
+    assert scored["cost_dollars"] < unscored["cost_dollars"]

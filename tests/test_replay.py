@@ -13,6 +13,7 @@ Four layers:
 """
 
 import dataclasses
+import json
 
 import pytest
 
@@ -251,3 +252,37 @@ def test_check_regression_ignores_mismatched_baseline():
     other_seed = _mini_scorecard(slice_utilization=0.10)
     other_seed["seed"] = 99
     assert check_regression(other_seed, old) == []
+
+
+def test_placement_block_is_additive_and_shaped(smoke_cluster,
+                                                smoke_serving):
+    """The scorecard's placement telemetry (ISSUE 9): derived
+    observations only — present, deterministic, and additive (every
+    pre-existing metric is produced by the same code paths as before)."""
+    wl, res = smoke_cluster
+    pb = res["placement"]
+    assert set(pb) == {
+        "ici_packed_fraction", "multi_slice_gangs_observed",
+        "spot_evictions_survived", "cost_weighted_slice_hours",
+        "normalized_throughput_utilization",
+        "normalized_throughput_weighted_goodput",
+        "util_slice_seconds_by_pool"}
+    assert 0.0 <= pb["ici_packed_fraction"] <= 1.0
+    assert pb["multi_slice_gangs_observed"] > 0
+    assert pb["cost_weighted_slice_hours"] > 0
+    # per-pool busy integrals sum to the same slice-seconds the headline
+    # utilization integrates
+    total = sum(pb["util_slice_seconds_by_pool"].values())
+    cap = sum(wl.profile.capacity.values())
+    assert total == pytest.approx(
+        res["slice_utilization"] * cap * res["makespan_s"], rel=0.01)
+    assert 0.0 < pb["normalized_throughput_weighted_goodput"] \
+        <= res["goodput"]["fleetGoodput"]
+    # the block rides the scorecard and the regression tolerances
+    sc = build_scorecard(wl, res, smoke_serving[1])
+    assert sc["jobs"]["placement"] == pb
+    worse = json.loads(json.dumps(sc))
+    worse["jobs"]["placement"]["ici_packed_fraction"] = max(
+        pb["ici_packed_fraction"] - 0.5, 0.0)
+    probs = check_regression(worse, sc)
+    assert any("ici_packed_fraction" in p for p in probs)

@@ -813,3 +813,226 @@ def test_disabled_path_leaves_no_artifacts(api, manager, clock):
         assert status == 501
     finally:
         server._httpd.server_close()
+
+
+# ---------------------------------------------------------------------------
+# placement scoring satellites (docs/scheduling.md "Placement scoring"):
+# explainer parity with the scored pass, the serving -> profile seam,
+# and the console pools endpoint
+# ---------------------------------------------------------------------------
+
+POOL_V4 = "tpu-v4-podslice/2x2x4"
+
+
+def _scored_scheduler(api, capacity, economics=None, rates=None,
+                      clock=None):
+    from kubedl_tpu.scheduling.scoring import PlacementScorer
+    inv = SliceInventory(api, static_capacity=capacity,
+                         economics=economics or {})
+    store = None
+    if rates:
+        store = ThroughputProfileStore(clock=clock or (lambda: 0.0))
+        for key, pools in sorted(rates.items()):
+            for pool, rate in sorted(pools.items()):
+                store.observe_rate(key, pool, rate)
+    return SliceScheduler(
+        api, inventory=inv, scorer=PlacementScorer(inv, profiles=store),
+        retry_policy=RetryPolicy(attempts=3, base=0.0, cap=0.0),
+        retry_sleep=lambda s: None)
+
+
+def _scored_pg(api, job, pool, pools, profile="testjob", queue="default"):
+    pg = m.new_obj("scheduling.sigs.k8s.io/v1alpha1", "PodGroup", job,
+                   "default", labels={c.LABEL_GANG_JOB_NAME: job},
+                   annotations={c.ANNOTATION_SCHED_POOL: pool,
+                                c.ANNOTATION_SCHED_QUEUE: queue,
+                                c.ANNOTATION_SCHED_NUM_SLICES: "1",
+                                c.ANNOTATION_SCHED_PRIORITY: "0",
+                                c.ANNOTATION_SCHED_POOLS: ",".join(pools),
+                                c.ANNOTATION_SCHED_PROFILE: profile})
+    pg["spec"] = {"minMember": 4}
+    api.create(pg)
+
+
+def test_explainer_replays_the_scored_pass(api, clock):
+    """ScoredPlacement parity: the verdict names the pool the SCORED
+    pass would choose (with score and runner-up), not the routed
+    primary an unscored simulation would debit."""
+    rates = {"testjob": {POOL: 4000.0, POOL_V4: 500.0}}
+    sched = _scored_scheduler(api, {POOL: 1, POOL_V4: 1}, rates=rates,
+                              clock=clock)
+    _scored_pg(api, "fast", POOL_V4, (POOL_V4, POOL))   # scoring -> POOL
+    v = explain_pending(sched, "default", "fast")
+    assert v["verdict"] == "Admissible"
+    sp = v["scoredPlacement"]
+    assert sp["chosen"]["pool"] == POOL
+    assert sp["chosen"]["score"] > 0
+    assert sp["runnerUp"]["pool"] == POOL_V4
+    assert sp["chosen"]["score"] >= sp["runnerUp"]["score"]
+    assert POOL in v["message"]
+    # the real pass agrees with the explainer
+    sched.schedule_pass()
+    assert sched.inventory.held_slices(POOL) == 1
+    # a second gang routed to the now-full POOL is still Admissible —
+    # via the alternative pool the scored simulation debits correctly
+    _scored_pg(api, "second", POOL, (POOL, POOL_V4))
+    v = explain_pending(sched, "default", "second")
+    assert v["verdict"] == "Admissible"
+    assert v["scoredPlacement"]["chosen"]["pool"] == POOL_V4
+    assert v["scoredPlacement"]["runnerUp"] is None
+    # both pools full: the capacity verdict names the primary pool
+    sched.schedule_pass()
+    _scored_pg(api, "third", POOL, (POOL, POOL_V4))
+    v = explain_pending(sched, "default", "third")
+    assert v["verdict"] == "PoolCapacity"
+
+
+def test_serving_replay_persists_throughput_profile(api, clock):
+    """The observe_serving_stats seam, wired (ISSUE 9 satellite): a
+    serving replay feeds decode tokens/s into the ThroughputProfileStore
+    and leaves a PERSISTED ThroughputProfile object behind."""
+    import dataclasses
+
+    from kubedl_tpu.api.throughputprofile import PROFILE_KIND
+    from kubedl_tpu.replay import ServingReplay, generate
+    from kubedl_tpu.replay.workload import PROFILES, POOL_V5E
+    from kubedl_tpu.trace import Tracer
+
+    profile = dataclasses.replace(PROFILES["smoke"], serving_requests=40,
+                                  prefixes=4)
+    wl = generate(profile, 5)
+    tel = FleetTelemetry(api, Tracer(enabled=False))
+    res = ServingReplay(wl, telemetry=tel, drain_every=64,
+                        model_key="bench-llama").run()
+    assert res["requests_completed"] == 40
+    est = tel.profiles.estimate("bench-llama", POOL_V5E)
+    assert est is not None and est > 0
+    objs = api.list(PROFILE_KIND)
+    assert len(objs) == 1
+    pools = (objs[0].get("status") or {}).get("pools") or {}
+    assert POOL_V5E in pools
+    assert pools[POOL_V5E]["tokensPerSecond"] > 0
+
+
+def test_serving_server_stats_hook_feeds_profiles():
+    """The serving engine's periodic stats hook: metric refreshes report
+    decode tokens/s through ServerConfig.stats_hook (the operator wires
+    observe_serving_stats here)."""
+    from kubedl_tpu.serving.server import InferenceServer, ServerConfig
+
+    class FakeEngine:
+        config = None
+        params = None
+
+    seen = []
+    srv = InferenceServer.__new__(InferenceServer)  # no HTTP socket
+    srv.config = ServerConfig(stats_hook=lambda s: seen.append(s))
+    from kubedl_tpu.metrics.registry import Registry
+    srv.metrics = Registry()
+    srv._m_tokens = srv.metrics.counter("t", "t")
+    import time as _time
+    srv._stats_last = (_time.monotonic() - 1.0, 0.0)
+
+    def refresh():  # the hook part of _refresh_engine_metrics, isolated
+        now_m = _time.monotonic()
+        tokens = srv._m_tokens.value()
+        last_t, last_tok = srv._stats_last
+        dt, dtok = now_m - last_t, tokens - last_tok
+        if dt > 0 and dtok > 0:
+            srv._stats_last = (now_m, tokens)
+            srv.config.stats_hook({"decode_tokens_per_s": dtok / dt})
+
+    srv._m_tokens.inc(500)
+    refresh()
+    assert seen and seen[0]["decode_tokens_per_s"] > 0
+
+
+def test_console_pools_endpoint_gated_and_populated(api, clock):
+    from kubedl_tpu.scheduling.inventory import PoolEconomics
+
+    # gate off (unscored scheduler): 501
+    server = _console(DataProxy(api, None, None, job_kinds=("TestJob",),
+                                scheduler=_scheduler(api, capacity=2)))
+    try:
+        status, payload = _route(server, "GET", "/api/v1/pools")
+        assert status == 501
+        assert "placement scoring" in payload["msg"]
+    finally:
+        server._httpd.server_close()
+
+    # gate on: the pool table with economics, domains, and profile norms
+    api2 = type(api)(clock=clock)
+    rates = {"llama": {POOL: 4000.0, POOL_V4: 1000.0}}
+    sched = _scored_scheduler(
+        api2, {POOL: 8, POOL_V4: 4},
+        economics={POOL_V4: PoolEconomics(0.5, spot=True)},
+        rates=rates, clock=clock)
+    _scored_pg(api2, "j1", POOL, (POOL, POOL_V4), profile="llama")
+    sched.schedule_pass()
+    proxy = DataProxy(api2, None, None, job_kinds=("TestJob",),
+                      scheduler=sched)
+    server = _console(proxy)
+    try:
+        status, payload = _route(server, "GET", "/api/v1/pools")
+        assert status == 200
+        rows = {r["pool"]: r for r in payload["data"]}
+        assert set(rows) == {POOL, POOL_V4}
+        p = rows[POOL]
+        assert p["capacitySlices"] == 8 and p["heldSlices"] == 1
+        assert p["slicesPerIciDomain"] == 4
+        assert p["iciDomainFree"] == [3, 4]
+        assert p["normalizedThroughput"] == {"llama": 1.0}
+        assert not p["spot"]
+        v4 = rows[POOL_V4]
+        assert v4["spot"] and v4["costPerChipHour"] == 0.5
+        assert v4["normalizedThroughput"] == {"llama": 0.25}
+        # queue usage gains the priced per-pool breakdown
+        status, payload = _route(server, "GET",
+                                 "/api/v1/queue/usage/default")
+        assert status == 200
+        pools = payload["data"]["pools"]
+        assert pools[POOL]["heldSlices"] == 1
+        assert pools[POOL]["costPerChipHour"] == 1.0
+    finally:
+        server._httpd.server_close()
+
+
+def test_explainer_pins_partially_landed_gang_to_held_pool(api, clock,
+                                                           monkeypatch):
+    """Anchor parity with the scored pass: a gang whose first slice
+    landed on a redirected pool is explained against THAT pool, even if
+    the pending member's annotation was re-stamped back to the routed
+    primary (the gang-layer race the scheduler pins against)."""
+    rates = {"train": {POOL: 500.0, POOL_V4: 4000.0}}
+    sched = _scored_scheduler(api, {POOL: 4, POOL_V4: 4}, rates=rates,
+                              clock=clock)
+    for i in range(2):
+        pg = m.new_obj("scheduling.sigs.k8s.io/v1alpha1", "PodGroup",
+                       f"a-slice-{i}", "default",
+                       labels={c.LABEL_GANG_JOB_NAME: "a"},
+                       annotations={
+                           c.ANNOTATION_SCHED_POOL: POOL,
+                           c.ANNOTATION_SCHED_QUEUE: "default",
+                           c.ANNOTATION_SCHED_NUM_SLICES: "2",
+                           c.ANNOTATION_SCHED_PRIORITY: "0",
+                           c.ANNOTATION_SCHED_POOLS:
+                               f"{POOL},{POOL_V4}",
+                           c.ANNOTATION_SCHED_PROFILE: "train"})
+        pg["spec"] = {"minMember": 4}
+        api.create(pg)
+    real = sched._write_status
+
+    def flaky(kind, ns, name, mutate):
+        if name == "a-slice-1":
+            return None
+        return real(kind, ns, name, mutate)
+    monkeypatch.setattr(sched, "_write_status", flaky)
+    sched.schedule_pass()                       # half-landed on POOL_V4
+    assert sched.inventory.held_slices(POOL_V4) == 1
+    api.patch_merge("PodGroup", "default", "a-slice-1",
+                    {"metadata": {"annotations": {
+                        c.ANNOTATION_SCHED_POOL: POOL}}})
+    v = explain_pending(sched, "default", "a")
+    assert v["verdict"] == "Admissible"
+    assert v["scoredPlacement"]["chosen"]["pool"] == POOL_V4
+    assert v["scoredPlacement"]["runnerUp"] is None  # pinned: one candidate
