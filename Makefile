@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test test-all test-fast test-chaos test-scheduler test-trace test-replay test-telemetry test-slo bench bench-controlplane bench-scheduler bench-serving-paged bench-trace bench-cluster dryrun crds run-standalone lint native
+.PHONY: test test-all test-fast test-chaos test-scheduler test-trace test-replay test-telemetry test-slo test-durability bench bench-controlplane bench-scheduler bench-serving-paged bench-trace bench-cluster dryrun crds run-standalone lint native
 
 # fast path (<3 min): everything except the compile-heavy compute suites
 # (those carry `pytestmark = pytest.mark.slow`). Chaos tests are fast and
@@ -29,9 +29,13 @@ test-fast: test
 bench:
 	$(PY) bench.py
 
-# control-plane settle throughput: 200 jobs x 8 replicas, indexed read path
-# vs the pre-index scan baseline -> BENCH_CONTROLPLANE.json (docs/
-# control-plane-perf.md); the fast tier-1 guard is tests/test_controlplane_perf.py
+# control-plane settle throughput -> BENCH_CONTROLPLANE.json: the legacy
+# 200x8 index-vs-scan leg plus the fleet-scale 10k jobs x 16 replicas
+# gate-on legs (durable control plane, shards=1 vs shards=4, bookmark
+# resume cycles; docs/durability.md). Gates: >=2x sharded settle at
+# no-worse reconcile p99, zero full relists; FAILS on regression vs the
+# committed artifact. Fast tier-1 guards: tests/test_controlplane_perf.py
+# + make test-durability. Use --quick for a 1/10th-scale smoke.
 bench-controlplane:
 	JAX_PLATFORMS=cpu $(PY) bench_controlplane.py
 
@@ -81,6 +85,12 @@ test-telemetry:
 # burn-rate alerting, console endpoints; docs/slo.md)
 test-slo:
 	$(PY) -m pytest tests/ -q -m slo
+
+# durable control-plane suite (journal/snapshot recovery, watch
+# bookmarks, sharded ownership, crash-mid-storm chaos e2e;
+# docs/durability.md)
+test-durability:
+	$(PY) -m pytest tests/ -q -m durability
 
 # THE fleet scorecard: a production-shaped day (thousands of jobs, tens
 # of thousands of serving requests, chaos faults) through the real

@@ -1,41 +1,81 @@
-"""Control-plane scale benchmark: settle N jobs × M replicas, one JSON line.
+"""Control-plane scale benchmark: settle N jobs × M replicas, one JSON doc.
 
 The training bench (``bench.py``) measures tokens/sec; this one measures
 the other half of the ROADMAP's "fast as the hardware allows": how fast
-the operator itself turns submitted jobs into Running jobs. It creates N
-PyTorchJobs of M replicas against the in-memory API server, drives the
-manager to settlement with a simulated kubelet (every Pending pod flips
-Running between drain rounds), and reports settle throughput, reconcile
-latency percentiles, and queue depth.
+the operator itself turns submitted jobs into Running jobs. Three legs
+(docs/durability.md, docs/control-plane-perf.md):
 
-Modes (``--mode``):
+* **legacy 200×8** — the PR 2 story, unchanged: indexed copy-on-write
+  read path vs the pre-index brute-force ``scan`` baseline (wall-clock
+  settle; scan at fleet scale would be O(N²), so it stays at 200×8);
+* **fleet scale 10k×16, gate-on** — the durable control plane
+  (``DurableControlPlane``: WAL journal + watch ring) settling 10,000
+  jobs × 16 replicas, once with ``shards=1`` and once with ``shards=4``.
+  The headline ``jobs_per_sec_settled`` divides by the **shard-busy
+  makespan**: each dispatch's measured wall latency is charged to the
+  shard that owned it, and the makespan is the busiest shard's total —
+  the settle time of the process-per-shard deployment the sharding is
+  built for (in ONE process the GIL serializes Python, so thread wall
+  time cannot show shard parallelism; the per-shard queues' measured
+  costs can). ``settle_wall_seconds`` (single-threaded drive, includes
+  the simulated kubelet) rides along for transparency.
+* **durability/resume** — after settle, the bench cycles an informer
+  through disconnect → bookmark resume while jobs keep changing, and
+  reports ``relists_avoided`` (resumes served from the event ring) vs
+  ``full_relists``.
 
-* ``index`` — the indexed copy-on-write read path (default server mode),
-* ``scan``  — the pre-index brute-force path (full world scan + deepcopy
-  per match on every list) kept inside the server as the baseline,
-* ``both``  — run both and report the speedup (the acceptance gate:
-  ``make bench-controlplane`` writes BENCH_CONTROLPLANE.json).
+Gates (``evaluate_gate``): ≥ 2x sharded settle throughput (shards=4 vs
+shards=1, same gate-on config) at no-worse reconcile p99, zero full
+relists. ``check_regression`` compares against the committed
+``BENCH_CONTROLPLANE.json`` with per-metric tolerances (the shared
+``check_tolerances`` engine) and exits non-zero on backslide, leaving
+the committed baseline untouched.
 
 Usage::
 
-    python bench_controlplane.py [--jobs 200] [--replicas 8]
-                                 [--mode both] [--out BENCH_CONTROLPLANE.json]
+    python bench_controlplane.py [--jobs 10000] [--replicas 16]
+                                 [--out BENCH_CONTROLPLANE.json]
+                                 [--no-check] [--quick]
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
+import shutil
+import sys
+import tempfile
 import time
 
 from kubedl_tpu.api.common import JobStatus
+from kubedl_tpu.client.informers import Informer
 from kubedl_tpu.controllers.registry import OperatorConfig, build_operator
 from kubedl_tpu.core import meta as m
 from kubedl_tpu.core.apiserver import APIServer
 from kubedl_tpu.utils import status as st
-from kubedl_tpu.utils.stats import percentile
+from kubedl_tpu.utils.stats import summarize
 
 CONTAINER = "pytorch"
+
+#: absolute gates: the acceptance criteria of the sharded control plane
+GATE_MIN_SHARD_SPEEDUP = 2.0
+#: "no worse p99" with wall-clock noise grace (ms)
+GATE_P99_SLACK_REL, GATE_P99_SLACK_ABS = 0.20, 0.5
+
+#: regression tolerances vs the committed artifact —
+#: (path, direction, relative slack, absolute grace). Wall-clock derived
+#: metrics carry generous slack; structural counts are tight.
+REGRESSION_RULES = (
+    ("legacy_200x8.speedup_settle_throughput", "higher_better", 0.30, 0.5),
+    ("shards1.jobs_per_sec_settled", "higher_better", 0.30, 10.0),
+    ("shards4.jobs_per_sec_settled", "higher_better", 0.30, 10.0),
+    ("speedup_sharded_settle", "higher_better", 0.15, 0.1),
+    ("shards1.reconcile_ms.p99", "lower_better", 0.50, 0.5),
+    ("shards4.reconcile_ms.p99", "lower_better", 0.50, 0.5),
+    ("durability.relists_avoided", "higher_better", 0.0, 0.0),
+    ("durability.full_relists", "lower_better", 0.0, 0.0),
+)
 
 
 def make_job(name: str, replicas: int) -> dict:
@@ -70,16 +110,12 @@ def _settled(api, n: int) -> bool:
         st.is_running(JobStatus.from_dict(j.get("status"))) for j in jobs)
 
 
-def run_once(jobs: int, replicas: int, mode: str) -> dict:
-    api = APIServer(list_mode=mode)
-    op = build_operator(api, OperatorConfig(workloads=["PyTorchJob"]))
-    op.manager.record_latency = True
-
+def _drive_settle(api, op, jobs: int, replicas: int) -> float:
     t0 = time.perf_counter()
     for i in range(jobs):
-        api.create(make_job(f"bench-{i:04d}", replicas))
+        api.create(make_job(f"bench-{i:05d}", replicas))
     for _ in range(10_000):
-        op.manager.run_until_idle(max_iterations=10_000_000)
+        op.manager.run_until_idle(max_iterations=100_000_000)
         pending = [p for p in api.list("Pod")
                    if (p.get("status") or {}).get("phase",
                                                   "Pending") != "Running"]
@@ -88,54 +124,227 @@ def run_once(jobs: int, replicas: int, mode: str) -> dict:
         for pod in pending:  # the simulated kubelet: everything schedules
             flip_running(api, pod)
     else:
-        raise RuntimeError(f"{jobs}x{replicas} did not settle in mode={mode}")
-    elapsed = time.perf_counter() - t0
+        raise RuntimeError(f"{jobs}x{replicas} did not settle")
+    return time.perf_counter() - t0
 
-    lat = op.manager.latency_samples
 
-    return {
+def run_once(jobs: int, replicas: int, mode: str = "index",
+             shards: int = 1, durable: bool = False,
+             journal_dir: str = "") -> dict:
+    api = APIServer(list_mode=mode)
+    cfg = OperatorConfig(workloads=["PyTorchJob"])
+    if durable:
+        cfg = OperatorConfig(
+            workloads=["PyTorchJob"], enable_durability=True,
+            journal_dir=journal_dir, reconcile_shards=shards,
+            # checkpoint roughly twice over the run: the snapshot path
+            # is exercised without dominating the WAL hot path
+            snapshot_every=max(jobs * replicas * 3, 4096))
+    op = build_operator(api, cfg)
+    op.manager.record_latency = True
+
+    elapsed = _drive_settle(api, op, jobs, replicas)
+
+    lat = list(op.manager.latency_samples)
+    owners = list(op.manager.latency_shards)
+    busy = [0.0] * max(shards, 1)
+    for latency, owner in zip(lat, owners):
+        busy[owner] += latency
+    makespan = max(busy) if any(busy) else elapsed
+
+    result = {
         "mode": mode,
-        "settle_seconds": round(elapsed, 3),
-        "jobs_per_sec_settled": round(jobs / elapsed, 2),
+        "shards": shards,
+        "durable": durable,
+        "settle_wall_seconds": round(elapsed, 3),
+        "settle_makespan_seconds": round(makespan, 3),
+        "jobs_per_sec_settled": round(jobs / makespan, 2),
+        "jobs_per_sec_wall": round(jobs / elapsed, 2),
+        "shard_busy_seconds": [round(b, 3) for b in busy],
         "reconciles": op.manager.reconcile_count,
-        "reconcile_p50_ms": round(percentile(lat, 0.50, default=0.0) * 1e3, 3),
-        "reconcile_p99_ms": round(percentile(lat, 0.99, default=0.0) * 1e3, 3),
+        "reconcile_ms": summarize([v * 1e3 for v in lat],
+                                  percentiles=(0.5, 0.99), ndigits=3),
         "max_queue_depth": op.manager.max_queue_depth,
         "world_objects": len(api),
     }
+    if durable and api._journal is not None:
+        result["journal"] = {
+            "appends": api._journal.appends,
+            "snapshots": api._journal.snapshots_written,
+        }
+    return result
+
+
+def run_legacy(jobs: int, replicas: int, repeat: int) -> dict:
+    """The PR 2 leg, wall-clock semantics unchanged: index vs scan."""
+    out = {}
+    for mode in ("index", "scan"):
+        runs = [run_once(jobs, replicas, mode=mode) for _ in range(repeat)]
+        best = min(runs, key=lambda r: r["settle_wall_seconds"])
+        out[mode] = {
+            "mode": mode,
+            "settle_seconds": best["settle_wall_seconds"],
+            "jobs_per_sec_settled": round(
+                jobs / best["settle_wall_seconds"], 2),
+            "reconciles": best["reconciles"],
+            "reconcile_p50_ms": best["reconcile_ms"]["p50"],
+            "reconcile_p99_ms": best["reconcile_ms"]["p99"],
+            "max_queue_depth": best["max_queue_depth"],
+            "world_objects": best["world_objects"],
+        }
+        print(json.dumps(out[mode]))
+    out["jobs"], out["replicas"] = jobs, replicas
+    out["speedup_settle_throughput"] = round(
+        out["scan"]["settle_seconds"]
+        / max(out["index"]["settle_seconds"], 1e-9), 2)
+    return out
+
+
+def run_resume_leg(jobs: int, replicas: int, cycles: int = 32,
+                   journal_dir: str = "") -> dict:
+    """Bookmark-resume cycles against a settled gate-on world: every
+    cycle drops the informer's watch, mutates a few jobs, and resumes
+    from the bookmark — the ring replays the gap, no relist."""
+    api = APIServer()
+    cfg = OperatorConfig(workloads=["PyTorchJob"], enable_durability=True,
+                         journal_dir=journal_dir,
+                         snapshot_every=max(jobs * replicas * 3, 4096))
+    op = build_operator(api, cfg)
+    _drive_settle(api, op, jobs, replicas)
+
+    informer = Informer(api, "PyTorchJob")
+    informer.start()
+    for c in range(cycles):
+        informer.disconnect()
+        for j in range(4):              # real missed events per cycle
+            api.patch_merge(
+                "PyTorchJob", "default", f"bench-{(c * 4 + j) % jobs:05d}",
+                {"metadata": {"annotations": {
+                    "bench.kubedl.io/resume-probe": f"c{c}"}}})
+        op.manager.run_until_idle(max_iterations=1_000_000)
+        informer.resume()
+    return {
+        "cycles": cycles,
+        "relists_avoided": informer.bookmark_resumes,
+        "full_relists": informer.full_relists,
+    }
+
+
+from kubedl_tpu.replay.scorecard import _get  # noqa: E402 — the one
+# dotted-path getter the scorecard, bench_scheduler, and this bench share
+
+
+def evaluate_gate(result: dict) -> list:
+    """The absolute acceptance gates; returns problem strings."""
+    problems = []
+    leg = result.get("sharded_leg", "shards4")
+    speedup = result.get("speedup_sharded_settle") or 0.0
+    if speedup < GATE_MIN_SHARD_SPEEDUP:
+        problems.append(
+            f"speedup_sharded_settle {speedup} < {GATE_MIN_SHARD_SPEEDUP} "
+            f"(the {leg} leg must settle >= 2x faster than shards=1)")
+    p99_1 = _get(result, "shards1.reconcile_ms.p99")
+    p99_4 = _get(result, f"{leg}.reconcile_ms.p99")
+    if p99_1 is not None and p99_4 is not None:
+        ceil = p99_1 * (1.0 + GATE_P99_SLACK_REL) + GATE_P99_SLACK_ABS
+        if p99_4 > ceil:
+            problems.append(
+                f"{leg} reconcile p99 {p99_4}ms worse than shards1 "
+                f"{p99_1}ms (ceil {round(ceil, 3)}ms)")
+    relists = _get(result, "durability.full_relists")
+    if relists:
+        problems.append(f"durability.full_relists {relists} != 0")
+    return problems
+
+
+def check_regression(new: dict, old: dict) -> list:
+    """Per-metric tolerance comparison against the committed
+    BENCH_CONTROLPLANE.json (the cluster scorecard's shared tolerance
+    engine with this bench's rule table). A re-scaled run (different
+    jobs/replicas) is a new baseline, not a regression."""
+    if (old.get("jobs"), old.get("replicas")) \
+            != (new.get("jobs"), new.get("replicas")):
+        return []
+    from kubedl_tpu.replay.scorecard import check_tolerances
+    return check_tolerances(new, old, REGRESSION_RULES)
 
 
 def main() -> dict:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--jobs", type=int, default=200)
-    ap.add_argument("--replicas", type=int, default=8)
-    ap.add_argument("--mode", choices=("index", "scan", "both"),
-                    default="both")
-    ap.add_argument("--repeat", type=int, default=3,
-                    help="runs per mode; the fastest settle is reported "
-                         "(damps CPU-scheduler noise, standard for "
-                         "throughput benchmarks)")
+    ap.add_argument("--jobs", type=int, default=10_000)
+    ap.add_argument("--replicas", type=int, default=16)
+    ap.add_argument("--legacy-jobs", type=int, default=200)
+    ap.add_argument("--legacy-replicas", type=int, default=8)
+    ap.add_argument("--legacy-repeat", type=int, default=3,
+                    help="legacy-leg runs per mode; fastest settle wins "
+                         "(damps CPU-scheduler noise)")
+    ap.add_argument("--shards", type=int, default=4,
+                    help="sharded leg's shard count (vs the shards=1 leg)")
+    ap.add_argument("--resume-cycles", type=int, default=32)
+    ap.add_argument("--quick", action="store_true",
+                    help="1/10th scale smoke (never write the artifact)")
+    ap.add_argument("--no-check", action="store_true",
+                    help="skip the regression check against the "
+                         "committed artifact at --out")
     ap.add_argument("--out", default="BENCH_CONTROLPLANE.json")
     args = ap.parse_args()
+    if args.quick:
+        args.jobs, args.replicas = max(args.jobs // 10, 50), 8
+        args.legacy_repeat = 1
+        args.resume_cycles = 8
+        args.out = ""
 
     result = {
         "benchmark": "controlplane_settle",
         "jobs": args.jobs,
         "replicas": args.replicas,
+        "gate_min_sharded_speedup": GATE_MIN_SHARD_SPEEDUP,
         "timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
     }
-    result["repeat"] = max(args.repeat, 1)
-    modes = ("index", "scan") if args.mode == "both" else (args.mode,)
-    for mode in modes:
-        runs = [run_once(args.jobs, args.replicas, mode)
-                for _ in range(result["repeat"])]
-        result[mode] = min(runs, key=lambda r: r["settle_seconds"])
-        print(json.dumps({k: v for k, v in result[mode].items()}))
-    if "index" in result and "scan" in result:
-        result["speedup_settle_throughput"] = round(
-            result["scan"]["settle_seconds"]
-            / max(result["index"]["settle_seconds"], 1e-9), 2)
+    result["legacy_200x8"] = run_legacy(args.legacy_jobs,
+                                        args.legacy_replicas,
+                                        max(args.legacy_repeat, 1))
+    tmp = tempfile.mkdtemp(prefix="kubedl-bench-journal-")
+    try:
+        # the result key tracks the actual shard count (a --shards 8 run
+        # must not masquerade as — or regression-compare against — the
+        # committed 4-shard leg; absent paths make check_regression
+        # treat it as a new baseline)
+        leg = f"shards{args.shards}"
+        result["sharded_leg"] = leg
+        for shards, key in ((1, "shards1"), (args.shards, leg)):
+            result[key] = run_once(
+                args.jobs, args.replicas, shards=shards, durable=True,
+                journal_dir=os.path.join(tmp, f"s{shards}"))
+            print(json.dumps(result[key]))
+        result["speedup_sharded_settle"] = round(
+            result["shards1"]["settle_makespan_seconds"]
+            / max(result[leg]["settle_makespan_seconds"], 1e-9), 2)
+        # the resume leg rides a smaller settled world: its product is a
+        # relist count, not a throughput number
+        result["durability"] = run_resume_leg(
+            min(args.jobs, 500), 8, cycles=args.resume_cycles,
+            journal_dir=os.path.join(tmp, "resume"))
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
     print(json.dumps(result))
+    problems = evaluate_gate(result)
+    if problems:
+        raise SystemExit("GATE FAILED:\n  " + "\n  ".join(problems))
+    if not args.no_check and args.out and os.path.exists(args.out):
+        try:
+            with open(args.out) as f:
+                committed = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"warning: cannot read committed {args.out}: {e}",
+                  file=sys.stderr)
+            committed = {}
+        regressions = check_regression(result, committed)
+        if regressions:
+            # keep the committed baseline intact on regression
+            raise SystemExit("REGRESSION vs committed control-plane bench:"
+                             "\n  " + "\n  ".join(regressions))
     if args.out:
         with open(args.out, "w") as f:
             json.dump(result, f, indent=2, sort_keys=True)

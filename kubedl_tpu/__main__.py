@@ -66,6 +66,26 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "derives from Node labels "
                         "(kubedl.io/cost-per-chip-hour, "
                         "cloud.google.com/gke-spot)")
+    p.add_argument("--enable-durability", action="store_true",
+                   help="durable, sharded control plane: write-ahead "
+                        "journal + snapshots, crash-recovery replay, "
+                        "resumable watch bookmarks, sharded reconcile "
+                        "ownership (docs/durability.md; also "
+                        "DurableControlPlane gate)")
+    p.add_argument("--journal-dir", default="",
+                   help="directory for the write-ahead journal + "
+                        "snapshots (standalone mode; requires "
+                        "--enable-durability; empty = durability "
+                        "without persistence)")
+    p.add_argument("--snapshot-every", type=int, default=4096,
+                   help="commits between store snapshots / WAL "
+                        "rotations when the journal is on")
+    p.add_argument("--reconcile-shards", type=int, default=1,
+                   help="N-way sharded reconcile ownership: the "
+                        "workqueue partitions by a consistent hash of "
+                        "each request's namespace/name; pair with "
+                        "--enable-leader-election for per-shard Leases "
+                        "(requires --enable-durability)")
     p.add_argument("--max-reconciles", type=int, default=4)
     p.add_argument("--model-image-builder", default="",
                    help="builder image for ModelVersion image builds")
@@ -109,7 +129,15 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--leader-election-namespace", default="kubedl-system")
     p.add_argument("--leader-election-id", default="kubedl-election")
     p.add_argument("-v", "--verbose", action="store_true")
-    return p.parse_args(argv)
+    args = p.parse_args(argv)
+    # fail fast on flag combinations that would silently degrade:
+    # build_operator only shards the manager when durability is on, so
+    # shard leases over an unsharded queue would drain nothing
+    if args.reconcile_shards > 1 and not args.enable_durability:
+        p.error("--reconcile-shards > 1 requires --enable-durability")
+    if args.journal_dir and not args.enable_durability:
+        p.error("--journal-dir requires --enable-durability")
+    return args
 
 
 def config_from_args(args: argparse.Namespace) -> OperatorConfig:
@@ -141,6 +169,10 @@ def config_from_args(args: argparse.Namespace) -> OperatorConfig:
         enable_slo=args.enable_slo,
         enable_placement_scoring=args.enable_placement_scoring,
         pool_cost=args.pool_cost,
+        enable_durability=args.enable_durability,
+        journal_dir=args.journal_dir,
+        snapshot_every=args.snapshot_every,
+        reconcile_shards=args.reconcile_shards,
     )
 
 
@@ -237,7 +269,25 @@ def main(argv=None) -> int:
         log.info("operator running (%d reconcile workers)",
                  max(1, operator.config.max_reconciles))
 
-    if args.enable_leader_election:
+    if args.enable_leader_election and args.reconcile_shards > 1:
+        # sharded ownership (docs/durability.md): every replica runs and
+        # drains exactly the shards whose Leases it holds; a lost lease
+        # hands that shard to whichever replica acquires it next — no
+        # whole-operator demotion, no restart
+        from .core.leaderelection import ShardLeaseSet
+        leases = ShardLeaseSet(
+            operator.api, args.reconcile_shards,
+            namespace=args.leader_election_namespace,
+            prefix=args.leader_election_id + "-shard")
+        operator.manager.shard_owner = leases.owns
+        log.info("per-shard leases enabled (%d shards, identity %s)",
+                 args.reconcile_shards, leases.identity)
+        elector_thread = threading.Thread(
+            target=leases.run, args=(stop,), name="shard-leases",
+            daemon=True)
+        elector_thread.start()
+        start_operator()
+    elif args.enable_leader_election:
         from .core.leaderelection import (LeaderElectionConfig,
                                           LeaderElector)
         elector = LeaderElector(operator.api, LeaderElectionConfig(

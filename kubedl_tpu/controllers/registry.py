@@ -108,6 +108,24 @@ class OperatorConfig:
     #: for control planes whose Nodes carry no cost/spot labels; empty =
     #: derive from Node labels ($KUBEDL_POOL_COST overrides)
     pool_cost: str = ""
+    #: durable, sharded control plane (docs/durability.md): write-ahead
+    #: journal + snapshots, crash-recovery replay, resumable watch
+    #: bookmarks, sharded reconcile ownership. Also switchable via the
+    #: DurableControlPlane gate; either turns it on. Off by default —
+    #: the store/manager paths stay byte-identical (no journal, no
+    #: event ring, deletes don't allocate resourceVersions, no
+    #: kubedl_journal_*/kubedl_watch_*/kubedl_shard_* families).
+    enable_durability: bool = False
+    #: --journal-dir: where the WAL + snapshots live ("" = durability
+    #: without persistence: the event ring and sharding still apply)
+    journal_dir: str = ""
+    #: --snapshot-every: commits between store snapshots / WAL rotations
+    snapshot_every: int = 4096
+    #: --reconcile-shards: N-way partition of the reconcile workqueue
+    #: (consistent hash of each request's namespace/name); 1 = unsharded
+    reconcile_shards: int = 1
+    #: bounded per-kind watch-event ring serving bookmark resumes
+    watch_ring_size: int = 4096
 
 
 @dataclass
@@ -169,8 +187,31 @@ def build_operator(api: Optional[APIServer] = None,
                      or telemetry_enabled)
     tracer = Tracer(enabled=trace_enabled, capacity=config.trace_buffer,
                     clock=api.now, metrics=TraceMetrics(registry))
+    # durable, sharded control plane (docs/durability.md): the
+    # kubedl_journal_*/kubedl_watch_*/kubedl_shard_* families register
+    # only here, so the disabled exposition stays byte-identical; the
+    # journal recovers any prior state into the store before the first
+    # reconcile, and the watch ring starts buffering bookmarks
+    durable = (config.enable_durability
+               or gates.enabled(ft.DURABLE_CONTROL_PLANE))
+    dur_metrics = None
+    if durable:
+        from ..metrics.registry import DurabilityMetrics
+        dur_metrics = DurabilityMetrics(registry)
+        journal = None
+        if config.journal_dir and hasattr(api, "enable_durability"):
+            from ..core.journal import Journal
+            journal = Journal(config.journal_dir,
+                              snapshot_every=config.snapshot_every,
+                              metrics=dur_metrics)
+        if hasattr(api, "enable_durability"):
+            api.enable_durability(journal=journal,
+                                  watch_ring=config.watch_ring_size,
+                                  metrics=dur_metrics)
     manager = Manager(api, metrics=ControlPlaneMetrics(registry),
-                      tracer=tracer)
+                      tracer=tracer,
+                      shards=(config.reconcile_shards if durable else 1),
+                      durability_metrics=dur_metrics)
     gang = (new_gang_scheduler(config.gang_scheduler_name, api)
             if config.gang_scheduler_name
             and gates.enabled(ft.GANG_SCHEDULING) else None)

@@ -47,6 +47,7 @@ import copy
 import os
 import threading
 import time
+from collections import deque
 from typing import Callable, Iterable, Optional
 
 from . import meta as m
@@ -95,6 +96,13 @@ class IndexParityError(AssertionError):
     shared snapshot it was handed)."""
 
 
+class TooOldResourceVersion(ApiError):
+    """A bookmark-resumed watch (``watch_from``) asked for events older
+    than the bounded event ring still holds (or the ring is disabled):
+    the caller must fall back to a full relist, exactly like a client-go
+    reflector on a 410 Gone."""
+
+
 _ts = m.rfc3339
 
 #: the JSON-tree copier (``meta.deep_copy``); the ``scan`` baseline keeps
@@ -125,7 +133,9 @@ class APIServer:
     def __init__(self, clock: Callable[[], float] = time.time,
                  admission=None, list_mode: Optional[str] = None,
                  uid_factory: Optional[Callable[[], str]] = None,
-                 preset_uid_kinds: tuple = ("SLO",)):
+                 preset_uid_kinds: tuple = ("SLO",),
+                 journal=None, watch_ring: int = 0,
+                 durability_metrics=None):
         self._clock = clock
         #: kinds whose creates honor a caller-supplied metadata.uid (the
         #: deterministic-replay seam — see create()). Deliberately an
@@ -167,6 +177,141 @@ class APIServer:
         # ownerReference when the involved object had no uid yet)
         self.add_indexer("Event", "involved-uid", _event_involved_uid)
         self.add_indexer("Event", "involved-name", _event_involved_name)
+        # -- durability (docs/durability.md; all None/0 by default so the
+        # gate-off store is byte-identical to the pre-durability path) ----
+        self._journal = None
+        self._ring_size = 0
+        self._event_ring: dict[str, object] = {}
+        self._ring_floor: dict[str, int] = {}
+        self._ring_base = 0
+        self._dur_metrics = None
+        if journal is not None or watch_ring or durability_metrics:
+            self.enable_durability(journal=journal, watch_ring=watch_ring,
+                                   metrics=durability_metrics)
+
+    # -- durability (WAL + snapshots + resumable watches) ------------------
+
+    def enable_durability(self, journal=None, watch_ring: int = 4096,
+                          metrics=None) -> None:
+        """Attach the durability layer (docs/durability.md): a
+        :class:`~kubedl_tpu.core.journal.Journal` whose existing state is
+        recovered into the store (resuming the ``resourceVersion``
+        counter), and a bounded per-kind event ring serving
+        bookmark-resumed watches (:meth:`watch_from`). Call before the
+        first write — recovered objects do not re-run admission and do
+        not emit watch events (a restarting operator relists once).
+
+        While durability is on, deletes allocate a resourceVersion
+        (etcd's revision-per-delete): WAL replay and ring bookmarks both
+        need every post-snapshot mutation ordered above the snapshot."""
+        with self._lock:
+            if metrics is not None:
+                self._dur_metrics = metrics
+            if watch_ring and not self._ring_size:
+                # the ring's base marks "events before this rv are not
+                # replayable" — set once, when buffering starts
+                self._ring_size = max(int(watch_ring), 0)
+                self._ring_base = self._rv
+            if journal is not None and self._journal is None:
+                self._journal = journal
+                if self._dur_metrics is not None and journal.metrics is None:
+                    journal.metrics = self._dur_metrics
+                rv, objs = journal.recover()
+                for k, obj in objs.items():
+                    self._objs[k] = obj
+                    self._index_add(k, obj)
+                    self._snaps[k] = self._dc(obj)
+                self._rv = max(self._rv, rv)
+                self._ring_base = max(self._ring_base, self._rv)
+
+    @property
+    def _durable(self) -> bool:
+        return self._journal is not None or self._ring_size > 0
+
+    def _ring_append(self, kind: str, event_type: str, snap: Obj,
+                     seq: int) -> None:
+        ring = self._event_ring.get(kind)
+        if ring is None:
+            ring = self._event_ring[kind] = deque()
+        if len(ring) >= self._ring_size:
+            evicted = ring.popleft()
+            floor = self._ring_floor.get(kind, self._ring_base)
+            self._ring_floor[kind] = max(floor, evicted[0])
+        ring.append((seq, event_type, snap))
+
+    def _journal_commit(self, k, snap: Obj, old: Optional[Obj]) -> None:
+        """Durability hooks for one commit — caller holds the lock and
+        just cut ``snap`` at resourceVersion ``self._rv``."""
+        if self._ring_size:
+            self._ring_append(k[0], "ADDED" if old is None else "MODIFIED",
+                              snap, self._rv)
+        if self._journal is not None:
+            self._journal.append_commit(k, snap, self._rv)
+
+    def _maybe_snapshot(self) -> None:
+        """Checkpoint when due — called on the write entry points AFTER
+        the store lock is released. The O(world) serialization must not
+        stall reads/writes, so only the shallow value grab happens under
+        the lock (the per-object snapshots are immutable by contract —
+        the dump serializes them in place); commits racing the dump land
+        in the pre-rotation WAL and replay via the rv filter."""
+        j = self._journal
+        if j is None or not j.snapshot_due():
+            return
+        with self._lock:
+            if not j.claim_snapshot():
+                return                  # another writer claimed it
+            rv, snaps = self._rv, dict(self._snaps)
+        j.write_snapshot(rv, snaps)
+
+    def watch_from(self, fn: Callable[[str, Obj], None],
+                   resource_version: int,
+                   kinds: Optional[Iterable[str]] = None):
+        """Bookmark-resumed watch: replay buffered events with
+        ``rv > resource_version`` from the bounded per-kind ring, then
+        stream live. Returns ``(cancel, caught_up_rv)`` — the caller's
+        next bookmark. Raises :class:`TooOldResourceVersion` (counted in
+        ``kubedl_watch_relists_total{reason}``) when the bookmark has
+        been evicted, or the ring is disabled: fall back to a full
+        relist, like a reflector on 410 Gone.
+
+        Replayed events are delivered after the live subscription is
+        registered; with concurrent writers a replayed event can arrive
+        after a newer live one — consumers must be level-based and drop
+        events whose resourceVersion is older than what they hold (the
+        informer cache guards every apply exactly so)."""
+        bookmark = int(resource_version)
+        with self._lock:
+            if not self._ring_size:
+                if self._dur_metrics is not None:
+                    self._dur_metrics.watch_relists.inc(
+                        reason="ring_disabled")
+                raise TooOldResourceVersion("watch event ring disabled")
+            ks = tuple(kinds) if kinds is not None \
+                else tuple(self._event_ring)
+            for kd in ks:
+                floor = self._ring_floor.get(kd, self._ring_base)
+                if bookmark < floor:
+                    if self._dur_metrics is not None:
+                        self._dur_metrics.watch_relists.inc(
+                            reason="too_old")
+                    raise TooOldResourceVersion(
+                        f"bookmark {bookmark} older than the {kd} ring "
+                        f"floor {floor}")
+            replay = sorted(
+                e for kd in ks for e in self._event_ring.get(kd, ())
+                if e[0] > bookmark)
+            caught_up = self._rv
+            self._watchers.append(fn)
+
+        def cancel():
+            with self._lock:
+                if fn in self._watchers:
+                    self._watchers.remove(fn)
+
+        for _seq, event_type, snap in replay:
+            fn(event_type, snap)
+        return cancel, caught_up
 
     # -- helpers ----------------------------------------------------------
 
@@ -281,6 +426,8 @@ class APIServer:
         self._index_add(k, new)
         snap = self._dc(new)
         self._snaps[k] = snap
+        if self._durable:
+            self._journal_commit(k, snap, old)
         return snap
 
     # -- CRUD -------------------------------------------------------------
@@ -319,6 +466,7 @@ class APIServer:
             md["creationTimestamp"] = _ts(self.now())
             snap = self._commit(k, obj)
         self._emit("ADDED", snap)
+        self._maybe_snapshot()
         return self._dc(snap)
 
     def get(self, kind: str, namespace: str, name: str) -> Obj:
@@ -555,6 +703,7 @@ class APIServer:
             self._remove_key(k)
         else:
             self._emit("MODIFIED", snap)
+        self._maybe_snapshot()
         return self._dc(snap)
 
     def update_status(self, obj: Obj) -> Obj:
@@ -601,8 +750,10 @@ class APIServer:
                     return
         if snap is not None:
             self._emit("MODIFIED", snap)
+            self._maybe_snapshot()
             return
         self._remove_key(k)
+        self._maybe_snapshot()
 
     def _remove_key(self, k) -> None:
         with self._lock:
@@ -613,6 +764,21 @@ class APIServer:
             snap = self._snaps.pop(k, None)
             if snap is None:
                 snap = self._dc(removed)
+            if self._durable:
+                # deletes allocate an rv while durability is on (etcd
+                # revision semantics): WAL replay and ring bookmarks
+                # need post-snapshot deletes ordered above the snapshot.
+                # The tombstone handed to watchers carries that rv (as a
+                # real api-server's DELETED event does) so bookmarks
+                # advance past the deletion
+                seq = self._next_rv()
+                snap = dict(snap)
+                snap["metadata"] = dict(snap.get("metadata") or {},
+                                        resourceVersion=seq)
+                if self._ring_size:
+                    self._ring_append(k[0], "DELETED", snap, seq)
+                if self._journal is not None:
+                    self._journal.append_delete(k, seq)
         self._emit("DELETED", snap)
         self._gc_dependents(removed)
 

@@ -46,6 +46,13 @@ SLO_ENGINE = "SLOEngine"
 #: default — the unscored pass stays byte-identical (pinned by test).
 #: Requires the slice scheduler (the gate is a no-op without it).
 TPU_PLACEMENT_SCORING = "TPUPlacementScoring"
+#: durable, sharded control plane (docs/durability.md): write-ahead
+#: journal + snapshots over the COW store, crash-recovery replay,
+#: resumable watch bookmarks, and N-way sharded reconcile ownership
+#: with per-shard leases; off by default — the gate-off store/manager
+#: paths are byte-identical to the pre-durability control plane
+#: (pinned by tests/test_durability.py)
+DURABLE_CONTROL_PLANE = "DurableControlPlane"
 
 _DEFAULTS = {
     GANG_SCHEDULING: True,           # Beta
@@ -59,6 +66,7 @@ _DEFAULTS = {
     FLEET_TELEMETRY: False,          # Alpha
     SLO_ENGINE: False,               # Alpha
     TPU_PLACEMENT_SCORING: False,    # Alpha
+    DURABLE_CONTROL_PLANE: False,    # Alpha
 }
 
 ENV_FEATURE_GATES = "KUBEDL_FEATURE_GATES"

@@ -1,0 +1,305 @@
+"""Write-ahead journal + snapshots for the in-memory control plane.
+
+ROADMAP item 1 (docs/durability.md): the ``APIServer`` is the store of
+record in standalone mode, and before this layer existed it lost the
+world on restart — MTTR after an operator crash was "replay nothing,
+relist everything". The journal makes the store durable with the classic
+WAL + checkpoint split:
+
+* **WAL** (``wal-<rv>.log``): every commit/delete appends one compact
+  JSON record. Appends are ``write(2)``-flushed per record (a process
+  crash loses nothing the store acknowledged) and ``fsync``ed in groups
+  of ``fsync_every`` records (the power-loss durability knob) — classic
+  group commit, so the write hot path stays O(append).
+* **Snapshots** (``snap-<rv>.json``): every ``snapshot_every`` commits
+  the store's copy-on-write read snapshots are serialized as-is — PR 2
+  guarantees every commit produces an immutable per-object snapshot, so
+  the dump serializes shared frozen trees instead of copying the world —
+  and the WAL rotates. Old generations are removed only after the new
+  snapshot is durably renamed into place, so a crash at any point leaves
+  a recoverable (snapshot, WAL-tail) pair on disk.
+
+**Recovery** (:meth:`Journal.recover`): load the newest parseable
+snapshot, then replay every WAL record with ``rv`` greater than the
+snapshot's, in file order, tolerating a torn final line (a crash
+mid-append). The caller resumes its ``resourceVersion`` counter from the
+recovered maximum, so a restarted operator continues the same rv stream
+— the watch-bookmark contract (docs/durability.md) depends on rv never
+moving backwards across a restart.
+
+Record format (one JSON object per line, keys kept one-letter compact —
+the WAL is the write hot path)::
+
+    {"t": "c", "rv": 1234, "k": ["Pod", "default", "p-0"], "o": {...}}
+    {"t": "d", "rv": 1240, "k": ["Pod", "default", "p-0"]}
+
+``t`` is the record type (``c`` commit, ``d`` delete), ``rv`` the store
+resourceVersion counter after the write (deletes allocate an rv while
+durability is on, mirroring etcd's revision-per-delete — the ``rv > S``
+replay filter needs every post-snapshot record above the snapshot's rv),
+``k`` the (kind, namespace, name) key, and ``o`` the committed object.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+_SNAP_PREFIX = "snap-"
+_WAL_PREFIX = "wal-"
+
+
+def _gen_name(prefix: str, rv: int) -> str:
+    return f"{prefix}{rv:016d}"
+
+
+def _gen_rv(name: str, prefix: str) -> Optional[int]:
+    stem = name[len(prefix):].split(".", 1)[0]
+    try:
+        return int(stem)
+    except ValueError:
+        return None
+
+
+class JournalCorrupt(Exception):
+    """No snapshot generation in the journal directory could be parsed
+    (WAL-only recovery from rv 0 still works; this is only raised when a
+    snapshot file exists but every generation is unreadable)."""
+
+
+class Journal:
+    """Append-side and recovery-side of the WAL (one instance per store).
+
+    File operations take the journal's own lock: appends arrive under
+    the APIServer's store lock (the serialization WAL order relies on),
+    but checkpoints (:meth:`write_snapshot`) deliberately run *outside*
+    it — serializing the world must not stall every read and write — so
+    the WAL rotation has to be safe against a concurrent append.
+    Records committed while a checkpoint is in flight may land in the
+    pre-rotation generation; recovery's ``rv > snapshot_rv`` filter
+    replays them regardless of which file they sit in.
+    """
+
+    def __init__(self, dirpath: str, snapshot_every: int = 4096,
+                 fsync_every: int = 64, metrics=None,
+                 timer=time.perf_counter):
+        self.dir = dirpath
+        self._lock = threading.Lock()
+        self.snapshot_every = max(int(snapshot_every), 1)
+        self.fsync_every = max(int(fsync_every), 1)
+        self.metrics = metrics
+        self._timer = timer
+        os.makedirs(dirpath, exist_ok=True)
+        self._f = None
+        self._since_fsync = 0
+        self._since_snapshot = 0
+        #: total WAL records appended by this instance
+        self.appends = 0
+        #: snapshots written by this instance
+        self.snapshots_written = 0
+        #: how the last recover() rebuilt the world (test/debug surface)
+        self.recovered_from: dict = {}
+
+    # -- recovery ----------------------------------------------------------
+
+    def _generations(self, prefix: str) -> list:
+        out = []
+        for name in os.listdir(self.dir):
+            if name.startswith(prefix) and not name.endswith(".tmp"):
+                rv = _gen_rv(name, prefix)
+                if rv is not None:
+                    out.append((rv, os.path.join(self.dir, name)))
+        out.sort()
+        return out
+
+    def recover(self) -> tuple:
+        """Rebuild ``(max_rv, {key: obj})`` from newest snapshot + WAL
+        tail. An empty/new directory recovers to ``(0, {})``. Also
+        positions the journal to append to the newest WAL generation."""
+        snaps = self._generations(_SNAP_PREFIX)
+        objs: dict[tuple, dict] = {}
+        snap_rv = 0
+        snap_used = None
+        for rv, path in reversed(snaps):
+            try:
+                with open(path) as f:
+                    doc = json.load(f)
+                snap_rv = int(doc["rv"])
+                for o in doc["objects"]:
+                    md = o.get("metadata") or {}
+                    objs[(o.get("kind", ""),
+                          md.get("namespace", "default"),
+                          md.get("name", ""))] = o
+                snap_used = path
+                break
+            except (OSError, ValueError, KeyError):
+                continue           # torn snapshot: fall back a generation
+        if snaps and snap_used is None:
+            raise JournalCorrupt(
+                f"no parseable snapshot generation in {self.dir}")
+        max_rv = snap_rv
+        wal_records = 0
+        torn = 0
+        for base_rv, path in self._generations(_WAL_PREFIX):
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        torn += 1      # crash mid-append: drop the tail
+                        continue
+                    rv = int(rec["rv"])
+                    if rv <= snap_rv:
+                        continue       # already folded into the snapshot
+                    k = tuple(rec["k"])
+                    if rec["t"] == "c":
+                        objs[k] = rec["o"]
+                    elif rec["t"] == "d":
+                        objs.pop(k, None)
+                    max_rv = max(max_rv, rv)
+                    wal_records += 1
+        self.recovered_from = {
+            "snapshot_rv": snap_rv,
+            "snapshot_file": os.path.basename(snap_used) if snap_used
+            else None,
+            "wal_records": wal_records,
+            "torn_records": torn,
+            "objects": len(objs),
+            "rv": max_rv,
+        }
+        return max_rv, objs
+
+    # -- append path -------------------------------------------------------
+
+    def _wal_file(self):
+        if self._f is None:
+            gens = self._generations(_WAL_PREFIX)
+            path = (gens[-1][1] if gens else
+                    os.path.join(self.dir, _gen_name(_WAL_PREFIX, 0)
+                                 + ".log"))
+            torn_tail = False
+            if os.path.exists(path) and os.path.getsize(path) > 0:
+                with open(path, "rb") as rf:
+                    rf.seek(-1, os.SEEK_END)
+                    torn_tail = rf.read(1) != b"\n"
+            self._f = open(path, "a")
+            if torn_tail:
+                # a prior crash tore the final line (that record was
+                # never acknowledged): terminate the garbage as its own
+                # unparseable line, or the NEXT acknowledged append
+                # would glue onto it and be lost at the following
+                # recovery
+                self._f.write("\n")
+                self._f.flush()
+        return self._f
+
+    def _append(self, rec: dict) -> None:
+        line = json.dumps(rec, separators=(",", ":")) + "\n"
+        with self._lock:
+            f = self._wal_file()
+            f.write(line)
+            # flush every record: write(2)-level durability (survives a
+            # process crash); fsync (power loss) is the batched one
+            f.flush()
+            self.appends += 1
+            self._since_fsync += 1
+            if self._since_fsync >= self.fsync_every:
+                self._fsync()
+        if self.metrics is not None:
+            self.metrics.journal_appends.inc()
+
+    def _fsync(self) -> None:
+        """Caller holds ``self._lock``."""
+        if self._f is None:
+            return
+        t0 = self._timer()
+        os.fsync(self._f.fileno())
+        if self.metrics is not None:
+            self.metrics.journal_fsync.observe(
+                max(self._timer() - t0, 0.0))
+        self._since_fsync = 0
+
+    def append_commit(self, key: tuple, obj: dict, rv: int) -> None:
+        self._append({"t": "c", "rv": rv, "k": list(key), "o": obj})
+        self._since_snapshot += 1
+
+    def append_delete(self, key: tuple, rv: int) -> None:
+        self._append({"t": "d", "rv": rv, "k": list(key)})
+
+    def snapshot_due(self) -> bool:
+        return self._since_snapshot >= self.snapshot_every
+
+    def claim_snapshot(self) -> bool:
+        """Atomically claim the due checkpoint (resets the commit
+        counter so concurrent writers don't double-snapshot). The
+        APIServer calls this under its store lock together with the
+        O(dict-size) shallow grab of the snapshot values, then runs
+        :meth:`write_snapshot` with the lock released."""
+        if self._since_snapshot < self.snapshot_every:
+            return False
+        self._since_snapshot = 0
+        return True
+
+    def write_snapshot(self, rv: int, snaps: dict) -> None:
+        """Checkpoint: serialize the store's (already immutable)
+        per-object read snapshots, rotate the WAL, drop old generations.
+        Runs OUTSIDE the store lock — commits racing the checkpoint land
+        in the pre-rotation WAL generation and are replayed by the
+        ``rv > snapshot_rv`` filter. Crash-safe at every step — the old
+        (snapshot, WAL) pair survives until the new snapshot is durably
+        renamed into place."""
+        # 1. durable snapshot first: tmp -> fsync -> rename (no journal
+        # state touched yet, so a crash here leaves the old pair whole)
+        final = os.path.join(self.dir, _gen_name(_SNAP_PREFIX, rv)
+                             + ".json")
+        tmp = final + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"rv": rv, "objects": list(snaps.values())}, f,
+                      separators=(",", ":"))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, final)
+        with self._lock:
+            # 2. seal the current WAL and open the post-rv generation
+            if self._f is not None:
+                self._fsync()
+                self._f.close()
+            self._f = open(os.path.join(self.dir,
+                                        _gen_name(_WAL_PREFIX, rv)
+                                        + ".log"), "a")
+            # 3. old snapshots are redundant; old WAL generations are
+            # NOT judged by their filename rv — the name bounds a file's
+            # MINIMUM record rv, and the generation just sealed can hold
+            # records ABOVE this snapshot's rv (commits racing the
+            # checkpoint land there by design). Keep the current and the
+            # most recent sealed generation; anything older was sealed
+            # before the previous checkpoint claimed its rv, so all its
+            # records are <= this snapshot's rv and safely folded in.
+            # Recovery's rv filter makes the retained extra file free.
+            for gen_rv, path in self._generations(_SNAP_PREFIX):
+                if gen_rv < rv:
+                    os.unlink(path)
+            wals = self._generations(_WAL_PREFIX)
+            for gen_rv, path in wals[:-2]:
+                os.unlink(path)
+            self.snapshots_written += 1
+        if self.metrics is not None:
+            self.metrics.snapshot_writes.inc()
+
+    def flush(self) -> None:
+        """Force the fsync boundary (shutdown path)."""
+        with self._lock:
+            self._fsync()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None:
+                self._fsync()
+                self._f.close()
+                self._f = None

@@ -195,3 +195,67 @@ class LeaderElector:
         except ApiError:
             pass
         self.is_leader = False
+
+
+class ShardLeaseSet:
+    """Per-shard reconcile-ownership leases (docs/durability.md).
+
+    The sharded ``Manager`` partitions its workqueue by
+    ``manager.shard_for(namespace, name, shards)``; this class decides
+    *which process* drains each shard: one independent
+    :class:`LeaderElector` per shard, on Leases named
+    ``<prefix>-<shard>``, all under this candidate's single identity.
+    Every process runs the same election set; a shard's workers only pop
+    while ``owns(shard)`` is True, so losing a lease hands the shard off
+    — the successor holds an identically-hashed copy of the queue (its
+    own watch stream populated it) and simply starts draining.
+
+    ``step()`` runs one election round across all shards and returns the
+    owned set; callers drive it on their retry cadence (an operator
+    binary from a renewal thread, tests by hand against a sim clock).
+    """
+
+    def __init__(self, api, shards: int, identity: str = "",
+                 namespace: str = "kubedl-system",
+                 prefix: str = "kubedl-shard",
+                 lease_duration: float = 15.0,
+                 renew_deadline: float = 10.0,
+                 retry_period: float = 2.0,
+                 clock: Optional[Callable[[], float]] = None):
+        self.shards = max(int(shards), 1)
+        self.identity = identity or default_identity()
+        self.retry_period = retry_period
+        self.electors = [
+            LeaderElector(api, LeaderElectionConfig(
+                namespace=namespace, name=f"{prefix}-{i}",
+                identity=self.identity,
+                lease_duration=lease_duration,
+                renew_deadline=renew_deadline,
+                retry_period=retry_period), clock=clock)
+            for i in range(self.shards)]
+
+    def step(self) -> set:
+        """One acquire-or-renew round per shard; returns the shard
+        indices this candidate now holds."""
+        return {i for i, el in enumerate(self.electors)
+                if el.try_acquire_or_renew()}
+
+    def owns(self, shard: int) -> bool:
+        """The ``Manager.shard_owner`` predicate."""
+        return self.electors[shard].is_leader
+
+    def owned(self) -> set:
+        return {i for i, el in enumerate(self.electors) if el.is_leader}
+
+    def run(self, stop: threading.Event) -> None:
+        """Blocking renewal loop (standalone binary): step every
+        ``retry_period`` until stopped, then release everything held."""
+        while not stop.is_set():
+            self.step()
+            stop.wait(self.retry_period)
+        self.release_all()
+
+    def release_all(self) -> None:
+        for el in self.electors:
+            if el.is_leader:
+                el.release()

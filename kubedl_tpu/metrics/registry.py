@@ -413,6 +413,38 @@ class SLOMetrics:
             "Alert severities currently firing per objective", ("slo",))
 
 
+class DurabilityMetrics:
+    """Durable-control-plane families (docs/durability.md): WAL append
+    throughput and fsync group-commit latency, snapshot cadence, watch
+    relists the bookmark ring could not avoid, and the sharded
+    workqueue's per-shard occupancy. Constructed only when the
+    DurableControlPlane gate is on — the disabled operator's exposition
+    carries none of these families (the PR 5/7/8 byte-identical-disabled
+    convention)."""
+
+    def __init__(self, registry: Optional[Registry] = None):
+        self.registry = registry or Registry()
+        r = self.registry
+        self.journal_appends = r.counter(
+            "kubedl_journal_appends_total",
+            "Write-ahead-journal records appended (commits + deletes)")
+        self.journal_fsync = r.histogram(
+            "kubedl_journal_fsync_seconds",
+            "Group-commit fsync latency (one fsync per fsync_every "
+            "appended records)", buckets=_LATENCY_BUCKETS)
+        self.snapshot_writes = r.counter(
+            "kubedl_snapshot_writes_total",
+            "Store snapshots serialized (WAL rotations)")
+        self.watch_relists = r.counter(
+            "kubedl_watch_relists_total",
+            "Bookmark-resumed watches that fell back to a full relist, "
+            "by reason (too_old = ring evicted the bookmark, "
+            "ring_disabled = no event ring on this store)", ("reason",))
+        self.shard_owned_keys = r.gauge(
+            "kubedl_shard_owned_keys",
+            "Live queued request keys per reconcile shard", ("shard",))
+
+
 class TraceMetrics:
     """Span-recorder health (docs/tracing.md): recorded-span throughput
     per component, ring-buffer occupancy, and the overflow-drop counter

@@ -102,10 +102,17 @@ class ClusterReplay:
     scorecard aggregates (lists of trace-derived samples + final metric
     reads), all in simulated seconds."""
 
-    def __init__(self, workload: Workload):
+    def __init__(self, workload: Workload, shards: int = 1):
         self.workload = workload
         profile = workload.profile
         seed = workload.seed
+        #: reconcile-shard count threaded to the Manager
+        #: (docs/durability.md). The default 1 keeps every committed
+        #: BENCH_CLUSTER.json metric byte-identical; any value is
+        #: timeline-identical too, because the manager's synchronous
+        #: drain pops in globally-earliest-(ready_at, seq) order
+        #: regardless of shard count (pinned by tests/test_replay.py).
+        self.shards = max(int(shards), 1)
         self.clock = SimClock()
         self.registry = Registry()
         # deterministic uids: trace ids and per-job restart-backoff
@@ -133,7 +140,8 @@ class ClusterReplay:
         # lifecycle spans); reconcile latency lives in cp_metrics instead
         from ..core.manager import Manager
         self.manager = Manager(self.chaos, clock=self.clock,
-                               metrics=self.cp_metrics)
+                               metrics=self.cp_metrics,
+                               shards=self.shards)
         self.job_metrics = JobMetrics(self.registry)
         self.engine = JobEngine(
             self.chaos, TestJobController(),

@@ -1,0 +1,822 @@
+"""Durable, sharded control plane (docs/durability.md).
+
+Four layers:
+
+* **journal** — WAL round trips, snapshot rotation, torn-tail tolerance,
+  resourceVersion resumption, fsync group-commit accounting;
+* **resumable watches** — bookmark replay from the bounded per-kind event
+  ring, too-old fallback (counted), informer resume vs full relist;
+* **sharded ownership** — consistent shard hash, shard-deterministic
+  ``run_until_idle`` order, per-shard lease handoff between two operator
+  candidates, unowned shards parking until the lease comes back;
+* **THE crash-mid-storm chaos e2e** — a seeded fault storm is killed
+  mid-flight, a fresh operator recovers the exact pre-crash store from
+  snapshot + WAL replay, informers resume via bookmark with zero full
+  relists, and the recovered world converges to parity with a
+  never-crashed reference run.
+
+Gate-off behavior is byte-identical to the pre-durability control plane
+and pinned here (no journal, no ring, deletes allocate no
+resourceVersion, no ``kubedl_journal_*``/``kubedl_watch_*``/
+``kubedl_shard_*`` families, one reconcile shard).
+"""
+
+import copy
+import os
+
+import pytest
+
+from kubedl_tpu.api.common import JobStatus
+from kubedl_tpu.client.informers import Informer
+from kubedl_tpu.controllers.chaos import ChaosAPIServer, ChaosConfig
+from kubedl_tpu.controllers.engine import EngineConfig, JobEngine
+from kubedl_tpu.controllers.registry import OperatorConfig, build_operator
+from kubedl_tpu.controllers.testing import (TestJobController, new_test_job,
+                                            set_pod_phase)
+from kubedl_tpu.core import meta as m
+from kubedl_tpu.core.apiserver import APIServer, TooOldResourceVersion
+from kubedl_tpu.core.clock import SimClock
+from kubedl_tpu.core.journal import Journal, JournalCorrupt
+from kubedl_tpu.core.leaderelection import ShardLeaseSet
+from kubedl_tpu.core.manager import Manager, Reconciler, Request, shard_for
+from kubedl_tpu.metrics.registry import DurabilityMetrics, Registry
+from kubedl_tpu.scheduling.gang import CoschedulerPlugin
+from kubedl_tpu.utils import status as st
+from kubedl_tpu.utils.retry import RetryPolicy
+
+pytestmark = pytest.mark.durability
+
+
+def cm(name, data=None):
+    obj = m.new_obj("v1", "ConfigMap", name)
+    if data is not None:
+        obj["data"] = data
+    return obj
+
+
+# ---------------------------------------------------------------------------
+# journal: WAL + snapshots + recovery
+# ---------------------------------------------------------------------------
+
+
+def test_wal_replay_round_trips_the_store(tmp_path, clock):
+    api = APIServer(clock=clock, journal=Journal(str(tmp_path)))
+    api.create(cm("a", {"k": "1"}))
+    b = api.create(cm("b"))
+    b["data"] = {"k": "2"}
+    api.update(b)
+    api.create(cm("gone"))
+    api.delete("ConfigMap", "default", "gone")
+    rv = api.latest_resource_version()
+
+    # "restart": a fresh store recovers from the same directory
+    api2 = APIServer(clock=clock, journal=Journal(str(tmp_path)))
+    assert api2.latest_resource_version() == rv
+    assert {m.name(o) for o in api2.list("ConfigMap")} == {"a", "b"}
+    assert api2.get("ConfigMap", "default", "b")["data"] == {"k": "2"}
+    # canonical state is exactly the pre-restart canonical state
+    assert api2._objs == api._objs
+    # the rv counter resumed: the next write is above everything replayed
+    c = api2.create(cm("c"))
+    assert m.resource_version(c) == rv + 1
+
+
+def test_snapshot_rotation_and_recovery_from_snapshot_plus_tail(tmp_path,
+                                                                clock):
+    j = Journal(str(tmp_path), snapshot_every=5)
+    api = APIServer(clock=clock, journal=j)
+    for i in range(12):
+        api.create(cm(f"o-{i:02d}"))
+    assert j.snapshots_written >= 2
+    # rotation dropped old generations: one snapshot + the live WAL +
+    # the most recent sealed WAL (retained because a commit racing a
+    # checkpoint lands in the pre-rotation file with an rv ABOVE the
+    # snapshot's — filename rv bounds a file's minimum record rv only)
+    names = sorted(os.listdir(tmp_path))
+    assert sum(n.startswith("snap-") for n in names) == 1
+    assert sum(n.startswith("wal-") for n in names) == 2
+
+    j2 = Journal(str(tmp_path))
+    api2 = APIServer(clock=clock, journal=j2)
+    assert len(api2.list("ConfigMap")) == 12
+    assert api2.latest_resource_version() == api.latest_resource_version()
+    # provenance: newest snapshot plus a non-empty WAL tail
+    assert j2.recovered_from["snapshot_rv"] > 0
+    assert j2.recovered_from["wal_records"] == 2  # 12 commits, snap at 10
+
+
+def test_torn_wal_tail_is_tolerated(tmp_path, clock):
+    api = APIServer(clock=clock, journal=Journal(str(tmp_path)))
+    api.create(cm("ok"))
+    [wal] = [n for n in os.listdir(tmp_path) if n.startswith("wal-")]
+    with open(tmp_path / wal, "a") as f:
+        f.write('{"t": "c", "rv": 99, "k": ["ConfigMap", "d')  # crash mid-append
+    j2 = Journal(str(tmp_path))
+    api2 = APIServer(clock=clock, journal=j2)
+    assert [m.name(o) for o in api2.list("ConfigMap")] == ["ok"]
+    assert j2.recovered_from["torn_records"] == 1
+    assert api2.latest_resource_version() == 1
+
+
+def test_append_after_torn_tail_does_not_glue_records(tmp_path, clock):
+    """Review fix: reopening a WAL whose tail was torn by a crash must
+    terminate the garbage line first — otherwise the first acknowledged
+    post-restart append glues onto it and a SECOND recovery drops it."""
+    api = APIServer(clock=clock, journal=Journal(str(tmp_path)))
+    api.create(cm("before"))
+    [wal] = [n for n in os.listdir(tmp_path) if n.startswith("wal-")]
+    with open(tmp_path / wal, "a") as f:
+        f.write('{"t": "c", "rv": 9, "k": ["ConfigMap"')  # torn tail
+    # restart 1: recovery tolerates the tear, then ACKNOWLEDGES a write
+    api2 = APIServer(clock=clock, journal=Journal(str(tmp_path)))
+    api2.create(cm("after"))
+    # restart 2: the acknowledged record must have survived
+    api3 = APIServer(clock=clock, journal=Journal(str(tmp_path)))
+    assert {m.name(o) for o in api3.list("ConfigMap")} \
+        == {"before", "after"}
+    assert api3.latest_resource_version() \
+        == api2.latest_resource_version()
+
+
+def test_recovery_falls_back_past_a_torn_snapshot(tmp_path, clock):
+    j = Journal(str(tmp_path), snapshot_every=3)
+    api = APIServer(clock=clock, journal=j)
+    for i in range(4):
+        api.create(cm(f"o-{i}"))
+    # a torn NEWER snapshot (crash mid-checkpoint before the rename
+    # completed would normally leave only a .tmp; simulate the rename
+    # having landed on garbage bytes)
+    with open(tmp_path / "snap-0000000000000099.json", "w") as f:
+        f.write('{"rv": 99, "objects": [{"kind"')
+    j2 = Journal(str(tmp_path))
+    api2 = APIServer(clock=clock, journal=j2)
+    assert len(api2.list("ConfigMap")) == 4
+    assert j2.recovered_from["snapshot_rv"] == 3
+
+
+def test_checkpoint_keeps_records_that_raced_it(tmp_path, clock):
+    """Review fix: a commit racing the (outside-the-lock) checkpoint
+    lands in the pre-rotation WAL generation with an rv ABOVE the
+    snapshot's — the rotation must not unlink that file, or an
+    acknowledged write is lost and the recovered rv counter regresses."""
+    j = Journal(str(tmp_path), snapshot_every=10**9)
+    api = APIServer(clock=clock, journal=j)
+    for i in range(5):
+        api.create(cm(f"o-{i}"))
+    # the _maybe_snapshot interleaving: claim (rv, snaps), then another
+    # writer commits before write_snapshot runs
+    rv, snaps = api.latest_resource_version(), dict(api._snaps)
+    api.create(cm("raced"))
+    j.write_snapshot(rv, snaps)
+
+    j2 = Journal(str(tmp_path))
+    api2 = APIServer(clock=clock, journal=j2)
+    assert api2.try_get("ConfigMap", "default", "raced") is not None
+    assert api2.latest_resource_version() == rv + 1
+    assert j2.recovered_from["wal_records"] == 1
+
+
+def test_all_snapshots_unreadable_raises(tmp_path):
+    with open(tmp_path / "snap-0000000000000001.json", "w") as f:
+        f.write("not json")
+    with pytest.raises(JournalCorrupt):
+        Journal(str(tmp_path)).recover()
+
+
+def test_fsync_group_commit_batches(tmp_path, clock):
+    reg = Registry()
+    dm = DurabilityMetrics(reg)
+    j = Journal(str(tmp_path), fsync_every=8, metrics=dm)
+    api = APIServer(clock=clock, journal=j, durability_metrics=dm)
+    for i in range(20):
+        api.create(cm(f"o-{i}"))
+    assert dm.journal_appends.value() == 20
+    # 20 appends / fsync_every=8 -> exactly 2 group fsyncs
+    assert dm.journal_fsync.count() == 2
+    j.flush()
+    assert dm.journal_fsync.count() == 3
+
+
+def test_empty_dir_recovers_to_empty(tmp_path):
+    rv, objs = Journal(str(tmp_path)).recover()
+    assert rv == 0 and objs == {}
+
+
+# ---------------------------------------------------------------------------
+# resumable watches: the bounded per-kind event ring
+# ---------------------------------------------------------------------------
+
+
+def test_watch_from_replays_only_post_bookmark_events(clock):
+    api = APIServer(clock=clock, watch_ring=64)
+    api.create(cm("a"))
+    api.create(cm("b"))
+    bookmark = api.latest_resource_version()
+    api.create(cm("c"))
+    cc = api.get("ConfigMap", "default", "c")
+    cc["data"] = {"x": "1"}
+    api.update(cc)
+    api.delete("ConfigMap", "default", "a")
+
+    events = []
+    cancel, caught_up = api.watch_from(
+        lambda t, o: events.append((t, m.name(o), m.resource_version(o))),
+        bookmark)
+    assert events == [("ADDED", "c", 3), ("MODIFIED", "c", 4),
+                      ("DELETED", "a", 5)]  # tombstone carries the rv
+    assert caught_up == api.latest_resource_version() == 5
+    # live events flow after the replay
+    api.create(cm("d"))
+    assert events[-1] == ("ADDED", "d", 6)
+    cancel()
+    api.create(cm("e"))
+    assert events[-1] == ("ADDED", "d", 6)
+
+
+def test_watch_from_too_old_bookmark_counts_a_relist(clock):
+    dm = DurabilityMetrics(Registry())
+    api = APIServer(clock=clock, watch_ring=2, durability_metrics=dm)
+    for i in range(6):
+        api.create(cm(f"o-{i}"))
+    with pytest.raises(TooOldResourceVersion):
+        api.watch_from(lambda t, o: None, 0, kinds=("ConfigMap",))
+    assert dm.watch_relists.value(reason="too_old") == 1
+    # a fresh bookmark still resumes fine
+    _, rv = api.watch_from(lambda t, o: None,
+                           api.latest_resource_version(),
+                           kinds=("ConfigMap",))
+    assert rv == api.latest_resource_version()
+    assert dm.watch_relists.value(reason="too_old") == 1
+
+
+def test_ring_floors_are_per_kind(clock):
+    api = APIServer(clock=clock, watch_ring=3)
+    api.create(new_test_job("tj", workers=1))
+    for i in range(6):                 # evicts ConfigMap entries only
+        api.create(cm(f"o-{i}"))
+    with pytest.raises(TooOldResourceVersion):
+        api.watch_from(lambda t, o: None, 0, kinds=("ConfigMap",))
+    # the TestJob ring never overflowed: bookmark 0 replays its ADDED
+    got = []
+    api.watch_from(lambda t, o: got.append(m.name(o)), 0,
+                   kinds=("TestJob",))
+    assert got == ["tj"]
+
+
+def test_plain_store_has_no_ring_and_counts_the_fallback(clock):
+    api = APIServer(clock=clock)
+    with pytest.raises(TooOldResourceVersion):
+        api.watch_from(lambda t, o: None, 0)
+
+
+def test_informer_resumes_from_bookmark_without_relist(clock):
+    api = APIServer(clock=clock, watch_ring=64)
+    api.create(cm("a"))
+    inf = Informer(api, "ConfigMap")
+    inf.start()
+    assert inf.lister().get("default", "a") is not None
+
+    inf.disconnect()                     # dropped watch connection
+    api.create(cm("b"))
+    aa = api.get("ConfigMap", "default", "a")
+    aa["data"] = {"v": "2"}
+    api.update(aa)
+    api.create(new_test_job("foreign", workers=1))  # other kinds advance rv
+
+    inf.resume()
+    assert inf.bookmark_resumes == 1 and inf.full_relists == 0
+    assert inf.lister().get("default", "b") is not None
+    assert inf.lister().get("default", "a")["data"] == {"v": "2"}
+    # live again
+    api.create(cm("c"))
+    assert inf.lister().get("default", "c") is not None
+
+
+def test_relist_fallback_repairs_stale_and_ghost_cache_entries(clock):
+    """Review fix: the too-old fallback must be a client-go Replace(),
+    not an add-only start() — objects modified or deleted while the
+    informer was disconnected would otherwise stay stale/ghost in the
+    cache forever (and their handlers would never hear the delete)."""
+    api = APIServer(clock=clock, watch_ring=2)
+    inf = Informer(api, "ConfigMap")
+    deletes, updates = [], []
+    inf.add_event_handler(on_update=lambda old, new: updates.append(
+        m.name(new)), on_delete=lambda o: deletes.append(m.name(o)))
+    api.create(cm("stale", {"v": "1"}))
+    api.create(cm("ghost"))
+    inf.start()
+    inf.disconnect()
+
+    upd = api.get("ConfigMap", "default", "stale")
+    upd["data"] = {"v": "2"}
+    api.update(upd)
+    api.delete("ConfigMap", "default", "ghost")
+    for i in range(4):                   # evict the bookmark from the ring
+        api.create(cm(f"filler-{i}"))
+
+    inf.resume()
+    assert inf.full_relists == 1
+    assert inf.lister().get("default", "stale")["data"] == {"v": "2"}
+    assert inf.lister().get("default", "ghost") is None
+    assert deletes == ["ghost"] and "stale" in updates
+    assert {m.name(o) for o in inf.lister().list()} \
+        == {m.name(o) for o in api.list("ConfigMap")}
+
+
+def test_informer_cache_is_level_based_against_stale_events(clock):
+    """Review fix: a replayed event racing a newer live delivery (or a
+    chaos-duplicated one) must never regress the cache — MODIFIED below
+    the cached rv is dropped, and a stale DELETED tombstone cannot
+    remove a newer recreated object."""
+    api = APIServer(clock=clock, watch_ring=64)
+    inf = Informer(api, "ConfigMap")
+    api.create(cm("a", {"v": "1"}))
+    inf.start()
+    fresh = inf.lister().get("default", "a")
+    stale = copy.deepcopy(fresh)
+    upd = api.get("ConfigMap", "default", "a")
+    upd["data"] = {"v": "2"}
+    api.update(upd)                      # cache now at the newer rv
+
+    inf._on_event("MODIFIED", stale)     # replayed old snapshot
+    assert inf.lister().get("default", "a")["data"] == {"v": "2"}
+    inf._on_event("DELETED", stale)      # stale tombstone
+    assert inf.lister().get("default", "a") is not None
+    # a legitimate delete (tombstone at/above the cached rv) applies
+    api.delete("ConfigMap", "default", "a")
+    assert inf.lister().get("default", "a") is None
+    # review fix: a replayed stale MODIFIED landing AFTER the delete
+    # must not resurrect the object (deletion popped the cache level;
+    # the tombstone map keeps it)
+    inf._on_event("MODIFIED", stale)
+    assert inf.lister().get("default", "a") is None
+    # a genuine recreate carries a higher rv and clears the tombstone
+    api.create(cm("a", {"v": "3"}))
+    assert inf.lister().get("default", "a")["data"] == {"v": "3"}
+
+
+def test_informer_falls_back_to_full_relist_when_too_old(clock):
+    dm = DurabilityMetrics(Registry())
+    api = APIServer(clock=clock, watch_ring=2, durability_metrics=dm)
+    inf = Informer(api, "ConfigMap")
+    inf.start()
+    inf.disconnect()
+    for i in range(8):                   # blow the ring while disconnected
+        api.create(cm(f"o-{i}"))
+    inf.resume()
+    assert inf.full_relists == 1 and inf.bookmark_resumes == 0
+    assert dm.watch_relists.value(reason="too_old") == 1
+    assert len(inf.lister().list()) == 8
+    assert inf.has_synced()
+
+
+# ---------------------------------------------------------------------------
+# gate-off contract: byte-identical pre-durability behavior
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_gate_is_byte_identical(api, clock):
+    """THE pin: a plain store journals nothing, rings nothing, and a
+    delete allocates NO resourceVersion — exactly the pre-durability rv
+    stream. The durable store's delete allocates one (etcd revision
+    semantics) — that difference is gate-on only."""
+    api.create(cm("a"))
+    api.create(cm("b"))
+    api.delete("ConfigMap", "default", "a")
+    assert api.latest_resource_version() == 2   # delete did not bump
+    assert api._journal is None and api._ring_size == 0
+    assert api._event_ring == {}
+
+    durable = APIServer(clock=clock, watch_ring=8)
+    durable.create(cm("a"))
+    durable.create(cm("b"))
+    durable.delete("ConfigMap", "default", "a")
+    assert durable.latest_resource_version() == 3  # tombstone rv
+
+
+def test_disabled_operator_has_no_durability_families_and_one_shard():
+    op = build_operator(config=OperatorConfig(workloads=["PyTorchJob"]))
+    body = op.metrics_registry.expose()
+    assert "kubedl_journal_" not in body
+    assert "kubedl_watch_relists_total" not in body
+    assert "kubedl_shard_owned_keys" not in body
+    assert op.manager.shards == 1
+    assert op.api._journal is None and op.api._ring_size == 0
+
+
+def test_gate_on_operator_registers_families_shards_and_recovers(tmp_path):
+    cfg = OperatorConfig(workloads=["PyTorchJob"], enable_durability=True,
+                         journal_dir=str(tmp_path / "j"),
+                         snapshot_every=50, reconcile_shards=4)
+    op = build_operator(config=cfg)
+    assert op.manager.shards == 4
+    body = op.metrics_registry.expose()
+    assert "kubedl_journal_appends_total" in body
+    assert "kubedl_watch_relists_total" in body
+
+    template = {"spec": {"containers": [{
+        "name": "pytorch", "image": "img:v1",
+        "ports": [{"name": "pytorchjob-port", "containerPort": 23456}]}]}}
+    op.api.create(m.new_obj(
+        "training.kubedl.io/v1alpha1", "PyTorchJob", "pj",
+        spec={"pytorchReplicaSpecs": {"Master": {
+            "replicas": 1, "restartPolicy": "Never",
+            "template": template}}}))
+    for _ in range(10):
+        op.manager.run_until_idle(max_iterations=10_000)
+        pending = [p for p in op.api.list("Pod")
+                   if (p.get("status") or {}).get("phase",
+                                                  "Pending") != "Running"]
+        if not pending:
+            break
+        for pod in pending:
+            set_pod_phase(op.api, pod, "Running", container="pytorch")
+    jobs = op.api.list("PyTorchJob")
+    assert st.is_running(JobStatus.from_dict(jobs[0].get("status")))
+    assert op.api._journal.appends > 0
+
+    # the operator binary restarts: the world comes back from the journal
+    op2 = build_operator(config=cfg)
+    assert {m.name(j) for j in op2.api.list("PyTorchJob")} == {"pj"}
+    assert st.is_running(JobStatus.from_dict(
+        op2.api.list("PyTorchJob")[0].get("status")))
+    assert len(op2.api.list("Pod")) == len(op.api.list("Pod"))
+
+
+# ---------------------------------------------------------------------------
+# sharded reconcile ownership
+# ---------------------------------------------------------------------------
+
+
+def test_shard_hash_is_stable_and_balanced():
+    assert shard_for("default", "job-1", 1) == 0
+    one = shard_for("ns-a", "job-7", 8)
+    assert shard_for("ns-a", "job-7", 8) == one    # stable across calls
+    counts = [0] * 4
+    for i in range(1000):
+        counts[shard_for("default", f"job-{i:04d}", 4)] += 1
+    assert sum(counts) == 1000
+    assert all(150 <= c <= 350 for c in counts), counts
+
+
+class _OrderRecorder(Reconciler):
+    kind = "TestJob"
+
+    def __init__(self):
+        self.order = []
+
+    def reconcile(self, req):
+        self.order.append(req.name)
+
+
+def _dispatch_order(clock, shards):
+    api = APIServer(clock=clock)
+    mgr = Manager(api, clock=clock, shards=shards)
+    rec = mgr.register(_OrderRecorder())
+    for i in range(24):
+        api.create(new_test_job(f"j-{i:02d}", workers=1))
+    mgr.run_until_idle(max_iterations=10_000)
+    return rec.order
+
+
+def test_run_until_idle_order_is_identical_across_shard_counts(clock):
+    """The determinism contract BENCH_CLUSTER.json's byte-identity rides
+    on: the synchronous drain pops the globally-earliest (ready_at, seq)
+    entry whatever the shard count."""
+    assert _dispatch_order(clock, 1) == _dispatch_order(clock, 5) \
+        == _dispatch_order(clock, 16)
+
+
+def test_unowned_shards_park_until_the_lease_comes_back(clock):
+    api = APIServer(clock=clock)
+    owned = {0}
+    dm = DurabilityMetrics(Registry())
+    mgr = Manager(api, clock=clock, shards=4,
+                  shard_owner=lambda i: i in owned,
+                  durability_metrics=dm)
+    rec = mgr.register(_OrderRecorder())
+    names = [f"j-{i:02d}" for i in range(16)]
+    for n in names:
+        api.create(new_test_job(n, workers=1))
+    mine = {n for n in names if shard_for("default", n, 4) == 0}
+    assert 0 < len(mine) < len(names)
+
+    mgr.run_until_idle(max_iterations=10_000)
+    assert set(rec.order) == mine          # only the owned shard drained
+    assert mgr.pending() > 0
+    # per-shard occupancy is visible while keys wait for their owner
+    waiting = sum(int(dm.shard_owned_keys.value(shard=str(i)))
+                  for i in range(1, 4))
+    assert waiting == len(names) - len(mine)
+
+    owned.update({1, 2, 3})                # lease handoff: we own it all
+    mgr.run_until_idle(max_iterations=10_000)
+    assert set(rec.order) == set(names)
+    assert mgr.pending() == 0
+
+
+def test_shard_lease_handoff_between_candidates(clock):
+    api = APIServer(clock=clock)
+    a = ShardLeaseSet(api, 2, identity="op-a", clock=clock)
+    b = ShardLeaseSet(api, 2, identity="op-b", clock=clock)
+    assert a.step() == {0, 1}              # first candidate takes all
+    assert b.step() == set()
+    clock.advance(5.0)
+    assert a.step() == {0, 1}              # renewal holds the fleet
+    assert b.step() == set()
+    assert a.owned() == {0, 1} and b.owned() == set()
+
+    # op-a dies (stops renewing); after lease_duration on op-b's OWN
+    # clock the record reads stale and op-b takes both shards over
+    clock.advance(16.0)
+    assert b.step() == {0, 1}
+    assert a.step() == set()               # demoted on its next round
+    assert not a.owns(0) and b.owns(0) and b.owns(1)
+    # handoff is visible in the Lease objects themselves
+    for i in range(2):
+        lease = api.get("Lease", "kubedl-system", f"kubedl-shard-{i}")
+        assert lease["spec"]["holderIdentity"] == "op-b"
+        assert int(lease["spec"]["leaseTransitions"]) >= 1
+
+
+def test_sharded_managers_split_ownership_and_converge(clock):
+    """Two managers over one store, each holding one shard's lease:
+    every job is reconciled by exactly one of them, and together they
+    cover the world — the N-process deployment in miniature."""
+    api = APIServer(clock=clock)
+    a_set = ShardLeaseSet(api, 2, identity="op-a", clock=clock)
+    assert a_set.step() == {0, 1}
+    a_set.electors[1].release()            # op-a keeps shard 0 only
+    b_set = ShardLeaseSet(api, 2, identity="op-b", clock=clock)
+    assert b_set.step() == {1}
+
+    mgr_a = Manager(api, clock=clock, shards=2, shard_owner=a_set.owns)
+    mgr_b = Manager(api, clock=clock, shards=2, shard_owner=b_set.owns)
+    rec_a = mgr_a.register(_OrderRecorder())
+    rec_b = mgr_b.register(_OrderRecorder())
+    names = [f"j-{i:02d}" for i in range(12)]
+    for n in names:
+        api.create(new_test_job(n, workers=1))
+    mgr_a.run_until_idle(max_iterations=10_000)
+    mgr_b.run_until_idle(max_iterations=10_000)
+    assert set(rec_a.order) & set(rec_b.order) == set()
+    assert set(rec_a.order) | set(rec_b.order) == set(names)
+    assert {shard_for("default", n, 2) for n in rec_a.order} == {0}
+    assert {shard_for("default", n, 2) for n in rec_b.order} == {1}
+
+
+# ---------------------------------------------------------------------------
+# THE crash-mid-storm chaos e2e (acceptance)
+# ---------------------------------------------------------------------------
+
+N_STORM_JOBS = 6
+
+
+def _uid_factory(seed):
+    state = {"n": 0}
+
+    def factory():
+        state["n"] += 1
+        return f"dur-{seed}-{state['n']:06d}"
+    return factory
+
+
+def _build_stack(inner, clock, seed, budget):
+    chaos = ChaosAPIServer(inner, ChaosConfig(
+        seed=seed, conflict_on_status_update=0.15, error_on_create=0.10,
+        drop_watch_events=0.05, max_faults=budget))
+    manager = Manager(chaos, clock=clock, shards=2)
+    engine = JobEngine(
+        chaos, TestJobController(),
+        EngineConfig(enable_gang_scheduling=True,
+                     retry_policy=RetryPolicy(attempts=5, base=0.01,
+                                              cap=0.05),
+                     retry_sleep=clock.advance,
+                     backoff_jitter_seed=seed,
+                     restart_backoff_base=5.0, restart_backoff_cap=30.0),
+        gang=CoschedulerPlugin(chaos))
+    manager.register(engine)
+    return chaos, manager
+
+
+def _drive(manager, clock, inner, rounds=1):
+    """One storm round: drain, resync-nudge every job (the stand-in for
+    the informer relist that repairs chaos-dropped watch events), play
+    kubelet, then advance the sim clock to the manager's next deadline
+    so requeue nets and restart backoffs fire when scheduled."""
+    for _ in range(rounds):
+        manager.run_until_idle(max_iterations=20_000)
+        for job in inner.list("TestJob"):
+            manager.enqueue(Request("TestJob", "default", m.name(job)))
+        manager.run_until_idle(max_iterations=20_000)
+        for pod in inner.list("Pod"):
+            ph = (pod.get("status") or {}).get("phase", "Pending")
+            if ph == "Pending" and not m.is_deleting(pod):
+                set_pod_phase(inner, pod, "Running")
+        manager.run_until_idle(max_iterations=20_000)
+        dl = manager.next_deadline()
+        if dl is not None:
+            clock.advance_to(dl - clock.t0 + 1e-6)
+        else:
+            clock.advance(2.0)
+        manager.run_until_idle(max_iterations=20_000)
+
+
+def _jobs_status(inner):
+    return {m.name(j): JobStatus.from_dict(j.get("status"))
+            for j in inner.list("TestJob")}
+
+
+def _drive_to_succeeded(manager, clock, inner, max_rounds=120):
+    for _ in range(max_rounds):
+        _drive(manager, clock, inner, rounds=1)
+        for name, s in _jobs_status(inner).items():
+            if st.is_succeeded(s) or not st.is_running(s):
+                continue
+            job = inner.get("TestJob", "default", name)
+            for p in inner.list_owned("Pod", m.uid(job),
+                                      namespace="default"):
+                if (p.get("status") or {}).get("phase") == "Running":
+                    set_pod_phase(inner, p, "Succeeded", exit_code=0)
+        manager.run_until_idle(max_iterations=20_000)
+        statuses = _jobs_status(inner)
+        if len(statuses) == N_STORM_JOBS and all(
+                st.is_succeeded(s) for s in statuses.values()):
+            return
+    raise AssertionError(
+        f"storm never converged: "
+        f"{ {n: s.conditions[-1].type if s.conditions else '?' for n, s in _jobs_status(inner).items()} }")
+
+
+def _submit(inner, i):
+    inner.create(new_test_job(
+        f"storm-{i}", workers=2, restart_policy="ExitCode",
+        tpu_policy={"acceleratorType": "v5p-16"}))
+
+
+def _run_storm(seed, clock, journal_dir=None, crash=False,
+               dur_metrics=None):
+    """The scripted storm. With ``crash=True`` the operator process-model
+    is killed right after the chaos preemption and a fresh one recovers
+    from the journal; returns (final inner api, crash diagnostics)."""
+    journal = Journal(str(journal_dir), snapshot_every=25,
+                      fsync_every=16) if journal_dir else None
+    inner = APIServer(clock=clock, uid_factory=_uid_factory(seed),
+                      journal=journal,
+                      watch_ring=2048 if journal else 0,
+                      durability_metrics=dur_metrics)
+    chaos, manager = _build_stack(inner, clock, seed, budget=25)
+    informer = Informer(inner, "TestJob")   # the "console process"
+    informer.start()
+
+    for i in range(3):
+        _submit(inner, i)
+    for _ in range(40):
+        _drive(manager, clock, inner, rounds=1)
+        statuses = _jobs_status(inner)
+        if len(statuses) == 3 and all(st.is_running(s)
+                                      for s in statuses.values()):
+            break
+    else:
+        raise AssertionError(
+            f"seed {seed}: storm phase 1 never reached Running")
+
+    # the storm's disruption: a chaos node preemption mid-run
+    victim = sorted(m.name(p) for p in inner.list("Pod"))[0]
+    chaos.preempt("default", victim)
+    manager.run_until_idle(max_iterations=20_000)
+
+    diag = {}
+    if crash:
+        # make sure the WAL has a tail past the newest snapshot, then
+        # kill the operator: no close(), no flush beyond the per-record
+        # write(2) — exactly what a SIGKILL leaves behind
+        i = 0
+        while journal._since_snapshot == 0:
+            inner.create(cm(f"crash-marker-{i}"))
+            i += 1
+        pre_objs = copy.deepcopy(inner._objs)
+        pre_rv = inner.latest_resource_version()
+        informer.disconnect()               # its server just went away
+
+        journal2 = Journal(str(journal_dir), snapshot_every=25,
+                           fsync_every=16)
+        recovered = APIServer(clock=clock, uid_factory=_uid_factory(seed + 7),
+                              journal=journal2, watch_ring=2048,
+                              durability_metrics=dur_metrics)
+        # exact pre-crash store: objects AND the rv counter
+        assert recovered._objs == pre_objs
+        assert recovered.latest_resource_version() == pre_rv
+        assert journal2.recovered_from["snapshot_rv"] > 0, \
+            "recovery must have used a snapshot"
+        assert journal2.recovered_from["wal_records"] > 0, \
+            "recovery must have replayed a WAL tail"
+        diag["recovered_from"] = dict(journal2.recovered_from)
+
+        # the surviving informer resumes via bookmark: no full relist
+        informer.api = recovered
+        informer.resume()
+        assert informer.bookmark_resumes == 1
+        assert informer.full_relists == 0
+        inner = recovered
+        chaos, manager = _build_stack(inner, clock, seed + 1000, budget=10)
+        # restart relist: the manager's startup enqueue (this is the
+        # operator's own boot list, not an informer relist)
+        for j in inner.list("TestJob"):
+            manager.enqueue(Request("TestJob", "default", m.name(j)))
+
+    for i in range(3, N_STORM_JOBS):
+        _submit(inner, i)
+    _drive_to_succeeded(manager, clock, inner)
+
+    # informer cache converged with the store (bookmark stream stayed
+    # gapless through the crash)
+    cached = {m.name(o) for o in informer.lister().list()}
+    assert cached == {m.name(o) for o in inner.list("TestJob")}
+    return inner, diag
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_crash_mid_storm_recovers_to_parity(tmp_path, seed):
+    """Acceptance: kill/restart of the operator process-model mid
+    3-seed storm recovers from snapshot+WAL replay and converges to
+    parity with a never-crashed reference run — with informers resumed
+    via bookmark and zero full relists after recovery."""
+    dm = DurabilityMetrics(Registry())
+    crashed, diag = _run_storm(seed, SimClock(),
+                               journal_dir=tmp_path / "journal",
+                               crash=True, dur_metrics=dm)
+    reference, _ = _run_storm(seed, SimClock())
+
+    # parity with the never-crashed run: same job set, every job
+    # completed in both worlds
+    a, b = _jobs_status(crashed), _jobs_status(reference)
+    assert set(a) == set(b)
+    assert all(st.is_succeeded(s) for s in a.values()), \
+        f"crashed run did not converge (recovery: {diag})"
+    assert all(st.is_succeeded(s) for s in b.values())
+    # < 1 full relist per informer after recovery — actually zero
+    assert dm.watch_relists.value(reason="too_old") == 0
+    assert dm.watch_relists.value(reason="ring_disabled") == 0
+    # both worlds settled to the same pod population per job
+    pods_a = sorted(m.name(p) for p in crashed.list("Pod"))
+    pods_b = sorted(m.name(p) for p in reference.list("Pod"))
+    assert pods_a == pods_b
+
+
+# ---------------------------------------------------------------------------
+# bench regression gate plumbing (tamper test, like bench_scheduler's)
+# ---------------------------------------------------------------------------
+
+
+def _bench_doc(**overrides):
+    doc = {
+        "benchmark": "controlplane_settle",
+        "jobs": 10000, "replicas": 16,
+        "shards1": {"jobs_per_sec_settled": 100.0,
+                    "reconcile_ms": {"p50": 0.4, "p99": 3.0}},
+        "shards4": {"jobs_per_sec_settled": 320.0,
+                    "reconcile_ms": {"p50": 0.4, "p99": 3.0}},
+        "speedup_sharded_settle": 3.2,
+        "durability": {"relists_avoided": 32, "full_relists": 0},
+        "legacy_200x8": {"speedup_settle_throughput": 5.7},
+    }
+    for k, v in overrides.items():
+        if isinstance(v, dict) and isinstance(doc.get(k), dict):
+            doc[k] = {**doc[k], **v}
+        else:
+            doc[k] = v
+    return doc
+
+
+def test_bench_regression_gate_detects_tampering():
+    import bench_controlplane as bench
+    old = _bench_doc()
+    assert bench.check_regression(_bench_doc(), old) == []
+    # sharded settle throughput collapse: flagged
+    worse = _bench_doc(shards4={"jobs_per_sec_settled": 150.0,
+                                "reconcile_ms": {"p50": 0.4, "p99": 3.0}},
+                       speedup_sharded_settle=1.5)
+    assert any("shards4" in p or "speedup" in p
+               for p in bench.check_regression(worse, old))
+    # p99 blow-up: flagged
+    slow = _bench_doc(shards4={"jobs_per_sec_settled": 320.0,
+                               "reconcile_ms": {"p50": 0.4, "p99": 30.0}})
+    assert any("p99" in p for p in bench.check_regression(slow, old))
+    # a re-scaled run is a new baseline, not a regression
+    rescaled = _bench_doc(jobs=500)
+    rescaled["shards4"]["jobs_per_sec_settled"] = 1.0
+    assert bench.check_regression(rescaled, old) == []
+
+
+def test_bench_gate_requires_sharded_speedup():
+    import bench_controlplane as bench
+    ok = _bench_doc()
+    assert bench.evaluate_gate(ok) == []
+    slow = _bench_doc(speedup_sharded_settle=1.4)
+    assert any("speedup" in p for p in bench.evaluate_gate(slow))
+    worse_p99 = _bench_doc(
+        shards4={"jobs_per_sec_settled": 320.0,
+                 "reconcile_ms": {"p50": 0.4, "p99": 30.0}})
+    assert any("p99" in p for p in bench.evaluate_gate(worse_p99))

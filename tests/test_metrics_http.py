@@ -207,6 +207,38 @@ def test_slo_families_in_exposition(served):
     assert 'kubedl_slo_budget_remaining_ratio{slo="we\\"ird"} 1.0' in body
 
 
+def test_durability_families_in_exposition(served):
+    """Pin the durable-control-plane families (docs/durability.md):
+    names, label sets, and the histogram contract on the fsync latency.
+    These register only when the DurableControlPlane gate is on — their
+    absence from a gate-off operator's exposition is pinned in
+    tests/test_durability.py."""
+    from kubedl_tpu.metrics.registry import DurabilityMetrics
+    reg, port = served
+    dm = DurabilityMetrics(reg)
+    dm.journal_appends.inc(5)
+    dm.journal_fsync.observe(0.002)
+    dm.snapshot_writes.inc()
+    dm.watch_relists.inc(reason="too_old")
+    dm.watch_relists.inc(reason="ring_disabled")
+    dm.shard_owned_keys.set(7, shard="0")
+    dm.shard_owned_keys.set(3, shard="3")
+    _, body, _ = scrape(port)
+    assert "# TYPE kubedl_journal_appends_total counter" in body
+    assert "kubedl_journal_appends_total 5.0" in body
+    assert "# TYPE kubedl_journal_fsync_seconds histogram" in body
+    assert 'kubedl_journal_fsync_seconds_bucket{le="0.0025"} 1' in body
+    assert "kubedl_journal_fsync_seconds_count 1" in body
+    assert "# TYPE kubedl_snapshot_writes_total counter" in body
+    assert "kubedl_snapshot_writes_total 1.0" in body
+    assert "# TYPE kubedl_watch_relists_total counter" in body
+    assert 'kubedl_watch_relists_total{reason="too_old"} 1.0' in body
+    assert 'kubedl_watch_relists_total{reason="ring_disabled"} 1.0' in body
+    assert "# TYPE kubedl_shard_owned_keys gauge" in body
+    assert 'kubedl_shard_owned_keys{shard="0"} 7.0' in body
+    assert 'kubedl_shard_owned_keys{shard="3"} 3.0' in body
+
+
 def test_label_value_escaping(served):
     reg, port = served
     g = reg.gauge("kubedl_esc", "escapes", ("name",))

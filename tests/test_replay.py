@@ -180,6 +180,21 @@ def test_job_replay_deterministic_bit_for_bit():
     assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
 
 
+@pytest.mark.durability
+def test_sharded_replay_is_timeline_identical():
+    """The sharded-ownership leg (docs/durability.md): the replay with
+    reconcile shards threaded through produces the BIT-FOR-BIT same
+    observations as shards=1 — the manager's synchronous drain pops in
+    globally-earliest order whatever the shard count, which is exactly
+    why the committed BENCH_CLUSTER.json (shards=1 default) stays
+    byte-identical under this PR."""
+    import json
+    p = small_profile()
+    a = ClusterReplay(generate(p, 3)).run()
+    b = ClusterReplay(generate(p, 3), shards=4).run()
+    assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+
+
 # ---------------------------------------------------------------------------
 # scorecard gates + regression check (synthetic, no replay needed)
 # ---------------------------------------------------------------------------
