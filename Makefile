@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test test-all test-fast test-chaos test-scheduler test-trace test-replay test-telemetry test-slo test-durability bench bench-controlplane bench-scheduler bench-serving-paged bench-trace bench-cluster dryrun crds run-standalone lint native
+.PHONY: test test-all test-fast test-chaos test-campaign test-scheduler test-trace test-replay test-telemetry test-slo test-durability bench bench-controlplane bench-scheduler bench-serving-paged bench-trace bench-cluster bench-cluster-adversarial dryrun crds run-standalone lint native
 
 # fast path (<3 min): everything except the compile-heavy compute suites
 # (those carry `pytestmark = pytest.mark.slow`). Chaos tests are fast and
@@ -14,6 +14,11 @@ test:
 # failing seed (every chaos test prints the seed it ran with)
 test-chaos:
 	$(PY) -m pytest tests/ -q -m chaos
+
+# chaos-campaign suite (correlated fault primitives, latency injection,
+# scenario scripts, SLO-survival e2e; docs/chaos.md)
+test-campaign:
+	$(PY) -m pytest tests/ -q -m campaign
 
 # full suite on the 8-device virtual CPU mesh (conftest pins the platform);
 # -n auto spreads the compute compiles over workers when pytest-xdist is
@@ -101,6 +106,19 @@ test-durability:
 # replay in tests/test_replay.py.
 bench-cluster:
 	JAX_PLATFORMS=cpu $(PY) bench_cluster.py --profile day
+
+# the adversarial chaos-campaign gate (docs/chaos.md): for each seed,
+# the declarative 'adversarial' scenario (domain outage, spot-dry
+# capacity sweep, rolling drains, watch storms, hot-looping shard, slow
+# WAL fsync) runs through the real stack TWICE (bit-for-bit determinism
+# proven in-run) plus a fault-free reference of the same workload ->
+# BENCH_CLUSTER_ADVERSARIAL.json. Gates on SLO survival: >= 1 page
+# fires AND clears, no error budget exhausts, zero stranded
+# alerts/conditions, object-level parity with the reference world;
+# FAILS on regression vs the committed artifact (shared tolerance
+# engine). The tier-1 guard is the e2e in tests/test_campaign.py.
+bench-cluster-adversarial:
+	JAX_PLATFORMS=cpu $(PY) bench_cluster.py --profile adversarial
 
 # multi-chip sharding compile+execute proof on a virtual mesh
 dryrun:

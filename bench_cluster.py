@@ -15,10 +15,21 @@ When a committed scorecard exists at ``--out``, the fresh run is also
 checked against it and the bench FAILS on regression — one number every
 future PR must move, never backslide.
 
+The ``--profile adversarial`` leg is the chaos-campaign gate
+(docs/chaos.md): for each ``--seeds`` seed it compiles the declarative
+``adversarial`` scenario (correlated domain outage, spot-dry sweep,
+rolling drains, watch storms, hot-looping shard, slow WAL fsync), drives
+the job day through the REAL stack with the campaign firing, re-runs it
+to prove bit-for-bit determinism, replays a fault-free reference of the
+same workload, and commits ``BENCH_CLUSTER_ADVERSARIAL.json`` gated on
+SLO survival: at least one page fires AND clears, no error budget
+exhausts, zero stranded alerts/conditions, and the post-campaign control
+plane reaches object-level parity with the reference world.
+
 Usage::
 
-    python bench_cluster.py [--profile smoke|day] [--seed 0]
-                            [--out BENCH_CLUSTER.json] [--no-check]
+    python bench_cluster.py [--profile smoke|day|adversarial] [--seed 0]
+                            [--seeds 0,1] [--out FILE] [--no-check]
 """
 
 from __future__ import annotations
@@ -27,14 +38,131 @@ import argparse
 import json
 import os
 import sys
+import tempfile
 import time
+
+
+def run_adversarial(args) -> dict:
+    from kubedl_tpu.chaos import build_campaign
+    from kubedl_tpu.replay import (ClusterReplay,
+                                   build_campaign_scorecard,
+                                   check_campaign_regression,
+                                   evaluate_campaign_gates, generate)
+
+    if args.seeds is not None:
+        seeds = [int(s) for s in str(args.seeds).split(",")
+                 if s.strip() != ""]
+    elif args.seed is not None:
+        seeds = [args.seed]          # replaying one failed campaign
+    else:
+        seeds = [0, 1]               # the committed-artifact default
+    if not seeds:
+        raise SystemExit("--seeds must name at least one seed "
+                         "(e.g. --seeds 0,1)")
+    legs = []
+    for seed in seeds:
+        workload = generate("adversarial", seed)
+        campaign = build_campaign(args.scenario, seed, workload.profile)
+        print(f"seed {seed}: {len(workload.jobs)} jobs, campaign "
+              f"{args.scenario} with {len(campaign.actions)} actions, "
+              f"fingerprint {campaign.fingerprint()[:16]}",
+              file=sys.stderr)
+
+        def one_run():
+            wl = generate("adversarial", seed)
+            camp = build_campaign(args.scenario, seed, wl.profile)
+            with tempfile.TemporaryDirectory() as jdir:
+                replay = ClusterReplay(wl, shards=4, campaign=camp,
+                                       journal_dir=jdir)
+                res = replay.run()
+                return res, replay.control_plane_state()
+
+        t0 = time.perf_counter()
+        result, state = one_run()
+        repeat, repeat_state = one_run()
+        deterministic = (
+            json.dumps(result, sort_keys=True)
+            == json.dumps(repeat, sort_keys=True)
+            and state == repeat_state)
+        reference = ClusterReplay(generate("adversarial", seed))
+        ref_result = reference.run()
+        ref_state = reference.control_plane_state()
+        print(f"seed {seed}: campaign x2 + reference replayed in "
+              f"{time.perf_counter() - t0:.1f}s wall "
+              f"(deterministic={deterministic}, "
+              f"pages={result['slo_health']['pages_fired']}, "
+              f"min budget "
+              f"{result['slo_health']['min_budget_remaining']})",
+              file=sys.stderr)
+        legs.append({"workload": workload, "result": result,
+                     "state": state, "reference": ref_result,
+                     "reference_state": ref_state,
+                     "deterministic": deterministic})
+
+    scorecard = build_campaign_scorecard(args.scenario, legs)
+    scorecard["gates"] = evaluate_campaign_gates(scorecard)
+
+    problems = []
+    if not args.no_check and args.out and os.path.exists(args.out):
+        try:
+            with open(args.out) as f:
+                committed = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"warning: cannot read committed {args.out}: {e}",
+                  file=sys.stderr)
+            committed = {}
+        problems = check_campaign_regression(scorecard, committed)
+
+    print(json.dumps(scorecard))
+    if not scorecard["gates"]["passed"]:
+        failed = [c for c in scorecard["gates"]["checks"]
+                  if not c["passed"]]
+        raise SystemExit(f"GATE FAILED: {failed}")
+    if problems:
+        raise SystemExit("REGRESSION vs committed scorecard:\n  "
+                         + "\n  ".join(problems))
+    # a narrowed debug replay (--seed N / --seeds / --scenario) must not
+    # silently rewrite the committed two-seed artifact with a subset:
+    # check_campaign_regression only compares seeds present in BOTH
+    # artifacts, so the lost baseline would never be flagged. Write the
+    # defaulted path only for the committed shape; a debug run needs an
+    # explicit --out.
+    committed_shape = (seeds == [0, 1]
+                       and args.scenario == "adversarial")
+    if args.out and (getattr(args, "out_explicit", True)
+                     or committed_shape):
+        with open(args.out, "w") as f:
+            json.dump(scorecard, f, indent=2, sort_keys=True)
+            f.write("\n")
+    elif args.out:
+        print(f"not writing {args.out}: narrowed debug run "
+              f"(seeds={seeds}, scenario={args.scenario!r}) would "
+              f"replace the committed artifact with a "
+              f"{len(seeds)}-seed subset; pass --out explicitly to "
+              f"write it",
+              file=sys.stderr)
+    return scorecard
 
 
 def main() -> dict:
     ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--profile", choices=("smoke", "day"), default="day")
-    ap.add_argument("--seed", type=int, default=0)
-    ap.add_argument("--out", default="BENCH_CLUSTER.json")
+    ap.add_argument("--profile", choices=("smoke", "day", "adversarial"),
+                    default="day")
+    ap.add_argument("--seed", type=int, default=None,
+                    help="workload seed (default 0); for --profile "
+                         "adversarial a bare --seed N runs that one "
+                         "campaign seed")
+    ap.add_argument("--seeds", default=None,
+                    help="adversarial profile: comma-separated campaign "
+                         "seeds (each is a full run set; default 0,1 — "
+                         "the committed artifact)")
+    ap.add_argument("--scenario", default="adversarial",
+                    help="adversarial profile: scenario name from "
+                         "kubedl_tpu.chaos.SCENARIOS")
+    ap.add_argument("--out", default=None,
+                    help="scorecard path (default BENCH_CLUSTER.json, "
+                         "or BENCH_CLUSTER_ADVERSARIAL.json for "
+                         "--profile adversarial)")
     ap.add_argument("--no-check", action="store_true",
                     help="skip the regression check against the "
                          "committed scorecard at --out")
@@ -42,6 +170,15 @@ def main() -> dict:
                     help="job day only (debugging aid; gates involving "
                          "serving will fail)")
     args = ap.parse_args()
+    args.out_explicit = args.out is not None
+    if args.out is None:
+        args.out = ("BENCH_CLUSTER_ADVERSARIAL.json"
+                    if args.profile == "adversarial"
+                    else "BENCH_CLUSTER.json")
+    if args.profile == "adversarial":
+        return run_adversarial(args)
+    if args.seed is None:
+        args.seed = 0
 
     from kubedl_tpu.replay import (ClusterReplay, ServingReplay,
                                    build_scorecard, check_regression,

@@ -50,11 +50,24 @@ DISRUPTION_TARGET = c.POD_COND_DISRUPTION_TARGET
 
 def chaos_seed(default: int = DEFAULT_SEED) -> int:
     """The chaos seed, overridable via ``KUBEDL_CHAOS_SEED`` for replaying
-    a failed run."""
-    try:
-        return int(os.environ.get(ENV_CHAOS_SEED, "") or default)
-    except ValueError:
+    a failed run. A malformed override fails HERE, loudly — silently
+    falling back to the default would "replay" a different storm than the
+    one being debugged, and raising bare ``int()`` noise mid-run names
+    neither the variable nor the fix."""
+    raw = os.environ.get(ENV_CHAOS_SEED, "")
+    if not raw.strip():
         return default
+    try:
+        seed = int(raw)
+    except ValueError:
+        raise ValueError(
+            f"{ENV_CHAOS_SEED} must be a base-10 integer seed, got "
+            f"{raw!r} (unset it to use the default {default})")
+    if seed < 0:
+        raise ValueError(
+            f"{ENV_CHAOS_SEED} must be >= 0, got {raw!r} (seeds are "
+            f"printed by chaos runs as non-negative integers)")
+    return seed
 
 
 @dataclass
@@ -78,19 +91,40 @@ class ChaosConfig:
     #: stop injecting probabilistic faults after this many, so soak tests
     #: provably terminate (scripted faults are not budgeted)
     max_faults: Optional[int] = None
+    #: probabilistic latency injection: op -> (probability, seconds).
+    #: The delay ADVANCES THE INJECTED CLOCK (never sleeps), so sim-time
+    #: campaigns stay bit-for-bit reproducible; ops are the CRUD names
+    #: plus "fsync" (the journal's group-commit path, docs/chaos.md).
+    #: Latency injections are recorded in ``ChaosAPIServer.latencies``
+    #: and do NOT consume the ``max_faults`` budget.
+    op_latency: dict = field(default_factory=dict)
 
 
 class ChaosAPIServer:
     """Fault-injecting proxy: drop-in for ``APIServer`` wherever the engine
     or manager expects one. Unlisted attributes delegate to ``inner``."""
 
-    def __init__(self, inner: APIServer, config: Optional[ChaosConfig] = None):
+    def __init__(self, inner: APIServer, config: Optional[ChaosConfig] = None,
+                 clock=None):
         self.inner = inner
         self.config = config or ChaosConfig()
         self.rng = random.Random(self.config.seed)
         #: every injected fault: (op, kind, "ns/name", exc class name)
         self.faults: list[tuple] = []
+        #: every injected latency: (op, kind, "ns/name", seconds) —
+        #: separate from ``faults`` so delays never burn the max_faults
+        #: budget (a slow write is not a failed write)
+        self.latencies: list[tuple] = []
+        #: every preemption this server executed (scripted or scheduled):
+        #: ("ns/name", deleted) — the injector's own ledger, so benches
+        #: can attribute restarts to chaos with zero bench-local counters
+        self.preemptions: list[tuple] = []
+        #: injectable sim clock latency advances ride (SimClock or any
+        #: object with ``advance(dt)``); without one, latency injection
+        #: is a loud no-op — this layer never sleeps
+        self.clock = clock
         self._scripted: dict[str, list] = {}   # op -> [(exc, kind, after)]
+        self._slow: dict[str, list] = {}       # op -> [(seconds, kind)]
         self._pod_creates = 0
         self._preempt_at: dict[int, bool] = {}  # nth pod create -> delete?
         log.info("chaos enabled: seed=%d (replay with %s=%d)",
@@ -117,6 +151,19 @@ class ChaosAPIServer:
         DisruptionTarget condition + Failed(143), plus deletion when
         ``delete``."""
         self._preempt_at[nth_pod_create] = delete
+
+    def slow_next(self, op: str, seconds: float, times: int = 1,
+                  kind: Optional[str] = None) -> None:
+        """Queue ``times`` deterministic latency injections for ``op``
+        (the CRUD names, or ``"fsync"`` for the journal's group-commit
+        path): the next matching operation advances the injected clock
+        by ``seconds`` before committing. Needs a ``clock`` — this layer
+        simulates a slow disk/apiserver, it never sleeps."""
+        if seconds <= 0:
+            raise ValueError(f"slow_next seconds must be > 0, "
+                             f"got {seconds!r}")
+        self._slow.setdefault(op, []).extend((float(seconds), kind)
+                                             for _ in range(times))
 
     # -- fault engine -----------------------------------------------------
 
@@ -155,8 +202,53 @@ class ChaosAPIServer:
         log.info("injecting %s", err)
         return err
 
+    def _take_latency(self, op: str, kind: str, target: str) -> float:
+        """Seconds of injected latency for this operation: a scripted
+        ``slow_next`` match first, then the probabilistic
+        ``ChaosConfig.op_latency`` rate. Draws the rng ONLY when a rate
+        is configured for ``op`` — an unconfigured server's random
+        stream is untouched (committed scorecards depend on this)."""
+        total = 0.0
+        script = self._slow.get(op)
+        if script:
+            for i, (seconds, want_kind) in enumerate(script):
+                if want_kind is None or want_kind == kind:
+                    script.pop(i)
+                    total += seconds
+                    break
+        rate = self.config.op_latency.get(op)
+        if rate:
+            prob, seconds = rate
+            if prob > 0 and self.rng.random() < prob:
+                total += float(seconds)
+        if total > 0:
+            self.latencies.append((op, kind, target, total))
+            log.info("chaos: injecting %gs latency on %s %s %s (seed=%d)",
+                     total, op, kind, target, self.config.seed)
+        return total
+
+    def _advance(self, seconds: float) -> None:
+        if seconds <= 0:
+            return
+        if self.clock is None:
+            log.warning("chaos: latency injection configured but no "
+                        "clock to advance; dropping the delay (this "
+                        "layer never sleeps)")
+            return
+        self.clock.advance(seconds)
+
+    def fsync_hook(self) -> None:
+        """The journal's slow-disk seam (docs/chaos.md): installed as
+        ``Journal(fsync_hook=...)``, called inside the group-commit
+        fsync, advances the injected clock by any pending ``fsync``
+        latency. With the journal's ``timer`` on the same clock, the
+        delay lands inside ``kubedl_journal_fsync_seconds`` — exactly
+        where a 1/100th-speed WAL disk would show up."""
+        self._advance(self._take_latency("fsync", "Journal", "*"))
+
     def _run(self, op: str, obj_kind: str, target: str, prob: float,
              default_exc: type, call):
+        self._advance(self._take_latency(op, obj_kind, target))
         err, after = self._fault(op, obj_kind, target, prob, default_exc)
         if err is not None and not after:
             raise err
@@ -184,6 +276,8 @@ class ChaosAPIServer:
                              self._pod_creates, m.name(out), self.config.seed)
                     preempt_pod(self.inner, m.namespace(out), m.name(out),
                                 delete=delete)
+                    self.preemptions.append(
+                        (f"{m.namespace(out)}/{m.name(out)}", delete))
             return out
 
         # transient creates alternate 5xx and timeout so both the clean
@@ -212,28 +306,49 @@ class ChaosAPIServer:
 
     # -- watch chaos ------------------------------------------------------
 
+    def _watch_filter(self, fn, drop_ok):
+        """The one seeded drop/duplicate filter both watch paths share
+        (a divergence in fault recording or rng-draw order between them
+        would silently fork the chaos stream). ``drop_ok()`` gates
+        drops per event; duplication is always eligible."""
+        def filtered(event_type, obj):
+            if m.kind(obj) not in self.config.watch_kinds:
+                fn(event_type, obj)
+                return
+            target = f"{m.namespace(obj)}/{m.name(obj)}"
+            if drop_ok() and self.config.drop_watch_events > 0 \
+                    and self.rng.random() < self.config.drop_watch_events:
+                self.faults.append(("watch_drop", m.kind(obj), target,
+                                    event_type))
+                return
+            fn(event_type, obj)
+            if self.config.duplicate_watch_events > 0 \
+                    and self.rng.random() < self.config.duplicate_watch_events:
+                self.faults.append(("watch_dup", m.kind(obj), target,
+                                    event_type))
+                fn(event_type, copy.deepcopy(obj))
+        return filtered
+
     def watch(self, fn):
         """Subscribe through a filter that may drop or duplicate child
         events per the seeded schedule — the lossy-informer simulation the
         expectations expiry path exists for."""
-        def filtered(event_type, obj):
-            if m.kind(obj) in self.config.watch_kinds:
-                if self.config.drop_watch_events > 0 \
-                        and self.rng.random() < self.config.drop_watch_events:
-                    self.faults.append(("watch_drop", m.kind(obj),
-                                        f"{m.namespace(obj)}/{m.name(obj)}",
-                                        event_type))
-                    return
-                fn(event_type, obj)
-                if self.config.duplicate_watch_events > 0 \
-                        and self.rng.random() < self.config.duplicate_watch_events:
-                    self.faults.append(("watch_dup", m.kind(obj),
-                                        f"{m.namespace(obj)}/{m.name(obj)}",
-                                        event_type))
-                    fn(event_type, copy.deepcopy(obj))
-                return
-            fn(event_type, obj)
-        return self.inner.watch(filtered)
+        return self.inner.watch(self._watch_filter(fn, lambda: True))
+
+    def watch_from(self, fn, bookmark: int, kinds=None):
+        """Bookmark-resumed watch (docs/durability.md) through the same
+        seeded event chaos: replayed ring events may be DUPLICATED (the
+        at-least-once delivery a level-based informer cache must absorb)
+        but never dropped — the ring replay IS the recovery path, and a
+        store that silently skips post-bookmark history has no resumable
+        contract left to test. Live events past the catch-up point take
+        both duplication and drops, exactly like :meth:`watch`."""
+        live = [False]
+        cancel, caught_up = self.inner.watch_from(
+            self._watch_filter(fn, lambda: live[0]), bookmark,
+            kinds=kinds)
+        live[0] = True
+        return cancel, caught_up
 
     # -- preemption -------------------------------------------------------
 
@@ -243,6 +358,7 @@ class ChaosAPIServer:
         (the disruption is the chaos)."""
         preempt_pod(self.inner, namespace, name, delete=delete,
                     exit_code=exit_code)
+        self.preemptions.append((f"{namespace}/{name}", delete))
 
 
 def preempt_pod(api: APIServer, namespace: str, name: str, *,

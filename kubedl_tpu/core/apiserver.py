@@ -787,7 +787,14 @@ class APIServer:
         (owner-UID index lookup, not a world scan)."""
         owner_uid = m.uid(owner)
         with self._lock:
-            dependents = list(self._owner_idx.get(owner_uid, ()))
+            # sorted: the owner index is a set of (kind, ns, name)
+            # tuples, and set order follows the per-process string hash
+            # seed — an unsorted walk deletes dependents (and allocates
+            # their delete rvs / emits their DELETED events) in an order
+            # that varies across processes and repeat in-process runs,
+            # which seeded chaos replay and the campaign determinism
+            # contract (docs/chaos.md) both forbid
+            dependents = sorted(self._owner_idx.get(owner_uid, ()))
         for kd, ns, nm in dependents:
             try:
                 self.delete(kd, ns, nm)

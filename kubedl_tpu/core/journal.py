@@ -85,13 +85,20 @@ class Journal:
 
     def __init__(self, dirpath: str, snapshot_every: int = 4096,
                  fsync_every: int = 64, metrics=None,
-                 timer=time.perf_counter):
+                 timer=time.perf_counter, fsync_hook=None):
         self.dir = dirpath
         self._lock = threading.Lock()
         self.snapshot_every = max(int(snapshot_every), 1)
         self.fsync_every = max(int(fsync_every), 1)
         self.metrics = metrics
         self._timer = timer
+        #: chaos seam (docs/chaos.md): called inside every group-commit
+        #: fsync, between the latency timer's start and the real
+        #: ``os.fsync``. A slow-disk campaign installs
+        #: ``ChaosAPIServer.fsync_hook`` here so the injected delay is
+        #: measured by ``kubedl_journal_fsync_seconds`` exactly like a
+        #: genuinely slow WAL device would be.
+        self.fsync_hook = fsync_hook
         os.makedirs(dirpath, exist_ok=True)
         self._f = None
         self._since_fsync = 0
@@ -219,6 +226,8 @@ class Journal:
         if self._f is None:
             return
         t0 = self._timer()
+        if self.fsync_hook is not None:
+            self.fsync_hook()
         os.fsync(self._f.fileno())
         if self.metrics is not None:
             self.metrics.journal_fsync.observe(

@@ -18,3 +18,6 @@ from .harness import ClusterReplay  # noqa: F401
 from .serving import ServingReplay  # noqa: F401
 from .scorecard import (build_scorecard, check_regression,  # noqa: F401
                         evaluate_gates)
+from .scorecard import (build_campaign_scorecard,  # noqa: F401
+                        check_campaign_regression,
+                        evaluate_campaign_gates)

@@ -32,6 +32,7 @@ from typing import Optional
 from ..api.common import JobStatus
 from ..api.queue import new_queue
 from ..api.slo import new_slo
+from ..chaos.campaign import CampaignRunner, control_plane_digest
 from ..controllers.chaos import ChaosAPIServer, ChaosConfig
 from ..controllers.engine import EngineConfig, JobEngine
 from ..controllers.testing import TestJobController, new_test_job, \
@@ -56,8 +57,10 @@ from .workload import (HOSTS_PER_SLICE, POOL_ACCELERATOR, POOL_CHIPS,
                        POOL_COSTS, POOL_SPOT, QUEUES, Workload)
 
 #: event kinds, in same-time processing order (arrivals before
-#: completions before preemptions before retirements keeps ties stable)
-_EV_ARRIVAL, _EV_COMPLETE, _EV_PREEMPT, _EV_RETIRE = 0, 1, 2, 3
+#: completions before preemptions before retirements before campaign
+#: actions keeps ties stable)
+_EV_ARRIVAL, _EV_COMPLETE, _EV_PREEMPT, _EV_RETIRE, _EV_CAMPAIGN = \
+    0, 1, 2, 3, 4
 
 #: sim-time comparison slack: ``t0 + sim_t - t0`` loses an ulp at
 #: day-epoch magnitudes, so strict ``<=`` against ``clock.elapsed``
@@ -71,6 +74,8 @@ def default_job_slos(profile) -> list:
     absolute gate). Every object carries an explicit uid so its create
     never consumes the deterministic uid factory the job timeline keys
     on — adding an SLO must not move a single job's trace id."""
+    if profile.name == "adversarial":
+        return adversarial_job_slos(profile)
     window = 4.0 * profile.sim_seconds      # covers day + settle tail
     goodput_floor = {"smoke": 0.10, "day": 0.20}.get(profile.name, 0.20)
     return [
@@ -80,6 +85,40 @@ def default_job_slos(profile) -> list:
                 window_s=window, uid="slo-queue-delay-p99"),
         new_slo("restart-mttr-p50", "restart_mttr_p50", 1800.0,
                 window_s=window, uid="slo-restart-mttr-p50"),
+    ]
+
+
+def adversarial_job_slos(profile) -> list:
+    """The adversarial campaign's declared objectives (docs/chaos.md):
+    looser goals than the day profile (a campaign is SUPPOSED to burn
+    budget) with burn thresholds a correlated-failure wave can actually
+    reach inside its alert windows — the gate is survival (budget never
+    exhausts, every page clears), not cleanliness. Burn thresholds must
+    stay <= 1/budget or the pair can mathematically never fire."""
+    window = 4.0 * profile.sim_seconds
+    return [
+        new_slo("fleet-goodput", "fleet_goodput", 0.05,
+                goal=0.90, window_s=window, uid="slo-fleet-goodput"),
+        # goal 0.75 => 25% error budget; page at 2x budget pace means
+        # >= half the jobs retiring across BOTH a 5m and a 30m window
+        # waited longer than 20 minutes — a correlated outage signature,
+        # not a noisy blip
+        new_slo("queue-delay-p75", "queue_delay_p75", 1200.0,
+                window_s=window, uid="slo-queue-delay-p75",
+                alerting=[
+                    {"severity": "page", "shortSeconds": 300.0,
+                     "longSeconds": 1800.0, "burn": 2.0},
+                    {"severity": "ticket", "shortSeconds": 3600.0,
+                     "longSeconds": 4 * 3600.0, "burn": 1.0},
+                ]),
+        new_slo("restart-mttr-p50", "restart_mttr_p50", 1800.0,
+                window_s=window, uid="slo-restart-mttr-p50",
+                alerting=[
+                    {"severity": "page", "shortSeconds": 300.0,
+                     "longSeconds": 1800.0, "burn": 1.6},
+                    {"severity": "ticket", "shortSeconds": 3600.0,
+                     "longSeconds": 4 * 3600.0, "burn": 1.0},
+                ]),
     ]
 
 
@@ -102,10 +141,16 @@ class ClusterReplay:
     scorecard aggregates (lists of trace-derived samples + final metric
     reads), all in simulated seconds."""
 
-    def __init__(self, workload: Workload, shards: int = 1):
+    def __init__(self, workload: Workload, shards: int = 1,
+                 campaign=None, journal_dir: Optional[str] = None):
         self.workload = workload
         profile = workload.profile
         seed = workload.seed
+        #: chaos campaign (docs/chaos.md): a compiled fault script the
+        #: runner executes at its scheduled sim times; None = the plain
+        #: day (every committed smoke/day scorecard)
+        self.campaign = campaign
+        self.campaign_runner = None
         #: reconcile-shard count threaded to the Manager
         #: (docs/durability.md). The default 1 keeps every committed
         #: BENCH_CLUSTER.json metric byte-identical; any value is
@@ -124,13 +169,32 @@ class ClusterReplay:
             self._uid_n += 1
             return f"replay-{seed}-{self._uid_n:08d}"
 
-        self.inner = APIServer(clock=self.clock, uid_factory=uid_factory)
+        #: durable control plane (docs/durability.md): the adversarial
+        #: profile journals every commit so the slow-fsync primitive has
+        #: a real group-commit path to slow down. The journal's latency
+        #: timer is the SIM clock, so kubedl_journal_fsync_seconds
+        #: measures exactly the injected delay — deterministic.
+        self.journal = None
+        if journal_dir is not None:
+            from ..core.journal import Journal
+            from ..metrics.registry import DurabilityMetrics
+            self.journal = Journal(journal_dir, snapshot_every=4096,
+                                   fsync_every=64, timer=self.clock)
+            self.inner = APIServer(
+                clock=self.clock, uid_factory=uid_factory,
+                journal=self.journal, watch_ring=8192,
+                durability_metrics=DurabilityMetrics(self.registry))
+        else:
+            self.inner = APIServer(clock=self.clock,
+                                   uid_factory=uid_factory)
         self.chaos = ChaosAPIServer(self.inner, ChaosConfig(
             seed=seed,
             conflict_on_status_update=profile.chaos_conflict,
             error_on_create=profile.chaos_create_error,
             drop_watch_events=profile.chaos_drop_watch,
-            max_faults=profile.chaos_max_faults))
+            max_faults=profile.chaos_max_faults), clock=self.clock)
+        if self.journal is not None:
+            self.journal.fsync_hook = self.chaos.fsync_hook
         self.tracer = Tracer(enabled=True, capacity=profile.trace_capacity,
                              clock=self.clock,
                              metrics=TraceMetrics(self.registry))
@@ -224,6 +288,8 @@ class ClusterReplay:
         #: NOT count as spot evictions
         self._chaos_preempted_jobs: set = set()
         self.spot_evictions_survived = 0
+        if campaign is not None:
+            self.campaign_runner = CampaignRunner(campaign, self)
 
     # ------------------------------------------------------------------
     # watch-fed job state
@@ -352,20 +418,34 @@ class ClusterReplay:
         self._push(self.clock.elapsed + self.workload.profile.retire_after_s,
                    _EV_RETIRE, name)
 
+    def preempt_job(self, name: str) -> bool:
+        """Chaos-preempt one running pod of ``name`` (slice-atomic
+        failover tears down and restarts the whole gang). Returns
+        whether a pod was actually disrupted — the campaign runner's
+        primitives and the workload's scripted preemptions share this
+        one path so every injected eviction lands in the same ledgers."""
+        rec = self._jobs.get(name)
+        if rec is None or rec.succeeded or not rec.running:
+            return False
+        pods = sorted(self._owned_pods(name), key=m.name)
+        victims = [p for p in pods
+                   if (p.get("status") or {}).get("phase") == "Running"]
+        if not victims:
+            return False
+        self.chaos.preempt("default", m.name(victims[0]))
+        self.chaos_preempts_executed += 1
+        self._chaos_preempted_jobs.add(name)
+        return True
+
     def _on_preempt(self, ordinal: int) -> None:
         running = sorted(n for n, r in self._jobs.items()
                          if r.running and not r.succeeded)
         if not running:
             return                       # nothing to disrupt right now
-        name = running[ordinal % len(running)]
-        pods = sorted(self._owned_pods(name), key=m.name)
-        victims = [p for p in pods
-                   if (p.get("status") or {}).get("phase") == "Running"]
-        if not victims:
-            return
-        self.chaos.preempt("default", m.name(victims[0]))
-        self.chaos_preempts_executed += 1
-        self._chaos_preempted_jobs.add(name)
+        self.preempt_job(running[ordinal % len(running)])
+
+    def _on_campaign(self, action) -> None:
+        self.campaign_runner.execute(action)
 
     def _on_retire(self, name: str) -> None:
         """Harvest the job's trace (the scorecard's per-job samples),
@@ -434,11 +514,15 @@ class ClusterReplay:
             self._push(spec.arrival_s, _EV_ARRIVAL, spec)
         for pe in self.workload.preemptions:
             self._push(pe.time_s, _EV_PREEMPT, pe.ordinal)
+        if self.campaign is not None:
+            for action in self.campaign.actions:
+                self._push(action.time_s, _EV_CAMPAIGN, action)
         handlers = {
             _EV_ARRIVAL: self._on_arrival,
             _EV_COMPLETE: lambda p: self._on_complete(*p),
             _EV_PREEMPT: self._on_preempt,
             _EV_RETIRE: self._on_retire,
+            _EV_CAMPAIGN: self._on_campaign,
         }
         self._last_t = self.clock()
         max_rounds = 80 * profile.jobs + 10_000
@@ -509,13 +593,86 @@ class ClusterReplay:
                 p: round(self._util_by_pool[p], 1) for p in pools},
         }
 
+    def _chaos_attribution(self) -> dict:
+        """The scorecard's chaos ledger (docs/chaos.md): what the
+        injector says it did vs what the system's own metric registries
+        attribute to it. Every number is read from the chaos server's
+        ledgers or an existing metric family — zero bench-local
+        bookkeeping, so a missing restart here is a product bug, not a
+        counting bug."""
+        by_op_kind: dict[str, int] = {}
+        for op, kind, _target, _detail in self.chaos.faults:
+            key = f"{op}/{kind}"
+            by_op_kind[key] = by_op_kind.get(key, 0) + 1
+        sm = self.sched_metrics
+        return {
+            "faults_injected": dict(sorted(by_op_kind.items())),
+            "faults_total": len(self.chaos.faults),
+            "latency_injections": len(self.chaos.latencies),
+            "latency_seconds_injected": round(
+                sum(lat[3] for lat in self.chaos.latencies), 3),
+            "preemptions_injected": len(self.chaos.preemptions),
+            "restarts_observed": self.job_metrics.restarted.value(
+                kind="TestJob"),
+            "restart_rounds_traced": self.restart_rounds_seen,
+            "mttr_observed": self.job_metrics.restart_mttr.count(
+                kind="TestJob"),
+            "scheduler_preemptions": sum(
+                sm.preempted.value(queue=q["name"]) for q in QUEUES),
+        }
+
+    def _slo_health(self) -> dict:
+        """Alert-lifecycle survival (docs/chaos.md): onset counts per
+        severity, plus anything STRANDED at end of run — a firing flag
+        or a True SLOBurnRate condition that never cleared. The
+        adversarial gate holds both stranded counts to zero."""
+        from ..telemetry.slo import SLO_BURN_RATE
+        fired = 0
+        pages_fired = 0
+        stranded_alerts = 0
+        min_budget = 1.0
+        for s in self.slo.statuses():
+            if "invalid" in s:
+                continue
+            min_budget = min(min_budget, s["budgetRemaining"])
+            for severity, a in s["alerts"].items():
+                fired += a["fired"]
+                if severity == "page":
+                    pages_fired += a["fired"]
+                if a["firing"]:
+                    stranded_alerts += 1
+        stranded_conditions = 0
+        for obj in self.inner.list("SLO"):
+            for cond in (obj.get("status") or {}).get("conditions", []):
+                if cond.get("type") == SLO_BURN_RATE \
+                        and cond.get("status") == "True":
+                    stranded_conditions += 1
+        return {
+            "alerts_fired": fired,
+            "pages_fired": pages_fired,
+            "stranded_alerts": stranded_alerts,
+            "stranded_conditions": stranded_conditions,
+            "min_budget_remaining": round(min_budget, 6),
+        }
+
+    def control_plane_state(self) -> dict:
+        """Object-level end state for the recovery-parity gate: the
+        spec-digest of every surviving object (statuses excluded) plus
+        the scheduler inventory's residual holds. A campaign run must
+        land on the same digest as a fault-free reference run."""
+        state = dict(control_plane_digest(self.inner))
+        state["held_slices"] = sum(
+            self.inventory.held_slices(p)
+            for p in self.workload.profile.capacity)
+        return state
+
     def _result(self) -> dict:
         profile = self.workload.profile
         capacity = sum(profile.capacity.values())
         makespan = max(self.clock.elapsed, 1e-9)
         demand = sum(j.num_slices * j.duration_s for j in self.workload.jobs)
         sm, cm = self.sched_metrics, self.cp_metrics
-        return {
+        out = {
             "jobs_submitted": len(self.workload.jobs),
             "jobs_completed": self._completions,
             "makespan_s": round(makespan, 1),
@@ -559,6 +716,8 @@ class ClusterReplay:
             "goodput": self.goodput.summary(ndigits=4),
             "placement": self._placement_block(),
             "slo": self.slo.summary(ndigits=4),
+            "slo_health": self._slo_health(),
+            "chaos": {"attribution": self._chaos_attribution()},
             "trace": {
                 "sampled_jobs": self.sampled_traces,
                 "orphan_violations": len(self.orphan_violations),
@@ -566,5 +725,8 @@ class ClusterReplay:
                 "spans_dropped": self.tracer.dropped,
             },
         }
+        if self.campaign_runner is not None:
+            out["campaign"] = self.campaign_runner.summary()
+        return out
 
 

@@ -96,6 +96,46 @@ _REGRESSION = (
      "higher_better", 0.10, 0.05),
     ("slo.objectives.fleet-goodput.budgetRemaining",
      "higher_better", 0.10, 0.05),
+    # chaos attribution (docs/chaos.md): the injected-fault ledger vs
+    # the restarts/evictions the system's own registries attribute to
+    # chaos. More restarts per injected fault than the committed day is
+    # a failover regression even when every job still completes.
+    ("jobs.chaos.attribution.restarts_observed",
+     "lower_better", 0.25, 5.0),
+    ("jobs.chaos.attribution.faults_total", "lower_better", 0.25, 10.0),
+)
+
+#: adversarial-campaign gates, applied inside EVERY seed block of the
+#: campaign scorecard (docs/chaos.md "SLO-survival gate"): the campaign
+#: must burn — at least one page fires, gangs bleed — but the fleet must
+#: survive: budgets never exhaust, every alert clears, the control plane
+#: recovers to object-level parity with the fault-free reference run,
+#: and the whole thing is bit-for-bit reproducible from its seed.
+_CAMPAIGN_GATES = (
+    ("jobs.completed_fraction", ">=", 1.0),
+    ("jobs.trace.orphan_violations", "<=", 0),
+    ("slo.health.pages_fired", ">=", 1),
+    ("slo.health.alerts_fired", ">=", 1),
+    ("slo.health.stranded_alerts", "<=", 0),
+    ("slo.health.stranded_conditions", "<=", 0),
+    ("slo.health.min_budget_remaining", ">=", 0.0),
+    ("recovery.parity", ">=", 1),
+    ("recovery.held_slices_end", "<=", 0),
+    ("campaign.gangs_preempted", ">=", 4),
+    ("chaos.attribution.restarts_observed", ">=", 1),
+    ("deterministic", ">=", 1),
+)
+
+#: per-seed regression tolerances vs the committed campaign artifact
+#: (same rule grammar as _REGRESSION; paths are seed-block-relative)
+_CAMPAIGN_REGRESSION = (
+    ("jobs.fleet_goodput", "higher_better", 0.08, 0.02),
+    ("jobs.queue_delay_s.p99", "lower_better", 0.15, 60.0),
+    ("jobs.restart_mttr_s.p99", "lower_better", 0.20, 30.0),
+    ("jobs.reconciles_per_job", "lower_better", 0.20, 5.0),
+    ("slo.health.min_budget_remaining", "higher_better", 0.10, 0.05),
+    ("slo.health.alerts_fired", "lower_better", 0.50, 2.0),
+    ("chaos.attribution.restarts_observed", "lower_better", 0.25, 5.0),
 )
 
 
@@ -207,6 +247,128 @@ def check_tolerances(new: dict, old: dict, rules) -> list:
                 problems.append(
                     f"{path}: {nv} > {round(ceil, 4)} "
                     f"(committed {ov}, tolerance +{rel * 100:g}%)")
+    return problems
+
+
+def build_campaign_scorecard(scenario: str, legs: list) -> dict:
+    """Fold the adversarial legs into the committed campaign scorecard
+    (``BENCH_CLUSTER_ADVERSARIAL.json``, docs/chaos.md). Each leg is one
+    seed's run set::
+
+        {"workload": Workload, "result": campaign-run observations,
+         "state": campaign-run control_plane_state(),
+         "reference": fault-free same-workload observations,
+         "reference_state": its control_plane_state(),
+         "deterministic": repeat-run JSON equality (bool)}
+
+    Deterministic like :func:`build_scorecard`: floats arrive rounded
+    from the replay, keys sort at serialization, no wall clocks."""
+    profile = legs[0]["workload"].profile
+    seeds = {}
+    for leg in legs:
+        wl, res = leg["workload"], leg["result"]
+        state, ref_state = leg["state"], leg["reference_state"]
+        ref = leg["reference"]
+        seeds[str(wl.seed)] = {
+            "workload_fingerprint": wl.fingerprint(),
+            "campaign": res["campaign"],
+            "jobs": {
+                "completed_fraction": round(
+                    res["jobs_completed"]
+                    / max(res["jobs_submitted"], 1), 4),
+                "makespan_s": res["makespan_s"],
+                "fleet_goodput": (res.get("goodput") or {}).get(
+                    "fleetGoodput", 0.0),
+                "queue_delay_s": summarize(
+                    res["queue_delays_s"],
+                    percentiles=(0.5, 0.9, 0.99), ndigits=1),
+                "restart_mttr_s": summarize(
+                    res["restart_mttrs_s"],
+                    percentiles=(0.5, 0.99), ndigits=1),
+                "reconciles_per_job":
+                    res["controlplane"]["reconciles_per_job"],
+                "trace": {"orphan_violations":
+                          res["trace"]["orphan_violations"]},
+            },
+            "slo": {"objectives": res["slo"],
+                    "health": res["slo_health"]},
+            "chaos": res["chaos"],
+            "recovery": {
+                # 1/0, not true/false: the gate table compares with >=
+                "parity": int(state["digest"] == ref_state["digest"]),
+                "objects": state["objects"],
+                "digest": state["digest"],
+                "held_slices_end": state["held_slices"],
+                "reference_digest": ref_state["digest"],
+                "reference_completed_fraction": round(
+                    ref["jobs_completed"]
+                    / max(ref["jobs_submitted"], 1), 4),
+                "reference_makespan_s": ref["makespan_s"],
+            },
+            "deterministic": int(bool(leg["deterministic"])),
+        }
+    return {
+        "benchmark": "cluster_chaos_campaign",
+        "profile": profile.name,
+        "scenario": scenario,
+        "workload": {
+            "sim_day_s": profile.sim_seconds,
+            "jobs": profile.jobs,
+            "capacity_slices": dict(profile.capacity),
+        },
+        "seeds": {k: seeds[k] for k in sorted(seeds)},
+    }
+
+
+def evaluate_campaign_gates(scorecard: dict) -> dict:
+    """Apply :data:`_CAMPAIGN_GATES` inside every seed block; same
+    result shape as :func:`evaluate_gates` (the table is embedded into
+    the committed artifact)."""
+    results = []
+    ok = True
+    seeds = scorecard.get("seeds") or {}
+    for seed in sorted(seeds):
+        for path, op, threshold in _CAMPAIGN_GATES:
+            full = f"seeds.{seed}.{path}"
+            value = _get(scorecard, full)
+            passed = (value is not None
+                      and (value >= threshold if op == ">=" else
+                           value <= threshold))
+            ok = ok and passed
+            results.append({"metric": full, "op": op,
+                            "threshold": threshold, "value": value,
+                            "passed": passed})
+    if not seeds:
+        ok = False
+        results.append({"metric": "seeds", "op": ">=", "threshold": 2,
+                        "value": 0, "passed": False})
+    return {"checks": results, "passed": ok}
+
+
+def check_campaign_regression(new: dict, old: dict) -> list:
+    """Per-seed regression check vs the committed campaign artifact,
+    riding the shared :func:`check_tolerances` engine. Only seeds
+    present in BOTH artifacts are compared; scenario or profile drift is
+    a new baseline, not a regression."""
+    if old.get("profile") != new.get("profile") \
+            or old.get("scenario") != new.get("scenario"):
+        return []
+    problems = []
+    shared = sorted(set(new.get("seeds") or ())
+                    & set(old.get("seeds") or ()))
+    for seed in shared:
+        rules = [(f"seeds.{seed}.{path}", direction, rel, grace)
+                 for path, direction, rel, grace in _CAMPAIGN_REGRESSION]
+        problems.extend(check_tolerances(new, old, rules))
+        for path in ("slo.health.stranded_alerts",
+                     "slo.health.stranded_conditions",
+                     "jobs.trace.orphan_violations"):
+            if _get(new, f"seeds.{seed}.{path}"):
+                problems.append(f"seeds.{seed}.{path} must stay 0")
+        if _get(new, f"seeds.{seed}.recovery.parity") != 1:
+            problems.append(
+                f"seeds.{seed}.recovery.parity must stay 1 (campaign "
+                f"run must converge to the fault-free reference world)")
     return problems
 
 

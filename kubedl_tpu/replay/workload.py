@@ -94,6 +94,19 @@ PROFILES = {
         chaos_max_faults=600,
         serving_requests=52000, serving_bursts=140, lanes=16,
         pool_blocks=96, prefixes=10, serving_trace_capacity=32768),
+    # the chaos-campaign leg (docs/chaos.md): a moderate job day whose
+    # ONLY preemptions come from the campaign's correlated primitives
+    # (chaos_preemptions=0 keeps attribution exact) and whose background
+    # fault rates stay low so the storm windows dominate the signal; no
+    # serving leg — the campaign targets the job control plane
+    "adversarial": Profile(
+        name="adversarial", sim_seconds=6 * 3600.0, jobs=260,
+        job_bursts=5, burst_frac=0.40, chaos_preemptions=0,
+        capacity={POOL_V5P: 12, POOL_V5E: 16},
+        duration_mean_s=1200.0, trace_capacity=65536, sample_traces=32,
+        chaos_conflict=0.02, chaos_create_error=0.01,
+        chaos_drop_watch=0.0, chaos_max_faults=200,
+        serving_requests=0, serving_bursts=0),
 }
 
 #: tenant queues: prod is guaranteed, batch partially, best borrows only

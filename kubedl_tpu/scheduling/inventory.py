@@ -269,6 +269,21 @@ class SliceInventory:
         with self._lock:
             return sum(1 for h in self._held.values() if h.pool == pool)
 
+    def set_static_capacity(self, pool: str,
+                            slices: Optional[int]) -> None:
+        """Adjust a pool's static slice capacity at runtime — the
+        drained-pool / spot-dryness seam (docs/chaos.md): a spot pool
+        whose capacity vanished mid-day is modeled as its static entry
+        dropping to 0 and later recovering. ``None`` removes the static
+        entry (back to Node-derived capacity). Invalidates the
+        ICI-domain assignment cache like any capacity change."""
+        with self._lock:
+            if slices is None:
+                self.static_capacity.pop(pool, None)
+            else:
+                self.static_capacity[pool] = int(slices)
+            self._domain_gen += 1
+
     def free_slices(self, pool: str) -> Optional[int]:
         cap = self.capacity_slices(pool)
         if cap is None:
@@ -398,6 +413,17 @@ class SliceInventory:
         the pool has no domain math (unknown capacity/shape)."""
         asg = self._domain_assignment(pool)
         return None if asg is None else list(asg["free"])
+
+    def domain_gangs(self, pool: str) -> Optional[dict]:
+        """{(namespace, job): [domain indexes]} for every gang holding
+        slices in ``pool``, or None when the pool has no domain math —
+        the chaos campaign layer's targeting input (docs/chaos.md): a
+        domain-wide outage preempts exactly the gangs the inventory's
+        own per-domain accounting places there."""
+        asg = self._domain_assignment(pool)
+        if asg is None:
+            return None
+        return {gk: list(doms) for gk, doms in asg["gangs"].items()}
 
     def gang_domains(self, namespace: str, job: str,
                      pool: str) -> Optional[int]:

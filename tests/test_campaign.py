@@ -1,0 +1,561 @@
+"""Chaos campaigns: correlated fault domains gated on SLO survival
+(docs/chaos.md).
+
+Five layers:
+
+* **latency primitives** — ``slow_next`` / probabilistic op latency
+  advance the injected clock (never sleep), and the journal's
+  ``fsync_hook`` seam lands the delay inside
+  ``kubedl_journal_fsync_seconds``;
+* **seed hygiene** — a malformed ``KUBEDL_CHAOS_SEED`` fails loudly at
+  parse time, not as bare ``int()`` noise mid-run;
+* **campaign scripts** — pure functions of (scenario, seed, profile)
+  with the ``fingerprint()`` determinism contract;
+* **watch-storm durability** — duplicated events replayed through
+  ``watch_from`` must not double-apply in the level-based informer
+  cache (the PR 10 interaction this suite pins);
+* **THE e2e** — a seeded adversarial campaign through the real stack:
+  at least one SLO page fires and clears, no budget exhausts, zero
+  stranded alerts, the control plane recovers to object-level parity
+  with a fault-free reference run, and the whole thing is bit-for-bit
+  deterministic per seed.
+"""
+
+import dataclasses
+import json
+
+import pytest
+
+from kubedl_tpu.chaos import (Campaign, CampaignRunner, FaultAction,
+                              PRIMITIVES, SCENARIOS, build_campaign,
+                              control_plane_digest)
+from kubedl_tpu.client.informers import Informer
+from kubedl_tpu.controllers.chaos import (ChaosAPIServer, ChaosConfig,
+                                          ENV_CHAOS_SEED, chaos_seed)
+from kubedl_tpu.core import meta as m
+from kubedl_tpu.core.apiserver import APIServer
+from kubedl_tpu.core.clock import SimClock
+from kubedl_tpu.core.journal import Journal
+from kubedl_tpu.metrics.registry import DurabilityMetrics, Registry
+from kubedl_tpu.replay import (ClusterReplay, build_campaign_scorecard,
+                               check_campaign_regression,
+                               evaluate_campaign_gates, generate)
+from kubedl_tpu.replay.workload import PROFILES
+from kubedl_tpu.scheduling.inventory import SliceInventory
+
+pytestmark = pytest.mark.campaign
+
+
+def cm(name, data=None):
+    obj = m.new_obj("v1", "ConfigMap", name)
+    if data is not None:
+        obj["data"] = data
+    return obj
+
+
+# ---------------------------------------------------------------------------
+# latency injection (the ChaosAPIServer primitive slow-fsync rides on)
+# ---------------------------------------------------------------------------
+
+
+def test_slow_next_advances_injected_clock_not_wall():
+    clock = SimClock()
+    api = ChaosAPIServer(APIServer(clock=clock), ChaosConfig(seed=1),
+                         clock=clock)
+    api.slow_next("create", 2.5)
+    t0 = clock()
+    api.create(cm("a"))
+    assert clock() - t0 == pytest.approx(2.5)
+    assert api.latencies == [("create", "ConfigMap", "default/a", 2.5)]
+    # the budget ledger is untouched: a slow write is not a failed write
+    assert api.faults == []
+    # one-shot: the next create is full speed
+    t1 = clock()
+    api.create(cm("b"))
+    assert clock() == t1
+
+
+def test_slow_next_kind_qualified_and_multi():
+    clock = SimClock()
+    api = ChaosAPIServer(APIServer(clock=clock), ChaosConfig(seed=1),
+                         clock=clock)
+    api.slow_next("create", 1.0, times=2, kind="Pod")
+    t0 = clock()
+    api.create(cm("a"))                   # ConfigMap: not taken
+    assert clock() == t0
+    pod = m.new_obj("v1", "Pod", "p-0")
+    pod["spec"] = {"containers": [{"name": "main"}]}
+    api.create(pod)
+    assert clock() - t0 == pytest.approx(1.0)
+    assert len(api.latencies) == 1
+
+
+def test_slow_next_rejects_nonpositive_seconds():
+    api = ChaosAPIServer(APIServer(), ChaosConfig(seed=1))
+    with pytest.raises(ValueError):
+        api.slow_next("create", 0.0)
+
+
+def test_probabilistic_op_latency_advances_every_matching_op():
+    clock = SimClock()
+    cfg = ChaosConfig(seed=3, op_latency={"update_status": (1.0, 0.5)})
+    api = ChaosAPIServer(APIServer(clock=clock), cfg, clock=clock)
+    obj = api.create(cm("a"))
+    t0 = clock()
+    api.update_status(obj)
+    api.update_status(api.get("ConfigMap", "default", "a"))
+    assert clock() - t0 == pytest.approx(1.0)
+    assert len(api.latencies) == 2
+
+
+def test_unconfigured_latency_consumes_no_rng():
+    """Two same-seed servers, one with latency config on an op the test
+    never calls: their fault streams must stay identical — committed
+    scorecards depend on the latency seam drawing nothing unless the op
+    is actually configured."""
+    from kubedl_tpu.core.apiserver import ApiError
+
+    def run(cfg):
+        api = ChaosAPIServer(APIServer(), cfg)
+        for i in range(40):
+            try:
+                api.create(cm(f"o-{i}"))
+            except ApiError:
+                pass                     # the injected fault itself
+        return api.faults
+
+    base = ChaosConfig(seed=11, error_on_create=0.3)
+    with_latency = ChaosConfig(seed=11, error_on_create=0.3,
+                               op_latency={"delete": (1.0, 9.9)})
+    a, b = run(base), run(with_latency)
+    assert a == b and a    # same faults at the same positions
+
+
+def test_latency_without_clock_is_a_loud_noop(caplog):
+    api = ChaosAPIServer(APIServer(), ChaosConfig(seed=1))
+    api.slow_next("create", 5.0)
+    api.create(cm("a"))                   # no crash, no sleep
+    assert api.latencies  # taken and recorded even though undeliverable
+
+
+def test_fsync_hook_lands_latency_in_journal_histogram(tmp_path):
+    """The slow-fsync seam end to end: chaos latency + sim-clock timer
+    means kubedl_journal_fsync_seconds measures EXACTLY the injected
+    delay — the deterministic model of a dying WAL disk."""
+    clock = SimClock()
+    reg = Registry()
+    dm = DurabilityMetrics(reg)
+    journal = Journal(str(tmp_path), fsync_every=2, metrics=dm,
+                      timer=clock)
+    api = APIServer(clock=clock, journal=journal)
+    chaos = ChaosAPIServer(api, ChaosConfig(
+        seed=5, op_latency={"fsync": (1.0, 0.25)}), clock=clock)
+    journal.fsync_hook = chaos.fsync_hook
+    t0 = clock()
+    for i in range(6):                    # 6 appends = 3 group commits
+        chaos.create(cm(f"o-{i}"))
+    assert clock() - t0 == pytest.approx(0.75)
+    assert dm.journal_fsync.count() == 3
+    assert dm.journal_fsync.sum() == pytest.approx(0.75)
+    assert [lat[0] for lat in chaos.latencies] == ["fsync"] * 3
+    # end of the storm: fsyncs are free again
+    chaos.config.op_latency.pop("fsync")
+    t1 = clock()
+    for i in range(6, 10):
+        chaos.create(cm(f"o-{i}"))
+    assert clock() == t1
+    journal.close()
+
+
+# ---------------------------------------------------------------------------
+# KUBEDL_CHAOS_SEED hygiene
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("raw,expect", (
+    ("", "default"),
+    ("   ", "default"),
+    ("123", 123),
+    (" 42 ", 42),
+    ("0", 0),
+    ("abc", ValueError),
+    ("12.5", ValueError),
+    ("0x10", ValueError),
+    ("12abc", ValueError),
+    ("-1", ValueError),
+    ("-99999", ValueError),
+))
+def test_chaos_seed_table(monkeypatch, raw, expect):
+    monkeypatch.setenv(ENV_CHAOS_SEED, raw)
+    if expect is ValueError:
+        with pytest.raises(ValueError) as ei:
+            chaos_seed()
+        assert ENV_CHAOS_SEED in str(ei.value)
+        assert repr(raw) in str(ei.value)
+    elif expect == "default":
+        assert chaos_seed(default=777) == 777
+    else:
+        assert chaos_seed(default=777) == expect
+
+
+def test_chaos_seed_unset_uses_default(monkeypatch):
+    monkeypatch.delenv(ENV_CHAOS_SEED, raising=False)
+    assert chaos_seed(default=9) == 9
+
+
+# ---------------------------------------------------------------------------
+# campaign scripts (pure, fingerprinted)
+# ---------------------------------------------------------------------------
+
+
+def test_campaign_deterministic_for_fixed_inputs():
+    p = PROFILES["adversarial"]
+    a = build_campaign("adversarial", 7, p)
+    b = build_campaign("adversarial", 7, p)
+    assert a == b and a.fingerprint() == b.fingerprint()
+    assert build_campaign("adversarial", 8, p).fingerprint() \
+        != a.fingerprint()
+    # actions are time-sorted and inside the day
+    times = [x.time_s for x in a.actions]
+    assert times == sorted(times)
+    assert 0 < times[0] and times[-1] < p.sim_seconds
+    assert {x.primitive for x in a.actions} <= PRIMITIVES
+
+
+def test_every_scenario_compiles_with_known_primitives():
+    p = PROFILES["adversarial"]
+    for name in SCENARIOS:
+        camp = build_campaign(name, 0, p)
+        assert camp.actions, name
+        assert {x.primitive for x in camp.actions} <= PRIMITIVES, name
+    # window primitives always come in start/end pairs
+    adv = build_campaign("adversarial", 0, p)
+    for stem in ("watch_storm", "slow_fsync", "spot_dry"):
+        starts = sum(1 for x in adv.actions
+                     if x.primitive == f"{stem}_start")
+        ends = sum(1 for x in adv.actions if x.primitive == f"{stem}_end")
+        assert starts == ends >= 1, stem
+
+
+def test_unknown_scenario_and_params_access():
+    with pytest.raises(ValueError):
+        build_campaign("nope", 0, PROFILES["adversarial"])
+    act = FaultAction(1.0, "drain", (("ordinal", 3), ("pool", "p")))
+    assert act.param("pool") == "p"
+    assert act.param("missing", "d") == "d"
+    assert Campaign("x", 0, ()).window() == (0.0, 0.0)
+
+
+def test_spot_dry_capacity_seam_on_inventory():
+    inv = SliceInventory(static_capacity={"pool-a": 8})
+    assert inv.free_slices("pool-a") == 8
+    inv.set_static_capacity("pool-a", 0)
+    assert inv.capacity_slices("pool-a") == 0
+    assert inv.free_slices("pool-a") == 0
+    inv.set_static_capacity("pool-a", 8)
+    assert inv.free_slices("pool-a") == 8
+    inv.set_static_capacity("pool-a", None)
+    assert inv.capacity_slices("pool-a") is None   # back to node-derived
+
+
+def test_overlapping_spot_dry_windows_nest():
+    """Two overlapping spot_dry windows on one pool: the first _end must
+    not restore capacity while the second window is still open, and the
+    last _end restores the ORIGINAL static base, like the watch-storm
+    rate stack."""
+    class _Stub:
+        inventory = SliceInventory(static_capacity={"pool-a": 8})
+    runner = CampaignRunner(Campaign("x", 0, ()), _Stub())
+    start = FaultAction(1.0, "spot_dry_start", (("pool", "pool-a"),))
+    end = FaultAction(2.0, "spot_dry_end", (("pool", "pool-a"),))
+    runner.execute(start)
+    assert _Stub.inventory.capacity_slices("pool-a") == 0
+    runner.execute(start)                # overlapping second window
+    runner.execute(end)                  # inner end: pool stays dry
+    assert _Stub.inventory.capacity_slices("pool-a") == 0
+    runner.execute(end)                  # outer end: base restored
+    assert _Stub.inventory.capacity_slices("pool-a") == 8
+    runner.execute(end)                  # unmatched end: no-op
+    assert _Stub.inventory.capacity_slices("pool-a") == 8
+
+
+# ---------------------------------------------------------------------------
+# watch-storm x durability: duplicated replay events vs the level cache
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.durability
+def test_duplicated_watch_from_replay_does_not_double_apply():
+    """A bookmark resume through a storming ChaosAPIServer re-delivers
+    replayed ring events (at-least-once); the informer's level-based
+    cache must absorb the duplicates — same world as the store, every
+    object once, deletions not resurrected (the PR 10 interaction)."""
+    clock = SimClock()
+    inner = APIServer(clock=clock, watch_ring=256)
+    chaos = ChaosAPIServer(inner, ChaosConfig(
+        seed=13, duplicate_watch_events=1.0,
+        watch_kinds=("ConfigMap",)))
+    for i in range(4):
+        inner.create(cm(f"o-{i}", {"v": "0"}))
+    inf = Informer(chaos, "ConfigMap")
+    inf.start()
+    inf.disconnect()
+    # history the resume must replay: updates, a delete, a create
+    obj = inner.get("ConfigMap", "default", "o-1")
+    obj["data"] = {"v": "1"}
+    inner.update(obj)
+    inner.delete("ConfigMap", "default", "o-2")
+    inner.create(cm("o-4", {"v": "4"}))
+    inf.resume()
+    # every replayed event was delivered TWICE (dup rate 1.0) ...
+    dups = [f for f in chaos.faults if f[0] == "watch_dup"]
+    assert len(dups) >= 3
+    # ... and the cache is still exactly the store
+    want = {m.name(o): o.get("data")
+            for o in inner.list("ConfigMap")}
+    got = {m.name(o): o.get("data")
+           for o in inf.lister().list()}
+    assert got == want
+    assert "o-2" not in got and got["o-1"] == {"v": "1"}
+    # live duplicated + dropped events after the catch-up point keep the
+    # cache level-consistent too
+    chaos.config.drop_watch_events = 0.3
+    for i in range(20):
+        objx = inner.get("ConfigMap", "default", "o-3")
+        objx["data"] = {"v": str(i)}
+        inner.update(objx)
+    # a drop may leave the cache one level behind — a later event (or
+    # relist) catches it up; the final update always lands or is caught
+    # by resume()
+    inf.disconnect()
+    inf.resume()
+    assert inf.lister().get("default", "o-3")["data"] == {"v": "19"}
+    inf.stop()
+
+
+# ---------------------------------------------------------------------------
+# THE e2e: adversarial campaign at test scale (2 seeds)
+# ---------------------------------------------------------------------------
+
+
+def tiny_profile(**overrides):
+    base = dataclasses.replace(
+        PROFILES["adversarial"], jobs=90, sim_seconds=4 * 3600.0,
+        sample_traces=12, trace_capacity=32768, chaos_max_faults=60)
+    return dataclasses.replace(base, **overrides)
+
+
+def _campaign_run(seed, tmp_path, tag):
+    wl = generate(tiny_profile(), seed)
+    camp = build_campaign("adversarial", seed, wl.profile)
+    replay = ClusterReplay(wl, shards=4, campaign=camp,
+                           journal_dir=str(tmp_path / f"j-{tag}"))
+    res = replay.run()
+    return replay, res
+
+
+@pytest.fixture(scope="module")
+def e2e(tmp_path_factory):
+    """seed -> (campaign replay, result, repeat result, reference
+    replay, reference result)."""
+    tmp = tmp_path_factory.mktemp("campaign")
+    out = {}
+    for seed in (0, 1):
+        r1, res1 = _campaign_run(seed, tmp, f"{seed}-a")
+        _r2, res2 = _campaign_run(seed, tmp, f"{seed}-b")
+        ref = ClusterReplay(generate(tiny_profile(), seed))
+        ref_res = ref.run()
+        out[seed] = (r1, res1, res2, ref, ref_res)
+    return out
+
+
+def test_campaign_day_completes_and_every_primitive_fired(e2e):
+    for seed, (r, res, _res2, _ref, _ref_res) in e2e.items():
+        assert res["jobs_completed"] == res["jobs_submitted"]
+        assert res["trace"]["orphan_violations"] == 0, seed
+        executed = res["campaign"]["actions_executed"]
+        assert set(executed) == {
+            "domain_outage", "drain", "hot_loop", "spot_dry_start",
+            "spot_dry_end", "watch_storm_start", "watch_storm_end",
+            "slow_fsync_start", "slow_fsync_end"}, seed
+        assert res["campaign"]["gangs_preempted"] >= 4, seed
+        # the slow-fsync window really slowed the journal (sim seconds)
+        assert res["chaos"]["attribution"]["latency_seconds_injected"] \
+            > 0, seed
+
+
+def test_campaign_fires_a_page_that_clears_and_budgets_survive(e2e):
+    """The SLO-survival contract (docs/chaos.md): burn but never
+    exhaust; every onset has a matching clear; nothing stranded."""
+    paged = 0
+    for seed, (r, res, _res2, _ref, _ref_res) in e2e.items():
+        h = res["slo_health"]
+        paged += h["pages_fired"]
+        assert h["stranded_alerts"] == 0, (seed, h)
+        assert h["stranded_conditions"] == 0, (seed, h)
+        assert h["min_budget_remaining"] >= 0.0, (seed, h)
+        # the alert log is balanced: every fire has a clear
+        fires = [a for a in r.slo.alert_log if a["event"] == "fire"]
+        clears = [a for a in r.slo.alert_log if a["event"] == "clear"]
+        assert len(fires) == len(clears), seed
+    assert paged >= 1      # at least one seed's campaign paged a human
+
+
+def test_campaign_restarts_are_chaos_attributed_and_slice_atomic(e2e):
+    for seed, (r, res, _res2, _ref, _ref_res) in e2e.items():
+        attr = res["chaos"]["attribution"]
+        gangs = res["campaign"]["gangs_preempted"]
+        # the injector's ledger and the system's registries agree: each
+        # preempted gang produced at least one WHOLE-gang restart round
+        # (slice-atomic failover — pod-level atomicity is pinned in
+        # tests/test_chaos.py), and the traces saw them too
+        assert attr["preemptions_injected"] == gangs
+        assert attr["restarts_observed"] >= gangs
+        assert res["restart_rounds_traced"] >= gangs
+        assert attr["mttr_observed"] >= 1
+        # every campaign-preempted gang still completed
+        victims = {j for j, _p in r.campaign_runner.gang_preemptions}
+        assert all(r._jobs[v].succeeded for v in victims), seed
+
+
+def test_campaign_recovers_to_parity_with_fault_free_reference(e2e):
+    for seed, (r, res, _res2, ref, ref_res) in e2e.items():
+        assert ref_res["jobs_completed"] == res["jobs_completed"]
+        a, b = r.control_plane_state(), ref.control_plane_state()
+        assert a["digest"] == b["digest"], seed
+        assert a["held_slices"] == 0 and b["held_slices"] == 0
+        # and the reference run really was fault-free of preemptions
+        assert ref_res["chaos"]["attribution"]["preemptions_injected"] \
+            == 0
+
+
+def test_campaign_replay_is_bit_for_bit_deterministic(e2e):
+    for seed, (_r, res, res2, _ref, _ref_res) in e2e.items():
+        assert json.dumps(res, sort_keys=True) \
+            == json.dumps(res2, sort_keys=True), seed
+
+
+def test_control_plane_digest_excludes_status_not_spec():
+    api = APIServer()
+    api.create(cm("a", {"x": "1"}))
+    d1 = control_plane_digest(api)
+    obj = api.get("ConfigMap", "default", "a")
+    obj.setdefault("status", {})["conditions"] = [{"type": "T"}]
+    api.update_status(obj)
+    assert control_plane_digest(api)["digest"] == d1["digest"]
+    obj = api.get("ConfigMap", "default", "a")
+    obj["spec"] = {"changed": True}
+    api.update(obj)
+    assert control_plane_digest(api)["digest"] != d1["digest"]
+
+
+# ---------------------------------------------------------------------------
+# campaign scorecard: gates + regression (synthetic, no replay needed)
+# ---------------------------------------------------------------------------
+
+
+def _mini_campaign_scorecard(**seed_overrides):
+    block = {
+        "workload_fingerprint": "wf",
+        "campaign": {"scenario": "adversarial", "fingerprint": "cf",
+                     "actions_total": 30,
+                     "actions_executed": {"drain": 4},
+                     "gangs_preempted": 20,
+                     "gangs_preempted_by_primitive": {"drain": 4}},
+        "jobs": {"completed_fraction": 1.0, "makespan_s": 21600.0,
+                 "fleet_goodput": 0.40,
+                 "queue_delay_s": {"p99": 4000.0},
+                 "restart_mttr_s": {"p99": 900.0},
+                 "reconciles_per_job": 60.0,
+                 "trace": {"orphan_violations": 0}},
+        "slo": {"objectives": {}, "health": {
+            "alerts_fired": 4, "pages_fired": 2,
+            "stranded_alerts": 0, "stranded_conditions": 0,
+            "min_budget_remaining": 0.4}},
+        "chaos": {"attribution": {"restarts_observed": 30.0,
+                                  "faults_total": 100}},
+        "recovery": {"parity": 1, "objects": 6, "digest": "d",
+                     "held_slices_end": 0, "reference_digest": "d",
+                     "reference_completed_fraction": 1.0,
+                     "reference_makespan_s": 21600.0},
+        "deterministic": 1,
+    }
+    doc = {"benchmark": "cluster_chaos_campaign",
+           "profile": "adversarial", "scenario": "adversarial",
+           "workload": {"jobs": 260},
+           "seeds": {"0": json.loads(json.dumps(block)),
+                     "1": json.loads(json.dumps(block))}}
+    for path, value in seed_overrides.items():
+        cur = doc["seeds"]["0"]
+        parts = path.split(".")
+        for part in parts[:-1]:
+            cur = cur[part]
+        cur[parts[-1]] = value
+    return doc
+
+
+def test_campaign_gates_pass_and_fail():
+    ok = evaluate_campaign_gates(_mini_campaign_scorecard())
+    assert ok["passed"], [c for c in ok["checks"] if not c["passed"]]
+    for path, bad in (
+            ("slo.health.pages_fired", 0),
+            ("slo.health.stranded_alerts", 1),
+            ("slo.health.min_budget_remaining", -0.01),
+            ("recovery.parity", 0),
+            ("deterministic", 0),
+            ("jobs.completed_fraction", 0.99)):
+        res = evaluate_campaign_gates(_mini_campaign_scorecard(
+            **{path: bad}))
+        assert not res["passed"], path
+        failing = [c["metric"] for c in res["checks"] if not c["passed"]]
+        assert f"seeds.0.{path}" in failing, (path, failing)
+    assert not evaluate_campaign_gates({"seeds": {}})["passed"]
+
+
+def test_campaign_regression_detects_tampering():
+    old = _mini_campaign_scorecard()
+    assert check_campaign_regression(_mini_campaign_scorecard(), old) \
+        == []
+    # budget collapse on one seed: flagged with the seed in the path
+    worse = _mini_campaign_scorecard(
+        **{"slo.health.min_budget_remaining": 0.1})
+    probs = check_campaign_regression(worse, old)
+    assert any("seeds.0" in p and "min_budget_remaining" in p
+               for p in probs)
+    # stranded alerts / lost parity can never appear
+    probs = check_campaign_regression(
+        _mini_campaign_scorecard(**{"slo.health.stranded_alerts": 1}),
+        old)
+    assert any("stranded_alerts" in p for p in probs)
+    probs = check_campaign_regression(
+        _mini_campaign_scorecard(**{"recovery.parity": 0}), old)
+    assert any("parity" in p for p in probs)
+    # a restart explosion past tolerance: flagged
+    probs = check_campaign_regression(
+        _mini_campaign_scorecard(
+            **{"chaos.attribution.restarts_observed": 60.0}), old)
+    assert any("restarts_observed" in p for p in probs)
+    # scenario drift is a new baseline, not a regression
+    other = _mini_campaign_scorecard()
+    other["scenario"] = "hot-loop"
+    assert check_campaign_regression(other, old) == []
+
+
+def test_campaign_scorecard_builder_shape(e2e):
+    r, res, res2, ref, ref_res = e2e[0]
+    leg = {"workload": r.workload, "result": res,
+           "state": r.control_plane_state(), "reference": ref_res,
+           "reference_state": ref.control_plane_state(),
+           "deterministic": json.dumps(res, sort_keys=True)
+           == json.dumps(res2, sort_keys=True)}
+    sc = build_campaign_scorecard("adversarial", [leg])
+    assert sc["benchmark"] == "cluster_chaos_campaign"
+    block = sc["seeds"]["0"]
+    assert block["workload_fingerprint"] == r.workload.fingerprint()
+    assert block["campaign"]["fingerprint"] \
+        == r.campaign.fingerprint()
+    assert block["recovery"]["parity"] == 1
+    assert block["deterministic"] == 1
+    assert {"p50", "p99"} <= set(block["jobs"]["queue_delay_s"])
+    # the scorecard JSON round-trips deterministically
+    assert json.loads(json.dumps(sc, sort_keys=True)) == sc
