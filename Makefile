@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test test-all test-fast test-chaos test-campaign test-scheduler test-trace test-replay test-telemetry test-slo test-durability bench bench-controlplane bench-scheduler bench-serving-paged bench-trace bench-cluster bench-cluster-adversarial dryrun crds run-standalone lint native
+.PHONY: test test-all test-fast test-chaos test-campaign test-scheduler test-trace test-replay test-telemetry test-slo test-durability test-forensics bench bench-controlplane bench-scheduler bench-serving-paged bench-trace bench-cluster bench-cluster-adversarial postmortem dryrun crds run-standalone lint native
 
 # fast path (<3 min): everything except the compile-heavy compute suites
 # (those carry `pytestmark = pytest.mark.slow`). Chaos tests are fast and
@@ -96,6 +96,18 @@ test-slo:
 # docs/durability.md)
 test-durability:
 	$(PY) -m pytest tests/ -q -m durability
+
+# forensics suite (WAL time-travel WorldLine, rv-reconstruction parity
+# vs a live store, incident timeline + causal page->fault linking,
+# postmortem determinism, console endpoints; docs/forensics.md)
+test-forensics:
+	$(PY) -m pytest tests/ -q -m forensics
+
+# render the committed adversarial campaign's forensics blocks as
+# markdown postmortems (docs/forensics.md; regenerate the blocks with
+# make bench-cluster-adversarial)
+postmortem:
+	$(PY) -m kubedl_tpu.forensics.report BENCH_CLUSTER_ADVERSARIAL.json
 
 # THE fleet scorecard: a production-shaped day (thousands of jobs, tens
 # of thousands of serving requests, chaos faults) through the real

@@ -24,7 +24,10 @@ to prove bit-for-bit determinism, replays a fault-free reference of the
 same workload, and commits ``BENCH_CLUSTER_ADVERSARIAL.json`` gated on
 SLO survival: at least one page fires AND clears, no error budget
 exhausts, zero stranded alerts/conditions, and the post-campaign control
-plane reaches object-level parity with the reference world.
+plane reaches object-level parity with the reference world. Each seed
+block also carries a ``forensics`` postmortem (docs/forensics.md) —
+every fired page causally linked to the injected fault window(s) that
+caused it — rendered to markdown by ``make postmortem``.
 
 Usage::
 
@@ -87,12 +90,15 @@ def run_adversarial(args) -> dict:
         reference = ClusterReplay(generate("adversarial", seed))
         ref_result = reference.run()
         ref_state = reference.control_plane_state()
+        fsum = result["forensics"]["summary"]
         print(f"seed {seed}: campaign x2 + reference replayed in "
               f"{time.perf_counter() - t0:.1f}s wall "
               f"(deterministic={deterministic}, "
               f"pages={result['slo_health']['pages_fired']}, "
               f"min budget "
-              f"{result['slo_health']['min_budget_remaining']})",
+              f"{result['slo_health']['min_budget_remaining']}, "
+              f"forensics: {fsum['pages_linked']}/{fsum['pages']} pages "
+              f"linked via {fsum['links_total']} links)",
               file=sys.stderr)
         legs.append({"workload": workload, "result": result,
                      "state": state, "reference": ref_result,

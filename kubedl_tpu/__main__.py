@@ -247,7 +247,8 @@ def main(argv=None) -> int:
                           job_kinds=tuple(operator.engines),
                           tracer=operator.tracer,
                           scheduler=operator.scheduler,
-                          telemetry=operator.telemetry)
+                          telemetry=operator.telemetry,
+                          journal=operator.journal)
         console = ConsoleServer(
             proxy, ConsoleConfig(host=args.console_host,
                                  port=args.console_port))

@@ -294,6 +294,9 @@ class CampaignRunner:
         self.executed: dict[str, int] = {}
         #: distinct (job, primitive) gang preemptions performed
         self.gang_preemptions: list = []
+        #: the same evictions with their sim times, for the incident
+        #: timeline (docs/forensics.md): {"t", "job", "primitive"}
+        self.preemption_log: list = []
         #: watch-storm rate stack: each _start pushes the rates it
         #: found, each _end restores the most recent push (overlapping
         #: windows degrade to nested semantics instead of a mid-storm
@@ -338,6 +341,9 @@ class CampaignRunner:
         for name in names:
             if self.replay.preempt_job(name):
                 self.gang_preemptions.append((name, primitive))
+                self.preemption_log.append({
+                    "t": self.replay.clock(), "job": name,
+                    "primitive": primitive})
 
     def _running_in_pool(self, pool: str) -> list:
         return sorted(n for n, r in self.replay._jobs.items()

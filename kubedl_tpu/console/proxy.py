@@ -43,7 +43,7 @@ class DataProxy:
                  object_backend: Optional[ObjectBackend] = None,
                  event_backend: Optional[EventBackend] = None,
                  job_kinds=TRAINING_KINDS, tracer=None, scheduler=None,
-                 telemetry=None):
+                 telemetry=None, journal=None):
         self.api = api
         self.object_backend = object_backend
         self.event_backend = event_backend
@@ -57,6 +57,9 @@ class DataProxy:
         #: the FleetTelemetry bundle (docs/telemetry.md); None = the job
         #: detail carries no goodput field (disabled path byte-identical)
         self.telemetry = telemetry
+        #: the control plane's WAL journal (docs/durability.md); None =
+        #: the /api/v1/forensics and /api/v1/durability endpoints 501
+        self.journal = journal
 
     # -- jobs -------------------------------------------------------------
 
@@ -552,6 +555,71 @@ class DataProxy:
         if not spans:
             return None
         return goodput_breakdown(trace_breakdown(spans))
+
+    # -- forensics (docs/forensics.md) ------------------------------------
+
+    @property
+    def forensics_enabled(self) -> bool:
+        return self.journal is not None
+
+    @property
+    def incidents_enabled(self) -> bool:
+        """The incident stream reads the SLO evaluator's logs — it
+        needs telemetry with the SLO engine, not the journal."""
+        return getattr(self.telemetry, "slo", None) is not None
+
+    def _worldline(self):
+        from ..forensics import WorldLine
+        return WorldLine(self.journal.dir)
+
+    def world_at(self, rv: int) -> dict:
+        """The store reconstructed at resourceVersion ``rv`` (newest
+        snapshot <= rv + WAL tail replay), summarized for the console:
+        per-kind counts, keys, and the reconstruction provenance."""
+        return self._worldline().world_summary(int(rv))
+
+    def forensic_object_history(self, kind: str, namespace: str,
+                                name: str) -> Optional[dict]:
+        """Every retained spec/status commit of one object, with WAL
+        timestamps; None when the journal holds no record of it."""
+        history = self._worldline().object_history(kind, namespace, name)
+        if not history:
+            return None
+        return {"kind": kind, "namespace": namespace, "name": name,
+                "history": history}
+
+    def incident_timeline(self) -> dict:
+        """The live operator's incident stream: SLO fire/clear
+        transitions merged into incidents, with whatever attribution
+        sources exist (a production operator has no campaign, so
+        incidents carry no fault links — the stream itself is the
+        value: one ordered record instead of grepping Events)."""
+        from ..forensics import IncidentTimeline
+        tl = IncidentTimeline(epoch=0.0)
+        slo = self.telemetry.slo
+        # copied under the evaluator lock: this runs on a console
+        # request thread while the operator thread appends
+        alert_log, bad_samples = slo.attribution()
+        tl.add_alert_log(alert_log, slo.specs())
+        tl.add_bad_samples(bad_samples)
+        return tl.build()
+
+    def durability_status(self) -> dict:
+        """The journal's operator-visible health: where the WAL lives,
+        how the last recovery rebuilt the world (``recovered_from`` —
+        which snapshot generation, how much tail was replayed, torn
+        records tolerated), and the live append/snapshot counters."""
+        j = self.journal
+        return {
+            "journalDir": j.dir,
+            "snapshotEvery": j.snapshot_every,
+            "fsyncEvery": j.fsync_every,
+            "retainAll": j.retain_all,
+            "appends": j.appends,
+            "snapshotsWritten": j.snapshots_written,
+            "snapshotGenerations": [rv for rv, _ in j.snapshots()],
+            "recoveredFrom": dict(j.recovered_from),
+        }
 
     def explain_pending(self, namespace: str, name: str) -> Optional[dict]:
         """The pending-job explainer verdict (requires the scheduler);

@@ -510,6 +510,43 @@ class ConsoleServer:
                     raise NotFound(f"SLO {mt.group(1)} not found")
                 return ok(status)
 
+        # forensics (docs/forensics.md). The incident stream reads the
+        # SLO evaluator, not the journal — it gates on telemetry; the
+        # worldline/durability routes gate on the journal (no journal =
+        # no worldline to reconstruct from).
+        if path == "/api/v1/forensics/incidents":
+            if not self.proxy.incidents_enabled:
+                return 501, {"code": 501,
+                             "msg": "slo telemetry disabled "
+                                    "(--enable-slo / SLOEngine gate) — "
+                                    "the incident stream reads the SLO "
+                                    "evaluator's alert log"}, []
+            return ok(self.proxy.incident_timeline())
+        if path.startswith("/api/v1/forensics/") \
+                or path == "/api/v1/durability/status":
+            if not self.proxy.forensics_enabled:
+                return 501, {"code": 501,
+                             "msg": "durability disabled "
+                                    "(--enable-durability + "
+                                    "--journal-dir / "
+                                    "DurableControlPlane gate)"}, []
+            if path == "/api/v1/durability/status":
+                return ok(self.proxy.durability_status())
+            mt = re.fullmatch(r"/api/v1/forensics/world/(\d+)", path)
+            if mt:
+                return ok(self.proxy.world_at(int(mt.group(1))))
+            mt = re.fullmatch(
+                r"/api/v1/forensics/object/([^/]+)/([^/]+)/([^/]+)",
+                path)
+            if mt:
+                kind, ns, name = (unquote(g) for g in mt.groups())
+                history = self.proxy.forensic_object_history(kind, ns,
+                                                             name)
+                if history is None:
+                    raise NotFound(
+                        f"no journal history for {kind} {ns}/{name}")
+                return ok(history)
+
         # slice-scheduler queues: quota + live usage (docs/scheduling.md)
         if path == "/api/v1/queue/list":
             return ok(self.proxy.list_queues())

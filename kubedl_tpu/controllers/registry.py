@@ -145,6 +145,9 @@ class Operator:
     tracer: object = None
     #: the FleetTelemetry bundle when enabled (None otherwise)
     telemetry: object = None
+    #: the WAL journal when --enable-durability + --journal-dir are on
+    #: (None otherwise) — the console's forensics/durability surface
+    journal: object = None
 
     def run_until_idle(self, **kw):
         return self.manager.run_until_idle(**kw)
@@ -195,15 +198,16 @@ def build_operator(api: Optional[APIServer] = None,
     durable = (config.enable_durability
                or gates.enabled(ft.DURABLE_CONTROL_PLANE))
     dur_metrics = None
+    journal = None
     if durable:
         from ..metrics.registry import DurabilityMetrics
         dur_metrics = DurabilityMetrics(registry)
-        journal = None
         if config.journal_dir and hasattr(api, "enable_durability"):
             from ..core.journal import Journal
             journal = Journal(config.journal_dir,
                               snapshot_every=config.snapshot_every,
-                              metrics=dur_metrics)
+                              metrics=dur_metrics,
+                              clock=getattr(api, "now", None))
         if hasattr(api, "enable_durability"):
             api.enable_durability(journal=journal,
                                   watch_ring=config.watch_ring_size,
@@ -348,7 +352,7 @@ def build_operator(api: Optional[APIServer] = None,
                     object_backend=object_backend,
                     event_backend=event_backend, admission=admission,
                     scheduler=scheduler, tracer=tracer,
-                    telemetry=telemetry)
+                    telemetry=telemetry, journal=journal)
 
 
 def _storage_backend(spec: str, for_events: bool = False):

@@ -223,6 +223,18 @@ class APIServer:
                     self._snaps[k] = self._dc(obj)
                 self._rv = max(self._rv, rv)
                 self._ring_base = max(self._ring_base, self._rv)
+                if self._dur_metrics is not None:
+                    # recovery provenance as an info metric — which
+                    # snapshot generation this world came from, for
+                    # post-crash forensics (docs/forensics.md)
+                    rf = journal.recovered_from
+                    self._dur_metrics.journal_recovered.set(
+                        1.0,
+                        snapshot_rv=rf["snapshot_rv"],
+                        snapshot_file=rf["snapshot_file"] or "",
+                        wal_records=rf["wal_records"],
+                        torn_records=rf["torn_records"],
+                        objects=rf["objects"], rv=rf["rv"])
 
     @property
     def _durable(self) -> bool:

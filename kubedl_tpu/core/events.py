@@ -33,17 +33,22 @@ class Recorder:
         self.component = component
         self._dedup: dict[tuple, str] = {}  # (uid, type, reason, message) -> name
 
-    def event(self, obj: dict, event_type: str, reason: str, message: str) -> None:
+    def event(self, obj: dict, event_type: str, reason: str, message: str,
+              annotations: dict = None) -> None:
         """Record an event; best-effort like the real recorder — an
         apiserver hiccup (or injected chaos fault) writing an Event must
-        never fail the reconcile that emitted it."""
+        never fail the reconcile that emitted it. ``annotations`` land
+        on the Event's metadata — machine-parseable detail (the SLO
+        engine's burn-window bounds, docs/forensics.md) that consumers
+        read without parsing the prose message."""
         try:
-            self._record(obj, event_type, reason, message)
+            self._record(obj, event_type, reason, message, annotations)
         except ApiError as e:
             log.warning("dropping event %s/%s for %s: %s",
                         event_type, reason, m.key(obj), e)
 
-    def _record(self, obj: dict, event_type: str, reason: str, message: str) -> None:
+    def _record(self, obj: dict, event_type: str, reason: str, message: str,
+                annotations: dict = None) -> None:
         key = (m.uid(obj), event_type, reason, message)
         existing_name = self._dedup.get(key)
         if existing_name is not None:
@@ -51,11 +56,17 @@ class Recorder:
             if existing is not None:
                 existing["count"] = int(existing.get("count", 1)) + 1
                 existing["lastTimestamp"] = m.rfc3339(self.api.now())
+                if annotations:
+                    md = existing.setdefault("metadata", {})
+                    md["annotations"] = {**(md.get("annotations") or {}),
+                                         **annotations}
                 self.api.update(existing)
                 return
             self._dedup.pop(key, None)
         ev = m.new_obj("v1", "Event",
                        f"{m.name(obj)}.{next(_seq):08x}", m.namespace(obj))
+        if annotations:
+            ev.setdefault("metadata", {})["annotations"] = dict(annotations)
         ev.update({
             "type": event_type,
             "reason": reason,

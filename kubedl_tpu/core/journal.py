@@ -30,14 +30,24 @@ moving backwards across a restart.
 Record format (one JSON object per line, keys kept one-letter compact —
 the WAL is the write hot path)::
 
-    {"t": "c", "rv": 1234, "k": ["Pod", "default", "p-0"], "o": {...}}
-    {"t": "d", "rv": 1240, "k": ["Pod", "default", "p-0"]}
+    {"t": "c", "rv": 1234, "ts": 1700000042.5, "k": ["Pod", "default", "p-0"], "o": {...}}
+    {"t": "d", "rv": 1240, "ts": 1700000050.0, "k": ["Pod", "default", "p-0"]}
 
 ``t`` is the record type (``c`` commit, ``d`` delete), ``rv`` the store
 resourceVersion counter after the write (deletes allocate an rv while
 durability is on, mirroring etcd's revision-per-delete — the ``rv > S``
 replay filter needs every post-snapshot record above the snapshot's rv),
-``k`` the (kind, namespace, name) key, and ``o`` the committed object.
+``ts`` the store clock at the write (sim time in replays, wall time in
+production — the forensics layer's per-commit timestamp; readers must
+tolerate its absence, pre-forensics WALs don't carry it), ``k`` the
+(kind, namespace, name) key, and ``o`` the committed object.
+
+The **read side** is public (docs/forensics.md): :meth:`Journal
+.iter_records` streams parsed records for an rv range with the same
+torn-tail tolerance recovery uses, and :meth:`Journal.snapshots` /
+:meth:`Journal.read_snapshot` expose the checkpoint generations — one
+reader shared by :meth:`recover`, the forensics ``WorldLine``, and any
+future WAL follower, instead of each re-parsing the files.
 """
 
 from __future__ import annotations
@@ -85,13 +95,23 @@ class Journal:
 
     def __init__(self, dirpath: str, snapshot_every: int = 4096,
                  fsync_every: int = 64, metrics=None,
-                 timer=time.perf_counter, fsync_hook=None):
+                 timer=time.perf_counter, fsync_hook=None,
+                 clock=time.time, retain_all: bool = False):
         self.dir = dirpath
         self._lock = threading.Lock()
         self.snapshot_every = max(int(snapshot_every), 1)
         self.fsync_every = max(int(fsync_every), 1)
         self.metrics = metrics
         self._timer = timer
+        #: timestamp source for the per-record ``ts`` field (the store's
+        #: clock: sim time in replays, wall time in production)
+        self._clock = clock or time.time
+        #: keep every snapshot + WAL generation instead of pruning to the
+        #: active pair — the forensics retention mode: ``WorldLine`` can
+        #: then reconstruct the store at ANY rv back to the journal's
+        #: birth (docs/forensics.md). Off by default: a long-lived
+        #: operator's journal would otherwise grow without bound.
+        self.retain_all = bool(retain_all)
         #: chaos seam (docs/chaos.md): called inside every group-commit
         #: fsync, between the latency timer's start and the real
         #: ``os.fsync``. A slow-disk campaign installs
@@ -110,7 +130,7 @@ class Journal:
         #: how the last recover() rebuilt the world (test/debug surface)
         self.recovered_from: dict = {}
 
-    # -- recovery ----------------------------------------------------------
+    # -- read side (public: recovery, WorldLine, future followers) ---------
 
     def _generations(self, prefix: str) -> list:
         out = []
@@ -122,24 +142,79 @@ class Journal:
         out.sort()
         return out
 
+    def snapshots(self) -> list:
+        """``[(rv, path)]`` of on-disk snapshot generations, rv-sorted."""
+        return self._generations(_SNAP_PREFIX)
+
+    def wal_generations(self) -> list:
+        """``[(base_rv, path)]`` of on-disk WAL generations, rv-sorted.
+        A generation's name bounds its MINIMUM record rv (a commit racing
+        a checkpoint lands in the pre-rotation file), never a maximum."""
+        return self._generations(_WAL_PREFIX)
+
+    @staticmethod
+    def read_snapshot(path: str) -> tuple:
+        """Parse one snapshot file into ``(rv, {key: obj})``. Raises
+        ``OSError``/``ValueError``/``KeyError`` for a torn or unreadable
+        file — callers fall back a generation, exactly like recovery."""
+        with open(path) as f:
+            doc = json.load(f)
+        rv = int(doc["rv"])
+        objs: dict[tuple, dict] = {}
+        for o in doc["objects"]:
+            md = o.get("metadata") or {}
+            objs[(o.get("kind", ""),
+                  md.get("namespace", "default"),
+                  md.get("name", ""))] = o
+        return rv, objs
+
+    def iter_records(self, from_rv: int = 0, to_rv: Optional[int] = None,
+                     counts: Optional[dict] = None):
+        """Stream parsed WAL records with ``from_rv < rv <= to_rv`` in
+        file order (the exact replay order recovery uses; ``to_rv=None``
+        is unbounded). Torn lines — a crash mid-append — are tolerated
+        and skipped, tallied into ``counts['torn']`` when a dict is
+        passed (``counts['records']`` tallies the yields). Records are
+        plain dicts; pre-forensics records carry no ``ts`` key, so
+        readers must treat ``rec.get('ts')`` as optional. A generation
+        vanishing between the listing and the open (a live journal's
+        checkpoint pruned it — its records are folded into a newer
+        snapshot) is skipped, not an error: forensics readers run on
+        console threads against the operator's live journal."""
+        for _base_rv, path in self.wal_generations():
+            try:
+                f = open(path)
+            except OSError:
+                continue
+            with f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        if counts is not None:
+                            counts["torn"] = counts.get("torn", 0) + 1
+                        continue
+                    rv = int(rec["rv"])
+                    if rv <= from_rv or (to_rv is not None and rv > to_rv):
+                        continue
+                    if counts is not None:
+                        counts["records"] = counts.get("records", 0) + 1
+                    yield rec
+
     def recover(self) -> tuple:
         """Rebuild ``(max_rv, {key: obj})`` from newest snapshot + WAL
         tail. An empty/new directory recovers to ``(0, {})``. Also
         positions the journal to append to the newest WAL generation."""
-        snaps = self._generations(_SNAP_PREFIX)
+        snaps = self.snapshots()
         objs: dict[tuple, dict] = {}
         snap_rv = 0
         snap_used = None
         for rv, path in reversed(snaps):
             try:
-                with open(path) as f:
-                    doc = json.load(f)
-                snap_rv = int(doc["rv"])
-                for o in doc["objects"]:
-                    md = o.get("metadata") or {}
-                    objs[(o.get("kind", ""),
-                          md.get("namespace", "default"),
-                          md.get("name", ""))] = o
+                snap_rv, objs = self.read_snapshot(path)
                 snap_used = path
                 break
             except (OSError, ValueError, KeyError):
@@ -148,35 +223,20 @@ class Journal:
             raise JournalCorrupt(
                 f"no parseable snapshot generation in {self.dir}")
         max_rv = snap_rv
-        wal_records = 0
-        torn = 0
-        for base_rv, path in self._generations(_WAL_PREFIX):
-            with open(path) as f:
-                for line in f:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    try:
-                        rec = json.loads(line)
-                    except ValueError:
-                        torn += 1      # crash mid-append: drop the tail
-                        continue
-                    rv = int(rec["rv"])
-                    if rv <= snap_rv:
-                        continue       # already folded into the snapshot
-                    k = tuple(rec["k"])
-                    if rec["t"] == "c":
-                        objs[k] = rec["o"]
-                    elif rec["t"] == "d":
-                        objs.pop(k, None)
-                    max_rv = max(max_rv, rv)
-                    wal_records += 1
+        counts: dict = {}
+        for rec in self.iter_records(from_rv=snap_rv, counts=counts):
+            k = tuple(rec["k"])
+            if rec["t"] == "c":
+                objs[k] = rec["o"]
+            elif rec["t"] == "d":
+                objs.pop(k, None)
+            max_rv = max(max_rv, int(rec["rv"]))
         self.recovered_from = {
             "snapshot_rv": snap_rv,
             "snapshot_file": os.path.basename(snap_used) if snap_used
             else None,
-            "wal_records": wal_records,
-            "torn_records": torn,
+            "wal_records": counts.get("records", 0),
+            "torn_records": counts.get("torn", 0),
             "objects": len(objs),
             "rv": max_rv,
         }
@@ -235,11 +295,14 @@ class Journal:
         self._since_fsync = 0
 
     def append_commit(self, key: tuple, obj: dict, rv: int) -> None:
-        self._append({"t": "c", "rv": rv, "k": list(key), "o": obj})
+        self._append({"t": "c", "rv": rv,
+                      "ts": round(self._clock(), 6),
+                      "k": list(key), "o": obj})
         self._since_snapshot += 1
 
     def append_delete(self, key: tuple, rv: int) -> None:
-        self._append({"t": "d", "rv": rv, "k": list(key)})
+        self._append({"t": "d", "rv": rv,
+                      "ts": round(self._clock(), 6), "k": list(key)})
 
     def snapshot_due(self) -> bool:
         return self._since_snapshot >= self.snapshot_every
@@ -269,7 +332,8 @@ class Journal:
                              + ".json")
         tmp = final + ".tmp"
         with open(tmp, "w") as f:
-            json.dump({"rv": rv, "objects": list(snaps.values())}, f,
+            json.dump({"rv": rv, "ts": round(self._clock(), 6),
+                       "objects": list(snaps.values())}, f,
                       separators=(",", ":"))
             f.flush()
             os.fsync(f.fileno())
@@ -291,12 +355,15 @@ class Journal:
             # before the previous checkpoint claimed its rv, so all its
             # records are <= this snapshot's rv and safely folded in.
             # Recovery's rv filter makes the retained extra file free.
-            for gen_rv, path in self._generations(_SNAP_PREFIX):
-                if gen_rv < rv:
+            # retain_all (forensics mode) keeps every generation so
+            # WorldLine can time-travel to any rv since journal birth.
+            if not self.retain_all:
+                for gen_rv, path in self._generations(_SNAP_PREFIX):
+                    if gen_rv < rv:
+                        os.unlink(path)
+                wals = self._generations(_WAL_PREFIX)
+                for gen_rv, path in wals[:-2]:
                     os.unlink(path)
-            wals = self._generations(_WAL_PREFIX)
-            for gen_rv, path in wals[:-2]:
-                os.unlink(path)
             self.snapshots_written += 1
         if self.metrics is not None:
             self.metrics.snapshot_writes.inc()

@@ -443,6 +443,14 @@ class DurabilityMetrics:
         self.shard_owned_keys = r.gauge(
             "kubedl_shard_owned_keys",
             "Live queued request keys per reconcile shard", ("shard",))
+        self.journal_recovered = r.gauge(
+            "kubedl_journal_recovered_info",
+            "Provenance of the last journal recovery (info pattern: "
+            "value 1, labels carry which snapshot generation the world "
+            "came from and how much WAL tail was replayed) — the "
+            "post-crash forensics anchor (docs/forensics.md)",
+            ("snapshot_rv", "snapshot_file", "wal_records",
+             "torn_records", "objects", "rv"))
 
 
 class TraceMetrics:

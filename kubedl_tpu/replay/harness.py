@@ -50,7 +50,7 @@ from ..scheduling.inventory import SliceInventory
 from ..scheduling.scheduler import SliceScheduler
 from ..trace import Tracer, job_trace_context
 from ..trace.analysis import (assert_well_formed, restart_mttrs,
-                              trace_breakdown)
+                              restart_windows, trace_breakdown)
 from ..utils import status as st
 from ..utils.retry import RetryPolicy
 from .workload import (HOSTS_PER_SLICE, POOL_ACCELERATOR, POOL_CHIPS,
@@ -178,8 +178,13 @@ class ClusterReplay:
         if journal_dir is not None:
             from ..core.journal import Journal
             from ..metrics.registry import DurabilityMetrics
+            # clock= stamps each WAL record's ts with sim time and
+            # retain_all keeps every generation, so the forensics
+            # WorldLine can reconstruct the store at ANY rv of the
+            # campaign day (docs/forensics.md)
             self.journal = Journal(journal_dir, snapshot_every=4096,
-                                   fsync_every=64, timer=self.clock)
+                                   fsync_every=64, timer=self.clock,
+                                   clock=self.clock, retain_all=True)
             self.inner = APIServer(
                 clock=self.clock, uid_factory=uid_factory,
                 journal=self.journal, watch_ring=8192,
@@ -269,6 +274,9 @@ class ClusterReplay:
         self.queue_delays: list = []
         self.mttrs: list = []
         self.restart_rounds_seen = 0
+        #: (start, end, job) of every traced Restarting phase — the
+        #: incident timeline's restart-round stream (docs/forensics.md)
+        self.restart_windows: list = []
         self.orphan_violations: list = []
         self.sampled_traces = 0
         self.chaos_preempts_executed = 0
@@ -467,17 +475,20 @@ class ClusterReplay:
         self.goodput.observe(bd)
         queue_delay = bd["byPhase"].get("Queuing", 0.0)
         mttrs = restart_mttrs(bd["phases"])
-        # the SLO engine sees exactly the samples the scorecard reports
+        # the SLO engine sees exactly the samples the scorecard reports;
+        # the job label rides along purely for forensic attribution
+        # (selectors never match on it; window math is label-blind)
         now = self.clock()
         self.slo.observe("queue_delay", queue_delay, now,
-                         {"queue": rec.spec.queue})
+                         {"queue": rec.spec.queue, "job": name})
         for v in mttrs:
             self.slo.observe("restart_mttr", v, now,
-                             {"queue": rec.spec.queue})
+                             {"queue": rec.spec.queue, "job": name})
         self.queue_delays.append(queue_delay)
         self.mttrs.extend(mttrs)
-        self.restart_rounds_seen += sum(
-            1 for p in bd["phases"] if p["name"] == "Restarting")
+        for start, end in restart_windows(bd["phases"]):
+            self.restart_rounds_seen += 1
+            self.restart_windows.append((start, end, name))
         profile = self.workload.profile
         stride = max(1, profile.jobs // max(profile.sample_traces, 1))
         if rec.completion_ordinal % stride == 0:
@@ -727,6 +738,28 @@ class ClusterReplay:
         }
         if self.campaign_runner is not None:
             out["campaign"] = self.campaign_runner.summary()
+            out["forensics"] = self._forensics_block(
+                out["campaign"], out["slo_health"])
         return out
+
+    def _forensics_block(self, campaign_summary: dict,
+                         slo_health: dict) -> dict:
+        """The campaign postmortem (docs/forensics.md): merge the fault
+        script, alert transitions, chaos preemptions, and traced restart
+        rounds into one causal timeline. Every input is deterministic
+        for a fixed seed (times normalize to sim-relative seconds), so
+        the block rides the same bit-for-bit determinism gate as the
+        rest of the result."""
+        from ..forensics import IncidentTimeline, build_postmortem
+        tl = IncidentTimeline(epoch=self.clock.t0)
+        tl.add_campaign(self.campaign)
+        tl.add_alert_log(self.slo.alert_log, self.slo.specs())
+        tl.add_preemptions(self.campaign_runner.preemption_log)
+        tl.add_restarts(self.restart_windows)
+        tl.add_bad_samples(self.slo.bad_samples)
+        return build_postmortem(
+            self.campaign.scenario, self.workload.seed,
+            campaign_summary["fingerprint"], tl.build(),
+            slo_health=slo_health)
 
 

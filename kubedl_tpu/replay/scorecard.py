@@ -124,6 +124,16 @@ _CAMPAIGN_GATES = (
     ("campaign.gangs_preempted", ">=", 4),
     ("chaos.attribution.restarts_observed", ">=", 1),
     ("deterministic", ">=", 1),
+    # forensics (docs/forensics.md): every fired page must be causally
+    # linked to at least one injected fault, every incident must close,
+    # and the postmortem must actually cover the campaign's faults — an
+    # unexplainable page means either a real unknown failure mode or a
+    # broken attribution chain, both blockers
+    ("forensics.summary.pages", ">=", 1),
+    ("forensics.summary.pages_unlinked", "<=", 0),
+    ("forensics.summary.pages_linked", ">=", 1),
+    ("forensics.summary.unresolved_incidents", "<=", 0),
+    ("forensics.summary.faults", ">=", 1),
 )
 
 #: per-seed regression tolerances vs the committed campaign artifact
@@ -136,6 +146,12 @@ _CAMPAIGN_REGRESSION = (
     ("slo.health.min_budget_remaining", "higher_better", 0.10, 0.05),
     ("slo.health.alerts_fired", "lower_better", 0.50, 2.0),
     ("chaos.attribution.restarts_observed", "lower_better", 0.25, 5.0),
+    # forensics (docs/forensics.md): the attribution chain must not
+    # quietly thin out — fewer causal links or fewer attributed bad
+    # samples than the committed postmortem means the timeline lost
+    # evidence even if the hard pages_unlinked zero still holds
+    ("forensics.summary.links_total", "higher_better", 0.30, 1.0),
+    ("forensics.summary.bad_samples", "higher_better", 0.30, 5.0),
 )
 
 
@@ -293,6 +309,10 @@ def build_campaign_scorecard(scenario: str, legs: list) -> dict:
             "slo": {"objectives": res["slo"],
                     "health": res["slo_health"]},
             "chaos": res["chaos"],
+            # the campaign postmortem (docs/forensics.md): rendered to
+            # markdown by `make postmortem`; its summary rows are gated
+            # and regression-checked like every other block
+            "forensics": res.get("forensics") or {},
             "recovery": {
                 # 1/0, not true/false: the gate table compares with >=
                 "parity": int(state["digest"] == ref_state["digest"]),
@@ -362,6 +382,8 @@ def check_campaign_regression(new: dict, old: dict) -> list:
         problems.extend(check_tolerances(new, old, rules))
         for path in ("slo.health.stranded_alerts",
                      "slo.health.stranded_conditions",
+                     "forensics.summary.pages_unlinked",
+                     "forensics.summary.unresolved_incidents",
                      "jobs.trace.orphan_violations"):
             if _get(new, f"seeds.{seed}.{path}"):
                 problems.append(f"seeds.{seed}.{path} must stay 0")

@@ -233,6 +233,13 @@ class SLOEvaluator:
         self._lock = threading.Lock()
         #: transition history: {"t", "slo", "severity", "event", "burn"}
         self.alert_log: list = []
+        #: bad-sample attribution (docs/forensics.md): every EVENT
+        #: sample that burned an objective's budget, with the labels the
+        #: feeder stamped (``job`` from the retirement harvest) — the
+        #: chain the incident timeline walks from a page back to the
+        #: specific jobs whose samples drove the burn. Bounded so a
+        #: long-lived operator can't grow it without limit.
+        self.bad_samples: deque = deque(maxlen=65536)
 
     # -- spec registration -------------------------------------------------
 
@@ -289,7 +296,13 @@ class SLOEvaluator:
             for st in self._states.values():
                 if st.spec.kind == "event" and st.spec.base == signal \
                         and st.spec.matches(labels):
-                    st.add(now, not st.spec.good(value))
+                    bad = not st.spec.good(value)
+                    st.add(now, bad)
+                    if bad:
+                        self.bad_samples.append({
+                            "t": now, "slo": st.spec.name,
+                            "signal": signal, "value": value,
+                            "labels": dict(labels or {})})
 
     def _sample_derived_locked(self, now: float) -> None:
         """Per-tick samples for gauge and registry-metric signals."""
@@ -355,7 +368,13 @@ class SLOEvaluator:
                         if st.spec.kind == "event" \
                                 and st.spec.base == signal \
                                 and st.spec.matches(None):
-                            st.add(t, not st.spec.good(value))
+                            bad = not st.spec.good(value)
+                            st.add(t, bad)
+                            if bad:
+                                self.bad_samples.append({
+                                    "t": t, "slo": st.spec.name,
+                                    "signal": signal, "value": value,
+                                    "labels": {}})
             self._sample_derived_locked(now)
             statuses = []
             for name in sorted(self._states):
@@ -364,8 +383,9 @@ class SLOEvaluator:
                 statuses.append(self._tick_locked(st, now, transitions))
         for st in retired:
             self._retire_state(st, now)
-        for st, severity, fired, status in transitions:
-            self._emit_transition(st, severity, fired, status, now)
+        for st, w, fired, status, short, long_ in transitions:
+            self._emit_transition(st, w, fired, status, now, short,
+                                  long_)
         return statuses
 
     def _retire_state(self, st: _SLOState, now: float) -> None:
@@ -422,7 +442,8 @@ class SLOEvaluator:
                     st.fired[w.severity] += 1
                 status = self._status_locked(st, now, consumed,
                                              burn_rates, alerts)
-                transitions.append((st, w.severity, firing, status))
+                transitions.append((st, w, firing, status,
+                                    short, long_))
                 self.alert_log.append({
                     "t": now, "slo": spec.name, "severity": w.severity,
                     "event": "fire" if firing else "clear",
@@ -470,9 +491,12 @@ class SLOEvaluator:
 
     # -- alert transitions (condition + Event, idempotent per onset) -------
 
-    def _emit_transition(self, st: _SLOState, severity: str, fired: bool,
-                         status: dict, now: float) -> None:
+    def _emit_transition(self, st: _SLOState, w, fired: bool,
+                         status: dict, now: float,
+                         short: Optional[float],
+                         long_: Optional[float]) -> None:
         spec = st.spec
+        severity = w.severity
         consumed = status["budgetConsumed"]
         consumed = "n/a" if consumed is None else f"{consumed:.4f}"
         if fired:
@@ -489,6 +513,24 @@ class SLOEvaluator:
         obj = self.api.try_get(SLO_KIND, "default", spec.name)
         if obj is None:
             return
+        # machine-parseable burn-window bounds (docs/forensics.md): the
+        # incident timeline attributes pages from these annotations
+        # without re-deriving windows from prose
+        annotations = {
+            "slo.kubedl.io/severity": severity,
+            "slo.kubedl.io/signal": spec.signal,
+            "slo.kubedl.io/short-window-seconds": f"{w.short_s:g}",
+            "slo.kubedl.io/long-window-seconds": f"{w.long_s:g}",
+            "slo.kubedl.io/short-window-start": rfc3339(now - w.short_s),
+            "slo.kubedl.io/long-window-start": rfc3339(now - w.long_s),
+            "slo.kubedl.io/burn-threshold": f"{w.burn:g}",
+            "slo.kubedl.io/short-burn":
+                "" if short is None else f"{short:.6f}",
+            "slo.kubedl.io/long-burn":
+                "" if long_ is None else f"{long_:.6f}",
+            "slo.kubedl.io/budget-remaining":
+                f"{status['budgetRemaining']:.6f}",
+        }
         # the condition reflects the AGGREGATE state, not this one
         # transition: when the page pair clears while the ticket pair
         # still fires, the condition must stay True and say so — never
@@ -507,7 +549,8 @@ class SLOEvaluator:
         if self.recorder is not None:
             self.recorder.event(
                 obj, TYPE_WARNING if fired else TYPE_NORMAL,
-                REASON_SLO_BURN if fired else REASON_SLO_RECOVERED, msg)
+                REASON_SLO_BURN if fired else REASON_SLO_RECOVERED, msg,
+                annotations=annotations)
 
     def _write_condition(self, name: str, status: str, reason: str,
                          message: str) -> None:
@@ -542,6 +585,21 @@ class SLOEvaluator:
         log.warning("SLOBurnRate condition write %s kept conflicting", name)
 
     # -- reading -----------------------------------------------------------
+
+    def specs(self) -> dict:
+        """``{name: SLOSpec}`` of the registered objectives — the
+        incident timeline resolves each severity's burn-window widths
+        from here (docs/forensics.md)."""
+        with self._lock:
+            return {name: st.spec for name, st in self._states.items()}
+
+    def attribution(self) -> tuple:
+        """``(alert_log, bad_samples)`` copied under the evaluator lock.
+        The console's incident timeline iterates these from its own
+        request thread while the operator thread appends — iterating
+        the live deque there would raise mid-mutation."""
+        with self._lock:
+            return list(self.alert_log), list(self.bad_samples)
 
     def status(self, name: str) -> Optional[dict]:
         """One SLO's live status (no evaluation side effects). An

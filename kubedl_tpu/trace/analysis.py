@@ -88,6 +88,16 @@ def trace_breakdown(spans: Iterable[Span],
     }
 
 
+def restart_windows(phases: list) -> list:
+    """``(start, end)`` of every ``Restarting`` phase span in a
+    breakdown's ``phases`` list — the restart-round stream the incident
+    timeline merges (docs/forensics.md). Kept beside
+    :func:`restart_mttrs` so the forensics layer and the MTTR signal
+    read the same spans, one derivation each."""
+    return [(p["start"], p["end"]) for p in phases
+            if p["name"] == "Restarting"]
+
+
 def restart_mttrs(phases: list) -> list:
     """Trace-derived restart-MTTR samples from a breakdown's ``phases``
     list: for each outage (first ``Restarting`` phase span after a

@@ -435,6 +435,56 @@ def test_campaign_replay_is_bit_for_bit_deterministic(e2e):
             == json.dumps(res2, sort_keys=True), seed
 
 
+@pytest.mark.forensics
+def test_campaign_forensics_links_every_page(e2e):
+    """The postmortem contract (docs/forensics.md): every fired page is
+    causally linked to >= 1 injected fault, every incident closes, and
+    the block is deterministic (it rides the result JSON, so the
+    bit-for-bit test above already covers repeat runs)."""
+    for seed, (r, res, res2, _ref, _ref_res) in e2e.items():
+        f = res["forensics"]
+        s = f["summary"]
+        assert s["pages"] == res["slo_health"]["pages_fired"], seed
+        assert s["pages_unlinked"] == 0, (seed, f["incidents"])
+        assert s["unresolved_incidents"] == 0, seed
+        assert s["faults"] == len(r.campaign.actions)
+        assert f["campaign_fingerprint"] == r.campaign.fingerprint()
+        for inc in f["incidents"]:
+            if inc["severity"] != "page":
+                continue
+            assert inc["links"], (seed, inc)
+            assert inc["clearedAt"] is not None, (seed, inc)
+            for lk in inc["links"]:
+                # causality: no fault window may START after the page
+                assert lk["windowStart"] <= inc["firedAt"], (seed, inc)
+        # the evidence chain names real campaign-preempted gangs
+        evidence = {j for inc in f["incidents"]
+                    for lk in inc["links"] for j in lk["evidenceJobs"]}
+        preempted = {j for j, _p in r.campaign_runner.gang_preemptions}
+        assert evidence <= preempted, seed
+
+
+@pytest.mark.forensics
+def test_campaign_journal_supports_worldline_time_travel(e2e):
+    """The campaign journal runs in retain_all mode, so WorldLine can
+    reconstruct the store at any rv of the day — the head world must
+    match the live post-campaign store exactly."""
+    from kubedl_tpu.forensics import WorldLine
+    r, _res, _res2, _ref, _ref_res = e2e[0]
+    wl = WorldLine(r.journal.dir)
+    head = wl.head_rv()
+    assert head == r.inner.latest_resource_version()
+    world = wl.at(head)
+    assert set(world) == set(r.inner._objs)
+    for key, obj in world.items():
+        assert obj == r.inner._objs[key], key
+    # and mid-day time travel works: the world at half the rv stream is
+    # reconstructible and non-empty (jobs were live then)
+    mid = wl.at(head // 2)
+    assert mid
+    assert any(k[0] == "TestJob" for k in mid)
+
+
 def test_control_plane_digest_excludes_status_not_spec():
     api = APIServer()
     api.create(cm("a", {"x": "1"}))
@@ -478,6 +528,10 @@ def _mini_campaign_scorecard(**seed_overrides):
                      "held_slices_end": 0, "reference_digest": "d",
                      "reference_completed_fraction": 1.0,
                      "reference_makespan_s": 21600.0},
+        "forensics": {"summary": {
+            "pages": 2, "pages_linked": 2, "pages_unlinked": 0,
+            "links_total": 6, "bad_samples": 12, "faults": 30,
+            "incidents": 4, "unresolved_incidents": 0}},
         "deterministic": 1,
     }
     doc = {"benchmark": "cluster_chaos_campaign",
@@ -503,6 +557,8 @@ def test_campaign_gates_pass_and_fail():
             ("slo.health.min_budget_remaining", -0.01),
             ("recovery.parity", 0),
             ("deterministic", 0),
+            ("forensics.summary.pages_unlinked", 1),
+            ("forensics.summary.unresolved_incidents", 1),
             ("jobs.completed_fraction", 0.99)):
         res = evaluate_campaign_gates(_mini_campaign_scorecard(
             **{path: bad}))
@@ -535,6 +591,16 @@ def test_campaign_regression_detects_tampering():
         _mini_campaign_scorecard(
             **{"chaos.attribution.restarts_observed": 60.0}), old)
     assert any("restarts_observed" in p for p in probs)
+    # an unexplained page or a never-cleared incident can never appear
+    probs = check_campaign_regression(
+        _mini_campaign_scorecard(
+            **{"forensics.summary.pages_unlinked": 1}), old)
+    assert any("pages_unlinked" in p for p in probs)
+    # the attribution chain quietly thinning out is a regression
+    probs = check_campaign_regression(
+        _mini_campaign_scorecard(
+            **{"forensics.summary.links_total": 1}), old)
+    assert any("links_total" in p for p in probs)
     # scenario drift is a new baseline, not a regression
     other = _mini_campaign_scorecard()
     other["scenario"] = "hot-loop"

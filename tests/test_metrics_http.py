@@ -223,6 +223,9 @@ def test_durability_families_in_exposition(served):
     dm.watch_relists.inc(reason="ring_disabled")
     dm.shard_owned_keys.set(7, shard="0")
     dm.shard_owned_keys.set(3, shard="3")
+    dm.journal_recovered.set(
+        1.0, snapshot_rv=4096, snapshot_file="snap-0000000000004096.json",
+        wal_records=12, torn_records=1, objects=40, rv=4108)
     _, body, _ = scrape(port)
     assert "# TYPE kubedl_journal_appends_total counter" in body
     assert "kubedl_journal_appends_total 5.0" in body
@@ -237,6 +240,13 @@ def test_durability_families_in_exposition(served):
     assert "# TYPE kubedl_shard_owned_keys gauge" in body
     assert 'kubedl_shard_owned_keys{shard="0"} 7.0' in body
     assert 'kubedl_shard_owned_keys{shard="3"} 3.0' in body
+    # recovery provenance rides the info pattern: value 1, the story in
+    # the labels (docs/forensics.md)
+    assert "# TYPE kubedl_journal_recovered_info gauge" in body
+    assert ('kubedl_journal_recovered_info{snapshot_rv="4096",'
+            'snapshot_file="snap-0000000000004096.json",'
+            'wal_records="12",torn_records="1",objects="40",'
+            'rv="4108"} 1.0') in body
 
 
 def test_label_value_escaping(served):
