@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test test-all test-fast test-chaos test-campaign test-scheduler test-trace test-replay test-telemetry test-slo test-durability test-forensics bench bench-controlplane bench-scheduler bench-serving-paged bench-trace bench-cluster bench-cluster-adversarial postmortem dryrun crds run-standalone lint native
+.PHONY: test test-all test-fast test-chaos test-campaign test-scheduler test-trace test-replay test-telemetry test-slo test-durability test-forensics test-replication bench bench-controlplane bench-scheduler bench-serving-paged bench-trace bench-cluster bench-cluster-adversarial postmortem dryrun crds run-standalone lint native
 
 # fast path (<3 min): everything except the compile-heavy compute suites
 # (those carry `pytestmark = pytest.mark.slow`). Chaos tests are fast and
@@ -37,10 +37,14 @@ bench:
 # control-plane settle throughput -> BENCH_CONTROLPLANE.json: the legacy
 # 200x8 index-vs-scan leg plus the fleet-scale 10k jobs x 16 replicas
 # gate-on legs (durable control plane, shards=1 vs shards=4, bookmark
-# resume cycles; docs/durability.md). Gates: >=2x sharded settle at
-# no-worse reconcile p99, zero full relists; FAILS on regression vs the
-# committed artifact. Fast tier-1 guards: tests/test_controlplane_perf.py
-# + make test-durability. Use --quick for a 1/10th-scale smoke.
+# resume cycles; docs/durability.md) plus the replication leg (leader
+# SIGKILLed mid-10k-job storm with WAL followers; docs/replication.md).
+# Gates: >=2x sharded settle at no-worse reconcile p99, zero full
+# relists, ZERO acknowledged writes lost across failover, promotion
+# inside one lease term, read throughput scaling with follower count;
+# FAILS on regression vs the committed artifact. Fast tier-1 guards:
+# tests/test_controlplane_perf.py + make test-durability +
+# make test-replication. Use --quick for a 1/10th-scale smoke.
 bench-controlplane:
 	JAX_PLATFORMS=cpu $(PY) bench_controlplane.py
 
@@ -102,6 +106,13 @@ test-durability:
 # postmortem determinism, console endpoints; docs/forensics.md)
 test-forensics:
 	$(PY) -m pytest tests/ -q -m forensics
+
+# replicated control-plane suite (WAL shipping at the group-commit
+# fsync boundary, follower apply idempotence, SIGKILL failover +
+# promotion inside one lease term, leader-kill campaign e2e;
+# docs/replication.md)
+test-replication:
+	$(PY) -m pytest tests/ -q -m replication
 
 # render the committed adversarial campaign's forensics blocks as
 # markdown postmortems (docs/forensics.md; regenerate the blocks with

@@ -23,6 +23,21 @@ the operator itself turns submitted jobs into Running jobs. Three legs
   through disconnect → bookmark resume while jobs keep changing, and
   reports ``relists_avoided`` (resumes served from the event ring) vs
   ``full_relists``.
+* **replication** (docs/replication.md) — a 10k-job write storm against
+  a leader shipping sealed group-commit WAL batches to N followers; the
+  leader is SIGKILLed mid-storm (journal never closed, tail only
+  write(2)-flushed) and the most-caught-up follower is promoted. Gates:
+  ZERO acknowledged writes lost (every pre-kill object at its exact rv
+  in the promoted store, rv counter resumed), promotion inside one
+  lease term of sim time (lease_duration + one retry step — the
+  granularity the protocol polls at), the surviving informer resumes by
+  rv bookmark with zero full relists, zero follower lag at end of
+  storm, and follower-served read throughput scaling with follower
+  count. Reads are charged to the store that served them and the
+  replicated makespan is the busiest store's total — the same
+  process-per-replica accounting the sharded settle leg uses (the GIL
+  makes one-process thread wall time meaningless; the per-store charged
+  costs show the deployment-model scaling).
 
 Gates (``evaluate_gate``): ≥ 2x sharded settle throughput (shards=4 vs
 shards=1, same gate-on config) at no-worse reconcile p99, zero full
@@ -63,6 +78,15 @@ GATE_MIN_SHARD_SPEEDUP = 2.0
 #: "no worse p99" with wall-clock noise grace (ms)
 GATE_P99_SLACK_REL, GATE_P99_SLACK_ABS = 0.20, 0.5
 
+#: replication-leg lease cadence (sim seconds): promotion must land
+#: inside one lease term, measured at the retry-step granularity the
+#: protocol polls at
+REPL_LEASE_DURATION_S = 15.0
+REPL_RETRY_PERIOD_S = 2.0
+#: read throughput must scale with follower count: >= this fraction of
+#: perfectly linear (charged-cost accounting, see module docstring)
+GATE_REPL_READ_SCALING_FRAC = 0.7
+
 #: regression tolerances vs the committed artifact —
 #: (path, direction, relative slack, absolute grace). Wall-clock derived
 #: metrics carry generous slack; structural counts are tight.
@@ -75,6 +99,14 @@ REGRESSION_RULES = (
     ("shards4.reconcile_ms.p99", "lower_better", 0.50, 0.5),
     ("durability.relists_avoided", "higher_better", 0.0, 0.0),
     ("durability.full_relists", "lower_better", 0.0, 0.0),
+    # replication (docs/replication.md): loss/lag/relists are hard
+    # zeroes; promotion is sim-time (deterministic) with headroom for
+    # cadence shifts; read scaling is wall-derived, generous slack
+    ("replication.acknowledged_writes_lost", "lower_better", 0.0, 0.0),
+    ("replication.final_follower_lag_rv", "lower_better", 0.0, 0.0),
+    ("replication.full_relists", "lower_better", 0.0, 0.0),
+    ("replication.promotion_s", "lower_better", 0.20, 2.0),
+    ("replication.read_scaling", "higher_better", 0.20, 0.1),
 )
 
 
@@ -230,6 +262,132 @@ def run_resume_leg(jobs: int, replicas: int, cycles: int = 32,
     }
 
 
+def run_replication_leg(jobs: int, followers: int, journal_dir: str,
+                        reads: int = 20_000) -> dict:
+    """Leader SIGKILL mid-``jobs``-job write storm with ``followers``
+    WAL followers (docs/replication.md; module docstring for the
+    contract). Promotion latency is SIM time (deterministic); the read
+    legs are wall time under charged-cost accounting."""
+    from kubedl_tpu.core.clock import SimClock
+    from kubedl_tpu.core.journal import Journal
+    from kubedl_tpu.core.replication import ReplicatedControlPlane
+    from kubedl_tpu.metrics.registry import Registry, ReplicationMetrics
+
+    sim = SimClock()
+    uid_n = [0]
+
+    def uid_factory() -> str:
+        uid_n[0] += 1
+        return f"repl-{uid_n[0]:08d}"
+
+    journal = Journal(journal_dir, snapshot_every=max(jobs, 4096),
+                      fsync_every=64, clock=sim)
+    api = APIServer(clock=sim, uid_factory=uid_factory, journal=journal,
+                    watch_ring=16384, async_snapshots=True)
+    rcp = ReplicatedControlPlane(
+        api, journal, followers=followers, clock=sim,
+        metrics=ReplicationMetrics(Registry()),
+        lease_duration=REPL_LEASE_DURATION_S,
+        retry_period=REPL_RETRY_PERIOD_S)
+    rcp.step_election()
+
+    # the surviving client: an informer served by a FOLLOWER store
+    informer = Informer(rcp.followers[0].api, "PyTorchJob")
+    informer.start()
+
+    def storm(target, lo, hi):
+        for i in range(lo, hi):
+            target.create(make_job(f"bench-{i:05d}", 2))
+            if i % 200 == 199:
+                sim.advance(2.0)
+                rcp.maybe_step_election(sim())
+
+    half = jobs // 2
+    storm(api, 0, half)
+    ndel = min(64, half // 4)            # deletes ride the stream too
+    for i in range(ndel):                # (scaled so a small --jobs run
+        api.delete("PyTorchJob", "default",   # still has survivors to
+                   f"bench-{i:05d}")          # read below)
+    assert ndel < half, f"jobs={jobs} leaves nothing to read"
+    # seal the storm before the read phase: the reads measure follower
+    # SERVING, not shipping lag, so every name they ask for must have
+    # shipped (at any scale — without this the last < fsync_every
+    # creates can still sit in the unfsynced tail)
+    journal.flush()
+
+    # follower-served read throughput, charged-cost accounting: every
+    # get's measured wall cost is charged to the store that served it;
+    # the replicated makespan is the busiest store's total
+    names = [f"bench-{i:05d}" for i in range(ndel, half)]
+    stores = [f.api for f in rcp.followers]
+    leader_busy = 0.0
+    follower_busy = [0.0] * len(stores)
+    for r in range(reads):
+        name = names[r % len(names)]
+        t0 = time.perf_counter()
+        api.get("PyTorchJob", "default", name)
+        leader_busy += time.perf_counter() - t0
+        store = stores[r % len(stores)]
+        t0 = time.perf_counter()
+        store.get("PyTorchJob", "default", name)
+        follower_busy[r % len(stores)] += time.perf_counter() - t0
+    read_scaling = leader_busy / max(max(follower_busy), 1e-9)
+
+    # the write(2)-only tail the dead leader's WAL must surrender: 32
+    # acknowledged creates, deliberately < fsync_every=64 so they are
+    # never sealed/shipped before the kill
+    for i in range(32):
+        api.create(make_job(f"tail-{i:03d}", 2))
+
+    # SIGKILL: nothing closed, nothing flushed beyond write(2); the
+    # acknowledged world is every committed object at its exact rv —
+    # audited by the same helper the leader_kill campaign gate uses
+    promo = rcp.kill_and_promote_audited()
+    promo.pop("follower")
+
+    # the surviving informer re-resolves to the new leader and resumes
+    # by rv bookmark — zero relists, zero gap
+    informer.disconnect()
+    informer.api = rcp.api
+    informer.resume()
+
+    storm(rcp.api, half, jobs)           # the storm finishes on the
+    rcp.journal.flush()                  # promoted leader, new epoch
+    # drain the DEAD leader's async-snapshot worker (rcp.api is now the
+    # winner's store, which never checkpoints async): a checkpoint still
+    # being written while the caller rmtree's the journal dir would race
+    api.wait_for_checkpoints()
+    leader_rv = rcp.api.latest_resource_version()
+    final_lag = max((leader_rv - f.applied_rv for f in rcp.followers),
+                    default=0)
+    cached = len(informer.lister().list())
+    return {
+        "jobs": jobs,
+        "followers": followers,
+        "ack_objects_at_kill": promo["ackObjectsAtKill"],
+        "ack_rv_at_kill": promo["killedAtRv"],
+        "acknowledged_writes_lost": promo["ackObjectsLost"],
+        "extra_objects_after_promotion": promo["extraObjects"],
+        "rv_resumed": bool(promo["rvResumed"]),
+        "tail_records_replayed": promo["tailRecordsReplayed"],
+        "promotion_s": promo["promotionSeconds"],
+        "lease_wait_s": promo["leaseWaitSeconds"],
+        "lease_term_s": REPL_LEASE_DURATION_S + REPL_RETRY_PERIOD_S,
+        "promoted_from": promo["promotedFrom"],
+        "epoch": promo["epoch"],
+        "bookmark_resumes": informer.bookmark_resumes,
+        "full_relists": informer.full_relists,
+        "informer_cached_objects": cached,
+        "shipped_batches": rcp.counters["frames"],
+        "shipped_bytes": rcp.counters["bytes"],
+        "final_follower_lag_rv": final_lag,
+        "reads": reads,
+        "read_makespan_leader_s": round(leader_busy, 4),
+        "read_makespan_replicated_s": round(max(follower_busy), 4),
+        "read_scaling": round(read_scaling, 2),
+    }
+
+
 from kubedl_tpu.replay.scorecard import _get  # noqa: E402 — the one
 # dotted-path getter the scorecard, bench_scheduler, and this bench share
 
@@ -254,6 +412,35 @@ def evaluate_gate(result: dict) -> list:
     relists = _get(result, "durability.full_relists")
     if relists:
         problems.append(f"durability.full_relists {relists} != 0")
+    repl = result.get("replication")
+    if repl is not None:
+        if repl["acknowledged_writes_lost"]:
+            problems.append(
+                f"replication.acknowledged_writes_lost "
+                f"{repl['acknowledged_writes_lost']} != 0 (an fsynced/"
+                f"write(2)-acknowledged commit vanished across failover)")
+        if not repl["rv_resumed"]:
+            problems.append("replication: promoted rv counter moved "
+                            "backwards")
+        if repl["promotion_s"] > repl["lease_term_s"]:
+            problems.append(
+                f"replication.promotion_s {repl['promotion_s']} > one "
+                f"lease term ({repl['lease_term_s']}s)")
+        if repl["full_relists"] or not repl["bookmark_resumes"]:
+            problems.append(
+                f"replication: surviving informer needed "
+                f"{repl['full_relists']} full relists "
+                f"({repl['bookmark_resumes']} bookmark resumes)")
+        if repl["final_follower_lag_rv"]:
+            problems.append(
+                f"replication.final_follower_lag_rv "
+                f"{repl['final_follower_lag_rv']} != 0 after flush")
+        floor = GATE_REPL_READ_SCALING_FRAC * repl["followers"]
+        if repl["read_scaling"] < floor:
+            problems.append(
+                f"replication.read_scaling {repl['read_scaling']} < "
+                f"{round(floor, 2)} ({GATE_REPL_READ_SCALING_FRAC}x "
+                f"linear over {repl['followers']} followers)")
     return problems
 
 
@@ -281,6 +468,11 @@ def main() -> dict:
     ap.add_argument("--shards", type=int, default=4,
                     help="sharded leg's shard count (vs the shards=1 leg)")
     ap.add_argument("--resume-cycles", type=int, default=32)
+    ap.add_argument("--replication-followers", type=int, default=2,
+                    help="follower count for the replication leg "
+                         "(0 skips the leg)")
+    ap.add_argument("--replication-reads", type=int, default=20_000,
+                    help="point reads for the read-scaling measurement")
     ap.add_argument("--quick", action="store_true",
                     help="1/10th scale smoke (never write the artifact)")
     ap.add_argument("--no-check", action="store_true",
@@ -292,6 +484,7 @@ def main() -> dict:
         args.jobs, args.replicas = max(args.jobs // 10, 50), 8
         args.legacy_repeat = 1
         args.resume_cycles = 8
+        args.replication_reads = 2000
         args.out = ""
 
     result = {
@@ -325,6 +518,12 @@ def main() -> dict:
         result["durability"] = run_resume_leg(
             min(args.jobs, 500), 8, cycles=args.resume_cycles,
             journal_dir=os.path.join(tmp, "resume"))
+        if args.replication_followers > 0:
+            result["replication"] = run_replication_leg(
+                args.jobs, args.replication_followers,
+                journal_dir=os.path.join(tmp, "replication"),
+                reads=args.replication_reads)
+            print(json.dumps(result["replication"]))
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
 

@@ -80,6 +80,15 @@ def parse_args(argv=None) -> argparse.Namespace:
     p.add_argument("--snapshot-every", type=int, default=4096,
                    help="commits between store snapshots / WAL "
                         "rotations when the journal is on")
+    p.add_argument("--replication-followers", type=int, default=0,
+                   help="N warm follower stores fed by WAL shipping at "
+                        "the group-commit fsync boundary, promotable on "
+                        "leader loss (docs/replication.md; requires "
+                        "--enable-durability and --journal-dir)")
+    p.add_argument("--async-snapshots", action="store_true",
+                   help="serialize store checkpoints on a background "
+                        "worker so commits and WAL shipping never wait "
+                        "on the O(world) dump (docs/replication.md)")
     p.add_argument("--reconcile-shards", type=int, default=1,
                    help="N-way sharded reconcile ownership: the "
                         "workqueue partitions by a consistent hash of "
@@ -137,6 +146,16 @@ def parse_args(argv=None) -> argparse.Namespace:
         p.error("--reconcile-shards > 1 requires --enable-durability")
     if args.journal_dir and not args.enable_durability:
         p.error("--journal-dir requires --enable-durability")
+    # same pattern as --reconcile-shards: replication without the gate
+    # (or without a WAL to ship) would silently run a follower-less
+    # leader — fail at the parser instead
+    if args.replication_followers > 0 and not args.enable_durability:
+        p.error("--replication-followers requires --enable-durability")
+    if args.replication_followers > 0 and not args.journal_dir:
+        p.error("--replication-followers requires --journal-dir (the "
+                "group-commit fsync batch is the shipping unit)")
+    if args.async_snapshots and not args.enable_durability:
+        p.error("--async-snapshots requires --enable-durability")
     return args
 
 
@@ -173,6 +192,8 @@ def config_from_args(args: argparse.Namespace) -> OperatorConfig:
         journal_dir=args.journal_dir,
         snapshot_every=args.snapshot_every,
         reconcile_shards=args.reconcile_shards,
+        replication_followers=args.replication_followers,
+        async_snapshots=args.async_snapshots,
     )
 
 
@@ -248,7 +269,8 @@ def main(argv=None) -> int:
                           tracer=operator.tracer,
                           scheduler=operator.scheduler,
                           telemetry=operator.telemetry,
-                          journal=operator.journal)
+                          journal=operator.journal,
+                          replication=operator.replication)
         console = ConsoleServer(
             proxy, ConsoleConfig(host=args.console_host,
                                  port=args.console_port))
@@ -270,16 +292,46 @@ def main(argv=None) -> int:
         log.info("operator running (%d reconcile workers)",
                  max(1, operator.config.max_reconciles))
 
+    if operator.replication is not None:
+        # drive the replication group's election protocol on the retry
+        # cadence (docs/replication.md): the leader renews its
+        # replicated Lease and every standby refreshes its expiry
+        # observation — the watching that lets a promotion establish
+        # expiry within one lease term. Without this thread the Lease
+        # would never be created or renewed and the shipped followers
+        # would be read replicas with no live failover protocol.
+        import time as _time
+        rcp = operator.replication
+        rcp_now = getattr(operator.api, "now", None) or _time.time
+
+        def step_replication_election():
+            while not stop.is_set():
+                try:
+                    rcp.maybe_step_election(rcp_now())
+                except Exception as e:  # noqa: BLE001 — the election
+                    # loop must survive transient api errors; a dead
+                    # thread would silently freeze the group's protocol
+                    log.warning("replication election step failed: %s", e)
+                stop.wait(rcp.retry_period)
+
+        threading.Thread(target=step_replication_election,
+                         name="replication-election", daemon=True).start()
+
     if args.enable_leader_election and args.reconcile_shards > 1:
         # sharded ownership (docs/durability.md): every replica runs and
         # drains exactly the shards whose Leases it holds; a lost lease
         # hands that shard to whichever replica acquires it next — no
         # whole-operator demotion, no restart
         from .core.leaderelection import ShardLeaseSet
+        # clock= is the store's clock (docs/replication.md): wall time
+        # in production, a SimClock under the replay/bench drivers —
+        # which is what makes lease expiry and promotion latency
+        # measurable in sim time, deterministic per seed
         leases = ShardLeaseSet(
             operator.api, args.reconcile_shards,
             namespace=args.leader_election_namespace,
-            prefix=args.leader_election_id + "-shard")
+            prefix=args.leader_election_id + "-shard",
+            clock=getattr(operator.api, "now", None))
         operator.manager.shard_owner = leases.owns
         log.info("per-shard leases enabled (%d shards, identity %s)",
                  args.reconcile_shards, leases.identity)
@@ -293,7 +345,8 @@ def main(argv=None) -> int:
                                           LeaderElector)
         elector = LeaderElector(operator.api, LeaderElectionConfig(
             namespace=args.leader_election_namespace,
-            name=args.leader_election_id))
+            name=args.leader_election_id),
+            clock=getattr(operator.api, "now", None))
         log.info("leader election enabled (%s/%s as %s)",
                  args.leader_election_namespace, args.leader_election_id,
                  elector.config.identity)

@@ -35,6 +35,12 @@ from its committed seed. The grammar (docs/chaos.md):
   ``slow_fsync``       ``_start``/``_end`` pair: the WAL's group-commit
                        fsync takes extra injected seconds (a dying disk),
                        advancing the sim clock — never sleeping
+  ``leader_kill``      the control-plane leader dies SIGKILL-style mid-
+                       day (journal never closed, tail only write(2)-
+                       flushed) and the most-caught-up WAL follower is
+                       promoted through the Lease machinery — requires
+                       the replay's ``replication_followers`` > 0
+                       (docs/replication.md)
   ===================  ====================================================
 
 Faults are injected through the seeded :class:`ChaosAPIServer`
@@ -59,6 +65,7 @@ PRIMITIVES = frozenset({
     "spot_dry_start", "spot_dry_end",
     "watch_storm_start", "watch_storm_end",
     "slow_fsync_start", "slow_fsync_end",
+    "leader_kill",
 })
 
 
@@ -237,6 +244,20 @@ def _scn_adversarial(rng, profile, spot_pools) -> list:
     return acts
 
 
+def _scn_leader_kill(rng, profile, spot_pools) -> list:
+    """The full adversarial day PLUS a SIGKILL of the control-plane
+    leader landing on the recovery tail of the spot sweep — failover
+    exercised under correlated faults, not in a quiet lab. Draw order
+    is fixed (adversarial's clauses first, then the kill time), and the
+    scenario name seeds its own rng stream, so the committed
+    ``adversarial`` scenario's script is untouched bit for bit."""
+    acts = _scn_adversarial(rng, profile, spot_pools)
+    acts.append(FaultAction(
+        round(rng.uniform(0.55, 0.65) * profile.sim_seconds, 3),
+        "leader_kill"))
+    return acts
+
+
 SCENARIOS = {
     "domain-outage": _scn_domain_outage,
     "spot-dryness": _scn_spot_dryness,
@@ -245,6 +266,7 @@ SCENARIOS = {
     "hot-loop": _scn_hot_loop,
     "slow-fsync": _scn_slow_fsync,
     "adversarial": _scn_adversarial,
+    "leader-kill": _scn_leader_kill,
 }
 
 
@@ -422,6 +444,16 @@ class CampaignRunner:
                 continue
             if shard_for("default", name, mgr.shards) == shard:
                 mgr.enqueue(Request("TestJob", "default", name))
+
+    # -- leader kill -------------------------------------------------------
+
+    def _do_leader_kill(self, action: FaultAction) -> None:
+        """SIGKILL the control-plane leader and promote the most-
+        caught-up WAL follower (docs/replication.md). The replay owns
+        the process model; it raises loudly when the campaign was run
+        without ``replication_followers`` — a silently skipped failover
+        would gut the scenario's whole point."""
+        self.replay.kill_leader()
 
     # -- slow fsync --------------------------------------------------------
 

@@ -43,7 +43,7 @@ class DataProxy:
                  object_backend: Optional[ObjectBackend] = None,
                  event_backend: Optional[EventBackend] = None,
                  job_kinds=TRAINING_KINDS, tracer=None, scheduler=None,
-                 telemetry=None, journal=None):
+                 telemetry=None, journal=None, replication=None):
         self.api = api
         self.object_backend = object_backend
         self.event_backend = event_backend
@@ -60,6 +60,9 @@ class DataProxy:
         #: the control plane's WAL journal (docs/durability.md); None =
         #: the /api/v1/forensics and /api/v1/durability endpoints 501
         self.journal = journal
+        #: the ReplicatedControlPlane (docs/replication.md); None = the
+        #: /api/v1/replication endpoints 501
+        self.replication = replication
 
     # -- jobs -------------------------------------------------------------
 
@@ -620,6 +623,20 @@ class DataProxy:
             "snapshotGenerations": [rv for rv, _ in j.snapshots()],
             "recoveredFrom": dict(j.recovered_from),
         }
+
+    # -- replication (docs/replication.md) --------------------------------
+
+    @property
+    def replication_enabled(self) -> bool:
+        return self.replication is not None
+
+    def replication_status(self) -> dict:
+        """The replication group's live health: role, stream epoch,
+        per-follower applied-rv lag, shipping volume, and — after a
+        failover — the ``lastPromotion`` provenance (who was promoted,
+        how much inherited WAL tail was replayed, how long the lease
+        wait took), the replication analog of ``recoveredFrom``."""
+        return self.replication.status()
 
     def explain_pending(self, namespace: str, name: str) -> Optional[dict]:
         """The pending-job explainer verdict (requires the scheduler);

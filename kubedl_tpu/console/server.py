@@ -547,6 +547,18 @@ class ConsoleServer:
                         f"no journal history for {kind} {ns}/{name}")
                 return ok(history)
 
+        # replication (docs/replication.md): role, epoch, per-follower
+        # lag, last-promotion provenance; 501 when replication is off,
+        # matching the durability endpoints' convention
+        if path == "/api/v1/replication/status":
+            if not self.proxy.replication_enabled:
+                return 501, {"code": 501,
+                             "msg": "replication disabled "
+                                    "(--replication-followers with "
+                                    "--enable-durability + "
+                                    "--journal-dir)"}, []
+            return ok(self.proxy.replication_status())
+
         # slice-scheduler queues: quota + live usage (docs/scheduling.md)
         if path == "/api/v1/queue/list":
             return ok(self.proxy.list_queues())

@@ -126,6 +126,18 @@ class OperatorConfig:
     reconcile_shards: int = 1
     #: bounded per-kind watch-event ring serving bookmark resumes
     watch_ring_size: int = 4096
+    #: --replication-followers: N warm follower stores fed by WAL
+    #: shipping at the group-commit fsync boundary, promotable on
+    #: leader loss (docs/replication.md). Requires durability AND a
+    #: journal dir (the sealed fsync batch is the shipping unit);
+    #: 0 = no replication (byte-identical PR 12 behavior: no shipping
+    #: hooks, no kubedl_replication_* families, 501 endpoints).
+    replication_followers: int = 0
+    #: --async-snapshots: run the O(world) checkpoint serializer on a
+    #: background worker so commits AND WAL shipping never wait on it
+    #: (docs/replication.md; the COW store's immutable per-object
+    #: snapshots make the concurrent dump safe)
+    async_snapshots: bool = False
 
 
 @dataclass
@@ -148,6 +160,9 @@ class Operator:
     #: the WAL journal when --enable-durability + --journal-dir are on
     #: (None otherwise) — the console's forensics/durability surface
     journal: object = None
+    #: the ReplicatedControlPlane when --replication-followers > 0
+    #: (None otherwise) — WAL shipping + promotion (docs/replication.md)
+    replication: object = None
 
     def run_until_idle(self, **kw):
         return self.manager.run_until_idle(**kw)
@@ -199,6 +214,7 @@ def build_operator(api: Optional[APIServer] = None,
                or gates.enabled(ft.DURABLE_CONTROL_PLANE))
     dur_metrics = None
     journal = None
+    replication = None
     if durable:
         from ..metrics.registry import DurabilityMetrics
         dur_metrics = DurabilityMetrics(registry)
@@ -211,7 +227,25 @@ def build_operator(api: Optional[APIServer] = None,
         if hasattr(api, "enable_durability"):
             api.enable_durability(journal=journal,
                                   watch_ring=config.watch_ring_size,
-                                  metrics=dur_metrics)
+                                  metrics=dur_metrics,
+                                  async_snapshots=config.async_snapshots
+                                  or None)
+        if config.replication_followers > 0:
+            # WAL shipping + promotable followers (docs/replication.md):
+            # the kubedl_replication_* families register only here, so
+            # the un-replicated exposition stays byte-identical. Needs
+            # the journal — the sealed fsync batch is the shipping unit.
+            if journal is None:
+                raise ValueError(
+                    "replication_followers requires a journal_dir "
+                    "(the group-commit fsync batch is the shipping "
+                    "unit; there is nothing to ship without a WAL)")
+            from ..core.replication import ReplicatedControlPlane
+            from ..metrics.registry import ReplicationMetrics
+            replication = ReplicatedControlPlane(
+                api, journal, followers=config.replication_followers,
+                clock=getattr(api, "now", None),
+                metrics=ReplicationMetrics(registry))
     manager = Manager(api, metrics=ControlPlaneMetrics(registry),
                       tracer=tracer,
                       shards=(config.reconcile_shards if durable else 1),
@@ -352,7 +386,8 @@ def build_operator(api: Optional[APIServer] = None,
                     object_backend=object_backend,
                     event_backend=event_backend, admission=admission,
                     scheduler=scheduler, tracer=tracer,
-                    telemetry=telemetry, journal=journal)
+                    telemetry=telemetry, journal=journal,
+                    replication=replication)
 
 
 def _storage_backend(spec: str, for_events: bool = False):

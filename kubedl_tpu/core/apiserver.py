@@ -135,7 +135,7 @@ class APIServer:
                  uid_factory: Optional[Callable[[], str]] = None,
                  preset_uid_kinds: tuple = ("SLO",),
                  journal=None, watch_ring: int = 0,
-                 durability_metrics=None):
+                 durability_metrics=None, async_snapshots: bool = False):
         self._clock = clock
         #: kinds whose creates honor a caller-supplied metadata.uid (the
         #: deterministic-replay seam — see create()). Deliberately an
@@ -185,6 +185,20 @@ class APIServer:
         self._ring_floor: dict[str, int] = {}
         self._ring_base = 0
         self._dur_metrics = None
+        #: replication apply levels for DELETED records (docs/
+        #: replication.md): removal pops the object and with it the rv
+        #: the level guards compare against, so a re-shipped stale
+        #: commit could resurrect a deleted object without this map.
+        #: Populated only by apply_replicated — a non-follower store
+        #: never touches it. Bounded, insertion-ordered.
+        self._replica_dead: dict[tuple, int] = {}
+        # async checkpointing (docs/replication.md): the O(world)
+        # snapshot serializer runs on a dedicated worker so neither
+        # commits nor WAL shipping ever wait on it. Off by default —
+        # the synchronous path is PR 10's exact behavior.
+        self._snap_async = bool(async_snapshots)
+        self._ckpt_queue = None
+        self._ckpt_thread = None
         if journal is not None or watch_ring or durability_metrics:
             self.enable_durability(journal=journal, watch_ring=watch_ring,
                                    metrics=durability_metrics)
@@ -192,7 +206,8 @@ class APIServer:
     # -- durability (WAL + snapshots + resumable watches) ------------------
 
     def enable_durability(self, journal=None, watch_ring: int = 4096,
-                          metrics=None) -> None:
+                          metrics=None,
+                          async_snapshots: Optional[bool] = None) -> None:
         """Attach the durability layer (docs/durability.md): a
         :class:`~kubedl_tpu.core.journal.Journal` whose existing state is
         recovered into the store (resuming the ``resourceVersion``
@@ -207,6 +222,8 @@ class APIServer:
         with self._lock:
             if metrics is not None:
                 self._dur_metrics = metrics
+            if async_snapshots is not None:
+                self._snap_async = bool(async_snapshots)
             if watch_ring and not self._ring_size:
                 # the ring's base marks "events before this rv are not
                 # replayable" — set once, when buffering starts
@@ -274,7 +291,153 @@ class APIServer:
             if not j.claim_snapshot():
                 return                  # another writer claimed it
             rv, snaps = self._rv, dict(self._snaps)
+        if self._snap_async:
+            # truly non-blocking checkpoints (docs/replication.md): the
+            # (rv, snaps) pair was captured under the lock — the
+            # per-object snapshots are immutable by the COW contract, so
+            # the serializer can run fully concurrent with commits AND
+            # with WAL shipping; only the file dump is deferred
+            self._ckpt_submit(j, rv, snaps)
+            return
         j.write_snapshot(rv, snaps)
+
+    def _ckpt_submit(self, journal, rv: int, snaps: dict) -> None:
+        import queue
+        with self._lock:
+            if self._ckpt_queue is None:
+                self._ckpt_queue = queue.Queue()
+                self._ckpt_thread = threading.Thread(
+                    target=self._ckpt_worker, name="kubedl-checkpoint",
+                    daemon=True)
+                self._ckpt_thread.start()
+        self._ckpt_queue.put((journal, rv, snaps))
+
+    def _ckpt_worker(self) -> None:
+        while True:
+            journal, rv, snaps = self._ckpt_queue.get()
+            try:
+                journal.write_snapshot(rv, snaps)
+            except Exception:  # noqa: BLE001 — a failed checkpoint must
+                # not kill the worker: the WAL alone still recovers, and
+                # the next due checkpoint retries the dump
+                import logging
+                logging.getLogger("kubedl_tpu.apiserver").exception(
+                    "async checkpoint at rv %d failed", rv)
+            finally:
+                self._ckpt_queue.task_done()
+
+    def wait_for_checkpoints(self) -> None:
+        """Block until every queued async checkpoint has been written
+        (tests and orderly shutdown; a no-op in synchronous mode)."""
+        if self._ckpt_queue is not None:
+            self._ckpt_queue.join()
+
+    # -- replication (docs/replication.md) --------------------------------
+
+    def world_snapshot(self) -> tuple:
+        """``(rv, {key: snapshot})`` — the same shallow grab of the
+        immutable per-object snapshots a checkpoint claims, for shipping
+        a catch-up manifest to a gapped follower."""
+        with self._lock:
+            return self._rv, dict(self._snaps)
+
+    def adopt_journal(self, journal) -> None:
+        """Attach an already-positioned journal WITHOUT running recovery
+        — the promotion seam: the store is already caught up (shipped
+        batches + the inherited WAL tail replay), so re-reading the
+        journal would be wasted work at best and a double-apply at
+        worst. Future commits append through the adopted journal."""
+        with self._lock:
+            self._journal = journal
+            if self._dur_metrics is not None and journal.metrics is None:
+                journal.metrics = self._dur_metrics
+
+    def apply_replicated(self, rec: dict) -> bool:
+        """Apply one shipped WAL record ({"t","rv","k","o"}) under the
+        level-based informer-cache rules (docs/replication.md), so
+        duplicated, re-shipped, and reordered batches are idempotent:
+
+        * a commit applies only when its rv is above BOTH the stored
+          object's rv and any remembered deletion level for the key;
+        * a delete applies only when its rv is above the stored rv, and
+          its level is remembered so a stale re-shipped commit cannot
+          resurrect the object;
+        * the store's rv counter only ever moves forward.
+
+        Applied records ride the watch ring and fan out to watchers —
+        a follower serves reads and ``watch_from`` like any store.
+        Never journals (the records already live in the leader's WAL).
+        Returns whether the record changed the store."""
+        k = tuple(rec["k"])
+        rv = int(rec["rv"])
+        snap = None
+        event = None
+        with self._lock:
+            cur = self._objs.get(k)
+            cur_rv = m.resource_version(cur) if cur is not None else 0
+            if rv <= max(cur_rv, self._replica_dead.get(k, 0)):
+                return False
+            self._rv = max(self._rv, rv)
+            if rec["t"] == "c":
+                obj = rec["o"]
+                if cur is not None:
+                    self._index_remove(k, cur)
+                # the shipped object is the leader's frozen read
+                # snapshot — immutable by contract, safe to adopt as
+                # this store's canonical; the follower cuts its OWN
+                # read snapshot so its readers share nothing mutable
+                self._objs[k] = obj
+                self._index_add(k, obj)
+                snap = self._dc(obj)
+                self._snaps[k] = snap
+                self._replica_dead.pop(k, None)
+                event = "ADDED" if cur is None else "MODIFIED"
+                if self._ring_size:
+                    self._ring_append(k[0], event, snap, rv)
+            else:                       # "d"
+                self._replica_dead[k] = rv
+                while len(self._replica_dead) > 4096:
+                    self._replica_dead.pop(next(iter(self._replica_dead)))
+                if cur is None:
+                    return True         # level advanced; nothing stored
+                self._index_remove(k, cur)
+                del self._objs[k]
+                snap = self._snaps.pop(k, None) or self._dc(cur)
+                snap = dict(snap)
+                snap["metadata"] = dict(snap.get("metadata") or {},
+                                        resourceVersion=rv)
+                event = "DELETED"
+                if self._ring_size:
+                    self._ring_append(k[0], event, snap, rv)
+        if event is not None:
+            self._emit(event, snap)
+        return True
+
+    def install_replica_snapshot(self, rv: int, objects) -> None:
+        """Replace the whole world from a shipped snapshot manifest —
+        the catch-up path for a follower that joined late or fell
+        behind the shipping stream. Watchers are NOT notified (a
+        follower being resynced has no caught-up consumers by
+        definition — they resume by bookmark afterwards); the ring
+        restarts at ``rv`` since pre-manifest history is gone."""
+        rv = int(rv)
+        with self._lock:
+            for k in list(self._objs):
+                self._index_remove(k, self._objs[k])
+            self._objs.clear()
+            self._snaps.clear()
+            self._replica_dead.clear()
+            for obj in objects:
+                md = obj.get("metadata") or {}
+                k = (obj.get("kind", ""), md.get("namespace", "default"),
+                     md.get("name", ""))
+                self._objs[k] = obj
+                self._index_add(k, obj)
+                self._snaps[k] = self._dc(obj)
+            self._rv = max(self._rv, rv)
+            self._event_ring.clear()
+            self._ring_floor.clear()
+            self._ring_base = self._rv
 
     def watch_from(self, fn: Callable[[str, Obj], None],
                    resource_version: int,
@@ -814,6 +977,14 @@ class APIServer:
                 pass
 
     # -- test/introspection helpers --------------------------------------
+
+    @property
+    def commit_lock(self):
+        """The store's commit RLock — the journal's ``seal_guard``
+        (docs/replication.md): WAL shipping acquires it before the
+        journal lock so the global lock order is store -> journal on
+        every seal path."""
+        return self._lock
 
     def latest_resource_version(self) -> int:
         """Current store RV (list+watch consistency for HTTP frontends)."""

@@ -46,12 +46,24 @@ The **read side** is public (docs/forensics.md): :meth:`Journal
 .iter_records` streams parsed records for an rv range with the same
 torn-tail tolerance recovery uses, and :meth:`Journal.snapshots` /
 :meth:`Journal.read_snapshot` expose the checkpoint generations — one
-reader shared by :meth:`recover`, the forensics ``WorldLine``, and any
-future WAL follower, instead of each re-parsing the files.
+reader shared by :meth:`recover`, the forensics ``WorldLine``, and the
+replication layer's WAL followers, instead of each re-parsing the files.
+
+The **ship side** (docs/replication.md) hangs off the group-commit
+boundary: when an ``on_seal`` hook is installed, every record appended
+since the last fsync is buffered and handed to the hook — as parsed
+dicts plus their serialized byte count — the moment the fsync that
+makes them durable returns. The sealed batch is the replication
+shipping unit: anything fsynced has been offered to the followers,
+anything shipped has been fsynced. ``on_snapshot(rv)`` fires after a
+checkpoint lands durably (the follower-visible snapshot manifest
+cadence). Both hooks default to None and cost one attribute check on
+the hot path, so a non-replicated journal is byte-identical to PR 10's.
 """
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import threading
@@ -119,7 +131,39 @@ class Journal:
         #: measured by ``kubedl_journal_fsync_seconds`` exactly like a
         #: genuinely slow WAL device would be.
         self.fsync_hook = fsync_hook
+        #: replication ship seam (docs/replication.md): called as
+        #: ``on_seal(records, nbytes)`` after each group-commit fsync
+        #: with the parsed records that fsync sealed — the WAL-shipping
+        #: unit. None (default) = no buffering, no shipping.
+        self.on_seal = None
+        #: called as ``on_snapshot(rv)`` after a checkpoint is durably
+        #: renamed into place (the snapshot-manifest cadence followers
+        #: hear about). None = no-op.
+        self.on_snapshot = None
+        #: lock-order guard for the ship hooks (set by the WalShipper
+        #: to the store's commit lock): every journal path that can
+        #: seal+ship acquires it BEFORE the journal lock, so the global
+        #: order is store -> journal everywhere. Without it, a thread
+        #: that fsyncs without the store lock (the async checkpoint
+        #: worker, a shutdown flush) would hold the journal lock while
+        #: on_seal reaches for the store — the exact ABBA inversion of
+        #: a committer holding the store lock while appending. None
+        #: (replication off) = zero overhead.
+        self.seal_guard = None
+        self._pending_ship: list = []
+        self._pending_bytes = 0
         os.makedirs(dirpath, exist_ok=True)
+        # sweep checkpoint tmp orphans: a crash between write_snapshot's
+        # tmp+fsync and the rename leaves ``*.tmp`` behind, and recovery
+        # deliberately ignores tmp files — without this sweep the orphan
+        # accumulates forever (and a half-written one could be confused
+        # for a real generation by out-of-tree tooling)
+        for name in os.listdir(dirpath):
+            if name.endswith(".tmp"):
+                try:
+                    os.unlink(os.path.join(dirpath, name))
+                except OSError:
+                    pass
         self._f = None
         self._since_fsync = 0
         self._since_snapshot = 0
@@ -266,9 +310,17 @@ class Journal:
                 self._f.flush()
         return self._f
 
+    def _guard(self):
+        """The seal-order guard (store lock before journal lock) when
+        shipping is on; free otherwise. Committing threads already hold
+        the store lock — an RLock, so re-acquiring is order-keeping,
+        not blocking."""
+        g = self.seal_guard
+        return g if g is not None else contextlib.nullcontext()
+
     def _append(self, rec: dict) -> None:
         line = json.dumps(rec, separators=(",", ":")) + "\n"
-        with self._lock:
+        with self._guard(), self._lock:
             f = self._wal_file()
             f.write(line)
             # flush every record: write(2)-level durability (survives a
@@ -276,6 +328,9 @@ class Journal:
             f.flush()
             self.appends += 1
             self._since_fsync += 1
+            if self.on_seal is not None:
+                self._pending_ship.append(rec)
+                self._pending_bytes += len(line)
             if self._since_fsync >= self.fsync_every:
                 self._fsync()
         if self.metrics is not None:
@@ -293,6 +348,16 @@ class Journal:
             self.metrics.journal_fsync.observe(
                 max(self._timer() - t0, 0.0))
         self._since_fsync = 0
+        if self.on_seal is not None and self._pending_ship:
+            # the batch this fsync just made durable IS the replication
+            # shipping unit (docs/replication.md): hand it over before
+            # anything else can append. Still under the journal lock, so
+            # batches ship in seal order; followers must never write
+            # back through this journal (documented, and they don't —
+            # they apply into their own stores).
+            batch, nbytes = self._pending_ship, self._pending_bytes
+            self._pending_ship, self._pending_bytes = [], 0
+            self.on_seal(batch, nbytes)
 
     def append_commit(self, key: tuple, obj: dict, rv: int) -> None:
         self._append({"t": "c", "rv": rv,
@@ -338,7 +403,10 @@ class Journal:
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, final)
-        with self._lock:
+        # seal_guard before the journal lock (lock-order contract): the
+        # async checkpoint worker reaches this without the store lock,
+        # and the rotation's _fsync may ship
+        with self._guard(), self._lock:
             # 2. seal the current WAL and open the post-rv generation
             if self._f is not None:
                 self._fsync()
@@ -367,14 +435,36 @@ class Journal:
             self.snapshots_written += 1
         if self.metrics is not None:
             self.metrics.snapshot_writes.inc()
+        if self.on_snapshot is not None:
+            self.on_snapshot(rv)
+
+    def reopen(self) -> None:
+        """Position the journal to append — sealing any torn tail a
+        crashed writer left — WITHOUT running recovery. The promotion
+        path (docs/replication.md): the new leader's store is already
+        caught up from shipped batches plus the tail replay, so only the
+        file positioning half of single-process recovery is needed."""
+        with self._lock:
+            self._wal_file()
+
+    def successor(self) -> "Journal":
+        """A fresh journal over the same directory with the same knobs —
+        what a promoted follower opens to inherit the dead leader's WAL
+        (docs/replication.md). The dead instance's handle is abandoned
+        un-closed, exactly as a SIGKILL leaves it; the successor's first
+        append (or an explicit :meth:`reopen`) seals any torn tail."""
+        return Journal(self.dir, snapshot_every=self.snapshot_every,
+                       fsync_every=self.fsync_every, metrics=self.metrics,
+                       timer=self._timer, fsync_hook=self.fsync_hook,
+                       clock=self._clock, retain_all=self.retain_all)
 
     def flush(self) -> None:
         """Force the fsync boundary (shutdown path)."""
-        with self._lock:
+        with self._guard(), self._lock:
             self._fsync()
 
     def close(self) -> None:
-        with self._lock:
+        with self._guard(), self._lock:
             if self._f is not None:
                 self._fsync()
                 self._f.close()

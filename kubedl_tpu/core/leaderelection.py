@@ -100,24 +100,12 @@ class LeaderElector:
                 self.is_leader = True
                 return True
 
-            # client-go semantics: measure expiry purely on OUR clock from
-            # the last time the lease record changed — never against the
-            # holder's renewTime (a skewed holder clock would read as
-            # permanently expired and split-brain the operators)
-            record = (holder, spec.get("renewTime"), spec.get("acquireTime"))
-            if record != self._observed_record:
-                self._observed_record = record
-                self._observed_at = now
-            expired = (now - self._observed_at) > duration
-            if holder and not expired:
+            if holder and not self._record_stale(spec, now, duration):
                 self.is_leader = False
                 return False
 
             # stale holder: take over
-            prev_transitions = int(spec.get("leaseTransitions") or 0)
-            spec.update(self._spec(now))
-            spec["leaseTransitions"] = prev_transitions + 1
-            self.api.update(lease)
+            self._takeover_write(lease, now)
             log.info("%s took over lease %s/%s from %r",
                      c.identity, c.namespace, c.name, holder)
             self.is_leader = True
@@ -132,6 +120,87 @@ class LeaderElector:
             # no lease while a successor takes over — permanent dual-leader)
             log.warning("election round failed: %s", e)
             return False
+
+    # -- shared expiry / takeover mechanics --------------------------------
+
+    def _record_stale(self, spec: dict, now: float,
+                      duration: Optional[float] = None) -> bool:
+        """Client-go expiry semantics, shared by the acquisition and
+        standby paths: measure staleness purely on OUR clock from the
+        last time the lease record changed — never against the holder's
+        renewTime (a skewed holder clock would read as permanently
+        expired and split-brain the operators)."""
+        if duration is None:
+            duration = float(spec.get("leaseDurationSeconds")
+                             or self.config.lease_duration)
+        record = (spec.get("holderIdentity", ""), spec.get("renewTime"),
+                  spec.get("acquireTime"))
+        if record != self._observed_record:
+            self._observed_record = record
+            self._observed_at = now
+        return (now - self._observed_at) > duration
+
+    def _takeover_write(self, lease: dict, now: float) -> None:
+        """Rewrite an existing Lease with this candidate as holder,
+        bumping leaseTransitions — the one takeover write, shared by
+        the stale-holder path and the promotion path."""
+        spec = lease.setdefault("spec", {})
+        prev = int(spec.get("leaseTransitions") or 0)
+        spec.update(self._spec(now))
+        spec["leaseTransitions"] = prev + 1
+        self.api.update(lease)
+
+    # -- standby-side protocol (docs/replication.md) ----------------------
+
+    def lease_expired(self) -> bool:
+        """Whether the observed lease record has gone stale on THIS
+        candidate's clock (the same client-go expiry semantics
+        :meth:`try_acquire_or_renew` uses), WITHOUT attempting the
+        acquisition write. A warm standby calls this on its renew
+        cadence so its observation clock tracks the holder's renewals
+        as they arrive — promotion then completes within one lease term
+        of the holder's death instead of one term after the standby
+        first looks. True when the record is absent, held by this
+        candidate, or unrenewed for longer than its lease duration;
+        False while a live holder keeps renewing (or the api is
+        unreachable — an unreachable store proves nothing expired)."""
+        c = self.config
+        now = self._clock()
+        try:
+            lease = self.api.try_get("Lease", c.namespace, c.name)
+        except ApiError:
+            return False
+        if lease is None:
+            return True
+        spec = lease.get("spec") or {}
+        holder = spec.get("holderIdentity", "")
+        if not holder or holder == c.identity:
+            return True
+        return self._record_stale(spec, now)
+
+    def observe(self) -> None:
+        """Refresh the expiry observation without acting on it — the
+        follower half of the replication group's election step."""
+        self.lease_expired()
+
+    def take_over(self) -> None:
+        """Unconditionally write this candidate as the holder — the
+        promotion path's final step, run only AFTER expiry was
+        established via :meth:`lease_expired` (possibly against another
+        replica of the same replicated Lease record). Split from the
+        wait so the takeover write can land on the store that will
+        serve the new leader's rv stream, ordered after the inherited
+        WAL tail replay."""
+        c = self.config
+        now = self._clock()
+        lease = self.api.try_get("Lease", c.namespace, c.name)
+        if lease is None:
+            self.api.create(self._new_lease(now))
+        else:
+            self._takeover_write(lease, now)
+        log.info("%s took over lease %s/%s (promotion)",
+                 c.identity, c.namespace, c.name)
+        self.is_leader = True
 
     def _new_lease(self, now: float) -> dict:
         c = self.config

@@ -453,6 +453,41 @@ class DurabilityMetrics:
              "torn_records", "objects", "rv"))
 
 
+class ReplicationMetrics:
+    """Replicated-control-plane families (docs/replication.md): how far
+    each follower's applied rv trails the leader, the shipping stream's
+    volume, promotion count, and the live stream epoch (the fencing
+    token — a bumped epoch means a failover happened). Constructed only
+    when replication is on (``--replication-followers`` > 0) — the
+    disabled operator's exposition carries none of these families (the
+    PR 5/7/8/10 byte-identical-disabled convention)."""
+
+    def __init__(self, registry: Optional[Registry] = None):
+        self.registry = registry or Registry()
+        r = self.registry
+        self.follower_lag = r.gauge(
+            "kubedl_replication_follower_lag_rv",
+            "Leader resourceVersion minus the follower's applied rv "
+            "(0 = fully caught up)", ("follower",))
+        self.shipped_batches = r.counter(
+            "kubedl_replication_shipped_batches_total",
+            "Sealed group-commit WAL batches shipped to followers")
+        self.shipped_bytes = r.counter(
+            "kubedl_replication_shipped_bytes_total",
+            "Serialized WAL bytes shipped to followers")
+        self.promotions = r.counter(
+            "kubedl_replication_promotions_total",
+            "Followers promoted to leader after a leader loss")
+        self.epoch = r.gauge(
+            "kubedl_replication_epoch",
+            "Current replication stream epoch (bumped on every "
+            "promotion; a follower rejects frames from older epochs)")
+        self.stale_frames = r.counter(
+            "kubedl_replication_stale_frames_total",
+            "Frames rejected for carrying a deposed leader's epoch "
+            "(the zombie fence)", ("follower",))
+
+
 class TraceMetrics:
     """Span-recorder health (docs/tracing.md): recorded-span throughput
     per component, ring-buffer occupancy, and the overflow-drop counter

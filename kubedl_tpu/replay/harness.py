@@ -142,7 +142,8 @@ class ClusterReplay:
     reads), all in simulated seconds."""
 
     def __init__(self, workload: Workload, shards: int = 1,
-                 campaign=None, journal_dir: Optional[str] = None):
+                 campaign=None, journal_dir: Optional[str] = None,
+                 replication_followers: int = 0):
         self.workload = workload
         profile = workload.profile
         seed = workload.seed
@@ -192,6 +193,29 @@ class ClusterReplay:
         else:
             self.inner = APIServer(clock=self.clock,
                                    uid_factory=uid_factory)
+        #: replicated control plane (docs/replication.md): N warm
+        #: follower stores fed by WAL shipping at the group-commit
+        #: fsync boundary, promotable by the leader_kill primitive.
+        #: 0 (every committed scorecard) = no replication object, no
+        #: shipping hooks, byte-identical timelines.
+        self.replication = None
+        self.replication_report: Optional[dict] = None
+        if replication_followers:
+            if self.journal is None:
+                raise ValueError("replication_followers requires "
+                                 "journal_dir (WAL shipping ships the "
+                                 "journal's sealed fsync batches)")
+            from ..core.replication import ReplicatedControlPlane
+            from ..metrics.registry import ReplicationMetrics
+            # lease cadence in sim seconds: coarse enough that renewals
+            # don't dominate the WAL, tight enough that promotion lands
+            # well inside the day
+            self.replication = ReplicatedControlPlane(
+                self.inner, self.journal,
+                followers=replication_followers, clock=self.clock,
+                metrics=ReplicationMetrics(self.registry),
+                lease_duration=60.0, retry_period=15.0,
+                identity="leader-0")
         self.chaos = ChaosAPIServer(self.inner, ChaosConfig(
             seed=seed,
             conflict_on_status_update=profile.chaos_conflict,
@@ -445,6 +469,35 @@ class ClusterReplay:
         self._chaos_preempted_jobs.add(name)
         return True
 
+    def kill_leader(self) -> dict:
+        """The ``leader_kill`` primitive (docs/replication.md): SIGKILL
+        the control-plane leader mid-day and promote the most-caught-up
+        WAL follower. The dead leader's journal is never closed — its
+        tail past the last group-commit fsync is only write(2)-flushed
+        — and the promoted follower inherits it, replaying the
+        acknowledged tail exactly like single-process recovery.
+
+        Process model: after promotion the replay keeps driving its
+        live store, having AUDITED (and recorded, for the e2e gate)
+        that the promoted follower's world is identical to it — every
+        acknowledged object at its exact rv, the rv counter resumed.
+        That identity is what lets the single in-process stack stand in
+        for "every client re-resolved to the new leader": continuing on
+        a bit-identical world is indistinguishable from switching
+        stores, and the real client-side resume (an informer moving to
+        the promoted store by rv bookmark with zero relists) is proven
+        separately in tests/test_replication.py and the
+        bench_controlplane replication leg."""
+        rcp = self.replication
+        if rcp is None:
+            raise RuntimeError(
+                "leader_kill fired but the replay has no replication "
+                "(pass replication_followers > 0 with journal_dir)")
+        report = rcp.kill_and_promote_audited(takeover_api=self.inner)
+        report.pop("follower")
+        self.replication_report = report
+        return self.replication_report
+
     def _on_preempt(self, ordinal: int) -> None:
         running = sorted(n for n, r in self._jobs.items()
                          if r.running and not r.succeeded)
@@ -565,7 +618,19 @@ class ClusterReplay:
             self._kubelet_round()
             self._integrate_util()
             self.slo.maybe_evaluate(self.clock())
+            if self.replication is not None:
+                # lease renewals + standby expiry observations on the
+                # retry cadence (sim time) — the watching that lets a
+                # promotion land within one lease term of a kill
+                self.replication.maybe_step_election(self.clock())
         self.slo.evaluate(self.clock())     # final windows + verdicts
+        if self.replication is not None:
+            # orderly end of day: seal the WAL tail so the shipping
+            # stream drains and the followers report their true lag.
+            # The group's journal, not self.journal — after a mid-day
+            # promotion the live journal is the successor the new
+            # leader opened over the same directory
+            self.replication.journal.flush()
         if hasattr(self.scheduler, "check_parity"):
             self.scheduler.check_parity()
         return self._result()
@@ -736,6 +801,11 @@ class ClusterReplay:
                 "spans_dropped": self.tracer.dropped,
             },
         }
+        if self.replication is not None:
+            out["replication"] = {
+                "status": self.replication.status(),
+                "report": self.replication_report,
+            }
         if self.campaign_runner is not None:
             out["campaign"] = self.campaign_runner.summary()
             out["forensics"] = self._forensics_block(

@@ -249,6 +249,43 @@ def test_durability_families_in_exposition(served):
             'rv="4108"} 1.0') in body
 
 
+def test_replication_families_in_exposition(served):
+    """Pin the replicated-control-plane families (docs/replication.md):
+    names, label sets, and gauge/counter types. These register only
+    when --replication-followers > 0 — their absence from a
+    replication-off operator's exposition is pinned in
+    tests/test_replication.py."""
+    from kubedl_tpu.metrics.registry import ReplicationMetrics
+    reg, port = served
+    rm = ReplicationMetrics(reg)
+    rm.follower_lag.set(12, follower="follower-0")
+    rm.follower_lag.set(0, follower="follower-1")
+    rm.shipped_batches.inc(7)
+    rm.shipped_bytes.inc(4096)
+    rm.promotions.inc()
+    rm.epoch.set(1)
+    rm.stale_frames.inc(follower="follower-1")
+    _, body, _ = scrape(port)
+    assert "# TYPE kubedl_replication_follower_lag_rv gauge" in body
+    assert ('kubedl_replication_follower_lag_rv{follower="follower-0"}'
+            ' 12.0') in body
+    assert ('kubedl_replication_follower_lag_rv{follower="follower-1"}'
+            ' 0.0') in body
+    assert ("# TYPE kubedl_replication_shipped_batches_total counter"
+            in body)
+    assert "kubedl_replication_shipped_batches_total 7.0" in body
+    assert ("# TYPE kubedl_replication_shipped_bytes_total counter"
+            in body)
+    assert "kubedl_replication_shipped_bytes_total 4096.0" in body
+    assert "# TYPE kubedl_replication_promotions_total counter" in body
+    assert "kubedl_replication_promotions_total 1.0" in body
+    assert "# TYPE kubedl_replication_epoch gauge" in body
+    assert "kubedl_replication_epoch 1.0" in body
+    assert "# TYPE kubedl_replication_stale_frames_total counter" in body
+    assert ('kubedl_replication_stale_frames_total{follower="follower-1"}'
+            ' 1.0') in body
+
+
 def test_label_value_escaping(served):
     reg, port = served
     g = reg.gauge("kubedl_esc", "escapes", ("name",))

@@ -1,0 +1,634 @@
+"""Replicated control plane (docs/replication.md).
+
+Five layers:
+
+* **shipping** — the sealed group-commit fsync batch is the shipping
+  unit (nothing ships before its fsync; ``flush()`` drains the tail),
+  followers serve reads and bookmark watches off their own stores;
+* **apply idempotence** — the table test: a duplicated frame, a frame
+  replayed across a follower restart, a torn frame later re-sent whole,
+  and a stale-epoch frame from a deposed leader all leave the follower
+  store byte-identical to a single clean apply;
+* **failover** — SIGKILL-model leader loss (journal never closed, tail
+  only write(2)-flushed) promotes the most-caught-up follower inside
+  one lease term, replays the inherited WAL tail, resumes the rv
+  counter, fences the zombie's epoch, and loses ZERO acknowledged
+  writes — with promotion latency measured in sim time, bit-for-bit
+  deterministic;
+* **checkpoint concurrency** — async snapshots never block commits or
+  shipping; the crashed-checkpoint ``*.tmp`` orphan is swept;
+* **gate-off** — no replication object, no shipping hooks, no
+  ``kubedl_replication_*`` families, 501 console endpoints; plus the
+  leader-kill adversarial campaign e2e holding the SLO-survival,
+  store-parity, and forensics gates through a mid-day failover.
+"""
+
+import copy
+import dataclasses
+import os
+import threading
+
+import pytest
+
+from kubedl_tpu.console import ConsoleConfig, ConsoleServer, DataProxy
+from kubedl_tpu.controllers.registry import OperatorConfig, build_operator
+from kubedl_tpu.core import meta as m
+from kubedl_tpu.core.apiserver import APIServer
+from kubedl_tpu.core.clock import SimClock
+from kubedl_tpu.core.journal import Journal
+from kubedl_tpu.core.replication import (FollowerStore,
+                                         ReplicatedControlPlane,
+                                         ShipFrame, read_epoch)
+from kubedl_tpu.metrics.registry import Registry, ReplicationMetrics
+
+pytestmark = pytest.mark.replication
+
+
+def cm(name, data=None, ns="default"):
+    obj = m.new_obj("v1", "ConfigMap", name, namespace=ns)
+    if data is not None:
+        obj["data"] = data
+    return obj
+
+
+def build_group(tmp_path, clock, followers=2, fsync_every=4,
+                snapshot_every=10**9, keep_frames=False, metrics=None):
+    journal = Journal(str(tmp_path), snapshot_every=snapshot_every,
+                      fsync_every=fsync_every, clock=clock, timer=clock)
+    api = APIServer(clock=clock, journal=journal, watch_ring=512)
+    rcp = ReplicatedControlPlane(api, journal, followers=followers,
+                                 clock=clock, metrics=metrics,
+                                 keep_frames=keep_frames)
+    return api, journal, rcp
+
+
+def world(api) -> dict:
+    """Canonical store content keyed by (kind, ns, name) -> object."""
+    return copy.deepcopy(api._objs)
+
+
+# ---------------------------------------------------------------------------
+# shipping: the group-commit fsync batch is the unit
+# ---------------------------------------------------------------------------
+
+
+def test_ship_unit_is_the_sealed_fsync_batch(tmp_path, clock):
+    api, journal, rcp = build_group(tmp_path, clock, followers=2,
+                                    fsync_every=4)
+    f0, f1 = rcp.followers
+    for i in range(4):                   # exactly one fsync group
+        api.create(cm(f"o-{i}"))
+    assert f0.applied_rv == f1.applied_rv == 4
+    api.create(cm("tail"))               # write(2)-flushed, NOT fsynced
+    assert f0.applied_rv == 4            # nothing ships before its fsync
+    journal.flush()                      # seals the tail
+    assert f0.applied_rv == f1.applied_rv == 5
+    assert f0.try_get("ConfigMap", "default", "tail") is not None
+    # deletes ride the stream with their allocated rv
+    api.delete("ConfigMap", "default", "o-0")
+    journal.flush()
+    assert f0.try_get("ConfigMap", "default", "o-0") is None
+    assert f0.applied_rv == api.latest_resource_version()
+
+
+def test_follower_serves_reads_and_bookmark_watches(tmp_path, clock):
+    api, journal, rcp = build_group(tmp_path, clock, followers=1)
+    f = rcp.followers[0]
+    for i in range(8):
+        api.create(cm(f"o-{i}", {"v": str(i)}))
+    journal.flush()
+    # reads off the follower's own store match the leader
+    assert [m.name(o) for o in f.list("ConfigMap")] \
+        == [m.name(o) for o in api.list("ConfigMap")]
+    assert f.get("ConfigMap", "default", "o-3")["data"] == {"v": "3"}
+    # bookmark watch off the follower's own ring
+    bookmark = 4
+    got = []
+    cancel, caught_up = f.watch_from(
+        lambda t, o: got.append((t, m.name(o))), bookmark,
+        kinds=("ConfigMap",))
+    assert got == [("ADDED", f"o-{i}") for i in range(4, 8)]
+    assert caught_up == f.latest_resource_version()
+    # live events flow after the replay
+    api.create(cm("live"))
+    journal.flush()
+    assert got[-1] == ("ADDED", "live")
+    cancel()
+
+
+def test_late_joining_follower_catches_up_via_snapshot(tmp_path, clock):
+    api, journal, rcp = build_group(tmp_path, clock, followers=1)
+    for i in range(6):
+        api.create(cm(f"o-{i}"))
+    journal.flush()
+    late = FollowerStore("late", clock=clock)
+    rcp.shipper.followers.append(late)
+    api.create(cm("new"))
+    journal.flush()                      # late sees a gap -> resync
+    assert late.gaps == 1 and late.snapshots_installed == 1
+    assert {m.name(o) for o in late.list("ConfigMap")} \
+        == {m.name(o) for o in api.list("ConfigMap")}
+    assert late.applied_rv == api.latest_resource_version()
+
+
+# ---------------------------------------------------------------------------
+# THE apply-idempotence table (satellite)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def shipped_world(tmp_path, clock):
+    """A scripted write mix (creates, update, delete, recreate) shipped
+    to one follower, with every frame retained: (frames, baseline
+    objects, baseline applied_rv)."""
+    api, journal, rcp = build_group(tmp_path, clock, followers=1,
+                                    keep_frames=True)
+    f = rcp.followers[0]
+    api.create(cm("a", {"v": "1"}))
+    api.create(cm("b"))
+    aa = api.get("ConfigMap", "default", "a")
+    aa["data"] = {"v": "2"}
+    api.update(aa)
+    api.delete("ConfigMap", "default", "b")
+    api.create(cm("b", {"reborn": "yes"}))   # recreate above the tombstone
+    api.create(cm("c"))
+    journal.flush()
+    frames = list(rcp.shipper.shipped)
+    assert frames and all(fr.kind == "wal" for fr in frames)
+    return frames, world(f.api), f.applied_rv
+
+
+def _fresh_apply(frames):
+    f = FollowerStore("fresh", clock=lambda: 0.0)
+    for fr in frames:
+        f.apply(fr)
+    return f
+
+
+def test_duplicated_frames_are_idempotent(shipped_world):
+    frames, baseline, rv = shipped_world
+    f = _fresh_apply(frames)
+    before = world(f.api)
+    for fr in frames:                    # the whole stream again
+        f.apply(fr)
+    assert world(f.api) == before == baseline
+    assert f.applied_rv == rv
+    assert f.records_skipped >= len(frames)  # every dup was levelled out
+
+
+def test_replay_across_follower_restart_is_byte_identical(shipped_world):
+    frames, baseline, rv = shipped_world
+    f = _fresh_apply(frames)             # "restart": empty store, replay
+    assert world(f.api) == baseline
+    assert f.applied_rv == rv
+    assert f.latest_resource_version() == rv
+
+
+def test_torn_final_frame_then_full_resend_is_byte_identical(
+        shipped_world):
+    frames, baseline, rv = shipped_world
+    f = _fresh_apply(frames[:-1])
+    last = frames[-1]
+    assert len(last.records) >= 1
+    torn = dataclasses.replace(last, records=last.records[:1])
+    f.apply(torn)                        # truncated in transit
+    assert f.applied_rv == int(torn.records[-1]["rv"])  # not to_rv
+    f.apply(last)                        # the leader re-sends it whole
+    assert world(f.api) == baseline
+    assert f.applied_rv == rv
+
+
+def test_stale_epoch_frames_from_deposed_leader_are_fenced(
+        shipped_world):
+    frames, baseline, rv = shipped_world
+    f = _fresh_apply(frames)
+    f.apply(ShipFrame(epoch=1, from_rv=rv, to_rv=rv, kind="epoch"))
+    assert f.epoch == 1
+    rejected_before = f.frames_rejected_stale
+    for fr in frames:                    # the zombie's late deliveries
+        assert f.apply(fr) is False
+    assert f.frames_rejected_stale == rejected_before + len(frames)
+    assert world(f.api) == baseline      # byte-identical: nothing moved
+    assert f.applied_rv == rv
+
+
+def test_gap_sets_needs_resync_instead_of_skipping_history(clock):
+    f = FollowerStore("f", clock=clock)
+    rec = {"t": "c", "rv": 9, "k": ["ConfigMap", "default", "x"],
+           "o": cm("x")}
+    assert f.apply(ShipFrame(epoch=0, from_rv=8, to_rv=9,
+                             records=(rec,))) is False
+    assert f.needs_resync and f.gaps == 1
+    assert f.try_get("ConfigMap", "default", "x") is None
+
+
+# ---------------------------------------------------------------------------
+# failover: SIGKILL leader -> promotion
+# ---------------------------------------------------------------------------
+
+
+def _scripted_failover(tmp_path, clock):
+    """The scripted kill: follower-1 detached (lagging) for the last
+    writes, an unflushed WAL tail, then SIGKILL + promotion."""
+    rm = ReplicationMetrics(Registry())
+    api, journal, rcp = build_group(tmp_path, clock, followers=2,
+                                    fsync_every=4, metrics=rm)
+    rcp.step_election()
+    for i in range(8):
+        api.create(cm(f"o-{i}"))
+        clock.advance(1.0)
+        rcp.maybe_step_election(clock())
+    f0, f1 = rcp.followers
+    rcp.shipper.followers.remove(f1)     # f1's link goes down: it lags
+    api.create(cm("late-1"))
+    api.create(cm("late-2"))
+    journal.flush()
+    api.create(cm("tail"))               # acknowledged, never fsynced
+    pre_rv = api.latest_resource_version()
+    pre = {k: m.resource_version(o) for k, o in api._objs.items()
+           if k[0] != "Lease"}
+    assert f0.applied_rv > f1.applied_rv
+    rcp.kill_leader()
+    promo = rcp.promote()
+    return rm, rcp, promo, pre, pre_rv
+
+
+def test_sigkill_promotes_most_caught_up_and_loses_nothing(tmp_path,
+                                                           clock):
+    rm, rcp, promo, pre, pre_rv = _scripted_failover(tmp_path, clock)
+    winner = promo.pop("follower")
+    assert promo["promotedFrom"] == "follower-0"   # the caught-up one
+    # zero acknowledged-write loss: every pre-kill object at its exact
+    # rv, including the write(2)-only tail the inherited WAL replayed
+    got = {k: m.resource_version(o) for k, o in winner.api._objs.items()
+           if k[0] != "Lease"}
+    assert got == pre
+    assert promo["tailRecordsReplayed"] >= 1
+    assert winner.api.latest_resource_version() >= pre_rv  # rv resumed
+    # promotion inside one lease term (sim time), epoch bumped+persisted
+    assert promo["promotionSeconds"] <= \
+        rcp.lease_duration + rcp.retry_period
+    assert rcp.epoch == 1 == read_epoch(rcp.journal.dir)
+    assert rm.promotions.value() == 1
+    assert rm.epoch.value() == 1
+
+
+def test_post_promotion_stream_fences_the_zombie(tmp_path, clock):
+    _rm, rcp, promo, _pre, _pre_rv = _scripted_failover(tmp_path, clock)
+    promo.pop("follower")
+    # the new leader ships at the bumped epoch; the survivor (which was
+    # LAGGING at promotion) resyncs and follows the new stream
+    [survivor] = rcp.followers
+    rcp.api.create(cm("post-promo"))
+    rcp.journal.flush()
+    assert survivor.epoch == rcp.epoch == 1
+    assert survivor.try_get("ConfigMap", "default", "post-promo") \
+        is not None
+    assert survivor.applied_rv == rcp.api.latest_resource_version()
+    # a zombie ex-leader's late frame (old epoch) is rejected
+    zombie_rec = {"t": "c", "rv": 999,
+                  "k": ["ConfigMap", "default", "zombie"],
+                  "o": cm("zombie")}
+    assert survivor.apply(ShipFrame(
+        epoch=0, from_rv=0, to_rv=999, records=(zombie_rec,))) is False
+    assert survivor.try_get("ConfigMap", "default", "zombie") is None
+    assert survivor.frames_rejected_stale >= 1
+
+
+def test_promotion_latency_is_deterministic_sim_time(tmp_path):
+    a = _scripted_failover(tmp_path / "a", SimClock())[2]
+    b = _scripted_failover(tmp_path / "b", SimClock())[2]
+    a.pop("follower"), b.pop("follower")
+    assert a == b                        # bit-for-bit, incl. latency
+    assert a["promotionSeconds"] == a["leaseWaitSeconds"] \
+        + 0.0                            # the wait dominates; tail is sim-free
+
+
+def test_informer_resumes_onto_promoted_store_without_relist(tmp_path,
+                                                             clock):
+    from kubedl_tpu.client.informers import Informer
+    api, journal, rcp = build_group(tmp_path, clock, followers=2,
+                                    fsync_every=2)
+    rcp.step_election()
+    inf = Informer(rcp.followers[0].api, "ConfigMap")
+    for i in range(6):
+        api.create(cm(f"o-{i}"))
+    journal.flush()
+    inf.start()
+    api.create(cm("while-connected"))
+    api.create(cm("unflushed-tail"))
+    rcp.kill_leader()
+    inf.disconnect()                     # its serving replica went away
+    promo = rcp.promote()
+    promo.pop("follower")
+    inf.api = rcp.api                    # re-resolve to the new leader
+    inf.resume()
+    assert inf.bookmark_resumes == 1 and inf.full_relists == 0
+    # the gap (shipped + tail-replayed events) arrived via the ring
+    assert inf.lister().get("default", "unflushed-tail") is not None
+    assert {m.name(o) for o in inf.lister().list()} \
+        == {m.name(o) for o in rcp.api.list("ConfigMap")}
+
+
+def test_promotion_seeds_from_snapshot_past_wal_rotation(tmp_path,
+                                                         clock):
+    """A winner that lagged past a checkpoint rotation: the records it
+    missed live only in the snapshot (the WAL generations holding them
+    were pruned), so promote() must seed from the snapshot before the
+    tail replay — WAL-only replay would silently lose acknowledged
+    writes."""
+    api, journal, rcp = build_group(tmp_path, clock, followers=1,
+                                    fsync_every=2, snapshot_every=6)
+    f = rcp.followers[0]
+    for i in range(4):
+        api.create(cm(f"early-{i}"))
+    journal.flush()
+    rcp.shipper.followers.remove(f)      # link down: f lags from here
+    lag_rv = f.applied_rv
+    # two full checkpoint rotations prune the generation holding the
+    # records just past f's applied_rv
+    for i in range(14):
+        api.create(cm(f"mid-{i}"))
+        api._maybe_snapshot()
+    api.create(cm("tail"))               # write(2)-only tail
+    pre = {k: m.resource_version(o) for k, o in api._objs.items()
+           if k[0] != "Lease"}
+    assert journal.snapshots()           # rotation really happened
+    assert journal.snapshots()[-1][0] > lag_rv
+    rcp.kill_leader()
+    promo = rcp.promote()
+    winner = promo.pop("follower")
+    got = {k: m.resource_version(o) for k, o in winner.api._objs.items()
+           if k[0] != "Lease"}
+    assert got == pre                    # nothing acknowledged was lost
+    assert promo["snapshotSeededRv"] is not None
+    assert promo["snapshotSeededRv"] > lag_rv
+
+
+def test_concurrent_commits_and_async_checkpoints_never_deadlock(
+        tmp_path):
+    """The lock-order contract (Journal.seal_guard): committers hold
+    the store lock while appending; the async checkpoint worker fsyncs
+    (and therefore ships) without it. Both must take store -> journal
+    in that order or the group deadlocks under load."""
+    j = Journal(str(tmp_path), snapshot_every=25, fsync_every=4)
+    api = APIServer(clock=SimClock(), journal=j, watch_ring=256,
+                    async_snapshots=True)
+    rcp = ReplicatedControlPlane(api, j, followers=1, clock=SimClock())
+    errors = []
+
+    def writer(base):
+        try:
+            for i in range(120):
+                api.create(cm(f"w{base}-{i}", ns="default"))
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer, args=(t,))
+               for t in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30.0)
+    assert not any(t.is_alive() for t in threads), \
+        "writer wedged: seal/store lock inversion"
+    assert not errors
+    j.flush()
+    api.wait_for_checkpoints()
+    assert rcp.followers[0].applied_rv == api.latest_resource_version()
+    assert len(rcp.followers[0].api) == len(api)
+
+
+# ---------------------------------------------------------------------------
+# checkpoints: async serializer + tmp-orphan sweep (satellites)
+# ---------------------------------------------------------------------------
+
+
+def test_crashed_checkpoint_tmp_orphan_is_swept(tmp_path, clock,
+                                                monkeypatch):
+    j = Journal(str(tmp_path), snapshot_every=10**9)
+    api = APIServer(clock=clock, journal=j)
+    for i in range(4):
+        api.create(cm(f"o-{i}"))
+    # crash between the tmp write and the rename: os.replace never runs
+    real_replace = os.replace
+    monkeypatch.setattr(os, "replace",
+                        lambda *a: (_ for _ in ()).throw(OSError("died")))
+    with pytest.raises(OSError):
+        j.write_snapshot(*api.world_snapshot())
+    monkeypatch.setattr(os, "replace", real_replace)
+    assert any(n.endswith(".tmp") for n in os.listdir(tmp_path))
+
+    # restart: the orphan is swept at Journal.__init__ and recovery
+    # serves the exact world from the surviving (snapshot, WAL) pair
+    api2 = APIServer(clock=clock, journal=Journal(str(tmp_path)))
+    assert not any(n.endswith(".tmp") for n in os.listdir(tmp_path))
+    assert {m.name(o) for o in api2.list("ConfigMap")} \
+        == {f"o-{i}" for i in range(4)}
+    assert api2.latest_resource_version() == 4
+
+
+class _GatedJournal(Journal):
+    """write_snapshot blocks until released — the slow serializer."""
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.started = threading.Event()
+        self.release = threading.Event()
+
+    def write_snapshot(self, rv, snaps):
+        self.started.set()
+        assert self.release.wait(10.0), "test never released the gate"
+        super().write_snapshot(rv, snaps)
+
+
+def test_async_snapshots_block_neither_commits_nor_shipping(tmp_path,
+                                                            clock):
+    j = _GatedJournal(str(tmp_path), snapshot_every=5, fsync_every=2)
+    api = APIServer(clock=clock, journal=j, watch_ring=64,
+                    async_snapshots=True)
+    rcp = ReplicatedControlPlane(api, j, followers=1, clock=clock)
+    f = rcp.followers[0]
+    for i in range(5):                   # checkpoint becomes due
+        api.create(cm(f"o-{i}"))
+    assert j.started.wait(10.0)          # serializer is RUNNING (blocked)
+    # ... and neither commits nor shipping wait on it
+    api.create(cm("while-checkpointing"))
+    j.flush()
+    assert f.try_get("ConfigMap", "default", "while-checkpointing") \
+        is not None
+    j.release.set()
+    api.wait_for_checkpoints()
+    assert j.snapshots_written == 1
+    assert any(n.startswith("snap-") for n in os.listdir(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# gate-off contract + operator/console wiring
+# ---------------------------------------------------------------------------
+
+
+def test_disabled_gate_is_byte_identical_no_families_no_hooks(tmp_path):
+    # durability WITHOUT replication: no shipping hooks, no replication
+    # object, none of the kubedl_replication_* families
+    cfg = OperatorConfig(workloads=["PyTorchJob"], enable_durability=True,
+                         journal_dir=str(tmp_path / "j"))
+    op = build_operator(config=cfg)
+    assert op.replication is None
+    assert op.api._journal.on_seal is None
+    assert op.api._journal.on_snapshot is None
+    assert "kubedl_replication_" not in op.metrics_registry.expose()
+    # plain operator: nothing either
+    plain = build_operator(config=OperatorConfig(workloads=["PyTorchJob"]))
+    assert plain.replication is None
+    assert "kubedl_replication_" not in plain.metrics_registry.expose()
+
+
+def test_operator_wires_replication_and_followers_stay_warm(tmp_path):
+    cfg = OperatorConfig(workloads=["PyTorchJob"], enable_durability=True,
+                         journal_dir=str(tmp_path / "j"),
+                         replication_followers=2)
+    op = build_operator(config=cfg)
+    assert op.replication is not None and op.replication.role == "leader"
+    body = op.metrics_registry.expose()
+    assert "kubedl_replication_shipped_batches_total" in body
+    op.api.create(cm("warm"))
+    op.api._journal.flush()
+    for f in op.replication.followers:
+        assert f.try_get("ConfigMap", "default", "warm") is not None
+
+
+def test_replication_without_journal_dir_refuses(tmp_path):
+    with pytest.raises(ValueError):
+        build_operator(config=OperatorConfig(
+            workloads=["PyTorchJob"], enable_durability=True,
+            replication_followers=2))
+
+
+def test_cli_flags_fail_fast():
+    from kubedl_tpu.__main__ import parse_args
+    with pytest.raises(SystemExit):
+        parse_args(["--replication-followers", "2"])
+    with pytest.raises(SystemExit):
+        parse_args(["--replication-followers", "2",
+                    "--enable-durability"])   # still no --journal-dir
+    with pytest.raises(SystemExit):
+        parse_args(["--async-snapshots"])
+    args = parse_args(["--replication-followers", "2",
+                       "--enable-durability", "--journal-dir", "/tmp/j",
+                       "--async-snapshots"])
+    assert args.replication_followers == 2 and args.async_snapshots
+
+
+def test_console_replication_status_on_and_off(tmp_path, clock):
+    api = APIServer(clock=clock)
+    server = ConsoleServer(DataProxy(api), ConsoleConfig(port=0, users={}))
+    try:
+        status, payload, _ = server.route(
+            "GET", "/api/v1/replication/status", {}, b"", None)
+        assert status == 501 and "replication" in payload["msg"]
+    finally:
+        server._httpd.server_close()
+
+    japi, journal, rcp = build_group(tmp_path, clock, followers=2)
+    japi.create(cm("x"))
+    journal.flush()
+    rcp.kill_leader()
+    promo = rcp.promote()
+    promo.pop("follower")
+    server = ConsoleServer(DataProxy(rcp.api, replication=rcp),
+                           ConsoleConfig(port=0, users={}))
+    try:
+        status, payload, _ = server.route(
+            "GET", "/api/v1/replication/status", {}, b"", None)
+        assert status == 200
+        d = payload["data"]
+        assert d["role"] == "leader" and d["epoch"] == 1
+        assert d["promotions"] == 1
+        # recoveredFrom-style provenance after the promotion
+        lp = d["lastPromotion"]
+        assert lp["promotedFrom"] == d["leader"]
+        assert "tailRecordsReplayed" in lp and "leaseWaitSeconds" in lp
+        assert len(d["followers"]) == 1
+        assert "lagRv" in d["followers"][0]
+    finally:
+        server._httpd.server_close()
+
+
+# ---------------------------------------------------------------------------
+# THE leader-kill adversarial campaign e2e
+# ---------------------------------------------------------------------------
+
+
+def _lk_profile():
+    from kubedl_tpu.replay.workload import PROFILES
+    return dataclasses.replace(
+        PROFILES["adversarial"], jobs=70, sim_seconds=4 * 3600.0,
+        sample_traces=10, trace_capacity=32768, chaos_max_faults=50)
+
+
+def _lk_run(seed, tmp_path, tag):
+    from kubedl_tpu.chaos.campaign import build_campaign
+    from kubedl_tpu.replay import ClusterReplay
+    from kubedl_tpu.replay.workload import generate
+    wl = generate(_lk_profile(), seed)
+    camp = build_campaign("leader-kill", seed, wl.profile)
+    replay = ClusterReplay(wl, shards=2, campaign=camp,
+                           journal_dir=str(tmp_path / f"lk-{tag}"),
+                           replication_followers=2)
+    return replay, replay.run()
+
+
+@pytest.fixture(scope="module")
+def leader_kill_e2e(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("leader-kill")
+    from kubedl_tpu.replay import ClusterReplay
+    from kubedl_tpu.replay.workload import generate
+    replay, res = _lk_run(0, tmp, "a")
+    _replay2, res2 = _lk_run(0, tmp, "b")
+    ref = ClusterReplay(generate(_lk_profile(), 0))
+    ref_res = ref.run()
+    return replay, res, res2, ref, ref_res
+
+
+@pytest.mark.campaign
+def test_leader_kill_campaign_fails_over_and_completes(leader_kill_e2e):
+    replay, res, _res2, _ref, _ref_res = leader_kill_e2e
+    assert res["jobs_completed"] == res["jobs_submitted"]
+    assert replay.campaign_runner.executed["leader_kill"] == 1
+    rep = res["replication"]["report"]
+    # zero acknowledged-write loss across the mid-day failover, rv
+    # stream resumed, promotion inside one lease term
+    assert rep["ackObjectsLost"] == 0 and rep["extraObjects"] == 0
+    assert rep["rvResumed"] is True
+    assert rep["ackObjectsAtKill"] > 0
+    assert rep["promotionSeconds"] <= 60.0 + 15.0
+    st = res["replication"]["status"]
+    assert st["promotions"] == 1 and st["epoch"] == 1
+    assert st["role"] == "leader"
+    # the surviving follower ends the day fully caught up
+    assert all(f["lagRv"] == 0 for f in st["followers"])
+
+
+@pytest.mark.campaign
+def test_leader_kill_campaign_keeps_slo_survival_and_parity(
+        leader_kill_e2e):
+    from kubedl_tpu.chaos.campaign import control_plane_digest
+    replay, res, res2, ref, _ref_res = leader_kill_e2e
+    # SLO survival through the failover: budgets burn but never
+    # exhaust, nothing stranded (the PR 11 campaign bar, gates intact)
+    sh = res["slo_health"]
+    assert sh["stranded_alerts"] == 0 and sh["stranded_conditions"] == 0
+    assert sh["min_budget_remaining"] >= 0.0
+    # forensics bar: every fired page causally explained
+    assert res["forensics"]["summary"]["pages_unlinked"] == 0
+    assert res["forensics"]["summary"]["unresolved_incidents"] == 0
+    # store parity with the fault-free reference world (the Lease is
+    # replication coordination state the reference never creates)
+    dig = control_plane_digest(replay.inner,
+                               exclude_kinds=("Event", "Lease"))
+    ref_dig = control_plane_digest(ref.inner,
+                                   exclude_kinds=("Event", "Lease"))
+    assert dig == ref_dig
+    # bit-for-bit per seed, failover included
+    assert res == res2
