@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test test-all test-fast test-chaos test-campaign test-scheduler test-trace test-replay test-telemetry test-slo test-durability test-forensics test-replication bench bench-controlplane bench-scheduler bench-serving-paged bench-trace bench-cluster bench-cluster-adversarial postmortem dryrun crds run-standalone lint native
+.PHONY: test test-all test-fast test-chaos test-campaign test-scheduler test-trace test-replay test-telemetry test-slo test-durability test-forensics test-replication test-elastic bench bench-controlplane bench-scheduler bench-serving-paged bench-trace bench-cluster bench-cluster-adversarial bench-elastic postmortem dryrun crds run-standalone lint native
 
 # fast path (<3 min): everything except the compile-heavy compute suites
 # (those carry `pytestmark = pytest.mark.slow`). Chaos tests are fast and
@@ -113,6 +113,26 @@ test-forensics:
 # docs/replication.md)
 test-replication:
 	$(PY) -m pytest tests/ -q -m replication
+
+# concurrency-elastic training suite (min..max gang admission,
+# shrink-in-place, restart-free reconfiguration via the 2-phase
+# checkpoint protocol, checkpoint-tier upload contract, the
+# shrink-vs-evict e2e; docs/elastic.md)
+test-elastic:
+	$(PY) -m pytest tests/ -q -m elastic
+
+# concurrency-elastic shrink/regrow bench -> BENCH_ELASTIC.json
+# (docs/elastic.md): the spot-shrink control-plane comparison (elastic
+# shrink-in-place vs whole-gang eviction, 2 seeds) plus a real sharded
+# trainer shrinking 8 -> 4 -> 8 devices through async multi-tier
+# checkpoints with loss-curve continuity. Gates: zero restart rounds
+# and zero Running-exits on the elastic leg, goodput strictly better
+# and median recovery a fraction of the full-restart baseline's, async
+# saves blocking < 1 step each; FAILS on regression vs the committed
+# artifact. The tier-1 guard is tests/test_elastic_slices.py.
+bench-elastic:
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	    $(PY) bench_elastic.py
 
 # render the committed adversarial campaign's forensics blocks as
 # markdown postmortems (docs/forensics.md; regenerate the blocks with

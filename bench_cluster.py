@@ -175,6 +175,10 @@ def main() -> dict:
     ap.add_argument("--skip-serving", action="store_true",
                     help="job day only (debugging aid; gates involving "
                          "serving will fail)")
+    ap.add_argument("--skip-elastic", action="store_true",
+                    help="skip the concurrency-elastic shrink-vs-evict "
+                         "leg (debugging aid; the day profile's "
+                         "jobs.elastic gates will fail)")
     args = ap.parse_args()
     args.out_explicit = args.out is not None
     if args.out is None:
@@ -215,6 +219,20 @@ def main() -> dict:
         print(f"serving day replayed in {time.perf_counter() - t1:.1f}s "
               f"wall ({serving['engine_ticks']} ticks, "
               f"{serving['tokens_generated']} tokens)", file=sys.stderr)
+
+    if args.profile == "day" and not args.skip_elastic:
+        # the concurrency-elastic leg (docs/elastic.md): shrink-vs-evict
+        # through a spot-shrink window, committed as the additive
+        # jobs.elastic block — the day leg above is untouched, so every
+        # prior metric stays byte-identical
+        from kubedl_tpu.replay import run_elastic_comparison
+        t2 = time.perf_counter()
+        cluster["elastic"] = run_elastic_comparison(args.seed)
+        eb = cluster["elastic"]
+        print(f"elastic leg replayed in {time.perf_counter() - t2:.1f}s "
+              f"wall (goodput gain "
+              f"{eb['gains']['goodput_gain']}, recovery p50 ratio "
+              f"{eb['gains']['recovery_p50_ratio']})", file=sys.stderr)
 
     scorecard = build_scorecard(workload, cluster, serving)
     scorecard["gates"] = evaluate_gates(scorecard)

@@ -50,6 +50,15 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "multi-window burn-rate alerting, console "
                         "/api/v1/slo endpoints (docs/slo.md; also "
                         "SLOEngine gate; implies telemetry + tracing)")
+    p.add_argument("--enable-elastic-slices", action="store_true",
+                   help="concurrency-elastic training: gangs advertise "
+                        "min..max slices, spot dryness shrinks jobs in "
+                        "place instead of evicting whole gangs, "
+                        "returning capacity regrows them, restart-free "
+                        "trainer reconfiguration via the 2-phase "
+                        "checkpoint protocol (docs/elastic.md; also "
+                        "TPUElasticSlices gate; requires "
+                        "--enable-slice-scheduler)")
     p.add_argument("--slice-capacity", default="",
                    help='static slice inventory "POOL=N,..." (e.g. '
                         '"tpu-v5p-slice/2x2x4=4") when the control plane '
@@ -156,6 +165,13 @@ def parse_args(argv=None) -> argparse.Namespace:
                 "group-commit fsync batch is the shipping unit)")
     if args.async_snapshots and not args.enable_durability:
         p.error("--async-snapshots requires --enable-durability")
+    # the shrink/regrow authority is a scheduling pass: elastic slices
+    # without the slice scheduler would silently never shrink or regrow
+    # anything — fail at the parser instead (docs/elastic.md)
+    if args.enable_elastic_slices and not args.enable_slice_scheduler:
+        p.error("--enable-elastic-slices requires "
+                "--enable-slice-scheduler (min..max gang admission and "
+                "shrink-in-place are scheduling-pass decisions)")
     return args
 
 
@@ -194,6 +210,7 @@ def config_from_args(args: argparse.Namespace) -> OperatorConfig:
         reconcile_shards=args.reconcile_shards,
         replication_followers=args.replication_followers,
         async_snapshots=args.async_snapshots,
+        enable_elastic_slices=args.enable_elastic_slices,
     )
 
 
@@ -270,7 +287,8 @@ def main(argv=None) -> int:
                           scheduler=operator.scheduler,
                           telemetry=operator.telemetry,
                           journal=operator.journal,
-                          replication=operator.replication)
+                          replication=operator.replication,
+                          elastic=operator.elastic_enabled)
         console = ConsoleServer(
             proxy, ConsoleConfig(host=args.console_host,
                                  port=args.console_port))

@@ -76,6 +76,30 @@ ANNOTATION_SCHED_PROFILE = KUBEDL_PREFIX + "/scheduler-profile"
 #: PodGroups (for the scheduler) and into pods via $KUBEDL_TRACEPARENT
 ANNOTATION_TRACEPARENT = KUBEDL_PREFIX + "/traceparent"
 
+# concurrency-elastic gangs (docs/elastic.md "Elastic slices"): the gang
+# advertises a min..max slice range instead of one fixed count. Stamped
+# on PodGroups only when the job declares schedulingPolicy.minSlices, so
+# the PodGroup shape of non-elastic jobs is byte-identical with the
+# TPUElasticSlices gate off.
+ANNOTATION_SCHED_MIN_SLICES = KUBEDL_PREFIX + "/scheduler-min-slices"
+ANNOTATION_SCHED_MAX_SLICES = KUBEDL_PREFIX + "/scheduler-max-slices"
+#: the engine's record of the slice ids the job is CURRENTLY running on
+#: (comma-joined, e.g. "0,1,3"); a divergence between this record and
+#: the admitted PodGroup set is what triggers a restart-free
+#: reconfiguration through the 2-phase checkpoint protocol
+ANNOTATION_ELASTIC_SLICES = KUBEDL_PREFIX + "/elastic-slices"
+#: when the in-flight reconfiguration's checkpoint was requested — the
+#: start of the reconfiguration window the MTTR accounting and the
+#: ``elastic.reconfigure`` trace span measure
+ANNOTATION_ELASTIC_RECONFIGURE_AT = \
+    KUBEDL_PREFIX + "/elastic-reconfigure-at"
+#: the checkpoint version gating the IN-FLIGHT reconfiguration ("0" =
+#: none). Without it, "ack landed" and "no request in flight" are
+#: indistinguishable once requested == completed, and the controller
+#: would re-request forever instead of executing the resize.
+ANNOTATION_ELASTIC_CKPT_VERSION = \
+    KUBEDL_PREFIX + "/elastic-ckpt-version"
+
 #: PodGroup conditions the slice scheduler owns: ``Admitted`` gates the job
 #: controllers' pod creation; ``Preempted`` marks a gang whose eviction is
 #: in flight (so a scheduling pass never double-preempts it)
@@ -244,6 +268,14 @@ class SchedulingPolicy:
     #: attribute and all serving stats persist under model keys); empty
     #: = the job kind, lowercased
     profile: str = ""
+    #: concurrency-elastic slice range (docs/elastic.md "Elastic
+    #: slices"): the job tolerates running on any slice count in
+    #: [minSlices, tpuPolicy.numSlices]. None (default) = fixed-width
+    #: gang, byte-identical pre-elastic semantics. maxSlices defaults to
+    #: the job's declared numSlices; it exists for forward-compat with
+    #: opportunistic growth beyond the declared shape.
+    min_slices: Optional[int] = None
+    max_slices: Optional[int] = None
 
     @classmethod
     def from_dict(cls, d: Optional[dict]):
@@ -256,6 +288,8 @@ class SchedulingPolicy:
             queue=d.get("queue", ""),
             pools=tuple(d.get("pools", []) or []),
             profile=str(d.get("profile", "") or ""),
+            min_slices=d.get("minSlices"),
+            max_slices=d.get("maxSlices"),
         )
 
     def to_dict(self) -> dict:
@@ -264,6 +298,8 @@ class SchedulingPolicy:
             "priority": self.priority,
             "priorityClassName": self.priority_class_name or None,
             "queue": self.queue or None,
+            "minSlices": self.min_slices,
+            "maxSlices": self.max_slices,
         })
 
 

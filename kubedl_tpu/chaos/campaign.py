@@ -199,6 +199,26 @@ def _scn_spot_dryness(rng, profile, spot_pools) -> list:
     ]
 
 
+def _scn_spot_shrink(rng, profile, spot_pools) -> list:
+    """Partial spot dryness (docs/elastic.md): the spot pool's capacity
+    halves for a window instead of vanishing. With the elastic gate on
+    the scheduler sheds surplus slices in place (shrink); with it off
+    every holder is swept (the full-restart baseline) — the SAME script
+    drives both legs of the shrink-vs-evict comparison."""
+    day = profile.sim_seconds
+    spots = _spot_pools(profile, spot_pools) or _pools(profile)
+    pool = spots[0]
+    at = rng.uniform(0.38, 0.46) * day
+    duration = rng.uniform(2000.0, 2600.0)
+    level = max(profile.capacity.get(pool, 2) // 2, 1)
+    return [
+        FaultAction(round(at, 3), "spot_dry_start",
+                    _params(pool=pool, level=level)),
+        FaultAction(round(at + duration, 3), "spot_dry_end",
+                    _params(pool=pool)),
+    ]
+
+
 def _scn_rolling_drain(rng, profile, spot_pools) -> list:
     day = profile.sim_seconds
     return _rolling_drain(rng.uniform(0.60, 0.70) * day, count=4,
@@ -261,6 +281,7 @@ def _scn_leader_kill(rng, profile, spot_pools) -> list:
 SCENARIOS = {
     "domain-outage": _scn_domain_outage,
     "spot-dryness": _scn_spot_dryness,
+    "spot-shrink": _scn_spot_shrink,
     "rolling-drain": _scn_rolling_drain,
     "watch-storm": _scn_watch_storm,
     "hot-loop": _scn_hot_loop,
@@ -359,9 +380,13 @@ class CampaignRunner:
 
     # -- correlated preemption primitives ---------------------------------
 
-    def _preempt_jobs(self, names, primitive: str) -> None:
+    def _preempt_jobs(self, names, primitive: str, fn=None) -> None:
+        """Preempt ``names`` via ``fn`` (default: the replay's one-pod
+        ``preempt_job``), recording each hit in the shared ledgers."""
         for name in names:
-            if self.replay.preempt_job(name):
+            hit = (fn(name) if fn is not None
+                   else self.replay.preempt_job(name))
+            if hit:
                 self.gang_preemptions.append((name, primitive))
                 self.preemption_log.append({
                     "t": self.replay.clock(), "job": name,
@@ -386,6 +411,10 @@ class CampaignRunner:
 
     def _do_spot_dry_start(self, action: FaultAction) -> None:
         pool = action.param("pool")
+        #: partial dryness (docs/elastic.md): ``level`` pins capacity at
+        #: a floor instead of zero. Absent (every committed scenario)
+        #: the classic total-dryness semantics apply bit for bit.
+        level = action.param("level")
         inv = self.replay.inventory
         # save the STATIC entry, not capacity_slices(): a pool with
         # Node-derived capacity has no static entry, and restoring
@@ -393,9 +422,27 @@ class CampaignRunner:
         # the node count as a permanent static override
         self._dry_base.setdefault(pool, []).append(
             inv.static_capacity.get(pool))
-        # capacity vanishes FIRST, then the sweep: evicted gangs must
+        # capacity vanishes FIRST, then the response: evicted gangs must
         # not be re-admitted into a pool that no longer exists
-        inv.set_static_capacity(pool, 0)
+        inv.set_static_capacity(pool, 0 if level is None else int(level))
+        if level is not None:
+            if getattr(self.replay, "elastic", False):
+                # the scheduler's shrink pass is the authority over an
+                # overcommitted pool (docs/elastic.md): elastic gangs
+                # shed surplus slices in place, only the remainder
+                # evicts whole — one nudged pass, no harness-side sweep
+                self.replay.scheduler.schedule_pass()
+                return
+            # baseline (gate off): partial dryness still reclaims WHOLE
+            # gangs — one pod per slice, so slice-atomic failover tears
+            # each gang down in a single round and it re-enters its
+            # queue complete (a lone pending slice would starve behind
+            # a fully-evicted head's reservation forever)
+            holders = sorted({h.job for h in inv.held_records()
+                              if h.pool == pool})
+            self._preempt_jobs(holders, "spot_dry",
+                               fn=self.replay.preempt_gang)
+            return
         holders = sorted({h.job for h in inv.held_records()
                           if h.pool == pool})
         self._preempt_jobs(holders, "spot_dry")

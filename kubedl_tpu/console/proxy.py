@@ -43,7 +43,8 @@ class DataProxy:
                  object_backend: Optional[ObjectBackend] = None,
                  event_backend: Optional[EventBackend] = None,
                  job_kinds=TRAINING_KINDS, tracer=None, scheduler=None,
-                 telemetry=None, journal=None, replication=None):
+                 telemetry=None, journal=None, replication=None,
+                 elastic: bool = False):
         self.api = api
         self.object_backend = object_backend
         self.event_backend = event_backend
@@ -63,6 +64,9 @@ class DataProxy:
         #: the ReplicatedControlPlane (docs/replication.md); None = the
         #: /api/v1/replication endpoints 501
         self.replication = replication
+        #: concurrency-elastic slices on (docs/elastic.md); False = the
+        #: /api/v1/elastic endpoints answer 501
+        self.elastic_enabled = bool(elastic)
 
     # -- jobs -------------------------------------------------------------
 
@@ -637,6 +641,55 @@ class DataProxy:
         how much inherited WAL tail was replayed, how long the lease
         wait took), the replication analog of ``recoveredFrom``."""
         return self.replication.status()
+
+    def job_elastic(self, namespace: str, name: str) -> Optional[dict]:
+        """The job's live elastic state (docs/elastic.md): the recorded
+        running slice set, per-slice gang states (active / leaving /
+        pending), the declared min..max range, and where the 2-phase
+        checkpoint protocol stands. None for unknown jobs."""
+        from ..scheduling.gang import is_gang_admitted, is_gang_preempted
+        job = None
+        for kind in self.job_kinds:
+            job = self.api.try_get(kind, namespace, name)
+            if job is not None:
+                break
+        if job is None:
+            return None
+        ann = m.get_annotations(job)
+        slices = []
+        mn = mx = 0
+        for pg in self.api.list("PodGroup", namespace,
+                                selector={c.LABEL_GANG_JOB_NAME: name}):
+            pg_ann = m.get_annotations(pg)
+            try:
+                mn = max(mn, int(pg_ann.get(
+                    c.ANNOTATION_SCHED_MIN_SLICES, "0") or 0))
+                mx = max(mx, int(pg_ann.get(
+                    c.ANNOTATION_SCHED_MAX_SLICES, "0") or 0))
+            except ValueError:
+                pass
+            state = "pending"
+            if is_gang_admitted(pg):
+                state = "leaving" if is_gang_preempted(pg) else "active"
+            slices.append({"podGroup": m.name(pg), "state": state,
+                           "pool": pg_ann.get(c.ANNOTATION_SCHED_POOL,
+                                              "")})
+        slices.sort(key=lambda s: s["podGroup"])
+        return {
+            "job": f"{namespace}/{name}",
+            "minSlices": mn or None,
+            "maxSlices": mx or None,
+            "runningSlices": ann.get(c.ANNOTATION_ELASTIC_SLICES),
+            "slices": slices,
+            "activeSlices": sum(1 for s in slices
+                                if s["state"] == "active"),
+            "checkpointRequestedVersion": int(ann.get(
+                c.ANNOTATION_CKPT_REQUESTED_VERSION, 0) or 0),
+            "checkpointCompletedVersion": int(ann.get(
+                c.ANNOTATION_CKPT_COMPLETED_VERSION, 0) or 0),
+            "reconfigureRequestedAt": ann.get(
+                c.ANNOTATION_ELASTIC_RECONFIGURE_AT),
+        }
 
     def explain_pending(self, namespace: str, name: str) -> Optional[dict]:
         """The pending-job explainer verdict (requires the scheduler);

@@ -484,6 +484,23 @@ class ConsoleServer:
                 raise NotFound(f"job {ns}/{name} not found")
             return ok(verdict)
 
+        # concurrency-elastic state (docs/elastic.md): per-slice gang
+        # states, the recorded running set, and the 2-phase checkpoint
+        # protocol position; 501 when elastic slices are off, matching
+        # the trace endpoints' convention
+        mt = re.fullmatch(r"/api/v1/elastic/([^/]+)/([^/]+)", path)
+        if mt:
+            if not self.proxy.elastic_enabled:
+                return 501, {"code": 501,
+                             "msg": "elastic slices disabled "
+                                    "(--enable-elastic-slices / "
+                                    "TPUElasticSlices gate)"}, []
+            ns, name = mt.groups()
+            state = self.proxy.job_elastic(ns, name)
+            if state is None:
+                raise NotFound(f"job {ns}/{name} not found")
+            return ok(state)
+
         # fleet goodput rollup (docs/telemetry.md): the live fleet-wide
         # number BENCH_CLUSTER gates on; 501 with the telemetry gate off
         if path == "/api/v1/telemetry/goodput":

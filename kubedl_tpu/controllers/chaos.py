@@ -304,6 +304,18 @@ class ChaosAPIServer:
     def update_status(self, obj):
         return self.update(obj, subresource="status")
 
+    def patch_merge(self, kind: str, namespace: str, name: str, patch):
+        """Scripted-fault seam for annotation patches (op ``patch``):
+        ``fail_next("patch", Conflict, ...)`` injects the 409 the
+        elastic 2-phase protocol's ack writes must survive
+        (docs/elastic.md). No probabilistic rate is configured for the
+        op, so an unscripted server draws NOTHING from the rng here —
+        committed scorecards are untouched by this override existing."""
+        return self._run("patch", kind, f"{namespace}/{name}", 0.0,
+                         Conflict,
+                         lambda: self.inner.patch_merge(kind, namespace,
+                                                        name, patch))
+
     # -- watch chaos ------------------------------------------------------
 
     def _watch_filter(self, fn, drop_ok):

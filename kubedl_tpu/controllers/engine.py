@@ -49,6 +49,7 @@ from ..utils import status as st
 from ..utils import train
 from ..utils.retry import RetryPolicy, restart_delay, retry_transient
 from . import hostnetwork as hn
+from .elastic import ANNOTATION_WORLD_SIZE
 from .expectations import Expectations
 from .interface import TPUPolicy, WorkloadController
 
@@ -95,6 +96,14 @@ class EngineConfig:
     #: queued jobs) a tight poll is a thundering herd, so the cluster
     #: replay widens it; 5s keeps the historical single-job snappiness
     gate_requeue_s: float = 5.0
+    #: concurrency-elastic slices (docs/elastic.md, TPUElasticSlices
+    #: gate): jobs declaring ``schedulingPolicy.minSlices`` run on any
+    #: admitted width in [min, numSlices]; scheduler shrink preemptions
+    #: of surplus slices become restart-free world reconfigurations
+    #: driven through the 2-phase checkpoint protocol instead of
+    #: whole-gang failover. False (default) = byte-identical pre-elastic
+    #: engine behavior.
+    elastic_slices: bool = False
 
 
 @dataclass
@@ -112,6 +121,44 @@ class _ReplicaPlan:
     num_slices: int = 1
     offsets: dict = field(default_factory=dict)
     global_dns: list = field(default_factory=list)  # hostname per global id
+
+
+@dataclass
+class _ElasticPlan:
+    """One reconcile's concurrency-elastic view of the gang
+    (docs/elastic.md): which slice ids are admitted-and-live
+    (``active``), which the scheduler marked for in-place shedding
+    (``leaving``), and the slice set the job is RECORDED as running on
+    (the ``kubedl.io/elastic-slices`` annotation; None before the first
+    world forms). ``active != recorded`` is what triggers a
+    reconfiguration. Built only when the active width is at or above the
+    gang's min — below the floor, pre-elastic whole-gang semantics
+    apply unchanged."""
+    min_slices: int
+    num_slices: int
+    active: tuple            # sorted admitted, non-preempted slice ids
+    leaving: tuple           # sorted admitted-but-preempted slice ids
+    recorded: Optional[tuple]
+
+    @property
+    def exempt(self) -> tuple:
+        """Slices the slice-atomic failover must NOT treat as disrupted:
+        everything outside the active set (leaving slices are being shed
+        in place; pending slices have no world to tear down)."""
+        act = set(self.active)
+        return tuple(s for s in range(self.num_slices) if s not in act)
+
+
+def _gang_slice_id(pg_name: str, job_name: str) -> Optional[int]:
+    """Slice id encoded in a multislice gang's PodGroup name
+    (``gang_name``: ``{job}-slice-{sid}``), or None for foreign names."""
+    prefix = job_name + "-slice-"
+    if pg_name.startswith(prefix):
+        try:
+            return int(pg_name[len(prefix):])
+        except ValueError:
+            return None
+    return 0 if pg_name == job_name else None
 
 
 @dataclass
@@ -133,7 +180,7 @@ class JobEngine(Reconciler):
                  metrics: Optional[JobMetrics] = None,
                  recorder: Optional[Recorder] = None,
                  gang: Optional[GangScheduler] = None,
-                 tracer=None, telemetry=None):
+                 tracer=None, telemetry=None, elastic_metrics=None):
         self.api = api
         self.controller = controller
         self.config = config or EngineConfig()
@@ -144,6 +191,9 @@ class JobEngine(Reconciler):
         #: job retirement + the straggler scan driver; None when the
         #: FleetTelemetry gate is off (every hook is one None check)
         self.telemetry = telemetry
+        #: kubedl_elastic_* families (docs/elastic.md); None when the
+        #: TPUElasticSlices gate is off
+        self.elastic_metrics = elastic_metrics
         #: span recorder (docs/tracing.md); the shared disabled tracer by
         #: default, so every trace call below is one attribute check
         self.tracer = tracer if tracer is not None else NOOP_TRACER
@@ -379,6 +429,19 @@ class JobEngine(Reconciler):
                 job, self._gang_min_members(replicas, plan),
                 run_policy.scheduling_policy, annotations=gang_ann))
 
+        # ---- concurrency-elastic context (docs/elastic.md) -------------
+        # built BEFORE failover: slices the scheduler is shedding in
+        # place (Preempted PodGroups with >= min survivors) must be
+        # exempt from the whole-gang disruption scan, or the shrink
+        # would degrade into exactly the full restart it exists to avoid
+        elastic = None
+        if (self.config.elastic_slices and plan.policy is not None
+                and plan.num_slices > 1
+                and self.config.gate_on_gang_admission
+                and self.config.enable_gang_scheduling
+                and self.gang is not None):
+            elastic = self._elastic_plan(job, run_policy, plan)
+
         # ---- slice-atomic failover (TPU jobs only) ---------------------
         # A gang-scheduled slice whose member was preempted/killed is a
         # dead world: the PJRT coordinator topology is fixed at startup,
@@ -386,7 +449,10 @@ class JobEngine(Reconciler):
         slice_wait, slice_frozen = None, ()
         if plan.policy is not None:
             dec = self._slice_failover(job, status, old_status, pods,
-                                       replicas, plan)
+                                       replicas, plan,
+                                       exempt=(elastic.exempt
+                                               if elastic is not None
+                                               else ()))
             if dec is not None:
                 if dec.action == "fail":
                     return self._fail_permanently(
@@ -418,8 +484,12 @@ class JobEngine(Reconciler):
         # the gate sees the recreated, un-admitted gang and parks the job
         if self.config.gate_on_gang_admission \
                 and self.config.enable_gang_scheduling and self.gang is not None:
-            waiting = [m.name(g) for g in self.gang.get_gangs(job)
-                       if not is_gang_admitted(g)]
+            # an elastic gang at or above its min width runs NOW on the
+            # admitted subset (docs/elastic.md); pending surplus slices
+            # regrow later instead of parking the whole job
+            waiting = [] if elastic is not None \
+                else [m.name(g) for g in self.gang.get_gangs(job)
+                      if not is_gang_admitted(g)]
             if waiting:
                 st.update_job_conditions(
                     status, c.JOB_QUEUING, st.REASON_JOB_QUEUING,
@@ -449,6 +519,16 @@ class JobEngine(Reconciler):
                         created_at=_parse_ts(
                             m.meta(job).get("creationTimestamp")))
 
+        # ---- restart-free world reconfiguration (docs/elastic.md) ------
+        # shrink: leaving slices tear down AFTER the checkpoint ack;
+        # grow: new slices' pods are created only after the ack, so the
+        # survivors reshard from a state the whole new world agrees on
+        reconf_requeue = None
+        elastic_allowed: Optional[set] = None
+        if elastic is not None:
+            reconf_requeue, elastic_allowed = self._elastic_reconfigure(
+                job, status, plan, elastic, pods)
+
         # ---- elastic scaling hook --------------------------------------
         # scale_out/scale_in may return a requeue delay while waiting to
         # confirm in-place restarts (the CRR-status analog)
@@ -470,6 +550,13 @@ class JobEngine(Reconciler):
         # a pending (backoff-gated) slice restart counts as restarting so
         # _update_job_status keeps the job Restarting instead of Failed
         restart = [slice_wait is not None]
+        if elastic_allowed is not None:
+            # pods exist only on the allowed slice set: the recorded
+            # world plus, once a reconfiguration completes, the grown one
+            slice_frozen = tuple(sorted(
+                set(slice_frozen)
+                | {s for s in range(plan.num_slices)
+                   if s not in elastic_allowed}))
         # hostnetwork: replica -> live port, re-learned every round so
         # service targetPorts track fail-overed pods (reference pod.go:337-340)
         hostnet_ports: Optional[dict] = \
@@ -538,19 +625,27 @@ class JobEngine(Reconciler):
                     f"all {total} gang pod(s) of {self.kind} {req.name} "
                     f"are running; rendezvous can complete")
         # restart-MTTR: first disruption of the outage (marked when
-        # _slice_failover stamps a restart round) -> every replica active
-        # again. Consecutive restart rounds extend one outage window.
+        # _slice_failover stamps a restart round, or when an elastic
+        # reconfiguration is requested) -> every replica of the CURRENT
+        # world active again. Consecutive restart rounds extend one
+        # outage window. For elastic jobs the expected count is the
+        # allowed width's pods, not the full declared shape.
         uid = m.uid(job)
-        if (total and uid in self._mttr_start
+        eff_total = total
+        if elastic_allowed is not None and plan.policy is not None:
+            eff_total = total - plan.slice_spec.num_hosts * (
+                plan.num_slices - len(elastic_allowed))
+        if (eff_total and uid in self._mttr_start
                 and sum(rs.active
-                        for rs in status.replica_statuses.values()) == total):
+                        for rs in status.replica_statuses.values())
+                == eff_total):
             self.metrics.restart_mttr.observe(
                 self.api.now() - self._mttr_start.pop(uid), kind=self.kind)
 
         self._trace_phase(job, status, pods, replicas)
         flushed = self._flush_status(job, status, old_status)
         requeues = [r for r in (deadline_requeue, tb_requeue, elastic_requeue,
-                                slice_wait)
+                                reconf_requeue, slice_wait)
                     if r and r > 0]
         if not flushed:
             requeues.append(1.0)  # status write kept failing: try again soon
@@ -1268,7 +1363,8 @@ class JobEngine(Reconciler):
         return members
 
     def _slice_failover(self, job, status: JobStatus, old_status: JobStatus,
-                        pods, replicas, plan: _ReplicaPlan
+                        pods, replicas, plan: _ReplicaPlan,
+                        exempt: tuple = ()
                         ) -> Optional[_FailoverDecision]:
         """Slice-atomic recovery for gang-scheduled TPU jobs.
 
@@ -1305,6 +1401,12 @@ class JobEngine(Reconciler):
         was_up = st.is_running(old_status) or st.is_restarting(old_status)
         disrupted: set[int] = set()
         for sid in range(plan.num_slices):
+            if sid in exempt:
+                # concurrency-elastic exemption (docs/elastic.md): this
+                # slice is being shed in place or has no world yet —
+                # its disruption marks are the reconfiguration protocol
+                # at work, not a failure to recover from
+                continue
             mem = members[sid]
             if was_up and 0 < len(mem) < hosts \
                     and any(_pod_phase(p) != c.POD_PENDING for _, p in mem):
@@ -1392,6 +1494,200 @@ class JobEngine(Reconciler):
         self.recorder.event(job, TYPE_WARNING, "SliceRestart", msg)
         self.metrics.restarted.inc(kind=self.kind)
         return _FailoverDecision("restart")
+
+    # ------------------------------------------------------------------
+    # concurrency-elastic slices (docs/elastic.md)
+    # ------------------------------------------------------------------
+
+    def _elastic_plan(self, job, run_policy: RunPolicy,
+                      plan: _ReplicaPlan) -> Optional[_ElasticPlan]:
+        """The gang's elastic view this round, or None when pre-elastic
+        semantics apply: the job declares no slice range, or the live
+        width fell below its min (whole-gang failover is then the only
+        move that converges — a world under the floor cannot train)."""
+        policy = run_policy.scheduling_policy
+        mn = policy.min_slices if policy is not None else None
+        if not mn:
+            return None
+        mn = max(min(int(mn), plan.num_slices), 1)
+        if mn >= plan.num_slices:
+            return None
+        active, leaving = [], []
+        for g in self.gang.get_gangs(job):
+            sid = _gang_slice_id(m.name(g), m.name(job))
+            if sid is None or not (0 <= sid < plan.num_slices) \
+                    or not is_gang_admitted(g) or m.is_deleting(g):
+                continue
+            from ..scheduling.gang import is_gang_preempted
+            if is_gang_preempted(g):
+                leaving.append(sid)
+            else:
+                active.append(sid)
+        if len(active) < mn:
+            return None
+        raw = m.get_annotations(job).get(c.ANNOTATION_ELASTIC_SLICES)
+        recorded = None
+        if raw is not None:
+            try:
+                recorded = tuple(sorted(
+                    int(x) for x in raw.split(",") if x != ""))
+            except ValueError:
+                recorded = None
+        return _ElasticPlan(min_slices=mn, num_slices=plan.num_slices,
+                            active=tuple(sorted(active)),
+                            leaving=tuple(sorted(leaving)),
+                            recorded=recorded)
+
+    def _elastic_reconfigure(self, job, status: JobStatus,
+                             plan: _ReplicaPlan, ctx: _ElasticPlan,
+                             pods) -> tuple:
+        """Drive one restart-free world reconfiguration through the
+        2-phase checkpoint protocol (docs/elastic.md):
+
+        1. *Request*: the admitted width diverged from the recorded
+           world — bump ``ckpt-requested-version``; the in-container
+           agent (``ElasticCheckpointAgent``) saves and acks via
+           ``ckpt-completed-version``. The job keeps Running; leaving
+           slices keep computing until the checkpoint is down.
+        2. *Execute* (ack landed): leaving slices' pods are deleted and
+           their PodGroups re-enter gang admission (``readmit_slice`` —
+           the regrow source); survivors get a fresh ``world-size``
+           annotation (the downward-API in-place restart contract);
+           the job's ``elastic-slices`` record adopts the new set. The
+           job never transitions back to Created/Queuing.
+
+        Returns ``(requeue_or_None, allowed_slice_set)`` — the diff
+        loops create pods only for allowed slices.
+        """
+        now = self.api.now()
+        ann = m.get_annotations(job)
+        active = set(ctx.active)
+        sig = ",".join(str(s) for s in ctx.active)
+        if ctx.recorded is None:
+            # first world: record the width the job is starting at
+            self._patch_job_annotations(
+                job, {c.ANNOTATION_ELASTIC_SLICES: sig})
+            return None, active
+        if tuple(sorted(ctx.recorded)) == ctx.active and not ctx.leaving:
+            return None, active
+        survivors = active & set(ctx.recorded)
+        has_world = any(_pod_phase(p) == c.POD_RUNNING for p in pods)
+        if not has_world and not ctx.leaving:
+            # no live world yet: adopt the grown width for free — there
+            # is nothing to checkpoint or reshard
+            self._patch_job_annotations(
+                job, {c.ANNOTATION_ELASTIC_SLICES: sig})
+            return None, active
+        requested = int(
+            ann.get(c.ANNOTATION_CKPT_REQUESTED_VERSION, 0) or 0)
+        completed = int(
+            ann.get(c.ANNOTATION_CKPT_COMPLETED_VERSION, 0) or 0)
+        #: the version gating the in-flight reconfiguration; 0 = none.
+        #: Needed because requested == completed is ambiguous between
+        #: "our ack just landed" and "nothing in flight"
+        gate_v = int(
+            ann.get(c.ANNOTATION_ELASTIC_CKPT_VERSION, 0) or 0)
+        uid = m.uid(job)
+        if gate_v <= 0:
+            # phase 1: request a checkpoint for this reconfiguration
+            version = max(requested, completed) + 1
+            if self._patch_job_annotations(job, {
+                    c.ANNOTATION_CKPT_REQUESTED_VERSION: str(version),
+                    c.ANNOTATION_ELASTIC_CKPT_VERSION: str(version),
+                    c.ANNOTATION_ELASTIC_RECONFIGURE_AT:
+                        m.rfc3339(now)}):
+                self._mttr_start.setdefault(uid, now)
+                self.recorder.event(
+                    job, TYPE_NORMAL, "ElasticCheckpointRequested",
+                    f"world change {len(ctx.recorded)} -> "
+                    f"{len(ctx.active)} slice(s): checkpoint "
+                    f"v{version} requested before reconfiguration")
+            return 2.0, survivors
+        if completed < gate_v:
+            return 2.0, survivors       # phase 2 pending: no ack yet
+        # ---- execute: the checkpoint is down ---------------------------
+        hosts = plan.slice_spec.num_hosts
+        rt_of = {rt.lower(): rt for rt in plan.offsets}
+        members: dict[int, list] = {}
+        for p in pods:
+            lbl = m.labels(p)
+            rtype = rt_of.get(lbl.get(c.LABEL_REPLICA_TYPE, ""))
+            idx = lbl.get(c.LABEL_REPLICA_INDEX, "")
+            if rtype is None or not idx.isdigit():
+                continue
+            sid = (plan.offsets[rtype] + int(idx)) // hosts
+            members.setdefault(sid, []).append((rtype, p))
+        job_key = m.key(job)
+        removed = sorted((set(ctx.recorded) | set(ctx.leaving)) - active)
+        for sid in removed:
+            for rtype, p in members.get(sid, []):
+                if not m.is_deleting(p):
+                    self._delete_pod(job_key, rtype, p)
+            try:
+                self._retry(lambda s=sid: self.gang.readmit_slice(
+                    job, s, plan.num_slices))
+            except ServerError as e:
+                log.warning("elastic re-admission for slice %d of %s "
+                            "failed: %s", sid, job_key, e)
+        world = hosts * len(active)
+        for sid in sorted(survivors):
+            for rtype, p in members.get(sid, []):
+                try:
+                    self._retry(lambda pp=p: self.api.patch_merge(
+                        "Pod", m.namespace(pp), m.name(pp),
+                        {"metadata": {"annotations": {
+                            ANNOTATION_WORLD_SIZE: str(world)}}}))
+                except (Conflict, NotFound, ServerError):
+                    pass                # downward-API visibility only
+        t0 = _parse_ts(
+            ann.get(c.ANNOTATION_ELASTIC_RECONFIGURE_AT)) or now
+        direction = "shrink" if len(active) < len(ctx.recorded) else "grow"
+        self._patch_job_annotations(
+            job, {c.ANNOTATION_ELASTIC_SLICES: sig,
+                  c.ANNOTATION_ELASTIC_CKPT_VERSION: "0"})
+        self.recorder.event(
+            job, TYPE_NORMAL, "ElasticReconfigured",
+            f"reconfigured in place ({direction}): {len(ctx.recorded)} "
+            f"-> {len(active)} slice(s), world size {world} process(es); "
+            f"the job never left Running")
+        if self.elastic_metrics is not None:
+            self.elastic_metrics.reconfigurations.inc(
+                kind=self.kind, direction=direction)
+            self.elastic_metrics.reconfigure_seconds.observe(
+                max(now - t0, 0.0), kind=self.kind)
+        if self.tracer.enabled:
+            trace_id, root = job_trace_context(job)
+            self.tracer.record(
+                "elastic.reconfigure", t0, now, trace_id=trace_id,
+                parent_id=root, component="engine",
+                attributes={"direction": direction,
+                            "fromSlices": len(ctx.recorded),
+                            "toSlices": len(active),
+                            "world": world})
+        return None, active
+
+    def _patch_job_annotations(self, job, ann: dict) -> bool:
+        """Merge-patch job annotations with bounded conflict re-reads
+        plus transient retries — the ack-write discipline shared with
+        ``ElasticCheckpointAgent`` (docs/elastic.md): a chaos 409 must
+        re-apply, never silently drop a protocol step."""
+        for _ in range(8):
+            try:
+                self._retry(lambda: self.api.patch_merge(
+                    self.kind, m.namespace(job), m.name(job),
+                    {"metadata": {"annotations": dict(ann)}}))
+                return True
+            except Conflict:
+                continue
+            except NotFound:
+                return False
+            except ServerError as e:
+                log.warning("annotation patch for %s failed: %s",
+                            m.key(job), e)
+                return False
+        log.warning("annotation patch for %s kept conflicting",
+                    m.key(job))
+        return False
 
     def _recount_replica_statuses(self, status: JobStatus, replicas,
                                   pods) -> None:

@@ -138,6 +138,13 @@ class OperatorConfig:
     #: (docs/replication.md; the COW store's immutable per-object
     #: snapshots make the concurrent dump safe)
     async_snapshots: bool = False
+    #: concurrency-elastic training (docs/elastic.md). Also switchable
+    #: via the TPUElasticSlices gate; either turns it on. REQUIRES the
+    #: slice scheduler (the shrink/regrow authority is a scheduling
+    #: pass) — build_operator fails fast otherwise. Off by default: the
+    #: fixed-width admission pass and engine failover stay
+    #: byte-identical, and no kubedl_elastic_* family registers.
+    enable_elastic_slices: bool = False
 
 
 @dataclass
@@ -163,6 +170,9 @@ class Operator:
     #: the ReplicatedControlPlane when --replication-followers > 0
     #: (None otherwise) — WAL shipping + promotion (docs/replication.md)
     replication: object = None
+    #: concurrency-elastic slices on (docs/elastic.md): the console's
+    #: /api/v1/elastic endpoints answer only when True
+    elastic_enabled: bool = False
 
     def run_until_idle(self, **kw):
         return self.manager.run_until_idle(**kw)
@@ -256,6 +266,21 @@ def build_operator(api: Optional[APIServer] = None,
     sched_enabled = gang is not None and (
         config.enable_slice_scheduler
         or gates.enabled(ft.TPU_SLICE_SCHEDULER))
+    # concurrency-elastic slices (docs/elastic.md): the shrink/regrow
+    # authority is a scheduling pass, so the gate is meaningless — and
+    # silently degrading — without the slice scheduler underneath
+    elastic_enabled = (config.enable_elastic_slices
+                       or gates.enabled(ft.TPU_ELASTIC_SLICES))
+    if elastic_enabled and not sched_enabled:
+        raise ValueError(
+            "enable_elastic_slices requires the slice scheduler "
+            "(--enable-slice-scheduler / TPUSliceScheduler gate): "
+            "min..max gang admission and shrink-in-place are "
+            "scheduling-pass decisions")
+    elastic_metrics = None
+    if elastic_enabled:
+        from ..metrics.registry import ElasticMetrics
+        elastic_metrics = ElasticMetrics(registry)
     # fleet telemetry bundle (docs/telemetry.md): one instance shared by
     # every engine (goodput harvest + straggler scans) and the console
     # (explainer / job-detail goodput); None keeps the disabled path free
@@ -286,7 +311,8 @@ def build_operator(api: Optional[APIServer] = None,
         dns_domain=config.dns_domain,
         hostnetwork_port_range=config.hostnetwork_port_range,
         hostnet_with_headless_svc=gates.enabled(ft.HOSTNET_WITH_HEADLESS_SVC),
-        gate_on_gang_admission=sched_enabled)
+        gate_on_gang_admission=sched_enabled,
+        elastic_slices=elastic_enabled)
 
     engines = {}
     enabled = set(config.workloads) if config.workloads is not None else None
@@ -305,7 +331,8 @@ def build_operator(api: Optional[APIServer] = None,
             ctrl.kubectl_delivery_image = config.kubectl_delivery_image
         engine = JobEngine(api, ctrl, engine_config, metrics=metrics,
                            recorder=recorder, gang=gang, tracer=tracer,
-                           telemetry=telemetry)
+                           telemetry=telemetry,
+                           elastic_metrics=elastic_metrics)
         manager.register(engine)
         engines[ctrl_cls.kind] = engine
     if telemetry is not None and engines:
@@ -357,7 +384,9 @@ def build_operator(api: Optional[APIServer] = None,
         scheduler = SliceScheduler(api, inventory=inventory,
                                    metrics=SchedulerMetrics(registry),
                                    recorder=recorder, tracer=tracer,
-                                   scorer=scorer)
+                                   scorer=scorer,
+                                   elastic=elastic_enabled,
+                                   elastic_metrics=elastic_metrics)
         manager.register(scheduler)
 
     # admission chain: defaulting + validation at create/update (reference
@@ -387,7 +416,8 @@ def build_operator(api: Optional[APIServer] = None,
                     event_backend=event_backend, admission=admission,
                     scheduler=scheduler, tracer=tracer,
                     telemetry=telemetry, journal=journal,
-                    replication=replication)
+                    replication=replication,
+                    elastic_enabled=elastic_enabled)
 
 
 def _storage_backend(spec: str, for_events: bool = False):

@@ -53,6 +53,14 @@ TPU_PLACEMENT_SCORING = "TPUPlacementScoring"
 #: paths are byte-identical to the pre-durability control plane
 #: (pinned by tests/test_durability.py)
 DURABLE_CONTROL_PLANE = "DurableControlPlane"
+#: concurrency-elastic training (docs/elastic.md "Elastic slices"):
+#: gangs advertise min..max slices, spot dryness shrinks jobs in place
+#: (surplus slices preempted, the job keeps Running) instead of evicting
+#: whole gangs, returning capacity regrows them, and the engine drives
+#: restart-free trainer reconfiguration through the 2-phase checkpoint
+#: protocol; off by default — the fixed-width admission pass stays
+#: byte-identical (pinned by test). Requires the slice scheduler.
+TPU_ELASTIC_SLICES = "TPUElasticSlices"
 
 _DEFAULTS = {
     GANG_SCHEDULING: True,           # Beta
@@ -67,6 +75,7 @@ _DEFAULTS = {
     SLO_ENGINE: False,               # Alpha
     TPU_PLACEMENT_SCORING: False,    # Alpha
     DURABLE_CONTROL_PLANE: False,    # Alpha
+    TPU_ELASTIC_SLICES: False,       # Alpha
 }
 
 ENV_FEATURE_GATES = "KUBEDL_FEATURE_GATES"

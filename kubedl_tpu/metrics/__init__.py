@@ -1,5 +1,6 @@
 """Prometheus-style metrics (counters/gauges/histograms + text exposition)."""
 
-from .registry import (ControlPlaneMetrics, Counter, Gauge,  # noqa: F401
-                       Histogram, JobMetrics, Registry, SLOMetrics,
-                       SchedulerMetrics, TelemetryMetrics, TraceMetrics)
+from .registry import (ControlPlaneMetrics, Counter,  # noqa: F401
+                       ElasticMetrics, Gauge, Histogram, JobMetrics,
+                       Registry, SLOMetrics, SchedulerMetrics,
+                       TelemetryMetrics, TraceMetrics)

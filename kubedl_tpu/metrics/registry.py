@@ -488,6 +488,38 @@ class ReplicationMetrics:
             "(the zombie fence)", ("follower",))
 
 
+class ElasticMetrics:
+    """Concurrency-elastic training families (docs/elastic.md "Elastic
+    slices"): restart-free reconfigurations by direction, slices shed by
+    the scheduler's shrink pass / regrown on returning capacity, and the
+    reconfiguration-window histogram (the shrink analog of restart
+    MTTR). Constructed only when the TPUElasticSlices gate is on — the
+    disabled operator's exposition carries no ``kubedl_elastic_*``
+    family at all (the byte-identical-disabled convention)."""
+
+    def __init__(self, registry: Optional[Registry] = None):
+        self.registry = registry or Registry()
+        r = self.registry
+        self.reconfigurations = r.counter(
+            "kubedl_elastic_reconfigurations_total",
+            "Restart-free world reconfigurations driven to completion, "
+            "by direction (shrink / grow)", ("kind", "direction"))
+        self.shrunk_slices = r.counter(
+            "kubedl_elastic_shrunk_slices_total",
+            "Slices shed in place by the scheduler's shrink pass "
+            "(surplus-only preemptions; the job kept Running)", ("pool",))
+        self.regrown_slices = r.counter(
+            "kubedl_elastic_regrown_slices_total",
+            "Slices admitted to an already-running elastic gang "
+            "(regrow after a shrink, or completing a partial-width "
+            "start)", ("pool",))
+        self.reconfigure_seconds = r.histogram(
+            "kubedl_elastic_reconfigure_seconds",
+            "Checkpoint request to reconfigured world (the elastic "
+            "analog of restart MTTR)", ("kind",),
+            buckets=_MTTR_BUCKETS)
+
+
 class TraceMetrics:
     """Span-recorder health (docs/tracing.md): recorded-span throughput
     per component, ring-buffer occupancy, and the overflow-drop counter

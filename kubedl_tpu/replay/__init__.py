@@ -21,3 +21,5 @@ from .scorecard import (build_scorecard, check_regression,  # noqa: F401
 from .scorecard import (build_campaign_scorecard,  # noqa: F401
                         check_campaign_regression,
                         evaluate_campaign_gates)
+from .elastic import (build_elastic_block,  # noqa: F401
+                      run_elastic_comparison)

@@ -29,6 +29,7 @@ from __future__ import annotations
 import heapq
 from typing import Optional
 
+from ..api import common as c
 from ..api.common import JobStatus
 from ..api.queue import new_queue
 from ..api.slo import new_slo
@@ -58,9 +59,9 @@ from .workload import (HOSTS_PER_SLICE, POOL_ACCELERATOR, POOL_CHIPS,
 
 #: event kinds, in same-time processing order (arrivals before
 #: completions before preemptions before retirements before campaign
-#: actions keeps ties stable)
-_EV_ARRIVAL, _EV_COMPLETE, _EV_PREEMPT, _EV_RETIRE, _EV_CAMPAIGN = \
-    0, 1, 2, 3, 4
+#: actions before checkpoint acks keeps ties stable)
+(_EV_ARRIVAL, _EV_COMPLETE, _EV_PREEMPT, _EV_RETIRE, _EV_CAMPAIGN,
+ _EV_CKPT_ACK) = 0, 1, 2, 3, 4, 5
 
 #: sim-time comparison slack: ``t0 + sim_t - t0`` loses an ulp at
 #: day-epoch magnitudes, so strict ``<=`` against ``clock.elapsed``
@@ -124,7 +125,7 @@ def adversarial_job_slos(profile) -> list:
 
 class _JobState:
     __slots__ = ("spec", "remaining", "run_start", "token", "running",
-                 "succeeded", "completion_ordinal")
+                 "succeeded", "completion_ordinal", "width_frac")
 
     def __init__(self, spec):
         self.spec = spec
@@ -134,6 +135,13 @@ class _JobState:
         self.running = False
         self.succeeded = False
         self.completion_ordinal = -1
+        #: fraction of the declared width the job currently runs at
+        #: (docs/elastic.md): a shrunk job makes proportionally slower
+        #: progress — ``remaining`` is banked in full-width seconds and
+        #: burned at ``width_frac`` per wall second. Always 1.0 outside
+        #: elastic replays, keeping the arithmetic bit-identical
+        #: (x * 1.0 == x and x / 1.0 == x exactly in IEEE754).
+        self.width_frac = 1.0
 
 
 class ClusterReplay:
@@ -143,10 +151,18 @@ class ClusterReplay:
 
     def __init__(self, workload: Workload, shards: int = 1,
                  campaign=None, journal_dir: Optional[str] = None,
-                 replication_followers: int = 0):
+                 replication_followers: int = 0, elastic: bool = False):
         self.workload = workload
         profile = workload.profile
         seed = workload.seed
+        #: concurrency-elastic slices (docs/elastic.md): multi-slice
+        #: jobs declare min = half their width, the engine/scheduler run
+        #: with the TPUElasticSlices gate on, and the harness plays the
+        #: in-container checkpoint agent (acking ckpt requests after
+        #: ``ckpt_ack_s`` of simulated save time). False (every
+        #: committed BENCH_CLUSTER scorecard) = byte-identical replays.
+        self.elastic = bool(elastic)
+        self.ckpt_ack_s = 20.0
         #: chaos campaign (docs/chaos.md): a compiled fault script the
         #: runner executes at its scheduled sim times; None = the plain
         #: day (every committed smoke/day scorecard)
@@ -236,6 +252,10 @@ class ClusterReplay:
                                metrics=self.cp_metrics,
                                shards=self.shards)
         self.job_metrics = JobMetrics(self.registry)
+        self.elastic_metrics = None
+        if self.elastic:
+            from ..metrics.registry import ElasticMetrics
+            self.elastic_metrics = ElasticMetrics(self.registry)
         self.engine = JobEngine(
             self.chaos, TestJobController(),
             EngineConfig(
@@ -246,9 +266,11 @@ class ClusterReplay:
                 retry_sleep=self.clock.advance,
                 backoff_jitter_seed=seed + 1,
                 restart_backoff_base=5.0,
-                restart_backoff_cap=120.0),
+                restart_backoff_cap=120.0,
+                elastic_slices=self.elastic),
             metrics=self.job_metrics,
-            gang=CoschedulerPlugin(self.chaos), tracer=self.tracer)
+            gang=CoschedulerPlugin(self.chaos), tracer=self.tracer,
+            elastic_metrics=self.elastic_metrics)
         self.manager.register(self.engine)
         self.sched_metrics = SchedulerMetrics(self.registry)
         self.inventory = SliceInventory(self.chaos,
@@ -257,7 +279,8 @@ class ClusterReplay:
             self.chaos, inventory=self.inventory,
             metrics=self.sched_metrics, tracer=self.tracer,
             retry_policy=RetryPolicy(attempts=5, base=0.05, cap=2.0),
-            retry_sleep=self.clock.advance)
+            retry_sleep=self.clock.advance,
+            elastic=self.elastic, elastic_metrics=self.elastic_metrics)
         self.manager.register(self.scheduler)
         for q in QUEUES:
             self.inner.create(new_queue(**q))
@@ -320,6 +343,15 @@ class ClusterReplay:
         #: NOT count as spot evictions
         self._chaos_preempted_jobs: set = set()
         self.spot_evictions_survived = 0
+        #: elastic observations (docs/elastic.md; populated only when
+        #: ``elastic=True`` — the day/smoke result dicts are untouched):
+        #: per-retired-job elastic.reconfigure span durations, the jobs
+        #: that reconfigured, and any trace showing a reconfigured job
+        #: leaving Running (the zero-transitions-back-to-Created gate)
+        self.reconfig_durations: list = []
+        self.reconfigured_jobs: set = set()
+        self.elastic_phase_violations: list = []
+        self._acks_scheduled: set = set()
         if campaign is not None:
             self.campaign_runner = CampaignRunner(campaign, self)
 
@@ -347,11 +379,14 @@ class ClusterReplay:
         s = JobStatus.from_dict(obj.get("status"))
         now = self.clock()
         running = st.is_running(s)
+        if self.elastic:
+            self._observe_elastic(name, rec, obj, running, now)
         if running and not rec.running:
             rec.running = True
             rec.run_start = now
             rec.token += 1
-            self._push(now - self.clock.t0 + rec.remaining, _EV_COMPLETE,
+            self._push(now - self.clock.t0
+                       + rec.remaining / rec.width_frac, _EV_COMPLETE,
                        (name, rec.token))
             if rec.spec.num_slices > 1:
                 # ICI packedness of the multi-slice gang as placed (the
@@ -364,11 +399,72 @@ class ClusterReplay:
                         self._ms_gangs_packed += 1
         elif not running and rec.running:
             # preempted / restarting mid-run: bank the progress made
+            # (at the width the job was actually running at)
             rec.running = False
-            rec.remaining = max(rec.remaining - (now - rec.run_start), 1.0)
+            rec.remaining = max(
+                rec.remaining - (now - rec.run_start) * rec.width_frac,
+                1.0)
             rec.run_start = None
         if st.is_succeeded(s):
             rec.succeeded = True
+
+    def _observe_elastic(self, name: str, rec, obj: dict, running: bool,
+                         now: float) -> None:
+        """The harness's elastic roles (docs/elastic.md): play the
+        in-container checkpoint agent — schedule an ack ``ckpt_ack_s``
+        of simulated save time after each request — and model a shrunk
+        job's proportionally slower progress by re-banking ``remaining``
+        whenever the engine's elastic-slices record changes width."""
+        ann = m.get_annotations(obj)
+        requested = int(
+            ann.get(c.ANNOTATION_CKPT_REQUESTED_VERSION, 0) or 0)
+        completed = int(
+            ann.get(c.ANNOTATION_CKPT_COMPLETED_VERSION, 0) or 0)
+        if requested > completed \
+                and (name, requested) not in self._acks_scheduled:
+            self._acks_scheduled.add((name, requested))
+            self._push(self.clock.elapsed + self.ckpt_ack_s,
+                       _EV_CKPT_ACK, (name, requested))
+        sig = ann.get(c.ANNOTATION_ELASTIC_SLICES)
+        width = len([x for x in sig.split(",") if x != ""]) if sig \
+            else rec.spec.num_slices
+        frac = width / rec.spec.num_slices
+        if frac == rec.width_frac:
+            return
+        if rec.running and running:
+            # width changed mid-run: bank progress at the old rate and
+            # re-arm the completion at the new one
+            rec.remaining = max(
+                rec.remaining - (now - rec.run_start) * rec.width_frac,
+                1.0)
+            rec.run_start = now
+            rec.width_frac = frac
+            rec.token += 1
+            self._push(now - self.clock.t0
+                       + rec.remaining / rec.width_frac, _EV_COMPLETE,
+                       (name, rec.token))
+        else:
+            rec.width_frac = frac
+
+    def _on_ckpt_ack(self, name: str, version: int) -> None:
+        """The in-container agent's ack (docs/elastic.md): the simulated
+        save finished — write ``ckpt-completed-version``. Uses the raw
+        store like the kubelet helpers: the agent has its own apiserver
+        connection, operator-aimed chaos must not fault it."""
+        job = self.inner.try_get("TestJob", "default", name)
+        if job is None:
+            return
+        ann = m.get_annotations(job)
+        requested = int(
+            ann.get(c.ANNOTATION_CKPT_REQUESTED_VERSION, 0) or 0)
+        completed = int(
+            ann.get(c.ANNOTATION_CKPT_COMPLETED_VERSION, 0) or 0)
+        if requested <= completed:
+            return                       # already acked (idempotent)
+        self.inner.patch_merge("TestJob", "default", name, {
+            "metadata": {"annotations": {
+                c.ANNOTATION_CKPT_COMPLETED_VERSION: str(requested)}}})
+        self.manager.run_until_idle(max_iterations=1_000_000)
 
     # ------------------------------------------------------------------
     # event machinery
@@ -381,13 +477,17 @@ class ClusterReplay:
     def _make_job(self, spec) -> dict:
         hosts = HOSTS_PER_SLICE[spec.pool]
         queue = next(q for q in QUEUES if q["name"] == spec.queue)
+        policy = {"queue": spec.queue, "priority": queue["priority"]}
+        if self.elastic and spec.num_slices > 1:
+            # elastic range (docs/elastic.md): a multi-slice job
+            # tolerates running at half its declared width
+            policy["minSlices"] = max(spec.num_slices // 2, 1)
         return new_test_job(
             spec.name, workers=hosts * spec.num_slices,
             restart_policy="ExitCode",
             tpu_policy={"acceleratorType": POOL_ACCELERATOR[spec.pool],
                         "numSlices": spec.num_slices},
-            run_policy={"schedulingPolicy": {
-                "queue": spec.queue, "priority": queue["priority"]}})
+            run_policy={"schedulingPolicy": policy})
 
     def _owned_pods(self, name: str) -> list:
         job = self.inner.try_get("TestJob", "default", name)
@@ -498,6 +598,40 @@ class ClusterReplay:
         self.replication_report = report
         return self.replication_report
 
+    def preempt_gang(self, name: str) -> bool:
+        """Spot-evict EVERY slice of one running job at once (one pod
+        per slice disrupted, so slice-atomic failover tears the complete
+        gang down in a single round) — the whole-gang spot reclaim the
+        level-based ``spot_dry`` baseline sweeps with (docs/elastic.md).
+        Single-pod preemption would leave a partially-held gang whose
+        lone pending slice can starve behind a fully-evicted queue
+        head's reservation; a real capacity reclaim takes the gang
+        whole."""
+        rec = self._jobs.get(name)
+        if rec is None or rec.succeeded or not rec.running:
+            return False
+        hosts = HOSTS_PER_SLICE[rec.spec.pool]
+        seen: set = set()
+        hit = False
+        for p in sorted(self._owned_pods(name), key=m.name):
+            if (p.get("status") or {}).get("phase") != "Running":
+                continue
+            try:
+                idx = int(m.labels(p).get(c.LABEL_REPLICA_INDEX, "0")
+                          or 0)
+            except ValueError:
+                idx = 0
+            sid = idx // hosts
+            if sid in seen:
+                continue
+            seen.add(sid)
+            self.chaos.preempt("default", m.name(p))
+            hit = True
+        if hit:
+            self.chaos_preempts_executed += 1
+            self._chaos_preempted_jobs.add(name)
+        return hit
+
     def _on_preempt(self, ordinal: int) -> None:
         running = sorted(n for n, r in self._jobs.items()
                          if r.running and not r.succeeded)
@@ -539,6 +673,31 @@ class ClusterReplay:
                              {"queue": rec.spec.queue, "job": name})
         self.queue_delays.append(queue_delay)
         self.mttrs.extend(mttrs)
+        if self.elastic:
+            # elastic.reconfigure windows are recovery samples too
+            # (docs/elastic.md: the restart-MTTR SLO covers shrink
+            # events) — and a reconfigured job's trace must show it
+            # never fell back out of Running
+            reconfs = [e.get("duration", 0.0)
+                       for e in bd.get("events") or []
+                       if e.get("component") == "engine"
+                       and e.get("name") == "elastic.reconfigure"]
+            if reconfs:
+                self.reconfigured_jobs.add(name)
+                self.reconfig_durations.extend(reconfs)
+                self.mttrs.extend(reconfs)
+                for v in reconfs:
+                    self.slo.observe("restart_mttr", v, now,
+                                     {"queue": rec.spec.queue,
+                                      "job": name})
+                seen_running = False
+                for p in bd["phases"]:
+                    if p["name"] == "Running":
+                        seen_running = True
+                    elif seen_running and p["name"] in (
+                            "Created", "Queuing", "Restarting"):
+                        self.elastic_phase_violations.append(
+                            f"{name}: {p['name']} after Running")
         for start, end in restart_windows(bd["phases"]):
             self.restart_rounds_seen += 1
             self.restart_windows.append((start, end, name))
@@ -587,6 +746,7 @@ class ClusterReplay:
             _EV_PREEMPT: self._on_preempt,
             _EV_RETIRE: self._on_retire,
             _EV_CAMPAIGN: self._on_campaign,
+            _EV_CKPT_ACK: lambda p: self._on_ckpt_ack(*p),
         }
         self._last_t = self.clock()
         max_rounds = 80 * profile.jobs + 10_000
@@ -801,6 +961,31 @@ class ClusterReplay:
                 "spans_dropped": self.tracer.dropped,
             },
         }
+        if self.elastic:
+            from ..utils.stats import summarize
+            em = self.elastic_metrics
+            pools = sorted(profile.capacity)
+            out["elastic"] = {
+                "jobs_reconfigured": len(self.reconfigured_jobs),
+                "reconfigurations": {
+                    "shrink": em.reconfigurations.value(
+                        kind="TestJob", direction="shrink"),
+                    "grow": em.reconfigurations.value(
+                        kind="TestJob", direction="grow"),
+                },
+                "shrunk_slices": {
+                    p: em.shrunk_slices.value(pool=p) for p in pools
+                    if em.shrunk_slices.value(pool=p)},
+                "regrown_slices": {
+                    p: em.regrown_slices.value(pool=p) for p in pools
+                    if em.regrown_slices.value(pool=p)},
+                "reconfigure_s": summarize(
+                    self.reconfig_durations, percentiles=(0.5, 0.99),
+                    ndigits=1),
+                "phase_violations": len(self.elastic_phase_violations),
+                "phase_violation_examples":
+                    self.elastic_phase_violations[:3],
+            }
         if self.replication is not None:
             out["replication"] = {
                 "status": self.replication.status(),

@@ -59,6 +59,19 @@ _GATES = {
         ("slo.objectives.queue-delay-p99.budgetRemaining", ">=", 0.0),
         ("slo.objectives.restart-mttr-p50.budgetRemaining", ">=", 0.0),
         ("slo.objectives.fleet-goodput.budgetRemaining", ">=", 0.0),
+        # concurrency-elastic leg (docs/elastic.md): the spot-shrink
+        # window must shrink jobs in place (>=1 shrink AND >=1 regrow,
+        # zero reconfigured-job transitions out of Running) and beat the
+        # full-restart baseline on both sticks — goodput strictly
+        # better, median recovery at most half the baseline's
+        ("jobs.elastic.elastic.completed_fraction", ">=", 1.0),
+        ("jobs.elastic.baseline.completed_fraction", ">=", 1.0),
+        ("jobs.elastic.elastic.phase_violations", "<=", 0),
+        ("jobs.elastic.elastic.reconfigurations.shrink", ">=", 1),
+        ("jobs.elastic.elastic.reconfigurations.grow", ">=", 1),
+        ("jobs.elastic.elastic.restart_rounds", "<=", 0),
+        ("jobs.elastic.gains.goodput_gain", ">=", 1.02),
+        ("jobs.elastic.gains.recovery_p50_ratio", "<=", 0.5),
     ),
 }
 
@@ -103,6 +116,13 @@ _REGRESSION = (
     ("jobs.chaos.attribution.restarts_observed",
      "lower_better", 0.25, 5.0),
     ("jobs.chaos.attribution.faults_total", "lower_better", 0.25, 10.0),
+    # concurrency-elastic leg (docs/elastic.md): the shrink-vs-evict
+    # margin must not quietly thin — a goodput gain sliding toward 1.0
+    # or the recovery ratio creeping toward the baseline is an elastic
+    # regression even while the absolute gates still pass
+    ("jobs.elastic.gains.goodput_gain", "higher_better", 0.05, 0.02),
+    ("jobs.elastic.gains.recovery_p50_ratio", "lower_better", 0.50, 0.01),
+    ("jobs.elastic.elastic.fleet_goodput", "higher_better", 0.05, 0.01),
 )
 
 #: adversarial-campaign gates, applied inside EVERY seed block of the

@@ -107,6 +107,19 @@ PROFILES = {
         chaos_conflict=0.02, chaos_create_error=0.01,
         chaos_drop_watch=0.0, chaos_max_faults=200,
         serving_requests=0, serving_bursts=0),
+    # the concurrency-elastic leg (docs/elastic.md): a small, chaos-free
+    # job day for the shrink-vs-evict comparison — the `spot-shrink`
+    # campaign halves the spot pool's capacity mid-day; the ONLY
+    # disruption is that capacity drop, so shrink/regrow attribution and
+    # the full-restart baseline comparison are exact. No serving leg.
+    "elastic": Profile(
+        name="elastic", sim_seconds=3 * 3600.0, jobs=48, job_bursts=2,
+        burst_frac=0.35, chaos_preemptions=0,
+        capacity={POOL_V5P: 8, POOL_V5E: 12},
+        duration_mean_s=2400.0, trace_capacity=32768, sample_traces=16,
+        chaos_conflict=0.0, chaos_create_error=0.0,
+        chaos_drop_watch=0.0, chaos_max_faults=0,
+        serving_requests=0, serving_bursts=0),
 }
 
 #: tenant queues: prod is guaranteed, batch partially, best borrows only

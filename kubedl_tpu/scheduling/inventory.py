@@ -125,6 +125,10 @@ class HeldSlice:
     priority: int
     admitted_at: float  # creationTimestamp (FIFO/victim ordering)
     preempted: bool = False  # eviction in flight; still holds its slice
+    #: elastic slice range of the owning gang (docs/elastic.md):
+    #: 0 = fixed-width (the gang is not concurrency-elastic)
+    min_slices: int = 0
+    max_slices: int = 0
 
 
 def _held_from_pg(pg: dict) -> Optional[HeldSlice]:
@@ -135,18 +139,23 @@ def _held_from_pg(pg: dict) -> Optional[HeldSlice]:
     if not pool:
         return None  # non-TPU gang: holds no slice
     from .gang import is_gang_preempted
-    try:
-        prio = int(ann.get(c.ANNOTATION_SCHED_PRIORITY, "0") or 0)
-    except ValueError:
-        prio = 0
+
+    def _int(key: str) -> int:
+        try:
+            return int(ann.get(key, "0") or 0)
+        except ValueError:
+            return 0
+
     return HeldSlice(
         namespace=m.namespace(pg), name=m.name(pg), pool=pool,
         queue=ann.get(c.ANNOTATION_SCHED_QUEUE, "") or "default",
         job=m.get_labels(pg).get(c.LABEL_GANG_JOB_NAME, m.name(pg)),
-        priority=prio,
+        priority=_int(c.ANNOTATION_SCHED_PRIORITY),
         admitted_at=m.parse_rfc3339(
             m.meta(pg).get("creationTimestamp")) or 0.0,
-        preempted=is_gang_preempted(pg))
+        preempted=is_gang_preempted(pg),
+        min_slices=_int(c.ANNOTATION_SCHED_MIN_SLICES),
+        max_slices=_int(c.ANNOTATION_SCHED_MAX_SLICES))
 
 
 def _node_pool_of(node: dict) -> Optional[str]:
@@ -289,6 +298,25 @@ class SliceInventory:
         if cap is None:
             return None
         return max(cap - self.held_slices(pool), 0)
+
+    def overcommitted(self) -> dict:
+        """``pool -> surplus`` for every pool whose LIVE held count (held
+        minus evictions already in flight) exceeds its known capacity —
+        the state a spot-dryness capacity drop leaves behind. The
+        inventory is the authority here (docs/elastic.md): the
+        scheduler's shrink pass consumes this to shed exactly the
+        surplus, instead of an external sweep guessing at holders."""
+        out: dict[str, int] = {}
+        with self._lock:
+            live: dict[str, int] = {}
+            for h in self._held.values():
+                if not h.preempted:
+                    live[h.pool] = live.get(h.pool, 0) + 1
+        for pool, n in live.items():
+            cap = self.capacity_slices(pool)
+            if cap is not None and n > cap:
+                out[pool] = n - cap
+        return out
 
     def held_records(self) -> list:
         with self._lock:

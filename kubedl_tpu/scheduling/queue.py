@@ -65,14 +65,25 @@ def gang_annotations(job: dict, policy: Optional[SchedulingPolicy],
     # default the telemetry layer folds anonymous step spans into
     profile = ((policy.profile if policy is not None else "")
                or (job.get("kind") or "job")).lower()
-    return {
+    want = max(int(num_slices or 1), 1)
+    out = {
         c.ANNOTATION_SCHED_POOL: pool,
         c.ANNOTATION_SCHED_QUEUE: job_queue_name(job, policy),
-        c.ANNOTATION_SCHED_NUM_SLICES: str(max(int(num_slices or 1), 1)),
+        c.ANNOTATION_SCHED_NUM_SLICES: str(want),
         c.ANNOTATION_SCHED_PRIORITY: str(priority),
         c.ANNOTATION_SCHED_POOLS: ",".join(eligible),
         c.ANNOTATION_SCHED_PROFILE: profile,
     }
+    # elastic slice range (docs/elastic.md): stamped ONLY when the job
+    # declares minSlices, so fixed-width gangs keep their exact
+    # pre-elastic annotation shape (the gate-off byte-identity contract)
+    if policy is not None and policy.min_slices is not None:
+        mn = max(min(int(policy.min_slices), want), 1)
+        mx = want if policy.max_slices is None \
+            else max(min(int(policy.max_slices), want), mn)
+        out[c.ANNOTATION_SCHED_MIN_SLICES] = str(mn)
+        out[c.ANNOTATION_SCHED_MAX_SLICES] = str(mx)
+    return out
 
 
 def load_queue_specs(api) -> dict:

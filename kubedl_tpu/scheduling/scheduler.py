@@ -59,6 +59,19 @@ log = logging.getLogger("kubedl_tpu.scheduler")
 REASON_ADMITTED = "GangAdmitted"
 REASON_PREEMPTED = "GangPreempted"
 REASON_INFEASIBLE = "GangInfeasible"
+#: elastic shrink (docs/elastic.md): surplus slices shed in place — the
+#: job keeps Running, distinct from a whole-gang preemption
+REASON_SHRUNK = "GangShrunk"
+
+
+def _slice_ordinal(pg_name: str) -> int:
+    """Slice id from a multislice gang's PodGroup name
+    (``{job}-slice-{sid}``, scheduling/gang.py); 0 for single-slice
+    names — the shed-order key that keeps slice 0 alive."""
+    _, sep, tail = pg_name.rpartition("-slice-")
+    if sep and tail.isdigit():
+        return int(tail)
+    return 0
 
 
 @dataclass
@@ -76,6 +89,10 @@ class GangSet:
     pools: tuple = ()
     #: throughput-profile key (job kind / model) for the scorer
     profile: str = ""
+    #: elastic slice range (docs/elastic.md): 0 = fixed-width gang;
+    #: consumed only when the scheduler runs with ``elastic=True``
+    min_slices: int = 0
+    max_slices: int = 0
     pgs: dict = field(default_factory=dict)  # un-admitted pg name -> created ts
 
     def first_seen(self) -> float:
@@ -84,22 +101,24 @@ class GangSet:
 
 def _pg_gangset_fields(pg: dict) -> tuple:
     ann = m.get_annotations(pg)
-    try:
-        prio = int(ann.get(c.ANNOTATION_SCHED_PRIORITY, "0") or 0)
-    except ValueError:
-        prio = 0
-    try:
-        want = max(int(ann.get(c.ANNOTATION_SCHED_NUM_SLICES, "1") or 1), 1)
-    except ValueError:
-        want = 1
+
+    def _int(key: str, default: int = 0) -> int:
+        try:
+            return int(ann.get(key, str(default)) or default)
+        except ValueError:
+            return default
+
+    want = max(_int(c.ANNOTATION_SCHED_NUM_SLICES, 1), 1)
     pools = tuple(p for p in ann.get(
         c.ANNOTATION_SCHED_POOLS, "").split(",") if p)
     return (ann.get(c.ANNOTATION_SCHED_POOL, ""),
             want,
             ann.get(c.ANNOTATION_SCHED_QUEUE, "") or DEFAULT_QUEUE,
-            prio,
+            _int(c.ANNOTATION_SCHED_PRIORITY),
             pools,
-            ann.get(c.ANNOTATION_SCHED_PROFILE, ""))
+            ann.get(c.ANNOTATION_SCHED_PROFILE, ""),
+            _int(c.ANNOTATION_SCHED_MIN_SLICES),
+            _int(c.ANNOTATION_SCHED_MAX_SLICES))
 
 
 class SliceScheduler(Reconciler):
@@ -116,8 +135,16 @@ class SliceScheduler(Reconciler):
                  resync_every: int = 16,
                  retry_policy: Optional[RetryPolicy] = None,
                  retry_sleep: Callable = time.sleep,
-                 tracer=None, scorer=None):
+                 tracer=None, scorer=None, elastic: bool = False,
+                 elastic_metrics=None):
         self.api = api
+        #: concurrency-elastic slices (docs/elastic.md): when True, gangs
+        #: advertising a min..max range may be admitted at any width in
+        #: range, and every pass runs the shrink authority over
+        #: ``SliceInventory.overcommitted()`` pools. False (default) =
+        #: the fixed-width pass, byte-identical pre-elastic behavior
+        self.elastic = bool(elastic)
+        self.elastic_metrics = elastic_metrics
         #: placement scorer (docs/scheduling.md "Placement scoring"):
         #: a scheduling.scoring.PlacementScorer when the
         #: TPUPlacementScoring gate is on; None = the pre-scoring pass,
@@ -192,8 +219,9 @@ class SliceScheduler(Reconciler):
             gs = self._pending.get(key)
             if gs is None:
                 gs = self._pending[key] = GangSet(namespace=ns, job=job)
-            (gs.pool, gs.want, gs.queue, gs.priority,
-                 gs.pools, gs.profile) = _pg_gangset_fields(obj)
+            (gs.pool, gs.want, gs.queue, gs.priority, gs.pools,
+                 gs.profile, gs.min_slices, gs.max_slices) = \
+                _pg_gangset_fields(obj)
             gs.pgs[name] = m.parse_rfc3339(
                 m.meta(obj).get("creationTimestamp")) or self.api.now()
 
@@ -213,8 +241,9 @@ class SliceScheduler(Reconciler):
             job = m.get_labels(pg).get(c.LABEL_GANG_JOB_NAME, name)
             gs = pending.setdefault((ns, job),
                                     GangSet(namespace=ns, job=job))
-            (gs.pool, gs.want, gs.queue, gs.priority,
-             gs.pools, gs.profile) = _pg_gangset_fields(pg)
+            (gs.pool, gs.want, gs.queue, gs.priority, gs.pools,
+             gs.profile, gs.min_slices, gs.max_slices) = \
+                _pg_gangset_fields(pg)
             gs.pgs[name] = m.parse_rfc3339(
                 m.meta(pg).get("creationTimestamp")) or 0.0
         with self._lock:
@@ -291,14 +320,23 @@ class SliceScheduler(Reconciler):
 
             queues = dict(self._queues)
             queues.setdefault(DEFAULT_QUEUE, QueueSpec(name=DEFAULT_QUEUE))
+            if self.elastic:
+                # shrink authority (docs/elastic.md): pools whose live
+                # held count exceeds capacity shed surplus BEFORE the
+                # admission pass reads the held set, so a pass never
+                # admits into a pool it is about to shrink
+                self._shrink_pass(queues)
             held = self.inventory.held_records()
             held_by_queue: dict[str, int] = {}
             held_jobs: dict[tuple, int] = {}
+            held_live: dict[tuple, int] = {}
             held_pool: dict[tuple, str] = {}
             for h in held:
                 held_by_queue[h.queue] = held_by_queue.get(h.queue, 0) + 1
                 hk = (h.namespace, h.job)
                 held_jobs[hk] = held_jobs.get(hk, 0) + 1
+                if not h.preempted:
+                    held_live[hk] = held_live.get(hk, 0) + 1
                 held_pool[hk] = h.pool
 
             # complete gang-sets only: a job whose slices are still being
@@ -329,7 +367,8 @@ class SliceScheduler(Reconciler):
             for qname in sorted(queues, key=lambda n: (-queues[n].priority, n)):
                 self._schedule_queue(queues[qname], by_queue.get(qname, []),
                                      queues, held_by_queue, reserved,
-                                     held_pool=held_pool)
+                                     held_pool=held_pool,
+                                     held_live=held_live)
             self._refresh_gauges(queues, by_queue, held_by_queue)
         if self.tracer.enabled:
             self.tracer.record(
@@ -338,7 +377,8 @@ class SliceScheduler(Reconciler):
 
     def _schedule_queue(self, q: QueueSpec, fifo: list, queues: dict,
                         held_by_queue: dict, reserved: dict,
-                        held_pool: Optional[dict] = None) -> None:
+                        held_pool: Optional[dict] = None,
+                        held_live: Optional[dict] = None) -> None:
         head_blocked = False
         for gs in list(fifo):
             demand = len(gs.pgs) if gs.pool else 0
@@ -371,9 +411,26 @@ class SliceScheduler(Reconciler):
                 # would let the next gang sail past the max ceiling
                 held_by_queue[q.name] = \
                     held_by_queue.get(q.name, 0) + landed
+                self._note_regrow(gs, landed, pool,
+                                  (held_live or {}).get(
+                                      (gs.namespace, gs.job), 0))
                 continue
             avail = detail
             anchor = pin or gs.pool
+            if self._elastic_gang(gs) and avail > 0:
+                # concurrency-elastic admission (docs/elastic.md): the
+                # gang tolerates any width in [min, want], so a
+                # capacity-blocked elastic gang takes whatever fits as
+                # long as (already-held live slices + what fits) reaches
+                # its min — a partial world the trainer can actually run
+                live = (held_live or {}).get((gs.namespace, gs.job), 0)
+                if live + avail >= max(gs.min_slices, 1):
+                    landed = self._admit(gs, backfill=head_blocked,
+                                         pool=anchor, limit=avail)
+                    held_by_queue[q.name] = \
+                        held_by_queue.get(q.name, 0) + landed
+                    self._note_regrow(gs, landed, anchor, live)
+                    continue
             if not head_blocked:
                 head_blocked = True
                 # the head reserves every free slice it could use in its
@@ -415,7 +472,12 @@ class SliceScheduler(Reconciler):
         candidates = self.candidates_for(gs, pin_pool)
         anchor = candidates[0]   # primary, or the pinned held pool
         caps = {p: self.inventory.capacity_slices(p) for p in candidates}
-        if all(caps[p] is not None and demand > caps[p]
+        # an elastic gang is feasible as long as its MIN width fits
+        # somewhere (docs/elastic.md) — judging the full declared width
+        # would strand a range gang in a pool that can host its floor
+        feas = min(demand, max(gs.min_slices, 1)) \
+            if self._elastic_gang(gs) else demand
+        if all(caps[p] is not None and feas > caps[p]
                for p in candidates):
             return ("infeasible", caps[anchor])
         fitting = []
@@ -465,9 +527,17 @@ class SliceScheduler(Reconciler):
     # admission
     # ------------------------------------------------------------------
 
+    def _elastic_gang(self, gs: GangSet) -> bool:
+        """Whether this pending gang-set rides elastic-width admission:
+        the scheduler's gate is on AND the gang advertises a real range
+        (min below its declared width)."""
+        return (self.elastic and gs.min_slices > 0
+                and gs.min_slices < gs.want)
+
     def _admit(self, gs: GangSet, backfill: bool = False,
                pool: Optional[str] = None,
-               score_rows: Optional[list] = None) -> int:
+               score_rows: Optional[list] = None,
+               limit: Optional[int] = None) -> int:
         """Admit every un-admitted PodGroup of the set. Returns how many
         writes landed (partial admission leaves the rest pending; the next
         pass finishes the set — the held part counts toward both its
@@ -489,7 +559,16 @@ class SliceScheduler(Reconciler):
         landed = 0
         all_landed = True
         first_pg = None
-        for name in sorted(gs.pgs):
+        names = sorted(gs.pgs)
+        if limit is not None and limit < len(names):
+            # elastic partial width (docs/elastic.md): admit the LOWEST
+            # slice ordinals first (numeric, not lexicographic — names
+            # order "slice-10" before "slice-2") so the admitted world
+            # is the contiguous low prefix the shed order preserves;
+            # the rest stay pending and regrow later
+            names = sorted(names, key=_slice_ordinal)[:limit]
+            all_landed = False
+        for name in names:
             committed = self._write_status(
                 "PodGroup", gs.namespace, name, self._mutate_admit)
             if committed is None:
@@ -615,6 +694,132 @@ class SliceScheduler(Reconciler):
                     f"{gs.pool} but the pool holds only {cap}; it will "
                     f"never be admitted")
                 break
+
+    def _note_regrow(self, gs: GangSet, landed: int, pool: Optional[str],
+                     live: int) -> None:
+        """Count slices re-admitted to an already-running elastic gang
+        (the regrow half of shrink/regrow, docs/elastic.md)."""
+        if landed and live > 0 and self._elastic_gang(gs) \
+                and self.elastic_metrics is not None:
+            self.elastic_metrics.regrown_slices.inc(landed,
+                                                    pool=pool or gs.pool)
+
+    # ------------------------------------------------------------------
+    # elastic shrink (docs/elastic.md "Shrink in place")
+    # ------------------------------------------------------------------
+
+    def _shrink_pass(self, queues: dict) -> None:
+        """Shed surplus from every overcommitted pool (capacity dropped
+        below the live held count — spot dryness). Elastic gangs give up
+        slices down to their advertised min FIRST — surplus-only
+        preemptions the engine turns into a restart-free world
+        reconfiguration, the job never leaves Running — and only the
+        remainder falls back to whole-gang eviction. Victim order
+        matches reclaim: lowest queue priority, lowest job priority,
+        newest first; within a gang the newest-admitted slices shed
+        first (slice 0, the master's home, sheds last)."""
+        over = self.inventory.overcommitted()
+        for pool in sorted(over):
+            surplus = over[pool]
+            held = [h for h in self.inventory.held_records()
+                    if h.pool == pool and not h.preempted]
+            groups: dict[tuple, list] = {}
+            for h in held:
+                groups.setdefault((h.namespace, h.job), []).append(h)
+            cands = []
+            for (ns, job), slices in groups.items():
+                vq = queues.get(slices[0].queue,
+                                QueueSpec(name=slices[0].queue))
+                cands.append((vq.priority,
+                              max(h.priority for h in slices),
+                              -max(h.admitted_at for h in slices),
+                              ns, job, slices))
+            cands.sort(key=lambda t: (t[0], t[1], t[2]))
+            shed_names: set = set()
+            for _, _, _, ns, job, slices in cands:
+                if surplus <= 0:
+                    break
+                mn = max((h.min_slices for h in slices), default=0)
+                if mn <= 0 or mn >= len(slices):
+                    continue            # fixed-width, or already at min
+                shed = min(surplus, len(slices) - mn)
+                # shed the HIGHEST slice ordinals first: slice 0 hosts
+                # worker 0 (the master/success-judgment home) and must
+                # survive every shrink, and a contiguous low prefix is
+                # what the trainer's world re-forms around
+                victims = sorted(slices,
+                                 key=lambda h: (-_slice_ordinal(h.name),
+                                                -h.admitted_at))[:shed]
+                self._preempt_slices(
+                    ns, job, victims, whole=False,
+                    reason=(f"pool {pool} capacity shrank: shedding "
+                            f"{shed} surplus slice(s) of {job} in place "
+                            f"(elastic min {mn})"))
+                shed_names.update(h.name for h in victims)
+                if self.elastic_metrics is not None:
+                    self.elastic_metrics.shrunk_slices.inc(shed, pool=pool)
+                surplus -= shed
+            for _, _, _, ns, job, slices in cands:
+                if surplus <= 0:
+                    break
+                rest = [h for h in slices if h.name not in shed_names]
+                if not rest:
+                    continue
+                self._preempt_slices(
+                    ns, job, rest, whole=True,
+                    reason=(f"pool {pool} capacity shrank below its held "
+                            f"slices; evicting gang {job} whole"))
+                shed_names.update(h.name for h in rest)
+                surplus -= len(rest)
+            if surplus > 0:
+                log.info("pool %s still %d slice(s) overcommitted after "
+                         "the shrink pass (no eligible holders)",
+                         pool, surplus)
+
+    def _preempt_slices(self, ns: str, job: str, victims: list,
+                        reason: str, whole: bool) -> None:
+        """Preempt exactly ``victims`` (a subset of one gang's held
+        slices, or all of them for ``whole=True``): each PodGroup gets
+        the Preempted condition, its pods DisruptionTarget — the same
+        write surface as reclaim, so the engine's teardown paths (full
+        failover, or the elastic in-place removal) see an identical
+        stimulus."""
+        victim_queue = victims[0].queue
+        victim_pg = None
+        for rec in victims:
+            pg = self.api.try_get("PodGroup", rec.namespace, rec.name)
+            if pg is None:
+                continue
+            if victim_pg is None:
+                victim_pg = pg
+            if is_gang_preempted(pg):
+                self.inventory.mark_preempted(rec.namespace, rec.name)
+                continue
+            pods = self._gang_pods(rec.namespace, rec.name)
+            if not pods:
+                # no world on this slice yet: release it directly; the
+                # owning job's next reconcile recreates it un-admitted
+                try:
+                    self._retry(lambda r=rec: self.api.delete(
+                        "PodGroup", r.namespace, r.name))
+                except (NotFound, ServerError):
+                    pass
+                continue
+            self._write_status("PodGroup", rec.namespace, rec.name,
+                               self._mutate_preempt)
+            self.inventory.mark_preempted(rec.namespace, rec.name)
+            for pod in pods:
+                self._write_status("Pod", m.namespace(pod), m.name(pod),
+                                   self._mutate_disrupt)
+        if victim_pg is not None:
+            self.recorder.event(victim_pg, TYPE_WARNING,
+                                REASON_PREEMPTED if whole
+                                else REASON_SHRUNK, reason)
+        if whole:
+            self.metrics.preempted.inc(queue=victim_queue)
+        log.info("%s %d slice(s) of %s/%s (queue %s): %s",
+                 "evicted" if whole else "shed", len(victims), ns, job,
+                 victim_queue, reason)
 
     # ------------------------------------------------------------------
     # reclaim / preemption

@@ -37,9 +37,15 @@ _PHASE_CATEGORY = {
     "Restarting": "restart",
 }
 
-#: every overhead bucket, in stable output order
+#: every overhead bucket, in stable output order. ``reconfiguration`` is
+#: the elastic shrink/regrow window (docs/elastic.md): the
+#: ``elastic.reconfigure`` spans the engine records while a job
+#: reshapes its world WITHOUT leaving Running — carved out of the
+#: productive bucket exactly like checkpoint time, so a restart-free
+#: resize is still honestly accounted as overhead, just a much smaller
+#: one than the restart round it replaces.
 OVERHEAD_CATEGORIES = ("queue", "scheduling", "podStart", "rendezvous",
-                       "restart", "checkpoint", "other")
+                       "restart", "checkpoint", "reconfiguration", "other")
 
 
 def goodput_breakdown(breakdown: dict, ndigits: int = 6) -> Optional[dict]:
@@ -60,7 +66,9 @@ def goodput_breakdown(breakdown: dict, ndigits: int = 6) -> Optional[dict]:
             overhead[_PHASE_CATEGORY.get(phase, "other")] += seconds
     # checkpoint time is carved OUT of the productive bucket (the trainer
     # records train.checkpoint spans inside the Running window), so the
-    # decomposition total is preserved
+    # decomposition total is preserved; elastic reconfiguration windows
+    # (engine elastic.reconfigure spans, docs/elastic.md) are carved the
+    # same way
     ckpt = sum(e.get("duration", 0.0)
                for e in breakdown.get("events") or []
                if e.get("component") == "train"
@@ -68,6 +76,13 @@ def goodput_breakdown(breakdown: dict, ndigits: int = 6) -> Optional[dict]:
     ckpt = min(ckpt, productive)
     productive -= ckpt
     overhead["checkpoint"] = ckpt
+    reconf = sum(e.get("duration", 0.0)
+                 for e in breakdown.get("events") or []
+                 if e.get("component") == "engine"
+                 and e.get("name") == "elastic.reconfigure")
+    reconf = min(reconf, productive)
+    productive -= reconf
+    overhead["reconfiguration"] = reconf
     wall = productive + sum(overhead.values())
     return {
         "wallSeconds": round(wall, ndigits),

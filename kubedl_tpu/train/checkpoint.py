@@ -21,6 +21,11 @@ the training container. This framework ships both halves:
 from __future__ import annotations
 
 import logging
+import os
+import queue
+import shutil
+import threading
+import time
 from dataclasses import dataclass
 from typing import Callable, Optional
 
@@ -29,6 +34,7 @@ import orbax.checkpoint as ocp
 
 from ..api import common as c
 from ..core import meta as m
+from ..core.apiserver import ApiError
 
 log = logging.getLogger("kubedl_tpu.checkpoint")
 
@@ -160,6 +166,241 @@ class CheckpointManager:
         self._mngr.close()
 
 
+class CheckpointTiers:
+    """Host-local fast tier + object-store durable tier (docs/elastic.md
+    "Async multi-tier checkpointing").
+
+    The local tier is the orbax directory the trainer saves into
+    (device→host already overlapped with compute by orbax's async
+    checkpointing); this class adds the host→object-store leg on a
+    background worker so neither tier ever blocks a training step, and
+    the *nearest*-tier read path for restore.
+
+    Upload contract (the WAL-snapshot tmp+rename discipline): a step is
+    copied into ``<object_dir>/<step>.uploading`` and atomically renamed
+    to ``<object_dir>/<step>`` only when every byte is down — a torn
+    upload (crash mid-copy) leaves a ``.uploading`` orphan that the read
+    path NEVER serves and the next publisher sweeps. The object tier
+    therefore never serves a partial checkpoint.
+    """
+
+    UPLOADING_SUFFIX = ".uploading"
+
+    def __init__(self, local_dir: str, object_dir: str,
+                 ready: Optional[Callable] = None,
+                 poll_interval_s: float = 0.02,
+                 ready_timeout_s: float = 120.0):
+        self.local_dir = str(local_dir)
+        self.object_dir = str(object_dir)
+        os.makedirs(self.object_dir, exist_ok=True)
+        #: ``ready(step) -> bool``: whether the local tier has finalized
+        #: the step (orbax renames its tmp dir into place on finalize,
+        #: so directory existence is the default readiness signal)
+        self._ready = ready or self._local_finalized
+        self._poll = float(poll_interval_s)
+        self._ready_timeout = float(ready_timeout_s)
+        self._queue: "queue.Queue" = queue.Queue()
+        self._worker: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+        #: steps whose upload completed (observability / tests)
+        self.uploaded: list = []
+        #: torn ``.uploading`` orphans swept before uploads
+        self.swept = 0
+        #: per-step upload attempts so far (bounded retries)
+        self._attempts: dict = {}
+        #: steps whose upload exhausted its retries — ``flush`` raises
+        #: on these instead of reporting a durable tier it never wrote
+        self.failed: list = []
+        self.max_attempts = 3
+
+    # -- read side --------------------------------------------------------
+
+    def _step_dirs(self, root: str) -> list:
+        try:
+            names = os.listdir(root)
+        except OSError:
+            return []
+        out = []
+        for n in names:
+            if n.endswith(self.UPLOADING_SUFFIX):
+                continue               # torn upload: never served
+            try:
+                out.append(int(n))
+            except ValueError:
+                continue
+        return sorted(out)
+
+    def local_steps(self) -> list:
+        return [s for s in self._step_dirs(self.local_dir)
+                if self._local_finalized(s)]
+
+    def object_steps(self) -> list:
+        return self._step_dirs(self.object_dir)
+
+    def nearest_step(self) -> Optional[int]:
+        """Newest step across both tiers (restore reads the nearest copy
+        of it: local when present, object-store otherwise)."""
+        steps = set(self.local_steps()) | set(self.object_steps())
+        return max(steps) if steps else None
+
+    def localize(self, step: int) -> bool:
+        """Ensure ``step`` exists in the local tier, downloading from
+        the object tier when the local copy is gone (the
+        fresh-host-after-eviction path). Returns False when neither
+        tier has it."""
+        if step in self.local_steps():
+            return True
+        if step not in self.object_steps():
+            return False
+        src = os.path.join(self.object_dir, str(step))
+        tmp = os.path.join(self.local_dir,
+                           f"{step}{self.UPLOADING_SUFFIX}")
+        dst = os.path.join(self.local_dir, str(step))
+        shutil.rmtree(tmp, ignore_errors=True)
+        os.makedirs(self.local_dir, exist_ok=True)
+        shutil.copytree(src, tmp)
+        os.replace(tmp, dst)
+        log.info("checkpoint step %d localized from the object tier",
+                 step)
+        return True
+
+    def localize_latest(self) -> Optional[int]:
+        """Pull the newest object-tier step missing locally — run before
+        opening the orbax manager so restore sees the nearest tier."""
+        newest = self.nearest_step()
+        if newest is not None and self.localize(newest):
+            return newest
+        return None
+
+    # -- write side -------------------------------------------------------
+
+    def _local_finalized(self, step: int) -> bool:
+        """Orbax finalizes a step by renaming its tmp dir into place, so
+        a plain directory named ``<step>`` IS the commit marker."""
+        return os.path.isdir(os.path.join(self.local_dir, str(step)))
+
+    def publish(self, step: int) -> None:
+        """Enqueue the host→object-store upload of ``step`` on the
+        background worker (never blocks the training step)."""
+        self._ensure_worker()
+        self._queue.put(int(step))
+
+    def _ensure_worker(self) -> None:
+        with self._lock:
+            if self._worker is None or not self._worker.is_alive():
+                self._worker = threading.Thread(
+                    target=self._drain, name="ckpt-upload", daemon=True)
+                self._worker.start()
+
+    def _drain(self) -> None:
+        while True:
+            step = self._queue.get()
+            try:
+                if step is None:
+                    return
+                self._upload(step)
+            except Exception as e:  # noqa: BLE001 — a failed upload
+                # must not kill the worker; retry bounded, and if the
+                # step keeps failing record it so flush() surfaces the
+                # hole instead of reporting a durable tier that was
+                # never written
+                n = self._attempts.get(step, 0) + 1
+                self._attempts[step] = n
+                if n < self.max_attempts:
+                    log.warning("checkpoint upload of step %s failed "
+                                "(attempt %d/%d, will retry): %s",
+                                step, n, self.max_attempts, e)
+                    time.sleep(self._poll)
+                    self._queue.put(step)
+                else:
+                    log.error("checkpoint upload of step %s failed "
+                              "%d times; the object tier is MISSING "
+                              "this step: %s", step, n, e)
+                    self.failed.append(step)
+            finally:
+                self._queue.task_done()
+
+    def _upload(self, step: int) -> None:
+        deadline = time.monotonic() + self._ready_timeout
+        while not self._ready(step):
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"step {step} never finalized in the local tier")
+            time.sleep(self._poll)
+        dst = os.path.join(self.object_dir, str(step))
+        if os.path.isdir(dst):
+            return                      # already uploaded (idempotent)
+        tmp = dst + self.UPLOADING_SUFFIX
+        if os.path.isdir(tmp):
+            shutil.rmtree(tmp)          # torn upload from a prior crash
+            self.swept += 1
+        shutil.copytree(os.path.join(self.local_dir, str(step)), tmp)
+        os.replace(tmp, dst)            # atomic: readers see all or nothing
+        self.uploaded.append(step)
+        log.info("checkpoint step %d published to the object tier", step)
+
+    def flush(self, timeout_s: float = 120.0) -> None:
+        """Wait until every enqueued upload has landed; raise when any
+        step exhausted its retries — a clean return MEANS the object
+        tier holds every published step (the contract restore-on-a-
+        fresh-host depends on)."""
+        deadline = time.monotonic() + timeout_s
+        while not self._queue.empty() or self._queue.unfinished_tasks:
+            if time.monotonic() >= deadline:
+                raise TimeoutError("checkpoint uploads did not drain")
+            time.sleep(self._poll)
+        if self.failed:
+            raise RuntimeError(
+                f"object-tier upload failed permanently for step(s) "
+                f"{sorted(set(self.failed))}; the durable tier is "
+                f"missing them")
+
+    def close(self) -> None:
+        if self._worker is not None and self._worker.is_alive():
+            self._queue.put(None)
+            self._worker.join(timeout=10.0)
+
+
+class TieredCheckpointManager(CheckpointManager):
+    """:class:`CheckpointManager` + the object-store tier: every
+    completed save is published to ``object_dir`` on the background
+    worker, and construction pulls the newest object-tier step down
+    first, so ``restore``/``latest_step`` read the nearest tier even on
+    a host whose local disk started empty (the spot-eviction resume
+    path, docs/elastic.md)."""
+
+    def __init__(self, config: CheckpointConfig, object_dir: str,
+                 upload: bool = True):
+        self.tiers = CheckpointTiers(config.directory, object_dir)
+        self.tiers.localize_latest()
+        super().__init__(config)
+        self._upload_enabled = bool(upload)
+
+    def save(self, state, force: bool = False, step: Optional[int] = None,
+             periodic: bool = False,
+             data_state: Optional[dict] = None) -> bool:
+        saved = super().save(state, force=force, step=step,
+                             periodic=periodic, data_state=data_state)
+        if saved and self._upload_enabled:
+            if step is None:
+                step = int(jax.device_get(state.step))
+            self.tiers.publish(step)
+        return saved
+
+    def wait_until_finished(self) -> None:
+        super().wait_until_finished()
+        self.tiers.flush()
+
+    def close(self) -> None:
+        try:
+            self.tiers.flush()
+        except (TimeoutError, RuntimeError) as e:
+            log.warning("closing with unfinished checkpoint uploads: %s",
+                        e)
+        self.tiers.close()
+        super().close()
+
+
 def abstract_state_like(state, mesh, param_specs, opt_specs, step_spec=None):
     """Build the abstract restore target for ``state`` on ``mesh``:
     ShapeDtypeStructs carrying the *target* NamedShardings."""
@@ -220,9 +461,44 @@ class ElasticCheckpointAgent:
                           data_state=(self.data_state_fn()
                                       if self.data_state_fn else None))
         self.manager.wait_until_finished()  # ack only after bytes are down
-        self.api.patch_merge(self.kind, self.namespace, self.name, {
-            "metadata": {"annotations": {
-                c.ANNOTATION_CKPT_COMPLETED_VERSION: str(requested)}}})
-        self._acked = requested
-        log.info("elastic checkpoint v%d taken and acknowledged", requested)
+        acked = self._ack(requested)
+        if acked is None:
+            # the ack write could not land this poll: leave _acked
+            # untouched so the NEXT poll retries the acknowledgement
+            # (the checkpoint itself is down; re-saving is a no-op)
+            log.warning("elastic checkpoint v%d saved but the ack write "
+                        "did not land; will retry", requested)
+            return True
+        self._acked = acked
+        log.info("elastic checkpoint v%d taken and acknowledged", acked)
         return True
+
+    def _ack(self, requested: int) -> Optional[int]:
+        """Write ``ckpt-completed-version`` with the standard conflict
+        re-read/re-apply retry (docs/elastic.md): under chaos 409s the
+        bare patch raced the controller's own annotation writes — a
+        dropped ack stalls the whole reconfiguration, with the
+        controller waiting on an acknowledgement the agent believes it
+        sent. Each retry RE-READS the job: a newer requested version
+        observed mid-retry is acknowledged instead (the checkpoint just
+        taken covers it — state only moves between polls)."""
+        for _ in range(8):
+            try:
+                self.api.patch_merge(self.kind, self.namespace, self.name, {
+                    "metadata": {"annotations": {
+                        c.ANNOTATION_CKPT_COMPLETED_VERSION:
+                            str(requested)}}})
+                return requested
+            except ApiError as e:   # Conflict / transient 5xx / timeout
+                job = self.api.try_get(self.kind, self.namespace,
+                                       self.name)
+                if job is None:
+                    return None
+                ann = m.annotations(job)
+                newer = int(
+                    ann.get(c.ANNOTATION_CKPT_REQUESTED_VERSION, 0) or 0)
+                requested = max(requested, newer)
+                log.warning("elastic ack conflicted (%s); re-applying "
+                            "as v%d", e, requested)
+                continue
+        return None

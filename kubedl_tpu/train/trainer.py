@@ -187,6 +187,17 @@ class Trainer:
             self._step_fn = self._build_step()
         return self._step_fn
 
+    def remesh(self, mesh: Mesh) -> None:
+        """Adopt a new device mesh after an elastic world change
+        (docs/elastic.md): the jitted step and its shardings rebuild
+        lazily against the new topology. The caller restores state via
+        :func:`~kubedl_tpu.train.checkpoint.abstract_state_like` on the
+        new mesh (``abstract_state`` already targets ``self.mesh``), so
+        a shrink/regrow never re-initializes — the step counter and the
+        loss curve continue where the checkpoint left them."""
+        self.mesh = mesh
+        self._step_fn = None
+
     # -- loop -------------------------------------------------------------
 
     def fit(self, state: TrainState, batches, num_steps: int,

@@ -226,6 +226,15 @@ def _mini_scorecard(**jobs_overrides):
                          "serving-queue-p99")
         }},
     }
+    # the concurrency-elastic comparison block (docs/elastic.md) the
+    # day gates hold alongside everything else
+    sc["jobs"]["elastic"] = {
+        "elastic": {"completed_fraction": 1.0, "phase_violations": 0,
+                    "restart_rounds": 0, "fleet_goodput": 0.55,
+                    "reconfigurations": {"shrink": 3, "grow": 4}},
+        "baseline": {"completed_fraction": 1.0},
+        "gains": {"goodput_gain": 1.25, "recovery_p50_ratio": 0.01},
+    }
     sc["jobs"].update(jobs_overrides)
     return sc
 
