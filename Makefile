@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test test-all test-fast test-chaos test-campaign test-scheduler test-trace test-replay test-telemetry test-slo test-durability test-forensics test-replication test-elastic bench bench-controlplane bench-scheduler bench-serving-paged bench-trace bench-cluster bench-cluster-adversarial bench-elastic postmortem dryrun crds run-standalone lint native
+.PHONY: test test-all test-fast test-chaos test-campaign test-scheduler test-trace test-replay test-telemetry test-slo test-durability test-forensics test-replication test-elastic test-serving-fleet bench bench-controlplane bench-scheduler bench-serving-paged bench-serving-fleet bench-trace bench-cluster bench-cluster-adversarial bench-elastic postmortem dryrun crds run-standalone lint native
 
 # fast path (<3 min): everything except the compile-heavy compute suites
 # (those carry `pytestmark = pytest.mark.slow`). Chaos tests are fast and
@@ -133,6 +133,24 @@ test-elastic:
 bench-elastic:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 	    $(PY) bench_elastic.py
+
+# SLO-driven serving-fleet suite (disaggregated prefill/decode
+# block-table handoff, prefix LRU eviction, prefix-aware routing with
+# tenant fairness, burn-rate autoscaling + drain semantics, gate-off
+# contract; docs/serving_fleet.md)
+test-serving-fleet:
+	$(PY) -m pytest tests/ -q -m serving_fleet
+
+# serving-fleet comparison bench -> BENCH_SERVING_FLEET.json
+# (docs/serving_fleet.md): prefix-aware vs random routing (>= 1.5x
+# prefix-hit rate), disaggregated vs combined prefill/decode on a
+# long-prompt mix (>= 1.3x p99 TTFT at no decode-throughput loss), and
+# the flash-crowd autoscaler leg (pages, scales, recovers without
+# budget exhaustion, drains with zero dropped streams); FAILS on
+# regression vs the committed artifact. The tier-1 guard is
+# tests/test_serving_fleet.py.
+bench-serving-fleet:
+	JAX_PLATFORMS=cpu $(PY) bench_serving_fleet.py
 
 # render the committed adversarial campaign's forensics blocks as
 # markdown postmortems (docs/forensics.md; regenerate the blocks with

@@ -179,6 +179,10 @@ def main() -> dict:
                     help="skip the concurrency-elastic shrink-vs-evict "
                          "leg (debugging aid; the day profile's "
                          "jobs.elastic gates will fail)")
+    ap.add_argument("--skip-fleet", action="store_true",
+                    help="skip the multi-replica serving-fleet leg "
+                         "(debugging aid; the day profile's "
+                         "serving.fleet gates will fail)")
     args = ap.parse_args()
     args.out_explicit = args.out is not None
     if args.out is None:
@@ -219,6 +223,24 @@ def main() -> dict:
         print(f"serving day replayed in {time.perf_counter() - t1:.1f}s "
               f"wall ({serving['engine_ticks']} ticks, "
               f"{serving['tokens_generated']} tokens)", file=sys.stderr)
+
+    if args.profile == "day" and not args.skip_serving \
+            and not args.skip_fleet:
+        # the multi-replica serving-fleet leg (docs/serving_fleet.md):
+        # routing / disaggregation / autoscaling comparisons committed
+        # as the additive serving.fleet block — the single-engine
+        # serving day above is untouched, so every prior metric stays
+        # byte-identical
+        from kubedl_tpu.replay import run_fleet_comparison
+        tf = time.perf_counter()
+        serving["fleet"] = run_fleet_comparison(args.seed)
+        fl = serving["fleet"]
+        print(f"serving-fleet leg replayed in "
+              f"{time.perf_counter() - tf:.1f}s wall (hit-rate ratio "
+              f"{fl['routing']['hit_rate_ratio']}, ttft p99 ratio "
+              f"{fl['disagg']['ttft_p99_ratio']}, "
+              f"{fl['autoscaler']['pages_fired']} page(s))",
+              file=sys.stderr)
 
     if args.profile == "day" and not args.skip_elastic:
         # the concurrency-elastic leg (docs/elastic.md): shrink-vs-evict

@@ -1,0 +1,174 @@
+"""Serving-fleet bench: routing, disaggregation, autoscaling — one JSON.
+
+Three comparison legs through the REAL fleet stack (engines + router +
+autoscaler + headless SLO engine) on a sim clock (docs/serving_fleet.md):
+
+* **routing** — prefix-cache-aware placement vs seeded-random placement
+  on the identical tenant-labelled Zipf-prefix day; gate: the aware
+  router's prefix-hit rate (requests landing on a replica ALREADY
+  holding their shared prefix blocks) is >= 1.5x the random baseline's.
+* **disagg** — disaggregated prefill/decode lanes (block-table handoff
+  through the shared pool) vs the combined engine on a
+  long-prompt-heavy mix; gates: p99 TTFT improves >= 1.3x at no
+  decode-throughput loss.
+* **autoscaler** — a flash crowd against a one-replica fleet: the TTFT
+  objective PAGES, replicas scale up (the page verdict is a scale
+  reason), the burn clears without exhausting the error budget, and the
+  post-crowd quiet drains the fleet back down with zero dropped
+  streams.
+
+The document is bit-for-bit reproducible for a fixed ``--seed`` (no
+wall clocks; workload fingerprints committed). When a committed
+``BENCH_SERVING_FLEET.json`` exists at ``--out``, the fresh run is
+checked against it and the bench FAILS on regression — the shared
+tolerance engine, like every other bench.
+
+Usage::
+
+    python bench_serving_fleet.py [--seed 0] [--out FILE] [--no-check]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+#: absolute gates over the scorecard (path, op, threshold)
+GATES = (
+    ("routing.hit_rate_ratio", ">=", 1.5),
+    ("routing.prefix_aware.completed_fraction", ">=", 1.0),
+    ("routing.random.completed_fraction", ">=", 1.0),
+    ("routing.prefix_aware.errors", "<=", 0),
+    ("routing.random.errors", "<=", 0),
+    ("disagg.ttft_p99_ratio", ">=", 1.3),
+    ("disagg.decode_tokens_ratio", ">=", 1.0),
+    ("disagg.disaggregated.handoffs", ">=", 1),
+    ("disagg.disaggregated.completed_fraction", ">=", 1.0),
+    ("disagg.combined.completed_fraction", ">=", 1.0),
+    ("autoscaler.completed_fraction", ">=", 1.0),
+    ("autoscaler.pages_fired", ">=", 1),
+    ("autoscaler.stranded_alerts", "<=", 0),
+    ("autoscaler.min_budget_remaining", ">=", 0.0),
+    ("autoscaler.fleet.scale_ups", ">=", 1),
+    ("autoscaler.fleet.drains", ">=", 1),
+    ("autoscaler.fleet.reaped_count", ">=", 1),
+    ("autoscaler.dropped_streams", "<=", 0),
+    ("autoscaler.requests_unfinished", "<=", 0),
+)
+
+#: regression tolerances vs the committed artifact (shared engine)
+REGRESSION = (
+    ("routing.hit_rate_ratio", "higher_better", 0.05, 0.02),
+    ("routing.prefix_aware.prefix_hit_rate", "higher_better", 0.05, 0.02),
+    ("disagg.ttft_p99_ratio", "higher_better", 0.10, 0.05),
+    ("disagg.decode_tokens_ratio", "higher_better", 0.02, 0.01),
+    ("disagg.disaggregated.ttft_s.p99", "lower_better", 0.12, 0.05),
+    ("autoscaler.min_budget_remaining", "higher_better", 0.10, 0.05),
+    ("autoscaler.ttft_s.p99", "lower_better", 0.15, 0.5),
+)
+
+
+def evaluate_gates(scorecard: dict) -> dict:
+    from kubedl_tpu.replay.scorecard import _get
+    results, ok = [], True
+    for path, op, threshold in GATES:
+        value = _get(scorecard, path)
+        passed = (value is not None
+                  and (value >= threshold if op == ">=" else
+                       value <= threshold))
+        ok = ok and passed
+        results.append({"metric": path, "op": op, "threshold": threshold,
+                        "value": value, "passed": passed})
+    return {"checks": results, "passed": ok}
+
+
+def check_regression(new: dict, old: dict) -> list:
+    from kubedl_tpu.replay.scorecard import check_tolerances
+    if old.get("seed") != new.get("seed"):
+        return []
+    problems = check_tolerances(new, old, REGRESSION)
+    for path in ("autoscaler.dropped_streams",
+                 "autoscaler.stranded_alerts"):
+        from kubedl_tpu.replay.scorecard import _get
+        if _get(new, path):
+            problems.append(f"{path} must stay 0")
+    return problems
+
+
+def main() -> dict:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default="BENCH_SERVING_FLEET.json")
+    ap.add_argument("--no-check", action="store_true")
+    args = ap.parse_args()
+
+    from dataclasses import asdict
+
+    from kubedl_tpu.replay.fleet import (FLEET_PROFILES,
+                                         run_autoscaler_leg,
+                                         run_disagg_comparison,
+                                         run_routing_comparison)
+
+    t0 = time.perf_counter()
+    routing = run_routing_comparison(args.seed)
+    t1 = time.perf_counter()
+    print(f"routing leg in {t1 - t0:.1f}s wall: hit-rate ratio "
+          f"{routing['hit_rate_ratio']} (aware "
+          f"{routing['prefix_aware']['prefix_hit_rate']} vs random "
+          f"{routing['random']['prefix_hit_rate']})", file=sys.stderr)
+    disagg = run_disagg_comparison(args.seed)
+    t2 = time.perf_counter()
+    print(f"disagg leg in {t2 - t1:.1f}s wall: p99 TTFT ratio "
+          f"{disagg['ttft_p99_ratio']}, decode tokens ratio "
+          f"{disagg['decode_tokens_ratio']}, "
+          f"{disagg['disaggregated']['handoffs']} handoffs",
+          file=sys.stderr)
+    autoscaler = run_autoscaler_leg(args.seed)
+    print(f"autoscaler leg in {time.perf_counter() - t2:.1f}s wall: "
+          f"{autoscaler['pages_fired']} page(s), "
+          f"{autoscaler['fleet']['scale_ups']} scale-ups, "
+          f"{autoscaler['fleet']['drains']} drains, min budget "
+          f"{autoscaler['min_budget_remaining']}", file=sys.stderr)
+
+    scorecard = {
+        "benchmark": "serving_fleet",
+        "seed": args.seed,
+        "profiles": {name: asdict(p)
+                     for name, p in sorted(FLEET_PROFILES.items())},
+        "routing": routing,
+        "disagg": disagg,
+        "autoscaler": autoscaler,
+    }
+    scorecard["gates"] = evaluate_gates(scorecard)
+
+    problems = []
+    if not args.no_check and args.out and os.path.exists(args.out):
+        try:
+            with open(args.out) as f:
+                committed = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"warning: cannot read committed {args.out}: {e}",
+                  file=sys.stderr)
+            committed = {}
+        problems = check_regression(scorecard, committed)
+
+    print(json.dumps(scorecard))
+    if not scorecard["gates"]["passed"]:
+        failed = [c for c in scorecard["gates"]["checks"]
+                  if not c["passed"]]
+        raise SystemExit(f"GATE FAILED: {failed}")
+    if problems:
+        raise SystemExit("REGRESSION vs committed scorecard:\n  "
+                         + "\n  ".join(problems))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(scorecard, f, indent=2, sort_keys=True)
+            f.write("\n")
+    return scorecard
+
+
+if __name__ == "__main__":
+    main()

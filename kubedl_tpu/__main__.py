@@ -59,6 +59,15 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "checkpoint protocol (docs/elastic.md; also "
                         "TPUElasticSlices gate; requires "
                         "--enable-slice-scheduler)")
+    p.add_argument("--enable-serving-fleet", action="store_true",
+                   help="SLO-driven serving fleet: replica autoscaling "
+                        "on burn-rate verdicts + engine health, "
+                        "prefix-cache-aware routing with per-tenant "
+                        "fairness, disaggregated prefill/decode lanes "
+                        "with block-table handoff, console "
+                        "/api/v1/serving/fleet endpoint "
+                        "(docs/serving_fleet.md; also ServingFleet "
+                        "gate)")
     p.add_argument("--slice-capacity", default="",
                    help='static slice inventory "POOL=N,..." (e.g. '
                         '"tpu-v5p-slice/2x2x4=4") when the control plane '
@@ -211,6 +220,7 @@ def config_from_args(args: argparse.Namespace) -> OperatorConfig:
         replication_followers=args.replication_followers,
         async_snapshots=args.async_snapshots,
         enable_elastic_slices=args.enable_elastic_slices,
+        enable_serving_fleet=args.enable_serving_fleet,
     )
 
 
@@ -288,7 +298,8 @@ def main(argv=None) -> int:
                           telemetry=operator.telemetry,
                           journal=operator.journal,
                           replication=operator.replication,
-                          elastic=operator.elastic_enabled)
+                          elastic=operator.elastic_enabled,
+                          serving_fleet=operator.serving_fleet)
         console = ConsoleServer(
             proxy, ConsoleConfig(host=args.console_host,
                                  port=args.console_port))

@@ -44,7 +44,8 @@ class DataProxy:
                  event_backend: Optional[EventBackend] = None,
                  job_kinds=TRAINING_KINDS, tracer=None, scheduler=None,
                  telemetry=None, journal=None, replication=None,
-                 elastic: bool = False):
+                 elastic: bool = False, serving_fleet=None,
+                 serving_autoscaler=None, serving_router=None):
         self.api = api
         self.object_backend = object_backend
         self.event_backend = event_backend
@@ -67,6 +68,12 @@ class DataProxy:
         #: concurrency-elastic slices on (docs/elastic.md); False = the
         #: /api/v1/elastic endpoints answer 501
         self.elastic_enabled = bool(elastic)
+        #: the live ServingFleet (+ optional autoscaler/router) when
+        #: this process hosts serving replicas (docs/serving_fleet.md);
+        #: None = the /api/v1/serving/fleet endpoint answers 501
+        self.serving_fleet = serving_fleet
+        self.serving_autoscaler = serving_autoscaler
+        self.serving_router = serving_router
 
     # -- jobs -------------------------------------------------------------
 
@@ -690,6 +697,18 @@ class DataProxy:
             "reconfigureRequestedAt": ann.get(
                 c.ANNOTATION_ELASTIC_RECONFIGURE_AT),
         }
+
+    def serving_fleet_status(self) -> dict:
+        """The fleet snapshot (docs/serving_fleet.md): per-replica
+        health, drain state, router placement counters, and the
+        autoscaler's event log — everything the operator needs to
+        answer "why did the fleet scale"."""
+        out = self.serving_fleet.status()
+        if self.serving_router is not None:
+            out["router"] = self.serving_router.stats()
+        if self.serving_autoscaler is not None:
+            out["autoscaler"] = self.serving_autoscaler.status()
+        return out
 
     def explain_pending(self, namespace: str, name: str) -> Optional[dict]:
         """The pending-job explainer verdict (requires the scheduler);

@@ -501,6 +501,18 @@ class ConsoleServer:
                 raise NotFound(f"job {ns}/{name} not found")
             return ok(state)
 
+        # serving fleet (docs/serving_fleet.md): replica health, drain
+        # state, router placement counters, autoscaler events; 501 when
+        # this process hosts no fleet (gate off, or a plain operator)
+        if path == "/api/v1/serving/fleet":
+            if self.proxy.serving_fleet is None:
+                return 501, {"code": 501,
+                             "msg": "serving fleet disabled "
+                                    "(--enable-serving-fleet / "
+                                    "ServingFleet gate, and this "
+                                    "process hosts no replicas)"}, []
+            return ok(self.proxy.serving_fleet_status())
+
         # fleet goodput rollup (docs/telemetry.md): the live fleet-wide
         # number BENCH_CLUSTER gates on; 501 with the telemetry gate off
         if path == "/api/v1/telemetry/goodput":

@@ -145,6 +145,15 @@ class OperatorConfig:
     #: fixed-width admission pass and engine failover stay
     #: byte-identical, and no kubedl_elastic_* family registers.
     enable_elastic_slices: bool = False
+    #: SLO-driven serving fleet (docs/serving_fleet.md). Also
+    #: switchable via the ServingFleet gate; either turns it on. Off by
+    #: default: no kubedl_serving_fleet_*/kubedl_serving_free_blocks
+    #: family registers and the console fleet endpoint answers 501 (the
+    #: byte-identical-disabled convention). The serving replicas
+    #: themselves live in the predictor process — the operator side
+    #: carries the metric families and the console surface a hosted
+    #: fleet plugs into.
+    enable_serving_fleet: bool = False
 
 
 @dataclass
@@ -173,6 +182,15 @@ class Operator:
     #: concurrency-elastic slices on (docs/elastic.md): the console's
     #: /api/v1/elastic endpoints answer only when True
     elastic_enabled: bool = False
+    #: SLO-driven serving fleet on (docs/serving_fleet.md)
+    serving_fleet_enabled: bool = False
+    #: the ServingFleetMetrics bundle when the gate is on (a hosted
+    #: fleet adopts it so its health lands in THIS exposition)
+    serving_fleet_metrics: object = None
+    #: a live ServingFleet when this process hosts one (the predictor
+    #: binary / tests); None in the plain operator — the console's
+    #: /api/v1/serving/fleet endpoint answers 501 without it
+    serving_fleet: object = None
 
     def run_until_idle(self, **kw):
         return self.manager.run_until_idle(**kw)
@@ -281,6 +299,17 @@ def build_operator(api: Optional[APIServer] = None,
     if elastic_enabled:
         from ..metrics.registry import ElasticMetrics
         elastic_metrics = ElasticMetrics(registry)
+    # SLO-driven serving fleet (docs/serving_fleet.md): the
+    # kubedl_serving_fleet_*/kubedl_serving_free_blocks families
+    # register only here, so the disabled exposition stays
+    # byte-identical; the fleet object itself lives in whichever
+    # process hosts the replicas and adopts this metrics bundle
+    serving_fleet_enabled = (config.enable_serving_fleet
+                             or gates.enabled(ft.SERVING_FLEET))
+    serving_fleet_metrics = None
+    if serving_fleet_enabled:
+        from ..metrics.registry import ServingFleetMetrics
+        serving_fleet_metrics = ServingFleetMetrics(registry)
     # fleet telemetry bundle (docs/telemetry.md): one instance shared by
     # every engine (goodput harvest + straggler scans) and the console
     # (explainer / job-detail goodput); None keeps the disabled path free
@@ -417,7 +446,9 @@ def build_operator(api: Optional[APIServer] = None,
                     scheduler=scheduler, tracer=tracer,
                     telemetry=telemetry, journal=journal,
                     replication=replication,
-                    elastic_enabled=elastic_enabled)
+                    elastic_enabled=elastic_enabled,
+                    serving_fleet_enabled=serving_fleet_enabled,
+                    serving_fleet_metrics=serving_fleet_metrics)
 
 
 def _storage_backend(spec: str, for_events: bool = False):

@@ -61,6 +61,14 @@ DURABLE_CONTROL_PLANE = "DurableControlPlane"
 #: protocol; off by default — the fixed-width admission pass stays
 #: byte-identical (pinned by test). Requires the slice scheduler.
 TPU_ELASTIC_SLICES = "TPUElasticSlices"
+#: SLO-driven serving fleet (docs/serving_fleet.md): replica
+#: autoscaling on burn-rate verdicts + engine health gauges,
+#: prefix-cache-aware request routing with per-tenant fairness, and
+#: disaggregated prefill/decode lanes with block-table handoff; off by
+#: default — no ServingFleet object exists, no kubedl_serving_fleet_*/
+#: kubedl_serving_free_blocks families register, and the console fleet
+#: endpoint answers 501 (the byte-identical-disabled convention)
+SERVING_FLEET = "ServingFleet"
 
 _DEFAULTS = {
     GANG_SCHEDULING: True,           # Beta
@@ -76,6 +84,7 @@ _DEFAULTS = {
     TPU_PLACEMENT_SCORING: False,    # Alpha
     DURABLE_CONTROL_PLANE: False,    # Alpha
     TPU_ELASTIC_SLICES: False,       # Alpha
+    SERVING_FLEET: False,            # Alpha
 }
 
 ENV_FEATURE_GATES = "KUBEDL_FEATURE_GATES"

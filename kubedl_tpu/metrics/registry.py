@@ -520,6 +520,100 @@ class ElasticMetrics:
             buckets=_MTTR_BUCKETS)
 
 
+class ServingFleetMetrics:
+    """Serving-fleet families (docs/serving_fleet.md): the per-replica
+    engine health gauges the ServingAutoscaler consumes (free pool
+    blocks, queue depth, active lanes), fleet size / scale events, the
+    router's placement counters, and prefill→decode block-table
+    handoffs. Constructed only when the ServingFleet gate is on — the
+    disabled exposition carries none of these families (the
+    byte-identical-disabled convention)."""
+
+    def __init__(self, registry: Optional[Registry] = None):
+        self.registry = registry or Registry()
+        r = self.registry
+        self.free_blocks = r.gauge(
+            "kubedl_serving_free_blocks",
+            "Unreferenced KV pool blocks per serving replica (the "
+            "autoscaler's memory-pressure signal)", ("replica",))
+        self.queue_depth = r.gauge(
+            "kubedl_serving_queue_depth",
+            "Requests queued per serving replica (admitted to no lane "
+            "yet)", ("replica",))
+        self.active_lanes = r.gauge(
+            "kubedl_serving_active_lanes",
+            "Lanes holding an in-flight request per serving replica "
+            "(parked prefill lanes included)", ("replica",))
+        self.replicas = r.gauge(
+            "kubedl_serving_fleet_replicas",
+            "Live serving replicas (draining replicas included until "
+            "reaped)")
+        self.draining = r.gauge(
+            "kubedl_serving_fleet_draining",
+            "Replicas currently draining (no new placements; in-flight "
+            "streams finishing)")
+        self.scale_events = r.counter(
+            "kubedl_serving_fleet_scale_events_total",
+            "Autoscaler actions by direction (up = replica added, "
+            "drain = scale-down began, reap = drained replica removed)",
+            ("direction",))
+        self.router_prefix_hits = r.counter(
+            "kubedl_serving_router_prefix_hits_total",
+            "Requests placed on a replica already holding their shared "
+            "prefix blocks")
+        self.router_prefix_misses = r.counter(
+            "kubedl_serving_router_prefix_misses_total",
+            "Prefix-bearing requests placed on a replica without their "
+            "prefix resident")
+        self.router_tenant_spills = r.counter(
+            "kubedl_serving_router_tenant_spills_total",
+            "Placements diverted off the preferred replica because the "
+            "tenant's queue already held its fair share there",
+            ("queue",))
+        self.handoffs = r.counter(
+            "kubedl_serving_prefill_handoffs_total",
+            "Prefill→decode block-table handoffs per replica "
+            "(disaggregated lanes only)", ("replica",))
+        self._handoffs_seen: dict = {}
+        self._replicas_seen: set = set()
+
+    def note_reaped(self, replica: str, handoffs_total: int) -> None:
+        """Flush a reaped replica's final handoff delta into the counter
+        BEFORE its engine disappears from ``fleet.health()`` — without
+        this, handoffs performed between the last refresh and the reap
+        would vanish from the exposition (the bench's fleet-lifetime
+        rollup keeps them, and the two must agree)."""
+        delta = handoffs_total - self._handoffs_seen.pop(replica, 0)
+        if delta > 0:
+            self.handoffs.inc(delta, replica=replica)
+
+    def refresh(self, fleet) -> None:
+        """Push one fleet health snapshot (gauges per live replica;
+        series of reaped replicas are removed, not frozen)."""
+        live = set()
+        draining = 0
+        for h in fleet.health():
+            name = h["replica"]
+            live.add(name)
+            if h.get("draining"):
+                draining += 1
+            self.free_blocks.set(h.get("free_blocks") or 0, replica=name)
+            self.queue_depth.set(h["queue_depth"], replica=name)
+            self.active_lanes.set(h["active_lanes"], replica=name)
+            delta = h["handoffs"] - self._handoffs_seen.get(name, 0)
+            if delta > 0:
+                self.handoffs.inc(delta, replica=name)
+                self._handoffs_seen[name] = h["handoffs"]
+        for name in self._replicas_seen - live:
+            self.free_blocks.remove(replica=name)
+            self.queue_depth.remove(replica=name)
+            self.active_lanes.remove(replica=name)
+            self._handoffs_seen.pop(name, None)
+        self._replicas_seen = live
+        self.replicas.set(len(live))
+        self.draining.set(draining)
+
+
 class TraceMetrics:
     """Span-recorder health (docs/tracing.md): recorded-span throughput
     per component, ring-buffer occupancy, and the overflow-drop counter

@@ -23,3 +23,7 @@ from .scorecard import (build_campaign_scorecard,  # noqa: F401
                         evaluate_campaign_gates)
 from .elastic import (build_elastic_block,  # noqa: F401
                       run_elastic_comparison)
+from .fleet import (FLEET_PROFILES, FleetProfile,  # noqa: F401
+                    FleetWorkload, ServingFleetReplay, generate_fleet,
+                    run_autoscaler_leg, run_disagg_comparison,
+                    run_fleet_comparison, run_routing_comparison)

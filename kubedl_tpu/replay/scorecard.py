@@ -72,6 +72,24 @@ _GATES = {
         ("jobs.elastic.elastic.restart_rounds", "<=", 0),
         ("jobs.elastic.gains.goodput_gain", ">=", 1.02),
         ("jobs.elastic.gains.recovery_p50_ratio", "<=", 0.5),
+        # serving-fleet leg (docs/serving_fleet.md), folded in
+        # additively: prefix-aware routing must beat random placement
+        # on hit rate, disaggregated prefill/decode must beat the
+        # combined engine on tail TTFT at no decode-throughput loss,
+        # and the autoscaler leg must page, scale, recover without
+        # budget exhaustion, and drain without dropping a stream
+        ("serving.fleet.routing.hit_rate_ratio", ">=", 1.5),
+        ("serving.fleet.disagg.ttft_p99_ratio", ">=", 1.3),
+        ("serving.fleet.disagg.decode_tokens_ratio", ">=", 1.0),
+        ("serving.fleet.disagg.disaggregated.handoffs", ">=", 1),
+        ("serving.fleet.autoscaler.pages_fired", ">=", 1),
+        ("serving.fleet.autoscaler.stranded_alerts", "<=", 0),
+        ("serving.fleet.autoscaler.min_budget_remaining", ">=", 0.0),
+        ("serving.fleet.autoscaler.dropped_streams", "<=", 0),
+        ("serving.fleet.autoscaler.requests_unfinished", "<=", 0),
+        ("serving.fleet.autoscaler.fleet.scale_ups", ">=", 1),
+        ("serving.fleet.autoscaler.fleet.drains", ">=", 1),
+        ("serving.fleet.autoscaler.fleet.reaped_count", ">=", 1),
     ),
 }
 
@@ -123,6 +141,18 @@ _REGRESSION = (
     ("jobs.elastic.gains.goodput_gain", "higher_better", 0.05, 0.02),
     ("jobs.elastic.gains.recovery_p50_ratio", "lower_better", 0.50, 0.01),
     ("jobs.elastic.elastic.fleet_goodput", "higher_better", 0.05, 0.01),
+    # serving-fleet leg (docs/serving_fleet.md): the routing and
+    # disaggregation margins must not quietly thin, and the autoscaler
+    # leg's surviving budget must not erode, even while the absolute
+    # gates still pass
+    ("serving.fleet.routing.hit_rate_ratio", "higher_better", 0.05, 0.02),
+    ("serving.fleet.routing.prefix_aware.prefix_hit_rate",
+     "higher_better", 0.05, 0.02),
+    ("serving.fleet.disagg.ttft_p99_ratio", "higher_better", 0.10, 0.05),
+    ("serving.fleet.disagg.decode_tokens_ratio",
+     "higher_better", 0.02, 0.01),
+    ("serving.fleet.autoscaler.min_budget_remaining",
+     "higher_better", 0.10, 0.05),
 )
 
 #: adversarial-campaign gates, applied inside EVERY seed block of the

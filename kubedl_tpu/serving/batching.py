@@ -171,11 +171,16 @@ class _Prefix:
     KV copy (legacy ``_load_prefix`` path); ``blocks`` are the paged
     pool blocks pinned for the prefix's FULL blocks only — the partial
     tail block is never shared (two lanes would write different tokens
-    into it), it is re-prefilled per lane instead."""
+    into it), it is re-prefilled per lane instead. ``pinned`` prefixes
+    are exempt from the least-recently-hit eviction that makes room at
+    ``max_prefixes`` (docs/serving_fleet.md: the fleet router registers
+    prefixes opportunistically; an operator-pinned system prompt must
+    never be displaced by that churn)."""
     key: tuple
     plen: int
     stored: Optional[dict] = None
     blocks: tuple = ()
+    pinned: bool = False
 
 
 @dataclass
@@ -288,12 +293,21 @@ class _Lane:
     #: (shared prefix blocks first, then private). Freed via decref when
     #: the lane finishes/cancels/preempts.
     blocks: list = field(default_factory=list)
+    #: disaggregated serving (docs/serving_fleet.md): a prefill lane
+    #: whose request finished prefilling and is waiting for a free
+    #: decode lane to take the block-table handoff. Parked lanes are
+    #: masked out of decode ticks (their KV must not move until the
+    #: handoff lands).
+    parked: bool = False
+    parked_at: float = 0.0     # tracer clock at park (handoff span)
 
     def reset(self) -> None:
         self.request = None
         self.pos = 0
         self.remaining = 0
         self.blocks = []
+        self.parked = False
+        self.parked_at = 0.0
 
 
 class ContinuousBatchingEngine:
@@ -312,7 +326,8 @@ class ContinuousBatchingEngine:
                  spec_k: int = 0, quantize_draft: Optional[str] = None,
                  kv_mode: Optional[str] = None, kv_block: int = 64,
                  pool_blocks: Optional[int] = None,
-                 headroom_blocks: int = 1, tracer=None):
+                 headroom_blocks: int = 1, tracer=None,
+                 prefill_lanes: int = 0):
         from .engine import (SpecStats, init_mesh_serving, resolve_family,
                              sample_logits)
         self.config = config
@@ -345,6 +360,35 @@ class ContinuousBatchingEngine:
         #: admission watermark: free blocks required beyond the prompt's
         #: so a fresh lane can decode a while before growing
         self.headroom_blocks = max(int(headroom_blocks), 0)
+        #: disaggregated prefill/decode (docs/serving_fleet.md): the
+        #: first ``prefill_lanes`` lanes only ever run prefills; a
+        #: freshly-prefilled request hands its BLOCK TABLE to a free
+        #: decode lane (no KV copied — the table entries ARE the KV),
+        #: so a long prompt's chunked prefill never occupies a decode
+        #: lane. 0 (the default) = the combined engine, byte-identical.
+        self.prefill_lanes = int(prefill_lanes)
+        if self.prefill_lanes:
+            if self.kv_mode != "paged":
+                raise ValueError(
+                    "disaggregated prefill lanes require the paged KV "
+                    "layout (the handoff moves block-table references; "
+                    "a dense slab would need a device KV copy)")
+            if not 0 < self.prefill_lanes < lanes:
+                raise ValueError(
+                    f"prefill_lanes {self.prefill_lanes} must leave at "
+                    f"least one decode lane (lanes {lanes})")
+            if draft_params is not None and spec_k:
+                raise ValueError(
+                    "speculative decoding and disaggregated prefill "
+                    "lanes are mutually exclusive (the verify round "
+                    "spans every lane)")
+        #: lifetime prefill→decode block-table handoffs (/metrics)
+        self.handoffs = 0
+        #: prompt tokens prefilled in the current / all scheduler ticks
+        #: (the replay's cost-model seam: a combined deployment's decode
+        #: cadence stalls for the prefill work a tick performed)
+        self.prefill_tokens_step = 0
+        self.prefill_tokens_total = 0
         #: lifetime preemption count (pool ran dry; /metrics counter)
         self.preempted = 0
         #: peak simultaneously-active lanes (the bench's concurrency
@@ -477,6 +521,11 @@ class ContinuousBatchingEngine:
         self._fill_prefix = _fill_prefix
         self._load_prefix = _load_prefix
         self._prefixes: list = []   # sorted [_Prefix], longest first
+        #: admission-time hit ordinals per prefix key — the
+        #: least-recently-hit order ``register_prefix`` evicts in when
+        #: the cap is reached (mutated under ``_sched_lock`` only)
+        self._prefix_hits: dict = {}
+        self._prefix_hit_clock = 0
         self._sample = sample_logits
         if self.spec_k:
             self._d_decode = make_decode(self.dcfg, self.dfam)
@@ -519,7 +568,8 @@ class ContinuousBatchingEngine:
     # -- public API -------------------------------------------------------
 
     def register_prefix(self, tokens: Sequence[int],
-                        max_prefixes: Optional[int] = None) -> None:
+                        max_prefixes: Optional[int] = None,
+                        pinned: bool = False) -> None:
         """Prefill a shared prompt prefix ONCE; later requests whose
         prompts start with it skip re-prefilling it — the standard
         system-prompt optimization. Greedy outputs are unchanged (the
@@ -531,7 +581,16 @@ class ContinuousBatchingEngine:
         tables at them (refcounted copy-on-write sharing, no device
         copy at admission); the partial tail block — where a lane's own
         tokens would land next to prefix tokens — is never shared and
-        is re-prefilled per lane."""
+        is re-prefilled per lane.
+
+        At ``max_prefixes`` the LEAST-RECENTLY-HIT unpinned prefix is
+        evicted (its pin decref'd — lanes still referencing the blocks
+        keep them alive until they finish) instead of the registration
+        failing: the fleet router registers prefixes opportunistically
+        on whichever replica it warms (docs/serving_fleet.md), and a
+        hard raise there would wedge placement on a full cache. Only
+        when every stored prefix is ``pinned`` does the cap still
+        raise."""
         tokens = list(tokens)
         if not tokens:
             raise ValueError("empty prefix")
@@ -542,13 +601,14 @@ class ContinuousBatchingEngine:
         key = tuple(tokens)
         if max_prefixes is not None and \
                 not any(p.key == key for p in self._prefixes) and \
-                len(self._prefixes) >= max_prefixes:
+                len(self._prefixes) >= max_prefixes and \
+                all(p.pinned for p in self._prefixes):
             # optimistic pre-check: a rejected registration must not
             # first burn a full device prefill (the authoritative check
             # below runs under the lock)
             raise ValueError(
-                f"prefix limit {max_prefixes} reached "
-                "(each prefix pins a KV block in HBM)")
+                f"prefix limit {max_prefixes} reached and every stored "
+                "prefix is pinned (each prefix pins a KV block in HBM)")
         stored = None
         if self.kv_mode == "dense":
             bucket = min(_bucket(plen), self.max_len)
@@ -564,11 +624,23 @@ class ContinuousBatchingEngine:
             # cap enforced HERE, under the lock: a server-side
             # check-then-call would race concurrent registrations past
             # the limit, and an idempotent re-register (key already
-            # stored) must never be rejected — it pins no new HBM
-            if max_prefixes is not None and len(entries) >= max_prefixes:
-                raise ValueError(
-                    f"prefix limit {max_prefixes} reached "
-                    "(each prefix pins a KV block in HBM)")
+            # stored) must never be rejected — it pins no new HBM.
+            # Over-cap registrations evict the least-recently-hit
+            # unpinned prefix; the raise survives only for an all-pinned
+            # cache (nothing is legally evictable).
+            evicted: list = []
+            if max_prefixes is not None:
+                while len(entries) >= max_prefixes:
+                    victims = [p for p in entries if not p.pinned]
+                    if not victims:
+                        raise ValueError(
+                            f"prefix limit {max_prefixes} reached and "
+                            "every stored prefix is pinned (each prefix "
+                            "pins a KV block in HBM)")
+                    victim = min(victims, key=lambda p: (
+                        self._prefix_hits.get(p.key, 0), p.key))
+                    entries = [p for p in entries if p.key != victim.key]
+                    evicted.append(victim)
             blocks: tuple = ()
             if self.kv_mode != "dense":
                 # release a replaced pin BEFORE allocating the new one:
@@ -578,9 +650,15 @@ class ContinuousBatchingEngine:
                 # takes). The entry list is swapped in first so a failed
                 # re-fill can never leave a registered entry pointing at
                 # freed blocks — the old registration is simply gone.
+                # Evicted victims decref the same way: a lane still
+                # sharing the blocks keeps them alive; an unreferenced
+                # pin returns to the free list right here.
                 for old in self._prefixes:
                     if old.key == key and old.blocks:
                         self._bpool.decref(old.blocks)
+                for victim in evicted:
+                    if victim.blocks:
+                        self._bpool.decref(victim.blocks)
                 self._prefixes = entries
                 # KV at position p depends only on tokens <= p, so the
                 # shareable full blocks need exactly the first
@@ -607,8 +685,17 @@ class ContinuousBatchingEngine:
                         # requires).
                         self._recover_locked()
                         raise
+            for victim in evicted:
+                self._prefix_hits.pop(victim.key, None)
+            # seed the hit clock at registration: a never-yet-admitted
+            # prefix must rank by registration recency, not tie at 0 —
+            # otherwise the victim among fresh registrations falls to
+            # arbitrary token-tuple order and router-driven churn can
+            # evict the prefix it registered one request ago
+            self._record_prefix_hit(key)
             entries = entries + [_Prefix(key=key, plen=plen,
-                                         stored=stored, blocks=blocks)]
+                                         stored=stored, blocks=blocks,
+                                         pinned=bool(pinned))]
             entries.sort(key=lambda p: -p.plen)
             self._prefixes = entries
 
@@ -664,26 +751,83 @@ class ContinuousBatchingEngine:
                 if p.blocks:
                     self._bpool.decref(p.blocks)
             self._prefixes = []
+            self._prefix_hits = {}
 
-    def _match_prefix(self, prompt: list):
+    def _record_prefix_hit(self, key: tuple) -> None:
+        """Admission-time LRU bookkeeping (caller holds _sched_lock)."""
+        self._prefix_hit_clock += 1
+        self._prefix_hits[key] = self._prefix_hit_clock
+
+    def _match_prefix(self, prompt: list, record_hit: bool = True):
         """Dense-mode match: (stored KV, suffix start)."""
         for p in self._prefixes:
             if len(prompt) >= p.plen and tuple(prompt[:p.plen]) == p.key:
+                if record_hit:
+                    self._record_prefix_hit(p.key)
                 # keep at least one suffix token so the prefill has a
                 # position to read logits from (re-running the prefix's
                 # last token overwrites its own slot with identical KV)
                 return p.stored, min(p.plen, len(prompt) - 1)
         return None, 0
 
-    def _match_prefix_blocks(self, prompt: list):
+    def _match_prefix_blocks(self, prompt: list, record_hit: bool = True):
         """Paged-mode match: (shareable block ids, suffix start). Shares
         only FULL blocks, clamped so at least one suffix token remains
         to prefill (start = n_shared * block <= len(prompt) - 1)."""
         for p in self._prefixes:
             if len(prompt) >= p.plen and tuple(prompt[:p.plen]) == p.key:
+                if record_hit:
+                    self._record_prefix_hit(p.key)
                 n = min(len(p.blocks), (len(prompt) - 1) // self.kv_block)
                 return list(p.blocks[:n]), n * self.kv_block
         return [], 0
+
+    def prefix_residency(self, prompt: Sequence[int]) -> int:
+        """Pool blocks a registered prefix would share with this prompt
+        right now (0 = no resident prefix). The fleet router's placement
+        signal (docs/serving_fleet.md): the refcounted pool makes
+        residency a pure host-side read. Deliberately does NOT touch the
+        LRU hit clock — the router probes EVERY replica per request, and
+        only real admissions should count as hits."""
+        if self.kv_mode == "dense":
+            return 0
+        with self._sched_lock:
+            shared, _ = self._match_prefix_blocks(list(prompt),
+                                                  record_hit=False)
+        return len(shared)
+
+    def has_prefix(self, tokens: Sequence[int]) -> bool:
+        """Whether exactly this prefix is registered (the router's
+        warm-check before a router-driven ``register_prefix``)."""
+        key = tuple(tokens)
+        with self._sched_lock:
+            return any(p.key == key for p in self._prefixes)
+
+    @property
+    def queue_depth(self) -> int:
+        with self._cv:
+            return len(self._queue)
+
+    def health(self) -> dict:
+        """The autoscaler's control inputs (docs/serving_fleet.md):
+        free pool blocks, queue depth, lane occupancy, handoff and
+        preemption counters — one consistent snapshot per lock."""
+        with self._sched_lock:
+            active = sum(1 for l in self._lane_state
+                         if l.request is not None)
+            parked = sum(1 for l in self._lane_state if l.parked)
+            free = (self._bpool.free_count if self.kv_mode != "dense"
+                    else None)
+        return {
+            "queue_depth": self.queue_depth,
+            "active_lanes": active,
+            "parked_lanes": parked,
+            "free_blocks": free,
+            "lanes": self.lanes,
+            "prefill_lanes": self.prefill_lanes,
+            "handoffs": self.handoffs,
+            "preempted": self.preempted,
+        }
 
     def validate(self, prompt: Sequence[int], max_new: int) -> None:
         """Raise ValueError if the request can never fit the cache —
@@ -846,7 +990,8 @@ class ContinuousBatchingEngine:
                     self._fill_prefix_blocks(
                         blocks, list(p.key)[:len(blocks) * self.kv_block])
                 entries.append(_Prefix(key=p.key, plen=p.plen,
-                                       stored=p.stored, blocks=blocks))
+                                       stored=p.stored, blocks=blocks,
+                                       pinned=p.pinned))
             self._prefixes = entries
         if self.spec_k:
             # the draft cache is donated into _d_decode/_d_prefill too
@@ -931,6 +1076,8 @@ class ContinuousBatchingEngine:
                                      for p in self._prefixes),
                 "block_allocs": bp.allocs,
                 "preempted": self.preempted,
+                "handoffs": self.handoffs,
+                "prefill_tokens": self.prefill_tokens_total,
             })
         return out
 
@@ -990,6 +1137,65 @@ class ContinuousBatchingEngine:
             lane.blocks = []
             self._tables[i, :] = 0
         lane.request = None
+        lane.parked = False
+        lane.parked_at = 0.0
+
+    def _handoff(self, src: int, dst: int) -> None:
+        """Move a freshly-prefilled request from prefill lane ``src`` to
+        decode lane ``dst``: the block-table row, the cursor token, and
+        the position move; the KV itself never does — the table entries
+        reference the same shared-pool blocks (docs/serving_fleet.md).
+        Caller holds ``_sched_lock``."""
+        s, d = self._lane_state[src], self._lane_state[dst]
+        req = s.request
+        d.request, d.pos, d.remaining = req, s.pos, s.remaining
+        d.blocks, s.blocks = s.blocks, []
+        d.parked = False
+        self._tables[dst, :] = self._tables[src, :]
+        self._tables[src, :] = 0
+        self._cur[dst, 0] = self._cur[src, 0]
+        self._pos[dst] = self._pos[src]
+        s.request = None
+        s.pos = 0
+        s.remaining = 0
+        s.parked = False
+        self.handoffs += 1
+        if self.tracer.enabled and req.trace_id:
+            now = self.tracer.clock()
+            self.tracer.record(
+                "request.handoff", s.parked_at or now, now,
+                trace_id=req.trace_id, parent_id=req._span_root,
+                component="serving",
+                attributes={"fromLane": src, "toLane": dst,
+                            "blocks": len(d.blocks)})
+            # decode genuinely starts on the decode lane, not at the
+            # prefill lane's first-token emit — the decode span must
+            # not swallow the parked wait
+            req._t_decode = now
+        s.parked_at = 0.0
+
+    def _try_handoffs(self) -> None:
+        """Hand each parked prefill lane's request to a free decode
+        lane, FIFO over lane index (admission fills lanes in index
+        order, so lower index == earlier arrival). A cancelled request
+        parked mid-handoff is freed here — its blocks decref exactly
+        like a cancelled decode lane's, so a cancel between prefill and
+        handoff leaks nothing."""
+        for src in range(self.prefill_lanes):
+            lane = self._lane_state[src]
+            if not lane.parked:
+                continue
+            req = lane.request
+            if req.cancel_requested:
+                self._free_lane(src)
+                req._finish()
+                self._trace_finish(req)
+                continue
+            dst = next((j for j in range(self.prefill_lanes, self.lanes)
+                        if self._lane_state[j].request is None), None)
+            if dst is None:
+                return           # every decode lane busy: wait parked
+            self._handoff(src, dst)
 
     def _preempt_for_blocks(self) -> bool:
         """Pool ran dry mid-step: evict the lowest-progress active lane
@@ -1029,9 +1235,11 @@ class ContinuousBatchingEngine:
         """Ensure every active lane's table covers a write at
         ``pos + extra``, preempting lowest-progress lanes while the pool
         is dry (the growing lane itself can be the victim — it is then
-        simply requeued)."""
+        simply requeued). Parked lanes are skipped: they write nothing
+        until their handoff lands, and growing them early could trigger
+        a needless preemption."""
         for i, lane in enumerate(self._lane_state):
-            while lane.request is not None and \
+            while lane.request is not None and not lane.parked and \
                     not self._ensure_blocks(i, lane.pos + extra):
                 if not self._preempt_for_blocks():
                     break
@@ -1323,6 +1531,8 @@ class ContinuousBatchingEngine:
                 self._assert_parity(logits, logits_p, "prefill", rows=[0])
             else:
                 logits = logits_p
+        self.prefill_tokens_step += plen - prefill_from
+        self.prefill_tokens_total += plen - prefill_from
         self._key, sub = jax.random.split(self._key)
         t, k_, p_ = self._lane_sampling(req)
         if t <= 0.0:
@@ -1387,22 +1597,59 @@ class ContinuousBatchingEngine:
 
     def _step_once(self) -> bool:
         """Fill free lanes, run one decode tick (or a speculative round
-        when a draft model is configured). Returns False once idle."""
+        when a draft model is configured). Returns False once idle.
+
+        Disaggregated mode (``prefill_lanes`` > 0): handoffs land
+        first (a decode lane freed last tick takes the oldest parked
+        request), admissions target prefill lanes only, and a
+        just-prefilled request parks for handoff — so a long prompt's
+        chunked prefill never occupies a decode lane, and the decode
+        tick's cadence is independent of prefill work."""
         gen = self.gen
+        self.prefill_tokens_step = 0
         stalled = False
-        for i, lane in enumerate(self._lane_state):
-            while self._queue and lane.request is None:
-                if not self._admit(i):
-                    # FCFS: the head is waiting on pool capacity —
-                    # every other free lane would stall on it too
-                    stalled = True
+        if self.prefill_lanes:
+            self._try_handoffs()
+            for i in range(self.prefill_lanes):
+                lane = self._lane_state[i]
+                while self._queue and lane.request is None:
+                    if not self._admit(i):
+                        # FCFS: the head is waiting on pool capacity —
+                        # every other free lane would stall on it too
+                        stalled = True
+                        break
+                    if lane.request is not None:
+                        # prefilled, first token emitted: park for the
+                        # block-table handoff (an immediately-free
+                        # decode lane takes it now, re-opening this
+                        # prefill lane within the same tick)
+                        lane.parked = True
+                        if self.tracer.enabled and lane.request.trace_id:
+                            lane.parked_at = self.tracer.clock()
+                        self._try_handoffs()
+                if stalled or not self._queue:
                     break
-            if stalled or not self._queue:
-                break
+        else:
+            for i, lane in enumerate(self._lane_state):
+                while self._queue and lane.request is None:
+                    if not self._admit(i):
+                        # FCFS: the head is waiting on pool capacity —
+                        # every other free lane would stall on it too
+                        stalled = True
+                        break
+                if stalled or not self._queue:
+                    break
         self.peak_active = max(self.peak_active, sum(
             1 for l in self._lane_state if l.request is not None))
         if not self._active():
             return bool(self._queue)
+        if self.prefill_lanes and not any(
+                l.request is not None and not l.parked
+                for l in self._lane_state):
+            # only parked work left: nothing may decode this tick (the
+            # parked KV must not move before its handoff), but work
+            # remains — the next tick's handoff pass places it
+            return True
         if self.spec_k:
             k = self._spec_round_k()
             if k >= 1:
@@ -1421,15 +1668,28 @@ class ContinuousBatchingEngine:
             self._grow_active(0)
             if not self._active():
                 return bool(self._queue)
-        # one decode tick for every lane (dead lanes compute garbage)
-        cur, pos = jnp.asarray(self._cur), jnp.asarray(self._pos)
+        # one decode tick for every lane (dead lanes compute garbage).
+        # Parked prefill lanes are masked to the garbage sink: their
+        # tables hold LIVE blocks awaiting handoff, and an unmasked
+        # decode write would corrupt the position their decode lane is
+        # about to continue from.
+        parked_rows = ([i for i, l in enumerate(self._lane_state)
+                        if l.parked] if self.prefill_lanes else [])
+        pos_np = self._pos
+        tbl_np = self._tables if self.kv_mode != "dense" else None
+        if parked_rows:
+            pos_np = self._pos.copy()
+            pos_np[parked_rows] = 0
+            tbl_np = self._tables.copy()
+            tbl_np[parked_rows, :] = 0
+        cur, pos = jnp.asarray(self._cur), jnp.asarray(pos_np)
         if self.kv_mode == "dense":
             logits, self._cache = self._decode(self.params, self._cache,
                                                cur, pos)
         elif self.kv_mode == "paged":
             logits, self._pool = self._decode_p(
                 self.params, self._pool, cur, pos,
-                jnp.asarray(self._tables))
+                jnp.asarray(tbl_np))
         else:
             logits, self._cache = self._decode(self.params, self._cache,
                                                cur, pos)
@@ -1474,8 +1734,8 @@ class ContinuousBatchingEngine:
                                                  jnp.asarray(nxt)))
         for i, lane in enumerate(self._lane_state):
             req = lane.request
-            if req is None:
-                continue
+            if req is None or lane.parked:
+                continue                 # parked: awaiting handoff
             tok = int(nxt[i])
             req._push(tok, float(lane_lps[i]) if req.want_logprobs else None)
             lane.pos += 1

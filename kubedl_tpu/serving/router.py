@@ -1,0 +1,220 @@
+"""Prefix-cache-aware request routing with per-tenant fairness.
+
+The fleet's placement layer (docs/serving_fleet.md): a request whose
+prompt starts with a registered shared prefix should land on the replica
+ALREADY holding that prefix's pool blocks — the refcounted
+:class:`~kubedl_tpu.serving.batching.BlockPool` makes residency a pure
+host-side read (``engine.prefix_residency``), so placement costs no
+device work. Two guards keep affinity honest:
+
+* **router-driven registration**: a declared prefix the chosen replica
+  has never seen is registered there on first placement (the engine's
+  least-recently-hit eviction means this can never wedge a warm
+  replica's full prefix cache);
+* **per-tenant fairness**, reusing the Queue API's tenant routing
+  (``api/queue.QueueSpec.tenants`` — the same attribution the slice
+  scheduler routes jobs by): when the preferred replica is hot (its
+  queue is backed up) and one tenant's queue already holds its fair
+  share of that replica's outstanding work, the placement spills to the
+  next-best replica instead of letting the hot tenant monopolize the
+  prefix-warm one.
+
+:class:`RandomRouter` is the control arm the routing leg of
+``bench_serving_fleet.py`` compares against: identical traffic,
+identical router-driven registration, placement by seeded uniform draw.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import random
+from typing import Optional, Sequence
+
+from ..api.queue import DEFAULT_QUEUE
+
+
+def _prefix_home(prefix, n: int) -> int:
+    """Stable home replica for a cold prefix: a consistent hash of its
+    tokens over the active set, so the fleet's prefix caches partition
+    the catalog instead of every replica churning through all of it."""
+    digest = hashlib.sha256(
+        ",".join(str(int(t)) for t in prefix).encode()).digest()
+    return int.from_bytes(digest[:8], "big") % n
+
+
+class RandomRouter:
+    """Uniform placement over non-draining replicas (the baseline)."""
+
+    def __init__(self, fleet, seed: int = 0, max_prefixes: int = 8,
+                 metrics=None):
+        self.fleet = fleet
+        self.rng = random.Random(f"{seed}:router")
+        #: per-replica prefix-cache cap for router-driven registration
+        self.max_prefixes = int(max_prefixes)
+        self.metrics = metrics
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+        self.tenant_spills = 0
+        self.routed: dict = {}           # replica name -> placements
+
+    # -- placement --------------------------------------------------------
+
+    def select(self, prompt: Sequence[int], tenant: Optional[str] = None,
+               prefix: Optional[Sequence[int]] = None):
+        reps = self.fleet.active()
+        if not reps:
+            raise RuntimeError("no active serving replica (fleet empty "
+                               "or fully draining)")
+        return reps[self.rng.randrange(len(reps))]
+
+    def _ensure_prefix(self, rep, prefix) -> None:
+        if not rep.engine.has_prefix(prefix):
+            rep.engine.register_prefix(list(prefix),
+                                       max_prefixes=self.max_prefixes)
+
+    def _account(self, rep, prefix) -> None:
+        self.routed[rep.name] = self.routed.get(rep.name, 0) + 1
+        if prefix is not None:
+            if rep.engine.prefix_residency(prefix) > 0:
+                self.prefix_hits += 1
+                if self.metrics is not None:
+                    self.metrics.router_prefix_hits.inc()
+            else:
+                self.prefix_misses += 1
+                if self.metrics is not None:
+                    self.metrics.router_prefix_misses.inc()
+
+    def submit(self, prompt: Sequence[int], max_new: int,
+               tenant: Optional[str] = None,
+               prefix: Optional[Sequence[int]] = None, **kw):
+        """Place + submit one request; returns ``(Request, replica)``.
+        ``prefix`` is the client-declared shared prefix (system prompt)
+        — the placement signal and the router-driven registration
+        unit."""
+        rep = self.select(prompt, tenant=tenant, prefix=prefix)
+        self._account(rep, prefix)
+        if prefix is not None:
+            self._ensure_prefix(rep, prefix)
+        req = rep.engine.submit(prompt, max_new, **kw)
+        self._note_submitted(rep, tenant, req)
+        return req, rep
+
+    def _note_submitted(self, rep, tenant, req) -> None:
+        """Fairness bookkeeping hook (no-op for the random baseline)."""
+
+    def stats(self) -> dict:
+        total = self.prefix_hits + self.prefix_misses
+        return {
+            "prefix_hits": self.prefix_hits,
+            "prefix_misses": self.prefix_misses,
+            "prefix_hit_rate": round(self.prefix_hits / total, 4)
+            if total else None,
+            "tenant_spills": self.tenant_spills,
+            "routed": {k: self.routed[k] for k in sorted(self.routed)},
+        }
+
+
+class PrefixAwareRouter(RandomRouter):
+    """Place on the replica already holding the request's shared prefix
+    blocks; fairness spills a hot tenant off the warm replica."""
+
+    def __init__(self, fleet, seed: int = 0, max_prefixes: int = 8,
+                 queues: Sequence = (), hot_queue_depth: int = 4,
+                 metrics=None):
+        super().__init__(fleet, seed=seed, max_prefixes=max_prefixes,
+                         metrics=metrics)
+        #: tenant -> queue name, from the Queue API's tenant lists (the
+        #: slice scheduler's exact routing rule, docs/scheduling.md);
+        #: unrouted tenants land on the implicit default queue
+        self._tenant_queue: dict = {}
+        for q in queues:
+            for t in getattr(q, "tenants", ()) or ():
+                self._tenant_queue.setdefault(t, q.name)
+        #: replica hotness bar: at or past this queue depth the replica
+        #: is contended and fairness applies
+        self.hot_queue_depth = int(hot_queue_depth)
+        #: (replica name, queue) -> live Requests (pruned lazily on
+        #: reads, and swept every ``_SWEEP_EVERY`` submits so a
+        #: long-lived server below the hotness bar — where _over_share
+        #: never reads — cannot grow this without bound, and keys of
+        #: reaped replicas don't live forever)
+        self._outstanding: dict = {}
+        self._submits_since_sweep = 0
+
+    def queue_for(self, tenant: Optional[str]) -> str:
+        if not tenant:
+            return DEFAULT_QUEUE
+        return self._tenant_queue.get(tenant, DEFAULT_QUEUE)
+
+    # -- fairness bookkeeping --------------------------------------------
+
+    def _live(self, rep_name: str, queue: str) -> int:
+        reqs = self._outstanding.get((rep_name, queue))
+        if not reqs:
+            return 0
+        live = [r for r in reqs if not r.done.is_set()]
+        self._outstanding[(rep_name, queue)] = live
+        return len(live)
+
+    _SWEEP_EVERY = 256
+
+    def _note_submitted(self, rep, tenant, req) -> None:
+        key = (rep.name, self.queue_for(tenant))
+        reqs = self._outstanding.setdefault(key, [])
+        if len(reqs) >= 8:
+            self._outstanding[key] = reqs = [
+                r for r in reqs if not r.done.is_set()]
+        reqs.append(req)
+        self._submits_since_sweep += 1
+        if self._submits_since_sweep >= self._SWEEP_EVERY:
+            self._submits_since_sweep = 0
+            live_names = {r.name for r in self.fleet.replicas}
+            self._outstanding = {
+                k: live for k, v in self._outstanding.items()
+                if k[0] in live_names
+                and (live := [r for r in v if not r.done.is_set()])}
+
+    def _over_share(self, rep, queue: str) -> bool:
+        """Would this queue exceed its fair share of ``rep``'s
+        outstanding work? Share = replica lanes split evenly over the
+        queues currently holding work there (at least one lane each)."""
+        holders = {q for (name, q), reqs in self._outstanding.items()
+                   if name == rep.name and self._live(name, q) > 0}
+        holders.add(queue)
+        share = max(rep.engine.lanes // len(holders), 1)
+        return self._live(rep.name, queue) >= share
+
+    # -- placement --------------------------------------------------------
+
+    def select(self, prompt: Sequence[int], tenant: Optional[str] = None,
+               prefix: Optional[Sequence[int]] = None):
+        reps = self.fleet.active()
+        if not reps:
+            raise RuntimeError("no active serving replica (fleet empty "
+                               "or fully draining)")
+        probe = prefix if prefix is not None else prompt
+        scored = [(rep.engine.prefix_residency(probe),
+                   -rep.engine.queue_depth, -i, rep)
+                  for i, rep in enumerate(reps)]
+        scored.sort(reverse=True)        # residency desc, depth asc, FIFO
+        best = scored[0][3]
+        if scored[0][0] == 0 and prefix is not None:
+            # nowhere warm: give the prefix a stable home so its NEXT
+            # requests find it resident (and other prefixes' homes stay
+            # unpolluted) instead of piling every cold prefix onto the
+            # emptiest replica
+            best = reps[_prefix_home(prefix, len(reps))]
+        queue = self.queue_for(tenant)
+        if len(scored) > 1 and best.engine.queue_depth \
+                >= self.hot_queue_depth and self._over_share(best, queue):
+            # the warm replica is contended AND this tenant's queue
+            # already holds its share of it: spill to the least-loaded
+            # other replica instead of monopolizing the prefix-warm one
+            others = sorted(((rep.engine.queue_depth, i, rep)
+                             for i, (_, _, _, rep) in enumerate(scored)
+                             if rep is not best))
+            self.tenant_spills += 1
+            if self.metrics is not None:
+                self.metrics.router_tenant_spills.inc(queue=queue)
+            return others[0][2]
+        return best

@@ -716,10 +716,16 @@ class InferenceServer:
         if not hasattr(self.engine, "register_prefix"):
             raise ValueError(
                 "this engine does not support prefix caching")
-        # the engine enforces the cap under its own lock (atomic with the
-        # store; idempotent re-registration of a stored prefix passes)
+        # the engine enforces the cap under its own lock (atomic with
+        # the store; idempotent re-registration of a stored prefix
+        # passes; over the cap the least-recently-hit unpinned prefix
+        # is evicted — only an all-pinned cache still rejects).
+        # `pinned` exempts THIS prefix from that eviction
+        # (docs/serving_fleet.md: operator-pinned system prompts
+        # survive router-driven registration churn).
         self.engine.register_prefix([int(t) for t in toks],
-                                    max_prefixes=self.config.max_prefixes)
+                                    max_prefixes=self.config.max_prefixes,
+                                    pinned=bool(body.get("pinned")))
         return {"registered": len(toks)}
 
     def status(self) -> dict:

@@ -249,6 +249,51 @@ def test_durability_families_in_exposition(served):
             'rv="4108"} 1.0') in body
 
 
+def test_serving_fleet_families_in_exposition(served):
+    """Pin the serving-fleet families (docs/serving_fleet.md): the
+    per-replica engine health gauges the autoscaler consumes, fleet
+    size / scale events, router placement counters, and prefill→decode
+    handoffs. These register only when the ServingFleet gate is on —
+    their absence from a gate-off operator's exposition is pinned in
+    tests/test_serving_fleet.py."""
+    from kubedl_tpu.metrics.registry import ServingFleetMetrics
+    reg, port = served
+    sm = ServingFleetMetrics(reg)
+    sm.free_blocks.set(42, replica="replica-0")
+    sm.queue_depth.set(3, replica="replica-0")
+    sm.active_lanes.set(5, replica="replica-0")
+    sm.replicas.set(2)
+    sm.draining.set(1)
+    sm.scale_events.inc(direction="up")
+    sm.scale_events.inc(direction="drain")
+    sm.router_prefix_hits.inc(9)
+    sm.router_prefix_misses.inc(2)
+    sm.router_tenant_spills.inc(queue="team-ads")
+    sm.handoffs.inc(4, replica="replica-0")
+    _, body, _ = scrape(port)
+    assert "# TYPE kubedl_serving_free_blocks gauge" in body
+    assert 'kubedl_serving_free_blocks{replica="replica-0"} 42.0' in body
+    assert "# TYPE kubedl_serving_queue_depth gauge" in body
+    assert 'kubedl_serving_queue_depth{replica="replica-0"} 3.0' in body
+    assert "# TYPE kubedl_serving_active_lanes gauge" in body
+    assert 'kubedl_serving_active_lanes{replica="replica-0"} 5.0' in body
+    assert "# TYPE kubedl_serving_fleet_replicas gauge" in body
+    assert "kubedl_serving_fleet_replicas 2.0" in body
+    assert "kubedl_serving_fleet_draining 1.0" in body
+    assert ("# TYPE kubedl_serving_fleet_scale_events_total counter"
+            in body)
+    assert ('kubedl_serving_fleet_scale_events_total{direction="up"} 1.0'
+            in body)
+    assert ('kubedl_serving_fleet_scale_events_total{direction="drain"}'
+            ' 1.0') in body
+    assert "kubedl_serving_router_prefix_hits_total 9.0" in body
+    assert "kubedl_serving_router_prefix_misses_total 2.0" in body
+    assert ('kubedl_serving_router_tenant_spills_total{queue="team-ads"}'
+            ' 1.0') in body
+    assert ('kubedl_serving_prefill_handoffs_total{replica="replica-0"}'
+            ' 4.0') in body
+
+
 def test_replication_families_in_exposition(served):
     """Pin the replicated-control-plane families (docs/replication.md):
     names, label sets, and gauge/counter types. These register only

@@ -235,6 +235,19 @@ def _mini_scorecard(**jobs_overrides):
         "baseline": {"completed_fraction": 1.0},
         "gains": {"goodput_gain": 1.25, "recovery_p50_ratio": 0.01},
     }
+    # the serving-fleet comparison block (docs/serving_fleet.md) the
+    # day gates hold alongside everything else
+    sc["serving"]["fleet"] = {
+        "routing": {"hit_rate_ratio": 1.9,
+                    "prefix_aware": {"prefix_hit_rate": 0.98}},
+        "disagg": {"ttft_p99_ratio": 2.0, "decode_tokens_ratio": 1.0,
+                   "disaggregated": {"handoffs": 100}},
+        "autoscaler": {"pages_fired": 1, "stranded_alerts": 0,
+                       "min_budget_remaining": 0.3,
+                       "dropped_streams": 0, "requests_unfinished": 0,
+                       "fleet": {"scale_ups": 1, "drains": 1,
+                                 "reaped_count": 1}},
+    }
     sc["jobs"].update(jobs_overrides)
     return sc
 

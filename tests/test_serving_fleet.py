@@ -1,0 +1,502 @@
+"""SLO-driven serving fleet (docs/serving_fleet.md): disaggregated
+prefill/decode lanes with block-table handoff, prefix LRU eviction,
+prefix-aware routing with tenant fairness, autoscaling on burn-rate
+verdicts, drain-don't-drop scale-down — and the gate-off contract."""
+
+import dataclasses
+import json
+
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from kubedl_tpu.controllers.servingfleet import (AutoscalerConfig,  # noqa: E402
+                                                 ServingAutoscaler)
+from kubedl_tpu.models import llama  # noqa: E402
+from kubedl_tpu.serving.batching import ContinuousBatchingEngine  # noqa: E402
+from kubedl_tpu.serving.fleet import ServingFleet  # noqa: E402
+from kubedl_tpu.serving.router import (PrefixAwareRouter,  # noqa: E402
+                                       RandomRouter)
+
+pytestmark = pytest.mark.serving_fleet
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = dataclasses.replace(
+        llama.tiny(vocab=128), d_model=64, n_heads=2, n_kv_heads=2,
+        d_ff=128, dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def make_engine(model, lanes=3, prefill_lanes=0, pool_blocks=24,
+                max_len=64, kv_block=8, **kw):
+    cfg, params = model
+    return ContinuousBatchingEngine(
+        cfg, params, lanes=lanes, max_len=max_len, kv_mode="paged",
+        kv_block=kv_block, pool_blocks=pool_blocks,
+        prefill_lanes=prefill_lanes, **kw)
+
+
+# ----------------------------------------------------------------------
+# block-table handoff invariants (ISSUE satellite: token identity +
+# zero-leak cancel)
+# ----------------------------------------------------------------------
+
+def _walk_requests(seed):
+    import random
+    rng = random.Random(seed)
+    out = []
+    for _ in range(8):
+        plen = rng.choice([3, 9, 21, 40, 51])
+        prompt = [rng.randrange(1, 127) for _ in range(plen)]
+        out.append((prompt, rng.randrange(2, 8)))
+    return out
+
+
+def test_handoff_token_identical_to_single_lane_path(model):
+    """A prefill-lane table handed to a decode lane produces
+    TOKEN-IDENTICAL output to the combined single-lane path (greedy
+    decoding; the same property the preemption-resume path rides)."""
+    reqs = _walk_requests(7)
+    combined = make_engine(model, lanes=3, pool_blocks=24)
+    disagg = make_engine(model, lanes=4, prefill_lanes=1, pool_blocks=24)
+    want = combined.run(reqs)
+    got = disagg.run(reqs)
+    assert got == want
+    assert disagg.handoffs >= len(reqs) - 1  # finished-in-prefill may skip
+    assert combined.handoffs == 0
+
+
+def test_handoff_moves_blocks_without_copy_and_frees_cleanly(model):
+    eng = make_engine(model, lanes=3, prefill_lanes=1, pool_blocks=24)
+    req = eng.submit([5] * 20, 4)
+    while eng.step():
+        pass
+    assert req.result() and len(req.tokens) == 4
+    assert eng.handoffs == 1
+    # every block returned once the request finished: nothing leaked
+    # across the handoff (the table moved, the refcounts did not)
+    assert eng._bpool.free_count == eng.pool_blocks
+    assert eng._bpool.refcounts() == {}
+
+
+def test_cancel_mid_handoff_leaks_zero_blocks(model):
+    """A request cancelled while PARKED (prefilled, waiting for a
+    decode lane) must free its blocks exactly once — pool free-count
+    restored."""
+    eng = make_engine(model, lanes=3, prefill_lanes=1, pool_blocks=30,
+                      max_len=64)
+    # occupy both decode lanes with long generations
+    long_a = eng.submit([1, 2, 3], 30)
+    long_b = eng.submit([4, 5, 6], 30)
+    eng.step()
+    assert eng.health()["active_lanes"] == 2
+    held = eng.pool_blocks - eng._bpool.free_count
+    # the third request prefills onto the prefill lane and parks
+    victim = eng.submit([7] * 33, 10)
+    eng.step()
+    assert eng.health()["parked_lanes"] == 1
+    assert len(victim.tokens) == 1       # first token from the prefill
+    victim.cancel()
+    eng.step()                           # the handoff pass frees it
+    assert eng.health()["parked_lanes"] == 0
+    # its blocks came back; the two decode lanes still hold theirs
+    # (they each grew during the interleaved ticks, so compare against
+    # what the live lanes actually reference)
+    live = sum(len(l.blocks) for l in eng._lane_state)
+    assert eng._bpool.free_count == eng.pool_blocks - live
+    assert held >= 1
+    while eng.step():
+        pass
+    assert long_a.result() and long_b.result()
+    assert victim.done.is_set() and not victim.cancelled  # client cancel
+    assert eng._bpool.free_count == eng.pool_blocks
+
+
+def test_disagg_requires_paged_and_bounds(model):
+    cfg, params = model
+    with pytest.raises(ValueError, match="paged"):
+        ContinuousBatchingEngine(cfg, params, lanes=4, max_len=64,
+                                 kv_mode="dense", prefill_lanes=1)
+    with pytest.raises(ValueError, match="decode lane"):
+        make_engine(model, lanes=2, prefill_lanes=2)
+
+
+# ----------------------------------------------------------------------
+# register_prefix: raise -> evict (ISSUE satellite)
+# ----------------------------------------------------------------------
+
+def test_register_prefix_evicts_least_recently_hit(model):
+    eng = make_engine(model, lanes=2, pool_blocks=32)
+    p1, p2 = [1] * 16, [2] * 16
+    eng.register_prefix(p1, max_prefixes=2)
+    eng.register_prefix(p2, max_prefixes=2)
+    # hit p2 via a real admission so p1 becomes the LRU victim
+    eng.run([(list(p2) + [9, 9], 2)])
+    eng.register_prefix([3] * 16, max_prefixes=2)
+    assert eng.prefix_count == 2
+    assert not eng.has_prefix(p1)
+    assert eng.has_prefix(p2) and eng.has_prefix([3] * 16)
+    # the evicted pin's blocks returned to the pool (2 prefixes x 2
+    # full blocks pinned)
+    assert eng._bpool.free_count == eng.pool_blocks - 4
+
+
+def test_evicted_prefix_refcounts_drain_to_zero(model):
+    """Evicting a prefix a live lane still shares must not free the
+    blocks out from under it: the pin's refcount drops, the lane keeps
+    its share, and the blocks return only when the lane finishes."""
+    eng = make_engine(model, lanes=2, pool_blocks=32)
+    p1 = [4] * 16
+    eng.register_prefix(p1, max_prefixes=1)
+    req = eng.submit(list(p1) + [8, 8], 12)    # shares p1's 2 blocks
+    eng.step()
+    shared_before = eng.pool_stats()["blocks_shared"]
+    assert shared_before >= 2
+    eng.register_prefix([5] * 16, max_prefixes=1)   # evicts p1
+    assert not eng.has_prefix(p1)
+    # the lane still references the old prefix blocks: not free yet
+    assert eng._bpool.free_count < eng.pool_blocks - 2
+    while eng.step():
+        pass
+    assert req.result()
+    # everything except the new pin drained to zero refs
+    assert eng._bpool.free_count == eng.pool_blocks - 2
+    assert all(r == 1 for r in eng._bpool.refcounts().values())
+
+
+def test_all_pinned_cache_still_raises(model):
+    eng = make_engine(model, lanes=2, pool_blocks=32)
+    eng.register_prefix([1] * 16, max_prefixes=2, pinned=True)
+    eng.register_prefix([2] * 16, max_prefixes=2, pinned=True)
+    with pytest.raises(ValueError, match="pinned"):
+        eng.register_prefix([3] * 16, max_prefixes=2)
+    # a pinned prefix never falls to router-driven churn
+    eng.register_prefix([1] * 16, max_prefixes=2, pinned=True)  # idempotent
+    assert eng.prefix_count == 2
+
+
+# ----------------------------------------------------------------------
+# fleet + router
+# ----------------------------------------------------------------------
+
+def fleet_of(model, n=2, prefill_lanes=0, lanes=3, pool_blocks=24):
+    def factory(idx):
+        return make_engine(model, lanes=lanes,
+                           prefill_lanes=prefill_lanes,
+                           pool_blocks=pool_blocks, seed=idx)
+    return ServingFleet(factory, replicas=n)
+
+
+def test_router_prefix_affinity_and_hit_accounting(model):
+    fleet = fleet_of(model, n=2)
+    router = PrefixAwareRouter(fleet, max_prefixes=4)
+    prefix = [7] * 16
+    reqs = []
+    homes = set()
+    for _ in range(4):
+        req, rep = router.submit(list(prefix) + [3, 3], 2, prefix=prefix)
+        reqs.append(req)
+        homes.add(rep.name)
+        while fleet.step():
+            pass
+    assert len(homes) == 1               # same home replica every time
+    stats = router.stats()
+    assert stats["prefix_misses"] == 1      # only the cold first call
+    assert stats["prefix_hits"] == 3
+    for r in reqs:
+        assert r.result()
+
+
+def test_router_tenant_fairness_spills_hot_tenant(model):
+    fleet = fleet_of(model, n=2)
+    from kubedl_tpu.api.queue import QueueSpec
+    router = PrefixAwareRouter(
+        fleet, max_prefixes=4, hot_queue_depth=1,
+        queues=[QueueSpec(name="q-ads", tenants=("ads",))])
+    prefix = [9] * 16
+    # the warm replica's queue backs up with the hot tenant's work
+    # (no stepping: requests stay queued)
+    placements = []
+    for _ in range(6):
+        _req, rep = router.submit(list(prefix) + [2, 2], 2,
+                                  tenant="ads", prefix=prefix)
+        placements.append(rep.name)
+    assert len(set(placements)) == 2     # the spill happened
+    assert router.stats()["tenant_spills"] >= 1
+    while fleet.step():
+        pass
+
+
+def test_fleet_drain_finishes_streams_and_reaps(model):
+    fleet = fleet_of(model, n=2)
+    router = RandomRouter(fleet, seed=3)
+    reqs = [router.submit([i + 1, i + 2], 6)[0] for i in range(6)]
+    drained = fleet.begin_drain()
+    assert drained is not None and drained.draining
+    assert fleet.reap() == []            # still busy: NOT reaped
+    assert len(fleet.active()) == 1
+    while fleet.step():
+        pass
+    assert fleet.reap() == [drained.name]
+    assert fleet.size == 1
+    for r in reqs:
+        assert r.result()                # zero dropped streams
+
+
+def test_autoscaler_pages_scale_up_then_drain_down(model):
+    from kubedl_tpu.api.slo import new_slo
+    from kubedl_tpu.telemetry.slo import SLOEvaluator
+    clock = {"t": 0.0}
+    slo = SLOEvaluator(clock=lambda: clock["t"],
+                       evaluate_interval_s=1.0)
+    slo.add(new_slo("ttft", "ttft_p99", 5.0, goal=0.75, window_s=3600.0,
+                    alerting=[{"severity": "page", "shortSeconds": 60.0,
+                               "longSeconds": 120.0, "burn": 2.0}]))
+    fleet = fleet_of(model, n=1)
+    asc = ServingAutoscaler(
+        fleet, slo=slo,
+        config=AutoscalerConfig(min_replicas=1, max_replicas=2,
+                                cooldown_s=5.0, scale_down_idle_s=20.0),
+        clock=lambda: clock["t"])
+    for i in range(40):
+        slo.observe("ttft", 30.0, clock["t"] + i * 0.1)
+    clock["t"] = 10.0
+    slo.evaluate(clock["t"])
+    assert asc.page_firing()
+    actions = asc.step(clock["t"])
+    assert any("page-severity burn" in a for a in actions)
+    assert fleet.size == 2 and asc.scale_ups == 1
+    # burn clears (short window slides past the bad samples), the
+    # fleet is idle: quiet period begins, then a drain, then the reap
+    clock["t"] = 400.0
+    slo.evaluate(clock["t"])
+    assert not asc.page_firing()
+    asc.step(clock["t"])                 # quiet starts
+    clock["t"] = 430.0
+    actions = asc.step(clock["t"])
+    assert any(a.startswith("drain") for a in actions)
+    clock["t"] = 431.0
+    actions = asc.step(clock["t"])       # idle drained replica reaps
+    assert any(a.startswith("reap") for a in actions)
+    assert fleet.size == 1 and asc.drains == 1 and asc.reaped == 1
+
+
+def test_autoscaler_undrains_before_adding_under_pressure(model):
+    """Pressure returning mid-drain must restore the draining replica
+    (instant capacity — its engine never stopped) instead of refusing
+    to actuate because fleet.size already sits at max_replicas."""
+    from kubedl_tpu.api.slo import new_slo
+    from kubedl_tpu.telemetry.slo import SLOEvaluator
+    clock = {"t": 0.0}
+    slo = SLOEvaluator(clock=lambda: clock["t"], evaluate_interval_s=1.0)
+    slo.add(new_slo("ttft", "ttft_p99", 5.0, goal=0.75, window_s=3600.0,
+                    alerting=[{"severity": "page", "shortSeconds": 60.0,
+                               "longSeconds": 120.0, "burn": 2.0}]))
+    fleet = fleet_of(model, n=2)
+    asc = ServingAutoscaler(
+        fleet, slo=slo,
+        config=AutoscalerConfig(min_replicas=1, max_replicas=2,
+                                cooldown_s=0.0, scale_down_idle_s=1e9),
+        clock=lambda: clock["t"])
+    drained = fleet.begin_drain()
+    assert drained is not None and len(fleet.active()) == 1
+    # the draining replica still holds in-flight work (NOT idle): the
+    # reap pass must not remove it, the pressure pass must restore it
+    inflight = drained.engine.submit([1, 2, 3], 6)
+    for i in range(40):
+        slo.observe("ttft", 30.0, i * 0.1)
+    clock["t"] = 10.0
+    slo.evaluate(clock["t"])
+    actions = asc.step(clock["t"])
+    assert any(a.startswith("undrain") for a in actions), actions
+    assert not drained.draining and len(fleet.active()) == 2
+    assert fleet.size == 2                  # no fresh replica paid for
+    while fleet.step():
+        pass
+    assert inflight.result()
+
+
+class _FakeEngine:
+    """Just enough engine surface for router-only unit tests."""
+    lanes = 4
+    handoffs = 0
+    prefill_tokens_total = 0
+
+    def __init__(self):
+        self.queue_depth = 0
+        self.prefixes = set()
+
+    def prefix_residency(self, prompt):
+        return 2 if tuple(prompt) in self.prefixes else 0
+
+    def has_prefix(self, tokens):
+        return tuple(tokens) in self.prefixes
+
+    def register_prefix(self, tokens, max_prefixes=None, pinned=False):
+        self.prefixes.add(tuple(tokens))
+
+    def health(self):
+        return {"queue_depth": 0, "active_lanes": 0, "parked_lanes": 0,
+                "free_blocks": 0, "lanes": self.lanes,
+                "prefill_lanes": 0, "handoffs": 0, "preempted": 0}
+
+    def stop(self):
+        pass
+
+    def submit(self, prompt, max_new, **kw):
+        import threading
+
+        class _R:
+            done = threading.Event()
+        return _R()
+
+
+def test_router_outstanding_state_stays_bounded():
+    """A long-lived server below the hotness bar (fairness never reads
+    _outstanding) must not grow router bookkeeping without bound; keys
+    of reaped replicas are swept too."""
+    from kubedl_tpu.serving.fleet import ServingFleet
+    fleet = ServingFleet(lambda i: _FakeEngine(), replicas=2)
+    router = PrefixAwareRouter(fleet, hot_queue_depth=10**9)
+    done_reqs = []
+    for i in range(600):
+        req, _rep = router.submit([1, 2, i], 2, tenant="ads")
+        req.done.set()                       # finished immediately
+        done_reqs.append(req)
+    held = sum(len(v) for v in router._outstanding.values())
+    assert held <= 2 * router._SWEEP_EVERY, held
+    # a reaped replica's keys disappear on the next sweep
+    fleet.begin_drain()
+    assert fleet.reap()
+    for i in range(router._SWEEP_EVERY + 1):
+        req, _rep = router.submit([3, 4, i], 2, tenant="ads")
+        req.done.set()
+    names = {k[0] for k in router._outstanding}
+    assert names <= {r.name for r in fleet.replicas}
+
+
+# ----------------------------------------------------------------------
+# e2e smoke legs (real replay, tiny scale) + determinism
+# ----------------------------------------------------------------------
+
+SMOKE = dict(sim_seconds=240.0, requests=160, bursts=6, replicas=2,
+             max_replicas=2, decode_lanes=4, prefill_lanes=1,
+             pool_blocks=48, prefixes=10, max_prefixes_per_replica=5,
+             zipf_s=0.7)
+
+
+def _smoke_profile(**over):
+    from kubedl_tpu.replay.fleet import FleetProfile
+    return FleetProfile(name="smoke", **{**SMOKE, **over})
+
+
+@pytest.mark.perf
+def test_smoke_routing_leg_prefix_beats_random(model):
+    from kubedl_tpu.replay.fleet import ServingFleetReplay, generate_fleet
+    p = _smoke_profile()
+    aware = ServingFleetReplay(generate_fleet(p, 0), router="prefix",
+                               model=model).run()
+    rand = ServingFleetReplay(generate_fleet(p, 0), router="random",
+                              model=model).run()
+    assert aware["requests_completed"] == aware["requests_submitted"]
+    assert aware["errors"] == 0 and rand["errors"] == 0
+    a, r = (aware["router"]["prefix_hit_rate"],
+            rand["router"]["prefix_hit_rate"])
+    assert a >= 1.3 * r, (a, r)          # measured 0.8629 vs 0.6129
+
+
+@pytest.mark.perf
+def test_smoke_disagg_leg_improves_tail_ttft(model):
+    from kubedl_tpu.replay.fleet import ServingFleetReplay, generate_fleet
+    from kubedl_tpu.utils.stats import summarize
+    p = _smoke_profile(long_prompt_frac=0.5, prefix_share=0.35,
+                       pool_blocks=100, decode_lanes=6, bursts=10,
+                       requests=200)
+    dis = ServingFleetReplay(generate_fleet(p, 0), router="prefix",
+                             disaggregate=True, model=model).run()
+    comb = ServingFleetReplay(generate_fleet(p, 0), router="prefix",
+                              disaggregate=False, model=model).run()
+    dp = summarize(dis["ttfts_s"], percentiles=(0.99,))["p99"]
+    cp = summarize(comb["ttfts_s"], percentiles=(0.99,))["p99"]
+    assert dis["handoffs"] > 0 and comb["handoffs"] == 0
+    assert cp >= 1.3 * dp, (cp, dp)
+    assert dis["decode_tokens_per_s"] >= comb["decode_tokens_per_s"]
+    # same tokens either way: the handoff only moves time, never output
+    assert dis["tokens_generated"] == comb["tokens_generated"]
+
+
+def test_smoke_fleet_replay_deterministic(model):
+    from kubedl_tpu.replay.fleet import ServingFleetReplay, generate_fleet
+    p = _smoke_profile(requests=60, sim_seconds=120.0)
+    a = ServingFleetReplay(generate_fleet(p, 1), router="prefix",
+                           model=model).run()
+    b = ServingFleetReplay(generate_fleet(p, 1), router="prefix",
+                           model=model).run()
+    assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+
+
+# ----------------------------------------------------------------------
+# gate-off contract + console
+# ----------------------------------------------------------------------
+
+def _console(proxy):
+    from kubedl_tpu.console.server import ConsoleConfig, ConsoleServer
+    return ConsoleServer(proxy, ConsoleConfig(host="127.0.0.1", port=0,
+                                              users={}))
+
+
+def test_gate_off_no_families_console_501(model):
+    from kubedl_tpu.console.proxy import DataProxy
+    from kubedl_tpu.controllers.registry import (OperatorConfig,
+                                                 build_operator)
+    op = build_operator(config=OperatorConfig(workloads=[]))
+    assert not op.serving_fleet_enabled
+    body = op.metrics_registry.expose()
+    for family in ("kubedl_serving_free_blocks",
+                   "kubedl_serving_queue_depth",
+                   "kubedl_serving_active_lanes",
+                   "kubedl_serving_fleet_replicas",
+                   "kubedl_serving_router_prefix_hits_total",
+                   "kubedl_serving_prefill_handoffs_total"):
+        assert family not in body
+    server = _console(DataProxy(op.api))
+    try:
+        status, payload, _ = server.route(
+            "GET", "/api/v1/serving/fleet", {}, b"", None)
+        assert status == 501 and "serving fleet" in payload["msg"]
+    finally:
+        server._httpd.server_close()
+
+
+def test_gate_on_families_and_console_status(model):
+    from kubedl_tpu.console.proxy import DataProxy
+    from kubedl_tpu.controllers.registry import (OperatorConfig,
+                                                 build_operator)
+    op = build_operator(config=OperatorConfig(
+        workloads=[], enable_serving_fleet=True))
+    assert op.serving_fleet_enabled
+    fleet = fleet_of(model, n=2)
+    fleet.metrics = op.serving_fleet_metrics
+    router = PrefixAwareRouter(fleet, metrics=op.serving_fleet_metrics)
+    req, _rep = router.submit([1, 2, 3], 2, prefix=[1, 2])
+    while fleet.step():
+        pass
+    assert req.result()
+    fleet.refresh_metrics()
+    body = op.metrics_registry.expose()
+    assert 'kubedl_serving_queue_depth{replica="replica-0"}' in body
+    assert "kubedl_serving_fleet_replicas 2.0" in body
+    server = _console(DataProxy(op.api, serving_fleet=fleet,
+                                serving_router=router))
+    try:
+        status, payload, _ = server.route(
+            "GET", "/api/v1/serving/fleet", {}, b"", None)
+        assert status == 200
+        assert payload["data"]["replicas"] == 2
+        assert "router" in payload["data"]
+    finally:
+        server._httpd.server_close()

@@ -124,6 +124,40 @@ def test_register_prefix_rejected_on_static_engine(model):
 
 
 def test_prefix_cap_is_atomic_and_idempotent(model):
+    """The cap contract after the raise→evict change
+    (docs/serving_fleet.md): an over-cap registration of UNPINNED
+    prefixes evicts the least-recently-hit one instead of 400ing, an
+    all-pinned cache still rejects, and idempotent re-registration of a
+    stored prefix always passes (it pins no new HBM)."""
+    cfg, params = model
+    eng = ContinuousBatchingEngine(cfg, params, lanes=1, max_len=96).start()
+    server = InferenceServer(eng, ServerConfig(
+        model_name="m", host="127.0.0.1", port=0, max_prefixes=2)).start()
+    try:
+        for pfx in ([1, 2, 3], [4, 5, 6]):
+            with post(server.url, "/v1/models/m:registerPrefix",
+                      {"prefix_tokens": pfx, "pinned": True}):
+                pass
+        # at the cap with every prefix PINNED: a NEW prefix is rejected
+        # (nothing is legally evictable)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post(server.url, "/v1/models/m:registerPrefix",
+                 {"prefix_tokens": [7, 8, 9]})
+        assert ei.value.code == 400
+        # idempotent re-registration of a stored one still passes
+        with post(server.url, "/v1/models/m:registerPrefix",
+                  {"prefix_tokens": [1, 2, 3], "pinned": True}) as r:
+            assert json.load(r)["registered"] == 3
+        assert eng.prefix_count == 2
+    finally:
+        server.stop()
+        eng.stop()
+
+
+def test_prefix_cap_evicts_unpinned_lru(model):
+    """Router-driven registration on a warm replica must not wedge: an
+    over-cap UNPINNED registration evicts the least-recently-hit prefix
+    and succeeds (the raise→evict regression pin)."""
     cfg, params = model
     eng = ContinuousBatchingEngine(cfg, params, lanes=1, max_len=96).start()
     server = InferenceServer(eng, ServerConfig(
@@ -133,17 +167,15 @@ def test_prefix_cap_is_atomic_and_idempotent(model):
             with post(server.url, "/v1/models/m:registerPrefix",
                       {"prefix_tokens": pfx}):
                 pass
-        # at the cap: a NEW prefix is rejected...
-        with pytest.raises(urllib.error.HTTPError) as ei:
-            post(server.url, "/v1/models/m:registerPrefix",
-                 {"prefix_tokens": [7, 8, 9]})
-        assert ei.value.code == 400
-        # ...but idempotent re-registration of a stored one still passes
-        # (it pins no new HBM)
         with post(server.url, "/v1/models/m:registerPrefix",
-                  {"prefix_tokens": [1, 2, 3]}) as r:
+                  {"prefix_tokens": [7, 8, 9]}) as r:
             assert json.load(r)["registered"] == 3
         assert eng.prefix_count == 2
+        assert eng.has_prefix([7, 8, 9])
+        # deterministic victim: the OLDEST never-hit registration (the
+        # hit clock is seeded at registration time)
+        assert not eng.has_prefix([1, 2, 3])
+        assert eng.has_prefix([4, 5, 6])
     finally:
         server.stop()
         eng.stop()
