@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test test-all test-fast test-chaos test-campaign test-scheduler test-trace test-replay test-telemetry test-slo test-durability test-forensics test-replication test-elastic test-serving-fleet bench bench-controlplane bench-scheduler bench-serving-paged bench-serving-fleet bench-trace bench-cluster bench-cluster-adversarial bench-elastic postmortem dryrun crds run-standalone lint native
+.PHONY: test test-all test-fast test-chaos test-campaign test-scheduler test-trace test-replay test-telemetry test-slo test-durability test-forensics test-replication test-elastic test-serving-fleet test-federation bench bench-controlplane bench-scheduler bench-serving-paged bench-serving-fleet bench-federation bench-trace bench-cluster bench-cluster-adversarial bench-elastic postmortem dryrun crds run-standalone lint native
 
 # fast path (<3 min): everything except the compile-heavy compute suites
 # (those carry `pytestmark = pytest.mark.slow`). Chaos tests are fast and
@@ -151,6 +151,19 @@ test-serving-fleet:
 # tests/test_serving_fleet.py.
 bench-serving-fleet:
 	JAX_PLATFORMS=cpu $(PY) bench_serving_fleet.py
+
+test-federation:
+	$(PY) -m pytest tests/ -q -m federation
+
+# multi-region federation bench -> BENCH_FEDERATION.json
+# (docs/federation.md): the federation profile's day across three
+# regions with a mid-day region-evacuation; gates: zero acknowledged
+# writes lost, zero dropped non-evacuated streams, every job completes,
+# pages fire/clear/link, and the whole day bit-identical across two
+# in-process runs; FAILS on regression vs the committed artifact. The
+# tier-1 guard is tests/test_federation.py.
+bench-federation:
+	JAX_PLATFORMS=cpu $(PY) bench_federation.py
 
 # render the committed adversarial campaign's forensics blocks as
 # markdown postmortems (docs/forensics.md; regenerate the blocks with

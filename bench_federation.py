@@ -1,0 +1,245 @@
+"""Multi-region federation bench: the region-evacuation survival gate.
+
+One leg, one JSON (``BENCH_FEDERATION.json``, docs/federation.md): the
+:class:`~kubedl_tpu.federation.replay.FederationReplay` driver runs the
+``federation`` profile's job+serving day across THREE regions (the
+reference topology below — two US regions 65 ms apart, an EU region an
+ocean away), each region a full ``ClusterReplay``-backed control plane
+with a WAL journal and a cross-region standby, all on ONE shared sim
+clock. Mid-day the ``region-evacuation`` campaign kills one whole
+region — leader, followers, serving fleet, running gangs, streams —
+and the global layer evacuates it.
+
+Gates, per seed:
+
+* **zero acknowledged writes lost** — every object the dead region's
+  journal had group-committed at the kill instant is present in the
+  peer-region standby after catch-up, with zero torn tail records;
+* **zero dropped non-evacuated streams** — every serving stream
+  completes; streams homed in the dead region re-route and finish
+  elsewhere;
+* **every job completes** — elastic gangs in the dead region shrink to
+  zero, emigrate on their banked object-store checkpoint tier, and
+  finish in the region the global router names (runner-up recorded);
+* **pages fire, clear, and link** — the evacuation burns SLO error
+  budget (pages fired >= 1) without exhausting it (min budget
+  remaining > 0), no alert is still firing at day end, and the
+  forensics timeline causally links every page to the ``region_down``
+  window (``pages_unlinked == 0``);
+* **bit-for-bit determinism** — the whole day runs TWICE in process
+  (fresh journal roots) and the two result documents are
+  byte-identical under canonical JSON.
+
+The gate-off contract is checked by the test suite, not here: without
+``--enable-federation`` every committed single-cluster BENCH_* artifact
+is byte-identical and the console federation endpoints answer 501.
+
+Usage::
+
+    python bench_federation.py [--seeds 0] [--out BENCH_FEDERATION.json]
+                               [--no-check]
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+import tempfile
+import time
+
+#: the reference topology: latency ms / egress $-per-GB per pair
+TOPOLOGY_SPEC = ("us-east,us-west,eu-west;us-east~us-west=65/0.02;"
+                 "us-east~eu-west=140/0.05;us-west~eu-west=150/0.05")
+
+_GATES = (
+    # prefixed seeds.<seed>.
+    ("jobs.completed_fraction", ">=", 1.0),
+    ("jobs.evacuated", ">=", 1),
+    ("jobs.evacuated_pending_count", "<=", 0),
+    ("serving.completed_fraction", ">=", 1.0),
+    ("serving.dropped_non_evacuated_count", "<=", 0),
+    ("serving.rerouted", ">=", 1),
+    ("evacuation.ack_objects_at_kill", ">=", 1),
+    ("evacuation.ack_objects_lost", "<=", 0),
+    ("evacuation.torn_tail_records", "<=", 0),
+    ("slo.pages_fired", ">=", 1),
+    ("slo.stranded_alerts", "<=", 0),
+    ("slo.min_budget_remaining", ">=", 1e-6),
+    ("forensics.pages_unlinked", "<=", 0),
+    ("forensics.unresolved_incidents", "<=", 0),
+    ("shipping.frames_dropped", "<=", 0),
+    ("determinism.bit_identical", ">=", 1),
+)
+
+#: regression tolerances vs the committed artifact
+_REGRESSION = (
+    ("seeds.0.slo.min_budget_remaining", "higher_better", 0.50, 0.01),
+    ("seeds.0.serving.rerouted", "higher_better", 0.50, 0.5),
+    ("seeds.0.makespan_s", "lower_better", 0.25, 1.0),
+)
+
+
+def _run_once(topo, seed: int) -> dict:
+    from kubedl_tpu.federation import FederationReplay
+    with tempfile.TemporaryDirectory() as td:
+        return FederationReplay(topo, td, seed=seed).run()
+
+
+def federation_leg(seeds) -> dict:
+    from kubedl_tpu.federation import RegionTopology
+    topo = RegionTopology.parse(TOPOLOGY_SPEC)
+    out = {}
+    for seed in seeds:
+        t0 = time.perf_counter()
+        res = _run_once(topo, seed)
+        blob = json.dumps(res, sort_keys=True)
+        again = json.dumps(_run_once(topo, seed), sort_keys=True)
+        bit_identical = int(blob == again)
+        wall = time.perf_counter() - t0
+
+        (victim, evac), = res["evacuations"].items()
+        ship = {
+            "frames_shipped": sum(s["framesShipped"]
+                                  for s in res["shipping"].values()),
+            "retries": sum(s["retries"]
+                           for s in res["shipping"].values()),
+            "frames_dropped": sum(s["framesDropped"]
+                                  for s in res["shipping"].values()),
+            "resyncs": sum(s["resyncs"]
+                           for s in res["shipping"].values()),
+        }
+        jobs, serving = res["jobs"], res["serving"]
+        health = res["slo_health"]
+        summary = res["forensics"]["summary"]
+        block = {
+            "topology_fingerprint": res["topology_fingerprint"],
+            "campaign_fingerprint": res["campaign"]["fingerprint"],
+            "victim_region": victim,
+            "evacuated_at_s": evac["atSimSeconds"],
+            "regions_alive_at_end": res["regions_alive"],
+            "makespan_s": res["makespan_s"],
+            "rounds": res["rounds"],
+            "jobs": {
+                "submitted": jobs["submitted"],
+                "completed": jobs["completed"],
+                "completed_fraction": round(
+                    jobs["completed"] / max(jobs["submitted"], 1), 4),
+                "evacuated": jobs["evacuated"],
+                "evacuated_completed": jobs["evacuated_completed"],
+                "evacuated_pending_count": len(jobs["evacuated_pending"]),
+            },
+            "serving": {
+                "streams": serving["streams"],
+                "completed_ok": serving["completed_ok"],
+                "completed_fraction": round(
+                    serving["completed_ok"]
+                    / max(serving["streams"], 1), 4),
+                "rerouted": serving["rerouted"],
+                "evacuated_completed_ok": serving[
+                    "evacuated_completed_ok"],
+                "dropped_non_evacuated_count": len(
+                    serving["dropped_non_evacuated"]),
+            },
+            "evacuation": {
+                "ack_objects_at_kill": evac["ackObjectsAtKill"],
+                "ack_objects_lost": evac["ackObjectsLost"],
+                "torn_tail_records": evac["standbyCatchUp"][
+                    "tailTornRecords"],
+                "jobs_evacuated": evac["jobsEvacuated"],
+                "prefix_homes_moved": evac["prefixHomesMoved"],
+                "streams_rerouted": evac["streamsRerouted"],
+            },
+            "slo": {
+                "alerts_fired": health["alerts_fired"],
+                "pages_fired": health["pages_fired"],
+                "stranded_alerts": health["stranded_alerts"],
+                "min_budget_remaining": health["min_budget_remaining"],
+            },
+            "forensics": {
+                "pages": summary["pages"],
+                "pages_linked": summary["pages_linked"],
+                "pages_unlinked": summary["pages_unlinked"],
+                "unresolved_incidents": summary["unresolved_incidents"],
+            },
+            "shipping": ship,
+            "determinism": {
+                "bit_identical": bit_identical,
+                "result_sha256": hashlib.sha256(
+                    blob.encode()).hexdigest(),
+            },
+        }
+        print(f"seed {seed}: two evacuation days replayed in "
+              f"{wall:.1f}s wall (victim {victim} @"
+              f"{evac['atSimSeconds']}s, {evac['jobsEvacuated']} job(s) "
+              f"emigrated, {serving['rerouted']} stream(s) rerouted, "
+              f"acked-objects lost {evac['ackObjectsLost']}, "
+              f"bit_identical={bit_identical})", file=sys.stderr)
+        out[str(seed)] = block
+    return out
+
+
+def _evaluate(scorecard: dict, seeds) -> dict:
+    from kubedl_tpu.replay.scorecard import _get
+    checks, ok = [], True
+    for seed in seeds:
+        for path, op, thr in _GATES:
+            full = f"seeds.{seed}.{path}"
+            value = _get(scorecard, full)
+            passed = (value is not None
+                      and (value >= thr if op == ">=" else value <= thr))
+            ok = ok and passed
+            checks.append({"metric": full, "op": op, "threshold": thr,
+                           "value": value, "passed": passed})
+    return {"checks": checks, "passed": ok}
+
+
+def main() -> dict:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--seeds", default="0",
+                    help="evacuation-day seeds")
+    ap.add_argument("--out", default="BENCH_FEDERATION.json")
+    ap.add_argument("--no-check", action="store_true",
+                    help="skip the regression check against the "
+                         "committed artifact")
+    args = ap.parse_args()
+    seeds = [int(x) for x in args.seeds.split(",") if x.strip() != ""]
+
+    scorecard = {
+        "benchmark": "federation",
+        "topology": {"spec": TOPOLOGY_SPEC},
+        "seeds": federation_leg(seeds),
+    }
+    scorecard["gates"] = _evaluate(scorecard, seeds)
+
+    problems = []
+    if not args.no_check and args.out and os.path.exists(args.out):
+        from kubedl_tpu.replay.scorecard import check_tolerances
+        try:
+            with open(args.out) as f:
+                committed = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"warning: cannot read committed {args.out}: {e}",
+                  file=sys.stderr)
+            committed = {}
+        problems = check_tolerances(scorecard, committed, _REGRESSION)
+
+    print(json.dumps(scorecard))
+    if not scorecard["gates"]["passed"]:
+        failed = [c for c in scorecard["gates"]["checks"]
+                  if not c["passed"]]
+        raise SystemExit(f"GATE FAILED: {failed}")
+    if problems:
+        raise SystemExit("REGRESSION vs committed scorecard:\n  "
+                         + "\n  ".join(problems))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(scorecard, f, indent=2, sort_keys=True)
+            f.write("\n")
+    return scorecard
+
+
+if __name__ == "__main__":
+    main()

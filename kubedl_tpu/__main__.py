@@ -68,6 +68,18 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "/api/v1/serving/fleet endpoint "
                         "(docs/serving_fleet.md; also ServingFleet "
                         "gate)")
+    p.add_argument("--enable-federation", action="store_true",
+                   help="multi-region federation: global queue routing "
+                        "over per-region placement scores, cross-region "
+                        "serving catalog with geo-affinity, cross-region "
+                        "WAL shipping to warm standbys, region-evacuation "
+                        "survival, console /api/v1/federation endpoints "
+                        "(docs/federation.md; also Federation gate; "
+                        "requires --enable-durability)")
+    p.add_argument("--region-topology", default="",
+                   help='static region graph "r1,r2;r1~r2=LAT_MS/'
+                        'EGRESS_PER_GB;..." (docs/federation.md '
+                        '"Region topology grammar")')
     p.add_argument("--slice-capacity", default="",
                    help='static slice inventory "POOL=N,..." (e.g. '
                         '"tpu-v5p-slice/2x2x4=4") when the control plane '
@@ -181,6 +193,16 @@ def parse_args(argv=None) -> argparse.Namespace:
         p.error("--enable-elastic-slices requires "
                 "--enable-slice-scheduler (min..max gang admission and "
                 "shrink-in-place are scheduling-pass decisions)")
+    # the federation's zero-loss evacuation contract IS the journal +
+    # cross-region standby — federation without durability would
+    # silently lose every acknowledged write a dead region held, so
+    # fail at the parser (build_operator re-checks for library callers)
+    if args.enable_federation and not args.enable_durability:
+        p.error("--enable-federation requires --enable-durability (the "
+                "region-evacuation zero-loss contract rests on each "
+                "region's WAL journal and its cross-region standby)")
+    if args.region_topology and not args.enable_federation:
+        p.error("--region-topology requires --enable-federation")
     return args
 
 
@@ -221,6 +243,8 @@ def config_from_args(args: argparse.Namespace) -> OperatorConfig:
         async_snapshots=args.async_snapshots,
         enable_elastic_slices=args.enable_elastic_slices,
         enable_serving_fleet=args.enable_serving_fleet,
+        enable_federation=args.enable_federation,
+        region_topology=args.region_topology,
     )
 
 

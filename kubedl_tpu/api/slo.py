@@ -56,8 +56,12 @@ SLO_API_VERSION = "slo.kubedl.io/v1alpha1"
 #: default compliance window: the SRE-conventional 30 days
 DEFAULT_WINDOW_S = 30 * 86400.0
 
-#: event-signal bases the built-in harvesters feed (docs/slo.md catalogue)
-EVENT_SIGNALS = ("ttft", "queue", "queue_delay", "restart_mttr")
+#: event-signal bases the built-in harvesters feed (docs/slo.md
+#: catalogue). ``evac_restore`` / ``evac_lostwork`` are the federation
+#: driver's evacuation signals (docs/federation.md): per-emigration
+#: restore latency + work lost past the object-store checkpoint bank.
+EVENT_SIGNALS = ("ttft", "queue", "queue_delay", "restart_mttr",
+                 "evac_restore", "evac_lostwork")
 
 #: the fleet-goodput gauge signal (GoodputAccountant.fleet_goodput)
 SIGNAL_FLEET_GOODPUT = "fleet_goodput"

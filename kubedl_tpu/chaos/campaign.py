@@ -41,6 +41,13 @@ from its committed seed. The grammar (docs/chaos.md):
                        promoted through the Lease machinery — requires
                        the replay's ``replication_followers`` > 0
                        (docs/replication.md)
+  ``region_down``      ``_start``/``_end`` pair: an entire REGION dies at
+                       once — its leader, followers, and every pool —
+                       and the federation layer evacuates (elastic jobs
+                       emigrate via the object-store checkpoint tier,
+                       serving streams re-route). Requires a federation
+                       driver (``FederationReplay``); a single-cluster
+                       replay raises loudly (docs/federation.md)
   ===================  ====================================================
 
 Faults are injected through the seeded :class:`ChaosAPIServer`
@@ -66,6 +73,7 @@ PRIMITIVES = frozenset({
     "watch_storm_start", "watch_storm_end",
     "slow_fsync_start", "slow_fsync_end",
     "leader_kill",
+    "region_down_start", "region_down_end",
 })
 
 
@@ -278,6 +286,28 @@ def _scn_leader_kill(rng, profile, spot_pools) -> list:
     return acts
 
 
+def _scn_region_evacuation(rng, profile, spot_pools, regions) -> list:
+    """The federation tentpole (docs/federation.md): one region dies
+    whole at mid-day — leader, followers, and pools in a single sweep —
+    and stays down long enough that evacuation, emigration, and the
+    global SLO verdicts all land inside the window. The victim is drawn
+    from the sorted region names so the script is a pure function of
+    ``(seed, profile, regions)``."""
+    if not regions:
+        raise ValueError("region-evacuation scenario needs regions=")
+    day = profile.sim_seconds
+    names = sorted(regions)
+    victim = names[rng.randrange(len(names))]
+    at = rng.uniform(0.45, 0.55) * day
+    duration = rng.uniform(1500.0, 2100.0)
+    return [
+        FaultAction(round(at, 3), "region_down_start",
+                    _params(region=victim)),
+        FaultAction(round(at + duration, 3), "region_down_end",
+                    _params(region=victim)),
+    ]
+
+
 SCENARIOS = {
     "domain-outage": _scn_domain_outage,
     "spot-dryness": _scn_spot_dryness,
@@ -288,21 +318,32 @@ SCENARIOS = {
     "slow-fsync": _scn_slow_fsync,
     "adversarial": _scn_adversarial,
     "leader-kill": _scn_leader_kill,
+    "region-evacuation": _scn_region_evacuation,
 }
+
+#: scenarios whose builders take the region-name list as a 4th argument;
+#: every other builder keeps its 3-arg signature, so pre-existing
+#: scenario scripts stay bit-identical whether or not ``regions`` is
+#: passed to :func:`build_campaign`
+_REGION_SCENARIOS = frozenset({"region-evacuation"})
 
 
 def build_campaign(scenario: str, seed: int, profile,
-                   spot_pools=None) -> Campaign:
+                   spot_pools=None, regions=None) -> Campaign:
     """Compile ``scenario`` for ``(seed, profile)`` — pure: no wall
     clock, no ambient entropy, one namespaced rng stream. ``spot_pools``
     overrides the fleet's spot-class set (defaults to the replay
-    workload's ``POOL_SPOT``)."""
+    workload's ``POOL_SPOT``); ``regions`` is the sorted-then-drawn
+    victim set for region scenarios (ignored elsewhere)."""
     builder = SCENARIOS.get(scenario)
     if builder is None:
         raise ValueError(f"unknown scenario {scenario!r}: want one of "
                          f"{', '.join(sorted(SCENARIOS))}")
     rng = random.Random(f"{seed}:campaign:{scenario}")
-    actions = builder(rng, profile, spot_pools)
+    if scenario in _REGION_SCENARIOS:
+        actions = builder(rng, profile, spot_pools, regions)
+    else:
+        actions = builder(rng, profile, spot_pools)
     bad = sorted({a.primitive for a in actions} - PRIMITIVES)
     if bad:
         raise ValueError(f"scenario {scenario!r} emitted unknown "
@@ -501,6 +542,38 @@ class CampaignRunner:
         without ``replication_followers`` — a silently skipped failover
         would gut the scenario's whole point."""
         self.replay.kill_leader()
+
+    # -- region down -------------------------------------------------------
+
+    def _do_region_down_start(self, action: FaultAction) -> None:
+        """Kill an entire region — leader, followers, pools — and hand
+        evacuation to the federation driver (docs/federation.md). Like
+        ``leader_kill``, a replay that cannot evacuate raises loudly: a
+        silently skipped region death would gut the scenario. Evacuated
+        jobs land in the shared preemption ledgers so the forensics
+        timeline can chain their pages to the ``region_down`` window."""
+        region = action.param("region")
+        evacuate = getattr(self.replay, "region_down", None)
+        if evacuate is None:
+            raise RuntimeError(
+                "region_down needs a federation driver (FederationReplay"
+                "); a single-cluster replay has no region to kill")
+        for name in evacuate(region):
+            self.gang_preemptions.append((name, "region_down"))
+            self.preemption_log.append({
+                "t": self.replay.clock(), "job": name,
+                "primitive": "region_down"})
+
+    def _do_region_down_end(self, action: FaultAction) -> None:
+        """Close the forensics window. The region does NOT come back —
+        evacuation is one-way for the day (a revived region would need a
+        rejoin/backfill protocol this layer doesn't model yet); the
+        driver only notes the window so timeline attribution can pair
+        start and end by region param."""
+        region = action.param("region")
+        restore = getattr(self.replay, "region_down_end", None)
+        if restore is not None:
+            restore(region)
 
     # -- slow fsync --------------------------------------------------------
 

@@ -45,7 +45,8 @@ class DataProxy:
                  job_kinds=TRAINING_KINDS, tracer=None, scheduler=None,
                  telemetry=None, journal=None, replication=None,
                  elastic: bool = False, serving_fleet=None,
-                 serving_autoscaler=None, serving_router=None):
+                 serving_autoscaler=None, serving_router=None,
+                 federation=None):
         self.api = api
         self.object_backend = object_backend
         self.event_backend = event_backend
@@ -74,6 +75,10 @@ class DataProxy:
         self.serving_fleet = serving_fleet
         self.serving_autoscaler = serving_autoscaler
         self.serving_router = serving_router
+        #: the federation driver (docs/federation.md); None = the
+        #: /api/v1/federation endpoints answer 501 (gate-off path
+        #: byte-identical: this process hosts no global layer)
+        self.federation = federation
 
     # -- jobs -------------------------------------------------------------
 
@@ -648,6 +653,26 @@ class DataProxy:
         how much inherited WAL tail was replayed, how long the lease
         wait took), the replication analog of ``recoveredFrom``."""
         return self.replication.status()
+
+    # -- federation (docs/federation.md) ----------------------------------
+
+    @property
+    def federation_enabled(self) -> bool:
+        return self.federation is not None
+
+    def federation_status(self) -> dict:
+        """The global layer's live document: region liveness, routing
+        spread, catalog prefix homes, cross-region shipping health, and
+        standby state (docs/federation.md)."""
+        return self.federation.status()
+
+    def federation_topology(self) -> dict:
+        """The static region topology the routing scores derive from:
+        regions, pairwise latency/egress, and the grammar fingerprint
+        the committed federation scorecard pins."""
+        doc = self.federation.topology.describe()
+        doc["fingerprint"] = self.federation.topology.fingerprint()
+        return doc
 
     def job_elastic(self, namespace: str, name: str) -> Optional[dict]:
         """The job's live elastic state (docs/elastic.md): the recorded

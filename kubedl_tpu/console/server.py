@@ -588,6 +588,22 @@ class ConsoleServer:
                                     "--journal-dir)"}, []
             return ok(self.proxy.replication_status())
 
+        # federation (docs/federation.md): the global layer's live
+        # routing/catalog/shipping document and the static region
+        # topology; 501 when this process hosts no federation driver
+        # (--enable-federation / Federation gate off), matching the
+        # replication endpoints' convention
+        if path.startswith("/api/v1/federation/"):
+            if not self.proxy.federation_enabled:
+                return 501, {"code": 501,
+                             "msg": "federation disabled "
+                                    "(--enable-federation / Federation "
+                                    "gate, with --enable-durability)"}, []
+            if path == "/api/v1/federation/status":
+                return ok(self.proxy.federation_status())
+            if path == "/api/v1/federation/topology":
+                return ok(self.proxy.federation_topology())
+
         # slice-scheduler queues: quota + live usage (docs/scheduling.md)
         if path == "/api/v1/queue/list":
             return ok(self.proxy.list_queues())

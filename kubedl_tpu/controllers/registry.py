@@ -154,6 +154,23 @@ class OperatorConfig:
     #: carries the metric families and the console surface a hosted
     #: fleet plugs into.
     enable_serving_fleet: bool = False
+    #: multi-region federation (docs/federation.md). Also switchable
+    #: via the Federation gate; either turns it on. REQUIRES the
+    #: durable control plane (--enable-durability): the global layer's
+    #: zero-loss evacuation contract rests on each region being
+    #: journal-backed + replicated — build_operator fails fast
+    #: otherwise. Off by default: no kubedl_federation_* family
+    #: registers and the console federation endpoints answer 501 (the
+    #: byte-identical-disabled convention). The federation driver
+    #: itself lives in the simulation harness
+    #: (kubedl_tpu.federation.FederationReplay) — the operator side
+    #: carries the metric families, the parsed topology, and the
+    #: console surface a hosted driver plugs into.
+    enable_federation: bool = False
+    #: --region-topology: the static region graph
+    #: ("r1,r2,r3;r1~r2=latency_ms/egress_per_gb;..." — docs/federation
+    #: .md "Region topology grammar"); "" = no topology parsed
+    region_topology: str = ""
 
 
 @dataclass
@@ -191,6 +208,15 @@ class Operator:
     #: binary / tests); None in the plain operator — the console's
     #: /api/v1/serving/fleet endpoint answers 501 without it
     serving_fleet: object = None
+    #: multi-region federation on (docs/federation.md)
+    federation_enabled: bool = False
+    #: the FederationMetrics bundle when the gate is on (a hosted
+    #: federation driver adopts it so the kubedl_federation_* families
+    #: land in THIS exposition)
+    federation_metrics: object = None
+    #: the parsed RegionTopology when --region-topology is set (the
+    #: console's /api/v1/federation/topology source); None otherwise
+    region_topology: object = None
 
     def run_until_idle(self, **kw):
         return self.manager.run_until_idle(**kw)
@@ -310,6 +336,28 @@ def build_operator(api: Optional[APIServer] = None,
     if serving_fleet_enabled:
         from ..metrics.registry import ServingFleetMetrics
         serving_fleet_metrics = ServingFleetMetrics(registry)
+    # multi-region federation (docs/federation.md): the
+    # kubedl_federation_* families register only here, so the disabled
+    # exposition stays byte-identical. The gate is meaningless without
+    # the durable control plane underneath — the evacuation's zero-loss
+    # contract IS the journal + standby catch-up — so fail fast rather
+    # than silently degrade (same posture as elastic-without-scheduler).
+    federation_enabled = (config.enable_federation
+                          or gates.enabled(ft.FEDERATION))
+    if federation_enabled and not durable:
+        raise ValueError(
+            "enable_federation requires the durable control plane "
+            "(--enable-durability / DurableControlPlane gate): the "
+            "region-evacuation zero-loss contract rests on each "
+            "region's WAL journal and its cross-region standby")
+    federation_metrics = None
+    region_topology = None
+    if federation_enabled:
+        from ..federation.topology import RegionTopology
+        from ..metrics.registry import FederationMetrics
+        federation_metrics = FederationMetrics(registry)
+        if config.region_topology:
+            region_topology = RegionTopology.parse(config.region_topology)
     # fleet telemetry bundle (docs/telemetry.md): one instance shared by
     # every engine (goodput harvest + straggler scans) and the console
     # (explainer / job-detail goodput); None keeps the disabled path free
@@ -448,7 +496,10 @@ def build_operator(api: Optional[APIServer] = None,
                     replication=replication,
                     elastic_enabled=elastic_enabled,
                     serving_fleet_enabled=serving_fleet_enabled,
-                    serving_fleet_metrics=serving_fleet_metrics)
+                    serving_fleet_metrics=serving_fleet_metrics,
+                    federation_enabled=federation_enabled,
+                    federation_metrics=federation_metrics,
+                    region_topology=region_topology)
 
 
 def _storage_backend(spec: str, for_events: bool = False):

@@ -69,6 +69,15 @@ TPU_ELASTIC_SLICES = "TPUElasticSlices"
 #: kubedl_serving_free_blocks families register, and the console fleet
 #: endpoint answers 501 (the byte-identical-disabled convention)
 SERVING_FLEET = "ServingFleet"
+#: multi-region federation (docs/federation.md): a global layer over N
+#: replicated clusters — topology-priced queue routing, a cross-region
+#: serving catalog with geo-affine prefix homes, follower-served
+#: cross-region reads, and region-evacuation chaos; off by default — no
+#: kubedl_federation_* family registers, the console federation
+#: endpoints answer 501, and every committed single-cluster scorecard
+#: stays byte-identical. Requires the durable control plane (regions
+#: replicate through the WAL shipping stream).
+FEDERATION = "Federation"
 
 _DEFAULTS = {
     GANG_SCHEDULING: True,           # Beta
@@ -85,6 +94,7 @@ _DEFAULTS = {
     DURABLE_CONTROL_PLANE: False,    # Alpha
     TPU_ELASTIC_SLICES: False,       # Alpha
     SERVING_FLEET: False,            # Alpha
+    FEDERATION: False,               # Alpha
 }
 
 ENV_FEATURE_GATES = "KUBEDL_FEATURE_GATES"

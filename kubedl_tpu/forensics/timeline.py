@@ -36,8 +36,11 @@ from __future__ import annotations
 from typing import Optional
 
 #: primitives whose _start/_end pairs bound a window; everything else
-#: is a point fault at its action time
-_WINDOW_PRIMITIVES = ("spot_dry", "watch_storm", "slow_fsync")
+#: is a point fault at its action time. ``region_down`` ends carry the
+#: region param (like ``spot_dry_end`` names its pool), so two staggered
+#: region outages pair by region instead of LIFO-swapping attribution.
+_WINDOW_PRIMITIVES = ("spot_dry", "watch_storm", "slow_fsync",
+                      "region_down")
 
 #: how long a closed fault window keeps explaining later bad samples
 #: (rule 3): retirement-time signals report a fault's damage when the

@@ -614,6 +614,55 @@ class ServingFleetMetrics:
         self.draining.set(draining)
 
 
+class FederationMetrics:
+    """Multi-region federation families (docs/federation.md): the
+    cross-region WAL shipping stream's retry/exhaustion counters, global
+    queue-routing decisions, evacuation outcomes, and the follower-read
+    path. Constructed only when the Federation gate is on — the disabled
+    operator's exposition carries no ``kubedl_federation_*`` family at
+    all (the byte-identical-disabled convention)."""
+
+    def __init__(self, registry: Optional[Registry] = None):
+        self.registry = registry or Registry()
+        r = self.registry
+        self.ship_retries = r.counter(
+            "kubedl_federation_ship_retries_total",
+            "Cross-region WAL ship attempts retried after a transient "
+            "failure (exponential backoff; bounded)", ("region",))
+        self.ship_frames = r.counter(
+            "kubedl_federation_ship_frames_total",
+            "Cross-region WAL frames delivered to a peer region's "
+            "standby", ("region",))
+        self.ship_exhausted = r.counter(
+            "kubedl_federation_ship_exhausted_total",
+            "Frames abandoned after the retry budget ran out (a Warning "
+            "Event fires; the standby catches up by snapshot resync)",
+            ("region",))
+        self.jobs_routed = r.counter(
+            "kubedl_federation_jobs_routed_total",
+            "Jobs landed by the global router, by chosen region",
+            ("region",))
+        self.jobs_evacuated = r.counter(
+            "kubedl_federation_jobs_evacuated_total",
+            "Jobs emigrated out of a dead region (object-store restore "
+            "in a survivor)", ("region",))
+        self.follower_reads = r.counter(
+            "kubedl_federation_follower_reads_total",
+            "Cross-region reads served from a peer region's standby",
+            ("region",))
+        self.read_redirects = r.counter(
+            "kubedl_federation_read_redirects_total",
+            "Cross-region reads redirected because the standby was "
+            "mid-promotion (never a torn read)", ("region",))
+        self.streams_rerouted = r.counter(
+            "kubedl_federation_streams_rerouted_total",
+            "Serving streams re-homed off a dead region's catalog "
+            "partition", ("region",))
+        self.regions_down = r.gauge(
+            "kubedl_federation_regions_down",
+            "Regions currently evacuated")
+
+
 class TraceMetrics:
     """Span-recorder health (docs/tracing.md): recorded-span throughput
     per component, ring-buffer occupancy, and the overflow-drop counter

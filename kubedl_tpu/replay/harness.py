@@ -151,7 +151,8 @@ class ClusterReplay:
 
     def __init__(self, workload: Workload, shards: int = 1,
                  campaign=None, journal_dir: Optional[str] = None,
-                 replication_followers: int = 0, elastic: bool = False):
+                 replication_followers: int = 0, elastic: bool = False,
+                 clock: Optional[SimClock] = None):
         self.workload = workload
         profile = workload.profile
         seed = workload.seed
@@ -175,7 +176,11 @@ class ClusterReplay:
         #: drain pops in globally-earliest-(ready_at, seq) order
         #: regardless of shard count (pinned by tests/test_replay.py).
         self.shards = max(int(shards), 1)
-        self.clock = SimClock()
+        #: an injected clock makes this replay one REGION of a larger
+        #: simulation (docs/federation.md): N replays sharing one
+        #: SimClock advance in lockstep under a federation driver. The
+        #: default — own clock — is every committed scorecard's path.
+        self.clock = clock if clock is not None else SimClock()
         self.registry = Registry()
         # deterministic uids: trace ids and per-job restart-backoff
         # jitter derive from uids, so uuid4 would make every run's
@@ -331,6 +336,14 @@ class ClusterReplay:
         self._util_slice_seconds = 0.0
         self._last_t: Optional[float] = None
         self.rounds = 0
+        self._handlers = {
+            _EV_ARRIVAL: self._on_arrival,
+            _EV_COMPLETE: lambda p: self._on_complete(*p),
+            _EV_PREEMPT: self._on_preempt,
+            _EV_RETIRE: self._on_retire,
+            _EV_CAMPAIGN: self._on_campaign,
+            _EV_CKPT_ACK: lambda p: self._on_ckpt_ack(*p),
+        }
         # placement telemetry (docs/scheduling.md "Placement scoring"):
         # derived observations only — the replay's scheduling decisions
         # are untouched, so every pre-existing scorecard metric stays
@@ -731,8 +744,17 @@ class ClusterReplay:
             self._util_slice_seconds += held * dt
         self._last_t = now
 
-    def run(self) -> dict:
-        profile = self.workload.profile
+    # The day loop is split into stepper methods so a federation driver
+    # (docs/federation.md) can interleave N regions on ONE shared clock:
+    # prepare() seeds the heap, next_wake() reports when this region
+    # needs the clock, service() runs one round at the current time, and
+    # finalize() settles the end of day. run() composes them in exactly
+    # the original operation order, so every committed scorecard stays
+    # byte-identical (pinned by the bench regression gates).
+
+    def prepare(self) -> None:
+        """Seed the event heap from the workload and arm the utilization
+        integrator — everything ``run()`` did before its first round."""
         for spec in self.workload.jobs:
             self._push(spec.arrival_s, _EV_ARRIVAL, spec)
         for pe in self.workload.preemptions:
@@ -740,49 +762,54 @@ class ClusterReplay:
         if self.campaign is not None:
             for action in self.campaign.actions:
                 self._push(action.time_s, _EV_CAMPAIGN, action)
-        handlers = {
-            _EV_ARRIVAL: self._on_arrival,
-            _EV_COMPLETE: lambda p: self._on_complete(*p),
-            _EV_PREEMPT: self._on_preempt,
-            _EV_RETIRE: self._on_retire,
-            _EV_CAMPAIGN: self._on_campaign,
-            _EV_CKPT_ACK: lambda p: self._on_ckpt_ack(*p),
-        }
         self._last_t = self.clock()
-        max_rounds = 80 * profile.jobs + 10_000
-        while self._events or not all(
-                r.succeeded for r in self._jobs.values()):
-            self.rounds += 1
-            if self.rounds > max_rounds:
-                raise RuntimeError(
-                    f"replay exceeded {max_rounds} rounds — wedged?")
-            nxt = self._events[0][0] if self._events else None
-            dl = self.manager.next_deadline()
-            if dl is not None:
-                dl_sim = dl - self.clock.t0
-                nxt = dl_sim if nxt is None else min(nxt, dl_sim)
-            if nxt is None:
-                unfinished = [n for n, r in self._jobs.items()
-                              if not r.succeeded]
-                raise RuntimeError(
-                    f"replay wedged: no events, no manager deadlines, "
-                    f"{len(unfinished)} job(s) unfinished "
-                    f"(e.g. {unfinished[:5]})")
-            self._integrate_util()
-            self.clock.advance_to(nxt + _EPS)
-            while self._events \
-                    and self._events[0][0] <= self.clock.elapsed + _EPS:
-                _, kind, _, payload = heapq.heappop(self._events)
-                handlers[kind](payload)
-            self.manager.run_until_idle(max_iterations=1_000_000)
-            self._kubelet_round()
-            self._integrate_util()
-            self.slo.maybe_evaluate(self.clock())
-            if self.replication is not None:
-                # lease renewals + standby expiry observations on the
-                # retry cadence (sim time) — the watching that lets a
-                # promotion land within one lease term of a kill
-                self.replication.maybe_step_election(self.clock())
+
+    def next_wake(self) -> Optional[float]:
+        """Sim-relative time of this replay's next scheduled work: the
+        event heap's head or the manager's earliest deadline, whichever
+        comes first (None = nothing scheduled)."""
+        nxt = self._events[0][0] if self._events else None
+        dl = self.manager.next_deadline()
+        if dl is not None:
+            dl_sim = dl - self.clock.t0
+            nxt = dl_sim if nxt is None else min(nxt, dl_sim)
+        return nxt
+
+    def service(self) -> None:
+        """One round at the CURRENT clock time: pop every due event,
+        drain the manager, run the kubelet, settle utilization, and step
+        the SLO evaluator + replication election. The caller advances
+        the clock (``run()`` to :meth:`next_wake`; a federation driver
+        to the global minimum across regions)."""
+        while self._events \
+                and self._events[0][0] <= self.clock.elapsed + _EPS:
+            _, kind, _, payload = heapq.heappop(self._events)
+            self._handlers[kind](payload)
+        self.manager.run_until_idle(max_iterations=1_000_000)
+        self._kubelet_round()
+        self._integrate_util()
+        self.slo.maybe_evaluate(self.clock())
+        if self.replication is not None:
+            # lease renewals + standby expiry observations on the
+            # retry cadence (sim time) — the watching that lets a
+            # promotion land within one lease term of a kill
+            self.replication.maybe_step_election(self.clock())
+
+    @property
+    def finished(self) -> bool:
+        """No pending events and every tracked job succeeded."""
+        return not self._events and all(
+            r.succeeded for r in self._jobs.values())
+
+    def inject_job(self, spec) -> None:
+        """Mid-run arrival injection — the federation layer's global-
+        routing and evacuation seam (docs/federation.md): identical to a
+        workload arrival landing at the current sim time."""
+        self._on_arrival(spec)
+
+    def finalize(self) -> None:
+        """End of day: final SLO windows + verdicts, WAL tail seal, and
+        the scheduler's inventory-parity check."""
         self.slo.evaluate(self.clock())     # final windows + verdicts
         if self.replication is not None:
             # orderly end of day: seal the WAL tail so the shipping
@@ -793,6 +820,28 @@ class ClusterReplay:
             self.replication.journal.flush()
         if hasattr(self.scheduler, "check_parity"):
             self.scheduler.check_parity()
+
+    def run(self) -> dict:
+        profile = self.workload.profile
+        self.prepare()
+        max_rounds = 80 * profile.jobs + 10_000
+        while not self.finished:
+            self.rounds += 1
+            if self.rounds > max_rounds:
+                raise RuntimeError(
+                    f"replay exceeded {max_rounds} rounds — wedged?")
+            nxt = self.next_wake()
+            if nxt is None:
+                unfinished = [n for n, r in self._jobs.items()
+                              if not r.succeeded]
+                raise RuntimeError(
+                    f"replay wedged: no events, no manager deadlines, "
+                    f"{len(unfinished)} job(s) unfinished "
+                    f"(e.g. {unfinished[:5]})")
+            self._integrate_util()
+            self.clock.advance_to(nxt + _EPS)
+            self.service()
+        self.finalize()
         return self._result()
 
     def _placement_block(self) -> dict:

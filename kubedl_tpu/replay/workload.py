@@ -120,6 +120,23 @@ PROFILES = {
         chaos_conflict=0.0, chaos_create_error=0.0,
         chaos_drop_watch=0.0, chaos_max_faults=0,
         serving_requests=0, serving_bursts=0),
+    # the multi-region leg (docs/federation.md): ONE global job day the
+    # federation driver routes across N regions (each region runs this
+    # profile's capacity), plus a modest serving day whose streams the
+    # cross-region catalog partitions. Chaos-free background — the only
+    # disruption is the `region-evacuation` campaign's region death, so
+    # evacuation attribution and the zero-loss audit are exact. Long
+    # mean durations keep jobs running at the mid-day kill.
+    "federation": Profile(
+        name="federation", sim_seconds=4 * 3600.0, jobs=24,
+        job_bursts=2, burst_frac=0.35, chaos_preemptions=0,
+        capacity={POOL_V5P: 6, POOL_V5E: 8},
+        duration_mean_s=3600.0, trace_capacity=32768, sample_traces=8,
+        chaos_conflict=0.0, chaos_create_error=0.0,
+        chaos_drop_watch=0.0, chaos_max_faults=0,
+        serving_requests=60, serving_bursts=3, lanes=4,
+        max_len=64, pool_blocks=48, prefixes=6,
+        serving_trace_capacity=16384),
 }
 
 #: tenant queues: prod is guaranteed, batch partially, best borrows only

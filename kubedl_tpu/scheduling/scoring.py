@@ -89,10 +89,21 @@ class PlacementScorer:
 
     # -- the ranking ------------------------------------------------------
 
-    def rank(self, key: str, pools: list, demand: int) -> list:
+    def rank(self, key: str, pools: list, demand: int,
+             region=None) -> list:
         """Score every candidate pool for a ``demand``-slice gang;
         returns score rows sorted best-first (ties: candidate order, so
-        the routed primary pool wins a dead heat). Pure read."""
+        the routed primary pool wins a dead heat). Pure read.
+
+        ``region`` is the federation layer's per-region cost context
+        (``federation/topology.RegionCost``, docs/federation.md): any
+        object with ``name`` / ``latency_ms`` / ``egress_per_gb`` /
+        ``factor``. When present, the factor divides the score — data
+        gravity and wire distance price a far region down exactly like
+        an expensive pool — and the rows carry the region terms so the
+        pending-job explainer can name them. When absent (every
+        single-cluster caller), the rows and scores are byte-identical
+        to before the federation layer existed."""
         rates = self.rates(key, pools)
         best = max(rates.values(), default=0.0)
         rows = []
@@ -104,7 +115,7 @@ class PlacementScorer:
             chips = topology.pool_slice_chips(pool) or 1
             cost = max(econ.cost_per_chip_hour, 1e-9) * chips
             norm = rates[pool] / best if best > 0 else 0.0
-            rows.append({
+            row = {
                 "pool": pool,
                 "tokensPerSecond": round(rates[pool], 4),
                 "normalizedThroughput": round(norm, 4),
@@ -114,7 +125,17 @@ class PlacementScorer:
                 "spot": econ.spot,
                 "score": round(norm / (penalty * cost), 6),
                 "_order": order,
-            })
+            }
+            if region is not None:
+                rfac = max(float(region.factor), 1e-9)
+                row["region"] = region.name
+                row["regionLatencyMs"] = round(
+                    float(region.latency_ms), 4)
+                row["regionEgressPerGB"] = round(
+                    float(region.egress_per_gb), 4)
+                row["regionFactor"] = round(rfac, 6)
+                row["score"] = round(norm / (penalty * cost * rfac), 6)
+            rows.append(row)
         rows.sort(key=lambda r: (-r["score"], r["_order"]))
         for r in rows:
             del r["_order"]

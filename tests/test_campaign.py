@@ -225,7 +225,11 @@ def test_campaign_deterministic_for_fixed_inputs():
 def test_every_scenario_compiles_with_known_primitives():
     p = PROFILES["adversarial"]
     for name in SCENARIOS:
-        camp = build_campaign(name, 0, p)
+        # region-evacuation is the one scenario parameterized beyond the
+        # profile: it draws its victim from the region set
+        regions = (("r1", "r2", "r3")
+                   if name == "region-evacuation" else None)
+        camp = build_campaign(name, 0, p, regions=regions)
         assert camp.actions, name
         assert {x.primitive for x in camp.actions} <= PRIMITIVES, name
     # window primitives always come in start/end pairs
