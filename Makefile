@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test test-all test-fast test-chaos test-campaign test-scheduler test-trace test-replay test-telemetry test-slo test-durability test-forensics test-replication test-elastic test-serving-fleet test-federation bench bench-controlplane bench-scheduler bench-serving-paged bench-serving-fleet bench-federation bench-trace bench-cluster bench-cluster-adversarial bench-elastic postmortem dryrun crds run-standalone lint native
+.PHONY: test test-all test-fast test-chaos test-campaign test-scheduler test-trace test-replay test-telemetry test-slo test-durability test-forensics test-replication test-elastic test-serving-fleet test-federation test-rl bench bench-controlplane bench-scheduler bench-serving-paged bench-serving-fleet bench-federation bench-rl bench-trace bench-cluster bench-cluster-adversarial bench-elastic postmortem dryrun crds run-standalone lint native
 
 # fast path (<3 min): everything except the compile-heavy compute suites
 # (those carry `pytestmark = pytest.mark.slow`). Chaos tests are fast and
@@ -164,6 +164,26 @@ test-federation:
 # tier-1 guard is tests/test_federation.py.
 bench-federation:
 	JAX_PLATFORMS=cpu $(PY) bench_federation.py
+
+# RL post-training flywheel suite (GRPO math, rollout-tenant generation
+# through the fleet router, weight publishing without dropped streams,
+# version-pinned rollouts, RLJob controller, gate-off contract;
+# docs/rl.md)
+test-rl:
+	$(PY) -m pytest tests/ -q -m rl
+
+# RL flywheel bench -> BENCH_RL.json (docs/rl.md): the routing day with
+# an RLJob riding the fleet as a low-priority rollout tenant vs the
+# same day without it. Gates: user p99 TTFT within tolerance of the
+# no-RL baseline, rollout throughput >= the declared floor, >= 2 weight
+# publishes with zero dropped streams (user AND rollout), loss-curve
+# continuity across one elastic learner resize (bit-identical restore),
+# and the whole leg bit-identical across two in-process runs; FAILS on
+# regression vs the committed artifact. The tier-1 guard is
+# tests/test_rl.py.
+bench-rl:
+	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	    $(PY) bench_rl.py
 
 # render the committed adversarial campaign's forensics blocks as
 # markdown postmortems (docs/forensics.md; regenerate the blocks with

@@ -1,0 +1,151 @@
+"""RL post-training flywheel bench: one RLJob rides the serving day.
+
+One leg, one JSON (``BENCH_RL.json``, docs/rl.md): the committed
+``routing`` fleet day (identical workload fingerprint, engines,
+prefix-aware router, SLO evaluator, SimClock as
+``BENCH_SERVING_FLEET.json``'s routing leg) replayed twice — once bare
+(the no-RL baseline) and once with a :class:`~kubedl_tpu.replay.rl
+.FlywheelReplay` co-scheduling a GRPO RLJob as the ``rollout`` tenant:
+
+* rollout generations ride the replay's own router on a dedicated
+  low-priority queue (``QueueSpec.tenants``); the fairness spill
+  squeezes them off hot replicas during the day's flash crowds;
+* the learner is a real sharded ``Trainer`` on the same tiny llama the
+  engines serve, with ONE restart-free elastic resize (world 8 -> 4)
+  mid-job through the tiered checkpoint manager;
+* weight publishes roll replica-by-replica between drains while user
+  traffic keeps flowing.
+
+Gates — the two sides of the co-scheduling contract plus the flywheel's
+own invariants: user-facing p99 TTFT within tolerance of the no-RL
+baseline; rollout throughput at or above the declared floor; >= 2
+publishes landing with ZERO dropped streams (user or rollout); the
+loss curve finite and the step counter monotonic across the elastic
+resize, with the restored params bit-identical after gather. The whole
+leg must also be bit-identical across two in-process runs (the sim is
+deterministic; any divergence is a bug, not noise).
+
+Usage::
+
+    python bench_rl.py [--seed 0] [--out BENCH_RL.json] [--no-check]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_GATES = (
+    # user traffic: the RLJob must not break the serving day
+    ("flywheel.ttft_p99_ratio", "<=", 1.3),
+    ("flywheel.with_rl.dropped_streams", "<=", 0),
+    ("flywheel.with_rl.errors", "<=", 0),
+    ("flywheel.baseline.dropped_streams", "<=", 0),
+    # the flywheel: complete, published, never torn, never dropped
+    ("flywheel.rl.job_complete", ">=", 1),
+    ("flywheel.rl.publishes", ">=", 2),
+    ("flywheel.rl.rollout_errors", "<=", 0),
+    ("flywheel.rl.rollout_dropped", "<=", 0),
+    # declared throughput floor (RLJobSpec.rollout_floor_tokens_per_s)
+    ("flywheel.rl.rollout_tokens_per_gen_s", ">=", 1.0),
+    # loss-curve continuity across the restart-free elastic resize
+    ("flywheel.rl.loss_finite", ">=", 1),
+    ("flywheel.rl.step_monotonic", ">=", 1),
+    ("flywheel.rl.elastic_resizes", ">=", 1),
+    ("flywheel.rl.resize_restore_bit_identical", ">=", 1),
+    ("determinism.identical", ">=", 1),
+)
+
+#: regression tolerances vs the committed artifact
+_REGRESSION = (
+    ("flywheel.ttft_p99_ratio", "lower_better", 0.15, 0.05),
+    ("flywheel.rl.rollout_tokens_per_gen_s", "higher_better",
+     0.25, 0.5),
+    ("flywheel.rl.publishes", "higher_better", 0.0, 0.01),
+)
+
+
+def flywheel_leg(seed: int) -> tuple:
+    from kubedl_tpu.replay.rl import RLJobSpec, run_flywheel_leg
+    spec = RLJobSpec()
+    t0 = time.perf_counter()
+    leg = run_flywheel_leg(seed, spec)
+    first_s = time.perf_counter() - t0
+    rl = leg["rl"]
+    print(f"seed {seed}: baseline + flywheel day replayed in "
+          f"{first_s:.1f}s wall (ttft p99 ratio "
+          f"{leg['ttft_p99_ratio']}, {rl['publishes']} publishes, "
+          f"{rl['rollout_tokens_per_gen_s']} rollout tok/gen-s, "
+          f"{rl['tenant_spills']} tenant spills)", file=sys.stderr)
+    # the determinism arm: the identical day again, in-process — the
+    # sim clock owns all time, so the WHOLE observation must match
+    # bit for bit
+    t0 = time.perf_counter()
+    again = run_flywheel_leg(seed, spec)
+    print(f"seed {seed}: determinism re-run in "
+          f"{time.perf_counter() - t0:.1f}s wall", file=sys.stderr)
+    identical = int(json.dumps(leg, sort_keys=True)
+                    == json.dumps(again, sort_keys=True))
+    return leg, {"runs": 2, "identical": identical}
+
+
+def _evaluate(scorecard: dict) -> dict:
+    from kubedl_tpu.replay.scorecard import _get
+    checks, ok = [], True
+    for path, op, thr in _GATES:
+        value = _get(scorecard, path)
+        passed = (value is not None
+                  and (value >= thr if op == ">=" else value <= thr))
+        ok = ok and passed
+        checks.append({"metric": path, "op": op, "threshold": thr,
+                       "value": value, "passed": passed})
+    return {"checks": checks, "passed": ok}
+
+
+def main() -> dict:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default="BENCH_RL.json")
+    ap.add_argument("--no-check", action="store_true",
+                    help="skip the regression check against the "
+                         "committed artifact")
+    args = ap.parse_args()
+
+    leg, determinism = flywheel_leg(args.seed)
+    scorecard = {"benchmark": "rl_flywheel", "flywheel": leg,
+                 "determinism": determinism}
+    scorecard["gates"] = _evaluate(scorecard)
+
+    problems = []
+    if not args.no_check and args.out and os.path.exists(args.out):
+        from kubedl_tpu.replay.scorecard import check_tolerances
+        try:
+            with open(args.out) as f:
+                committed = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"warning: cannot read committed {args.out}: {e}",
+                  file=sys.stderr)
+            committed = {}
+        problems = check_tolerances(scorecard, committed, _REGRESSION)
+
+    print(json.dumps(scorecard))
+    if not scorecard["gates"]["passed"]:
+        failed = [c for c in scorecard["gates"]["checks"]
+                  if not c["passed"]]
+        raise SystemExit(f"GATE FAILED: {failed}")
+    if problems:
+        raise SystemExit("REGRESSION vs committed artifact: "
+                         + "; ".join(problems))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(scorecard, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"wrote {args.out}", file=sys.stderr)
+    return scorecard
+
+
+if __name__ == "__main__":
+    main()

@@ -106,6 +106,18 @@ TRAINING = {
                 {"webHost": {"type": "string"},
                  "workerMemoryTuningPolicy": OPEN}),
     "ElasticDLJob": ("elasticdlReplicaSpecs", {}),
+    "RLJob": ("rlReplicaSpecs",
+              # the flywheel contract (docs/rl.md): rollout tenant
+              # attribution, the declared throughput floor, and the
+              # publish cadence; min/maxSlices ride runPolicy.
+              # schedulingPolicy.minSlices + tpuPolicy.numSlices
+              {"flywheel": {
+                  "type": "object",
+                  "properties": {
+                      "rolloutTenant": {"type": "string"},
+                      "rolloutFloorTokensPerSecond": {"type": "number"},
+                      "publishEvery": {"type": "integer"},
+                  }}}),
 }
 
 PLATFORM = {

@@ -68,6 +68,14 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "/api/v1/serving/fleet endpoint "
                         "(docs/serving_fleet.md; also ServingFleet "
                         "gate)")
+    p.add_argument("--enable-rl-flywheel", action="store_true",
+                   help="RL post-training flywheel: RLJob rollouts ride "
+                        "the serving fleet as a low-priority tenant, the "
+                        "GRPO learner trains on the sharded elastic "
+                        "Trainer, weight publishes roll between drains, "
+                        "console /api/v1/rl endpoints (docs/rl.md; also "
+                        "RLFlywheel gate; requires "
+                        "--enable-serving-fleet)")
     p.add_argument("--enable-federation", action="store_true",
                    help="multi-region federation: global queue routing "
                         "over per-region placement scores, cross-region "
@@ -203,6 +211,14 @@ def parse_args(argv=None) -> argparse.Namespace:
                 "region's WAL journal and its cross-region standby)")
     if args.region_topology and not args.enable_federation:
         p.error("--region-topology requires --enable-federation")
+    # rollouts ARE fleet traffic: the flywheel without the serving fleet
+    # would have no tenant queue, no router, no replicas to publish onto
+    # — fail at the parser (build_operator re-checks for library callers)
+    if args.enable_rl_flywheel and not args.enable_serving_fleet:
+        p.error("--enable-rl-flywheel requires --enable-serving-fleet "
+                "(rollout generation rides the fleet's router as a "
+                "low-priority tenant; there is no rollout substrate "
+                "without it)")
     return args
 
 
@@ -243,6 +259,7 @@ def config_from_args(args: argparse.Namespace) -> OperatorConfig:
         async_snapshots=args.async_snapshots,
         enable_elastic_slices=args.enable_elastic_slices,
         enable_serving_fleet=args.enable_serving_fleet,
+        enable_rl_flywheel=args.enable_rl_flywheel,
         enable_federation=args.enable_federation,
         region_topology=args.region_topology,
     )

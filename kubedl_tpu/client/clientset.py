@@ -37,6 +37,7 @@ KIND_TABLE = {
     "XDLJob": ResourceInfo("XDLJob", "training.kubedl.io/v1alpha1", "xdljobs"),
     "MarsJob": ResourceInfo("MarsJob", "training.kubedl.io/v1alpha1", "marsjobs"),
     "ElasticDLJob": ResourceInfo("ElasticDLJob", "training.kubedl.io/v1alpha1", "elasticdljobs"),
+    "RLJob": ResourceInfo("RLJob", "training.kubedl.io/v1alpha1", "rljobs"),
     # platform groups
     "Model": ResourceInfo("Model", "model.kubedl.io/v1alpha1", "models"),
     "ModelVersion": ResourceInfo("ModelVersion", "model.kubedl.io/v1alpha1", "modelversions"),

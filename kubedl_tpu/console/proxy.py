@@ -46,7 +46,7 @@ class DataProxy:
                  telemetry=None, journal=None, replication=None,
                  elastic: bool = False, serving_fleet=None,
                  serving_autoscaler=None, serving_router=None,
-                 federation=None):
+                 federation=None, rl=None):
         self.api = api
         self.object_backend = object_backend
         self.event_backend = event_backend
@@ -79,6 +79,10 @@ class DataProxy:
         #: /api/v1/federation endpoints answer 501 (gate-off path
         #: byte-identical: this process hosts no global layer)
         self.federation = federation
+        #: the hosted RLFlywheel driver (docs/rl.md); None = the
+        #: /api/v1/rl endpoints answer 501 (gate off, or this process
+        #: hosts no flywheel — same convention as serving_fleet)
+        self.rl = rl
 
     # -- jobs -------------------------------------------------------------
 
@@ -673,6 +677,19 @@ class DataProxy:
         doc = self.federation.topology.describe()
         doc["fingerprint"] = self.federation.topology.fingerprint()
         return doc
+
+    # -- RL flywheel (docs/rl.md) -----------------------------------------
+
+    @property
+    def rl_enabled(self) -> bool:
+        return self.rl is not None
+
+    def rl_job(self, namespace: str, name: str) -> Optional[dict]:
+        """One RLJob's live flywheel document: policy version vs the
+        serving fleet's visible versions, rollout throughput against the
+        declared floor, publish/staleness counters, queue spills. None
+        when the hosted flywheel drives a different job."""
+        return self.rl.job_status(namespace, name)
 
     def job_elastic(self, namespace: str, name: str) -> Optional[dict]:
         """The job's live elastic state (docs/elastic.md): the recorded

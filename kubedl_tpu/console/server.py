@@ -513,6 +513,26 @@ class ConsoleServer:
                                     "process hosts no replicas)"}, []
             return ok(self.proxy.serving_fleet_status())
 
+        # RL flywheel (docs/rl.md): one RLJob's policy version vs the
+        # fleet's visible versions, rollout throughput against the
+        # declared floor, publish/staleness counters; 501 when this
+        # process hosts no flywheel (--enable-rl-flywheel / RLFlywheel
+        # gate off), matching the serving-fleet endpoint's convention
+        mt = re.fullmatch(r"/api/v1/rl/([^/]+)/([^/]+)", path)
+        if mt:
+            if not self.proxy.rl_enabled:
+                return 501, {"code": 501,
+                             "msg": "rl flywheel disabled "
+                                    "(--enable-rl-flywheel / RLFlywheel "
+                                    "gate, with --enable-serving-fleet, "
+                                    "and this process hosts no "
+                                    "flywheel)"}, []
+            ns, name = mt.groups()
+            doc = self.proxy.rl_job(ns, name)
+            if doc is None:
+                raise NotFound(f"no flywheel for RLJob {ns}/{name}")
+            return ok(doc)
+
         # fleet goodput rollup (docs/telemetry.md): the live fleet-wide
         # number BENCH_CLUSTER gates on; 501 with the telemetry gate off
         if path == "/api/v1/telemetry/goodput":

@@ -171,6 +171,16 @@ class OperatorConfig:
     #: ("r1,r2,r3;r1~r2=latency_ms/egress_per_gb;..." — docs/federation
     #: .md "Region topology grammar"); "" = no topology parsed
     region_topology: str = ""
+    #: RL post-training flywheel (docs/rl.md). Also switchable via the
+    #: RLFlywheel gate; either turns it on. REQUIRES the serving fleet
+    #: (--enable-serving-fleet): rollouts ride the fleet's router as a
+    #: low-priority tenant — build_operator fails fast otherwise. Off
+    #: by default: no kubedl_rl_* family registers and the console
+    #: /api/v1/rl endpoints answer 501 (the byte-identical-disabled
+    #: convention). The flywheel driver itself lives in whichever
+    #: process hosts the fleet — the operator side carries the metric
+    #: families and the console surface a hosted flywheel plugs into.
+    enable_rl_flywheel: bool = False
 
 
 @dataclass
@@ -217,6 +227,11 @@ class Operator:
     #: the parsed RegionTopology when --region-topology is set (the
     #: console's /api/v1/federation/topology source); None otherwise
     region_topology: object = None
+    #: RL post-training flywheel on (docs/rl.md)
+    rl_enabled: bool = False
+    #: the RLMetrics bundle when the gate is on (a hosted flywheel
+    #: adopts it so the kubedl_rl_* families land in THIS exposition)
+    rl_metrics: object = None
 
     def run_until_idle(self, **kw):
         return self.manager.run_until_idle(**kw)
@@ -350,6 +365,24 @@ def build_operator(api: Optional[APIServer] = None,
             "(--enable-durability / DurableControlPlane gate): the "
             "region-evacuation zero-loss contract rests on each "
             "region's WAL journal and its cross-region standby")
+    # RL post-training flywheel (docs/rl.md): the kubedl_rl_* families
+    # register only here, so the disabled exposition stays
+    # byte-identical. The gate is meaningless without the serving fleet
+    # underneath — rollouts ARE fleet traffic, arbitrated by the
+    # router's tenant fairness — so fail fast rather than silently
+    # degrade (same posture as federation-without-durability).
+    rl_enabled = (config.enable_rl_flywheel
+                  or gates.enabled(ft.RL_FLYWHEEL))
+    if rl_enabled and not serving_fleet_enabled:
+        raise ValueError(
+            "enable_rl_flywheel requires the serving fleet "
+            "(--enable-serving-fleet / ServingFleet gate): rollout "
+            "generation rides the fleet's router as a low-priority "
+            "tenant; there is no rollout substrate without it")
+    rl_metrics = None
+    if rl_enabled:
+        from ..metrics.registry import RLMetrics
+        rl_metrics = RLMetrics(registry)
     federation_metrics = None
     region_topology = None
     if federation_enabled:
@@ -499,7 +532,8 @@ def build_operator(api: Optional[APIServer] = None,
                     serving_fleet_metrics=serving_fleet_metrics,
                     federation_enabled=federation_enabled,
                     federation_metrics=federation_metrics,
-                    region_topology=region_topology)
+                    region_topology=region_topology,
+                    rl_enabled=rl_enabled, rl_metrics=rl_metrics)
 
 
 def _storage_backend(spec: str, for_events: bool = False):

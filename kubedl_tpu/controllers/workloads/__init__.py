@@ -5,6 +5,7 @@ from .jaxjob import JAXJobController  # noqa: F401
 from .mars import MarsJobController  # noqa: F401
 from .mpi import MPIJobController  # noqa: F401
 from .pytorch import PyTorchJobController  # noqa: F401
+from .rljob import RLJobController  # noqa: F401
 from .tensorflow import TFJobController  # noqa: F401
 from .xdl import XDLJobController  # noqa: F401
 from .xgboost import XGBoostJobController  # noqa: F401
@@ -12,5 +13,5 @@ from .xgboost import XGBoostJobController  # noqa: F401
 ALL_CONTROLLERS = (
     PyTorchJobController, TFJobController, JAXJobController, MPIJobController,
     XGBoostJobController, XDLJobController, MarsJobController,
-    ElasticDLJobController,
+    ElasticDLJobController, RLJobController,
 )

@@ -78,6 +78,18 @@ SERVING_FLEET = "ServingFleet"
 #: stays byte-identical. Requires the durable control plane (regions
 #: replicate through the WAL shipping stream).
 FEDERATION = "Federation"
+#: RL post-training flywheel (docs/rl.md): RLJob rides the serving
+#: fleet as a dedicated low-priority rollout tenant — the RolloutClient
+#: submits prompt groups through the prefix-aware router (flash crowds
+#: squeeze rollouts via the fairness spill, idle decode capacity feeds
+#: them), the FlywheelLearner drives the GRPO loss on the sharded
+#: elastic-width Trainer, and the WeightPublisher rolls new policy
+#: versions across replicas between drains; off by default — no
+#: kubedl_rl_* family registers, the console /api/v1/rl endpoints
+#: answer 501, and every committed serving/cluster scorecard stays
+#: byte-identical. Requires the serving fleet (rollouts ARE fleet
+#: traffic; there is no tenant queue to ride without it).
+RL_FLYWHEEL = "RLFlywheel"
 
 _DEFAULTS = {
     GANG_SCHEDULING: True,           # Beta
@@ -95,6 +107,7 @@ _DEFAULTS = {
     TPU_ELASTIC_SLICES: False,       # Alpha
     SERVING_FLEET: False,            # Alpha
     FEDERATION: False,               # Alpha
+    RL_FLYWHEEL: False,              # Alpha
 }
 
 ENV_FEATURE_GATES = "KUBEDL_FEATURE_GATES"

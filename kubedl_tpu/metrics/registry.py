@@ -663,6 +663,41 @@ class FederationMetrics:
             "Regions currently evacuated")
 
 
+class RLMetrics:
+    """RL post-training flywheel families (docs/rl.md): rollout-tenant
+    throughput against its declared floor, rollout batches consumed by
+    the learner, the off-policy staleness gap, weight publishes rolled
+    across the fleet, and floor violations. Constructed only when the
+    RLFlywheel gate is on — the disabled operator's exposition carries
+    no ``kubedl_rl_*`` family at all (the byte-identical-disabled
+    convention)."""
+
+    def __init__(self, registry: Optional[Registry] = None):
+        self.registry = registry or Registry()
+        r = self.registry
+        self.rollout_tokens_per_s = r.gauge(
+            "kubedl_rl_rollout_tokens_per_s",
+            "Rollout generation throughput per RLJob (decode tokens "
+            "completed through the fleet, windowed)", ("job",))
+        self.batches_consumed = r.counter(
+            "kubedl_rl_batches_consumed_total",
+            "Versioned rollout batches the learner has stepped on",
+            ("job",))
+        self.staleness = r.gauge(
+            "kubedl_rl_staleness",
+            "Off-policy gap per RLJob: learner policy version minus the "
+            "version that generated the batch being consumed", ("job",))
+        self.publishes = r.counter(
+            "kubedl_rl_publishes_total",
+            "Policy weight versions rolled across the serving fleet "
+            "(publish-between-drains; never a torn version)", ("job",))
+        self.floor_violations = r.counter(
+            "kubedl_rl_floor_violations_total",
+            "Observation windows where rollout throughput fell below "
+            "the RLJob's declared floor (flash crowds squeezing the "
+            "rollout tenant)", ("job",))
+
+
 class TraceMetrics:
     """Span-recorder health (docs/tracing.md): recorded-span throughput
     per component, ring-buffer occupancy, and the overflow-drop counter

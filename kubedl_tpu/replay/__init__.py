@@ -27,3 +27,5 @@ from .fleet import (FLEET_PROFILES, FleetProfile,  # noqa: F401
                     FleetWorkload, ServingFleetReplay, generate_fleet,
                     run_autoscaler_leg, run_disagg_comparison,
                     run_fleet_comparison, run_routing_comparison)
+from .rl import (FlywheelReplay, RLJobSpec,  # noqa: F401
+                 run_flywheel_leg)

@@ -291,10 +291,19 @@ class ServingFleetReplay:
 
     # -- span drain -------------------------------------------------------
 
+    def _filter_spans(self, spans: list) -> list:
+        """Subclass seam: spans to fold into the USER-facing
+        accumulators (ttft/queue/completed/SLO). The RL replay diverts
+        rollout-tenant request spans here — rollout TTFT is a different
+        population with its own floor, and mixing it in would corrupt
+        the user SLO the flywheel is required not to violate."""
+        return spans
+
     def _drain(self) -> None:
         spans = self.tracer.spans()
         if spans:
             self.tracer.clear()
+            spans = self._filter_spans(spans)
             for signal, value, t in self._harvester.feed(spans):
                 if signal == "ttft":
                     self.ttfts.append(value)

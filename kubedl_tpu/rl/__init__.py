@@ -1,0 +1,35 @@
+"""RL post-training flywheel (docs/rl.md).
+
+RL post-training is the workload that couples both halves of this
+system: rollout generation IS serving (continuous batching, prefix
+caching over the shared system prompt) and learning IS training (the
+sharded ``Trainer`` step, tiered checkpoints, elastic width). The
+flywheel closes the loop:
+
+* :class:`~kubedl_tpu.rl.rollout.RolloutClient` — prompt groups ride
+  the serving fleet's router as a dedicated LOW-PRIORITY tenant (the
+  Queue API's tenant attribution + the router's fairness spill: flash
+  crowds squeeze rollouts, idle decode capacity feeds them), pinned to
+  ONE policy version per batch;
+* :class:`~kubedl_tpu.rl.learner.FlywheelLearner` — GRPO updates on the
+  sharded elastic-width ``Trainer``, staleness-tracked (the off-policy
+  gap between the learner's version and the version that generated each
+  batch), checkpointed through the tiered object store;
+* :class:`~kubedl_tpu.rl.publisher.WeightPublisher` — new policy
+  versions roll across fleet replicas BETWEEN drains, one replica at a
+  time, never dropping a stream and never serving a torn version;
+* :class:`~kubedl_tpu.rl.flywheel.RLFlywheel` — one RLJob's loop,
+  composed; the console's ``/api/v1/rl/{ns}/{job}`` source.
+
+Everything here is gated behind ``--enable-rl-flywheel`` / the
+``RLFlywheel`` feature gate (requires the serving fleet); the disabled
+operator carries no ``kubedl_rl_*`` family and answers 501.
+"""
+
+from .flywheel import RLFlywheel
+from .learner import FlywheelLearner
+from .publisher import WeightPublisher
+from .rollout import ROLLOUT_TENANT, RolloutBatch, RolloutClient
+
+__all__ = ["ROLLOUT_TENANT", "RolloutBatch", "RolloutClient",
+           "FlywheelLearner", "WeightPublisher", "RLFlywheel"]

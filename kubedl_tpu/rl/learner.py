@@ -1,0 +1,133 @@
+"""FlywheelLearner: GRPO updates on the sharded, elastic-width Trainer.
+
+The training half of the flywheel (docs/rl.md). The learner is a plain
+``train.Trainer`` client — the same sharded, jitted, donated step as
+pre-training — wired for the flywheel's three contracts:
+
+* **versioned consumption**: every rollout batch carries the policy
+  version that generated it; the learner records the off-policy gap
+  (``its own version - batch version``) as STALENESS. GRPO's clipped
+  ratio tolerates a small gap (that is what the clip is for); the gauge
+  makes the gap visible instead of silently growing;
+* **frozen reference**: the starting policy's params are kept on host
+  and score ``ref_logps`` for the KL term — the reference never moves,
+  so late-run policies are still anchored to the same distribution;
+* **elastic width**: :meth:`remesh` is the restart-free resize from
+  docs/elastic.md — forced save through the tiered checkpoint manager,
+  ``Trainer.remesh``, restore onto the NEW mesh's shardings. The step
+  counter and the loss curve continue where they left off.
+
+Weights publish through the ``TieredCheckpointManager`` OBJECT tier
+(:meth:`publish`): the atomic tmp+rename upload is exactly the
+never-serve-a-torn-checkpoint guarantee the WeightPublisher's
+never-serve-a-torn-version rule composes with.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import numpy as np
+
+from ..train.data import shard_batch
+from ..train.grpo import GRPOConfig, make_grpo_loss_fn, token_logps
+
+
+class FlywheelLearner:
+    """Consume versioned rollout batches; produce policy versions."""
+
+    def __init__(self, model_config, trainer, params,
+                 grpo: Optional[GRPOConfig] = None, checkpoint=None,
+                 metrics=None, job: str = ""):
+        self.model_config = model_config
+        self.trainer = trainer
+        self.grpo = grpo or GRPOConfig()
+        #: TieredCheckpointManager (or None: publish()/remesh() that
+        #: need it will refuse) — the object tier is the publish path
+        self.checkpoint = checkpoint
+        self.metrics = metrics
+        self.job = job
+        if trainer.loss_fn is None:
+            trainer.loss_fn = make_grpo_loss_fn(
+                model_config, self.grpo, mesh=trainer.mesh)
+        #: frozen reference = the starting policy, host-side (numpy):
+        #: survives remesh untouched, re-placed per scoring call
+        self.ref_params = jax.tree.map(
+            lambda x: np.asarray(jax.device_get(x)), params)
+        self.state = trainer.init_state(params)
+        #: the learner's CURRENT policy version; bumped by publish()
+        self.version = 0
+        self.batches_consumed = 0
+        self.staleness_last = 0
+        self.staleness_max = 0
+        self.resizes = 0
+        self.losses: list = []
+
+    # -- consumption ------------------------------------------------------
+
+    def step(self, rollout) -> float:
+        """One GRPO update on a :class:`~kubedl_tpu.rl.rollout
+        .RolloutBatch`; returns the loss. Scores the frozen reference
+        here (ref logps are data — never differentiated), shards the
+        batch over the trainer's current mesh."""
+        b = dict(rollout.batch)
+        ref = token_logps(self.model_config, self.ref_params,
+                          b["tokens"], b["targets"],
+                          mesh=self.trainer.mesh)
+        b["ref_logps"] = np.asarray(ref, np.float32)
+        b.pop("rewards", None)        # reward stats, not a loss input
+        batch = shard_batch(b, self.trainer.mesh)
+        self.state, loss = self.trainer.step(self.state, batch)
+        loss = float(loss)
+        self.losses.append(loss)
+        self.batches_consumed += 1
+        self.staleness_last = self.version - rollout.version
+        self.staleness_max = max(self.staleness_max, self.staleness_last)
+        if self.metrics is not None:
+            self.metrics.batches_consumed.inc(job=self.job)
+            self.metrics.staleness.set(self.staleness_last, job=self.job)
+        return loss
+
+    # -- publication ------------------------------------------------------
+
+    def publish(self):
+        """Cut a new policy version: bump the counter, push the state
+        through the checkpoint manager (the object tier's atomic
+        tmp+rename upload — a fresh host restores exactly this), and
+        return the new version's params as a host pytree for the
+        WeightPublisher to install."""
+        self.version += 1
+        if self.checkpoint is not None:
+            self.checkpoint.save(
+                self.state, force=True,
+                step=int(jax.device_get(self.state.step)))
+            self.checkpoint.wait_until_finished()
+            tiers = getattr(self.checkpoint, "tiers", None)
+            if tiers is not None:
+                tiers.flush()
+        return jax.tree.map(lambda x: np.asarray(jax.device_get(x)),
+                            self.state.params)
+
+    # -- elastic width ----------------------------------------------------
+
+    def remesh(self, mesh) -> None:
+        """Adopt a new device mesh without restarting (docs/elastic.md):
+        forced save at the current step, rebuild the jitted step against
+        the new topology, restore onto the NEW mesh's shardings (orbax
+        reshards; nothing re-initializes)."""
+        if self.checkpoint is None:
+            raise ValueError(
+                "remesh needs a checkpoint manager: the restart-free "
+                "resize IS a save/restore through the tiers")
+        self.checkpoint.save(self.state, force=True,
+                             step=int(jax.device_get(self.state.step)))
+        self.checkpoint.wait_until_finished()
+        old = self.state
+        self.trainer.remesh(mesh)
+        self.state = self.checkpoint.restore(
+            self.trainer.abstract_state(old))
+        self.resizes += 1
+
+
+__all__ = ["FlywheelLearner"]

@@ -889,6 +889,25 @@ class ContinuousBatchingEngine:
                 raise ValueError(f"top_p out of range (0, 1]: {top_p}")
         return {"temperature": temperature, "top_k": top_k, "top_p": top_p}
 
+    def reseed(self, seed: int) -> None:
+        """Rebase the sampling stream on ``seed`` — the determinism seam
+        for batch drivers (inline :meth:`run`, the RL rollout tenant):
+        identical submissions after an identical ``reseed`` sample
+        identical token streams. Refused while the background loop runs
+        (other clients share the stream)."""
+        if self._thread is not None:
+            raise ValueError(
+                "cannot reseed a running engine (other clients share "
+                "the sampling stream)")
+        with self._sched_lock:
+            self._key = jax.random.PRNGKey(seed)
+            if self.spec_k:
+                # the speculative accept rule draws from per-request
+                # host rngs (seed + admission ordinal): rebase both
+                # or a reseeded sampled run would not reproduce
+                self._seed = seed
+                self._spec_admitted = 0
+
     def run(self, requests: Sequence[tuple], seed: Optional[int] = None) -> list:
         """requests: [(prompt_token_list, max_new_tokens), ...] in arrival
         order. Returns one generated-id list per request. Inline when no
@@ -898,18 +917,7 @@ class ContinuousBatchingEngine:
         for prompt, max_new in requests:
             self.validate(prompt, max_new)
         if seed is not None:
-            if self._thread is not None:
-                raise ValueError(
-                    "cannot reseed a running engine (other clients share "
-                    "the sampling stream)")
-            with self._sched_lock:
-                self._key = jax.random.PRNGKey(seed)
-                if self.spec_k:
-                    # the speculative accept rule draws from per-request
-                    # host rngs (seed + admission ordinal): rebase both
-                    # or a reseeded sampled run would not reproduce
-                    self._seed = seed
-                    self._spec_admitted = 0
+            self.reseed(seed)
         reqs = [self.submit(p, n) for p, n in requests]
         if self._thread is None:
             with self._sched_lock:

@@ -29,17 +29,28 @@ from typing import Callable, Optional
 class ServingReplica:
     """One engine + its fleet bookkeeping."""
 
-    __slots__ = ("name", "engine", "draining")
+    __slots__ = ("name", "engine", "draining", "policy_version",
+                 "weight_swap")
 
-    def __init__(self, name: str, engine):
+    def __init__(self, name: str, engine, policy_version: int = 0):
         self.name = name
         self.engine = engine
         self.draining = False
+        #: the ONE policy version this replica serves (docs/rl.md): a
+        #: weight publish flips it atomically only after the new params
+        #: are fully installed — a replica never advertises a version
+        #: it cannot serve, and the router can pin placements to one
+        self.policy_version = policy_version
+        #: True while a publisher holds this replica mid-swap; guards
+        #: :meth:`ServingFleet.cancel_drain` from un-draining a replica
+        #: whose weights are torn (satellite: drain/publish composition)
+        self.weight_swap = False
 
     def health(self) -> dict:
         h = self.engine.health()
         h["replica"] = self.name
         h["draining"] = self.draining
+        h["policy_version"] = self.policy_version
         return h
 
     def idle(self) -> bool:
@@ -107,10 +118,13 @@ class ServingFleet:
         before its streams finished): its engine never stopped, so
         marking it active restores capacity instantly — strictly better
         than paying a fresh replica's spin-up while one is standing
-        right there. Returns the replica, or None when nothing is
-        draining."""
-        rep = next((r for r in reversed(self.replicas) if r.draining),
-                   None)
+        right there. A replica mid-weight-swap is SKIPPED: the
+        publisher drained it to install new params, and handing it back
+        to the router before the swap commits would serve a torn
+        version (docs/rl.md "publish between drains"). Returns the
+        replica, or None when nothing is (safely) un-drainable."""
+        rep = next((r for r in reversed(self.replicas)
+                    if r.draining and not r.weight_swap), None)
         if rep is None:
             return None
         rep.draining = False
@@ -118,9 +132,13 @@ class ServingFleet:
 
     def reap(self) -> list:
         """Remove every draining replica that has gone idle (its engine
-        stopped — nothing in flight, so no stream is cancelled).
+        stopped — nothing in flight, so no stream is cancelled). A
+        replica mid-weight-swap is exempt: drained-and-idle is exactly
+        the publish window, and the publisher hands it back (or the
+        autoscaler reaps it on a later pass if it stays draining).
         Returns the reaped names."""
-        done = [r for r in self.replicas if r.draining and r.idle()]
+        done = [r for r in self.replicas
+                if r.draining and not r.weight_swap and r.idle()]
         for rep in done:
             rep.engine.stop()
             self.replicas.remove(rep)

@@ -60,12 +60,29 @@ class RandomRouter:
     # -- placement --------------------------------------------------------
 
     def select(self, prompt: Sequence[int], tenant: Optional[str] = None,
-               prefix: Optional[Sequence[int]] = None):
+               prefix: Optional[Sequence[int]] = None,
+               version: Optional[int] = None):
+        reps = self._candidates(version)
+        return reps[self.rng.randrange(len(reps))]
+
+    def _candidates(self, version: Optional[int]) -> list:
+        """Active replicas, optionally pinned to one policy version —
+        the rollout path's guarantee that every completion in a batch
+        came from the SAME weights (docs/rl.md: a mixed-version batch
+        has no well-defined behavior policy)."""
         reps = self.fleet.active()
+        if version is not None:
+            reps = [r for r in reps
+                    if getattr(r, "policy_version", 0) == version]
+            if not reps:
+                raise RuntimeError(
+                    f"no active replica serving policy version "
+                    f"{version} (mid-publish, or the version was "
+                    "already rolled past)")
         if not reps:
             raise RuntimeError("no active serving replica (fleet empty "
                                "or fully draining)")
-        return reps[self.rng.randrange(len(reps))]
+        return reps
 
     def _ensure_prefix(self, rep, prefix) -> None:
         if not rep.engine.has_prefix(prefix):
@@ -86,12 +103,15 @@ class RandomRouter:
 
     def submit(self, prompt: Sequence[int], max_new: int,
                tenant: Optional[str] = None,
-               prefix: Optional[Sequence[int]] = None, **kw):
+               prefix: Optional[Sequence[int]] = None,
+               version: Optional[int] = None, **kw):
         """Place + submit one request; returns ``(Request, replica)``.
         ``prefix`` is the client-declared shared prefix (system prompt)
         — the placement signal and the router-driven registration
-        unit."""
-        rep = self.select(prompt, tenant=tenant, prefix=prefix)
+        unit. ``version`` pins placement to replicas advertising that
+        policy version (the rollout tenant's same-weights guarantee)."""
+        rep = self.select(prompt, tenant=tenant, prefix=prefix,
+                          version=version)
         self._account(rep, prefix)
         if prefix is not None:
             self._ensure_prefix(rep, prefix)
@@ -187,11 +207,9 @@ class PrefixAwareRouter(RandomRouter):
     # -- placement --------------------------------------------------------
 
     def select(self, prompt: Sequence[int], tenant: Optional[str] = None,
-               prefix: Optional[Sequence[int]] = None):
-        reps = self.fleet.active()
-        if not reps:
-            raise RuntimeError("no active serving replica (fleet empty "
-                               "or fully draining)")
+               prefix: Optional[Sequence[int]] = None,
+               version: Optional[int] = None):
+        reps = self._candidates(version)
         probe = prefix if prefix is not None else prompt
         scored = [(rep.engine.prefix_residency(probe),
                    -rep.engine.queue_depth, -i, rep)

@@ -47,6 +47,14 @@ _PHASE_CATEGORY = {
 OVERHEAD_CATEGORIES = ("queue", "scheduling", "podStart", "rendezvous",
                        "restart", "checkpoint", "reconfiguration", "other")
 
+#: LAZY category (docs/rl.md): rollout-generation windows (``rl.rollout``
+#: spans, component ``rl``) are carved from productive time exactly like
+#: checkpoint/reconfiguration — the learner is waiting on the serving
+#: fleet, not training — but the key appears in a breakdown ONLY when
+#: such spans exist. Non-RL jobs (and every committed pre-RL scorecard)
+#: keep their exact ``overheadSeconds`` shape.
+ROLLOUT_CATEGORY = "rollout"
+
 
 def goodput_breakdown(breakdown: dict, ndigits: int = 6) -> Optional[dict]:
     """Fold one job's ``trace_breakdown`` dict into the goodput
@@ -83,6 +91,14 @@ def goodput_breakdown(breakdown: dict, ndigits: int = 6) -> Optional[dict]:
     reconf = min(reconf, productive)
     productive -= reconf
     overhead["reconfiguration"] = reconf
+    rollout = sum(e.get("duration", 0.0)
+                  for e in breakdown.get("events") or []
+                  if e.get("component") == "rl"
+                  and e.get("name") == "rl.rollout")
+    if rollout:
+        rollout = min(rollout, productive)
+        productive -= rollout
+        overhead[ROLLOUT_CATEGORY] = rollout
     wall = productive + sum(overhead.values())
     return {
         "wallSeconds": round(wall, ndigits),
@@ -120,7 +136,8 @@ class GoodputAccountant:
         self.jobs += 1
         self.productive_s += gp["productiveSeconds"]
         for k, v in gp["overheadSeconds"].items():
-            self.overhead_s[k] += v
+            # .get: the lazy rollout category appears only on RL jobs
+            self.overhead_s[k] = self.overhead_s.get(k, 0.0) + v
         if self.metrics is not None:
             mt = self.metrics
             mt.jobs_observed.inc()

@@ -135,36 +135,80 @@ def make_grpo_loss_fn(config, grpo: GRPOConfig = GRPOConfig(),
     return loss_fn
 
 
+def _generate_submit(engine, groups, max_new_tokens: int, seed: int):
+    """Rollouts through the paged/continuous path: any object with the
+    fleet submit surface (``submit(prompt, max_new, logprobs=,
+    temperature=, top_k=, top_p=)`` — a ``ContinuousBatchingEngine``, a
+    fleet router, or the RL ``RolloutClient``). Per-request overrides
+    force plain temperature-1 sampling so the engine's full-softmax
+    logprobs ARE the behavior policy, whatever its own GenerateConfig
+    says — the bare-``generate`` path has to refuse a greedy engine;
+    this one just overrides it. ``reseed`` (when exposed) pins the
+    sampling stream so a fixed (seed, policy version) reproduces the
+    exact token streams."""
+    reseed = getattr(engine, "reseed", None)
+    if reseed is not None:
+        reseed(seed)
+    reqs = [engine.submit(list(p), max_new_tokens, logprobs=True,
+                          temperature=1.0, top_k=0, top_p=1.0)
+            for p in groups]
+    step = getattr(engine, "step", None)
+    if step is not None:
+        while step():
+            pass
+    return [(r.result(), list(r.logprobs)) for r in reqs]
+
+
 def rollout_batch(engine, prompts, reward_fn, max_new_tokens: int,
                   cfg: GRPOConfig = GRPOConfig(), seed: int = 0,
                   pad_id: int = 0):
     """Sample a group of completions per prompt and assemble the GRPO
     update batch.
 
-    ``engine`` is a ``serving.engine.InferenceEngine`` holding the
-    CURRENT policy weights; its sampled-token logprobs become
-    ``old_logps``. ``reward_fn(prompt_ids, completion_ids) -> float`` is
-    the verifiable reward. Returns the batch dict (numpy, 128-aligned)
-    WITHOUT ``ref_logps`` — score it with ``token_logps`` under the
-    frozen reference, then pass to the trainer."""
-    gen = getattr(engine, "gen", None)
-    if gen is not None:
-        # the engine reports FULL-softmax logprobs (token_logprobs is
-        # deliberately sampling-agnostic); they equal the behavior
-        # policy only under plain temperature-1 sampling. Greedy would
-        # additionally make every group identical -> all advantages 0.
-        if gen.temperature != 1.0 or gen.top_k or gen.top_p != 1.0:
-            raise ValueError(
-                "GRPO rollouts need plain sampling (temperature=1, no "
-                f"top_k/top_p) so reported logprobs ARE the behavior "
-                f"policy; engine has temperature={gen.temperature}, "
-                f"top_k={gen.top_k}, top_p={gen.top_p}")
+    ``engine`` holds the CURRENT policy weights; its sampled-token
+    logprobs become ``old_logps``. Two generation surfaces are accepted:
+    the fleet submit surface (``submit``/``step`` — the paged,
+    continuous-batching path; preferred) and the legacy bare
+    ``InferenceEngine.generate`` handle. ``reward_fn(prompt_ids,
+    completion_ids) -> float`` is the verifiable reward. Returns the
+    batch dict (numpy, 128-aligned) WITHOUT ``ref_logps`` — score it
+    with ``token_logps`` under the frozen reference, then pass to the
+    trainer."""
     groups = [list(p) for p in prompts for _ in range(cfg.group_size)]
-    outs = engine.generate(groups, max_new_tokens, seed=seed,
-                           return_logprobs=True)
+    if hasattr(engine, "submit"):
+        outs = _generate_submit(engine, groups, max_new_tokens, seed)
+    else:
+        gen = getattr(engine, "gen", None)
+        if gen is not None:
+            # the engine reports FULL-softmax logprobs (token_logprobs
+            # is deliberately sampling-agnostic); they equal the
+            # behavior policy only under plain temperature-1 sampling.
+            # Greedy would additionally make every group identical ->
+            # all advantages 0.
+            if gen.temperature != 1.0 or gen.top_k or gen.top_p != 1.0:
+                raise ValueError(
+                    "GRPO rollouts need plain sampling (temperature=1, "
+                    f"no top_k/top_p) so reported logprobs ARE the "
+                    f"behavior policy; engine has temperature="
+                    f"{gen.temperature}, top_k={gen.top_k}, "
+                    f"top_p={gen.top_p}")
+        outs = engine.generate(groups, max_new_tokens, seed=seed,
+                               return_logprobs=True)
+    return assemble_batch(groups, outs, len(prompts), reward_fn,
+                          cfg=cfg, pad_id=pad_id)
+
+
+def assemble_batch(groups, outs, n_prompts: int, reward_fn,
+                   cfg: GRPOConfig = GRPOConfig(), pad_id: int = 0):
+    """Completed rollouts -> the GRPO update batch (the assembly half of
+    :func:`rollout_batch`, shared with the RL flywheel's
+    ``RolloutClient``, which gathers ``outs`` through the fleet router
+    instead of one engine). ``groups`` is the flat prompt list
+    (``n_prompts * cfg.group_size`` rows, group-major); ``outs`` is one
+    ``(generated_ids, logprobs)`` pair per row."""
     rewards = np.asarray(
         [reward_fn(groups[i], ids) for i, (ids, _) in enumerate(outs)],
-        np.float32).reshape(len(prompts), cfg.group_size)
+        np.float32).reshape(n_prompts, cfg.group_size)
     adv = np.asarray(group_advantages(rewards, cfg))
 
     rows = [p + list(ids) for p, (ids, _) in zip(groups, outs)]

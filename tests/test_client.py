@@ -15,7 +15,7 @@ def tfjob(name="tf1", ns="default"):
 
 
 def test_kind_table_covers_operator_surface():
-    assert len(TRAINING_KINDS) == 8
+    assert len(TRAINING_KINDS) == 9
     assert plural_to_kind("pytorchjobs") == "PyTorchJob"
     assert KIND_TABLE["Cron"].api_version == "apps.kubedl.io/v1alpha1"
 

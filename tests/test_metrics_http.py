@@ -331,6 +331,36 @@ def test_replication_families_in_exposition(served):
             ' 1.0') in body
 
 
+def test_rl_families_in_exposition(served):
+    """Pin the RL-flywheel families (docs/rl.md): rollout-tenant
+    throughput vs its declared floor, rollout batches consumed, the
+    off-policy staleness gap, weight publishes, floor violations — all
+    labeled by RLJob. These register only when the RLFlywheel gate is
+    on — their absence from a gate-off operator's exposition is pinned
+    in tests/test_rl.py."""
+    from kubedl_tpu.metrics.registry import RLMetrics
+    reg, port = served
+    rm = RLMetrics(reg)
+    rm.rollout_tokens_per_s.set(123.5, job="grpo-tune")
+    rm.batches_consumed.inc(8, job="grpo-tune")
+    rm.staleness.set(1, job="grpo-tune")
+    rm.publishes.inc(2, job="grpo-tune")
+    rm.floor_violations.inc(job="grpo-tune")
+    _, body, _ = scrape(port)
+    assert "# TYPE kubedl_rl_rollout_tokens_per_s gauge" in body
+    assert ('kubedl_rl_rollout_tokens_per_s{job="grpo-tune"} 123.5'
+            in body)
+    assert "# TYPE kubedl_rl_batches_consumed_total counter" in body
+    assert 'kubedl_rl_batches_consumed_total{job="grpo-tune"} 8.0' in body
+    assert "# TYPE kubedl_rl_staleness gauge" in body
+    assert 'kubedl_rl_staleness{job="grpo-tune"} 1.0' in body
+    assert "# TYPE kubedl_rl_publishes_total counter" in body
+    assert 'kubedl_rl_publishes_total{job="grpo-tune"} 2.0' in body
+    assert "# TYPE kubedl_rl_floor_violations_total counter" in body
+    assert ('kubedl_rl_floor_violations_total{job="grpo-tune"} 1.0'
+            in body)
+
+
 def test_label_value_escaping(served):
     reg, port = served
     g = reg.gauge("kubedl_esc", "escapes", ("name",))

@@ -1,0 +1,644 @@
+"""RL post-training flywheel (docs/rl.md): seeded rollout determinism
+through the fleet submit surface, drain/publish composition (never a
+torn version, never a dropped stream), the RolloutClient / learner /
+publisher / RLFlywheel loop, the RLJob controller's flywheel contract,
+the lazy ``rollout`` goodput category, and the gate-off contract."""
+
+import dataclasses
+
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from kubedl_tpu.models import llama  # noqa: E402
+from kubedl_tpu.rl import (RolloutBatch, RolloutClient,  # noqa: E402
+                           RLFlywheel, WeightPublisher)
+from kubedl_tpu.serving.batching import ContinuousBatchingEngine  # noqa: E402
+from kubedl_tpu.serving.fleet import ServingFleet  # noqa: E402
+from kubedl_tpu.serving.router import (PrefixAwareRouter,  # noqa: E402
+                                       RandomRouter)
+from kubedl_tpu.train import dpo, grpo  # noqa: E402
+
+pytestmark = pytest.mark.rl
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = dataclasses.replace(
+        llama.tiny(vocab=128), d_model=64, n_heads=2, n_kv_heads=2,
+        d_ff=128, dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def make_engine(model, lanes=3, prefill_lanes=0, pool_blocks=24,
+                max_len=64, kv_block=8, **kw):
+    cfg, params = model
+    return ContinuousBatchingEngine(
+        cfg, params, lanes=lanes, max_len=max_len, kv_mode="paged",
+        kv_block=kv_block, pool_blocks=pool_blocks,
+        prefill_lanes=prefill_lanes, **kw)
+
+
+def fleet_of(model, n=2, lanes=3, pool_blocks=24):
+    def factory(idx):
+        return make_engine(model, lanes=lanes,
+                           pool_blocks=pool_blocks, seed=idx)
+    return ServingFleet(factory, replicas=n)
+
+
+# ----------------------------------------------------------------------
+# satellite: seeded rollout determinism through the submit surface
+# ----------------------------------------------------------------------
+
+def _reward(prompt, ids):
+    return sum(1 for t in ids if t % 2 == 0) / max(len(ids), 1)
+
+
+def _batch_equal(a, b):
+    assert set(a) == set(b)
+    for k in a:
+        np.testing.assert_array_equal(a[k], b[k], err_msg=k)
+
+
+def test_rollout_batch_deterministic_for_fixed_seed(model):
+    """rollout_batch through the paged/continuous submit surface:
+    ``reseed`` pins the sampling stream, so a fixed (seed, weights)
+    reproduces the exact token streams — on the same engine called
+    twice AND on a freshly built engine."""
+    gcfg = grpo.GRPOConfig(group_size=2)
+    prompts = [[1, 2, 3], [4, 5]]
+    eng = make_engine(model, seed=0)
+    b1 = grpo.rollout_batch(eng, prompts, _reward, 4, cfg=gcfg, seed=11)
+    b2 = grpo.rollout_batch(eng, prompts, _reward, 4, cfg=gcfg, seed=11)
+    _batch_equal(b1, b2)
+    fresh = make_engine(model, seed=0)
+    b3 = grpo.rollout_batch(fresh, prompts, _reward, 4, cfg=gcfg,
+                            seed=11)
+    _batch_equal(b1, b3)
+    # sampled, not greedy: temperature-1 groups differ within a prompt
+    n = len(prompts) * gcfg.group_size
+    assert b1["tokens"].shape[0] == n
+    assert b1["old_logps"][b1["mask"] == 1].size > 0
+
+
+def test_rollout_client_deterministic_for_fixed_seed_and_version(model):
+    """The fleet-level guarantee the learner's staleness contract sits
+    on: identical (engine seeds, router seed, policy version) harvest
+    bit-identical rollout batches."""
+    def run():
+        fleet = fleet_of(model, n=2)
+        router = PrefixAwareRouter(fleet, seed=3)
+        client = RolloutClient(router, _reward,
+                               cfg=grpo.GRPOConfig(group_size=2),
+                               system_prompt=[9] * 8, max_new_tokens=3)
+        client.pin_prefix()
+        client.submit_prompts([[1, 2], [3, 4, 5]], version=0)
+        while fleet.step():
+            pass
+        rb = client.try_harvest()
+        fleet.stop()
+        return rb
+
+    a, b = run(), run()
+    assert a is not None and b is not None
+    assert a.version == b.version == 0
+    assert a.tokens == b.tokens
+    _batch_equal(a.batch, b.batch)
+
+
+# ----------------------------------------------------------------------
+# satellite: drain semantics compose with the publisher's weight swap
+# ----------------------------------------------------------------------
+
+def test_cancel_drain_skips_weight_swap_and_version_never_torn(model):
+    """begin_drain mid-weight-swap + cancel_drain (autoscaler pressure
+    mid-publish) must never expose a half-loaded version: cancel_drain
+    returns the scale-down replica, NOT the swapping one; reap leaves
+    the swap window alone; a replica advertises the new version only
+    once the new params are fully installed."""
+    cfg, params = model
+    fleet = fleet_of(model, n=3)
+    new_params = jax.tree.map(lambda x: x, params)   # distinct pytree
+    pub = WeightPublisher(fleet)
+    pub.begin_publish(1, new_params)
+    act = pub.step()
+    assert act is not None and "drain" in act
+    rep0 = fleet.replicas[0]
+    assert rep0.draining and rep0.weight_swap
+    assert rep0.policy_version == 0                  # still the old one
+
+    # autoscaler scale-down drains another replica mid-publish...
+    drained = fleet.begin_drain()
+    assert drained is not None and drained.name == "replica-2"
+    # ...then pressure returns: cancel must pick the scale-down
+    # replica and SKIP the swap-marked one
+    back = fleet.cancel_drain()
+    assert back is drained
+    assert fleet.cancel_drain() is None              # only the swap left
+    assert rep0.draining and rep0.weight_swap
+    # reap looks for drained-and-idle — exactly the publish window
+    assert fleet.reap() == []
+    assert rep0 in fleet.replicas
+
+    # user traffic keeps flowing through the rest of the fleet
+    router = RandomRouter(fleet, seed=1)
+    req, rep = router.submit([7, 8, 9], 2)
+    assert rep is not rep0
+    while fleet.step():
+        pass
+    assert req.result() and not req.cancelled
+
+    # roll to completion; the version flips only WITH the params
+    for _ in range(20):
+        if pub.publishes:
+            break
+        pub.step()
+        for r in fleet.replicas:
+            if r.policy_version == 1:
+                assert r.engine.params is new_params
+            else:
+                assert not (r.engine.params is new_params
+                            and not r.weight_swap)
+    assert pub.publishes == 1
+    assert pub.replicas_rolled == 3
+    assert {r.policy_version for r in fleet.replicas} == {1}
+    assert not any(r.draining or r.weight_swap for r in fleet.replicas)
+    fleet.stop()
+
+
+def test_publisher_never_takes_last_active_replica(model):
+    cfg, params = model
+    fleet = fleet_of(model, n=1)
+    pub = WeightPublisher(fleet)
+    pub.begin_publish(1, params)
+    for _ in range(4):
+        assert pub.step() is None
+    assert pub.publishes == 0
+    assert fleet.replicas[0].policy_version == 0
+    assert not fleet.replicas[0].draining
+    # a second replica unblocks the roll
+    fleet.add_replica()
+    for _ in range(20):
+        if pub.publishes:
+            break
+        pub.step()
+    assert pub.publishes == 1
+    assert {r.policy_version for r in fleet.replicas} == {1}
+    fleet.stop()
+
+
+# ----------------------------------------------------------------------
+# RolloutClient: tenant/version-pinned generation, pinned prefix
+# ----------------------------------------------------------------------
+
+def test_rollout_client_pins_version_and_prefix(model):
+    fleet = fleet_of(model, n=2)
+    fleet.replicas[1].policy_version = 1
+    router = PrefixAwareRouter(fleet, seed=0)
+    client = RolloutClient(router, _reward,
+                           cfg=grpo.GRPOConfig(group_size=2),
+                           tenant="rollout", system_prompt=[9] * 12,
+                           max_new_tokens=3)
+    # pinned on every active replica; idempotent on re-call
+    assert client.pin_prefix() == 2
+    assert client.pin_prefix() == 0
+    placed = []
+    orig = router.submit
+
+    def recording_submit(*a, **kw):
+        req, rep = orig(*a, **kw)
+        placed.append(rep.name)
+        return req, rep
+
+    router.submit = recording_submit
+    n = client.submit_prompts([[1, 2], [3, 4]], version=1)
+    assert n == 4 and set(placed) == {"replica-1"}
+    with pytest.raises(RuntimeError, match="in flight"):
+        client.submit_prompts([[5]], version=1)
+    assert client.pending() == 4
+    while fleet.step():
+        pass
+    rb = client.try_harvest()
+    assert isinstance(rb, RolloutBatch)
+    assert rb.version == 1 and rb.prompts == 2 and rb.completions == 4
+    assert rb.tokens > 0 and client.tokens_total == rb.tokens
+    assert rb.batch["rewards"].shape == (2, 2)
+    assert client.batches_built == 1
+    assert client.try_harvest() is None              # one-shot harvest
+    fleet.stop()
+
+
+# ----------------------------------------------------------------------
+# RLFlywheel loop (fakes: cadence / floor / status, no device work)
+# ----------------------------------------------------------------------
+
+class _FakeReplica:
+    def __init__(self, name, version=0):
+        self.name = name
+        self.policy_version = version
+
+
+class _FakeFleet:
+    def __init__(self, n=2):
+        self.replicas = [_FakeReplica(f"replica-{i}") for i in range(n)]
+
+    def active(self):
+        return list(self.replicas)
+
+
+class _FakeRouter:
+    def __init__(self):
+        self.tenant_spills = 0
+        self.fleet = None
+
+
+class _FakeRollouts:
+    def __init__(self):
+        self.router = _FakeRouter()
+        self.tokens_total = 0
+        self.batches_built = 0
+        self._reqs = []
+        self._ready = []
+        self.version_submitted = []
+
+    def submit_prompts(self, prompts, version):
+        self._reqs = [object()] * len(prompts)
+        self._version = version
+        self.version_submitted.append(version)
+        return len(self._reqs)
+
+    def finish(self, tokens=30):
+        self._ready.append(RolloutBatch(
+            version=self._version, batch={}, prompts=1, completions=2,
+            tokens=tokens, mean_reward=0.5))
+        self._reqs = []
+        self.tokens_total += tokens
+        self.batches_built += 1
+
+    def pending(self):
+        return len(self._reqs)
+
+    def try_harvest(self):
+        return self._ready.pop(0) if self._ready else None
+
+
+class _FakeLearner:
+    def __init__(self):
+        self.version = 0
+        self.batches_consumed = 0
+        self.staleness_last = 0
+        self.staleness_max = 0
+        self.resizes = 0
+        self.losses = []
+
+    def step(self, rb):
+        self.batches_consumed += 1
+        self.staleness_last = self.version - rb.version
+        self.staleness_max = max(self.staleness_max,
+                                 self.staleness_last)
+        self.losses.append(0.5)
+        return 0.5
+
+    def publish(self):
+        self.version += 1
+        return {"w": self.version}
+
+
+class _InstantPublisher:
+    """Flips the whole fake fleet in one step (the real rolling
+    publisher is pinned above; the flywheel only needs the protocol)."""
+
+    def __init__(self, fleet):
+        self.fleet = fleet
+        self.publishes = 0
+        self.replicas_rolled = 0
+        self._target = None
+
+    @property
+    def idle(self):
+        return self._target is None
+
+    @property
+    def target(self):
+        return self._target
+
+    def begin_publish(self, version, params):
+        assert self._target is None
+        self._target = version
+
+    def step(self):
+        if self._target is None:
+            return None
+        for r in self.fleet.replicas:
+            r.policy_version = self._target
+        self.replicas_rolled += len(self.fleet.replicas)
+        v, self._target = self._target, None
+        self.publishes += 1
+        return f"published v{v}"
+
+
+def _fake_flywheel(publish_every=2, floor=0.0, batches=6):
+    fleet = _FakeFleet()
+    rollouts = _FakeRollouts()
+    feed = [[[1, 2]] for _ in range(batches)]
+    fly = RLFlywheel(
+        "rl", "grpo-tune", rollouts, _FakeLearner(),
+        _InstantPublisher(fleet),
+        lambda: feed.pop(0) if feed else None,
+        publish_every=publish_every,
+        rollout_floor_tokens_per_s=floor)
+    return fly, rollouts
+
+
+def test_flywheel_publish_cadence_and_staleness():
+    fly, rollouts = _fake_flywheel(publish_every=2, batches=6)
+    now = 0.0
+    while fly.learner.batches_consumed < 6:
+        fly.step(now)
+        if rollouts._reqs:
+            rollouts.finish()
+        now += 1.0
+    fly.step(now)
+    assert fly.publisher.publishes == 3            # every 2 batches
+    assert fly.learner.version == 3
+    # every generation was pinned to the version the fleet served
+    assert rollouts.version_submitted[0] == 0
+    assert fly.serving_version() == 3
+    # the instant publisher lands before the next submit: never stale
+    assert fly.learner.staleness_max == 0
+    st = fly.status()
+    for key in ("policyVersion", "servingVersions", "batchesConsumed",
+                "staleness", "stalenessMax", "publishes",
+                "replicasRolled", "publishRolling", "rolloutTokens",
+                "rolloutBatches", "rolloutPending", "rolloutTokensPerS",
+                "rolloutFloorTokensPerS", "floorViolations",
+                "tenantSpills", "lossLast", "elasticResizes"):
+        assert key in st, key
+    assert st["batchesConsumed"] == 6 and st["publishes"] == 3
+    assert fly.job_status("rl", "grpo-tune") == fly.status()
+    assert fly.job_status("rl", "other") is None
+    assert fly.job_status("default", "grpo-tune") is None
+
+
+def test_flywheel_floor_violations_windowed():
+    fly, rollouts = _fake_flywheel(publish_every=99, floor=5.0,
+                                   batches=2)
+    assert fly.observe(0.0) is None                # primes the window
+    fly.step(0.0)
+    rollouts.finish(tokens=60)
+    fly.step(1.0)
+    rollouts.finish(tokens=60)
+    fly.step(2.0)
+    rate = fly.observe(10.0)                       # 120 tokens / 10 s
+    assert rate == pytest.approx(12.0)
+    assert fly.floor_violations == 0
+    rate = fly.observe(1000.0)                     # quiet window
+    assert rate == pytest.approx(0.0, abs=1e-9)
+    assert fly.floor_violations == 1
+    assert fly.rate_last == rate
+
+
+# ----------------------------------------------------------------------
+# satellite: the long-dormant math (grpo_loss masking, advantages,
+# DPO reference-free fallback) — see tests/test_grpo.py / test_dpo.py
+# for the rest of the suites
+# ----------------------------------------------------------------------
+
+def test_group_advantages_all_equal_group_is_exactly_zero():
+    r = np.array([[2.0, 2.0, 2.0], [0.0, 1.0, 2.0]])
+    cfg = grpo.GRPOConfig(group_size=3)
+    a = np.asarray(grpo.group_advantages(r, cfg))
+    np.testing.assert_array_equal(a[0], 0.0)       # no NaN from std 0
+    assert np.all(np.isfinite(a))
+    np.testing.assert_allclose(a.mean(axis=1), 0.0, atol=1e-6)
+    # Dr.GRPO center-only variant keeps the same degenerate behavior
+    a2 = np.asarray(grpo.group_advantages(
+        r, grpo.GRPOConfig(group_size=3, normalize_std=False)))
+    np.testing.assert_array_equal(a2[0], 0.0)
+    with pytest.raises(ValueError, match="n_groups"):
+        grpo.group_advantages(np.zeros(6))
+
+
+def test_grpo_loss_mask_excludes_padding_positions():
+    """Values at masked positions (padding / prompt tokens) must not
+    move the loss or any metric."""
+    key = jax.random.PRNGKey(2)
+    lp = jax.random.normal(key, (2, 4)) * 0.1
+    old = lp - 0.05
+    ref = jnp.zeros((2, 4))
+    adv = jnp.array([0.7, -0.4])
+    mask = jnp.array([[1.0, 1.0, 0.0, 0.0], [1.0, 0.0, 0.0, 0.0]])
+    loss1, m1 = grpo.grpo_loss(lp, old, ref, adv, mask)
+    poison = lambda x, v: jnp.where(mask == 1, x, v)  # noqa: E731
+    loss2, m2 = grpo.grpo_loss(poison(lp, 37.0), poison(old, -21.0),
+                               poison(ref, 4.0), adv, mask)
+    np.testing.assert_allclose(float(loss1), float(loss2), rtol=1e-6)
+    for k in m1:
+        np.testing.assert_allclose(float(m1[k]), float(m2[k]),
+                                   rtol=1e-6, err_msg=k)
+
+
+def test_dpo_reference_free_fallback_matches_and_stops_gradient(model):
+    """No ``ref_*_logps`` in the batch + ``ref_params`` at build time:
+    the loss computes reference logps in-step under stop_gradient —
+    same value AND same policy gradient as the precomputed-ref path."""
+    cfg, params = model
+    batch = {k: jnp.asarray(v) for k, v in dpo.preference_batch(
+        [[1, 2, 3, 9], [4, 5, 6]], [[1, 2, 8, 8], [4, 5, 7]],
+        [2, 2]).items()}
+    fallback = dpo.make_dpo_loss_fn(cfg, ref_params=params)
+    ref_c, ref_r = dpo.reference_logps_fn(cfg, params)(batch)
+    pre_batch = dict(batch, ref_chosen_logps=ref_c,
+                     ref_rejected_logps=ref_r)
+    precomputed = dpo.make_dpo_loss_fn(cfg)
+    np.testing.assert_allclose(float(fallback(params, batch)),
+                               float(precomputed(params, pre_batch)),
+                               rtol=1e-5)
+    g1 = jax.grad(fallback)(params, batch)
+    g2 = jax.grad(precomputed)(params, pre_batch)
+    for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-6)
+    # neither precomputed logps nor ref_params: refuse loudly
+    with pytest.raises(ValueError, match="ref_"):
+        precomputed(params, batch)
+
+
+# ----------------------------------------------------------------------
+# goodput: the lazy ``rollout`` category
+# ----------------------------------------------------------------------
+
+def test_goodput_rollout_category_is_lazy():
+    from kubedl_tpu.telemetry.goodput import (GoodputAccountant,
+                                              goodput_breakdown)
+    bd = {"byPhase": {"Queuing": 5.0, "Running": 100.0},
+          "events": [{"name": "rl.rollout", "component": "rl",
+                      "duration": 30.0}]}
+    g = goodput_breakdown(bd)
+    assert g["overheadSeconds"]["rollout"] == 30.0
+    assert g["productiveSeconds"] == 70.0
+    assert g["wallSeconds"] == 105.0
+    # no rl.rollout spans -> the key does not exist (committed non-RL
+    # scorecards keep their exact overheadSeconds shape)
+    g2 = goodput_breakdown({"byPhase": {"Running": 100.0}})
+    assert "rollout" not in g2["overheadSeconds"]
+    acc = GoodputAccountant()
+    acc.observe(bd)
+    acc.observe({"byPhase": {"Running": 10.0}})
+    assert acc.overhead_s.get("rollout") == 30.0
+
+
+# ----------------------------------------------------------------------
+# gate-off contract + console + fail-fast
+# ----------------------------------------------------------------------
+
+def _console(proxy):
+    from kubedl_tpu.console.server import ConsoleConfig, ConsoleServer
+    return ConsoleServer(proxy, ConsoleConfig(host="127.0.0.1", port=0,
+                                              users={}))
+
+
+def test_gate_off_no_rl_families_console_501():
+    from kubedl_tpu.console.proxy import DataProxy
+    from kubedl_tpu.controllers.registry import (OperatorConfig,
+                                                 build_operator)
+    op = build_operator(config=OperatorConfig(workloads=[]))
+    assert not op.rl_enabled and op.rl_metrics is None
+    assert "kubedl_rl_" not in op.metrics_registry.expose()
+    server = _console(DataProxy(op.api))
+    try:
+        status, payload, _ = server.route(
+            "GET", "/api/v1/rl/rl/grpo-tune", {}, b"", None)
+        assert status == 501 and "rl flywheel" in payload["msg"]
+    finally:
+        server._httpd.server_close()
+
+
+def test_gate_requires_serving_fleet():
+    from kubedl_tpu.__main__ import parse_args
+    from kubedl_tpu.controllers.registry import (OperatorConfig,
+                                                 build_operator)
+    with pytest.raises(ValueError, match="serving fleet"):
+        build_operator(config=OperatorConfig(
+            workloads=[], enable_rl_flywheel=True))
+    with pytest.raises(SystemExit):
+        parse_args(["--enable-rl-flywheel"])
+    args = parse_args(["--enable-rl-flywheel", "--enable-serving-fleet"])
+    assert args.enable_rl_flywheel and args.enable_serving_fleet
+
+
+def test_gate_on_families_and_console_status():
+    from kubedl_tpu.console.proxy import DataProxy
+    from kubedl_tpu.controllers.registry import (OperatorConfig,
+                                                 build_operator)
+    op = build_operator(config=OperatorConfig(
+        workloads=[], enable_serving_fleet=True,
+        enable_rl_flywheel=True))
+    assert op.rl_enabled and op.rl_metrics is not None
+    body = op.metrics_registry.expose()
+    for family in ("kubedl_rl_rollout_tokens_per_s",
+                   "kubedl_rl_batches_consumed_total",
+                   "kubedl_rl_staleness", "kubedl_rl_publishes_total",
+                   "kubedl_rl_floor_violations_total"):
+        assert f"# TYPE {family} " in body
+    fly, _ = _fake_flywheel()
+    server = _console(DataProxy(op.api, rl=fly))
+    try:
+        status, payload, _ = server.route(
+            "GET", "/api/v1/rl/rl/grpo-tune", {}, b"", None)
+        assert status == 200
+        assert payload["data"]["job"] == "grpo-tune"
+        assert "policyVersion" in payload["data"]
+        status, payload, _ = server.route(
+            "GET", "/api/v1/rl/rl/unknown", {}, b"", None)
+        assert status == 404
+    finally:
+        server._httpd.server_close()
+
+
+# ----------------------------------------------------------------------
+# RLJob controller: the flywheel contract lands in the learner env
+# ----------------------------------------------------------------------
+
+def _mk_rljob(name="j1", flywheel=None, replicas=2):
+    from kubedl_tpu.core import meta as m
+    spec = {"rlReplicaSpecs": {"Learner": {
+        "replicas": replicas,
+        "template": {"spec": {"containers": [{
+            "name": "learner", "image": "img:v1",
+            "ports": [{"name": "rljob-port", "containerPort": 8476}],
+        }]}},
+    }}}
+    if flywheel is not None:
+        spec["flywheel"] = flywheel
+    return m.new_obj("training.kubedl.io/v1alpha1", "RLJob", name,
+                     spec=spec)
+
+
+def test_flywheel_spec_defaults():
+    from kubedl_tpu.controllers.workloads.rljob import RLJobController
+    job = _mk_rljob()
+    assert RLJobController.flywheel_spec(job) == {
+        "rolloutTenant": "j1",
+        "rolloutFloorTokensPerSecond": 0.0,
+        "publishEvery": 2,
+    }
+    job2 = _mk_rljob(flywheel={"rolloutTenant": "rollout",
+                               "rolloutFloorTokensPerSecond": 12.5,
+                               "publishEvery": 4})
+    assert RLJobController.flywheel_spec(job2) == {
+        "rolloutTenant": "rollout",
+        "rolloutFloorTokensPerSecond": 12.5,
+        "publishEvery": 4,
+    }
+
+
+def test_rljob_controller_renders_flywheel_env(api):
+    from kubedl_tpu.controllers.registry import build_operator
+    op = build_operator(api)
+    api.create(_mk_rljob(flywheel={"publishEvery": 3}))
+    op.run_until_idle()
+    pod = api.get("Pod", "default", "j1-learner-0")
+    env = {e["name"]: e.get("value")
+           for e in pod["spec"]["containers"][0].get("env", [])}
+    assert env["KUBEDL_RL_ROLLOUT_TENANT"] == "j1"
+    assert env["KUBEDL_RL_ROLLOUT_FLOOR_TOKENS_PER_S"] == "0.0"
+    assert env["KUBEDL_RL_PUBLISH_EVERY"] == "3"
+    assert env["JAX_PLATFORMS"] == "tpu,cpu"
+    # off-TPU RLJob renders the full JAX bootstrap contract
+    assert env["KUBEDL_NUM_PROCESSES"] == "2"
+    assert env["KUBEDL_COORDINATOR_ADDRESS"].startswith("j1-learner-0:")
+
+
+# ----------------------------------------------------------------------
+# the whole loop at day scale (the bench's leg, small profile)
+# ----------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_flywheel_replay_small_profile():
+    from kubedl_tpu.replay.fleet import (FLEET_PROFILES,
+                                         generate_fleet)
+    from kubedl_tpu.replay.rl import FlywheelReplay, RLJobSpec
+    profile = dataclasses.replace(
+        FLEET_PROFILES["routing"], name="rl-smoke", sim_seconds=300.0,
+        requests=300, bursts=4)
+    # rollout rows (prompts_per_batch x group_size = 8) stay divisible
+    # by both learner worlds (dp=8 -> dp=4)
+    spec = RLJobSpec(total_batches=4, publish_every=2,
+                     resize_after_batches=3, gen_interval_s=5.0,
+                     max_new_tokens=4)
+    res = FlywheelReplay(generate_fleet(profile, 0), spec=spec).run()
+    rl = res["rl"]
+    assert rl["job_complete"] == 1
+    assert rl["batches_consumed"] == 4
+    assert rl["publishes"] >= 2
+    assert rl["rollout_errors"] == 0 and rl["rollout_dropped"] == 0
+    assert rl["loss_finite"] == 1 and rl["step_monotonic"] == 1
+    assert rl["elastic_resizes"] == 1
+    assert rl["resize_restore_bit_identical"] == 1
+    assert res["dropped_streams"] == 0
+    # every serving replica ended on the learner's published version
+    assert set(rl["serving_versions"].values()) == {rl["policy_version"]}
