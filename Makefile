@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test test-all test-fast test-chaos test-campaign test-scheduler test-trace test-replay test-telemetry test-slo test-durability test-forensics test-replication test-elastic test-serving-fleet test-federation test-rl bench bench-controlplane bench-scheduler bench-serving-paged bench-serving-fleet bench-federation bench-rl bench-trace bench-cluster bench-cluster-adversarial bench-elastic postmortem dryrun crds run-standalone lint native
+.PHONY: test test-all test-fast test-chaos test-campaign test-scheduler test-trace test-replay test-telemetry test-slo test-durability test-forensics test-replication test-elastic test-serving-fleet test-federation test-rl test-multimodel bench bench-controlplane bench-scheduler bench-serving-paged bench-serving-fleet bench-federation bench-rl bench-multimodel bench-trace bench-cluster bench-cluster-adversarial bench-elastic postmortem dryrun crds run-standalone lint native
 
 # fast path (<3 min): everything except the compile-heavy compute suites
 # (those carry `pytestmark = pytest.mark.slow`). Chaos tests are fast and
@@ -184,6 +184,23 @@ test-rl:
 bench-rl:
 	JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 	    $(PY) bench_rl.py
+
+# multi-model serving suite (adapter catalog + paged residency
+# lifecycle, model-scoped prefix cache, adapter-affine routing,
+# per-model SLOs, gate-off contract; docs/multimodel.md)
+test-multimodel:
+	$(PY) -m pytest tests/ -q -m multimodel
+
+# multi-model bench -> BENCH_MULTIMODEL.json (docs/multimodel.md):
+# the 30-adapter Zipf day, adapter-aware vs adapter-blind routing on
+# identical traffic. Gates: affinity beats blind on adapter-fault rate
+# AND model-request p99 TTFT, every model's SLO compliance column
+# reported, adapter pages within the fleet HBM budget, zero dropped
+# streams, and the whole leg bit-identical across two in-process runs;
+# FAILS on regression vs the committed artifact. The tier-1 guard is
+# tests/test_multimodel.py.
+bench-multimodel:
+	JAX_PLATFORMS=cpu $(PY) bench_multimodel.py
 
 # render the committed adversarial campaign's forensics blocks as
 # markdown postmortems (docs/forensics.md; regenerate the blocks with

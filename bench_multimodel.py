@@ -1,0 +1,174 @@
+"""Multi-model serving bench: adapter-aware vs adapter-blind — one JSON.
+
+One comparison leg through the REAL multi-model stack (engines with a
+shared AdapterCatalog paging weight pages through their refcounted
+pools + the adapter-affine PrefixAwareRouter + per-model SLO
+objectives) on a sim clock (docs/multimodel.md):
+
+* **multimodel** — the 30-adapter Zipf day, placed twice on identical
+  traffic: adapter-AWARE routing (prefer resident replicas; cold
+  models get consistent-hash homes, so the fleet partitions the
+  catalog) vs adapter-BLIND routing (the model rides to the engine but
+  placement ignores residency — every replica churns through the whole
+  catalog and the per-replica residency cap binds). Gates: affinity
+  beats blind on adapter-fault rate AND model-request p99 TTFT, every
+  model's SLO compliance column reported, adapter pages within the
+  fleet HBM page cap, zero errors / dropped streams / unfinished
+  requests on both arms, and the aware arm bit-identical across two
+  in-process runs.
+
+The document is bit-for-bit reproducible for a fixed ``--seed`` (no
+wall clocks; the workload fingerprint is committed). When a committed
+``BENCH_MULTIMODEL.json`` exists at ``--out``, the fresh run is
+checked against it and the bench FAILS on regression — the shared
+tolerance engine, like every other bench.
+
+Usage::
+
+    python bench_multimodel.py [--seed 0] [--out FILE] [--no-check]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+#: absolute gates over the scorecard (path, op, threshold)
+GATES = (
+    ("fault_rate_ratio", ">=", 2.0),
+    ("model_ttft_p99_ratio", ">=", 1.05),
+    ("adapter_aware.completed_fraction", ">=", 1.0),
+    ("adapter_blind.completed_fraction", ">=", 1.0),
+    ("adapter_aware.errors", "<=", 0),
+    ("adapter_blind.errors", "<=", 0),
+    ("adapter_aware.dropped_streams", "<=", 0),
+    ("adapter_blind.dropped_streams", "<=", 0),
+    ("adapter_aware.requests_unfinished", "<=", 0),
+    ("adapter_blind.requests_unfinished", "<=", 0),
+    ("adapter_aware.multi_model.models_reported", ">=", 30),
+    ("adapter_blind.multi_model.models_reported", ">=", 30),
+    ("adapter_aware.multi_model.hbm.within_cap", ">=", 1),
+    ("adapter_blind.multi_model.hbm.within_cap", ">=", 1),
+    ("adapter_aware.multi_model.adapter_faults", ">=", 1),
+    ("deterministic", ">=", 1),
+)
+
+#: regression tolerances vs the committed artifact (shared engine)
+REGRESSION = (
+    ("fault_rate_ratio", "higher_better", 0.15, 0.5),
+    ("model_ttft_p99_ratio", "higher_better", 0.10, 0.05),
+    ("adapter_aware.multi_model.fault_rate", "lower_better", 0.15, 0.01),
+    ("adapter_aware.ttft_s.p99", "lower_better", 0.15, 0.05),
+    ("adapter_aware.multi_model.model_ttft_s.p99", "lower_better",
+     0.15, 0.05),
+)
+
+
+def evaluate_gates(scorecard: dict) -> dict:
+    from kubedl_tpu.replay.scorecard import _get
+    results, ok = [], True
+    for path, op, threshold in GATES:
+        value = _get(scorecard, path)
+        passed = (value is not None
+                  and (value >= threshold if op == ">=" else
+                       value <= threshold))
+        ok = ok and passed
+        results.append({"metric": path, "op": op, "threshold": threshold,
+                        "value": value, "passed": passed})
+    return {"checks": results, "passed": ok}
+
+
+def check_regression(new: dict, old: dict) -> list:
+    from kubedl_tpu.replay.scorecard import _get, check_tolerances
+    if old.get("seed") != new.get("seed"):
+        return []
+    problems = check_tolerances(new, old, REGRESSION)
+    for path in ("adapter_aware.dropped_streams",
+                 "adapter_blind.dropped_streams",
+                 "adapter_aware.requests_unfinished",
+                 "adapter_blind.requests_unfinished"):
+        if _get(new, path):
+            problems.append(f"{path} must stay 0")
+    return problems
+
+
+def main() -> dict:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default="BENCH_MULTIMODEL.json")
+    ap.add_argument("--no-check", action="store_true")
+    args = ap.parse_args()
+
+    from dataclasses import asdict
+
+    from kubedl_tpu.replay.multimodel import (MULTIMODEL_PROFILES,
+                                              MultiModelReplay, _mm_leg,
+                                              generate_multimodel,
+                                              run_multimodel_comparison)
+
+    t0 = time.perf_counter()
+    comparison = run_multimodel_comparison(args.seed)
+    t1 = time.perf_counter()
+    aware = comparison["adapter_aware"]["multi_model"]
+    blind = comparison["adapter_blind"]["multi_model"]
+    print(f"comparison in {t1 - t0:.1f}s wall: fault-rate ratio "
+          f"{comparison['fault_rate_ratio']} (aware {aware['fault_rate']}"
+          f" vs blind {blind['fault_rate']}), model p99 TTFT ratio "
+          f"{comparison['model_ttft_p99_ratio']}, "
+          f"{aware['models_reported']}/{aware['models']} models "
+          "reported", file=sys.stderr)
+
+    # determinism: the aware arm replayed in-process must reproduce the
+    # comparison's aware leg bit for bit (sim clock only — no wall
+    # time, no process-global state leaks between runs)
+    rerun = _mm_leg(MultiModelReplay(
+        generate_multimodel("multimodel", args.seed),
+        adapter_affinity=True).run())
+    deterministic = int(
+        json.dumps(rerun, sort_keys=True)
+        == json.dumps(comparison["adapter_aware"], sort_keys=True))
+    print(f"determinism leg in {time.perf_counter() - t1:.1f}s wall: "
+          f"{'bit-identical' if deterministic else 'DIVERGED'}",
+          file=sys.stderr)
+
+    scorecard = {
+        "benchmark": "multimodel",
+        "seed": args.seed,
+        "profiles": {name: asdict(p)
+                     for name, p in sorted(MULTIMODEL_PROFILES.items())},
+        "deterministic": deterministic,
+        **comparison,
+    }
+    scorecard["gates"] = evaluate_gates(scorecard)
+
+    problems = []
+    if not args.no_check and args.out and os.path.exists(args.out):
+        try:
+            with open(args.out) as f:
+                committed = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"warning: cannot read committed {args.out}: {e}",
+                  file=sys.stderr)
+            committed = {}
+        problems = check_regression(scorecard, committed)
+
+    print(json.dumps(scorecard))
+    if not scorecard["gates"]["passed"]:
+        failed = [c for c in scorecard["gates"]["checks"]
+                  if not c["passed"]]
+        raise SystemExit(f"GATE FAILED: {failed}")
+    if problems:
+        raise SystemExit("REGRESSION vs committed scorecard:\n  "
+                         + "\n  ".join(problems))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(scorecard, f, indent=2, sort_keys=True)
+            f.write("\n")
+    return scorecard
+
+
+if __name__ == "__main__":
+    main()

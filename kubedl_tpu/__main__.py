@@ -76,6 +76,15 @@ def parse_args(argv=None) -> argparse.Namespace:
                         "console /api/v1/rl endpoints (docs/rl.md; also "
                         "RLFlywheel gate; requires "
                         "--enable-serving-fleet)")
+    p.add_argument("--enable-multi-model", action="store_true",
+                   help="multi-model serving: LoRA adapter multiplexing "
+                        "on the paged fleet — adapter weight pages share "
+                        "the refcounted KV pool, model-scoped prefix "
+                        "caches, adapter-affine routing, per-model SLO "
+                        "columns, console /api/v1/serving/models "
+                        "endpoint (docs/multimodel.md; also "
+                        "MultiModelServing gate; requires "
+                        "--enable-serving-fleet)")
     p.add_argument("--enable-federation", action="store_true",
                    help="multi-region federation: global queue routing "
                         "over per-region placement scores, cross-region "
@@ -219,6 +228,13 @@ def parse_args(argv=None) -> argparse.Namespace:
                 "(rollout generation rides the fleet's router as a "
                 "low-priority tenant; there is no rollout substrate "
                 "without it)")
+    # adapters are replica residency: multi-model without the serving
+    # fleet would have no replica pools to page adapter weights through
+    # — fail at the parser (build_operator re-checks for library callers)
+    if args.enable_multi_model and not args.enable_serving_fleet:
+        p.error("--enable-multi-model requires --enable-serving-fleet "
+                "(adapter weight pages live in the replicas' paged KV "
+                "pools; there is no residency substrate without them)")
     return args
 
 
@@ -260,6 +276,7 @@ def config_from_args(args: argparse.Namespace) -> OperatorConfig:
         enable_elastic_slices=args.enable_elastic_slices,
         enable_serving_fleet=args.enable_serving_fleet,
         enable_rl_flywheel=args.enable_rl_flywheel,
+        enable_multi_model=args.enable_multi_model,
         enable_federation=args.enable_federation,
         region_topology=args.region_topology,
     )

@@ -46,7 +46,7 @@ class DataProxy:
                  telemetry=None, journal=None, replication=None,
                  elastic: bool = False, serving_fleet=None,
                  serving_autoscaler=None, serving_router=None,
-                 federation=None, rl=None):
+                 federation=None, rl=None, adapter_catalog=None):
         self.api = api
         self.object_backend = object_backend
         self.event_backend = event_backend
@@ -83,6 +83,11 @@ class DataProxy:
         #: /api/v1/rl endpoints answer 501 (gate off, or this process
         #: hosts no flywheel — same convention as serving_fleet)
         self.rl = rl
+        #: the fleet-wide AdapterCatalog (docs/multimodel.md); None =
+        #: the /api/v1/serving/models endpoint answers 501 (gate off,
+        #: or this process hosts no multi-model fleet — same convention
+        #: as serving_fleet)
+        self.adapter_catalog = adapter_catalog
 
     # -- jobs -------------------------------------------------------------
 
@@ -751,6 +756,33 @@ class DataProxy:
         if self.serving_autoscaler is not None:
             out["autoscaler"] = self.serving_autoscaler.status()
         return out
+
+    @property
+    def multi_model_enabled(self) -> bool:
+        return (self.adapter_catalog is not None
+                and self.serving_fleet is not None)
+
+    def serving_models_status(self) -> dict:
+        """The multi-model snapshot (docs/multimodel.md): the fleet-wide
+        adapter catalog plus each replica's residency — which adapters
+        are resident/pinned where, their pool pages, fault-in and
+        eviction counts. The answer to "where does model X live and
+        what is it costing"."""
+        cat = self.adapter_catalog
+        models = [{"model": m,
+                   "pages": cat.spec(m).pages,
+                   "rank": cat.spec(m).rank}
+                  for m in cat.models()]
+        replicas = []
+        for rep in self.serving_fleet.replicas:
+            status_fn = getattr(rep.engine, "adapter_status", None)
+            st = status_fn() if status_fn is not None else None
+            replicas.append({"replica": rep.name,
+                             "draining": rep.draining,
+                             "adapters": st})
+        return {"baseModel": cat.base_model,
+                "models": models,
+                "replicas": replicas}
 
     def explain_pending(self, namespace: str, name: str) -> Optional[dict]:
         """The pending-job explainer verdict (requires the scheduler);

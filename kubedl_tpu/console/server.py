@@ -513,6 +513,22 @@ class ConsoleServer:
                                     "process hosts no replicas)"}, []
             return ok(self.proxy.serving_fleet_status())
 
+        # multi-model serving (docs/multimodel.md): the adapter catalog
+        # plus each replica's residency (which models live where, their
+        # pool pages, fault/eviction counts); 501 when the gate is off
+        # or this process hosts no multi-model fleet — the same
+        # convention as the fleet endpoint, one gate deeper
+        if path == "/api/v1/serving/models":
+            if not self.proxy.multi_model_enabled:
+                return 501, {"code": 501,
+                             "msg": "multi-model serving disabled "
+                                    "(--enable-multi-model / "
+                                    "MultiModelServing gate, with "
+                                    "--enable-serving-fleet, and this "
+                                    "process hosts no adapter "
+                                    "catalog)"}, []
+            return ok(self.proxy.serving_models_status())
+
         # RL flywheel (docs/rl.md): one RLJob's policy version vs the
         # fleet's visible versions, rollout throughput against the
         # declared floor, publish/staleness counters; 501 when this

@@ -181,6 +181,17 @@ class OperatorConfig:
     #: process hosts the fleet — the operator side carries the metric
     #: families and the console surface a hosted flywheel plugs into.
     enable_rl_flywheel: bool = False
+    #: multi-model serving (docs/multimodel.md). Also switchable via
+    #: the MultiModelServing gate; either turns it on. REQUIRES the
+    #: serving fleet (--enable-serving-fleet): adapter weight pages
+    #: live in the replicas' paged KV pools — build_operator fails fast
+    #: otherwise. Off by default: no kubedl_serving_adapter_* family
+    #: registers and the console /api/v1/serving/models endpoint
+    #: answers 501 (the byte-identical-disabled convention). The
+    #: adapter catalog and residency live with the hosted fleet — the
+    #: operator side carries the metric families and the console
+    #: surface.
+    enable_multi_model: bool = False
 
 
 @dataclass
@@ -232,6 +243,12 @@ class Operator:
     #: the RLMetrics bundle when the gate is on (a hosted flywheel
     #: adopts it so the kubedl_rl_* families land in THIS exposition)
     rl_metrics: object = None
+    #: multi-model serving on (docs/multimodel.md): the console's
+    #: /api/v1/serving/models endpoint answers only when True
+    multi_model_enabled: bool = False
+    #: the fleet-wide AdapterCatalog when a hosting process installed
+    #: one (tests / the predictor binary); None in the plain operator
+    adapter_catalog: object = None
 
     def run_until_idle(self, **kw):
         return self.manager.run_until_idle(**kw)
@@ -347,10 +364,25 @@ def build_operator(api: Optional[APIServer] = None,
     # process hosts the replicas and adopts this metrics bundle
     serving_fleet_enabled = (config.enable_serving_fleet
                              or gates.enabled(ft.SERVING_FLEET))
+    # multi-model serving (docs/multimodel.md): adapters are replica
+    # residency — weight pages allocate from the replicas' paged KV
+    # pools — so the gate is meaningless without the fleet underneath;
+    # fail fast rather than silently degrade (same posture as
+    # rl-without-fleet). The kubedl_serving_adapter_* families register
+    # only when on, so the fleet-only exposition stays byte-identical.
+    multi_model_enabled = (config.enable_multi_model
+                           or gates.enabled(ft.MULTI_MODEL_SERVING))
+    if multi_model_enabled and not serving_fleet_enabled:
+        raise ValueError(
+            "enable_multi_model requires the serving fleet "
+            "(--enable-serving-fleet / ServingFleet gate): adapter "
+            "weight pages live in the replicas' paged KV pools; there "
+            "is no residency substrate without them")
     serving_fleet_metrics = None
     if serving_fleet_enabled:
         from ..metrics.registry import ServingFleetMetrics
-        serving_fleet_metrics = ServingFleetMetrics(registry)
+        serving_fleet_metrics = ServingFleetMetrics(
+            registry, multi_model=multi_model_enabled)
     # multi-region federation (docs/federation.md): the
     # kubedl_federation_* families register only here, so the disabled
     # exposition stays byte-identical. The gate is meaningless without
@@ -533,7 +565,8 @@ def build_operator(api: Optional[APIServer] = None,
                     federation_enabled=federation_enabled,
                     federation_metrics=federation_metrics,
                     region_topology=region_topology,
-                    rl_enabled=rl_enabled, rl_metrics=rl_metrics)
+                    rl_enabled=rl_enabled, rl_metrics=rl_metrics,
+                    multi_model_enabled=multi_model_enabled)
 
 
 def _storage_backend(spec: str, for_events: bool = False):

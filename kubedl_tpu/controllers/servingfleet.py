@@ -40,6 +40,10 @@ class AutoscalerConfig:
     #: free-block floor: at or under this (with work queued) the pool
     #: is the bottleneck
     free_blocks_low: int = 4
+    #: adapter fault-ins per reconcile (summed over active replicas)
+    #: that read as residency thrash on a multi-model fleet
+    #: (docs/multimodel.md); 0 disables the signal
+    adapter_faults_high: int = 0
     #: seconds between actuations (either direction)
     cooldown_s: float = 60.0
     #: quiet seconds (no pressure, no firing alert, empty queues)
@@ -77,6 +81,10 @@ class ServingAutoscaler:
         self.log: list = []
         self._last_actuation = float("-inf")
         self._quiet_since: Optional[float] = None
+        #: fleet-wide adapter fault-ins seen at the last reconcile (the
+        #: multi-model pressure signal is the DELTA, not the lifetime
+        #: total — a fleet that thrashed yesterday is not thrashing now)
+        self._adapter_faults_seen = 0
 
     # -- signals ----------------------------------------------------------
 
@@ -109,6 +117,21 @@ class ServingAutoscaler:
         if frees and min(frees) <= self.config.free_blocks_low and qd > 0:
             return (f"free blocks at {min(frees)} with {qd} queued "
                     "(pool-starved)")
+        if self.config.adapter_faults_high > 0:
+            # multi-model residency thrash: too many cold adapter
+            # fault-ins since the last reconcile while work is queued
+            # means the catalog's working set no longer fits the
+            # fleet's pools — a new replica adds a pool AND another
+            # consistent-hash home to partition the catalog over
+            total = sum(sum((h.get("adapter_faults") or {}).values())
+                        for h in self.fleet.health())
+            total += getattr(self.fleet, "reaped_adapter_faults", 0)
+            delta = max(total - self._adapter_faults_seen, 0)
+            self._adapter_faults_seen = max(total,
+                                            self._adapter_faults_seen)
+            if delta >= self.config.adapter_faults_high and qd > 0:
+                return (f"{delta} adapter fault-ins since last "
+                        "reconcile (residency thrash)")
         return None
 
     # -- the reconcile ----------------------------------------------------

@@ -90,6 +90,18 @@ FEDERATION = "Federation"
 #: byte-identical. Requires the serving fleet (rollouts ARE fleet
 #: traffic; there is no tenant queue to ride without it).
 RL_FLYWHEEL = "RLFlywheel"
+#: multi-model serving (docs/multimodel.md): LoRA adapter multiplexing
+#: on the paged fleet — an AdapterCatalog whose weight pages allocate
+#: from the same refcounted BlockPool as KV blocks (load pins, requests
+#: refcount, idle adapters LRU-evict under the register_prefix
+#: contract), model-scoped prefix caches, adapter-affine routing with
+#: consistent-hash homes for cold models, and per-model SLO columns;
+#: off by default — no kubedl_serving_adapter_* family registers, the
+#: console /api/v1/serving/models endpoint answers 501, and every
+#: committed scorecard stays byte-identical. Requires the serving
+#: fleet (adapters are replica residency; there is no replica pool to
+#: page them through without it).
+MULTI_MODEL_SERVING = "MultiModelServing"
 
 _DEFAULTS = {
     GANG_SCHEDULING: True,           # Beta
@@ -108,6 +120,7 @@ _DEFAULTS = {
     SERVING_FLEET: False,            # Alpha
     FEDERATION: False,               # Alpha
     RL_FLYWHEEL: False,              # Alpha
+    MULTI_MODEL_SERVING: False,      # Alpha
 }
 
 ENV_FEATURE_GATES = "KUBEDL_FEATURE_GATES"

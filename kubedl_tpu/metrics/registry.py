@@ -527,10 +527,15 @@ class ServingFleetMetrics:
     router's placement counters, and prefill→decode block-table
     handoffs. Constructed only when the ServingFleet gate is on — the
     disabled exposition carries none of these families (the
-    byte-identical-disabled convention)."""
+    byte-identical-disabled convention). ``multi_model=True`` (the
+    MultiModelServing gate, docs/multimodel.md) adds the adapter
+    families; off, not one ``kubedl_serving_adapter_*`` family exists —
+    the same convention, one gate deeper."""
 
-    def __init__(self, registry: Optional[Registry] = None):
+    def __init__(self, registry: Optional[Registry] = None,
+                 multi_model: bool = False):
         self.registry = registry or Registry()
+        self.multi_model = bool(multi_model)
         r = self.registry
         self.free_blocks = r.gauge(
             "kubedl_serving_free_blocks",
@@ -574,18 +579,46 @@ class ServingFleetMetrics:
             "kubedl_serving_prefill_handoffs_total",
             "Prefill→decode block-table handoffs per replica "
             "(disaggregated lanes only)", ("replica",))
+        if self.multi_model:
+            self.adapter_faults = r.counter(
+                "kubedl_serving_adapter_faults_total",
+                "Cold adapter fault-ins through the paged pool by model "
+                "(a resident adapter costs none; the router-quality "
+                "signal)", ("model",))
+            self.adapter_resident = r.gauge(
+                "kubedl_serving_adapter_resident",
+                "Adapters currently resident per serving replica",
+                ("replica",))
+            self.adapter_pages = r.gauge(
+                "kubedl_serving_adapter_pages",
+                "Pool blocks pinned by resident adapter weights per "
+                "serving replica (HBM shared with KV blocks)",
+                ("replica",))
         self._handoffs_seen: dict = {}
+        self._adapter_faults_seen: dict = {}
         self._replicas_seen: set = set()
 
-    def note_reaped(self, replica: str, handoffs_total: int) -> None:
+    def note_reaped(self, replica: str, handoffs_total: int,
+                    adapter_faults: Optional[dict] = None) -> None:
         """Flush a reaped replica's final handoff delta into the counter
         BEFORE its engine disappears from ``fleet.health()`` — without
         this, handoffs performed between the last refresh and the reap
         would vanish from the exposition (the bench's fleet-lifetime
-        rollup keeps them, and the two must agree)."""
+        rollup keeps them, and the two must agree). ``adapter_faults``
+        (a per-model dict) does the same for a multi-model replica's
+        fault counters."""
         delta = handoffs_total - self._handoffs_seen.pop(replica, 0)
         if delta > 0:
             self.handoffs.inc(delta, replica=replica)
+        if self.multi_model:
+            for model, total in (adapter_faults or {}).items():
+                d = total - self._adapter_faults_seen.pop(
+                    (replica, model), 0)
+                if d > 0:
+                    self.adapter_faults.inc(d, model=model)
+            self._adapter_faults_seen = {
+                k: v for k, v in self._adapter_faults_seen.items()
+                if k[0] != replica}
 
     def refresh(self, fleet) -> None:
         """Push one fleet health snapshot (gauges per live replica;
@@ -604,11 +637,30 @@ class ServingFleetMetrics:
             if delta > 0:
                 self.handoffs.inc(delta, replica=name)
                 self._handoffs_seen[name] = h["handoffs"]
+            if self.multi_model and "resident_adapters" in h:
+                self.adapter_resident.set(
+                    h["resident_adapters"], replica=name)
+                self.adapter_pages.set(h["adapter_pages"], replica=name)
+                for model, total in (h.get("adapter_faults")
+                                     or {}).items():
+                    d = total - self._adapter_faults_seen.get(
+                        (name, model), 0)
+                    if d > 0:
+                        self.adapter_faults.inc(d, model=model)
+                        self._adapter_faults_seen[(name, model)] = total
         for name in self._replicas_seen - live:
             self.free_blocks.remove(replica=name)
             self.queue_depth.remove(replica=name)
             self.active_lanes.remove(replica=name)
             self._handoffs_seen.pop(name, None)
+            if self.multi_model:
+                # a reaped replica's per-replica adapter series go with
+                # it (fault totals were flushed by note_reaped)
+                self.adapter_resident.remove(replica=name)
+                self.adapter_pages.remove(replica=name)
+                self._adapter_faults_seen = {
+                    k: v for k, v in self._adapter_faults_seen.items()
+                    if k[0] != name}
         self._replicas_seen = live
         self.replicas.set(len(live))
         self.draining.set(draining)

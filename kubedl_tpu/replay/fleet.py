@@ -236,20 +236,15 @@ class ServingFleetReplay:
                              capacity=profile.trace_capacity,
                              clock=self.clock,
                              metrics=TraceMetrics(self.registry))
-        self.metrics = ServingFleetMetrics(self.registry)
+        self.metrics = self._make_metrics()
         cfg, params = model if model is not None else _tiny_model()
+        self._model = (cfg, params)
         seed = workload.seed
 
         def factory(idx: int):
             from ..serving.batching import ContinuousBatchingEngine
-            pf = profile.prefill_lanes if self.disaggregate else 0
             return ContinuousBatchingEngine(
-                cfg, params, lanes=profile.decode_lanes + pf,
-                max_len=profile.max_len, kv_mode="paged",
-                kv_block=profile.kv_block,
-                pool_blocks=profile.pool_blocks,
-                seed=seed + 17 * idx, tracer=self.tracer,
-                prefill_lanes=pf)
+                cfg, params, **self._engine_kwargs(idx))
 
         self.fleet = ServingFleet(factory, replicas=profile.replicas,
                                   metrics=self.metrics)
@@ -260,6 +255,7 @@ class ServingFleetReplay:
               "metrics": self.metrics}
         if router_cls is PrefixAwareRouter:
             kw["queues"] = fleet_queues(profile)
+        kw.update(self._router_kwargs(router_cls))
         self.router = router_cls(self.fleet, **kw)
         self.slo = SLOEvaluator(clock=self.clock,
                                 evaluate_interval_s=15.0)
@@ -289,6 +285,31 @@ class ServingFleetReplay:
         #: device frees up (the cost model; empty for disaggregated)
         self._busy_until: dict = {}
 
+    # -- subclass seams ---------------------------------------------------
+
+    def _make_metrics(self):
+        """Subclass seam: the ServingFleetMetrics bundle (the
+        multi-model replay turns the adapter families on)."""
+        return ServingFleetMetrics(self.registry)
+
+    def _engine_kwargs(self, idx: int) -> dict:
+        """Subclass seam: per-replica engine kwargs — the multi-model
+        replay adds the shared adapter catalog and the per-replica
+        residency cap on top of these."""
+        profile = self.workload.profile
+        pf = profile.prefill_lanes if self.disaggregate else 0
+        return dict(lanes=profile.decode_lanes + pf,
+                    max_len=profile.max_len, kv_mode="paged",
+                    kv_block=profile.kv_block,
+                    pool_blocks=profile.pool_blocks,
+                    seed=self.workload.seed + 17 * idx,
+                    tracer=self.tracer, prefill_lanes=pf)
+
+    def _router_kwargs(self, router_cls) -> dict:
+        """Subclass seam: extra router kwargs (the multi-model replay's
+        adapter-blind arm passes ``adapter_affinity=False``)."""
+        return {}
+
     # -- span drain -------------------------------------------------------
 
     def _filter_spans(self, spans: list) -> list:
@@ -299,15 +320,23 @@ class ServingFleetReplay:
         the user SLO the flywheel is required not to violate."""
         return spans
 
+    def _fold_signals(self, spans: list) -> None:
+        """Subclass seam: harvest span-derived signals into the
+        accumulators and the SLO evaluator. The multi-model replay
+        overrides this to label each sample with its request's model
+        (``feed_traced`` + a trace→model map) so per-model objectives
+        see only their own traffic."""
+        for signal, value, t in self._harvester.feed(spans):
+            if signal == "ttft":
+                self.ttfts.append(value)
+            self.slo.observe(signal, value, t)
+
     def _drain(self) -> None:
         spans = self.tracer.spans()
         if spans:
             self.tracer.clear()
             spans = self._filter_spans(spans)
-            for signal, value, t in self._harvester.feed(spans):
-                if signal == "ttft":
-                    self.ttfts.append(value)
-                self.slo.observe(signal, value, t)
+            self._fold_signals(spans)
             for s in spans:
                 if s.name == "request.queue":
                     self.queue_waits.append(s.duration)
@@ -327,6 +356,13 @@ class ServingFleetReplay:
         self.replicas_peak = max(self.replicas_peak, self.fleet.size)
 
     # -- the day loop -----------------------------------------------------
+
+    def _submit_arrival(self, a, prefix):
+        """Subclass seam: route + submit one arrival (the multi-model
+        replay threads the arrival's model id through the router)."""
+        req, _rep = self.router.submit(
+            list(a.prompt), a.max_new, tenant=a.tenant, prefix=prefix)
+        return req
 
     def _step_fleet(self) -> None:
         now = self.clock.elapsed
@@ -361,10 +397,7 @@ class ServingFleetReplay:
                 a = arrivals[i]
                 prefix = (list(prefixes[a.prefix_rank])
                           if a.prefix_rank >= 0 else None)
-                req, _rep = self.router.submit(
-                    list(a.prompt), a.max_new, tenant=a.tenant,
-                    prefix=prefix)
-                requests.append(req)
+                requests.append(self._submit_arrival(a, prefix))
                 i += 1
             self.clock.advance(profile.tick_s)
             self._step_fleet()

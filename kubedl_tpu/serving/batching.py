@@ -175,12 +175,19 @@ class _Prefix:
     are exempt from the least-recently-hit eviction that makes room at
     ``max_prefixes`` (docs/serving_fleet.md: the fleet router registers
     prefixes opportunistically; an operator-pinned system prompt must
-    never be displaced by that churn)."""
+    never be displaced by that churn). ``model`` scopes the entry: the
+    cache keys on ``(model, tokens)`` so two models' identical token
+    prefixes can never alias each other's KV blocks — a LoRA adapter's
+    attention output differs from the base model's even on identical
+    tokens, so a cross-model share would serve WRONG KV
+    (docs/multimodel.md). "" is the base model; every pre-multi-model
+    caller stays on it untouched."""
     key: tuple
     plen: int
     stored: Optional[dict] = None
     blocks: tuple = ()
     pinned: bool = False
+    model: str = ""
 
 
 @dataclass
@@ -198,6 +205,10 @@ class Request:
     tokens: list = field(default_factory=list)
     logprobs: list = field(default_factory=list)
     want_logprobs: bool = False
+    #: adapter id this request decodes under ("" = the base model).
+    #: Admission gates on the adapter being resident (a cold one faults
+    #: its weight pages in through the pool first — docs/multimodel.md)
+    model: str = ""
     #: per-request sampling overrides; None = the engine's GenerateConfig
     temperature: Optional[float] = None
     top_k: Optional[int] = None
@@ -300,6 +311,11 @@ class _Lane:
     #: handoff lands).
     parked: bool = False
     parked_at: float = 0.0     # tracer clock at park (handoff span)
+    #: multi-model serving: the adapter this lane decodes under and the
+    #: weight pages it increfed at admission (released exactly once via
+    #: _free_lane; a handoff MOVES them with the block-table row)
+    adapter: str = ""
+    adapter_blocks: list = field(default_factory=list)
 
     def reset(self) -> None:
         self.request = None
@@ -308,6 +324,8 @@ class _Lane:
         self.blocks = []
         self.parked = False
         self.parked_at = 0.0
+        self.adapter = ""
+        self.adapter_blocks = []
 
 
 class ContinuousBatchingEngine:
@@ -327,7 +345,8 @@ class ContinuousBatchingEngine:
                  kv_mode: Optional[str] = None, kv_block: int = 64,
                  pool_blocks: Optional[int] = None,
                  headroom_blocks: int = 1, tracer=None,
-                 prefill_lanes: int = 0):
+                 prefill_lanes: int = 0, adapters=None,
+                 max_adapters: Optional[int] = None):
         from .engine import (SpecStats, init_mesh_serving, resolve_family,
                              sample_logits)
         self.config = config
@@ -551,6 +570,29 @@ class ContinuousBatchingEngine:
             self._decode_p = make_decode_paged(cfg, family)
             self._prefill_p = make_prefill_paged(cfg, family)
             self._spec_verify_p = _spec_verify_paged
+        #: multi-model serving (docs/multimodel.md): an AdapterCatalog
+        #: turns this engine into a multiplexer — requests carry a
+        #: ``model=`` id and the adapter's weight pages allocate from
+        #: the SAME refcounted pool as KV blocks. ``max_adapters`` is
+        #: the resident-count cap (the ``max_prefixes`` analog).
+        self._adapters = None
+        if adapters is not None:
+            if self.kv_mode == "dense":
+                raise ValueError(
+                    "multi-model adapters require a paged KV layout "
+                    "(adapter weight pages live in the block pool; a "
+                    "dense slab has no pool to page them from)")
+            from .adapters import AdapterResidency
+            self._adapters = AdapterResidency(
+                adapters, self._bpool, max_resident=max_adapters)
+        #: monotonic residency generation: bumped whenever the prefix
+        #: set or resident-adapter set changes, so the fleet router can
+        #: cache residency snapshots and probe without taking
+        #: _sched_lock on every submit (invalidation = epoch mismatch)
+        self.residency_epoch = 0
+        #: adapter weight pages cold-faulted in the current tick (the
+        #: replay's cost-model seam, like prefill_tokens_step)
+        self.adapter_fault_pages_step = 0
         self._lane_state = [_Lane() for _ in range(lanes)]
         self._cur = np.zeros((lanes, 1), np.int32)
         self._pos = np.zeros((lanes,), np.int32)
@@ -569,7 +611,7 @@ class ContinuousBatchingEngine:
 
     def register_prefix(self, tokens: Sequence[int],
                         max_prefixes: Optional[int] = None,
-                        pinned: bool = False) -> None:
+                        pinned: bool = False, model: str = "") -> None:
         """Prefill a shared prompt prefix ONCE; later requests whose
         prompts start with it skip re-prefilling it — the standard
         system-prompt optimization. Greedy outputs are unchanged (the
@@ -590,7 +632,13 @@ class ContinuousBatchingEngine:
         on whichever replica it warms (docs/serving_fleet.md), and a
         hard raise there would wedge placement on a full cache. Only
         when every stored prefix is ``pinned`` does the cap still
-        raise."""
+        raise.
+
+        ``model`` scopes the entry to one adapter ("" = base model):
+        the cache keys on ``(model, tokens)``, so only requests
+        decoding under the SAME model match it — identical token
+        prefixes under different adapters hold different KV
+        (docs/multimodel.md)."""
         tokens = list(tokens)
         if not tokens:
             raise ValueError("empty prefix")
@@ -599,8 +647,10 @@ class ContinuousBatchingEngine:
             raise ValueError(
                 f"prefix {plen} exceeds cache capacity {self.max_len}")
         key = tuple(tokens)
+        model = model or ""
         if max_prefixes is not None and \
-                not any(p.key == key for p in self._prefixes) and \
+                not any(p.key == key and p.model == model
+                        for p in self._prefixes) and \
                 len(self._prefixes) >= max_prefixes and \
                 all(p.pinned for p in self._prefixes):
             # optimistic pre-check: a rejected registration must not
@@ -620,7 +670,8 @@ class ContinuousBatchingEngine:
             # dedup (re-registering replaces) + longest-first ordering so
             # the best match wins during admission; swap in a NEW list so
             # concurrent _match_prefix iterations never see a mid-sort view
-            entries = [p for p in self._prefixes if p.key != key]
+            entries = [p for p in self._prefixes
+                       if not (p.key == key and p.model == model)]
             # cap enforced HERE, under the lock: a server-side
             # check-then-call would race concurrent registrations past
             # the limit, and an idempotent re-register (key already
@@ -638,8 +689,11 @@ class ContinuousBatchingEngine:
                             "every stored prefix is pinned (each prefix "
                             "pins a KV block in HBM)")
                     victim = min(victims, key=lambda p: (
-                        self._prefix_hits.get(p.key, 0), p.key))
-                    entries = [p for p in entries if p.key != victim.key]
+                        self._prefix_hits.get((p.model, p.key), 0),
+                        p.model, p.key))
+                    entries = [p for p in entries
+                               if not (p.key == victim.key
+                                       and p.model == victim.model)]
                     evicted.append(victim)
             blocks: tuple = ()
             if self.kv_mode != "dense":
@@ -654,7 +708,8 @@ class ContinuousBatchingEngine:
                 # sharing the blocks keeps them alive; an unreferenced
                 # pin returns to the free list right here.
                 for old in self._prefixes:
-                    if old.key == key and old.blocks:
+                    if old.key == key and old.model == model \
+                            and old.blocks:
                         self._bpool.decref(old.blocks)
                 for victim in evicted:
                     if victim.blocks:
@@ -686,18 +741,20 @@ class ContinuousBatchingEngine:
                         self._recover_locked()
                         raise
             for victim in evicted:
-                self._prefix_hits.pop(victim.key, None)
+                self._prefix_hits.pop((victim.model, victim.key), None)
             # seed the hit clock at registration: a never-yet-admitted
             # prefix must rank by registration recency, not tie at 0 —
             # otherwise the victim among fresh registrations falls to
             # arbitrary token-tuple order and router-driven churn can
             # evict the prefix it registered one request ago
-            self._record_prefix_hit(key)
+            self._record_prefix_hit((model, key))
             entries = entries + [_Prefix(key=key, plen=plen,
                                          stored=stored, blocks=blocks,
-                                         pinned=bool(pinned))]
+                                         pinned=bool(pinned),
+                                         model=model)]
             entries.sort(key=lambda p: -p.plen)
             self._prefixes = entries
+            self.residency_epoch += 1
 
     def _chunked_prefill(self, step, seq: list, start: int):
         """THE chunking rule, shared by every prefill path (dense lane,
@@ -752,37 +809,45 @@ class ContinuousBatchingEngine:
                     self._bpool.decref(p.blocks)
             self._prefixes = []
             self._prefix_hits = {}
+            self.residency_epoch += 1
 
     def _record_prefix_hit(self, key: tuple) -> None:
         """Admission-time LRU bookkeeping (caller holds _sched_lock)."""
         self._prefix_hit_clock += 1
         self._prefix_hits[key] = self._prefix_hit_clock
 
-    def _match_prefix(self, prompt: list, record_hit: bool = True):
-        """Dense-mode match: (stored KV, suffix start)."""
+    def _match_prefix(self, prompt: list, model: str = "",
+                      record_hit: bool = True):
+        """Dense-mode match: (stored KV, suffix start). Scoped to
+        ``model`` — another model's identical tokens never match."""
         for p in self._prefixes:
-            if len(prompt) >= p.plen and tuple(prompt[:p.plen]) == p.key:
+            if p.model == model and len(prompt) >= p.plen \
+                    and tuple(prompt[:p.plen]) == p.key:
                 if record_hit:
-                    self._record_prefix_hit(p.key)
+                    self._record_prefix_hit((p.model, p.key))
                 # keep at least one suffix token so the prefill has a
                 # position to read logits from (re-running the prefix's
                 # last token overwrites its own slot with identical KV)
                 return p.stored, min(p.plen, len(prompt) - 1)
         return None, 0
 
-    def _match_prefix_blocks(self, prompt: list, record_hit: bool = True):
+    def _match_prefix_blocks(self, prompt: list, model: str = "",
+                             record_hit: bool = True):
         """Paged-mode match: (shareable block ids, suffix start). Shares
         only FULL blocks, clamped so at least one suffix token remains
-        to prefill (start = n_shared * block <= len(prompt) - 1)."""
+        to prefill (start = n_shared * block <= len(prompt) - 1).
+        Scoped to ``model`` like :meth:`_match_prefix`."""
         for p in self._prefixes:
-            if len(prompt) >= p.plen and tuple(prompt[:p.plen]) == p.key:
+            if p.model == model and len(prompt) >= p.plen \
+                    and tuple(prompt[:p.plen]) == p.key:
                 if record_hit:
-                    self._record_prefix_hit(p.key)
+                    self._record_prefix_hit((p.model, p.key))
                 n = min(len(p.blocks), (len(prompt) - 1) // self.kv_block)
                 return list(p.blocks[:n]), n * self.kv_block
         return [], 0
 
-    def prefix_residency(self, prompt: Sequence[int]) -> int:
+    def prefix_residency(self, prompt: Sequence[int],
+                         model: str = "") -> int:
         """Pool blocks a registered prefix would share with this prompt
         right now (0 = no resident prefix). The fleet router's placement
         signal (docs/serving_fleet.md): the refcounted pool makes
@@ -793,15 +858,65 @@ class ContinuousBatchingEngine:
             return 0
         with self._sched_lock:
             shared, _ = self._match_prefix_blocks(list(prompt),
+                                                  model=model or "",
                                                   record_hit=False)
         return len(shared)
 
-    def has_prefix(self, tokens: Sequence[int]) -> bool:
-        """Whether exactly this prefix is registered (the router's
-        warm-check before a router-driven ``register_prefix``)."""
+    def has_prefix(self, tokens: Sequence[int], model: str = "") -> bool:
+        """Whether exactly this (model, prefix) is registered (the
+        router's warm-check before a router-driven
+        ``register_prefix``)."""
         key = tuple(tokens)
+        model = model or ""
         with self._sched_lock:
-            return any(p.key == key for p in self._prefixes)
+            return any(p.key == key and p.model == model
+                       for p in self._prefixes)
+
+    def residency_snapshot(self) -> tuple:
+        """One consistent ``(epoch, prefixes, resident_adapters,
+        kv_block)`` view, where ``prefixes`` is the longest-first
+        ``(model, key, n_blocks)`` list the match walks. The fleet
+        router caches this per replica keyed on the epoch and computes
+        residency host-side — a submit takes ZERO engine locks until
+        the epoch moves (docs/multimodel.md "probe cost")."""
+        with self._sched_lock:
+            return (self.residency_epoch,
+                    tuple((p.model, p.key, len(p.blocks))
+                          for p in self._prefixes),
+                    (frozenset(self._adapters.resident_models())
+                     if self._adapters is not None else frozenset()),
+                    self.kv_block)
+
+    # -- multi-model adapters (docs/multimodel.md) ------------------------
+
+    @property
+    def multi_model(self) -> bool:
+        return self._adapters is not None
+
+    def load_adapter(self, model: str, pinned: bool = False) -> None:
+        """Pin an adapter's weight pages resident ahead of traffic (the
+        ``register_prefix`` analog for weights). At ``max_adapters``
+        the least-recently-hit unpinned adapter is evicted; an
+        all-pinned catalog raises."""
+        if self._adapters is None:
+            raise ValueError("engine has no adapter catalog (pass "
+                             "adapters= to enable multi-model serving)")
+        with self._sched_lock:
+            self._adapters.load(model, pinned=pinned)
+            self.residency_epoch += 1
+
+    def adapter_resident(self, model: str) -> bool:
+        if self._adapters is None:
+            return False
+        with self._sched_lock:
+            return self._adapters.is_resident(model)
+
+    def adapter_status(self) -> dict:
+        """Resident set + fault/eviction counters (console endpoint)."""
+        if self._adapters is None:
+            return {}
+        with self._sched_lock:
+            return self._adapters.status()
 
     @property
     def queue_depth(self) -> int:
@@ -818,7 +933,16 @@ class ContinuousBatchingEngine:
             parked = sum(1 for l in self._lane_state if l.parked)
             free = (self._bpool.free_count if self.kv_mode != "dense"
                     else None)
-        return {
+            adapters = None
+            if self._adapters is not None:
+                adapters = {
+                    "resident_adapters": len(
+                        self._adapters.resident_models()),
+                    "adapter_pages": self._adapters.resident_pages(),
+                    "adapter_faults": dict(self._adapters.faults),
+                    "adapter_evictions": self._adapters.evictions,
+                }
+        out = {
             "queue_depth": self.queue_depth,
             "active_lanes": active,
             "parked_lanes": parked,
@@ -828,6 +952,12 @@ class ContinuousBatchingEngine:
             "handoffs": self.handoffs,
             "preempted": self.preempted,
         }
+        if adapters is not None:
+            # keys appear ONLY on multi-model engines: single-model
+            # health dicts (and everything derived from them — replay
+            # scorecards, committed bench artifacts) stay byte-identical
+            out.update(adapters)
+        return out
 
     def validate(self, prompt: Sequence[int], max_new: int) -> None:
         """Raise ValueError if the request can never fit the cache —
@@ -842,16 +972,23 @@ class ContinuousBatchingEngine:
     def submit(self, prompt: Sequence[int], max_new: int,
                logprobs: bool = False, temperature: Optional[float] = None,
                top_k: Optional[int] = None,
-               top_p: Optional[float] = None) -> Request:
+               top_p: Optional[float] = None,
+               model: str = "") -> Request:
         """Enqueue one generation; returns a Request whose ``result()``
         blocks until finished. Thread-safe. ``temperature``/``top_k``/
         ``top_p`` override the engine's GenerateConfig for THIS request
-        only (each lane samples with its own request's params)."""
+        only (each lane samples with its own request's params).
+        ``model`` picks the adapter to decode under ("" / the catalog's
+        base name = the base model); requires an adapter catalog and a
+        registered adapter — validated HERE, in the caller's thread,
+        so an unknown model 400s one request instead of reaching the
+        scheduler loop."""
         self.validate(prompt, max_new)
         sampling = self.validate_sampling(temperature=temperature,
                                           top_k=top_k, top_p=top_p)
+        model = self.validate_model(model)
         req = Request(prompt=list(prompt), max_new=max_new,
-                      want_logprobs=logprobs, **sampling)
+                      want_logprobs=logprobs, model=model, **sampling)
         if self.tracer.enabled:
             req.trace_id = self.tracer.new_trace_id()
             req._span_root = self.tracer.new_span_id()
@@ -865,6 +1002,22 @@ class ContinuousBatchingEngine:
             self._queue.append(req)
             self._cv.notify()
         return req
+
+    def validate_model(self, model: Optional[str]) -> str:
+        """Normalize + bounds-check a request's adapter id in the
+        CALLER's thread (same contract as :meth:`validate_sampling`).
+        Returns "" for the base model."""
+        if not model:
+            return ""
+        if self._adapters is None:
+            raise ValueError(
+                f"model {model!r} requested but this engine serves only "
+                "its base model (no adapter catalog configured)")
+        model = self._adapters.catalog.normalize(model)
+        if model and model not in self._adapters.catalog:
+            raise ValueError(f"unknown model {model!r}: not in the "
+                             "adapter catalog")
+        return model
 
     def validate_sampling(self, temperature=None, top_k=None,
                           top_p=None) -> dict:
@@ -999,8 +1152,14 @@ class ContinuousBatchingEngine:
                         blocks, list(p.key)[:len(blocks) * self.kv_block])
                 entries.append(_Prefix(key=p.key, plen=p.plen,
                                        stored=p.stored, blocks=blocks,
-                                       pinned=p.pinned))
+                                       pinned=p.pinned, model=p.model))
             self._prefixes = entries
+            if self._adapters is not None:
+                # adapter pins lived in the dead pool too; re-pin them
+                # into the fresh one (every lane incref died with its
+                # lane above, so active counts legitimately restart)
+                self._adapters.rebuild(self._bpool)
+            self.residency_epoch += 1
         if self.spec_k:
             # the draft cache is donated into _d_decode/_d_prefill too
             self._d_cache = self._place_d_cache(
@@ -1087,6 +1246,14 @@ class ContinuousBatchingEngine:
                 "handoffs": self.handoffs,
                 "prefill_tokens": self.prefill_tokens_total,
             })
+            if self._adapters is not None:
+                # multi-model only: single-model scrapes stay identical
+                out.update({
+                    "adapter_pages": self._adapters.resident_pages(),
+                    "adapter_peak_pages": self._adapters.peak_pages,
+                    "adapter_faults": self._adapters.faults_total(),
+                    "adapter_evictions": self._adapters.evictions,
+                })
         return out
 
     # -- scheduler --------------------------------------------------------
@@ -1110,6 +1277,7 @@ class ContinuousBatchingEngine:
             attributes={"tokens": len(req.tokens),
                         "promptTokens": len(req.prompt),
                         "preemptions": req._preempts,
+                        **({"model": req.model} if req.model else {}),
                         **({"error": req.error} if req.error else {})})
         req._span_root = ""   # finalized: never re-record this root
 
@@ -1138,12 +1306,19 @@ class ContinuousBatchingEngine:
 
     def _free_lane(self, i: int) -> None:
         """Detach lane i's request and return its pool blocks (shared
-        prefix blocks drop one refcount; private ones free)."""
+        prefix blocks drop one refcount; private ones free). The lane's
+        adapter weight-page share releases here too — and ONLY here, so
+        every finish/cancel/preempt/handoff-cancel path decrefs the
+        adapter exactly once."""
         lane = self._lane_state[i]
         if lane.blocks:
             self._bpool.decref(lane.blocks)
             lane.blocks = []
             self._tables[i, :] = 0
+        if lane.adapter_blocks:
+            self._adapters.release(lane.adapter, lane.adapter_blocks)
+            lane.adapter_blocks = []
+        lane.adapter = ""
         lane.request = None
         lane.parked = False
         lane.parked_at = 0.0
@@ -1158,6 +1333,11 @@ class ContinuousBatchingEngine:
         req = s.request
         d.request, d.pos, d.remaining = req, s.pos, s.remaining
         d.blocks, s.blocks = s.blocks, []
+        # the adapter refcount MOVES with the block-table row: the
+        # decode lane inherits the prefill lane's weight-page share
+        # (no incref/decref pair — the share itself transfers)
+        d.adapter, s.adapter = s.adapter, ""
+        d.adapter_blocks, s.adapter_blocks = s.adapter_blocks, []
         d.parked = False
         self._tables[dst, :] = self._tables[src, :]
         self._tables[src, :] = 0
@@ -1466,6 +1646,38 @@ class ContinuousBatchingEngine:
             req = self._queue[0]
             shared, start_p = [], 0
             if self.kv_mode != "dense":
+                if req.model and self._adapters is not None:
+                    # the adapter must be resident BEFORE the request's
+                    # first tick: a cold one faults its weight pages in
+                    # through the pool here (counted per model). Runs
+                    # ahead of the KV watermark so the pages it takes
+                    # are visible to the free-count check below.
+                    v0 = self._adapters.version
+                    pages, faulted = self._adapters.ensure(req.model)
+                    if self._adapters.version != v0:
+                        # fault-in OR evictions along the way: either
+                        # way the resident set moved — invalidate the
+                        # router's cached snapshot of this replica
+                        self.residency_epoch += 1
+                    if faulted:
+                        self.adapter_fault_pages_step += len(pages)
+                    if pages is None:
+                        if not self._active():
+                            # nothing running will ever free pages —
+                            # the adapter can never fit (pool too small
+                            # after prefix + pinned-adapter pins)
+                            self._queue.popleft()
+                            spec = self._adapters.catalog.spec(req.model)
+                            req.error = (
+                                f"adapter {req.model} needs "
+                                f"{spec.pages} weight pages but only "
+                                f"{self._bpool.free_count} blocks are "
+                                f"free and no unpinned adapter is "
+                                f"evictable (pool {self.pool_blocks})")
+                            req._finish(cancelled=True)
+                            self._trace_finish(req, status="error")
+                            return True
+                        return False
                 # admission watermark: the prompt's private blocks plus
                 # headroom must be free, or the head waits (degrading to
                 # fewer concurrent lanes instead of OOM/preempt-thrash).
@@ -1473,7 +1685,8 @@ class ContinuousBatchingEngine:
                 # can change it in between (we hold _sched_lock, which
                 # register_prefix also needs).
                 seq = (req.prompt or [0]) + req.tokens
-                shared, start_p = self._match_prefix_blocks(seq)
+                shared, start_p = self._match_prefix_blocks(
+                    seq, model=req.model)
                 need = self._blocks_for(len(seq)) - len(shared)
                 free = self._bpool.free_count
                 if not self._active():
@@ -1504,6 +1717,13 @@ class ContinuousBatchingEngine:
         # request would never be cancelled and its waiter would hang)
         lane = self._lane_state[lane_idx]
         lane.request = req
+        if req.model and self._adapters is not None:
+            # bind the lane to the (now-resident) adapter: incref its
+            # weight pages for the life of the lane. The residency gate
+            # above ran under the same _sched_lock hold, so nothing can
+            # have evicted it in between.
+            lane.adapter = req.model
+            lane.adapter_blocks = self._adapters.attach(req.model)
         # resume-aware: a preempted request re-prefills prompt PLUS the
         # tokens it already streamed, then continues its budget — the
         # client-visible stream never replays
@@ -1514,7 +1734,7 @@ class ContinuousBatchingEngine:
         prefill_from = 0      # first position actually prefilled (traces)
         if self.kv_mode in ("dense", "parity"):
             if self.kv_mode == "dense":
-                stored, start = self._match_prefix(seq)
+                stored, start = self._match_prefix(seq, model=req.model)
                 prefill_from = start
                 if stored is not None:
                     self._cache = self._load_prefix(self._cache, stored,
@@ -1572,7 +1792,9 @@ class ContinuousBatchingEngine:
                 component="serving",
                 attributes={"tokens": plen - prefill_from,
                             "lane": lane_idx,
-                            "sharedBlocks": len(shared)})
+                            "sharedBlocks": len(shared),
+                            **({"model": req.model} if req.model
+                               else {})})
             req._t_decode = now_t
         if lane.remaining <= 0 or hit_stop(req.tokens, gen):
             self._free_lane(lane_idx)    # finished in prefill
@@ -1615,6 +1837,7 @@ class ContinuousBatchingEngine:
         tick's cadence is independent of prefill work."""
         gen = self.gen
         self.prefill_tokens_step = 0
+        self.adapter_fault_pages_step = 0
         stalled = False
         if self.prefill_lanes:
             self._try_handoffs()

@@ -84,6 +84,7 @@ class ServingFleet:
         #: are gone; fleet-lifetime rollups must not lose them)
         self.reaped_handoffs = 0
         self.reaped_prefill_tokens = 0
+        self.reaped_adapter_faults = 0
         for _ in range(max(int(replicas), 1)):
             self.add_replica()
 
@@ -145,10 +146,16 @@ class ServingFleet:
             self.reaped.append(rep.name)
             self.reaped_handoffs += rep.engine.handoffs
             self.reaped_prefill_tokens += rep.engine.prefill_tokens_total
+            faults = getattr(rep.engine, "adapter_status", None)
+            faults = faults()["faults"] if faults is not None and \
+                getattr(rep.engine, "multi_model", False) else None
+            if faults:
+                self.reaped_adapter_faults += sum(faults.values())
             if self.metrics is not None:
                 # flush the final counter delta before the engine's
                 # health vanishes from refresh()'s view
-                self.metrics.note_reaped(rep.name, rep.engine.handoffs)
+                self.metrics.note_reaped(rep.name, rep.engine.handoffs,
+                                         adapter_faults=faults)
         return [r.name for r in done]
 
     # -- reads ------------------------------------------------------------

@@ -42,26 +42,85 @@ def _prefix_home(prefix, n: int) -> int:
     return int.from_bytes(digest[:8], "big") % n
 
 
+def _model_home(model: str, n: int) -> int:
+    """Stable home replica for a cold MODEL (adapter id): the same
+    consistent-hash partitioning as cold prefixes, applied to the
+    adapter catalog — each replica's pool holds a stable slice of the
+    catalog instead of every replica faulting through all of it
+    (docs/multimodel.md)."""
+    digest = hashlib.sha256(model.encode()).digest()
+    return int.from_bytes(digest[:8], "big") % n
+
+
 class RandomRouter:
     """Uniform placement over non-draining replicas (the baseline)."""
 
     def __init__(self, fleet, seed: int = 0, max_prefixes: int = 8,
-                 metrics=None):
+                 metrics=None, cache_residency: bool = True):
         self.fleet = fleet
         self.rng = random.Random(f"{seed}:router")
         #: per-replica prefix-cache cap for router-driven registration
         self.max_prefixes = int(max_prefixes)
         self.metrics = metrics
+        #: probe residency from per-replica snapshots cached on the
+        #: engine's residency_epoch instead of taking each engine's
+        #: scheduler lock on every probe: a submit is O(1) pool reads
+        #: amortized, and placement decisions are IDENTICAL to the
+        #: uncached path (the snapshot walk mirrors
+        #: _match_prefix_blocks; pinned by a test on the routing leg)
+        self.cache_residency = bool(cache_residency)
+        self._res_cache: dict = {}   # replica name -> snapshot tuple
         self.prefix_hits = 0
         self.prefix_misses = 0
         self.tenant_spills = 0
         self.routed: dict = {}           # replica name -> placements
 
+    # -- cached residency --------------------------------------------------
+
+    def _snapshot(self, rep):
+        eng = rep.engine
+        cached = self._res_cache.get(rep.name)
+        if cached is None or cached[0] != eng.residency_epoch:
+            # epoch moved (prefix registered/evicted, adapter
+            # faulted/evicted, engine recovered) — or first sight of
+            # this replica: take one locked snapshot, then every probe
+            # until the next change is a pure host-side walk
+            cached = eng.residency_snapshot()
+            self._res_cache[rep.name] = cached
+        return cached
+
+    def _residency(self, rep, probe, model: str = "") -> int:
+        """``engine.prefix_residency`` through the snapshot cache (or
+        live, when caching is off / the engine predates snapshots)."""
+        eng = rep.engine
+        if not self.cache_residency or \
+                not hasattr(eng, "residency_snapshot"):
+            if model:
+                return eng.prefix_residency(probe, model=model)
+            return eng.prefix_residency(probe)
+        _, prefixes, _, kv_block = self._snapshot(rep)
+        probe_t = tuple(int(t) for t in probe)
+        n = len(probe_t)
+        for pmodel, key, nblocks in prefixes:   # longest-first, like
+            if pmodel == model and n >= len(key) \
+                    and probe_t[:len(key)] == key:  # _match_prefix_blocks
+                return min(nblocks, (n - 1) // kv_block)
+        return 0
+
+    def _adapter_resident(self, rep, model: str) -> bool:
+        eng = rep.engine
+        if not self.cache_residency or \
+                not hasattr(eng, "residency_snapshot"):
+            fn = getattr(eng, "adapter_resident", None)
+            return bool(fn(model)) if fn is not None else False
+        return model in self._snapshot(rep)[2]
+
     # -- placement --------------------------------------------------------
 
     def select(self, prompt: Sequence[int], tenant: Optional[str] = None,
                prefix: Optional[Sequence[int]] = None,
-               version: Optional[int] = None):
+               version: Optional[int] = None,
+               model: Optional[str] = None):
         reps = self._candidates(version)
         return reps[self.rng.randrange(len(reps))]
 
@@ -84,15 +143,23 @@ class RandomRouter:
                                "or fully draining)")
         return reps
 
-    def _ensure_prefix(self, rep, prefix) -> None:
-        if not rep.engine.has_prefix(prefix):
+    def _ensure_prefix(self, rep, prefix, model: str = "") -> None:
+        # model-scoped both ways: the warm-check and the registration
+        # key on (model, tokens) — model kwargs only when scoped, so
+        # engines/stubs that predate multi-model keep working
+        if model:
+            if not rep.engine.has_prefix(prefix, model=model):
+                rep.engine.register_prefix(list(prefix),
+                                           max_prefixes=self.max_prefixes,
+                                           model=model)
+        elif not rep.engine.has_prefix(prefix):
             rep.engine.register_prefix(list(prefix),
                                        max_prefixes=self.max_prefixes)
 
-    def _account(self, rep, prefix) -> None:
+    def _account(self, rep, prefix, model: str = "") -> None:
         self.routed[rep.name] = self.routed.get(rep.name, 0) + 1
         if prefix is not None:
-            if rep.engine.prefix_residency(prefix) > 0:
+            if self._residency(rep, prefix, model) > 0:
                 self.prefix_hits += 1
                 if self.metrics is not None:
                     self.metrics.router_prefix_hits.inc()
@@ -104,17 +171,24 @@ class RandomRouter:
     def submit(self, prompt: Sequence[int], max_new: int,
                tenant: Optional[str] = None,
                prefix: Optional[Sequence[int]] = None,
-               version: Optional[int] = None, **kw):
+               version: Optional[int] = None,
+               model: Optional[str] = None, **kw):
         """Place + submit one request; returns ``(Request, replica)``.
         ``prefix`` is the client-declared shared prefix (system prompt)
         — the placement signal and the router-driven registration
         unit. ``version`` pins placement to replicas advertising that
-        policy version (the rollout tenant's same-weights guarantee)."""
+        policy version (the rollout tenant's same-weights guarantee).
+        ``model`` is the adapter id for multi-model fleets
+        (docs/multimodel.md): it scopes the prefix work and rides down
+        to ``engine.submit`` so admission gates on residency."""
+        model = model or ""
         rep = self.select(prompt, tenant=tenant, prefix=prefix,
-                          version=version)
-        self._account(rep, prefix)
+                          version=version, model=model)
+        self._account(rep, prefix, model)
         if prefix is not None:
-            self._ensure_prefix(rep, prefix)
+            self._ensure_prefix(rep, prefix, model)
+        if model:
+            kw = dict(kw, model=model)
         req = rep.engine.submit(prompt, max_new, **kw)
         self._note_submitted(rep, tenant, req)
         return req, rep
@@ -140,9 +214,16 @@ class PrefixAwareRouter(RandomRouter):
 
     def __init__(self, fleet, seed: int = 0, max_prefixes: int = 8,
                  queues: Sequence = (), hot_queue_depth: int = 4,
-                 metrics=None):
+                 metrics=None, cache_residency: bool = True,
+                 adapter_affinity: bool = True):
         super().__init__(fleet, seed=seed, max_prefixes=max_prefixes,
-                         metrics=metrics)
+                         metrics=metrics, cache_residency=cache_residency)
+        #: multi-model placement (docs/multimodel.md): prefer replicas
+        #: where the request's adapter is already resident; a cold
+        #: model gets a consistent-hash home. Off = adapter-BLIND
+        #: routing (the bench_multimodel comparison arm): the model
+        #: still rides to the engine, but placement ignores it.
+        self.adapter_affinity = bool(adapter_affinity)
         #: tenant -> queue name, from the Queue API's tenant lists (the
         #: slice scheduler's exact routing rule, docs/scheduling.md);
         #: unrouted tenants land on the implicit default queue
@@ -193,6 +274,12 @@ class PrefixAwareRouter(RandomRouter):
                 k: live for k, v in self._outstanding.items()
                 if k[0] in live_names
                 and (live := [r for r in v if not r.done.is_set()])}
+            # reaped replicas' residency snapshots go with them (the
+            # reap side of snapshot invalidation; epoch mismatches
+            # handle every registration/eviction on live replicas)
+            self._res_cache = {name: snap for name, snap
+                               in self._res_cache.items()
+                               if name in live_names}
 
     def _over_share(self, rep, queue: str) -> bool:
         """Would this queue exceed its fair share of ``rep``'s
@@ -208,15 +295,31 @@ class PrefixAwareRouter(RandomRouter):
 
     def select(self, prompt: Sequence[int], tenant: Optional[str] = None,
                prefix: Optional[Sequence[int]] = None,
-               version: Optional[int] = None):
+               version: Optional[int] = None,
+               model: Optional[str] = None):
         reps = self._candidates(version)
         probe = prefix if prefix is not None else prompt
-        scored = [(rep.engine.prefix_residency(probe),
+        model = (model or "") if self.adapter_affinity else ""
+        # adapter residency DOMINATES prefix residency: adapter weight
+        # pages are the heavier thing to move (a fault allocates pages
+        # and may evict another model), and a prefix can be registered
+        # cheaply wherever the adapter lives — never the reverse
+        scored = [(1 if model and self._adapter_resident(rep, model)
+                   else 0,
+                   self._residency(rep, probe, model),
                    -rep.engine.queue_depth, -i, rep)
                   for i, rep in enumerate(reps)]
-        scored.sort(reverse=True)        # residency desc, depth asc, FIFO
-        best = scored[0][3]
-        if scored[0][0] == 0 and prefix is not None:
+        scored.sort(reverse=True)   # adapter desc, residency desc,
+        best = scored[0][4]         # depth asc, FIFO
+        if model and scored[0][0] == 0:
+            # model resident nowhere: give it a stable consistent-hash
+            # home so the fleet PARTITIONS the catalog — each replica's
+            # pool converges on its slice of the models instead of
+            # every replica churning through all of them
+            best = reps[_model_home(model, len(reps))]
+        elif not model and scored[0][1] == 0 and prefix is not None:
+            # (model requests skip prefix homing: wherever the adapter
+            # lives — or was just homed — is where the prefix belongs)
             # nowhere warm: give the prefix a stable home so its NEXT
             # requests find it resident (and other prefixes' homes stay
             # unpolluted) instead of piling every cold prefix onto the
@@ -229,7 +332,7 @@ class PrefixAwareRouter(RandomRouter):
             # already holds its share of it: spill to the least-loaded
             # other replica instead of monopolizing the prefix-warm one
             others = sorted(((rep.engine.queue_depth, i, rep)
-                             for i, (_, _, _, rep) in enumerate(scored)
+                             for i, (_, _, _, _, rep) in enumerate(scored)
                              if rep is not best))
             self.tenant_spills += 1
             if self.metrics is not None:

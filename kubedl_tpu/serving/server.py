@@ -722,11 +722,28 @@ class InferenceServer:
         # is evicted — only an all-pinned cache still rejects).
         # `pinned` exempts THIS prefix from that eviction
         # (docs/serving_fleet.md: operator-pinned system prompts
-        # survive router-driven registration churn).
+        # survive router-driven registration churn). `model` scopes the
+        # prefix to one adapter (docs/multimodel.md): two models'
+        # identical token prefixes must never alias each other's KV
+        # blocks — omitted, the prefix belongs to the base model and
+        # existing callers are untouched.
+        kw = {}
+        model = str(body.get("model") or "")
+        if model:
+            if not getattr(self.engine, "multi_model", False):
+                raise ValueError(
+                    f"model {model!r} requested but this engine serves "
+                    "only its base model (no adapter catalog configured)")
+            model = self.engine.validate_model(model)
+            if model:
+                kw["model"] = model
         self.engine.register_prefix([int(t) for t in toks],
                                     max_prefixes=self.config.max_prefixes,
-                                    pinned=bool(body.get("pinned")))
-        return {"registered": len(toks)}
+                                    pinned=bool(body.get("pinned")), **kw)
+        out = {"registered": len(toks)}
+        if model:
+            out["model"] = model
+        return out
 
     def status(self) -> dict:
         return {"model_version_status": [{

@@ -154,6 +154,17 @@ class RequestSpanHarvester:
         self._trace_spans: dict[str, list] = {}
 
     def feed(self, spans) -> list:
+        """``(signal, value, t)`` samples (the public shape); a
+        consumer that needs to attribute samples to requests (the
+        multi-model replay's per-model SLO labels) uses
+        :meth:`feed_traced` instead."""
+        return [(sig, v, t) for sig, v, t, _ in self.feed_traced(spans)]
+
+    def feed_traced(self, spans) -> list:
+        """:meth:`feed` plus attribution: ``(signal, value, t,
+        trace_id)`` — same dedup, same derivations, the trace id is
+        the request's root id so a consumer holding a trace→model map
+        can label samples per model (docs/multimodel.md)."""
         out = []
         for s in spans:
             if s.span_id in self._seen:
@@ -165,7 +176,7 @@ class RequestSpanHarvester:
                         s.trace_id, []).append(s.span_id)
                 if s.attributes.get("resumed"):
                     continue
-                out.append(("queue", s.duration, s.end))
+                out.append(("queue", s.duration, s.end, s.trace_id))
                 if s.trace_id not in self._done:
                     self._qstart.setdefault(s.trace_id, s.start)
             elif s.name == "request.prefill":
@@ -176,7 +187,7 @@ class RequestSpanHarvester:
                 t0 = self._qstart.pop(s.trace_id, None)
                 if t0 is not None and s.trace_id not in self._done:
                     self._done[s.trace_id] = s.end
-                    out.append(("ttft", s.end - t0, s.end))
+                    out.append(("ttft", s.end - t0, s.end, s.trace_id))
             elif s.name == "serving.request" and not self._prune:
                 # ring-clearing mode: the request is complete and its
                 # spans can never be re-offered, so its bookkeeping is

@@ -1,0 +1,550 @@
+"""Multi-model serving (docs/multimodel.md): adapter catalog + paged
+weight residency over the refcounted block pool, model-scoped prefix
+cache, adapter-affine routing with consistent-hash homes, per-model
+SLOs on the replay day — and the gate-off contract."""
+
+import dataclasses
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from kubedl_tpu.controllers.servingfleet import (AutoscalerConfig,  # noqa: E402
+                                                 ServingAutoscaler)
+from kubedl_tpu.models import llama  # noqa: E402
+from kubedl_tpu.serving.adapters import (AdapterCatalog,  # noqa: E402
+                                         AdapterSpec)
+from kubedl_tpu.serving.batching import ContinuousBatchingEngine  # noqa: E402
+from kubedl_tpu.serving.fleet import ServingFleet  # noqa: E402
+from kubedl_tpu.serving.router import (PrefixAwareRouter,  # noqa: E402
+                                       _model_home)
+
+pytestmark = pytest.mark.multimodel
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = dataclasses.replace(
+        llama.tiny(vocab=128), d_model=64, n_heads=2, n_kv_heads=2,
+        d_ff=128, dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def make_catalog(pages=2, models=("m-a", "m-b", "m-c")):
+    cat = AdapterCatalog()
+    for m in models:
+        cat.register(AdapterSpec(model=m, pages=pages))
+    return cat
+
+
+def make_engine(model, lanes=3, prefill_lanes=0, pool_blocks=24,
+                max_len=64, kv_block=8, **kw):
+    cfg, params = model
+    return ContinuousBatchingEngine(
+        cfg, params, lanes=lanes, max_len=max_len, kv_mode="paged",
+        kv_block=kv_block, pool_blocks=pool_blocks,
+        prefill_lanes=prefill_lanes, **kw)
+
+
+def mm_fleet(model, cat, n=2, max_adapters=3, pool_blocks=32, lanes=3):
+    def factory(idx):
+        return make_engine(model, lanes=lanes, pool_blocks=pool_blocks,
+                           seed=idx, adapters=cat,
+                           max_adapters=max_adapters)
+    return ServingFleet(factory, replicas=n)
+
+
+# ----------------------------------------------------------------------
+# adapter lifecycle: the register_prefix eviction contract on weights
+# (ISSUE satellite: lifecycle tests)
+# ----------------------------------------------------------------------
+
+def test_lru_evict_mid_flight_drains_refcounts(model):
+    """Evicting the LRU adapter while a lane still decodes under it
+    must not free the weight pages out from under the lane: the PIN's
+    refcount drops, the lane's share survives, and the pages return to
+    the pool only when the request finishes."""
+    cat = make_catalog()
+    eng = make_engine(model, lanes=2, pool_blocks=24, adapters=cat,
+                      max_adapters=2)
+    req = eng.submit([5] * 12, 8, model="m-a")
+    eng.step()
+    st = eng.adapter_status()
+    assert eng.adapter_resident("m-a")
+    assert st["faults"] == {"m-a": 1} and st["active"] == {"m-a": 1}
+    eng.load_adapter("m-b")
+    eng.load_adapter("m-c")          # cap 2: evicts m-a (LRU), in flight
+    st = eng.adapter_status()
+    assert not eng.adapter_resident("m-a")
+    assert st["resident"] == ["m-b", "m-c"] and st["evictions"] == 1
+    # the evicted adapter's 2 pages are still alive under the lane's
+    # incref: only the two pins (2 pages each) plus live KV are held
+    live_kv = sum(len(ln.blocks) for ln in eng._lane_state)
+    assert eng._bpool.free_count == eng.pool_blocks - live_kv - 2 - 4
+    while eng.step():
+        pass
+    assert req.result() and len(req.tokens) == 8
+    # the lane's share drained to zero: only the two pins remain
+    assert eng._bpool.free_count == eng.pool_blocks - 4
+    assert all(r == 1 for r in eng._bpool.refcounts().values())
+    assert eng.adapter_status()["active"] == {}
+
+
+def test_all_pinned_catalog_still_rejects(model):
+    cat = make_catalog()
+    eng = make_engine(model, lanes=2, pool_blocks=24, adapters=cat,
+                      max_adapters=2)
+    eng.load_adapter("m-a", pinned=True)
+    eng.load_adapter("m-b", pinned=True)
+    with pytest.raises(ValueError, match="pinned"):
+        eng.load_adapter("m-c")
+    # idempotent re-load of a resident adapter pins no new pages
+    eng.load_adapter("m-a", pinned=True)
+    st = eng.adapter_status()
+    assert st["resident"] == ["m-a", "m-b"] == st["pinned"]
+    assert eng._bpool.free_count == eng.pool_blocks - 4
+
+
+def test_cancel_mid_handoff_releases_adapter_exactly_once(model):
+    """A model request cancelled while PARKED (prefilled, waiting for a
+    decode lane) must drop its adapter-page share exactly once — the
+    pin stays resident, the pool restores to pins + live KV."""
+    cat = make_catalog()
+    eng = make_engine(model, lanes=3, prefill_lanes=1, pool_blocks=30,
+                      adapters=cat, max_adapters=3)
+    long_a = eng.submit([1, 2, 3], 30)
+    long_b = eng.submit([4, 5, 6], 30)
+    eng.step()
+    assert eng.health()["active_lanes"] == 2
+    victim = eng.submit([7] * 33, 10, model="m-a")
+    eng.step()
+    assert eng.health()["parked_lanes"] == 1
+    assert eng.adapter_status()["active"] == {"m-a": 1}
+    victim.cancel()
+    eng.step()                       # the handoff pass frees it
+    assert eng.health()["parked_lanes"] == 0
+    st = eng.adapter_status()
+    assert st["active"] == {} and st["resident"] == ["m-a"]
+    live_kv = sum(len(ln.blocks) for ln in eng._lane_state)
+    assert eng._bpool.free_count == eng.pool_blocks - live_kv - 2
+    while eng.step():
+        pass
+    assert long_a.result() and long_b.result()
+    assert victim.done.is_set() and not victim.cancelled
+    # exactly-once: a double release would free the pin's pages too
+    assert eng._bpool.free_count == eng.pool_blocks - 2
+    assert all(r == 1 for r in eng._bpool.refcounts().values())
+
+
+def test_handoff_moves_adapter_refcount_and_tokens_match_base(model):
+    """The prefill→decode handoff MOVES the adapter share with the
+    block-table row (never re-increfs), and residency is host-side
+    accounting only: a model request's greedy tokens equal the base
+    model's for the same prompt."""
+    cat = make_catalog()
+    disagg = make_engine(model, lanes=4, prefill_lanes=1, pool_blocks=24,
+                         adapters=cat, max_adapters=3)
+    req = disagg.submit([5] * 20, 4, model="m-b")
+    while disagg.step():
+        pass
+    assert req.result() and disagg.handoffs == 1
+    combined = make_engine(model, lanes=3, pool_blocks=24)
+    assert [req.tokens] == combined.run([([5] * 20, 4)])
+    # everything but the pin returned exactly once across the handoff
+    assert disagg._bpool.free_count == disagg.pool_blocks - 2
+    assert all(r == 1 for r in disagg._bpool.refcounts().values())
+
+
+def test_submit_validates_model_in_caller_thread(model):
+    cat = make_catalog()
+    eng = make_engine(model, lanes=2, adapters=cat)
+    with pytest.raises(ValueError, match="catalog"):
+        eng.submit([1, 2], 2, model="nope")
+    plain = make_engine(model, lanes=2)
+    with pytest.raises(ValueError, match="base model"):
+        plain.submit([1, 2], 2, model="m-a")
+    # "" and the catalog's base name are the base model: no adapter
+    r = eng.submit([1, 2], 2, model="base")
+    while eng.step():
+        pass
+    assert r.result() and eng.adapter_status()["faults"] == {}
+
+
+# ----------------------------------------------------------------------
+# model-scoped prefix cache (ISSUE satellite: cross-model cache leak)
+# ----------------------------------------------------------------------
+
+def test_prefix_cache_keyed_by_model_never_aliases(model):
+    """Model A's registered prefix must never serve model B (or the
+    base model): same tokens, different KV blocks — the regression pin
+    for the cross-model cache leak."""
+    cat = make_catalog()
+    eng = make_engine(model, lanes=2, pool_blocks=32, adapters=cat,
+                      max_adapters=3)
+    p = [3] * 16
+    eng.register_prefix(p, model="m-a")
+    assert eng.has_prefix(p, model="m-a")
+    assert not eng.has_prefix(p) and not eng.has_prefix(p, model="m-b")
+    probe = list(p) + [9, 9]
+    assert eng.prefix_residency(probe, model="m-a") >= 2
+    assert eng.prefix_residency(probe) == 0
+    assert eng.prefix_residency(probe, model="m-b") == 0
+    # model B prefills the WHOLE prompt; model A skips the shared
+    # blocks; greedy tokens are identical either way
+    before = eng.prefill_tokens_total
+    rb = eng.submit(probe, 2, model="m-b")
+    while eng.step():
+        pass
+    cold = eng.prefill_tokens_total - before
+    before = eng.prefill_tokens_total
+    ra = eng.submit(probe, 2, model="m-a")
+    while eng.step():
+        pass
+    warm = eng.prefill_tokens_total - before
+    assert rb.result() == ra.result()
+    assert warm <= cold - 16, (warm, cold)
+
+
+def _post(base, path, body):
+    req = urllib.request.Request(
+        base + path, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    return urllib.request.urlopen(req, timeout=30)
+
+
+def test_register_prefix_route_model_field(model):
+    from kubedl_tpu.serving.server import InferenceServer, ServerConfig
+    cat = make_catalog()
+    eng = make_engine(model, lanes=2, pool_blocks=32, adapters=cat,
+                      max_adapters=3).start()
+    server = InferenceServer(eng, ServerConfig(
+        model_name="m", host="127.0.0.1", port=0)).start()
+    try:
+        with _post(server.url, "/v1/models/m:registerPrefix",
+                   {"prefix_tokens": [1, 2, 3], "model": "m-a"}) as r:
+            out = json.load(r)
+        assert out["registered"] == 3 and out["model"] == "m-a"
+        assert eng.has_prefix([1, 2, 3], model="m-a")
+        assert not eng.has_prefix([1, 2, 3])
+        # no model in the body: base-scoped, the pre-multi-model shape
+        # (existing callers untouched — no "model" key in the response)
+        with _post(server.url, "/v1/models/m:registerPrefix",
+                   {"prefix_tokens": [4, 5, 6]}) as r:
+            out = json.load(r)
+        assert out == {"registered": 3}
+        assert eng.has_prefix([4, 5, 6])
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(server.url, "/v1/models/m:registerPrefix",
+                  {"prefix_tokens": [7, 8], "model": "nope"})
+        assert ei.value.code == 400
+    finally:
+        server.stop()
+        eng.stop()
+
+
+def test_register_prefix_route_model_needs_catalog(model):
+    from kubedl_tpu.serving.server import InferenceServer, ServerConfig
+    eng = make_engine(model, lanes=2).start()
+    server = InferenceServer(eng, ServerConfig(
+        model_name="m", host="127.0.0.1", port=0)).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(server.url, "/v1/models/m:registerPrefix",
+                  {"prefix_tokens": [1, 2], "model": "m-a"})
+        assert ei.value.code == 400
+    finally:
+        server.stop()
+        eng.stop()
+
+
+# ----------------------------------------------------------------------
+# router: adapter affinity, consistent-hash homes, the blind arm,
+# cached residency snapshots (ISSUE satellite: probe cost)
+# ----------------------------------------------------------------------
+
+def test_router_prefers_adapter_resident_replica(model):
+    cat = make_catalog()
+    fleet = mm_fleet(model, cat, n=2)
+    router = PrefixAwareRouter(fleet, max_prefixes=4)
+    fleet.replicas[1].engine.load_adapter("m-a")
+    homes = set()
+    for _ in range(3):
+        req, rep = router.submit([1, 2, 3], 2, model="m-a")
+        homes.add(rep.name)
+        while fleet.step():
+            pass
+        assert req.result()
+    assert homes == {"replica-1"}
+    # affinity kept every placement on the warm pool: zero faults
+    assert all(r.engine.adapter_status()["faults"] == {}
+               for r in fleet.replicas)
+
+
+def test_router_cold_model_goes_to_consistent_hash_home(model):
+    cat = make_catalog()
+    fleet = mm_fleet(model, cat, n=2)
+    router = PrefixAwareRouter(fleet, max_prefixes=4)
+    want = fleet.replicas[_model_home("m-b", 2)].name
+    req, rep = router.submit([9, 9], 2, model="m-b")
+    assert rep.name == want
+    while fleet.step():
+        pass
+    assert req.result()
+    # exactly one fault, on the home replica
+    faults = {r.name: r.engine.adapter_status()["faults"]
+              for r in fleet.replicas}
+    assert faults[want] == {"m-b": 1}
+    assert all(f == {} for n, f in faults.items() if n != want)
+
+
+def test_blind_arm_ignores_residency(model):
+    cat = make_catalog()
+    fleet = mm_fleet(model, cat, n=2)
+    router = PrefixAwareRouter(fleet, max_prefixes=4,
+                               adapter_affinity=False)
+    fleet.replicas[1].engine.load_adapter("m-a")
+    _req, rep = router.submit([1, 2, 3], 2, model="m-a")
+    # placement ignored the warm replica (scoring saw no model at all)
+    assert rep.name == "replica-0"
+    while fleet.step():
+        pass
+    assert fleet.replicas[0].engine.adapter_status()["faults"] == \
+        {"m-a": 1}
+
+
+def test_cached_residency_snapshots_match_uncached_placement(model):
+    """The O(1) snapshot cache must be a pure optimization: identical
+    placements to live per-probe engine calls on an identical request
+    sequence (prefix, model, and base traffic interleaved)."""
+    cat = make_catalog()
+    pfx = [7] * 16
+    placements = []
+    routers = []
+    for cached in (True, False):
+        fleet = mm_fleet(model, cat, n=2)
+        router = PrefixAwareRouter(fleet, max_prefixes=4,
+                                   cache_residency=cached)
+        routers.append(router)
+        seen = []
+        for i in range(12):
+            if i % 3 == 0:
+                _r, rep = router.submit(list(pfx) + [i + 1, 1], 2,
+                                        prefix=pfx)
+            elif i % 3 == 1:
+                _r, rep = router.submit([9, i + 1], 2,
+                                        model="m-a" if i % 2 else "m-b")
+            else:
+                _r, rep = router.submit([5, i + 1], 2)
+            seen.append(rep.name)
+            while fleet.step():
+                pass
+        placements.append(seen)
+    assert placements[0] == placements[1]
+    assert routers[0]._res_cache          # the cached arm actually cached
+    assert not routers[1]._res_cache
+
+
+# ----------------------------------------------------------------------
+# autoscaler: adapter-fault pressure (residency thrash)
+# ----------------------------------------------------------------------
+
+def test_autoscaler_scales_up_on_adapter_fault_thrash(model):
+    cat = make_catalog(pages=1, models=("m-a", "m-b"))
+    fleet = mm_fleet(model, cat, n=1, max_adapters=1, pool_blocks=24)
+    eng = fleet.replicas[0].engine
+    for m in ("m-a", "m-b", "m-a", "m-b"):
+        req = eng.submit([3, 4, 5], 2, model=m)
+        while eng.step():
+            pass
+        assert req.result()
+    assert eng.adapter_status()["evictions"] == 3
+    asc = ServingAutoscaler(
+        fleet, config=AutoscalerConfig(
+            min_replicas=1, max_replicas=2, cooldown_s=0.0,
+            queue_high=100, adapter_faults_high=3))
+    # no queued work: thrash alone must NOT trigger (delta consumed)
+    assert asc._pressure() is None
+    for m in ("m-a", "m-b", "m-a", "m-b"):
+        eng.submit([3, 4, 5], 2, model=m)
+        while eng.step():
+            pass
+    eng.submit([1, 2], 2)
+    eng.submit([3, 4], 2)                 # queued: qd > 0
+    actions = asc.step(0.0)
+    assert any("residency thrash" in a for a in actions), actions
+    assert fleet.size == 2 and asc.scale_ups == 1
+    while fleet.step():
+        pass
+
+
+# ----------------------------------------------------------------------
+# metrics: gated families, refresh() sweeping reaped replicas
+# (ISSUE satellite: series hygiene)
+# ----------------------------------------------------------------------
+
+def test_metrics_refresh_drops_reaped_replica_series(model):
+    from kubedl_tpu.metrics.registry import (Registry,
+                                             ServingFleetMetrics)
+    reg = Registry()
+    cat = make_catalog()
+    fleet = mm_fleet(model, cat, n=2)
+    fleet.metrics = ServingFleetMetrics(reg, multi_model=True)
+    router = PrefixAwareRouter(fleet, max_prefixes=4)
+    req, rep = router.submit([1, 2, 3], 2, model="m-a")
+    while fleet.step():
+        pass
+    assert req.result()
+    fleet.refresh_metrics()
+    body = reg.expose()
+    assert 'kubedl_serving_adapter_resident{replica="replica-0"}' in body
+    assert 'kubedl_serving_adapter_resident{replica="replica-1"}' in body
+    assert 'kubedl_serving_adapter_faults_total{model="m-a"} 1.0' in body
+    drained = fleet.begin_drain()
+    while fleet.step():
+        pass
+    assert fleet.reap() == [drained.name]
+    fleet.refresh_metrics()
+    body = reg.expose()
+    # the reaped replica's per-replica adapter series are swept; the
+    # fault COUNTER keeps its total (note_reaped flushed the deltas)
+    assert f'kubedl_serving_adapter_resident{{replica="{drained.name}"}}' \
+        not in body
+    assert f'kubedl_serving_adapter_pages{{replica="{drained.name}"}}' \
+        not in body
+    assert 'kubedl_serving_adapter_faults_total{model="m-a"} 1.0' in body
+
+
+# ----------------------------------------------------------------------
+# gate-off contract + console + fail-fast
+# ----------------------------------------------------------------------
+
+def _console(proxy):
+    from kubedl_tpu.console.server import ConsoleConfig, ConsoleServer
+    return ConsoleServer(proxy, ConsoleConfig(host="127.0.0.1", port=0,
+                                              users={}))
+
+
+def test_gate_requires_serving_fleet():
+    from kubedl_tpu.__main__ import parse_args
+    from kubedl_tpu.controllers.registry import (OperatorConfig,
+                                                 build_operator)
+    with pytest.raises(ValueError, match="serving fleet"):
+        build_operator(config=OperatorConfig(
+            workloads=[], enable_multi_model=True))
+    with pytest.raises(SystemExit):
+        parse_args(["--enable-multi-model"])
+    args = parse_args(["--enable-multi-model", "--enable-serving-fleet"])
+    assert args.enable_multi_model and args.enable_serving_fleet
+
+
+def test_gate_off_no_adapter_families_console_501():
+    from kubedl_tpu.console.proxy import DataProxy
+    from kubedl_tpu.controllers.registry import (OperatorConfig,
+                                                 build_operator)
+    op = build_operator(config=OperatorConfig(workloads=[]))
+    assert not op.multi_model_enabled
+    assert "kubedl_serving_adapter_" not in op.metrics_registry.expose()
+    # the serving fleet alone must not leak adapter families either
+    op2 = build_operator(config=OperatorConfig(
+        workloads=[], enable_serving_fleet=True))
+    assert not op2.multi_model_enabled
+    assert "kubedl_serving_adapter_" not in op2.metrics_registry.expose()
+    server = _console(DataProxy(op.api))
+    try:
+        status, payload, _ = server.route(
+            "GET", "/api/v1/serving/models", {}, b"", None)
+        assert status == 501 and "multi-model" in payload["msg"]
+    finally:
+        server._httpd.server_close()
+
+
+def test_gate_on_families_and_console_models_status(model):
+    from kubedl_tpu.console.proxy import DataProxy
+    from kubedl_tpu.controllers.registry import (OperatorConfig,
+                                                 build_operator)
+    op = build_operator(config=OperatorConfig(
+        workloads=[], enable_serving_fleet=True,
+        enable_multi_model=True))
+    assert op.multi_model_enabled
+    body = op.metrics_registry.expose()
+    for family in ("kubedl_serving_adapter_faults_total",
+                   "kubedl_serving_adapter_resident",
+                   "kubedl_serving_adapter_pages"):
+        assert f"# TYPE {family} " in body
+    cat = make_catalog()
+    fleet = mm_fleet(model, cat, n=2)
+    fleet.replicas[0].engine.load_adapter("m-a", pinned=True)
+    server = _console(DataProxy(op.api, serving_fleet=fleet,
+                                adapter_catalog=cat))
+    try:
+        status, payload, _ = server.route(
+            "GET", "/api/v1/serving/models", {}, b"", None)
+        assert status == 200
+        data = payload["data"]
+        assert data["baseModel"] == "base"
+        assert [m["model"] for m in data["models"]] == \
+            ["m-a", "m-b", "m-c"]
+        by_name = {r["replica"]: r for r in data["replicas"]}
+        assert by_name["replica-0"]["adapters"]["resident"] == ["m-a"]
+        assert by_name["replica-0"]["adapters"]["pinned"] == ["m-a"]
+    finally:
+        server._httpd.server_close()
+
+
+# ----------------------------------------------------------------------
+# the replay day, tiny scale: determinism + aware-vs-blind
+# ----------------------------------------------------------------------
+
+MM_SMOKE = dict(sim_seconds=240.0, requests=100, bursts=6, replicas=2,
+                max_replicas=2, decode_lanes=4, prefill_lanes=1,
+                pool_blocks=64, prefixes=6, max_prefixes_per_replica=4,
+                zipf_s=0.7, adapters=6, adapter_pages=2,
+                adapter_share=0.7, max_adapters_per_replica=2,
+                adapter_fault_page_s=0.03)
+
+
+def _mm_profile(**over):
+    from kubedl_tpu.replay.multimodel import MultiModelProfile
+    return MultiModelProfile(name="mm-smoke", **{**MM_SMOKE, **over})
+
+
+def test_smoke_multimodel_replay_deterministic(model):
+    from kubedl_tpu.replay.multimodel import (MultiModelReplay,
+                                              generate_multimodel)
+    p = _mm_profile(requests=60, sim_seconds=120.0)
+    a = MultiModelReplay(generate_multimodel(p, 1), model=model).run()
+    b = MultiModelReplay(generate_multimodel(p, 1), model=model).run()
+    assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+    mm = a["multi_model"]
+    assert mm["models_reported"] == mm["models"] == 6
+    assert a["requests_completed"] == a["requests_submitted"]
+    assert a["dropped_streams"] == 0 and a["errors"] == 0
+
+
+@pytest.mark.perf
+def test_smoke_multimodel_aware_beats_blind(model):
+    """The bench's comparison at smoke scale: a 6-adapter catalog over
+    2 replicas capped at 2 resident each — affinity partitions the
+    catalog, the blind arm churns every replica through all of it."""
+    from kubedl_tpu.replay.multimodel import (MultiModelReplay,
+                                              generate_multimodel)
+    p = _mm_profile()
+    aware = MultiModelReplay(generate_multimodel(p, 0),
+                             adapter_affinity=True, model=model).run()
+    blind = MultiModelReplay(generate_multimodel(p, 0),
+                             adapter_affinity=False, model=model).run()
+    a, b = aware["multi_model"], blind["multi_model"]
+    assert aware["requests_completed"] == aware["requests_submitted"]
+    assert blind["requests_completed"] == blind["requests_submitted"]
+    assert aware["errors"] == 0 and blind["errors"] == 0
+    assert a["adapter_faults"] < b["adapter_faults"], (a, b)
+    assert a["hbm"]["within_cap"] == 1 and b["hbm"]["within_cap"] == 1
+    # every model's compliance column reported on both arms
+    assert a["models_reported"] == b["models_reported"] == 6
+    # token outputs identical across arms: residency only moves time
+    assert aware["tokens_generated"] == blind["tokens_generated"]
